@@ -1,0 +1,58 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference's closest analogue to a test backend is the driver-local
+``HorovodRunner(np=-1)`` smoke mode (reference P1/03:385-397); we
+generalize that to a CPU backend with 8 virtual devices so every
+distributed code path (shard_map, pjit, collectives) runs under plain
+pytest with no TPU attached (SURVEY.md §4).
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import io
+import random
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def flower_dir(tmp_path_factory):
+    """Synthetic stand-in for the tf_flowers directory tree.
+
+    Mirrors the reference dataset layout (class-name parent dirs of JPEGs,
+    reference P1/01_data_prep.py:57-66): <root>/<label>/<name>.jpg.
+    """
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("flowers")
+    rng = random.Random(42)
+    classes = ["daisy", "dandelion", "roses", "sunflowers", "tulips"]
+    for ci, cls in enumerate(classes):
+        d = root / cls
+        d.mkdir()
+        for i in range(8):
+            arr = np.zeros((48, 64, 3), dtype=np.uint8)
+            arr[..., ci % 3] = 40 + 20 * (i % 5)
+            arr[i % 48, :, :] = 255
+            img = Image.fromarray(arr)
+            buf = io.BytesIO()
+            img.save(buf, format="JPEG", quality=rng.randint(70, 95))
+            (d / f"img_{i}.jpg").write_bytes(buf.getvalue())
+        # a non-jpg file that ingest must skip
+        (d / "notes.txt").write_text("not an image")
+    return root

@@ -1,0 +1,107 @@
+"""Loader tests (C5): sharding, infinite streams, determinism, converter."""
+
+import numpy as np
+import pytest
+
+from tpuflow.data import TableStore, ingest_images, add_label_from_path
+from tpuflow.data import build_label_index, index_labels, make_dataset
+from tpuflow.data.loader import make_converter
+
+
+@pytest.fixture(scope="module")
+def silver_table(tmp_path_factory, flower_dir):
+    store = TableStore(str(tmp_path_factory.mktemp("tbl")), "db")
+    bronze = store.table("bronze")
+    ingest_images(str(flower_dir), bronze)
+    t = add_label_from_path(bronze.read())
+    t = index_labels(t, build_label_index(t))
+    silver = store.table("silver")
+    silver.write(t, compression=None)
+    return silver
+
+
+def test_batch_shapes_and_dtypes(silver_table):
+    ds = make_dataset(silver_table, batch_size=8, infinite=False,
+                      img_height=32, img_width=32, shuffle=False)
+    batches = list(ds)
+    assert len(batches) == 40 // 8
+    b = batches[0]
+    assert b["image"].shape == (8, 32, 32, 3) and b["image"].dtype == np.uint8
+    assert b["label"].shape == (8,) and b["label"].dtype == np.int32
+    assert set(np.concatenate([b["label"] for b in batches]).tolist()) <= set(range(5))
+
+
+def test_sharding_partitions_rows(silver_table):
+    seen = []
+    for shard in range(4):
+        ds = make_dataset(silver_table, batch_size=1, infinite=False,
+                          shard=(shard, 4), img_height=16, img_width=16,
+                          shuffle=False)
+        assert len(ds) == 10  # 40 rows / 4 shards
+        seen.append(sum(b["label"].sum() for b in ds))
+    # shards are disjoint: the per-shard label sums must add to the total
+    full = make_dataset(silver_table, batch_size=1, infinite=False,
+                        img_height=16, img_width=16, shuffle=False)
+    assert sum(seen) == sum(b["label"].sum() for b in full)
+
+
+def test_infinite_stream_and_reshuffle(silver_table):
+    ds = make_dataset(silver_table, batch_size=40, infinite=True,
+                      img_height=16, img_width=16, seed=3)
+    it = iter(ds)
+    e0 = next(it)["label"]
+    e1 = next(it)["label"]  # second epoch: same multiset, new order
+    assert sorted(e0.tolist()) == sorted(e1.tolist())
+    assert e0.tolist() != e1.tolist()
+
+
+def test_determinism_given_seed(silver_table):
+    a = next(iter(make_dataset(silver_table, batch_size=8, seed=5,
+                               img_height=16, img_width=16)))
+    b = next(iter(make_dataset(silver_table, batch_size=8, seed=5,
+                               img_height=16, img_width=16)))
+    assert np.array_equal(a["image"], b["image"])
+    assert np.array_equal(a["label"], b["label"])
+
+
+def test_converter_lifecycle(tmp_path, silver_table):
+    conv = make_converter(silver_table, str(tmp_path / "cache"), min_partitions=4)
+    assert len(conv) == 40
+    assert len(conv.files) == 4  # ≙ repartition(world_size), P1/03:109-111
+    ds = conv.make_dataset(batch_size=4, cur_shard=1, shard_count=2,
+                           infinite=False, img_height=16, img_width=16)
+    assert len(ds) == 20
+    import os
+    assert os.path.isdir(conv.cache_path)
+    conv.delete()
+    assert not os.path.isdir(conv.cache_path)
+
+
+def test_steps_per_epoch_accounting(silver_table):
+    # steps = train_size // (BATCH x world) (P1/03:350-351)
+    ds = make_dataset(silver_table, batch_size=4, shard=(0, 2),
+                      img_height=16, img_width=16)
+    assert ds.total_rows == 40
+    assert ds.total_rows // (4 * 2) == ds.steps_per_epoch()
+
+
+def test_starved_shard_raises_instead_of_deadlocking(silver_table):
+    # 40 rows / 16 shards = 2-3 rows per shard < batch_size=4
+    with pytest.raises(ValueError, match="fewer than batch_size"):
+        make_dataset(silver_table, batch_size=4, shard=(0, 16),
+                     img_height=16, img_width=16, infinite=True)
+
+
+def test_abandoned_iterator_does_not_leak_producer(silver_table):
+    import threading
+    before = threading.active_count()
+    for _ in range(5):
+        it = iter(make_dataset(silver_table, batch_size=4, prefetch=1,
+                               img_height=16, img_width=16))
+        next(it)
+        it.close()  # abandon mid-epoch
+    import time
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before

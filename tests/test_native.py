@@ -1,0 +1,85 @@
+"""Native decode-plane tests (N4): correctness vs a numpy reference."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from tpuflow.native import decode_resize_batch, have_native
+import tpuflow.native.binding as binding
+
+
+def _jpeg(arr, quality=95):
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _bilinear_ref(src, dh, dw):
+    """Naive half-pixel-center bilinear (tf.image.resize v2 convention)."""
+    sh, sw, _ = src.shape
+    out = np.empty((dh, dw, 3), dtype=np.float32)
+    ys = np.maximum((np.arange(dh) + 0.5) * sh / dh - 0.5, 0)
+    xs = np.maximum((np.arange(dw) + 0.5) * sw / dw - 0.5, 0)
+    y0 = np.minimum(ys.astype(int), sh - 1)
+    y1 = np.minimum(y0 + 1, sh - 1)
+    x0 = np.minimum(xs.astype(int), sw - 1)
+    x1 = np.minimum(x0 + 1, sw - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    s = src.astype(np.float32)
+    top = s[y0][:, x0] * (1 - wx) + s[y0][:, x1] * wx
+    bot = s[y1][:, x0] * (1 - wx) + s[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return np.clip(out + 0.5, 0, 255).astype(np.uint8)
+
+
+def test_decode_resize_matches_reference():
+    rng = np.random.default_rng(0)
+    arr = (rng.random((90, 120, 3)) * 255).astype(np.uint8)
+    jpeg = _jpeg(arr, quality=100)
+    imgs, ok = decode_resize_batch([jpeg], 64, 48, num_threads=2)
+    assert ok[0] == 1
+    decoded = np.asarray(Image.open(io.BytesIO(jpeg)).convert("RGB"))
+    ref = _bilinear_ref(decoded, 64, 48)
+    diff = np.abs(imgs[0].astype(int) - ref.astype(int))
+    assert diff.mean() < 2.0  # small decode differences allowed
+    assert np.percentile(diff, 99) <= 3
+
+
+def test_corrupt_input_does_not_fail_batch():
+    arr = np.zeros((32, 32, 3), dtype=np.uint8)
+    jpeg = _jpeg(arr)
+    imgs, ok = decode_resize_batch([jpeg, b"notajpeg", jpeg[: len(jpeg) // 2]], 16, 16)
+    assert ok.tolist() == [1, 0, 0]
+    assert imgs[1].sum() == 0
+
+
+def test_identity_resize_roundtrip():
+    arr = (np.arange(48 * 48 * 3) % 255).astype(np.uint8).reshape(48, 48, 3)
+    jpeg = _jpeg(arr, quality=100)
+    imgs, ok = decode_resize_batch([jpeg], 48, 48)
+    decoded = np.asarray(Image.open(io.BytesIO(jpeg)).convert("RGB"))
+    assert ok[0] == 1
+    assert np.array_equal(imgs[0], decoded)
+
+
+def test_preallocated_out_buffer_reuse():
+    arr = np.full((20, 20, 3), 128, dtype=np.uint8)
+    jpeg = _jpeg(arr)
+    out = np.empty((2, 16, 16, 3), dtype=np.uint8)
+    imgs, ok = decode_resize_batch([jpeg, jpeg], 16, 16, out=out)
+    assert imgs is out and ok.all()
+
+
+def test_pil_fallback_agrees_on_upscale():
+    # On upscale PIL's bilinear has no antialias, so both paths should be close.
+    arr = (np.random.default_rng(1).random((30, 30, 3)) * 255).astype(np.uint8)
+    jpeg = _jpeg(arr, quality=100)
+    out_n = np.empty((1, 60, 60, 3), np.uint8)
+    ok_n = np.empty(1, np.uint8)
+    binding._decode_resize_batch_pil([jpeg], 60, 60, out_n, ok_n)
+    imgs, _ = decode_resize_batch([jpeg], 60, 60)
+    diff = np.abs(imgs[0].astype(int) - out_n[0].astype(int))
+    assert diff.mean() < 3.0

@@ -1,0 +1,29 @@
+"""tpuflow — a TPU-native distributed deep-learning framework.
+
+Re-implements, TPU-first, the full capability surface of the reference
+workshop (smellslikeml/distributed-deep-learning-workshop): columnar image
+table store, sharded streaming input pipeline with a native C++ decode
+plane, Flax transfer-learning models, data-parallel training over a
+``jax.sharding.Mesh`` with XLA collectives (replacing Horovod/NCCL),
+experiment tracking + model registry (replacing MLflow), TPE
+hyperparameter search (replacing Hyperopt), packaged inference models and
+distributed batch inference (replacing the pyfunc/Spark-UDF path).
+
+Layer map (see SURVEY.md §1 for the reference's equivalent):
+
+  cli/        multi-host SPMD launcher (≙ HorovodRunner/mpirun)
+  parallel/   mesh + collectives (≙ Horovod C++ core over NCCL/MPI)
+  data/       table store + streaming loader (≙ Delta Lake + Petastorm)
+  native/     C++ JPEG decode/resize data plane (≙ tf.data C++ kernels)
+  models/     Flax models + preprocess (≙ Keras/MobileNetV2)
+  ops/        Pallas/XLA custom ops
+  train/      Trainer, schedules, callbacks (≙ Keras fit + hvd callbacks)
+  ckpt/       checkpoint/resume (≙ ModelCheckpoint)
+  track/      run tracking + model registry (≙ MLflow)
+  packaging/  packaged inference model format (≙ mlflow.pyfunc)
+  tune/       TPE search + trial executors (≙ Hyperopt)
+  infer/      distributed batch inference (≙ spark_udf)
+  obs/        profiling, MFU, device metrics (≙ Ganglia/Horovod timeline)
+"""
+
+__version__ = "0.1.0"

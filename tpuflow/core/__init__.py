@@ -1,0 +1,17 @@
+from tpuflow.core.dist import (  # noqa: F401
+    initialize,
+    is_primary,
+    local_device_count,
+    primary_only,
+    process_count,
+    process_index,
+    world_device_count,
+)
+from tpuflow.core.config import (  # noqa: F401
+    Config,
+    DataConfig,
+    InferConfig,
+    ModelConfig,
+    TrainConfig,
+    TuneConfig,
+)

@@ -1,0 +1,110 @@
+"""Typed config tree (SURVEY.md §5.6).
+
+The reference scatters configuration across module-level constants
+(P1/02_model_training_single_node.py:41-46), a ``DataCfg`` dataclass
+(P2/03_pyfunc_distributed_inference.py:85-95) and kwargs dicts
+(P2/03:392-409). Here it is one serializable dataclass tree with the
+same escape hatches: kwargs dicts thread through, and optimizers are
+selectable by name (needed for HPO over optimizer choice, P2/01:154-155).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclass
+class DataConfig:
+    """≙ DataCfg (reference P2/03:85-95) + the notebook image constants."""
+
+    table_root: str = "./tables"
+    database: str = "flowers"
+    img_height: int = 224
+    img_width: int = 224
+    img_channels: int = 3
+    batch_size: int = 32
+    cache_dir: str = "./loader_cache"
+    shuffle_buffer: int = 2048
+    num_decode_workers: int = 8
+    prefetch: int = 2
+    sample_fraction: float = 1.0
+    split_seed: int = 42
+    val_fraction: float = 0.1
+
+
+@dataclass
+class ModelConfig:
+    backbone: str = "mobilenet_v2"
+    num_classes: int = 5
+    dropout: float = 0.5
+    width_mult: float = 1.0
+    freeze_backbone: bool = True
+    dtype: str = "bfloat16"  # compute dtype; params stay float32
+
+
+@dataclass
+class TrainConfig:
+    optimizer: str = "adam"  # resolved by name, ≙ getattr(tf.keras.optimizers, name)
+    learning_rate: float = 1e-3
+    scale_lr_by_world_size: bool = True  # ≙ lr × hvd.size(), P1/03:300-302
+    warmup_epochs: int = 5  # ≙ LearningRateWarmupCallback, P1/03:315-318
+    epochs: int = 3
+    reduce_on_plateau_patience: int = 10  # ≙ ReduceLROnPlateau, P1/03:319-322
+    reduce_on_plateau_factor: float = 0.1
+    early_stopping_patience: Optional[int] = None  # ≙ EarlyStopping, P2/03:397-401
+    checkpoint_dir: Optional[str] = None
+    seed: int = 0
+    optimizer_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TuneConfig:
+    max_evals: int = 20
+    parallelism: int = 1
+    seed: int = 0
+
+
+@dataclass
+class InferConfig:
+    batch_size: int = 64
+    result_type: str = "string"
+
+
+@dataclass
+class Config:
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    tune: TuneConfig = field(default_factory=TuneConfig)
+    infer: InferConfig = field(default_factory=InferConfig)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Config":
+        return cls(
+            data=DataConfig(**d.get("data", {})),
+            model=ModelConfig(**d.get("model", {})),
+            train=TrainConfig(**d.get("train", {})),
+            tune=TuneConfig(**d.get("tune", {})),
+            infer=InferConfig(**d.get("infer", {})),
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls.from_dict(json.loads(s))
+
+    def flat_params(self) -> Dict[str, Any]:
+        """Flatten to dotted keys for run-tracking param logging."""
+        out: Dict[str, Any] = {}
+        for section, value in self.to_dict().items():
+            for k, v in value.items():
+                out[f"{section}.{k}"] = v
+        return out

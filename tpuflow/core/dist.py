@@ -1,0 +1,93 @@
+"""Process topology + rank-0 gating.
+
+Replaces the reference's Horovod rank machinery (``hvd.init/rank/size/
+local_rank``, reference P1/03_model_training_distributed.py:283,295,301)
+with JAX process topology. Side effects (tracking, checkpointing) are
+gated to the primary process exactly as the reference gates them to
+rank 0 (P1/03:360-361, P2/02:206-211).
+
+Multi-host bootstrap (≙ HorovodRunner's pickle→barrier→mpirun cascade,
+P1/03:256-263) is a single ``initialize`` call per host process; the
+launcher CLI (tpuflow.cli.launch) spawns one process per host.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Callable, Optional, TypeVar
+
+import jax
+
+T = TypeVar("T")
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bootstrap multi-host JAX.
+
+    ``np=-1`` analogue: with no arguments and no TPUFLOW_* env vars this is
+    a no-op and the program runs single-process (the reference's
+    driver-local smoke mode, P1/03:385-397).
+
+    Env fallbacks: TPUFLOW_COORDINATOR, TPUFLOW_NUM_PROCESSES,
+    TPUFLOW_PROCESS_ID (set by the launcher CLI).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("TPUFLOW_COORDINATOR")
+    if num_processes is None and "TPUFLOW_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["TPUFLOW_NUM_PROCESSES"])
+    if process_id is None and "TPUFLOW_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["TPUFLOW_PROCESS_ID"])
+    if coordinator_address is None or num_processes in (None, 1, -1):
+        return  # single-process mode
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def world_device_count() -> int:
+    return jax.device_count()
+
+
+def is_primary() -> bool:
+    """True on the process that owns side effects (≙ hvd.rank() == 0)."""
+    return jax.process_index() == 0
+
+
+def primary_only(fn: Callable[..., T]) -> Callable[..., Optional[T]]:
+    """Decorator: run ``fn`` only on the primary process, return None elsewhere.
+
+    The by-construction race-avoidance discipline of the reference
+    (checkpoints and tracking only from rank 0, P2/02:206-211).
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if is_primary():
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapper

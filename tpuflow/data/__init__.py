@@ -1,0 +1,9 @@
+from tpuflow.data.table import Table, TableStore  # noqa: F401
+from tpuflow.data.ingest import ingest_images  # noqa: F401
+from tpuflow.data.transforms import (  # noqa: F401
+    add_label_from_path,
+    build_label_index,
+    index_labels,
+    random_split,
+)
+from tpuflow.data.loader import Dataset, make_dataset  # noqa: F401

@@ -1,0 +1,73 @@
+"""Binary image ingest → bronze table (C2).
+
+≙ ``spark.read.format('binaryFile').option(pathGlobFilter='*.jpg',
+recursiveFileLookup=True).load(path).sample(fraction)`` followed by an
+uncompressed Delta write (reference P1/01_data_prep.py:61-95). Produces
+the same logical schema: path / modificationTime / length / content.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+from typing import List, Optional
+
+import pyarrow as pa
+
+from tpuflow.data.table import Table
+
+
+def _glob_files(root: str, pattern: str, recursive: bool) -> List[str]:
+    out = []
+    if recursive:
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fn in filenames:
+                if fnmatch.fnmatch(fn, pattern):
+                    out.append(os.path.join(dirpath, fn))
+    else:
+        for fn in os.listdir(root):
+            p = os.path.join(root, fn)
+            if os.path.isfile(p) and fnmatch.fnmatch(fn, pattern):
+                out.append(p)
+    return sorted(out)  # deterministic order
+
+
+def ingest_images(
+    source_dir: str,
+    table: Table,
+    glob: str = "*.jpg",
+    recursive: bool = True,
+    sample_fraction: float = 1.0,
+    seed: int = 12,
+    compression: Optional[str] = None,
+) -> int:
+    """Read image files into ``table`` (bronze). Returns row count.
+
+    ``sample_fraction`` mirrors ``.sample(fraction=0.5)`` used to speed the
+    workshop up (P1/01:65). Compression defaults to None — uncompressed,
+    the reference's choice for binary columns (P1/01:91-92).
+    """
+    files = _glob_files(source_dir, glob, recursive)
+    if sample_fraction < 1.0:
+        rng = random.Random(seed)
+        files = [f for f in files if rng.random() < sample_fraction]
+    paths, mtimes, lengths, contents = [], [], [], []
+    for f in files:
+        st = os.stat(f)
+        with open(f, "rb") as fh:
+            data = fh.read()
+        paths.append(os.path.abspath(f))
+        mtimes.append(st.st_mtime)
+        lengths.append(len(data))
+        contents.append(data)
+    tbl = pa.table(
+        {
+            "path": pa.array(paths, pa.string()),
+            "modificationTime": pa.array(mtimes, pa.float64()),
+            "length": pa.array(lengths, pa.int64()),
+            "content": pa.array(contents, pa.binary()),
+        }
+    )
+    table.write(tbl, compression=compression)
+    return tbl.num_rows

@@ -1,0 +1,185 @@
+"""Versioned Parquet table store — the Delta Lake / Spark-table equivalent (N6-N7).
+
+The reference persists image data as Delta tables in a per-user database
+(bronze/silver medallion, P1/01_data_prep.py:84-95,136,216-222). This is
+the native equivalent: a database is a directory, a table is a directory
+of immutable versions, each version a set of Parquet part files plus a
+JSON manifest. Semantics kept from the reference:
+
+- overwrite writes a NEW version and atomically repoints ``_latest``
+  (Delta's versioned overwrite);
+- binary (image) columns can be stored uncompressed — the reference
+  disables compression for binary reads' sake (P1/01:91-92);
+- tables are addressed ``database.table`` like ``spark.table(...)``.
+
+No SQL engine: only the operations the workshop exercises (SURVEY.md N6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+_MANIFEST = "_manifest.json"
+_LATEST = "_latest"
+
+
+@dataclass
+class TableVersion:
+    version: int
+    path: str
+    num_rows: int
+    files: List[str]
+    created_at: float
+    schema: List[str]
+
+
+class Table:
+    """Handle to one versioned table directory."""
+
+    def __init__(self, path: str, name: str):
+        self.path = path
+        self.name = name
+
+    # ---- write ----------------------------------------------------------
+
+    def write(
+        self,
+        data: pa.Table,
+        mode: str = "overwrite",
+        compression: Optional[str] = "zstd",
+        rows_per_file: int = 512,
+    ) -> TableVersion:
+        """Write a new version. ``compression=None`` stores uncompressed
+        (use for binary image columns, ≙ P1/01:91-92)."""
+        if mode not in ("overwrite", "append"):
+            raise ValueError(f"unknown write mode {mode!r}")
+        if mode == "append" and self.exists():
+            data = pa.concat_tables([self.read(), data], promote_options="default")
+        version = self.latest_version() + 1 if self.exists() else 0
+        vdir = os.path.join(self.path, f"v{version}")
+        os.makedirs(vdir, exist_ok=True)
+        files = []
+        n = data.num_rows
+        codec = compression if compression is not None else "none"
+        for i, start in enumerate(range(0, max(n, 1), rows_per_file)):
+            chunk = data.slice(start, rows_per_file)
+            fname = f"part-{i:05d}.parquet"
+            pq.write_table(chunk, os.path.join(vdir, fname), compression=codec)
+            files.append(fname)
+        manifest = TableVersion(
+            version=version,
+            path=vdir,
+            num_rows=n,
+            files=files,
+            created_at=time.time(),
+            schema=data.schema.names,
+        )
+        with open(os.path.join(vdir, _MANIFEST), "w") as f:
+            json.dump(manifest.__dict__, f)
+        # atomic repoint of _latest
+        fd, tmp = tempfile.mkstemp(dir=self.path)
+        with os.fdopen(fd, "w") as f:
+            f.write(str(version))
+        os.replace(tmp, os.path.join(self.path, _LATEST))
+        return manifest
+
+    # ---- read -----------------------------------------------------------
+
+    def exists(self) -> bool:
+        return os.path.exists(os.path.join(self.path, _LATEST))
+
+    def latest_version(self) -> int:
+        with open(os.path.join(self.path, _LATEST)) as f:
+            return int(f.read().strip())
+
+    def versions(self) -> List[int]:
+        out = []
+        for d in os.listdir(self.path):
+            if d.startswith("v") and d[1:].isdigit():
+                out.append(int(d[1:]))
+        return sorted(out)
+
+    def manifest(self, version: Optional[int] = None) -> TableVersion:
+        version = self.latest_version() if version is None else version
+        with open(os.path.join(self.path, f"v{version}", _MANIFEST)) as f:
+            return TableVersion(**json.load(f))
+
+    def files(self, version: Optional[int] = None) -> List[str]:
+        m = self.manifest(version)
+        return [os.path.join(m.path, f) for f in m.files]
+
+    def read(
+        self,
+        columns: Optional[Sequence[str]] = None,
+        version: Optional[int] = None,
+    ) -> pa.Table:
+        paths = self.files(version)
+        tables = [pq.read_table(p, columns=list(columns) if columns else None) for p in paths]
+        return pa.concat_tables(tables)
+
+    def count(self, version: Optional[int] = None) -> int:
+        return self.manifest(version).num_rows
+
+    def schema(self, version: Optional[int] = None) -> pa.Schema:
+        return pq.read_schema(self.files(version)[0])
+
+    def iter_batches(
+        self,
+        columns: Optional[Sequence[str]] = None,
+        batch_size: int = 256,
+        version: Optional[int] = None,
+    ) -> Iterator[pa.RecordBatch]:
+        for p in self.files(version):
+            pf = pq.ParquetFile(p)
+            yield from pf.iter_batches(
+                batch_size=batch_size, columns=list(columns) if columns else None
+            )
+
+    def to_pandas(self, columns: Optional[Sequence[str]] = None, version=None):
+        return self.read(columns, version).to_pandas()
+
+    def delete(self) -> None:
+        shutil.rmtree(self.path, ignore_errors=True)
+
+
+class TableStore:
+    """A 'database' of tables rooted at one directory (≙ per-user Spark DB,
+    P1/00_setup.py:3-11 + P1/01:84-87)."""
+
+    def __init__(self, root: str, database: str = "default"):
+        self.root = root
+        self.database = database
+        self.db_path = os.path.join(root, database)
+        os.makedirs(self.db_path, exist_ok=True)
+
+    def table(self, name: str) -> Table:
+        if "." in name:  # database.table addressing, ≙ spark.table("db.tbl")
+            db, name = name.split(".", 1)
+            return TableStore(self.root, db).table(name)
+        return Table(os.path.join(self.db_path, name), name)
+
+    def tables(self) -> List[str]:
+        return sorted(
+            d
+            for d in os.listdir(self.db_path)
+            if os.path.isdir(os.path.join(self.db_path, d))
+        )
+
+    def drop_database(self) -> None:
+        """≙ DROP DATABASE ... CASCADE (P1/01:84-86)."""
+        shutil.rmtree(self.db_path, ignore_errors=True)
+        os.makedirs(self.db_path, exist_ok=True)
+
+
+def table_from_pydict(d: Dict[str, list]) -> pa.Table:
+    return pa.table(d)

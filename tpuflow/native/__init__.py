@@ -1,0 +1,5 @@
+from tpuflow.native.binding import (  # noqa: F401
+    decode_resize_batch,
+    have_native,
+    native_lib,
+)

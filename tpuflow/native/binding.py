@@ -1,0 +1,135 @@
+"""ctypes binding + build-on-first-use for the C++ decode plane (N4).
+
+Compiles ``decode.cpp`` against libjpeg into a cached shared library the
+first time it is needed; falls back to a Pillow implementation when no
+toolchain/libjpeg is available so every code path still runs (the same
+spirit as the reference's CPU fallback for its GPU pinning,
+P1/03_model_training_distributed.py:276-278).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import io
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "decode.cpp")
+_LIB_PATH = os.path.join(_HERE, "_libtpuflow_decode.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    cmd = [
+        "g++", "-O3", "-march=native", "-fPIC", "-shared", "-std=c++17",
+        _SRC, "-o", _LIB_PATH, "-ljpeg", "-pthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _LIB_PATH
+    except Exception:
+        return None
+
+
+def native_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        stale = os.path.exists(_LIB_PATH) and os.path.getmtime(
+            _LIB_PATH
+        ) < os.path.getmtime(_SRC)
+        path = _LIB_PATH if os.path.exists(_LIB_PATH) and not stale else _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.tf_decode_resize_batch.restype = ctypes.c_int
+        lib.tf_decode_resize_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
+        _lib = lib
+        return _lib
+
+
+def have_native() -> bool:
+    return native_lib() is not None
+
+
+def _decode_resize_batch_pil(
+    jpegs: Sequence[bytes], out_h: int, out_w: int, out: np.ndarray, ok: np.ndarray
+) -> int:
+    from PIL import Image
+
+    failures = 0
+    for i, b in enumerate(jpegs):
+        try:
+            img = Image.open(io.BytesIO(b)).convert("RGB").resize(
+                (out_w, out_h), Image.BILINEAR
+            )
+            out[i] = np.asarray(img, dtype=np.uint8)
+            ok[i] = 1
+        except Exception:
+            out[i] = 0
+            ok[i] = 0
+            failures += 1
+    return failures
+
+
+def decode_resize_batch(
+    jpegs: Sequence[bytes],
+    out_h: int,
+    out_w: int,
+    num_threads: int = 8,
+    out: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Decode a batch of JPEG byte strings to uint8 [n, out_h, out_w, 3].
+
+    Returns (images, ok_mask). Corrupt inputs yield a zero image and
+    ok=0 rather than failing the batch (a training stream must survive a
+    bad file). Writes into ``out`` if given (preallocated, reused across
+    steps to avoid allocator churn).
+    """
+    n = len(jpegs)
+    if out is None:
+        out = np.empty((n, out_h, out_w, 3), dtype=np.uint8)
+    if out.shape != (n, out_h, out_w, 3) or out.dtype != np.uint8:
+        raise ValueError(
+            f"out must be uint8 {(n, out_h, out_w, 3)}, got {out.dtype} {out.shape}"
+        )
+    if not out.flags.c_contiguous:
+        raise ValueError("out must be C-contiguous")
+    ok = np.empty((n,), dtype=np.uint8)
+    if n == 0:
+        return out, ok
+    lib = native_lib()
+    if lib is None:
+        _decode_resize_batch_pil(jpegs, out_h, out_w, out, ok)
+        return out, ok
+    bufs = [np.frombuffer(b, dtype=np.uint8) for b in jpegs]
+    ptrs = (ctypes.c_void_p * n)(
+        *[b.ctypes.data_as(ctypes.c_void_p).value for b in bufs]
+    )
+    lens = (ctypes.c_int64 * n)(*[len(b) for b in jpegs])
+    lib.tf_decode_resize_batch(
+        ptrs, lens, n, out_h, out_w,
+        out.ctypes.data_as(ctypes.c_void_p),
+        ok.ctypes.data_as(ctypes.c_void_p),
+        num_threads,
+    )
+    return out, ok

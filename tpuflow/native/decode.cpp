@@ -1,0 +1,188 @@
+// tpuflow native data plane: batched JPEG decode + bilinear resize (N4/N5).
+//
+// The reference delegates image decode to TensorFlow's C++ kernels
+// (tf.image.decode_jpeg/resize, reference P1/02_model_training_single_node.py:123-124)
+// and, in the packaged-model path, to a per-row Python/PIL loop
+// (P2/03_pyfunc_distributed_inference.py:204) — the documented throughput
+// cliff. This library is the TPU build's native equivalent: libjpeg
+// decode with DCT-domain prescaling, exact bilinear resize to the target
+// resolution, and a std::thread worker pool that processes a whole batch
+// into one preallocated contiguous buffer (ready for device_put).
+//
+// C ABI only — bound from Python with ctypes (no pybind11 in the image).
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void on_error(j_common_ptr cinfo) {
+  ErrMgr* err = reinterpret_cast<ErrMgr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+void on_emit(j_common_ptr, int) {}  // silence warnings
+
+// Decode one JPEG to RGB. Uses libjpeg's DCT scaling to decode at the
+// smallest 1/1..1/8 scale that still covers (min_h, min_w), which cuts
+// IDCT+color-convert work ~Nx for large sources. Returns false on
+// corrupt input.
+bool decode_jpeg(const uint8_t* data, size_t len, int min_h, int min_w,
+                 std::vector<uint8_t>* out, int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = on_error;
+  jerr.pub.emit_message = on_emit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  // Pick largest denominator d in {8,4,2,1} with dims/d still >= target.
+  cinfo.scale_num = 1;
+  cinfo.scale_denom = 1;
+  if (min_h > 0 && min_w > 0) {
+    for (int d = 8; d >= 1; d /= 2) {
+      if (static_cast<int>(cinfo.image_height) / d >= min_h &&
+          static_cast<int>(cinfo.image_width) / d >= min_w) {
+        cinfo.scale_denom = d;
+        break;
+      }
+    }
+  }
+  jpeg_start_decompress(&cinfo);
+  *h = cinfo.output_height;
+  *w = cinfo.output_width;
+  const int stride = cinfo.output_width * cinfo.output_components;
+  out->resize(static_cast<size_t>(*h) * stride);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() + static_cast<size_t>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  // Grayscale safety: libjpeg honors out_color_space=JCS_RGB for
+  // grayscale sources too (3 components), so stride math above holds.
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Exact bilinear resize (align_corners=false, half-pixel centers — the
+// tf.image.resize v2 / PIL convention) from (sh, sw) RGB to (dh, dw).
+void resize_bilinear(const uint8_t* src, int sh, int sw, uint8_t* dst,
+                     int dh, int dw) {
+  if (sh == dh && sw == dw) {
+    std::memcpy(dst, src, static_cast<size_t>(dh) * dw * 3);
+    return;
+  }
+  const float hs = static_cast<float>(sh) / dh;
+  const float ws = static_cast<float>(sw) / dw;
+  std::vector<int> x0(dw), x1(dw);
+  std::vector<float> xl(dw);
+  for (int x = 0; x < dw; ++x) {
+    float sx = (x + 0.5f) * ws - 0.5f;
+    sx = std::max(0.0f, sx);
+    int xi = static_cast<int>(sx);
+    x0[x] = std::min(xi, sw - 1);
+    x1[x] = std::min(xi + 1, sw - 1);
+    xl[x] = sx - xi;
+  }
+  for (int y = 0; y < dh; ++y) {
+    float sy = (y + 0.5f) * hs - 0.5f;
+    sy = std::max(0.0f, sy);
+    int yi = static_cast<int>(sy);
+    const int y0 = std::min(yi, sh - 1), y1 = std::min(yi + 1, sh - 1);
+    const float yl = sy - yi;
+    const uint8_t* r0 = src + static_cast<size_t>(y0) * sw * 3;
+    const uint8_t* r1 = src + static_cast<size_t>(y1) * sw * 3;
+    uint8_t* drow = dst + static_cast<size_t>(y) * dw * 3;
+    for (int x = 0; x < dw; ++x) {
+      const int a = x0[x] * 3, b = x1[x] * 3;
+      const float lx = xl[x];
+      for (int c = 0; c < 3; ++c) {
+        const float top = r0[a + c] + (r0[b + c] - r0[a + c]) * lx;
+        const float bot = r1[a + c] + (r1[b + c] - r1[a + c]) * lx;
+        drow[x * 3 + c] =
+            static_cast<uint8_t>(std::min(255.0f, std::max(0.0f, top + (bot - top) * yl + 0.5f)));
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode+resize a batch of JPEGs into out[n, out_h, out_w, 3] (uint8,
+// contiguous). ok[i] = 1 on success, 0 on corrupt input (row left
+// zeroed). Returns number of failures.
+int tf_decode_resize_batch(const uint8_t** jpegs, const int64_t* lens,
+                           int n, int out_h, int out_w, uint8_t* out,
+                           uint8_t* ok, int num_threads) {
+  if (num_threads < 1) num_threads = 1;
+  num_threads = std::min(num_threads, n > 0 ? n : 1);
+  std::atomic<int> next(0), failures(0);
+  const size_t img_sz = static_cast<size_t>(out_h) * out_w * 3;
+  auto worker = [&]() {
+    std::vector<uint8_t> tmp;
+    int h = 0, w = 0;
+    for (;;) {
+      const int i = next.fetch_add(1);
+      if (i >= n) break;
+      uint8_t* dst = out + static_cast<size_t>(i) * img_sz;
+      if (decode_jpeg(jpegs[i], static_cast<size_t>(lens[i]), out_h, out_w,
+                      &tmp, &h, &w)) {
+        resize_bilinear(tmp.data(), h, w, dst, out_h, out_w);
+        ok[i] = 1;
+      } else {
+        std::memset(dst, 0, img_sz);
+        ok[i] = 0;
+        failures.fetch_add(1);
+      }
+    }
+  };
+  std::vector<std::thread> threads;
+  threads.reserve(num_threads);
+  for (int t = 0; t < num_threads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+  return failures.load();
+}
+
+// Decode a single JPEG at full resolution into caller-provided buffer
+// of capacity cap bytes; writes h/w. Returns needed size, or -1 on
+// corrupt input. Two-call protocol when cap is too small.
+int64_t tf_decode_jpeg(const uint8_t* data, int64_t len, uint8_t* buf,
+                       int64_t cap, int* h, int* w) {
+  std::vector<uint8_t> tmp;
+  if (!decode_jpeg(data, static_cast<size_t>(len), 0, 0, &tmp, h, w)) return -1;
+  const int64_t need = static_cast<int64_t>(tmp.size());
+  if (buf != nullptr && cap >= need) std::memcpy(buf, tmp.data(), need);
+  return need;
+}
+
+int tf_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,11 @@
+from tpuflow.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    data_sharding,
+    replicated_sharding,
+)
+from tpuflow.parallel.collectives import (  # noqa: F401
+    pmean_tree,
+    psum_tree,
+    broadcast_from_primary,
+)

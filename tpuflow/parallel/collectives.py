@@ -1,0 +1,49 @@
+"""Collective helpers — the layer that replaces Horovod's C++ core (N1).
+
+The reference syncs gradients with ``hvd.DistributedOptimizer`` (ring
+allreduce each step, P1/03:302), initializes consistently with
+``BroadcastGlobalVariablesCallback(0)`` (P1/03:308) and averages epoch
+metrics with ``MetricAverageCallback`` (P1/03:313). Here those are XLA
+collectives inside traced code — compiler-scheduled, fused and
+overlapped with compute, which is precisely the advantage of the
+XLA/ICI path over an external NCCL engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpuflow.parallel.mesh import DATA_AXIS
+
+
+def pmean_tree(tree: Any, axis_name: str = DATA_AXIS) -> Any:
+    """Mean-allreduce every leaf (grad sync ≙ DistributedOptimizer)."""
+    return jax.tree.map(lambda x: jax.lax.pmean(x, axis_name), tree)
+
+
+def psum_tree(tree: Any, axis_name: str = DATA_AXIS) -> Any:
+    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), tree)
+
+
+def broadcast_from_primary(tree: Any) -> Any:
+    """Replicate host-0's values to all processes (outside jit).
+
+    ≙ BroadcastGlobalVariablesCallback(0) (P1/03:305-308). With a single
+    seeded init this is normally a no-op safety net; it matters when
+    state was restored from a checkpoint on one host.
+    """
+    import jax.experimental.multihost_utils as mhu
+
+    if jax.process_count() == 1:
+        return tree
+    return mhu.broadcast_one_to_all(tree)
+
+
+def replicated_norm(tree: Any) -> jnp.ndarray:
+    """Global L2 norm — used by the cross-process consistency check
+    (the testable form of the broadcast-init invariant, SURVEY.md §5.2)."""
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))

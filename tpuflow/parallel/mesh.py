@@ -1,0 +1,68 @@
+"""Device mesh construction — the TPU replacement for Horovod topology.
+
+The reference's world is flat MPI ranks (hvd.rank()/size(),
+P1/03_model_training_distributed.py:295-301). On TPU the topology is a
+``jax.sharding.Mesh`` whose axes name the parallelism dimensions; XLA
+lowers collectives onto ICI within a slice and DCN across slices
+(SURVEY.md §5.8). v1 trains data-parallel (the only parallelism the
+reference has, SURVEY.md §2c) but the mesh carries a ``model`` axis so
+tensor-parallel sharding rules can land without re-plumbing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """How to carve the device set into (data, model) axes."""
+
+    data: int = -1  # -1 = all remaining devices
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int]:
+        model = self.model
+        data = self.data if self.data != -1 else n_devices // model
+        if data * model != n_devices:
+            raise ValueError(
+                f"mesh {data}x{model} != device count {n_devices}"
+            )
+        return data, model
+
+
+def build_mesh(
+    spec: MeshSpec = MeshSpec(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a 2-D (data, model) mesh over ``devices`` (default: all).
+
+    Device order follows jax.devices(), which on TPU reflects physical
+    torus locality, so the fast-varying ``model`` axis rides the
+    highest-bandwidth ICI links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    data, model = spec.resolve(len(devices))
+    arr = np.array(devices).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding over the data axis (leading dim split)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def world_size(mesh: Mesh) -> int:
+    return int(np.prod(list(mesh.shape.values())))
