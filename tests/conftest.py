@@ -11,12 +11,24 @@ Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The container's sitecustomize may have imported jax at interpreter start
+# (to register the axon TPU plugin), freezing JAX_PLATFORMS=axon into the
+# already-loaded config — in that case the env var above is ignored and
+# backend init would dial the TPU relay. Override the live config too:
+# backends initialize lazily, so this keeps tests hermetic-CPU.
+import sys
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 import sys

@@ -91,3 +91,19 @@ def test_label_extract_index_split(store, flower_dir):
     # determinism
     train2, _ = random_split(silver, (0.9, 0.1), seed=42)
     assert train.column("path").to_pylist() == train2.column("path").to_pylist()
+
+
+def test_append_is_incremental(store):
+    t = store.table("inc")
+    t.write(pa.table({"a": list(range(600))}))  # 2 part files (512 rows/file)
+    t.write(pa.table({"a": [1000]}), mode="append")
+    import os
+    v1_dir = os.path.join(t.path, "v1")
+    # append wrote only the new rows, referencing v0's parts
+    assert len(os.listdir(v1_dir)) == 2  # 1 new part + manifest
+    assert t.count() == 601
+    vals = t.read().column("a").to_pylist()
+    assert vals[:600] == list(range(600)) and vals[-1] == 1000
+    # a second append chains manifests
+    t.write(pa.table({"a": [2000]}), mode="append")
+    assert t.count() == 602

@@ -105,3 +105,16 @@ def test_abandoned_iterator_does_not_leak_producer(silver_table):
     while threading.active_count() > before and time.time() < deadline:
         time.sleep(0.05)
     assert threading.active_count() <= before
+
+
+def test_producer_error_propagates(silver_table, monkeypatch):
+    import tpuflow.data.loader as L
+
+    def boom(*a, **k):
+        raise RuntimeError("decode plane exploded")
+
+    monkeypatch.setattr(L, "decode_resize_batch", boom)
+    ds = make_dataset(silver_table, batch_size=4, infinite=True,
+                      img_height=16, img_width=16)
+    with pytest.raises(RuntimeError, match="producer failed"):
+        next(iter(ds))

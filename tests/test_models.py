@@ -1,0 +1,94 @@
+"""Model tests (C6-C7): architecture, freezing semantics, preprocess."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.models import (
+    MobileNetV2,
+    build_model,
+    backbone_param_mask,
+    preprocess_input,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model_vars():
+    m = build_model(num_classes=5, dropout=0.5, width_mult=0.25)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    v = m.init({"params": jax.random.key(0)}, x, train=False)
+    return m, v, x
+
+
+def test_logits_shape_and_dtype(tiny_model_vars):
+    m, v, x = tiny_model_vars
+    out = m.apply(v, x, train=False)
+    assert out.shape == (2, 5)
+    assert out.dtype == jnp.float32  # head computes in f32 (loss stability)
+
+
+def test_backbone_feature_stride_32():
+    m = MobileNetV2(width_mult=0.25)
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    v = m.init(jax.random.key(0), x, train=False)
+    feats = m.apply(v, x, train=False)
+    assert feats.shape[1:3] == (2, 2)  # 64/32
+    assert feats.shape[-1] == 1280  # width<1 keeps the 1280 head conv
+
+
+def test_only_head_trainable(tiny_model_vars):
+    m, v, _ = tiny_model_vars
+    mask = backbone_param_mask(v["params"])
+    trainable = [p for p, val in jax.tree_util.tree_leaves_with_path(mask) if val]
+    frozen = [p for p, val in jax.tree_util.tree_leaves_with_path(mask) if not val]
+    assert len(trainable) == 2  # head_dense kernel + bias
+    assert all("backbone" in jax.tree_util.keystr(p) for p in frozen)
+
+
+def test_frozen_backbone_bn_stats_immutable(tiny_model_vars):
+    # ≙ Keras trainable=False freezing BN statistics (P1/02:167-169)
+    m, v, x = tiny_model_vars
+    out, mutated = m.apply(
+        v, x, train=True, rngs={"dropout": jax.random.key(1)}, mutable=["batch_stats"]
+    )
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(v["batch_stats"]),
+        jax.tree_util.tree_leaves_with_path(mutated["batch_stats"]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_trainable_backbone_bn_stats_update():
+    m = build_model(num_classes=3, width_mult=0.25, freeze_backbone=False)
+    x = jax.random.normal(jax.random.key(2), (4, 32, 32, 3))
+    v = m.init({"params": jax.random.key(0)}, x, train=False)
+    _, mutated = m.apply(
+        v, x, train=True, rngs={"dropout": jax.random.key(1)}, mutable=["batch_stats"]
+    )
+    diffs = [
+        float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+        for a, b in zip(
+            jax.tree.leaves(v["batch_stats"]), jax.tree.leaves(mutated["batch_stats"])
+        )
+    ]
+    assert sum(diffs) > 0
+
+
+def test_dropout_active_only_in_train_mode(tiny_model_vars):
+    m, v, _ = tiny_model_vars
+    x = jax.random.normal(jax.random.key(9), (2, 32, 32, 3))
+    a = m.apply(v, x, train=False)
+    b = m.apply(v, x, train=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = m.apply(v, x, train=True, rngs={"dropout": jax.random.key(1)})
+    d = m.apply(v, x, train=True, rngs={"dropout": jax.random.key(2)})
+    assert not np.array_equal(np.asarray(c), np.asarray(d))
+
+
+def test_preprocess_input_range():
+    x = jnp.array([[0, 127, 255]], jnp.uint8)
+    y = preprocess_input(x, dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(y), [[-1.0, -0.00392157, 1.0]], atol=1e-5
+    )

@@ -1,0 +1,203 @@
+"""Trainer tests (C8-C10): DP parity, LR schedule, callbacks, resume.
+
+Uses a small surrogate with the same backbone/head structure as the
+flagship model so 1-core CPU compiles stay fast; MobileNetV2-specific
+behavior is covered in test_models.py. All runs execute on the 8-device
+virtual CPU mesh (SURVEY.md §4: the np=-1 pattern generalized).
+"""
+
+import os
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.core.config import TrainConfig
+from tpuflow.models.classifier import BACKBONE
+from tpuflow.parallel.mesh import MeshSpec, build_mesh
+from tpuflow.train import (
+    EarlyStopping,
+    LRController,
+    ModelCheckpoint,
+    ReduceLROnPlateau,
+    Trainer,
+)
+
+
+class TinyBackbone(nn.Module):
+    dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Conv(8, (3, 3), strides=(2, 2), use_bias=False, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, name="bn")(x)
+        return nn.relu(x)
+
+
+class TinyClassifier(nn.Module):
+    num_classes: int = 5
+    dropout: float = 0.0
+    freeze_backbone: bool = True
+    dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        bb_train = train and not self.freeze_backbone
+        x = TinyBackbone(name=BACKBONE)(x, train=bb_train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, name="drop")(x, deterministic=not train)
+        return nn.Dense(self.num_classes, name="head_dense")(x)
+
+
+class ArrayDataset:
+    """In-memory stand-in for data.Dataset (loader has its own tests)."""
+
+    def __init__(self, images, labels, batch_size, img_hw=(16, 16)):
+        self.images, self.labels = images, labels
+        self.batch_size = batch_size
+        self.img_height, self.img_width = img_hw
+        self.total_rows = len(images)
+
+    def steps_per_epoch(self):
+        return max(1, self.total_rows // self.batch_size)
+
+    def __iter__(self):
+        rng = np.random.default_rng(0)
+        n = len(self.images)
+        while True:
+            order = rng.permutation(n)
+            for s in range(0, n - self.batch_size + 1, self.batch_size):
+                sel = order[s : s + self.batch_size]
+                yield {"image": self.images[sel], "label": self.labels[sel]}
+
+
+def _synth_data(n=64, hw=16, classes=5, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    # class-dependent mean makes the problem learnable
+    images = (
+        rng.normal(64, 10, (n, hw, hw, 3)) + labels[:, None, None, None] * 30
+    ).clip(0, 255).astype(np.uint8)
+    return images, labels
+
+
+@pytest.fixture(scope="module")
+def data():
+    return _synth_data()
+
+
+def test_fit_learns_and_history(data):
+    images, labels = data
+    ds = ArrayDataset(images, labels, batch_size=16)
+    t = Trainer(TinyClassifier(), TrainConfig(epochs=8, learning_rate=0.05,
+                                              warmup_epochs=0,
+                                              scale_lr_by_world_size=False))
+    hist = t.fit(ds, val_ds=ds).history
+    assert set(hist) >= {"loss", "accuracy", "lr", "val_loss", "val_accuracy"}
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert all(np.isfinite(v) for v in hist["loss"])
+
+
+def test_dp_equals_single_device_step(data):
+    """SURVEY.md §4 parity property: an 8-way DP step == the 1-device
+    step on the same global batch (dropout off, fp32)."""
+    images, labels = data
+    ds = ArrayDataset(images, labels, batch_size=16)
+    cfgs = {}
+    for name, spec in [("dp8", MeshSpec(data=8)), ("single", MeshSpec(data=1))]:
+        mesh = build_mesh(spec, devices=jax.devices()[: spec.data if spec.data > 0 else None])
+        t = Trainer(
+            TinyClassifier(dropout=0.0),
+            TrainConfig(epochs=1, learning_rate=0.01, warmup_epochs=0,
+                        scale_lr_by_world_size=False, seed=7),
+            mesh=mesh,
+        )
+        t.fit(ds, epochs=1, steps_per_epoch=2)
+        cfgs[name] = jax.device_get(t.state.params)
+    flat_a = jax.tree.leaves(cfgs["dp8"])
+    flat_b = jax.tree.leaves(cfgs["single"])
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_frozen_backbone_params_unchanged(data):
+    images, labels = data
+    ds = ArrayDataset(images, labels, batch_size=16)
+    t = Trainer(TinyClassifier(freeze_backbone=True),
+                TrainConfig(epochs=2, learning_rate=0.05, warmup_epochs=0))
+    t.init_state((16, 16, 3))
+    before = jax.device_get(t.state.params[BACKBONE])
+    t.fit(ds, epochs=2)
+    after = jax.device_get(t.state.params[BACKBONE])
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)
+    # head must have moved
+    head_b = jax.device_get(t.state.params["head_dense"])
+    assert float(np.abs(head_b["kernel"]).sum()) > 0
+
+
+def test_lr_controller_warmup_and_plateau():
+    # ≙ lr×size + 5-epoch warmup + ReduceLROnPlateau (P1/03:300-322)
+    c = LRController(0.001, world_size=8, scale_by_world_size=True,
+                     warmup_epochs=5, steps_per_epoch=10)
+    assert c.lr_for_step(0) == pytest.approx(0.001)
+    assert c.lr_for_step(25) == pytest.approx(0.001 + (0.008 - 0.001) * 0.5)
+    assert c.lr_for_step(50) == pytest.approx(0.008)
+    assert c.lr_for_step(500) == pytest.approx(0.008)
+    c.reduce(0.1)
+    assert c.lr_for_step(500) == pytest.approx(0.0008)
+
+
+def test_reduce_on_plateau_and_early_stopping(data):
+    images, labels = data
+    ds = ArrayDataset(images, labels, batch_size=16)
+    t = Trainer(TinyClassifier(), TrainConfig(epochs=6, learning_rate=0.0,
+                                              warmup_epochs=0))
+    rop = ReduceLROnPlateau(monitor="val_loss", patience=2, factor=0.5)
+    es = EarlyStopping(monitor="val_loss", patience=3)
+    hist = t.fit(ds, val_ds=ds, epochs=6, steps_per_epoch=1,
+                 validation_steps=1, callbacks=[rop, es]).history
+    # lr=0 ⇒ no improvement ⇒ plateau fires and early stopping stops run
+    assert t.lr_controller.plateau_factor < 1.0
+    assert len(hist["loss"]) < 6
+
+
+def test_checkpoint_callback_and_resume(tmp_path, data):
+    from tpuflow.ckpt import latest_checkpoint, restore_into_state, list_checkpoints
+
+    images, labels = data
+    ds = ArrayDataset(images, labels, batch_size=16)
+    ckdir = str(tmp_path / "ck")
+    t = Trainer(TinyClassifier(), TrainConfig(epochs=2, learning_rate=0.05,
+                                              warmup_epochs=0))
+    t.fit(ds, epochs=2, callbacks=[ModelCheckpoint(ckdir, save_weights_only=False)])
+    assert len(list_checkpoints(ckdir)) == 2
+    step_after = int(jax.device_get(t.state.step))
+
+    # fresh trainer resumes exactly
+    t2 = Trainer(TinyClassifier(), TrainConfig(epochs=2, learning_rate=0.05,
+                                               warmup_epochs=0))
+    t2.init_state((16, 16, 3))
+    t2.state = restore_into_state(latest_checkpoint(ckdir), t2.state)
+    assert int(jax.device_get(t2.state.step)) == step_after
+    for a, b in zip(jax.tree.leaves(jax.device_get(t.state.params)),
+                    jax.tree.leaves(jax.device_get(t2.state.params))):
+        np.testing.assert_array_equal(a, b)
+    # and can continue training
+    t2.fit(ds, epochs=3, initial_epoch=2)
+    assert int(jax.device_get(t2.state.step)) > step_after
+
+
+def test_state_replicated_across_mesh(data):
+    """Broadcast-init invariant (P1/03:305-308) as a testable property."""
+    images, labels = data
+    ds = ArrayDataset(images, labels, batch_size=16)
+    t = Trainer(TinyClassifier(), TrainConfig(epochs=1, warmup_epochs=0))
+    t.fit(ds, epochs=1, steps_per_epoch=2)
+    for leaf in jax.tree.leaves(t.state.params):
+        assert leaf.sharding.is_fully_replicated
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)

@@ -1,0 +1,7 @@
+from tpuflow.ckpt.checkpoint import (  # noqa: F401
+    latest_checkpoint,
+    list_checkpoints,
+    restore_checkpoint,
+    restore_into_state,
+    save_checkpoint,
+)

@@ -1,0 +1,129 @@
+"""Checkpoint / resume (C17, SURVEY.md §5.4).
+
+The reference writes rank-0-only per-epoch weight checkpoints
+(``ModelCheckpoint(save_weights_only=True)`` to
+``{checkpoint_dir}/checkpoint-{epoch}.ckpt``,
+P2/02_hyperopt_distributed_model.py:65-67,206-211) but never restores.
+This module keeps the layout semantics and ADDS real resume: full
+TrainState (params + BN stats + optimizer state + step) serialization,
+atomic writes, latest-checkpoint discovery, and restore-into-state.
+
+Serialization is flax msgpack (dependency-light, host-RAM friendly at
+this model scale); the writer is primary-process-only by convention
+(callbacks gate it), and restored state is broadcast-replicated on
+load, which is exactly the consistency story
+BroadcastGlobalVariablesCallback documents (P1/03:305-308).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Any, List, Optional
+
+import jax
+from flax import serialization
+
+_PAT = re.compile(r"checkpoint-(\d+)\.ckpt$")
+
+
+def _is_key(x: Any) -> bool:
+    import jax.numpy as jnp
+
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
+
+
+def _unkey(tree: Any) -> Any:
+    """Typed PRNG keys → raw uint32 (msgpack-serializable)."""
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if _is_key(x) else x, tree
+    )
+
+
+def _rekey(template: Any, restored: Any) -> Any:
+    """Re-wrap raw key data where the template holds typed keys."""
+    return jax.tree.map(
+        lambda t, r: jax.random.wrap_key_data(r) if _is_key(t) and not _is_key(r) else r,
+        template,
+        restored,
+    )
+
+
+def _path(checkpoint_dir: str, step: int) -> str:
+    return os.path.join(checkpoint_dir, f"checkpoint-{step}.ckpt")
+
+
+def save_checkpoint(
+    checkpoint_dir: str,
+    state: Any,
+    step: int,
+    weights_only: bool = False,
+) -> str:
+    """Write checkpoint atomically. ``weights_only`` mirrors the
+    reference's save_weights_only=True (params+batch_stats only)."""
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    if weights_only:
+        payload = {
+            "params": jax.device_get(state.params),
+            "batch_stats": jax.device_get(state.batch_stats),
+        }
+    else:
+        payload = jax.device_get(serialization.to_state_dict(_unkey(state)))
+    data = serialization.msgpack_serialize(payload)
+    path = _path(checkpoint_dir, step)
+    fd, tmp = tempfile.mkstemp(dir=checkpoint_dir, suffix=".tmp")
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return path
+
+
+def list_checkpoints(checkpoint_dir: str) -> List[str]:
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for fn in os.listdir(checkpoint_dir):
+        if _PAT.search(fn):
+            out.append(os.path.join(checkpoint_dir, fn))
+    return sorted(out, key=lambda p: int(_PAT.search(p).group(1)))
+
+
+def latest_checkpoint(checkpoint_dir: str) -> Optional[str]:
+    cks = list_checkpoints(checkpoint_dir)
+    return cks[-1] if cks else None
+
+
+def restore_checkpoint(path: str) -> dict:
+    """Raw payload (dict of numpy arrays)."""
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def restore_into_state(path: str, state: Any) -> Any:
+    """Restore a FULL checkpoint into a template TrainState (resume).
+
+    The template supplies structure (built by Trainer.init_state); the
+    payload supplies values, including optimizer state and step, so
+    training continues exactly where it stopped — the capability the
+    reference gestures at but never implements (SURVEY.md §5.4).
+    """
+    payload = restore_checkpoint(path)
+    if set(payload.keys()) == {"params", "batch_stats"}:
+        restored = state.replace(
+            params=serialization.from_state_dict(state.params, payload["params"]),
+            batch_stats=serialization.from_state_dict(
+                state.batch_stats, payload["batch_stats"]
+            ),
+        )
+    else:
+        restored = serialization.from_state_dict(_unkey(state), payload)
+        restored = _rekey(state, restored)
+    # keep the template's sharding (replicated across the mesh)
+    return jax.tree.map(
+        lambda v, t: jax.device_put(v, t.sharding)
+        if hasattr(t, "sharding")
+        else v,
+        restored,
+        state,
+    )

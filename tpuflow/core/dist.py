@@ -46,8 +46,11 @@ def initialize(
         num_processes = int(os.environ["TPUFLOW_NUM_PROCESSES"])
     if process_id is None and "TPUFLOW_PROCESS_ID" in os.environ:
         process_id = int(os.environ["TPUFLOW_PROCESS_ID"])
-    if coordinator_address is None or num_processes in (None, 1, -1):
-        return  # single-process mode
+    if coordinator_address is None or num_processes in (1, -1):
+        return  # single-process mode (explicit np=-1 or nothing configured)
+    # num_processes=None with a coordinator: let JAX auto-detect (TPU
+    # metadata); never silently degrade to single-process when the user
+    # asked for distributed.
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -33,6 +33,13 @@ from tpuflow.data.table import Table
 from tpuflow.native import decode_resize_batch
 
 
+class _StreamError:
+    """Producer-thread exception in transit to the consumer."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class Dataset:
     """Iterable of {'image': uint8 [B,H,W,3], 'label': int32 [B]} batches.
 
@@ -165,6 +172,9 @@ class Dataset:
                 epoch += 1
                 if not self.infinite:
                     break
+        except BaseException as e:  # propagate to the consumer, don't
+            put(_StreamError(e))  # let an 'infinite' stream end quietly
+            return
         finally:
             put(None)  # sentinel; dropped only if the consumer is gone
 
@@ -178,6 +188,10 @@ class Dataset:
                 item = out_q.get()
                 if item is None:
                     return
+                if isinstance(item, _StreamError):
+                    raise RuntimeError(
+                        "data stream producer failed"
+                    ) from item.exc
                 yield item
         finally:
             stop.set()

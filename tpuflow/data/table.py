@@ -60,26 +60,38 @@ class Table:
         rows_per_file: int = 512,
     ) -> TableVersion:
         """Write a new version. ``compression=None`` stores uncompressed
-        (use for binary image columns, ≙ P1/01:91-92)."""
+        (use for binary image columns, ≙ P1/01:91-92).
+
+        ``append`` writes ONLY the new rows; the new version's manifest
+        references the previous version's part files (Delta-style
+        incremental commit), so k appends cost O(new rows), not O(total).
+        """
         if mode not in ("overwrite", "append"):
             raise ValueError(f"unknown write mode {mode!r}")
+        prev_files: List[str] = []
+        prev_rows = 0
         if mode == "append" and self.exists():
-            data = pa.concat_tables([self.read(), data], promote_options="default")
+            prev = self.manifest()
+            # normalize to table-root-relative paths
+            prev_files = [
+                f if "/" in f else f"v{prev.version}/{f}" for f in prev.files
+            ]
+            prev_rows = prev.num_rows
         version = self.latest_version() + 1 if self.exists() else 0
         vdir = os.path.join(self.path, f"v{version}")
         os.makedirs(vdir, exist_ok=True)
-        files = []
+        files = list(prev_files)
         n = data.num_rows
         codec = compression if compression is not None else "none"
         for i, start in enumerate(range(0, max(n, 1), rows_per_file)):
             chunk = data.slice(start, rows_per_file)
             fname = f"part-{i:05d}.parquet"
             pq.write_table(chunk, os.path.join(vdir, fname), compression=codec)
-            files.append(fname)
+            files.append(f"v{version}/{fname}")
         manifest = TableVersion(
             version=version,
             path=vdir,
-            num_rows=n,
+            num_rows=prev_rows + n,
             files=files,
             created_at=time.time(),
             schema=data.schema.names,
@@ -116,7 +128,11 @@ class Table:
 
     def files(self, version: Optional[int] = None) -> List[str]:
         m = self.manifest(version)
-        return [os.path.join(m.path, f) for f in m.files]
+        # table-root-relative entries ("vN/part-x") vs legacy bare names
+        return [
+            os.path.join(self.path, f) if "/" in f else os.path.join(m.path, f)
+            for f in m.files
+        ]
 
     def read(
         self,

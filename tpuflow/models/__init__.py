@@ -1,0 +1,7 @@
+from tpuflow.models.mobilenet_v2 import MobileNetV2  # noqa: F401
+from tpuflow.models.classifier import (  # noqa: F401
+    TransferClassifier,
+    build_model,
+    backbone_param_mask,
+)
+from tpuflow.models.preprocess import preprocess_input, preprocess  # noqa: F401

@@ -1,0 +1,86 @@
+"""Transfer-learning classifier (C6): frozen backbone + trainable head.
+
+≙ the reference's ``build_model``: MobileNetV2(include_top=False) with
+every backbone layer frozen, then GlobalAveragePooling2D → Dropout(p) →
+Dense(num_classes) producing LOGITS (loss uses from_logits=True)
+(P1/02_model_training_single_node.py:159-178; HPO variant with dropout
+param P2/01:92-108).
+
+Freezing semantics match Keras ``trainable=False`` exactly: frozen
+backbone params get zero updates (optax mask, see
+``backbone_param_mask``) AND backbone BatchNorm runs in inference mode
+so running statistics never update (P1/02:167-169) — the subtle part
+called out in SURVEY.md §7 "hard parts".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpuflow.models.mobilenet_v2 import MobileNetV2
+
+BACKBONE = "backbone"
+
+
+class TransferClassifier(nn.Module):
+    num_classes: int = 5
+    dropout: float = 0.5
+    width_mult: float = 1.0
+    freeze_backbone: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # Frozen backbone always runs with train=False: BN uses running
+        # averages and batch_stats stay immutable (Keras trainable=False).
+        bb_train = train and not self.freeze_backbone
+        feats = MobileNetV2(self.width_mult, dtype=self.dtype, name=BACKBONE)(
+            x, train=bb_train
+        )
+        x = jnp.mean(feats, axis=(1, 2))  # GlobalAveragePooling2D
+        x = nn.Dropout(self.dropout, name="head_dropout")(
+            x, deterministic=not train
+        )
+        # Head in float32: the single small matmul costs nothing and the
+        # logits/loss stay numerically clean.
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head_dense")(
+            x.astype(jnp.float32)
+        )
+        return x  # logits
+
+
+def build_model(
+    img_height: int = 224,
+    img_width: int = 224,
+    img_channels: int = 3,
+    num_classes: int = 5,
+    dropout: float = 0.5,
+    width_mult: float = 1.0,
+    freeze_backbone: bool = True,
+    dtype: Any = jnp.bfloat16,
+) -> TransferClassifier:
+    """≙ build_model(img_height, img_width, img_channels, num_classes)
+    (P1/02:159-178). Image size/channels are carried by the data, not the
+    module (Flax modules are shape-polymorphic until init)."""
+    del img_height, img_width, img_channels  # API parity; shapes from data
+    return TransferClassifier(
+        num_classes=num_classes,
+        dropout=dropout,
+        width_mult=width_mult,
+        freeze_backbone=freeze_backbone,
+        dtype=dtype,
+    )
+
+
+def backbone_param_mask(params: Dict) -> Dict:
+    """Pytree mask: True where params are TRAINABLE (head), False where
+    frozen (backbone). Feed to ``optax.masked`` / multi_transform."""
+    import jax
+
+    def mark(path, _leaf):
+        return not (len(path) > 0 and path[0].key == BACKBONE)
+
+    return jax.tree_util.tree_map_with_path(mark, params)

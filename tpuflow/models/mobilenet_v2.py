@@ -1,0 +1,138 @@
+"""MobileNetV2 in Flax — the backbone of the reference's transfer model.
+
+The reference uses ``tf.keras.applications.MobileNetV2(include_top=False)``
+(reference P1/02_model_training_single_node.py:164-169). This is a
+TPU-first reimplementation, not a port: NHWC layout (TPU-native),
+bfloat16 compute with float32 params/statistics, ReLU6 fused by XLA into
+the surrounding convs, static shapes throughout. Architecture follows
+the MobileNetV2 paper (Sandler et al. 2018): stem conv(32,s2) →
+inverted-residual stages (t,c,n,s) = (1,16,1,1)(6,24,2,2)(6,32,3,2)
+(6,64,4,2)(6,96,3,1)(6,160,3,2)(6,320,1,1) → conv(1280).
+
+Weights initialize randomly; ``tpuflow.models.pretrained`` can load a
+converted checkpoint when one is available (no network access here).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+# (expand_ratio t, out_channels c, repeats n, first_stride s)
+_INVERTED_RESIDUAL_SETTINGS: Sequence[Tuple[int, int, int, int]] = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def make_divisible(v: float, divisor: int = 8) -> int:
+    """Round channel counts to multiples of 8 (also MXU-friendly lanes)."""
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    groups: int = 1
+    act: bool = True
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            strides=self.strides,
+            padding="SAME",
+            use_bias=False,
+            feature_group_count=self.groups,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.999,
+            epsilon=1e-3,
+            dtype=self.dtype,
+            name="bn",
+        )(x)
+        if self.act:
+            x = jnp.minimum(jnp.maximum(x, 0.0), 6.0)  # ReLU6
+        return x
+
+
+class InvertedResidual(nn.Module):
+    features: int
+    strides: Tuple[int, int]
+    expand_ratio: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand_ratio
+        y = x
+        if self.expand_ratio != 1:
+            y = ConvBN(hidden, (1, 1), act=True, dtype=self.dtype, name="expand")(
+                y, train
+            )
+        y = ConvBN(
+            hidden,
+            (3, 3),
+            strides=self.strides,
+            groups=hidden,
+            act=True,
+            dtype=self.dtype,
+            name="depthwise",
+        )(y, train)
+        y = ConvBN(self.features, (1, 1), act=False, dtype=self.dtype, name="project")(
+            y, train
+        )
+        if self.strides == (1, 1) and in_ch == self.features:
+            y = x + y
+        return y
+
+
+class MobileNetV2(nn.Module):
+    """Feature extractor (``include_top=False`` form).
+
+    Output: [N, H/32, W/32, 1280·width] feature map. Inputs are expected
+    preprocessed to [-1, 1] (tpuflow.models.preprocess).
+    """
+
+    width_mult: float = 1.0
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        stem = make_divisible(32 * self.width_mult)
+        x = ConvBN(stem, (3, 3), strides=(2, 2), dtype=self.dtype, name="stem")(
+            x, train
+        )
+        for si, (t, c, n, s) in enumerate(_INVERTED_RESIDUAL_SETTINGS):
+            out_ch = make_divisible(c * self.width_mult)
+            for i in range(n):
+                x = InvertedResidual(
+                    out_ch,
+                    strides=(s, s) if i == 0 else (1, 1),
+                    expand_ratio=t,
+                    dtype=self.dtype,
+                    name=f"block_{si}_{i}",
+                )(x, train)
+        last = make_divisible(1280 * max(1.0, self.width_mult))
+        x = ConvBN(last, (1, 1), dtype=self.dtype, name="head_conv")(x, train)
+        return x

@@ -1,0 +1,33 @@
+"""Input preprocessing (C7).
+
+≙ the reference's ``preprocess(content, label)``: decode_jpeg → resize →
+``mobilenet_v2.preprocess_input`` (scale to [-1, 1])
+(P1/02_model_training_single_node.py:119-126). In the TPU build the
+decode+resize live in the native host plane (tpuflow.native); only the
+scaling runs on device so the host→device transfer stays uint8 (4x less
+HBM/PCIe traffic) and XLA fuses the scale into the first conv.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def preprocess_input(x: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """uint8 [0,255] → dtype [-1,1] (≙ keras mobilenet_v2.preprocess_input)."""
+    return (x.astype(dtype) / jnp.asarray(127.5, dtype)) - jnp.asarray(1.0, dtype)
+
+
+def preprocess(content: bytes, img_height: int = 224, img_width: int = 224) -> np.ndarray:
+    """Host-side single-image path: JPEG bytes → float32 [-1,1] HWC.
+
+    The per-example convenience form (used by packaged inference models);
+    batch training uses the native batched plane directly.
+    """
+    from tpuflow.native import decode_resize_batch
+
+    imgs, ok = decode_resize_batch([content], img_height, img_width, num_threads=1)
+    if not ok[0]:
+        raise ValueError("corrupt image")
+    return imgs[0].astype(np.float32) / 127.5 - 1.0

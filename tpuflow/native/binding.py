@@ -28,15 +28,35 @@ _tried = False
 
 
 def _build() -> Optional[str]:
+    """Compile to a temp file and atomically rename, under a file lock,
+    so concurrent processes (one per host is the normal topology) never
+    observe a half-written .so."""
+    import fcntl
+
+    lock_path = _LIB_PATH + ".lock"
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-march=native", "-fPIC", "-shared", "-std=c++17",
-        _SRC, "-o", _LIB_PATH, "-ljpeg", "-pthread",
+        _SRC, "-o", tmp, "-ljpeg", "-pthread",
     ]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return _LIB_PATH
+        with open(lock_path, "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            if os.path.exists(_LIB_PATH) and os.path.getmtime(
+                _LIB_PATH
+            ) >= os.path.getmtime(_SRC):
+                return _LIB_PATH  # another process built it while we waited
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB_PATH)
+            return _LIB_PATH
     except Exception:
         return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def native_lib() -> Optional[ctypes.CDLL]:

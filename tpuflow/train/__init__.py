@@ -1,0 +1,15 @@
+from tpuflow.train.trainer import Trainer  # noqa: F401
+from tpuflow.train.state import TrainState  # noqa: F401
+from tpuflow.train.lr import LRController  # noqa: F401
+from tpuflow.train.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    History,
+    ModelCheckpoint,
+    ReduceLROnPlateau,
+    TrackingCallback,
+)
+from tpuflow.train.optimizers import (  # noqa: F401
+    available_optimizers,
+    get_optimizer,
+)

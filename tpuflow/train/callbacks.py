@@ -1,0 +1,137 @@
+"""Epoch-level callbacks (C9, C17).
+
+The Keras/Horovod callback roster the reference wires up
+(P1/03_model_training_distributed.py:304-322, P2/02:206-211,
+P2/03:397-401), re-expressed for the functional trainer:
+
+- broadcast-init and metric averaging are NOT callbacks here — they are
+  structural (single seeded init replicated via sharding; pmean inside
+  the jitted step), which is the TPU-native way;
+- ReduceLROnPlateau / EarlyStopping / ModelCheckpoint / History remain
+  host-side epoch hooks, same ordering rules as Keras.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+
+class Callback:
+    def set_trainer(self, trainer) -> None:
+        self.trainer = trainer
+
+    def on_train_begin(self) -> None: ...
+
+    def on_epoch_end(self, epoch: int, logs: Dict[str, float]) -> None: ...
+
+    def on_train_end(self) -> None: ...
+
+
+class History(Callback):
+    def __init__(self):
+        self.history: Dict[str, List[float]] = {}
+
+    def on_epoch_end(self, epoch, logs):
+        for k, v in logs.items():
+            self.history.setdefault(k, []).append(v)
+
+
+class ReduceLROnPlateau(Callback):
+    """≙ keras ReduceLROnPlateau(monitor='val_loss', patience, factor)
+    (P1/03:319-322). Mutates the trainer's LRController."""
+
+    def __init__(
+        self,
+        monitor: str = "val_loss",
+        factor: float = 0.1,
+        patience: int = 10,
+        min_delta: float = 1e-4,
+        verbose: bool = False,
+    ):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.verbose = verbose
+        self.best = float("inf")
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs):
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if cur < self.best - self.min_delta:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                new_lr = self.trainer.lr_controller.reduce(self.factor)
+                self.wait = 0
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+
+
+class EarlyStopping(Callback):
+    """≙ keras EarlyStopping (P2/03:397-401)."""
+
+    def __init__(self, monitor: str = "val_loss", patience: int = 3, min_delta: float = 0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = float("inf")
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs):
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if cur < self.best - self.min_delta:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.trainer.stop_training = True
+
+
+class ModelCheckpoint(Callback):
+    """Per-epoch checkpoint, PRIMARY PROCESS ONLY (≙ rank-0-only
+    ModelCheckpoint(save_weights_only=True) to
+    {dir}/checkpoint-{epoch}.ckpt, P2/02:206-211)."""
+
+    def __init__(self, checkpoint_dir: str, save_weights_only: bool = True):
+        self.checkpoint_dir = checkpoint_dir
+        self.save_weights_only = save_weights_only
+
+    def on_epoch_end(self, epoch, logs):
+        from tpuflow.core import is_primary
+        from tpuflow.ckpt import save_checkpoint
+
+        if not is_primary():
+            return
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        save_checkpoint(
+            self.checkpoint_dir,
+            self.trainer.state,
+            step=epoch + 1,
+            weights_only=self.save_weights_only,
+        )
+
+
+class TrackingCallback(Callback):
+    """Autolog per-epoch metrics into a tracking run, primary-only
+    (≙ mlflow autolog / rank-0 log_metric, P1/02:195, P1/03:360-373)."""
+
+    def __init__(self, run, log_lr: bool = True):
+        self.run = run
+        self.log_lr = log_lr
+
+    def on_epoch_end(self, epoch, logs):
+        from tpuflow.core import is_primary
+
+        if not is_primary() or self.run is None:
+            return
+        for k, v in logs.items():
+            self.run.log_metric(k, float(v), step=epoch)

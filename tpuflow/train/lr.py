@@ -1,0 +1,44 @@
+"""Learning-rate control: world-size scaling, warmup, plateau factor.
+
+≙ the reference's three LR mechanisms (P1/03_model_training_distributed.py):
+- base LR × world size (:300-302, the Goyal et al. linear-scaling rule),
+- ``LearningRateWarmupCallback(warmup_epochs=5)`` ramping from the base
+  LR to the scaled LR over the first epochs (:315-318),
+- ``ReduceLROnPlateau(patience=10)`` (:319-322).
+
+Here all three compose in one host-side controller producing the LR for
+every step; the value enters the jitted step as a traced scalar so
+adjustments never recompile (per-BATCH warmup granularity, same as the
+Horovod callback).
+"""
+
+from __future__ import annotations
+
+
+class LRController:
+    def __init__(
+        self,
+        base_lr: float,
+        world_size: int = 1,
+        scale_by_world_size: bool = True,
+        warmup_epochs: int = 5,
+        steps_per_epoch: int = 1,
+    ):
+        self.base_lr = float(base_lr)
+        self.target_lr = float(base_lr) * (world_size if scale_by_world_size else 1)
+        self.warmup_steps = max(0, int(warmup_epochs) * int(steps_per_epoch))
+        self.plateau_factor = 1.0
+        self.min_lr = 0.0
+
+    def lr_for_step(self, global_step: int) -> float:
+        if self.warmup_steps > 0 and global_step < self.warmup_steps:
+            frac = global_step / self.warmup_steps
+            lr = self.base_lr + (self.target_lr - self.base_lr) * frac
+        else:
+            lr = self.target_lr
+        return max(lr * self.plateau_factor, self.min_lr)
+
+    def reduce(self, factor: float) -> float:
+        """Apply a plateau reduction; returns the new post-warmup LR."""
+        self.plateau_factor *= factor
+        return self.target_lr * self.plateau_factor

@@ -1,0 +1,109 @@
+"""Optimizer factory: selection by name (C14).
+
+≙ the reference's reflection over Keras optimizers,
+``getattr(tf.keras.optimizers, params['optimizer'])(lr)``
+(P2/01_hyperopt_single_machine_model.py:154-155) — needed so HPO can
+search over the optimizer choice. Frozen-backbone masking applies zero
+updates to backbone params (≙ Keras layer.trainable=False).
+
+The learning rate is wrapped with ``optax.inject_hyperparams`` so
+callbacks can adjust it at runtime (warmup, ReduceLROnPlateau) without
+recompiling — the TPU-native form of Keras LR callbacks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import optax
+
+# Case-insensitive registry; Keras-style names included.
+_OPTIMIZERS: Dict[str, Callable[..., optax.GradientTransformation]] = {
+    "adam": optax.adam,
+    "adamw": optax.adamw,
+    "adadelta": optax.adadelta,
+    "adagrad": optax.adagrad,
+    "sgd": optax.sgd,
+    "rmsprop": optax.rmsprop,
+    "lamb": optax.lamb,
+    "lion": optax.lion,
+    "nadam": optax.nadam,
+}
+
+
+def available_optimizers() -> list:
+    return sorted(_OPTIMIZERS)
+
+
+def get_optimizer(
+    name: str,
+    learning_rate: float,
+    param_mask: Optional[Any] = None,
+    **kwargs,
+) -> optax.GradientTransformation:
+    """Build an optimizer by name with a runtime-adjustable LR.
+
+    ``param_mask``: pytree of bools, True = trainable. Frozen leaves get
+    ``optax.set_to_zero`` — structurally zero updates, and crucially zero
+    *optimizer state*, so frozen-backbone training carries no Adam
+    moments for the backbone (the ZeRO-ish memory win of masking).
+    """
+    key = name.lower()
+    if key not in _OPTIMIZERS:
+        raise ValueError(
+            f"unknown optimizer {name!r}; available: {available_optimizers()}"
+        )
+    tx = optax.inject_hyperparams(_OPTIMIZERS[key])(
+        learning_rate=learning_rate, **kwargs
+    )
+    if param_mask is not None:
+        tx = optax.multi_transform(
+            {"train": tx, "frozen": optax.set_to_zero()},
+            param_labels=lambda params: _labels_from_mask(param_mask),
+        )
+    return tx
+
+
+def _labels_from_mask(mask: Any) -> Any:
+    import jax
+
+    return jax.tree.map(lambda t: "train" if t else "frozen", mask)
+
+
+def set_learning_rate(opt_state: Any, lr: float) -> Any:
+    """Return opt_state with the injected learning_rate leaf replaced.
+
+    Works through the optional multi_transform wrapper. This is how
+    warmup/plateau callbacks steer the LR between steps (≙ Keras
+    callbacks mutating optimizer.lr) — a 4-byte update, no recompile.
+    """
+    import jax.numpy as jnp
+
+    def _replace(s):
+        if isinstance(s, optax.InjectStatefulHyperparamsState) or hasattr(
+            s, "hyperparams"
+        ):
+            hp = dict(s.hyperparams)
+            hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+            return s._replace(hyperparams=hp)
+        return s
+
+    if hasattr(opt_state, "inner_states"):  # multi_transform wrapper
+        inner = dict(opt_state.inner_states)
+        inner["train"] = _map_masked_node(inner["train"], _replace)
+        return opt_state._replace(inner_states=inner)
+    return _replace(opt_state)
+
+
+def get_learning_rate(opt_state: Any) -> float:
+    if hasattr(opt_state, "inner_states"):
+        node = opt_state.inner_states["train"]
+        node = node.inner_state if hasattr(node, "inner_state") else node
+        return float(node.hyperparams["learning_rate"])
+    return float(opt_state.hyperparams["learning_rate"])
+
+
+def _map_masked_node(node: Any, fn: Callable[[Any], Any]) -> Any:
+    if hasattr(node, "inner_state"):  # MaskedState
+        return node._replace(inner_state=fn(node.inner_state))
+    return fn(node)

@@ -1,0 +1,24 @@
+"""Functional train state: params + BN stats + optimizer state + step.
+
+≙ the mutable Keras model+optimizer the reference trains
+(P1/02_model_training_single_node.py:198-215); here it is one immutable
+pytree threaded through a jitted step — the donation-friendly XLA shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    rng: jax.Array
+
+    def num_params(self) -> int:
+        return sum(int(p.size) for p in jax.tree.leaves(self.params))
