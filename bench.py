@@ -1,0 +1,144 @@
+"""tpuflow benchmark: images/sec/chip on the flagship DP training config.
+
+Measures the steady-state jitted train step of the MobileNetV2 transfer
+classifier (the reference's distributed config: 224x224x3, per-worker
+batch 256 — P1/03_model_training_distributed.py:81) on all local
+devices, and reports ONE JSON line:
+
+  {"metric": "train_images_per_sec_per_chip", "value": N,
+   "unit": "images/s/chip", "vs_baseline": R}
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+anchored to the driver's north star instead: measured MFU / 0.60 (the
+"≥60% MFU" target from BASELINE.json) — 1.0 means the target is met.
+FLOPs come from XLA cost analysis of the compiled step (obs.mfu).
+
+Extra diagnostics (stderr): MFU, step time, native-decode throughput.
+Usage: python bench.py [--smoke] [--batch N] [--steps N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes on CPU (CI smoke)")
+    p.add_argument("--batch", type=int, default=None, help="per-chip batch")
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    args = p.parse_args()
+
+    if args.smoke:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_model
+    from tpuflow.obs.mfu import device_peak_flops, flops_of_jitted
+    from tpuflow.parallel.mesh import MeshSpec, build_mesh
+    from tpuflow.train import Trainer
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    if args.smoke:
+        hw, width, batch = 64, 0.25, args.batch or 8
+    else:
+        # the reference's distributed per-worker batch (P1/03:81)
+        hw, width, batch = 224, 1.0, args.batch or 256
+    global_batch = batch * n_chips
+
+    mesh = build_mesh(MeshSpec(data=n_chips, model=1))
+    model = build_model(num_classes=5, dropout=0.5, width_mult=width)
+    trainer = Trainer(model, TrainConfig(learning_rate=1e-3, warmup_epochs=0),
+                      mesh=mesh)
+    trainer.init_state((hw, hw, 3))
+    trainer._make_steps()
+
+    rng = np.random.default_rng(0)
+    batch_np = {
+        "image": rng.integers(0, 255, (global_batch, hw, hw, 3)).astype(np.uint8),
+        "label": rng.integers(0, 5, (global_batch,)).astype(np.int32),
+    }
+    images, labels = trainer._put(batch_np)
+    lr = jnp.asarray(1e-3, jnp.float32)
+
+    t_compile = time.time()
+    state, m = trainer._train_step(trainer.state, images, labels, lr)
+    jax.block_until_ready(m)
+    compile_s = time.time() - t_compile
+
+    flops = flops_of_jitted(
+        trainer._train_step, trainer.state, images, labels, lr
+    )
+
+    for _ in range(args.warmup):
+        state, m = trainer._train_step(state, images, labels, lr)
+    jax.block_until_ready(m)
+    t0 = time.time()
+    for _ in range(args.steps):
+        state, m = trainer._train_step(state, images, labels, lr)
+    jax.block_until_ready(m)
+    dt = (time.time() - t0) / args.steps
+
+    img_per_sec_chip = global_batch / dt / n_chips
+    peak = device_peak_flops(devices[0])
+    mfu_val = (flops / dt) / (n_chips * peak) if flops else 0.0
+
+    # decode-plane diagnostic (not part of the headline number)
+    decode_rate = _decode_diag(hw)
+
+    print(
+        f"# devices={n_chips} ({devices[0].device_kind}) hw={hw} width={width} "
+        f"batch/chip={batch} step={dt*1e3:.2f}ms compile={compile_s:.1f}s "
+        f"flops/step={flops:.3e} MFU={mfu_val*100:.1f}% "
+        f"decode={decode_rate:.0f} img/s loss={float(m['loss']):.4f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "train_images_per_sec_per_chip",
+                "value": round(img_per_sec_chip, 2),
+                "unit": "images/s/chip",
+                "vs_baseline": round(mfu_val / 0.60, 4),
+            }
+        )
+    )
+    return 0
+
+
+def _decode_diag(hw: int) -> float:
+    try:
+        import io
+
+        import numpy as np
+        from PIL import Image
+
+        from tpuflow.native import decode_resize_batch
+
+        arr = (np.random.default_rng(0).random((256, 256, 3)) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+        jpegs = [buf.getvalue()] * 128
+        decode_resize_batch(jpegs[:8], hw, hw)  # warm
+        t0 = time.time()
+        decode_resize_batch(jpegs, hw, hw, num_threads=os.cpu_count() or 1)
+        return len(jpegs) / (time.time() - t0)
+    except Exception:
+        return 0.0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,3 +107,11 @@ def test_append_is_incremental(store):
     # a second append chains manifests
     t.write(pa.table({"a": [2000]}), mode="append")
     assert t.count() == 602
+
+
+def test_append_schema_mismatch_rejected(store):
+    t = store.table("schema_guard")
+    t.write(pa.table({"a": [1, 2]}))
+    with pytest.raises(ValueError, match="schema"):
+        t.write(pa.table({"a": [3], "b": ["z"]}), mode="append")
+    assert t.read().column("a").to_pylist() == [1, 2]

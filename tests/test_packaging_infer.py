@@ -1,0 +1,110 @@
+"""Packaged model + batch inference tests (C13, C16)."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpuflow.packaging import PackagedModel, load_packaged_model, save_packaged_model
+from tpuflow.packaging.model import register_model_builder
+from tpuflow.track import ModelRegistry, TrackingStore
+
+CLASSES = ["daisy", "roses", "tulips"]
+
+
+class _Tiny(nn.Module):
+    num_classes: int = 3
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def _jpeg(color, hw=(32, 32)):
+    arr = np.zeros((*hw, 3), np.uint8)
+    arr[..., :] = color
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def packaged_dir(tmp_path_factory):
+    register_model_builder("tiny_test", lambda cfg: _Tiny(cfg["num_classes"]))
+    m = _Tiny(3)
+    v = m.init(jax.random.key(0), jnp.zeros((1, 16, 16, 3)))
+    # bias the head so predictions are deterministic by channel means
+    params = jax.device_get(v["params"])
+    params["Dense_0"]["kernel"] = np.array(
+        [[10.0, 0, 0], [0, 10.0, 0], [0, 0, 10.0]], np.float32
+    )
+    d = tmp_path_factory.mktemp("pkg")
+    save_packaged_model(
+        str(d), params, {}, CLASSES, img_height=16, img_width=16,
+        model_type="tiny_test", model_config={"num_classes": 3},
+    )
+    return str(d)
+
+
+def test_predict_returns_class_strings(packaged_dir):
+    model = PackagedModel(packaged_dir)
+    # pure red/green/blue → channel argmax picks class 0/1/2
+    preds = model.predict([_jpeg((255, 0, 0)), _jpeg((0, 255, 0)), _jpeg((0, 0, 255))])
+    assert preds == CLASSES
+
+
+def test_bytes_as_str_quirk(packaged_dir):
+    # ≙ ast.literal_eval repair (P2/03:226-229)
+    model = PackagedModel(packaged_dir)
+    raw = _jpeg((0, 255, 0))
+    assert model.predict([str(raw)]) == ["roses"]
+
+
+def test_partial_batch_padding(packaged_dir):
+    model = PackagedModel(packaged_dir)
+    preds = model.predict([_jpeg((255, 0, 0))] * 5, batch_size=4)
+    assert preds == ["daisy"] * 5
+
+
+def test_load_by_registry_uri(packaged_dir, tmp_path):
+    store = TrackingStore(str(tmp_path / "rt"))
+    run = store.start_run("train")
+    run.log_artifact(packaged_dir, "")
+    import os
+    name = os.path.basename(packaged_dir)
+    reg = ModelRegistry(store)
+    v = reg.register_model(f"runs:/{run.run_id}/{name}", "tinymodel")
+    reg.transition_model_version_stage("tinymodel", v["version"], "Production")
+    m = load_packaged_model("models:/tinymodel/production", registry=reg)
+    assert m.predict([_jpeg((255, 0, 0))]) == ["daisy"]
+
+
+def test_predict_table_sharded(packaged_dir, tmp_path):
+    import pyarrow as pa
+    from tpuflow.data import TableStore
+    from tpuflow.infer import predict_table
+
+    store = TableStore(str(tmp_path / "tbl"), "db")
+    t = store.table("images")
+    rows = [_jpeg((255, 0, 0)), _jpeg((0, 255, 0))] * 4
+    t.write(pa.table({"content": pa.array(rows, pa.binary())}), compression=None)
+    model = PackagedModel(packaged_dir)
+    full = predict_table(model, t)
+    assert full.column("prediction").to_pylist() == ["daisy", "roses"] * 4
+    # shards partition the rows
+    s0 = predict_table(model, t, shard=(0, 2))
+    s1 = predict_table(model, t, shard=(1, 2))
+    assert s0.num_rows + s1.num_rows == 8
+    # limit smoke mode (≙ limit(1000), P2/03:470)
+    assert predict_table(model, t, limit=3).num_rows == 3
+    # output table collects shard results
+    out = store.table("preds")
+    predict_table(model, t, shard=(0, 2), output_table=out)
+    predict_table(model, t, shard=(1, 2), output_table=out)
+    assert out.count() == 8

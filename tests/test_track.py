@@ -1,0 +1,104 @@
+"""Tracking + registry tests (C11-C12, N10)."""
+
+import os
+
+import pytest
+
+from tpuflow.track import ModelRegistry, TrackingStore
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return TrackingStore(str(tmp_path / "runs"))
+
+
+def test_run_params_metrics_artifacts(store, tmp_path):
+    with store.start_run("r1") as run:
+        run.log_param("lr", 0.01)
+        run.log_params({"optimizer": "adam", "batch": 32})
+        for step, v in enumerate([1.0, 0.5, 0.3]):
+            run.log_metric("loss", v, step=step)
+        f = tmp_path / "art.txt"
+        f.write_text("hello")
+        run.log_artifact(str(f))
+        run.log_dict({"a": 1}, "cfg/params.json")
+    r = store.get_run(run.run_id)
+    assert r.params() == {"lr": 0.01, "optimizer": "adam", "batch": 32}
+    assert [m["value"] for m in r.metric_history("loss")] == [1.0, 0.5, 0.3]
+    assert r.metrics()["loss"] == 0.3
+    assert os.path.exists(r.artifact_path("art.txt"))
+    assert os.path.exists(r.artifact_path("cfg/params.json"))
+    assert r.meta()["status"] == "FINISHED"
+
+
+def test_reattach_existing_run(store):
+    # ≙ workers attaching to the driver's run_uuid (P1/03:361-363)
+    run = store.start_run("driver_run")
+    worker_run = store.start_run(run_id=run.run_id)
+    worker_run.log_metric("val_loss", 0.1)
+    assert store.get_run(run.run_id).metrics()["val_loss"] == 0.1
+    assert worker_run.meta()["run_name"] == "driver_run"
+
+
+def test_nested_runs_and_search(store):
+    # ≙ HPO child runs under a parent + metric-ordered search (P2/02:244-260,390-399)
+    parent = store.start_run("hpo_parent")
+    for i, acc in enumerate([0.7, 0.9, 0.8]):
+        child = store.start_run(
+            f"lr_{i}", parent_run_id=parent.run_id
+        )
+        child.log_param("lr", 10 ** -i)
+        child.log_metric("val_accuracy", acc)
+        child.end()
+    rows = store.search_runs(
+        filter={"tags.parentRunId": parent.run_id},
+        order_by="metrics.val_accuracy DESC",
+    )
+    assert len(rows) == 3
+    assert rows[0]["run_name"] == "lr_1"
+    assert rows[0]["metrics.val_accuracy"] == 0.9
+
+
+def test_registry_stage_flow(store, tmp_path):
+    # ≙ register → Production → load by stage URI (P2/01:278-299)
+    run = store.start_run("train")
+    mdir = tmp_path / "m"
+    mdir.mkdir()
+    (mdir / "weights.bin").write_bytes(b"w")
+    run.log_artifact(str(mdir), "")  # artifacts/m
+    reg = ModelRegistry(store)
+    v1 = reg.register_model(f"runs:/{run.run_id}/m", "flowers")
+    assert v1["version"] == 1 and v1["stage"] == "None"
+    reg.transition_model_version_stage("flowers", 1, "Production")
+    assert reg.latest_version("flowers", stage="production")["version"] == 1
+    # second version displaces the first from Production
+    v2 = reg.register_model(f"runs:/{run.run_id}/m", "flowers")
+    reg.transition_model_version_stage("flowers", v2["version"], "Production")
+    stages = {m["version"]: m["stage"] for m in reg.versions("flowers")}
+    assert stages == {1: "Archived", 2: "Production"}
+    path = reg.resolve_uri("models:/flowers/production")
+    assert os.path.exists(os.path.join(path, "weights.bin"))
+    # version-number URI
+    assert reg.resolve_uri("models:/flowers/1") == reg.get_version("flowers", 1)["source_path"]
+
+
+def test_search_runs_filter_by_param(store):
+    a = store.start_run("a"); a.log_param("opt", "adam"); a.end()
+    b = store.start_run("b"); b.log_param("opt", "sgd"); b.end()
+    rows = store.search_runs(filter={"params.opt": "sgd"})
+    assert [r["run_name"] for r in rows] == ["b"]
+
+
+def test_bad_uri_and_missing_run(store):
+    with pytest.raises(KeyError):
+        store.get_run("nope")
+    with pytest.raises(ValueError):
+        store.resolve_uri("gs://elsewhere")
+
+
+def test_search_orders_missing_metrics_last(store):
+    a = store.start_run("with_metric"); a.log_metric("acc", 0.5); a.end()
+    b = store.start_run("no_metric"); b.end()
+    rows = store.search_runs(order_by="metrics.acc DESC")
+    assert rows[0]["run_name"] == "with_metric"
+    assert rows[-1]["run_name"] == "no_metric"

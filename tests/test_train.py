@@ -201,3 +201,56 @@ def test_state_replicated_across_mesh(data):
         shards = [np.asarray(s.data) for s in leaf.addressable_shards]
         for s in shards[1:]:
             np.testing.assert_array_equal(shards[0], s)
+
+
+def test_plateau_factor_survives_resume(tmp_path, data):
+    from tpuflow.ckpt import latest_checkpoint, restore_into_state
+
+    images, labels = data
+    ds = ArrayDataset(images, labels, batch_size=16)
+    ck = str(tmp_path / "ck2")
+    t = Trainer(TinyClassifier(), TrainConfig(epochs=4, learning_rate=0.0,
+                                              warmup_epochs=0,
+                                              reduce_on_plateau_patience=2,
+                                              reduce_on_plateau_factor=0.5,
+                                              checkpoint_dir=ck))
+    t.fit(ds, val_ds=ds, epochs=4, steps_per_epoch=1, validation_steps=1)
+    assert t.lr_controller.plateau_factor < 1.0
+    reduced = t.lr_controller.plateau_factor
+
+    t2 = Trainer(TinyClassifier(), TrainConfig(epochs=5, learning_rate=0.0,
+                                               warmup_epochs=0))
+    t2.init_state((16, 16, 3))
+    t2.state = restore_into_state(latest_checkpoint(ck), t2.state)
+    t2.fit(ds, epochs=5, initial_epoch=4, steps_per_epoch=1)
+    assert t2.lr_controller.plateau_factor == pytest.approx(reduced)
+
+
+def test_finite_stream_ends_cleanly(data):
+    images, labels = data
+
+    class FiniteDS(ArrayDataset):
+        def __iter__(self):
+            n = len(self.images)
+            for s in range(0, n - self.batch_size + 1, self.batch_size):
+                yield {"image": self.images[s:s+self.batch_size],
+                       "label": self.labels[s:s+self.batch_size]}
+
+    ds = FiniteDS(images, labels, batch_size=16)  # 4 batches total
+    t = Trainer(TinyClassifier(), TrainConfig(epochs=10, learning_rate=0.01,
+                                              warmup_epochs=0))
+    hist = t.fit(ds, epochs=10, steps_per_epoch=3).history
+    # 4 batches / 3 steps per epoch: epoch0 full, epoch1 partial, then stop
+    assert len(hist["loss"]) == 2
+
+
+def test_config_wires_checkpoint_callback(tmp_path, data):
+    from tpuflow.ckpt import list_checkpoints
+
+    images, labels = data
+    ds = ArrayDataset(images, labels, batch_size=16)
+    ck = str(tmp_path / "auto_ck")
+    t = Trainer(TinyClassifier(), TrainConfig(epochs=2, learning_rate=0.01,
+                                              warmup_epochs=0, checkpoint_dir=ck))
+    t.fit(ds, epochs=2, steps_per_epoch=1)
+    assert len(list_checkpoints(ck)) == 2

@@ -1,0 +1,113 @@
+"""TPE + fmin + trial-executor tests (C14-C15, N9)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpuflow.tune import ParallelTrials, Trials, fmin, hp, STATUS_OK
+
+
+def test_space_sampling_and_bounds():
+    space = {
+        "optimizer": hp.choice(["adadelta", "adam"]),
+        "lr": hp.loguniform(-5, 0),
+        "dropout": hp.uniform(0.1, 0.9),
+        "batch": hp.quniform(32, 128, 32),
+    }
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        s = {k: d.sample(rng) for k, d in space.items()}
+        assert s["optimizer"] in ("adadelta", "adam")
+        assert np.exp(-5) <= s["lr"] <= 1.0
+        assert 0.1 <= s["dropout"] <= 0.9
+        assert s["batch"] in (32, 64, 96, 128)
+
+
+def test_fmin_minimizes_quadratic():
+    def objective(params):
+        return {"loss": (params["x"] - 0.7) ** 2, "status": STATUS_OK}
+
+    best = fmin(objective, {"x": hp.uniform(0, 1)}, max_evals=40, seed=1)
+    assert abs(best["x"] - 0.7) < 0.1
+
+
+def test_tpe_beats_random_on_average():
+    def objective(params):
+        return (params["x"] - 0.25) ** 2 + (params["y"] + 2) ** 2 / 16
+
+    def best_loss(algo, seed):
+        t = Trials()
+        fmin(objective, {"x": hp.uniform(0, 1), "y": hp.uniform(-4, 4)},
+             max_evals=30, algo=algo, trials=t, seed=seed)
+        return t.best().loss
+
+    tpe_losses = [best_loss("tpe", s) for s in range(5)]
+    rnd_losses = [best_loss("random", s) for s in range(5)]
+    assert np.mean(tpe_losses) <= np.mean(rnd_losses) * 1.2
+
+
+def test_negated_accuracy_convention():
+    # ≙ returning -accuracy to maximize accuracy (P2/01:179-181)
+    def objective(params):
+        acc = 1.0 - abs(params["lr"] - 0.1)
+        return {"loss": -acc, "status": STATUS_OK}
+
+    t = Trials()
+    best = fmin(objective, {"lr": hp.uniform(0, 1)}, max_evals=30, trials=t, seed=3)
+    assert abs(best["lr"] - 0.1) < 0.15
+    assert t.best().loss <= -0.85
+
+
+def test_failed_trial_does_not_kill_sweep():
+    calls = []
+
+    def objective(params):
+        calls.append(params)
+        if len(calls) == 3:
+            raise RuntimeError("boom")
+        return params["x"] ** 2
+
+    t = Trials()
+    best = fmin(objective, {"x": hp.uniform(-1, 1)}, max_evals=10, trials=t, seed=0)
+    assert len(t.results) == 10
+    fails = [r for r in t.results if r.status != STATUS_OK]
+    assert len(fails) == 1 and "boom" in fails[0].extra["error"]
+    assert abs(best["x"]) < 1
+
+
+def test_parallel_trials_concurrency_and_device_groups():
+    # ≙ SparkTrials(parallelism=4) (P2/01:229): trials run concurrently,
+    # each with a disjoint device subset
+    active = []
+    peak = []
+    lock = threading.Lock()
+    seen_devices = []
+
+    def objective(params, devices=None):
+        with lock:
+            active.append(1)
+            peak.append(len(active))
+            seen_devices.append(tuple(d.id for d in devices))
+        time.sleep(0.05)
+        with lock:
+            active.pop()
+        return params["x"] ** 2
+
+    t = ParallelTrials(parallelism=4)
+    assert len(t.device_groups) == 4
+    assert len({d.id for g in t.device_groups for d in g}) == 8  # disjoint cover
+    fmin(objective, {"x": hp.uniform(-1, 1)}, max_evals=8, trials=t, seed=0)
+    assert max(peak) > 1  # genuinely concurrent
+    assert len(t.results) == 8
+    assert all(len(set(g)) == 2 for g in seen_devices)  # 8 devs / 4 groups
+
+
+def test_trials_best_and_losses():
+    t = Trials()
+    t.record(0, {"x": 1}, 5.0)
+    t.record(1, {"x": 2}, {"loss": 2.0, "status": STATUS_OK, "note": "hi"})
+    assert t.losses == [5.0, 2.0]
+    assert t.best().params == {"x": 2}
+    assert t.best().extra["note"] == "hi"

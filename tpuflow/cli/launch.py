@@ -1,0 +1,130 @@
+"""SPMD launcher (N2) — the HorovodRunner/mpirun equivalent.
+
+The reference's launch cascade — pickle the train fn, Spark barrier job,
+BarrierTaskContext IP gather, ``mpirun`` one python per worker
+(P1/03_model_training_distributed.py:256-263) — collapses on TPU to
+"run the SAME program once per host with a coordinator address"
+(SPMD). This CLI covers the three topologies:
+
+1. real pod: run on each host with --process-id/--num-processes (or let
+   TPU metadata fill them in), one command per host;
+2. local fake cluster: ``--local N`` forks N CPU processes on this
+   machine with a shared coordinator — the multi-process test rig the
+   reference lacks (SURVEY.md §4);
+3. ``--np -1``: driver-local single process, the reference's smoke mode
+   (P1/03:385-397).
+
+Gang semantics (≙ Spark barrier mode, P1/03:256): with --local, if any
+process exits non-zero the launcher terminates the rest and exits
+non-zero — all-or-nothing, no half-alive training jobs.
+
+Usage:
+  python -m tpuflow.cli.launch --local 4 -- python train_script.py
+  python -m tpuflow.cli.launch --np -1 -- python train_script.py
+  python -m tpuflow.cli.launch --coordinator host0:8476 \
+      --num-processes 4 --process-id $HOST_ID -- python train_script.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+from typing import List
+
+
+def _parse(argv: List[str]) -> tuple:
+    p = argparse.ArgumentParser(prog="tpuflow.cli.launch", description=__doc__)
+    p.add_argument("--local", type=int, default=0,
+                   help="fork N local CPU processes (fake cluster)")
+    p.add_argument("--np", type=int, default=None,
+                   help="-1 = single local process (smoke mode)")
+    p.add_argument("--coordinator", type=str, default=None,
+                   help="host:port of process 0 (multi-host)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--port", type=int, default=8476)
+    if "--" not in argv:
+        p.error("command required after --")
+    split = argv.index("--")
+    args = p.parse_args(argv[:split])
+    cmd = argv[split + 1 :]
+    if not cmd:
+        p.error("empty command after --")
+    return args, cmd
+
+
+def _run_local_cluster(n: int, port: int, cmd: List[str]) -> int:
+    """Fork n processes with coordinator env; gang-fail together."""
+    procs: List[subprocess.Popen] = []
+    base = dict(os.environ)
+    # hermetic CPU: each process sees n fake devices? No — one CPU device
+    # per process; the mesh spans processes (true multi-process SPMD).
+    base.pop("PALLAS_AXON_POOL_IPS", None)
+    base["PYTHONPATH"] = ":".join(
+        p for p in base.get("PYTHONPATH", "").split(":") if p and "axon" not in p
+    )
+    base["JAX_PLATFORMS"] = base.get("TPUFLOW_LOCAL_PLATFORM", "cpu")
+    # each process gets its natural device count: strip any inherited
+    # virtual-device forcing (e.g. from a test harness)
+    if "XLA_FLAGS" in base:
+        base["XLA_FLAGS"] = " ".join(
+            f
+            for f in base["XLA_FLAGS"].split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+    for i in range(n):
+        env = dict(base)
+        env["TPUFLOW_COORDINATOR"] = f"localhost:{port}"
+        env["TPUFLOW_NUM_PROCESSES"] = str(n)
+        env["TPUFLOW_PROCESS_ID"] = str(i)
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    try:
+        remaining = set(range(n))
+        while remaining:
+            for i in list(remaining):
+                code = procs[i].poll()
+                if code is not None:
+                    remaining.discard(i)
+                    if code != 0:
+                        rc = code
+                        raise RuntimeError(f"process {i} exited {code}")
+            import time
+
+            time.sleep(0.2)
+    except (RuntimeError, KeyboardInterrupt):
+        rc = rc or 1
+        for pr in procs:
+            if pr.poll() is None:
+                pr.send_signal(signal.SIGTERM)
+        for pr in procs:
+            pr.wait(timeout=30)
+    return rc
+
+
+def main(argv: List[str] | None = None) -> int:
+    args, cmd = _parse(argv if argv is not None else sys.argv[1:])
+    if args.local and args.local > 0:
+        return _run_local_cluster(args.local, args.port, cmd)
+    env = dict(os.environ)
+    if args.np == -1 or (
+        args.coordinator is None and not args.local
+    ):
+        # driver-local smoke mode: no distributed init (≙ np=-1)
+        env.pop("TPUFLOW_COORDINATOR", None)
+        env["TPUFLOW_NUM_PROCESSES"] = "1"
+        env["TPUFLOW_PROCESS_ID"] = "0"
+        return subprocess.call(cmd, env=env)
+    env["TPUFLOW_COORDINATOR"] = args.coordinator
+    if args.num_processes is not None:
+        env["TPUFLOW_NUM_PROCESSES"] = str(args.num_processes)
+    if args.process_id is not None:
+        env["TPUFLOW_PROCESS_ID"] = str(args.process_id)
+    return subprocess.call(cmd, env=env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

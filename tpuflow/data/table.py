@@ -72,6 +72,13 @@ class Table:
         prev_rows = 0
         if mode == "append" and self.exists():
             prev = self.manifest()
+            if list(data.schema.names) != list(prev.schema):
+                # Delta-style schema enforcement: reject rather than write
+                # parts that cannot be concatenated at read time
+                raise ValueError(
+                    f"append schema {data.schema.names} != table schema "
+                    f"{prev.schema}"
+                )
             # normalize to table-root-relative paths
             prev_files = [
                 f if "/" in f else f"v{prev.version}/{f}" for f in prev.files

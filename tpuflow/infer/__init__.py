@@ -1,0 +1,1 @@
+from tpuflow.infer.batch import predict_table  # noqa: F401

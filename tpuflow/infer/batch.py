@@ -1,0 +1,61 @@
+"""Distributed batch inference (C16) — the spark_udf equivalent.
+
+≙ ``mlflow.pyfunc.spark_udf(spark, model_uri, result_type='string')``
+applied to a table's ``content`` column (P2/03_pyfunc_distributed_
+inference.py:466-472): each executor loads the packaged model once and
+maps it over its partitions. TPU-native form: each PROCESS loads the
+model once and streams its row shard through the jitted forward on its
+local devices; results land in a predictions table (one part per
+shard), so the multi-host path needs no driver gather.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Tuple
+
+import pyarrow as pa
+
+from tpuflow.data.table import Table
+from tpuflow.packaging.model import PackagedModel, load_packaged_model
+
+
+def predict_table(
+    model: "PackagedModel | str",
+    table: Table,
+    content_col: str = "content",
+    batch_size: int = 64,
+    shard: Tuple[int, int] = (0, 1),
+    limit: Optional[int] = None,
+    output_table: Optional[Table] = None,
+    store=None,
+    registry=None,
+) -> pa.Table:
+    """Map a packaged model over one shard of ``table``.
+
+    Returns the shard's rows with a ``prediction`` string column
+    appended (≙ df.withColumn('prediction', udf('content')),
+    P2/03:468-472). ``limit`` mirrors the notebook's ``limit(1000)``
+    smoke runs (P2/03:470). With ``output_table``, predictions are
+    appended there instead (multi-host pattern: every process writes
+    its own shard, shard (i,n) rows are disjoint by construction).
+    """
+    if isinstance(model, str):
+        model = load_packaged_model(model, store=store, registry=registry)
+    cur, n_shards = shard
+    data = table.read()
+    if limit is not None:
+        data = data.slice(0, limit)
+    if n_shards > 1:
+        import numpy as np
+
+        idx = np.arange(data.num_rows)
+        data = data.take(pa.array(idx[idx % n_shards == cur]))
+    preds: List[str] = []
+    contents = data.column(content_col).to_pylist()
+    for s in range(0, len(contents), batch_size):
+        preds.extend(model.predict(contents[s : s + batch_size], batch_size))
+    out = data.append_column("prediction", pa.array(preds, pa.string()))
+    if output_table is not None:
+        output_table.write(out, mode="append" if output_table.exists() else "overwrite")
+    return out

@@ -1,0 +1,7 @@
+from tpuflow.obs.profiler import trace, annotate  # noqa: F401
+from tpuflow.obs.mfu import (  # noqa: F401
+    device_peak_flops,
+    flops_of_jitted,
+    mfu,
+)
+from tpuflow.obs.sysmetrics import sample_system_metrics  # noqa: F401

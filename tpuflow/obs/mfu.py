@@ -1,0 +1,105 @@
+"""MFU accounting (SURVEY.md §7 hard part 5, N11).
+
+The north-star metric is images/sec/chip at ≥60% MFU (BASELINE.md).
+FLOPs per step come from XLA's own cost analysis of the compiled
+executable — honest numbers that track the real program, not a paper
+formula; peak chip FLOP/s comes from a per-generation table
+(bf16, dense) overridable via TPUFLOW_PEAK_FLOPS.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+# bf16 dense peak FLOP/s per chip by TPU generation (public specs).
+_PEAK_BF16 = {
+    "v2": 45e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
+
+
+def device_peak_flops(device: Optional[Any] = None) -> float:
+    """Peak bf16 FLOP/s of one chip. Env override TPUFLOW_PEAK_FLOPS."""
+    env = os.environ.get("TPUFLOW_PEAK_FLOPS")
+    if env:
+        return float(env)
+    import jax
+
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_BF16.items():
+        if key in kind:
+            return val
+    if device.platform == "cpu":
+        return 1e11  # nominal, keeps MFU math testable on CPU
+    return 275e12  # default to v4 (the baseline target hardware)
+
+
+def flops_of_jitted(jitted_fn, *args, **kwargs) -> float:
+    """FLOPs of one invocation, from XLA cost analysis of the lowered
+    executable. Returns 0.0 if the backend reports no estimate."""
+    compiled = jitted_fn.lower(*args, **kwargs).compile()
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return 0.0
+    if isinstance(ca, list):  # per-device list on some backends
+        ca = ca[0] if ca else {}
+    return float(ca.get("flops", 0.0)) if ca else 0.0
+
+
+def mfu(
+    flops_per_step: float,
+    step_time_s: float,
+    n_chips: int = 1,
+    device: Optional[Any] = None,
+) -> float:
+    """Model FLOP utilization in [0, 1]."""
+    if step_time_s <= 0 or flops_per_step <= 0:
+        return 0.0
+    return flops_per_step / (step_time_s * n_chips * device_peak_flops(device))
+
+
+def mobilenet_v2_flops(
+    img_height: int = 224,
+    img_width: int = 224,
+    width_mult: float = 1.0,
+    num_classes: int = 5,
+    train: bool = True,
+) -> float:
+    """Analytic MobileNetV2 forward FLOPs per image (multiply-adds × 2),
+    as a sanity cross-check against XLA's cost analysis. Backward for
+    the frozen-backbone transfer model adds only the head, so
+    train≈forward here; full fine-tuning would be ~3x forward."""
+    from tpuflow.models.mobilenet_v2 import (
+        _INVERTED_RESIDUAL_SETTINGS,
+        make_divisible,
+    )
+
+    h, w = img_height // 2, img_width // 2
+    stem = make_divisible(32 * width_mult)
+    flops = 2 * h * w * stem * 3 * 9  # stem 3x3 conv
+    in_ch = stem
+    for t, c, n, s in _INVERTED_RESIDUAL_SETTINGS:
+        out_ch = make_divisible(c * width_mult)
+        for i in range(n):
+            stride = s if i == 0 else 1
+            hidden = in_ch * t
+            if t != 1:
+                flops += 2 * h * w * in_ch * hidden  # expand 1x1
+            h2, w2 = h // stride, w // stride
+            flops += 2 * h2 * w2 * hidden * 9  # depthwise 3x3
+            flops += 2 * h2 * w2 * hidden * out_ch  # project 1x1
+            h, w, in_ch = h2, w2, out_ch
+    last = make_divisible(1280 * max(1.0, width_mult))
+    flops += 2 * h * w * in_ch * last
+    flops += 2 * last * num_classes  # head dense
+    return float(flops)

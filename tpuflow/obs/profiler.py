@@ -1,0 +1,48 @@
+"""Profiling (SURVEY.md §5.1) — the Horovod-Timeline equivalent.
+
+The reference's only op-level tracer is the opt-in Horovod Timeline
+written to JSON for chrome://tracing (P1/03_model_training_distributed.py:407-409),
+plus MLflow autolog for per-epoch metrics. Here:
+
+- ``trace(logdir)`` wraps ``jax.profiler`` and produces a
+  TensorBoard/Perfetto trace of N steps (device + host timelines, XLA
+  op breakdown — strictly more than Horovod Timeline showed);
+- ``annotate(name)`` marks host-code regions so loader/step phases are
+  attributable in the trace;
+- opt-in via env var TPUFLOW_TRACE_DIR as the reference's
+  HOROVOD_TIMELINE was env-driven, or programmatic.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str] = None) -> Iterator[Optional[str]]:
+    """Capture a profiler trace around the enclosed steps.
+
+    No-op when logdir is None and TPUFLOW_TRACE_DIR is unset, so the
+    call can stay in production code (the timeline's "only enable when
+    debugging" warning, P1/03:408, becomes a default)."""
+    import jax
+
+    logdir = logdir or os.environ.get("TPUFLOW_TRACE_DIR")
+    if not logdir:
+        yield None
+        return
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named host-region annotation visible in traces."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,80 @@
+"""Host + device metrics sampling (N11) — the Ganglia equivalent.
+
+≙ the workshop's monitoring story: Ganglia dashboards for CPU/mem/
+network (P1/04_monitoring_and_optimization.py:25-30). Sampled
+programmatically (from /proc and the JAX device API) so the numbers can
+be logged as run metrics alongside training instead of living in a
+separate dashboard.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict
+
+
+def _proc_meminfo() -> Dict[str, float]:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                v = rest.strip().split()
+                if v:
+                    out[k] = float(v[0]) * 1024  # kB -> bytes
+    except OSError:
+        pass
+    return out
+
+
+_last_cpu = None
+
+
+def _cpu_percent() -> float:
+    """System-wide CPU utilization since the previous call."""
+    global _last_cpu
+    try:
+        with open("/proc/stat") as f:
+            parts = f.readline().split()[1:]
+        vals = [float(x) for x in parts]
+    except OSError:
+        return 0.0
+    idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
+    total = sum(vals)
+    if _last_cpu is None:
+        _last_cpu = (total, idle)
+        return 0.0
+    dt, di = total - _last_cpu[0], idle - _last_cpu[1]
+    _last_cpu = (total, idle)
+    return 100.0 * (1 - di / dt) if dt > 0 else 0.0
+
+
+def sample_system_metrics(include_devices: bool = True) -> Dict[str, float]:
+    """One snapshot: host cpu/mem + per-device HBM, prefixed for
+    run-metric logging (sys.* / device<i>.*)."""
+    m: Dict[str, float] = {"sys.cpu_percent": _cpu_percent(), "sys.time": time.time()}
+    mem = _proc_meminfo()
+    if mem:
+        total = mem.get("MemTotal", 0.0)
+        avail = mem.get("MemAvailable", 0.0)
+        m["sys.mem_total_bytes"] = total
+        m["sys.mem_used_bytes"] = total - avail
+    try:
+        m["sys.load_1m"] = os.getloadavg()[0]
+    except OSError:
+        pass
+    if include_devices:
+        import jax
+
+        for d in jax.local_devices():
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                pass
+            if "bytes_in_use" in stats:
+                m[f"device{d.id}.hbm_in_use_bytes"] = float(stats["bytes_in_use"])
+            if "bytes_limit" in stats:
+                m[f"device{d.id}.hbm_limit_bytes"] = float(stats["bytes_limit"])
+    return m

@@ -1,0 +1,5 @@
+from tpuflow.packaging.model import (  # noqa: F401
+    PackagedModel,
+    load_packaged_model,
+    save_packaged_model,
+)

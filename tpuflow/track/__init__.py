@@ -1,0 +1,2 @@
+from tpuflow.track.store import Run, TrackingStore  # noqa: F401
+from tpuflow.track.registry import ModelRegistry  # noqa: F401

@@ -1,0 +1,118 @@
+"""Model registry with stage transitions (C12, N10).
+
+≙ the reference's registry flow: ``register_model('runs:/<id>/model',
+name)`` → ``transition_model_version_stage(..., 'Production')`` → load
+``models:/<name>/production`` (P2/01_hyperopt_single_machine_model.py:278-299,
+repeated P2/02:417-432). Versions are monotonically numbered; a stage
+transition optionally archives the versions currently in that stage
+(MLflow's archive_existing_versions semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from tpuflow.track.store import TrackingStore, _atomic_json
+
+STAGES = ("None", "Staging", "Production", "Archived")
+
+
+class ModelRegistry:
+    def __init__(self, store: TrackingStore):
+        self.store = store
+        self.root = os.path.join(store.root, "registry")
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- registration -----------------------------------------------------
+
+    def register_model(self, model_uri: str, name: str) -> Dict[str, Any]:
+        """Snapshot the artifact path behind ``model_uri`` as a new
+        version of ``name``. Returns version metadata."""
+        src = self.store.resolve_uri(model_uri)
+        if not os.path.exists(src):
+            raise FileNotFoundError(f"model uri {model_uri!r} -> {src} missing")
+        versions = self.versions(name)
+        v = (max((m["version"] for m in versions), default=0)) + 1
+        vdir = self._vdir(name, v)
+        os.makedirs(vdir, exist_ok=True)
+        meta = {
+            "name": name,
+            "version": v,
+            "source_uri": model_uri,
+            "source_path": src,
+            "stage": "None",
+            "created_at": time.time(),
+        }
+        _atomic_json(os.path.join(vdir, "meta.json"), meta)
+        return meta
+
+    # -- stages -----------------------------------------------------------
+
+    def transition_model_version_stage(
+        self,
+        name: str,
+        version: int,
+        stage: str,
+        archive_existing_versions: bool = True,
+    ) -> Dict[str, Any]:
+        if stage not in STAGES:
+            raise ValueError(f"stage must be one of {STAGES}")
+        if archive_existing_versions and stage in ("Staging", "Production"):
+            for m in self.versions(name):
+                if m["stage"] == stage and m["version"] != version:
+                    self._set_stage(name, m["version"], "Archived")
+        return self._set_stage(name, version, stage)
+
+    def _set_stage(self, name: str, version: int, stage: str) -> Dict[str, Any]:
+        vdir = self._vdir(name, version)
+        mpath = os.path.join(vdir, "meta.json")
+        with open(mpath) as f:
+            meta = json.load(f)
+        meta["stage"] = stage
+        _atomic_json(mpath, meta)
+        return meta
+
+    # -- queries ----------------------------------------------------------
+
+    def versions(self, name: str) -> List[Dict[str, Any]]:
+        ndir = os.path.join(self.root, name, "versions")
+        if not os.path.isdir(ndir):
+            return []
+        out = []
+        for d in sorted(os.listdir(ndir), key=lambda s: int(s)):
+            with open(os.path.join(ndir, d, "meta.json")) as f:
+                out.append(json.load(f))
+        return out
+
+    def get_version(self, name: str, version: int) -> Dict[str, Any]:
+        with open(os.path.join(self._vdir(name, version), "meta.json")) as f:
+            return json.load(f)
+
+    def latest_version(
+        self, name: str, stage: Optional[str] = None
+    ) -> Optional[Dict[str, Any]]:
+        vs = self.versions(name)
+        if stage is not None:
+            vs = [m for m in vs if m["stage"].lower() == stage.lower()]
+        return vs[-1] if vs else None
+
+    def resolve_uri(self, uri: str) -> str:
+        """``models:/<name>/<stage-or-version>`` → artifact filesystem path
+        (≙ load_model('models:/<name>/production'), P2/01:297-299)."""
+        if not uri.startswith("models:/"):
+            return self.store.resolve_uri(uri)
+        rest = uri[len("models:/") :]
+        name, _, sel = rest.partition("/")
+        if sel.isdigit():
+            meta = self.get_version(name, int(sel))
+        else:
+            meta = self.latest_version(name, stage=sel or None)
+            if meta is None:
+                raise KeyError(f"no version of {name!r} in stage {sel!r}")
+        return meta["source_path"]
+
+    def _vdir(self, name: str, version: int) -> str:
+        return os.path.join(self.root, name, "versions", str(version))

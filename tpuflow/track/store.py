@@ -1,0 +1,270 @@
+"""File-based experiment tracking (C11, N10) — the MLflow-tracking equivalent.
+
+Covers what the reference actually exercises of MLflow:
+- runs with params / step-stamped metrics / artifacts
+  (``log_param/log_metric/log_model``, P1/03_model_training_distributed.py:363-373);
+- autolog-style per-epoch metric capture (P1/02:195) via
+  train.TrackingCallback;
+- NESTED child runs per HPO trial, named by the param string
+  (P2/02:244-260);
+- re-attaching to an existing run id from another process — the
+  pattern where the driver creates a run and workers log into it by
+  run_uuid (P1/03:361-363, :411-415);
+- ``search_runs`` filtered by parent-run tag and ordered by a metric
+  (P2/01:257-261, P2/02:390-399).
+
+Storage is a plain directory tree (JSON + JSONL): no server, works on
+shared filesystems, safe under the rank-0-only write discipline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_ROOT = os.environ.get("TPUFLOW_TRACKING_DIR", "./tpuflow_runs")
+
+
+class Run:
+    """Handle to one run directory. Context-manager; primary-only by
+    convention (callers gate on core.is_primary, ≙ hvd.rank()==0)."""
+
+    def __init__(self, store: "TrackingStore", run_id: str):
+        self.store = store
+        self.run_id = run_id
+        self.path = store._run_path(run_id)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def __enter__(self) -> "Run":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end("FAILED" if exc_type else "FINISHED")
+
+    def end(self, status: str = "FINISHED") -> None:
+        meta = self.meta()
+        meta["status"] = status
+        meta["end_time"] = time.time()
+        self._write_meta(meta)
+
+    # -- logging ----------------------------------------------------------
+
+    def log_param(self, key: str, value: Any) -> None:
+        params = self.params()
+        params[str(key)] = value
+        _atomic_json(os.path.join(self.path, "params.json"), params)
+
+    def log_params(self, params: Dict[str, Any]) -> None:
+        cur = self.params()
+        cur.update({str(k): v for k, v in params.items()})
+        _atomic_json(os.path.join(self.path, "params.json"), cur)
+
+    def log_metric(self, key: str, value: float, step: int = 0) -> None:
+        mdir = os.path.join(self.path, "metrics")
+        os.makedirs(mdir, exist_ok=True)
+        with open(os.path.join(mdir, f"{key}.jsonl"), "a") as f:
+            f.write(json.dumps({"step": step, "value": float(value), "ts": time.time()}) + "\n")
+
+    def log_metrics(self, metrics: Dict[str, float], step: int = 0) -> None:
+        for k, v in metrics.items():
+            self.log_metric(k, v, step)
+
+    def set_tag(self, key: str, value: str) -> None:
+        meta = self.meta()
+        meta.setdefault("tags", {})[str(key)] = str(value)
+        self._write_meta(meta)
+
+    def log_artifact(self, local_path: str, artifact_path: str = "") -> str:
+        dst_dir = os.path.join(self.path, "artifacts", artifact_path)
+        os.makedirs(dst_dir, exist_ok=True)
+        dst = os.path.join(dst_dir, os.path.basename(local_path))
+        if os.path.isdir(local_path):
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(local_path, dst)
+        else:
+            shutil.copy2(local_path, dst)
+        return dst
+
+    def log_dict(self, d: Dict[str, Any], artifact_file: str) -> str:
+        dst = os.path.join(self.path, "artifacts", artifact_file)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        _atomic_json(dst, d)
+        return dst
+
+    def artifact_path(self, artifact_path: str = "") -> str:
+        return os.path.join(self.path, "artifacts", artifact_path)
+
+    # -- reads ------------------------------------------------------------
+
+    def meta(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "meta.json")) as f:
+            return json.load(f)
+
+    def params(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, "params.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def metric_history(self, key: str) -> List[Dict[str, Any]]:
+        p = os.path.join(self.path, "metrics", f"{key}.jsonl")
+        if not os.path.exists(p):
+            return []
+        with open(p) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def metrics(self) -> Dict[str, float]:
+        """Latest value per metric key."""
+        mdir = os.path.join(self.path, "metrics")
+        if not os.path.isdir(mdir):
+            return {}
+        out = {}
+        for fn in os.listdir(mdir):
+            if fn.endswith(".jsonl"):
+                hist = self.metric_history(fn[:-6])
+                if hist:
+                    out[fn[:-6]] = hist[-1]["value"]
+        return out
+
+    def _write_meta(self, meta: Dict[str, Any]) -> None:
+        _atomic_json(os.path.join(self.path, "meta.json"), meta)
+
+
+class TrackingStore:
+    def __init__(self, root: str = _DEFAULT_ROOT):
+        self.root = os.path.abspath(root)
+        os.makedirs(os.path.join(self.root, "runs"), exist_ok=True)
+
+    # -- runs -------------------------------------------------------------
+
+    def start_run(
+        self,
+        run_name: Optional[str] = None,
+        experiment: str = "default",
+        parent_run_id: Optional[str] = None,
+        run_id: Optional[str] = None,
+        nested: bool = False,
+    ) -> Run:
+        """Create a run — or RE-ATTACH when ``run_id`` exists already
+        (the driver-creates/worker-logs pattern, P1/03:361-363)."""
+        if run_id is not None and os.path.isdir(self._run_path(run_id)):
+            return Run(self, run_id)
+        run_id = run_id or uuid.uuid4().hex[:16]
+        path = self._run_path(run_id)
+        os.makedirs(path, exist_ok=True)
+        meta = {
+            "run_id": run_id,
+            "run_name": run_name or run_id,
+            "experiment": experiment,
+            "parent_run_id": parent_run_id,
+            "status": "RUNNING",
+            "start_time": time.time(),
+            "end_time": None,
+            "tags": {},
+        }
+        if parent_run_id:
+            meta["tags"]["parentRunId"] = parent_run_id
+        _atomic_json(os.path.join(path, "meta.json"), meta)
+        return Run(self, run_id)
+
+    def get_run(self, run_id: str) -> Run:
+        if not os.path.isdir(self._run_path(run_id)):
+            raise KeyError(f"no such run: {run_id}")
+        return Run(self, run_id)
+
+    def list_runs(self, experiment: Optional[str] = None) -> List[str]:
+        rdir = os.path.join(self.root, "runs")
+        out = []
+        for rid in sorted(os.listdir(rdir)):
+            try:
+                meta = Run(self, rid).meta()
+            except (OSError, json.JSONDecodeError):
+                continue
+            if experiment is None or meta.get("experiment") == experiment:
+                out.append(rid)
+        return out
+
+    def search_runs(
+        self,
+        filter: Optional[Dict[str, Any]] = None,
+        order_by: Optional[str] = None,
+        experiment: Optional[str] = None,
+        max_results: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Query runs (≙ mlflow.search_runs, P2/01:257-261).
+
+        ``filter``: dict of dotted keys — ``tags.parentRunId``,
+        ``params.lr``, ``metrics.val_accuracy`` — matched for equality.
+        ``order_by``: e.g. ``"metrics.val_accuracy DESC"``.
+        Returns flat dicts with run_id/run_name/params.*/metrics.*/tags.*.
+        """
+        rows = []
+        for rid in self.list_runs(experiment):
+            run = Run(self, rid)
+            meta = run.meta()
+            row: Dict[str, Any] = {
+                "run_id": rid,
+                "run_name": meta.get("run_name"),
+                "status": meta.get("status"),
+                "parent_run_id": meta.get("parent_run_id"),
+            }
+            for k, v in meta.get("tags", {}).items():
+                row[f"tags.{k}"] = v
+            for k, v in run.params().items():
+                row[f"params.{k}"] = v
+            for k, v in run.metrics().items():
+                row[f"metrics.{k}"] = v
+            rows.append(row)
+        if filter:
+            def keep(row):
+                for k, v in filter.items():
+                    if str(row.get(k)) != str(v):
+                        return False
+                return True
+
+            rows = [r for r in rows if keep(r)]
+        if order_by:
+            parts = order_by.split()
+            key = parts[0]
+            desc = len(parts) > 1 and parts[1].upper() == "DESC"
+            present = [r for r in rows if r.get(key) is not None]
+            absent = [r for r in rows if r.get(key) is None]
+            present.sort(key=lambda r: r[key], reverse=desc)
+            rows = present + absent  # missing metric always ranks last
+        if max_results:
+            rows = rows[:max_results]
+        return rows
+
+    # -- uris -------------------------------------------------------------
+
+    def resolve_uri(self, uri: str) -> str:
+        """``runs:/<run_id>/<artifact_path>`` → filesystem path
+        (``models:/...`` URIs resolve via ModelRegistry)."""
+        if uri.startswith("runs:/"):
+            rest = uri[len("runs:/") :]
+            run_id, _, apath = rest.partition("/")
+            return self.get_run(run_id).artifact_path(apath)
+        if os.path.exists(uri):
+            return uri
+        raise ValueError(f"cannot resolve uri {uri!r}")
+
+    def _run_path(self, run_id: str) -> str:
+        return os.path.join(self.root, "runs", run_id)
+
+
+def _atomic_json(path: str, obj: Any) -> None:
+    import tempfile
+
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d)
+    with os.fdopen(fd, "w") as f:
+        json.dump(obj, f, indent=1, default=str)
+    os.replace(tmp, path)

@@ -69,6 +69,15 @@ class ReduceLROnPlateau(Callback):
             if self.wait >= self.patience:
                 new_lr = self.trainer.lr_controller.reduce(self.factor)
                 self.wait = 0
+                # persist into TrainState so checkpoints resume at the
+                # reduced LR
+                import jax.numpy as jnp
+
+                self.trainer.state = self.trainer.state.replace(
+                    plateau_factor=jnp.asarray(
+                        self.trainer.lr_controller.plateau_factor, jnp.float32
+                    )
+                )
                 if self.verbose:
                     print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
 
@@ -98,10 +107,14 @@ class EarlyStopping(Callback):
 
 class ModelCheckpoint(Callback):
     """Per-epoch checkpoint, PRIMARY PROCESS ONLY (≙ rank-0-only
-    ModelCheckpoint(save_weights_only=True) to
-    {dir}/checkpoint-{epoch}.ckpt, P2/02:206-211)."""
+    ModelCheckpoint to {dir}/checkpoint-{epoch}.ckpt, P2/02:206-211).
 
-    def __init__(self, checkpoint_dir: str, save_weights_only: bool = True):
+    Default saves the FULL TrainState (params + optimizer state + step +
+    LR state) so resume is exact — the capability the reference lacks;
+    ``save_weights_only=True`` gives the reference's weights-only files.
+    """
+
+    def __init__(self, checkpoint_dir: str, save_weights_only: bool = False):
         self.checkpoint_dir = checkpoint_dir
         self.save_weights_only = save_weights_only
 

@@ -19,6 +19,10 @@ class TrainState(struct.PyTreeNode):
     batch_stats: Any
     opt_state: Any
     rng: jax.Array
+    # host-steered LR state that must survive checkpoint/resume: the
+    # cumulative ReduceLROnPlateau factor (resume at the reduced LR, not
+    # the schedule's full LR)
+    plateau_factor: jax.Array
 
     def num_params(self) -> int:
         return sum(int(p.size) for p in jax.tree.leaves(self.params))

@@ -97,6 +97,7 @@ class Trainer:
             batch_stats=batch_stats,
             opt_state=self.tx.init(params),
             rng=jax.random.key(self.cfg.seed + 1),
+            plateau_factor=jnp.ones((), jnp.float32),
         )
         replicated = NamedSharding(self.mesh, P())
         self.state = jax.device_put(state, replicated)
@@ -242,8 +243,14 @@ class Trainer:
             warmup_epochs=cfg.warmup_epochs,
             steps_per_epoch=steps_per_epoch,
         )
+        # resume any checkpointed/prior plateau reduction (never restart
+        # a resumed run at the full schedule LR)
+        self.lr_controller.plateau_factor = float(
+            jax.device_get(self.state.plateau_factor)
+        )
         history = History()
         cbs = [history] + list(callbacks or [])
+        cbs += self._callbacks_from_config(cbs)
         for cb in cbs:
             cb.set_trainer(self)
         self.stop_training = False
@@ -252,17 +259,26 @@ class Trainer:
 
         train_iter = self._prefetch(iter(train_ds))
         global_step = initial_epoch * steps_per_epoch
+        lr = self.lr_controller.lr_for_step(global_step)
+        exhausted = False
         for epoch in range(initial_epoch, epochs):
             step_metrics = []
-            lr = self.lr_controller.lr_for_step(global_step)
             for _ in range(steps_per_epoch):
                 lr = self.lr_controller.lr_for_step(global_step)
-                images, labels = next(train_iter)
+                try:
+                    images, labels = next(train_iter)
+                except StopIteration:
+                    # finite (non-infinite) stream ran dry: end training
+                    # cleanly after this partial epoch (Keras semantics)
+                    exhausted = True
+                    break
                 self.state, m = self._train_step(
                     self.state, images, labels, jnp.asarray(lr, jnp.float32)
                 )
                 step_metrics.append(m)
                 global_step += 1
+            if exhausted and not step_metrics:
+                break
             logs = _mean_metrics(step_metrics)
             logs["lr"] = lr
             if val_ds is not None:
@@ -272,11 +288,37 @@ class Trainer:
                 print(f"epoch {epoch}: " + " ".join(f"{k}={v:.4f}" for k, v in logs.items()))
             for cb in cbs:
                 cb.on_epoch_end(epoch, logs)
-            if self.stop_training:
+            if self.stop_training or exhausted:
                 break
         for cb in cbs:
             cb.on_train_end()
         return history
+
+    def _callbacks_from_config(self, existing: List[Callback]) -> List[Callback]:
+        """Wire TrainConfig's callback fields (plateau/early-stop/
+        checkpoint) unless the caller already supplied that callback
+        type — config must not be silently dead."""
+        from tpuflow.train.callbacks import (
+            EarlyStopping,
+            ModelCheckpoint,
+            ReduceLROnPlateau,
+        )
+
+        have = {type(cb) for cb in existing}
+        cfg = self.cfg
+        out: List[Callback] = []
+        if cfg.reduce_on_plateau_patience and ReduceLROnPlateau not in have:
+            out.append(
+                ReduceLROnPlateau(
+                    patience=cfg.reduce_on_plateau_patience,
+                    factor=cfg.reduce_on_plateau_factor,
+                )
+            )
+        if cfg.early_stopping_patience and EarlyStopping not in have:
+            out.append(EarlyStopping(patience=cfg.early_stopping_patience))
+        if cfg.checkpoint_dir and ModelCheckpoint not in have:
+            out.append(ModelCheckpoint(cfg.checkpoint_dir))
+        return out
 
     def evaluate(self, ds, steps: Optional[int] = None) -> Dict[str, float]:
         """Eval with cross-replica metric averaging (≙ MetricAverageCallback)."""

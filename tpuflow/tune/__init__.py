@@ -1,0 +1,3 @@
+from tpuflow.tune.space import hp  # noqa: F401
+from tpuflow.tune.fmin import fmin, STATUS_OK  # noqa: F401
+from tpuflow.tune.trials import ParallelTrials, Trials  # noqa: F401

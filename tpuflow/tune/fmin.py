@@ -1,0 +1,62 @@
+"""fmin — the hyperopt.fmin equivalent (C14-C15).
+
+≙ ``fmin(fn, space, algo=tpe.suggest, trials, max_evals)``
+(P2/01_hyperopt_single_machine_model.py:232-238, P2/02:360-365).
+The objective returns ``{'loss': ..., 'status': STATUS_OK}`` — to
+maximize accuracy, return ``-accuracy`` as the loss exactly as the
+reference does (P2/01:179-181).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from tpuflow.tune.space import Space, sample_space
+from tpuflow.tune.tpe import TPE
+from tpuflow.tune.trials import STATUS_OK, Trials  # noqa: F401 (re-export)
+
+
+def fmin(
+    fn: Callable[[Dict[str, Any]], Any],
+    space: Space,
+    max_evals: int = 20,
+    algo: str = "tpe",
+    trials: Optional[Trials] = None,
+    seed: int = 0,
+    verbose: bool = False,
+) -> Dict[str, Any]:
+    """Minimize ``fn`` over ``space``; returns the best params dict.
+
+    ``trials``: Trials (sequential; required when fn is itself
+    distributed, ≙ P2/02:341-344) or ParallelTrials (concurrent
+    single-device trials, ≙ SparkTrials). Inspect ``trials.results``
+    afterwards for the full record.
+    """
+    trials = trials if trials is not None else Trials()
+    import numpy as np
+
+    tpe = TPE(seed=seed)
+    rng = np.random.default_rng(seed + 1)
+    tid = len(trials.results)
+    while tid < max_evals:
+        batch_size = min(trials.suggest_batch_size(), max_evals - tid)
+        history = [(t.params, t.loss) for t in trials.results]
+        batch = []
+        for _ in range(batch_size):
+            if algo == "random":
+                params = sample_space(space, rng)
+            else:
+                params = tpe.suggest(space, history)
+            # pending in-batch params carry inf loss: excluded from the
+            # Parzen model; sampling stochasticity diversifies the batch
+            history = history + [(params, float("inf"))]
+            batch.append(params)
+        new = trials.run_batch(fn, batch, tid)
+        tid += len(new)
+        if verbose:
+            for t in new:
+                msg = f"trial {t.tid}: loss={t.loss:.5f} params={t.params}"
+                if t.status != STATUS_OK:
+                    msg += f" FAILED: {t.extra.get('error', 'unknown')}"
+                print(msg)
+    return trials.best().params

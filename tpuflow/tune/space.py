@@ -1,0 +1,101 @@
+"""Hyperparameter search space (C14-C15) — the hyperopt.hp equivalent.
+
+≙ the space constructors the reference uses: ``hp.choice`` over
+optimizer names / batch sizes, ``hp.loguniform`` for LR,
+``hp.uniform`` for dropout (P2/01_hyperopt_single_machine_model.py:194-198,
+P2/02_hyperopt_distributed_model.py:322-326). Same semantics:
+``loguniform(low, high)`` samples exp(U(low, high)).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Dimension:
+    kind: str  # choice | uniform | loguniform | quniform | randint
+    options: tuple = ()
+    low: float = 0.0
+    high: float = 1.0
+    q: float = 1.0
+
+    # -- sampling ---------------------------------------------------------
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        if self.kind == "choice":
+            return self.options[int(rng.integers(len(self.options)))]
+        if self.kind == "uniform":
+            return float(rng.uniform(self.low, self.high))
+        if self.kind == "loguniform":
+            return float(math.exp(rng.uniform(self.low, self.high)))
+        if self.kind == "quniform":
+            v = rng.uniform(self.low, self.high)
+            return float(round(v / self.q) * self.q)
+        if self.kind == "randint":
+            return int(rng.integers(self.low, self.high))
+        raise ValueError(self.kind)
+
+    # -- mapping to the real line for the Parzen estimators ---------------
+
+    def to_unit(self, value: Any) -> float:
+        if self.kind == "choice":
+            return float(self.options.index(value))
+        if self.kind == "loguniform":
+            return math.log(value)
+        return float(value)
+
+    def from_unit(self, x: float) -> Any:
+        if self.kind == "choice":
+            return self.options[int(np.clip(round(x), 0, len(self.options) - 1))]
+        if self.kind == "loguniform":
+            return float(math.exp(np.clip(x, self.low, self.high)))
+        if self.kind == "quniform":
+            return float(round(np.clip(x, self.low, self.high) / self.q) * self.q)
+        if self.kind == "randint":
+            return int(np.clip(round(x), self.low, self.high - 1))
+        return float(np.clip(x, self.low, self.high))
+
+    def bounds(self) -> tuple:
+        if self.kind == "choice":
+            return (0.0, float(len(self.options) - 1))
+        return (self.low, self.high)
+
+
+class hp:
+    """Namespace mirroring hyperopt.hp (name arg omitted: the dict key
+    names the dimension)."""
+
+    @staticmethod
+    def choice(options: Sequence[Any]) -> Dimension:
+        return Dimension("choice", options=tuple(options))
+
+    @staticmethod
+    def uniform(low: float, high: float) -> Dimension:
+        return Dimension("uniform", low=low, high=high)
+
+    @staticmethod
+    def loguniform(low: float, high: float) -> Dimension:
+        """exp(U(low, high)) — low/high are in LOG space (hyperopt
+        convention; the reference uses loguniform(-5, 0) for LR ∈
+        [exp(-5), 1], P2/01:196)."""
+        return Dimension("loguniform", low=low, high=high)
+
+    @staticmethod
+    def quniform(low: float, high: float, q: float) -> Dimension:
+        return Dimension("quniform", low=low, high=high, q=q)
+
+    @staticmethod
+    def randint(low: int, high: int) -> Dimension:
+        return Dimension("randint", low=low, high=high)
+
+
+Space = Dict[str, Dimension]
+
+
+def sample_space(space: Space, rng: np.random.Generator) -> Dict[str, Any]:
+    return {k: d.sample(rng) for k, d in space.items()}

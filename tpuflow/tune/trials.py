@@ -1,0 +1,134 @@
+"""Trial bookkeeping + the two execution topologies (C14-C15, N9).
+
+The reference's split, preserved deliberately (SURVEY.md §2 C14-C15):
+
+- ``ParallelTrials`` ≙ ``SparkTrials(parallelism=k)``
+  (P2/01_hyperopt_single_machine_model.py:229): k single-device
+  objectives run CONCURRENTLY, each pinned to a disjoint device subset
+  of the local mesh (the TPU analogue of one-trial-per-executor).
+- ``Trials`` ≙ hyperopt's default driver-side Trials — REQUIRED for
+  objectives that are themselves distributed over the whole pod, which
+  must launch sequentially from the driver (the documented constraint
+  at P2/02:341-344).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+STATUS_OK = "ok"
+STATUS_FAIL = "fail"
+
+
+@dataclass
+class TrialResult:
+    tid: int
+    params: Dict[str, Any]
+    loss: float
+    status: str
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class Trials:
+    """Sequential, driver-side trial execution + record of results."""
+
+    def __init__(self):
+        self.results: List[TrialResult] = []
+        self._lock = threading.Lock()
+
+    @property
+    def losses(self) -> List[float]:
+        return [t.loss for t in self.results]
+
+    def best(self) -> TrialResult:
+        ok = [t for t in self.results if t.status == STATUS_OK]
+        if not ok:
+            raise ValueError("no successful trials")
+        return min(ok, key=lambda t: t.loss)
+
+    def record(self, tid, params, outcome) -> TrialResult:
+        loss, status, extra = _normalize(outcome)
+        tr = TrialResult(tid, params, loss, status, extra)
+        with self._lock:
+            self.results.append(tr)
+        return tr
+
+    # -- execution --------------------------------------------------------
+
+    def run_batch(
+        self, fn: Callable, batch: List[Dict[str, Any]], start_tid: int
+    ) -> List[TrialResult]:
+        out = []
+        for i, params in enumerate(batch):
+            out.append(self.record(start_tid + i, params, _safe_call(fn, params)))
+        return out
+
+    def suggest_batch_size(self) -> int:
+        return 1
+
+
+class ParallelTrials(Trials):
+    """Concurrent trials over disjoint device subsets.
+
+    Each in-flight trial gets ``devices`` (a list of jax.Device) if the
+    objective accepts that keyword — the mesh-scoping hook that turns
+    one pod into k independent trial slots (SURVEY.md §7 hard part 4).
+    """
+
+    def __init__(self, parallelism: int = 4, devices: Optional[List] = None):
+        super().__init__()
+        import jax
+
+        self.parallelism = max(1, parallelism)
+        devs = list(devices if devices is not None else jax.devices())
+        k = min(self.parallelism, len(devs))
+        per = len(devs) // k
+        self.device_groups = [devs[i * per : (i + 1) * per] for i in range(k)]
+
+    def suggest_batch_size(self) -> int:
+        return self.parallelism
+
+    def run_batch(self, fn, batch, start_tid) -> List[TrialResult]:
+        import inspect
+
+        takes_devices = "devices" in inspect.signature(fn).parameters
+        results: List[Optional[TrialResult]] = [None] * len(batch)
+
+        def one(i: int, params):
+            group = self.device_groups[i % len(self.device_groups)]
+            if takes_devices:
+                outcome = _safe_call(fn, params, devices=group)
+            else:
+                outcome = _safe_call(fn, params)
+            results[i] = self.record(start_tid + i, params, outcome)
+
+        with ThreadPoolExecutor(max_workers=self.parallelism) as ex:
+            futs = [ex.submit(one, i, p) for i, p in enumerate(batch)]
+            for f in futs:
+                f.result()
+        return [r for r in results if r is not None]
+
+
+def _safe_call(fn, params, **kw):
+    try:
+        return fn(params, **kw)
+    except Exception as e:  # a failed trial must not kill the sweep
+        return {
+            "loss": float("inf"),
+            "status": STATUS_FAIL,
+            "error": f"{type(e).__name__}: {e}",
+            "traceback": traceback.format_exc(),
+        }
+
+
+def _normalize(outcome) -> tuple:
+    if isinstance(outcome, dict):
+        loss = float(outcome.get("loss", float("inf")))
+        status = outcome.get("status", STATUS_OK)
+        extra = {k: v for k, v in outcome.items() if k not in ("loss", "status")}
+        return loss, status, extra
+    return float(outcome), STATUS_OK, {}

@@ -1,0 +1,197 @@
+"""Workflow API (L7): the user-facing functions of the framework.
+
+These are the TPU-native equivalents of the reference notebooks' public
+surface (SURVEY.md §1 L7): ``train_and_evaluate`` ≙
+``train_and_evaluate_hvd`` (P1/03_model_training_distributed.py:282-375)
+and ``train_and_package`` ≙ ``train_model_petastorm_data_ingest``
+(P2/03_pyfunc_distributed_inference.py:253-409). Where the reference
+composes Spark/Petastorm/Horovod/MLflow, these compose
+data/train/track/packaging over one device mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from tpuflow.core import is_primary
+from tpuflow.core.config import Config, DataConfig, ModelConfig, TrainConfig
+from tpuflow.data.loader import make_converter
+from tpuflow.data.table import Table, TableStore
+from tpuflow.models import build_model
+from tpuflow.packaging import save_packaged_model
+from tpuflow.parallel.mesh import build_mesh, world_size
+from tpuflow.track import TrackingStore
+from tpuflow.train import TrackingCallback, Trainer
+
+
+def train_and_evaluate(
+    train_table: Table,
+    val_table: Table,
+    config: Optional[Config] = None,
+    learning_rate: Optional[float] = None,
+    dropout: Optional[float] = None,
+    batch_size: Optional[int] = None,
+    checkpoint_dir: Optional[str] = None,
+    run_id: Optional[str] = None,
+    run_name: Optional[str] = None,
+    parent_run_id: Optional[str] = None,
+    store: Optional[TrackingStore] = None,
+    mesh=None,
+    model=None,
+    epochs: Optional[int] = None,
+    cache_dir: Optional[str] = None,
+) -> Tuple[float, float]:
+    """Train data-parallel over the mesh.
+
+    Returns (val_loss, val_accuracy, trainer) — the first two are the
+    reference's return contract (P1/03:375); the trainer rides along so
+    callers can package the trained weights.
+
+    ≙ train_and_evaluate_hvd (P1/03:282-375) and its HPO variant taking
+    (learning_rate, dropout, batch_size, checkpoint_dir)
+    (P2/02:161-262). Side effects (tracking, checkpoints) are
+    primary-process-only; metrics come back replica-averaged.
+    """
+    cfg = config or Config()
+    if learning_rate is not None:
+        cfg.train.learning_rate = learning_rate
+    if dropout is not None:
+        cfg.model.dropout = dropout
+    if batch_size is not None:
+        cfg.data.batch_size = batch_size
+    if epochs is not None:
+        cfg.train.epochs = epochs
+    if checkpoint_dir is not None:
+        cfg.train.checkpoint_dir = checkpoint_dir
+
+    mesh = mesh if mesh is not None else build_mesh()
+    import jax
+
+    procs = jax.process_count()
+    local_devices = world_size(mesh) // procs
+    # per-DEVICE batch (the reference's per-worker batch with 1 GPU/worker)
+    local_batch = cfg.data.batch_size * local_devices
+
+    cache = cache_dir or cfg.data.cache_dir
+    conv_t = make_converter(train_table, cache, min_partitions=procs)
+    conv_v = make_converter(val_table, cache, min_partitions=procs)
+    ds_kwargs = dict(
+        img_height=cfg.data.img_height,
+        img_width=cfg.data.img_width,
+        num_decode_workers=cfg.data.num_decode_workers,
+        prefetch=cfg.data.prefetch,
+    )
+    train_ds = conv_t.make_dataset(
+        local_batch,
+        cur_shard=jax.process_index(),
+        shard_count=procs,
+        seed=cfg.train.seed,
+        **ds_kwargs,
+    )
+    val_ds = conv_v.make_dataset(
+        local_batch,
+        cur_shard=jax.process_index(),
+        shard_count=procs,
+        seed=cfg.train.seed,
+        **ds_kwargs,
+    )
+
+    if model is None:
+        model = build_model(
+            img_height=cfg.data.img_height,
+            img_width=cfg.data.img_width,
+            img_channels=cfg.data.img_channels,
+            num_classes=cfg.model.num_classes,
+            dropout=cfg.model.dropout,
+            width_mult=cfg.model.width_mult,
+            freeze_backbone=cfg.model.freeze_backbone,
+        )
+
+    run = None
+    if store is not None and is_primary():
+        run = store.start_run(
+            run_name=run_name, run_id=run_id, parent_run_id=parent_run_id
+        )
+        run.log_params(cfg.flat_params())
+        run.log_param("world_size", world_size(mesh))
+
+    # plateau/early-stop/checkpoint callbacks wire automatically from
+    # cfg.train inside Trainer.fit; only tracking needs the run handle
+    callbacks = [TrackingCallback(run)] if run is not None else []
+
+    trainer = Trainer(model, cfg.train, mesh=mesh, run=run)
+    try:
+        hist = trainer.fit(train_ds, val_ds=val_ds, callbacks=callbacks).history
+        val_loss = hist.get("val_loss", [float("nan")])[-1]
+        val_acc = hist.get("val_accuracy", [float("nan")])[-1]
+        if run is not None:
+            run.end("FINISHED")
+        return val_loss, val_acc, trainer  # trainer returned for packaging
+    finally:
+        conv_t.delete()  # ≙ converter.delete() (P1/03:425-426)
+        conv_v.delete()
+
+
+def train_and_package(
+    store: TrackingStore,
+    train_table: Table,
+    val_table: Table,
+    classes: Sequence[str],
+    config: Optional[Config] = None,
+    run_name: str = "train_and_package",
+    mesh=None,
+    model=None,
+    model_type: str = "transfer_classifier",
+) -> Dict[str, Any]:
+    """One-shot pipeline: run-create → param log → train → package →
+    evaluate → cleanup. ≙ train_model_petastorm_data_ingest
+    (P2/03:253-409). Returns {'run_id', 'model_uri', 'val_loss',
+    'val_accuracy'}."""
+    cfg = config or Config()
+    run = store.start_run(run_name=run_name) if is_primary() else None
+    run_id = run.run_id if run is not None else None
+    if run is not None:
+        # ≙ logging img_params_dict.json as an artifact (P2/03:285-287)
+        run.log_dict(
+            {
+                "img_height": cfg.data.img_height,
+                "img_width": cfg.data.img_width,
+                "img_channels": cfg.data.img_channels,
+                "classes": list(classes),
+            },
+            "img_params_dict.json",
+        )
+    val_loss, val_acc, trainer = train_and_evaluate(
+        train_table, val_table, config=cfg, run_id=run_id, store=None, mesh=mesh,
+        model=model,
+    )
+    model_uri = None
+    if run is not None:
+        pkg_dir = os.path.join(run.artifact_path(), "model")
+        save_packaged_model(
+            pkg_dir,
+            params=trainer.state.params,
+            batch_stats=trainer.state.batch_stats,
+            classes=classes,
+            img_height=cfg.data.img_height,
+            img_width=cfg.data.img_width,
+            img_channels=cfg.data.img_channels,
+            model_type=model_type,
+            model_config={
+                "num_classes": cfg.model.num_classes,
+                "dropout": cfg.model.dropout,
+                "width_mult": cfg.model.width_mult,
+                "freeze_backbone": cfg.model.freeze_backbone,
+            },
+        )
+        run.log_params(cfg.flat_params())
+        run.log_metrics({"val_loss": val_loss, "val_accuracy": val_acc})
+        run.end("FINISHED")
+        model_uri = f"runs:/{run.run_id}/model"
+    return {
+        "run_id": run_id,
+        "model_uri": model_uri,
+        "val_loss": val_loss,
+        "val_accuracy": val_acc,
+    }
