@@ -1,0 +1,124 @@
+"""Pallas flash-attention kernel vs plain-XLA oracle (fwd + grads).
+
+Runs the real kernel code in interpret mode on the CPU backend
+(SURVEY.md §4 world-size-1/CPU discipline).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.ops import flash_attention, mha_reference
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(jax.random.key(key), shape, dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize(
+    "b,h,sq,skv,d",
+    [
+        (2, 2, 32, 32, 16),
+        (1, 3, 40, 40, 8),  # seq not a multiple of block
+    ],
+)
+def test_forward_matches_reference(causal, b, h, sq, skv, d):
+    if causal and sq != skv:
+        pytest.skip("causal needs square")
+    q, k, v = (_rand((b, h, s, d), i) for i, s in enumerate((sq, skv, skv)))
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_cross_attention_forward():
+    q, k, v = _rand((1, 2, 24, 8), 0), _rand((1, 2, 56, 8), 1), _rand((1, 2, 56, 8), 2)
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    np.testing.assert_allclose(out, mha_reference(q, k, v), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference(causal):
+    b, h, s, d = 1, 2, 48, 16
+    q, k, v = (_rand((b, h, s, d), i) for i in range(3))
+
+    def loss_kernel(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(mha_reference(q, k, v, causal=causal)))
+
+    g1 = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(a, b_, atol=5e-5, rtol=5e-4)
+
+
+def test_gradients_with_padding():
+    # seq 36 forces zero-padded blocks in both q and kv grids
+    b, h, s, d = 1, 1, 36, 8
+    q, k, v = (_rand((b, h, s, d), i + 7) for i in range(3))
+
+    def f(op):
+        def loss(q, k, v):
+            return jnp.sum(op(q, k, v) ** 2)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    g1 = f(lambda q, k, v: flash_attention(q, k, v, block_q=16, block_k=16))
+    g2 = f(mha_reference)
+    for a, b_ in zip(g1, g2):
+        assert np.all(np.isfinite(a))
+        np.testing.assert_allclose(a, b_, atol=5e-5, rtol=5e-4)
+
+
+def test_bf16_inputs():
+    q, k, v = (_rand((1, 2, 32, 16), i, jnp.bfloat16) for i in range(3))
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(
+        out.astype(np.float32), ref.astype(np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_return_lse_matches_log_softmax_denominator():
+    q, k, v = (_rand((1, 1, 16, 8), i) for i in range(3))
+    _, lse = flash_attention(q, k, v, block_q=8, block_k=8, return_lse=True)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (8**-0.5)
+    expect = jax.nn.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(lse, expect, atol=2e-5, rtol=2e-5)
+
+
+def test_jit_compatible():
+    q, k, v = (_rand((1, 1, 32, 8), i) for i in range(3))
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, block_q=16, block_k=16))
+    np.testing.assert_allclose(f(q, k, v), mha_reference(q, k, v), atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_matches_masked_block_ref():
+    """The Pallas kernels and the jnp masked refs are the two dispatch
+    targets of ring attention (TPU vs interpret) — they must agree
+    bit-for-tolerance, including padded rows/cols and causal masks."""
+    from tpuflow.ops.attention import _Cfg, _bwd_impl, _bwd_ref, _fwd, _fwd_ref
+
+    bh, s_pad, d, s_valid = 2, 24, 8, 20
+    q, k, v, do = (_rand((bh, s_pad, d), i + 20) for i in range(4))
+    for causal in (False, True):
+        cfg = _Cfg(
+            causal=causal, scale=d**-0.5, block_q=8, block_k=8,
+            sq_valid=s_valid, skv_valid=s_valid, interpret=True,
+        )
+        o1, lse1 = _fwd(cfg, q, k, v)
+        o2, lse2 = _fwd_ref(cfg, q, k, v)
+        np.testing.assert_allclose(o1[:, :s_valid], o2[:, :s_valid], atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(lse1[:, :s_valid], lse2[:, :s_valid], atol=2e-5, rtol=2e-5)
+        g1 = _bwd_impl(cfg, q, k, v, o2, lse2, do)
+        g2 = _bwd_ref(cfg, q, k, v, o2, lse2, do)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                a[:, :s_valid], b[:, :s_valid], atol=5e-5, rtol=5e-4
+            )

@@ -5,3 +5,4 @@ from tpuflow.models.classifier import (  # noqa: F401
     backbone_param_mask,
 )
 from tpuflow.models.preprocess import preprocess_input, preprocess  # noqa: F401
+from tpuflow.models.vit import ViTClassifier, build_vit  # noqa: F401

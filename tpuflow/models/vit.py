@@ -1,0 +1,271 @@
+"""Vision Transformer classifier — the attention model family.
+
+The reference's only model is a frozen MobileNetV2 + head
+(P1/02_model_training_single_node.py:159-178); this adds the attention
+family the TPU build treats as first-class (long context, tensor/
+sequence parallelism — SURVEY.md §2c "TPU-native plan" column):
+
+- **Tensor parallelism**: every transformer weight carries a
+  ``nn.with_partitioning`` annotation over the mesh ``model`` axis
+  (attention heads and MLP hidden column-sharded, output projections
+  row-sharded). Under ``jit`` with a (data, model) mesh, GSPMD shards
+  the matmuls and inserts the reduce-scatters/all-reduces — the
+  idiomatic XLA path (no hand-written collectives).
+- **Sequence parallelism**: ``seq_axis="seq"`` switches the module into
+  manual mode for use inside ``shard_map`` with images sharded along H:
+  attention becomes ring attention (K/V rotating over ICI,
+  tpuflow.parallel.ring_attention), the positional table is sliced per
+  shard, and token pooling becomes a psum-mean. Everything else is
+  per-token and needs no communication.
+- **Attention impls**: ``attn_impl='auto'`` lowers to XLA einsums (fully
+  GSPMD-partitionable — best for short vision sequences); ``'flash'``
+  calls the Pallas blockwise kernel (tpuflow.ops.attention — best for
+  long sequences on one device's shard).
+
+Mean-pool classification (no CLS token) keeps every op shard-uniform.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuflow.ops.attention import flash_attention, mha_reference
+from tpuflow.parallel.mesh import MODEL_AXIS
+from tpuflow.parallel.ring_attention import ring_attention
+
+
+def _part(init, names, enabled: bool = True):
+    """TP annotation, disabled in manual (shard_map) sequence-parallel
+    mode: flax re-applies partitioning metadata as sharding constraints
+    at apply time, which would reference the absent 'model' axis there
+    (params are replicated by the shard_map in_spec instead)."""
+    return nn.with_partitioning(init, names) if enabled else init
+
+
+_dense_init = nn.initializers.xavier_uniform()
+
+
+class ViTMlp(nn.Module):
+    dim: int
+    hidden: int
+    dropout: float
+    dtype: Any
+    tp: bool = True
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        x = nn.Dense(
+            self.hidden,
+            dtype=self.dtype,
+            kernel_init=_part(_dense_init, (None, MODEL_AXIS), self.tp),
+            bias_init=_part(nn.initializers.zeros_init(), (MODEL_AXIS,), self.tp),
+            name="fc_in",
+        )(x)
+        x = nn.gelu(x)
+        x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        x = nn.Dense(
+            self.dim,
+            dtype=self.dtype,
+            kernel_init=_part(_dense_init, (MODEL_AXIS, None), self.tp),
+            name="fc_out",
+        )(x)
+        return x
+
+
+class ViTAttention(nn.Module):
+    dim: int
+    heads: int
+    dtype: Any
+    attn_impl: str = "auto"  # auto | flash
+    seq_axis: Optional[str] = None  # set → ring attention inside shard_map
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        # Megatron-style rank-2 projections: q/k/v column-sharded, the
+        # output projection row-sharded (one all-reduce per block under
+        # GSPMD). Column chunks are contiguous heads ((H, D) row-major),
+        # so the model axis shards whole heads when it divides `heads`.
+        tp = self.seq_axis is None
+        head_dim = self.dim // self.heads
+
+        def proj_in(name):
+            return nn.Dense(
+                self.dim,
+                dtype=self.dtype,
+                kernel_init=_part(_dense_init, (None, MODEL_AXIS), tp),
+                bias_init=_part(nn.initializers.zeros_init(), (MODEL_AXIS,), tp),
+                name=name,
+            )(x)
+
+        b, s, _ = x.shape
+
+        def heads_first(t):  # (B, S, C) → (B, H, S, D)
+            return t.reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = (heads_first(proj_in(n)) for n in ("query", "key", "value"))
+        if self.seq_axis is not None:
+            o = ring_attention(q, k, v, axis_name=self.seq_axis)
+        elif self.attn_impl == "flash":
+            o = flash_attention(q, k, v)
+        else:
+            o = mha_reference(q, k, v)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, self.dim)
+        return nn.Dense(
+            self.dim,
+            dtype=self.dtype,
+            kernel_init=_part(_dense_init, (MODEL_AXIS, None), tp),
+            name="proj",
+        )(o)
+
+
+class ViTBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int
+    dropout: float
+    dtype: Any
+    attn_impl: str
+    seq_axis: Optional[str]
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        y = ViTAttention(
+            self.dim,
+            self.heads,
+            self.dtype,
+            self.attn_impl,
+            self.seq_axis,
+            name="attn",
+        )(y, deterministic)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        y = ViTMlp(
+            self.dim,
+            self.dim * self.mlp_ratio,
+            self.dropout,
+            self.dtype,
+            tp=self.seq_axis is None,
+            name="mlp",
+        )(y, deterministic)
+        return x + y
+
+
+class ViTClassifier(nn.Module):
+    """ViT image classifier; plugs into Trainer exactly like
+    TransferClassifier (logits out, 'dropout' rng, no batch_stats)."""
+
+    num_classes: int = 5
+    patch_size: int = 16
+    width: int = 192
+    depth: int = 6
+    heads: int = 6
+    mlp_ratio: int = 4
+    dropout: float = 0.1
+    num_patches: int = 196  # global token count (for the positional table)
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "auto"
+    seq_axis: Optional[str] = None
+    freeze_backbone: bool = False  # API parity with TransferClassifier
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p = self.patch_size
+        # Non-overlapping patch embed: under sequence parallelism each
+        # shard holds a contiguous slab of image rows, so patch order is
+        # globally row-major and shards stay contiguous.
+        x = nn.Conv(
+            self.width,
+            (p, p),
+            strides=(p, p),
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        b, hh, ww, c = x.shape
+        x = x.reshape(b, hh * ww, c)
+
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, self.num_patches, self.width),
+            jnp.float32,
+        )
+        if self.seq_axis is not None:
+            # slice this shard's rows of the global positional table
+            n_shards = lax.axis_size(self.seq_axis)
+            if hh * ww * n_shards != self.num_patches:
+                raise ValueError(
+                    f"got {hh * ww} local patches x {n_shards} shards, model "
+                    f"configured for {self.num_patches} global patches "
+                    "(image size / patch_size / shard-count mismatch)"
+                )
+            shard = lax.axis_index(self.seq_axis)
+            pos = lax.dynamic_slice_in_dim(pos, shard * (hh * ww), hh * ww, axis=1)
+        else:
+            if hh * ww != self.num_patches:
+                raise ValueError(
+                    f"got {hh * ww} patches, model configured for "
+                    f"{self.num_patches} (image size / patch_size mismatch)"
+                )
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout)(x, deterministic=not train)
+
+        for i in range(self.depth):
+            x = ViTBlock(
+                self.width,
+                self.heads,
+                self.mlp_ratio,
+                self.dropout,
+                self.dtype,
+                self.attn_impl,
+                self.seq_axis,
+                name=f"block{i}",
+            )(x, deterministic=not train)
+
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+        if self.seq_axis is not None:
+            # global mean over the sharded token axis: psum of local sums
+            # (uniform shards ⇒ the divisor is static)
+            local = jnp.sum(x, axis=1)
+            total = lax.psum(local, self.seq_axis)
+            x = total / (hh * ww * lax.axis_size(self.seq_axis))
+        else:
+            x = jnp.mean(x, axis=1)
+        x = nn.Dropout(self.dropout)(x, deterministic=not train)
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, name="head_dense"
+        )(x.astype(jnp.float32))
+
+
+def build_vit(
+    num_classes: int = 5,
+    img_size: int = 224,
+    patch_size: int = 16,
+    width: int = 192,
+    depth: int = 6,
+    heads: int = 6,
+    dropout: float = 0.1,
+    dtype: Any = jnp.bfloat16,
+    attn_impl: str = "auto",
+    seq_axis: Optional[str] = None,
+) -> ViTClassifier:
+    if img_size % patch_size:
+        raise ValueError("img_size must be a multiple of patch_size")
+    n = (img_size // patch_size) ** 2
+    return ViTClassifier(
+        num_classes=num_classes,
+        patch_size=patch_size,
+        width=width,
+        depth=depth,
+        heads=heads,
+        dropout=dropout,
+        num_patches=n,
+        dtype=dtype,
+        attn_impl=attn_impl,
+        seq_axis=seq_axis,
+    )

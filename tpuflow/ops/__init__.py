@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §7 step 4 analogue).
+
+The reference delegates all kernels to TF's C++/CUDA runtime (N4,
+P1/02_model_training_single_node.py:123-124,210-215); here the compute
+path is XLA, and these Pallas kernels cover the ops XLA's defaults
+leave on the table — blockwise flash attention (the hot op of the
+attention/long-context model family) with an online-softmax forward and
+a recomputation backward.
+"""
+
+from tpuflow.ops.attention import flash_attention, mha_reference  # noqa: F401

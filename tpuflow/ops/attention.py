@@ -1,0 +1,390 @@
+"""Blockwise flash attention as a Pallas TPU kernel.
+
+Online-softmax forward (running max / normalizer, O(S) memory) and a
+recomputation backward (two kernels: dQ over query blocks, dK/dV over
+key blocks) wrapped in ``jax.custom_vjp``. This is the hot op of the
+attention model family and the per-shard compute of ring attention
+(tpuflow.parallel.ring_attention); the reference has no attention
+anywhere (SURVEY.md §2c, §5.7) — this is the long-context capability
+the TPU build adds as first-class.
+
+Layout: ``(batch, heads, seq, head_dim)``. Compute is float32 on the
+MXU regardless of input dtype; outputs match the input dtype. K/V for
+one (batch, head) are kept whole in VMEM (fine to ~16k sequence at
+head_dim 128 in bf16); queries stream in ``block_q`` tiles.
+
+On non-TPU backends the kernels run in Pallas interpret mode, so the
+whole test suite exercises the real kernel code on CPU (SURVEY.md §4's
+world-size-1/CPU-backend discipline).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -1e30
+
+
+class _Cfg(NamedTuple):
+    """Static kernel configuration (hashable → custom_vjp nondiff arg)."""
+
+    causal: bool
+    scale: float
+    block_q: int
+    block_k: int
+    sq_valid: int  # unpadded query length
+    skv_valid: int  # unpadded key/value length
+    interpret: bool
+
+
+def _vma(*xs):
+    """Union of the inputs' varying-manual-axes sets, so kernel outputs
+    carry the right vma when called under shard_map (e.g. from ring
+    attention) and an empty set otherwise."""
+    out = frozenset()
+    for x in xs:
+        out = out | getattr(jax.typeof(x), "vma", frozenset())
+    return out
+
+
+def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Plain-XLA multi-head attention (numerics oracle for the kernel)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# masked block-attention reference (jnp)
+#
+# Same masking semantics as the kernels, on (BH, S, D) arrays. Used as
+# the numerics oracle in tests AND as the interpret-mode block compute
+# of ring attention: Pallas's HLO interpreter cannot evaluate kernels
+# whose operands carry varying manual axes (shard_map vma), so off-TPU
+# the ring path runs this math instead — the kernels are equivalence-
+# tested against it in tests/test_ops.py.
+# ---------------------------------------------------------------------------
+
+
+def _mask_for(cfg: _Cfg, sq: int, skv: int):
+    row = lax.broadcasted_iota(jnp.int32, (sq, skv), 0)
+    col = lax.broadcasted_iota(jnp.int32, (sq, skv), 1)
+    mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
+    if cfg.causal:
+        mask = mask & (col <= row)
+    return mask
+
+
+def _fwd_ref(cfg: _Cfg, q, k, v):
+    """(o, lse) with the kernel's masking; fully-masked rows → o=0,
+    lse=_NEG_BIG (the ring-merge identity)."""
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * cfg.scale
+    mask = _mask_for(cfg, q.shape[1], k.shape[1])
+    m = jnp.max(jnp.where(mask, s, _NEG_BIG), axis=-1, keepdims=True)
+    p = jnp.where(mask, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    safe = jnp.where(l > 0, l, 1.0)
+    o = jnp.where(l > 0, jnp.einsum("bqk,bkd->bqd", p, vf) / safe, 0.0)
+    lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(safe[..., 0]), _NEG_BIG)
+    return o.astype(q.dtype), lse
+
+
+def _bwd_ref(cfg: _Cfg, q, k, v, o, lse, do):
+    """Flash-attention backward in plain jnp (global-lse probabilities)."""
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)[..., None]
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * cfg.scale
+    mask = _mask_for(cfg, q.shape[1], k.shape[1])
+    p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * cfg.scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * cfg.scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, cfg: _Cfg):
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    bk = cfg.block_k
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * cfg.scale
+
+    nk_valid = pl.cdiv(cfg.skv_valid, bk)
+    if cfg.causal:
+        # last key block that any row of this query block can see
+        upper = jnp.minimum(nk_valid, lax.div((qi + 1) * bq + bk - 1, bk))
+    else:
+        upper = nk_valid
+
+    row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        col = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = col < cfg.skv_valid
+        if cfg.causal:
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, _NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, a0))
+
+    safe_l = jnp.where(l > 0, l, 1.0)
+    o_ref[0] = jnp.where(l > 0, acc / safe_l, 0.0).astype(o_ref.dtype)
+    lse = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(safe_l[:, 0]), _NEG_BIG)
+    lse_ref[0, :] = lse
+
+
+def _fwd(cfg: _Cfg, q, k, v):
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    nq = sq // cfg.block_q
+    grid = (bh, nq)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, cfg=cfg),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, cfg.block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, cfg.block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, cfg.block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v)),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32, vma=_vma(q, k, v)),
+        ],
+        interpret=cfg.interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, cfg: _Cfg):
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    bk = cfg.block_k
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :][:, None]
+    delta = delta_ref[0, :][:, None]
+    row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    row_ok = row < cfg.sq_valid
+
+    nk_valid = pl.cdiv(cfg.skv_valid, bk)
+    if cfg.causal:
+        upper = jnp.minimum(nk_valid, lax.div((qi + 1) * bq + bk - 1, bk))
+    else:
+        upper = nk_valid
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * cfg.scale
+        col = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = (col < cfg.skv_valid) & row_ok
+        if cfg.causal:
+            mask = mask & (col <= row)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * cfg.scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                cfg: _Cfg):
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    bq = cfg.block_q
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    col = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    col_ok = col < cfg.skv_valid
+
+    nq = pl.cdiv(cfg.sq_valid, bq)
+    # causal: the first query block whose rows can see this key block
+    lower = lax.div(ki * bk, bq) if cfg.causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * bq, bq)][:, None]
+        delta = delta_ref[0, pl.ds(i * bq, bq)][:, None]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * cfg.scale
+        row = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        mask = col_ok & (row < cfg.sq_valid)
+        if cfg.causal:
+            mask = mask & (col <= row)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = lax.fori_loop(lower, nq, body, (z, z))
+    dk_ref[0] = (dk * cfg.scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do):
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    q_spec = pl.BlockSpec((1, cfg.block_q, d), lambda b, i: (b, i, 0))
+    kv_full = pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0))
+    vec_q = pl.BlockSpec((1, cfg.block_q), lambda b, i: (b, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, cfg=cfg),
+        grid=(bh, sq // cfg.block_q),
+        in_specs=[q_spec, kv_full, kv_full, q_spec, vec_q, vec_q],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v, do)),
+        interpret=cfg.interpret,
+    )(q, k, v, do, lse, delta)
+
+    k_spec = pl.BlockSpec((1, cfg.block_k, d), lambda b, j: (b, j, 0))
+    q_full = pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0))
+    vec_full = pl.BlockSpec((1, sq), lambda b, j: (b, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, cfg=cfg),
+        grid=(bh, skv // cfg.block_k),
+        in_specs=[k_spec, k_spec, q_full, q_full, vec_full, vec_full],
+        out_specs=[k_spec, k_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, skv, d), k.dtype, vma=_vma(q, k, v, do)),
+            jax.ShapeDtypeStruct((bh, skv, d), v.dtype, vma=_vma(q, k, v, do)),
+        ],
+        interpret=cfg.interpret,
+    )(k, v, q, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp core over padded (BH, S, D) arrays
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_core(cfg: _Cfg, q, k, v):
+    o, _ = _fwd(cfg, q, k, v)
+    return o
+
+
+def _flash_core_fwd(cfg: _Cfg, q, k, v):
+    o, lse = _fwd(cfg, q, k, v)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_core_bwd(cfg: _Cfg, res, do):
+    q, k, v, o, lse = res
+    return _bwd_impl(cfg, q, k, v, o, lse, do)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _pad_seq(x, mult):
+    s = x.shape[1]
+    pad = (-s) % mult
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+    return_lse: bool = False,
+):
+    """Flash attention over ``(batch, heads, seq, head_dim)`` tensors.
+
+    Differentiable (custom VJP). Sequence lengths need not be multiples
+    of the block sizes — inputs are zero-padded and masked inside the
+    kernel. ``return_lse`` additionally returns the per-row
+    log-sum-exp (float32, shape ``(batch, heads, seq)``) for softmax
+    merging across shards (ring attention); the lse path is
+    forward-only.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    if causal and sq != skv:
+        raise ValueError("causal=True requires equal q/kv sequence lengths")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = float(scale) if scale is not None else d**-0.5
+    block_q = min(block_q, max(8, sq))
+    block_k = min(block_k, max(8, skv))
+    cfg = _Cfg(
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        sq_valid=sq,
+        skv_valid=skv,
+        interpret=bool(interpret),
+    )
+    qp = _pad_seq(q.reshape(b * h, sq, d), block_q)
+    kp = _pad_seq(k.reshape(b * h, skv, d), block_k)
+    vp = _pad_seq(v.reshape(b * h, skv, d), block_k)
+    if return_lse:
+        o, lse = _fwd(cfg, qp, kp, vp)
+        return (
+            o[:, :sq].reshape(b, h, sq, d),
+            lse[:, :sq].reshape(b, h, sq),
+        )
+    o = _flash_core(cfg, qp, kp, vp)
+    return o[:, :sq].reshape(b, h, sq, d)

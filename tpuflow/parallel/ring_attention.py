@@ -1,0 +1,254 @@
+"""Ring attention: exact sequence-parallel attention over a mesh axis.
+
+The long-context capability (first-class in the TPU build; absent from
+the reference, which is a fixed-224px vision workload — SURVEY.md §5.7):
+Q/K/V are sharded along the sequence dimension over a mesh axis; K/V
+shards rotate around the ring via ``lax.ppermute`` (XLA lowers this to
+neighbor ICI transfers) while each device computes blockwise flash
+attention of its resident Q shard against the visiting K/V shard,
+merging partial softmax results with the log-sum-exp trick.
+
+Memory stays O(local shard) in both passes: the backward is a ring-level
+``custom_vjp`` that RE-ROTATES K/V (recomputation) and lets each
+dK/dV accumulator travel with its shard — after ``n`` rotations the
+gradients arrive back at their home device. No full-sequence tensor is
+ever materialized on any device.
+
+Per-shard compute uses the Pallas flash kernels from
+``tpuflow.ops.attention`` (interpret mode off-TPU, so CPU tests run the
+real kernels).
+
+Use inside ``shard_map`` with the sequence axis manual, e.g.::
+
+    out = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
+        mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None),
+    )(q, k, v)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuflow.ops.attention import (
+    _NEG_BIG,
+    _Cfg,
+    _bwd_impl,
+    _bwd_ref,
+    _fwd,
+    _fwd_ref,
+)
+
+
+def _pvary(x, axis_name: str):
+    """Tag x as varying over axis_name (branch-type agreement in switch)."""
+    try:
+        return lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):
+        return lax.pvary(x, (axis_name,))
+
+
+class _RingCfg(NamedTuple):
+    axis_name: str
+    n: int  # ring size (static)
+    causal: bool
+    scale: float
+    block_q: int
+    block_k: int
+    s_valid: int  # unpadded LOCAL sequence length (uniform shards)
+    interpret: bool
+
+    def block_cfg(self, causal: bool) -> _Cfg:
+        return _Cfg(
+            causal=causal,
+            scale=self.scale,
+            block_q=self.block_q,
+            block_k=self.block_k,
+            sq_valid=self.s_valid,
+            skv_valid=self.s_valid,
+            interpret=self.interpret,
+        )
+
+
+def _rotate(x, axis_name: str, n: int):
+    """Send to the next ring neighbor (i → i+1 mod n)."""
+    return lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Combine two partial softmax results via their log-sum-exps."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    l = w1 + w2
+    safe = jnp.where(l > 0, l, 1.0)
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / safe[..., None]
+    return o, m + jnp.log(safe)
+
+
+def _fwd_mode(rcfg: _RingCfg, q, k, v, mode):
+    """Block attention under a traced visibility mode.
+
+    mode 0 = skip (future shard under causal), 1 = full, 2 = diagonal
+    (own shard under causal: local causal mask).
+    """
+    bh, s, d = q.shape
+
+    # off-TPU the Pallas HLO interpreter can't evaluate vma-carrying
+    # operands, so the block math runs as its jnp reference (equivalence
+    # kernel<->reference is covered by tests/test_ops.py)
+    fwd = _fwd_ref if rcfg.interpret else _fwd
+
+    def skip(_):
+        return (
+            _pvary(jnp.zeros((bh, s, d), q.dtype), rcfg.axis_name),
+            _pvary(jnp.full((bh, s), _NEG_BIG, jnp.float32), rcfg.axis_name),
+        )
+
+    def full(_):
+        return fwd(rcfg.block_cfg(False), q, k, v)
+
+    def diag(_):
+        return fwd(rcfg.block_cfg(True), q, k, v)
+
+    return lax.switch(mode, [skip, full, diag], None)
+
+
+def _bwd_mode(rcfg: _RingCfg, q, k, v, o, lse, do, mode):
+    bwd = _bwd_ref if rcfg.interpret else _bwd_impl
+
+    def skip(_):
+        return (
+            _pvary(jnp.zeros(q.shape, q.dtype), rcfg.axis_name),
+            _pvary(jnp.zeros(k.shape, k.dtype), rcfg.axis_name),
+            _pvary(jnp.zeros(v.shape, v.dtype), rcfg.axis_name),
+        )
+
+    def full(_):
+        return bwd(rcfg.block_cfg(False), q, k, v, o, lse, do)
+
+    def diag(_):
+        return bwd(rcfg.block_cfg(True), q, k, v, o, lse, do)
+
+    return lax.switch(mode, [skip, full, diag], None)
+
+
+def _mode_at(rcfg: _RingCfg, my, t: int):
+    """Visibility of the shard held at ring step t (origin (my-t) mod n)."""
+    if not rcfg.causal:
+        return jnp.int32(1)
+    if t == 0:
+        return jnp.int32(2)  # own shard: local causal
+    src = (my - t) % rcfg.n
+    return jnp.where(src < my, 1, 0).astype(jnp.int32)
+
+
+def _ring_fwd_impl(rcfg: _RingCfg, q, k, v):
+    my = lax.axis_index(rcfg.axis_name)
+    acc_o = jnp.zeros(q.shape, jnp.float32)
+    acc_lse = jnp.full(q.shape[:2], _NEG_BIG, jnp.float32)
+    k_t, v_t = k, v
+    for t in range(rcfg.n):
+        o_b, lse_b = _fwd_mode(rcfg, q, k_t, v_t, _mode_at(rcfg, my, t))
+        acc_o, acc_lse = _merge(acc_o, acc_lse, o_b.astype(jnp.float32), lse_b)
+        if t < rcfg.n - 1:
+            k_t = _rotate(k_t, rcfg.axis_name, rcfg.n)
+            v_t = _rotate(v_t, rcfg.axis_name, rcfg.n)
+    return acc_o.astype(q.dtype), acc_lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ring_core(rcfg: _RingCfg, q, k, v):
+    o, _ = _ring_fwd_impl(rcfg, q, k, v)
+    return o
+
+
+def _ring_core_fwd(rcfg: _RingCfg, q, k, v):
+    o, lse = _ring_fwd_impl(rcfg, q, k, v)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_core_bwd(rcfg: _RingCfg, res, do):
+    q, k, v, o, lse = res
+    my = lax.axis_index(rcfg.axis_name)
+    dq = jnp.zeros(q.shape, jnp.float32)
+    # (k, v) re-rotate (recomputation); (dk, dv) travel with their shard
+    # and are home again after n rotations.
+    k_t, v_t = k, v
+    dk_t = jnp.zeros(k.shape, jnp.float32)
+    dv_t = jnp.zeros(v.shape, jnp.float32)
+    for t in range(rcfg.n):
+        dq_c, dk_c, dv_c = _bwd_mode(
+            rcfg, q, k_t, v_t, o, lse, do, _mode_at(rcfg, my, t)
+        )
+        dq = dq + dq_c.astype(jnp.float32)
+        dk_t = dk_t + dk_c.astype(jnp.float32)
+        dv_t = dv_t + dv_c.astype(jnp.float32)
+        if t < rcfg.n - 1:  # k/v unused after the last contribution
+            k_t = _rotate(k_t, rcfg.axis_name, rcfg.n)
+            v_t = _rotate(v_t, rcfg.axis_name, rcfg.n)
+        dk_t = _rotate(dk_t, rcfg.axis_name, rcfg.n)
+        dv_t = _rotate(dv_t, rcfg.axis_name, rcfg.n)
+    return dq.astype(q.dtype), dk_t.astype(k.dtype), dv_t.astype(v.dtype)
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Sequence-parallel attention on local ``(batch, heads, seq_shard,
+    head_dim)`` shards; must run inside shard_map/pjit with ``axis_name``
+    manual. Differentiable; exact (not approximate) attention.
+
+    ``causal`` treats the global sequence as the concatenation of shards
+    in mesh-axis order.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
+    if q.shape != k.shape or k.shape != v.shape:
+        raise ValueError("ring attention requires uniform q/k/v shard shapes")
+    b, h, s, d = q.shape
+    n = lax.axis_size(axis_name)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # uniform shards ⇒ one block size; collapse BEFORE computing padding
+    # so the padded length is always a multiple of the final block
+    block = min(block_q, block_k, max(8, s))
+    block_q = block_k = block
+    pad = (-s) % block
+    rcfg = _RingCfg(
+        axis_name=axis_name,
+        n=n,
+        causal=causal,
+        scale=float(scale) if scale is not None else d**-0.5,
+        block_q=block_q,
+        block_k=block_k,
+        s_valid=s,
+        interpret=bool(interpret),
+    )
+
+    from tpuflow.ops.attention import _pad_seq
+
+    def prep(x):
+        return _pad_seq(x.reshape(b * h, s, d), block)
+
+    o = _ring_core(rcfg, prep(q), prep(k), prep(v))
+    return o[:, :s].reshape(b, h, s, d)

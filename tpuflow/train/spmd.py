@@ -1,0 +1,183 @@
+"""Auto-sharded (GSPMD) trainer: tensor parallelism via pjit.
+
+The shard_map Trainer (tpuflow.train.trainer) is the data-parallel
+parity path with the reference's Horovod design (SURVEY.md §5.8). This
+is the scale-out path for models whose WEIGHTS are sharded — e.g. the
+ViT family's ``nn.with_partitioning`` annotations over the mesh
+``model`` axis. Instead of manual collectives:
+
+- parameter/optimizer-state shardings are derived from the module's
+  partitioning metadata (``nn.get_partition_spec``), with optimizer
+  moments inheriting their parameter's sharding;
+- the train step is a plain ``jax.jit`` over the (data, model) mesh
+  with batch-sharded inputs; XLA's SPMD partitioner inserts and
+  schedules every collective (all-reduce for the data axis, all-gather/
+  reduce-scatter around the model-sharded matmuls) on ICI.
+
+There is no Horovod analogue to cite — the reference has no tensor
+parallelism at all (SURVEY.md §2c) — so this subclass reuses the
+Trainer's fit/callback/LR machinery and replaces only state init, data
+placement, and the jitted steps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuflow.core.config import TrainConfig
+from tpuflow.models.classifier import backbone_param_mask
+from tpuflow.models.preprocess import preprocess_input
+from tpuflow.parallel.mesh import DATA_AXIS
+from tpuflow.train.optimizers import get_optimizer, set_learning_rate
+from tpuflow.train.state import TrainState
+from tpuflow.train.trainer import Trainer
+
+
+def _specs_like(tree, param_specs, params_def):
+    """Spec tree for a state pytree: any subtree structured exactly like
+    params (optimizer moments) inherits the param specs; every other
+    leaf is replicated."""
+
+    def is_param_tree(node):
+        try:
+            return jax.tree.structure(node) == params_def
+        except Exception:
+            return False
+
+    def sub(node):
+        if is_param_tree(node):
+            return param_specs
+        return jax.tree.map(lambda _: P(), node)
+
+    return jax.tree.map(sub, tree, is_leaf=is_param_tree)
+
+
+class SpmdTrainer(Trainer):
+    """Trainer whose step is jit-auto-sharded over a (data, model) mesh."""
+
+    def __init__(self, model, config: Optional[TrainConfig] = None, mesh=None,
+                 run=None):
+        super().__init__(model, config, mesh=mesh, run=run)
+        # LR ×N scaling follows the reference's rule (P1/03:300-302):
+        # N = number of data-parallel replicas, not total chips.
+        self.world = self.mesh.shape[DATA_AXIS]
+
+    def init_state(self, sample_image_shape: Sequence[int]) -> TrainState:
+        cfg = self.cfg
+        dummy = jnp.zeros((1, *sample_image_shape), jnp.float32)
+
+        def make_state(rng):
+            variables = self.model.init({"params": rng}, dummy, train=False)
+            variables = nn.unbox(variables)
+            params = variables["params"]
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                batch_stats=variables.get("batch_stats", {}),
+                opt_state=self.tx.init(params),
+                rng=jax.random.key(cfg.seed + 1),
+                plateau_factor=jnp.ones((), jnp.float32),
+            )
+
+        # partition specs from the module's with_partitioning metadata
+        boxed = jax.eval_shape(
+            lambda r: self.model.init({"params": r}, dummy, train=False),
+            jax.random.key(cfg.seed),
+        )
+        param_specs = nn.get_partition_spec(boxed)["params"]
+        params_def = jax.tree.structure(param_specs)
+
+        mask = (
+            backbone_param_mask(nn.unbox(boxed)["params"])
+            if getattr(self.model, "freeze_backbone", False)
+            else None
+        )
+        self.lr0 = cfg.learning_rate
+        self.tx = get_optimizer(
+            cfg.optimizer, self.lr0, param_mask=mask, **cfg.optimizer_kwargs
+        )
+
+        abstract = jax.eval_shape(make_state, jax.random.key(cfg.seed))
+        specs = TrainState(
+            step=P(),
+            params=param_specs,
+            batch_stats=jax.tree.map(lambda _: P(), abstract.batch_stats),
+            opt_state=_specs_like(abstract.opt_state, param_specs, params_def),
+            rng=P(),
+            plateau_factor=P(),
+        )
+        self._state_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self.state = jax.jit(
+            make_state, out_shardings=self._state_shardings
+        )(jax.random.key(cfg.seed))
+        return self.state
+
+    def _make_steps(self):
+        model = self.model
+        data_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+
+        def train_step(state: TrainState, images, labels, lr):
+            x = preprocess_input(images, dtype=getattr(model, "dtype", jnp.bfloat16))
+            step_rng = jax.random.fold_in(state.rng, state.step)
+
+            def loss_fn(params):
+                out = model.apply(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    x,
+                    train=True,
+                    rngs={"dropout": step_rng},
+                    mutable=["batch_stats"],
+                )
+                logits, new_vars = out
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), labels
+                ).mean()
+                return loss, (logits, new_vars)
+
+            # global-batch mean loss ⇒ gradients are already averaged
+            # across the data axis; XLA emits the all-reduce.
+            (loss, (logits, new_vars)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+            opt_state = set_learning_rate(state.opt_state, lr)
+            updates, opt_state = self.tx.update(grads, opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = state.replace(
+                step=state.step + 1,
+                params=params,
+                batch_stats=new_vars.get("batch_stats", state.batch_stats),
+                opt_state=opt_state,
+            )
+            return new_state, {"loss": loss, "accuracy": acc}
+
+        def eval_step(state: TrainState, images, labels):
+            x = preprocess_input(images, dtype=getattr(model, "dtype", jnp.bfloat16))
+            logits = model.apply(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                x,
+                train=False,
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels
+            ).mean()
+            acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+            return {"loss": loss, "accuracy": acc}
+
+        self._train_step = jax.jit(
+            train_step,
+            in_shardings=(self._state_shardings, data_sh, data_sh, None),
+            donate_argnums=0,
+        )
+        self._eval_step = jax.jit(
+            eval_step, in_shardings=(self._state_shardings, data_sh, data_sh)
+        )

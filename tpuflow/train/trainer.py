@@ -77,6 +77,11 @@ class Trainer:
         rng = jax.random.key(self.cfg.seed)
         dummy = jnp.zeros((1, *sample_image_shape), jnp.float32)
         variables = self.model.init({"params": rng}, dummy, train=False)
+        # strip nn.with_partitioning boxes (e.g. the ViT family's TP
+        # annotations) — the shard_map DP path replicates params
+        import flax.linen as _nn
+
+        variables = _nn.unbox(variables)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         mask = (
