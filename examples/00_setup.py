@@ -1,0 +1,42 @@
+"""Session setup — per-user database + tracking store.
+
+≙ P1/00_setup.py + P2/00_setup.py: the reference derives a per-user
+database name and captures the tracking server's host/token so worker
+processes can log to it (P1/00_setup.py:3-17). tpuflow's equivalents:
+
+- ``TableStore(root, database)`` — a named database of versioned
+  Parquet tables (≙ the per-user Spark database).
+- ``TrackingStore(root)`` — a file-backed run store every process can
+  reach via a shared path; no host/token env plumbing needed because
+  multi-host TPU jobs share the filesystem path instead
+  (rank-0-gating handled by tpuflow.core.is_primary).
+
+Run: python examples/00_setup.py
+"""
+
+import getpass
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import default_workdir
+
+from tpuflow.data.table import TableStore
+from tpuflow.track import TrackingStore
+
+
+def setup(workdir: str):
+    # ≙ the per-user database name derived at P1/00_setup.py:3-11
+    user = getpass.getuser().replace("-", "_").replace(".", "_")
+    database_name = f"{user}_flower_demo"
+    store = TableStore(os.path.join(workdir, "tables"), database_name)
+    tracking = TrackingStore(os.path.join(workdir, "tracking"))
+    return database_name, store, tracking
+
+
+if __name__ == "__main__":
+    workdir = sys.argv[1] if len(sys.argv) > 1 else default_workdir()
+    database_name, store, tracking = setup(workdir)
+    print(f"database_name = {database_name}")
+    print(f"table store   = {store.root}")
+    print(f"tracking root = {tracking.root}")

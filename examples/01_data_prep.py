@@ -1,0 +1,58 @@
+"""Data prep: image files → bronze → silver → indexed train/val tables.
+
+≙ P1/01_data_prep.py end to end:
+  - recursive *.jpg glob ingest with fractional sampling into an
+    UNCOMPRESSED bronze table (P1/01:61-95; compression off for binary
+    columns per the note at :91-92) — 0.9 here vs the reference's 0.5,
+    since the synthetic dataset is already small,
+  - label extracted from the parent directory → silver (P1/01:124-136),
+  - seeded split (85/15 here; the reference's 90/10 leaves too few val
+    rows at this scale) + sorted-label index → train / val tables with
+    an integer ``label_idx`` column (P1/01:162-222).
+
+Run: python examples/01_data_prep.py [workdir]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import default_workdir, make_synthetic_flowers, setup
+
+from tpuflow.data.ingest import ingest_images
+from tpuflow.data.transforms import (
+    add_label_from_path,
+    build_label_index,
+    index_labels,
+    random_split,
+)
+
+def main(workdir: str) -> None:
+    _db, store, _tracking = setup(workdir)
+    data_dir = make_synthetic_flowers(os.path.join(workdir, "flower_photos"))
+
+    # bronze: binary ingest, sampled, uncompressed (≙ P1/01:61-95)
+    bronze = store.table("flowers_bronze")
+    n = ingest_images(data_dir, bronze, glob="*.jpg", recursive=True,
+                      sample_fraction=0.9, compression=None)
+    print(f"bronze: {n} rows, schema = {bronze.schema().names}")
+
+    # silver: label column from parent dir (≙ pandas_udf, P1/01:124-136)
+    silver_tbl = add_label_from_path(bronze.read())
+    silver = store.table("flowers_silver")
+    silver.write(silver_tbl)
+    labels = sorted(set(silver_tbl.column("label").to_pylist()))
+    print(f"silver: labels = {labels}")
+
+    # split + index (≙ randomSplit(seed=42) + label_to_idx, P1/01:162-222)
+    train_t, val_t = random_split(silver_tbl, fractions=(0.85, 0.15), seed=42)
+    label_to_idx = build_label_index(silver_tbl)
+    print(f"label_to_idx = {label_to_idx}")
+    store.table("flowers_train").write(index_labels(train_t, label_to_idx))
+    store.table("flowers_val").write(index_labels(val_t, label_to_idx))
+    print(f"train = {store.table('flowers_train').count()} rows, "
+          f"val = {store.table('flowers_val').count()} rows")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else default_workdir())

@@ -1,0 +1,46 @@
+"""Single-device transfer-learning training with tracking autolog.
+
+≙ P1/02_model_training_single_node.py: read the indexed train/val
+tables, decode+resize+normalize, train a frozen-backbone MobileNetV2 +
+GAP/Dropout/Dense head with Adam(1e-3) and from-logits cross-entropy
+for a few steps-per-epoch-bounded epochs, with params/metrics
+auto-logged to a run (≙ mlflow.tensorflow.autolog(), P1/02:195).
+
+Requires 01_data_prep.py to have run first (same workdir).
+Run: python examples/02_train_single_device.py [workdir]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import default_workdir, setup, small_config
+
+
+def main(workdir: str) -> None:
+    _db, store, tracking = setup(workdir)
+    import jax
+
+    from tpuflow.parallel.mesh import MeshSpec, build_mesh
+    from tpuflow.workflows import train_and_evaluate
+
+    # single-device mesh — the ≙ of the one-GPU notebook (P1/02); the
+    # SAME call scaled over all devices is 03_train_distributed.py
+    mesh = build_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+    cfg = small_config(batch_size=8, epochs=2)
+    run = tracking.start_run(run_name="single_device_training")
+    val_loss, val_acc, _trainer = train_and_evaluate(
+        store.table("flowers_train"),
+        store.table("flowers_val"),
+        config=cfg,
+        run_id=run.run_id,
+        store=tracking,
+        mesh=mesh,
+        cache_dir=os.path.join(workdir, "cache"),
+    )
+    print(f"run {run.run_id}: val_loss={val_loss:.4f} val_acc={val_acc:.4f}")
+    print(f"logged metrics: {sorted(tracking.get_run(run.run_id).metrics())}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else default_workdir())

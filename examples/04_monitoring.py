@@ -1,0 +1,56 @@
+"""Monitoring & optimization: profiler traces, system metrics, MFU.
+
+≙ P1/04_monitoring_and_optimization.py (prose-only in the reference:
+Ganglia dashboards + scale-up/scale-out guidance) plus the
+Horovod-Timeline hook (P1/03:407-409). tpuflow makes both executable:
+
+- ``obs.profiler.trace`` wraps N steps in a jax.profiler capture
+  (Perfetto/TensorBoard — the Horovod Timeline equivalent),
+- ``obs.sysmetrics.sample_system_metrics`` samples host CPU/mem and
+  device memory (the Ganglia equivalent) for logging as run metrics,
+- ``obs.mfu`` computes FLOPs/step from XLA cost analysis → MFU, the
+  scale-up-vs-out decision input the reference leaves to eyeballing.
+
+Run: python examples/04_monitoring.py [workdir]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import default_workdir
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(workdir: str) -> None:
+    from tpuflow.models import build_model
+    from tpuflow.obs.mfu import device_peak_flops, flops_of_jitted
+    from tpuflow.obs.profiler import trace
+    from tpuflow.obs.sysmetrics import sample_system_metrics
+
+    model = build_model(num_classes=5, dropout=0.5, width_mult=0.25)
+    x = jnp.zeros((8, 64, 64, 3), jnp.float32)
+    variables = model.init({"params": jax.random.key(0)}, x, train=False)
+    fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
+
+    flops = flops_of_jitted(fwd, variables, x)
+    peak = device_peak_flops(jax.devices()[0])
+    print(f"forward flops/step = {flops:.3e}; device peak = {peak:.3e} FLOP/s")
+
+    logdir = os.path.join(workdir, "profile")
+    with trace(logdir):
+        for _ in range(3):
+            fwd(variables, x).block_until_ready()
+    print(f"profiler trace written under {logdir} "
+          "(open in TensorBoard / Perfetto)")
+
+    metrics = sample_system_metrics()
+    for k in sorted(metrics):
+        print(f"  {k} = {metrics[k]:.3f}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else default_workdir())

@@ -1,0 +1,81 @@
+"""HPO with TPE: parallel single-device trials under a parent run.
+
+≙ P2/01_hyperopt_single_machine_model.py: a TPE search over
+{optimizer name, log-uniform LR, uniform dropout} where the objective
+trains a single-device model and returns ``-accuracy`` as the loss
+(maximize accuracy by minimizing its negative, P2/01:179-181);
+trials run CONCURRENTLY (≙ SparkTrials(parallelism=4), P2/01:229) and
+log as nested child runs; afterwards the best child is found by
+metric-ordered run search and registered → Production
+(P2/01:257-299).
+
+Requires 01_data_prep.py to have run first (same workdir).
+Run: python examples/05_tune_parallel_trials.py [workdir]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import CLASSES, default_workdir, setup, small_config
+
+
+def main(workdir: str) -> None:
+    _db, store, tracking = setup(workdir)
+    from tpuflow.parallel.mesh import MeshSpec, build_mesh
+    from tpuflow.track.registry import ModelRegistry
+    from tpuflow.tune import ParallelTrials, fmin, hp
+    from tpuflow.workflows import train_and_package
+
+    cache = os.path.join(workdir, "cache")
+    train_t, val_t = store.table("flowers_train"), store.table("flowers_val")
+    parent = tracking.start_run(run_name="tpe_parallel_tuning")
+
+    # ≙ search_space at P2/01:194-198 (optimizer chosen BY NAME — the
+    # reference's getattr(tf.keras.optimizers, ...) reflection idiom)
+    space = {
+        "optimizer": hp.choice(["adam", "adadelta"]),
+        "learning_rate": hp.loguniform(-5, 0),
+        "dropout": hp.uniform(0.1, 0.9),
+    }
+
+    # ParallelTrials hands each in-flight trial a DISJOINT device subset
+    # via the ``devices`` keyword — one pod becomes k independent trial
+    # slots (the one-trial-per-executor analogue of SparkTrials)
+    def objective(params, devices):
+        cfg = small_config(batch_size=8, epochs=1)
+        cfg.train.optimizer = params["optimizer"]  # optimizer by name
+        mesh = build_mesh(MeshSpec(data=len(devices)), devices=devices)
+        result = train_and_package(
+            tracking, train_t, val_t, classes=sorted(CLASSES),
+            config=cfg, run_name=str(params), mesh=mesh,
+            parent_run_id=parent.run_id,
+            learning_rate=params["learning_rate"],
+            dropout=params["dropout"], cache_dir=cache,
+        )
+        return {"loss": -result["val_accuracy"], "status": "ok"}  # ≙ P2/01:179-181
+
+    best = fmin(objective, space, max_evals=4,
+                trials=ParallelTrials(parallelism=2), seed=0, verbose=True)
+    parent.log_params({f"best_{k}": v for k, v in best.items()})
+    parent.end("FINISHED")
+    print(f"best params: {best}")
+
+    # best child by metric-ordered search (≙ P2/01:257-261)
+    runs = tracking.search_runs(
+        filter={"tags.parentRunId": parent.run_id},
+        order_by="metrics.val_accuracy DESC",
+    )
+    best_run_id = runs[0]["run_id"]
+    print(f"best child run: {best_run_id}")
+
+    # register → Production → load by stage URI (≙ P2/01:282-299)
+    registry = ModelRegistry(tracking)
+    mv = registry.register_model(f"runs:/{best_run_id}/model", "flower_clf")
+    registry.transition_model_version_stage("flower_clf", mv["version"],
+                                            "Production")
+    print(f"registered flower_clf v{mv['version']} → Production")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else default_workdir())

@@ -1,0 +1,83 @@
+"""HPO over DISTRIBUTED training: sequential trials, each owning the mesh.
+
+≙ P2/02_hyperopt_distributed_model.py: each TPE trial launches a full
+data-parallel training run over the whole device mesh, so trials MUST
+run sequentially from the driver — the reference documents exactly this
+constraint (default Trials, never SparkTrials, P2/02:341-344). Per
+trial: a nested child run named by its param string (P2/02:244-247)
+and a per-trial checkpoint directory written by the primary process
+only (P2/02:206-211). Afterwards: best-run selection by metric-ordered
+search, register → Production (P2/02:390-432).
+
+Requires 01_data_prep.py to have run first (same workdir).
+Run: python examples/06_tune_distributed.py [workdir]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import CLASSES, default_workdir, setup, small_config
+
+
+def main(workdir: str) -> None:
+    _db, store, tracking = setup(workdir)
+    from tpuflow.parallel.mesh import build_mesh
+    from tpuflow.track.registry import ModelRegistry
+    from tpuflow.tune import Trials, fmin, hp
+    from tpuflow.workflows import train_and_package
+
+    cache = os.path.join(workdir, "cache")
+    ckpt_root = os.path.join(workdir, "checkpoints")
+    train_t, val_t = store.table("flowers_train"), store.table("flowers_val")
+    mesh = build_mesh()  # every trial trains over ALL devices
+    parent = tracking.start_run(run_name="tpe_distributed_tuning")
+
+    # ≙ search space at P2/02:322-326
+    space = {
+        "learning_rate": hp.loguniform(-5, 0),
+        "dropout": hp.uniform(0.1, 0.9),
+        "batch_size": hp.choice([1, 2, 4]),  # per-device (×8 devices here)
+    }
+
+    def objective(params):
+        param_str = (
+            f"lr_{params['learning_rate']:.6f}"
+            f"_dropout_{params['dropout']:.3f}_bs_{params['batch_size']}"
+        )
+        cfg = small_config(batch_size=params["batch_size"], epochs=1)
+        # per-trial checkpoint dir, primary-only writes (≙ P2/02:206-211)
+        cfg.train.checkpoint_dir = os.path.join(ckpt_root, param_str)
+        result = train_and_package(
+            tracking, train_t, val_t, classes=sorted(CLASSES),
+            config=cfg, run_name=param_str, mesh=mesh,
+            parent_run_id=parent.run_id,
+            learning_rate=params["learning_rate"],
+            dropout=params["dropout"], cache_dir=cache,
+        )
+        return {"loss": result["val_loss"], "status": "ok"}  # ≙ P2/02:309
+
+    # sequential driver-side Trials — the P2/02:341-344 constraint
+    best = fmin(objective, space, max_evals=2, trials=Trials(), seed=0,
+                verbose=True)
+    parent.log_params({f"best_{k}": v for k, v in best.items()})
+    parent.end("FINISHED")
+    print(f"best params: {best}")
+    print(f"checkpoints: {sorted(os.listdir(ckpt_root))}")
+
+    runs = tracking.search_runs(
+        filter={"tags.parentRunId": parent.run_id},
+        order_by="metrics.val_accuracy DESC",
+    )
+    best_run_id = runs[0]["run_id"]
+    registry = ModelRegistry(tracking)
+    mv = registry.register_model(f"runs:/{best_run_id}/model",
+                                 "flower_clf_distributed")
+    registry.transition_model_version_stage(
+        "flower_clf_distributed", mv["version"], "Production"
+    )
+    print(f"registered flower_clf_distributed v{mv['version']} → Production")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else default_workdir())

@@ -1,0 +1,66 @@
+"""Packaged model + single-host and sharded batch inference.
+
+≙ P2/03_pyfunc_distributed_inference.py: one pipeline function trains
+and logs a PACKAGED model — weights + preprocess config + class names
+in one artifact (≙ mlflow.pyfunc.log_model with FlowerPyFunc,
+P2/03:354-363) — then the package is loaded by URI and mapped over a
+table's raw ``content`` bytes: JPEG decode → resize → forward → argmax
+→ class-name strings (P2/03:186-212). The distributed form shards the
+table and runs one shard per process (≙ spark_udf over partitions,
+P2/03:466-472), with ``limit`` smoke runs (≙ limit(10)/limit(1000),
+P2/03:447,470).
+
+Requires 01_data_prep.py to have run first (same workdir).
+Run: python examples/07_package_and_batch_inference.py [workdir]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import CLASSES, default_workdir, setup, small_config
+
+
+def main(workdir: str) -> None:
+    _db, store, tracking = setup(workdir)
+    from tpuflow.infer.batch import predict_table
+    from tpuflow.packaging import load_packaged_model
+    from tpuflow.workflows import train_and_package
+
+    cache = os.path.join(workdir, "cache")
+    train_t, val_t = store.table("flowers_train"), store.table("flowers_val")
+
+    # train + package in one call (≙ train_model_petastorm_data_ingest)
+    result = train_and_package(
+        tracking, train_t, val_t, classes=sorted(CLASSES),
+        config=small_config(batch_size=4, epochs=1),  # per-device batch
+        run_name="train_and_package_demo", cache_dir=cache,
+    )
+    print(f"packaged model at {result['model_uri']} "
+          f"(val_acc={result['val_accuracy']:.4f})")
+
+    # single-host smoke inference (≙ load_model + predict, P2/03:446-450)
+    model = load_packaged_model(result["model_uri"], store=tracking)
+    sample = val_t.read(columns=["content", "label"]).slice(0, 10)
+    preds = model.predict(sample.column("content").to_pylist())
+    for label, pred in zip(sample.column("label").to_pylist(), preds):
+        print(f"  true={label:12s} pred={pred}")
+
+    # sharded batch inference (≙ spark_udf over partitions, P2/03:466-472):
+    # here both shards run in-process; multi-host, each process runs its
+    # own shard=(process_index, process_count) into the same output table
+    out_table = store.table("flowers_predictions")
+    if out_table.exists():
+        out_table.delete()  # fresh table per run — shards APPEND below
+    for shard in range(2):
+        part = predict_table(model, val_t, shard=(shard, 2),
+                             output_table=out_table, limit=None)
+        print(f"shard {shard}: {part.num_rows} rows predicted")
+    n = out_table.count()
+    preds_col = out_table.read(columns=["prediction"]).column("prediction")
+    print(f"predictions table: {n} rows, "
+          f"classes seen: {sorted(set(preds_col.to_pylist()))}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else default_workdir())

@@ -1,0 +1,84 @@
+"""Shared helpers for the example scripts.
+
+Each example mirrors one reference notebook (see examples/README.md for
+the mapping). They run end-to-end on CPU with a synthetic stand-in for
+the tf_flowers dataset (class-name parent dirs of JPEGs — the layout the
+reference ingests at P1/01_data_prep.py:57-66), so no downloads or TPU
+hardware are required; on a TPU host the same scripts use the real
+devices unchanged.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Honor JAX_PLATFORMS even when a sitecustomize already imported jax with
+# another platform frozen into the live config (same realignment as
+# tests/conftest.py).
+if os.environ.get("JAX_PLATFORMS") and "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+CLASSES = ["daisy", "dandelion", "roses", "sunflowers", "tulips"]
+
+
+def make_synthetic_flowers(root: str, per_class: int = 60, seed: int = 42) -> str:
+    """Write a tiny synthetic flower-photo tree: <root>/<label>/img_N.jpg."""
+    import numpy as np
+    from PIL import Image
+
+    rng = random.Random(seed)
+    os.makedirs(root, exist_ok=True)
+    for ci, cls in enumerate(CLASSES):
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = np.zeros((48, 64, 3), dtype=np.uint8)
+            arr[..., ci % 3] = 40 + 20 * (i % 5)
+            arr[(i * 7) % 48, :, :] = 255
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG",
+                                      quality=rng.randint(70, 95))
+            with open(os.path.join(d, f"img_{i}.jpg"), "wb") as f:
+                f.write(buf.getvalue())
+    return root
+
+
+def default_workdir() -> str:
+    return os.environ.get("TPUFLOW_EXAMPLES_DIR",
+                          os.path.join("/tmp", "tpuflow_examples"))
+
+
+def setup(workdir: str):
+    """Run examples/00_setup.py's setup(); returns (database_name,
+    TableStore, TrackingStore). Indirection via importlib because the
+    module name starts with a digit."""
+    import importlib
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    if here not in sys.path:
+        sys.path.insert(0, here)
+    return importlib.import_module("00_setup").setup(workdir)
+
+
+def small_config(batch_size: int = 8, epochs: int = 2):
+    """A Config scaled down for the synthetic dataset (48x64 sources,
+    trained at 64x64 with a 0.25-width backbone so CPU runs finish in
+    seconds). On real data use the defaults: 224x224, width 1.0."""
+    from tpuflow.core.config import Config
+
+    cfg = Config()
+    cfg.data.img_height = 64
+    cfg.data.img_width = 64
+    cfg.data.batch_size = batch_size
+    cfg.model.width_mult = 0.25
+    cfg.model.num_classes = len(CLASSES)
+    cfg.train.epochs = epochs
+    cfg.train.warmup_epochs = 0
+    return cfg

@@ -1,0 +1,63 @@
+"""Smoke tests for the examples/ scripts (notebook-parity surface).
+
+The data-prep example runs in-process (fast, pure host path); the full
+training chain is exercised by the slow-marked end-to-end test.
+"""
+
+import importlib
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+
+
+def _load(name):
+    sys.path.insert(0, _EXAMPLES)
+    try:
+        return importlib.import_module(name)
+    finally:
+        sys.path.remove(_EXAMPLES)
+
+
+def test_examples_import():
+    for name in [
+        "00_setup",
+        "01_data_prep",
+        "02_train_single_device",
+        "03_train_distributed",
+        "04_monitoring",
+        "05_tune_parallel_trials",
+        "06_tune_distributed",
+        "07_package_and_batch_inference",
+    ]:
+        assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
+
+
+def test_data_prep_example(tmp_path):
+    ex = _load("01_data_prep")
+    ex.main(str(tmp_path))
+    setup = _load("00_setup")
+    _db, store, _tracking = setup.setup(str(tmp_path))
+    assert store.table("flowers_train").count() > 0
+    assert store.table("flowers_val").count() > 0
+    cols = store.table("flowers_train").schema().names
+    assert {"content", "label", "label_idx"} <= set(cols)
+
+
+@pytest.mark.slow
+def test_train_distributed_example(tmp_path):
+    env = dict(os.environ)
+    env["TPUFLOW_EXAMPLES_DIR"] = str(tmp_path)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    for script in ["01_data_prep.py", "03_train_distributed.py"]:
+        r = subprocess.run(
+            [sys.executable, os.path.join(_EXAMPLES, script)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]

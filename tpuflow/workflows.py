@@ -25,6 +25,33 @@ from tpuflow.track import TrackingStore
 from tpuflow.train import TrackingCallback, Trainer
 
 
+def _with_overrides(
+    config: Optional[Config],
+    learning_rate=None,
+    dropout=None,
+    batch_size=None,
+    epochs=None,
+    checkpoint_dir=None,
+) -> Config:
+    """Copy of ``config`` with the HPO-style overrides applied — the
+    caller's Config is never mutated, so one shared Config can back a
+    whole trial sweep."""
+    import copy
+
+    cfg = copy.deepcopy(config) if config is not None else Config()
+    if learning_rate is not None:
+        cfg.train.learning_rate = learning_rate
+    if dropout is not None:
+        cfg.model.dropout = dropout
+    if batch_size is not None:
+        cfg.data.batch_size = batch_size
+    if epochs is not None:
+        cfg.train.epochs = epochs
+    if checkpoint_dir is not None:
+        cfg.train.checkpoint_dir = checkpoint_dir
+    return cfg
+
+
 def train_and_evaluate(
     train_table: Table,
     val_table: Table,
@@ -53,17 +80,14 @@ def train_and_evaluate(
     (P2/02:161-262). Side effects (tracking, checkpoints) are
     primary-process-only; metrics come back replica-averaged.
     """
-    cfg = config or Config()
-    if learning_rate is not None:
-        cfg.train.learning_rate = learning_rate
-    if dropout is not None:
-        cfg.model.dropout = dropout
-    if batch_size is not None:
-        cfg.data.batch_size = batch_size
-    if epochs is not None:
-        cfg.train.epochs = epochs
-    if checkpoint_dir is not None:
-        cfg.train.checkpoint_dir = checkpoint_dir
+    cfg = _with_overrides(
+        config,
+        learning_rate=learning_rate,
+        dropout=dropout,
+        batch_size=batch_size,
+        epochs=epochs,
+        checkpoint_dir=checkpoint_dir,
+    )
 
     mesh = mesh if mesh is not None else build_mesh()
     import jax
@@ -143,13 +167,34 @@ def train_and_package(
     mesh=None,
     model=None,
     model_type: str = "transfer_classifier",
+    parent_run_id: Optional[str] = None,
+    learning_rate: Optional[float] = None,
+    dropout: Optional[float] = None,
+    batch_size: Optional[int] = None,
+    epochs: Optional[int] = None,
+    cache_dir: Optional[str] = None,
 ) -> Dict[str, Any]:
     """One-shot pipeline: run-create → param log → train → package →
     evaluate → cleanup. ≙ train_model_petastorm_data_ingest
     (P2/03:253-409). Returns {'run_id', 'model_uri', 'val_loss',
-    'val_accuracy'}."""
-    cfg = config or Config()
-    run = store.start_run(run_name=run_name) if is_primary() else None
+    'val_accuracy'}.
+
+    ``parent_run_id`` nests the run as an HPO child (≙ the per-trial
+    nested child runs of P2/02:244-247) so each trial logs a loadable
+    model; the hyperparameter overrides mirror train_and_evaluate's.
+    """
+    cfg = _with_overrides(
+        config,
+        learning_rate=learning_rate,
+        dropout=dropout,
+        batch_size=batch_size,
+        epochs=epochs,
+    )
+    run = (
+        store.start_run(run_name=run_name, parent_run_id=parent_run_id)
+        if is_primary()
+        else None
+    )
     run_id = run.run_id if run is not None else None
     if run is not None:
         # ≙ logging img_params_dict.json as an artifact (P2/03:285-287)
@@ -164,7 +209,7 @@ def train_and_package(
         )
     val_loss, val_acc, trainer = train_and_evaluate(
         train_table, val_table, config=cfg, run_id=run_id, store=None, mesh=mesh,
-        model=model,
+        model=model, cache_dir=cache_dir,
     )
     model_uri = None
     if run is not None:
