@@ -1,0 +1,169 @@
+"""Transformer LM family: forward, causal flash parity, tensor-parallel
+GSPMD parity, sequence-parallel (causal ring attention) parity, loss.
+
+All on the 8-device virtual CPU mesh (SURVEY.md §4 discipline).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuflow.models.transformer import (
+    build_transformer_lm,
+    next_token_loss,
+    rotary_embed,
+)
+from tpuflow.parallel.mesh import MeshSpec, build_mesh
+
+
+def _tiny_lm(dtype=jnp.float32, **kw):
+    return build_transformer_lm(
+        vocab_size=64, dim=32, depth=2, heads=4, mlp_ratio=2, dtype=dtype,
+        **kw,
+    )
+
+
+def _tokens(b=2, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 64, (b, s)).astype(np.int32)
+
+
+def test_forward_shapes_and_dtype():
+    m = _tiny_lm()
+    toks = jnp.asarray(_tokens())
+    v = m.init({"params": jax.random.key(0)}, toks)
+    out = m.apply(v, toks)
+    assert out.shape == (2, 16, 64)
+    assert out.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_causality():
+    """Changing a future token must not change past logits."""
+    m = _tiny_lm()
+    toks = _tokens()
+    v = nn.unbox(m.init({"params": jax.random.key(0)}, jnp.asarray(toks)))
+    out1 = m.apply(v, jnp.asarray(toks))
+    toks2 = toks.copy()
+    toks2[:, -1] = (toks2[:, -1] + 1) % 64
+    out2 = m.apply(v, jnp.asarray(toks2))
+    np.testing.assert_allclose(out1[:, :-1], out2[:, :-1], atol=1e-6)
+    assert not np.allclose(out1[:, -1], out2[:, -1])
+
+
+def test_rotary_preserves_norm_and_relativity():
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, 2, 8, 16)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 2, 8, 16)).astype(np.float32))
+    pos = jnp.arange(8, dtype=jnp.int32)
+    q1, k1 = rotary_embed(q, k, pos)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(q1), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1),
+        atol=1e-5,
+    )
+    # scores depend only on RELATIVE position: shifting all positions by
+    # a constant leaves q·k scores unchanged
+    q2, k2 = rotary_embed(q, k, pos + 17)
+    s1 = jnp.einsum("bhqd,bhkd->bhqk", q1, k1)
+    s2 = jnp.einsum("bhqd,bhkd->bhqk", q2, k2)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=1e-4)
+
+
+def test_flash_impl_matches_auto():
+    toks = jnp.asarray(_tokens())
+    m_auto = _tiny_lm(attn_impl="auto")
+    m_flash = _tiny_lm(attn_impl="flash")
+    v = nn.unbox(m_auto.init({"params": jax.random.key(0)}, toks))
+    np.testing.assert_allclose(
+        m_auto.apply(v, toks), m_flash.apply(v, toks), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_tp_forward_matches_single_device():
+    """GSPMD-sharded forward over (data=2, model=4) == unsharded."""
+    m = _tiny_lm()
+    toks = jnp.asarray(_tokens(b=4))
+    v = nn.unbox(m.init({"params": jax.random.key(0)}, toks))
+    ref = m.apply(v, toks)
+
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+    boxed = jax.eval_shape(
+        lambda r: m.init({"params": r}, toks), jax.random.key(0)
+    )
+    specs = nn.get_partition_spec(boxed)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    fwd = jax.jit(
+        m.apply,
+        in_shardings=(shardings, NamedSharding(mesh, P("data", None))),
+    )
+    out = fwd(v, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+    # embed really lands vocab-sharded on the mesh
+    assert fwd.lower(v, toks).compile()  # compiles clean
+
+
+def test_sequence_parallel_matches_standard():
+    """Causal ring attention inside the full LM under shard_map with
+    tokens sharded along the sequence == the standard model."""
+    m_std = _tiny_lm(seq_axis=None)
+    m_sp = _tiny_lm(seq_axis="seq")
+    toks = jnp.asarray(_tokens(b=2, s=16))
+    v = nn.unbox(m_std.init({"params": jax.random.key(0)}, toks))
+    ref = m_std.apply(v, toks)
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    sp_fwd = shard_map(
+        lambda v, t: m_sp.apply(v, t),
+        mesh=mesh,
+        in_specs=(P(), P(None, "seq")),
+        out_specs=P(None, "seq", None),
+    )
+    out = sp_fwd(v, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_next_token_loss():
+    b, s, vocab = 2, 8, 64
+    logits = jnp.zeros((b, s, vocab), jnp.float32)
+    toks = jnp.asarray(_tokens(b, s))
+    loss = next_token_loss(logits, toks)
+    np.testing.assert_allclose(float(loss), np.log(vocab), atol=1e-5)
+    # fully masked targets → loss 0 (and no NaN from 0/0)
+    masked = jnp.full((b, s), -1, jnp.int32)
+    assert float(next_token_loss(logits, masked)) == 0.0
+
+
+def test_lm_trains():
+    """A few Adam steps reduce the loss on a repeating sequence."""
+    import optax
+
+    m = _tiny_lm()
+    toks = jnp.asarray(np.tile(np.arange(8, dtype=np.int32), (2, 4)))
+    v = nn.unbox(m.init({"params": jax.random.key(0)}, toks))
+    params = v["params"]
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            return next_token_loss(m.apply({"params": p}, toks), toks)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        upd, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, upd), opt, loss
+
+    losses = []
+    for _ in range(10):
+        params, opt, loss = step(params, opt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses

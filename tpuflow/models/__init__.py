@@ -6,3 +6,8 @@ from tpuflow.models.classifier import (  # noqa: F401
 )
 from tpuflow.models.preprocess import preprocess_input, preprocess  # noqa: F401
 from tpuflow.models.vit import ViTClassifier, build_vit  # noqa: F401
+from tpuflow.models.transformer import (  # noqa: F401
+    TransformerLM,
+    build_transformer_lm,
+    next_token_loss,
+)

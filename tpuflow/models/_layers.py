@@ -1,0 +1,20 @@
+"""Shared layer helpers for the model families (ViT, transformer, MoE).
+
+One home for the tensor-parallel annotation idiom so a change to the
+partitioning-metadata API lands in every model family at once.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+dense_init = nn.initializers.xavier_uniform()
+
+
+def part(init, names, enabled: bool = True):
+    """TP annotation via ``nn.with_partitioning``, disabled in manual
+    (shard_map) sequence-parallel mode: flax re-applies partitioning
+    metadata as sharding constraints at apply time, which would
+    reference the absent mesh axes there (params are replicated by the
+    shard_map in_spec instead)."""
+    return nn.with_partitioning(init, names) if enabled else init

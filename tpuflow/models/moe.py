@@ -1,0 +1,127 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Absent from the reference (SURVEY.md §2c lists expert parallelism as an
+honest absence); first-class here. GShard/Mixtral-style top-k routed
+MoE in the TPU-idiomatic GSPMD formulation:
+
+- expert weights are stacked ``(E, ...)`` and annotated over an
+  ``expert`` mesh axis via ``nn.with_partitioning``; under ``jit`` on a
+  mesh with that axis, XLA partitions the batched expert matmuls and
+  inserts the dispatch/combine **all-to-alls** itself — the same
+  compiler-scheduled path the framework uses for TP (no hand-written
+  collectives, they ride ICI);
+- routing is dense one-hot dispatch with a per-expert CAPACITY: each
+  token's top-k experts get softmax gates, tokens beyond an expert's
+  capacity are dropped (gate 0) — keeping every shape static for XLA
+  (data-dependent gather/scatter would forbid MXU tiling);
+- the standard load-balance auxiliary loss (mean gate fraction ×
+  routed fraction per expert, scaled by E²·α) is returned alongside
+  the output so the caller can add it to the task loss.
+
+Use ``ep_axis=None`` (default) for replicated experts (single device /
+DP); ``ep_axis='expert'`` when the mesh carries an expert axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpuflow.models._layers import dense_init as _dense_init  # noqa: E402
+from tpuflow.models._layers import part as _part  # noqa: E402
+
+EXPERT_AXIS = "expert"
+
+
+class MoEMlp(nn.Module):
+    """Top-k routed expert MLP: (B, S, dim) → ((B, S, dim), aux_loss)."""
+
+    dim: int
+    hidden: int
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.bfloat16
+    ep_axis: Optional[str] = None  # mesh axis sharding the expert dim
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        b, s, d = x.shape
+        e, k = self.n_experts, self.top_k
+        t = b * s
+        # per-expert capacity: even share × factor × k (each token asks
+        # for k slots), at least 1 — static for XLA
+        cap = max(1, int(self.capacity_factor * k * t / e))
+        ep = self.ep_axis is not None
+
+        tokens = x.reshape(t, d)
+        # router in float32 (small, precision-sensitive)
+        router_logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, name="router"
+        )(tokens.astype(jnp.float32))
+        probs = nn.softmax(router_logits, axis=-1)  # (T, E)
+
+        # top-k one-hot dispatch masks, built greedily so a token's k
+        # choices occupy distinct experts
+        gates = jnp.zeros((t, e), jnp.float32)
+        mask = jnp.zeros((t, e), jnp.float32)
+        remaining = probs
+        for _ in range(k):
+            choice = jnp.argmax(remaining, axis=-1)
+            one_hot = nn.one_hot(choice, e, dtype=jnp.float32)
+            gates = gates + one_hot * probs
+            mask = mask + one_hot
+            remaining = remaining * (1.0 - one_hot)
+
+        # position of each token within its expert's buffer (per expert
+        # running count over tokens); tokens past capacity are dropped
+        position = jnp.cumsum(mask, axis=0) * mask - 1.0  # (T, E)
+        in_cap = (position < cap) & (mask > 0)
+        gates = jnp.where(in_cap, gates, 0.0)
+        # renormalize surviving gates so each token's weights sum to 1
+        denom = jnp.sum(gates, axis=-1, keepdims=True)
+        gates = gates / jnp.maximum(denom, 1e-9)
+
+        # (T, E, C) one-hot of (expert, slot) per token
+        pos_idx = jnp.clip(position, 0, cap - 1).astype(jnp.int32)
+        slot_one_hot = nn.one_hot(pos_idx, cap, dtype=jnp.float32)  # (T,E,C)
+        dispatch = slot_one_hot * in_cap[..., None]  # (T, E, C)
+
+        # dispatch tokens → (E, C, d); under GSPMD with expert-sharded
+        # weights XLA turns this into the dispatch all-to-all
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch, tokens.astype(jnp.float32)
+        ).astype(self.dtype)
+
+        w_in = self.param(
+            "w_in",
+            _part(_dense_init, (self.ep_axis, None, None), ep),
+            (e, d, self.hidden),
+            jnp.float32,
+        )
+        w_out = self.param(
+            "w_out",
+            _part(_dense_init, (self.ep_axis, None, None), ep),
+            (e, self.hidden, d),
+            jnp.float32,
+        )
+        h = nn.silu(jnp.einsum(
+            "ecd,edh->ech", expert_in, w_in.astype(self.dtype)))
+        expert_out = jnp.einsum("ech,ehd->ecd", h, w_out.astype(self.dtype))
+
+        # combine back with gate weights (the combine all-to-all)
+        combine = dispatch * gates[..., None]  # (T, E, C)
+        out = jnp.einsum(
+            "tec,ecd->td", combine, expert_out.astype(jnp.float32)
+        )
+
+        # load-balance aux loss (Switch/GShard): E · Σ_e f_e · p_e where
+        # f_e = fraction of tokens routed to e, p_e = mean router prob
+        f = jnp.mean(mask, axis=0)  # (E,) — pre-capacity routing share
+        p = jnp.mean(probs, axis=0)
+        aux = self.aux_loss_weight * e * jnp.sum(f * p)
+
+        return out.astype(self.dtype).reshape(b, s, d), aux

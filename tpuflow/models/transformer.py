@@ -1,0 +1,290 @@
+"""Decoder-only transformer language model — the long-context family.
+
+The reference has no text models and no attention at all (SURVEY.md
+§2c, §5.7); this is the capability the TPU build adds as first-class:
+a causal LM whose design axes map one-to-one onto the mesh:
+
+- **Tensor parallelism**: Megatron-style — q/k/v and the MLP's
+  gate/up projections column-sharded over the ``model`` axis, output
+  projections row-sharded (one all-reduce per block under GSPMD); the
+  token embedding and LM head are vocab-sharded. Same
+  ``nn.with_partitioning`` idiom as the ViT family
+  (tpuflow.models.vit), auto-lowered by jit over a (data, model) mesh.
+- **Sequence parallelism**: ``seq_axis="seq"`` switches to manual mode
+  for use inside ``shard_map`` with TOKENS sharded along the sequence:
+  attention becomes causal ring attention (K/V shards rotating over
+  ICI — tpuflow.parallel.ring_attention), rotary positions are offset
+  by the shard's global start, and everything else is per-token.
+- **Attention impls**: ``attn_impl='flash'`` uses the Pallas blockwise
+  kernel (tpuflow.ops.attention) with causal block skipping;
+  ``'auto'`` uses XLA einsums (fully GSPMD-partitionable).
+
+Pre-norm blocks with RMSNorm, SwiGLU MLP, rotary position embeddings,
+no biases — the standard modern decoder recipe, chosen because every
+op in it is shard-uniform (SP needs no per-position parameters).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+from tpuflow.ops.attention import flash_attention, mha_reference
+from tpuflow.parallel.mesh import MODEL_AXIS
+from tpuflow.parallel.ring_attention import ring_attention
+
+
+from tpuflow.models._layers import dense_init as _dense_init  # noqa: E402
+from tpuflow.models._layers import part as _part  # noqa: E402
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        x32 = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (x.shape[-1],), jnp.float32)
+        y = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
+                            + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+def rotary_embed(q, k, positions, theta: float = 10000.0):
+    """Apply rotary position embeddings to q, k of shape (B, H, S, D).
+
+    ``positions``: (S,) int32 GLOBAL token positions — under sequence
+    parallelism the caller passes the shard's absolute positions so
+    rotations agree across shards. Computed in float32.
+    """
+    d = q.shape[-1]
+    half = d // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (S, half)
+    cos = jnp.cos(angles)[None, None, :, :]
+    sin = jnp.sin(angles)[None, None, :, :]
+
+    def rot(t):
+        t32 = t.astype(jnp.float32)
+        t1, t2 = t32[..., :half], t32[..., half:]
+        out = jnp.concatenate(
+            [t1 * cos - t2 * sin, t1 * sin + t2 * cos], axis=-1
+        )
+        return out.astype(t.dtype)
+
+    return rot(q), rot(k)
+
+
+class CausalAttention(nn.Module):
+    dim: int
+    heads: int
+    dtype: Any
+    attn_impl: str = "auto"  # auto | flash
+    seq_axis: Optional[str] = None  # set → causal ring attention
+    rope_theta: float = 10000.0
+
+    @nn.compact
+    def __call__(self, x):
+        tp = self.seq_axis is None
+        head_dim = self.dim // self.heads
+        b, s, _ = x.shape
+
+        def proj_in(name):
+            return nn.Dense(
+                self.dim,
+                use_bias=False,
+                dtype=self.dtype,
+                kernel_init=_part(_dense_init, (None, MODEL_AXIS), tp),
+                name=name,
+            )(x)
+
+        def heads_first(t):  # (B, S, C) → (B, H, S, D)
+            return t.reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = (heads_first(proj_in(n)) for n in ("query", "key", "value"))
+
+        if self.seq_axis is not None:
+            # absolute positions of this shard's tokens
+            shard = lax.axis_index(self.seq_axis)
+            positions = shard * s + jnp.arange(s, dtype=jnp.int32)
+        else:
+            positions = jnp.arange(s, dtype=jnp.int32)
+        q, k = rotary_embed(q, k, positions, self.rope_theta)
+
+        if self.seq_axis is not None:
+            o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        elif self.attn_impl == "flash":
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            o = mha_reference(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, self.dim)
+        return nn.Dense(
+            self.dim,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=_part(_dense_init, (MODEL_AXIS, None), tp),
+            name="proj",
+        )(o)
+
+
+class SwiGLU(nn.Module):
+    dim: int
+    hidden: int
+    dtype: Any
+    tp: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        def col(name):
+            return nn.Dense(
+                self.hidden,
+                use_bias=False,
+                dtype=self.dtype,
+                kernel_init=_part(_dense_init, (None, MODEL_AXIS), self.tp),
+                name=name,
+            )(x)
+
+        y = nn.silu(col("gate")) * col("up")
+        return nn.Dense(
+            self.dim,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=_part(_dense_init, (MODEL_AXIS, None), self.tp),
+            name="down",
+        )(y)
+
+
+class DecoderBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int
+    dtype: Any
+    attn_impl: str
+    seq_axis: Optional[str]
+    rope_theta: float = 10000.0
+    n_experts: int = 0  # >0 → MoE MLP in this block
+    moe_top_k: int = 2
+    ep_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + CausalAttention(
+            self.dim, self.heads, self.dtype, self.attn_impl, self.seq_axis,
+            self.rope_theta, name="attn",
+        )(RMSNorm(self.dtype, name="norm1")(x))
+        y = RMSNorm(self.dtype, name="norm2")(x)
+        if self.n_experts > 0:
+            from tpuflow.models.moe import MoEMlp
+
+            y, aux = MoEMlp(
+                self.dim, self.dim * self.mlp_ratio,
+                n_experts=self.n_experts, top_k=self.moe_top_k,
+                dtype=self.dtype, ep_axis=self.ep_axis, name="moe",
+            )(y)
+            # accumulated under mutable=['losses']; no-op otherwise
+            self.sow("losses", "moe_aux", aux)
+        else:
+            y = SwiGLU(
+                self.dim, self.dim * self.mlp_ratio, self.dtype,
+                tp=self.seq_axis is None, name="mlp",
+            )(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: token ids (B, S) int32 → logits (B, S, vocab) f32."""
+
+    vocab_size: int = 32000
+    dim: int = 512
+    depth: int = 6
+    heads: int = 8
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "auto"
+    seq_axis: Optional[str] = None
+    rope_theta: float = 10000.0
+    n_experts: int = 0  # >0 → MoE MLP in every moe_every-th block
+    moe_every: int = 2
+    moe_top_k: int = 2
+    ep_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        tp = self.seq_axis is None
+        embed = self.param(
+            "embed",
+            _part(nn.initializers.normal(0.02), (MODEL_AXIS, None), tp),
+            (self.vocab_size, self.dim),
+            jnp.float32,
+        )
+        x = jnp.take(embed, tokens, axis=0).astype(self.dtype)
+        for i in range(self.depth):
+            moe_block = self.n_experts > 0 and (i % self.moe_every
+                                                == self.moe_every - 1)
+            x = DecoderBlock(
+                self.dim, self.heads, self.mlp_ratio, self.dtype,
+                self.attn_impl, self.seq_axis, self.rope_theta,
+                n_experts=self.n_experts if moe_block else 0,
+                moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
+                name=f"block{i}",
+            )(x)
+        x = RMSNorm(self.dtype, name="norm_final")(x)
+        # vocab-sharded LM head (column-parallel); logits in float32
+        return nn.Dense(
+            self.vocab_size,
+            use_bias=False,
+            dtype=jnp.float32,
+            kernel_init=_part(_dense_init, (None, MODEL_AXIS), tp),
+            name="lm_head",
+        )(x.astype(jnp.float32))
+
+
+def build_transformer_lm(
+    vocab_size: int = 32000,
+    dim: int = 512,
+    depth: int = 6,
+    heads: int = 8,
+    mlp_ratio: int = 4,
+    dtype: Any = jnp.bfloat16,
+    attn_impl: str = "auto",
+    seq_axis: Optional[str] = None,
+    n_experts: int = 0,
+    moe_every: int = 2,
+    moe_top_k: int = 2,
+    ep_axis: Optional[str] = None,
+) -> TransformerLM:
+    if dim % heads:
+        raise ValueError("dim must be a multiple of heads")
+    if (dim // heads) % 2:
+        raise ValueError("head_dim must be even (rotary pairs)")
+    return TransformerLM(
+        vocab_size=vocab_size, dim=dim, depth=depth, heads=heads,
+        mlp_ratio=mlp_ratio, dtype=dtype, attn_impl=attn_impl,
+        seq_axis=seq_axis, n_experts=n_experts, moe_every=moe_every,
+        moe_top_k=moe_top_k, ep_axis=ep_axis,
+    )
+
+
+def next_token_loss(logits, tokens, ignore_index: int = -1):
+    """Mean cross-entropy of logits[:, :-1] predicting tokens[:, 1:].
+
+    Positions whose TARGET equals ``ignore_index`` are masked out.
+    Use on global (unsharded or batch-sharded) arrays; under sequence
+    parallelism apply to the all-gathered logits or compute the shifted
+    targets outside the shard_map so the shift crosses shard boundaries
+    correctly.
+    """
+    import optax
+
+    targets = tokens[:, 1:]
+    pred = logits[:, :-1].astype(jnp.float32)
+    mask = (targets != ignore_index).astype(jnp.float32)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        pred, jnp.where(targets == ignore_index, 0, targets)
+    )
+    return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -39,15 +39,8 @@ from tpuflow.parallel.mesh import MODEL_AXIS
 from tpuflow.parallel.ring_attention import ring_attention
 
 
-def _part(init, names, enabled: bool = True):
-    """TP annotation, disabled in manual (shard_map) sequence-parallel
-    mode: flax re-applies partitioning metadata as sharding constraints
-    at apply time, which would reference the absent 'model' axis there
-    (params are replicated by the shard_map in_spec instead)."""
-    return nn.with_partitioning(init, names) if enabled else init
-
-
-_dense_init = nn.initializers.xavier_uniform()
+from tpuflow.models._layers import dense_init as _dense_init  # noqa: E402
+from tpuflow.models._layers import part as _part  # noqa: E402
 
 
 class ViTMlp(nn.Module):

@@ -19,6 +19,30 @@ import jax.numpy as jnp
 from tpuflow.parallel.mesh import DATA_AXIS
 
 
+def pvary(x, axis_names) -> Any:
+    """Tag x as varying over the given manual mesh axes — needed where
+    shard_map type-checks branches/carries (lax.switch, lax.scan) and a
+    constant (e.g. a zeros skip-value) must match a collective-produced
+    value's varying-manual-axes."""
+    axes = (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
+    try:
+        return jax.lax.pcast(x, axes, to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, axes)
+
+
+def pvary_like(x, *refs) -> Any:
+    """Tag x as varying over every manual axis the refs vary over (the
+    general form: refs may vary over other mesh axes than the one a
+    caller knows about, e.g. 'data' on a data x seq mesh)."""
+    want = frozenset()
+    for r in refs:
+        want = want | getattr(jax.typeof(r), "vma", frozenset())
+    have = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(want - have)
+    return pvary(x, missing) if missing else x
+
+
 def pmean_tree(tree: Any, axis_name: str = DATA_AXIS) -> Any:
     """Mean-allreduce every leaf (grad sync ≙ DistributedOptimizer)."""
     return jax.tree.map(lambda x: jax.lax.pmean(x, axis_name), tree)

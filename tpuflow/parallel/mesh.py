@@ -55,6 +55,21 @@ def build_mesh(
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
+def build_nd_mesh(
+    axes: "dict[str, int]",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh with arbitrary named axes, e.g. {'data': 2, 'pipe': 2,
+    'expert': 2} — for the parallelism dimensions beyond (data, model)
+    (pipeline, expert, sequence). Axis order = dict order; put the
+    fastest-communicating axis last (innermost ICI)."""
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = list(axes.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(f"mesh {axes} != device count {len(devices)}")
+    return Mesh(np.array(devices).reshape(sizes), tuple(axes.keys()))
+
+
 def data_sharding(mesh: Mesh) -> NamedSharding:
     """Batch-dim sharding over the data axis (leading dim split)."""
     return NamedSharding(mesh, P(DATA_AXIS))

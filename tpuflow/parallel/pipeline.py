@@ -1,0 +1,127 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a mesh axis.
+
+Absent from the reference (SURVEY.md §2c — its only training
+parallelism is Horovod data parallelism); first-class here because the
+TPU build targets model scales where one chip cannot hold the stack.
+
+TPU-idiomatic SPMD formulation (no per-stage programs, no host
+scheduler): every device runs the SAME traced computation inside
+``shard_map`` over a ``pipe`` mesh axis —
+
+- layer parameters are STACKED with a leading stage dimension and
+  sharded over the axis, so each device holds its own stage's weights;
+- a ``lax.scan`` over ``n_micro + n_stages - 1`` ticks runs the
+  classic GPipe fill/steady/drain schedule: stage 0 ingests one
+  microbatch per tick, every stage applies its layer, and activations
+  hop to the next stage via ``lax.ppermute`` (one neighbor ICI
+  transfer per tick — XLA overlaps it with the next tick's compute);
+- backward falls out of autodiff: differentiating the scan replays the
+  schedule in reverse (ppermute's transpose is the reverse ppermute),
+  which IS GPipe's accumulate-over-microbatches backward.
+
+The bubble fraction is (n_stages-1)/(n_micro+n_stages-1); pick
+``n_micro >= 4 * n_stages`` to amortize it.
+
+Stage functions must be shape-uniform (same activation shape in and
+out) — the standard homogeneous-blocks restriction of SPMD pipelining;
+put the embed/head in the first/last stage fns if they differ.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuflow.parallel.collectives import pvary as _pvary
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(stage_params: Sequence[Any]):
+    """Stack per-stage parameter pytrees along a new leading axis.
+
+    The result is what you shard over the pipe axis:
+    ``in_specs=P('pipe')`` gives each device a (1, ...) slice; pipeline()
+    strips that leading axis before calling the stage fn.
+    """
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def pipeline(
+    stage_fn: Callable[[Any, Any], Any],
+    n_microbatches: int,
+    axis_name: str = PIPE_AXIS,
+) -> Callable[[Any, Any], Any]:
+    """Build the per-device pipelined apply, for use inside shard_map.
+
+    ``stage_fn(stage_params, x_micro) -> y_micro`` is one stage's
+    computation (shape-preserving). Returns ``run(stacked_params, x)``
+    where, per device, ``stacked_params`` is this stage's (1, ...) slice
+    and ``x`` is the full ``(n_micro, micro_batch, ...)`` input
+    (replicated; only stage 0 reads it). The returned buffer holds the
+    pipeline outputs on the LAST stage (zeros elsewhere) — use
+    ``from_last_stage`` to replicate them, or reduce on-stage (e.g. a
+    loss) and ``from_last_stage`` the scalar.
+    """
+
+    def run(stacked_params, x):
+        params = jax.tree.map(lambda a: a[0], stacked_params)
+        idx = lax.axis_index(axis_name)
+        n = lax.axis_size(axis_name)
+        n_micro = x.shape[0]
+        if n_micro != n_microbatches:
+            raise ValueError(
+                f"input has {n_micro} microbatches, pipeline built for "
+                f"{n_microbatches}"
+            )
+        ticks = n_micro + n - 1
+        fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def tick(carry, t):
+            state, outbuf = carry
+            # stage 0 ingests microbatch t (clipped garbage during drain
+            # ticks — those outputs never reach a valid output slot)
+            inp = lax.dynamic_index_in_dim(
+                x, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
+            )
+            cur = jnp.where(idx == 0, inp, state)
+            y = stage_fn(params, cur)
+            # the microbatch fed at tick p arrives at the last stage at
+            # tick p + n - 1 ⇒ this tick's last-stage output is slot t-(n-1)
+            pos = t - (n - 1)
+            written = lax.dynamic_update_index_in_dim(
+                outbuf, y, jnp.clip(pos, 0, n_micro - 1), axis=0
+            )
+            outbuf = jnp.where((pos >= 0) & (idx == n - 1), written, outbuf)
+            state = lax.ppermute(y, axis_name, fwd_perm)
+            return (state, outbuf), None
+
+        state0 = _pvary(jnp.zeros(x.shape[1:], x.dtype), axis_name)
+        out0 = _pvary(jnp.zeros_like(x), axis_name)
+        (_, outbuf), _ = lax.scan(tick, (state0, out0), jnp.arange(ticks))
+        return outbuf
+
+    return run
+
+
+def from_last_stage(x, axis_name: str = PIPE_AXIS):
+    """Replicate a value held by the last pipeline stage to all stages
+    (psum of a one-hot mask — a single small collective)."""
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    return lax.psum(jnp.where(idx == n - 1, x, jnp.zeros_like(x)), axis_name)
+
+
+def split_microbatches(batch, n_microbatches: int):
+    """(B, ...) → (n_micro, B // n_micro, ...). B must divide evenly —
+    the identical-step-count discipline of the sharded loader
+    (reference P1/03:197-200) extends to microbatches."""
+    b = batch.shape[0]
+    if b % n_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by {n_microbatches} microbatches"
+        )
+    return batch.reshape(n_microbatches, b // n_microbatches, *batch.shape[1:])

@@ -47,12 +47,8 @@ from tpuflow.ops.attention import (
 )
 
 
-def _pvary(x, axis_name: str):
-    """Tag x as varying over axis_name (branch-type agreement in switch)."""
-    try:
-        return lax.pcast(x, (axis_name,), to="varying")
-    except (AttributeError, TypeError):
-        return lax.pvary(x, (axis_name,))
+from tpuflow.parallel.collectives import pvary as _pvary  # noqa: E402
+from tpuflow.parallel.collectives import pvary_like as _pvary_like  # noqa: E402
 
 
 class _RingCfg(NamedTuple):
@@ -108,8 +104,8 @@ def _fwd_mode(rcfg: _RingCfg, q, k, v, mode):
 
     def skip(_):
         return (
-            _pvary(jnp.zeros((bh, s, d), q.dtype), rcfg.axis_name),
-            _pvary(jnp.full((bh, s), _NEG_BIG, jnp.float32), rcfg.axis_name),
+            _pvary_like(jnp.zeros((bh, s, d), q.dtype), q, k, v),
+            _pvary_like(jnp.full((bh, s), _NEG_BIG, jnp.float32), q, k, v),
         )
 
     def full(_):
@@ -126,9 +122,9 @@ def _bwd_mode(rcfg: _RingCfg, q, k, v, o, lse, do, mode):
 
     def skip(_):
         return (
-            _pvary(jnp.zeros(q.shape, q.dtype), rcfg.axis_name),
-            _pvary(jnp.zeros(k.shape, k.dtype), rcfg.axis_name),
-            _pvary(jnp.zeros(v.shape, v.dtype), rcfg.axis_name),
+            _pvary_like(jnp.zeros(q.shape, q.dtype), q, k, v, o, lse, do),
+            _pvary_like(jnp.zeros(k.shape, k.dtype), q, k, v, o, lse, do),
+            _pvary_like(jnp.zeros(v.shape, v.dtype), q, k, v, o, lse, do),
         )
 
     def full(_):

@@ -39,6 +39,28 @@ from tpuflow.train.state import TrainState
 from tpuflow.train.trainer import Trainer
 
 
+def shard_over_data(spec_tree, abstract_params, data_size: int):
+    """ZeRO-style sharding: extend each leaf's PartitionSpec by splitting
+    the first dimension that (a) is unsharded in the spec and (b) divides
+    evenly by the data-axis size, over ``DATA_AXIS``. Leaves with no such
+    dimension stay as-is (replicated over data) — correctness never
+    depends on a leaf being sharded, XLA just keeps a full copy.
+    """
+
+    def one(spec, leaf):
+        shape = leaf.shape
+        entries = list(spec) + [None] * (len(shape) - len(spec))
+        for i, dim in enumerate(shape):
+            if entries[i] is None and data_size > 0 and dim % data_size == 0:
+                entries[i] = DATA_AXIS
+                return P(*entries)
+        return spec
+
+    return jax.tree.map(
+        one, spec_tree, abstract_params, is_leaf=lambda s: isinstance(s, P)
+    )
+
+
 def _specs_like(tree, param_specs, params_def):
     """Spec tree for a state pytree: any subtree structured exactly like
     params (optimizer moments) inherits the param specs; every other
@@ -62,8 +84,18 @@ class SpmdTrainer(Trainer):
     """Trainer whose step is jit-auto-sharded over a (data, model) mesh."""
 
     def __init__(self, model, config: Optional[TrainConfig] = None, mesh=None,
-                 run=None):
+                 run=None, zero: Optional[str] = None):
+        """``zero``: None (replicated state — the reference's Horovod
+        semantics, where every worker holds full optimizer state,
+        SURVEY.md §2c "ZeRO/FSDP: absent"), ``'zero1'`` (optimizer
+        moments sharded over the data axis; XLA builds the
+        reduce-scatter/all-gather pair around the update), or
+        ``'fsdp'`` (params AND moments data-sharded; XLA all-gathers
+        weights around each layer's use — ZeRO-3)."""
         super().__init__(model, config, mesh=mesh, run=run)
+        if zero not in (None, "zero1", "fsdp"):
+            raise ValueError(f"zero must be None|'zero1'|'fsdp', got {zero!r}")
+        self.zero = zero
         # LR ×N scaling follows the reference's rule (P1/03:300-302):
         # N = number of data-parallel replicas, not total chips.
         self.world = self.mesh.shape[DATA_AXIS]
@@ -104,11 +136,22 @@ class SpmdTrainer(Trainer):
         )
 
         abstract = jax.eval_shape(make_state, jax.random.key(cfg.seed))
+        opt_param_specs = param_specs
+        if self.zero in ("zero1", "fsdp"):
+            data_size = self.mesh.shape[DATA_AXIS]
+            abstract_params = nn.unbox(boxed)["params"]
+            opt_param_specs = shard_over_data(
+                param_specs, abstract_params, data_size
+            )
+            if self.zero == "fsdp":
+                param_specs = opt_param_specs
         specs = TrainState(
             step=P(),
             params=param_specs,
             batch_stats=jax.tree.map(lambda _: P(), abstract.batch_stats),
-            opt_state=_specs_like(abstract.opt_state, param_specs, params_def),
+            opt_state=_specs_like(
+                abstract.opt_state, opt_param_specs, params_def
+            ),
             rng=P(),
             plateau_factor=P(),
         )
@@ -173,9 +216,18 @@ class SpmdTrainer(Trainer):
             acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
             return {"loss": loss, "accuracy": acc}
 
+        # out_shardings pins the new state to the same layout as the
+        # input state — without it XLA may pick a different output
+        # sharding (observed under ZeRO), breaking the next call's
+        # in_shardings contract.
+        replicated = NamedSharding(self.mesh, P())
         self._train_step = jax.jit(
             train_step,
             in_shardings=(self._state_shardings, data_sh, data_sh, None),
+            out_shardings=(
+                self._state_shardings,
+                {"loss": replicated, "accuracy": replicated},
+            ),
             donate_argnums=0,
         )
         self._eval_step = jax.jit(
