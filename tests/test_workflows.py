@@ -136,3 +136,43 @@ def test_train_and_evaluate_logs_into_existing_run(tables, tmp_path):
     hist = tracking.get_run(driver_run.run_id).metric_history("val_accuracy")
     assert len(hist) == 2
     assert tracking.get_run(driver_run.run_id).params()["world_size"] == 8
+
+
+def test_train_and_evaluate_resume(tables, tmp_path):
+    """Relaunch-after-failure: a second call with resume=True picks up
+    from the checkpointed epoch instead of restarting (SURVEY.md
+    §5.3-5.4 — the capability the reference gestures at but lacks)."""
+    store, root = tables
+    ckdir = str(tmp_path / "ck")
+    kw = dict(
+        config=_cfg(root), model=Tiny(), checkpoint_dir=ckdir,
+    )
+    tracking = TrackingStore(str(tmp_path / "runs3"))
+    run1 = tracking.start_run("r1")
+    train_and_evaluate(
+        store.table("flowers.silver_train"),
+        store.table("flowers.silver_val"),
+        run_id=run1.run_id, store=tracking, epochs=2, **kw,
+    )
+    assert os.path.exists(os.path.join(ckdir, "checkpoint-1.ckpt"))
+
+    # "relaunch": same command, more epochs, resume=True → continues at
+    # epoch 2; only epochs 2..3 are trained and logged
+    run2 = tracking.start_run("r2")
+    train_and_evaluate(
+        store.table("flowers.silver_train"),
+        store.table("flowers.silver_val"),
+        run_id=run2.run_id, store=tracking, epochs=4, resume=True, **kw,
+    )
+    hist = tracking.get_run(run2.run_id).metric_history("val_accuracy")
+    assert len(hist) == 2  # epochs 2 and 3 only
+    assert os.path.exists(os.path.join(ckdir, "checkpoint-3.ckpt"))
+
+    # resume when training is already complete: nothing further runs
+    run3 = tracking.start_run("r3")
+    val_loss, _va, _tr = train_and_evaluate(
+        store.table("flowers.silver_train"),
+        store.table("flowers.silver_val"),
+        run_id=run3.run_id, store=tracking, epochs=4, resume=True, **kw,
+    )
+    assert tracking.get_run(run3.run_id).metric_history("val_accuracy") == []

@@ -56,6 +56,10 @@ class TrainConfig:
     reduce_on_plateau_factor: float = 0.1
     early_stopping_patience: Optional[int] = None  # ≙ EarlyStopping, P2/03:397-401
     checkpoint_dir: Optional[str] = None
+    # >0: every N epochs assert replicas/processes hold identical state
+    # and params are finite (tpuflow.core.debug — the checkable form of
+    # the broadcast-init invariant, P1/03:305-308)
+    consistency_check_every: int = 0
     seed: int = 0
     optimizer_kwargs: Dict[str, Any] = field(default_factory=dict)
 

@@ -63,6 +63,7 @@ class Dataset:
         content_col: str = "content",
         label_col: str = "label_idx",
         drop_remainder: bool = True,
+        start_epoch: int = 0,
     ):
         self.files = list(files)
         self.batch_size = batch_size
@@ -79,6 +80,11 @@ class Dataset:
         self.content_col = content_col
         self.label_col = label_col
         self.drop_remainder = drop_remainder
+        # epoch the NEXT iterator starts shuffling from — per-epoch
+        # orders are seeded by (seed, epoch), so a resumed run sets this
+        # to its initial_epoch and sees the epochs it has NOT trained on
+        # instead of replaying the stream from epoch 0
+        self.start_epoch = start_epoch
         # Load shard rows once: JPEG bytes are small (compressed); for the
         # workshop-scale datasets this is the fast path. Row-group
         # streaming would slot in here for beyond-memory tables. Only this
@@ -148,7 +154,7 @@ class Dataset:
                     continue
             return False
 
-        epoch = 0
+        epoch = self.start_epoch
         bs = self.batch_size
         try:
             while not stop.is_set():

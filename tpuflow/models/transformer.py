@@ -29,6 +29,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -87,6 +88,7 @@ class CausalAttention(nn.Module):
     attn_impl: str = "auto"  # auto | flash
     seq_axis: Optional[str] = None  # set → causal ring attention
     rope_theta: float = 10000.0
+    decode: bool = False  # autoregressive KV-cache mode
 
     @nn.compact
     def __call__(self, x):
@@ -108,20 +110,59 @@ class CausalAttention(nn.Module):
 
         q, k, v = (heads_first(proj_in(n)) for n in ("query", "key", "value"))
 
-        if self.seq_axis is not None:
-            # absolute positions of this shard's tokens
-            shard = lax.axis_index(self.seq_axis)
-            positions = shard * s + jnp.arange(s, dtype=jnp.int32)
+        if self.decode:
+            # KV cache (flax idiom): created at init time with the FULL
+            # target length; decode calls then feed s<=full chunks which
+            # are written at cache_index. The cache shapes fix max_len.
+            ready = self.has_variable("cache", "cached_key")
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               k.shape, k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               v.shape, v.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            if ready:
+                i = ci.value
+                max_len = ck.value.shape[2]
+                positions = i + jnp.arange(s, dtype=jnp.int32)
+                q, k = rotary_embed(q, k, positions, self.rope_theta)
+                ck.value = lax.dynamic_update_slice(ck.value, k, (0, 0, i, 0))
+                cv.value = lax.dynamic_update_slice(cv.value, v, (0, 0, i, 0))
+                ci.value = i + s
+                # q rows attend to cache positions <= their own absolute
+                # position (causal within the chunk, full to the past)
+                key_pos = jnp.arange(max_len)[None, :]
+                ok = key_pos <= positions[:, None]  # (s, max_len)
+                scores = jnp.einsum(
+                    "bhqd,bhkd->bhqk",
+                    q.astype(jnp.float32), ck.value.astype(jnp.float32),
+                ) * (head_dim ** -0.5)
+                scores = jnp.where(ok[None, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1)
+                o = jnp.einsum(
+                    "bhqk,bhkd->bhqd", probs, cv.value.astype(jnp.float32)
+                ).astype(self.dtype)
+            else:
+                # init pass: shapes only (cache created above)
+                positions = jnp.arange(s, dtype=jnp.int32)
+                q, k = rotary_embed(q, k, positions, self.rope_theta)
+                o = mha_reference(q, k, v, causal=True)
         else:
-            positions = jnp.arange(s, dtype=jnp.int32)
-        q, k = rotary_embed(q, k, positions, self.rope_theta)
+            if self.seq_axis is not None:
+                # absolute positions of this shard's tokens
+                shard = lax.axis_index(self.seq_axis)
+                positions = shard * s + jnp.arange(s, dtype=jnp.int32)
+            else:
+                positions = jnp.arange(s, dtype=jnp.int32)
+            q, k = rotary_embed(q, k, positions, self.rope_theta)
 
-        if self.seq_axis is not None:
-            o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
-        elif self.attn_impl == "flash":
-            o = flash_attention(q, k, v, causal=True)
-        else:
-            o = mha_reference(q, k, v, causal=True)
+            if self.seq_axis is not None:
+                o = ring_attention(q, k, v, axis_name=self.seq_axis,
+                                   causal=True)
+            elif self.attn_impl == "flash":
+                o = flash_attention(q, k, v, causal=True)
+            else:
+                o = mha_reference(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, self.dim)
         return nn.Dense(
             self.dim,
@@ -170,12 +211,13 @@ class DecoderBlock(nn.Module):
     n_experts: int = 0  # >0 → MoE MLP in this block
     moe_top_k: int = 2
     ep_axis: Optional[str] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
         x = x + CausalAttention(
             self.dim, self.heads, self.dtype, self.attn_impl, self.seq_axis,
-            self.rope_theta, name="attn",
+            self.rope_theta, self.decode, name="attn",
         )(RMSNorm(self.dtype, name="norm1")(x))
         y = RMSNorm(self.dtype, name="norm2")(x)
         if self.n_experts > 0:
@@ -212,6 +254,7 @@ class TransformerLM(nn.Module):
     moe_every: int = 2
     moe_top_k: int = 2
     ep_axis: Optional[str] = None
+    decode: bool = False  # autoregressive KV-cache mode (see infer.generate)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -231,6 +274,7 @@ class TransformerLM(nn.Module):
                 self.attn_impl, self.seq_axis, self.rope_theta,
                 n_experts=self.n_experts if moe_block else 0,
                 moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
+                decode=self.decode,
                 name=f"block{i}",
             )(x)
         x = RMSNorm(self.dtype, name="norm_final")(x)

@@ -148,3 +148,30 @@ class TrackingCallback(Callback):
             return
         for k, v in logs.items():
             self.run.log_metric(k, float(v), step=epoch)
+
+
+class ReplicaConsistencyCheck(Callback):
+    """Every N epochs, assert the replicated-state invariants: all
+    devices hold bitwise-identical replicated params, all processes
+    agree on a state checksum, and params are finite — the testable
+    form of the reference's unchecked broadcast-init guarantee
+    (P1/03:305-308; SURVEY.md §5.2)."""
+
+    def __init__(self, every_n_epochs: int = 1, check_nans: bool = True):
+        self.every = max(1, every_n_epochs)
+        self.check_nans = check_nans
+
+    def on_epoch_end(self, epoch, logs):
+        if (epoch + 1) % self.every:
+            return
+        from tpuflow.core.debug import (
+            assert_consistent_across_processes,
+            assert_replicated_across_devices,
+            nan_check,
+        )
+
+        params = self.trainer.state.params
+        assert_replicated_across_devices(params, "params")
+        assert_consistent_across_processes(params, "params")
+        if self.check_nans:
+            nan_check(params, "params")

@@ -216,6 +216,35 @@ class Trainer:
 
     # ---- fit/evaluate ----------------------------------------------------
 
+    def maybe_resume(self, checkpoint_dir: Optional[str] = None) -> int:
+        """Restore the newest checkpoint in ``checkpoint_dir`` (default:
+        cfg.checkpoint_dir) into ``self.state`` and return the epoch to
+        continue from — 0 when there is nothing to resume.
+
+        This is the restart half of the failure story the reference
+        lacks (SURVEY.md §5.3-5.4: gang-fail → relaunch → restore): a
+        relaunched job calls fit(initial_epoch=maybe_resume()) with the
+        same command line and continues where it stopped. The filename
+        number (``checkpoint-{n}.ckpt``, the reference's layout at
+        P2/02:206-211) is the count of COMPLETED epochs — which is
+        exactly the next 0-based epoch index.
+        """
+        import re
+
+        from tpuflow.ckpt import latest_checkpoint, restore_into_state
+
+        ckdir = checkpoint_dir or self.cfg.checkpoint_dir
+        if not ckdir:
+            return 0
+        path = latest_checkpoint(ckdir)
+        if path is None:
+            return 0
+        if self.state is None:
+            raise RuntimeError("call init_state() before maybe_resume()")
+        self.state = restore_into_state(path, self.state)
+        m = re.search(r"checkpoint-(\d+)\.ckpt$", path)
+        return int(m.group(1)) if m else 0
+
     def fit(
         self,
         train_ds,
@@ -323,6 +352,13 @@ class Trainer:
             out.append(EarlyStopping(patience=cfg.early_stopping_patience))
         if cfg.checkpoint_dir and ModelCheckpoint not in have:
             out.append(ModelCheckpoint(cfg.checkpoint_dir))
+        if cfg.consistency_check_every > 0:
+            from tpuflow.train.callbacks import ReplicaConsistencyCheck
+
+            if ReplicaConsistencyCheck not in have:
+                out.append(
+                    ReplicaConsistencyCheck(cfg.consistency_check_every)
+                )
         return out
 
     def evaluate(self, ds, steps: Optional[int] = None) -> Dict[str, float]:

@@ -68,8 +68,13 @@ def train_and_evaluate(
     model=None,
     epochs: Optional[int] = None,
     cache_dir: Optional[str] = None,
+    resume: bool = False,
 ) -> Tuple[float, float]:
     """Train data-parallel over the mesh.
+
+    ``resume=True`` restores the newest checkpoint under
+    ``checkpoint_dir`` (when one exists) and continues from the next
+    epoch — the relaunch-after-failure path (SURVEY.md §5.3-5.4).
 
     Returns (val_loss, val_accuracy, trainer) — the first two are the
     reference's return contract (P1/03:375); the trainer rides along so
@@ -145,8 +150,17 @@ def train_and_evaluate(
     callbacks = [TrackingCallback(run)] if run is not None else []
 
     trainer = Trainer(model, cfg.train, mesh=mesh, run=run)
+    initial_epoch = 0
+    if resume and cfg.train.checkpoint_dir:
+        trainer.init_state(
+            (cfg.data.img_height, cfg.data.img_width, cfg.data.img_channels)
+        )
+        initial_epoch = trainer.maybe_resume()
     try:
-        hist = trainer.fit(train_ds, val_ds=val_ds, callbacks=callbacks).history
+        hist = trainer.fit(
+            train_ds, val_ds=val_ds, callbacks=callbacks,
+            initial_epoch=initial_epoch,
+        ).history
         val_loss = hist.get("val_loss", [float("nan")])[-1]
         val_acc = hist.get("val_accuracy", [float("nan")])[-1]
         if run is not None:
