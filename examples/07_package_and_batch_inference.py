@@ -53,9 +53,10 @@ def main(workdir: str) -> None:
     if out_table.exists():
         out_table.delete()  # fresh table per run — shards APPEND below
     for shard in range(2):
-        part = predict_table(model, val_t, shard=(shard, 2),
-                             output_table=out_table, limit=None)
-        print(f"shard {shard}: {part.num_rows} rows predicted")
+        before = out_table.count() if out_table.exists() else 0
+        predict_table(model, val_t, shard=(shard, 2),
+                      output_table=out_table, limit=None)
+        print(f"shard {shard}: {out_table.count() - before} rows predicted")
     n = out_table.count()
     preds_col = out_table.read(columns=["prediction"]).column("prediction")
     print(f"predictions table: {n} rows, "

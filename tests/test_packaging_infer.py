@@ -108,3 +108,33 @@ def test_predict_table_sharded(packaged_dir, tmp_path):
     predict_table(model, t, shard=(0, 2), output_table=out)
     predict_table(model, t, shard=(1, 2), output_table=out)
     assert out.count() == 8
+
+
+def test_predict_table_streams_not_full_read(packaged_dir, tmp_path, monkeypatch):
+    """predict_table must never materialize the whole table: Table.read
+    is forbidden during the call; only iter_batches may be used."""
+    import pyarrow as pa
+    from tpuflow.data import TableStore
+    from tpuflow.data.table import Table
+    from tpuflow.infer import predict_table
+
+    store = TableStore(str(tmp_path / "tbl"), "db")
+    t = store.table("images")
+    rows = [_jpeg((255, 0, 0)), _jpeg((0, 255, 0))] * 8
+    t.write(pa.table({"content": pa.array(rows, pa.binary())}),
+            compression=None, rows_per_file=4)
+
+    def boom(self, *a, **k):
+        raise AssertionError("predict_table called Table.read — not streaming")
+
+    monkeypatch.setattr(Table, "read", boom)
+    model = PackagedModel(packaged_dir)
+    out = predict_table(model, t, batch_size=4)
+    assert out.column("prediction").to_pylist() == ["daisy", "roses"] * 8
+    # output_table mode streams appends in flush_rows commits
+    dst = store.table("preds")
+    assert predict_table(model, t, output_table=dst, batch_size=4,
+                         flush_rows=8) is None
+    assert dst.count() == 16
+    # limit counts global rows and stops the stream early
+    assert predict_table(model, t, limit=5, batch_size=4).num_rows == 5

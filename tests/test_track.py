@@ -102,3 +102,48 @@ def test_search_orders_missing_metrics_last(store):
     rows = store.search_runs(order_by="metrics.acc DESC")
     assert rows[0]["run_name"] == "with_metric"
     assert rows[-1]["run_name"] == "no_metric"
+
+
+def test_concurrent_param_writes_no_lost_updates(store):
+    """k threads × n params into ONE run — the ParallelTrials shared-
+    parent pattern. The per-run fcntl lock must make every read-modify-
+    write of params.json land (no lost updates)."""
+    import threading
+
+    run = store.start_run("shared_parent")
+    k, n = 8, 25
+
+    def writer(t):
+        for i in range(n):
+            run.log_param(f"t{t}_p{i}", i)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(k)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    params = run.params()
+    assert len(params) == k * n
+    for t in range(k):
+        for i in range(n):
+            assert params[f"t{t}_p{i}"] == i
+
+
+def test_concurrent_tag_and_end_meta(store):
+    import threading
+
+    run = store.start_run("meta_race")
+    k = 8
+
+    def tagger(t):
+        run.set_tag(f"tag{t}", str(t))
+
+    threads = [threading.Thread(target=tagger, args=(t,)) for t in range(k)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    run.end()
+    tags = run.meta()["tags"]
+    assert all(tags.get(f"tag{t}") == str(t) for t in range(k))
+    assert run.meta()["status"] == "FINISHED"

@@ -5,7 +5,8 @@ by-construction (rank-0-only side effects, P2/02:206-211) and an
 UNCHECKED invariant: after broadcast-init every worker holds identical
 weights (P1/03:305-308). Here that invariant is testable machinery:
 
-- ``tree_checksum``: cheap order-independent float64 digest of a pytree;
+- ``tree_checksum``: collision-resistant blake2b digest of a pytree's
+  raw leaf bytes (keyed by tree path, dtype and shape);
 - ``assert_replicated_across_devices``: every device's copy of each
   replicated array is bitwise identical (catches desync introduced by
   non-deterministic host code writing into device buffers);
@@ -21,23 +22,30 @@ primary process's perspective; zero overhead when off.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Any
 
 import jax
 import numpy as np
 
 
-def tree_checksum(tree: Any) -> float:
-    """Order-independent digest: Σ |x| + Σ x over float64 per leaf.
-    Identical trees ⇒ identical checksums; cheap enough per epoch."""
-    total = 0.0
-    for leaf in jax.tree.leaves(tree):
+def tree_checksum(tree: Any) -> int:
+    """Collision-resistant digest of a pytree's raw bytes.
+
+    blake2b over each numeric leaf's bytes, mixed with its tree path,
+    dtype and shape — so permutations, sign flips, and value swaps all
+    change the digest (unlike a Σ|x|+Σx style sum). Returned as a
+    uint64-sized int so it can ride a process allgather."""
+    h = hashlib.blake2b(digest_size=8)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
         arr = np.asarray(jax.device_get(leaf))
         if not np.issubdtype(arr.dtype, np.number):
             continue
-        a = arr.astype(np.float64)
-        total += float(np.sum(np.abs(a)) + np.sum(a))
-    return total
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return int.from_bytes(h.digest(), "little")
 
 
 def assert_replicated_across_devices(tree: Any, name: str = "state") -> None:
@@ -68,9 +76,14 @@ def assert_consistent_across_processes(tree: Any, name: str = "state") -> None:
         return
     from jax.experimental import multihost_utils as mhu
 
-    local = np.array([tree_checksum(tree)], np.float64)
-    all_sums = np.asarray(mhu.process_allgather(local)).reshape(-1)
-    if not np.allclose(all_sums, all_sums[0], rtol=0, atol=0):
+    # gather as two uint32 words: uint64 would be silently truncated
+    # (or rejected) by jax under the default x64-disabled config
+    digest = tree_checksum(tree)
+    local = np.array(
+        [digest & 0xFFFFFFFF, digest >> 32], np.uint32
+    )
+    all_sums = np.asarray(mhu.process_allgather(local)).reshape(-1, 2)
+    if not np.all(all_sums == all_sums[0]):
         raise AssertionError(
             f"{name} checksum differs across processes: {all_sums.tolist()}"
         )

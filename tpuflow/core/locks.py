@@ -1,0 +1,31 @@
+"""Shared directory-scoped advisory locking.
+
+One flock helper for every on-disk store that does read-modify-write
+commits (tracking runs, versioned tables). A fresh fd per acquisition
+means ``flock`` serializes both threads within one process and writers
+across processes; platforms without ``fcntl`` degrade to unlocked
+writes (the reference's rank-0-only discipline still applies there).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def dir_lock(path: str, name: str = ".lock"):
+    """Exclusive advisory lock on directory ``path`` (created if needed)."""
+    try:
+        import fcntl
+    except ImportError:  # non-POSIX: fall back to unlocked writes
+        yield
+        return
+    os.makedirs(path, exist_ok=True)
+    fd = os.open(os.path.join(path, name), os.O_CREAT | os.O_RDWR)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)

@@ -33,6 +33,28 @@ from tpuflow.data.table import Table
 from tpuflow.native import decode_resize_batch
 
 
+def take_shard_rows(
+    rb: pa.RecordBatch, gidx: int, shard: Tuple[int, int]
+) -> Optional[pa.RecordBatch]:
+    """Rows of ``rb`` whose GLOBAL row index (``gidx`` + local position)
+    belongs to shard ``(cur, n)`` under round-robin (modulo) assignment.
+
+    THE shard convention, shared by every consumer — the training
+    loader and streaming batch inference — so a convention change can
+    never desync them. Returns None when no rows land in the shard.
+    """
+    cur, n_shards = shard
+    if not (0 <= cur < n_shards):
+        raise ValueError(f"bad shard {shard}")
+    if n_shards == 1:
+        return rb
+    local = np.arange(gidx, gidx + rb.num_rows)
+    keep = np.nonzero(local % n_shards == cur)[0]
+    if not len(keep):
+        return None
+    return rb.take(pa.array(keep))
+
+
 class _StreamError:
     """Producer-thread exception in transit to the consumer."""
 
@@ -96,14 +118,13 @@ class Dataset:
         for f in self.files:
             pf = pq.ParquetFile(f)
             for rb in pf.iter_batches(batch_size=1024, columns=[content_col, label_col]):
-                n = rb.num_rows
-                local = np.arange(gidx, gidx + n)
-                keep = np.nonzero(local % self.shard_count == self.cur_shard)[0]
-                if len(keep):
-                    sub = rb.take(pa.array(keep))
+                sub = take_shard_rows(
+                    rb, gidx, (self.cur_shard, self.shard_count)
+                )
+                if sub is not None:
                     self._contents.extend(sub.column(0).to_pylist())
                     self._labels.extend(int(x) for x in sub.column(1).to_pylist())
-                gidx += n
+                gidx += rb.num_rows
         self._total_rows = gidx
         if self.infinite and len(self._contents) < (
             self.batch_size if self.drop_remainder else 1

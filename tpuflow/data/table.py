@@ -32,6 +32,12 @@ import pyarrow.parquet as pq
 _MANIFEST = "_manifest.json"
 _LATEST = "_latest"
 
+# Concurrent appends (e.g. every process of a multi-host batch-
+# inference job writing its shard into one predictions table) must not
+# both claim ``latest_version()+1`` — each commit runs under this lock
+# so versions are allocated one writer at a time.
+from tpuflow.core.locks import dir_lock as _table_lock  # noqa: E402
+
 
 @dataclass
 class TableVersion:
@@ -68,6 +74,16 @@ class Table:
         """
         if mode not in ("overwrite", "append"):
             raise ValueError(f"unknown write mode {mode!r}")
+        with _table_lock(self.path):
+            return self._write_locked(data, mode, compression, rows_per_file)
+
+    def _write_locked(
+        self,
+        data: pa.Table,
+        mode: str,
+        compression: Optional[str],
+        rows_per_file: int,
+    ) -> TableVersion:
         prev_files: List[str] = []
         prev_rows = 0
         if mode == "append" and self.exists():
@@ -111,6 +127,17 @@ class Table:
             f.write(str(version))
         os.replace(tmp, os.path.join(self.path, _LATEST))
         return manifest
+
+    def ensure(self, schema: pa.Schema) -> None:
+        """Create the table as an empty v0 with ``schema`` iff it does
+        not exist yet — atomically (check + create under the table
+        lock), so concurrent writers can't clobber a sibling's data
+        with an empty overwrite."""
+        with _table_lock(self.path):
+            if not self.exists():
+                self._write_locked(
+                    schema.empty_table(), "overwrite", None, 512
+                )
 
     # ---- read -----------------------------------------------------------
 

@@ -4,18 +4,30 @@
 applied to a table's ``content`` column (P2/03_pyfunc_distributed_
 inference.py:466-472): each executor loads the packaged model once and
 maps it over its partitions. TPU-native form: each PROCESS loads the
-model once and streams its row shard through the jitted forward on its
+model once and STREAMS its row shard through the jitted forward on its
 local devices; results land in a predictions table (one part per
 shard), so the multi-host path needs no driver gather.
+
+The read path is streaming: record batches are pulled one at a time
+from the Parquet files (never ``table.read()``), the shard mask and
+``limit`` are applied per batch BEFORE any Python materialization, and
+sharded rows are buffered up to ``batch_size`` so every jitted forward
+(except the final remainder) runs a FULL batch — no padding waste from
+shard-thinned or row-group-truncated record batches. In
+``output_table`` mode host memory is bounded by ``batch_size`` +
+``flush_rows`` regardless of table size — the property the reference
+gets from Spark's per-partition UDF execution. (The return-a-table
+mode necessarily holds the shard's result in memory; use
+``output_table`` for beyond-memory tables.)
 """
 
 from __future__ import annotations
 
-import os
-from typing import Any, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import pyarrow as pa
 
+from tpuflow.data.loader import take_shard_rows
 from tpuflow.data.table import Table
 from tpuflow.packaging.model import PackagedModel, load_packaged_model
 
@@ -30,32 +42,101 @@ def predict_table(
     output_table: Optional[Table] = None,
     store=None,
     registry=None,
-) -> pa.Table:
-    """Map a packaged model over one shard of ``table``.
+    flush_rows: int = 4096,
+) -> Optional[pa.Table]:
+    """Map a packaged model over one shard of ``table``, streaming.
 
     Returns the shard's rows with a ``prediction`` string column
     appended (≙ df.withColumn('prediction', udf('content')),
     P2/03:468-472). ``limit`` mirrors the notebook's ``limit(1000)``
-    smoke runs (P2/03:470). With ``output_table``, predictions are
-    appended there instead (multi-host pattern: every process writes
-    its own shard, shard (i,n) rows are disjoint by construction).
+    smoke runs (P2/03:470) and counts GLOBAL (pre-shard) rows. With
+    ``output_table``, prediction chunks are appended there in
+    ``flush_rows``-sized commits instead of being accumulated, and the
+    return value is ``None`` — the bounded-memory multi-host pattern
+    (every process writes its own shard; shard (i,n) rows are disjoint
+    by construction).
     """
     if isinstance(model, str):
         model = load_packaged_model(model, store=store, registry=registry)
-    cur, n_shards = shard
-    data = table.read()
-    if limit is not None:
-        data = data.slice(0, limit)
-    if n_shards > 1:
-        import numpy as np
 
-        idx = np.arange(data.num_rows)
-        data = data.take(pa.array(idx[idx % n_shards == cur]))
-    preds: List[str] = []
-    contents = data.column(content_col).to_pylist()
-    for s in range(0, len(contents), batch_size):
-        preds.extend(model.predict(contents[s : s + batch_size], batch_size))
-    out = data.append_column("prediction", pa.array(preds, pa.string()))
+    chunks: List[pa.Table] = []  # return path only
+    out_pending: List[pa.Table] = []  # output_table path only
+    out_pending_rows = 0
+    ensured = False
+
+    def flush_out() -> None:
+        nonlocal out_pending, out_pending_rows, ensured
+        if not out_pending:
+            return
+        out = pa.concat_tables(out_pending)
+        # ensure-then-append (not exists?-overwrite:-append) so two
+        # processes' first flushes can't both pick "overwrite" and one
+        # clobber the other's committed rows; latched after the first
+        # flush — the table is guaranteed to exist from then on
+        if not ensured:
+            output_table.ensure(out.schema)
+            ensured = True
+        output_table.write(out, mode="append")
+        out_pending, out_pending_rows = [], 0
+
+    def deliver(chunk: pa.Table) -> None:
+        nonlocal out_pending_rows
+        if output_table is not None:
+            out_pending.append(chunk)
+            out_pending_rows += chunk.num_rows
+            if out_pending_rows >= flush_rows:
+                flush_out()
+        else:
+            chunks.append(chunk)
+
+    # shard-thinned rows buffered until a full model batch is ready
+    ready: List[pa.Table] = []
+    n_ready = 0
+
+    def predict_ready(final: bool = False) -> None:
+        nonlocal ready, n_ready
+        take = n_ready if final else (n_ready // batch_size) * batch_size
+        if take == 0:
+            return
+        allt = pa.concat_tables(ready)
+        head, rest = allt.slice(0, take), allt.slice(take)
+        # by-name lookup raises KeyError on a missing/misspelled column
+        preds = model.predict(
+            head.column(content_col).to_pylist(), batch_size
+        )
+        deliver(
+            head.append_column("prediction", pa.array(preds, pa.string()))
+        )
+        ready = [rest] if rest.num_rows else []
+        n_ready = rest.num_rows
+
+    gidx = 0
+    for rb in table.iter_batches(batch_size=batch_size):
+        if limit is not None and gidx >= limit:
+            break
+        if limit is not None and gidx + rb.num_rows > limit:
+            rb = rb.slice(0, limit - gidx)
+        # shard by global row index — the same take_shard_rows
+        # assignment the training loader uses, applied per streamed batch
+        sub = take_shard_rows(rb, gidx, shard)
+        gidx += rb.num_rows
+        if sub is not None and sub.num_rows:
+            ready.append(pa.Table.from_batches([sub]))
+            n_ready += sub.num_rows
+            predict_ready()
+    predict_ready(final=True)
+
     if output_table is not None:
-        output_table.write(out, mode="append" if output_table.exists() else "overwrite")
-    return out
+        flush_out()
+        # empty shard: still create the table (0 rows, full schema) so
+        # readers never race a missing _latest; ensure() is atomic and
+        # never clobbers rows a sibling shard already appended
+        if not ensured:
+            output_table.ensure(
+                table.schema().append(pa.field("prediction", pa.string()))
+            )
+        return None
+    if not chunks:
+        schema = table.schema().append(pa.field("prediction", pa.string()))
+        return schema.empty_table()
+    return pa.concat_tables(chunks)

@@ -34,7 +34,8 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        k = min(max(int(top_k), 1), logits.shape[-1])
+        kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
         logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
@@ -66,6 +67,13 @@ def generate(
     b, p = prompt.shape
     if p < 1:
         raise ValueError("prompt must have at least one token")
+    if top_k is not None:
+        vocab = getattr(model, "vocab_size", None)
+        if top_k < 1 or (vocab is not None and top_k > vocab):
+            raise ValueError(
+                f"top_k={top_k} must be in [1, vocab_size"
+                f"{'=' + str(vocab) if vocab is not None else ''}]"
+            )
     max_len = p + max_new_tokens
 
     # cache struct at full length via eval_shape (no FLOPs), then zeros

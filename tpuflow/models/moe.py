@@ -15,8 +15,9 @@ MoE in the TPU-idiomatic GSPMD formulation:
   capacity are dropped (gate 0) — keeping every shape static for XLA
   (data-dependent gather/scatter would forbid MXU tiling);
 - the standard load-balance auxiliary loss (mean gate fraction ×
-  routed fraction per expert, scaled by E²·α) is returned alongside
-  the output so the caller can add it to the task loss.
+  routed fraction per expert, summed over experts and scaled by E·α)
+  is returned alongside the output so the caller can add it to the
+  task loss.
 
 Use ``ep_axis=None`` (default) for replicated experts (single device /
 DP); ``ep_axis='expert'`` when the mesh carries an expert axis.

@@ -15,6 +15,10 @@ Covers what the reference actually exercises of MLflow:
 
 Storage is a plain directory tree (JSON + JSONL): no server, works on
 shared filesystems, safe under the rank-0-only write discipline.
+Read-modify-write paths (params.json / meta.json) additionally take a
+per-run ``fcntl`` file lock, so concurrent writers to the SAME run —
+e.g. ParallelTrials threads all logging to a shared parent run — never
+lose updates.
 """
 
 from __future__ import annotations
@@ -25,6 +29,8 @@ import shutil
 import time
 import uuid
 from typing import Any, Dict, List, Optional
+
+from tpuflow.core.locks import dir_lock as _run_lock
 
 _DEFAULT_ROOT = os.environ.get("TPUFLOW_TRACKING_DIR", "./tpuflow_runs")
 
@@ -47,22 +53,22 @@ class Run:
         self.end("FAILED" if exc_type else "FINISHED")
 
     def end(self, status: str = "FINISHED") -> None:
-        meta = self.meta()
-        meta["status"] = status
-        meta["end_time"] = time.time()
-        self._write_meta(meta)
+        with _run_lock(self.path):
+            meta = self.meta()
+            meta["status"] = status
+            meta["end_time"] = time.time()
+            self._write_meta(meta)
 
     # -- logging ----------------------------------------------------------
 
     def log_param(self, key: str, value: Any) -> None:
-        params = self.params()
-        params[str(key)] = value
-        _atomic_json(os.path.join(self.path, "params.json"), params)
+        self.log_params({key: value})
 
     def log_params(self, params: Dict[str, Any]) -> None:
-        cur = self.params()
-        cur.update({str(k): v for k, v in params.items()})
-        _atomic_json(os.path.join(self.path, "params.json"), cur)
+        with _run_lock(self.path):
+            cur = self.params()
+            cur.update({str(k): v for k, v in params.items()})
+            _atomic_json(os.path.join(self.path, "params.json"), cur)
 
     def log_metric(self, key: str, value: float, step: int = 0) -> None:
         mdir = os.path.join(self.path, "metrics")
@@ -75,9 +81,10 @@ class Run:
             self.log_metric(k, v, step)
 
     def set_tag(self, key: str, value: str) -> None:
-        meta = self.meta()
-        meta.setdefault("tags", {})[str(key)] = str(value)
-        self._write_meta(meta)
+        with _run_lock(self.path):
+            meta = self.meta()
+            meta.setdefault("tags", {})[str(key)] = str(value)
+            self._write_meta(meta)
 
     def log_artifact(self, local_path: str, artifact_path: str = "") -> str:
         dst_dir = os.path.join(self.path, "artifacts", artifact_path)
@@ -154,11 +161,26 @@ class TrackingStore:
     ) -> Run:
         """Create a run — or RE-ATTACH when ``run_id`` exists already
         (the driver-creates/worker-logs pattern, P1/03:361-363)."""
-        if run_id is not None and os.path.isdir(self._run_path(run_id)):
+        # fast path requires meta.json, not just the directory — the dir
+        # appears before meta under the creation lock below, and a
+        # meta-less re-attach would break the first meta() read
+        if run_id is not None and os.path.exists(
+            os.path.join(self._run_path(run_id), "meta.json")
+        ):
             return Run(self, run_id)
         run_id = run_id or uuid.uuid4().hex[:16]
         path = self._run_path(run_id)
         os.makedirs(path, exist_ok=True)
+        with _run_lock(path):
+            # two workers racing start_run(run_id=X): first writer wins,
+            # the loser re-attaches (driver-creates/worker-logs pattern)
+            if os.path.exists(os.path.join(path, "meta.json")):
+                return Run(self, run_id)
+            return self._create_run(path, run_id, run_name, experiment,
+                                    parent_run_id)
+
+    def _create_run(self, path, run_id, run_name, experiment,
+                    parent_run_id) -> "Run":
         meta = {
             "run_id": run_id,
             "run_name": run_name or run_id,

@@ -111,20 +111,6 @@ def train_and_evaluate(
         num_decode_workers=cfg.data.num_decode_workers,
         prefetch=cfg.data.prefetch,
     )
-    train_ds = conv_t.make_dataset(
-        local_batch,
-        cur_shard=jax.process_index(),
-        shard_count=procs,
-        seed=cfg.train.seed,
-        **ds_kwargs,
-    )
-    val_ds = conv_v.make_dataset(
-        local_batch,
-        cur_shard=jax.process_index(),
-        shard_count=procs,
-        seed=cfg.train.seed,
-        **ds_kwargs,
-    )
 
     if model is None:
         model = build_model(
@@ -156,6 +142,25 @@ def train_and_evaluate(
             (cfg.data.img_height, cfg.data.img_width, cfg.data.img_channels)
         )
         initial_epoch = trainer.maybe_resume()
+    # Datasets are built AFTER resume resolution so a resumed run's
+    # stream starts at the (seed, initial_epoch) shuffle order instead
+    # of replaying epoch 0 — per-epoch orders are seeded by
+    # (seed, epoch) in Dataset._epoch_order.
+    train_ds = conv_t.make_dataset(
+        local_batch,
+        cur_shard=jax.process_index(),
+        shard_count=procs,
+        seed=cfg.train.seed,
+        start_epoch=initial_epoch,
+        **ds_kwargs,
+    )
+    val_ds = conv_v.make_dataset(
+        local_batch,
+        cur_shard=jax.process_index(),
+        shard_count=procs,
+        seed=cfg.train.seed,
+        **ds_kwargs,
+    )
     try:
         hist = trainer.fit(
             train_ds, val_ds=val_ds, callbacks=callbacks,
