@@ -3,27 +3,184 @@
 Measures the steady-state jitted train step of the MobileNetV2 transfer
 classifier (the reference's distributed config: 224x224x3, per-worker
 batch 256 — P1/03_model_training_distributed.py:81) on all local
-devices, and reports ONE JSON line:
+devices, and reports exactly ONE JSON line on stdout:
 
   {"metric": "train_images_per_sec_per_chip", "value": N,
-   "unit": "images/s/chip", "vs_baseline": R}
+   "unit": "images/s/chip", "vs_baseline": R, ...}
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 anchored to the driver's north star instead: measured MFU / 0.60 (the
 "≥60% MFU" target from BASELINE.json) — 1.0 means the target is met.
 FLOPs come from XLA cost analysis of the compiled step (obs.mfu).
 
-Extra diagnostics (stderr): MFU, step time, native-decode throughput.
+Robustness contract (the round-1 bench died in backend init and left
+no artifact): the JSON line is ALWAYS emitted — device-init failures
+are retried with backoff, a watchdog deadline fires a structured-error
+line if anything wedges, and every failure path exits 0 with an
+``error`` field instead of crashing. Diagnostics (MFU, step time,
+flash-attention kernel parity/timing, native-decode throughput) go to
+stderr and ride along in the JSON under ``diagnostics``.
+
 Usage: python bench.py [--smoke] [--batch N] [--steps N]
+       [--init-retries N] [--deadline SECONDS]
 """
 
 import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
+def emit(value: float, vs_baseline: float, error=None, diagnostics=None) -> None:
+    """Print the single stdout JSON line (at most once, thread-safe)."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        rec = {
+            "metric": "train_images_per_sec_per_chip",
+            "value": round(float(value), 2),
+            "unit": "images/s/chip",
+            "vs_baseline": round(float(vs_baseline), 4),
+        }
+        if error is not None:
+            rec["error"] = str(error)[:2000]
+        if diagnostics:
+            rec["diagnostics"] = diagnostics
+        print(json.dumps(rec), flush=True)
+
+
+def _init_devices(retries: int, backoff_s: float):
+    """jax.devices() with retry+backoff — TPU pool claims can transiently
+    fail UNAVAILABLE; each attempt itself may block for minutes."""
+    import jax
+
+    last = None
+    for attempt in range(retries):
+        t0 = time.time()
+        try:
+            devs = jax.devices()
+            print(
+                f"# backend up: {len(devs)}x {devs[0].device_kind} "
+                f"(attempt {attempt + 1}, {time.time() - t0:.0f}s)",
+                file=sys.stderr, flush=True,
+            )
+            return devs, None
+        except Exception as e:  # UNAVAILABLE / RuntimeError from PJRT
+            last = e
+            print(
+                f"# device init attempt {attempt + 1}/{retries} failed "
+                f"after {time.time() - t0:.0f}s: {e}",
+                file=sys.stderr, flush=True,
+            )
+            if attempt + 1 < retries:
+                time.sleep(backoff_s * (attempt + 1))
+    return None, last
+
+
+def _attention_diag(diag: dict, small: bool = False) -> None:
+    """Compiled flash-attention parity + timing vs the pure-jnp oracle.
+
+    Proves the Mosaic kernel path on real hardware (VERDICT round-1:
+    the Pallas kernels had only ever run in interpret mode). Never
+    raises — failures land in diag['flash_attention'] as text.
+    ``small`` shrinks shapes/iterations for interpret-mode smoke runs."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from tpuflow.core.hw import is_tpu_backend
+        from tpuflow.ops.attention import flash_attention, mha_reference
+
+        interpret = not is_tpu_backend()
+        b, h, s, d = (1, 2, 256, 64) if small else (4, 8, 1024, 128)
+        ks = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+
+        flash = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            interpret=interpret)
+        )
+        ref = jax.jit(lambda q, k, v: mha_reference(q, k, v, causal=True))
+        o_f = jax.block_until_ready(flash(q, k, v))
+        o_r = jax.block_until_ready(ref(q, k, v))
+        fwd_err = float(
+            jnp.max(jnp.abs(o_f.astype(jnp.float32) - o_r.astype(jnp.float32)))
+        )
+
+        def loss_flash(q):
+            return flash_attention(
+                q, k, v, causal=True, interpret=interpret
+            ).astype(jnp.float32).sum()
+
+        def loss_ref(q):
+            return mha_reference(q, k, v, causal=True).astype(jnp.float32).sum()
+
+        grad_fn = jax.jit(jax.grad(loss_flash))  # reused for timing below
+        g_f = jax.block_until_ready(grad_fn(q))
+        g_r = jax.block_until_ready(jax.jit(jax.grad(loss_ref))(q))
+        bwd_err = float(
+            jnp.max(jnp.abs(g_f.astype(jnp.float32) - g_r.astype(jnp.float32)))
+        )
+
+        steps = 3 if small else 20
+        t0 = time.time()
+        for _ in range(steps):
+            o_f = flash(q, k, v)
+        jax.block_until_ready(o_f)
+        fwd_ms = (time.time() - t0) / steps * 1e3
+        t0 = time.time()
+        for _ in range(steps):
+            g_f = grad_fn(q)
+        jax.block_until_ready(g_f)
+        fwdbwd_ms = (time.time() - t0) / steps * 1e3
+        # attention FLOPs: causal ⇒ ~half of 4*b*h*s^2*d (fwd)
+        att_fl = 2 * b * h * s * s * d  # qk^T + av, halved for causal
+        diag["flash_attention"] = {
+            "compiled": not interpret,
+            "shape": f"b{b}h{h}s{s}d{d}",
+            "fwd_max_abs_err": round(fwd_err, 5),
+            "bwd_max_abs_err": round(bwd_err, 5),
+            "fwd_ms": round(fwd_ms, 3),
+            "fwd_bwd_ms": round(fwdbwd_ms, 3),
+            "fwd_tflops": round(att_fl / (fwd_ms * 1e-3) / 1e12, 2),
+        }
+        print(f"# flash-attn diag: {diag['flash_attention']}",
+              file=sys.stderr, flush=True)
+    except Exception as e:
+        diag["flash_attention"] = f"failed: {e}"
+        print(f"# flash-attn diag failed: {e}", file=sys.stderr, flush=True)
+
+
+def _decode_diag(hw: int) -> float:
+    try:
+        import io
+
+        import numpy as np
+        from PIL import Image
+
+        from tpuflow.native import decode_resize_batch
+
+        arr = (np.random.default_rng(0).random((256, 256, 3)) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+        jpegs = [buf.getvalue()] * 128
+        decode_resize_batch(jpegs[:8], hw, hw)  # warm
+        t0 = time.time()
+        decode_resize_batch(jpegs, hw, hw, num_threads=os.cpu_count() or 1)
+        return len(jpegs) / (time.time() - t0)
+    except Exception:
+        return 0.0
 
 
 def main() -> int:
@@ -33,10 +190,37 @@ def main() -> int:
     p.add_argument("--batch", type=int, default=None, help="per-chip batch")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--init-retries", type=int, default=3)
+    p.add_argument("--init-backoff", type=float, default=30.0)
+    p.add_argument("--deadline", type=float, default=2400.0,
+                   help="watchdog: emit an error JSON line and exit if "
+                        "the bench has not finished by then")
+    p.add_argument("--no-attn-diag", action="store_true")
     args = p.parse_args()
 
     if args.smoke:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # FORCE cpu — the ambient env may pin JAX_PLATFORMS to a TPU
+        # plugin platform; setdefault would leave the smoke run trying
+        # (and possibly hanging) to claim real hardware
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    def watchdog():
+        time.sleep(args.deadline)
+        emit(0.0, 0.0, error=f"watchdog: deadline {args.deadline}s exceeded "
+                            f"(backend init or compile wedged)")
+        sys.stdout.flush()
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
+    try:
+        return _bench(args)
+    except BaseException as e:  # never exit without the JSON line
+        emit(0.0, 0.0, error=f"{type(e).__name__}: {e}")
+        return 0
+
+
+def _bench(args) -> int:
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -50,7 +234,12 @@ def main() -> int:
     from tpuflow.parallel.mesh import MeshSpec, build_mesh
     from tpuflow.train import Trainer
 
-    devices = jax.devices()
+    devices, err = _init_devices(args.init_retries, args.init_backoff)
+    if devices is None:
+        emit(0.0, 0.0, error=f"device init failed after "
+                             f"{args.init_retries} attempts: {err}")
+        return 0
+
     n_chips = len(devices)
     if args.smoke:
         hw, width, batch = 64, 0.25, args.batch or 8
@@ -96,48 +285,31 @@ def main() -> int:
     peak = device_peak_flops(devices[0])
     mfu_val = (flops / dt) / (n_chips * peak) if flops else 0.0
 
-    # decode-plane diagnostic (not part of the headline number)
-    decode_rate = _decode_diag(hw)
+    diag = {
+        "device_kind": devices[0].device_kind,
+        "n_chips": n_chips,
+        "image_hw": hw,
+        "batch_per_chip": batch,
+        "step_ms": round(dt * 1e3, 3),
+        "compile_s": round(compile_s, 1),
+        "flops_per_step": flops,
+        "mfu": round(mfu_val, 4),
+        "peak_flops_assumed": peak,
+        "decode_img_per_s": round(_decode_diag(hw), 0),
+        "loss": round(float(m["loss"]), 4),
+    }
+    if not args.no_attn_diag:
+        _attention_diag(diag, small=args.smoke)
 
     print(
         f"# devices={n_chips} ({devices[0].device_kind}) hw={hw} width={width} "
         f"batch/chip={batch} step={dt*1e3:.2f}ms compile={compile_s:.1f}s "
         f"flops/step={flops:.3e} MFU={mfu_val*100:.1f}% "
-        f"decode={decode_rate:.0f} img/s loss={float(m['loss']):.4f}",
-        file=sys.stderr,
+        f"decode={diag['decode_img_per_s']:.0f} img/s loss={diag['loss']:.4f}",
+        file=sys.stderr, flush=True,
     )
-    print(
-        json.dumps(
-            {
-                "metric": "train_images_per_sec_per_chip",
-                "value": round(img_per_sec_chip, 2),
-                "unit": "images/s/chip",
-                "vs_baseline": round(mfu_val / 0.60, 4),
-            }
-        )
-    )
+    emit(img_per_sec_chip, mfu_val / 0.60, diagnostics=diag)
     return 0
-
-
-def _decode_diag(hw: int) -> float:
-    try:
-        import io
-
-        import numpy as np
-        from PIL import Image
-
-        from tpuflow.native import decode_resize_batch
-
-        arr = (np.random.default_rng(0).random((256, 256, 3)) * 255).astype(np.uint8)
-        buf = io.BytesIO()
-        Image.fromarray(arr).save(buf, format="JPEG", quality=90)
-        jpegs = [buf.getvalue()] * 128
-        decode_resize_batch(jpegs[:8], hw, hw)  # warm
-        t0 = time.time()
-        decode_resize_batch(jpegs, hw, hw, num_threads=os.cpu_count() or 1)
-        return len(jpegs) / (time.time() - t0)
-    except Exception:
-        return 0.0
 
 
 if __name__ == "__main__":

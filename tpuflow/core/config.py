@@ -27,7 +27,15 @@ class DataConfig:
     img_channels: int = 3
     batch_size: int = 32
     cache_dir: str = "./loader_cache"
+    # streaming=True reads row groups through a bounded shuffle buffer
+    # (beyond-memory tables, ≙ Petastorm's reason to exist, P1/03:32-34);
+    # default keeps the in-memory fast path for workshop-scale data
+    streaming: bool = False
     shuffle_buffer: int = 2048
+    # None = auto: reuse decode output buffers on TPU backends (halves
+    # allocator churn in the infeed); forced off on CPU where JAX may
+    # alias numpy arrays zero-copy into device buffers
+    reuse_decode_buffers: "bool | None" = None
     num_decode_workers: int = 8
     prefetch: int = 2
     sample_fraction: float = 1.0
@@ -43,6 +51,9 @@ class ModelConfig:
     width_mult: float = 1.0
     freeze_backbone: bool = True
     dtype: str = "bfloat16"  # compute dtype; params stay float32
+    # converted pretrained-backbone checkpoint path (models/pretrained
+    # canonical npz) — ≙ Keras weights='imagenet' (P1/02:164-169)
+    weights: "str | None" = None
 
 
 @dataclass

@@ -1,0 +1,34 @@
+"""Hardware/backend detection helpers.
+
+TPU access can arrive through out-of-tree PJRT plugins whose platform
+name is NOT ``"tpu"`` (tunneled/relayed backends), so feature gates
+keyed on ``jax.default_backend() == "tpu"`` would silently fall back
+to interpret/emulation paths on real hardware. Detection here keys on
+the device kind as well as the platform name.
+"""
+
+from __future__ import annotations
+
+
+def is_tpu_backend() -> bool:
+    """True when the default JAX backend drives real TPU hardware.
+
+    Used to pick compiled Mosaic kernels (Pallas ``interpret=False``)
+    vs the interpreter: platform name ``tpu`` OR a device kind that
+    names a TPU generation (covers PJRT plugins with custom platform
+    names fronting real chips).
+    """
+    import re
+
+    import jax
+
+    try:
+        if jax.default_backend() == "tpu":
+            return True
+        d = jax.devices()[0]
+    except Exception:
+        return False
+    kind = (getattr(d, "device_kind", "") or "").lower()
+    # "tpu v4" / "TPU v5 lite" / bare generation tags like "v5e" — but
+    # NOT arbitrary v-prefixed kinds (e.g. "vgpu"): require v<digit>
+    return d.platform == "tpu" or "tpu" in kind or bool(re.match(r"v\d", kind))

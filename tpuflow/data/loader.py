@@ -12,8 +12,29 @@ P1/03_model_training_distributed.py:137-144,332-337). Semantics kept:
 - cache-dir materialization + ``delete()`` cleanup (P1/03:425-426);
 - drop-remainder static batch shapes (XLA requires static shapes).
 
+Two residency modes:
+
+- **in-memory** (default): the shard's compressed JPEG bytes are
+  materialized once — the fast path for workshop-scale data;
+- **streaming** (``streaming=True``): Petastorm's actual reason to
+  exist — "data too big for single-machine memory" (P1/03:32-34,
+  197-205). Only Parquet METADATA is read at init; per epoch, row
+  groups are visited in a seeded shuffled order on a reader thread and
+  rows pass through a bounded shuffle buffer, so host memory is
+  O(shuffle_buffer + one row group) regardless of table size. Shuffle
+  is deterministic given (seed, epoch, shard) in both modes (orders
+  differ between modes).
+
 The decode hot path runs in the native C++ plane (tpuflow.native) on a
-background producer thread, so host decode overlaps device compute.
+background producer thread — host decode overlaps device compute — and
+with ``reuse_buffers=True`` writes into a small ring of reused output
+buffers (no per-batch ~38MB allocation at 256x224²; safe when the
+consumer copies batches to an accelerator promptly, because at most
+``prefetch`` batches are in flight and each buffer's reuse period is
+``prefetch + 3``). Reuse stays OFF by default: on the CPU backend JAX
+can alias numpy arrays zero-copy into device buffers, where reuse
+would corrupt in-flight batches — the TPU training path turns it on
+(workflows auto-enables it on TPU backends).
 """
 
 from __future__ import annotations
@@ -23,7 +44,7 @@ import queue
 import threading
 from queue import Empty as _QueueEmpty
 import uuid
-from typing import Dict, Iterator, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -34,8 +55,8 @@ from tpuflow.native import decode_resize_batch
 
 
 def take_shard_rows(
-    rb: pa.RecordBatch, gidx: int, shard: Tuple[int, int]
-) -> Optional[pa.RecordBatch]:
+    rb: "pa.RecordBatch | pa.Table", gidx: int, shard: Tuple[int, int]
+) -> "Optional[pa.RecordBatch | pa.Table]":
     """Rows of ``rb`` whose GLOBAL row index (``gidx`` + local position)
     belongs to shard ``(cur, n)`` under round-robin (modulo) assignment.
 
@@ -86,6 +107,9 @@ class Dataset:
         label_col: str = "label_idx",
         drop_remainder: bool = True,
         start_epoch: int = 0,
+        streaming: bool = False,
+        shuffle_buffer: int = 2048,
+        reuse_buffers: bool = False,
     ):
         self.files = list(files)
         self.batch_size = batch_size
@@ -107,31 +131,51 @@ class Dataset:
         # to its initial_epoch and sees the epochs it has NOT trained on
         # instead of replaying the stream from epoch 0
         self.start_epoch = start_epoch
-        # Load shard rows once: JPEG bytes are small (compressed); for the
-        # workshop-scale datasets this is the fast path. Row-group
-        # streaming would slot in here for beyond-memory tables. Only this
-        # shard's rows are materialized — record batches are sliced with a
-        # mask before any Python-object conversion.
+        self.streaming = streaming
+        self.shuffle_buffer = max(1, shuffle_buffer)
+        self.reuse_buffers = reuse_buffers
+        # observability for the bounded-memory guarantee (tests)
+        self.peak_buffered_rows = 0
+
         self._contents: list = []
         self._labels: list = []
+        # (file, row_group_index, global_start_row, num_rows)
+        self._rg_index: List[Tuple[str, int, int, int]] = []
         gidx = 0
-        for f in self.files:
-            pf = pq.ParquetFile(f)
-            for rb in pf.iter_batches(batch_size=1024, columns=[content_col, label_col]):
-                sub = take_shard_rows(
-                    rb, gidx, (self.cur_shard, self.shard_count)
-                )
-                if sub is not None:
-                    self._contents.extend(sub.column(0).to_pylist())
-                    self._labels.extend(int(x) for x in sub.column(1).to_pylist())
-                gidx += rb.num_rows
+        if streaming:
+            # metadata-only scan: row counts per row group, zero data read
+            for f in self.files:
+                md = pq.ParquetFile(f).metadata
+                for rg in range(md.num_row_groups):
+                    n = md.row_group(rg).num_rows
+                    self._rg_index.append((f, rg, gidx, n))
+                    gidx += n
+        else:
+            # Load shard rows once: JPEG bytes are small (compressed);
+            # for workshop-scale datasets this is the fast path. Only
+            # this shard's rows are materialized — record batches are
+            # sliced with a mask before any Python-object conversion.
+            for f in self.files:
+                pf = pq.ParquetFile(f)
+                for rb in pf.iter_batches(
+                    batch_size=1024, columns=[content_col, label_col]
+                ):
+                    sub = take_shard_rows(
+                        rb, gidx, (self.cur_shard, self.shard_count)
+                    )
+                    if sub is not None:
+                        self._contents.extend(sub.column(0).to_pylist())
+                        self._labels.extend(
+                            int(x) for x in sub.column(1).to_pylist()
+                        )
+                    gidx += rb.num_rows
         self._total_rows = gidx
-        if self.infinite and len(self._contents) < (
+        if self.infinite and len(self) < (
             self.batch_size if self.drop_remainder else 1
         ):
             raise ValueError(
                 f"shard {self.cur_shard}/{self.shard_count} has "
-                f"{len(self._contents)} rows — fewer than batch_size="
+                f"{len(self)} rows — fewer than batch_size="
                 f"{self.batch_size}; an infinite stream would produce no "
                 f"batches (deadlock). Lower batch_size/shard_count or "
                 f"repartition the table (≙ reference P1/03:109-111)."
@@ -139,7 +183,11 @@ class Dataset:
 
     def __len__(self) -> int:
         """Number of examples in THIS shard."""
-        return len(self._contents)
+        if not self.streaming:
+            return len(self._contents)
+        # arithmetic count of g in [0, total) with g % n == cur
+        total, cur, n = self._total_rows, self.cur_shard, self.shard_count
+        return (total - cur + n - 1) // n if total > cur else 0
 
     @property
     def total_rows(self) -> int:
@@ -155,6 +203,8 @@ class Dataset:
         exactly as Petastorm's num_epochs=None does (P1/03:197-200)."""
         return max(1, self._total_rows // (self.batch_size * self.shard_count))
 
+    # ---- row iteration (per residency mode) ------------------------------
+
     def _epoch_order(self, epoch: int) -> np.ndarray:
         n = len(self._contents)
         idx = np.arange(n)
@@ -162,6 +212,132 @@ class Dataset:
             rng = np.random.default_rng((self.seed, epoch, self.cur_shard))
             rng.shuffle(idx)
         return idx
+
+    def _iter_rows_mem(self, epoch: int, stop: threading.Event):
+        order = self._epoch_order(epoch)
+        for i in order:
+            if stop.is_set():
+                return
+            yield self._contents[i], self._labels[i]
+
+    def _iter_rows_stream(self, epoch: int, stop: threading.Event):
+        """Row-group-shuffled, shuffle-buffered row stream.
+
+        A reader thread pulls row groups (in a (seed, epoch)-seeded
+        order) and shard-filters them; this thread drains them through
+        a bounded reservoir popped at seeded-random positions — the
+        Petastorm recipe: approximate global shuffle, exact per-epoch
+        determinism, memory O(shuffle_buffer + row group).
+        """
+        rng = np.random.default_rng(
+            (self.seed, epoch, self.cur_shard, 0xB0F)
+        )
+        rg_order = np.arange(len(self._rg_index))
+        if self.shuffle:
+            rng.shuffle(rg_order)
+
+        rg_q: "queue.Queue" = queue.Queue(maxsize=2)
+        done = threading.Event()  # consumer finished/abandoned this epoch
+
+        def halted() -> bool:
+            return stop.is_set() or done.is_set()
+
+        def rput(item) -> bool:
+            while not halted():
+                try:
+                    rg_q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def read_rgs():
+            pf_cache: Dict[str, pq.ParquetFile] = {}
+            try:
+                for rgi in rg_order:
+                    if halted():
+                        return
+                    f, rg, g0, _n = self._rg_index[rgi]
+                    pf = pf_cache.get(f)
+                    if pf is None:
+                        pf = pf_cache[f] = pq.ParquetFile(f)
+                    tbl = pf.read_row_group(
+                        rg, columns=[self.content_col, self.label_col]
+                    )
+                    sub = take_shard_rows(
+                        tbl, g0, (self.cur_shard, self.shard_count)
+                    )
+                    rows = []
+                    if sub is not None:
+                        rows = list(
+                            zip(
+                                sub.column(0).to_pylist(),
+                                (int(x) for x in sub.column(1).to_pylist()),
+                            )
+                        )
+                    if not rput(rows):
+                        return
+            except BaseException as e:
+                rput(_StreamError(e))
+                return
+            finally:
+                rput(None)  # sentinel (skipped only when halted)
+
+        reader = threading.Thread(target=read_rgs, daemon=True)
+        reader.start()
+        buf: list = []
+        try:
+            draining = False
+            while True:
+                if not draining:
+                    if stop.is_set():
+                        return
+                    try:
+                        item = rg_q.get(timeout=0.1)
+                    except _QueueEmpty:
+                        continue
+                    if item is None:
+                        draining = True
+                        continue
+                    if isinstance(item, _StreamError):
+                        raise item.exc
+                    if not self.shuffle:
+                        # no reservoir needed: rows pass through in
+                        # exact table order (rg_order is unshuffled too)
+                        for row in item:
+                            yield row
+                        continue
+                    buf.extend(item)
+                    if len(buf) > self.peak_buffered_rows:
+                        self.peak_buffered_rows = len(buf)
+                    while len(buf) >= self.shuffle_buffer:
+                        j = int(rng.integers(len(buf)))
+                        buf[j], buf[-1] = buf[-1], buf[j]
+                        yield buf.pop()
+                else:
+                    if not buf:
+                        return
+                    if stop.is_set():
+                        return
+                    j = int(rng.integers(len(buf)))
+                    buf[j], buf[-1] = buf[-1], buf[j]
+                    yield buf.pop()
+        finally:
+            # retire the reader: it observes ``done`` inside rput/halted
+            # within 0.1s whether it is blocked on a full queue or mid-read
+            done.set()
+
+    # ---- batch production ------------------------------------------------
+
+    def _decode_out(self, pool: List[Optional[np.ndarray]], slot: int):
+        if not self.reuse_buffers:
+            return None
+        if pool[slot] is None:
+            pool[slot] = np.empty(
+                (self.batch_size, self.img_height, self.img_width, 3),
+                np.uint8,
+            )
+        return pool[slot]
 
     def _produce(self, out_q: "queue.Queue", stop: threading.Event) -> None:
         def put(item) -> bool:
@@ -177,24 +353,59 @@ class Dataset:
 
         epoch = self.start_epoch
         bs = self.batch_size
+        # ring of reused decode buffers: at most ``prefetch`` batches sit
+        # in the queue + 1 at the consumer, so a period of prefetch + 3
+        # never overwrites a batch still in flight (the extra slot is
+        # headroom for an async H2D transfer still reading the oldest)
+        pool: List[Optional[np.ndarray]] = [None] * (self.prefetch + 3)
+        slot = 0
         try:
             while not stop.is_set():
-                order = self._epoch_order(epoch)
-                n = len(order)
-                end = (n // bs) * bs if self.drop_remainder else n
-                for start in range(0, end, bs):
-                    sel = order[start : start + bs]
-                    jpegs = [self._contents[i] for i in sel]
+                rows = (
+                    self._iter_rows_stream(epoch, stop)
+                    if self.streaming
+                    else self._iter_rows_mem(epoch, stop)
+                )
+                jpegs: list = []
+                labels: list = []
+                emitted = 0
+                # cap batches when drop_remainder so every epoch emits
+                # exactly len(self)//bs batches in BOTH residency modes
+                max_batches = len(self) // bs if self.drop_remainder else None
+                for content, label in rows:
+                    jpegs.append(content)
+                    labels.append(label)
+                    if len(jpegs) == bs:
+                        out = self._decode_out(pool, slot)
+                        slot = (slot + 1) % len(pool)
+                        images, _ok = decode_resize_batch(
+                            jpegs,
+                            self.img_height,
+                            self.img_width,
+                            num_threads=self.num_decode_workers,
+                            out=out,
+                        )
+                        if not put(
+                            {
+                                "image": images,
+                                "label": np.asarray(labels, np.int32),
+                            }
+                        ):
+                            return
+                        jpegs, labels = [], []
+                        emitted += 1
+                        if max_batches is not None and emitted >= max_batches:
+                            break
+                if jpegs and not self.drop_remainder and not stop.is_set():
                     images, _ok = decode_resize_batch(
                         jpegs,
                         self.img_height,
                         self.img_width,
                         num_threads=self.num_decode_workers,
                     )
-                    labels = np.asarray(
-                        [self._labels[i] for i in sel], dtype=np.int32
-                    )
-                    if not put({"image": images, "label": labels}):
+                    if not put(
+                        {"image": images, "label": np.asarray(labels, np.int32)}
+                    ):
                         return
                 epoch += 1
                 if not self.infinite:

@@ -5,6 +5,11 @@ from tpuflow.models.classifier import (  # noqa: F401
     backbone_param_mask,
 )
 from tpuflow.models.preprocess import preprocess_input, preprocess  # noqa: F401
+from tpuflow.models.pretrained import (  # noqa: F401
+    load_backbone_npz,
+    load_backbone_variables,
+    save_backbone_npz,
+)
 from tpuflow.models.vit import ViTClassifier, build_vit  # noqa: F401
 from tpuflow.models.transformer import (  # noqa: F401
     TransformerLM,

@@ -15,7 +15,7 @@ called out in SURVEY.md §7 "hard parts".
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -31,6 +31,10 @@ class TransferClassifier(nn.Module):
     width_mult: float = 1.0
     freeze_backbone: bool = True
     dtype: Any = jnp.bfloat16
+    # path to a converted backbone checkpoint (models/pretrained.py
+    # canonical npz); applied by Trainer.init_state after module init —
+    # ≙ the Keras default weights='imagenet' (P1/02:164-169)
+    weights: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -61,10 +65,18 @@ def build_model(
     width_mult: float = 1.0,
     freeze_backbone: bool = True,
     dtype: Any = jnp.bfloat16,
+    weights: Optional[str] = None,
 ) -> TransferClassifier:
     """≙ build_model(img_height, img_width, img_channels, num_classes)
     (P1/02:159-178). Image size/channels are carried by the data, not the
-    module (Flax modules are shape-polymorphic until init)."""
+    module (Flax modules are shape-polymorphic until init).
+
+    ``weights``: path to a converted pretrained-backbone checkpoint
+    (``tpuflow.models.pretrained`` canonical npz) — the ImageNet
+    transfer-learning story (Keras ships weights='imagenet' by default,
+    P1/02:164-169). The backbone loads from the file at init; the head
+    always initializes fresh.
+    """
     del img_height, img_width, img_channels  # API parity; shapes from data
     return TransferClassifier(
         num_classes=num_classes,
@@ -72,6 +84,7 @@ def build_model(
         width_mult=width_mult,
         freeze_backbone=freeze_backbone,
         dtype=dtype,
+        weights=weights,
     )
 
 

@@ -9,8 +9,11 @@ the MobileNetV2 paper (Sandler et al. 2018): stem conv(32,s2) →
 inverted-residual stages (t,c,n,s) = (1,16,1,1)(6,24,2,2)(6,32,3,2)
 (6,64,4,2)(6,96,3,1)(6,160,3,2)(6,320,1,1) → conv(1280).
 
-Weights initialize randomly; ``tpuflow.models.pretrained`` can load a
-converted checkpoint when one is available (no network access here).
+Weights initialize randomly by default; ``tpuflow.models.pretrained``
+loads a converted ImageNet checkpoint (torchvision ``.pth`` or Keras
+``.h5``, converted offline to the canonical npz) via
+``build_model(weights=path)`` — the reference's transfer-learning
+story (Keras default weights='imagenet', P1/02:164-169).
 """
 
 from __future__ import annotations

@@ -1,0 +1,276 @@
+"""Pretrained MobileNetV2 backbone weights (C6).
+
+The reference's transfer model starts from an ImageNet-PRETRAINED
+backbone — ``tf.keras.applications.MobileNetV2(include_top=False,
+...)`` ships ``weights='imagenet'`` by default (reference
+P1/02_model_training_single_node.py:164-169) — and freezes it. Freezing
+a randomly initialized backbone is semantically empty, so this module
+makes the pretrained story real without any network access:
+
+- **Canonical checkpoint format**: a ``.npz`` whose keys are
+  '/'-joined, BACKBONE-RELATIVE Flax paths —
+  ``params/stem/conv/kernel``, ``batch_stats/block_1_0/expand/bn/mean``
+  — so the file is independent of the wrapper model that embeds the
+  backbone.
+- **Offline converters** from the two common public sources:
+  torchvision's ``mobilenet_v2`` state_dict (``.pth``, loaded with
+  ``torch.load``) and Keras's ``mobilenet_v2`` weight file (``.h5``,
+  read with h5py). Run where those files exist:
+  ``python -m tpuflow.models.pretrained torch_or_h5_file out.npz``.
+- **Loader** that merges the file into an initialized model's
+  variables with full shape verification (every file tensor must land
+  somewhere; every backbone tensor must be covered — loud failure
+  beats silently-random weights).
+
+Wired through ``build_model(weights=...)`` → ``Trainer.init_state``
+(the head stays freshly initialized; only the backbone is replaced).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+SEP = "/"
+
+# (expand t, channels c, repeats n) — mirrors mobilenet_v2.py settings;
+# used to enumerate block names in source-checkpoint order
+_SETTINGS = ((1, 16, 1), (6, 24, 2), (6, 32, 3), (6, 64, 4), (6, 96, 3),
+             (6, 160, 3), (6, 320, 1))
+
+
+def _block_names():
+    for si, (_t, _c, n) in enumerate(_SETTINGS):
+        for i in range(n):
+            yield f"block_{si}_{i}", _t, si, i
+
+
+# ---------------------------------------------------------------------------
+# canonical npz format
+# ---------------------------------------------------------------------------
+
+
+def flatten_tree(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(flatten_tree(v, f"{prefix}{k}{SEP}"))
+    else:
+        out[prefix[: -len(SEP)]] = np.asarray(tree)
+    return out
+
+
+def unflatten_tree(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    root: Dict[str, Any] = {}
+    for key, val in flat.items():
+        parts = key.split(SEP)
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return root
+
+
+def save_backbone_npz(path: str, params: Dict, batch_stats: Dict) -> None:
+    """Save a backbone's params + BN statistics in the canonical format."""
+    flat = flatten_tree({"params": params, "batch_stats": batch_stats})
+    np.savez(path, **flat)
+
+
+def load_backbone_npz(path: str) -> Tuple[Dict, Dict]:
+    """Load canonical npz → (params_tree, batch_stats_tree)."""
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    tree = unflatten_tree(flat)
+    return tree.get("params", {}), tree.get("batch_stats", {})
+
+
+def load_backbone_variables(
+    variables: Dict,
+    path: str,
+    backbone: str = "backbone",
+    dtype: Optional[Any] = None,
+) -> Dict:
+    """Merge a canonical checkpoint into a model's initialized variables.
+
+    ``variables`` is the output of ``model.init`` (with the backbone as
+    submodule ``backbone``). Every file tensor must match an existing
+    leaf (same path, same shape) and every backbone leaf must be
+    covered — asymmetries raise with the offending paths listed.
+    Returns a NEW variables dict; the head is untouched.
+    """
+    import jax
+
+    p_new, bs_new = load_backbone_npz(path)
+    loaded = flatten_tree({"params": p_new, "batch_stats": bs_new})
+
+    target = flatten_tree(
+        {
+            "params": variables["params"].get(backbone, {}),
+            "batch_stats": variables.get("batch_stats", {}).get(backbone, {}),
+        }
+    )
+    missing = sorted(set(target) - set(loaded))
+    unexpected = sorted(set(loaded) - set(target))
+    if missing or unexpected:
+        raise ValueError(
+            f"checkpoint {path!r} does not cover the backbone: "
+            f"missing={missing[:8]}{'...' if len(missing) > 8 else ''} "
+            f"unexpected={unexpected[:8]}{'...' if len(unexpected) > 8 else ''} "
+            f"(width_mult mismatch?)"
+        )
+    bad = [
+        k for k in target if tuple(loaded[k].shape) != tuple(target[k].shape)
+    ]
+    if bad:
+        detail = ", ".join(
+            f"{k}: file{loaded[k].shape} != model{target[k].shape}"
+            for k in bad[:8]
+        )
+        raise ValueError(f"checkpoint shape mismatch: {detail}")
+
+    def cast(x, like):
+        want = dtype or np.asarray(like).dtype
+        return np.asarray(x).astype(want)
+
+    out = jax.tree.map(lambda x: x, variables)  # shallow-ish copy
+    out["params"] = dict(out["params"])
+    out["params"][backbone] = jax.tree.map(
+        cast, p_new, variables["params"][backbone]
+    )
+    if bs_new:
+        out["batch_stats"] = dict(out.get("batch_stats", {}))
+        out["batch_stats"][backbone] = jax.tree.map(
+            cast, bs_new, variables["batch_stats"][backbone]
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# converters (run offline where the source files exist)
+# ---------------------------------------------------------------------------
+
+
+def convert_torchvision_state_dict(sd: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """torchvision ``mobilenet_v2`` state_dict → canonical flat dict.
+
+    Layout conversions: conv (out,in,kh,kw) → (kh,kw,in,out); depthwise
+    (ch,1,kh,kw) → (kh,kw,1,ch) (same transpose); BatchNorm
+    weight/bias/running_mean/running_var → scale/bias/mean/var.
+    """
+
+    def arr(name):
+        t = sd[name]
+        return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+    out: Dict[str, np.ndarray] = {}
+
+    def conv_bn(dst: str, conv_key: str, bn_key: str) -> None:
+        w = arr(f"{conv_key}.weight")
+        out[f"params/{dst}/conv/kernel"] = np.transpose(w, (2, 3, 1, 0))
+        out[f"params/{dst}/bn/scale"] = arr(f"{bn_key}.weight")
+        out[f"params/{dst}/bn/bias"] = arr(f"{bn_key}.bias")
+        out[f"batch_stats/{dst}/bn/mean"] = arr(f"{bn_key}.running_mean")
+        out[f"batch_stats/{dst}/bn/var"] = arr(f"{bn_key}.running_var")
+
+    conv_bn("stem", "features.0.0", "features.0.1")
+    fi = 1
+    for name, t, _si, _i in _block_names():
+        base = f"features.{fi}"
+        if t != 1:
+            conv_bn(f"{name}/expand", f"{base}.conv.0.0", f"{base}.conv.0.1")
+            conv_bn(f"{name}/depthwise", f"{base}.conv.1.0", f"{base}.conv.1.1")
+            conv_bn(f"{name}/project", f"{base}.conv.2", f"{base}.conv.3")
+        else:
+            conv_bn(f"{name}/depthwise", f"{base}.conv.0.0", f"{base}.conv.0.1")
+            conv_bn(f"{name}/project", f"{base}.conv.1", f"{base}.conv.2")
+        fi += 1
+    conv_bn("head_conv", "features.18.0", "features.18.1")
+    return out
+
+
+# Keras tf.keras.applications.MobileNetV2 layer names, in our block order
+def _keras_layer_names():
+    yield "stem", "Conv1", "bn_Conv1", None
+    for name, t, si, i in _block_names():
+        k = 0 if (si == 0 and i == 0) else None
+        if k == 0:  # first block is named expanded_conv_* (no index)
+            yield f"{name}/depthwise", "expanded_conv_depthwise", \
+                "expanded_conv_depthwise_BN", "depthwise"
+            yield f"{name}/project", "expanded_conv_project", \
+                "expanded_conv_project_BN", None
+        else:
+            idx = sum(n for _t2, _c2, n in _SETTINGS[:si]) + i  # 1..16
+            if t != 1:
+                yield f"{name}/expand", f"block_{idx}_expand", \
+                    f"block_{idx}_expand_BN", None
+            yield f"{name}/depthwise", f"block_{idx}_depthwise", \
+                f"block_{idx}_depthwise_BN", "depthwise"
+            yield f"{name}/project", f"block_{idx}_project", \
+                f"block_{idx}_project_BN", None
+    yield "head_conv", "Conv_1", "Conv_1_bn", None
+
+
+def convert_keras_h5(path: str) -> Dict[str, np.ndarray]:
+    """Keras MobileNetV2 ``.h5`` weight file → canonical flat dict.
+
+    Keras conv kernels are already (kh,kw,in,out); depthwise kernels
+    (kh,kw,ch,1) transpose to (kh,kw,1,ch). BN order:
+    gamma/beta/moving_mean/moving_variance.
+    """
+    import h5py
+
+    by_layer: Dict[str, Dict[str, np.ndarray]] = {}
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+
+        def visit(name, obj):
+            if isinstance(obj, h5py.Dataset):
+                parts = [p for p in name.split("/") if p]
+                layer, wname = parts[0], parts[-1].split(":")[0]
+                by_layer.setdefault(layer, {})[wname] = np.asarray(obj)
+
+        root.visititems(visit)
+
+    out: Dict[str, np.ndarray] = {}
+    for dst, conv_l, bn_l, kind in _keras_layer_names():
+        conv_w = by_layer[conv_l]
+        kname = "depthwise_kernel" if kind == "depthwise" else "kernel"
+        w = conv_w[kname]
+        if kind == "depthwise":
+            w = np.transpose(w, (0, 1, 3, 2))
+        out[f"params/{dst}/conv/kernel"] = w
+        bn = by_layer[bn_l]
+        out[f"params/{dst}/bn/scale"] = bn["gamma"]
+        out[f"params/{dst}/bn/bias"] = bn["beta"]
+        out[f"batch_stats/{dst}/bn/mean"] = bn["moving_mean"]
+        out[f"batch_stats/{dst}/bn/var"] = bn["moving_variance"]
+    return out
+
+
+def convert(src: str, dst: str) -> None:
+    """Convert a torchvision ``.pth``/``.pt`` or Keras ``.h5``
+    MobileNetV2 checkpoint into the canonical npz at ``dst``."""
+    if src.endswith((".h5", ".hdf5")):
+        flat = convert_keras_h5(src)
+    else:
+        import torch
+
+        obj = torch.load(src, map_location="cpu", weights_only=True)
+        sd = obj.get("state_dict", obj) if isinstance(obj, dict) else obj
+        flat = convert_torchvision_state_dict(sd)
+    np.savez(dst, **flat)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        print(
+            "usage: python -m tpuflow.models.pretrained "
+            "<mobilenet_v2.{pth,h5}> <out.npz>",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    convert(sys.argv[1], sys.argv[2])
+    print(f"wrote {sys.argv[2]}")

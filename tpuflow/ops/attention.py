@@ -364,7 +364,9 @@ def flash_attention(
     if causal and sq != skv:
         raise ValueError("causal=True requires equal q/kv sequence lengths")
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from tpuflow.core.hw import is_tpu_backend
+
+        interpret = not is_tpu_backend()
     scale = float(scale) if scale is not None else d**-0.5
     block_q = min(block_q, max(8, sq))
     block_k = min(block_k, max(8, skv))

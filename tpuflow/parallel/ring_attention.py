@@ -224,7 +224,9 @@ def ring_attention(
     b, h, s, d = q.shape
     n = lax.axis_size(axis_name)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from tpuflow.core.hw import is_tpu_backend
+
+        interpret = not is_tpu_backend()
     # uniform shards ⇒ one block size; collapse BEFORE computing padding
     # so the padded length is always a multiple of the final block
     block = min(block_q, block_k, max(8, s))

@@ -82,6 +82,14 @@ class Trainer:
         import flax.linen as _nn
 
         variables = _nn.unbox(variables)
+        weights = getattr(self.model, "weights", None)
+        if weights:
+            # pretrained backbone (≙ Keras weights='imagenet',
+            # P1/02:164-169): replace the randomly initialized backbone
+            # with the converted checkpoint; head stays fresh
+            from tpuflow.models.pretrained import load_backbone_variables
+
+            variables = load_backbone_variables(variables, weights)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         mask = (

@@ -105,11 +105,19 @@ def train_and_evaluate(
     cache = cache_dir or cfg.data.cache_dir
     conv_t = make_converter(train_table, cache, min_partitions=procs)
     conv_v = make_converter(val_table, cache, min_partitions=procs)
+    from tpuflow.core.hw import is_tpu_backend
+
+    reuse = cfg.data.reuse_decode_buffers
+    if reuse is None:
+        reuse = is_tpu_backend()  # see DataConfig.reuse_decode_buffers
     ds_kwargs = dict(
         img_height=cfg.data.img_height,
         img_width=cfg.data.img_width,
         num_decode_workers=cfg.data.num_decode_workers,
         prefetch=cfg.data.prefetch,
+        streaming=cfg.data.streaming,
+        shuffle_buffer=cfg.data.shuffle_buffer,
+        reuse_buffers=reuse,
     )
 
     if model is None:
@@ -121,6 +129,7 @@ def train_and_evaluate(
             dropout=cfg.model.dropout,
             width_mult=cfg.model.width_mult,
             freeze_backbone=cfg.model.freeze_backbone,
+            weights=cfg.model.weights,
         )
 
     run = None
