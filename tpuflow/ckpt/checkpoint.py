@@ -119,9 +119,13 @@ def restore_into_state(path: str, state: Any) -> Any:
     else:
         restored = serialization.from_state_dict(_unkey(state), payload)
         restored = _rekey(state, restored)
-    # keep the template's sharding (replicated across the mesh)
+    # keep the template's sharding (replicated across the mesh);
+    # put_replicated handles non-addressable (multi-process) meshes —
+    # every process restores the same file, so values are host-identical
+    from tpuflow.parallel.mesh import put_replicated
+
     return jax.tree.map(
-        lambda v, t: jax.device_put(v, t.sharding)
+        lambda v, t: put_replicated(v, t.sharding)
         if hasattr(t, "sharding")
         else v,
         restored,

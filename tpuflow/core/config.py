@@ -31,6 +31,7 @@ class DataConfig:
     # (beyond-memory tables, ≙ Petastorm's reason to exist, P1/03:32-34);
     # default keeps the in-memory fast path for workshop-scale data
     streaming: bool = False
+    shuffle: bool = True  # per-epoch seeded shuffle (off ⇒ table order)
     shuffle_buffer: int = 2048
     # None = auto: reuse decode output buffers on TPU backends (halves
     # allocator churn in the infeed); forced off on CPU where JAX may

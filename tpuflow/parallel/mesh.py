@@ -75,6 +75,42 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def put_replicated(x, sharding: NamedSharding):
+    """Place one HOST-IDENTICAL array under ``sharding``.
+
+    Single-process (fully addressable mesh): plain device_put. True
+    multi-process mesh: ``device_put`` rejects non-addressable
+    shardings, so the global array is assembled from each process's
+    identical local copy (``make_array_from_process_local_data``) —
+    valid because replicated state is host-identical by construction
+    (seeded init / shared checkpoint files, the broadcast-init
+    invariant P1/03:305-308). Typed PRNG keys travel as raw key data
+    and are re-wrapped on device.
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    from jax import dtypes as _dtypes
+
+    if hasattr(x, "dtype") and _dtypes.issubdtype(
+        getattr(x, "dtype", None), _dtypes.prng_key
+    ):
+        data = np.asarray(jax.device_get(jax.random.key_data(x)))
+        g = jax.make_array_from_process_local_data(sharding, data)
+        return jax.jit(
+            jax.random.wrap_key_data, out_shardings=sharding
+        )(g)
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(jax.device_get(x))
+    )
+
+
+def replicate_tree(tree, mesh: Mesh):
+    """Fully replicate a host-identical pytree across ``mesh`` (multi-
+    process-safe; see put_replicated)."""
+    sh = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: put_replicated(x, sh), tree)
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

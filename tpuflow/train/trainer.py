@@ -112,8 +112,12 @@ class Trainer:
             rng=jax.random.key(self.cfg.seed + 1),
             plateau_factor=jnp.ones((), jnp.float32),
         )
-        replicated = NamedSharding(self.mesh, P())
-        self.state = jax.device_put(state, replicated)
+        from tpuflow.parallel.mesh import replicate_tree
+
+        # multi-process-safe replication (device_put cannot target
+        # non-addressable meshes); host state is identical on every
+        # process by seeded construction
+        self.state = replicate_tree(state, self.mesh)
         return self.state
 
     # ---- jitted steps ----------------------------------------------------

@@ -116,6 +116,7 @@ def train_and_evaluate(
         num_decode_workers=cfg.data.num_decode_workers,
         prefetch=cfg.data.prefetch,
         streaming=cfg.data.streaming,
+        shuffle=cfg.data.shuffle,
         shuffle_buffer=cfg.data.shuffle_buffer,
         reuse_buffers=reuse,
     )
