@@ -44,7 +44,7 @@ _WORKER = textwrap.dedent(
     cfg.data.batch_size = 4
     cfg.data.shuffle = False
     cfg.data.cache_dir = os.path.join(work, f"cache_{pid}")
-    cfg.model.num_classes = 2
+    cfg.model.num_classes = 5
     cfg.model.width_mult = 0.25
     cfg.model.dropout = 0.0
     cfg.train.epochs = 2
@@ -135,7 +135,7 @@ def test_two_process_train_matches_single_process(tmp_path, flower_dir):
     cfg.data.batch_size = 4
     cfg.data.shuffle = False
     cfg.data.cache_dir = os.path.join(work, "cache_sp")
-    cfg.model.num_classes = 2
+    cfg.model.num_classes = 5
     cfg.model.width_mult = 0.25
     cfg.model.dropout = 0.0
     cfg.train.epochs = 2

@@ -29,9 +29,9 @@ _PAT = re.compile(r"checkpoint-(\d+)\.ckpt$")
 
 
 def _is_key(x: Any) -> bool:
-    import jax.numpy as jnp
+    from tpuflow.parallel.mesh import is_typed_prng_key
 
-    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
+    return is_typed_prng_key(x)
 
 
 def _unkey(tree: Any) -> Any:

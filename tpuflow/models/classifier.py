@@ -97,3 +97,20 @@ def backbone_param_mask(params: Dict) -> Dict:
         return not (len(path) > 0 and path[0].key == BACKBONE)
 
     return jax.tree_util.tree_map_with_path(mark, params)
+
+
+def stop_gradient_frozen(params: Dict, mask: Optional[Dict]) -> Dict:
+    """Sever the differentiable path into frozen (mask=False) leaves.
+
+    Used inside trainer loss functions so autodiff never builds the
+    backward graph through a frozen backbone — masking only at the
+    optimizer (≙ Keras layer.trainable=False, P1/02:164-169) would
+    still pay the full backprop FLOPs for gradients it then discards.
+    """
+    import jax
+
+    if mask is None:
+        return params
+    return jax.tree_util.tree_map(
+        lambda p, m: p if m else jax.lax.stop_gradient(p), params, mask
+    )

@@ -125,6 +125,12 @@ def _bwd_ref(cfg: _Cfg, q, k, v, o, lse, do):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, cfg: _Cfg):
+    # lse_ref block is the FULL padded row, shape (1, 1, sq_pad): TPU
+    # block specs require the last two block dims divisible by (8, 128)
+    # or equal to the array dims — a (1, block_q) tile of a (BH, S)
+    # array violates that (Mosaic rejects it on hardware even though
+    # interpret mode accepts it), while a whole-row block is always
+    # legal and costs only S*4 bytes of VMEM.
     bq, d = q_ref.shape[1], q_ref.shape[2]
     bk = cfg.block_k
     qi = pl.program_id(1)
@@ -164,7 +170,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, cfg: _Cfg):
     safe_l = jnp.where(l > 0, l, 1.0)
     o_ref[0] = jnp.where(l > 0, acc / safe_l, 0.0).astype(o_ref.dtype)
     lse = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(safe_l[:, 0]), _NEG_BIG)
-    lse_ref[0, :] = lse
+    lse_ref[0, 0, pl.ds(qi * bq, bq)] = lse
 
 
 def _fwd(cfg: _Cfg, q, k, v):
@@ -182,15 +188,15 @@ def _fwd(cfg: _Cfg, q, k, v):
         ],
         out_specs=[
             pl.BlockSpec((1, cfg.block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, cfg.block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, sq), lambda b, i: (b, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v)),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32, vma=_vma(q, k, v)),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32, vma=_vma(q, k, v)),
         ],
         interpret=cfg.interpret,
     )(q, k, v)
-    return o, lse
+    return o, lse[:, 0, :]
 
 
 # ---------------------------------------------------------------------------
@@ -204,8 +210,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, cfg: _Cf
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :][:, None]
-    delta = delta_ref[0, :][:, None]
+    lse = lse_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
+    delta = delta_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
     row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     row_ok = row < cfg.sq_valid
 
@@ -250,8 +256,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * bq, bq)][:, None]
-        delta = delta_ref[0, pl.ds(i * bq, bq)][:, None]
+        lse = lse_ref[0, 0, pl.ds(i * bq, bq)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * cfg.scale
         row = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         mask = col_ok & (row < cfg.sq_valid)
@@ -274,33 +280,35 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do):
     bh, sq, d = q.shape
     skv = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # vectors ride as (BH, 1, S) whole-row blocks — see _fwd_kernel note
+    lse3 = lse[:, None, :]
+    delta3 = delta[:, None, :]
     q_spec = pl.BlockSpec((1, cfg.block_q, d), lambda b, i: (b, i, 0))
     kv_full = pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0))
-    vec_q = pl.BlockSpec((1, cfg.block_q), lambda b, i: (b, i))
+    vec_row = pl.BlockSpec((1, 1, sq), lambda b, i: (b, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, cfg=cfg),
         grid=(bh, sq // cfg.block_q),
-        in_specs=[q_spec, kv_full, kv_full, q_spec, vec_q, vec_q],
+        in_specs=[q_spec, kv_full, kv_full, q_spec, vec_row, vec_row],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v, do)),
         interpret=cfg.interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
 
     k_spec = pl.BlockSpec((1, cfg.block_k, d), lambda b, j: (b, j, 0))
     q_full = pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0))
-    vec_full = pl.BlockSpec((1, sq), lambda b, j: (b, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, cfg=cfg),
         grid=(bh, skv // cfg.block_k),
-        in_specs=[k_spec, k_spec, q_full, q_full, vec_full, vec_full],
+        in_specs=[k_spec, k_spec, q_full, q_full, vec_row, vec_row],
         out_specs=[k_spec, k_spec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, skv, d), k.dtype, vma=_vma(q, k, v, do)),
             jax.ShapeDtypeStruct((bh, skv, d), v.dtype, vma=_vma(q, k, v, do)),
         ],
         interpret=cfg.interpret,
-    )(k, v, q, do, lse, delta)
+    )(k, v, q, do, lse3, delta3)
     return dq, dk, dv
 
 

@@ -75,32 +75,46 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
-def put_replicated(x, sharding: NamedSharding):
-    """Place one HOST-IDENTICAL array under ``sharding``.
+def is_typed_prng_key(x) -> bool:
+    """True for typed jax.random keys (extended prng_key dtype)."""
+    from jax import dtypes as _dtypes
 
-    Single-process (fully addressable mesh): plain device_put. True
-    multi-process mesh: ``device_put`` rejects non-addressable
-    shardings, so the global array is assembled from each process's
-    identical local copy (``make_array_from_process_local_data``) —
-    valid because replicated state is host-identical by construction
-    (seeded init / shared checkpoint files, the broadcast-init
-    invariant P1/03:305-308). Typed PRNG keys travel as raw key data
-    and are re-wrapped on device.
+    return hasattr(x, "dtype") and _dtypes.issubdtype(
+        getattr(x, "dtype", None), _dtypes.prng_key
+    )
+
+
+def put_replicated(x, sharding: NamedSharding):
+    """Place one HOST-IDENTICAL *global* array under ``sharding``.
+
+    ``x`` is the full global value, identical on every process (seeded
+    init / shared checkpoint files — the broadcast-init invariant
+    P1/03:305-308). Single-process (fully addressable mesh): plain
+    device_put. Multi-process: ``device_put`` rejects non-addressable
+    shardings, so each addressable shard is sliced out of the global
+    array by index (``make_array_from_callback``) — correct for
+    replicated AND partitioned specs (e.g. restoring a ZeRO/FSDP
+    TrainState, where each process owns a slice of the optimizer
+    state). Typed PRNG keys travel as raw key data and are re-wrapped
+    on device.
     """
     if sharding.is_fully_addressable:
         return jax.device_put(x, sharding)
-    from jax import dtypes as _dtypes
-
-    if hasattr(x, "dtype") and _dtypes.issubdtype(
-        getattr(x, "dtype", None), _dtypes.prng_key
-    ):
+    if is_typed_prng_key(x):
+        if sharding.spec != P() and any(sharding.spec):
+            raise NotImplementedError(
+                "multi-process placement of PARTITIONED typed PRNG keys "
+                f"is not supported (spec {sharding.spec}); keys in "
+                "TrainState are replicated"
+            )
         data = np.asarray(jax.device_get(jax.random.key_data(x)))
         g = jax.make_array_from_process_local_data(sharding, data)
         return jax.jit(
             jax.random.wrap_key_data, out_shardings=sharding
         )(g)
-    return jax.make_array_from_process_local_data(
-        sharding, np.asarray(jax.device_get(x))
+    data = np.asarray(jax.device_get(x))
+    return jax.make_array_from_callback(
+        data.shape, sharding, lambda idx: data[idx]
     )
 
 

@@ -31,7 +31,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpuflow.core.config import TrainConfig
-from tpuflow.models.classifier import backbone_param_mask
+from tpuflow.models.classifier import backbone_param_mask, stop_gradient_frozen
 from tpuflow.models.preprocess import preprocess_input
 from tpuflow.parallel.mesh import DATA_AXIS
 from tpuflow.train.optimizers import get_optimizer, set_learning_rate
@@ -131,6 +131,7 @@ class SpmdTrainer(Trainer):
             else None
         )
         self.lr0 = cfg.learning_rate
+        self.param_mask = mask  # used by _make_steps to prune the backward
         self.tx = get_optimizer(
             cfg.optimizer, self.lr0, param_mask=mask, **cfg.optimizer_kwargs
         )
@@ -167,12 +168,16 @@ class SpmdTrainer(Trainer):
     def _make_steps(self):
         model = self.model
         data_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        mask = getattr(self, "param_mask", None)
 
         def train_step(state: TrainState, images, labels, lr):
             x = preprocess_input(images, dtype=getattr(model, "dtype", jnp.bfloat16))
             step_rng = jax.random.fold_in(state.rng, state.step)
 
             def loss_fn(params):
+                # frozen backbone ⇒ head-only backward (see
+                # stop_gradient_frozen)
+                params = stop_gradient_frozen(params, mask)
                 out = model.apply(
                     {"params": params, "batch_stats": state.batch_stats},
                     x,

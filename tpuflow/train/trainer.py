@@ -36,7 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from tpuflow.core.config import TrainConfig
-from tpuflow.models.classifier import backbone_param_mask
+from tpuflow.models.classifier import backbone_param_mask, stop_gradient_frozen
 from tpuflow.models.preprocess import preprocess_input
 from tpuflow.parallel.mesh import DATA_AXIS, build_mesh, world_size
 from tpuflow.train.callbacks import Callback, History
@@ -97,6 +97,11 @@ class Trainer:
             if getattr(self.model, "freeze_backbone", False)
             else None
         )
+        # kept for _make_steps: frozen leaves are stop_gradient'ed inside
+        # the loss so XLA never builds the backbone backward at all —
+        # masking only at the optimizer would still pay full backprop
+        # FLOPs and allreduce bandwidth for gradients it then discards
+        self.param_mask = mask
         self.lr0 = self.cfg.learning_rate
         self.tx = get_optimizer(
             self.cfg.optimizer,
@@ -125,6 +130,7 @@ class Trainer:
     def _make_steps(self):
         mesh = self.mesh
         model = self.model
+        mask = getattr(self, "param_mask", None)
 
         def train_step(state: TrainState, images, labels, lr):
             x = preprocess_input(images, dtype=getattr(model, "dtype", jnp.bfloat16))
@@ -132,6 +138,9 @@ class Trainer:
             step_rng = jax.random.fold_in(step_rng, jax.lax.axis_index(DATA_AXIS))
 
             def loss_fn(params):
+                # frozen backbone ⇒ head-only backward (XLA DCEs the
+                # backbone backward — ~2x step FLOPs on the flagship)
+                params = stop_gradient_frozen(params, mask)
                 out = model.apply(
                     {"params": params, "batch_stats": state.batch_stats},
                     x,
@@ -148,8 +157,19 @@ class Trainer:
             (loss, (logits, new_vars)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(state.params)
-            # ≙ hvd.DistributedOptimizer: mean-allreduce gradients (P1/03:302)
-            grads = jax.lax.pmean(grads, DATA_AXIS)
+            # ≙ hvd.DistributedOptimizer: mean-allreduce gradients
+            # (P1/03:302). Frozen leaves are identically zero — rebuild
+            # them from the replicated params (right vma for the P()
+            # out_spec) instead of paying pmean bandwidth on zeros.
+            if mask is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda g, p, m: (
+                        jax.lax.pmean(g, DATA_AXIS) if m else jnp.zeros_like(p)
+                    ),
+                    grads, state.params, mask,
+                )
+            else:
+                grads = jax.lax.pmean(grads, DATA_AXIS)
             # ≙ MetricAverageCallback: average metrics across replicas (P1/03:313)
             acc = jnp.mean(
                 (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
