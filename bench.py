@@ -196,6 +196,11 @@ def main() -> int:
                    help="watchdog: emit an error JSON line and exit if "
                         "the bench has not finished by then")
     p.add_argument("--no-attn-diag", action="store_true")
+    p.add_argument("--model", choices=["cnn", "vit"], default="cnn",
+                   help="cnn = flagship MobileNetV2 transfer config "
+                        "(the reference's P1/03 parity target); vit = "
+                        "dense ViT train step, the MXU-bound MFU "
+                        "demonstrator (see MFU_ANALYSIS.md)")
     args = p.parse_args()
 
     if args.smoke:
@@ -249,7 +254,25 @@ def _bench(args) -> int:
     global_batch = batch * n_chips
 
     mesh = build_mesh(MeshSpec(data=n_chips, model=1))
-    model = build_model(num_classes=5, dropout=0.5, width_mult=width)
+    if args.model == "vit":
+        # dense MFU demonstrator: full-backward ViT training step.
+        # MobileNetV2's depthwise convs cap its MFU well below the 60%
+        # north star on ANY accelerator (memory-bound; MFU_ANALYSIS.md);
+        # this config is matmul-dominated so it shows what the framework
+        # achieves when the model maps onto the MXU.
+        from tpuflow.models.vit import build_vit
+
+        if args.smoke:
+            hw, batch, width = 32, args.batch or 8, 64
+            model = build_vit(num_classes=5, img_size=hw, patch_size=8,
+                              width=width, depth=2, heads=4)
+        else:
+            hw, batch, width = 224, args.batch or 128, 768
+            model = build_vit(num_classes=5, img_size=hw, patch_size=16,
+                              width=width, depth=12, heads=12)  # ViT-Base
+        global_batch = batch * n_chips
+    else:
+        model = build_model(num_classes=5, dropout=0.5, width_mult=width)
     trainer = Trainer(model, TrainConfig(learning_rate=1e-3, warmup_epochs=0),
                       mesh=mesh)
     trainer.init_state((hw, hw, 3))

@@ -9,10 +9,13 @@ TrainState (params + BN stats + optimizer state + step) serialization,
 atomic writes, latest-checkpoint discovery, and restore-into-state.
 
 Serialization is flax msgpack (dependency-light, host-RAM friendly at
-this model scale); the writer is primary-process-only by convention
-(callbacks gate it), and restored state is broadcast-replicated on
-load, which is exactly the consistency story
-BroadcastGlobalVariablesCallback documents (P1/03:305-308).
+this model scale). Only the primary process WRITES files, but saving
+cross-process-sharded (ZeRO/FSDP) state is a COLLECTIVE — every
+process must call save_checkpoint so the assembling allgathers match
+(see save_checkpoint's contract). Restored state is placed back under
+the template's shardings on load — replicated state everywhere, the
+consistency story BroadcastGlobalVariablesCallback documents
+(P1/03:305-308), and partitioned state sliced per process.
 """
 
 from __future__ import annotations
@@ -54,6 +57,42 @@ def _path(checkpoint_dir: str, step: int) -> str:
     return os.path.join(checkpoint_dir, f"checkpoint-{step}.ckpt")
 
 
+def _host_fetch(tree: Any) -> Any:
+    """Fetch a (possibly cross-process-sharded) device tree to host.
+
+    Replicated or single-process leaves come back via plain device_get.
+    PARTITIONED leaves on a non-addressable mesh (ZeRO/FSDP optimizer
+    state) are assembled with a process allgather so every process
+    holds the full global array — the checkpoint file is always the
+    complete state regardless of how training sharded it.
+    """
+
+    def one(x):
+        if _needs_allgather(x):
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(x, tiled=True)
+        return x
+
+    return jax.device_get(jax.tree.map(one, tree))
+
+
+def _needs_allgather(x: Any) -> bool:
+    """Leaf is partitioned over devices this process cannot address —
+    fetching it to host requires a process allgather."""
+    return (
+        isinstance(x, jax.Array)
+        and not x.is_fully_addressable
+        and not x.sharding.is_fully_replicated
+    )
+
+
+def is_cross_process_sharded(tree: Any) -> bool:
+    """True if any leaf is partitioned over devices this process cannot
+    address — i.e. saving it is a collective (see save_checkpoint)."""
+    return any(_needs_allgather(x) for x in jax.tree.leaves(tree))
+
+
 def save_checkpoint(
     checkpoint_dir: str,
     state: Any,
@@ -61,17 +100,29 @@ def save_checkpoint(
     weights_only: bool = False,
 ) -> str:
     """Write checkpoint atomically. ``weights_only`` mirrors the
-    reference's save_weights_only=True (params+batch_stats only)."""
-    os.makedirs(checkpoint_dir, exist_ok=True)
+    reference's save_weights_only=True (params+batch_stats only).
+
+    COLLECTIVE when ``state`` holds cross-process-sharded leaves
+    (ZeRO/FSDP): assembling them is an allgather, so EVERY process must
+    call this with the same state; only the primary touches the
+    filesystem (rank-0 discipline, P2/02:206-211). With fully
+    replicated/addressable state (the Trainer default) non-primary
+    processes may skip the call entirely — there is no collective.
+    """
+    from tpuflow.core.dist import is_primary
+
     if weights_only:
         payload = {
-            "params": jax.device_get(state.params),
-            "batch_stats": jax.device_get(state.batch_stats),
+            "params": _host_fetch(state.params),
+            "batch_stats": _host_fetch(state.batch_stats),
         }
     else:
-        payload = jax.device_get(serialization.to_state_dict(_unkey(state)))
-    data = serialization.msgpack_serialize(payload)
+        payload = _host_fetch(serialization.to_state_dict(_unkey(state)))
     path = _path(checkpoint_dir, step)
+    if not is_primary():
+        return path
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    data = serialization.msgpack_serialize(payload)
     fd, tmp = tempfile.mkstemp(dir=checkpoint_dir, suffix=".tmp")
     with os.fdopen(fd, "wb") as f:
         f.write(data)

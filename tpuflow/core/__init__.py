@@ -1,4 +1,5 @@
 from tpuflow.core.dist import (  # noqa: F401
+    barrier,
     initialize,
     is_primary,
     local_device_count,

@@ -80,6 +80,18 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
+def barrier(name: str = "tpuflow_barrier") -> None:
+    """Block until every process reaches this point (≙ the gang
+    synchronization Spark barrier mode provides around Horovod stages,
+    P1/03:256). No-op single-process. Typical use: non-primary
+    processes must not read a checkpoint until the primary finished
+    writing it."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def primary_only(fn: Callable[..., T]) -> Callable[..., Optional[T]]:
     """Decorator: run ``fn`` only on the primary process, return None elsewhere.
 

@@ -13,7 +13,6 @@ P2/03:397-401), re-expressed for the functional trainer:
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, List, Optional
 
 
@@ -106,8 +105,12 @@ class EarlyStopping(Callback):
 
 
 class ModelCheckpoint(Callback):
-    """Per-epoch checkpoint, PRIMARY PROCESS ONLY (≙ rank-0-only
-    ModelCheckpoint to {dir}/checkpoint-{epoch}.ckpt, P2/02:206-211).
+    """Per-epoch checkpoint; only the PRIMARY process writes files
+    (≙ rank-0-only ModelCheckpoint to {dir}/checkpoint-{epoch}.ckpt,
+    P2/02:206-211). When the saved leaves are cross-process-sharded
+    (ZeRO/FSDP), every process enters save_checkpoint — assembling the
+    state is a collective; do NOT re-add an is_primary() gate around
+    the call or the primary deadlocks in the allgather.
 
     Default saves the FULL TrainState (params + optimizer state + step +
     LR state) so resume is exact — the capability the reference lacks;
@@ -121,13 +124,24 @@ class ModelCheckpoint(Callback):
     def on_epoch_end(self, epoch, logs):
         from tpuflow.core import is_primary
         from tpuflow.ckpt import save_checkpoint
+        from tpuflow.ckpt.checkpoint import is_cross_process_sharded
 
-        if not is_primary():
+        # ZeRO/FSDP state is assembled by a collective — every process
+        # must participate; only the primary writes (inside
+        # save_checkpoint). Gate on the leaves actually saved: a
+        # weights-only save of a ZeRO run ships replicated params, so
+        # non-primary processes have nothing to contribute or fetch.
+        state = self.trainer.state
+        saved = (
+            (state.params, state.batch_stats)
+            if self.save_weights_only
+            else state
+        )
+        if not is_primary() and not is_cross_process_sharded(saved):
             return
-        os.makedirs(self.checkpoint_dir, exist_ok=True)
         save_checkpoint(
             self.checkpoint_dir,
-            self.trainer.state,
+            state,
             step=epoch + 1,
             weights_only=self.save_weights_only,
         )
