@@ -246,33 +246,38 @@ def _bench(args) -> int:
         return 0
 
     n_chips = len(devices)
-    if args.smoke:
-        hw, width, batch = 64, 0.25, args.batch or 8
-    else:
-        # the reference's distributed per-worker batch (P1/03:81)
-        hw, width, batch = 224, 1.0, args.batch or 256
-    global_batch = batch * n_chips
-
-    mesh = build_mesh(MeshSpec(data=n_chips, model=1))
     if args.model == "vit":
         # dense MFU demonstrator: full-backward ViT training step.
         # MobileNetV2's depthwise convs cap its MFU well below the 60%
         # north star on ANY accelerator (memory-bound; MFU_ANALYSIS.md);
         # this config is matmul-dominated so it shows what the framework
-        # achieves when the model maps onto the MXU.
+        # achieves when the model maps onto the MXU. attn_impl='flash'
+        # puts the compiled Pallas kernel in the training loop (the
+        # smoke variant keeps the XLA-einsum path: interpret-mode Pallas
+        # on CPU is too slow for a smoke check).
         from tpuflow.models.vit import build_vit
 
         if args.smoke:
-            hw, batch, width = 32, args.batch or 8, 64
+            hw, batch = 32, args.batch or 8
             model = build_vit(num_classes=5, img_size=hw, patch_size=8,
-                              width=width, depth=2, heads=4)
+                              width=64, depth=2, heads=4)
+            width = "vit64"
         else:
-            hw, batch, width = 224, args.batch or 128, 768
+            hw, batch = 224, args.batch or 128
             model = build_vit(num_classes=5, img_size=hw, patch_size=16,
-                              width=width, depth=12, heads=12)  # ViT-Base
-        global_batch = batch * n_chips
+                              width=768, depth=12, heads=12,
+                              attn_impl="flash")  # ViT-Base
+            width = "vitB768-flash"
     else:
+        if args.smoke:
+            hw, width, batch = 64, 0.25, args.batch or 8
+        else:
+            # the reference's distributed per-worker batch (P1/03:81)
+            hw, width, batch = 224, 1.0, args.batch or 256
         model = build_model(num_classes=5, dropout=0.5, width_mult=width)
+    global_batch = batch * n_chips
+
+    mesh = build_mesh(MeshSpec(data=n_chips, model=1))
     trainer = Trainer(model, TrainConfig(learning_rate=1e-3, warmup_epochs=0),
                       mesh=mesh)
     trainer.init_state((hw, hw, 3))

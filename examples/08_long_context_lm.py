@@ -1,0 +1,102 @@
+"""Long-context LM training with ring-attention sequence parallelism.
+
+BEYOND-REFERENCE capability (SURVEY.md §5.7: the reference has no
+attention, no sequence axis — its only long-input story is dataset
+streaming). tpuflow makes long context first-class: the sequence axis
+of a causal transformer LM is SHARDED over the mesh, each device holds
+``seq_len / sp`` tokens, and attention runs as a ring — K/V shards
+rotate around the ``seq`` axis via ``ppermute`` while each hop's
+partial attention is merged in log-sum-exp space
+(tpuflow/parallel/ring_attention.py, custom VJP for the backward; the
+per-shard compute is the Pallas flash-attention kernel on TPU).
+
+Memory per device is O(seq/sp), so context length scales linearly with
+the mesh — the same recipe that trains million-token contexts on pods,
+demonstrated here on a virtual mesh. Run on CPU:
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/08_long_context_lm.py
+
+On a TPU slice, drop the env vars: the mesh axes map onto ICI.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from tpuflow.models import build_transformer_lm, next_token_loss
+    from tpuflow.parallel.mesh import build_nd_mesh
+
+    n_dev = len(jax.devices())
+    sp = 4 if n_dev >= 8 else max(1, n_dev // 2)
+    dp = max(1, n_dev // sp)
+    mesh = build_nd_mesh({"data": dp, "seq": sp},
+                         devices=jax.devices()[: dp * sp])
+    print(f"mesh: data={dp} x seq={sp} ({n_dev} devices)")
+
+    # a context long enough that each device only ever holds 1/sp of it
+    # (tiny here so the CPU demo stays fast; on TPU scale seq_len up —
+    # per-device memory is O(seq_len / sp))
+    seq_len = 16 * sp
+    vocab = 64
+    lm_kw = dict(vocab_size=vocab, dim=32, depth=2, heads=4, mlp_ratio=2,
+                 dtype=jnp.float32)
+    lm = build_transformer_lm(seq_axis="seq", **lm_kw)
+
+    # init with the seq_axis=None twin — identical params; the manual
+    # (shard_map) apply needs the named axis only at call time
+    toks0 = jnp.zeros((1, 8), jnp.int32)
+    params = nn.unbox(
+        build_transformer_lm(**lm_kw).init({"params": jax.random.key(0)}, toks0)
+    )["params"]
+
+    fwd = shard_map(
+        lambda p, t: lm.apply({"params": p}, t),
+        mesh=mesh,
+        in_specs=(P(), P("data", "seq")),
+        out_specs=P("data", "seq", None),
+    )
+
+    @jax.jit
+    def step(params, toks):
+        def loss_fn(p):
+            return next_token_loss(fwd(p, toks), toks)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return loss, jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+
+    # learnable synthetic corpus: arithmetic sequences mod vocab — the
+    # next token is predictable from the two before it
+    rng = np.random.default_rng(0)
+
+    def batch(n=4 * dp):
+        start = rng.integers(0, vocab, (n, 1))
+        stride = rng.integers(1, 7, (n, 1))
+        pos = np.arange(seq_len)[None, :]
+        return jnp.asarray((start + stride * pos) % vocab, jnp.int32)
+
+    losses = []
+    for i in range(80):
+        loss, params = step(params, batch())
+        losses.append(float(loss))
+        if i % 20 == 0:
+            print(f"step {i:3d}  loss {losses[-1]:.4f}")
+    print(f"final loss {losses[-1]:.4f} (start {losses[0]:.4f})")
+    assert losses[-1] < losses[0] * 0.7, "LM did not learn"
+    print("ring-attention LM training OK "
+          f"(context {seq_len} tokens over {sp} sequence shards)")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,7 @@ def test_examples_import():
         "05_tune_parallel_trials",
         "06_tune_distributed",
         "07_package_and_batch_inference",
+        "08_long_context_lm",
     ]:
         assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
 
@@ -61,3 +62,16 @@ def test_train_distributed_example(tmp_path):
             env=env, capture_output=True, text=True, timeout=900,
         )
         assert r.returncode == 0, r.stderr[-2000:]
+
+
+@pytest.mark.slow
+def test_long_context_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "08_long_context_lm.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ring-attention LM training OK" in r.stdout

@@ -26,7 +26,9 @@ Two residency modes:
   differ between modes).
 
 The decode hot path runs in the native C++ plane (tpuflow.native) on a
-background producer thread — host decode overlaps device compute — and
+two-stage background pipeline (row assembly → decode; the native call
+releases the GIL, so Parquet reads and Python batch assembly overlap
+the decode) — host work overlaps device compute — and
 with ``reuse_buffers=True`` writes into a small ring of reused output
 buffers (no per-batch ~38MB allocation at 256x224²; safe when the
 consumer copies batches to an accelerator promptly, because at most
@@ -339,26 +341,28 @@ class Dataset:
             )
         return pool[slot]
 
-    def _produce(self, out_q: "queue.Queue", stop: threading.Event) -> None:
-        def put(item) -> bool:
-            # Blocking put that still observes consumer abandonment, so an
-            # abandoned iterator never leaks this thread.
-            while not stop.is_set():
-                try:
-                    out_q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+    @staticmethod
+    def _stage_put(q: "queue.Queue", item, stop: threading.Event) -> bool:
+        """Blocking put that still observes consumer abandonment, so an
+        abandoned iterator never leaks pipeline threads."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
+    def _assemble(self, raw_q: "queue.Queue", stop: threading.Event) -> None:
+        """Stage 1: row iteration → raw (jpegs, labels) batches.
+
+        Runs concurrently with stage 2 so Parquet reads + Python batch
+        assembly overlap the native decode (which releases the GIL) —
+        the tf.data-style pipelined host path (N5). Single FIFO per
+        stage keeps batch order deterministic.
+        """
         epoch = self.start_epoch
         bs = self.batch_size
-        # ring of reused decode buffers: at most ``prefetch`` batches sit
-        # in the queue + 1 at the consumer, so a period of prefetch + 3
-        # never overwrites a batch still in flight (the extra slot is
-        # headroom for an async H2D transfer still reading the oldest)
-        pool: List[Optional[np.ndarray]] = [None] * (self.prefetch + 3)
-        slot = 0
         try:
             while not stop.is_set():
                 rows = (
@@ -376,51 +380,79 @@ class Dataset:
                     jpegs.append(content)
                     labels.append(label)
                     if len(jpegs) == bs:
-                        out = self._decode_out(pool, slot)
-                        slot = (slot + 1) % len(pool)
-                        images, _ok = decode_resize_batch(
-                            jpegs,
-                            self.img_height,
-                            self.img_width,
-                            num_threads=self.num_decode_workers,
-                            out=out,
-                        )
-                        if not put(
-                            {
-                                "image": images,
-                                "label": np.asarray(labels, np.int32),
-                            }
-                        ):
+                        if not self._stage_put(raw_q, (jpegs, labels), stop):
                             return
                         jpegs, labels = [], []
                         emitted += 1
                         if max_batches is not None and emitted >= max_batches:
                             break
                 if jpegs and not self.drop_remainder and not stop.is_set():
-                    images, _ok = decode_resize_batch(
-                        jpegs,
-                        self.img_height,
-                        self.img_width,
-                        num_threads=self.num_decode_workers,
-                    )
-                    if not put(
-                        {"image": images, "label": np.asarray(labels, np.int32)}
-                    ):
+                    if not self._stage_put(raw_q, (jpegs, labels), stop):
                         return
                 epoch += 1
                 if not self.infinite:
                     break
         except BaseException as e:  # propagate to the consumer, don't
-            put(_StreamError(e))  # let an 'infinite' stream end quietly
+            self._stage_put(raw_q, _StreamError(e), stop)  # end quietly
             return
         finally:
-            put(None)  # sentinel; dropped only if the consumer is gone
+            self._stage_put(raw_q, None, stop)  # sentinel
+
+    def _decode_stage(
+        self, raw_q: "queue.Queue", out_q: "queue.Queue", stop: threading.Event
+    ) -> None:
+        """Stage 2: native decode+resize of raw batches, in FIFO order."""
+        # ring of reused decode buffers: at most ``prefetch`` batches sit
+        # in the queue + 1 at the consumer, so a period of prefetch + 3
+        # never overwrites a batch still in flight (the extra slot is
+        # headroom for an async H2D transfer still reading the oldest)
+        pool: List[Optional[np.ndarray]] = [None] * (self.prefetch + 3)
+        slot = 0
+        try:
+            while True:
+                if stop.is_set():
+                    return
+                try:
+                    item = raw_q.get(timeout=0.1)
+                except _QueueEmpty:
+                    continue
+                if item is None or isinstance(item, _StreamError):
+                    self._stage_put(out_q, item, stop)
+                    return
+                jpegs, labels = item
+                out = None
+                if len(jpegs) == self.batch_size:
+                    out = self._decode_out(pool, slot)
+                    slot = (slot + 1) % len(pool)
+                images, _ok = decode_resize_batch(
+                    jpegs,
+                    self.img_height,
+                    self.img_width,
+                    num_threads=self.num_decode_workers,
+                    out=out,
+                )
+                if not self._stage_put(
+                    out_q,
+                    {"image": images, "label": np.asarray(labels, np.int32)},
+                    stop,
+                ):
+                    return
+        except BaseException as e:
+            self._stage_put(out_q, _StreamError(e), stop)
+            return
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        raw_q: "queue.Queue" = queue.Queue(maxsize=2)
         out_q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
-        t = threading.Thread(target=self._produce, args=(out_q, stop), daemon=True)
-        t.start()
+        t1 = threading.Thread(
+            target=self._assemble, args=(raw_q, stop), daemon=True
+        )
+        t2 = threading.Thread(
+            target=self._decode_stage, args=(raw_q, out_q, stop), daemon=True
+        )
+        t1.start()
+        t2.start()
         try:
             while True:
                 item = out_q.get()
@@ -433,12 +465,13 @@ class Dataset:
                 yield item
         finally:
             stop.set()
-            # drain so the producer can observe stop and exit
-            try:
-                while out_q.get_nowait() is not None:
+            # drain so the pipeline threads can observe stop and exit
+            for q in (out_q, raw_q):
+                try:
+                    while q.get_nowait() is not None:
+                        pass
+                except _QueueEmpty:
                     pass
-            except _QueueEmpty:
-                pass
 
 
 class Converter:
