@@ -52,7 +52,9 @@ def main() -> None:
     vocab = 64
     lm_kw = dict(vocab_size=vocab, dim=32, depth=2, heads=4, mlp_ratio=2,
                  dtype=jnp.float32)
-    lm = build_transformer_lm(seq_axis="seq", **lm_kw)
+    # remat=True: per-block gradient checkpointing — with the ring's
+    # O(seq/sp) residency this is the recipe's second memory lever
+    lm = build_transformer_lm(seq_axis="seq", remat=True, **lm_kw)
 
     # init with the seq_axis=None twin — identical params; the manual
     # (shard_map) apply needs the named axis only at call time

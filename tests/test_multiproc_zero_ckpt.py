@@ -35,9 +35,10 @@ _WORKER = textwrap.dedent(
     pid = jax.process_index()
 
     def make_trainer():
-        # freeze_backbone=False: a masked (frozen) optimizer wraps its
-        # state in MaskedState, which _specs_like treats as replicated —
-        # zero1 sharding applies to the unmasked optimizer tree
+        # freeze_backbone=False so EVERY param carries Adam moments —
+        # maximizes the cross-process-sharded leaves this round-trip
+        # exercises (masked/frozen optimizers shard too, covered by
+        # test_zero.py::test_zero1_with_frozen_backbone_masked_optimizer)
         model = build_model(num_classes=3, dropout=0.0, width_mult=0.25,
                             freeze_backbone=False)
         t = SpmdTrainer(

@@ -127,3 +127,23 @@ def test_vit_with_dp_trainer():
     state, m = tr._train_step(tr.state, img_d, lab_d, jnp.asarray(1e-3, jnp.float32))
     assert np.isfinite(float(m["loss"]))
     assert int(jax.device_get(state.step)) == 1
+
+
+def test_vit_remat_parity():
+    """remat ViT: same logits and grads as the stored-activation ViT."""
+    kw = dict(num_classes=3, img_size=16, patch_size=8, width=16, depth=2,
+              heads=2, dropout=0.0, dtype=jnp.float32)
+    x = jnp.asarray(
+        np.random.default_rng(0).random((2, 16, 16, 3)), jnp.float32
+    )
+    m0, m1 = build_vit(**kw), build_vit(remat=True, **kw)
+    params = m0.init({"params": jax.random.key(0)}, x)["params"]
+
+    def loss(m, p):
+        return m.apply({"params": p}, x, train=False).sum()
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(m0, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(m1, p))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)

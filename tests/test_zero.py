@@ -85,3 +85,32 @@ def test_zero_validates():
     mesh = build_mesh(MeshSpec(data=8, model=1))
     with pytest.raises(ValueError):
         SpmdTrainer(_tiny_vit(), TrainConfig(), mesh=mesh, zero="zero9")
+
+
+def test_zero1_with_frozen_backbone_masked_optimizer():
+    """optax.masked rewrites the moment tree's structure (MaskedNode),
+    which used to defeat ZeRO spec assignment silently — moments came
+    back fully replicated. The path-suffix matcher must still shard the
+    TRAINABLE (head) moments over the data axis."""
+    from tpuflow.models import build_model
+
+    mesh = build_mesh(
+        MeshSpec(data=4, model=1), devices=jax.devices()[:4]
+    )
+    tr = SpmdTrainer(
+        build_model(num_classes=5, dropout=0.0, width_mult=0.25),
+        TrainConfig(learning_rate=1e-3, warmup_epochs=0, seed=0),
+        mesh=mesh,
+        zero="zero1",
+    )
+    tr.init_state((32, 32, 3))
+    mu = _moment_leaf(tr.state.opt_state, needle="head")
+    assert "data" in tuple(mu.sharding.spec), mu.sharding
+    # training still steps finitely with the masked+sharded optimizer
+    tr._make_steps()
+    images, labels = _batch()
+    img_d, lab_d = tr._put({"image": images, "label": labels})
+    state, m = tr._train_step(
+        tr.state, img_d, lab_d, jnp.asarray(1e-3, jnp.float32)
+    )
+    assert np.isfinite(float(m["loss"]))

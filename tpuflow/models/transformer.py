@@ -255,6 +255,7 @@ class TransformerLM(nn.Module):
     moe_top_k: int = 2
     ep_axis: Optional[str] = None
     decode: bool = False  # autoregressive KV-cache mode (see infer.generate)
+    remat: bool = False  # gradient checkpointing per block (long context)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -266,10 +267,19 @@ class TransformerLM(nn.Module):
             jnp.float32,
         )
         x = jnp.take(embed, tokens, axis=0).astype(self.dtype)
+        # remat trades FLOPs for HBM: block activations are recomputed
+        # in the backward instead of stored — O(sqrt-free) memory per
+        # layer, the standard long-context lever (pairs with the ring's
+        # O(seq/sp) residency). Not in decode mode: the KV cache is a
+        # mutable collection, which lifted remat must not replay.
+        block_cls = (
+            nn.remat(DecoderBlock) if self.remat and not self.decode
+            else DecoderBlock
+        )
         for i in range(self.depth):
             moe_block = self.n_experts > 0 and (i % self.moe_every
                                                 == self.moe_every - 1)
-            x = DecoderBlock(
+            x = block_cls(
                 self.dim, self.heads, self.mlp_ratio, self.dtype,
                 self.attn_impl, self.seq_axis, self.rope_theta,
                 n_experts=self.n_experts if moe_block else 0,
@@ -301,6 +311,7 @@ def build_transformer_lm(
     moe_every: int = 2,
     moe_top_k: int = 2,
     ep_axis: Optional[str] = None,
+    remat: bool = False,
 ) -> TransformerLM:
     if dim % heads:
         raise ValueError("dim must be a multiple of heads")
@@ -310,7 +321,7 @@ def build_transformer_lm(
         vocab_size=vocab_size, dim=dim, depth=depth, heads=heads,
         mlp_ratio=mlp_ratio, dtype=dtype, attn_impl=attn_impl,
         seq_axis=seq_axis, n_experts=n_experts, moe_every=moe_every,
-        moe_top_k=moe_top_k, ep_axis=ep_axis,
+        moe_top_k=moe_top_k, ep_axis=ep_axis, remat=remat,
     )
 
 

@@ -165,6 +165,7 @@ class ViTClassifier(nn.Module):
     attn_impl: str = "auto"
     seq_axis: Optional[str] = None
     freeze_backbone: bool = False  # API parity with TransferClassifier
+    remat: bool = False  # gradient checkpointing per block
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -208,8 +209,19 @@ class ViTClassifier(nn.Module):
         x = x + pos.astype(self.dtype)
         x = nn.Dropout(self.dropout)(x, deterministic=not train)
 
+        # remat: recompute block activations in the backward instead of
+        # storing them — HBM for FLOPs, the long-context/memory lever.
+        # ``deterministic`` must stay a PYTHON bool through the
+        # checkpoint boundary (flax Dropout branches on it): pass it
+        # POSITIONALLY (static_argnums cannot mark kwargs) and mark
+        # argnum 2 static — linen numbering counts the module itself,
+        # so (module, x, deterministic) → 2.
+        block_cls = (
+            nn.remat(ViTBlock, static_argnums=(2,)) if self.remat
+            else ViTBlock
+        )
         for i in range(self.depth):
-            x = ViTBlock(
+            x = block_cls(
                 self.width,
                 self.heads,
                 self.mlp_ratio,
@@ -218,7 +230,7 @@ class ViTClassifier(nn.Module):
                 self.attn_impl,
                 self.seq_axis,
                 name=f"block{i}",
-            )(x, deterministic=not train)
+            )(x, not train)
 
         x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
         if self.seq_axis is not None:
@@ -246,6 +258,7 @@ def build_vit(
     dtype: Any = jnp.bfloat16,
     attn_impl: str = "auto",
     seq_axis: Optional[str] = None,
+    remat: bool = False,
 ) -> ViTClassifier:
     if img_size % patch_size:
         raise ValueError("img_size must be a multiple of patch_size")
@@ -261,4 +274,5 @@ def build_vit(
         dtype=dtype,
         attn_impl=attn_impl,
         seq_axis=seq_axis,
+        remat=remat,
     )

@@ -61,23 +61,55 @@ def shard_over_data(spec_tree, abstract_params, data_size: int):
     )
 
 
-def _specs_like(tree, param_specs, params_def):
-    """Spec tree for a state pytree: any subtree structured exactly like
-    params (optimizer moments) inherits the param specs; every other
-    leaf is replicated."""
+def _path_keys(path) -> tuple:
+    """KeyPath → tuple of plain string keys."""
+    out = []
+    for k in path:
+        if hasattr(k, "key"):
+            out.append(str(k.key))
+        elif hasattr(k, "name"):
+            out.append(str(k.name))
+        elif hasattr(k, "idx"):
+            out.append(str(k.idx))
+        else:
+            out.append(str(k))
+    return tuple(out)
 
-    def is_param_tree(node):
-        try:
-            return jax.tree.structure(node) == params_def
-        except Exception:
-            return False
 
-    def sub(node):
-        if is_param_tree(node):
-            return param_specs
-        return jax.tree.map(lambda _: P(), node)
+def _specs_like(tree, param_specs, abstract_params):
+    """Spec tree for a state pytree: optimizer moments inherit their
+    parameter's spec; every other leaf is replicated.
 
-    return jax.tree.map(sub, tree, is_leaf=is_param_tree)
+    Moments are recognized by PATH SUFFIX + shape: a state leaf at
+    ``('inner_state', '0', 'mu', 'backbone', 'conv', 'kernel')`` ends
+    with the param path ``('backbone', 'conv', 'kernel')`` and has its
+    shape. This sees through optax wrapper states — ``optax.masked``
+    (the frozen-backbone optimizer) rewrites the moment tree's
+    STRUCTURE (MaskedNode placeholders), so the previous
+    whole-tree-structure match silently fell back to replicated,
+    disabling ZeRO sharding for any masked optimizer.
+    """
+    from jax.tree_util import (tree_flatten_with_path, tree_map_with_path)
+
+    flat_specs, _ = tree_flatten_with_path(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    flat_params, _ = tree_flatten_with_path(abstract_params)
+    spec_by_path = {_path_keys(p): s for p, s in flat_specs}
+    shape_by_path = {
+        _path_keys(p): tuple(leaf.shape) for p, leaf in flat_params
+    }
+
+    def assign(path, leaf):
+        keys = _path_keys(path)
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        for i in range(len(keys)):
+            suf = keys[i:]
+            if suf in spec_by_path and shape_by_path.get(suf) == shape:
+                return spec_by_path[suf]
+        return P()
+
+    return tree_map_with_path(assign, tree)
 
 
 class SpmdTrainer(Trainer):
@@ -123,7 +155,6 @@ class SpmdTrainer(Trainer):
             jax.random.key(cfg.seed),
         )
         param_specs = nn.get_partition_spec(boxed)["params"]
-        params_def = jax.tree.structure(param_specs)
 
         mask = (
             backbone_param_mask(nn.unbox(boxed)["params"])
@@ -137,10 +168,10 @@ class SpmdTrainer(Trainer):
         )
 
         abstract = jax.eval_shape(make_state, jax.random.key(cfg.seed))
+        abstract_params = nn.unbox(boxed)["params"]
         opt_param_specs = param_specs
         if self.zero in ("zero1", "fsdp"):
             data_size = self.mesh.shape[DATA_AXIS]
-            abstract_params = nn.unbox(boxed)["params"]
             opt_param_specs = shard_over_data(
                 param_specs, abstract_params, data_size
             )
@@ -151,7 +182,7 @@ class SpmdTrainer(Trainer):
             params=param_specs,
             batch_stats=jax.tree.map(lambda _: P(), abstract.batch_stats),
             opt_state=_specs_like(
-                abstract.opt_state, opt_param_specs, params_def
+                abstract.opt_state, opt_param_specs, abstract_params
             ),
             rng=P(),
             plateau_factor=P(),
