@@ -196,6 +196,10 @@ def main() -> int:
                    help="watchdog: emit an error JSON line and exit if "
                         "the bench has not finished by then")
     p.add_argument("--no-attn-diag", action="store_true")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the timed steps "
+                        "into DIR (view in Perfetto/TensorBoard) — the "
+                        "op-level evidence behind MFU_ANALYSIS.md")
     p.add_argument("--model", choices=["cnn", "vit"], default="cnn",
                    help="cnn = flagship MobileNetV2 transfer config "
                         "(the reference's P1/03 parity target); vit = "
@@ -309,6 +313,14 @@ def _bench(args) -> int:
     jax.block_until_ready(m)
     dt = (time.time() - t0) / args.steps
 
+    if args.trace:
+        # profile a few EXTRA steps after the timed loop — capture
+        # overhead must not contaminate the reported step time/MFU
+        with jax.profiler.trace(args.trace):
+            for _ in range(min(5, args.steps)):
+                state, m = trainer._train_step(state, images, labels, lr)
+            jax.block_until_ready(m)
+
     img_per_sec_chip = global_batch / dt / n_chips
     peak = device_peak_flops(devices[0])
     mfu_val = (flops / dt) / (n_chips * peak) if flops else 0.0
@@ -326,6 +338,8 @@ def _bench(args) -> int:
         "decode_img_per_s": round(_decode_diag(hw), 0),
         "loss": round(float(m["loss"]), 4),
     }
+    if args.trace:
+        diag["trace_dir"] = args.trace  # captured AFTER the timed loop
     if not args.no_attn_diag:
         _attention_diag(diag, small=args.smoke)
 
