@@ -1,4 +1,5 @@
 from tpuflow.models.mobilenet_v2 import MobileNetV2  # noqa: F401
+from tpuflow.models.resnet import ResNet, build_resnet  # noqa: F401
 from tpuflow.models.classifier import (  # noqa: F401
     TransferClassifier,
     build_model,

@@ -35,15 +35,29 @@ class TransferClassifier(nn.Module):
     # canonical npz); applied by Trainer.init_state after module init —
     # ≙ the Keras default weights='imagenet' (P1/02:164-169)
     weights: Optional[str] = None
+    # 'mobilenet_v2' (reference parity) | 'resnet18' | 'resnet34' |
+    # 'resnet50' — every backbone shares the freeze/pretrained/trainer
+    # machinery (params live under the BACKBONE subtree)
+    backbone: str = "mobilenet_v2"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         # Frozen backbone always runs with train=False: BN uses running
         # averages and batch_stats stay immutable (Keras trainable=False).
         bb_train = train and not self.freeze_backbone
-        feats = MobileNetV2(self.width_mult, dtype=self.dtype, name=BACKBONE)(
-            x, train=bb_train
-        )
+        if self.backbone == "mobilenet_v2":
+            bb = MobileNetV2(self.width_mult, dtype=self.dtype, name=BACKBONE)
+        elif self.backbone in ("resnet18", "resnet34", "resnet50"):
+            from tpuflow.models.resnet import ResNet
+
+            bb = ResNet(int(self.backbone[len("resnet"):]), dtype=self.dtype,
+                        name=BACKBONE)
+        else:
+            raise ValueError(
+                f"unknown backbone {self.backbone!r}; expected "
+                "'mobilenet_v2', 'resnet18', 'resnet34', or 'resnet50'"
+            )
+        feats = bb(x, train=bb_train)
         x = jnp.mean(feats, axis=(1, 2))  # GlobalAveragePooling2D
         x = nn.Dropout(self.dropout, name="head_dropout")(
             x, deterministic=not train
@@ -66,6 +80,7 @@ def build_model(
     freeze_backbone: bool = True,
     dtype: Any = jnp.bfloat16,
     weights: Optional[str] = None,
+    backbone: str = "mobilenet_v2",
 ) -> TransferClassifier:
     """≙ build_model(img_height, img_width, img_channels, num_classes)
     (P1/02:159-178). Image size/channels are carried by the data, not the
@@ -85,6 +100,7 @@ def build_model(
         freeze_backbone=freeze_backbone,
         dtype=dtype,
         weights=weights,
+        backbone=backbone,
     )
 
 

@@ -46,12 +46,24 @@ def make_divisible(v: float, divisor: int = 8) -> int:
 
 
 class ConvBN(nn.Module):
+    """Conv → BatchNorm → activation, shared by the CNN backbones.
+
+    Defaults are the MobileNetV2 conventions (SAME padding, BN
+    momentum 0.999/eps 1e-3, ReLU6); ResNet overrides them
+    (tpuflow/models/resnet.py). ``act_fn`` takes precedence over the
+    boolean ``act`` (which selects ReLU6) when set.
+    """
+
     features: int
     kernel: Tuple[int, int] = (3, 3)
     strides: Tuple[int, int] = (1, 1)
     groups: int = 1
     act: bool = True
     dtype: Dtype = jnp.bfloat16
+    momentum: float = 0.999
+    epsilon: float = 1e-3
+    act_fn: Any = None
+    padding: Any = "SAME"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -59,7 +71,7 @@ class ConvBN(nn.Module):
             self.features,
             self.kernel,
             strides=self.strides,
-            padding="SAME",
+            padding=self.padding,
             use_bias=False,
             feature_group_count=self.groups,
             dtype=self.dtype,
@@ -67,12 +79,14 @@ class ConvBN(nn.Module):
         )(x)
         x = nn.BatchNorm(
             use_running_average=not train,
-            momentum=0.999,
-            epsilon=1e-3,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
             dtype=self.dtype,
             name="bn",
         )(x)
-        if self.act:
+        if self.act_fn is not None:
+            x = self.act_fn(x)
+        elif self.act:
             x = jnp.minimum(jnp.maximum(x, 0.0), 6.0)  # ReLU6
         return x
 

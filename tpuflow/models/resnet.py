@@ -1,0 +1,134 @@
+"""ResNet family in Flax — a second CNN backbone beyond the reference.
+
+The reference's only model is MobileNetV2
+(P1/02_model_training_single_node.py:164-169); tpuflow adds ResNet-18/
+34/50 as drop-in backbones for the same transfer-learning classifier
+(``build_model(backbone='resnet50')``), sharing the freeze semantics,
+trainers, and packaging unchanged.
+
+TPU-first choices mirror mobilenet_v2.py: NHWC layout, bfloat16 compute
+with float32 parameters/BN statistics, ReLU left to XLA fusion, static
+shapes. Architecture follows He et al. 2015 (v1.5 variant: stride in
+the 3x3 of the bottleneck, as torchvision ships), with EXPLICIT
+symmetric padding (k//2 per side) matching torch's conv convention —
+XLA's 'SAME' pads stride-2 convs asymmetrically, which would shift
+features relative to weights converted from torchvision. (A
+torchvision→npz converter is not bundled yet; the canonical-npz merge
+in models/pretrained.py is path-based and architecture-agnostic.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpuflow.models.mobilenet_v2 import ConvBN
+
+Dtype = Any
+
+# depth → (block type, stage repeats)
+_CONFIGS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+}
+_STAGE_WIDTHS = (64, 128, 256, 512)
+
+
+def _cbn(features, kernel=(3, 3), strides=(1, 1), act=True,
+         dtype=jnp.bfloat16, name=None):
+    """ResNet-convention ConvBN: BN momentum 0.9 / eps 1e-5 (torch
+    defaults), plain ReLU, symmetric k//2 padding."""
+    k = kernel[0]
+    return ConvBN(
+        features,
+        kernel,
+        strides=strides,
+        act=False,
+        act_fn=nn.relu if act else None,
+        dtype=dtype,
+        momentum=0.9,
+        epsilon=1e-5,
+        padding=((k // 2, k // 2), (k // 2, k // 2)),
+        name=name,
+    )
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int]
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = _cbn(self.features, (3, 3), self.strides, dtype=self.dtype,
+                 name="conv1")(x, train)
+        y = _cbn(self.features, (3, 3), act=False, dtype=self.dtype,
+                 name="conv2")(y, train)
+        if self.strides != (1, 1) or x.shape[-1] != self.features:
+            x = _cbn(self.features, (1, 1), self.strides, act=False,
+                     dtype=self.dtype, name="down")(x, train)
+        return nn.relu(x + y)
+
+
+class Bottleneck(nn.Module):
+    features: int  # output width (4x the inner width)
+    strides: Tuple[int, int]
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        inner = self.features // 4
+        y = _cbn(inner, (1, 1), dtype=self.dtype, name="conv1")(x, train)
+        # v1.5: stride lives on the 3x3 (torchvision), not the first 1x1
+        y = _cbn(inner, (3, 3), self.strides, dtype=self.dtype,
+                 name="conv2")(y, train)
+        y = _cbn(self.features, (1, 1), act=False, dtype=self.dtype,
+                 name="conv3")(y, train)
+        if self.strides != (1, 1) or x.shape[-1] != self.features:
+            x = _cbn(self.features, (1, 1), self.strides, act=False,
+                     dtype=self.dtype, name="down")(x, train)
+        return nn.relu(x + y)
+
+
+class ResNet(nn.Module):
+    """Feature extractor (``include_top=False`` form).
+
+    Output: [N, H/32, W/32, C_last] feature map (C_last = 512 for
+    18/34, 2048 for 50). Inputs preprocessed to [-1, 1]
+    (tpuflow.models.preprocess) — same contract as MobileNetV2.
+    """
+
+    depth: int = 50
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.depth not in _CONFIGS:
+            raise ValueError(
+                f"depth must be one of {sorted(_CONFIGS)}, got {self.depth}"
+            )
+        kind, repeats = _CONFIGS[self.depth]
+        block = BasicBlock if kind == "basic" else Bottleneck
+        expansion = 1 if kind == "basic" else 4
+
+        x = x.astype(self.dtype)
+        x = _cbn(64, (7, 7), strides=(2, 2), dtype=self.dtype,
+                 name="stem")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for si, (w, n) in enumerate(zip(_STAGE_WIDTHS, repeats)):
+            for bi in range(n):
+                strides = (2, 2) if (si > 0 and bi == 0) else (1, 1)
+                x = block(
+                    w * expansion,
+                    strides=strides,
+                    dtype=self.dtype,
+                    name=f"stage{si}_block{bi}",
+                )(x, train)
+        return x
+
+
+def build_resnet(depth: int = 50, dtype: Dtype = jnp.bfloat16) -> ResNet:
+    return ResNet(depth=depth, dtype=dtype)

@@ -55,6 +55,7 @@ def _default_builders():
                 dropout=cfg.get("dropout", 0.0),
                 width_mult=cfg.get("width_mult", 1.0),
                 freeze_backbone=cfg.get("freeze_backbone", True),
+                backbone=cfg.get("backbone", "mobilenet_v2"),
             ),
         )
 

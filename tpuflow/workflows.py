@@ -131,6 +131,7 @@ def train_and_evaluate(
             width_mult=cfg.model.width_mult,
             freeze_backbone=cfg.model.freeze_backbone,
             weights=cfg.model.weights,
+            backbone=cfg.model.backbone,
         )
 
     run = None
@@ -257,6 +258,7 @@ def train_and_package(
                 "dropout": cfg.model.dropout,
                 "width_mult": cfg.model.width_mult,
                 "freeze_backbone": cfg.model.freeze_backbone,
+                "backbone": cfg.model.backbone,
             },
         )
         run.log_params(cfg.flat_params())
