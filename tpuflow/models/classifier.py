@@ -21,6 +21,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from tpuflow.models.mobilenet_v2 import MobileNetV2
+from tpuflow.models.resnet import ResNet
 
 BACKBONE = "backbone"
 
@@ -48,8 +49,6 @@ class TransferClassifier(nn.Module):
         if self.backbone == "mobilenet_v2":
             bb = MobileNetV2(self.width_mult, dtype=self.dtype, name=BACKBONE)
         elif self.backbone in ("resnet18", "resnet34", "resnet50"):
-            from tpuflow.models.resnet import ResNet
-
             bb = ResNet(int(self.backbone[len("resnet"):]), dtype=self.dtype,
                         name=BACKBONE)
         else:
