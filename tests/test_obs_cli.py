@@ -122,3 +122,52 @@ def test_local_cluster_gang_failure(tmp_path):
     )
     rc = main(["--local", "2", "--port", "8914", "--", sys.executable, str(script)])
     assert rc != 0
+
+
+@pytest.mark.slow
+def test_local_cluster_gang_restart(tmp_path):
+    """--restarts: a gang that crashes once is relaunched whole and
+    succeeds on the second attempt (the §5.3 restart story; with
+    checkpoints the relaunched job resumes — test_workflows covers the
+    resume math, this covers the launcher loop)."""
+    from tpuflow.cli.launch import main
+
+    marker = tmp_path / "crashed_once"
+    script = tmp_path / "flaky.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        marker = {str(marker)!r}
+        if not os.path.exists(marker):
+            if os.environ["TPUFLOW_PROCESS_ID"] == "1":
+                open(marker, "w").close()
+                sys.exit(7)          # first attempt: one worker dies
+            import time; time.sleep(30)   # peers wait for the gang kill
+        # second attempt: the full gang runs a real collective
+        sys.path.insert(0, os.environ["TPUFLOW_REPO"])
+        import tpuflow.core as core
+        core.initialize()
+        import jax, numpy as np
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()).reshape(2), ("data",))
+        own = jnp.ones((1,)) * (jax.process_index() + 1)
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("data")), np.asarray(own))
+        assert float(jax.jit(jnp.sum)(arr)) == 3.0
+        open(os.path.join(os.path.dirname(marker),
+                          f"ok_{{os.environ['TPUFLOW_PROCESS_ID']}}"),
+             "w").close()
+    """))
+    env_backup = dict(os.environ)
+    os.environ["TPUFLOW_REPO"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    try:
+        rc = main(["--local", "2", "--port", "8921", "--restarts", "2",
+                   "--", sys.executable, str(script)])
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+    assert rc == 0
+    assert marker.exists()
+    assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()

@@ -16,7 +16,13 @@ BarrierTaskContext IP gather, ``mpirun`` one python per worker
 
 Gang semantics (≙ Spark barrier mode, P1/03:256): with --local, if any
 process exits non-zero the launcher terminates the rest and exits
-non-zero — all-or-nothing, no half-alive training jobs.
+non-zero — all-or-nothing, no half-alive training jobs. ``--restarts N``
+completes the failure story (SURVEY.md §5.3): after a gang failure the
+whole gang is relaunched (fresh coordinator port) up to N times; paired
+with ``Trainer.maybe_resume`` the job continues from its last
+checkpoint — the restart half the reference's barrier mode leaves to
+the operator. On real pods the same contract holds per host: have the
+cluster manager re-run the identical command line.
 
 Usage:
   python -m tpuflow.cli.launch --local 4 -- python train_script.py
@@ -32,6 +38,7 @@ import os
 import signal
 import subprocess
 import sys
+import time
 from typing import List
 
 
@@ -46,6 +53,9 @@ def _parse(argv: List[str]) -> tuple:
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--port", type=int, default=8476)
+    p.add_argument("--restarts", type=int, default=0,
+                   help="relaunch the gang up to N times after a failure "
+                        "(checkpoint resume continues the run)")
     if "--" not in argv:
         p.error("command required after --")
     split = argv.index("--")
@@ -82,6 +92,7 @@ def _run_local_cluster(n: int, port: int, cmd: List[str]) -> int:
         env["TPUFLOW_PROCESS_ID"] = str(i)
         procs.append(subprocess.Popen(cmd, env=env))
     rc = 0
+    interrupted = None
     try:
         remaining = set(range(n))
         while remaining:
@@ -92,23 +103,50 @@ def _run_local_cluster(n: int, port: int, cmd: List[str]) -> int:
                     if code != 0:
                         rc = code
                         raise RuntimeError(f"process {i} exited {code}")
-            import time
-
             time.sleep(0.2)
-    except (RuntimeError, KeyboardInterrupt):
+    except (RuntimeError, KeyboardInterrupt) as e:
+        if isinstance(e, KeyboardInterrupt):
+            interrupted = e
         rc = rc or 1
         for pr in procs:
             if pr.poll() is None:
                 pr.send_signal(signal.SIGTERM)
         for pr in procs:
             pr.wait(timeout=30)
+    if interrupted is not None:
+        # a deliberate Ctrl-C must not look like a gang failure (the
+        # --restarts loop would relaunch the job the user just killed)
+        raise interrupted
     return rc
 
 
 def main(argv: List[str] | None = None) -> int:
     args, cmd = _parse(argv if argv is not None else sys.argv[1:])
     if args.local and args.local > 0:
-        return _run_local_cluster(args.local, args.port, cmd)
+        rc = 0
+        for attempt in range(max(0, args.restarts) + 1):
+            # fresh port per attempt: the previous coordinator socket can
+            # linger in TIME_WAIT and refuse the bind
+            rc = _run_local_cluster(args.local, args.port + attempt, cmd)
+            if rc == 0:
+                return 0
+            if attempt < args.restarts:
+                print(
+                    f"tpuflow.launch: gang failed (rc={rc}); relaunching "
+                    f"(attempt {attempt + 2}/{args.restarts + 1})",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                time.sleep(1.0)
+        return rc
+    if args.restarts:
+        print(
+            "tpuflow.launch: --restarts only drives the --local gang; on "
+            "real pods have the cluster manager re-run this command "
+            "(resume picks up the checkpoints)",
+            file=sys.stderr,
+            flush=True,
+        )
     env = dict(os.environ)
     if args.np == -1 or (
         args.coordinator is None and not args.local
