@@ -112,7 +112,13 @@ def _run_local_cluster(n: int, port: int, cmd: List[str]) -> int:
             if pr.poll() is None:
                 pr.send_signal(signal.SIGTERM)
         for pr in procs:
-            pr.wait(timeout=30)
+            try:
+                pr.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                # a worker wedged in its SIGTERM handler must not hang
+                # the launcher (or orphan peers) — escalate
+                pr.kill()
+                pr.wait(timeout=10)
     if interrupted is not None:
         # a deliberate Ctrl-C must not look like a gang failure (the
         # --restarts loop would relaunch the job the user just killed)

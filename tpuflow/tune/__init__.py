@@ -1,3 +1,8 @@
 from tpuflow.tune.space import hp  # noqa: F401
 from tpuflow.tune.fmin import fmin, STATUS_OK  # noqa: F401
-from tpuflow.tune.trials import ParallelTrials, Trials  # noqa: F401
+from tpuflow.tune.trials import (  # noqa: F401
+    ParallelTrials,
+    STATUS_PRUNED,
+    Trials,
+)
+from tpuflow.tune.pruning import MedianPruner, Pruned  # noqa: F401

@@ -24,6 +24,7 @@ def fmin(
     trials: Optional[Trials] = None,
     seed: int = 0,
     verbose: bool = False,
+    pruner=None,
 ) -> Dict[str, Any]:
     """Minimize ``fn`` over ``space``; returns the best params dict.
 
@@ -31,6 +32,12 @@ def fmin(
     distributed, ≙ P2/02:341-344) or ParallelTrials (concurrent
     single-device trials, ≙ SparkTrials). Inspect ``trials.results``
     afterwards for the full record.
+
+    ``pruner``: e.g. ``tune.pruning.MedianPruner()`` — early-stops
+    unpromising trials whose objective reports intermediate values via
+    a ``report(step, value)`` keyword (see tpuflow/tune/pruning.py;
+    beyond the reference, whose Hyperopt always runs trials to the
+    end).
     """
     trials = trials if trials is not None else Trials()
     import numpy as np
@@ -51,12 +58,16 @@ def fmin(
             # Parzen model; sampling stochasticity diversifies the batch
             history = history + [(params, float("inf"))]
             batch.append(params)
-        new = trials.run_batch(fn, batch, tid)
+        new = trials.run_batch(fn, batch, tid, pruner=pruner)
         tid += len(new)
         if verbose:
+            from tpuflow.tune.trials import STATUS_PRUNED
+
             for t in new:
                 msg = f"trial {t.tid}: loss={t.loss:.5f} params={t.params}"
-                if t.status != STATUS_OK:
+                if t.status == STATUS_PRUNED:
+                    msg += f" pruned at step {t.extra.get('pruned_at', '?')}"
+                elif t.status != STATUS_OK:
                     msg += f" FAILED: {t.extra.get('error', 'unknown')}"
                 print(msg)
     return trials.best().params

@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 STATUS_OK = "ok"
 STATUS_FAIL = "fail"
+STATUS_PRUNED = "pruned"  # stopped early by a pruner (tune.pruning)
 
 
 @dataclass
@@ -60,11 +61,20 @@ class Trials:
     # -- execution --------------------------------------------------------
 
     def run_batch(
-        self, fn: Callable, batch: List[Dict[str, Any]], start_tid: int
+        self, fn: Callable, batch: List[Dict[str, Any]], start_tid: int,
+        pruner=None,
     ) -> List[TrialResult]:
         out = []
         for i, params in enumerate(batch):
-            out.append(self.record(start_tid + i, params, _safe_call(fn, params)))
+            tid = start_tid + i
+            kw = _pruner_kwargs(fn, pruner, tid)
+            tr = self.record(tid, params, _safe_call(fn, params, **kw))
+            if pruner is not None:
+                if tr.status == STATUS_OK:
+                    pruner.finish(tid)
+                else:
+                    pruner.discard(tid)
+            out.append(tr)
         return out
 
     def suggest_batch_size(self) -> int:
@@ -92,19 +102,24 @@ class ParallelTrials(Trials):
     def suggest_batch_size(self) -> int:
         return self.parallelism
 
-    def run_batch(self, fn, batch, start_tid) -> List[TrialResult]:
+    def run_batch(self, fn, batch, start_tid, pruner=None) -> List[TrialResult]:
         import inspect
 
         takes_devices = "devices" in inspect.signature(fn).parameters
         results: List[Optional[TrialResult]] = [None] * len(batch)
 
         def one(i: int, params):
-            group = self.device_groups[i % len(self.device_groups)]
+            tid = start_tid + i
+            kw = _pruner_kwargs(fn, pruner, tid)
             if takes_devices:
-                outcome = _safe_call(fn, params, devices=group)
-            else:
-                outcome = _safe_call(fn, params)
-            results[i] = self.record(start_tid + i, params, outcome)
+                kw["devices"] = self.device_groups[i % len(self.device_groups)]
+            outcome = _safe_call(fn, params, **kw)
+            results[i] = self.record(tid, params, outcome)
+            if pruner is not None:
+                if results[i].status == STATUS_OK:
+                    pruner.finish(tid)
+                else:
+                    pruner.discard(tid)
 
         with ThreadPoolExecutor(max_workers=self.parallelism) as ex:
             futs = [ex.submit(one, i, p) for i, p in enumerate(batch)]
@@ -113,9 +128,29 @@ class ParallelTrials(Trials):
         return [r for r in results if r is not None]
 
 
+def _pruner_kwargs(fn, pruner, tid) -> Dict[str, Any]:
+    """The ``report`` hook, bound to this trial — only when the
+    objective declares the keyword (same convention as ``devices``)."""
+    import inspect
+
+    if "report" not in inspect.signature(fn).parameters:
+        return {}
+    if pruner is None:
+        return {"report": None}
+    return {"report": lambda step, value: pruner.report(tid, step, value)}
+
+
 def _safe_call(fn, params, **kw):
+    from tpuflow.tune.pruning import Pruned
+
     try:
         return fn(params, **kw)
+    except Pruned as p:  # early stop, not a failure: keep the signal
+        return {
+            "loss": p.best_value,
+            "status": STATUS_PRUNED,
+            "pruned_at": p.step,
+        }
     except Exception as e:  # a failed trial must not kill the sweep
         return {
             "loss": float("inf"),
