@@ -116,9 +116,19 @@ def _run_local_cluster(n: int, port: int, cmd: List[str]) -> int:
                 pr.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 # a worker wedged in its SIGTERM handler must not hang
-                # the launcher (or orphan peers) — escalate
+                # the launcher (or orphan peers) — escalate; and a
+                # worker that survives even SIGKILL (D-state I/O) must
+                # not abort the reap loop for its peers
                 pr.kill()
-                pr.wait(timeout=10)
+                try:
+                    pr.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    print(
+                        f"tpuflow.launch: pid {pr.pid} unkillable "
+                        "(uninterruptible state); abandoning",
+                        file=sys.stderr,
+                        flush=True,
+                    )
     if interrupted is not None:
         # a deliberate Ctrl-C must not look like a gang failure (the
         # --restarts loop would relaunch the job the user just killed)

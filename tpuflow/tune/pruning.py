@@ -78,8 +78,9 @@ class MedianPruner:
             peers = [p for p in peers if p != float("inf")]
             if not peers:
                 return
-            peers.sort()
-            median = peers[len(peers) // 2]
+            import statistics
+
+            median = statistics.median(peers)
             mine = self._best_through(rec, step)
             if mine > median:
                 # drop the live record before raising: a reused pruner

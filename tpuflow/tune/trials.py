@@ -64,16 +64,13 @@ class Trials:
         self, fn: Callable, batch: List[Dict[str, Any]], start_tid: int,
         pruner=None,
     ) -> List[TrialResult]:
+        takes_report = _takes_report(fn)
         out = []
         for i, params in enumerate(batch):
             tid = start_tid + i
-            kw = _pruner_kwargs(fn, pruner, tid)
+            kw = _report_kw(takes_report, pruner, tid)
             tr = self.record(tid, params, _safe_call(fn, params, **kw))
-            if pruner is not None:
-                if tr.status == STATUS_OK:
-                    pruner.finish(tid)
-                else:
-                    pruner.discard(tid)
+            _settle_pruner(pruner, tid, tr.status)
             out.append(tr)
         return out
 
@@ -106,20 +103,17 @@ class ParallelTrials(Trials):
         import inspect
 
         takes_devices = "devices" in inspect.signature(fn).parameters
+        takes_report = _takes_report(fn)
         results: List[Optional[TrialResult]] = [None] * len(batch)
 
         def one(i: int, params):
             tid = start_tid + i
-            kw = _pruner_kwargs(fn, pruner, tid)
+            kw = _report_kw(takes_report, pruner, tid)
             if takes_devices:
                 kw["devices"] = self.device_groups[i % len(self.device_groups)]
             outcome = _safe_call(fn, params, **kw)
             results[i] = self.record(tid, params, outcome)
-            if pruner is not None:
-                if results[i].status == STATUS_OK:
-                    pruner.finish(tid)
-                else:
-                    pruner.discard(tid)
+            _settle_pruner(pruner, tid, results[i].status)
 
         with ThreadPoolExecutor(max_workers=self.parallelism) as ex:
             futs = [ex.submit(one, i, p) for i, p in enumerate(batch)]
@@ -128,16 +122,31 @@ class ParallelTrials(Trials):
         return [r for r in results if r is not None]
 
 
-def _pruner_kwargs(fn, pruner, tid) -> Dict[str, Any]:
-    """The ``report`` hook, bound to this trial — only when the
-    objective declares the keyword (same convention as ``devices``)."""
+def _takes_report(fn) -> bool:
     import inspect
 
-    if "report" not in inspect.signature(fn).parameters:
+    return "report" in inspect.signature(fn).parameters
+
+
+def _report_kw(takes_report: bool, pruner, tid) -> Dict[str, Any]:
+    """The ``report`` hook, bound to this trial — only when the
+    objective declares the keyword (same convention as ``devices``)."""
+    if not takes_report:
         return {}
     if pruner is None:
         return {"report": None}
     return {"report": lambda step, value: pruner.report(tid, step, value)}
+
+
+def _settle_pruner(pruner, tid: int, status: str) -> None:
+    """Completion protocol: finished trials join the pruner's median
+    set; pruned/failed trials are forgotten (no id collisions later)."""
+    if pruner is None:
+        return
+    if status == STATUS_OK:
+        pruner.finish(tid)
+    else:
+        pruner.discard(tid)
 
 
 def _safe_call(fn, params, **kw):
