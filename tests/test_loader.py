@@ -215,3 +215,38 @@ def test_streaming_no_shuffle_preserves_order(silver_table):
     st = [b["label"].tolist() for b in
           make_dataset(silver_table, streaming=True, shuffle_buffer=8, **kw)]
     assert st == mem  # exact table order in both residency modes
+
+
+def test_cache_decoded_identical_and_skips_decode(silver_table):
+    """cache_decoded: batches bitwise-match the uncached loader; after
+    epoch 1 the native decoder is never called again."""
+    from tpuflow.data.loader import Dataset
+
+    files = silver_table.files()
+    kw = dict(batch_size=4, img_height=32, img_width=32, shuffle=True,
+              seed=11, infinite=False)
+    plain = Dataset(files, **kw)
+    cached = Dataset(files, cache_decoded=True, **kw)
+
+    for epoch in range(3):
+        b_plain = list(plain)
+        b_cached = list(cached)
+        assert len(b_plain) == len(b_cached) > 0
+        for a, b in zip(b_plain, b_cached):
+            np.testing.assert_array_equal(a["image"], b["image"])
+            np.testing.assert_array_equal(a["label"], b["label"])
+
+    n_rows = len(cached)
+    rows_per_epoch = (n_rows // 4) * 4
+    # epoch 1 decoded each emitted row once; epochs 2-3 decoded nothing
+    assert cached.decode_calls <= n_rows, (cached.decode_calls, n_rows)
+    assert cached.decode_calls >= rows_per_epoch
+    assert plain.decode_calls >= 3 * rows_per_epoch
+
+
+def test_cache_decoded_rejects_streaming(silver_table):
+    from tpuflow.data.loader import Dataset
+
+    with pytest.raises(ValueError):
+        Dataset(silver_table.files(), batch_size=4, streaming=True,
+                cache_decoded=True)

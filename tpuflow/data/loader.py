@@ -112,6 +112,7 @@ class Dataset:
         streaming: bool = False,
         shuffle_buffer: int = 2048,
         reuse_buffers: bool = False,
+        cache_decoded: bool = False,
     ):
         self.files = list(files)
         self.batch_size = batch_size
@@ -136,8 +137,22 @@ class Dataset:
         self.streaming = streaming
         self.shuffle_buffer = max(1, shuffle_buffer)
         self.reuse_buffers = reuse_buffers
+        if cache_decoded and streaming:
+            raise ValueError(
+                "cache_decoded caches every decoded row in host memory — "
+                "incompatible with streaming=True (whose whole point is "
+                "beyond-memory tables)"
+            )
+        # decoded-row cache: epoch 2+ skips JPEG decode entirely and
+        # assembles batches by memcpy from cached uint8 rows. Costs
+        # rows x H x W x 3 bytes of host RAM (tf_flowers at 224^2:
+        # ~275 MB) — the right trade when epochs revisit the same rows
+        # and host decode is the bottleneck (SURVEY.md §7 hard part 1).
+        self.cache_decoded = cache_decoded
+        self._decoded_cache: Dict[int, np.ndarray] = {}
         # observability for the bounded-memory guarantee (tests)
         self.peak_buffered_rows = 0
+        self.decode_calls = 0  # rows actually sent to the native decoder
 
         self._contents: list = []
         self._labels: list = []
@@ -216,11 +231,13 @@ class Dataset:
         return idx
 
     def _iter_rows_mem(self, epoch: int, stop: threading.Event):
+        """Yields (row_index, content, label) — the index keys the
+        decoded-row cache."""
         order = self._epoch_order(epoch)
         for i in order:
             if stop.is_set():
                 return
-            yield self._contents[i], self._labels[i]
+            yield int(i), self._contents[i], self._labels[i]
 
     def _iter_rows_stream(self, epoch: int, stop: threading.Event):
         """Row-group-shuffled, shuffle-buffered row stream.
@@ -341,6 +358,33 @@ class Dataset:
             )
         return pool[slot]
 
+    def _decode_cached(self, idxs, jpegs, out):
+        """Assemble a batch from the decoded-row cache, decoding only
+        rows not yet cached (epoch 1 fills it; epoch 2+ is pure memcpy).
+        Cached rows come from fresh decode outputs (never the reuse
+        ring), so they stay valid for the Dataset's lifetime."""
+        missing = [j for j, i in enumerate(idxs) if i not in self._decoded_cache]
+        if missing:
+            self.decode_calls += len(missing)
+            fresh, _ok = decode_resize_batch(
+                [jpegs[j] for j in missing],
+                self.img_height,
+                self.img_width,
+                num_threads=self.num_decode_workers,
+            )
+            for k, j in enumerate(missing):
+                self._decoded_cache[idxs[j]] = fresh[k]
+        images = (
+            out
+            if out is not None
+            else np.empty(
+                (len(idxs), self.img_height, self.img_width, 3), np.uint8
+            )
+        )
+        for j, i in enumerate(idxs):
+            images[j] = self._decoded_cache[i]
+        return images
+
     @staticmethod
     def _stage_put(q: "queue.Queue", item, stop: threading.Event) -> bool:
         """Blocking put that still observes consumer abandonment, so an
@@ -365,29 +409,35 @@ class Dataset:
         bs = self.batch_size
         try:
             while not stop.is_set():
-                rows = (
-                    self._iter_rows_stream(epoch, stop)
-                    if self.streaming
-                    else self._iter_rows_mem(epoch, stop)
-                )
+                if self.streaming:
+                    rows = (
+                        (None, c, l)
+                        for c, l in self._iter_rows_stream(epoch, stop)
+                    )
+                else:
+                    rows = self._iter_rows_mem(epoch, stop)
+                idxs: list = []
                 jpegs: list = []
                 labels: list = []
                 emitted = 0
                 # cap batches when drop_remainder so every epoch emits
                 # exactly len(self)//bs batches in BOTH residency modes
                 max_batches = len(self) // bs if self.drop_remainder else None
-                for content, label in rows:
+                for idx, content, label in rows:
+                    idxs.append(idx)
                     jpegs.append(content)
                     labels.append(label)
                     if len(jpegs) == bs:
-                        if not self._stage_put(raw_q, (jpegs, labels), stop):
+                        if not self._stage_put(
+                            raw_q, (idxs, jpegs, labels), stop
+                        ):
                             return
-                        jpegs, labels = [], []
+                        idxs, jpegs, labels = [], [], []
                         emitted += 1
                         if max_batches is not None and emitted >= max_batches:
                             break
                 if jpegs and not self.drop_remainder and not stop.is_set():
-                    if not self._stage_put(raw_q, (jpegs, labels), stop):
+                    if not self._stage_put(raw_q, (idxs, jpegs, labels), stop):
                         return
                 epoch += 1
                 if not self.infinite:
@@ -419,18 +469,22 @@ class Dataset:
                 if item is None or isinstance(item, _StreamError):
                     self._stage_put(out_q, item, stop)
                     return
-                jpegs, labels = item
+                idxs, jpegs, labels = item
                 out = None
                 if len(jpegs) == self.batch_size:
                     out = self._decode_out(pool, slot)
                     slot = (slot + 1) % len(pool)
-                images, _ok = decode_resize_batch(
-                    jpegs,
-                    self.img_height,
-                    self.img_width,
-                    num_threads=self.num_decode_workers,
-                    out=out,
-                )
+                if self.cache_decoded and idxs and idxs[0] is not None:
+                    images = self._decode_cached(idxs, jpegs, out)
+                else:
+                    self.decode_calls += len(jpegs)
+                    images, _ok = decode_resize_batch(
+                        jpegs,
+                        self.img_height,
+                        self.img_width,
+                        num_threads=self.num_decode_workers,
+                        out=out,
+                    )
                 if not self._stage_put(
                     out_q,
                     {"image": images, "label": np.asarray(labels, np.int32)},

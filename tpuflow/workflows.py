@@ -119,6 +119,7 @@ def train_and_evaluate(
         shuffle=cfg.data.shuffle,
         shuffle_buffer=cfg.data.shuffle_buffer,
         reuse_buffers=reuse,
+        cache_decoded=cfg.data.cache_decoded,
     )
 
     if model is None:
