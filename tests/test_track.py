@@ -147,3 +147,61 @@ def test_concurrent_tag_and_end_meta(store):
     tags = run.meta()["tags"]
     assert all(tags.get(f"tag{t}") == str(t) for t in range(k))
     assert run.meta()["status"] == "FINISHED"
+
+
+def test_runs_cli(tmp_path, capsys):
+    """The run-browser CLI: list, show, best, models (≙ the MLflow UI
+    surface the reference reads, P2/01:257-261)."""
+    import json
+
+    from tpuflow.cli.runs import main
+    from tpuflow.track import TrackingStore
+    from tpuflow.track.registry import ModelRegistry
+
+    root = str(tmp_path / "store")
+    store = TrackingStore(root)
+    for i, acc in enumerate([0.5, 0.9, 0.7]):
+        with store.start_run(run_name=f"r{i}") as run:
+            run.log_param("lr", 10 ** -i)
+            run.log_metric("val_accuracy", acc)
+            art = tmp_path / "m.txt"
+            art.write_text("weights")
+            run.log_artifact(str(art), "model")
+            if i == 1:
+                best_id = run.run_id
+    reg = ModelRegistry(store)
+    reg.register_model(f"runs:/{best_id}/model", "flowers")
+    reg.transition_model_version_stage("flowers", 1, "Production")
+
+    assert main(["--store", root, "list"]) == 0
+    out = capsys.readouterr().out
+    assert "r0" in out and "r2" in out and "metrics.val_accuracy" in out
+
+    assert main(["--store", root, "show", best_id]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown["metrics"]["val_accuracy"] == 0.9
+
+    assert main(["--store", root, "best", "--metric", "val_accuracy"]) == 0
+    best = json.loads(capsys.readouterr().out)
+    assert best["run_id"] == best_id
+
+    assert main(["--store", root, "models"]) == 0
+    out = capsys.readouterr().out
+    assert "flowers" in out and "Production" in out
+
+    assert main(["--store", root, "best", "--metric", "nope"]) == 1
+
+
+def test_runs_cli_errors(tmp_path, capsys):
+    from tpuflow.cli.runs import main
+    from tpuflow.track import TrackingStore
+
+    # no store: clean error, nothing created
+    missing = str(tmp_path / "nowhere")
+    assert main(["--store", missing, "list"]) == 1
+    assert not os.path.exists(missing)
+
+    root = str(tmp_path / "store")
+    TrackingStore(root)
+    assert main(["--store", root, "show", "deadbeef"]) == 1
+    assert "error:" in capsys.readouterr().err

@@ -1,0 +1,146 @@
+"""Run browser CLI — the headless equivalent of the MLflow UI.
+
+The reference inspects experiments through the Databricks MLflow UI
+(runs table, per-run params/metrics — used throughout P2/01-P2/03);
+tpuflow's tracking store is a directory tree, and this CLI is the
+operator surface over it:
+
+  python -m tpuflow.cli.runs list   [--store DIR] [--experiment E]
+  python -m tpuflow.cli.runs show   RUN_ID [--store DIR]
+  python -m tpuflow.cli.runs best   --metric val_accuracy [--mode max]
+  python -m tpuflow.cli.runs models [--store DIR]
+
+`best` mirrors the search_runs(metric-ordered) selection the notebooks
+do programmatically (P2/01:257-261, P2/02:390-399).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from tpuflow.track import TrackingStore
+
+
+def _fmt_table(rows: List[dict], cols: List[str]) -> str:
+    if not rows:
+        return "(no runs)"
+    widths = {
+        c: max(len(c), *(len(str(r.get(c, ""))) for r in rows)) for c in cols
+    }
+    line = "  ".join(c.ljust(widths[c]) for c in cols)
+    out = [line, "  ".join("-" * widths[c] for c in cols)]
+    for r in rows:
+        out.append("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
+    return "\n".join(out)
+
+
+def _metric_cols(rows: List[dict], limit: int = 4) -> List[str]:
+    seen: List[str] = []
+    for r in rows:
+        for k in r:
+            if k.startswith("metrics.") and k not in seen:
+                seen.append(k)
+    return seen[:limit]
+
+
+def cmd_list(store: TrackingStore, experiment: Optional[str]) -> int:
+    rows = store.search_runs(experiment=experiment)
+    cols = ["run_id", "run_name", "status"] + _metric_cols(rows)
+    print(_fmt_table(rows, cols))
+    return 0
+
+
+def cmd_show(store: TrackingStore, run_id: str) -> int:
+    run = store.get_run(run_id)
+    print(json.dumps(
+        {
+            "meta": run.meta(),
+            "params": run.params(),
+            "metrics": run.metrics(),
+        },
+        indent=2,
+        default=str,
+    ))
+    return 0
+
+
+def cmd_best(
+    store: TrackingStore, metric: str, mode: str, experiment: Optional[str]
+) -> int:
+    order = f"metrics.{metric} {'DESC' if mode == 'max' else 'ASC'}"
+    rows = store.search_runs(order_by=order, experiment=experiment)
+    rows = [r for r in rows if f"metrics.{metric}" in r]
+    if not rows:
+        print(f"no runs with metric {metric!r}", file=sys.stderr)
+        return 1
+    best = rows[0]
+    print(json.dumps(best, indent=2, default=str))
+    return 0
+
+
+def cmd_models(store: TrackingStore) -> int:
+    from tpuflow.track.registry import ModelRegistry
+
+    reg = ModelRegistry(store)
+    rows = []
+    for name in reg.list_models():
+        for v in reg.versions(name):
+            rows.append({
+                "model": name,
+                "version": v.get("version"),
+                "stage": v.get("stage"),
+                "source": v.get("source_uri"),
+            })
+    print(_fmt_table(rows, ["model", "version", "stage", "source"]))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="tpuflow.cli.runs", description=__doc__)
+    p.add_argument("--store", default=None,
+                   help="tracking store root (default: the store's default)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pl = sub.add_parser("list");     pl.add_argument("--experiment")
+    ps = sub.add_parser("show");     ps.add_argument("run_id")
+    pb = sub.add_parser("best")
+    pb.add_argument("--metric", required=True)
+    pb.add_argument("--mode", choices=["max", "min"], default="max")
+    pb.add_argument("--experiment")
+    sub.add_parser("models")
+    args = p.parse_args(argv if argv is not None else sys.argv[1:])
+
+    import os
+
+    root = args.store if args.store else TrackingStore.default_root()
+    if not os.path.isdir(os.path.join(root, "runs")):
+        # a browser must not mkdir a store that isn't there — that would
+        # mask a wrong --store/cwd as "(no runs)"
+        print(f"no tracking store at {root!r} (pass --store)", file=sys.stderr)
+        return 1
+    store = TrackingStore(root)
+    try:
+        if args.cmd == "list":
+            return cmd_list(store, args.experiment)
+        if args.cmd == "show":
+            return cmd_show(store, args.run_id)
+        if args.cmd == "best":
+            return cmd_best(store, args.metric, args.mode, args.experiment)
+        return cmd_models(store)
+    except (KeyError, FileNotFoundError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        # stdout consumer (e.g. `| head`) closed early — normal for a
+        # browser CLI; suppress the traceback os-level too
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

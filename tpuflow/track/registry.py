@@ -77,6 +77,14 @@ class ModelRegistry:
 
     # -- queries ----------------------------------------------------------
 
+    def list_models(self) -> List[str]:
+        """Registered model names (a dir with a versions/ tree each)."""
+        out = []
+        for n in sorted(os.listdir(self.root)):
+            if os.path.isdir(os.path.join(self.root, n, "versions")):
+                out.append(n)
+        return out
+
     def versions(self, name: str) -> List[Dict[str, Any]]:
         ndir = os.path.join(self.root, name, "versions")
         if not os.path.isdir(ndir):

@@ -145,6 +145,12 @@ class Run:
 
 
 class TrackingStore:
+    @staticmethod
+    def default_root() -> str:
+        """The root used when none is passed (TPUFLOW_TRACKING_DIR or
+        ./tpuflow_runs) — resolvable without creating directories."""
+        return _DEFAULT_ROOT
+
     def __init__(self, root: str = _DEFAULT_ROOT):
         self.root = os.path.abspath(root)
         os.makedirs(os.path.join(self.root, "runs"), exist_ok=True)
