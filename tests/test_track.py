@@ -205,3 +205,22 @@ def test_runs_cli_errors(tmp_path, capsys):
     TrackingStore(root)
     assert main(["--store", root, "show", "deadbeef"]) == 1
     assert "error:" in capsys.readouterr().err
+
+
+def test_system_metrics_callback(tmp_path):
+    """SystemMetricsCallback logs sys.* metrics into the run per epoch."""
+    from tpuflow.track import TrackingStore
+    from tpuflow.train import SystemMetricsCallback
+
+    store = TrackingStore(str(tmp_path / "s"))
+    with store.start_run(run_name="sm") as run:
+        cb = SystemMetricsCallback(run, include_devices=False)
+        cb.on_epoch_end(0, {})
+        cb.on_epoch_end(1, {})
+    m = run.metrics()
+    # keys are pre-namespaced by sample_system_metrics: sys.cpu_percent
+    # etc. — no double prefix
+    assert "sys.cpu_percent" in m, m
+    assert not any(k.startswith("sys.sys.") for k in m), m
+    hist = run.metric_history("sys.cpu_percent")
+    assert [h["step"] for h in hist] == [0, 1]

@@ -82,8 +82,15 @@ def cmd_best(
 
 
 def cmd_models(store: TrackingStore) -> int:
+    import os
+
     from tpuflow.track.registry import ModelRegistry
 
+    if not os.path.isdir(os.path.join(store.root, "registry")):
+        # browsing must not create the registry tree (ModelRegistry's
+        # constructor mkdirs it)
+        print("(no models)")
+        return 0
     reg = ModelRegistry(store)
     rows = []
     for name in reg.list_models():

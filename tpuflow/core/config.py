@@ -75,6 +75,10 @@ class TrainConfig:
     # and params are finite (tpuflow.core.debug — the checkable form of
     # the broadcast-init invariant, P1/03:305-308)
     consistency_check_every: int = 0
+    # log host/device utilization into the run each epoch with a sys.
+    # prefix (≙ the Ganglia dashboards, P1/04:25-30, recorded with the
+    # run instead of living in a cluster UI)
+    log_system_metrics: bool = False
     seed: int = 0
     optimizer_kwargs: Dict[str, Any] = field(default_factory=dict)
 

@@ -7,6 +7,7 @@ from tpuflow.train.callbacks import (  # noqa: F401
     History,
     ModelCheckpoint,
     ReduceLROnPlateau,
+    SystemMetricsCallback,
     TrackingCallback,
 )
 from tpuflow.train.optimizers import (  # noqa: F401

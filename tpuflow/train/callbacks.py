@@ -164,6 +164,27 @@ class TrackingCallback(Callback):
             self.run.log_metric(k, float(v), step=epoch)
 
 
+class SystemMetricsCallback(Callback):
+    """Per-epoch host/device utilization into the tracking run,
+    primary-only (≙ the Ganglia dashboards the reference points
+    operators at, P1/04:25-30, but recorded WITH the run so they
+    outlive the cluster). Keys come pre-namespaced from
+    sample_system_metrics (``sys.*`` host, ``device<i>.*`` HBM)."""
+
+    def __init__(self, run, include_devices: bool = True):
+        self.run = run
+        self.include_devices = include_devices
+
+    def on_epoch_end(self, epoch, logs):
+        from tpuflow.core import is_primary
+        from tpuflow.obs.sysmetrics import sample_system_metrics
+
+        if not is_primary() or self.run is None:
+            return
+        for k, v in sample_system_metrics(self.include_devices).items():
+            self.run.log_metric(k, float(v), step=epoch)
+
+
 class ReplicaConsistencyCheck(Callback):
     """Every N epochs, assert the replicated-state invariants: all
     devices hold bitwise-identical replicated params, all processes

@@ -22,7 +22,7 @@ from tpuflow.models import build_model
 from tpuflow.packaging import save_packaged_model
 from tpuflow.parallel.mesh import build_mesh, world_size
 from tpuflow.track import TrackingStore
-from tpuflow.train import TrackingCallback, Trainer
+from tpuflow.train import SystemMetricsCallback, TrackingCallback, Trainer
 
 
 def _with_overrides(
@@ -145,7 +145,11 @@ def train_and_evaluate(
 
     # plateau/early-stop/checkpoint callbacks wire automatically from
     # cfg.train inside Trainer.fit; only tracking needs the run handle
-    callbacks = [TrackingCallback(run)] if run is not None else []
+    callbacks = []
+    if run is not None:
+        callbacks.append(TrackingCallback(run))
+        if cfg.train.log_system_metrics:
+            callbacks.append(SystemMetricsCallback(run))
 
     trainer = Trainer(model, cfg.train, mesh=mesh, run=run)
     initial_epoch = 0
