@@ -70,6 +70,61 @@ def build_nd_mesh(
     return Mesh(np.array(devices).reshape(sizes), tuple(axes.keys()))
 
 
+def build_hybrid_mesh(
+    dcn_axes: "dict[str, int]",
+    ici_axes: "dict[str, int]",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multi-slice mesh: outer axes over DCN (across slices), inner axes
+    over ICI (within a slice) — SURVEY.md §5.8's cross-slice story.
+
+    Use data parallelism (or pipeline stages) on the DCN axes and
+    bandwidth-hungry parallelism (tensor/sequence) on the ICI axes:
+    XLA's collectives then keep all-gathers/reduce-scatters on the fast
+    intra-slice fabric and only gradient-sized all-reduces cross DCN.
+    On multi-slice TPU hardware this uses jax's topology-aware hybrid
+    mesh; elsewhere (CPU meshes, single slice) it degrades to the plain
+    reshape so the same code runs in tests.
+
+    Example (2 slices of a v5e-256, DP across slices, TP inside):
+        mesh = build_hybrid_mesh({"data": 2}, {"model": 8, "replica": 32})
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if set(dcn_axes) & set(ici_axes):
+        raise ValueError(
+            f"axis names shared between DCN and ICI: "
+            f"{sorted(set(dcn_axes) & set(ici_axes))}"
+        )
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    sizes = list(dcn_axes.values()) + list(ici_axes.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(
+            f"hybrid mesh {dcn_axes}x{ici_axes} != device count {len(devices)}"
+        )
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if n_slices > 1:
+        arr = _hybrid_device_array(dcn_axes, ici_axes, devices)
+        assert list(arr.shape) == sizes, (arr.shape, sizes)
+        return Mesh(arr, names)
+    return build_nd_mesh({**dcn_axes, **ici_axes}, devices)
+
+
+def _hybrid_device_array(dcn_axes, ici_axes, devices) -> np.ndarray:
+    """Topology-aware (dcn..., ici...) device array for a multi-slice
+    mesh. create_hybrid_device_mesh wants mesh_shape and dcn_mesh_shape
+    at the SAME rank (elementwise product = the final mesh shape): pad
+    each side with 1s so the returned array already has the target
+    shape with DCN axes leading — no reshape (a reshape here would
+    interleave devices across slices on the DCN axes)."""
+    from jax.experimental import mesh_utils
+
+    return mesh_utils.create_hybrid_device_mesh(
+        [1] * len(dcn_axes) + list(ici_axes.values()),
+        list(dcn_axes.values()) + [1] * len(ici_axes),
+        devices=devices,
+    )
+
+
 def data_sharding(mesh: Mesh) -> NamedSharding:
     """Batch-dim sharding over the data axis (leading dim split)."""
     return NamedSharding(mesh, P(DATA_AXIS))
