@@ -21,6 +21,20 @@ line if anything wedges, and every failure path exits 0 with an
 flash-attention kernel parity/timing, native-decode throughput) go to
 stderr and ride along in the JSON under ``diagnostics``.
 
+Timing methodology (relay-safe): this environment reaches the TPU
+through a network relay where ``jax.block_until_ready`` can return
+before remote execution finishes (measured round 2: a 30-step "timed"
+loop completed in exactly one ~80 ms RTT), so every timing here forces
+a REAL sync by fetching a scalar that data-depends on the work. Two
+measurements are taken: (a) a provisional chained python loop with one
+scalar fetch at the end — robust, but includes per-call dispatch/RTT
+overhead; (b) the reported number: ``lax.scan`` of K train steps
+inside ONE jitted program — a single dispatch and a single fetch, so
+relay latency amortizes to nothing and the result is true device
+steady-state. If (b) wedges (e.g. remote-compile outage) the watchdog
+emits (a) instead of losing the artifact. The relay RTT itself is
+measured and reported in diagnostics.
+
 Usage: python bench.py [--smoke] [--batch N] [--steps N]
        [--init-retries N] [--deadline SECONDS]
 """
@@ -36,6 +50,9 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
+# Filled in by _bench as soon as a first valid measurement exists, so a
+# watchdog fired mid-refinement reports a real number, not 0.0.
+_PROVISIONAL: dict = {}
 
 
 def _last_known_good():
@@ -49,9 +66,15 @@ def _last_known_good():
     # newest first by mtime (lexicographic r9 > r10 would lie), falling
     # back through older artifacts if the newest is corrupt
     for p in sorted(paths, key=os.path.getmtime, reverse=True):
+        if "retracted" in os.path.basename(p):
+            continue
         try:
             with open(p) as f:
                 rec = json.load(f)
+            # skip retracted artifacts and pure failures — but keep
+            # watchdog-provisional records (error set, real value > 0)
+            if rec.get("retracted") or (rec.get("error") and not rec.get("value")):
+                continue
             rec["source_file"] = os.path.basename(p)
             return rec
         except Exception:
@@ -157,16 +180,36 @@ def _attention_diag(diag: dict, small: bool = False) -> None:
             jnp.max(jnp.abs(g_f.astype(jnp.float32) - g_r.astype(jnp.float32)))
         )
 
+        # timing: chain K calls inside one jitted scan (carry = q; the
+        # output has q's shape) and sync with a scalar fetch — see the
+        # module docstring's relay-safe timing note.
         steps = 3 if small else 20
+
+        @jax.jit
+        def _fwd_many(c):
+            def body(c, _):
+                o = flash_attention(c, k, v, causal=True, interpret=interpret)
+                return o, ()
+            return jax.lax.scan(body, c, None, length=steps)[0]
+
+        @jax.jit
+        def _bwd_many(c):
+            def body(c, _):
+                g = jax.grad(
+                    lambda q: flash_attention(
+                        q, k, v, causal=True, interpret=interpret
+                    ).astype(jnp.float32).sum()
+                )(c)
+                return g.astype(c.dtype), ()
+            return jax.lax.scan(body, c, None, length=steps)[0]
+
+        float(_fwd_many(q)[0, 0, 0, 0])  # compile
         t0 = time.time()
-        for _ in range(steps):
-            o_f = flash(q, k, v)
-        jax.block_until_ready(o_f)
+        float(_fwd_many(q)[0, 0, 0, 0])
         fwd_ms = (time.time() - t0) / steps * 1e3
+        float(_bwd_many(q)[0, 0, 0, 0])  # compile
         t0 = time.time()
-        for _ in range(steps):
-            g_f = grad_fn(q)
-        jax.block_until_ready(g_f)
+        float(_bwd_many(q)[0, 0, 0, 0])
         fwdbwd_ms = (time.time() - t0) / steps * 1e3
         # attention FLOPs: causal ⇒ ~half of 4*b*h*s^2*d (fwd)
         att_fl = 2 * b * h * s * s * d  # qk^T + av, halved for causal
@@ -184,6 +227,27 @@ def _attention_diag(diag: dict, small: bool = False) -> None:
     except Exception as e:
         diag["flash_attention"] = f"failed: {e}"
         print(f"# flash-attn diag failed: {e}", file=sys.stderr, flush=True)
+
+
+def _measure_rtt() -> float:
+    """Host↔device round-trip (dispatch trivial op + fetch scalar), ms.
+
+    On a local chip this is sub-millisecond; over the axon relay it is
+    the network RTT (~80 ms measured) and dominates any per-step
+    python-loop timing — which is why the headline number comes from an
+    on-device scan instead."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.ones(())
+    float(f(x))  # compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        float(f(x))
+        best = min(best, time.time() - t0)
+    return best * 1e3
 
 
 def _decode_diag(hw: int) -> float:
@@ -224,11 +288,14 @@ def main() -> int:
                    help="capture a jax.profiler trace of the timed steps "
                         "into DIR (view in Perfetto/TensorBoard) — the "
                         "op-level evidence behind MFU_ANALYSIS.md")
-    p.add_argument("--model", choices=["cnn", "vit"], default="cnn",
+    p.add_argument("--model", choices=["cnn", "vit", "resnet50"],
+                   default="cnn",
                    help="cnn = flagship MobileNetV2 transfer config "
                         "(the reference's P1/03 parity target); vit = "
                         "dense ViT train step, the MXU-bound MFU "
-                        "demonstrator (see MFU_ANALYSIS.md)")
+                        "demonstrator (see MFU_ANALYSIS.md); resnet50 = "
+                        "the classic images/sec CNN benchmark (dense "
+                        "convs, full backward, no freezing)")
     args = p.parse_args()
 
     if args.smoke:
@@ -239,8 +306,16 @@ def main() -> int:
 
     def watchdog():
         time.sleep(args.deadline)
-        emit(0.0, 0.0, error=f"watchdog: deadline {args.deadline}s exceeded "
-                            f"(backend init or compile wedged)")
+        if _PROVISIONAL:
+            emit(
+                _PROVISIONAL["value"], _PROVISIONAL["vs_baseline"],
+                error=f"watchdog: deadline {args.deadline}s hit during "
+                      f"refinement; reporting provisional loop-timed result",
+                diagnostics=_PROVISIONAL.get("diagnostics"),
+            )
+        else:
+            emit(0.0, 0.0, error=f"watchdog: deadline {args.deadline}s "
+                                 f"exceeded (backend init or compile wedged)")
         sys.stdout.flush()
         os._exit(0)
 
@@ -279,10 +354,7 @@ def _bench(args) -> int:
         # MobileNetV2's depthwise convs cap its MFU well below the 60%
         # north star on ANY accelerator (memory-bound; MFU_ANALYSIS.md);
         # this config is matmul-dominated so it shows what the framework
-        # achieves when the model maps onto the MXU. attn_impl='flash'
-        # puts the compiled Pallas kernel in the training loop (the
-        # smoke variant keeps the XLA-einsum path: interpret-mode Pallas
-        # on CPU is too slow for a smoke check).
+        # achieves when the model maps onto the MXU.
         from tpuflow.models.vit import build_vit
 
         if args.smoke:
@@ -291,11 +363,29 @@ def _bench(args) -> int:
                               width=64, depth=2, heads=4)
             width = "vit64"
         else:
+            # attn_impl='auto' → mha_xla at s=196 (flash buys nothing at
+            # vision lengths — MFU_ANALYSIS.md §4); the compiled Pallas
+            # kernel is separately proven+timed by _attention_diag at
+            # s=1024 on every TPU run.
             hw, batch = 224, args.batch or 128
             model = build_vit(num_classes=5, img_size=hw, patch_size=16,
                               width=768, depth=12, heads=12,
-                              attn_impl="flash")  # ViT-Base
-            width = "vitB768-flash"
+                              attn_impl="auto")  # ViT-Base
+            width = "vitB768"
+    elif args.model == "resnet50":
+        # the industry-standard CNN throughput benchmark: dense convs,
+        # full backward (nothing frozen) — MXU-shaped, unlike the
+        # memory-bound MobileNetV2 flagship (MFU_ANALYSIS.md §2)
+        if args.smoke:
+            hw, batch = 64, args.batch or 8
+            model = build_model(num_classes=5, dropout=0.0,
+                                backbone="resnet18", freeze_backbone=False)
+            width = "resnet18"
+        else:
+            hw, batch = 224, args.batch or 256
+            model = build_model(num_classes=5, dropout=0.0,
+                                backbone="resnet50", freeze_backbone=False)
+            width = "resnet50"
     else:
         if args.smoke:
             hw, width, batch = 64, 0.25, args.batch or 8
@@ -319,23 +409,95 @@ def _bench(args) -> int:
     images, labels = trainer._put(batch_np)
     lr = jnp.asarray(1e-3, jnp.float32)
 
+    rtt_ms = _measure_rtt()
+    print(f"# host<->device rtt: {rtt_ms:.1f} ms", file=sys.stderr, flush=True)
+
     t_compile = time.time()
     state, m = trainer._train_step(trainer.state, images, labels, lr)
-    jax.block_until_ready(m)
+    loss0 = float(m["loss"])  # scalar fetch = real sync (relay-safe)
     compile_s = time.time() - t_compile
 
     flops = flops_of_jitted(
         trainer._train_step, trainer.state, images, labels, lr
     )
+    peak = device_peak_flops(devices[0])
 
+    # -- (a) provisional: chained python loop, ONE scalar fetch at the
+    # end. Upper-bounds the step time (includes per-call dispatch/RTT
+    # pipelining effects) but cannot wedge beyond args.steps calls.
     for _ in range(args.warmup):
         state, m = trainer._train_step(state, images, labels, lr)
-    jax.block_until_ready(m)
+    float(m["loss"])
     t0 = time.time()
     for _ in range(args.steps):
         state, m = trainer._train_step(state, images, labels, lr)
-    jax.block_until_ready(m)
-    dt = (time.time() - t0) / args.steps
+    last_loss = float(m["loss"])
+    dt_loop = (time.time() - t0) / args.steps
+
+    def _diag_for(dt, method):
+        mfu_v = (flops / dt) / (n_chips * peak) if flops else 0.0
+        return mfu_v, {
+            "device_kind": devices[0].device_kind,
+            "n_chips": n_chips,
+            "image_hw": hw,
+            "batch_per_chip": batch,
+            "step_ms": round(dt * 1e3, 3),
+            "timing_method": method,
+            "step_ms_loop": round(dt_loop * 1e3, 3),
+            "rtt_ms": round(rtt_ms, 1),
+            "compile_s": round(compile_s, 1),
+            "flops_per_step": flops,
+            "mfu": round(mfu_v, 4),
+            "peak_flops_assumed": peak,
+            "loss": round(last_loss, 4),
+        }
+
+    mfu_loop, diag_loop = _diag_for(dt_loop, "loop_fetch")
+    _PROVISIONAL.update(
+        value=global_batch / dt_loop / n_chips,
+        vs_baseline=mfu_loop / 0.60,
+        diagnostics=diag_loop,
+    )
+    print(f"# provisional (loop+fetch): step={dt_loop*1e3:.2f}ms "
+          f"MFU={mfu_loop*100:.1f}%", file=sys.stderr, flush=True)
+
+    # -- (b) headline: K steps inside one jitted lax.scan — single
+    # dispatch, single fetch; true device steady-state over any relay.
+    dt = dt_loop
+    method = "loop_fetch"
+    try:
+        K = args.steps
+
+        @jax.jit
+        def _many(state):
+            def body(s, _):
+                s2, mm = trainer._train_step(s, images, labels, lr)
+                return s2, mm["loss"]
+            return jax.lax.scan(body, state, None, length=K)
+
+        t0 = time.time()
+        state, losses = _many(state)
+        last_loss = float(losses[-1])
+        scan_compile_s = time.time() - t0
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.time()
+            state, losses = _many(state)
+            last_loss = float(losses[-1])
+            total = time.time() - t0
+            # one dispatch+fetch still rides the relay once per call:
+            # subtract the measured RTT (capped at half the total so a
+            # mis-measured RTT can never eat the signal)
+            total -= min(rtt_ms * 1e-3, total / 2)
+            best = min(best, total / K)
+        dt = best
+        method = f"scan{K}"
+        print(f"# scan timing: step={dt*1e3:.3f}ms "
+              f"(scan compile {scan_compile_s:.0f}s)",
+              file=sys.stderr, flush=True)
+    except Exception as e:
+        print(f"# scan timing failed ({type(e).__name__}: {e}); "
+              f"reporting loop timing", file=sys.stderr, flush=True)
 
     if args.trace:
         # profile a few EXTRA steps after the timed loop — capture
@@ -343,25 +505,11 @@ def _bench(args) -> int:
         with jax.profiler.trace(args.trace):
             for _ in range(min(5, args.steps)):
                 state, m = trainer._train_step(state, images, labels, lr)
-            jax.block_until_ready(m)
+            float(m["loss"])
 
     img_per_sec_chip = global_batch / dt / n_chips
-    peak = device_peak_flops(devices[0])
-    mfu_val = (flops / dt) / (n_chips * peak) if flops else 0.0
-
-    diag = {
-        "device_kind": devices[0].device_kind,
-        "n_chips": n_chips,
-        "image_hw": hw,
-        "batch_per_chip": batch,
-        "step_ms": round(dt * 1e3, 3),
-        "compile_s": round(compile_s, 1),
-        "flops_per_step": flops,
-        "mfu": round(mfu_val, 4),
-        "peak_flops_assumed": peak,
-        "decode_img_per_s": round(_decode_diag(hw), 0),
-        "loss": round(float(m["loss"]), 4),
-    }
+    mfu_val, diag = _diag_for(dt, method)
+    diag["decode_img_per_s"] = round(_decode_diag(hw), 0)
     if args.trace:
         diag["trace_dir"] = args.trace  # captured AFTER the timed loop
     if not args.no_attn_diag:

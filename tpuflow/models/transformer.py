@@ -15,9 +15,12 @@ a causal LM whose design axes map one-to-one onto the mesh:
   attention becomes causal ring attention (K/V shards rotating over
   ICI — tpuflow.parallel.ring_attention), rotary positions are offset
   by the shard's global start, and everything else is per-token.
-- **Attention impls**: ``attn_impl='flash'`` uses the Pallas blockwise
-  kernel (tpuflow.ops.attention) with causal block skipping;
-  ``'auto'`` uses XLA einsums (fully GSPMD-partitionable).
+- **Attention impls**: ``attn_impl='flash'`` forces the Pallas
+  blockwise kernel (tpuflow.ops.attention) with causal block skipping;
+  ``'einsum'`` forces XLA einsums (fully GSPMD-partitionable);
+  ``'auto'`` (default) resolves per sequence length via
+  tpuflow.ops.pick_attn_impl — einsum below 1024 tokens, flash on TPU
+  at 1024+ where avoiding the materialized O(S²) score matrix pays.
 
 Pre-norm blocks with RMSNorm, SwiGLU MLP, rotary position embeddings,
 no biases — the standard modern decoder recipe, chosen because every
@@ -33,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpuflow.ops.attention import flash_attention, mha_reference
+from tpuflow.ops.attention import flash_attention, mha_xla, pick_attn_impl
 from tpuflow.parallel.mesh import MODEL_AXIS
 from tpuflow.parallel.ring_attention import ring_attention
 
@@ -146,7 +149,7 @@ class CausalAttention(nn.Module):
                 # init pass: shapes only (cache created above)
                 positions = jnp.arange(s, dtype=jnp.int32)
                 q, k = rotary_embed(q, k, positions, self.rope_theta)
-                o = mha_reference(q, k, v, causal=True)
+                o = mha_xla(q, k, v, causal=True)
         else:
             if self.seq_axis is not None:
                 # absolute positions of this shard's tokens
@@ -159,10 +162,10 @@ class CausalAttention(nn.Module):
             if self.seq_axis is not None:
                 o = ring_attention(q, k, v, axis_name=self.seq_axis,
                                    causal=True)
-            elif self.attn_impl == "flash":
+            elif pick_attn_impl(s, self.attn_impl) == "flash":
                 o = flash_attention(q, k, v, causal=True)
             else:
-                o = mha_reference(q, k, v, causal=True)
+                o = mha_xla(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, self.dim)
         return nn.Dense(
             self.dim,

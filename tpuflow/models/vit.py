@@ -17,10 +17,11 @@ sequence parallelism — SURVEY.md §2c "TPU-native plan" column):
   tpuflow.parallel.ring_attention), the positional table is sliced per
   shard, and token pooling becomes a psum-mean. Everything else is
   per-token and needs no communication.
-- **Attention impls**: ``attn_impl='auto'`` lowers to XLA einsums (fully
-  GSPMD-partitionable — best for short vision sequences); ``'flash'``
-  calls the Pallas blockwise kernel (tpuflow.ops.attention — best for
-  long sequences on one device's shard).
+- **Attention impls**: ``attn_impl='auto'`` resolves per sequence
+  length (tpuflow.ops.pick_attn_impl): XLA einsums for short vision
+  sequences (fully GSPMD-partitionable, one fused chain), the Pallas
+  blockwise kernel on TPU once the O(S^2) score matrix is worth
+  avoiding; ``'flash'``/``'einsum'`` force either path.
 
 Mean-pool classification (no CLS token) keeps every op shard-uniform.
 """
@@ -34,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpuflow.ops.attention import flash_attention, mha_reference
+from tpuflow.ops.attention import flash_attention, mha_xla, pick_attn_impl
 from tpuflow.parallel.mesh import MODEL_AXIS
 from tpuflow.parallel.ring_attention import ring_attention
 
@@ -103,10 +104,10 @@ class ViTAttention(nn.Module):
         q, k, v = (heads_first(proj_in(n)) for n in ("query", "key", "value"))
         if self.seq_axis is not None:
             o = ring_attention(q, k, v, axis_name=self.seq_axis)
-        elif self.attn_impl == "flash":
+        elif pick_attn_impl(s, self.attn_impl) == "flash":
             o = flash_attention(q, k, v)
         else:
-            o = mha_reference(q, k, v)
+            o = mha_xla(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, self.dim)
         return nn.Dense(
             self.dim,

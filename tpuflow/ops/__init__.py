@@ -8,4 +8,9 @@ attention/long-context model family) with an online-softmax forward and
 a recomputation backward.
 """
 
-from tpuflow.ops.attention import flash_attention, mha_reference  # noqa: F401
+from tpuflow.ops.attention import (  # noqa: F401
+    flash_attention,
+    mha_reference,
+    mha_xla,
+    pick_attn_impl,
+)

@@ -8,10 +8,14 @@ attention model family and the per-shard compute of ring attention
 anywhere (SURVEY.md §2c, §5.7) — this is the long-context capability
 the TPU build adds as first-class.
 
-Layout: ``(batch, heads, seq, head_dim)``. Compute is float32 on the
-MXU regardless of input dtype; outputs match the input dtype. K/V for
-one (batch, head) are kept whole in VMEM (fine to ~16k sequence at
-head_dim 128 in bf16); queries stream in ``block_q`` tiles.
+Layout: ``(batch, heads, seq, head_dim)``. The MXU dots run in the
+INPUT dtype (bf16 in → bf16×bf16 with float32 accumulation — the
+full-rate MXU mode; casting operands to f32 first would drop to the
+~8x-slower f32 path, measured round 2 as a ~2 TFLOP/s kernel), and all
+softmax statistics (max / normalizer / lse) are float32. Outputs match
+the input dtype. K/V for one (batch, head) are kept whole in VMEM
+(fine to ~16k sequence at head_dim 128 in bf16); queries stream in
+``block_q`` tiles.
 
 On non-TPU backends the kernels run in Pallas interpret mode, so the
 whole test suite exercises the real kernel code on CPU (SURVEY.md §4's
@@ -54,8 +58,24 @@ def _vma(*xs):
     return out
 
 
+def pick_attn_impl(seq_len: int, requested: str = "auto") -> str:
+    """Resolve an ``attn_impl`` request. ``'auto'`` chooses ``'flash'``
+    on a TPU backend once the sequence is long enough that avoiding the
+    materialized O(S²) score matrix pays for the kernel's blockwise
+    bookkeeping (vision-length sequences are faster as one fused XLA
+    einsum chain), ``'einsum'`` otherwise. Explicit requests pass
+    through untouched."""
+    if requested != "auto":
+        return requested
+    from tpuflow.core.hw import is_tpu_backend
+
+    return "flash" if (seq_len >= 1024 and is_tpu_backend()) else "einsum"
+
+
 def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
-    """Plain-XLA multi-head attention (numerics oracle for the kernel)."""
+    """Plain-XLA multi-head attention (numerics oracle for the kernel).
+
+    Everything float32 — use :func:`mha_xla` in production models."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
     s = s * scale
@@ -65,6 +85,28 @@ def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
         s = jnp.where(mask, s, _NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def mha_xla(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Production XLA attention: einsums in the INPUT dtype with float32
+    accumulation (full-rate MXU for bf16 models — upcasting operands to
+    f32 first, as the oracle does, lands on the ~8x-slower f32 MXU
+    path), float32 softmax. The right impl for short sequences where
+    the O(S^2) score matrix fits comfortably (vision models); long
+    sequences go to :func:`flash_attention`."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -134,7 +176,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, cfg: _Cfg):
     bq, d = q_ref.shape[1], q_ref.shape[2]
     bk = cfg.block_k
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * cfg.scale
+    q = q_ref[0]  # native dtype — bf16 in ⇒ full-rate MXU
 
     nk_valid = pl.cdiv(cfg.skv_valid, bk)
     if cfg.causal:
@@ -147,9 +189,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, cfg: _Cfg):
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * bk, bk), :]
+        v_blk = v_ref[0, pl.ds(j * bk, bk), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        s = s * cfg.scale  # scale the f32 scores, not the bf16 operand
         col = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = col < cfg.skv_valid
         if cfg.causal:
@@ -159,7 +202,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, cfg: _Cfg):
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        acc_new = acc * alpha + jnp.dot(
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
+        )
         return m_new, l_new, acc_new
 
     m0 = jnp.full((bq, 1), _NEG_BIG, jnp.float32)
@@ -208,8 +253,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, cfg: _Cf
     bq, d = q_ref.shape[1], q_ref.shape[2]
     bk = cfg.block_k
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
     delta = delta_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
     row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -222,8 +267,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, cfg: _Cf
         upper = nk_valid
 
     def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * bk, bk), :]
+        v_blk = v_ref[0, pl.ds(j * bk, bk), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * cfg.scale
         col = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = (col < cfg.skv_valid) & row_ok
@@ -231,7 +276,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, cfg: _Cf
             mask = mask & (col <= row)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k_blk.dtype)
         return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
 
     dq = lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
@@ -243,8 +288,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     bk, d = k_ref.shape[1], k_ref.shape[2]
     bq = cfg.block_q
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
     col = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     col_ok = col < cfg.skv_valid
 
@@ -254,8 +299,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        q_blk = q_ref[0, pl.ds(i * bq, bq), :]
+        do_blk = do_ref[0, pl.ds(i * bq, bq), :]
         lse = lse_ref[0, 0, pl.ds(i * bq, bq)][:, None]
         delta = delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * cfg.scale
@@ -264,9 +309,11 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         if cfg.causal:
             mask = mask & (col <= row)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dv = dv + jnp.dot(
+            p.T.astype(do_blk.dtype), do_blk, preferred_element_type=jnp.float32
+        )
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q_blk.dtype)
         dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
         return dk, dv
 
