@@ -82,7 +82,9 @@ def _last_known_good():
     return None
 
 
-def emit(value: float, vs_baseline: float, error=None, diagnostics=None) -> None:
+def emit(value: float, vs_baseline: float, error=None, diagnostics=None,
+         metric: str = "train_images_per_sec_per_chip",
+         unit: str = "images/s/chip") -> None:
     """Print the single stdout JSON line (at most once, thread-safe)."""
     global _EMITTED
     with _EMIT_LOCK:
@@ -90,9 +92,9 @@ def emit(value: float, vs_baseline: float, error=None, diagnostics=None) -> None
             return
         _EMITTED = True
         rec = {
-            "metric": "train_images_per_sec_per_chip",
+            "metric": metric,
             "value": round(float(value), 2),
-            "unit": "images/s/chip",
+            "unit": unit,
             "vs_baseline": round(float(vs_baseline), 4),
         }
         if error is not None:
@@ -133,7 +135,8 @@ def _init_devices(retries: int, backoff_s: float):
     return None, last
 
 
-def _attention_diag(diag: dict, small: bool = False) -> None:
+def _attention_diag(diag: dict, small: bool = False,
+                    rtt_ms: float = 0.0) -> None:
     """Compiled flash-attention parity + timing vs the pure-jnp oracle.
 
     Proves the Mosaic kernel path on real hardware (VERDICT round-1:
@@ -203,14 +206,18 @@ def _attention_diag(diag: dict, small: bool = False) -> None:
                 return g.astype(c.dtype), ()
             return jax.lax.scan(body, c, None, length=steps)[0]
 
-        float(_fwd_many(q)[0, 0, 0, 0])  # compile
-        t0 = time.time()
-        float(_fwd_many(q)[0, 0, 0, 0])
-        fwd_ms = (time.time() - t0) / steps * 1e3
-        float(_bwd_many(q)[0, 0, 0, 0])  # compile
-        t0 = time.time()
-        float(_bwd_many(q)[0, 0, 0, 0])
-        fwdbwd_ms = (time.time() - t0) / steps * 1e3
+        def _timed(fn):
+            # same RTT correction as the headline timing: one
+            # dispatch+fetch rides the relay once per call
+            float(fn(q)[0, 0, 0, 0])  # compile
+            t0 = time.time()
+            float(fn(q)[0, 0, 0, 0])
+            total = time.time() - t0
+            total -= min(rtt_ms * 1e-3, total / 2)
+            return total / steps * 1e3
+
+        fwd_ms = _timed(_fwd_many)
+        fwdbwd_ms = _timed(_bwd_many)
         # attention FLOPs: causal ⇒ ~half of 4*b*h*s^2*d (fwd)
         att_fl = 2 * b * h * s * s * d  # qk^T + av, halved for causal
         diag["flash_attention"] = {
@@ -227,6 +234,95 @@ def _attention_diag(diag: dict, small: bool = False) -> None:
     except Exception as e:
         diag["flash_attention"] = f"failed: {e}"
         print(f"# flash-attn diag failed: {e}", file=sys.stderr, flush=True)
+
+
+def _run_timing(args, jax, step1, state, rtt_ms, make_record,
+                metric: str = "train_images_per_sec_per_chip",
+                unit: str = "images/s/chip"):
+    """Relay-safe timing of ``step1: state -> (state, loss_scalar)``.
+
+    (a) provisional: chained python loop with ONE scalar fetch — upper
+    bound (includes per-call dispatch/RTT), cannot wedge; stored in
+    _PROVISIONAL via ``make_record`` so the watchdog has a real number.
+    (b) headline: K steps in one jitted ``lax.scan`` — single dispatch,
+    single fetch, minus one measured RTT.
+    Returns (state, dt, method, dt_loop, last_loss)."""
+    # at least one warmup step always runs: its scalar fetch is the sync
+    # anchor that keeps prior work out of the timed window (and --warmup 0
+    # would otherwise leave `loss` unbound)
+    for _ in range(max(1, args.warmup)):
+        state, loss = step1(state)
+    float(loss)
+    t0 = time.time()
+    for _ in range(args.steps):
+        state, loss = step1(state)
+    last_loss = float(loss)
+    dt_loop = (time.time() - t0) / args.steps
+
+    value, vs, diag = make_record(dt_loop, "loop_fetch", dt_loop, last_loss)
+    _PROVISIONAL.update(value=value, vs_baseline=vs, diagnostics=diag,
+                        metric=metric, unit=unit)
+    print(f"# provisional (loop+fetch): step={dt_loop*1e3:.2f}ms",
+          file=sys.stderr, flush=True)
+
+    dt, method = dt_loop, "loop_fetch"
+    try:
+        K = args.steps
+
+        @jax.jit
+        def _many(s):
+            def body(c, _):
+                c2, l = step1(c)
+                return c2, l
+            return jax.lax.scan(body, s, None, length=K)
+
+        t0 = time.time()
+        state, losses = _many(state)
+        last_loss = float(losses[-1])
+        scan_compile_s = time.time() - t0
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.time()
+            state, losses = _many(state)
+            last_loss = float(losses[-1])
+            total = time.time() - t0
+            # one dispatch+fetch still rides the relay once per call:
+            # subtract the measured RTT (capped at half the total so a
+            # mis-measured RTT can never eat the signal)
+            total -= min(rtt_ms * 1e-3, total / 2)
+            best = min(best, total / K)
+        dt = best
+        method = f"scan{K}"
+        print(f"# scan timing: step={dt*1e3:.3f}ms "
+              f"(scan compile {scan_compile_s:.0f}s)",
+              file=sys.stderr, flush=True)
+    except Exception as e:
+        print(f"# scan timing failed ({type(e).__name__}: {e}); "
+              f"reporting loop timing", file=sys.stderr, flush=True)
+    return state, dt, method, dt_loop, last_loss
+
+
+def _base_diag(dt, method, dt_loop, last_loss, *, flops, n_chips, peak,
+               rtt_ms, compile_s, devices, extras):
+    """Shared diagnostics-record builder (the image and lm paths add
+    model-specific keys via ``extras`` — one builder so new fields can
+    never silently diverge between artifact kinds)."""
+    mfu_v = (flops / dt) / (n_chips * peak) if flops else 0.0
+    rec = {
+        "device_kind": devices[0].device_kind,
+        "n_chips": n_chips,
+        **extras,
+        "step_ms": round(dt * 1e3, 3),
+        "timing_method": method,
+        "step_ms_loop": round(dt_loop * 1e3, 3),
+        "rtt_ms": round(rtt_ms, 1),
+        "compile_s": round(compile_s, 1),
+        "flops_per_step": flops,
+        "mfu": round(mfu_v, 4),
+        "peak_flops_assumed": peak,
+        "loss": round(last_loss, 4),
+    }
+    return mfu_v, rec
 
 
 def _measure_rtt() -> float:
@@ -288,14 +384,16 @@ def main() -> int:
                    help="capture a jax.profiler trace of the timed steps "
                         "into DIR (view in Perfetto/TensorBoard) — the "
                         "op-level evidence behind MFU_ANALYSIS.md")
-    p.add_argument("--model", choices=["cnn", "vit", "resnet50"],
+    p.add_argument("--model", choices=["cnn", "vit", "resnet50", "lm"],
                    default="cnn",
                    help="cnn = flagship MobileNetV2 transfer config "
                         "(the reference's P1/03 parity target); vit = "
                         "dense ViT train step, the MXU-bound MFU "
                         "demonstrator (see MFU_ANALYSIS.md); resnet50 = "
                         "the classic images/sec CNN benchmark (dense "
-                        "convs, full backward, no freezing)")
+                        "convs, full backward, no freezing); lm = "
+                        "long-context decoder LM at seq 4096 (Pallas "
+                        "flash attention + remat in the loop)")
     args = p.parse_args()
 
     if args.smoke:
@@ -312,6 +410,9 @@ def main() -> int:
                 error=f"watchdog: deadline {args.deadline}s hit during "
                       f"refinement; reporting provisional loop-timed result",
                 diagnostics=_PROVISIONAL.get("diagnostics"),
+                metric=_PROVISIONAL.get(
+                    "metric", "train_images_per_sec_per_chip"),
+                unit=_PROVISIONAL.get("unit", "images/s/chip"),
             )
         else:
             emit(0.0, 0.0, error=f"watchdog: deadline {args.deadline}s "
@@ -349,6 +450,8 @@ def _bench(args) -> int:
         return 0
 
     n_chips = len(devices)
+    if args.model == "lm":
+        return _bench_lm(args, devices)
     if args.model == "vit":
         # dense MFU demonstrator: full-backward ViT training step.
         # MobileNetV2's depthwise convs cap its MFU well below the 60%
@@ -412,108 +515,49 @@ def _bench(args) -> int:
     rtt_ms = _measure_rtt()
     print(f"# host<->device rtt: {rtt_ms:.1f} ms", file=sys.stderr, flush=True)
 
-    t_compile = time.time()
-    state, m = trainer._train_step(trainer.state, images, labels, lr)
-    loss0 = float(m["loss"])  # scalar fetch = real sync (relay-safe)
-    compile_s = time.time() - t_compile
+    def _step1_impl(s):
+        ns, mm = trainer._train_step(s, images, labels, lr)
+        return ns, mm["loss"]
 
-    flops = flops_of_jitted(
-        trainer._train_step, trainer.state, images, labels, lr
-    )
+    step1 = jax.jit(_step1_impl, donate_argnums=0)
+
+    t_compile = time.time()
+    flops = flops_of_jitted(step1, trainer.state)
+    state, loss = step1(trainer.state)
+    float(loss)  # scalar fetch = real sync (relay-safe)
+    compile_s = time.time() - t_compile
     peak = device_peak_flops(devices[0])
 
-    # -- (a) provisional: chained python loop, ONE scalar fetch at the
-    # end. Upper-bounds the step time (includes per-call dispatch/RTT
-    # pipelining effects) but cannot wedge beyond args.steps calls.
-    for _ in range(args.warmup):
-        state, m = trainer._train_step(state, images, labels, lr)
-    float(m["loss"])
-    t0 = time.time()
-    for _ in range(args.steps):
-        state, m = trainer._train_step(state, images, labels, lr)
-    last_loss = float(m["loss"])
-    dt_loop = (time.time() - t0) / args.steps
+    def _diag_for(dt, method, dt_loop, last_loss):
+        return _base_diag(
+            dt, method, dt_loop, last_loss, flops=flops, n_chips=n_chips,
+            peak=peak, rtt_ms=rtt_ms, compile_s=compile_s, devices=devices,
+            extras={"image_hw": hw, "batch_per_chip": batch},
+        )
 
-    def _diag_for(dt, method):
-        mfu_v = (flops / dt) / (n_chips * peak) if flops else 0.0
-        return mfu_v, {
-            "device_kind": devices[0].device_kind,
-            "n_chips": n_chips,
-            "image_hw": hw,
-            "batch_per_chip": batch,
-            "step_ms": round(dt * 1e3, 3),
-            "timing_method": method,
-            "step_ms_loop": round(dt_loop * 1e3, 3),
-            "rtt_ms": round(rtt_ms, 1),
-            "compile_s": round(compile_s, 1),
-            "flops_per_step": flops,
-            "mfu": round(mfu_v, 4),
-            "peak_flops_assumed": peak,
-            "loss": round(last_loss, 4),
-        }
+    def _record(dt, method, dt_loop, last_loss):
+        mfu_v, diag = _diag_for(dt, method, dt_loop, last_loss)
+        return global_batch / dt / n_chips, mfu_v / 0.60, diag
 
-    mfu_loop, diag_loop = _diag_for(dt_loop, "loop_fetch")
-    _PROVISIONAL.update(
-        value=global_batch / dt_loop / n_chips,
-        vs_baseline=mfu_loop / 0.60,
-        diagnostics=diag_loop,
+    state, dt, method, dt_loop, last_loss = _run_timing(
+        args, jax, step1, state, rtt_ms, _record
     )
-    print(f"# provisional (loop+fetch): step={dt_loop*1e3:.2f}ms "
-          f"MFU={mfu_loop*100:.1f}%", file=sys.stderr, flush=True)
-
-    # -- (b) headline: K steps inside one jitted lax.scan — single
-    # dispatch, single fetch; true device steady-state over any relay.
-    dt = dt_loop
-    method = "loop_fetch"
-    try:
-        K = args.steps
-
-        @jax.jit
-        def _many(state):
-            def body(s, _):
-                s2, mm = trainer._train_step(s, images, labels, lr)
-                return s2, mm["loss"]
-            return jax.lax.scan(body, state, None, length=K)
-
-        t0 = time.time()
-        state, losses = _many(state)
-        last_loss = float(losses[-1])
-        scan_compile_s = time.time() - t0
-        best = float("inf")
-        for _ in range(2):
-            t0 = time.time()
-            state, losses = _many(state)
-            last_loss = float(losses[-1])
-            total = time.time() - t0
-            # one dispatch+fetch still rides the relay once per call:
-            # subtract the measured RTT (capped at half the total so a
-            # mis-measured RTT can never eat the signal)
-            total -= min(rtt_ms * 1e-3, total / 2)
-            best = min(best, total / K)
-        dt = best
-        method = f"scan{K}"
-        print(f"# scan timing: step={dt*1e3:.3f}ms "
-              f"(scan compile {scan_compile_s:.0f}s)",
-              file=sys.stderr, flush=True)
-    except Exception as e:
-        print(f"# scan timing failed ({type(e).__name__}: {e}); "
-              f"reporting loop timing", file=sys.stderr, flush=True)
 
     if args.trace:
         # profile a few EXTRA steps after the timed loop — capture
         # overhead must not contaminate the reported step time/MFU
         with jax.profiler.trace(args.trace):
             for _ in range(min(5, args.steps)):
-                state, m = trainer._train_step(state, images, labels, lr)
-            float(m["loss"])
+                state, loss = step1(state)
+            float(loss)
 
     img_per_sec_chip = global_batch / dt / n_chips
-    mfu_val, diag = _diag_for(dt, method)
+    mfu_val, diag = _diag_for(dt, method, dt_loop, last_loss)
     diag["decode_img_per_s"] = round(_decode_diag(hw), 0)
     if args.trace:
         diag["trace_dir"] = args.trace  # captured AFTER the timed loop
     if not args.no_attn_diag:
-        _attention_diag(diag, small=args.smoke)
+        _attention_diag(diag, small=args.smoke, rtt_ms=rtt_ms)
 
     print(
         f"# devices={n_chips} ({devices[0].device_kind}) hw={hw} width={width} "
@@ -523,6 +567,97 @@ def _bench(args) -> int:
         file=sys.stderr, flush=True,
     )
     emit(img_per_sec_chip, mfu_val / 0.60, diagnostics=diag)
+    return 0
+
+
+def _bench_lm(args, devices) -> int:
+    """Long-context decoder-LM training step (the capability the
+    reference lacks entirely — SURVEY.md §5.7): seq 4096 with the Pallas
+    flash kernel auto-selected (tpuflow.ops.pick_attn_impl ≥1024 on
+    TPU), per-block gradient checkpointing, AdamW. Reports tokens/s/chip
+    in diagnostics; ``value`` stays sequences/s/chip for schema parity."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpuflow.models import build_transformer_lm, next_token_loss
+    from tpuflow.obs.mfu import device_peak_flops, flops_of_jitted
+
+    n_chips = len(devices)
+    if args.smoke:
+        seq, batch, dim, depth, heads, vocab = 128, args.batch or 2, 64, 2, 4, 256
+    else:
+        seq, batch, dim, depth, heads, vocab = (
+            4096, args.batch or 4, 1024, 12, 16, 32000
+        )
+    model = build_transformer_lm(
+        vocab_size=vocab, dim=dim, depth=depth, heads=heads,
+        attn_impl="auto", remat=not args.smoke,
+    )
+    global_batch = batch * n_chips
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, vocab, (global_batch, seq), dtype=np.int32
+        )
+    )
+    params = model.init({"params": jax.random.key(0)}, tokens[:1])["params"]
+    tx = optax.adamw(3e-4)
+
+    def _step1_impl(carry):
+        p, opt = carry
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens, train=True)
+            return next_token_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, opt = tx.update(grads, opt, p)
+        return (optax.apply_updates(p, updates), opt), loss
+
+    step1 = jax.jit(_step1_impl, donate_argnums=0)
+    state = (params, tx.init(params))
+
+    rtt_ms = _measure_rtt()
+    t_compile = time.time()
+    flops = flops_of_jitted(step1, state)
+    state, loss = step1(state)
+    float(loss)
+    compile_s = time.time() - t_compile
+    peak = device_peak_flops(devices[0])
+
+    def _diag_for(dt, method, dt_loop, last_loss):
+        return _base_diag(
+            dt, method, dt_loop, last_loss, flops=flops, n_chips=n_chips,
+            peak=peak, rtt_ms=rtt_ms, compile_s=compile_s, devices=devices,
+            extras={
+                "model": f"lm-d{dim}x{depth}h{heads}-s{seq}",
+                "seq_len": seq,
+                "batch_per_chip": batch,
+                "sequences_per_sec_per_chip": round(
+                    global_batch / dt / n_chips, 2
+                ),
+            },
+        )
+
+    def _record(dt, method, dt_loop, last_loss):
+        mfu_v, diag = _diag_for(dt, method, dt_loop, last_loss)
+        return global_batch * seq / dt / n_chips, mfu_v / 0.60, diag
+
+    state, dt, method, dt_loop, last_loss = _run_timing(
+        args, jax, step1, state, rtt_ms, _record,
+        metric="train_tokens_per_sec_per_chip", unit="tokens/s/chip",
+    )
+    mfu_val, diag = _diag_for(dt, method, dt_loop, last_loss)
+    tok_s_chip = global_batch * seq / dt / n_chips
+    print(
+        f"# lm seq={seq} batch/chip={batch} step={dt*1e3:.2f}ms "
+        f"tokens/s/chip={tok_s_chip:.0f} "
+        f"MFU={mfu_val*100:.1f}% loss={last_loss:.4f}",
+        file=sys.stderr, flush=True,
+    )
+    emit(tok_s_chip, mfu_val / 0.60, diagnostics=diag,
+         metric="train_tokens_per_sec_per_chip", unit="tokens/s/chip")
     return 0
 
 

@@ -122,3 +122,41 @@ def test_kernel_matches_masked_block_ref():
             np.testing.assert_allclose(
                 a[:, :s_valid], b[:, :s_valid], atol=5e-5, rtol=5e-4
             )
+
+
+def test_mha_xla_matches_oracle_f32():
+    q, k, v = (_rand((2, 2, 24, 16), i) for i in range(3))
+    from tpuflow.ops import mha_xla
+
+    for causal in (False, True):
+        np.testing.assert_allclose(
+            mha_xla(q, k, v, causal=causal),
+            mha_reference(q, k, v, causal=causal),
+            atol=2e-5, rtol=2e-5,
+        )
+
+
+def test_mha_xla_bf16_dtype_and_parity():
+    q, k, v = (_rand((1, 2, 32, 16), i, jnp.bfloat16) for i in range(3))
+    from tpuflow.ops import mha_xla
+
+    out = mha_xla(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        out.astype(np.float32),
+        mha_reference(q, k, v).astype(np.float32),
+        atol=3e-2, rtol=3e-2,
+    )
+
+
+def test_pick_attn_impl():
+    from tpuflow.core.hw import is_tpu_backend
+    from tpuflow.ops import pick_attn_impl
+
+    # explicit requests pass through untouched
+    assert pick_attn_impl(4096, "einsum") == "einsum"
+    assert pick_attn_impl(64, "flash") == "flash"
+    # auto: einsum at vision lengths; flash only on TPU at >=1024
+    assert pick_attn_impl(196) == "einsum"
+    expected_long = "flash" if is_tpu_backend() else "einsum"
+    assert pick_attn_impl(4096) == expected_long
