@@ -596,12 +596,23 @@ def _bench_lm(args, devices) -> int:
         attn_impl="auto", remat=not args.smoke,
     )
     global_batch = batch * n_chips
-    tokens = jnp.asarray(
-        np.random.default_rng(0).integers(
-            0, vocab, (global_batch, seq), dtype=np.int32
-        )
+    # batch-shard the tokens over all chips and replicate params — the
+    # per-chip normalization below is only honest if every chip works
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpuflow.parallel.mesh import DATA_AXIS, build_nd_mesh
+
+    mesh = build_nd_mesh({DATA_AXIS: n_chips}, devices=devices)
+    tokens = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(0).integers(
+                0, vocab, (global_batch, seq), dtype=np.int32
+            )
+        ),
+        NamedSharding(mesh, P(DATA_AXIS, None)),
     )
     params = model.init({"params": jax.random.key(0)}, tokens[:1])["params"]
+    params = jax.device_put(params, NamedSharding(mesh, P()))
     tx = optax.adamw(3e-4)
 
     def _step1_impl(carry):
@@ -649,6 +660,13 @@ def _bench_lm(args, devices) -> int:
         metric="train_tokens_per_sec_per_chip", unit="tokens/s/chip",
     )
     mfu_val, diag = _diag_for(dt, method, dt_loop, last_loss)
+    if args.trace:
+        # extra steps AFTER the timed window (same as the image path)
+        with jax.profiler.trace(args.trace):
+            for _ in range(min(5, args.steps)):
+                state, loss = step1(state)
+            float(loss)
+        diag["trace_dir"] = args.trace
     tok_s_chip = global_batch * seq / dt / n_chips
     print(
         f"# lm seq={seq} batch/chip={batch} step={dt*1e3:.2f}ms "

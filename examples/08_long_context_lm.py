@@ -18,6 +18,11 @@ demonstrated here on a virtual mesh. Run on CPU:
       python examples/08_long_context_lm.py
 
 On a TPU slice, drop the env vars: the mesh axes map onto ICI.
+
+This file shows the RAW recipe (explicit shard_map + manual update) so
+every moving part is visible; the packaged API for the same thing —
+with optimizer-by-name, LR warmup, tracking and checkpoint/resume — is
+``tpuflow.train.LMTrainer`` (tests/test_lm_trainer.py).
 """
 
 import os

@@ -1,4 +1,5 @@
 from tpuflow.train.trainer import Trainer  # noqa: F401
+from tpuflow.train.lm import LMTrainer  # noqa: F401
 from tpuflow.train.state import TrainState  # noqa: F401
 from tpuflow.train.lr import LRController  # noqa: F401
 from tpuflow.train.callbacks import (  # noqa: F401

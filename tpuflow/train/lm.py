@@ -1,0 +1,288 @@
+"""First-class long-context LM training.
+
+BEYOND-REFERENCE capability (SURVEY.md §5.7: the reference has no
+attention and no sequence axis — its only long-input story is dataset
+streaming). ``LMTrainer`` packages the recipe demonstrated raw in
+examples/08_long_context_lm.py as a peer of the image ``Trainer``:
+
+- **Mesh**: ``data`` (batch) × optional ``seq`` (context) axes. With a
+  ``seq_axis`` model, attention runs as ring attention — K/V shards
+  rotating over ICI (tpuflow.parallel.ring_attention) — and per-device
+  memory is O(seq / sp), the linear-context-scaling recipe.
+- **Collectives**: the forward is a ``shard_map`` over the mesh; loss
+  and grads are taken on the gathered logits under ``jit``, so XLA's
+  partitioner inserts the data-axis all-reduce (no hand-written pmean —
+  contrast tpuflow.train.trainer, which keeps the manual-pmean DP path
+  for reference parity with Horovod, SURVEY.md §5.8).
+- **Shared machinery**: TrainState, optimizer-by-name with runtime LR
+  (tpuflow.train.optimizers), LR warmup × world-size scaling
+  (tpuflow.train.lr — ≙ P1/03:300-318 applied to a new model family),
+  atomic checkpoint/resume (tpuflow.ckpt), tracking-store logging and
+  rank-0 side-effect discipline (≙ P1/03:360-373).
+
+Token batches are plain int32 arrays ``(batch, seq_len)`` — the LM has
+no decode/augmentation plane, so there is no converter/loader layer in
+between (corpus tokenization is upstream of this framework).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from tpuflow.ckpt.checkpoint import (
+    latest_checkpoint,
+    restore_into_state,
+    save_checkpoint,
+)
+from tpuflow.core.config import TrainConfig
+from tpuflow.core.dist import is_primary
+from tpuflow.models.transformer import TransformerLM, next_token_loss
+from tpuflow.parallel.mesh import DATA_AXIS, build_nd_mesh
+from tpuflow.train.lr import LRController
+from tpuflow.train.optimizers import get_optimizer, set_learning_rate
+from tpuflow.train.state import TrainState
+
+
+class LMTrainer:
+    """Data- (and optionally sequence-) parallel causal-LM trainer.
+
+    ``model.seq_axis`` decides the topology: ``None`` → pure DP over the
+    mesh's ``data`` axis; a named axis (e.g. ``"seq"``) → the mesh must
+    carry that axis too and tokens are sharded along it (ring
+    attention). ``batch_size`` in :meth:`fit` is GLOBAL (the whole mesh
+    consumes one batch per step).
+    """
+
+    def __init__(
+        self,
+        model: TransformerLM,
+        config: Optional[TrainConfig] = None,
+        mesh=None,
+        devices=None,
+    ):
+        self.model = model
+        self.cfg = config or TrainConfig()
+        if mesh is None:
+            n = len(devices) if devices is not None else len(jax.devices())
+            axes = {DATA_AXIS: n}
+            if model.seq_axis is not None:
+                axes = {DATA_AXIS: 1, model.seq_axis: n}
+            mesh = build_nd_mesh(axes, devices=devices)
+        self.mesh = mesh
+        if model.seq_axis is not None and model.seq_axis not in mesh.axis_names:
+            raise ValueError(
+                f"model.seq_axis={model.seq_axis!r} not in mesh axes "
+                f"{mesh.axis_names}"
+            )
+        self.world = mesh.shape[DATA_AXIS] if DATA_AXIS in mesh.axis_names else 1
+        self.sp = (
+            mesh.shape[model.seq_axis] if model.seq_axis is not None else 1
+        )
+        self.state: Optional[TrainState] = None
+        self.tx = None
+        self._train_step = None
+        self._eval_step = None
+        self.lr_controller: Optional[LRController] = None
+        self._initial_epoch = 0
+
+    # ---- initialization --------------------------------------------------
+
+    def init_state(self, rng_seed: Optional[int] = None) -> TrainState:
+        seed = self.cfg.seed if rng_seed is None else rng_seed
+        # init via the seq_axis=None twin: identical param tree (the
+        # named axis matters only inside shard_map at apply time), and
+        # it needs no mesh — same trick as examples/08.
+        plain = (
+            self.model.clone(seq_axis=None)
+            if self.model.seq_axis is not None
+            else self.model
+        )
+        toks0 = jnp.zeros((1, 8), jnp.int32)
+        params = nn.unbox(plain.init({"params": jax.random.key(seed)}, toks0))[
+            "params"
+        ]
+        self.tx = get_optimizer(
+            self.cfg.optimizer,
+            self.cfg.learning_rate,
+            **self.cfg.optimizer_kwargs,
+        )
+        self.state = TrainState(
+            step=jnp.asarray(0, jnp.int32),
+            params=params,
+            batch_stats={},
+            opt_state=self.tx.init(params),
+            rng=jax.random.key(seed),
+            plateau_factor=jnp.asarray(1.0, jnp.float32),
+        )
+        return self.state
+
+    # ---- steps -----------------------------------------------------------
+
+    def _token_spec(self):
+        if self.model.seq_axis is not None:
+            return P(DATA_AXIS, self.model.seq_axis)
+        return P(DATA_AXIS)
+
+    def _make_steps(self) -> None:
+        model = self.model
+        mesh = self.mesh
+
+        fwd = shard_map(
+            lambda p, t, train: model.apply({"params": p}, t, train=train),
+            mesh=mesh,
+            in_specs=(P(), self._token_spec(), P()),
+            out_specs=(
+                P(DATA_AXIS, model.seq_axis, None)
+                if model.seq_axis is not None
+                else P(DATA_AXIS, None, None)
+            ),
+        )
+
+        def train_step(state: TrainState, tokens, lr):
+            def loss_fn(p):
+                # loss over the GLOBAL gathered logits: the next-token
+                # shift crosses sequence-shard boundaries, so it must
+                # happen outside the shard_map (next_token_loss doc)
+                return next_token_loss(fwd(p, tokens, True), tokens)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            opt_state = set_learning_rate(state.opt_state, lr)
+            updates, opt_state = self.tx.update(grads, opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = state.replace(
+                step=state.step + 1, params=params, opt_state=opt_state
+            )
+            return new_state, {"loss": loss}
+
+        def eval_step(state: TrainState, tokens):
+            loss = next_token_loss(fwd(state.params, tokens, False), tokens)
+            return {"loss": loss}
+
+        self._train_step = jax.jit(train_step, donate_argnums=0)
+        self._eval_step = jax.jit(eval_step)
+
+    # ---- checkpoint / resume --------------------------------------------
+
+    def maybe_resume(self, checkpoint_dir: Optional[str]) -> int:
+        """Restore the newest checkpoint if one exists; returns the
+        epoch to continue from (0 when starting fresh)."""
+        if not checkpoint_dir:
+            return 0
+        path = latest_checkpoint(checkpoint_dir)
+        if path is None:
+            return 0
+        if self.state is None:
+            self.init_state()
+        self.state = restore_into_state(path, self.state)
+        step = int(self.state.step)
+        self._initial_epoch = int(
+            path.rsplit("-", 1)[-1].split(".")[0]
+        )
+        if is_primary():
+            print(f"resumed from {path} (step {step})")
+        return self._initial_epoch
+
+    # ---- fit -------------------------------------------------------------
+
+    def fit(
+        self,
+        train_tokens: np.ndarray,
+        batch_size: int,
+        epochs: Optional[int] = None,
+        val_tokens: Optional[np.ndarray] = None,
+        checkpoint_dir: Optional[str] = None,
+        run=None,
+        on_epoch: Optional[Callable[[int, Dict[str, float]], None]] = None,
+    ) -> Dict[str, float]:
+        """Train on ``(N, seq_len)`` int32 token rows; returns the final
+        epoch's metrics. Deterministic per-epoch shuffle (seeded by
+        config.seed + epoch, so resume replays the right order)."""
+        cfg = self.cfg
+        epochs = epochs if epochs is not None else cfg.epochs
+        if self.state is None:
+            self.init_state()
+        if self._train_step is None:
+            self._make_steps()
+        n = int(train_tokens.shape[0])
+        steps_per_epoch = max(1, n // int(batch_size))
+        self.lr_controller = LRController(
+            cfg.learning_rate,
+            world_size=self.world,
+            scale_by_world_size=cfg.scale_lr_by_world_size,
+            warmup_epochs=cfg.warmup_epochs,
+            steps_per_epoch=steps_per_epoch,
+        )
+        metrics: Dict[str, float] = {}
+        global_step = self._initial_epoch * steps_per_epoch
+        for epoch in range(self._initial_epoch, epochs):
+            order = np.random.default_rng(cfg.seed + epoch).permutation(n)
+            losses = []
+            for i in range(steps_per_epoch):
+                rows = order[i * batch_size : (i + 1) * batch_size]
+                toks = jnp.asarray(train_tokens[rows], jnp.int32)
+                lr = self.lr_controller.lr_for_step(global_step)
+                self.state, m = self._train_step(
+                    self.state, toks, jnp.asarray(lr, jnp.float32)
+                )
+                losses.append(m["loss"])
+                global_step += 1
+            epoch_loss = float(jnp.mean(jnp.stack(losses)))
+            metrics = {"loss": epoch_loss, "lr": float(lr)}
+            if val_tokens is not None:
+                vlosses = []
+                for j in range(
+                    max(1, int(val_tokens.shape[0]) // int(batch_size))
+                ):
+                    vt = jnp.asarray(
+                        val_tokens[j * batch_size : (j + 1) * batch_size],
+                        jnp.int32,
+                    )
+                    if vt.shape[0] < batch_size:
+                        break
+                    vlosses.append(self._eval_step(self.state, vt)["loss"])
+                if vlosses:
+                    vl = float(jnp.mean(jnp.stack(vlosses)))
+                    metrics["val_loss"] = vl
+                    metrics["val_ppl"] = float(np.exp(min(vl, 20.0)))
+            # rank-0-only tracking side effects (≙ P1/03:360-361);
+            # ``run`` is a tpuflow.track Run handle, same idiom as
+            # TrackingCallback on the image Trainer
+            if run is not None and is_primary():
+                for k, v in metrics.items():
+                    run.log_metric(k, float(v), step=epoch)
+            if checkpoint_dir:
+                save_checkpoint(checkpoint_dir, self.state, epoch + 1)
+            if on_epoch is not None:
+                on_epoch(epoch, metrics)
+        return metrics
+
+    # ---- evaluation ------------------------------------------------------
+
+    def evaluate(
+        self, tokens: np.ndarray, batch_size: int
+    ) -> Dict[str, float]:
+        if self._eval_step is None:
+            self._make_steps()
+        if int(tokens.shape[0]) < int(batch_size):
+            raise ValueError(
+                f"evaluate needs at least one full batch: got "
+                f"{int(tokens.shape[0])} rows < batch_size={batch_size}"
+            )
+        losses = []
+        for j in range(max(1, int(tokens.shape[0]) // int(batch_size))):
+            t = jnp.asarray(
+                tokens[j * batch_size : (j + 1) * batch_size], jnp.int32
+            )
+            if t.shape[0] < batch_size:
+                break
+            losses.append(self._eval_step(self.state, t)["loss"])
+        loss = float(jnp.mean(jnp.stack(losses)))
+        return {"loss": loss, "ppl": float(np.exp(min(loss, 20.0)))}
