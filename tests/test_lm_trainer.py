@@ -96,3 +96,110 @@ def test_lm_trainer_checkpoint_resume(tmp_path):
     m = tr2.fit(toks, batch_size=8, epochs=3, checkpoint_dir=ckpt)
     assert int(tr2.state.step) == step_after_2 + 4  # one more epoch of 4 steps
     assert np.isfinite(m["loss"])
+
+
+_MP_WORKER = """
+import json, os, sys
+sys.path.insert(0, os.environ["TPUFLOW_REPO"])
+import tpuflow.core as core
+core.initialize()
+import jax
+import jax.numpy as jnp
+import numpy as np
+from tpuflow.core.config import TrainConfig
+from tpuflow.models import build_transformer_lm
+from tpuflow.train import LMTrainer
+
+work = os.environ["TPUFLOW_TEST_WORK"]
+assert jax.process_count() == 2, jax.process_count()
+pid = jax.process_index()
+
+lm = build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=4,
+                          mlp_ratio=2, dtype=jnp.float32)
+cfg = TrainConfig(optimizer="sgd", learning_rate=1e-2, warmup_epochs=0,
+                  scale_lr_by_world_size=False, seed=0)
+tr = LMTrainer(lm, cfg)  # mesh over BOTH processes' devices
+toks = np.load(os.path.join(work, "toks.npy"))
+m = tr.fit(toks, batch_size=8, epochs=2,
+           checkpoint_dir=os.path.join(work, "ck"))
+with open(os.path.join(work, f"lm_metrics_{pid}.json"), "w") as f:
+    json.dump({"loss": m["loss"], "is_primary": core.is_primary()}, f)
+print("proc", pid, "loss", m["loss"])
+"""
+
+
+@pytest.mark.slow
+def test_lm_trainer_two_process_matches_single(tmp_path):
+    """2-process DP == 1-process run on the same union batches
+    (replica placement must not change the math — the LM analogue of
+    test_multiproc_train)."""
+    import json
+    import os
+    import sys
+
+    from tpuflow.cli.launch import main as launch_main
+
+    work = str(tmp_path)
+    toks = _corpus(32, 16, seed=9)
+    np.save(os.path.join(work, "toks.npy"), toks)
+    script = tmp_path / "lm_worker.py"
+    script.write_text(_MP_WORKER)
+    env_backup = dict(os.environ)
+    os.environ["TPUFLOW_REPO"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    os.environ["TPUFLOW_TEST_WORK"] = work
+    try:
+        rc = launch_main(["--local", "2", "--port", "8919", "--",
+                          sys.executable, str(script)])
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+    assert rc == 0
+
+    m0 = json.load(open(os.path.join(work, "lm_metrics_0.json")))
+    m1 = json.load(open(os.path.join(work, "lm_metrics_1.json")))
+    assert m0["is_primary"] and not m1["is_primary"]
+    np.testing.assert_allclose(m0["loss"], m1["loss"], rtol=1e-6)
+    # rank-0-only checkpoint writes happened
+    assert any("checkpoint" in c for c in os.listdir(os.path.join(work, "ck")))
+
+    # single-process on 2 devices over the same batches
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.parallel.mesh import build_nd_mesh
+
+    mesh = build_nd_mesh({"data": 2}, devices=jax.devices()[:2])
+    cfg = TrainConfig(optimizer="sgd", learning_rate=1e-2, warmup_epochs=0,
+                      scale_lr_by_world_size=False, seed=0)
+    tr = LMTrainer(_tiny_lm(), cfg, mesh=mesh)
+    m = tr.fit(toks, batch_size=8, epochs=2)
+    np.testing.assert_allclose(m0["loss"], m["loss"], rtol=5e-4)
+
+
+def test_lm_trainer_resume_consume_once_and_complete(tmp_path):
+    """maybe_resume's epoch applies to the NEXT fit only; resuming at
+    the final checkpoint returns eval metrics, not an empty dict."""
+    ckpt = str(tmp_path / "ck")
+    mesh = build_nd_mesh({"data": 1}, devices=jax.devices()[:1])
+    cfg = TrainConfig(optimizer="adamw", learning_rate=3e-3,
+                      warmup_epochs=0, seed=0)
+    toks = _corpus(16, 16)
+    tr = LMTrainer(_tiny_lm(), cfg, mesh=mesh)
+    tr.fit(toks, batch_size=8, epochs=2, checkpoint_dir=ckpt)
+
+    tr2 = LMTrainer(_tiny_lm(), cfg, mesh=mesh)
+    assert tr2.maybe_resume(ckpt) == 2
+    m = tr2.fit(toks, batch_size=8, epochs=2)  # nothing left to train
+    assert np.isfinite(m["loss"]) and "ppl" in m
+    step_after = int(tr2.state.step)
+    # a later fit() does NOT replay from epoch 2 — it trains fresh epochs
+    tr2.fit(toks, batch_size=8, epochs=1)
+    assert int(tr2.state.step) == step_after + 2  # 16/8 = 2 steps
+
+
+def test_lm_trainer_put_divisibility_errors():
+    mesh = build_nd_mesh({"data": 4}, devices=jax.devices()[:4])
+    tr = LMTrainer(_tiny_lm(), TrainConfig(warmup_epochs=0), mesh=mesh)
+    toks = _corpus(12, 16)
+    with pytest.raises(ValueError, match="not divisible by mesh data"):
+        tr.fit(toks, batch_size=6, epochs=1)

@@ -31,6 +31,15 @@ from flax import serialization
 _PAT = re.compile(r"checkpoint-(\d+)\.ckpt$")
 
 
+def checkpoint_number(path: str) -> int:
+    """The N of a ``checkpoint-{N}.ckpt`` path (the reference's layout,
+    P2/02:206-211) — the one parser for the filename format."""
+    m = _PAT.search(path)
+    if m is None:
+        raise ValueError(f"not a checkpoint path: {path!r}")
+    return int(m.group(1))
+
+
 def _is_key(x: Any) -> bool:
     from tpuflow.parallel.mesh import is_typed_prng_key
 

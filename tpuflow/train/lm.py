@@ -38,6 +38,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from tpuflow.ckpt.checkpoint import (
+    checkpoint_number,
     latest_checkpoint,
     restore_into_state,
     save_checkpoint,
@@ -131,6 +132,33 @@ class LMTrainer:
             return P(DATA_AXIS, self.model.seq_axis)
         return P(DATA_AXIS)
 
+    def _put(self, toks_np: np.ndarray):
+        """Process-local token rows → global batch-sharded array (same
+        idiom as Trainer._put: every process contributes its slice of
+        the global batch; with one process this is a plain device_put).
+        Multi-process sequence sharding requires the ``seq`` axis to
+        live within each process's addressable devices — the normal
+        topology (DP across hosts, SP inside a host/slice on ICI)."""
+        from jax.sharding import NamedSharding
+
+        n_data = self.mesh.shape.get(DATA_AXIS, 1)
+        global_rows = toks_np.shape[0] * jax.process_count()
+        if global_rows % n_data:
+            raise ValueError(
+                f"global batch {global_rows} not divisible by mesh data "
+                f"axis {n_data}; choose batch_size as a multiple of "
+                f"{n_data}"
+            )
+        if toks_np.shape[1] % self.sp:
+            raise ValueError(
+                f"seq_len {toks_np.shape[1]} not divisible by the "
+                f"sequence-parallel degree {self.sp}"
+            )
+        sharding = NamedSharding(self.mesh, self._token_spec())
+        return jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(toks_np, dtype=np.int32)
+        )
+
     def _make_steps(self) -> None:
         model = self.model
         mesh = self.mesh
@@ -183,14 +211,43 @@ class LMTrainer:
             self.init_state()
         self.state = restore_into_state(path, self.state)
         step = int(self.state.step)
-        self._initial_epoch = int(
-            path.rsplit("-", 1)[-1].split(".")[0]
-        )
+        self._initial_epoch = checkpoint_number(path)
         if is_primary():
             print(f"resumed from {path} (step {step})")
         return self._initial_epoch
 
     # ---- fit -------------------------------------------------------------
+
+    def _local_slice(self, batch_size: int) -> Tuple[int, int]:
+        """(rows per process, this process's index) for a GLOBAL batch."""
+        pc = jax.process_count()
+        if batch_size % pc:
+            raise ValueError(
+                f"global batch_size={batch_size} must divide by "
+                f"process_count={pc}"
+            )
+        return batch_size // pc, jax.process_index()
+
+    def _eval_mean_loss(
+        self, tokens: np.ndarray, batch_size: int
+    ) -> Optional[float]:
+        """Mean eval loss over all full global batches (None if there is
+        not even one). Shared by fit()'s val path and evaluate()."""
+        b_local, proc = self._local_slice(batch_size)
+        losses = []
+        for j in range(max(1, int(tokens.shape[0]) // int(batch_size))):
+            rows = tokens[j * batch_size : (j + 1) * batch_size]
+            if rows.shape[0] < batch_size:
+                break
+            t = self._put(rows[proc * b_local : (proc + 1) * b_local])
+            losses.append(self._eval_step(self.state, t)["loss"])
+        if not losses:
+            return None
+        return float(jnp.mean(jnp.stack(losses)))
+
+    @staticmethod
+    def _ppl(loss: float) -> float:
+        return float(np.exp(min(loss, 20.0)))
 
     def fit(
         self,
@@ -200,18 +257,33 @@ class LMTrainer:
         val_tokens: Optional[np.ndarray] = None,
         checkpoint_dir: Optional[str] = None,
         run=None,
+        initial_epoch: Optional[int] = None,
         on_epoch: Optional[Callable[[int, Dict[str, float]], None]] = None,
     ) -> Dict[str, float]:
         """Train on ``(N, seq_len)`` int32 token rows; returns the final
         epoch's metrics. Deterministic per-epoch shuffle (seeded by
-        config.seed + epoch, so resume replays the right order)."""
+        config.seed + epoch, so resume replays the right order).
+
+        ``initial_epoch`` defaults to the epoch recorded by the last
+        :meth:`maybe_resume` — consumed ONCE, so a later fit() on the
+        same trainer continues fresh instead of replaying old epochs
+        (pass it explicitly for full control, ≙ Trainer.fit). If no
+        epochs remain (a restart landed on the final checkpoint), the
+        restored model is evaluated instead so the returned metrics
+        always carry ``loss``."""
         cfg = self.cfg
         epochs = epochs if epochs is not None else cfg.epochs
         if self.state is None:
             self.init_state()
         if self._train_step is None:
             self._make_steps()
+        start = (
+            initial_epoch if initial_epoch is not None
+            else self._initial_epoch
+        )
+        self._initial_epoch = 0  # consume-once (see docstring)
         n = int(train_tokens.shape[0])
+        b_local, proc = self._local_slice(batch_size)
         steps_per_epoch = max(1, n // int(batch_size))
         self.lr_controller = LRController(
             cfg.learning_rate,
@@ -220,14 +292,28 @@ class LMTrainer:
             warmup_epochs=cfg.warmup_epochs,
             steps_per_epoch=steps_per_epoch,
         )
+        if start >= epochs:
+            # nothing left to train — report eval metrics of the
+            # restored state rather than an empty dict
+            metrics = self.evaluate(train_tokens, batch_size)
+            if val_tokens is not None:
+                vl = self._eval_mean_loss(val_tokens, batch_size)
+                if vl is not None:
+                    metrics["val_loss"] = vl
+                    metrics["val_ppl"] = self._ppl(vl)
+            return metrics
         metrics: Dict[str, float] = {}
-        global_step = self._initial_epoch * steps_per_epoch
-        for epoch in range(self._initial_epoch, epochs):
+        global_step = start * steps_per_epoch
+        for epoch in range(start, epochs):
             order = np.random.default_rng(cfg.seed + epoch).permutation(n)
             losses = []
             for i in range(steps_per_epoch):
+                # the shuffle order is seed-deterministic, so every
+                # process slices the SAME global batch and takes its own
+                # contiguous rows (≙ cur_shard=rank, P1/03:332-337)
                 rows = order[i * batch_size : (i + 1) * batch_size]
-                toks = jnp.asarray(train_tokens[rows], jnp.int32)
+                rows = rows[proc * b_local : (proc + 1) * b_local]
+                toks = self._put(train_tokens[rows])
                 lr = self.lr_controller.lr_for_step(global_step)
                 self.state, m = self._train_step(
                     self.state, toks, jnp.asarray(lr, jnp.float32)
@@ -237,21 +323,10 @@ class LMTrainer:
             epoch_loss = float(jnp.mean(jnp.stack(losses)))
             metrics = {"loss": epoch_loss, "lr": float(lr)}
             if val_tokens is not None:
-                vlosses = []
-                for j in range(
-                    max(1, int(val_tokens.shape[0]) // int(batch_size))
-                ):
-                    vt = jnp.asarray(
-                        val_tokens[j * batch_size : (j + 1) * batch_size],
-                        jnp.int32,
-                    )
-                    if vt.shape[0] < batch_size:
-                        break
-                    vlosses.append(self._eval_step(self.state, vt)["loss"])
-                if vlosses:
-                    vl = float(jnp.mean(jnp.stack(vlosses)))
+                vl = self._eval_mean_loss(val_tokens, batch_size)
+                if vl is not None:
                     metrics["val_loss"] = vl
-                    metrics["val_ppl"] = float(np.exp(min(vl, 20.0)))
+                    metrics["val_ppl"] = self._ppl(vl)
             # rank-0-only tracking side effects (≙ P1/03:360-361);
             # ``run`` is a tpuflow.track Run handle, same idiom as
             # TrackingCallback on the image Trainer
@@ -269,20 +344,14 @@ class LMTrainer:
     def evaluate(
         self, tokens: np.ndarray, batch_size: int
     ) -> Dict[str, float]:
+        if self.state is None:
+            self.init_state()
         if self._eval_step is None:
             self._make_steps()
-        if int(tokens.shape[0]) < int(batch_size):
+        loss = self._eval_mean_loss(tokens, batch_size)
+        if loss is None:
             raise ValueError(
                 f"evaluate needs at least one full batch: got "
                 f"{int(tokens.shape[0])} rows < batch_size={batch_size}"
             )
-        losses = []
-        for j in range(max(1, int(tokens.shape[0]) // int(batch_size))):
-            t = jnp.asarray(
-                tokens[j * batch_size : (j + 1) * batch_size], jnp.int32
-            )
-            if t.shape[0] < batch_size:
-                break
-            losses.append(self._eval_step(self.state, t)["loss"])
-        loss = float(jnp.mean(jnp.stack(losses)))
-        return {"loss": loss, "ppl": float(np.exp(min(loss, 20.0)))}
+        return {"loss": loss, "ppl": self._ppl(loss)}
