@@ -203,3 +203,32 @@ def test_lm_trainer_put_divisibility_errors():
     toks = _corpus(12, 16)
     with pytest.raises(ValueError, match="not divisible by mesh data"):
         tr.fit(toks, batch_size=6, epochs=1)
+
+
+def test_lm_hpo_objective():
+    """The TPE tuner is model-agnostic: an LMTrainer objective works the
+    same as the reference's image objectives (C14 pattern — return
+    {'loss', 'status'}), here minimizing LM val loss over lr."""
+    from tpuflow.tune import STATUS_OK, Trials, fmin, hp
+
+    toks = _corpus(32, 16)
+    val = _corpus(16, 16, seed=1)
+    mesh = build_nd_mesh({"data": 2}, devices=jax.devices()[:2])
+
+    def objective(params):
+        cfg = TrainConfig(optimizer="adamw",
+                          learning_rate=params["lr"],
+                          warmup_epochs=0, scale_lr_by_world_size=False,
+                          seed=0)
+        tr = LMTrainer(_tiny_lm(), cfg, mesh=mesh)
+        m = tr.fit(toks, batch_size=16, epochs=2, val_tokens=val)
+        return {"loss": m["val_loss"], "status": STATUS_OK}
+
+    trials = Trials()
+    best = fmin(objective, {"lr": hp.loguniform(-9, -3)},
+                max_evals=4, seed=3, trials=trials)
+    assert np.exp(-9) <= best["lr"] <= np.exp(-3)
+    assert all(np.isfinite(l) for l in trials.losses)
+    # fmin returns the argmin of the observed losses
+    assert trials.best().loss == min(trials.losses)
+    assert trials.best().params["lr"] == best["lr"]
