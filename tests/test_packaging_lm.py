@@ -1,0 +1,157 @@
+"""Packaged LM: the pyfunc-style artifact for the transformer family.
+
+≙ the reference's package → register → stage → load-by-URI flow
+(P2/01:282-299, P2/03:354-446) applied to the LM family the reference
+lacks. Pins: save/load round trip preserves greedy generation exactly,
+URIs resolve through store and registry, and the one-shot
+lm_train_and_package workflow produces a loadable, scoring artifact.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpuflow.core.config import TrainConfig
+from tpuflow.models import build_transformer_lm
+from tpuflow.packaging import PackagedLM, load_packaged_lm, save_packaged_lm
+from tpuflow.track import TrackingStore
+from tpuflow.track.registry import ModelRegistry
+
+LM_CFG = dict(vocab_size=48, dim=32, depth=2, heads=4, mlp_ratio=2,
+              dtype="float32")
+
+
+def _params(cfg):
+    import flax.linen as nn
+
+    model = build_transformer_lm(**{**cfg, "dtype": jnp.float32})
+    return model, nn.unbox(
+        model.init({"params": jax.random.key(0)},
+                   jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+
+
+def test_save_load_roundtrip_greedy_exact(tmp_path):
+    model, params = _params(LM_CFG)
+    out = save_packaged_lm(str(tmp_path / "pkg"), params, LM_CFG,
+                           generate_defaults={"temperature": 0.0})
+    lm = load_packaged_lm(out)
+    prompts = np.array([[1, 2, 3], [7, 8, 9]], np.int32)
+    got = lm.generate(prompts, max_new_tokens=5)
+    # oracle: generate() on the original params
+    from tpuflow.infer.generate import generate
+
+    want = np.asarray(generate(model, params, prompts, 5, temperature=0.0))
+    np.testing.assert_array_equal(got, want)
+    # prompts preserved
+    np.testing.assert_array_equal(got[:, :3], prompts)
+    s = lm.score(np.array([[1, 2, 3, 4, 5, 6, 7, 8]], np.int32))
+    assert np.isfinite(s["loss"]) and s["ppl"] > 0
+
+
+def test_wrong_model_type_rejected(tmp_path):
+    from tpuflow.packaging import save_packaged_model
+
+    d = str(tmp_path / "img")
+    save_packaged_model(d, params={}, batch_stats={}, classes=["a", "b"])
+    with pytest.raises(ValueError, match="not a packaged LM"):
+        load_packaged_lm(d)
+
+
+def test_lm_train_package_register_stage_load(tmp_path):
+    from tpuflow import workflows
+    from tpuflow.parallel.mesh import build_nd_mesh
+
+    store = TrackingStore(str(tmp_path / "runs"))
+    rng = np.random.default_rng(0)
+    start, stride = rng.integers(0, 48, (48, 1)), rng.integers(1, 5, (48, 1))
+    toks = ((start + stride * np.arange(16)[None, :]) % 48).astype(np.int32)
+
+    mesh = build_nd_mesh({"data": 2}, devices=jax.devices()[:2])
+    res = workflows.lm_train_and_package(
+        store, toks[:32], toks[32:], LM_CFG, batch_size=8,
+        train_config=TrainConfig(optimizer="adamw", learning_rate=3e-3,
+                                 warmup_epochs=0, seed=0),
+        epochs=2, mesh=mesh,
+        generate_defaults={"temperature": 0.0, "max_new_tokens": 4},
+    )
+    assert res["model_uri"].startswith("runs:/")
+    assert np.isfinite(res["val_loss"]) and res["val_ppl"] > 0
+
+    # load via runs:/ URI
+    lm = load_packaged_lm(res["model_uri"], store=store)
+    out = lm.generate(np.array([[1, 2, 3, 4]], np.int32))
+    assert out.shape == (1, 8)  # packaged default max_new_tokens=4
+
+    # registry: register -> Production -> load via models:/ URI
+    reg = ModelRegistry(store)
+    v = reg.register_model(res["model_uri"], "tiny_lm")
+    reg.transition_model_version_stage("tiny_lm", v["version"], "Production")
+    lm2 = load_packaged_lm("models:/tiny_lm/production", registry=reg)
+    np.testing.assert_array_equal(
+        lm2.generate(np.array([[1, 2, 3, 4]], np.int32)), out
+    )
+    # run params recorded the architecture
+    run = store.get_run(res["run_id"])
+    assert run.params().get("lm.dim") == "32"
+
+
+def test_sp_trained_lm_packages_and_scores(tmp_path):
+    """A ring-SP-trained LM must package into a plain (unsharded)
+    servable: score() and generate() work outside shard_map."""
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    cfg = {**LM_CFG, "seq_axis": "seq"}
+    mesh = build_nd_mesh({"data": 2, "seq": 2}, devices=jax.devices()[:4])
+    tr = LMTrainer(
+        build_transformer_lm(**{**cfg, "dtype": jnp.float32}),
+        TrainConfig(optimizer="adamw", learning_rate=3e-3, warmup_epochs=0),
+        mesh=mesh,
+    )
+    rng = np.random.default_rng(2)
+    toks = ((rng.integers(0, 48, (16, 1))
+             + rng.integers(1, 5, (16, 1)) * np.arange(16)[None, :])
+            % 48).astype(np.int32)
+    tr.fit(toks, batch_size=4, epochs=1)
+
+    out = save_packaged_lm(str(tmp_path / "sp_pkg"), tr.state.params, cfg)
+    lm = load_packaged_lm(out)
+    s = lm.score(toks[:2])
+    assert np.isfinite(s["loss"])
+    g = lm.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=3)
+    assert g.shape == (1, 6)
+
+
+def test_save_packaged_lm_normalizes_real_dtype(tmp_path):
+    _, params = _params(LM_CFG)
+    cfg = {**LM_CFG, "dtype": jnp.bfloat16}  # a REAL dtype, not a string
+    out = save_packaged_lm(str(tmp_path / "pkg"), params, cfg)
+    import json, os
+    meta = json.load(open(os.path.join(out, "MODEL.json")))
+    assert meta["model_config"]["dtype"] == "bfloat16"
+    lm = load_packaged_lm(out)  # loads without error
+    assert lm.model.dtype == jnp.bfloat16
+
+
+def test_lm_workflow_resume(tmp_path):
+    from tpuflow import workflows
+    from tpuflow.parallel.mesh import build_nd_mesh
+
+    store = TrackingStore(str(tmp_path / "runs"))
+    rng = np.random.default_rng(3)
+    toks = ((rng.integers(0, 48, (16, 1))
+             + rng.integers(1, 5, (16, 1)) * np.arange(12)[None, :])
+            % 48).astype(np.int32)
+    mesh = build_nd_mesh({"data": 1}, devices=jax.devices()[:1])
+    ck = str(tmp_path / "ck")
+    kw = dict(batch_size=8, epochs=2, mesh=mesh, checkpoint_dir=ck,
+              train_config=TrainConfig(optimizer="adamw",
+                                       learning_rate=3e-3, warmup_epochs=0))
+    workflows.lm_train_and_package(store, toks, None, LM_CFG, **kw)
+    # relaunch with resume: nothing left to train, still packages + metrics
+    res = workflows.lm_train_and_package(store, toks, None, LM_CFG,
+                                         resume=True, **kw)
+    assert res["model_uri"] is not None

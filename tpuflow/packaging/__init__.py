@@ -3,3 +3,8 @@ from tpuflow.packaging.model import (  # noqa: F401
     load_packaged_model,
     save_packaged_model,
 )
+from tpuflow.packaging.lm import (  # noqa: F401
+    PackagedLM,
+    load_packaged_lm,
+    save_packaged_lm,
+)

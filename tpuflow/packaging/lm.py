@@ -1,0 +1,165 @@
+"""Packaged LM — the pyfunc-style artifact for the transformer family.
+
+The reference's packaged-model concept (C13: weights + config + pre/post
+processing in one loadable directory, P2/03:157-234) applied to the
+model family the reference doesn't have: a causal LM whose "predict" is
+autoregressive generation (tpuflow.infer.generate) and whose eval is
+next-token loss / perplexity. Same directory format family as
+tpuflow.packaging.model (MODEL.json + weights.msgpack), same registry
+story (register the directory, stage it, load by URI).
+
+Directory layout:
+  MODEL.json        format metadata, model_config, generate_defaults
+  weights.msgpack   params
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from tpuflow.track.store import _atomic_json
+
+_FORMAT_VERSION = 1
+_MODEL_TYPE = "transformer_lm"
+
+
+def save_packaged_lm(
+    out_dir: str,
+    params: Any,
+    model_config: Dict[str, Any],
+    generate_defaults: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Bundle LM params + build config (+ default sampling knobs) into a
+    loadable directory (≙ mlflow.pyfunc.log_model, P2/03:354-363).
+
+    ``model_config`` is the kwargs of
+    :func:`tpuflow.models.build_transformer_lm` that rebuild this
+    architecture (vocab_size, dim, depth, heads, ...).
+    """
+    import jax
+    from flax import serialization
+
+    os.makedirs(out_dir, exist_ok=True)
+    model_config = dict(model_config)
+    if "dtype" in model_config and not isinstance(model_config["dtype"], str):
+        # normalize real dtypes to their name so the JSON round trip is
+        # loadable (getattr(jnp, name) on load)
+        model_config["dtype"] = np.dtype(model_config["dtype"]).name
+    meta = {
+        "format_version": _FORMAT_VERSION,
+        "model_type": _MODEL_TYPE,
+        "model_config": model_config,
+        "generate_defaults": dict(generate_defaults or {}),
+    }
+    _atomic_json(os.path.join(out_dir, "MODEL.json"), meta)
+    with open(os.path.join(out_dir, "weights.msgpack"), "wb") as f:
+        f.write(
+            serialization.msgpack_serialize({"params": jax.device_get(params)})
+        )
+    return out_dir
+
+
+class PackagedLM:
+    """Loaded packaged LM: token prompts in → continuations out."""
+
+    def __init__(self, path: str):
+        from flax import serialization
+
+        from tpuflow.models import build_transformer_lm
+
+        with open(os.path.join(path, "MODEL.json")) as f:
+            self.meta = json.load(f)
+        if self.meta.get("format_version", 0) > _FORMAT_VERSION:
+            raise ValueError("packaged LM from a newer format version")
+        if self.meta.get("model_type") != _MODEL_TYPE:
+            raise ValueError(
+                f"not a packaged LM: model_type={self.meta.get('model_type')!r}"
+                " (image classifiers load via tpuflow.packaging.PackagedModel)"
+            )
+        cfg = dict(self.meta["model_config"])
+        # dtype arrives as a string after the JSON round trip
+        if isinstance(cfg.get("dtype"), str):
+            import jax.numpy as jnp
+
+            cfg["dtype"] = getattr(jnp, cfg["dtype"])
+        # a packaged model serves OUTSIDE shard_map: strip the training
+        # topology axes (an LM trained with ring-attention SP or expert
+        # sharding has identical params; the named axes matter only at
+        # sharded apply time — same twin trick as LMTrainer.init_state)
+        cfg.pop("seq_axis", None)
+        cfg.pop("ep_axis", None)
+        self.model = build_transformer_lm(**cfg)
+        self._jit_loss = None
+        with open(os.path.join(path, "weights.msgpack"), "rb") as f:
+            payload = serialization.msgpack_restore(f.read())
+        self.params = payload["params"]
+        self.generate_defaults: Dict[str, Any] = self.meta.get(
+            "generate_defaults", {}
+        )
+
+    def generate(
+        self,
+        prompts: np.ndarray,
+        max_new_tokens: Optional[int] = None,
+        **kwargs,
+    ) -> np.ndarray:
+        """(B, P) int32 prompts → (B, P + max_new_tokens) int32.
+        Keyword args (temperature, top_k, seed, eos_id) default to the
+        packaged ``generate_defaults``."""
+        from tpuflow.infer.generate import generate
+
+        opts = dict(self.generate_defaults)
+        opts.update(kwargs)
+        if max_new_tokens is None:
+            max_new_tokens = int(opts.pop("max_new_tokens", 32))
+        else:
+            opts.pop("max_new_tokens", None)
+        out = generate(
+            self.model,
+            self.params,
+            np.asarray(prompts, np.int32),
+            max_new_tokens=int(max_new_tokens),
+            **opts,
+        )
+        return np.asarray(out)
+
+    def score(self, tokens: np.ndarray) -> Dict[str, float]:
+        """Mean next-token loss + perplexity of (B, S) int32 rows —
+        the LM analogue of the classifier's evaluate metrics."""
+        import jax
+        import jax.numpy as jnp
+
+        from tpuflow.models.transformer import next_token_loss
+
+        if self._jit_loss is None:
+            # built once — score() in an eval loop must not retrace
+            self._jit_loss = jax.jit(
+                lambda params, toks: next_token_loss(
+                    self.model.apply({"params": params}, toks), toks
+                )
+            )
+        loss = float(
+            self._jit_loss(self.params, jnp.asarray(tokens, jnp.int32))
+        )
+        return {"loss": loss, "ppl": float(np.exp(min(loss, 20.0)))}
+
+
+def load_packaged_lm(
+    uri_or_path: str, store=None, registry=None
+) -> PackagedLM:
+    """Load by path, ``runs:/...`` or ``models:/...`` URI
+    (≙ mlflow.pyfunc.load_model, P2/03:446, for the LM format)."""
+    path = uri_or_path
+    if uri_or_path.startswith("models:/"):
+        if registry is None:
+            raise ValueError("models:/ uri needs a registry")
+        path = registry.resolve_uri(uri_or_path)
+    elif uri_or_path.startswith("runs:/"):
+        if store is None:
+            raise ValueError("runs:/ uri needs a tracking store")
+        path = store.resolve_uri(uri_or_path)
+    return PackagedLM(path)

@@ -276,3 +276,81 @@ def train_and_package(
         "val_loss": val_loss,
         "val_accuracy": val_acc,
     }
+
+
+def lm_train_and_package(
+    store: TrackingStore,
+    train_tokens,
+    val_tokens,
+    lm_config: Dict[str, Any],
+    batch_size: int,
+    train_config: Optional[TrainConfig] = None,
+    epochs: Optional[int] = None,
+    run_name: str = "lm_train_and_package",
+    parent_run_id: Optional[str] = None,
+    mesh=None,
+    checkpoint_dir: Optional[str] = None,
+    resume: bool = False,
+    generate_defaults: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """The C20 one-shot pipeline for the LM family: run-create → param
+    log → LMTrainer fit → package (tpuflow.packaging.lm) → evaluate →
+    metrics. Returns {'run_id', 'model_uri', 'val_loss', 'val_ppl'}.
+
+    ``resume=True`` restores the newest checkpoint under
+    ``checkpoint_dir`` and continues from its epoch (≙
+    train_and_evaluate's resume path; the restart half of gang
+    relaunch).
+
+    ``lm_config``: build_transformer_lm kwargs that define the
+    architecture — stored in the package so the artifact is
+    self-contained (≙ the img-params artifact of P2/03:285-287).
+    """
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.packaging import save_packaged_lm
+    from tpuflow.train import LMTrainer
+
+    cfg = train_config or TrainConfig()
+    run = (
+        store.start_run(run_name=run_name, parent_run_id=parent_run_id)
+        if is_primary()
+        else None
+    )
+    run_id = run.run_id if run is not None else None
+    trainer = LMTrainer(build_transformer_lm(**lm_config), cfg, mesh=mesh)
+    initial_epoch = trainer.maybe_resume(checkpoint_dir) if resume else 0
+    if run is not None:
+        run.log_params(
+            {f"lm.{k}": str(v) for k, v in lm_config.items()}
+            | {
+                "optimizer": cfg.optimizer,
+                "learning_rate": cfg.learning_rate,
+                "batch_size": batch_size,
+                "epochs": epochs if epochs is not None else cfg.epochs,
+            }
+        )
+    metrics = trainer.fit(
+        train_tokens,
+        batch_size=batch_size,
+        epochs=epochs,
+        val_tokens=val_tokens,
+        checkpoint_dir=checkpoint_dir,
+        run=run,
+        initial_epoch=initial_epoch,
+    )
+    model_uri = None
+    if run is not None:
+        save_packaged_lm(
+            os.path.join(run.artifact_path(), "model"),
+            params=trainer.state.params,
+            model_config=lm_config,
+            generate_defaults=generate_defaults,
+        )
+        run.end("FINISHED")
+        model_uri = f"runs:/{run.run_id}/model"
+    return {
+        "run_id": run_id,
+        "model_uri": model_uri,
+        "val_loss": metrics.get("val_loss"),
+        "val_ppl": metrics.get("val_ppl"),
+    }
