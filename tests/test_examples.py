@@ -35,6 +35,7 @@ def test_examples_import():
         "06_tune_distributed",
         "07_package_and_batch_inference",
         "08_long_context_lm",
+        "09_lm_pipeline",
     ]:
         assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
 
@@ -75,3 +76,24 @@ def test_long_context_example():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "ring-attention LM training OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_lm_pipeline_example(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "09_lm_pipeline.py"),
+         str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "lm pipeline OK" in r.stdout
+    # the packaged model really learned the corpus (threshold, not
+    # bit-exact: float reduction order may shift a token across
+    # jax/XLA versions)
+    import re
+
+    m = re.search(r"accuracy: (\d+)/8", r.stdout)
+    assert m and int(m.group(1)) >= 6, r.stdout[-1000:]

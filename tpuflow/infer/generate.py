@@ -21,6 +21,7 @@ tests/test_generate.py.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import jax
@@ -75,6 +76,19 @@ def generate(
                 f"{'=' + str(vocab) if vocab is not None else ''}]"
             )
     max_len = p + max_new_tokens
+    run = _compiled_run(dm, b, p, max_len, float(temperature),
+                        None if top_k is None else int(top_k), eos_id)
+    return run(params, jnp.asarray(prompt, jnp.int32),
+               jax.random.key(seed))
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_run(dm, b: int, p: int, max_len: int, temperature: float,
+                  top_k: Optional[int], eos_id: Optional[int]):
+    """The jitted prompt+decode scan, memoized on (model, shapes,
+    sampling config) — a serving loop calling generate() per request
+    with identical shapes must compile ONCE, not per call (flax modules
+    are frozen dataclasses, so ``dm`` is a valid cache key)."""
 
     # cache struct at full length via eval_shape (no FLOPs), then zeros
     cache_shapes = jax.eval_shape(
@@ -83,10 +97,13 @@ def generate(
             jnp.zeros((b, max_len), jnp.int32),
         )["cache"]
     )
-    cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
-
     @jax.jit
     def run(params, prompt, rng):
+        # zeros built INSIDE the jit: the memoized closure then holds
+        # only ShapeDtypeStructs, not live device buffers
+        cache0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        )
         out0 = jnp.zeros((b, max_len), jnp.int32)
         out0 = lax.dynamic_update_slice(out0, prompt, (0, 0))
         done0 = jnp.zeros((b,), jnp.bool_)
@@ -116,5 +133,4 @@ def generate(
         )
         return out
 
-    return run(params, jnp.asarray(prompt, jnp.int32),
-               jax.random.key(seed))
+    return run

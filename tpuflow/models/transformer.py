@@ -328,6 +328,15 @@ def build_transformer_lm(
     )
 
 
+def perplexity(loss: float) -> float:
+    """exp(loss) with the standard overflow clamp — THE ppl definition
+    shared by LMTrainer metrics and PackagedLM.score (one clamp, one
+    place)."""
+    import numpy as np
+
+    return float(np.exp(min(float(loss), 20.0)))
+
+
 def next_token_loss(logits, tokens, ignore_index: int = -1):
     """Mean cross-entropy of logits[:, :-1] predicting tokens[:, 1:].
 

@@ -133,7 +133,7 @@ class PackagedLM:
         import jax
         import jax.numpy as jnp
 
-        from tpuflow.models.transformer import next_token_loss
+        from tpuflow.models.transformer import next_token_loss, perplexity
 
         if self._jit_loss is None:
             # built once — score() in an eval loop must not retrace
@@ -145,7 +145,7 @@ class PackagedLM:
         loss = float(
             self._jit_loss(self.params, jnp.asarray(tokens, jnp.int32))
         )
-        return {"loss": loss, "ppl": float(np.exp(min(loss, 20.0)))}
+        return {"loss": loss, "ppl": perplexity(loss)}
 
 
 def load_packaged_lm(

@@ -247,7 +247,9 @@ class LMTrainer:
 
     @staticmethod
     def _ppl(loss: float) -> float:
-        return float(np.exp(min(loss, 20.0)))
+        from tpuflow.models.transformer import perplexity
+
+        return perplexity(loss)
 
     def fit(
         self,
