@@ -30,6 +30,7 @@ while true; do
     [ -f BENCH_LOCAL_r02_vit.json ] || capture BENCH_LOCAL_r02_vit.json --model vit --steps 15 || ok=1
     [ -f BENCH_LOCAL_r02_resnet50.json ] || capture BENCH_LOCAL_r02_resnet50.json --model resnet50 --steps 20 --no-attn-diag || ok=1
     [ -f BENCH_LOCAL_r02_lm.json ] || capture BENCH_LOCAL_r02_lm.json --model lm --steps 10 --no-attn-diag || ok=1
+    [ -f BENCH_LOCAL_r02_e2e.json ] || capture BENCH_LOCAL_r02_e2e.json --end2end --no-attn-diag || ok=1
     if [ "$ok" -eq 0 ]; then echo "$(date) all captures done" >> "$log"; exit 0; fi
   else
     echo "$(date) tunnel down" >> "$log"
