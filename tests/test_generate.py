@@ -124,3 +124,61 @@ def test_overfit_lm_recites_training_sequence():
     prompt = jnp.asarray(pattern[None, :5])  # 0 1 2 3 4
     out = np.asarray(generate(m, params, prompt, 6, temperature=0.0))
     np.testing.assert_array_equal(out[0, 5:], (np.arange(5, 11) % 8))
+
+
+def test_top_p_nucleus_restricts_support():
+    """With a peaked distribution and small top_p, sampling must only
+    ever pick the head tokens; top_p=1.0 leaves sampling unrestricted."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuflow.infer.generate import _sample
+
+    # token 0 holds ~73% mass, token 1 ~27%; the rest negligible
+    logits = jnp.array([[5.0, 4.0, -2.0, -3.0, -4.0]])
+    picks = set()
+    for i in range(64):
+        picks.add(int(_sample(logits, jax.random.key(i), 1.0, None, 0.5)[0]))
+    assert picks == {0}  # 0.5 mass: only token 0 is in the nucleus
+    picks = set()
+    for i in range(64):
+        picks.add(int(_sample(logits, jax.random.key(i), 1.0, None, 0.95)[0]))
+    assert picks <= {0, 1} and 1 in picks
+    # top_p=1.0 behaves like plain temperature sampling (support can
+    # include the tail)
+    many = [int(_sample(jnp.zeros((1, 5)), jax.random.key(i), 1.0, None,
+                        1.0)[0]) for i in range(64)]
+    assert len(set(many)) >= 4
+
+
+def test_generate_top_p_validation_and_run():
+    import numpy as np
+    import pytest
+
+    from tpuflow.infer.generate import generate
+
+    model = _tiny_lm()
+    params = _params(model)
+    prompt = np.array([[1, 2, 3]], np.int32)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, temperature=1.0, top_p=0.0)
+    out = generate(model, params, prompt, 4, temperature=0.8, top_p=0.9,
+                   seed=1)
+    assert out.shape == (1, 7)
+
+
+def test_top_p_tied_logits_do_not_leak():
+    """Value-threshold nucleus filters keep every token tied with the
+    cutoff; the index-scatter implementation must not (uniform logits +
+    top_p=0.5 keeps ceil-half of the vocab, not all of it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.infer.generate import _sample
+
+    logits = jnp.zeros((1, 6))  # fully tied
+    picks = {int(_sample(logits, jax.random.key(i), 1.0, None, 0.5)[0])
+             for i in range(128)}
+    # 0.5 mass over 6 uniform tokens -> exactly 3 survive the filter
+    assert len(picks) == 3, picks

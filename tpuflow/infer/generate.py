@@ -11,8 +11,9 @@ serving loop, TPU-idiomatically:
   with the full target length (decode steps ``dynamic_update_slice``
   into it), so XLA sees one fixed buffer per layer — no growing
   tensors, no host round-trips per token;
-- sampling is temperature + optional top-k over float32 logits with a
-  counter-derived ``jax.random`` key per step.
+- sampling is temperature + optional top-k and nucleus (top-p)
+  filtering over float32 logits with a counter-derived ``jax.random``
+  key per step.
 
 Greedy (temperature=0) decode is exact argmax; the cache-consistency
 property (stepwise logits == full-forward logits) is tested in
@@ -29,15 +30,36 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+def _sample(logits, rng, temperature: float, top_k: Optional[int],
+            top_p: Optional[float] = None):
     logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
-    if top_k is not None:
-        k = min(max(int(top_k), 1), logits.shape[-1])
-        kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
-        logits = jnp.where(logits < kth, -1e30, logits)
+    want_p = top_p is not None and top_p < 1.0
+    if top_k is not None or want_p:
+        # ONE descending sort serves both filters, and the keep mask is
+        # scattered back by INDEX — a value threshold would keep every
+        # token tied with the cutoff logit (uniform logits + top_p=0.5
+        # would filter nothing)
+        vocab = logits.shape[-1]
+        idx = jnp.argsort(logits, axis=-1)[..., ::-1]
+        desc = jnp.take_along_axis(logits, idx, axis=-1)
+        keep_sorted = jnp.ones(desc.shape, bool)
+        if top_k is not None:
+            k = min(max(int(top_k), 1), vocab)
+            keep_sorted &= jnp.arange(vocab) < k
+        if want_p:
+            # nucleus: the smallest prefix of descending-prob tokens
+            # whose mass reaches top_p (the top token always stays —
+            # its preceding cumulative mass is 0)
+            probs = jax.nn.softmax(desc, axis=-1)
+            before = jnp.cumsum(probs, axis=-1) - probs
+            keep_sorted &= before < top_p
+        keep = jnp.zeros(desc.shape, bool)
+        keep = jnp.put_along_axis(keep, idx, keep_sorted, axis=-1,
+                                  inplace=False)
+        logits = jnp.where(keep, logits, -1e30)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -48,6 +70,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     seed: int = 0,
     eos_id: Optional[int] = None,
 ) -> jnp.ndarray:
@@ -75,16 +98,20 @@ def generate(
                 f"top_k={top_k} must be in [1, vocab_size"
                 f"{'=' + str(vocab) if vocab is not None else ''}]"
             )
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p={top_p} must be in (0, 1]")
     max_len = p + max_new_tokens
     run = _compiled_run(dm, b, p, max_len, float(temperature),
-                        None if top_k is None else int(top_k), eos_id)
+                        None if top_k is None else int(top_k),
+                        None if top_p is None else float(top_p), eos_id)
     return run(params, jnp.asarray(prompt, jnp.int32),
                jax.random.key(seed))
 
 
 @functools.lru_cache(maxsize=64)
 def _compiled_run(dm, b: int, p: int, max_len: int, temperature: float,
-                  top_k: Optional[int], eos_id: Optional[int]):
+                  top_k: Optional[int], top_p: Optional[float],
+                  eos_id: Optional[int]):
     """The jitted prompt+decode scan, memoized on (model, shapes,
     sampling config) — a serving loop calling generate() per request
     with identical shapes must compile ONCE, not per call (flax modules
@@ -115,7 +142,8 @@ def _compiled_run(dm, b: int, p: int, max_len: int, temperature: float,
                 {"params": params, "cache": cache}, tok, mutable=["cache"]
             )
             nxt = _sample(
-                logits[:, -1], jax.random.fold_in(rng, t), temperature, top_k
+                logits[:, -1], jax.random.fold_in(rng, t), temperature,
+                top_k, top_p,
             )
             # positions < p-1 are prefill: keep the prompt token that is
             # already in ``out`` instead of the model's prediction

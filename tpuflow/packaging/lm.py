@@ -108,8 +108,8 @@ class PackagedLM:
         **kwargs,
     ) -> np.ndarray:
         """(B, P) int32 prompts → (B, P + max_new_tokens) int32.
-        Keyword args (temperature, top_k, seed, eos_id) default to the
-        packaged ``generate_defaults``."""
+        Keyword args (temperature, top_k, top_p, seed, eos_id) default
+        to the packaged ``generate_defaults``."""
         from tpuflow.infer.generate import generate
 
         opts = dict(self.generate_defaults)
