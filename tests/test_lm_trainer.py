@@ -232,3 +232,18 @@ def test_lm_hpo_objective():
     # fmin returns the argmin of the observed losses
     assert trials.best().loss == min(trials.losses)
     assert trials.best().params["lr"] == best["lr"]
+
+
+def test_lm_trainer_throughput_metrics():
+    mesh = build_nd_mesh({"data": 2}, devices=jax.devices()[:2])
+    tr = LMTrainer(_tiny_lm(), TrainConfig(optimizer="adamw",
+                                           learning_rate=3e-3,
+                                           warmup_epochs=0), mesh=mesh)
+    m = tr.fit(_corpus(16, 16), batch_size=8, epochs=1)
+    # 2 steps/epoch: step 0 (compile) is excluded, step 1 is timed
+    assert m["tokens_per_sec"] > 0
+    assert 0.0 <= m.get("mfu", 0.0) < 1.0
+    # a second fit with DIFFERENT shapes must re-derive FLOPs (stale
+    # cache would corrupt MFU) and still report throughput
+    m2 = tr.fit(_corpus(32, 32), batch_size=16, epochs=1)
+    assert m2["tokens_per_sec"] > 0

@@ -27,6 +27,8 @@ between (corpus tokenization is upstream of this framework).
 
 from __future__ import annotations
 
+import time
+
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import flax.linen as nn
@@ -93,6 +95,7 @@ class LMTrainer:
         self._eval_step = None
         self.lr_controller: Optional[LRController] = None
         self._initial_epoch = 0
+        self._flops_per_step: Optional[float] = None  # XLA cost analysis
 
     # ---- initialization --------------------------------------------------
 
@@ -306,9 +309,15 @@ class LMTrainer:
             return metrics
         metrics: Dict[str, float] = {}
         global_step = start * steps_per_epoch
+        seq_len = int(train_tokens.shape[1])
+        # shapes are fixed within one fit but not across fits — stale
+        # FLOPs from a previous fit's shapes would corrupt MFU
+        self._flops_per_step = None
         for epoch in range(start, epochs):
             order = np.random.default_rng(cfg.seed + epoch).permutation(n)
             losses = []
+            t_epoch = None
+            timed_steps = 0
             for i in range(steps_per_epoch):
                 # the shuffle order is seed-deterministic, so every
                 # process slices the SAME global batch and takes its own
@@ -317,13 +326,48 @@ class LMTrainer:
                 rows = rows[proc * b_local : (proc + 1) * b_local]
                 toks = self._put(train_tokens[rows])
                 lr = self.lr_controller.lr_for_step(global_step)
+                if self._flops_per_step is None:
+                    # one lower+compile for XLA cost analysis (shares
+                    # the jit compile cache with the step call below) —
+                    # feeds the throughput/MFU metrics (N11). NOTE the
+                    # result is PER-DEVICE flops for a sharded program.
+                    try:
+                        from tpuflow.obs.mfu import flops_of_jitted
+
+                        self._flops_per_step = flops_of_jitted(
+                            self._train_step, self.state, toks,
+                            jnp.asarray(lr, jnp.float32),
+                        )
+                    except Exception:
+                        self._flops_per_step = 0.0
                 self.state, m = self._train_step(
                     self.state, toks, jnp.asarray(lr, jnp.float32)
                 )
                 losses.append(m["loss"])
                 global_step += 1
+                if i == 0:
+                    # sync, then time the REMAINING steps: step 0
+                    # carries trace+compile, which must not pollute the
+                    # throughput metrics logged to the run
+                    float(m["loss"])
+                    t_epoch = time.time()
+                    timed_steps = steps_per_epoch - 1
             epoch_loss = float(jnp.mean(jnp.stack(losses)))
+            # the scalar fetch above syncs, so the wall time is real
+            epoch_s = time.time() - t_epoch if t_epoch is not None else 0.0
             metrics = {"loss": epoch_loss, "lr": float(lr)}
+            if timed_steps > 0 and epoch_s > 0:
+                step_s = epoch_s / timed_steps
+                metrics["tokens_per_sec"] = batch_size * seq_len / step_s
+                if self._flops_per_step:
+                    from tpuflow.obs.mfu import mfu as _mfu
+
+                    # n_chips=1: cost analysis already reported the
+                    # per-device share of the sharded step
+                    metrics["mfu"] = _mfu(
+                        self._flops_per_step, step_s, n_chips=1,
+                        device=self.mesh.devices.flat[0],
+                    )
             if val_tokens is not None:
                 vl = self._eval_mean_loss(val_tokens, batch_size)
                 if vl is not None:
