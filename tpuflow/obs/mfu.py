@@ -43,10 +43,10 @@ def device_peak_flops(device: Optional[Any] = None) -> float:
     return 275e12  # default to v4 (the baseline target hardware)
 
 
-def flops_of_jitted(jitted_fn, *args, **kwargs) -> float:
-    """FLOPs of one invocation, from XLA cost analysis of the lowered
-    executable. Returns 0.0 if the backend reports no estimate."""
-    compiled = jitted_fn.lower(*args, **kwargs).compile()
+def flops_of_compiled(compiled) -> float:
+    """FLOPs from an already-compiled executable's XLA cost analysis
+    (0.0 if the backend reports none). NOTE: for a sharded program this
+    is the PER-DEVICE share."""
     try:
         ca = compiled.cost_analysis()
     except Exception:
@@ -54,6 +54,17 @@ def flops_of_jitted(jitted_fn, *args, **kwargs) -> float:
     if isinstance(ca, list):  # per-device list on some backends
         ca = ca[0] if ca else {}
     return float(ca.get("flops", 0.0)) if ca else 0.0
+
+
+def flops_of_jitted(jitted_fn, *args, **kwargs) -> float:
+    """FLOPs of one invocation, from XLA cost analysis of the lowered
+    executable. Returns 0.0 if the backend reports no estimate.
+
+    This pays a compile: jax's AOT path does not populate the jit
+    dispatch cache, so prefer compiling ONCE via ``lower().compile()``,
+    reading :func:`flops_of_compiled`, and executing the compiled
+    object — see LMTrainer.fit."""
+    return flops_of_compiled(jitted_fn.lower(*args, **kwargs).compile())
 
 
 def mfu(

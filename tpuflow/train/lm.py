@@ -48,7 +48,7 @@ from tpuflow.ckpt.checkpoint import (
 from tpuflow.core.config import TrainConfig
 from tpuflow.core.dist import is_primary
 from tpuflow.models.transformer import TransformerLM, next_token_loss
-from tpuflow.parallel.mesh import DATA_AXIS, build_nd_mesh
+from tpuflow.parallel.mesh import DATA_AXIS, MODEL_AXIS, build_nd_mesh
 from tpuflow.train.lr import LRController
 from tpuflow.train.optimizers import get_optimizer, set_learning_rate
 from tpuflow.train.state import TrainState
@@ -70,6 +70,7 @@ class LMTrainer:
         config: Optional[TrainConfig] = None,
         mesh=None,
         devices=None,
+        zero: Optional[str] = None,
     ):
         self.model = model
         self.cfg = config or TrainConfig()
@@ -78,6 +79,11 @@ class LMTrainer:
             axes = {DATA_AXIS: n}
             if model.seq_axis is not None:
                 axes = {DATA_AXIS: 1, model.seq_axis: n}
+            elif zero is not None:
+                # GSPMD state shardings reference the LM's 'model'
+                # annotations — a size-1 model axis keeps them valid
+                # for pure-ZeRO use on a data-only topology
+                axes = {DATA_AXIS: n, MODEL_AXIS: 1}
             mesh = build_nd_mesh(axes, devices=devices)
         self.mesh = mesh
         if model.seq_axis is not None and model.seq_axis not in mesh.axis_names:
@@ -89,6 +95,33 @@ class LMTrainer:
         self.sp = (
             mesh.shape[model.seq_axis] if model.seq_axis is not None else 1
         )
+        # GSPMD mode: a 'model' mesh axis (tensor parallelism over the
+        # LM's nn.with_partitioning annotations — Megatron-style qkv/
+        # mlp column+row sharding, vocab-sharded embed/head) and/or
+        # ZeRO-sharded optimizer state. Mutually exclusive with manual
+        # sequence parallelism: ring attention runs inside shard_map,
+        # where GSPMD's auto-partitioner has no say.
+        if zero not in (None, "zero1", "fsdp"):
+            raise ValueError(f"zero must be None|'zero1'|'fsdp', got {zero!r}")
+        self.zero = zero
+        self.tp = (
+            mesh.shape[MODEL_AXIS] if MODEL_AXIS in mesh.axis_names else 1
+        )
+        self._gspmd = self.tp > 1 or zero is not None
+        if self._gspmd and model.seq_axis is not None:
+            raise ValueError(
+                "tensor-parallel/ZeRO (GSPMD) and seq_axis (manual ring "
+                "attention) cannot combine in LMTrainer; shard long "
+                "contexts with seq_axis alone or shard weights with a "
+                "model axis alone"
+            )
+        if self._gspmd and MODEL_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"zero={zero!r} needs a mesh with a '{MODEL_AXIS}' axis "
+                "(size 1 is fine): the LM's partitioning annotations "
+                "name it — e.g. build_nd_mesh({'data': n, 'model': 1})"
+            )
+        self._state_shardings = None
         self.state: Optional[TrainState] = None
         self.tx = None
         self._train_step = None
@@ -101,6 +134,13 @@ class LMTrainer:
 
     def init_state(self, rng_seed: Optional[int] = None) -> TrainState:
         seed = self.cfg.seed if rng_seed is None else rng_seed
+        self.tx = get_optimizer(
+            self.cfg.optimizer,
+            self.cfg.learning_rate,
+            **self.cfg.optimizer_kwargs,
+        )
+        if self._gspmd:
+            return self._init_state_gspmd(seed)
         # init via the seq_axis=None twin: identical param tree (the
         # named axis matters only inside shard_map at apply time), and
         # it needs no mesh — same trick as examples/08.
@@ -113,11 +153,6 @@ class LMTrainer:
         params = nn.unbox(plain.init({"params": jax.random.key(seed)}, toks0))[
             "params"
         ]
-        self.tx = get_optimizer(
-            self.cfg.optimizer,
-            self.cfg.learning_rate,
-            **self.cfg.optimizer_kwargs,
-        )
         self.state = TrainState(
             step=jnp.asarray(0, jnp.int32),
             params=params,
@@ -126,6 +161,64 @@ class LMTrainer:
             rng=jax.random.key(seed),
             plateau_factor=jnp.asarray(1.0, jnp.float32),
         )
+        return self.state
+
+    def _init_state_gspmd(self, seed: int) -> TrainState:
+        """Sharded-state init: param specs from the LM's
+        ``nn.with_partitioning`` metadata; optimizer moments inherit
+        their parameter's spec, ZeRO additionally splits them (or the
+        params too, for fsdp) over the data axis — same machinery as
+        SpmdTrainer (tpuflow.train.spmd)."""
+        from jax.sharding import NamedSharding
+
+        from tpuflow.train.spmd import _specs_like, shard_over_data
+
+        toks0 = jnp.zeros((1, 8), jnp.int32)
+
+        def make_state(rng):
+            params = nn.unbox(self.model.init({"params": rng}, toks0))[
+                "params"
+            ]
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                batch_stats={},
+                opt_state=self.tx.init(params),
+                rng=jax.random.key(seed),
+                plateau_factor=jnp.ones((), jnp.float32),
+            )
+
+        boxed = jax.eval_shape(
+            lambda r: self.model.init({"params": r}, toks0),
+            jax.random.key(seed),
+        )
+        param_specs = nn.get_partition_spec(boxed)["params"]
+        abstract_params = nn.unbox(boxed)["params"]
+        abstract = jax.eval_shape(make_state, jax.random.key(seed))
+        opt_param_specs = param_specs
+        if self.zero in ("zero1", "fsdp"):
+            opt_param_specs = shard_over_data(
+                param_specs, abstract_params, self.world
+            )
+            if self.zero == "fsdp":
+                param_specs = opt_param_specs
+        specs = TrainState(
+            step=P(),
+            params=param_specs,
+            batch_stats={},
+            opt_state=_specs_like(
+                abstract.opt_state, opt_param_specs, abstract_params
+            ),
+            rng=P(),
+            plateau_factor=P(),
+        )
+        self._state_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self.state = jax.jit(
+            make_state, out_shardings=self._state_shardings
+        )(jax.random.key(seed))
         return self.state
 
     # ---- steps -----------------------------------------------------------
@@ -165,6 +258,49 @@ class LMTrainer:
     def _make_steps(self) -> None:
         model = self.model
         mesh = self.mesh
+
+        if self._gspmd:
+            # GSPMD: ONE jitted program over the (data, model) mesh —
+            # XLA's partitioner inserts the data-axis grad all-reduce,
+            # the TP all-gathers/reduce-scatters around the sharded
+            # matmuls, and ZeRO's scatter/gather around the update.
+            def train_step_g(state: TrainState, tokens, lr):
+                def loss_fn(p):
+                    return next_token_loss(
+                        model.apply({"params": p}, tokens, train=True),
+                        tokens,
+                    )
+
+                loss, grads = jax.value_and_grad(loss_fn)(state.params)
+                opt_state = set_learning_rate(state.opt_state, lr)
+                updates, opt_state = self.tx.update(
+                    grads, opt_state, state.params
+                )
+                params = optax.apply_updates(state.params, updates)
+                return (
+                    state.replace(
+                        step=state.step + 1, params=params,
+                        opt_state=opt_state,
+                    ),
+                    {"loss": loss},
+                )
+
+            def eval_step_g(state: TrainState, tokens):
+                return {
+                    "loss": next_token_loss(
+                        model.apply(
+                            {"params": state.params}, tokens, train=False
+                        ),
+                        tokens,
+                    )
+                }
+
+            self._train_step = jax.jit(
+                train_step_g, donate_argnums=0,
+                out_shardings=(self._state_shardings, None),
+            )
+            self._eval_step = jax.jit(eval_step_g)
+            return
 
         fwd = shard_map(
             lambda p, t, train: model.apply({"params": p}, t, train=train),
@@ -311,8 +447,10 @@ class LMTrainer:
         global_step = start * steps_per_epoch
         seq_len = int(train_tokens.shape[1])
         # shapes are fixed within one fit but not across fits — stale
-        # FLOPs from a previous fit's shapes would corrupt MFU
+        # FLOPs (or a stale AOT executable) from a previous fit's
+        # shapes would corrupt MFU / fail on call
         self._flops_per_step = None
+        self._step_exec = None
         for epoch in range(start, epochs):
             order = np.random.default_rng(cfg.seed + epoch).permutation(n)
             losses = []
@@ -326,23 +464,24 @@ class LMTrainer:
                 rows = rows[proc * b_local : (proc + 1) * b_local]
                 toks = self._put(train_tokens[rows])
                 lr = self.lr_controller.lr_for_step(global_step)
-                if self._flops_per_step is None:
-                    # one lower+compile for XLA cost analysis (shares
-                    # the jit compile cache with the step call below) —
-                    # feeds the throughput/MFU metrics (N11). NOTE the
-                    # result is PER-DEVICE flops for a sharded program.
-                    try:
-                        from tpuflow.obs.mfu import flops_of_jitted
+                lr_arr = jnp.asarray(lr, jnp.float32)
+                if self._step_exec is None:
+                    # ONE compile per fit: the AOT executable both runs
+                    # every step (jax's AOT path does not share the jit
+                    # dispatch cache — compiling separately for cost
+                    # analysis would double the compile) and yields the
+                    # FLOPs for the throughput/MFU metrics (N11). NOTE
+                    # cost analysis reports PER-DEVICE flops when the
+                    # program is sharded.
+                    from tpuflow.obs.mfu import flops_of_compiled
 
-                        self._flops_per_step = flops_of_jitted(
-                            self._train_step, self.state, toks,
-                            jnp.asarray(lr, jnp.float32),
-                        )
-                    except Exception:
-                        self._flops_per_step = 0.0
-                self.state, m = self._train_step(
-                    self.state, toks, jnp.asarray(lr, jnp.float32)
-                )
+                    self._step_exec = self._train_step.lower(
+                        self.state, toks, lr_arr
+                    ).compile()
+                    self._flops_per_step = flops_of_compiled(
+                        self._step_exec
+                    )
+                self.state, m = self._step_exec(self.state, toks, lr_arr)
                 losses.append(m["loss"])
                 global_step += 1
                 if i == 0:
