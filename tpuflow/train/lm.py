@@ -79,10 +79,12 @@ class LMTrainer:
             axes = {DATA_AXIS: n}
             if model.seq_axis is not None:
                 axes = {DATA_AXIS: 1, model.seq_axis: n}
-            elif zero is not None:
+            elif zero is not None or model.n_experts > 0:
                 # GSPMD state shardings reference the LM's 'model'
                 # annotations — a size-1 model axis keeps them valid
-                # for pure-ZeRO use on a data-only topology
+                # for pure-ZeRO / dense-MoE use on a data-only topology
+                # (expert-SHARDED MoE needs an explicit mesh carrying
+                # the expert axis)
                 axes = {DATA_AXIS: n, MODEL_AXIS: 1}
             mesh = build_nd_mesh(axes, devices=devices)
         self.mesh = mesh
@@ -107,7 +109,27 @@ class LMTrainer:
         self.tp = (
             mesh.shape[MODEL_AXIS] if MODEL_AXIS in mesh.axis_names else 1
         )
-        self._gspmd = self.tp > 1 or zero is not None
+        # MoE LMs also route through GSPMD: expert-sharded params are
+        # plain partitioning annotations (dryrun EP case), and the
+        # load-balance aux loss needs the mutable 'losses' collection
+        # that the manual shard_map fwd does not thread.
+        self._gspmd = (
+            self.tp > 1 or zero is not None or model.n_experts > 0
+        )
+        if model.n_experts > 0 and model.seq_axis is not None:
+            raise ValueError(
+                "MoE (n_experts>0) and seq_axis cannot combine in "
+                "LMTrainer: experts ride GSPMD, ring attention rides "
+                "shard_map"
+            )
+        if (
+            model.ep_axis is not None
+            and model.ep_axis not in mesh.axis_names
+        ):
+            raise ValueError(
+                f"ep_axis={model.ep_axis!r} not in mesh axes "
+                f"{mesh.axis_names}"
+            )
         if self._gspmd and model.seq_axis is not None:
             raise ValueError(
                 "tensor-parallel/ZeRO (GSPMD) and seq_axis (manual ring "
@@ -116,10 +138,15 @@ class LMTrainer:
                 "model axis alone"
             )
         if self._gspmd and MODEL_AXIS not in mesh.axis_names:
+            why = (
+                f"zero={zero!r}" if zero is not None
+                else f"MoE (n_experts={model.n_experts})"
+            )
             raise ValueError(
-                f"zero={zero!r} needs a mesh with a '{MODEL_AXIS}' axis "
-                "(size 1 is fine): the LM's partitioning annotations "
-                "name it — e.g. build_nd_mesh({'data': n, 'model': 1})"
+                f"{why} routes LMTrainer through GSPMD, which needs a "
+                f"mesh with a '{MODEL_AXIS}' axis (size 1 is fine): the "
+                "LM's partitioning annotations name it — e.g. "
+                "build_nd_mesh({'data': n, 'model': 1})"
             )
         self._state_shardings = None
         self.state: Optional[TrainState] = None
@@ -169,9 +196,7 @@ class LMTrainer:
         their parameter's spec, ZeRO additionally splits them (or the
         params too, for fsdp) over the data axis — same machinery as
         SpmdTrainer (tpuflow.train.spmd)."""
-        from jax.sharding import NamedSharding
-
-        from tpuflow.train.spmd import _specs_like, shard_over_data
+        from tpuflow.train.spmd import derive_state_shardings
 
         toks0 = jnp.zeros((1, 8), jnp.int32)
 
@@ -192,29 +217,9 @@ class LMTrainer:
             lambda r: self.model.init({"params": r}, toks0),
             jax.random.key(seed),
         )
-        param_specs = nn.get_partition_spec(boxed)["params"]
-        abstract_params = nn.unbox(boxed)["params"]
         abstract = jax.eval_shape(make_state, jax.random.key(seed))
-        opt_param_specs = param_specs
-        if self.zero in ("zero1", "fsdp"):
-            opt_param_specs = shard_over_data(
-                param_specs, abstract_params, self.world
-            )
-            if self.zero == "fsdp":
-                param_specs = opt_param_specs
-        specs = TrainState(
-            step=P(),
-            params=param_specs,
-            batch_stats={},
-            opt_state=_specs_like(
-                abstract.opt_state, opt_param_specs, abstract_params
-            ),
-            rng=P(),
-            plateau_factor=P(),
-        )
-        self._state_shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P),
+        self._state_shardings = derive_state_shardings(
+            self.mesh, boxed, abstract, self.world, self.zero
         )
         self.state = jax.jit(
             make_state, out_shardings=self._state_shardings
@@ -258,71 +263,62 @@ class LMTrainer:
     def _make_steps(self) -> None:
         model = self.model
         mesh = self.mesh
+        out_shardings = None
 
         if self._gspmd:
-            # GSPMD: ONE jitted program over the (data, model) mesh —
-            # XLA's partitioner inserts the data-axis grad all-reduce,
-            # the TP all-gathers/reduce-scatters around the sharded
-            # matmuls, and ZeRO's scatter/gather around the update.
-            def train_step_g(state: TrainState, tokens, lr):
-                def loss_fn(p):
-                    return next_token_loss(
-                        model.apply({"params": p}, tokens, train=True),
-                        tokens,
+            # GSPMD: ONE jitted program over the (data, model[, expert])
+            # mesh — XLA's partitioner inserts the data-axis grad
+            # all-reduce, the TP all-gathers/reduce-scatters around the
+            # sharded matmuls, the expert all-to-alls, and ZeRO's
+            # scatter/gather around the update.
+            def loss_of(p, tokens, train):
+                if model.n_experts > 0 and train:
+                    # MoE training: LM loss + the routers' load-balance
+                    # aux losses (sown into the mutable 'losses'
+                    # collection by tpuflow.models.moe)
+                    logits, coll = model.apply(
+                        {"params": p}, tokens, train=True,
+                        mutable=["losses"],
                     )
-
-                loss, grads = jax.value_and_grad(loss_fn)(state.params)
-                opt_state = set_learning_rate(state.opt_state, lr)
-                updates, opt_state = self.tx.update(
-                    grads, opt_state, state.params
-                )
-                params = optax.apply_updates(state.params, updates)
-                return (
-                    state.replace(
-                        step=state.step + 1, params=params,
-                        opt_state=opt_state,
-                    ),
-                    {"loss": loss},
-                )
-
-            def eval_step_g(state: TrainState, tokens):
-                return {
-                    "loss": next_token_loss(
-                        model.apply(
-                            {"params": state.params}, tokens, train=False
-                        ),
-                        tokens,
+                    aux = sum(
+                        jnp.sum(a)
+                        for a in jax.tree.leaves(coll.get("losses", {}))
                     )
-                }
+                    return next_token_loss(logits, tokens) + aux
+                return next_token_loss(
+                    model.apply({"params": p}, tokens, train=train),
+                    tokens,
+                )
 
-            self._train_step = jax.jit(
-                train_step_g, donate_argnums=0,
-                out_shardings=(self._state_shardings, None),
+            out_shardings = (self._state_shardings, None)
+        else:
+            fwd = shard_map(
+                lambda p, t, train: model.apply(
+                    {"params": p}, t, train=train
+                ),
+                mesh=mesh,
+                in_specs=(P(), self._token_spec(), P()),
+                out_specs=(
+                    P(DATA_AXIS, model.seq_axis, None)
+                    if model.seq_axis is not None
+                    else P(DATA_AXIS, None, None)
+                ),
             )
-            self._eval_step = jax.jit(eval_step_g)
-            return
 
-        fwd = shard_map(
-            lambda p, t, train: model.apply({"params": p}, t, train=train),
-            mesh=mesh,
-            in_specs=(P(), self._token_spec(), P()),
-            out_specs=(
-                P(DATA_AXIS, model.seq_axis, None)
-                if model.seq_axis is not None
-                else P(DATA_AXIS, None, None)
-            ),
-        )
-
-        def train_step(state: TrainState, tokens, lr):
-            def loss_fn(p):
+            def loss_of(p, tokens, train):
                 # loss over the GLOBAL gathered logits: the next-token
                 # shift crosses sequence-shard boundaries, so it must
                 # happen outside the shard_map (next_token_loss doc)
-                return next_token_loss(fwd(p, tokens, True), tokens)
+                return next_token_loss(fwd(p, tokens, train), tokens)
 
-            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        def train_step(state: TrainState, tokens, lr):
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_of(p, tokens, True)
+            )(state.params)
             opt_state = set_learning_rate(state.opt_state, lr)
-            updates, opt_state = self.tx.update(grads, opt_state, state.params)
+            updates, opt_state = self.tx.update(
+                grads, opt_state, state.params
+            )
             params = optax.apply_updates(state.params, updates)
             new_state = state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state
@@ -330,10 +326,14 @@ class LMTrainer:
             return new_state, {"loss": loss}
 
         def eval_step(state: TrainState, tokens):
-            loss = next_token_loss(fwd(state.params, tokens, False), tokens)
-            return {"loss": loss}
+            return {"loss": loss_of(state.params, tokens, False)}
 
-        self._train_step = jax.jit(train_step, donate_argnums=0)
+        if out_shardings is not None:
+            self._train_step = jax.jit(
+                train_step, donate_argnums=0, out_shardings=out_shardings
+            )
+        else:
+            self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
 
     # ---- checkpoint / resume --------------------------------------------

@@ -112,6 +112,39 @@ def _specs_like(tree, param_specs, abstract_params):
     return tree_map_with_path(assign, tree)
 
 
+def derive_state_shardings(mesh, boxed, abstract_state, world: int,
+                           zero: Optional[str]):
+    """NamedSharding tree for a TrainState from a module's boxed
+    (partitioning-annotated) init shapes: params from
+    ``nn.get_partition_spec``, optimizer moments inheriting their
+    parameter's spec (``_specs_like``), ZeRO splitting moments (and,
+    for fsdp, params) over the data axis. Shared by SpmdTrainer and
+    LMTrainer's GSPMD mode — one derivation, no drift."""
+    param_specs = nn.get_partition_spec(boxed)["params"]
+    abstract_params = nn.unbox(boxed)["params"]
+    opt_param_specs = param_specs
+    if zero in ("zero1", "fsdp"):
+        opt_param_specs = shard_over_data(
+            param_specs, abstract_params, world
+        )
+        if zero == "fsdp":
+            param_specs = opt_param_specs
+    specs = TrainState(
+        step=P(),
+        params=param_specs,
+        batch_stats=jax.tree.map(lambda _: P(), abstract_state.batch_stats),
+        opt_state=_specs_like(
+            abstract_state.opt_state, opt_param_specs, abstract_params
+        ),
+        rng=P(),
+        plateau_factor=P(),
+    )
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 class SpmdTrainer(Trainer):
     """Trainer whose step is jit-auto-sharded over a (data, model) mesh."""
 
@@ -154,7 +187,6 @@ class SpmdTrainer(Trainer):
             lambda r: self.model.init({"params": r}, dummy, train=False),
             jax.random.key(cfg.seed),
         )
-        param_specs = nn.get_partition_spec(boxed)["params"]
 
         mask = (
             backbone_param_mask(nn.unbox(boxed)["params"])
@@ -168,28 +200,9 @@ class SpmdTrainer(Trainer):
         )
 
         abstract = jax.eval_shape(make_state, jax.random.key(cfg.seed))
-        abstract_params = nn.unbox(boxed)["params"]
-        opt_param_specs = param_specs
-        if self.zero in ("zero1", "fsdp"):
-            data_size = self.mesh.shape[DATA_AXIS]
-            opt_param_specs = shard_over_data(
-                param_specs, abstract_params, data_size
-            )
-            if self.zero == "fsdp":
-                param_specs = opt_param_specs
-        specs = TrainState(
-            step=P(),
-            params=param_specs,
-            batch_stats=jax.tree.map(lambda _: P(), abstract.batch_stats),
-            opt_state=_specs_like(
-                abstract.opt_state, opt_param_specs, abstract_params
-            ),
-            rng=P(),
-            plateau_factor=P(),
-        )
-        self._state_shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P),
+        self._state_shardings = derive_state_shardings(
+            self.mesh, boxed, abstract, self.mesh.shape[DATA_AXIS],
+            self.zero,
         )
         self.state = jax.jit(
             make_state, out_shardings=self._state_shardings
