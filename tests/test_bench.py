@@ -1,0 +1,73 @@
+"""The bench artifact contract (VERDICT round-1 #1: the driver's perf
+artifact must NEVER be lost): exactly one JSON line on stdout with the
+fixed schema, exit code 0 — on success AND on watchdog/failure paths.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(*args, timeout=600):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # never dial the TPU relay in tests
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=_ROOT,
+    )
+    return r
+
+
+def _parse_single_json_line(stdout: str) -> dict:
+    lines = [l for l in stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got {lines}"
+    return json.loads(lines[0])
+
+
+@pytest.mark.slow
+def test_smoke_emits_schema():
+    r = _run("--smoke", "--steps", "2", "--warmup", "1", "--no-attn-diag")
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "train_images_per_sec_per_chip"
+    assert rec["unit"] == "images/s/chip"
+    assert rec["value"] > 0
+    assert "error" not in rec
+    d = rec["diagnostics"]
+    for key in ("step_ms", "timing_method", "mfu", "flops_per_step",
+                "rtt_ms", "loss"):
+        assert key in d, key
+
+
+@pytest.mark.slow
+def test_smoke_lm_metric_name():
+    r = _run("--smoke", "--model", "lm", "--steps", "2", "--warmup", "1",
+             "--no-attn-diag")
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "train_tokens_per_sec_per_chip"
+    assert rec["unit"] == "tokens/s/chip"
+    assert rec["value"] > 0
+
+
+@pytest.mark.slow
+def test_watchdog_still_emits_json():
+    # a 1-second deadline fires long before the model compiles; the
+    # bench must STILL print one JSON line and exit 0
+    r = _run("--smoke", "--steps", "2", "--deadline", "1",
+             "--no-attn-diag", timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert "error" in rec and "watchdog" in rec["error"]
+
+
+def test_end2end_rejects_non_cnn():
+    r = _run("--smoke", "--end2end", "--model", "vit", timeout=60)
+    assert r.returncode != 0
+    assert "--end2end" in r.stderr
