@@ -36,6 +36,7 @@ def test_examples_import():
         "07_package_and_batch_inference",
         "08_long_context_lm",
         "09_lm_pipeline",
+        "10_pipeline_lm",
     ]:
         assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
 
@@ -97,3 +98,17 @@ def test_lm_pipeline_example(tmp_path):
 
     m = re.search(r"accuracy: (\d+)/8", r.stdout)
     assert m and int(m.group(1)) >= 6, r.stdout[-1000:]
+
+
+@pytest.mark.slow
+def test_pipeline_lm_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "10_pipeline_lm.py")],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "forward parity with the unpipelined model: OK" in r.stdout
+    assert "gpipe LM training OK" in r.stdout
