@@ -71,3 +71,37 @@ def test_end2end_rejects_non_cnn():
     r = _run("--smoke", "--end2end", "--model", "vit", timeout=60)
     assert r.returncode != 0
     assert "--end2end" in r.stderr
+
+
+def test_last_known_good_selection(tmp_path, monkeypatch):
+    """Newest valid artifact wins; retracted files and pure failures are
+    skipped; watchdog-provisional records (error + real value) count."""
+    import time as _time
+
+    import bench
+
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+
+    def write(name, rec, age):
+        p = tmp_path / name
+        p.write_text(json.dumps(rec))
+        t = _time.time() - age
+        os.utime(p, (t, t))
+
+    write("BENCH_LOCAL_r01_old.json", {"value": 111.0}, age=300)
+    write("BENCH_LOCAL_r02_retracted.json", {"value": 999.0}, age=10)
+    write("BENCH_LOCAL_r02_fail.json",
+          {"value": 0.0, "error": "watchdog: ..."}, age=5)
+    # newest valid: a provisional record (error set but value real)
+    write("BENCH_LOCAL_r02_prov.json",
+          {"value": 222.0, "error": "watchdog: provisional"}, age=1)
+
+    rec = bench._last_known_good()
+    assert rec["value"] == 222.0
+    assert rec["source_file"] == "BENCH_LOCAL_r02_prov.json"
+
+    # with the provisional gone, fall through the pure failure and the
+    # retracted file to the old valid record
+    os.remove(tmp_path / "BENCH_LOCAL_r02_prov.json")
+    rec = bench._last_known_good()
+    assert rec["value"] == 111.0
