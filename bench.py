@@ -346,6 +346,58 @@ def _measure_rtt() -> float:
     return best * 1e3
 
 
+def _attention_sweep(diag: dict, rtt_ms: float = 0.0) -> None:
+    """Flash-kernel block-size sweep (TPU only): times the compiled
+    fwd kernel at s=2048/d=128 over (block_q, block_k) combinations and
+    records the table + the best pair — the tuning input for
+    flash_attention's defaults on this chip generation. Opt-in via
+    --attn-sweep; never raises."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from tpuflow.core.hw import is_tpu_backend
+        from tpuflow.ops.attention import flash_attention
+
+        if not is_tpu_backend():
+            diag["attn_sweep"] = "skipped: not a TPU backend"
+            return
+        b, h, s, d = 4, 8, 2048, 128
+        ks = jax.random.split(jax.random.key(1), 3)
+        q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+        steps = 10
+        results = {}
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512):
+
+                @jax.jit
+                def _many(c, bq=bq, bk=bk):
+                    def body(c, _):
+                        o = flash_attention(
+                            c, k, v, causal=True, block_q=bq, block_k=bk
+                        )
+                        return o, ()
+                    return jax.lax.scan(body, c, None, length=steps)[0]
+
+                float(_many(q)[0, 0, 0, 0])  # compile
+                t0 = time.time()
+                float(_many(q)[0, 0, 0, 0])
+                total = time.time() - t0
+                total -= min(rtt_ms * 1e-3, total / 2)
+                results[f"q{bq}k{bk}"] = round(total / steps * 1e3, 3)
+        best = min(results, key=results.get)
+        diag["attn_sweep"] = {
+            "shape": f"b{b}h{h}s{s}d{d}", "fwd_ms": results, "best": best
+        }
+        print(f"# attn sweep: best={best} {results}", file=sys.stderr,
+              flush=True)
+    except Exception as e:
+        diag["attn_sweep"] = f"failed: {e}"
+        print(f"# attn sweep failed: {e}", file=sys.stderr, flush=True)
+
+
 def _decode_diag(hw: int) -> float:
     try:
         import io
@@ -380,6 +432,10 @@ def main() -> int:
                    help="watchdog: emit an error JSON line and exit if "
                         "the bench has not finished by then")
     p.add_argument("--no-attn-diag", action="store_true")
+    p.add_argument("--attn-sweep", action="store_true",
+                   help="TPU only: sweep flash-attention block sizes "
+                        "at s=2048 and record the per-config timing "
+                        "table (kernel-tuning input)")
     p.add_argument("--end2end", action="store_true",
                    help="measure the FULL training pipeline (table -> "
                         "C++ JPEG decode -> infeed -> sharded step) "
@@ -572,6 +628,8 @@ def _bench(args) -> int:
         diag["trace_dir"] = args.trace  # captured AFTER the timed loop
     if not args.no_attn_diag:
         _attention_diag(diag, small=args.smoke, rtt_ms=rtt_ms)
+    if args.attn_sweep:
+        _attention_sweep(diag, rtt_ms=rtt_ms)
 
     print(
         f"# devices={n_chips} ({devices[0].device_kind}) hw={hw} width={width} "
