@@ -135,6 +135,35 @@ def _init_devices(retries: int, backoff_s: float):
     return None, last
 
 
+def _rtt_correct(total_s: float, rtt_ms: float) -> float:
+    """Subtract ONE relay round-trip from a timed window (capped at half
+    the window so a mis-measured RTT can never eat the signal) — the
+    single place the relay-correction convention lives."""
+    return total_s - min(rtt_ms * 1e-3, total_s / 2)
+
+
+def _timed_scan(jax, fn, carry, steps: int, rtt_ms: float) -> float:
+    """ms per application of ``fn`` (carry -> carry), timed as `steps`
+    chained calls inside ONE jitted lax.scan with a scalar-fetch sync
+    (the relay-safe methodology of the module docstring)."""
+
+    @jax.jit
+    def _many(c):
+        def body(c, _):
+            return fn(c), ()
+
+        return jax.lax.scan(body, c, None, length=steps)[0]
+
+    def _sync(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        float(leaf.reshape(-1)[0])
+
+    _sync(_many(carry))  # compile
+    t0 = time.time()
+    _sync(_many(carry))
+    return _rtt_correct(time.time() - t0, rtt_ms) / steps * 1e3
+
+
 def _attention_diag(diag: dict, small: bool = False,
                     rtt_ms: float = 0.0) -> None:
     """Compiled flash-attention parity + timing vs the pure-jnp oracle.
@@ -183,41 +212,24 @@ def _attention_diag(diag: dict, small: bool = False,
             jnp.max(jnp.abs(g_f.astype(jnp.float32) - g_r.astype(jnp.float32)))
         )
 
-        # timing: chain K calls inside one jitted scan (carry = q; the
-        # output has q's shape) and sync with a scalar fetch — see the
-        # module docstring's relay-safe timing note.
+        # timing: chained calls inside one jitted scan (carry = q;
+        # the output has q's shape), scalar-fetch sync — _timed_scan
         steps = 3 if small else 20
-
-        @jax.jit
-        def _fwd_many(c):
-            def body(c, _):
-                o = flash_attention(c, k, v, causal=True, interpret=interpret)
-                return o, ()
-            return jax.lax.scan(body, c, None, length=steps)[0]
-
-        @jax.jit
-        def _bwd_many(c):
-            def body(c, _):
-                g = jax.grad(
-                    lambda q: flash_attention(
-                        q, k, v, causal=True, interpret=interpret
-                    ).astype(jnp.float32).sum()
-                )(c)
-                return g.astype(c.dtype), ()
-            return jax.lax.scan(body, c, None, length=steps)[0]
-
-        def _timed(fn):
-            # same RTT correction as the headline timing: one
-            # dispatch+fetch rides the relay once per call
-            float(fn(q)[0, 0, 0, 0])  # compile
-            t0 = time.time()
-            float(fn(q)[0, 0, 0, 0])
-            total = time.time() - t0
-            total -= min(rtt_ms * 1e-3, total / 2)
-            return total / steps * 1e3
-
-        fwd_ms = _timed(_fwd_many)
-        fwdbwd_ms = _timed(_bwd_many)
+        fwd_ms = _timed_scan(
+            jax,
+            lambda c: flash_attention(c, k, v, causal=True,
+                                      interpret=interpret),
+            q, steps, rtt_ms,
+        )
+        fwdbwd_ms = _timed_scan(
+            jax,
+            lambda c: jax.grad(
+                lambda q: flash_attention(
+                    q, k, v, causal=True, interpret=interpret
+                ).astype(jnp.float32).sum()
+            )(c).astype(c.dtype),
+            q, steps, rtt_ms,
+        )
         # attention FLOPs: causal ⇒ ~half of 4*b*h*s^2*d (fwd)
         att_fl = 2 * b * h * s * s * d  # qk^T + av, halved for causal
         diag["flash_attention"] = {
@@ -285,11 +297,9 @@ def _run_timing(args, jax, step1, state, rtt_ms, make_record,
             t0 = time.time()
             state, losses = _many(state)
             last_loss = float(losses[-1])
-            total = time.time() - t0
-            # one dispatch+fetch still rides the relay once per call:
-            # subtract the measured RTT (capped at half the total so a
-            # mis-measured RTT can never eat the signal)
-            total -= min(rtt_ms * 1e-3, total / 2)
+            # one dispatch+fetch still rides the relay once per
+            # call — subtract it (_rtt_correct)
+            total = _rtt_correct(time.time() - t0, rtt_ms)
             best = min(best, total / K)
         dt = best
         method = f"scan{K}"
@@ -371,22 +381,14 @@ def _attention_sweep(diag: dict, rtt_ms: float = 0.0) -> None:
         results = {}
         for bq in (128, 256, 512):
             for bk in (128, 256, 512):
-
-                @jax.jit
-                def _many(c, bq=bq, bk=bk):
-                    def body(c, _):
-                        o = flash_attention(
-                            c, k, v, causal=True, block_q=bq, block_k=bk
-                        )
-                        return o, ()
-                    return jax.lax.scan(body, c, None, length=steps)[0]
-
-                float(_many(q)[0, 0, 0, 0])  # compile
-                t0 = time.time()
-                float(_many(q)[0, 0, 0, 0])
-                total = time.time() - t0
-                total -= min(rtt_ms * 1e-3, total / 2)
-                results[f"q{bq}k{bk}"] = round(total / steps * 1e3, 3)
+                ms = _timed_scan(
+                    jax,
+                    lambda c, bq=bq, bk=bk: flash_attention(
+                        c, k, v, causal=True, block_q=bq, block_k=bk
+                    ),
+                    q, steps, rtt_ms,
+                )
+                results[f"q{bq}k{bk}"] = round(ms, 3)
         best = min(results, key=results.get)
         diag["attn_sweep"] = {
             "shape": f"b{b}h{h}s{s}d{d}", "fwd_ms": results, "best": best
@@ -782,6 +784,8 @@ def _bench_e2e(args, devices) -> int:
                     callbacks=[_Times()])
         diag = _diag()
         diag["decode_img_per_s"] = round(_decode_diag(hw), 0)
+        if args.attn_sweep:
+            _attention_sweep(diag, rtt_ms=rtt_ms)
         print(f"# e2e: epoch_s={diag['epoch_s']} "
               f"epoch1={diag['epoch1_img_per_s_chip']:.0f} img/s/chip "
               f"cached={diag['cached_img_per_s_chip']:.0f} img/s/chip",
@@ -898,6 +902,8 @@ def _bench_lm(args, devices) -> int:
                 state, loss = step1(state)
             float(loss)
         diag["trace_dir"] = args.trace
+    if args.attn_sweep:
+        _attention_sweep(diag, rtt_ms=rtt_ms)
     tok_s_chip = global_batch * seq / dt / n_chips
     print(
         f"# lm seq={seq} batch/chip={batch} step={dt*1e3:.2f}ms "
