@@ -36,7 +36,8 @@ emits (a) instead of losing the artifact. The relay RTT itself is
 measured and reported in diagnostics.
 
 Usage: python bench.py [--smoke] [--batch N] [--steps N]
-       [--init-retries N] [--deadline SECONDS]
+       [--model cnn|vit|resnet50|lm] [--end2end] [--attn-sweep]
+       [--trace DIR] [--init-retries N] [--deadline SECONDS]
 """
 
 import argparse
