@@ -68,6 +68,10 @@ class TrainConfig:
     warmup_epochs: int = 5  # ≙ LearningRateWarmupCallback, P1/03:315-318
     epochs: int = 3
     reduce_on_plateau_patience: int = 10  # ≙ ReduceLROnPlateau, P1/03:319-322
+    # on-device random horizontal flip of training batches (the
+    # reference trains with NO augmentation — beyond-reference knob,
+    # default off so parity runs stay bit-identical)
+    augment_flip: bool = False
     reduce_on_plateau_factor: float = 0.1
     early_stopping_patience: Optional[int] = None  # ≙ EarlyStopping, P2/03:397-401
     checkpoint_dir: Optional[str] = None

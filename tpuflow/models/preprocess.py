@@ -10,6 +10,7 @@ HBM/PCIe traffic) and XLA fuses the scale into the first conv.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -17,6 +18,18 @@ import numpy as np
 def preprocess_input(x: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
     """uint8 [0,255] → dtype [-1,1] (≙ keras mobilenet_v2.preprocess_input)."""
     return (x.astype(dtype) / jnp.asarray(127.5, dtype)) - jnp.asarray(1.0, dtype)
+
+
+def random_flip(x: jnp.ndarray, rng) -> jnp.ndarray:
+    """Per-sample random horizontal flip, on device (BEYOND-REFERENCE:
+    the workshop trains with no augmentation at all, P1/02:119-126).
+
+    ``x``: (B, H, W, C); ``rng``: a jax PRNG key (fold the step counter
+    in upstream). A (B,1,1,1) bernoulli mask selects flipped rows —
+    pure vectorized ops, so XLA fuses it into the input pipeline with
+    no host round-trip and no data-dependent control flow."""
+    mask = jax.random.bernoulli(rng, 0.5, (x.shape[0], 1, 1, 1))
+    return jnp.where(mask, x[:, :, ::-1, :], x)
 
 
 def preprocess(content: bytes, img_height: int = 224, img_width: int = 224) -> np.ndarray:

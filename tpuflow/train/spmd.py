@@ -32,7 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpuflow.core.config import TrainConfig
 from tpuflow.models.classifier import backbone_param_mask, stop_gradient_frozen
-from tpuflow.models.preprocess import preprocess_input
+from tpuflow.models.preprocess import preprocess_input, random_flip
 from tpuflow.parallel.mesh import DATA_AXIS
 from tpuflow.train.optimizers import get_optimizer, set_learning_rate
 from tpuflow.train.state import TrainState
@@ -217,6 +217,8 @@ class SpmdTrainer(Trainer):
         def train_step(state: TrainState, images, labels, lr):
             x = preprocess_input(images, dtype=getattr(model, "dtype", jnp.bfloat16))
             step_rng = jax.random.fold_in(state.rng, state.step)
+            if self.cfg.augment_flip:
+                x = random_flip(x, jax.random.fold_in(step_rng, 1))
 
             def loss_fn(params):
                 # frozen backbone ⇒ head-only backward (see

@@ -37,7 +37,7 @@ from jax import shard_map
 
 from tpuflow.core.config import TrainConfig
 from tpuflow.models.classifier import backbone_param_mask, stop_gradient_frozen
-from tpuflow.models.preprocess import preprocess_input
+from tpuflow.models.preprocess import preprocess_input, random_flip
 from tpuflow.parallel.mesh import DATA_AXIS, build_mesh, world_size
 from tpuflow.train.callbacks import Callback, History
 from tpuflow.train.lr import LRController
@@ -136,6 +136,8 @@ class Trainer:
             x = preprocess_input(images, dtype=getattr(model, "dtype", jnp.bfloat16))
             step_rng = jax.random.fold_in(state.rng, state.step)
             step_rng = jax.random.fold_in(step_rng, jax.lax.axis_index(DATA_AXIS))
+            if self.cfg.augment_flip:
+                x = random_flip(x, jax.random.fold_in(step_rng, 1))
 
             def loss_fn(params):
                 # frozen backbone ⇒ head-only backward (XLA DCEs the
