@@ -322,3 +322,25 @@ def test_lm_trainer_moe_dense_and_expert_sharded():
     assert np.isfinite(m2["loss"]) and np.isfinite(m2["val_loss"])
     p_flat = jax.tree_util.tree_leaves_with_path(tr2._state_shardings.params)
     assert any("expert" in str(s.spec) for _, s in p_flat)
+
+
+def test_lm_label_smoothing_applies_to_training_only():
+    import jax.numpy as jnp
+
+    from tpuflow.models.transformer import next_token_loss
+
+    toks = jnp.asarray(_corpus(4, 16, seed=8))
+    logits = jax.random.normal(jax.random.key(0), (4, 16, VOCAB))
+    plain = float(next_token_loss(logits, toks))
+    sm = float(next_token_loss(logits, toks, label_smoothing=0.1))
+    assert sm != plain
+    # smoothing toward uniform pulls the loss toward log(V) territory
+    assert abs(sm - np.log(VOCAB)) < abs(plain - np.log(VOCAB)) + 1.0
+
+    cfg = TrainConfig(optimizer="adamw", learning_rate=3e-3,
+                      warmup_epochs=0, label_smoothing=0.1, seed=0)
+    mesh = build_nd_mesh({"data": 2}, devices=jax.devices()[:2])
+    tr = LMTrainer(_tiny_lm(), cfg, mesh=mesh)
+    m = tr.fit(_corpus(16, 16), batch_size=8, epochs=1,
+               val_tokens=_corpus(8, 16, seed=9))
+    assert np.isfinite(m["loss"]) and np.isfinite(m["val_loss"])

@@ -377,3 +377,49 @@ def test_augment_flip_helper_and_training():
     off_a, off_b = one_step(False), one_step(False)
     assert off_a == off_b  # deterministic default path
     assert off_a != one_step(True)  # the flag really changes the batch
+
+
+def test_grad_clip_and_label_smoothing():
+    """grad_clip_norm bounds the global update norm through chain +
+    mask + inject; the LR stays steerable; smoothing=0 is exactly the
+    plain integer-label CE."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpuflow.train.optimizers import (get_learning_rate, get_optimizer,
+                                          set_learning_rate)
+
+    params = {"w": jnp.zeros((4,)), "b": jnp.zeros((2,))}
+    tx = get_optimizer("sgd", 1.0, grad_clip_norm=1.0)
+    st = tx.init(params)
+    huge = {"w": jnp.full((4,), 1e6), "b": jnp.full((2,), 1e6)}
+    upd, st = tx.update(huge, st, params)
+    gn = float(optax.global_norm(upd))
+    assert gn <= 1.0 + 1e-5, gn
+    # LR steering sees through the chain state
+    st = set_learning_rate(st, 0.25)
+    assert get_learning_rate(st) == 0.25
+    small = {"w": jnp.full((4,), 0.1), "b": jnp.zeros((2,))}  # norm 0.2 < clip
+    upd, st = tx.update(small, st, params)
+    np.testing.assert_allclose(np.asarray(upd["w"]), -0.25 * 0.1, rtol=1e-6)
+
+    # masked + clipped together still steers
+    mask = {"w": True, "b": False}
+    tx2 = get_optimizer("sgd", 1.0, param_mask=mask, grad_clip_norm=1.0)
+    st2 = tx2.init(params)
+    st2 = set_learning_rate(st2, 0.5)
+    assert get_learning_rate(st2) == 0.5
+    upd2, _ = tx2.update(huge, st2, params)
+    assert float(jnp.max(jnp.abs(upd2["b"]))) == 0.0  # frozen
+
+    from tpuflow.train.trainer import _smoothed_ce
+
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(8, 5)),
+                         jnp.float32)
+    labels = jnp.arange(8) % 5
+    base = optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+    np.testing.assert_allclose(float(_smoothed_ce(logits, labels, 0.0)),
+                               float(base), rtol=1e-6)
+    assert float(_smoothed_ce(logits, labels, 0.1)) != float(base)

@@ -72,6 +72,11 @@ class TrainConfig:
     # reference trains with NO augmentation — beyond-reference knob,
     # default off so parity runs stay bit-identical)
     augment_flip: bool = False
+    # clip gradients to this global norm before the update (None = off)
+    grad_clip_norm: Optional[float] = None
+    # label smoothing on the TRAINING loss (eval stays plain CE so
+    # val_loss remains comparable across smoothing settings)
+    label_smoothing: float = 0.0
     reduce_on_plateau_factor: float = 0.1
     early_stopping_patience: Optional[int] = None  # ≙ EarlyStopping, P2/03:397-401
     checkpoint_dir: Optional[str] = None

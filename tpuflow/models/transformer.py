@@ -337,7 +337,8 @@ def perplexity(loss: float) -> float:
     return float(np.exp(min(float(loss), 20.0)))
 
 
-def next_token_loss(logits, tokens, ignore_index: int = -1):
+def next_token_loss(logits, tokens, ignore_index: int = -1,
+                    label_smoothing: float = 0.0):
     """Mean cross-entropy of logits[:, :-1] predicting tokens[:, 1:].
 
     Positions whose TARGET equals ``ignore_index`` are masked out.
@@ -345,13 +346,30 @@ def next_token_loss(logits, tokens, ignore_index: int = -1):
     parallelism apply to the all-gathered logits or compute the shifted
     targets outside the shard_map so the shift crosses shard boundaries
     correctly.
+
+    ``label_smoothing``: uniform smoothing without materializing a
+    (B, S, vocab) one-hot — smoothed NLL decomposes as
+    ``(1-ε)·nll(target) + ε·mean_v nll(v)``.
     """
     import optax
 
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}"
+        )
     targets = tokens[:, 1:]
     pred = logits[:, :-1].astype(jnp.float32)
     mask = (targets != ignore_index).astype(jnp.float32)
-    losses = optax.softmax_cross_entropy_with_integer_labels(
-        pred, jnp.where(targets == ignore_index, 0, targets)
-    )
+    safe_targets = jnp.where(targets == ignore_index, 0, targets)
+    if label_smoothing:
+        logp = jax.nn.log_softmax(pred, axis=-1)
+        nll_t = -jnp.take_along_axis(
+            logp, safe_targets[..., None], axis=-1
+        )[..., 0]
+        nll_u = -jnp.mean(logp, axis=-1)
+        losses = (1.0 - label_smoothing) * nll_t + label_smoothing * nll_u
+    else:
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            pred, safe_targets
+        )
     return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -164,6 +164,7 @@ class LMTrainer:
         self.tx = get_optimizer(
             self.cfg.optimizer,
             self.cfg.learning_rate,
+            grad_clip_norm=self.cfg.grad_clip_norm,
             **self.cfg.optimizer_kwargs,
         )
         if self._gspmd:
@@ -284,10 +285,16 @@ class LMTrainer:
                         jnp.sum(a)
                         for a in jax.tree.leaves(coll.get("losses", {}))
                     )
-                    return next_token_loss(logits, tokens) + aux
+                    return next_token_loss(
+                        logits, tokens,
+                        label_smoothing=self.cfg.label_smoothing,
+                    ) + aux
                 return next_token_loss(
                     model.apply({"params": p}, tokens, train=train),
                     tokens,
+                    label_smoothing=(
+                        self.cfg.label_smoothing if train else 0.0
+                    ),
                 )
 
             out_shardings = (self._state_shardings, None)
@@ -309,7 +316,12 @@ class LMTrainer:
                 # loss over the GLOBAL gathered logits: the next-token
                 # shift crosses sequence-shard boundaries, so it must
                 # happen outside the shard_map (next_token_loss doc)
-                return next_token_loss(fwd(p, tokens, train), tokens)
+                return next_token_loss(
+                    fwd(p, tokens, train), tokens,
+                    label_smoothing=(
+                        self.cfg.label_smoothing if train else 0.0
+                    ),
+                )
 
         def train_step(state: TrainState, tokens, lr):
             loss, grads = jax.value_and_grad(

@@ -39,6 +39,7 @@ def get_optimizer(
     name: str,
     learning_rate: float,
     param_mask: Optional[Any] = None,
+    grad_clip_norm: Optional[float] = None,
     **kwargs,
 ) -> optax.GradientTransformation:
     """Build an optimizer by name with a runtime-adjustable LR.
@@ -47,6 +48,10 @@ def get_optimizer(
     ``optax.set_to_zero`` — structurally zero updates, and crucially zero
     *optimizer state*, so frozen-backbone training carries no Adam
     moments for the backbone (the ZeRO-ish memory win of masking).
+
+    ``grad_clip_norm``: if set, gradients are clipped to this GLOBAL
+    norm before the update (optax.clip_by_global_norm chained in front;
+    the LR-steering helpers below see through the chain state).
     """
     key = name.lower()
     if key not in _OPTIMIZERS:
@@ -56,6 +61,10 @@ def get_optimizer(
     tx = optax.inject_hyperparams(_OPTIMIZERS[key])(
         learning_rate=learning_rate, **kwargs
     )
+    if grad_clip_norm is not None:
+        if grad_clip_norm <= 0:
+            raise ValueError(f"grad_clip_norm must be > 0, got {grad_clip_norm}")
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
     if param_mask is not None:
         tx = optax.multi_transform(
             {"train": tx, "frozen": optax.set_to_zero()},
@@ -86,6 +95,8 @@ def set_learning_rate(opt_state: Any, lr: float) -> Any:
             hp = dict(s.hyperparams)
             hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
             return s._replace(hyperparams=hp)
+        if type(s) is tuple:  # optax.chain state (e.g. grad clipping)
+            return tuple(_replace(x) for x in s)
         return s
 
     if hasattr(opt_state, "inner_states"):  # multi_transform wrapper
@@ -96,11 +107,28 @@ def set_learning_rate(opt_state: Any, lr: float) -> Any:
 
 
 def get_learning_rate(opt_state: Any) -> float:
+    def _find(s):
+        if hasattr(s, "hyperparams"):
+            return float(s.hyperparams["learning_rate"])
+        if type(s) is tuple:  # chain state: search the elements
+            for x in s:
+                got = _find(x)
+                if got is not None:
+                    return got
+        return None
+
     if hasattr(opt_state, "inner_states"):
         node = opt_state.inner_states["train"]
         node = node.inner_state if hasattr(node, "inner_state") else node
-        return float(node.hyperparams["learning_rate"])
-    return float(opt_state.hyperparams["learning_rate"])
+        got = _find(node)
+    else:
+        got = _find(opt_state)
+    if got is None:
+        raise ValueError(
+            "opt_state carries no inject_hyperparams learning_rate leaf "
+            "(was it built by get_optimizer?)"
+        )
+    return got
 
 
 def _map_masked_node(node: Any, fn: Callable[[Any], Any]) -> Any:

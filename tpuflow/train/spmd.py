@@ -36,7 +36,7 @@ from tpuflow.models.preprocess import preprocess_input, random_flip
 from tpuflow.parallel.mesh import DATA_AXIS
 from tpuflow.train.optimizers import get_optimizer, set_learning_rate
 from tpuflow.train.state import TrainState
-from tpuflow.train.trainer import Trainer
+from tpuflow.train.trainer import Trainer, _smoothed_ce
 
 
 def shard_over_data(spec_tree, abstract_params, data_size: int):
@@ -196,7 +196,8 @@ class SpmdTrainer(Trainer):
         self.lr0 = cfg.learning_rate
         self.param_mask = mask  # used by _make_steps to prune the backward
         self.tx = get_optimizer(
-            cfg.optimizer, self.lr0, param_mask=mask, **cfg.optimizer_kwargs
+            cfg.optimizer, self.lr0, param_mask=mask,
+            grad_clip_norm=cfg.grad_clip_norm, **cfg.optimizer_kwargs
         )
 
         abstract = jax.eval_shape(make_state, jax.random.key(cfg.seed))
@@ -232,9 +233,9 @@ class SpmdTrainer(Trainer):
                     mutable=["batch_stats"],
                 )
                 logits, new_vars = out
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits.astype(jnp.float32), labels
-                ).mean()
+                loss = _smoothed_ce(
+                    logits, labels, self.cfg.label_smoothing
+                )
                 return loss, (logits, new_vars)
 
             # global-batch mean loss ⇒ gradients are already averaged

@@ -45,6 +45,24 @@ from tpuflow.train.optimizers import get_optimizer, set_learning_rate
 from tpuflow.train.state import TrainState
 
 
+def _smoothed_ce(logits, labels, smoothing: float):
+    """Training cross-entropy with optional label smoothing (the
+    standard regularizer the reference lacks). smoothing=0.0 is exactly
+    ``softmax_cross_entropy_with_integer_labels`` — the parity path.
+    Eval losses stay unsmoothed so val_loss is comparable across
+    smoothing settings."""
+    if not 0.0 <= smoothing < 1.0:
+        raise ValueError(f"label_smoothing must be in [0, 1), got {smoothing}")
+    logits = logits.astype(jnp.float32)
+    if smoothing:
+        one_hot = jax.nn.one_hot(labels, logits.shape[-1])
+        targets = optax.smooth_labels(one_hot, smoothing)
+        return optax.softmax_cross_entropy(logits, targets).mean()
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels
+    ).mean()
+
+
 class Trainer:
     def __init__(
         self,
@@ -107,6 +125,7 @@ class Trainer:
             self.cfg.optimizer,
             self.lr0,
             param_mask=mask,
+            grad_clip_norm=self.cfg.grad_clip_norm,
             **self.cfg.optimizer_kwargs,
         )
         state = TrainState(
@@ -151,9 +170,9 @@ class Trainer:
                     mutable=["batch_stats"],
                 )
                 logits, new_vars = out
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits.astype(jnp.float32), labels
-                ).mean()
+                loss = _smoothed_ce(
+                    logits, labels, self.cfg.label_smoothing
+                )
                 return loss, (logits, new_vars)
 
             (loss, (logits, new_vars)), grads = jax.value_and_grad(
