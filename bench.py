@@ -431,9 +431,13 @@ def main() -> int:
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--init-retries", type=int, default=3)
     p.add_argument("--init-backoff", type=float, default=30.0)
-    p.add_argument("--deadline", type=float, default=2400.0,
-                   help="watchdog: emit an error JSON line and exit if "
-                        "the bench has not finished by then")
+    p.add_argument("--deadline", type=float, default=1500.0,
+                   help="watchdog: emit a JSON line (provisional result "
+                        "if one exists, else a structured error) and "
+                        "exit if the bench has not finished by then — "
+                        "kept well under typical harness timeouts, since "
+                        "a wedged relay BLOCKS jax.devices() without "
+                        "erroring and the watchdog is the only exit")
     p.add_argument("--no-attn-diag", action="store_true")
     p.add_argument("--attn-sweep", action="store_true",
                    help="TPU only: sweep flash-attention block sizes "
