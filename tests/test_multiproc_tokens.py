@@ -1,0 +1,107 @@
+"""2-process LM training from the streamed TokenDataset == 1-process.
+
+The LM twin of tests/test_multiproc_train.py: each process streams its
+round-robin shard of the SAME on-disk corpus (shuffle=False so the
+global batch at step i is the same SET of rows in both topologies —
+the per-row loss mean is row-permutation-invariant), so the 2-process
+losses must equal a single-process run over the unsharded stream on a
+2-device mesh (VERDICT r2 #3's parity requirement).
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+
+_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    sys.path.insert(0, os.environ["TPUFLOW_REPO"])
+    import tpuflow.core as core
+    core.initialize()
+    import jax
+    import jax.numpy as jnp
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.data.tokens import TokenDataset
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.train import LMTrainer
+
+    work = os.environ["TPUFLOW_TEST_WORK"]
+    assert jax.process_count() == 2, jax.process_count()
+    pid = jax.process_index()
+
+    # shard=None auto-wires to (process_index, process_count)
+    ds = TokenDataset(os.path.join(work, "corpus"), batch_rows=4,
+                      shuffle=False)
+    assert ds.cur_shard == pid and ds.shard_count == 2
+
+    cfg = TrainConfig(optimizer="sgd", learning_rate=1e-2,
+                      warmup_epochs=0, scale_lr_by_world_size=False,
+                      seed=11)
+    tr = LMTrainer(
+        build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=4,
+                             mlp_ratio=2, dtype=jnp.float32),
+        cfg,
+    )
+    m = tr.fit(ds, batch_size=8, epochs=2)
+    with open(os.path.join(work, f"lm_metrics_{pid}.json"), "w") as f:
+        json.dump({"loss": float(m["loss"])}, f)
+    print("proc", pid, "loss", m["loss"])
+    """
+)
+
+
+def test_two_process_token_stream_matches_single(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.cli.launch import main
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.data.tokens import TokenDataset, write_token_shards
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    work = str(tmp_path)
+    rng = np.random.default_rng(3)
+    start = rng.integers(0, 64, (32, 1))
+    stride = rng.integers(1, 7, (32, 1))
+    toks = ((start + stride * np.arange(24)[None, :]) % 64).astype(np.int32)
+    write_token_shards(toks, os.path.join(work, "corpus"), rows_per_shard=10)
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env_backup = dict(os.environ)
+    os.environ["TPUFLOW_REPO"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    os.environ["TPUFLOW_TEST_WORK"] = work
+    try:
+        rc = main(["--local", "2", "--port", "8923", "--",
+                   sys.executable, str(script)])
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+    assert rc == 0
+
+    m0 = json.load(open(os.path.join(work, "lm_metrics_0.json")))
+    m1 = json.load(open(os.path.join(work, "lm_metrics_1.json")))
+    np.testing.assert_allclose(m0["loss"], m1["loss"], rtol=1e-6)
+
+    # single process, 2-device mesh, unsharded stream: same global batch
+    # SETS per step → same losses (only float reduction order differs)
+    cfg = TrainConfig(optimizer="sgd", learning_rate=1e-2,
+                      warmup_epochs=0, scale_lr_by_world_size=False,
+                      seed=11)
+    mesh = build_nd_mesh({"data": 2}, devices=jax.devices()[:2])
+    tr = LMTrainer(
+        build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=4,
+                             mlp_ratio=2, dtype=jnp.float32),
+        cfg, mesh=mesh,
+    )
+    ds = TokenDataset(os.path.join(work, "corpus"), batch_rows=8,
+                      shard=(0, 1), shuffle=False)
+    m_sp = tr.fit(ds, batch_size=8, epochs=2)
+    np.testing.assert_allclose(m0["loss"], m_sp["loss"], rtol=5e-4)

@@ -124,6 +124,44 @@ def test_kernel_matches_masked_block_ref():
             )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("bq,bk", [(16, 32), (32, 16), (32, 32)])
+def test_streamed_grid_unequal_blocks(causal, bq, bk):
+    """The K/V-streamed grid carries online-softmax state across inner
+    grid steps in VMEM scratch; unequal block_q/block_k stress the
+    causal first-visible/last-visible block arithmetic that gates the
+    scratch init/finalize writes."""
+    b, h, s, d = 1, 2, 96, 16
+    q, k, v = (_rand((b, h, s, d), i + 30) for i in range(3))
+
+    def loss(op):
+        def f(q, k, v):
+            return jnp.sum(op(q, k, v) ** 2)
+
+        return jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    o1, g1 = loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=bq, block_k=bk))
+    o2, g2 = loss(lambda q, k, v: mha_reference(q, k, v, causal=causal))
+    np.testing.assert_allclose(o1, o2, atol=5e-5, rtol=5e-4)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(a, b_, atol=5e-5, rtol=5e-4)
+
+
+def test_streamed_grid_many_kv_blocks():
+    """Longer sequence with many revolving K/V tiles per query block
+    (the VMEM-bounded long-context shape, scaled down for interpret
+    mode: on-chip the same kernel runs 64k+ because per-(batch, head)
+    VMEM is O(block·head_dim), not O(seq·head_dim))."""
+    b, h, s, d = 1, 1, 512, 16
+    q, k, v = (_rand((b, h, s, d), i + 40, jnp.bfloat16) for i in range(3))
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        out.astype(np.float32), ref.astype(np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
 def test_mha_xla_matches_oracle_f32():
     q, k, v = (_rand((2, 2, 24, 16), i) for i in range(3))
     from tpuflow.ops import mha_xla

@@ -1,0 +1,171 @@
+"""TokenDataset: beyond-memory token streaming for the LM family.
+
+Peer of the image loader's streaming mode (tests/test_loader.py) —
+round-robin sharding, deterministic per-epoch reshuffle, bounded
+buffers — applied to tokenized corpora (VERDICT r2 #3).
+"""
+
+import numpy as np
+import pytest
+
+from tpuflow.data.tokens import TokenDataset, write_token_shards
+
+SEQ = 16
+
+
+def _rows(n, seed=0):
+    """Unique rows: row i's first token is i (identity for coverage
+    checks), rest random."""
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, 100, (n, SEQ)).astype(np.int32)
+    toks[:, 0] = np.arange(n)
+    return toks
+
+
+def _ids(batches):
+    return np.concatenate([b[:, 0] for b in batches])
+
+
+def test_write_shards_layout_and_immutability(tmp_path):
+    toks = _rows(100)
+    d = write_token_shards(toks, str(tmp_path / "c"), rows_per_shard=32)
+    ds = TokenDataset(d, batch_rows=10, shard=(0, 1), shuffle=False)
+    assert ds.total_rows == 100
+    assert ds.shard_rows == [32, 32, 32, 4]
+    assert ds.seq_len == SEQ
+    with pytest.raises(FileExistsError):
+        write_token_shards(toks, d)
+
+
+def test_blocks_stream_as_one_corpus(tmp_path):
+    blocks = [_rows(10), _rows(25, seed=1), _rows(7, seed=2)]
+    d = write_token_shards(blocks, str(tmp_path / "c"), rows_per_shard=16)
+    ds = TokenDataset(d, batch_rows=6, shard=(0, 1), shuffle=False)
+    got = np.concatenate(list(ds.iter_epoch(0)), axis=0)
+    want = np.concatenate(blocks, axis=0)[: ds.steps_per_epoch() * 6]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_no_shuffle_preserves_order(tmp_path):
+    toks = _rows(64)
+    d = write_token_shards(toks, str(tmp_path / "c"), rows_per_shard=16)
+    ds = TokenDataset(d, batch_rows=8, shard=(0, 1), shuffle=False)
+    assert ds.steps_per_epoch() == 8
+    got = np.concatenate(list(ds.iter_epoch(3)), axis=0)
+    np.testing.assert_array_equal(got, toks)
+
+
+def test_round_robin_shards_disjoint_and_cover(tmp_path):
+    toks = _rows(60)
+    d = write_token_shards(toks, str(tmp_path / "c"), rows_per_shard=17)
+    a = TokenDataset(d, batch_rows=5, shard=(0, 2), shuffle=False)
+    b = TokenDataset(d, batch_rows=5, shard=(1, 2), shuffle=False)
+    assert a.steps_per_epoch() == b.steps_per_epoch() == 6
+    ia = _ids(list(a.iter_epoch(0)))
+    ib = _ids(list(b.iter_epoch(0)))
+    # THE shard convention: global row g on shard g % n (loader parity)
+    assert all(i % 2 == 0 for i in ia)
+    assert all(i % 2 == 1 for i in ib)
+    assert len(set(ia) | set(ib)) == 60
+    assert len(a) == len(b) == 30
+
+
+def test_shuffle_deterministic_and_reshuffles(tmp_path):
+    d = write_token_shards(_rows(200), str(tmp_path / "c"), rows_per_shard=64)
+    ds = TokenDataset(d, batch_rows=20, shard=(0, 1), seed=7,
+                      shuffle_rows=50)
+    e0a = _ids(list(ds.iter_epoch(0)))
+    e0b = _ids(list(ds.iter_epoch(0)))
+    e1 = _ids(list(ds.iter_epoch(1)))
+    np.testing.assert_array_equal(e0a, e0b)  # resume replays exactly
+    assert not np.array_equal(e0a, e1)  # epochs reshuffle
+    # full coverage, no duplicates (budget == corpus here)
+    assert sorted(e0a) == list(range(200))
+    assert sorted(e1) == list(range(200))
+
+
+def test_corpus_much_larger_than_buffers_streams_bounded(tmp_path):
+    """Corpus >> reservoir + read chunk: the stream's working set is the
+    PREALLOCATED reservoir (shuffle_rows) + scratch (read_chunk_rows) +
+    one batch — nothing grows with corpus size (the flat-RSS design:
+    raw seek/readinto into reused buffers, no mmap residency)."""
+    n = 5000
+    d = write_token_shards(_rows(n), str(tmp_path / "c"), rows_per_shard=512)
+    ds = TokenDataset(d, batch_rows=32, shard=(0, 1), shuffle_rows=64,
+                      read_chunk_rows=128)
+    ids = _ids(list(ds.iter_epoch(0)))
+    assert len(ids) == ds.steps_per_epoch() * 32
+    assert len(set(ids.tolist())) == len(ids)  # no duplicates
+    # buffers are fixed-size allocations, independent of n
+    assert ds.shuffle_rows * SEQ * 4 + ds.read_chunk_rows * SEQ * 4 < 10 * n
+
+
+def test_validation_errors(tmp_path):
+    d = write_token_shards(_rows(30), str(tmp_path / "c"))
+    with pytest.raises(ValueError, match="bad shard"):
+        TokenDataset(d, batch_rows=4, shard=(2, 2))
+    with pytest.raises(ValueError, match="one global batch"):
+        TokenDataset(d, batch_rows=40, shard=(0, 1))
+    with pytest.raises(ValueError, match="batch_rows"):
+        TokenDataset(d, batch_rows=0, shard=(0, 1))
+
+
+# ---- LMTrainer integration -------------------------------------------------
+
+
+def _learnable_corpus(n, seq_len, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    start = rng.integers(0, vocab, (n, 1))
+    stride = rng.integers(1, 7, (n, 1))
+    pos = np.arange(seq_len)[None, :]
+    return ((start + stride * pos) % vocab).astype(np.int32)
+
+
+def test_lm_trainer_fits_from_token_stream(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    d = write_token_shards(
+        _learnable_corpus(64, 32), str(tmp_path / "c"), rows_per_shard=16
+    )
+    mesh = build_nd_mesh({"data": 2}, devices=jax.devices()[:2])
+    cfg = TrainConfig(optimizer="adamw", learning_rate=3e-3,
+                      warmup_epochs=0, scale_lr_by_world_size=False, seed=0)
+    tr = LMTrainer(
+        build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=4,
+                             mlp_ratio=2, dtype=jnp.float32),
+        cfg, mesh=mesh,
+    )
+    ds = TokenDataset(d, batch_rows=16, shard=(0, 1), seed=0)
+    first = tr.fit(ds, batch_size=16, epochs=1)
+    last = tr.fit(ds, batch_size=16, epochs=4)
+    assert last["loss"] < first["loss"] * 0.8, (first, last)
+
+    # topology mismatch fails loudly up front
+    bad = TokenDataset(d, batch_rows=8, shard=(0, 1))
+    with pytest.raises(ValueError, match="does not match this topology"):
+        tr.fit(bad, batch_size=16, epochs=1)
+
+
+def test_lm_trainer_rejects_short_corpus():
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    mesh = build_nd_mesh({"data": 1}, devices=jax.devices()[:1])
+    tr = LMTrainer(
+        build_transformer_lm(vocab_size=64, dim=32, depth=1, heads=2,
+                             mlp_ratio=2, dtype=jnp.float32),
+        TrainConfig(warmup_epochs=0), mesh=mesh,
+    )
+    with pytest.raises(ValueError, match="rows < batch_size"):
+        tr.fit(_learnable_corpus(8, 32), batch_size=16, epochs=1)

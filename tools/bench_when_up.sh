@@ -26,12 +26,16 @@ while true; do
   if timeout -k 10 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date) tunnel up; running bench" >> "$log"
     ok=0
-    [ -f BENCH_LOCAL_r02_cnn.json ] || capture BENCH_LOCAL_r02_cnn.json --steps 30 || ok=1
-    [ -f BENCH_LOCAL_r02_vit.json ] || capture BENCH_LOCAL_r02_vit.json --model vit --steps 15 || ok=1
-    [ -f BENCH_LOCAL_r02_resnet50.json ] || capture BENCH_LOCAL_r02_resnet50.json --model resnet50 --steps 20 --no-attn-diag || ok=1
-    [ -f BENCH_LOCAL_r02_lm.json ] || capture BENCH_LOCAL_r02_lm.json --model lm --steps 10 --no-attn-diag || ok=1
-    [ -f BENCH_LOCAL_r02_e2e.json ] || capture BENCH_LOCAL_r02_e2e.json --end2end --no-attn-diag || ok=1
-    if [ "$ok" -eq 0 ]; then echo "$(date) all captures done" >> "$log"; exit 0; fi
+    [ -f BENCH_LOCAL_r03_cnn.json ] || capture BENCH_LOCAL_r03_cnn.json --steps 30 || ok=1
+    [ -f BENCH_LOCAL_r03_vit.json ] || capture BENCH_LOCAL_r03_vit.json --model vit --steps 15 || ok=1
+    [ -f BENCH_LOCAL_r03_resnet50.json ] || capture BENCH_LOCAL_r03_resnet50.json --model resnet50 --steps 20 --no-attn-diag || ok=1
+    [ -f BENCH_LOCAL_r03_lm.json ] || capture BENCH_LOCAL_r03_lm.json --model lm --steps 10 --no-attn-diag || ok=1
+    [ -f BENCH_LOCAL_r03_e2e.json ] || capture BENCH_LOCAL_r03_e2e.json --end2end --no-attn-diag || ok=1
+    if [ "$ok" -eq 0 ]; then
+      # bonus (non-gating): kernel block-size sweep for the tuning table
+      [ -f BENCH_LOCAL_r03_sweep.json ] || capture BENCH_LOCAL_r03_sweep.json --model vit --steps 15 --attn-sweep || true
+      echo "$(date) all captures done" >> "$log"; exit 0
+    fi
   else
     echo "$(date) tunnel down" >> "$log"
   fi

@@ -7,3 +7,4 @@ from tpuflow.data.transforms import (  # noqa: F401
     random_split,
 )
 from tpuflow.data.loader import Dataset, make_dataset  # noqa: F401
+from tpuflow.data.tokens import TokenDataset, write_token_shards  # noqa: F401

@@ -1,0 +1,268 @@
+"""Beyond-memory token streaming for LM training (peer of C5/N8).
+
+The image pipeline earned a streaming loader (``Dataset(streaming=True)``,
+tpuflow.data.loader — the Petastorm rationale of P1/03:32-34: train on
+data that does not fit in RAM); this module applies the same discipline
+to the LM family tpuflow makes first-class. A tokenized corpus lives on
+disk as fixed-shape binary shards and is streamed through a bounded
+reservoir, so host RSS is O(shuffle_rows + chunk) regardless of corpus
+size.
+
+Storage format (written by :func:`write_token_shards`): a directory of
+``tokens-%05d.bin`` files (raw little-endian int32, row-major
+``(rows, seq_len)``) plus ``manifest.json`` recording ``seq_len``,
+per-shard row counts and the dtype. Raw binary + explicit seek/read
+into a REUSED scratch buffer — not ``np.load(mmap_mode=...)`` — because
+mmap'd pages touched during an epoch stay resident until memory
+pressure, which defeats a flat-RSS guarantee the tests can assert.
+
+Semantics shared with the image loader (tpuflow.data.loader):
+
+- **shard convention**: global row index ``g`` belongs to shard
+  ``g % shard_count`` (``take_shard_rows``'s round-robin rule).
+- **deterministic shuffle**: shard-file order and the reservoir are
+  seeded by ``(seed, epoch, cur_shard)``, so resume at ``start_epoch``
+  replays the exact batch order (≙ loader._epoch_order).
+- **lockstep steps**: ``steps_per_epoch = total_rows // (batch_rows ×
+  shard_count)`` — identical on every process, so collective steps
+  never desync (P1/03:350-351).
+
+The shuffle is a single-pass bounded reservoir (fill ``shuffle_rows``
+rows, then yield a random occupant and replace it with the next
+incoming row — tf.data's shuffle-buffer algorithm): uniform enough for
+training, O(shuffle_rows) memory, deterministic under the seeded rng.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+_MANIFEST = "manifest.json"
+_DTYPE = "int32"
+
+
+def write_token_shards(
+    tokens: Union[np.ndarray, Sequence[np.ndarray]],
+    out_dir: str,
+    rows_per_shard: int = 8192,
+) -> str:
+    """Write ``(N, seq_len)`` int32 token rows (one array or a sequence
+    of row-block arrays, e.g. a generator over tokenizer output) as a
+    sharded binary corpus. Returns ``out_dir``. Appends are not
+    supported — a corpus version is immutable once written (same
+    discipline as tpuflow.data.table versions)."""
+    os.makedirs(out_dir, exist_ok=True)
+    if os.path.exists(os.path.join(out_dir, _MANIFEST)):
+        raise FileExistsError(
+            f"{out_dir} already holds a token corpus (immutable once "
+            "written); write a new directory instead"
+        )
+    # stream the blocks — materializing a generator would defeat the
+    # beyond-host-RAM purpose (a corpus larger than RAM must flush
+    # shard by shard, holding at most rows_per_shard rows)
+    blocks = iter([tokens]) if isinstance(tokens, np.ndarray) else iter(tokens)
+    try:
+        first = np.asarray(next(blocks))
+    except StopIteration:
+        raise ValueError("no token rows to write") from None
+    seq_len = int(first.shape[1])
+    shard_rows: List[int] = []
+    cur: List[np.ndarray] = []
+    cur_n = 0
+
+    def _flush():
+        nonlocal cur, cur_n
+        if not cur_n:
+            return
+        arr = np.ascontiguousarray(
+            np.concatenate(cur, axis=0), dtype=np.dtype(_DTYPE).newbyteorder("<")
+        )
+        path = os.path.join(out_dir, f"tokens-{len(shard_rows):05d}.bin")
+        with open(path, "wb") as f:
+            f.write(arr.tobytes())
+        shard_rows.append(int(arr.shape[0]))
+        cur, cur_n = [], 0
+
+    import itertools
+
+    for blk in itertools.chain([first], blocks):
+        blk = np.asarray(blk)
+        if blk.ndim != 2 or blk.shape[1] != seq_len:
+            raise ValueError(
+                f"all blocks must be (rows, {seq_len}); got {blk.shape}"
+            )
+        start = 0
+        while start < blk.shape[0]:
+            take = min(rows_per_shard - cur_n, blk.shape[0] - start)
+            cur.append(blk[start : start + take])
+            cur_n += take
+            start += take
+            if cur_n == rows_per_shard:
+                _flush()
+    _flush()
+    manifest = {
+        "seq_len": seq_len,
+        "dtype": _DTYPE,
+        "shard_rows": shard_rows,
+        "total_rows": int(sum(shard_rows)),
+    }
+    tmp = os.path.join(out_dir, _MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(out_dir, _MANIFEST))  # atomic publish
+    return out_dir
+
+
+class TokenDataset:
+    """Memory-bounded, shard-aware stream of ``(batch_rows, seq_len)``
+    int32 batches over a :func:`write_token_shards` corpus.
+
+    ``shard=None`` auto-wires to ``(jax.process_index(),
+    jax.process_count())`` — the trainer-facing default; pass an
+    explicit ``(cur, count)`` for tests or custom topologies.
+    ``batch_rows`` is the rows yielded PER PROCESS per step (the
+    trainer's ``batch_size // process_count``).
+    """
+
+    def __init__(
+        self,
+        corpus_dir: str,
+        batch_rows: int,
+        *,
+        shard: Optional[Tuple[int, int]] = None,
+        seed: int = 0,
+        shuffle: bool = True,
+        shuffle_rows: int = 4096,
+        read_chunk_rows: int = 1024,
+    ):
+        with open(os.path.join(corpus_dir, _MANIFEST)) as f:
+            m = json.load(f)
+        self.dir = corpus_dir
+        self.seq_len = int(m["seq_len"])
+        self.shard_rows: List[int] = [int(r) for r in m["shard_rows"]]
+        self.total_rows = int(m["total_rows"])
+        if shard is None:
+            import jax
+
+            shard = (jax.process_index(), jax.process_count())
+        self.cur_shard, self.shard_count = shard
+        if not (0 <= self.cur_shard < self.shard_count):
+            raise ValueError(f"bad shard {shard}")
+        if batch_rows <= 0:
+            raise ValueError(f"batch_rows must be positive, got {batch_rows}")
+        self.batch_rows = int(batch_rows)
+        self.seed = seed
+        self.shuffle = shuffle
+        self.shuffle_rows = max(int(shuffle_rows), self.batch_rows)
+        self.read_chunk_rows = int(read_chunk_rows)
+        if self.steps_per_epoch() < 1:
+            raise ValueError(
+                f"corpus has {self.total_rows} rows < one global batch "
+                f"({self.batch_rows} x {self.shard_count} processes)"
+            )
+
+    # ---- accounting ------------------------------------------------------
+
+    def __len__(self) -> int:
+        """Rows in THIS shard (arithmetic count of g % n == cur)."""
+        total, cur, n = self.total_rows, self.cur_shard, self.shard_count
+        return (total - cur + n - 1) // n if total > cur else 0
+
+    def steps_per_epoch(self) -> int:
+        """Identical on every shard — lockstep collective step count."""
+        return self.total_rows // (self.batch_rows * self.shard_count)
+
+    # ---- streaming -------------------------------------------------------
+
+    def _iter_shard_rows(
+        self, shard_idx: int, scratch: np.ndarray
+    ) -> Iterator[np.ndarray]:
+        """This process's rows of one shard file, streamed in
+        ``read_chunk_rows`` chunks through ``scratch`` (one reused
+        buffer — the no-allocation-per-chunk discipline of the image
+        loader's reuse ring). Yields row VIEWS into scratch: consumers
+        copy (the reservoir does)."""
+        rows = self.shard_rows[shard_idx]
+        g0 = sum(self.shard_rows[:shard_idx])  # global index of row 0
+        row_bytes = self.seq_len * 4
+        path = os.path.join(self.dir, f"tokens-{shard_idx:05d}.bin")
+        with open(path, "rb", buffering=0) as f:
+            for start in range(0, rows, self.read_chunk_rows):
+                n = min(self.read_chunk_rows, rows - start)
+                buf = scratch[:n]
+                f.seek(start * row_bytes)
+                got = f.readinto(memoryview(buf).cast("B"))
+                if got != n * row_bytes:
+                    raise IOError(
+                        f"{path}: short read at row {start} "
+                        f"({got} != {n * row_bytes} bytes)"
+                    )
+                g = g0 + start + np.arange(n)
+                keep = np.nonzero(g % self.shard_count == self.cur_shard)[0]
+                for i in keep:
+                    yield buf[i]
+
+    def iter_epoch(self, epoch: int) -> Iterator[np.ndarray]:
+        """Yield ``steps_per_epoch`` batches of ``(batch_rows, seq_len)``
+        for one epoch — deterministic in ``(seed, epoch, cur_shard)``."""
+        rng = np.random.default_rng((self.seed, epoch, self.cur_shard))
+        order = np.arange(len(self.shard_rows))
+        if self.shuffle:
+            rng.shuffle(order)
+        scratch = np.empty(
+            (self.read_chunk_rows, self.seq_len),
+            np.dtype(_DTYPE).newbyteorder("<"),
+        )
+        reservoir = np.empty((self.shuffle_rows, self.seq_len), np.int32)
+        filled = 0
+        batch = np.empty((self.batch_rows, self.seq_len), np.int32)
+        in_batch = 0
+        emitted = 0
+        budget = self.steps_per_epoch()
+
+        def _emit_ready() -> bool:
+            return in_batch == self.batch_rows
+
+        def _rows():
+            for si in order:
+                yield from self._iter_shard_rows(int(si), scratch)
+
+        for row in _rows():
+            if emitted == budget:
+                break
+            if self.shuffle and filled < self.shuffle_rows:
+                reservoir[filled] = row
+                filled += 1
+                continue
+            if self.shuffle:
+                j = int(rng.integers(filled))
+                batch[in_batch] = reservoir[j]
+                reservoir[j] = row
+            else:
+                batch[in_batch] = row
+            in_batch += 1
+            if _emit_ready():
+                yield batch.copy()
+                emitted += 1
+                in_batch = 0
+        # drain the reservoir (shuffled) for the remaining budget
+        if self.shuffle and emitted < budget and filled:
+            drain = rng.permutation(filled)
+            for j in drain:
+                batch[in_batch] = reservoir[j]
+                in_batch += 1
+                if _emit_ready():
+                    yield batch.copy()
+                    emitted += 1
+                    in_batch = 0
+                    if emitted == budget:
+                        break
+        if emitted < budget:
+            raise RuntimeError(
+                f"shard {self.cur_shard}/{self.shard_count}: produced "
+                f"{emitted}/{budget} batches — corpus shrank under us?"
+            )

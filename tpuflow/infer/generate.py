@@ -108,6 +108,16 @@ def generate(
                jax.random.key(seed))
 
 
+def clear_compile_cache() -> None:
+    """Drop all memoized jitted decode closures (each holds a compiled
+    executable and a model reference). A long-lived server cycling many
+    distinct prompt shapes / sampling configs can call this to bound
+    resident compile-cache growth; bucketing prompt lengths before
+    calling :func:`generate` keeps the cache small in the first place
+    (ADVICE r2)."""
+    _compiled_run.cache_clear()
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_run(dm, b: int, p: int, max_len: int, temperature: float,
                   top_k: Optional[int], top_p: Optional[float],
@@ -115,7 +125,8 @@ def _compiled_run(dm, b: int, p: int, max_len: int, temperature: float,
     """The jitted prompt+decode scan, memoized on (model, shapes,
     sampling config) — a serving loop calling generate() per request
     with identical shapes must compile ONCE, not per call (flax modules
-    are frozen dataclasses, so ``dm`` is a valid cache key)."""
+    are frozen dataclasses, so ``dm`` is a valid cache key). Bounded at
+    64 entries; :func:`clear_compile_cache` empties it on demand."""
 
     # cache struct at full length via eval_shape (no FLOPs), then zeros
     cache_shapes = jax.eval_shape(

@@ -13,9 +13,19 @@ INPUT dtype (bf16 in → bf16×bf16 with float32 accumulation — the
 full-rate MXU mode; casting operands to f32 first would drop to the
 ~8x-slower f32 path, measured round 2 as a ~2 TFLOP/s kernel), and all
 softmax statistics (max / normalizer / lse) are float32. Outputs match
-the input dtype. K/V for one (batch, head) are kept whole in VMEM
-(fine to ~16k sequence at head_dim 128 in bf16); queries stream in
-``block_q`` tiles.
+the input dtype.
+
+K/V STREAM through the grid: every kernel walks a
+``(batch·heads, outer, inner)`` grid whose inner dimension revolves a
+``block_k`` (resp. ``block_q``) VMEM window over the sequence, with the
+online-softmax / gradient state carried across inner steps in VMEM
+scratch accumulators. Pallas double-buffers the revolving window, so
+the HBM→VMEM copy of tile *t+1* overlaps the MXU work on tile *t*, and
+per-(batch, head) VMEM is O(block · head_dim) — independent of
+sequence length. A 64k-token forward at head_dim 128 needs ~16 MB of
+K+V per (batch, head) whole (beyond VMEM); streamed it needs two
+32 KB tiles in flight. Causally-masked (block_q, block_k) pairs are
+skipped with ``pl.when`` (~2x at long sequence).
 
 On non-TPU backends the kernels run in Pallas interpret mode, so the
 whole test suite exercises the real kernel code on CPU (SURVEY.md §4's
@@ -166,7 +176,20 @@ def _bwd_ref(cfg: _Cfg, q, k, v, o, lse, do):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, cfg: _Cfg):
+# Softmax-statistic scratch rows are lane-replicated to the TPU lane
+# width: a (block_q, 1) f32 VMEM buffer would occupy a full (bq, 128)
+# tile anyway, and whole-tile stores avoid sub-lane masking.
+_LANES = 128
+
+
+def _causal_last_j(qi: int, bq: int, bk: int, nk: int):
+    """Index of the LAST key block any row of query block ``qi`` can
+    see under the causal mask (the inner grid skips blocks beyond it)."""
+    return jnp.minimum(nk - 1, lax.div((qi + 1) * bq - 1, bk))
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                cfg: _Cfg):
     # lse_ref block is the FULL padded row, shape (1, 1, sq_pad): TPU
     # block specs require the last two block dims divisible by (8, 128)
     # or equal to the array dims — a (1, block_q) tile of a (BH, S)
@@ -174,71 +197,88 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, cfg: _Cfg):
     # interpret mode accepts it), while a whole-row block is always
     # legal and costs only S*4 bytes of VMEM.
     bq, d = q_ref.shape[1], q_ref.shape[2]
-    bk = cfg.block_k
+    bk = k_ref.shape[1]
     qi = pl.program_id(1)
-    q = q_ref[0]  # native dtype — bf16 in ⇒ full-rate MXU
+    j = pl.program_id(2)  # inner: revolving K/V window, sequential
+    nk = pl.num_programs(2)
 
-    nk_valid = pl.cdiv(cfg.skv_valid, bk)
-    if cfg.causal:
-        # last key block that any row of this query block can see
-        upper = jnp.minimum(nk_valid, lax.div((qi + 1) * bq + bk - 1, bk))
-    else:
-        upper = nk_valid
+    last_j = _causal_last_j(qi, bq, bk, nk) if cfg.causal else nk - 1
 
-    row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_BIG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * bk, bk), :]
-        v_blk = v_ref[0, pl.ds(j * bk, bk), :]
+    @pl.when(j <= last_j)
+    def _compute():
+        q = q_ref[0]  # native dtype — bf16 in ⇒ full-rate MXU
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         s = s * cfg.scale  # scale the f32 scores, not the bf16 operand
         col = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = col < cfg.skv_valid
         if cfg.causal:
+            row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             mask = mask & (col <= row)
         s = jnp.where(mask, s, _NEG_BIG)
+        m = m_ref[:, :1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
             p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    m0 = jnp.full((bq, 1), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    a0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, a0))
-
-    safe_l = jnp.where(l > 0, l, 1.0)
-    o_ref[0] = jnp.where(l > 0, acc / safe_l, 0.0).astype(o_ref.dtype)
-    lse = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(safe_l[:, 0]), _NEG_BIG)
-    lse_ref[0, 0, pl.ds(qi * bq, bq)] = lse
+    @pl.when(j == last_j)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = jnp.where(l > 0, acc_ref[...] / safe_l, 0.0).astype(
+            o_ref.dtype
+        )
+        lse = jnp.where(
+            l[:, 0] > 0, m_ref[:, 0] + jnp.log(safe_l[:, 0]), _NEG_BIG
+        )
+        lse_ref[0, 0, pl.ds(qi * bq, bq)] = lse
 
 
 def _fwd(cfg: _Cfg, q, k, v):
     bh, sq, d = q.shape
     skv = k.shape[1]
-    nq = sq // cfg.block_q
-    grid = (bh, nq)
+    grid = (bh, sq // cfg.block_q, skv // cfg.block_k)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, cfg=cfg),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, cfg.block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, cfg.block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, sq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v)),
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32, vma=_vma(q, k, v)),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((cfg.block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((cfg.block_q, _LANES), jnp.float32),  # normalizer
+            pltpu.VMEM((cfg.block_q, d), jnp.float32),  # output accum
+        ],
+        # the qi dim must stay 'arbitrary': the (1, 1, sq) lse OUTPUT
+        # block's index map is invariant over qi, and a 'parallel' qi
+        # would let megacore give each core a private copy of that
+        # shared window — each core's flush clobbering the other's rows
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
         interpret=cfg.interpret,
     )(q, k, v)
     return o, lse[:, 0, :]
@@ -249,78 +289,90 @@ def _fwd(cfg: _Cfg, q, k, v):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, cfg: _Cfg):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc_ref, cfg: _Cfg):
     bq, d = q_ref.shape[1], q_ref.shape[2]
-    bk = cfg.block_k
+    bk = k_ref.shape[1]
     qi = pl.program_id(1)
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
-    delta = delta_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
-    row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    row_ok = row < cfg.sq_valid
+    j = pl.program_id(2)  # inner: revolving K/V window
+    nk = pl.num_programs(2)
 
-    nk_valid = pl.cdiv(cfg.skv_valid, bk)
-    if cfg.causal:
-        upper = jnp.minimum(nk_valid, lax.div((qi + 1) * bq + bk - 1, bk))
-    else:
-        upper = nk_valid
+    last_j = _causal_last_j(qi, bq, bk, nk) if cfg.causal else nk - 1
 
-    def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * bk, bk), :]
-        v_blk = v_ref[0, pl.ds(j * bk, bk), :]
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    @pl.when(j <= last_j)
+    def _compute():
+        q = q_ref[0]
+        do = do_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        lse = lse_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
+        row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * cfg.scale
         col = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = (col < cfg.skv_valid) & row_ok
+        mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
         if cfg.causal:
             mask = mask & (col <= row)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k_blk.dtype)
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        dq_acc_ref[...] = dq_acc_ref[...] + jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32
+        )
 
-    dq = lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * cfg.scale).astype(dq_ref.dtype)
+    @pl.when(j == last_j)
+    def _finalize():
+        dq_ref[0] = (dq_acc_ref[...] * cfg.scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                cfg: _Cfg):
+                dk_acc_ref, dv_acc_ref, cfg: _Cfg):
     bk, d = k_ref.shape[1], k_ref.shape[2]
-    bq = cfg.block_q
+    bq = q_ref.shape[1]
     ki = pl.program_id(1)
-    k = k_ref[0]
-    v = v_ref[0]
-    col = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    col_ok = col < cfg.skv_valid
+    i = pl.program_id(2)  # inner: revolving Q/dO window
+    nq = pl.num_programs(2)
 
-    nq = pl.cdiv(cfg.sq_valid, bq)
     # causal: the first query block whose rows can see this key block
-    lower = lax.div(ki * bk, bq) if cfg.causal else 0
+    first_i = lax.div(ki * bk, bq) if cfg.causal else 0
 
-    def body(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(i * bq, bq), :]
-        do_blk = do_ref[0, pl.ds(i * bq, bq), :]
+    @pl.when(i == first_i)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    @pl.when(i >= first_i)
+    def _compute():
+        k = k_ref[0]
+        v = v_ref[0]
+        q_blk = q_ref[0]
+        do_blk = do_ref[0]
         lse = lse_ref[0, 0, pl.ds(i * bq, bq)][:, None]
         delta = delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]
+        col = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * cfg.scale
         row = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        mask = col_ok & (row < cfg.sq_valid)
+        mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
         if cfg.causal:
             mask = mask & (col <= row)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dv = dv + jnp.dot(
+        dv_acc_ref[...] = dv_acc_ref[...] + jnp.dot(
             p.T.astype(do_blk.dtype), do_blk, preferred_element_type=jnp.float32
         )
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(q_blk.dtype)
-        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_acc_ref[...] = dk_acc_ref[...] + jnp.dot(
+            ds.T, q_blk, preferred_element_type=jnp.float32
+        )
 
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = lax.fori_loop(lower, nq, body, (z, z))
-    dk_ref[0] = (dk * cfg.scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc_ref[...] * cfg.scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do):
@@ -330,30 +382,43 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do):
     # vectors ride as (BH, 1, S) whole-row blocks — see _fwd_kernel note
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
-    q_spec = pl.BlockSpec((1, cfg.block_q, d), lambda b, i: (b, i, 0))
-    kv_full = pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0))
-    vec_row = pl.BlockSpec((1, 1, sq), lambda b, i: (b, 0, 0))
+    nq, nk = sq // cfg.block_q, skv // cfg.block_k
+    q_spec = pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0))
+    k_stream = pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0))
+    vec_row = pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0))
+    semantics = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, cfg=cfg),
-        grid=(bh, sq // cfg.block_q),
-        in_specs=[q_spec, kv_full, kv_full, q_spec, vec_row, vec_row],
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, k_stream, k_stream, q_spec, vec_row, vec_row],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v, do)),
+        scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
+        compiler_params=semantics,
         interpret=cfg.interpret,
     )(q, k, v, do, lse3, delta3)
 
-    k_spec = pl.BlockSpec((1, cfg.block_k, d), lambda b, j: (b, j, 0))
-    q_full = pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0))
+    # dk/dv: key blocks in the middle grid dim, queries stream innermost
+    k_spec = pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0))
+    q_stream = pl.BlockSpec((1, cfg.block_q, d), lambda b, j, i: (b, i, 0))
+    vec_row_kv = pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, cfg=cfg),
-        grid=(bh, skv // cfg.block_k),
-        in_specs=[k_spec, k_spec, q_full, q_full, vec_row, vec_row],
+        grid=(bh, nk, nq),
+        in_specs=[k_spec, k_spec, q_stream, q_stream, vec_row_kv, vec_row_kv],
         out_specs=[k_spec, k_spec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, skv, d), k.dtype, vma=_vma(q, k, v, do)),
             jax.ShapeDtypeStruct((bh, skv, d), v.dtype, vma=_vma(q, k, v, do)),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((cfg.block_k, d), jnp.float32),
+            pltpu.VMEM((cfg.block_k, d), jnp.float32),
+        ],
+        compiler_params=semantics,
         interpret=cfg.interpret,
     )(k, v, q, do, lse3, delta3)
     return dq, dk, dv

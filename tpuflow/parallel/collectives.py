@@ -23,8 +23,13 @@ def pvary(x, axis_names) -> Any:
     """Tag x as varying over the given manual mesh axes — needed where
     shard_map type-checks branches/carries (lax.switch, lax.scan) and a
     constant (e.g. a zeros skip-value) must match a collective-produced
-    value's varying-manual-axes."""
+    value's varying-manual-axes. Idempotent: axes the value already
+    varies over are skipped (pcast rejects varying→varying)."""
     axes = (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
+    have = getattr(jax.typeof(x), "vma", frozenset())
+    axes = tuple(a for a in axes if a not in have)
+    if not axes:
+        return x
     try:
         return jax.lax.pcast(x, axes, to="varying")
     except (AttributeError, TypeError):

@@ -107,6 +107,221 @@ def pipeline(
     return run
 
 
+def pipeline_1f1b(
+    first_fn: Callable[[Any, Any], Any],
+    stage_fn: Callable[[Any, Any], Any],
+    last_fn: Callable[[Any, Any, Any], Any],
+    n_microbatches: int,
+    axis_name: str = PIPE_AXIS,
+) -> Callable[[Any, Any, Any, Any, Any], Any]:
+    """1F1B (one-forward-one-backward) pipeline schedule, manual VJP.
+
+    :func:`pipeline` (GPipe) differentiates the forward scan, so
+    autodiff keeps ALL ``n_micro`` stage inputs alive until the drain
+    finishes — activation memory O(n_micro). 1F1B starts microbatch
+    ``m``'s backward the moment the last stage has its loss, draining
+    residuals as it goes: at most ``2·n_stages-1`` stage inputs are
+    resident per device (a circular buffer here), the schedule of
+    production pipeline trainers (PipeDream-flush / Megatron's
+    non-interleaved 1F1B). Same math as GPipe — gradients accumulate
+    over all microbatches before the (outside) optimizer step — only
+    the op ORDER and residual lifetime differ.
+
+    Per tick every device runs ONE forward op (microbatch ``t - s``)
+    and ONE backward op (microbatch ``t - (2S-2-s)``), with activations
+    hopping forward and gradients hopping backward via ``ppermute``;
+    the backward recomputes its stage forward from the saved INPUT
+    (per-stage rematerialization, as GPipe-with-remat would).
+
+    ``first_fn(first_params, data) -> x`` — the (cheap, recomputed per
+    tick) input embedding; running it INSIDE stage 0 lets its parameter
+    gradient accumulate in place, so nothing O(n_micro) is ever
+    carried. ``stage_fn(stage_params, x) -> y`` — shape-preserving
+    block stack. ``last_fn(last_params, y, targets) -> scalar`` — one
+    microbatch's MEAN loss (final norm + LM head + loss live here:
+    1F1B needs the loss per-microbatch at the last stage to seed each
+    backward).
+
+    Returns ``run(stacked_params, first_params, last_params,
+    data_micro, tgt_micro) -> (loss_mean, stage_grads, first_grads,
+    last_grads)`` for use INSIDE ``shard_map`` over ``axis_name``:
+    in_specs ``(P(axis), P(), P(), P(), P())``, out_specs ``(P(),
+    P(axis), P(), P())`` — ``stage_grads`` carries a leading length-1
+    stage axis matching the stacked layout, the rest are replicated.
+    """
+
+    def run(stacked_params, first_params, last_params, data_micro,
+            tgt_micro):
+        params = jax.tree.map(lambda a: a[0], stacked_params)
+        # tag the replicated first/head params as pipe-varying up
+        # front: the VJPs inside the per-stage conds must be pure
+        # per-device math (a VJP w.r.t. an UNVARYING operand would make
+        # the type system insert a psum over the axis — a collective
+        # inside a conditionally-executed branch)
+        first_params = jax.tree.map(
+            lambda p: _pvary(p, axis_name), first_params
+        )
+        last_params = jax.tree.map(
+            lambda p: _pvary(p, axis_name), last_params
+        )
+        idx = lax.axis_index(axis_name)
+        n = lax.axis_size(axis_name)
+        m_total = data_micro.shape[0]
+        if m_total != n_microbatches:
+            raise ValueError(
+                f"input has {m_total} microbatches, pipeline built for "
+                f"{n_microbatches}"
+            )
+        w = 2 * n  # circular residual slots (in-flight ≤ 2n-1)
+        ticks = m_total + 2 * n - 2
+        fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+        bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+        inv_m = 1.0 / m_total
+
+        def _zeros_varying(tree):
+            return jax.tree.map(
+                lambda p: _pvary(jnp.zeros_like(p), axis_name), tree
+            )
+
+        def _data_at(buf, i):
+            return lax.dynamic_index_in_dim(
+                buf, jnp.clip(i, 0, m_total - 1), 0, keepdims=False
+            )
+
+        # activation shape/dtype via an eval_shape probe (no FLOPs)
+        x_probe = jax.eval_shape(
+            lambda fp, d: first_fn(fp, d), first_params, data_micro[0]
+        )
+        x_shape, x_dtype = x_probe.shape, x_probe.dtype
+
+        def tick(carry, t):
+            fwd_in, bwd_in, resid, gacc, facc, lacc, loss_acc = carry
+            f = t - idx  # this stage's forward microbatch
+            b = t - (2 * n - 2 - idx)  # this stage's backward microbatch
+            valid_f = (f >= 0) & (f < m_total)
+            valid_b = (b >= 0) & (b < m_total)
+            slot_f = lax.rem(jnp.clip(f, 0, m_total - 1), w)
+            slot_b = lax.rem(jnp.clip(b, 0, m_total - 1), w)
+
+            # ---- one forward op (stage 0 embeds its microbatch; the
+            # embed is cheap enough to recompute rather than carry)
+            x_in = jnp.where(
+                idx == 0,
+                first_fn(first_params, _data_at(data_micro, f)),
+                fwd_in,
+            )
+            y = stage_fn(params, x_in)
+            # save the stage INPUT (backward recomputes from it); only
+            # while valid — a drain-tick write could clobber a residual
+            # whose backward has not run yet
+            resid = jnp.where(
+                valid_f,
+                lax.dynamic_update_index_in_dim(resid, x_in, slot_f, 0),
+                resid,
+            )
+
+            # ---- last stage: this tick's fwd micro IS its bwd micro
+            # (f == b there) — loss + seed gradient via the head's VJP
+            def head(args):
+                y_, tgt_ = args
+                lv, vjp = jax.vjp(
+                    lambda lp, yy: last_fn(lp, yy, tgt_), last_params, y_
+                )
+                # seed must carry the loss's varying-manual-axes type
+                dlp, dy_ = vjp(
+                    _pvary(jnp.asarray(inv_m, jnp.float32), axis_name)
+                )
+                return lv, dlp, dy_
+
+            def no_head(args):
+                return (
+                    _pvary(jnp.zeros((), jnp.float32), axis_name),
+                    _zeros_varying(last_params),
+                    _pvary(jnp.zeros(x_shape, x_dtype), axis_name),
+                )
+
+            is_last = idx == n - 1
+            lv, dlp, dy = lax.cond(
+                is_last & valid_b, head, no_head,
+                (y, _data_at(tgt_micro, b)),
+            )
+            loss_acc = loss_acc + lv
+            lacc = jax.tree.map(jnp.add, lacc, dlp)
+
+            # ---- one backward op (remat from the saved input)
+            g_in = jnp.where(is_last, dy, bwd_in)
+            x_saved = lax.dynamic_index_in_dim(resid, slot_b, 0,
+                                               keepdims=False)
+
+            def do_bwd(args):
+                xs, gi = args
+                _, vjp = jax.vjp(stage_fn, params, xs)
+                return vjp(gi)
+
+            def no_bwd(args):
+                return (
+                    _zeros_varying(params),
+                    _pvary(jnp.zeros(x_shape, x_dtype), axis_name),
+                )
+
+            dp, dx = lax.cond(valid_b, do_bwd, no_bwd, (x_saved, g_in))
+            gacc = jax.tree.map(jnp.add, gacc, dp)
+
+            # ---- stage 0: dx is the embedding-output gradient for
+            # micro b — fold it into the first_fn parameter grads NOW
+            # (an embed-param-sized accumulator, not an O(n_micro)
+            # activation buffer)
+            def do_first(args):
+                d_b, dxv = args
+                _, vjp = jax.vjp(
+                    lambda fp: first_fn(fp, d_b), first_params
+                )
+                (dfp,) = vjp(dxv)
+                return dfp
+
+            def no_first(args):
+                return _zeros_varying(first_params)
+
+            dfp = lax.cond(
+                valid_b & (idx == 0), do_first, no_first,
+                (_data_at(data_micro, b), dx),
+            )
+            facc = jax.tree.map(jnp.add, facc, dfp)
+
+            fwd_next = lax.ppermute(y, axis_name, fwd_perm)
+            bwd_next = lax.ppermute(dx, axis_name, bwd_perm)
+            return (
+                fwd_next, bwd_next, resid, gacc, facc, lacc, loss_acc
+            ), None
+
+        zeros_x = _pvary(jnp.zeros(x_shape, x_dtype), axis_name)
+        carry0 = (
+            zeros_x,
+            zeros_x,
+            _pvary(jnp.zeros((w, *x_shape), x_dtype), axis_name),
+            _zeros_varying(params),
+            _zeros_varying(first_params),
+            _zeros_varying(last_params),
+            _pvary(jnp.zeros((), jnp.float32), axis_name),
+        )
+        (_, _, _, gacc, facc, lacc, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(ticks)
+        )
+        # loss/lacc live on the last stage, facc on stage 0; zeros
+        # elsewhere, so a plain psum replicates them
+        loss_mean = lax.psum(loss_acc, axis_name) * inv_m
+        first_grads = jax.tree.map(
+            lambda g: lax.psum(g, axis_name), facc
+        )
+        last_grads = jax.tree.map(
+            lambda g: lax.psum(g, axis_name), lacc
+        )
+        stage_grads = jax.tree.map(lambda g: g[None], gacc)
+        return loss_mean, stage_grads, first_grads, last_grads
+
+    return run
+
+
 def from_last_stage(x, axis_name: str = PIPE_AXIS):
     """Replicate a value held by the last pipeline stage to all stages
     (psum of a one-hot mask — a single small collective)."""

@@ -1,5 +1,6 @@
 from tpuflow.train.trainer import Trainer  # noqa: F401
 from tpuflow.train.lm import LMTrainer  # noqa: F401
+from tpuflow.train.pipeline_trainer import PipelineTrainer  # noqa: F401
 from tpuflow.train.state import TrainState  # noqa: F401
 from tpuflow.train.lr import LRController  # noqa: F401
 from tpuflow.train.callbacks import (  # noqa: F401

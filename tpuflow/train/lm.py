@@ -47,6 +47,7 @@ from tpuflow.ckpt.checkpoint import (
 )
 from tpuflow.core.config import TrainConfig
 from tpuflow.core.dist import is_primary
+from tpuflow.data.tokens import TokenDataset
 from tpuflow.models.transformer import TransformerLM, next_token_loss
 from tpuflow.parallel.mesh import DATA_AXIS, MODEL_AXIS, build_nd_mesh
 from tpuflow.train.lr import LRController
@@ -404,7 +405,7 @@ class LMTrainer:
 
     def fit(
         self,
-        train_tokens: np.ndarray,
+        train_tokens: "np.ndarray | TokenDataset",
         batch_size: int,
         epochs: Optional[int] = None,
         val_tokens: Optional[np.ndarray] = None,
@@ -413,9 +414,13 @@ class LMTrainer:
         initial_epoch: Optional[int] = None,
         on_epoch: Optional[Callable[[int, Dict[str, float]], None]] = None,
     ) -> Dict[str, float]:
-        """Train on ``(N, seq_len)`` int32 token rows; returns the final
-        epoch's metrics. Deterministic per-epoch shuffle (seeded by
-        config.seed + epoch, so resume replays the right order).
+        """Train on ``(N, seq_len)`` int32 token rows — either in-memory
+        (a numpy array) or streamed from disk (a
+        :class:`tpuflow.data.tokens.TokenDataset`, the beyond-host-RAM
+        path: O(shuffle buffer) RSS regardless of corpus size). Returns
+        the final epoch's metrics. Deterministic per-epoch shuffle
+        (seeded by config.seed + epoch, so resume replays the right
+        order; the TokenDataset seeds its stream the same way).
 
         ``initial_epoch`` defaults to the epoch recorded by the last
         :meth:`maybe_resume` — consumed ONCE, so a later fit() on the
@@ -435,9 +440,45 @@ class LMTrainer:
             else self._initial_epoch
         )
         self._initial_epoch = 0  # consume-once (see docstring)
-        n = int(train_tokens.shape[0])
         b_local, proc = self._local_slice(batch_size)
-        steps_per_epoch = max(1, n // int(batch_size))
+        ds = train_tokens if isinstance(train_tokens, TokenDataset) else None
+        if ds is not None:
+            if ds.batch_rows != b_local or (
+                ds.shard_count != jax.process_count()
+            ):
+                raise ValueError(
+                    f"TokenDataset(batch_rows={ds.batch_rows}, "
+                    f"shard_count={ds.shard_count}) does not match this "
+                    f"topology: need batch_rows={b_local} "
+                    f"(batch_size {batch_size} / "
+                    f"{jax.process_count()} processes) and "
+                    f"shard_count={jax.process_count()}"
+                )
+            if ds.cur_shard != jax.process_index():
+                # an explicit shard=(0, n) copied onto every host would
+                # pass the count check yet stream IDENTICAL rows on all
+                # ranks — duplicated batches, most of the corpus unseen
+                raise ValueError(
+                    f"TokenDataset.cur_shard={ds.cur_shard} but this is "
+                    f"process {jax.process_index()}; use shard=None "
+                    "(auto) or shard=(process_index, process_count)"
+                )
+            n = ds.total_rows
+            steps_per_epoch = ds.steps_per_epoch()
+            seq_len = ds.seq_len
+        else:
+            n = int(train_tokens.shape[0])
+            if n < batch_size:
+                # fail loudly up front: a short row set would floor
+                # steps_per_epoch to an undersized batch, and in
+                # multi-process DP the per-process slices can be unequal
+                # or empty — a confusing mid-fit _put error
+                raise ValueError(
+                    f"train_tokens has {n} rows < batch_size={batch_size}; "
+                    "provide at least one full global batch"
+                )
+            steps_per_epoch = max(1, n // int(batch_size))
+            seq_len = int(train_tokens.shape[1])
         self.lr_controller = LRController(
             cfg.learning_rate,
             world_size=self.world,
@@ -448,7 +489,17 @@ class LMTrainer:
         if start >= epochs:
             # nothing left to train — report eval metrics of the
             # restored state rather than an empty dict
-            metrics = self.evaluate(train_tokens, batch_size)
+            if ds is not None:
+                # evaluate over one deterministic epoch of the stream
+                # (evaluate()'s array slicing does not apply)
+                losses = [
+                    self._eval_step(self.state, self._put(b))["loss"]
+                    for b in ds.iter_epoch(start)
+                ]
+                loss = float(jnp.mean(jnp.stack(losses)))
+                metrics = {"loss": loss, "ppl": self._ppl(loss)}
+            else:
+                metrics = self.evaluate(train_tokens, batch_size)
             if val_tokens is not None:
                 vl = self._eval_mean_loss(val_tokens, batch_size)
                 if vl is not None:
@@ -457,24 +508,32 @@ class LMTrainer:
             return metrics
         metrics: Dict[str, float] = {}
         global_step = start * steps_per_epoch
-        seq_len = int(train_tokens.shape[1])
         # shapes are fixed within one fit but not across fits — stale
         # FLOPs (or a stale AOT executable) from a previous fit's
         # shapes would corrupt MFU / fail on call
         self._flops_per_step = None
         self._step_exec = None
         for epoch in range(start, epochs):
-            order = np.random.default_rng(cfg.seed + epoch).permutation(n)
+            if ds is not None:
+                batch_iter = ds.iter_epoch(epoch)
+            else:
+                order = np.random.default_rng(cfg.seed + epoch).permutation(n)
             losses = []
             t_epoch = None
             timed_steps = 0
             for i in range(steps_per_epoch):
-                # the shuffle order is seed-deterministic, so every
-                # process slices the SAME global batch and takes its own
-                # contiguous rows (≙ cur_shard=rank, P1/03:332-337)
-                rows = order[i * batch_size : (i + 1) * batch_size]
-                rows = rows[proc * b_local : (proc + 1) * b_local]
-                toks = self._put(train_tokens[rows])
+                if ds is not None:
+                    # shard-disjoint stream: this process's slice comes
+                    # from its own round-robin rows (≙ cur_shard=rank)
+                    local_rows = next(batch_iter)
+                else:
+                    # the shuffle order is seed-deterministic, so every
+                    # process slices the SAME global batch and takes its
+                    # own contiguous rows (≙ cur_shard=rank, P1/03:332-337)
+                    rows = order[i * batch_size : (i + 1) * batch_size]
+                    rows = rows[proc * b_local : (proc + 1) * b_local]
+                    local_rows = train_tokens[rows]
+                toks = self._put(local_rows)
                 lr = self.lr_controller.lr_for_step(global_step)
                 lr_arr = jnp.asarray(lr, jnp.float32)
                 if self._step_exec is None:
@@ -511,12 +570,20 @@ class LMTrainer:
                 step_s = epoch_s / timed_steps
                 metrics["tokens_per_sec"] = batch_size * seq_len / step_s
                 if self._flops_per_step:
+                    from tpuflow.core.hw import is_tpu_backend
                     from tpuflow.obs.mfu import mfu as _mfu
 
-                    # n_chips=1: cost analysis already reported the
-                    # per-device share of the sharded step
+                    # n_chips=1: on TPU, cost analysis reports the
+                    # PER-DEVICE share of the SPMD-partitioned step. On
+                    # other backends (the CPU host-device meshes of the
+                    # test suite) it can report WHOLE-PROGRAM flops —
+                    # divide by mesh size there so the logged mfu is not
+                    # inflated by the device count (ADVICE r2).
+                    fl = self._flops_per_step
+                    if not is_tpu_backend():
+                        fl /= max(1, self.mesh.size)
                     metrics["mfu"] = _mfu(
-                        self._flops_per_step, step_s, n_chips=1,
+                        fl, step_s, n_chips=1,
                         device=self.mesh.devices.flat[0],
                     )
             if val_tokens is not None:
