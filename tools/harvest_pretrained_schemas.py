@@ -1,0 +1,127 @@
+"""Harvest real pretrained-checkpoint SCHEMAS into committed manifests.
+
+VERDICT r2 #8: the converters in tpuflow.models.pretrained were only
+ever exercised against synthetic checkpoints shaped by the same code
+that converts them — circular. This tool pins the REAL schemas:
+
+- **Keras MobileNetV2**: harvested LIVE from
+  ``keras.applications.MobileNetV2(include_top=False)`` (the actual
+  reference architecture, P1/02:164-169) — every variable path + shape,
+  in layer order. Keras is in this container, so the manifest is the
+  genuine article, not a transcription.
+- **torchvision resnet18/50**: torchvision is NOT installed here, so
+  the manifest is generated from torchvision's documented, decade-
+  stable resnet state_dict grammar (conv1/bn1, layer{1-4}.{b}.conv{n}/
+  bn{n}, downsample.{0,1}, fc) with shapes derived from the
+  architecture. The generation rule is in this file for audit.
+
+The manifests live in tests/fixtures/ and are used by
+tests/test_pretrained_schema.py to build bit-exact fixture checkpoints
+(legacy-format .h5 / torch .pth) and validate the converters against
+them; the live-Keras test additionally re-harvests and asserts the
+committed manifest still matches the installed reference architecture.
+
+Usage: python tools/harvest_pretrained_schemas.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures",
+)
+
+
+def keras_mnv2_manifest():
+    """[(variable_path, shape), ...] from the live reference model.
+    Variable paths are '<layer>/<weight>' (e.g. 'Conv1/kernel',
+    'bn_Conv1/gamma') — the grammar of the legacy .h5 layout real
+    downloadable checkpoints use."""
+    import keras
+
+    m = keras.applications.MobileNetV2(
+        include_top=False, weights=None, input_shape=(224, 224, 3)
+    )
+    out = []
+    for layer in m.layers:
+        for v in layer.weights:
+            path = getattr(v, "path", None) or v.name
+            out.append([str(path), list(v.shape)])
+    return out
+
+
+def torchvision_resnet_manifest(depth: int = 18):
+    """torchvision resnet state_dict key → shape, generated from the
+    architecture. Ground truth being encoded: conv weights are
+    (out, in, kh, kw); BN tensors weight/bias/running_mean/running_var
+    are (C,) plus a scalar int64 num_batches_tracked; stage L block 0
+    has a 1x1 downsample iff stride 2 or a channel change; the head is
+    fc.{weight,bias} at 1000 classes."""
+    repeats = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3)}[depth]
+    bottleneck = depth >= 50
+    widths = (64, 128, 256, 512)
+    out = {}
+
+    def bn(key, c):
+        out[f"{key}.weight"] = [c]
+        out[f"{key}.bias"] = [c]
+        out[f"{key}.running_mean"] = [c]
+        out[f"{key}.running_var"] = [c]
+        out[f"{key}.num_batches_tracked"] = []
+
+    out["conv1.weight"] = [64, 3, 7, 7]
+    bn("bn1", 64)
+    in_c = 64
+    for si, (w, n) in enumerate(zip(widths, repeats)):
+        out_c = w * (4 if bottleneck else 1)
+        for bi in range(n):
+            base = f"layer{si + 1}.{bi}"
+            if bottleneck:
+                out[f"{base}.conv1.weight"] = [w, in_c, 1, 1]
+                bn(f"{base}.bn1", w)
+                out[f"{base}.conv2.weight"] = [w, w, 3, 3]
+                bn(f"{base}.bn2", w)
+                out[f"{base}.conv3.weight"] = [out_c, w, 1, 1]
+                bn(f"{base}.bn3", out_c)
+            else:
+                out[f"{base}.conv1.weight"] = [w, in_c, 3, 3]
+                bn(f"{base}.bn1", w)
+                out[f"{base}.conv2.weight"] = [w, w, 3, 3]
+                bn(f"{base}.bn2", w)
+            if bi == 0 and (si > 0 or in_c != out_c):
+                out[f"{base}.downsample.0.weight"] = [out_c, in_c, 1, 1]
+                bn(f"{base}.downsample.1", out_c)
+            in_c = out_c
+    out["fc.weight"] = [1000, widths[-1] * (4 if bottleneck else 1)]
+    out["fc.bias"] = [1000]
+    return out
+
+
+def main() -> int:
+    os.makedirs(FIXTURES, exist_ok=True)
+    wrote = []
+    for depth in (18, 50):
+        path = os.path.join(FIXTURES, f"torchvision_resnet{depth}_manifest.json")
+        with open(path, "w") as f:
+            json.dump(torchvision_resnet_manifest(depth), f, indent=0)
+        wrote.append(path)
+    try:
+        man = keras_mnv2_manifest()
+        path = os.path.join(FIXTURES, "keras_mnv2_manifest.json")
+        with open(path, "w") as f:
+            json.dump(man, f, indent=0)
+        wrote.append(path)
+    except ImportError:
+        print("keras not installed; skipping live MobileNetV2 harvest",
+              file=sys.stderr)
+    for p in wrote:
+        print(f"wrote {p}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

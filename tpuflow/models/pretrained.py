@@ -250,9 +250,56 @@ def convert_keras_h5(path: str) -> Dict[str, np.ndarray]:
     return out
 
 
+_RESNET_REPEATS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3)}
+
+
+def convert_torchvision_resnet_state_dict(
+    sd: Dict[str, Any], depth: int = 18
+) -> Dict[str, np.ndarray]:
+    """torchvision ``resnet{18,34,50}`` state_dict → canonical flat dict
+    for tpuflow.models.resnet.ResNet (same layout rules as the
+    MobileNetV2 converter; torchvision resnet key grammar:
+    ``conv1/bn1``, ``layer{1..4}.{b}.conv{1..3}/bn{1..3}``,
+    ``layer{L}.0.downsample.{0,1}``; the classifier ``fc.*`` and BN
+    ``num_batches_tracked`` bookkeeping are skipped — the backbone is
+    the ``include_top=False`` form)."""
+    if depth not in _RESNET_REPEATS:
+        raise ValueError(f"depth must be one of {sorted(_RESNET_REPEATS)}")
+
+    def arr(name):
+        t = sd[name]
+        return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+    out: Dict[str, np.ndarray] = {}
+
+    def conv_bn(dst: str, conv_key: str, bn_key: str) -> None:
+        out[f"params/{dst}/conv/kernel"] = np.transpose(
+            arr(f"{conv_key}.weight"), (2, 3, 1, 0)
+        )
+        out[f"params/{dst}/bn/scale"] = arr(f"{bn_key}.weight")
+        out[f"params/{dst}/bn/bias"] = arr(f"{bn_key}.bias")
+        out[f"batch_stats/{dst}/bn/mean"] = arr(f"{bn_key}.running_mean")
+        out[f"batch_stats/{dst}/bn/var"] = arr(f"{bn_key}.running_var")
+
+    conv_bn("stem", "conv1", "bn1")
+    n_convs = 2 if depth in (18, 34) else 3
+    for si, n_blocks in enumerate(_RESNET_REPEATS[depth]):
+        for bi in range(n_blocks):
+            base = f"layer{si + 1}.{bi}"
+            dst = f"stage{si}_block{bi}"
+            for ci in range(1, n_convs + 1):
+                conv_bn(f"{dst}/conv{ci}", f"{base}.conv{ci}",
+                        f"{base}.bn{ci}")
+            if f"{base}.downsample.0.weight" in sd:
+                conv_bn(f"{dst}/down", f"{base}.downsample.0",
+                        f"{base}.downsample.1")
+    return out
+
+
 def convert(src: str, dst: str) -> None:
-    """Convert a torchvision ``.pth``/``.pt`` or Keras ``.h5``
-    MobileNetV2 checkpoint into the canonical npz at ``dst``."""
+    """Convert a torchvision ``.pth``/``.pt`` (MobileNetV2 or
+    ResNet-18/34/50, auto-detected from the key grammar) or Keras
+    ``.h5`` MobileNetV2 checkpoint into the canonical npz at ``dst``."""
     if src.endswith((".h5", ".hdf5")):
         flat = convert_keras_h5(src)
     else:
@@ -260,7 +307,28 @@ def convert(src: str, dst: str) -> None:
 
         obj = torch.load(src, map_location="cpu", weights_only=True)
         sd = obj.get("state_dict", obj) if isinstance(obj, dict) else obj
-        flat = convert_torchvision_state_dict(sd)
+        if "layer1.0.conv1.weight" in sd:  # torchvision resnet grammar
+            counts = tuple(
+                len({k.split(".")[1] for k in sd
+                     if k.startswith(f"layer{i}.")})
+                for i in (1, 2, 3, 4)
+            )
+            has_conv3 = "layer1.0.conv3.weight" in sd
+            by_sig = {((2, 2, 2, 2), False): 18, ((3, 4, 6, 3), False): 34,
+                      ((3, 4, 6, 3), True): 50}
+            depth = by_sig.get((counts, has_conv3))
+            if depth is None:
+                # e.g. resnet101 (3,4,23,3): all resnet50 keys EXIST, so
+                # a prefix conversion would silently drop blocks — fail
+                # loudly instead
+                raise ValueError(
+                    f"unsupported torchvision resnet variant: stage "
+                    f"block counts {counts}, bottleneck={has_conv3} "
+                    "(supported: resnet18/34/50)"
+                )
+            flat = convert_torchvision_resnet_state_dict(sd, depth)
+        else:
+            flat = convert_torchvision_state_dict(sd)
     np.savez(dst, **flat)
 
 

@@ -2,6 +2,7 @@ from tpuflow.tune.space import hp  # noqa: F401
 from tpuflow.tune.fmin import fmin, STATUS_OK  # noqa: F401
 from tpuflow.tune.trials import (  # noqa: F401
     ParallelTrials,
+    ProcessTrials,
     STATUS_PRUNED,
     Trials,
 )
