@@ -37,6 +37,7 @@ def test_examples_import():
         "08_long_context_lm",
         "09_lm_pipeline",
         "10_pipeline_lm",
+        "11_pipeline_trainer_streaming",
     ]:
         assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
 
@@ -112,3 +113,17 @@ def test_pipeline_lm_example():
     assert r.returncode == 0, r.stderr[-2000:]
     assert "forward parity with the unpipelined model: OK" in r.stdout
     assert "gpipe LM training OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_pipeline_trainer_streaming_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_EXAMPLES, "11_pipeline_trainer_streaming.py")],
+        env=env, capture_output=True, text=True, timeout=1200,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "pipeline-trainer streaming example OK" in r.stdout

@@ -99,8 +99,13 @@ def pipeline(
             state = lax.ppermute(y, axis_name, fwd_perm)
             return (state, outbuf), None
 
-        state0 = _pvary(jnp.zeros(x.shape[1:], x.dtype), axis_name)
-        out0 = _pvary(jnp.zeros_like(x), axis_name)
+        # the carry must vary over the pipe axis AND any axes the input
+        # already varies over (e.g. 'data' under DP x PP row sharding)
+        axes = tuple(
+            getattr(jax.typeof(x), "vma", frozenset()) | {axis_name}
+        )
+        state0 = _pvary(jnp.zeros(x.shape[1:], x.dtype), axes)
+        out0 = _pvary(jnp.zeros_like(x), axes)
         (_, outbuf), _ = lax.scan(tick, (state0, out0), jnp.arange(ticks))
         return outbuf
 
@@ -152,17 +157,29 @@ def pipeline_1f1b(
 
     def run(stacked_params, first_params, last_params, data_micro,
             tgt_micro):
-        params = jax.tree.map(lambda a: a[0], stacked_params)
-        # tag the replicated first/head params as pipe-varying up
-        # front: the VJPs inside the per-stage conds must be pure
-        # per-device math (a VJP w.r.t. an UNVARYING operand would make
-        # the type system insert a psum over the axis — a collective
-        # inside a conditionally-executed branch)
+        # every value in the schedule varies over the pipe axis AND any
+        # axes the microbatch data already varies over (e.g. 'data'
+        # under DP x PP row sharding)
+        axes = tuple(
+            getattr(jax.typeof(data_micro), "vma", frozenset())
+            | {axis_name}
+        )
+        # stage params too: they are pipe-sharded but replicated over
+        # any data axis, and their VJP must stay per-device math (the
+        # caller mean-reduces the returned grads across replicas)
+        params = jax.tree.map(
+            lambda a: _pvary(a[0], axes), stacked_params
+        )
+        # tag the replicated first/head params as varying up front: the
+        # VJPs inside the per-stage conds must be pure per-device math
+        # (a VJP w.r.t. an UNVARYING operand would make the type system
+        # insert a psum over the axis — a collective inside a
+        # conditionally-executed branch)
         first_params = jax.tree.map(
-            lambda p: _pvary(p, axis_name), first_params
+            lambda p: _pvary(p, axes), first_params
         )
         last_params = jax.tree.map(
-            lambda p: _pvary(p, axis_name), last_params
+            lambda p: _pvary(p, axes), last_params
         )
         idx = lax.axis_index(axis_name)
         n = lax.axis_size(axis_name)
@@ -180,7 +197,7 @@ def pipeline_1f1b(
 
         def _zeros_varying(tree):
             return jax.tree.map(
-                lambda p: _pvary(jnp.zeros_like(p), axis_name), tree
+                lambda p: _pvary(jnp.zeros_like(p), axes), tree
             )
 
         def _data_at(buf, i):
@@ -229,15 +246,15 @@ def pipeline_1f1b(
                 )
                 # seed must carry the loss's varying-manual-axes type
                 dlp, dy_ = vjp(
-                    _pvary(jnp.asarray(inv_m, jnp.float32), axis_name)
+                    _pvary(jnp.asarray(inv_m, jnp.float32), axes)
                 )
                 return lv, dlp, dy_
 
             def no_head(args):
                 return (
-                    _pvary(jnp.zeros((), jnp.float32), axis_name),
+                    _pvary(jnp.zeros((), jnp.float32), axes),
                     _zeros_varying(last_params),
-                    _pvary(jnp.zeros(x_shape, x_dtype), axis_name),
+                    _pvary(jnp.zeros(x_shape, x_dtype), axes),
                 )
 
             is_last = idx == n - 1
@@ -261,7 +278,7 @@ def pipeline_1f1b(
             def no_bwd(args):
                 return (
                     _zeros_varying(params),
-                    _pvary(jnp.zeros(x_shape, x_dtype), axis_name),
+                    _pvary(jnp.zeros(x_shape, x_dtype), axes),
                 )
 
             dp, dx = lax.cond(valid_b, do_bwd, no_bwd, (x_saved, g_in))
@@ -294,15 +311,15 @@ def pipeline_1f1b(
                 fwd_next, bwd_next, resid, gacc, facc, lacc, loss_acc
             ), None
 
-        zeros_x = _pvary(jnp.zeros(x_shape, x_dtype), axis_name)
+        zeros_x = _pvary(jnp.zeros(x_shape, x_dtype), axes)
         carry0 = (
             zeros_x,
             zeros_x,
-            _pvary(jnp.zeros((w, *x_shape), x_dtype), axis_name),
+            _pvary(jnp.zeros((w, *x_shape), x_dtype), axes),
             _zeros_varying(params),
             _zeros_varying(first_params),
             _zeros_varying(last_params),
-            _pvary(jnp.zeros((), jnp.float32), axis_name),
+            _pvary(jnp.zeros((), jnp.float32), axes),
         )
         (_, _, _, gacc, facc, lacc, loss_acc), _ = lax.scan(
             tick, carry0, jnp.arange(ticks)

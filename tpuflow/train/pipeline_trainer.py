@@ -66,8 +66,14 @@ class PipelineTrainer(LMTrainer):
     """Pipeline-parallel LM trainer (GPipe or 1F1B microbatch schedule).
 
     ``mesh`` must carry a ``pipe`` axis (default: a 1-D pipe mesh over
-    all local devices). ``batch_size`` in :meth:`fit` is global and
-    must divide by ``n_microbatches``.
+    all local devices) and may additionally carry a ``data`` axis for
+    DP x PP: microbatch ROWS are sharded over ``data`` while stages
+    are laid over ``pipe`` — each data replica runs the full microbatch
+    schedule on its slice and gradients are mean-reduced across
+    replicas (GPipe: by shard_map's autodiff transpose; 1F1B: an
+    explicit pmean after the schedule). ``batch_size`` in :meth:`fit`
+    is global and must divide by ``n_microbatches`` x the data-axis
+    size.
     """
 
     def __init__(
@@ -112,9 +118,17 @@ class PipelineTrainer(LMTrainer):
         self.blocks_per_stage = model.depth // n_stages
         self.n_microbatches = n_microbatches
         self.schedule = schedule
+        # data-parallel degree (1 = pure PP); self.world from LMTrainer
+        # already reads the data axis, so LR x world scaling Just Works
+        self.dp = self.world
 
-    # tokens are replicated over the pipe axis (stage 0 ingests them)
+    # token rows shard over 'data' (if present) and replicate over
+    # 'pipe' (stage 0 ingests them)
     def _token_spec(self):
+        from tpuflow.parallel.mesh import DATA_AXIS
+
+        if DATA_AXIS in self.mesh.axis_names:
+            return P(DATA_AXIS)
         return P()
 
     # ---- state -----------------------------------------------------------
@@ -187,22 +201,41 @@ class PipelineTrainer(LMTrainer):
         y = RMSNorm(self.model.dtype).apply({"params": norm_params}, y)
         return y.astype(jnp.float32) @ head_kernel
 
+    def _check_micro(self, tokens) -> None:
+        mb = tokens.shape[0] // self.n_microbatches
+        if tokens.shape[0] % self.n_microbatches or (
+            self.dp > 1 and mb % self.dp
+        ):
+            raise ValueError(
+                f"batch {tokens.shape[0]} must split into "
+                f"{self.n_microbatches} microbatches of rows divisible "
+                f"by the data-axis size {self.dp}"
+            )
+
     def _make_steps(self) -> None:
+        from tpuflow.parallel.mesh import DATA_AXIS
+
         model = self.model
         mesh = self.mesh
         mm = self.n_microbatches
+        dp = self.dp
+        has_data = DATA_AXIS in mesh.axis_names
+        # microbatch buffers: (n_micro, rows, ...) — rows shard over
+        # 'data' in DP x PP, stages always over 'pipe'
+        micro_spec = P(None, DATA_AXIS) if has_data else P()
         stage_fn = self._stage_fn()
         run_fwd = pipeline(stage_fn, mm, PIPE_AXIS)
 
         def forward(params, tokens):
+            self._check_micro(tokens)
             outer, stages = params["outer"], params["stages"]
             x = jnp.take(outer["embed"], tokens, axis=0).astype(model.dtype)
             micro = split_microbatches(x, mm)
             piped = shard_map(
                 lambda sb, mi: from_last_stage(run_fwd(sb, mi), PIPE_AXIS),
                 mesh=mesh,
-                in_specs=(P(PIPE_AXIS), P()),
-                out_specs=P(),
+                in_specs=(P(PIPE_AXIS), micro_spec),
+                out_specs=micro_spec,
             )
             y = piped(stages, micro).reshape(x.shape)
             return self._head(
@@ -248,7 +281,33 @@ class PipelineTrainer(LMTrainer):
                 first_fn, stage_fn, last_fn, mm, PIPE_AXIS
             )
 
+            def run_wrapped(stages, embed, last_params, dm, tm):
+                # gate on the AXIS EXISTING, not dp > 1: a size-1 data
+                # axis still makes dm/tm (and so every schedule value)
+                # data-varying, which the replicated out_specs reject
+                # unless the pmean strips the vma
+                if has_data:
+                    # per-device math over data-sharded microbatch rows:
+                    # tag the replicated params data-varying up front
+                    # (same reasoning as pipeline_1f1b's pipe pvary),
+                    # then mean-reduce the per-replica grads/loss
+                    from tpuflow.parallel.collectives import pvary
+
+                    embed = pvary(embed, DATA_AXIS)
+                    last_params = jax.tree.map(
+                        lambda p: pvary(p, DATA_AXIS), last_params
+                    )
+                out = run_1f1b(stages, embed, last_params, dm, tm)
+                if has_data:
+                    from jax import lax
+
+                    out = jax.tree.map(
+                        lambda g: lax.pmean(g, DATA_AXIS), out
+                    )
+                return out
+
             def train_step(state: TrainState, tokens, lr):
+                self._check_micro(tokens)
                 outer = state.params["outer"]
                 stages = state.params["stages"]
                 tok_micro = split_microbatches(tokens, mm)
@@ -257,9 +316,10 @@ class PipelineTrainer(LMTrainer):
                     "lm_head": outer["lm_head"],
                 }
                 piped = shard_map(
-                    run_1f1b,
+                    run_wrapped,
                     mesh=mesh,
-                    in_specs=(P(PIPE_AXIS), P(), P(), P(), P()),
+                    in_specs=(P(PIPE_AXIS), P(), P(),
+                              micro_spec, micro_spec),
                     out_specs=(P(), P(PIPE_AXIS), P(), P()),
                 )
                 # tokens are both the pipeline input (embedded at stage

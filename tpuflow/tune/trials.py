@@ -164,10 +164,15 @@ def _child_main(conn, fn_bytes: bytes, params: Dict[str, Any],
                 if not has_pruner:
                     return
                 conn.send(("report", int(step), float(value)))
-                if conn.recv() == "prune":
+                reply = conn.recv()
+                if reply == "prune":
                     from tpuflow.tune.pruning import Pruned
 
                     raise Pruned(step=int(step), best_value=float(value))
+                if isinstance(reply, tuple) and reply[0] == "fail":
+                    # the parent-side pruner itself blew up — a FAILED
+                    # trial, not a pruned one
+                    raise RuntimeError(f"pruner error: {reply[1]}")
 
             kw["report"] = report if has_pruner else None
         outcome = _safe_call(fn, params, **kw)
@@ -257,41 +262,26 @@ class ProcessTrials(Trials):
         takes_report = _takes_report(fn)
         ctx = mp.get_context("spawn")  # never fork a jax-initialized parent
         results: List[Optional[TrialResult]] = [None] * len(batch)
+        # slots hand out device groups: a FREE-SLOT QUEUE, not i %
+        # parallelism — with len(batch) > parallelism and uneven trial
+        # durations the modulo scheme could run two live children on
+        # the same device group / child_env target
+        import queue as _queue
+
+        free_slots: "_queue.Queue[int]" = _queue.Queue()
+        for s in range(self.parallelism):
+            free_slots.put(s)
 
         def one(i: int, params):
             tid = start_tid + i
-            slot = i % self.parallelism
-            parent_conn, child_conn = ctx.Pipe()
-            proc = ctx.Process(
-                target=_child_main,
-                args=(child_conn, fn_bytes, params,
-                      self.device_groups[slot], self._env_for(slot),
-                      takes_devices, takes_report, pruner is not None),
-                daemon=True,
-            )
-            proc.start()
-            child_conn.close()
-            outcome: Any = {
-                "loss": float("inf"), "status": STATUS_FAIL,
-                "error": "trial process died without reporting",
-            }
+            slot = free_slots.get()
             try:
-                while True:
-                    msg = parent_conn.recv()
-                    if msg[0] == "done":
-                        outcome = msg[1]
-                        break
-                    _, step, value = msg  # "report"
-                    try:
-                        pruner.report(tid, step, value)
-                        parent_conn.send("ok")
-                    except Exception:  # Pruned → tell the child to stop
-                        parent_conn.send("prune")
-            except EOFError:
-                pass  # child died: keep the default failure outcome
+                outcome = self._run_child(
+                    ctx, tid, params, slot, fn_bytes,
+                    takes_devices, takes_report, pruner,
+                )
             finally:
-                proc.join()
-                parent_conn.close()
+                free_slots.put(slot)
             results[i] = self.record(tid, params, outcome)
             _settle_pruner(pruner, tid, results[i].status)
 
@@ -302,6 +292,48 @@ class ProcessTrials(Trials):
             for f in futs:
                 f.result()
         return [r for r in results if r is not None]
+
+    def _run_child(self, ctx, tid, params, slot, fn_bytes,
+                   takes_devices, takes_report, pruner):
+        """Spawn one trial child on ``slot``'s device group and service
+        its pipe until it reports done (or dies). Returns the outcome."""
+        from tpuflow.tune.pruning import Pruned
+
+        parent_conn, child_conn = ctx.Pipe()
+        proc = ctx.Process(
+            target=_child_main,
+            args=(child_conn, fn_bytes, params,
+                  self.device_groups[slot], self._env_for(slot),
+                  takes_devices, takes_report, pruner is not None),
+            daemon=True,
+        )
+        proc.start()
+        child_conn.close()
+        outcome: Any = {
+            "loss": float("inf"), "status": STATUS_FAIL,
+            "error": "trial process died without reporting",
+        }
+        try:
+            while True:
+                msg = parent_conn.recv()
+                if msg[0] == "done":
+                    outcome = msg[1]
+                    break
+                _, step, value = msg  # "report"
+                try:
+                    pruner.report(tid, step, value)
+                    parent_conn.send("ok")
+                except Pruned:  # → tell the child to stop cleanly
+                    parent_conn.send("prune")
+                except Exception as e:
+                    # pruner BUG → failed trial, not a silent mass-prune
+                    parent_conn.send(("fail", f"{type(e).__name__}: {e}"))
+        except EOFError:
+            pass  # child died: keep the default failure outcome
+        finally:
+            proc.join()
+            parent_conn.close()
+        return outcome
 
 
 def _takes_report(fn) -> bool:
