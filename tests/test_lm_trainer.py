@@ -344,3 +344,36 @@ def test_lm_label_smoothing_applies_to_training_only():
     m = tr.fit(_corpus(16, 16), batch_size=8, epochs=1,
                val_tokens=_corpus(8, 16, seed=9))
     assert np.isfinite(m["loss"]) and np.isfinite(m["val_loss"])
+
+
+def test_lm_trainer_striped_sp_matches_plain_model():
+    """sp_layout='striped' (balanced causal ring): the trainer permutes
+    tokens to the round-robin layout and unpermutes logits, so the loss
+    must equal the unsharded run exactly — layout is a schedule choice,
+    not a math change."""
+    import jax.numpy as jnp
+
+    mesh = build_nd_mesh({"data": 2, "seq": 2}, devices=jax.devices()[:4])
+    cfg = TrainConfig(optimizer="sgd", learning_rate=1e-2,
+                      warmup_epochs=0, scale_lr_by_world_size=False, seed=3)
+    tr_sp = LMTrainer(_tiny_lm(seq_axis="seq", sp_layout="striped"),
+                      cfg, mesh=mesh)
+    mesh_dp = build_nd_mesh({"data": 1}, devices=jax.devices()[:1])
+    tr_dp = LMTrainer(_tiny_lm(), cfg, mesh=mesh_dp)
+    toks = _corpus(4, 32, seed=5)
+    m_sp = tr_sp.fit(toks, batch_size=4, epochs=2)
+    m_dp = tr_dp.fit(toks, batch_size=4, epochs=2)
+    np.testing.assert_allclose(m_sp["loss"], m_dp["loss"], rtol=2e-4)
+
+
+def test_striped_requires_seq_axis():
+    import pytest as _pytest
+
+    from tpuflow.models import build_transformer_lm
+
+    with _pytest.raises(ValueError, match="requires seq_axis"):
+        build_transformer_lm(vocab_size=64, dim=32, depth=1, heads=2,
+                             sp_layout="striped")
+    with _pytest.raises(ValueError, match="contiguous|striped"):
+        build_transformer_lm(vocab_size=64, dim=32, depth=1, heads=2,
+                             seq_axis="seq", sp_layout="zigzag")

@@ -102,15 +102,17 @@ def test_jit_compatible():
 def test_kernel_matches_masked_block_ref():
     """The Pallas kernels and the jnp masked refs are the two dispatch
     targets of ring attention (TPU vs interpret) — they must agree
-    bit-for-tolerance, including padded rows/cols and causal masks."""
+    bit-for-tolerance, including padded rows/cols, causal masks, and
+    the strict (shift -1) causal diagonal striped ring visits use."""
     from tpuflow.ops.attention import _Cfg, _bwd_impl, _bwd_ref, _fwd, _fwd_ref
 
     bh, s_pad, d, s_valid = 2, 24, 8, 20
     q, k, v, do = (_rand((bh, s_pad, d), i + 20) for i in range(4))
-    for causal in (False, True):
+    for causal, shift in ((False, 0), (True, 0), (True, -1)):
         cfg = _Cfg(
             causal=causal, scale=d**-0.5, block_q=8, block_k=8,
             sq_valid=s_valid, skv_valid=s_valid, interpret=True,
+            causal_shift=shift,
         )
         o1, lse1 = _fwd(cfg, q, k, v)
         o2, lse2 = _fwd_ref(cfg, q, k, v)
@@ -122,6 +124,25 @@ def test_kernel_matches_masked_block_ref():
             np.testing.assert_allclose(
                 a[:, :s_valid], b[:, :s_valid], atol=5e-5, rtol=5e-4
             )
+
+
+def test_strict_causal_shift_masks_diagonal():
+    """shift=-1 must exclude the diagonal: row r sees cols < r only
+    (row 0 fully masked -> o=0, lse=-inf sentinel)."""
+    from tpuflow.ops.attention import _NEG_BIG, _Cfg, _fwd, _fwd_ref
+
+    bh, s, d = 1, 16, 8
+    q, k, v = (_rand((bh, s, d), i + 60) for i in range(3))
+    cfg = _Cfg(causal=True, scale=d**-0.5, block_q=8, block_k=8,
+               sq_valid=s, skv_valid=s, interpret=True, causal_shift=-1)
+    o, lse = _fwd(cfg, q, k, v)
+    o_r, lse_r = _fwd_ref(cfg, q, k, v)
+    np.testing.assert_allclose(o, o_r, atol=2e-5, rtol=2e-5)
+    assert float(lse[0, 0]) < _NEG_BIG / 2 and np.all(o[0, 0] == 0)
+    # row 1 with strict mask == attending to key 0 only
+    np.testing.assert_allclose(
+        np.asarray(o[0, 1]), np.asarray(v[0, 0]), atol=2e-5, rtol=2e-5
+    )
 
 
 @pytest.mark.parametrize("causal", [False, True])

@@ -152,6 +152,40 @@ def test_lm_trainer_fits_from_token_stream(tmp_path):
         tr.fit(bad, batch_size=16, epochs=1)
 
 
+def test_lm_trainer_evaluates_token_stream(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    train_d = write_token_shards(
+        _learnable_corpus(32, 32), str(tmp_path / "train")
+    )
+    val_d = write_token_shards(
+        _learnable_corpus(16, 32, seed=9), str(tmp_path / "val")
+    )
+    mesh = build_nd_mesh({"data": 1}, devices=jax.devices()[:1])
+    cfg = TrainConfig(optimizer="adamw", learning_rate=3e-3,
+                      warmup_epochs=0, scale_lr_by_world_size=False)
+    tr = LMTrainer(
+        build_transformer_lm(vocab_size=64, dim=32, depth=1, heads=2,
+                             mlp_ratio=2, dtype=jnp.float32),
+        cfg, mesh=mesh,
+    )
+    ds = TokenDataset(train_d, batch_rows=8, shard=(0, 1))
+    val = TokenDataset(val_d, batch_rows=8, shard=(0, 1))
+    m = tr.fit(ds, batch_size=8, epochs=1, val_tokens=val)
+    assert np.isfinite(m["val_loss"]) and m["val_ppl"] > 0
+    ev = tr.evaluate(val, batch_size=8)
+    assert np.isfinite(ev["loss"])
+    # resume past the end: streamed eval instead of array slicing
+    m2 = tr.fit(ds, batch_size=8, epochs=1, initial_epoch=5)
+    assert np.isfinite(m2["loss"])
+
+
 def test_lm_trainer_rejects_short_corpus():
     import jax
     import jax.numpy as jnp

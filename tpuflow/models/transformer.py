@@ -92,6 +92,10 @@ class CausalAttention(nn.Module):
     seq_axis: Optional[str] = None  # set → causal ring attention
     rope_theta: float = 10000.0
     decode: bool = False  # autoregressive KV-cache mode
+    # sequence-shard layout under seq_axis: 'contiguous' (shard d holds
+    # tokens [d*s,(d+1)*s)) or 'striped' (shard d holds d, d+n, ... —
+    # balances the causal ring; the TRAINER permutes tokens/logits)
+    sp_layout: str = "contiguous"
 
     @nn.compact
     def __call__(self, x):
@@ -154,14 +158,18 @@ class CausalAttention(nn.Module):
             if self.seq_axis is not None:
                 # absolute positions of this shard's tokens
                 shard = lax.axis_index(self.seq_axis)
-                positions = shard * s + jnp.arange(s, dtype=jnp.int32)
+                if self.sp_layout == "striped":
+                    nsh = lax.axis_size(self.seq_axis)
+                    positions = shard + jnp.arange(s, dtype=jnp.int32) * nsh
+                else:
+                    positions = shard * s + jnp.arange(s, dtype=jnp.int32)
             else:
                 positions = jnp.arange(s, dtype=jnp.int32)
             q, k = rotary_embed(q, k, positions, self.rope_theta)
 
             if self.seq_axis is not None:
                 o = ring_attention(q, k, v, axis_name=self.seq_axis,
-                                   causal=True)
+                                   causal=True, layout=self.sp_layout)
             elif pick_attn_impl(s, self.attn_impl) == "flash":
                 o = flash_attention(q, k, v, causal=True)
             else:
@@ -215,12 +223,13 @@ class DecoderBlock(nn.Module):
     moe_top_k: int = 2
     ep_axis: Optional[str] = None
     decode: bool = False
+    sp_layout: str = "contiguous"
 
     @nn.compact
     def __call__(self, x):
         x = x + CausalAttention(
             self.dim, self.heads, self.dtype, self.attn_impl, self.seq_axis,
-            self.rope_theta, self.decode, name="attn",
+            self.rope_theta, self.decode, self.sp_layout, name="attn",
         )(RMSNorm(self.dtype, name="norm1")(x))
         y = RMSNorm(self.dtype, name="norm2")(x)
         if self.n_experts > 0:
@@ -259,6 +268,7 @@ class TransformerLM(nn.Module):
     ep_axis: Optional[str] = None
     decode: bool = False  # autoregressive KV-cache mode (see infer.generate)
     remat: bool = False  # gradient checkpointing per block (long context)
+    sp_layout: str = "contiguous"  # see CausalAttention.sp_layout
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -287,7 +297,7 @@ class TransformerLM(nn.Module):
                 self.attn_impl, self.seq_axis, self.rope_theta,
                 n_experts=self.n_experts if moe_block else 0,
                 moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
-                decode=self.decode,
+                decode=self.decode, sp_layout=self.sp_layout,
                 name=f"block{i}",
             )(x)
         x = RMSNorm(self.dtype, name="norm_final")(x)
@@ -315,16 +325,24 @@ def build_transformer_lm(
     moe_top_k: int = 2,
     ep_axis: Optional[str] = None,
     remat: bool = False,
+    sp_layout: str = "contiguous",
 ) -> TransformerLM:
     if dim % heads:
         raise ValueError("dim must be a multiple of heads")
     if (dim // heads) % 2:
         raise ValueError("head_dim must be even (rotary pairs)")
+    if sp_layout not in ("contiguous", "striped"):
+        raise ValueError(
+            f"sp_layout must be contiguous|striped, got {sp_layout!r}"
+        )
+    if sp_layout == "striped" and seq_axis is None:
+        raise ValueError("sp_layout='striped' requires seq_axis")
     return TransformerLM(
         vocab_size=vocab_size, dim=dim, depth=depth, heads=heads,
         mlp_ratio=mlp_ratio, dtype=dtype, attn_impl=attn_impl,
         seq_axis=seq_axis, n_experts=n_experts, moe_every=moe_every,
         moe_top_k=moe_top_k, ep_axis=ep_axis, remat=remat,
+        sp_layout=sp_layout,
     )
 
 
@@ -337,15 +355,16 @@ def perplexity(loss: float) -> float:
     return float(np.exp(min(float(loss), 20.0)))
 
 
-def next_token_loss(logits, tokens, ignore_index: int = -1,
-                    label_smoothing: float = 0.0):
-    """Mean cross-entropy of logits[:, :-1] predicting tokens[:, 1:].
-
-    Positions whose TARGET equals ``ignore_index`` are masked out.
-    Use on global (unsharded or batch-sharded) arrays; under sequence
-    parallelism apply to the all-gathered logits or compute the shifted
-    targets outside the shard_map so the shift crosses shard boundaries
-    correctly.
+def token_loss(logits, targets, mask=None, ignore_index: int = -1,
+               label_smoothing: float = 0.0):
+    """Mean cross-entropy of ``logits[:, i]`` predicting
+    ``targets[:, i]`` — the UNSHIFTED general form (the caller aligns
+    predictions with targets; :func:`next_token_loss` is this plus the
+    standard one-position shift). ``mask`` (optional, broadcastable to
+    targets' shape) excludes positions; positions whose target equals
+    ``ignore_index`` are always excluded. Used directly by the striped
+    sequence-parallel trainer, which keeps logits in the ring's striped
+    order and permutes only the (vocab-times smaller) integer targets.
 
     ``label_smoothing``: uniform smoothing without materializing a
     (B, S, vocab) one-hot — smoothed NLL decomposes as
@@ -357,9 +376,10 @@ def next_token_loss(logits, tokens, ignore_index: int = -1,
         raise ValueError(
             f"label_smoothing must be in [0, 1), got {label_smoothing}"
         )
-    targets = tokens[:, 1:]
-    pred = logits[:, :-1].astype(jnp.float32)
-    mask = (targets != ignore_index).astype(jnp.float32)
+    pred = logits.astype(jnp.float32)
+    valid = (targets != ignore_index).astype(jnp.float32)
+    if mask is not None:
+        valid = valid * mask.astype(jnp.float32)
     safe_targets = jnp.where(targets == ignore_index, 0, targets)
     if label_smoothing:
         logp = jax.nn.log_softmax(pred, axis=-1)
@@ -372,4 +392,20 @@ def next_token_loss(logits, tokens, ignore_index: int = -1,
         losses = optax.softmax_cross_entropy_with_integer_labels(
             pred, safe_targets
         )
-    return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(losses * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def next_token_loss(logits, tokens, ignore_index: int = -1,
+                    label_smoothing: float = 0.0):
+    """Mean cross-entropy of logits[:, :-1] predicting tokens[:, 1:].
+
+    Positions whose TARGET equals ``ignore_index`` are masked out.
+    Use on global (unsharded or batch-sharded) arrays; under sequence
+    parallelism apply to the all-gathered logits or compute the shifted
+    targets outside the shard_map so the shift crosses shard boundaries
+    correctly.
+    """
+    return token_loss(
+        logits[:, :-1], tokens[:, 1:], ignore_index=ignore_index,
+        label_smoothing=label_smoothing,
+    )

@@ -47,7 +47,13 @@ _NEG_BIG = -1e30
 
 
 class _Cfg(NamedTuple):
-    """Static kernel configuration (hashable → custom_vjp nondiff arg)."""
+    """Static kernel configuration (hashable → custom_vjp nondiff arg).
+
+    ``causal_shift`` offsets the causal diagonal: visible iff
+    ``col <= row + causal_shift``. 0 is the standard mask; -1 is the
+    STRICT mask (col < row) that striped ring attention needs for
+    visits from later-striped shards (tpuflow.parallel.ring_attention).
+    """
 
     causal: bool
     scale: float
@@ -56,6 +62,7 @@ class _Cfg(NamedTuple):
     sq_valid: int  # unpadded query length
     skv_valid: int  # unpadded key/value length
     interpret: bool
+    causal_shift: int = 0
 
 
 def _vma(*xs):
@@ -136,7 +143,7 @@ def _mask_for(cfg: _Cfg, sq: int, skv: int):
     col = lax.broadcasted_iota(jnp.int32, (sq, skv), 1)
     mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
     if cfg.causal:
-        mask = mask & (col <= row)
+        mask = mask & (col <= row + cfg.causal_shift)
     return mask
 
 
@@ -182,10 +189,13 @@ def _bwd_ref(cfg: _Cfg, q, k, v, o, lse, do):
 _LANES = 128
 
 
-def _causal_last_j(qi: int, bq: int, bk: int, nk: int):
+def _causal_last_j(qi: int, bq: int, bk: int, nk: int, shift: int = 0):
     """Index of the LAST key block any row of query block ``qi`` can
-    see under the causal mask (the inner grid skips blocks beyond it)."""
-    return jnp.minimum(nk - 1, lax.div((qi + 1) * bq - 1, bk))
+    see under the causal mask col <= row + shift (the inner grid skips
+    blocks beyond it). Clamped at 0 so a fully-masked first block
+    (possible with shift < 0) still takes the init/finalize path."""
+    last_col = (qi + 1) * bq - 1 + shift
+    return jnp.clip(lax.div(last_col, bk), 0, nk - 1)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
@@ -202,7 +212,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     j = pl.program_id(2)  # inner: revolving K/V window, sequential
     nk = pl.num_programs(2)
 
-    last_j = _causal_last_j(qi, bq, bk, nk) if cfg.causal else nk - 1
+    last_j = (
+        _causal_last_j(qi, bq, bk, nk, cfg.causal_shift)
+        if cfg.causal else nk - 1
+    )
 
     @pl.when(j == 0)
     def _init():
@@ -221,11 +234,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         mask = col < cfg.skv_valid
         if cfg.causal:
             row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            mask = mask & (col <= row)
+            mask = mask & (col <= row + cfg.causal_shift)
         s = jnp.where(mask, s, _NEG_BIG)
         m = m_ref[:, :1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # explicit mask gate: a FULLY-masked row keeps m_new at the
+        # -1e30 sentinel, where exp(s - m_new) = exp(0) = 1 would count
+        # masked entries into l/acc (possible under causal_shift < 0,
+        # whose first row sees nothing)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
@@ -297,7 +314,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     j = pl.program_id(2)  # inner: revolving K/V window
     nk = pl.num_programs(2)
 
-    last_j = _causal_last_j(qi, bq, bk, nk) if cfg.causal else nk - 1
+    last_j = (
+        _causal_last_j(qi, bq, bk, nk, cfg.causal_shift)
+        if cfg.causal else nk - 1
+    )
 
     @pl.when(j == 0)
     def _init():
@@ -316,7 +336,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         col = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
         if cfg.causal:
-            mask = mask & (col <= row)
+            mask = mask & (col <= row + cfg.causal_shift)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k_blk.dtype)
@@ -338,7 +358,12 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     nq = pl.num_programs(2)
 
     # causal: the first query block whose rows can see this key block
-    first_i = lax.div(ki * bk, bq) if cfg.causal else 0
+    # (col c is visible to rows >= c - causal_shift)
+    first_i = (
+        jnp.clip(lax.div(ki * bk - cfg.causal_shift, bq), 0,
+                 pl.num_programs(2) - 1)
+        if cfg.causal else 0
+    )
 
     @pl.when(i == first_i)
     def _init():
@@ -358,7 +383,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         row = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
         if cfg.causal:
-            mask = mask & (col <= row)
+            mask = mask & (col <= row + cfg.causal_shift)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv_acc_ref[...] = dv_acc_ref[...] + jnp.dot(
             p.T.astype(do_blk.dtype), do_blk, preferred_element_type=jnp.float32

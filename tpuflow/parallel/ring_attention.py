@@ -60,8 +60,9 @@ class _RingCfg(NamedTuple):
     block_k: int
     s_valid: int  # unpadded LOCAL sequence length (uniform shards)
     interpret: bool
+    layout: str = "contiguous"  # or "striped" (balanced causal ring)
 
-    def block_cfg(self, causal: bool) -> _Cfg:
+    def block_cfg(self, causal: bool, shift: int = 0) -> _Cfg:
         return _Cfg(
             causal=causal,
             scale=self.scale,
@@ -70,6 +71,7 @@ class _RingCfg(NamedTuple):
             sq_valid=self.s_valid,
             skv_valid=self.s_valid,
             interpret=self.interpret,
+            causal_shift=shift,
         )
 
 
@@ -93,7 +95,9 @@ def _fwd_mode(rcfg: _RingCfg, q, k, v, mode):
     """Block attention under a traced visibility mode.
 
     mode 0 = skip (future shard under causal), 1 = full, 2 = diagonal
-    (own shard under causal: local causal mask).
+    (own shard / earlier-striped shard: inclusive causal mask), 3 =
+    strict diagonal (later-striped shard: col < row — striped layout's
+    balanced-causal visits).
     """
     bh, s, d = q.shape
 
@@ -114,7 +118,10 @@ def _fwd_mode(rcfg: _RingCfg, q, k, v, mode):
     def diag(_):
         return fwd(rcfg.block_cfg(True), q, k, v)
 
-    return lax.switch(mode, [skip, full, diag], None)
+    def diag_strict(_):
+        return fwd(rcfg.block_cfg(True, shift=-1), q, k, v)
+
+    return lax.switch(mode, [skip, full, diag, diag_strict], None)
 
 
 def _bwd_mode(rcfg: _RingCfg, q, k, v, o, lse, do, mode):
@@ -133,16 +140,33 @@ def _bwd_mode(rcfg: _RingCfg, q, k, v, o, lse, do, mode):
     def diag(_):
         return bwd(rcfg.block_cfg(True), q, k, v, o, lse, do)
 
-    return lax.switch(mode, [skip, full, diag], None)
+    def diag_strict(_):
+        return bwd(rcfg.block_cfg(True, shift=-1), q, k, v, o, lse, do)
+
+    return lax.switch(mode, [skip, full, diag, diag_strict], None)
 
 
 def _mode_at(rcfg: _RingCfg, my, t: int):
-    """Visibility of the shard held at ring step t (origin (my-t) mod n)."""
+    """Visibility of the shard held at ring step t (origin (my-t) mod n).
+
+    Contiguous layout: earlier shards are FULLY visible, later shards
+    fully masked — device 0 does 1 visit of work while device n-1 does
+    n (the causal ring imbalance: wall time ~n full visits for ~n/2 of
+    average work). Striped layout (shard d holds global tokens d, d+n,
+    d+2n, ...): EVERY pairwise visit is half-visible — inclusive causal
+    over local indices when the visiting shard started earlier
+    (src < my, or the own shard), STRICT causal when it started later —
+    so all devices do equal ~half-visits every step and the causal wall
+    time is ~n/2 (the Striped Attention balance)."""
     if not rcfg.causal:
         return jnp.int32(1)
+    src = (my - t) % rcfg.n
+    if rcfg.layout == "striped":
+        if t == 0:
+            return jnp.int32(2)
+        return jnp.where(src < my, 2, 3).astype(jnp.int32)
     if t == 0:
         return jnp.int32(2)  # own shard: local causal
-    src = (my - t) % rcfg.n
     return jnp.where(src < my, 1, 0).astype(jnp.int32)
 
 
@@ -198,6 +222,28 @@ def _ring_core_bwd(rcfg: _RingCfg, res, do):
 _ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
+def striped_permutation(seq_len: int, n: int):
+    """Original-index order of the STRIPED layout: applying
+    ``x[..., striped_permutation(s, n), :]`` before contiguous sequence
+    sharding gives shard ``d`` the global tokens ``d, d+n, d+2n, ...``
+    — the round-robin assignment that balances causal ring attention
+    (every pairwise shard visit is half-visible instead of
+    all-or-nothing). Invert with :func:`inverse_permutation`."""
+    import numpy as np
+
+    if seq_len % n:
+        raise ValueError(f"seq_len {seq_len} not divisible by ring size {n}")
+    return np.arange(seq_len).reshape(seq_len // n, n).T.reshape(-1)
+
+
+def inverse_permutation(perm):
+    import numpy as np
+
+    inv = np.empty_like(np.asarray(perm))
+    inv[np.asarray(perm)] = np.arange(len(perm))
+    return inv
+
+
 def ring_attention(
     q,
     k,
@@ -209,14 +255,23 @@ def ring_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    layout: str = "contiguous",
 ):
     """Sequence-parallel attention on local ``(batch, heads, seq_shard,
     head_dim)`` shards; must run inside shard_map/pjit with ``axis_name``
     manual. Differentiable; exact (not approximate) attention.
 
-    ``causal`` treats the global sequence as the concatenation of shards
-    in mesh-axis order.
+    ``causal`` treats the global sequence as the shards laid out per
+    ``layout``: ``'contiguous'`` — shard ``d`` holds tokens
+    ``[d·s, (d+1)·s)`` (concatenation in mesh-axis order);
+    ``'striped'`` — shard ``d`` holds tokens ``d, d+n, d+2n, ...``
+    (the caller pre-permutes with :func:`striped_permutation`), which
+    balances the causal work across the ring: every visit is a half-
+    masked diagonal instead of full-or-nothing, so wall time is ~n/2
+    visits instead of n (Striped Attention).
     """
+    if layout not in ("contiguous", "striped"):
+        raise ValueError(f"layout must be contiguous|striped, got {layout!r}")
     if q.ndim != 4:
         raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
     if q.shape != k.shape or k.shape != v.shape:
@@ -241,6 +296,7 @@ def ring_attention(
         block_k=block_k,
         s_valid=s,
         interpret=bool(interpret),
+        layout=layout,
     )
 
     from tpuflow.ops.attention import _pad_seq

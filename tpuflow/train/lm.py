@@ -313,15 +313,47 @@ class LMTrainer:
                 ),
             )
 
+            striped = (
+                model.seq_axis is not None
+                and model.sp_layout == "striped"
+            )
+
             def loss_of(p, tokens, train):
                 # loss over the GLOBAL gathered logits: the next-token
                 # shift crosses sequence-shard boundaries, so it must
-                # happen outside the shard_map (next_token_loss doc)
+                # happen outside the shard_map (next_token_loss doc).
+                # Striped layout: tokens go to the model in round-robin
+                # shard order (balanced causal ring) and the LOGITS STAY
+                # striped — only the int32 targets are gathered into
+                # striped alignment (vocab-times smaller than
+                # un-permuting the (B, S, vocab) logits across the
+                # sequence shards). Striped position i holds logical
+                # token perm[i], whose target is logical token
+                # perm[i]+1; the final logical position is masked out
+                # (shapes are static → the index maps are trace-time
+                # constants).
+                ls = self.cfg.label_smoothing if train else 0.0
+                if striped:
+                    from tpuflow.models.transformer import token_loss
+                    from tpuflow.parallel.ring_attention import (
+                        striped_permutation,
+                    )
+
+                    s = tokens.shape[1]
+                    perm = striped_permutation(s, self.sp)
+                    logits = fwd(
+                        p, jnp.take(tokens, perm, axis=1), train
+                    )
+                    tgt_pos = np.minimum(perm + 1, s - 1)
+                    targets = jnp.take(tokens, tgt_pos, axis=1)
+                    valid = jnp.asarray(
+                        (perm + 1 < s).astype(np.float32)
+                    )[None, :]
+                    return token_loss(
+                        logits, targets, mask=valid, label_smoothing=ls
+                    )
                 return next_token_loss(
-                    fwd(p, tokens, train), tokens,
-                    label_smoothing=(
-                        self.cfg.label_smoothing if train else 0.0
-                    ),
+                    fwd(p, tokens, train), tokens, label_smoothing=ls
                 )
 
         def train_step(state: TrainState, tokens, lr):
@@ -381,10 +413,29 @@ class LMTrainer:
         return batch_size // pc, jax.process_index()
 
     def _eval_mean_loss(
-        self, tokens: np.ndarray, batch_size: int
+        self, tokens: "np.ndarray | TokenDataset", batch_size: int
     ) -> Optional[float]:
         """Mean eval loss over all full global batches (None if there is
-        not even one). Shared by fit()'s val path and evaluate()."""
+        not even one). Shared by fit()'s val path and evaluate().
+        Accepts a :class:`TokenDataset` (its own ``batch_rows`` governs;
+        epoch 0 of the deterministic stream is evaluated)."""
+        if isinstance(tokens, TokenDataset):
+            if tokens.cur_shard != jax.process_index() or (
+                tokens.shard_count != jax.process_count()
+            ):
+                raise ValueError(
+                    f"eval TokenDataset shard "
+                    f"({tokens.cur_shard}/{tokens.shard_count}) does not "
+                    f"match process {jax.process_index()}/"
+                    f"{jax.process_count()}"
+                )
+            losses = [
+                self._eval_step(self.state, self._put(b))["loss"]
+                for b in tokens.iter_epoch(0)
+            ]
+            return (
+                float(jnp.mean(jnp.stack(losses))) if losses else None
+            )
         b_local, proc = self._local_slice(batch_size)
         losses = []
         for j in range(max(1, int(tokens.shape[0]) // int(batch_size))):
@@ -489,17 +540,7 @@ class LMTrainer:
         if start >= epochs:
             # nothing left to train — report eval metrics of the
             # restored state rather than an empty dict
-            if ds is not None:
-                # evaluate over one deterministic epoch of the stream
-                # (evaluate()'s array slicing does not apply)
-                losses = [
-                    self._eval_step(self.state, self._put(b))["loss"]
-                    for b in ds.iter_epoch(start)
-                ]
-                loss = float(jnp.mean(jnp.stack(losses)))
-                metrics = {"loss": loss, "ppl": self._ppl(loss)}
-            else:
-                metrics = self.evaluate(train_tokens, batch_size)
+            metrics = self.evaluate(train_tokens, batch_size)
             if val_tokens is not None:
                 vl = self._eval_mean_loss(val_tokens, batch_size)
                 if vl is not None:
@@ -606,7 +647,7 @@ class LMTrainer:
     # ---- evaluation ------------------------------------------------------
 
     def evaluate(
-        self, tokens: np.ndarray, batch_size: int
+        self, tokens: "np.ndarray | TokenDataset", batch_size: int
     ) -> Dict[str, float]:
         if self.state is None:
             self.init_state()
@@ -614,8 +655,12 @@ class LMTrainer:
             self._make_steps()
         loss = self._eval_mean_loss(tokens, batch_size)
         if loss is None:
+            n = (
+                tokens.total_rows if isinstance(tokens, TokenDataset)
+                else int(tokens.shape[0])
+            )
             raise ValueError(
                 f"evaluate needs at least one full batch: got "
-                f"{int(tokens.shape[0])} rows < batch_size={batch_size}"
+                f"{n} rows < batch_size={batch_size}"
             )
         return {"loss": loss, "ppl": self._ppl(loss)}
