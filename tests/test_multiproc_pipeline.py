@@ -1,0 +1,112 @@
+"""2-process pipeline-parallel training == 1-process (both schedules).
+
+The PP twin of tests/test_multiproc_train.py: the 'pipe' mesh axis
+spans TWO real processes (one stage per process), so the microbatch
+ppermute hops cross a process boundary — the multi-host pipeline path.
+Same schedule, same math: losses must match the single-process run on
+a 2-device mesh.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+
+_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    sys.path.insert(0, os.environ["TPUFLOW_REPO"])
+    import tpuflow.core as core
+    core.initialize()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import PipelineTrainer
+
+    work = os.environ["TPUFLOW_TEST_WORK"]
+    sched = os.environ["TPUFLOW_TEST_SCHED"]
+    assert jax.process_count() == 2, jax.process_count()
+    pid = jax.process_index()
+
+    rng = np.random.default_rng(5)
+    start = rng.integers(0, 64, (16, 1))
+    stride = rng.integers(1, 7, (16, 1))
+    toks = ((start + stride * np.arange(16)[None, :]) % 64).astype(np.int32)
+
+    mesh = build_nd_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    tr = PipelineTrainer(
+        build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=4,
+                             mlp_ratio=2, dtype=jnp.float32),
+        TrainConfig(optimizer="sgd", learning_rate=1e-2,
+                    warmup_epochs=0, scale_lr_by_world_size=False,
+                    seed=4),
+        mesh=mesh, n_microbatches=4, schedule=sched,
+    )
+    m = tr.fit(toks, batch_size=8, epochs=2)
+    with open(os.path.join(work, f"pp_metrics_{pid}.json"), "w") as f:
+        json.dump({"loss": float(m["loss"])}, f)
+    print("proc", pid, "loss", m["loss"])
+    """
+)
+
+
+def _run_two_proc(tmp_path, sched: str, port: int) -> float:
+    from tpuflow.cli.launch import main
+
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    work = str(tmp_path)
+    script = tmp_path / f"worker_{sched}.py"
+    script.write_text(_WORKER)
+    env_backup = dict(os.environ)
+    os.environ["TPUFLOW_REPO"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    os.environ["TPUFLOW_TEST_WORK"] = work
+    os.environ["TPUFLOW_TEST_SCHED"] = sched
+    try:
+        rc = main(["--local", "2", "--port", str(port), "--",
+                   sys.executable, str(script)])
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+    assert rc == 0
+    m0 = json.load(open(os.path.join(work, "pp_metrics_0.json")))
+    m1 = json.load(open(os.path.join(work, "pp_metrics_1.json")))
+    np.testing.assert_allclose(m0["loss"], m1["loss"], rtol=1e-6)
+    return m0["loss"]
+
+
+def test_two_process_pipeline_matches_single(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import PipelineTrainer
+
+    loss_2p = _run_two_proc(tmp_path / "gpipe", "gpipe", 8931)
+    loss_2p_1f1b = _run_two_proc(tmp_path / "f1b", "1f1b", 8933)
+
+    # single-process oracle on a local 2-device pipe mesh
+    rng = np.random.default_rng(5)
+    start = rng.integers(0, 64, (16, 1))
+    stride = rng.integers(1, 7, (16, 1))
+    toks = ((start + stride * np.arange(16)[None, :]) % 64).astype(np.int32)
+    tr = PipelineTrainer(
+        build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=4,
+                             mlp_ratio=2, dtype=jnp.float32),
+        TrainConfig(optimizer="sgd", learning_rate=1e-2,
+                    warmup_epochs=0, scale_lr_by_world_size=False,
+                    seed=4),
+        mesh=build_nd_mesh({"pipe": 2}, devices=jax.devices()[:2]),
+        n_microbatches=4, schedule="gpipe",
+    )
+    loss_1p = tr.fit(toks, batch_size=8, epochs=2)["loss"]
+    np.testing.assert_allclose(loss_2p, loss_1p, rtol=5e-4)
+    np.testing.assert_allclose(loss_2p_1f1b, loss_1p, rtol=5e-4)

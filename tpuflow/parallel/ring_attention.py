@@ -239,9 +239,7 @@ def striped_permutation(seq_len: int, n: int):
 def inverse_permutation(perm):
     import numpy as np
 
-    inv = np.empty_like(np.asarray(perm))
-    inv[np.asarray(perm)] = np.arange(len(perm))
-    return inv
+    return np.argsort(np.asarray(perm))
 
 
 def ring_attention(

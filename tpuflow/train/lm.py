@@ -412,6 +412,13 @@ class LMTrainer:
             )
         return batch_size // pc, jax.process_index()
 
+    def _expected_shard(self) -> Tuple[int, int]:
+        """(cur, count) a TokenDataset must be sharded as for this
+        trainer's token layout — (process_index, process_count) when
+        rows shard over 'data'; PipelineTrainer overrides for its
+        replicated pure-PP feed."""
+        return jax.process_index(), jax.process_count()
+
     def _eval_mean_loss(
         self, tokens: "np.ndarray | TokenDataset", batch_size: int
     ) -> Optional[float]:
@@ -420,14 +427,14 @@ class LMTrainer:
         Accepts a :class:`TokenDataset` (its own ``batch_rows`` governs;
         epoch 0 of the deterministic stream is evaluated)."""
         if isinstance(tokens, TokenDataset):
-            if tokens.cur_shard != jax.process_index() or (
-                tokens.shard_count != jax.process_count()
+            want_cur, want_count = self._expected_shard()
+            if (tokens.cur_shard, tokens.shard_count) != (
+                want_cur, want_count
             ):
                 raise ValueError(
                     f"eval TokenDataset shard "
                     f"({tokens.cur_shard}/{tokens.shard_count}) does not "
-                    f"match process {jax.process_index()}/"
-                    f"{jax.process_count()}"
+                    f"match the expected ({want_cur}/{want_count})"
                 )
             losses = [
                 self._eval_step(self.state, self._put(b))["loss"]
@@ -494,25 +501,22 @@ class LMTrainer:
         b_local, proc = self._local_slice(batch_size)
         ds = train_tokens if isinstance(train_tokens, TokenDataset) else None
         if ds is not None:
-            if ds.batch_rows != b_local or (
-                ds.shard_count != jax.process_count()
-            ):
+            want_cur, want_count = self._expected_shard()
+            if ds.batch_rows != b_local or ds.shard_count != want_count:
                 raise ValueError(
                     f"TokenDataset(batch_rows={ds.batch_rows}, "
                     f"shard_count={ds.shard_count}) does not match this "
-                    f"topology: need batch_rows={b_local} "
-                    f"(batch_size {batch_size} / "
-                    f"{jax.process_count()} processes) and "
-                    f"shard_count={jax.process_count()}"
+                    f"topology: need batch_rows={b_local} and "
+                    f"shard_count={want_count}"
                 )
-            if ds.cur_shard != jax.process_index():
+            if ds.cur_shard != want_cur:
                 # an explicit shard=(0, n) copied onto every host would
                 # pass the count check yet stream IDENTICAL rows on all
                 # ranks — duplicated batches, most of the corpus unseen
                 raise ValueError(
-                    f"TokenDataset.cur_shard={ds.cur_shard} but this is "
-                    f"process {jax.process_index()}; use shard=None "
-                    "(auto) or shard=(process_index, process_count)"
+                    f"TokenDataset.cur_shard={ds.cur_shard} but this "
+                    f"trainer expects shard=({want_cur}, {want_count}); "
+                    "use shard=None (auto) for data-sharded feeds"
                 )
             n = ds.total_rows
             steps_per_epoch = ds.steps_per_epoch()
