@@ -219,3 +219,29 @@ def test_pick_attn_impl():
     assert pick_attn_impl(196) == "einsum"
     expected_long = "flash" if is_tpu_backend() else "einsum"
     assert pick_attn_impl(4096) == expected_long
+
+
+def test_streamed_kernel_fuzz_parity():
+    """Randomized shape/block/dtype configs — property check of the
+    streamed-grid kernels against the oracle (seeded, deterministic)."""
+    rng = np.random.default_rng(123)
+    for trial in range(6):
+        b = int(rng.integers(1, 3))
+        h = int(rng.integers(1, 3))
+        s = int(rng.integers(17, 97))
+        d = int(rng.choice([8, 16]))
+        bq = int(rng.choice([8, 16, 32]))
+        bk = int(rng.choice([8, 16, 32]))
+        causal = bool(rng.integers(0, 2))
+        dtype = jnp.float32 if rng.integers(0, 2) else jnp.bfloat16
+        q, k, v = (_rand((b, h, s, d), 100 + 3 * trial + i, dtype)
+                   for i in range(3))
+        out = flash_attention(q, k, v, causal=causal,
+                              block_q=bq, block_k=bk)
+        ref = mha_reference(q, k, v, causal=causal)
+        tol = dict(atol=3e-2, rtol=3e-2) if dtype == jnp.bfloat16 else \
+            dict(atol=5e-5, rtol=5e-4)
+        np.testing.assert_allclose(
+            out.astype(np.float32), ref.astype(np.float32), **tol,
+            err_msg=f"config: {(trial, b, h, s, d, bq, bk, causal, dtype)}",
+        )

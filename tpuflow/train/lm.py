@@ -403,21 +403,55 @@ class LMTrainer:
     # ---- fit -------------------------------------------------------------
 
     def _local_slice(self, batch_size: int) -> Tuple[int, int]:
-        """(rows per process, this process's index) for a GLOBAL batch."""
-        pc = jax.process_count()
-        if batch_size % pc:
+        """(rows per process, this process's slice index) for a GLOBAL
+        batch — derived from the TOKEN SHARDING's addressable row
+        ranges, not from process_count: with a replicated or
+        partially-replicated row dimension (pure PP; DP x PP whose pipe
+        axis crosses processes) several processes must feed the SAME
+        rows, and feeding per-process slices instead would silently
+        diverge the "replicated" global array across hosts."""
+        from jax.sharding import NamedSharding
+
+        spec = self._token_spec()
+        row_spec = P(spec[0]) if len(spec) else P()
+        n_rows_shards = (
+            self.mesh.shape.get(spec[0], 1)
+            if len(spec) and spec[0] is not None else 1
+        )
+        if batch_size % n_rows_shards:
             raise ValueError(
-                f"global batch_size={batch_size} must divide by "
-                f"process_count={pc}"
+                f"global batch {batch_size} not divisible by mesh data "
+                f"axis {n_rows_shards}; choose batch_size as a multiple "
+                f"of {n_rows_shards}"
             )
-        return batch_size // pc, jax.process_index()
+        sharding = NamedSharding(self.mesh, row_spec)
+        idx_map = sharding.addressable_devices_indices_map((batch_size,))
+        starts = [sl[0].start or 0 for sl in idx_map.values()]
+        stops = [
+            batch_size if sl[0].stop is None else sl[0].stop
+            for sl in idx_map.values()
+        ]
+        start, stop = min(starts), max(stops)
+        b_local = stop - start
+        if b_local <= 0 or batch_size % b_local or start % b_local:
+            raise ValueError(
+                f"global batch_size={batch_size} does not tile this "
+                f"topology's addressable row range [{start}, {stop}); "
+                f"choose a batch divisible by "
+                f"{batch_size // max(1, b_local)} feed groups"
+            )
+        return b_local, start // b_local
 
     def _expected_shard(self) -> Tuple[int, int]:
         """(cur, count) a TokenDataset must be sharded as for this
-        trainer's token layout — (process_index, process_count) when
-        rows shard over 'data'; PipelineTrainer overrides for its
-        replicated pure-PP feed."""
-        return jax.process_index(), jax.process_count()
+        trainer's token layout: one shard per distinct process row-range
+        (== process_count for pure DP; 1 for a replicated pure-PP
+        feed)."""
+        probe = max(1, self.mesh.shape.get(DATA_AXIS, 1)) * max(
+            1, jax.process_count()
+        )
+        b_local, idx = self._local_slice(probe)
+        return idx, probe // b_local
 
     def _eval_mean_loss(
         self, tokens: "np.ndarray | TokenDataset", batch_size: int

@@ -131,23 +131,10 @@ class PipelineTrainer(LMTrainer):
             return P(DATA_AXIS)
         return P()
 
-    def _local_slice(self, batch_size):
-        from tpuflow.parallel.mesh import DATA_AXIS
-
-        if DATA_AXIS in self.mesh.axis_names:
-            return super()._local_slice(batch_size)
-        # pure PP: tokens are REPLICATED over the mesh — every process
-        # must feed the FULL global batch (the pipe axis shards the
-        # MODEL, not the rows); slicing per process would make each
-        # process's "replicated" array hold different rows
-        return batch_size, 0
-
-    def _expected_shard(self):
-        from tpuflow.parallel.mesh import DATA_AXIS
-
-        if DATA_AXIS in self.mesh.axis_names:
-            return super()._expected_shard()
-        return 0, 1  # replicated feed: unsharded stream on every host
+    # NOTE: no _local_slice/_expected_shard overrides needed — the base
+    # LMTrainer derives the per-process feed from the token SHARDING's
+    # addressable row ranges, which handles replicated (pure PP) and
+    # partially-replicated (DP x PP across processes) feeds uniformly.
 
     # ---- state -----------------------------------------------------------
 
