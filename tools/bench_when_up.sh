@@ -34,6 +34,11 @@ while true; do
     if [ "$ok" -eq 0 ]; then
       # bonus (non-gating): kernel block-size sweep for the tuning table
       [ -f BENCH_LOCAL_r03_sweep.json ] || capture BENCH_LOCAL_r03_sweep.json --model vit --steps 15 --attn-sweep || true
+      # bonus (non-gating): convergence curves with REAL on-chip wall
+      # times — the time-to-accuracy half of BASELINE.md's metric
+      [ -f CONVERGENCE_TPU_r03.json ] || timeout -k 30 1800 \
+        python tools/convergence_run.py --epochs 12 \
+        --out CONVERGENCE_TPU_r03.json >> "$log" 2>&1 || true
       echo "$(date) all captures done" >> "$log"; exit 0
     fi
   else
