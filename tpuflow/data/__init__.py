@@ -8,3 +8,4 @@ from tpuflow.data.transforms import (  # noqa: F401
 )
 from tpuflow.data.loader import Dataset, make_dataset  # noqa: F401
 from tpuflow.data.tokens import TokenDataset, write_token_shards  # noqa: F401
+from tpuflow.data.text import ByteBPE, tokenize_corpus  # noqa: F401

@@ -1,0 +1,278 @@
+"""Byte-level BPE tokenization — the text half of the LM data plane.
+
+Closes the loop the LM family previously left to the user ("corpus
+tokenization is upstream of this framework"): raw text → ByteBPE →
+fixed-length token rows → :func:`tpuflow.data.tokens.write_token_shards`
+→ TokenDataset → LMTrainer. The reference has no text pipeline at all
+(its data plane is JPEG images, SURVEY.md §2); this is part of the
+beyond-reference LM surface.
+
+The heavy paths (training's pair counting, encoding's agenda merge) run
+in C++ (tpuflow/native/bpe.cpp, ctypes-bound, built on first use) with
+a pure-Python fallback implementing the SAME algorithm — parity between
+the two is pinned by tests/test_text.py, and the fallback keeps every
+code path runnable without a toolchain.
+
+Recipe (GPT-2-family, simplified to pure bytes): base vocabulary = the
+256 bytes, merge i creates token ``256 + i``; the byte stream
+pretokenizes into pieces starting at each space/newline (the separator
+prefixes the next piece) and merges never cross piece boundaries;
+training counts pairs over the UNIQUE-piece frequency table; ties break
+to the lowest pair for determinism.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from typing import Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+
+def _pieces(data: bytes) -> Iterable[bytes]:
+    """Split at each space/newline, separator attached to what follows
+    — MUST match for_each_piece in native/bpe.cpp."""
+    start = 0
+    for i in range(1, len(data)):
+        if data[i : i + 1] in (b" ", b"\n"):
+            yield data[start:i]
+            start = i
+    if len(data) > start:
+        yield data[start:]
+
+
+def _train_py(data: bytes, n_merges: int) -> List[Tuple[int, int]]:
+    from collections import Counter
+
+    freq = Counter(_pieces(data))
+    seqs = [list(p) for p in freq]
+    counts = list(freq.values())
+    merges: List[Tuple[int, int]] = []
+    for mi in range(n_merges):
+        pc: "Counter[Tuple[int, int]]" = Counter()
+        for s, c in zip(seqs, counts):
+            for j in range(len(s) - 1):
+                pc[(s[j], s[j + 1])] += c
+        if not pc:
+            break
+        # most frequent; deterministic lowest-pair tie break
+        best, best_n = min(
+            pc.items(), key=lambda kv: (-kv[1], kv[0])
+        )
+        if best_n < 2:
+            break
+        merges.append(best)
+        nt = 256 + mi
+        a, b = best
+        for s in seqs:
+            j, w = 0, []
+            while j < len(s):
+                if j + 1 < len(s) and s[j] == a and s[j + 1] == b:
+                    w.append(nt)
+                    j += 2
+                else:
+                    w.append(s[j])
+                    j += 1
+            s[:] = w
+    return merges
+
+
+def _encode_py(data: bytes, merges: Sequence[Tuple[int, int]]) -> List[int]:
+    rank = {tuple(m): i for i, m in enumerate(merges)}
+    memo: dict = {}
+    out: List[int] = []
+    for piece in _pieces(data):
+        toks = memo.get(piece)
+        if toks is None:
+            seq = list(piece)
+            while len(seq) >= 2:
+                best = None
+                for j in range(len(seq) - 1):
+                    r = rank.get((seq[j], seq[j + 1]))
+                    if r is not None and (best is None or r < best):
+                        best = r
+                if best is None:
+                    break
+                a, b = merges[best]
+                nt = 256 + best
+                j, w = 0, []
+                while j < len(seq):
+                    if j + 1 < len(seq) and seq[j] == a and seq[j + 1] == b:
+                        w.append(nt)
+                        j += 2
+                    else:
+                        w.append(seq[j])
+                        j += 1
+                seq = w
+            toks = memo[piece] = seq
+        out.extend(toks)
+    return out
+
+
+def _as_bytes(text: Union[str, bytes]) -> bytes:
+    return text.encode("utf-8") if isinstance(text, str) else bytes(text)
+
+
+class ByteBPE:
+    """Byte-level BPE tokenizer (vocab = 256 bytes + learned merges)."""
+
+    def __init__(self, merges: Sequence[Tuple[int, int]]):
+        self.merges: List[Tuple[int, int]] = [
+            (int(a), int(b)) for a, b in merges
+        ]
+        self.vocab_size = 256 + len(self.merges)
+        # token id → byte string (merge expansion)
+        tab: List[bytes] = [bytes([i]) for i in range(256)]
+        for a, b in self.merges:
+            tab.append(tab[a] + tab[b])
+        self._table = tab
+        # native encoder handle (rank map + piece memo persist ACROSS
+        # encode calls — a stream of small documents amortizes both);
+        # created lazily, freed with the tokenizer
+        self._pairs_np = np.asarray(
+            self.merges, np.uint32
+        ).reshape(-1, 2) if self.merges else np.zeros((0, 2), np.uint32)
+        self._enc_handle = None
+        self._finalizer = None
+
+    def _native_encoder(self, lib):
+        if self._enc_handle is None:
+            import weakref
+
+            handle = lib.tf_bpe_encoder_new(
+                self._pairs_np.ctypes.data_as(ctypes.c_void_p),
+                len(self.merges),
+            )
+            self._enc_handle = handle
+            self._finalizer = weakref.finalize(
+                self, lib.tf_bpe_encoder_free, handle
+            )
+        return self._enc_handle
+
+    # ---- training --------------------------------------------------------
+
+    @classmethod
+    def train(
+        cls,
+        corpus: Union[str, bytes, Iterable[Union[str, bytes]]],
+        vocab_size: int = 512,
+        max_bytes: int = 8 << 20,
+    ) -> "ByteBPE":
+        """Learn ``vocab_size - 256`` merges from the corpus (a string/
+        bytes or an iterable of them, e.g. a file-reading generator).
+        Training reads at most ``max_bytes`` (BPE statistics saturate
+        quickly; the standard subsample-to-train practice). May learn
+        fewer merges when nothing repeats (tiny corpora)."""
+        if vocab_size <= 256:
+            raise ValueError(f"vocab_size must exceed 256, got {vocab_size}")
+        if isinstance(corpus, (str, bytes)):
+            corpus = [corpus]
+        buf = bytearray()
+        for chunk in corpus:
+            buf += _as_bytes(chunk)
+            if len(buf) >= max_bytes:
+                break
+        data = bytes(buf[:max_bytes])
+        if not data:
+            raise ValueError("empty training corpus")
+        n_merges = vocab_size - 256
+
+        from tpuflow.native import bpe_lib
+
+        lib = bpe_lib()
+        if lib is None:
+            return cls(_train_py(data, n_merges))
+        out = np.empty((n_merges, 2), np.uint32)
+        learned = lib.tf_bpe_train(
+            data, len(data), n_merges,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return cls([tuple(map(int, p)) for p in out[:learned]])
+
+    # ---- encode / decode -------------------------------------------------
+
+    def encode(self, text: Union[str, bytes]) -> np.ndarray:
+        """Token ids (int32). A token stream never exceeds the byte
+        count, so the native path preallocates exactly len(data)."""
+        data = _as_bytes(text)
+        if not data:
+            return np.zeros((0,), np.int32)
+        from tpuflow.native import bpe_lib
+
+        lib = bpe_lib()
+        if lib is None:
+            return np.asarray(_encode_py(data, self.merges), np.int32)
+        out = np.empty((len(data),), np.uint32)
+        n = lib.tf_bpe_encoder_encode(
+            self._native_encoder(lib), data, len(data),
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out[:n].astype(np.int32)
+
+    def decode(self, ids: Sequence[int]) -> bytes:
+        """Exact inverse of encode (byte-level BPE is lossless)."""
+        t = self._table
+        return b"".join(t[int(i)] for i in np.asarray(ids).reshape(-1))
+
+    # ---- persistence -----------------------------------------------------
+
+    def __getstate__(self):
+        # the native encoder handle/finalizer cannot cross process
+        # boundaries (ProcessTrials objectives may close over a
+        # tokenizer); the merges fully define the tokenizer
+        return {"merges": self.merges}
+
+    def __setstate__(self, state):
+        self.__init__(state["merges"])
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"format": "tpuflow-bytebpe-v1",
+                       "merges": self.merges}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "ByteBPE":
+        with open(path) as f:
+            obj = json.load(f)
+        if obj.get("format") != "tpuflow-bytebpe-v1":
+            raise ValueError(f"{path} is not a ByteBPE file")
+        return cls([tuple(m) for m in obj["merges"]])
+
+
+def tokenize_corpus(
+    texts: Iterable[Union[str, bytes]],
+    bpe: ByteBPE,
+    out_dir: str,
+    seq_len: int,
+    rows_per_shard: int = 8192,
+    eot_id: Optional[int] = None,
+) -> str:
+    """Text stream → fixed-length token rows → sharded corpus on disk
+    (the writer streams; nothing is held whole). Documents are
+    concatenated (optionally separated by ``eot_id``) and packed into
+    ``(rows, seq_len)`` int32 rows, ragged tail dropped — the standard
+    next-token-training packing. Returns the corpus dir for
+    :class:`tpuflow.data.tokens.TokenDataset`."""
+    from tpuflow.data.tokens import write_token_shards
+
+    if seq_len < 2:
+        raise ValueError("seq_len must be at least 2")
+
+    def _blocks():
+        carry = np.zeros((0,), np.int32)
+        for text in texts:
+            ids = bpe.encode(text)
+            if eot_id is not None:
+                ids = np.concatenate(
+                    [ids, np.asarray([eot_id], np.int32)]
+                )
+            carry = np.concatenate([carry, ids])
+            n_rows = len(carry) // seq_len
+            if n_rows:
+                yield carry[: n_rows * seq_len].reshape(n_rows, seq_len)
+                carry = carry[n_rows * seq_len :]
+
+    return write_token_shards(_blocks(), out_dir,
+                              rows_per_shard=rows_per_shard)

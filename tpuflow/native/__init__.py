@@ -1,4 +1,5 @@
 from tpuflow.native.binding import (  # noqa: F401
+    bpe_lib,
     decode_resize_batch,
     have_native,
     native_lib,

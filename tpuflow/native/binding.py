@@ -21,34 +21,38 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "decode.cpp")
 _LIB_PATH = os.path.join(_HERE, "_libtpuflow_decode.so")
+_BPE_SRC = os.path.join(_HERE, "bpe.cpp")
+_BPE_LIB_PATH = os.path.join(_HERE, "_libtpuflow_bpe.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_bpe_lib_handle: Optional[ctypes.CDLL] = None
+_bpe_tried = False
 
 
-def _build() -> Optional[str]:
+def _build_lib(src: str, lib_path: str, link_flags: Sequence[str]) -> Optional[str]:
     """Compile to a temp file and atomically rename, under a file lock,
     so concurrent processes (one per host is the normal topology) never
     observe a half-written .so."""
     import fcntl
 
-    lock_path = _LIB_PATH + ".lock"
-    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    lock_path = lib_path + ".lock"
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-march=native", "-fPIC", "-shared", "-std=c++17",
-        _SRC, "-o", tmp, "-ljpeg", "-pthread",
+        src, "-o", tmp, *link_flags,
     ]
     try:
         with open(lock_path, "w") as lock_f:
             fcntl.flock(lock_f, fcntl.LOCK_EX)
-            if os.path.exists(_LIB_PATH) and os.path.getmtime(
-                _LIB_PATH
-            ) >= os.path.getmtime(_SRC):
-                return _LIB_PATH  # another process built it while we waited
+            if os.path.exists(lib_path) and os.path.getmtime(
+                lib_path
+            ) >= os.path.getmtime(src):
+                return lib_path  # another process built it while we waited
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(tmp, _LIB_PATH)
-            return _LIB_PATH
+            os.replace(tmp, lib_path)
+            return lib_path
     except Exception:
         return None
     finally:
@@ -59,22 +63,36 @@ def _build() -> Optional[str]:
                 pass
 
 
+def _load_lib(src: str, lib_path: str, link_flags: Sequence[str]) -> Optional[ctypes.CDLL]:
+    have_so = os.path.exists(lib_path)
+    if not os.path.exists(src):
+        # source stripped from the deployment: load the shipped .so if
+        # any (no staleness check possible), else signal fallback —
+        # never raise (the 'or None' contract)
+        path = lib_path if have_so else None
+    else:
+        stale = have_so and os.path.getmtime(lib_path) < os.path.getmtime(src)
+        path = (
+            lib_path if have_so and not stale
+            else _build_lib(src, lib_path, link_flags)
+        )
+    if path is None:
+        return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        return None
+
+
 def native_lib() -> Optional[ctypes.CDLL]:
-    """Load (building if needed) the native library, or None."""
+    """Load (building if needed) the decode library, or None."""
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        stale = os.path.exists(_LIB_PATH) and os.path.getmtime(
-            _LIB_PATH
-        ) < os.path.getmtime(_SRC)
-        path = _LIB_PATH if os.path.exists(_LIB_PATH) and not stale else _build()
-        if path is None:
-            return None
-        try:
-            lib = ctypes.CDLL(path)
-        except OSError:
+        lib = _load_lib(_SRC, _LIB_PATH, ("-ljpeg", "-pthread"))
+        if lib is None:
             return None
         lib.tf_decode_resize_batch.restype = ctypes.c_int
         lib.tf_decode_resize_batch.argtypes = [
@@ -85,6 +103,36 @@ def native_lib() -> Optional[ctypes.CDLL]:
         ]
         _lib = lib
         return _lib
+
+
+def bpe_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the BPE tokenizer library, or None."""
+    global _bpe_lib_handle, _bpe_tried
+    with _lock:
+        if _bpe_lib_handle is not None or _bpe_tried:
+            return _bpe_lib_handle
+        _bpe_tried = True
+        lib = _load_lib(_BPE_SRC, _BPE_LIB_PATH, ())
+        if lib is None:
+            return None
+        lib.tf_bpe_train.restype = ctypes.c_int32
+        lib.tf_bpe_train.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p,
+        ]
+        lib.tf_bpe_encoder_new.restype = ctypes.c_void_p
+        lib.tf_bpe_encoder_new.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+        ]
+        lib.tf_bpe_encoder_free.restype = None
+        lib.tf_bpe_encoder_free.argtypes = [ctypes.c_void_p]
+        lib.tf_bpe_encoder_encode.restype = ctypes.c_int64
+        lib.tf_bpe_encoder_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        _bpe_lib_handle = lib
+        return _bpe_lib_handle
 
 
 def have_native() -> bool:

@@ -1,0 +1,195 @@
+// Byte-level BPE tokenizer — the native text plane of the LM family.
+//
+// The reference has no text pipeline at all (its data plane is JPEG
+// images); tpuflow's LM family needs corpus tokenization upstream of
+// TokenDataset, and that plane belongs in native code next to the JPEG
+// decoder (SURVEY.md §2b N4/N5 discipline: host-side data planes are
+// C++, the TPU math is JAX).
+//
+// Design (the GPT-2-family recipe, simplified to pure bytes):
+//  - base vocabulary = the 256 bytes; merge i creates token 256+i;
+//  - PRETOKENIZATION: the byte stream splits into "pieces" starting at
+//    every space/newline (the separator prefixes the next piece, so
+//    " the" is one piece) — merges never cross piece boundaries;
+//  - TRAINING runs on the unique-piece frequency table (classic BPE):
+//    each round counts adjacent token pairs across unique pieces
+//    weighted by piece count, merges the most frequent pair
+//    (deterministic lowest-pair tie break), and stops early when no
+//    pair repeats. Cost is rounds x unique-piece bytes — fast even for
+//    large corpora, because unique pieces saturate quickly;
+//  - ENCODING applies merges by rank per piece (agenda algorithm) with
+//    a piece-level memo, so throughput is linear in input size;
+//  - a token stream never exceeds the byte count, so callers can
+//    allocate output = input length.
+//
+// C ABI only (ctypes binding in tpuflow/native/binding.py; pybind11 is
+// not available in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Piece = std::basic_string<uint8_t>;
+
+struct PieceHash {
+  size_t operator()(const Piece& p) const {
+    size_t h = 1469598103934665603ull;
+    for (uint8_t c : p) {
+      h ^= c;
+      h *= 1099511628211ull;
+    }
+    return h;
+  }
+};
+
+// split [text, text+len) into pieces: a new piece starts AT each
+// space/newline (separator attached to the following piece)
+template <typename F>
+void for_each_piece(const uint8_t* text, int64_t len, F&& f) {
+  int64_t start = 0;
+  for (int64_t i = 1; i < len; ++i) {
+    if (text[i] == ' ' || text[i] == '\n') {
+      f(text + start, i - start);
+      start = i;
+    }
+  }
+  if (len > start) f(text + start, len - start);
+}
+
+uint64_t pair_key(uint32_t a, uint32_t b) {
+  return (uint64_t(a) << 32) | b;
+}
+
+// merge every occurrence of (a, b) -> nt in seq (in place, compacting)
+void apply_merge(std::vector<uint32_t>& seq, uint32_t a, uint32_t b,
+                 uint32_t nt) {
+  size_t w = 0;
+  for (size_t r = 0; r < seq.size(); ++r) {
+    if (r + 1 < seq.size() && seq[r] == a && seq[r + 1] == b) {
+      seq[w++] = nt;
+      ++r;
+    } else {
+      seq[w++] = seq[r];
+    }
+  }
+  seq.resize(w);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Learn up to n_merges merges from [text, len). out_pairs holds
+// n_merges * 2 uint32 slots; returns the number of merges learned
+// (early stop when the best pair occurs fewer than 2 times).
+int32_t tf_bpe_train(const uint8_t* text, int64_t len, int32_t n_merges,
+                     uint32_t* out_pairs) {
+  if (len <= 0 || n_merges <= 0) return 0;
+  // unique-piece frequency table
+  std::unordered_map<Piece, int64_t, PieceHash> freq;
+  for_each_piece(text, len, [&](const uint8_t* p, int64_t n) {
+    freq[Piece(p, p + n)] += 1;
+  });
+  // token sequences per unique piece
+  std::vector<std::vector<uint32_t>> seqs;
+  std::vector<int64_t> counts;
+  seqs.reserve(freq.size());
+  for (auto& kv : freq) {
+    std::vector<uint32_t> s(kv.first.begin(), kv.first.end());
+    seqs.push_back(std::move(s));
+    counts.push_back(kv.second);
+  }
+
+  int32_t learned = 0;
+  for (; learned < n_merges; ++learned) {
+    std::unordered_map<uint64_t, int64_t> pc;
+    for (size_t i = 0; i < seqs.size(); ++i) {
+      const auto& s = seqs[i];
+      for (size_t j = 0; j + 1 < s.size(); ++j)
+        pc[pair_key(s[j], s[j + 1])] += counts[i];
+    }
+    uint64_t best = 0;
+    int64_t best_n = 0;
+    for (auto& kv : pc) {
+      if (kv.second > best_n ||
+          (kv.second == best_n && kv.first < best)) {
+        best = kv.first;
+        best_n = kv.second;
+      }
+    }
+    if (best_n < 2) break;  // nothing repeats — no compression left
+    uint32_t a = uint32_t(best >> 32), b = uint32_t(best & 0xffffffffu);
+    out_pairs[2 * learned] = a;
+    out_pairs[2 * learned + 1] = b;
+    uint32_t nt = 256 + uint32_t(learned);
+    for (auto& s : seqs)
+      if (s.size() >= 2) apply_merge(s, a, b, nt);
+  }
+  return learned;
+}
+
+// Persistent encoder: holds the merge-rank map and the piece memo
+// ACROSS calls, so a stream of many small documents (one encode per
+// document) amortizes both — common pieces like " the" are derived
+// once per encoder lifetime, not once per call.
+struct TfBpeEncoder {
+  std::unordered_map<uint64_t, uint32_t> rank;
+  std::unordered_map<Piece, std::vector<uint32_t>, PieceHash> memo;
+};
+
+void* tf_bpe_encoder_new(const uint32_t* pairs, int32_t n_merges) {
+  auto* enc = new TfBpeEncoder();
+  enc->rank.reserve(size_t(n_merges) * 2);
+  for (int32_t i = 0; i < n_merges; ++i)
+    enc->rank[pair_key(pairs[2 * i], pairs[2 * i + 1])] = uint32_t(i);
+  return enc;
+}
+
+void tf_bpe_encoder_free(void* handle) {
+  delete static_cast<TfBpeEncoder*>(handle);
+}
+
+// Encode [text, len) via a persistent encoder. out must hold at least
+// len uint32 (a BPE token stream never exceeds the byte count).
+// Returns the number of tokens written.
+int64_t tf_bpe_encoder_encode(void* handle, const uint8_t* text,
+                              int64_t len, uint32_t* out) {
+  if (len <= 0) return 0;
+  auto* enc = static_cast<TfBpeEncoder*>(handle);
+  std::vector<uint32_t> seq;
+  int64_t w = 0;
+  for_each_piece(text, len, [&](const uint8_t* p, int64_t n) {
+    Piece key(p, p + n);
+    auto it = enc->memo.find(key);
+    if (it == enc->memo.end()) {
+      seq.assign(key.begin(), key.end());
+      // agenda: repeatedly apply the LOWEST-rank pair present
+      while (seq.size() >= 2) {
+        uint32_t best_rank = UINT32_MAX;
+        uint32_t a = 0, b = 0;
+        for (size_t j = 0; j + 1 < seq.size(); ++j) {
+          auto r = enc->rank.find(pair_key(seq[j], seq[j + 1]));
+          if (r != enc->rank.end() && r->second < best_rank) {
+            best_rank = r->second;
+            a = seq[j];
+            b = seq[j + 1];
+          }
+        }
+        if (best_rank == UINT32_MAX) break;
+        apply_merge(seq, a, b, 256 + best_rank);
+      }
+      it = enc->memo.emplace(std::move(key), seq).first;
+    }
+    const auto& toks = it->second;
+    std::memcpy(out + w, toks.data(), toks.size() * sizeof(uint32_t));
+    w += int64_t(toks.size());
+  });
+  return w;
+}
+
+}  // extern "C"
