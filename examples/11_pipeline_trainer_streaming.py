@@ -1,11 +1,13 @@
-"""Trainer-level pipeline parallelism fed by a streamed token corpus.
+"""Trainer-level pipeline parallelism fed by a tokenized text corpus.
 
-Round-3 user surface in one workflow (both BEYOND-REFERENCE — the
-reference's only training parallelism is Horovod DP and its only
-beyond-memory story is Petastorm for images, SURVEY.md §2c):
+The round-3 user surface in one workflow (all BEYOND-REFERENCE — the
+reference's only training parallelism is Horovod DP, its only
+beyond-memory story is Petastorm for images, and it has no text plane
+at all, SURVEY.md §2c):
 
-1. tokenize once → ``write_token_shards`` (raw-binary shards +
-   manifest; the writer streams, so a corpus larger than host RAM
+1. raw TEXT → ``ByteBPE.train`` (native C++ byte-level BPE) →
+   ``tokenize_corpus`` → ``write_token_shards`` (raw-binary shards +
+   manifest; everything streams, so a corpus larger than host RAM
    flushes shard by shard);
 2. ``TokenDataset`` — bounded-memory shard-aware stream (reused read
    buffers, deterministic reservoir shuffle, round-robin row sharding
@@ -16,7 +18,8 @@ beyond-memory story is Petastorm for images, SURVEY.md §2c):
    tpuflow.parallel.pipeline.pipeline_1f1b); GPipe is one keyword
    away;
 4. the trained stages reassemble into the plain TransformerLM
-   (``unpipelined_params``) for greedy KV-cache generation.
+   (``unpipelined_params``) for greedy KV-cache generation, decoded
+   back to text with the same tokenizer.
 
 Run on CPU:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
@@ -38,20 +41,11 @@ if os.environ.get("JAX_PLATFORMS") and "jax" in sys.modules:
 
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-VOCAB = 64
 SEQ = 32
-
-
-def _corpus_blocks(n_blocks=6, rows=32, seed=0):
-    """Generator of tokenized blocks — the shape tokenizer output
-    arrives in (write_token_shards streams it, never holding the whole
-    corpus)."""
-    rng = np.random.default_rng(seed)
-    for _ in range(n_blocks):
-        start = rng.integers(0, VOCAB, (rows, 1))
-        stride = rng.integers(1, 7, (rows, 1))
-        pos = np.arange(SEQ)[None, :]
-        yield ((start + stride * pos) % VOCAB).astype(np.int32)
+TEXT = (
+    "the cat sat on the mat. the dog sat on the log. "
+    "the cat saw the dog and the dog saw the cat. "
+) * 60
 
 
 def main() -> None:
@@ -59,7 +53,8 @@ def main() -> None:
     import jax.numpy as jnp
 
     from tpuflow.core.config import TrainConfig
-    from tpuflow.data.tokens import TokenDataset, write_token_shards
+    from tpuflow.data.text import ByteBPE, tokenize_corpus
+    from tpuflow.data.tokens import TokenDataset
     from tpuflow.infer import generate
     from tpuflow.models import build_transformer_lm
     from tpuflow.parallel.mesh import build_nd_mesh
@@ -69,15 +64,20 @@ def main() -> None:
     n_micro = 2 * n_stages
     work = tempfile.mkdtemp(prefix="tpuflow_ex11_")
 
-    corpus = write_token_shards(
-        _corpus_blocks(), os.path.join(work, "corpus"), rows_per_shard=48
-    )
+    # 1) text -> native BPE -> packed, sharded token corpus
+    bpe = ByteBPE.train(TEXT, vocab_size=320)
+    docs = [TEXT[i : i + 400] for i in range(0, len(TEXT), 400)]
+    corpus = tokenize_corpus(docs, bpe, os.path.join(work, "corpus"),
+                             seq_len=SEQ, rows_per_shard=48)
     ds = TokenDataset(corpus, batch_rows=16, shard=(0, 1), seed=0)
-    print(f"corpus: {ds.total_rows} rows x {ds.seq_len} tokens in "
-          f"{len(ds.shard_rows)} shards; {ds.steps_per_epoch()} steps/epoch")
+    print(f"tokenizer: vocab {bpe.vocab_size} "
+          f"({len(bpe.merges)} merges); corpus: {ds.total_rows} rows x "
+          f"{ds.seq_len} tokens in {len(ds.shard_rows)} shards; "
+          f"{ds.steps_per_epoch()} steps/epoch")
 
-    lm = build_transformer_lm(vocab_size=VOCAB, dim=32, depth=n_stages,
-                              heads=4, mlp_ratio=2, dtype=jnp.float32)
+    lm = build_transformer_lm(vocab_size=bpe.vocab_size, dim=32,
+                              depth=n_stages, heads=4, mlp_ratio=2,
+                              dtype=jnp.float32)
     mesh = build_nd_mesh({"pipe": n_stages},
                          devices=jax.devices()[:n_stages])
     trainer = PipelineTrainer(
@@ -89,18 +89,17 @@ def main() -> None:
     print(f"pipeline: {n_stages} stages x {n_micro} microbatches (1f1b)")
 
     first = trainer.fit(ds, batch_size=16, epochs=1)
-    last = trainer.fit(ds, batch_size=16, epochs=5)
+    last = trainer.fit(ds, batch_size=16, epochs=12)
     print(f"loss {first['loss']:.4f} -> {last['loss']:.4f}")
     assert last["loss"] < first["loss"] * 0.8, "pipelined LM did not learn"
 
-    # stages -> plain TransformerLM -> generation continues the pattern
+    # stages -> plain TransformerLM -> generation, decoded back to text
     flat = trainer.unpipelined_params()
-    prompt = np.array([[5, 8, 11, 14, 17, 20, 23, 26]], np.int32)  # stride 3
-    out = generate(lm, flat, prompt=prompt, max_new_tokens=6, seed=0)
-    tail = np.asarray(out)[0, prompt.shape[1]:]
-    print("generated continuation:", tail.tolist())
-    hits = int(np.sum(tail == (29 + 3 * np.arange(6)) % VOCAB))
-    print(f"stride-3 continuation hits: {hits}/6")
+    prompt_ids = bpe.encode("the cat sat on")[None, :]
+    out = generate(lm, flat, prompt=prompt_ids, max_new_tokens=8, seed=0)
+    tail = np.asarray(out)[0, prompt_ids.shape[1]:]
+    continuation = bpe.decode(tail).decode("utf-8", "replace")
+    print(f"generated continuation: {continuation!r}")
     print("pipeline-trainer streaming example OK")
 
 
