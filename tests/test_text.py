@@ -127,3 +127,29 @@ def test_text_to_model_end_to_end(tmp_path):
     first = tr.fit(ds, batch_size=16, epochs=1)
     last = tr.fit(ds, batch_size=16, epochs=4)
     assert last["loss"] < first["loss"] * 0.8, (first, last)
+
+
+def test_tokenize_corpus_accepts_huggingface_tokenizer(tmp_path):
+    """Interop: a HuggingFace `tokenizers` BPE trained in-memory (no
+    downloads) drives the same packing path as ByteBPE."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+    from tpuflow.data.tokens import TokenDataset
+
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.train_from_iterator(
+        [CORPUS], trainers.BpeTrainer(vocab_size=200,
+                                      special_tokens=["<unk>"])
+    )
+    docs = [CORPUS[i : i + 300] for i in range(0, 1500, 300)]
+    d = tokenize_corpus(docs, tok, str(tmp_path / "c"), seq_len=16,
+                        rows_per_shard=8)
+    ds = TokenDataset(d, batch_rows=2, shard=(0, 1), shuffle=False)
+    rows = np.concatenate(list(ds.iter_epoch(0)), axis=0).reshape(-1)
+    stream = np.concatenate(
+        [np.asarray(tok.encode(t).ids, np.int32) for t in docs]
+    )
+    np.testing.assert_array_equal(rows, stream[: len(rows)])
+    assert rows.max() < tok.get_vocab_size()

@@ -241,9 +241,25 @@ class ByteBPE:
         return cls([tuple(m) for m in obj["merges"]])
 
 
+def _encode_any(tokenizer, text: Union[str, bytes]) -> np.ndarray:
+    """Normalize any tokenizer's encode output to int32 ids.
+
+    Accepts :class:`ByteBPE` (bytes-native), a HuggingFace
+    ``tokenizers.Tokenizer`` (returns an Encoding with ``.ids``), or a
+    ``transformers`` tokenizer (returns a list of ints) — the three
+    encode() shapes in this container."""
+    if isinstance(tokenizer, ByteBPE):
+        return tokenizer.encode(text)
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", "surrogateescape")
+    out = tokenizer.encode(text)
+    ids = getattr(out, "ids", out)
+    return np.asarray(ids, np.int32)
+
+
 def tokenize_corpus(
     texts: Iterable[Union[str, bytes]],
-    bpe: ByteBPE,
+    tokenizer,
     out_dir: str,
     seq_len: int,
     rows_per_shard: int = 8192,
@@ -253,7 +269,9 @@ def tokenize_corpus(
     (the writer streams; nothing is held whole). Documents are
     concatenated (optionally separated by ``eot_id``) and packed into
     ``(rows, seq_len)`` int32 rows, ragged tail dropped — the standard
-    next-token-training packing. Returns the corpus dir for
+    next-token-training packing. ``tokenizer`` is a :class:`ByteBPE`
+    or any HuggingFace ``tokenizers``/``transformers`` tokenizer (see
+    :func:`_encode_any`). Returns the corpus dir for
     :class:`tpuflow.data.tokens.TokenDataset`."""
     from tpuflow.data.tokens import write_token_shards
 
@@ -263,7 +281,7 @@ def tokenize_corpus(
     def _blocks():
         carry = np.zeros((0,), np.int32)
         for text in texts:
-            ids = bpe.encode(text)
+            ids = _encode_any(tokenizer, text)
             if eot_id is not None:
                 ids = np.concatenate(
                     [ids, np.asarray([eot_id], np.int32)]
