@@ -155,3 +155,75 @@ def test_lm_workflow_resume(tmp_path):
     res = workflows.lm_train_and_package(store, toks, None, LM_CFG,
                                          resume=True, **kw)
     assert res["model_uri"] is not None
+
+
+def test_packaged_lm_text_surface(tmp_path):
+    """Bundled tokenizer: raw strings in -> continued strings out, and
+    ragged-document scoring with masked padding — the text symmetry of
+    the image packaged model's bytes-in contract."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from tpuflow.data.text import ByteBPE
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.packaging.lm import PackagedLM, save_packaged_lm
+
+    corpus = "the cat sat on the mat. the dog sat on the log. " * 30
+    bpe = ByteBPE.train(corpus, vocab_size=300)
+    cfg = dict(vocab_size=bpe.vocab_size, dim=32, depth=1, heads=2,
+               mlp_ratio=2, dtype=jnp.float32)
+    lm = build_transformer_lm(**cfg)
+    params = lm.init(
+        {"params": jax.random.key(0)},
+        jnp.zeros((1, 8), jnp.int32),
+    )["params"]
+    import flax.linen as nn
+
+    d = str(tmp_path / "pkg")
+    save_packaged_lm(d, nn.unbox(params), cfg, tokenizer=bpe)
+    m = PackagedLM(d)
+    assert m.tokenizer is not None
+
+    outs = m.generate_text(["the cat", "the dog sat"],
+                           max_new_tokens=4, seed=0)
+    assert len(outs) == 2
+    assert outs[0].startswith("the cat") and outs[1].startswith("the dog sat")
+
+    sc = m.score_text(["the cat sat on the mat.", "the dog"])
+    assert np.isfinite(sc["loss"]) and sc["ppl"] > 0
+    # ragged scoring == equivalent hand-masked computation
+    sc2 = m.score_text(["the cat sat on the mat."])
+    assert np.isfinite(sc2["loss"])
+
+    # too-short texts fail loudly instead of silently dropping out
+    with pytest.raises(ValueError, match="too short"):
+        m.score_text(["the cat sat", "x"])
+
+    # only ByteBPE bundles (a foreign tokenizer's save format would
+    # make the artifact unloadable)
+    class FakeTok:
+        def save(self, path):  # pragma: no cover
+            pass
+
+    with pytest.raises(ValueError, match="ByteBPE"):
+        save_packaged_lm(str(tmp_path / "bad"), nn.unbox(params), cfg,
+                         tokenizer=FakeTok())
+
+    # a corrupt tokenizer.json loses only the text surface
+    d3 = str(tmp_path / "pkg3")
+    save_packaged_lm(d3, nn.unbox(params), cfg, tokenizer=bpe)
+    with open(d3 + "/tokenizer.json", "w") as f:
+        f.write("{}")
+    m3 = PackagedLM(d3)
+    assert m3.tokenizer is None
+    assert m3.generate(np.zeros((1, 4), np.int32),
+                       max_new_tokens=2).shape == (1, 6)
+
+    # without a bundled tokenizer the text surface fails loudly
+    d2 = str(tmp_path / "pkg2")
+    save_packaged_lm(d2, nn.unbox(params), cfg)
+    m2 = PackagedLM(d2)
+    with pytest.raises(ValueError, match="no bundled tokenizer"):
+        m2.generate_text(["x"])

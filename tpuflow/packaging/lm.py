@@ -11,6 +11,10 @@ story (register the directory, stage it, load by URI).
 Directory layout:
   MODEL.json        format metadata, model_config, generate_defaults
   weights.msgpack   params
+  tokenizer.json    (optional) bundled ByteBPE — enables the TEXT
+                    surface: generate_text / score_text take raw
+                    strings, the symmetry of the image packaged model's
+                    bytes-in contract (P2/03:186-212)
 """
 
 from __future__ import annotations
@@ -32,6 +36,7 @@ def save_packaged_lm(
     params: Any,
     model_config: Dict[str, Any],
     generate_defaults: Optional[Dict[str, Any]] = None,
+    tokenizer=None,
 ) -> str:
     """Bundle LM params + build config (+ default sampling knobs) into a
     loadable directory (≙ mlflow.pyfunc.log_model, P2/03:354-363).
@@ -60,6 +65,18 @@ def save_packaged_lm(
         f.write(
             serialization.msgpack_serialize({"params": jax.device_get(params)})
         )
+    if tokenizer is not None:
+        from tpuflow.data.text import ByteBPE
+
+        if not isinstance(tokenizer, ByteBPE):
+            # a HuggingFace tokenizer's .save() would silently write its
+            # own format here and make the artifact unloadable later
+            raise ValueError(
+                "save_packaged_lm bundles tpuflow ByteBPE tokenizers "
+                f"only (got {type(tokenizer).__name__}); convert or "
+                "ship the external tokenizer alongside the artifact"
+            )
+        tokenizer.save(os.path.join(out_dir, "tokenizer.json"))
     return out_dir
 
 
@@ -94,12 +111,24 @@ class PackagedLM:
         cfg.pop("ep_axis", None)
         self.model = build_transformer_lm(**cfg)
         self._jit_loss = None
+        self._jit_text_loss = None
         with open(os.path.join(path, "weights.msgpack"), "rb") as f:
             payload = serialization.msgpack_restore(f.read())
         self.params = payload["params"]
         self.generate_defaults: Dict[str, Any] = self.meta.get(
             "generate_defaults", {}
         )
+        self.tokenizer = None
+        tok_path = os.path.join(path, "tokenizer.json")
+        if os.path.exists(tok_path):
+            from tpuflow.data.text import ByteBPE
+
+            try:
+                self.tokenizer = ByteBPE.load(tok_path)
+            except ValueError:
+                # foreign/corrupt tokenizer file: the id-based surface
+                # must keep working; only the text surface is lost
+                self.tokenizer = None
 
     def generate(
         self,
@@ -126,6 +155,80 @@ class PackagedLM:
             **opts,
         )
         return np.asarray(out)
+
+    def _require_tokenizer(self):
+        if self.tokenizer is None:
+            raise ValueError(
+                "this packaged LM has no bundled tokenizer; package with "
+                "save_packaged_lm(..., tokenizer=ByteBPE(...)) to use "
+                "the text surface, or call generate()/score() on ids"
+            )
+        return self.tokenizer
+
+    def generate_text(
+        self,
+        prompts: "Sequence[str]",
+        max_new_tokens: Optional[int] = None,
+        **kwargs,
+    ) -> "list[str]":
+        """Raw strings in -> continued strings out (prompt included) —
+        the text symmetry of the image packaged model's bytes-in
+        contract. Prompts are encoded with the bundled tokenizer and
+        generated one by one (each distinct prompt length compiles once
+        via the memoized decode scan)."""
+        tok = self._require_tokenizer()
+        eos = kwargs.get("eos_id", self.generate_defaults.get("eos_id"))
+        out = []
+        for p in prompts:
+            ids = tok.encode(p)[None, :]
+            full = self.generate(ids, max_new_tokens=max_new_tokens,
+                                 **kwargs)[0]
+            if eos is not None:
+                # after a row emits eos the remaining fixed-length
+                # positions repeat it — truncate before decoding
+                cont = full[ids.shape[1]:]
+                hits = np.nonzero(cont == int(eos))[0]
+                if len(hits):
+                    full = full[: ids.shape[1] + int(hits[0])]
+            out.append(tok.decode(full).decode("utf-8", "replace"))
+        return out
+
+    def score_text(self, texts: "Sequence[str]") -> Dict[str, float]:
+        """Mean next-token loss + perplexity over raw strings: encodes
+        with the bundled tokenizer, right-pads to the longest row, and
+        masks the padded targets (token_loss's ignore_index) so ragged
+        documents score exactly."""
+        import jax
+        import jax.numpy as jnp
+
+        from tpuflow.models.transformer import perplexity, token_loss
+
+        tok = self._require_tokenizer()
+        rows = [tok.encode(t) for t in texts]
+        short = [i for i, r in enumerate(rows) if len(r) < 2]
+        if not rows or short:
+            raise ValueError(
+                "score_text needs at least 2 tokens per text; texts at "
+                f"indices {short or '[]'} are too short"
+            )
+        width = max(len(r) for r in rows)
+        ids = np.zeros((len(rows), width), np.int32)
+        tgt = np.full((len(rows), width), -1, np.int32)
+        for i, r in enumerate(rows):
+            ids[i, : len(r)] = r
+            tgt[i, : len(r)] = r
+        if self._jit_text_loss is None:
+            # one jitted closure; jax re-specializes per padded width
+            self._jit_text_loss = jax.jit(
+                lambda params, ids, tgt: token_loss(
+                    self.model.apply({"params": params}, ids)[:, :-1],
+                    tgt[:, 1:], ignore_index=-1,
+                )
+            )
+        loss = float(self._jit_text_loss(
+            self.params, jnp.asarray(ids), jnp.asarray(tgt)
+        ))
+        return {"loss": loss, "ppl": perplexity(loss)}
 
     def score(self, tokens: np.ndarray) -> Dict[str, float]:
         """Mean next-token loss + perplexity of (B, S) int32 rows —

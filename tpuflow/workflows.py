@@ -292,10 +292,13 @@ def lm_train_and_package(
     checkpoint_dir: Optional[str] = None,
     resume: bool = False,
     generate_defaults: Optional[Dict[str, Any]] = None,
+    tokenizer=None,
 ) -> Dict[str, Any]:
     """The C20 one-shot pipeline for the LM family: run-create → param
     log → LMTrainer fit → package (tpuflow.packaging.lm) → evaluate →
     metrics. Returns {'run_id', 'model_uri', 'val_loss', 'val_ppl'}.
+    ``tokenizer`` (a tpuflow.data.text.ByteBPE) is bundled into the
+    artifact, enabling PackagedLM's raw-text surface.
 
     ``resume=True`` restores the newest checkpoint under
     ``checkpoint_dir`` and continues from its epoch (≙
@@ -345,6 +348,7 @@ def lm_train_and_package(
             params=trainer.state.params,
             model_config=lm_config,
             generate_defaults=generate_defaults,
+            tokenizer=tokenizer,
         )
         run.end("FINISHED")
         model_uri = f"runs:/{run.run_id}/model"
