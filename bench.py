@@ -486,6 +486,58 @@ def _attention_sweep(diag: dict, rtt_ms: float = 0.0) -> None:
         print(f"# attn sweep failed: {e}", file=sys.stderr, flush=True)
 
 
+def _transport_diag(diag: dict, rtt_ms: float, smoke: bool = False) -> None:
+    """Measured transport numbers (SURVEY N3): HBM read+write bandwidth
+    from a scan-timed saxpy (the roofline's denominator — v5e peak is
+    ~819 GB/s), and, when 2+ devices exist, the all-reduce bandwidth of
+    a psum over the mesh (ICI verification; on this 1-chip rig the ICI
+    half is honestly absent and says so). Never raises."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        n = (1 << 16) if smoke else (1 << 26)  # 256 MB f32 resident
+        x = jnp.ones((n,), jnp.float32)
+        ms = _timed_scan(jax, lambda c: c * 1.0001 + 1.0, x,
+                         3 if smoke else 10, rtt_ms)
+        # one read + one write of the carry per step
+        diag["hbm_gb_s"] = round((2 * n * 4) / (ms * 1e-3) / 1e9, 1)
+
+        n_dev = len(jax.devices())
+        if n_dev >= 2:
+            from jax import shard_map
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            import numpy as np
+
+            mesh = Mesh(np.array(jax.devices()), ("d",))
+            m = (1 << 12) if smoke else (1 << 24)
+            y = jax.device_put(
+                jnp.ones((n_dev, m), jnp.float32),
+                NamedSharding(mesh, P("d")),
+            )
+            ar = shard_map(
+                lambda v: jax.lax.psum(v, "d"), mesh=mesh,
+                in_specs=P("d"), out_specs=P("d"),
+            )
+            ms_ar = _timed_scan(jax, ar, y, 3 if smoke else 10, rtt_ms)
+            # ring all-reduce moves ~2*(n-1)/n of the per-device bytes
+            bytes_moved = 2 * (n_dev - 1) / n_dev * m * 4
+            diag["allreduce_gb_s_per_link"] = round(
+                bytes_moved / (ms_ar * 1e-3) / 1e9, 3
+            )
+        else:
+            diag["allreduce_gb_s_per_link"] = (
+                "unmeasurable: 1 device on this rig"
+            )
+        print(f"# transport: hbm={diag['hbm_gb_s']} GB/s "
+              f"allreduce={diag['allreduce_gb_s_per_link']}",
+              file=sys.stderr, flush=True)
+    except Exception as e:
+        diag["transport"] = f"failed: {e}"
+        print(f"# transport diag failed: {e}", file=sys.stderr, flush=True)
+
+
 def _decode_diag(hw: int) -> float:
     """Single-point decode throughput at cpu_count threads (the e2e
     path's headline — one timed run, not the full curve)."""
@@ -741,6 +793,7 @@ def _bench(args) -> int:
         )
     except Exception:
         diag["decode_img_per_s"] = 0.0
+    _transport_diag(diag, rtt_ms, smoke=args.smoke)
     if args.trace:
         diag["trace_dir"] = args.trace  # captured AFTER the timed loop
     if not args.no_attn_diag:
