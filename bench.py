@@ -952,6 +952,7 @@ def _bench_e2e(args, devices) -> int:
                     callbacks=[_Times()])
         diag = _diag()
         diag["decode_img_per_s"] = round(_decode_diag(hw), 0)
+        _transport_diag(diag, rtt_ms, smoke=args.smoke)
         if args.attn_sweep:
             _attention_sweep(diag, rtt_ms=rtt_ms)
         print(f"# e2e: epoch_s={diag['epoch_s']} "
@@ -1064,6 +1065,7 @@ def _bench_lm(args, devices) -> int:
         min_step_s=flops / (n_chips * peak) if flops else 0.0,
     )
     mfu_val, diag = _diag_for(dt, method, dt_loop, last_loss)
+    _transport_diag(diag, rtt_ms, smoke=args.smoke)
     if args.trace:
         # extra steps AFTER the timed window (same as the image path)
         with jax.profiler.trace(args.trace):

@@ -377,3 +377,41 @@ def test_striped_requires_seq_axis():
     with _pytest.raises(ValueError, match="contiguous|striped"):
         build_transformer_lm(vocab_size=64, dim=32, depth=1, heads=2,
                              seq_axis="seq", sp_layout="zigzag")
+
+
+def test_grad_accumulation_matches_unaccumulated():
+    """grad_accum_steps=4 must reproduce the plain step exactly (mean
+    of micro-gradients == full-batch gradient for a mean loss) — on
+    both the shard_map DP path and the GSPMD path."""
+    import jax.numpy as jnp
+
+    toks = _corpus(32, 16, seed=8)
+
+    def losses(accum, **trainer_kw):
+        cfg = TrainConfig(optimizer="sgd", learning_rate=1e-2,
+                          warmup_epochs=0, scale_lr_by_world_size=False,
+                          seed=6, grad_accum_steps=accum)
+        tr = LMTrainer(_tiny_lm(), cfg,
+                       mesh=build_nd_mesh({"data": 2, "model": 1},
+                                          devices=jax.devices()[:2]),
+                       **trainer_kw)
+        hist = []
+        tr.fit(toks, batch_size=16, epochs=2,
+               on_epoch=lambda e, m: hist.append(m["loss"]))
+        return hist
+
+    np.testing.assert_allclose(losses(4), losses(1), rtol=2e-5)
+    # GSPMD (zero1) path honors accumulation too
+    np.testing.assert_allclose(
+        losses(4, zero="zero1"), losses(1, zero="zero1"), rtol=2e-5
+    )
+
+
+def test_grad_accumulation_validates_divisibility():
+    cfg = TrainConfig(optimizer="sgd", warmup_epochs=0,
+                      grad_accum_steps=3)
+    tr = LMTrainer(_tiny_lm(), cfg,
+                   mesh=build_nd_mesh({"data": 1},
+                                      devices=jax.devices()[:1]))
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        tr.fit(_corpus(16, 16), batch_size=16, epochs=1)

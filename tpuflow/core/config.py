@@ -77,6 +77,12 @@ class TrainConfig:
     # label smoothing on the TRAINING loss (eval stays plain CE so
     # val_loss remains comparable across smoothing settings)
     label_smoothing: float = 0.0
+    # gradient accumulation: each step's batch splits into this many
+    # sequential micro-steps whose gradients average before ONE
+    # optimizer update — the standard fit-a-bigger-batch-in-HBM lever
+    # (exactly equivalent to the unaccumulated step for mean losses).
+    # Honored by LMTrainer; 1 = off.
+    grad_accum_steps: int = 1
     reduce_on_plateau_factor: float = 0.1
     early_stopping_patience: Optional[int] = None  # ≙ EarlyStopping, P2/03:397-401
     checkpoint_dir: Optional[str] = None

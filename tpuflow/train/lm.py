@@ -356,10 +356,51 @@ class LMTrainer:
                     fwd(p, tokens, train), tokens, label_smoothing=ls
                 )
 
+        accum = max(1, int(self.cfg.grad_accum_steps))
+
         def train_step(state: TrainState, tokens, lr):
-            loss, grads = jax.value_and_grad(
-                lambda p: loss_of(p, tokens, True)
-            )(state.params)
+            if accum == 1:
+                loss, grads = jax.value_and_grad(
+                    lambda p: loss_of(p, tokens, True)
+                )(state.params)
+            else:
+                # gradient accumulation: sequential micro-steps against
+                # FIXED params, gradients averaged before one update —
+                # exactly the unaccumulated step for mean losses (equal
+                # micro sizes), with peak activation memory divided by
+                # `accum`
+                b = tokens.shape[0]
+                if b % accum:
+                    raise ValueError(
+                        f"batch {b} not divisible by "
+                        f"grad_accum_steps={accum}"
+                    )
+                if (b // accum) % max(1, self.world):
+                    raise ValueError(
+                        f"micro-batch {b // accum} rows must divide by "
+                        f"the data axis {self.world}; pick batch/accum "
+                        "as a multiple of it"
+                    )
+                micro = tokens.reshape(accum, b // accum, tokens.shape[1])
+
+                def body(carry, t):
+                    loss_sum, gacc = carry
+                    l, g = jax.value_and_grad(
+                        lambda p: loss_of(p, t, True)
+                    )(state.params)
+                    return (
+                        loss_sum + l,
+                        jax.tree.map(jnp.add, gacc, g),
+                    ), None
+
+                (loss_sum, gsum), _ = jax.lax.scan(
+                    body,
+                    (jnp.zeros((), jnp.float32),
+                     jax.tree.map(jnp.zeros_like, state.params)),
+                    micro,
+                )
+                loss = loss_sum / accum
+                grads = jax.tree.map(lambda g: g / accum, gsum)
             opt_state = set_learning_rate(state.opt_state, lr)
             updates, opt_state = self.tx.update(
                 grads, opt_state, state.params

@@ -114,6 +114,12 @@ class PipelineTrainer(LMTrainer):
                 "(>= 4x to amortize, pipeline module docstring)"
             )
         super().__init__(model, config, mesh=mesh)
+        if self.cfg.grad_accum_steps != 1:
+            raise ValueError(
+                "grad_accum_steps is not honored by PipelineTrainer: "
+                "microbatching already splits the batch — raise "
+                "n_microbatches instead"
+            )
         self.n_stages = n_stages
         self.blocks_per_stage = model.depth // n_stages
         self.n_microbatches = n_microbatches

@@ -73,6 +73,12 @@ class Trainer:
     ):
         self.model = model
         self.cfg = config or TrainConfig()
+        if getattr(self.cfg, "grad_accum_steps", 1) != 1:
+            raise ValueError(
+                "grad_accum_steps is honored by LMTrainer only; "
+                "Trainer updates once per batch — lower the batch "
+                "size or use the LM family"
+            )
         self.mesh = mesh if mesh is not None else build_mesh()
         self.world = world_size(self.mesh)
         self.run = run  # tracking run (primary-only effects)
