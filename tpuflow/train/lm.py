@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import time
 
-from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import flax.linen as nn
 import jax
