@@ -105,3 +105,27 @@ def test_last_known_good_selection(tmp_path, monkeypatch):
     os.remove(tmp_path / "BENCH_LOCAL_r02_prov.json")
     rec = bench._last_known_good()
     assert rec["value"] == 111.0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("model", ["vit", "resnet50"])
+def test_smoke_other_models_emit_schema(model):
+    """Every capture mode the recovery watcher drives must emit a valid
+    artifact (tools/bench_when_up.sh queues cnn/vit/resnet50/lm/e2e)."""
+    r = _run("--smoke", "--model", model, "--steps", "2", "--warmup", "1",
+             "--no-attn-diag")
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "train_images_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert "error" not in rec
+
+
+@pytest.mark.slow
+def test_smoke_end2end_emits_schema():
+    r = _run("--smoke", "--end2end", "--e2e-images", "32", "--no-attn-diag")
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "train_images_per_sec_per_chip_e2e"
+    assert rec["value"] > 0
+    assert "error" not in rec
