@@ -13,10 +13,11 @@ at all, SURVEY.md §2c):
    buffers, deterministic reservoir shuffle, round-robin row sharding
    across processes);
 3. ``PipelineTrainer`` — the decoder stack cut into pipeline stages
-   over a ``pipe`` mesh axis, trained on the 1F1B schedule (one
-   forward + one backward per tick, O(n_stages) resident activations —
-   tpuflow.parallel.pipeline.pipeline_1f1b); GPipe is one keyword
-   away;
+   over a ``pipe`` mesh axis, trained on the Megatron INTERLEAVED
+   virtual-stage 1F1B schedule (each device holds 2 round-robin model
+   chunks; the flush bubble shrinks by the virtual-stage factor —
+   tpuflow.parallel.interleave builds and verifies the slot tables);
+   plain 1F1B and GPipe are one keyword away;
 4. the trained stages reassemble into the plain TransformerLM
    (``unpipelined_params``) for greedy KV-cache generation, decoded
    back to text with the same tokenizer.
@@ -75,8 +76,9 @@ def main() -> None:
           f"{ds.seq_len} tokens in {len(ds.shard_rows)} shards; "
           f"{ds.steps_per_epoch()} steps/epoch")
 
+    # 2 virtual chunks per device: depth must divide stages x chunks
     lm = build_transformer_lm(vocab_size=bpe.vocab_size, dim=32,
-                              depth=n_stages, heads=4, mlp_ratio=2,
+                              depth=2 * n_stages, heads=4, mlp_ratio=2,
                               dtype=jnp.float32)
     mesh = build_nd_mesh({"pipe": n_stages},
                          devices=jax.devices()[:n_stages])
@@ -84,9 +86,11 @@ def main() -> None:
         lm,
         TrainConfig(optimizer="adamw", learning_rate=3e-3,
                     warmup_epochs=0, scale_lr_by_world_size=False, seed=0),
-        mesh=mesh, n_microbatches=n_micro, schedule="1f1b",
+        mesh=mesh, n_microbatches=n_micro, schedule="interleaved",
+        virtual_stages=2,
     )
-    print(f"pipeline: {n_stages} stages x {n_micro} microbatches (1f1b)")
+    print(f"pipeline: {n_stages} stages x 2 virtual chunks x "
+          f"{n_micro} microbatches (interleaved 1f1b)")
 
     first = trainer.fit(ds, batch_size=16, epochs=1)
     last = trainer.fit(ds, batch_size=16, epochs=12)

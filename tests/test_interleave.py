@@ -1,0 +1,83 @@
+"""Interleaved-1F1B schedule builder: bounds, verification, op order.
+
+The builder simulates the dependency graph and re-verifies its own
+tables, so these tests focus on the SCHEDULING claims: the slot count
+must hit the Megatron bound ``2*m*v + 2*(n-1)`` (the ~v× bubble
+shrink vs the non-interleaved pipeline is the entire point of the
+schedule), and malformed configurations must be rejected loudly.
+"""
+
+import numpy as np
+import pytest
+
+from tpuflow.parallel.interleave import build_interleaved_schedule
+
+
+@pytest.mark.parametrize(
+    "n,v,m",
+    [(2, 1, 4), (2, 2, 4), (4, 2, 8), (4, 4, 8), (8, 2, 16),
+     (4, 3, 16), (8, 4, 32), (1, 2, 4)],
+)
+def test_hits_megatron_bound(n, v, m):
+    s = build_interleaved_schedule(n, v, m)
+    assert s.n_ticks == 2 * m * v + 2 * (n - 1), (
+        f"schedule took {s.n_ticks} slots, Megatron bound is "
+        f"{2 * m * v + 2 * (n - 1)}"
+    )
+
+
+@pytest.mark.parametrize("n,v,m", [(4, 2, 8), (8, 4, 32)])
+def test_beats_noninterleaved_bubble(n, v, m):
+    """The v>1 schedule must spend strictly fewer chunk-op slots than
+    the non-interleaved 1F1B equivalent ((m + 2(n-1)) paired ticks of
+    v chunk-ops) — the measured form of the bubble/v claim."""
+    s = build_interleaved_schedule(n, v, m)
+    assert s.n_ticks < s.notes["noninterleaved_equiv_slots"]
+    # bubble fraction shrinks roughly by v: allow generous slack but
+    # pin the direction and magnitude
+    nonint_bubble = 2 * (n - 1) * v / (2 * (m + 2 * (n - 1)) * v)
+    assert s.bubble_fraction < nonint_bubble
+    assert s.bubble_fraction <= 2 * (n - 1) / (2 * m * v) + 1e-9
+
+
+def test_forward_only_schedule():
+    s = build_interleaved_schedule(4, 2, 8, forward_only=True)
+    # fwd ops only, one per (stage, micro)
+    assert int(s.op_valid.sum()) == 4 * 2 * 8
+    assert not s.grecv_valid.any()
+    # a forward wave needs m*v slots of work after an (n*v - 1)-slot fill
+    assert s.n_ticks < 8 * 2 + 4 * 2 + 4
+
+
+def test_rejects_bad_microbatch_count():
+    with pytest.raises(ValueError, match="divisible"):
+        build_interleaved_schedule(4, 2, 6)
+    with pytest.raises(ValueError, match="divisible"):
+        build_interleaved_schedule(4, 2, 2)
+
+
+def test_buffer_depth_is_bounded():
+    """Interleaving trades memory for bubble: the residual buffer depth
+    must stay well under one-slot-per-microbatch (the GPipe worst
+    case), and the builder's lifetime verifier has already proven no
+    slot is reused while live."""
+    s = build_interleaved_schedule(4, 2, 16)
+    assert s.n_buf <= 16
+    sh = build_interleaved_schedule(4, 2, 32)
+    # steady-state residency does not grow with m (1F1B property)
+    assert sh.n_buf == s.n_buf
+
+
+def test_op_order_is_megatron_interleaved():
+    """Device 0's warmup must walk chunk 0 for a full microbatch group
+    before touching chunk 1 (groups of n), and backwards must start
+    with the LAST chunk."""
+    s = build_interleaved_schedule(2, 2, 4)
+    d0 = [
+        (int(s.op_kind[t, 0]), int(s.op_chunk[t, 0]), int(s.op_micro[t, 0]))
+        for t in range(s.n_ticks) if s.op_valid[t, 0]
+    ]
+    fwds = [(c, m) for k, c, m in d0 if k == 0]
+    bwds = [(c, m) for k, c, m in d0 if k == 1]
+    assert fwds[:4] == [(0, 0), (0, 1), (1, 0), (1, 1)]
+    assert bwds[0][0] == 1  # deepest local chunk drains first

@@ -140,6 +140,91 @@ def test_multiple_blocks_per_stage_and_unpipelined_export():
     np.testing.assert_allclose(loss_plain, ev["loss"], rtol=2e-4)
 
 
+def test_interleaved_matches_unpipelined():
+    """Virtual-stage (Megatron interleaved) 1F1B: same math as the
+    plain trainer, v=2 chunks per device in the device-major
+    round-robin layout."""
+    toks = _corpus(24, 16)
+    mesh = build_nd_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    tr = PipelineTrainer(_lm(depth=4), _cfg(), mesh=mesh,
+                         n_microbatches=4, schedule="interleaved",
+                         virtual_stages=2)
+    losses = _fit_losses(tr, toks)
+    ref = LMTrainer(_lm(depth=4), _cfg(),
+                    mesh=build_nd_mesh({"data": 1},
+                                       devices=jax.devices()[:1]))
+    losses_ref = _fit_losses(ref, toks)
+    np.testing.assert_allclose(losses, losses_ref, rtol=2e-4)
+    # eval runs the forward-only interleaved schedule
+    ev = tr.evaluate(toks[:8], batch_size=8)
+    ev_ref = ref.evaluate(toks[:8], batch_size=8)
+    np.testing.assert_allclose(ev["loss"], ev_ref["loss"], rtol=2e-4)
+
+
+def test_interleaved_dp_x_pp_and_deep_pipe():
+    """Interleaved over a 4-deep pipe (v=2, 8 model chunks) and under
+    DP x PP row sharding — both must reproduce the unpipelined run."""
+    toks = _corpus(24, 16)
+    ref = LMTrainer(_lm(depth=8), _cfg(),
+                    mesh=build_nd_mesh({"data": 1},
+                                       devices=jax.devices()[:1]))
+    losses_ref = _fit_losses(ref, toks)
+
+    mesh4 = build_nd_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    tr4 = PipelineTrainer(_lm(depth=8), _cfg(), mesh=mesh4,
+                          n_microbatches=8, schedule="interleaved",
+                          virtual_stages=2)
+    np.testing.assert_allclose(_fit_losses(tr4, toks), losses_ref,
+                               rtol=2e-4)
+
+    mesh_dp = build_nd_mesh({"data": 2, "pipe": 2},
+                            devices=jax.devices()[:4])
+    tr_dp = PipelineTrainer(_lm(depth=8), _cfg(), mesh=mesh_dp,
+                            n_microbatches=4, schedule="interleaved",
+                            virtual_stages=4)
+    assert tr_dp.dp == 2
+    np.testing.assert_allclose(_fit_losses(tr_dp, toks), losses_ref,
+                               rtol=2e-4)
+
+
+def test_interleaved_unpipelined_export():
+    """The device-major round-robin chunk layout must invert cleanly
+    back to the flat block{i} tree of the plain TransformerLM."""
+    from tpuflow.models.transformer import next_token_loss
+
+    toks = _corpus(16, 16)
+    mesh = build_nd_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    tr = PipelineTrainer(_lm(depth=4), _cfg(), mesh=mesh,
+                         n_microbatches=4, schedule="interleaved",
+                         virtual_stages=2)
+    tr.fit(toks, batch_size=8, epochs=2)
+    ev = tr.evaluate(toks[:8], batch_size=8)
+    flat = tr.unpipelined_params()
+    loss_plain = float(next_token_loss(
+        _lm(depth=4).apply({"params": flat}, jnp.asarray(toks[:8])),
+        jnp.asarray(toks[:8]),
+    ))
+    np.testing.assert_allclose(loss_plain, ev["loss"], rtol=2e-4)
+
+
+def test_interleaved_validation():
+    mesh = build_nd_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="interleaved"):
+        PipelineTrainer(_lm(depth=4), _cfg(), mesh=mesh,
+                        virtual_stages=2)  # gpipe + v>1
+    with pytest.raises(ValueError, match="divide"):
+        PipelineTrainer(_lm(depth=6), _cfg(), mesh=mesh,
+                        n_microbatches=4, schedule="interleaved",
+                        virtual_stages=4)
+    with pytest.raises(ValueError, match="groups"):
+        PipelineTrainer(_lm(depth=4), _cfg(), mesh=mesh,
+                        n_microbatches=3, schedule="interleaved",
+                        virtual_stages=2)
+    with pytest.raises(ValueError, match="virtual_stages"):
+        PipelineTrainer(_lm(depth=4), _cfg(), mesh=mesh,
+                        schedule="interleaved", virtual_stages=0)
+
+
 def test_pipeline_trainer_checkpoint_resume(tmp_path):
     toks = _corpus(16, 16)
     mesh = build_nd_mesh({"pipe": 2}, devices=jax.devices()[:2])

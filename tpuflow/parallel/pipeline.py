@@ -339,6 +339,351 @@ def pipeline_1f1b(
     return run
 
 
+# ---- shared helpers for the interleaved schedules (train + fwd-only) ----
+
+def _micro_at(buf, i, m_total):
+    """Microbatch ``i`` of a ``(n_micro, ...)`` buffer (index clipped —
+    invalid slots read garbage that is never consumed)."""
+    return lax.dynamic_index_in_dim(
+        buf, jnp.clip(i, 0, m_total - 1), 0, keepdims=False
+    )
+
+
+def _buf_read(buf, c, w, x_shape):
+    """Read activation ``(chunk c, buffer slot w)`` of a
+    ``(v, n_buf, *x_shape)`` buffer."""
+    return lax.dynamic_slice(
+        buf, (c, w) + (0,) * len(x_shape), (1, 1) + x_shape
+    ).reshape(x_shape)
+
+
+def _buf_write_if(buf, val, c, w, valid, x_shape):
+    """Write ``val`` at ``(c, w)`` when ``valid`` — read-select-write
+    keeps the conditional O(activation), not O(buffer): a jnp.where
+    over the whole buffer would copy it every slot."""
+    cur = _buf_read(buf, c, w, x_shape)
+    return lax.dynamic_update_slice(
+        buf,
+        jnp.where(valid, val, cur).reshape((1, 1) + x_shape),
+        (c, w) + (0,) * len(x_shape),
+    )
+
+
+def _sched_tables(sched, keys):
+    """Schedule tables as replicated device constants (each device
+    gathers its own column with axis_index)."""
+    return {k: jnp.asarray(getattr(sched, k)) for k in keys}
+
+
+def pipeline_interleaved(
+    first_fn: Callable[[Any, Any], Any],
+    stage_fn: Callable[[Any, Any], Any],
+    last_fn: Callable[[Any, Any, Any], Any],
+    sched,
+    axis_name: str = PIPE_AXIS,
+) -> Callable[[Any, Any, Any, Any, Any], Any]:
+    """Interleaved (virtual-stage) 1F1B schedule, manual VJP.
+
+    Each device holds ``v = sched.n_chunks`` NON-contiguous model
+    chunks (round-robin: device ``d`` owns stages ``d, d+n, ...``), and
+    each schedule slot runs ONE op — a chunk forward or a chunk
+    backward — per the precomputed tables of
+    :class:`tpuflow.parallel.interleave.InterleavedSchedule`. The flush
+    bubble is ``~2*(n-1)`` chunk-ops instead of the non-interleaved
+    ``~2*(n-1)`` FULL-stage ops: v× less idle time, traded for ~v× the
+    resident activations (``sched.n_buf`` per chunk) and one
+    activation + one gradient ``ppermute`` per chunk-op instead of per
+    stage-op.
+
+    ``first_fn``/``stage_fn``/``last_fn`` contract matches
+    :func:`pipeline_1f1b` (embed recomputed at stage 0, loss head
+    inside the last chunk's backward, per-stage rematerialization from
+    the saved chunk INPUT). Returns ``run(stacked_params, first_params,
+    last_params, data_micro, tgt_micro) -> (loss_mean, stage_grads,
+    first_grads, last_grads)`` for use inside ``shard_map``: in_specs
+    ``(P(axis), P(), P(), P(), P())``, out_specs ``(P(), P(axis), P(),
+    P())``. Per-device ``stacked_params`` leaves carry a leading
+    ``(v, ...)`` chunk axis — globally ``(n*v, ...)`` in DEVICE-MAJOR
+    order (device d's chunks at rows ``[d*v, (d+1)*v)``), i.e. global
+    row ``d*v + c`` holds model stage ``c*n + d``.
+    """
+    n = sched.n_devices
+    v = sched.n_chunks
+    m_total = sched.n_micro
+    n_buf = sched.n_buf
+    inv_m = 1.0 / m_total
+    tb = _sched_tables(sched, (
+        "op_valid", "op_kind", "op_chunk", "op_micro", "op_buf",
+        "arecv_valid", "arecv_chunk", "arecv_buf",
+        "grecv_valid", "grecv_chunk", "grecv_buf",
+    ))
+
+    def run(stacked_params, first_params, last_params, data_micro,
+            tgt_micro):
+        if data_micro.shape[0] != m_total:
+            raise ValueError(
+                f"input has {data_micro.shape[0]} microbatches, schedule "
+                f"built for {m_total}"
+            )
+        axes = tuple(
+            getattr(jax.typeof(data_micro), "vma", frozenset())
+            | {axis_name}
+        )
+        params = jax.tree.map(lambda a: _pvary(a, axes), stacked_params)
+        first_params = jax.tree.map(lambda p: _pvary(p, axes), first_params)
+        last_params = jax.tree.map(lambda p: _pvary(p, axes), last_params)
+        idx = lax.axis_index(axis_name)
+        if lax.axis_size(axis_name) != n:
+            raise ValueError(
+                f"axis {axis_name!r} has size {lax.axis_size(axis_name)}, "
+                f"schedule built for {n}"
+            )
+        fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+        bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+
+        def _zeros_varying(tree):
+            return jax.tree.map(
+                lambda p: _pvary(jnp.zeros_like(p), axes), tree
+            )
+
+        def _data_at(buf, i):
+            return _micro_at(buf, i, m_total)
+
+        def _chunk_at(tree, c):
+            return jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                tree,
+            )
+
+        x_probe = jax.eval_shape(
+            lambda fp, d: first_fn(fp, d), first_params, data_micro[0]
+        )
+        x_shape, x_dtype = x_probe.shape, x_probe.dtype
+
+        def _read(buf, c, w):
+            return _buf_read(buf, c, w, x_shape)
+
+        def _write_if(buf, val, c, w, valid):
+            return _buf_write_if(buf, val, c, w, valid, x_shape)
+
+        def slot(carry, t):
+            fwd_msg, bwd_msg, xbuf, gbuf, gacc, facc, lacc, loss_acc = carry
+            cell = {k: tb[k][t, idx] for k in tb}
+            # ---- route last slot's ring arrivals into the buffers
+            xbuf = _write_if(
+                xbuf, fwd_msg, cell["arecv_chunk"], cell["arecv_buf"],
+                cell["arecv_valid"],
+            )
+            gbuf = _write_if(
+                gbuf, bwd_msg, cell["grecv_chunk"], cell["grecv_buf"],
+                cell["grecv_valid"],
+            )
+
+            c, w = cell["op_chunk"], cell["op_buf"]
+            micro, valid = cell["op_micro"], cell["op_valid"]
+            params_c = _chunk_at(params, c)
+            is_s0 = (idx == 0) & (c == 0)
+            is_last = (idx == n - 1) & (c == v - 1)
+
+            def fwd_branch(carry_in):
+                xbuf, gbuf, gacc, facc, lacc, loss_acc = carry_in
+                x_arr = _read(xbuf, c, w)
+                x_emb = first_fn(first_params, _data_at(data_micro, micro))
+                x_in = jnp.where(is_s0, x_emb, x_arr)
+                # persist stage 0's input for its backward recompute
+                # (other stages' inputs were persisted on arrival)
+                xbuf = _write_if(xbuf, x_in, c, w, valid & is_s0)
+                y = stage_fn(params_c, x_in)
+                zero_dx = _pvary(jnp.zeros(x_shape, x_dtype), axes)
+                return (xbuf, gbuf, gacc, facc, lacc, loss_acc, y, zero_dx)
+
+            def bwd_branch(carry_in):
+                xbuf, gbuf, gacc, facc, lacc, loss_acc = carry_in
+                x_saved = _read(xbuf, c, w)
+                gi = _read(gbuf, c, w)
+
+                def with_head(args):
+                    xs, _ = args
+                    lv, vjp = jax.vjp(
+                        lambda lp, pc, xx: last_fn(
+                            lp, stage_fn(pc, xx), _data_at(tgt_micro, micro)
+                        ),
+                        last_params, params_c, xs,
+                    )
+                    dlp, dpc, dx = vjp(
+                        _pvary(jnp.asarray(inv_m, jnp.float32), axes)
+                    )
+                    return lv, dlp, dpc, dx
+
+                def without_head(args):
+                    xs, gi_ = args
+                    _, vjp = jax.vjp(stage_fn, params_c, xs)
+                    dpc, dx = vjp(gi_)
+                    return (
+                        _pvary(jnp.zeros((), jnp.float32), axes),
+                        _zeros_varying(last_params),
+                        dpc, dx,
+                    )
+
+                # no invalid-op guard here: the builder emits every
+                # bubble slot as kind F (asserted in its _verify), so
+                # the backward branch only ever runs a REAL op
+                lv, dlp, dpc, dx = lax.cond(
+                    is_last, with_head, without_head, (x_saved, gi)
+                )
+                loss_acc = loss_acc + lv
+                lacc = jax.tree.map(jnp.add, lacc, dlp)
+                # accumulate this chunk's grads in place
+                gacc = jax.tree.map(
+                    lambda acc, g: lax.dynamic_update_index_in_dim(
+                        acc,
+                        lax.dynamic_index_in_dim(
+                            acc, c, 0, keepdims=False) + g,
+                        c, 0,
+                    ),
+                    gacc, dpc,
+                )
+
+                # stage 0: fold dx into the embed grads NOW (an
+                # embed-sized accumulator, nothing O(n_micro) carried)
+                def do_first(args):
+                    d_b, dxv = args
+                    _, vjp = jax.vjp(
+                        lambda fp: first_fn(fp, d_b), first_params
+                    )
+                    (dfp,) = vjp(dxv)
+                    return dfp
+
+                def no_first(args):
+                    return _zeros_varying(first_params)
+
+                dfp = lax.cond(
+                    is_s0, do_first, no_first,
+                    (_data_at(data_micro, micro), dx),
+                )
+                facc = jax.tree.map(jnp.add, facc, dfp)
+                zero_y = _pvary(jnp.zeros(x_shape, x_dtype), axes)
+                return (xbuf, gbuf, gacc, facc, lacc, loss_acc, zero_y, dx)
+
+            carry_in = (xbuf, gbuf, gacc, facc, lacc, loss_acc)
+            (xbuf, gbuf, gacc, facc, lacc, loss_acc, y_out,
+             dx_out) = lax.cond(
+                cell["op_kind"] == 0, fwd_branch, bwd_branch, carry_in
+            )
+            fwd_msg = lax.ppermute(y_out, axis_name, fwd_perm)
+            bwd_msg = lax.ppermute(dx_out, axis_name, bwd_perm)
+            return (
+                fwd_msg, bwd_msg, xbuf, gbuf, gacc, facc, lacc, loss_acc
+            ), None
+
+        zeros_x = _pvary(jnp.zeros(x_shape, x_dtype), axes)
+        carry0 = (
+            zeros_x,
+            zeros_x,
+            _pvary(jnp.zeros((v, n_buf, *x_shape), x_dtype), axes),
+            _pvary(jnp.zeros((v, n_buf, *x_shape), x_dtype), axes),
+            _zeros_varying(params),
+            _zeros_varying(first_params),
+            _zeros_varying(last_params),
+            _pvary(jnp.zeros((), jnp.float32), axes),
+        )
+        (_, _, _, _, gacc, facc, lacc, loss_acc), _ = lax.scan(
+            slot, carry0, jnp.arange(sched.n_ticks)
+        )
+        loss_mean = lax.psum(loss_acc, axis_name) * inv_m
+        first_grads = jax.tree.map(lambda g: lax.psum(g, axis_name), facc)
+        last_grads = jax.tree.map(lambda g: lax.psum(g, axis_name), lacc)
+        return loss_mean, gacc, first_grads, last_grads
+
+    return run
+
+
+def pipeline_interleaved_fwd(
+    first_fn: Callable[[Any, Any], Any],
+    stage_fn: Callable[[Any, Any], Any],
+    sched,
+    axis_name: str = PIPE_AXIS,
+) -> Callable[[Any, Any, Any], Any]:
+    """Forward-only interleaved pipeline (for eval/inference through the
+    interleaved DEVICE-MAJOR parameter layout, which the contiguous
+    GPipe :func:`pipeline` cannot consume). Uses the same slot tables
+    with every backward op a no-op slot; the last chunk's outputs are
+    collected per microbatch and replicated via :func:`from_last_stage`
+    by the caller. Returns ``run(stacked_params, first_params,
+    data_micro) -> (n_micro, ...)`` last-stage outputs (zeros off the
+    last device).
+    """
+    n, v, m_total, n_buf = (
+        sched.n_devices, sched.n_chunks, sched.n_micro, sched.n_buf
+    )
+    tb = _sched_tables(sched, (
+        "op_valid", "op_kind", "op_chunk", "op_micro", "op_buf",
+        "arecv_valid", "arecv_chunk", "arecv_buf",
+    ))
+
+    def run(stacked_params, first_params, data_micro):
+        axes = tuple(
+            getattr(jax.typeof(data_micro), "vma", frozenset())
+            | {axis_name}
+        )
+        params = jax.tree.map(lambda a: _pvary(a, axes), stacked_params)
+        first_params = jax.tree.map(lambda p: _pvary(p, axes), first_params)
+        idx = lax.axis_index(axis_name)
+        fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+        x_probe = jax.eval_shape(
+            lambda fp, d: first_fn(fp, d), first_params, data_micro[0]
+        )
+        x_shape, x_dtype = x_probe.shape, x_probe.dtype
+
+        def slot(carry, t):
+            fwd_msg, xbuf, outbuf = carry
+            cell = {k: tb[k][t, idx] for k in tb}
+            xbuf = _buf_write_if(
+                xbuf, fwd_msg, cell["arecv_chunk"], cell["arecv_buf"],
+                cell["arecv_valid"], x_shape,
+            )
+            c, w, micro = cell["op_chunk"], cell["op_buf"], cell["op_micro"]
+            do_f = cell["op_valid"] & (cell["op_kind"] == 0)
+            is_s0 = (idx == 0) & (c == 0)
+            is_last = (idx == n - 1) & (c == v - 1)
+            x_in = jnp.where(
+                is_s0,
+                first_fn(first_params, _micro_at(data_micro, micro,
+                                                 m_total)),
+                _buf_read(xbuf, c, w, x_shape),
+            )
+            params_c = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                params,
+            )
+            y = stage_fn(params_c, x_in)
+            # collect last-chunk outputs per microbatch
+            pos = jnp.clip(micro, 0, m_total - 1)
+            cur_out = lax.dynamic_index_in_dim(outbuf, pos, 0,
+                                               keepdims=False)
+            outbuf = lax.dynamic_update_index_in_dim(
+                outbuf,
+                jnp.where(do_f & is_last, y, cur_out),
+                pos, 0,
+            )
+            fwd_msg = lax.ppermute(y, axis_name, fwd_perm)
+            return (fwd_msg, xbuf, outbuf), None
+
+        zeros_x = _pvary(jnp.zeros(x_shape, x_dtype), axes)
+        carry0 = (
+            zeros_x,
+            _pvary(jnp.zeros((v, n_buf, *x_shape), x_dtype), axes),
+            _pvary(jnp.zeros((m_total, *x_shape), x_dtype), axes),
+        )
+        (_, _, outbuf), _ = lax.scan(
+            slot, carry0, jnp.arange(sched.n_ticks)
+        )
+        return outbuf
+
+    return run
+
+
 def from_last_stage(x, axis_name: str = PIPE_AXIS):
     """Replicate a value held by the last pipeline stage to all stages
     (psum of a one-hot mask — a single small collective)."""

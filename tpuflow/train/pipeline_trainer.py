@@ -23,6 +23,12 @@ Schedules:
   forward and one backward op per tick, residuals in a circular
   buffer, activation memory O(n_stages) (PipeDream-flush). Same math;
   better memory and the same bubble.
+- ``schedule='interleaved'``: Megatron-style virtual-stage 1F1B
+  (tpuflow.parallel.interleave + pipeline_interleaved) — each device
+  holds ``virtual_stages`` round-robin model chunks and the schedule
+  runs one CHUNK op per slot, shrinking the flush bubble by ~v× for
+  ~v× the resident activations. Same math again (grads accumulate
+  over all microbatches before the optimizer step).
 
 The reference has no pipeline story at all (SURVEY.md §2c — Horovod DP
 is its only training parallelism); this is part of the beyond-reference
@@ -49,11 +55,14 @@ from tpuflow.models.transformer import (
     next_token_loss,
 )
 from tpuflow.parallel.mesh import build_nd_mesh
+from tpuflow.parallel.interleave import build_interleaved_schedule
 from tpuflow.parallel.pipeline import (
     PIPE_AXIS,
     from_last_stage,
     pipeline,
     pipeline_1f1b,
+    pipeline_interleaved,
+    pipeline_interleaved_fwd,
     split_microbatches,
     stack_stage_params,
 )
@@ -63,17 +72,24 @@ from tpuflow.train.state import TrainState
 
 
 class PipelineTrainer(LMTrainer):
-    """Pipeline-parallel LM trainer (GPipe or 1F1B microbatch schedule).
+    """Pipeline-parallel LM trainer (GPipe, 1F1B or Megatron-interleaved
+    microbatch schedule).
 
     ``mesh`` must carry a ``pipe`` axis (default: a 1-D pipe mesh over
     all local devices) and may additionally carry a ``data`` axis for
     DP x PP: microbatch ROWS are sharded over ``data`` while stages
     are laid over ``pipe`` — each data replica runs the full microbatch
     schedule on its slice and gradients are mean-reduced across
-    replicas (GPipe: by shard_map's autodiff transpose; 1F1B: an
+    replicas (GPipe: by shard_map's autodiff transpose; 1F1B family: an
     explicit pmean after the schedule). ``batch_size`` in :meth:`fit`
     is global and must divide by ``n_microbatches`` x the data-axis
     size.
+
+    ``schedule='interleaved'`` additionally takes ``virtual_stages=v``:
+    each device holds ``v`` round-robin model chunks (``depth`` must
+    divide by ``n_stages*v``, ``n_microbatches`` by ``n_stages``) and
+    runs the Megatron virtual-stage schedule, shrinking the pipeline
+    flush bubble by ~v× for ~v× the resident activations.
     """
 
     def __init__(
@@ -84,15 +100,25 @@ class PipelineTrainer(LMTrainer):
         devices=None,
         n_microbatches: int = 8,
         schedule: str = "gpipe",
+        virtual_stages: int = 1,
     ):
         if model.seq_axis is not None or model.n_experts > 0:
             raise ValueError(
                 "PipelineTrainer pipelines the dense DP-free decoder "
                 "stack; combine with seq_axis/MoE via LMTrainer instead"
             )
-        if schedule not in ("gpipe", "1f1b"):
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"schedule must be 'gpipe' or '1f1b', got {schedule!r}"
+                f"schedule must be 'gpipe', '1f1b' or 'interleaved', "
+                f"got {schedule!r}"
+            )
+        if virtual_stages < 1:
+            raise ValueError(f"virtual_stages must be >= 1, got "
+                             f"{virtual_stages}")
+        if virtual_stages > 1 and schedule != "interleaved":
+            raise ValueError(
+                "virtual_stages > 1 requires schedule='interleaved' "
+                "(gpipe/1f1b run one contiguous stage per device)"
             )
         if mesh is None:
             n = len(devices) if devices is not None else len(jax.devices())
@@ -103,15 +129,23 @@ class PipelineTrainer(LMTrainer):
                 f"{mesh.axis_names}"
             )
         n_stages = mesh.shape[PIPE_AXIS]
-        if model.depth % n_stages:
+        v = virtual_stages if schedule == "interleaved" else 1
+        if model.depth % (n_stages * v):
             raise ValueError(
-                f"depth {model.depth} must divide by n_stages {n_stages}"
+                f"depth {model.depth} must divide by n_stages x "
+                f"virtual_stages = {n_stages}x{v}"
             )
         if n_microbatches < n_stages:
             raise ValueError(
                 f"n_microbatches {n_microbatches} < n_stages {n_stages} "
                 "leaves permanent bubbles; use at least n_stages "
                 "(>= 4x to amortize, pipeline module docstring)"
+            )
+        if schedule == "interleaved" and n_microbatches % n_stages:
+            raise ValueError(
+                f"the interleaved schedule advances microbatches in "
+                f"groups of n_stages; n_microbatches {n_microbatches} "
+                f"must divide by {n_stages}"
             )
         super().__init__(model, config, mesh=mesh)
         if self.cfg.grad_accum_steps != 1:
@@ -121,7 +155,20 @@ class PipelineTrainer(LMTrainer):
                 "n_microbatches instead"
             )
         self.n_stages = n_stages
-        self.blocks_per_stage = model.depth // n_stages
+        self.virtual_stages = v
+        self.blocks_per_stage = model.depth // (n_stages * v)
+        # model-slice index held by each row of the stacked param tree:
+        # contiguous for gpipe/1f1b; DEVICE-MAJOR round-robin for
+        # interleaved (device d's rows [d*v, (d+1)*v) hold model
+        # slices d, d+n, d+2n, ...)
+        if schedule == "interleaved":
+            self._stage_order = [
+                c * n_stages + d
+                for d in range(n_stages)
+                for c in range(v)
+            ]
+        else:
+            self._stage_order = list(range(n_stages))
         self.n_microbatches = n_microbatches
         self.schedule = schedule
         # data-parallel degree (1 = pure PP); self.world from LMTrainer
@@ -169,7 +216,7 @@ class PipelineTrainer(LMTrainer):
                 f"b{j}": raw[f"block{s * per + j}"]
                 for j in range(per)
             }
-            for s in range(self.n_stages)
+            for s in self._stage_order
         ]
         stacked = stack_stage_params(stage_trees)
         params = {
@@ -235,6 +282,9 @@ class PipelineTrainer(LMTrainer):
         # 'data' in DP x PP, stages always over 'pipe'
         micro_spec = P(None, DATA_AXIS) if has_data else P()
         stage_fn = self._stage_fn()
+        if self.schedule == "interleaved":
+            self._make_steps_interleaved(micro_spec, has_data, stage_fn)
+            return
         run_fwd = pipeline(stage_fn, mm, PIPE_AXIS)
 
         def forward(params, tokens):
@@ -273,81 +323,151 @@ class PipelineTrainer(LMTrainer):
                 return self._apply_grads(state, grads, lr, loss)
 
         else:  # 1f1b
-
-            def last_fn(last_params, y, tgt):
-                logits = self._head(
-                    last_params["norm_final"],
-                    last_params["lm_head"]["kernel"],
-                    y,
-                )
-                return next_token_loss(
-                    logits, tgt,
-                    label_smoothing=self.cfg.label_smoothing,
-                )
-
-            def first_fn(embed, tok):
-                return jnp.take(embed, tok, axis=0).astype(model.dtype)
-
+            first_fn, last_fn = self._first_last_fns()
             run_1f1b = pipeline_1f1b(
                 first_fn, stage_fn, last_fn, mm, PIPE_AXIS
             )
+            train_step = self._build_1f1b_train_step(
+                run_1f1b, micro_spec, has_data
+            )
 
-            def run_wrapped(stages, embed, last_params, dm, tm):
-                # gate on the AXIS EXISTING, not dp > 1: a size-1 data
-                # axis still makes dm/tm (and so every schedule value)
-                # data-varying, which the replicated out_specs reject
-                # unless the pmean strips the vma
-                if has_data:
-                    # per-device math over data-sharded microbatch rows:
-                    # tag the replicated params data-varying up front
-                    # (same reasoning as pipeline_1f1b's pipe pvary),
-                    # then mean-reduce the per-replica grads/loss
-                    from tpuflow.parallel.collectives import pvary
+        self._train_step = jax.jit(train_step, donate_argnums=0)
+        self._eval_step = jax.jit(eval_step)
 
-                    embed = pvary(embed, DATA_AXIS)
-                    last_params = jax.tree.map(
-                        lambda p: pvary(p, DATA_AXIS), last_params
-                    )
-                out = run_1f1b(stages, embed, last_params, dm, tm)
-                if has_data:
-                    from jax import lax
+    def _first_last_fns(self):
+        """The embed/loss-head halves shared by every manual-VJP
+        schedule (plain 1F1B and interleaved): the embed is recomputed
+        inside stage 0, the final norm + LM head + loss live inside the
+        last stage (each microbatch's backward needs its loss there)."""
+        model = self.model
 
-                    out = jax.tree.map(
-                        lambda g: lax.pmean(g, DATA_AXIS), out
-                    )
-                return out
+        def first_fn(embed, tok):
+            return jnp.take(embed, tok, axis=0).astype(model.dtype)
 
-            def train_step(state: TrainState, tokens, lr):
-                self._check_micro(tokens)
-                outer = state.params["outer"]
-                stages = state.params["stages"]
-                tok_micro = split_microbatches(tokens, mm)
-                last_params = {
-                    "norm_final": outer["norm_final"],
-                    "lm_head": outer["lm_head"],
-                }
-                piped = shard_map(
-                    run_wrapped,
-                    mesh=mesh,
-                    in_specs=(P(PIPE_AXIS), P(), P(),
-                              micro_spec, micro_spec),
-                    out_specs=(P(), P(PIPE_AXIS), P(), P()),
+        def last_fn(last_params, y, tgt):
+            logits = self._head(
+                last_params["norm_final"],
+                last_params["lm_head"]["kernel"],
+                y,
+            )
+            return next_token_loss(
+                logits, tgt,
+                label_smoothing=self.cfg.label_smoothing,
+            )
+
+        return first_fn, last_fn
+
+    def _build_1f1b_train_step(self, run_fn, micro_spec, has_data):
+        """train_step for any 1F1B-family runner (``pipeline_1f1b`` or
+        ``pipeline_interleaved`` — identical
+        ``run(stages, embed, last_params, data_micro, tgt_micro)``
+        contract): wraps it in the DP data-axis choreography and
+        assembles the grads tree for the optimizer."""
+        from tpuflow.parallel.mesh import DATA_AXIS
+
+        mesh = self.mesh
+        mm = self.n_microbatches
+
+        def run_wrapped(stages, embed, last_params, dm, tm):
+            # gate on the AXIS EXISTING, not dp > 1: a size-1 data
+            # axis still makes dm/tm (and so every schedule value)
+            # data-varying, which the replicated out_specs reject
+            # unless the pmean strips the vma
+            if has_data:
+                # per-device math over data-sharded microbatch rows:
+                # tag the replicated params data-varying up front
+                # (same reasoning as pipeline_1f1b's pipe pvary),
+                # then mean-reduce the per-replica grads/loss
+                from tpuflow.parallel.collectives import pvary
+
+                embed = pvary(embed, DATA_AXIS)
+                last_params = jax.tree.map(
+                    lambda p: pvary(p, DATA_AXIS), last_params
                 )
-                # tokens are both the pipeline input (embedded at stage
-                # 0) and the shifted next-token targets (last stage)
-                loss, stage_grads, d_embed, last_grads = piped(
-                    stages, outer["embed"], last_params,
-                    tok_micro, tok_micro,
+            out = run_fn(stages, embed, last_params, dm, tm)
+            if has_data:
+                from jax import lax
+
+                out = jax.tree.map(
+                    lambda g: lax.pmean(g, DATA_AXIS), out
                 )
-                grads = {
-                    "outer": {
-                        "embed": d_embed,
-                        "norm_final": last_grads["norm_final"],
-                        "lm_head": last_grads["lm_head"],
-                    },
-                    "stages": stage_grads,
-                }
-                return self._apply_grads(state, grads, lr, loss)
+            return out
+
+        def train_step(state: TrainState, tokens, lr):
+            self._check_micro(tokens)
+            outer = state.params["outer"]
+            stages = state.params["stages"]
+            tok_micro = split_microbatches(tokens, mm)
+            last_params = {
+                "norm_final": outer["norm_final"],
+                "lm_head": outer["lm_head"],
+            }
+            piped = shard_map(
+                run_wrapped,
+                mesh=mesh,
+                in_specs=(P(PIPE_AXIS), P(), P(),
+                          micro_spec, micro_spec),
+                out_specs=(P(), P(PIPE_AXIS), P(), P()),
+            )
+            # tokens are both the pipeline input (embedded at stage
+            # 0) and the shifted next-token targets (last stage)
+            loss, stage_grads, d_embed, last_grads = piped(
+                stages, outer["embed"], last_params,
+                tok_micro, tok_micro,
+            )
+            grads = {
+                "outer": {
+                    "embed": d_embed,
+                    "norm_final": last_grads["norm_final"],
+                    "lm_head": last_grads["lm_head"],
+                },
+                "stages": stage_grads,
+            }
+            return self._apply_grads(state, grads, lr, loss)
+
+        return train_step
+
+    def _make_steps_interleaved(self, micro_spec, has_data,
+                                stage_fn) -> None:
+        """Steps for schedule='interleaved': the Megatron virtual-stage
+        1F1B schedule over the device-major round-robin chunk layout
+        (tables precomputed and verified by
+        tpuflow.parallel.interleave.build_interleaved_schedule)."""
+        mesh = self.mesh
+        mm = self.n_microbatches
+        n, v = self.n_stages, self.virtual_stages
+        sched = build_interleaved_schedule(n, v, mm)
+        fwd_sched = build_interleaved_schedule(n, v, mm, forward_only=True)
+
+        first_fn, last_fn = self._first_last_fns()
+        run_train = pipeline_interleaved(
+            first_fn, stage_fn, last_fn, sched, PIPE_AXIS
+        )
+        run_eval = pipeline_interleaved_fwd(
+            first_fn, stage_fn, fwd_sched, PIPE_AXIS
+        )
+        train_step = self._build_1f1b_train_step(
+            run_train, micro_spec, has_data
+        )
+
+        def eval_step(state: TrainState, tokens):
+            self._check_micro(tokens)
+            outer = state.params["outer"]
+            tok_micro = split_microbatches(tokens, mm)
+            piped = shard_map(
+                lambda sb, emb, mi: from_last_stage(
+                    run_eval(sb, emb, mi), PIPE_AXIS
+                ),
+                mesh=mesh,
+                in_specs=(P(PIPE_AXIS), P(), micro_spec),
+                out_specs=micro_spec,
+            )
+            y = piped(state.params["stages"], outer["embed"], tok_micro)
+            y = y.reshape(tokens.shape[0], *y.shape[2:])
+            logits = self._head(
+                outer["norm_final"], outer["lm_head"]["kernel"], y
+            )
+            return {"loss": next_token_loss(logits, tokens)}
 
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
@@ -376,10 +496,10 @@ class PipelineTrainer(LMTrainer):
         out = dict(params["outer"])
         per = self.blocks_per_stage
         stages = params["stages"]
-        for s in range(self.n_stages):
+        for row, s in enumerate(self._stage_order):
             for j in range(per):
                 out[f"block{s * per + j}"] = jax.tree.map(
-                    lambda a: np.asarray(a[s]),
+                    lambda a, row=row: np.asarray(a[row]),
                     stages[f"b{j}"],
                 )
         return out
