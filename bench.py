@@ -465,8 +465,8 @@ def _attention_sweep(diag: dict, rtt_ms: float = 0.0) -> None:
         v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
         steps = 10
         results = {}
-        for bq in (128, 256, 512):
-            for bk in (128, 256, 512):
+        for bq in (128, 256, 512, 1024):
+            for bk in (128, 256, 512, 1024):
                 ms = _timed_scan(
                     jax,
                     lambda c, bq=bq, bk=bk: flash_attention(
@@ -852,6 +852,15 @@ def _bench_e2e(args, devices) -> int:
     rtt_ms = _measure_rtt()
     work = tempfile.mkdtemp(prefix="tpuflow_e2e_")
     conv = None
+    t_start = time.time()
+
+    def _phase(name):
+        # timestamped phase marker: the e2e path spans host synthesis,
+        # table IO, compile and the fit loop — when a run blows its
+        # watchdog, this is how the stall gets localized
+        print(f"# e2e phase [{time.time() - t_start:7.1f}s] {name}",
+              file=sys.stderr, flush=True)
+
     try:
         img_dir = os.path.join(work, "imgs", "flower")
         os.makedirs(img_dir)
@@ -864,14 +873,17 @@ def _bench_e2e(args, devices) -> int:
             with open(os.path.join(img_dir, f"{i}.jpg"), "wb") as f:
                 f.write(buf.getvalue())
         synth_s = time.time() - t0
+        _phase(f"synthesized {n_img} jpegs")
 
         store = TableStore(os.path.join(work, "tables"), "bench")
         table = store.table("imgs")
         ingest_images(os.path.dirname(img_dir), table)
+        _phase("ingested")
         t = add_label_from_path(table.read())
         table.write(index_labels(t, {"flower": 0}))
 
         conv = make_converter(table, os.path.join(work, "cache"))
+        _phase("converter ready")
         ds = conv.make_dataset(
             batch * n_chips, img_height=hw, img_width=hw,
             cache_decoded=True, reuse_buffers=True,
@@ -897,6 +909,7 @@ def _bench_e2e(args, devices) -> int:
                                     jnp.asarray(1e-3, jnp.float32))
         float(m0["loss"])
         compile_s = time.time() - t0
+        _phase(f"step compiled ({compile_s:.1f}s)")
         # the warm step DONATED trainer.state's buffers — rebuild fresh
         # state so fit() starts from a valid (and untrained) init
         trainer.init_state((hw, hw, 3))
@@ -948,10 +961,13 @@ def _bench_e2e(args, devices) -> int:
                     unit="images/s/chip",
                 )
 
+        _phase("fit start")
         trainer.fit(ds, epochs=3, steps_per_epoch=steps,
                     callbacks=[_Times()])
+        _phase("fit done")
         diag = _diag()
         diag["decode_img_per_s"] = round(_decode_diag(hw), 0)
+        _phase("decode diag done")
         _transport_diag(diag, rtt_ms, smoke=args.smoke)
         if args.attn_sweep:
             _attention_sweep(diag, rtt_ms=rtt_ms)
@@ -992,8 +1008,11 @@ def _bench_lm(args, devices) -> int:
     if args.smoke:
         seq, batch, dim, depth, heads, vocab = 128, args.batch or 2, 64, 2, 4, 256
     else:
+        # heads=8 ⇒ head_dim 128: a 64-deep MXU contraction (heads=16)
+        # runs the systolic array at half depth; 128 is the production
+        # long-context head size and the kernel's native lane width
         seq, batch, dim, depth, heads, vocab = (
-            4096, args.batch or 4, 1024, 12, 16, 32000
+            4096, args.batch or 4, 1024, 12, 8, 32000
         )
     model = build_transformer_lm(
         vocab_size=vocab, dim=dim, depth=depth, heads=heads,

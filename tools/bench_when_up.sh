@@ -30,7 +30,12 @@ while true; do
     [ -f BENCH_LOCAL_r03_vit.json ] || capture BENCH_LOCAL_r03_vit.json --model vit --steps 15 || ok=1
     [ -f BENCH_LOCAL_r03_resnet50.json ] || capture BENCH_LOCAL_r03_resnet50.json --model resnet50 --steps 20 --no-attn-diag || ok=1
     [ -f BENCH_LOCAL_r03_lm.json ] || capture BENCH_LOCAL_r03_lm.json --model lm --steps 10 --no-attn-diag || ok=1
-    [ -f BENCH_LOCAL_r03_e2e.json ] || capture BENCH_LOCAL_r03_e2e.json --end2end --no-attn-diag || ok=1
+    # tuned re-captures (round-3 perf pass: flash block defaults
+    # 128->512, LM head_dim 64->128): keep the originals as the
+    # before/after record
+    [ -f BENCH_LOCAL_r03_lm_tuned.json ] || capture BENCH_LOCAL_r03_lm_tuned.json --model lm --steps 10 --no-attn-diag || ok=1
+    [ -f BENCH_LOCAL_r03_vit_b256.json ] || capture BENCH_LOCAL_r03_vit_b256.json --model vit --batch 256 --steps 10 --no-attn-diag || ok=1
+    [ -f BENCH_LOCAL_r03_e2e.json ] || capture BENCH_LOCAL_r03_e2e.json --end2end --no-attn-diag --deadline 2300 || ok=1
     if [ "$ok" -eq 0 ]; then
       # bonus (non-gating): kernel block-size sweep for the tuning table
       [ -f BENCH_LOCAL_r03_sweep.json ] || capture BENCH_LOCAL_r03_sweep.json --model vit --steps 15 --attn-sweep || true

@@ -488,8 +488,8 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
     return_lse: bool = False,
 ):
@@ -501,6 +501,15 @@ def flash_attention(
     log-sum-exp (float32, shape ``(batch, heads, seq)``) for softmax
     merging across shards (ring attention); the lse path is
     forward-only.
+
+    Default blocks are 512x512 (clamped to the sequence): the dominant
+    cost at small blocks is per-grid-iteration overhead (window-swap
+    DMA setup + scalar control, ~1 us/iteration), not the MXU dots — a
+    seq-4096 forward at 128x128 runs 32x more inner iterations than at
+    512x512 for identical FLOPs (measured on v5e round 3: the s=1024
+    d=128 forward diag sat at ~3.7 TFLOP/s under 128x128). VMEM at
+    512x512/d=128 is a few MB against the 128 MB budget; shorter
+    sequences clamp down automatically.
     """
     if q.ndim != 4:
         raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")

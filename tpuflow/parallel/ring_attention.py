@@ -250,8 +250,8 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
     layout: str = "contiguous",
 ):
