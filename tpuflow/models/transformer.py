@@ -224,6 +224,7 @@ class DecoderBlock(nn.Module):
     ep_axis: Optional[str] = None
     decode: bool = False
     sp_layout: str = "contiguous"
+    remat_mlp: bool = False  # checkpoint the MLP sub-block only
 
     @nn.compact
     def __call__(self, x):
@@ -243,11 +244,52 @@ class DecoderBlock(nn.Module):
             # accumulated under mutable=['losses']; no-op otherwise
             self.sow("losses", "moe_aux", aux)
         else:
-            y = SwiGLU(
+            # remat_mlp: checkpoint ONLY the MLP sub-block — attention
+            # (and the flash kernel's residuals) live OUTSIDE any remat
+            # boundary, so the backward never replays the kernel; the
+            # cheap SwiGLU GEMMs are what get recomputed. MoE blocks
+            # skip this (their sow'd aux loss is a mutable side effect
+            # lifted remat must not replay).
+            mlp_cls = nn.remat(SwiGLU) if self.remat_mlp else SwiGLU
+            y = mlp_cls(
                 self.dim, self.dim * self.mlp_ratio, self.dtype,
                 tp=self.seq_axis is None, name="mlp",
             )(y)
         return x + y
+
+
+def lm_head_dot(x, kernel):
+    """The LM head matmul: both operands in the ACTIVATION dtype with
+    float32 accumulation — bf16 models stay on the full-rate MXU path
+    (an f32-operand matmul over a 32k vocab runs ~4-8x slower and was
+    measured dominating the LM step's tail) while the logits come out
+    float32 for the loss. ONE definition shared by :class:`LMHead` and
+    the pipeline trainer's in-stage head, so the two can never drift
+    numerically (their loss-parity tests depend on it)."""
+    return jax.lax.dot_general(
+        x, kernel.astype(x.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+class LMHead(nn.Module):
+    """Vocab projection (column-parallel under TP) via
+    :func:`lm_head_dot`; the kernel param itself remains a float32
+    master weight."""
+
+    vocab_size: int
+    tp: bool
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            _part(_dense_init, (None, MODEL_AXIS), self.tp),
+            (x.shape[-1], self.vocab_size),
+            jnp.float32,
+        )
+        return lm_head_dot(x, kernel)
 
 
 class TransformerLM(nn.Module):
@@ -268,6 +310,7 @@ class TransformerLM(nn.Module):
     ep_axis: Optional[str] = None
     decode: bool = False  # autoregressive KV-cache mode (see infer.generate)
     remat: bool = False  # gradient checkpointing per block (long context)
+    remat_policy: str = "full"  # 'full' | 'attn' (save attention outputs)
     sp_layout: str = "contiguous"  # see CausalAttention.sp_layout
 
     @nn.compact
@@ -280,13 +323,26 @@ class TransformerLM(nn.Module):
             jnp.float32,
         )
         x = jnp.take(embed, tokens, axis=0).astype(self.dtype)
-        # remat trades FLOPs for HBM: block activations are recomputed
-        # in the backward instead of stored — O(sqrt-free) memory per
-        # layer, the standard long-context lever (pairs with the ring's
-        # O(seq/sp) residency). Not in decode mode: the KV cache is a
-        # mutable collection, which lifted remat must not replay.
+        # remat trades FLOPs for HBM: 'full' checkpoints whole blocks
+        # (activations recomputed in the backward — the standard
+        # long-context lever, pairing with the ring's O(seq/sp)
+        # residency). remat_policy='attn' instead checkpoints ONLY each
+        # block's MLP sub-module: the attention residuals (including
+        # the flash kernel's output/lse) stay resident by construction,
+        # so the backward never replays the kernel and only the cheap
+        # SwiGLU GEMMs recompute — the middle rung between full remat
+        # and no remat. Not in decode mode: the KV cache is a mutable
+        # collection, which lifted remat must not replay.
+        if self.remat_policy not in ("full", "attn"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'attn', got "
+                f"{self.remat_policy!r}"
+            )
+        use_remat = self.remat and not self.decode
+        remat_mlp = use_remat and self.remat_policy == "attn"
         block_cls = (
-            nn.remat(DecoderBlock) if self.remat and not self.decode
+            nn.remat(DecoderBlock)
+            if use_remat and self.remat_policy == "full"
             else DecoderBlock
         )
         for i in range(self.depth):
@@ -298,17 +354,12 @@ class TransformerLM(nn.Module):
                 n_experts=self.n_experts if moe_block else 0,
                 moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
                 decode=self.decode, sp_layout=self.sp_layout,
+                remat_mlp=remat_mlp and not moe_block,
                 name=f"block{i}",
             )(x)
         x = RMSNorm(self.dtype, name="norm_final")(x)
         # vocab-sharded LM head (column-parallel); logits in float32
-        return nn.Dense(
-            self.vocab_size,
-            use_bias=False,
-            dtype=jnp.float32,
-            kernel_init=_part(_dense_init, (None, MODEL_AXIS), tp),
-            name="lm_head",
-        )(x.astype(jnp.float32))
+        return LMHead(self.vocab_size, tp, name="lm_head")(x)
 
 
 def build_transformer_lm(
@@ -325,6 +376,7 @@ def build_transformer_lm(
     moe_top_k: int = 2,
     ep_axis: Optional[str] = None,
     remat: bool = False,
+    remat_policy: str = "full",
     sp_layout: str = "contiguous",
 ) -> TransformerLM:
     if dim % heads:
@@ -342,7 +394,7 @@ def build_transformer_lm(
         mlp_ratio=mlp_ratio, dtype=dtype, attn_impl=attn_impl,
         seq_axis=seq_axis, n_experts=n_experts, moe_every=moe_every,
         moe_top_k=moe_top_k, ep_axis=ep_axis, remat=remat,
-        sp_layout=sp_layout,
+        remat_policy=remat_policy, sp_layout=sp_layout,
     )
 
 

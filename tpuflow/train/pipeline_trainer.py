@@ -52,6 +52,7 @@ from tpuflow.models.transformer import (
     DecoderBlock,
     RMSNorm,
     TransformerLM,
+    lm_head_dot,
     next_token_loss,
 )
 from tpuflow.parallel.mesh import build_nd_mesh
@@ -241,11 +242,17 @@ class PipelineTrainer(LMTrainer):
 
     def _stage_fn(self):
         m = self.model
-        cls = nn.remat(DecoderBlock) if m.remat else DecoderBlock
+        # mirror TransformerLM's remat_policy semantics: 'full' wraps
+        # whole blocks, 'attn' checkpoints only the MLP sub-module
+        cls = (
+            nn.remat(DecoderBlock)
+            if m.remat and m.remat_policy == "full" else DecoderBlock
+        )
         blk = cls(
             m.dim, m.heads, m.mlp_ratio, m.dtype,
             attn_impl=m.attn_impl, seq_axis=None,
             rope_theta=m.rope_theta,
+            remat_mlp=m.remat and m.remat_policy == "attn",
         )
 
         def stage_fn(stage_params, x):
@@ -257,7 +264,7 @@ class PipelineTrainer(LMTrainer):
 
     def _head(self, norm_params, head_kernel, y):
         y = RMSNorm(self.model.dtype).apply({"params": norm_params}, y)
-        return y.astype(jnp.float32) @ head_kernel
+        return lm_head_dot(y, head_kernel)
 
     def _check_micro(self, tokens) -> None:
         mb = tokens.shape[0] // self.n_microbatches
