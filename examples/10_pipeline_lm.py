@@ -44,7 +44,11 @@ def main() -> None:
     from jax.sharding import Mesh, PartitionSpec as P
 
     from tpuflow.models import build_transformer_lm, next_token_loss
-    from tpuflow.models.transformer import DecoderBlock, RMSNorm
+    from tpuflow.models.transformer import (
+        DecoderBlock,
+        RMSNorm,
+        lm_head_dot,
+    )
     from tpuflow.parallel.pipeline import (
         from_last_stage,
         pipeline,
@@ -98,7 +102,7 @@ def main() -> None:
         y = piped(stacked_blocks, micro)
         y = y.reshape(x.shape)
         y = norm.apply({"params": params["norm_final"]}, y)
-        return y.astype(jnp.float32) @ params["lm_head"]["kernel"]
+        return lm_head_dot(y, params["lm_head"]["kernel"])
 
     # ---- (1) parity with the unpipelined model -------------------------
     rng = np.random.default_rng(0)

@@ -122,6 +122,20 @@ def test_smoke_other_models_emit_schema(model):
 
 
 @pytest.mark.slow
+def test_smoke_generate_emits_schema():
+    """Decode/serving mode: KV-cache generation throughput with the
+    param-bandwidth roofline anchor."""
+    r = _run("--smoke", "--model", "generate", "--no-attn-diag")
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "generate_tokens_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert 0 < rec["vs_baseline"] < 1  # a decode step can't beat HBM
+    assert rec["diagnostics"]["roofline_steps_per_s"] > 0
+    assert "error" not in rec
+
+
+@pytest.mark.slow
 def test_smoke_end2end_emits_schema():
     r = _run("--smoke", "--end2end", "--e2e-images", "32", "--no-attn-diag")
     assert r.returncode == 0, r.stderr[-2000:]

@@ -8,11 +8,15 @@
 # only intermittently here).
 cd "$(dirname "$0")/.."
 log=/tmp/bench_watch.log
-# recorded at START: the *_tuned re-captures are before/after evidence
-# and only meaningful when a pre-tuning artifact already exists — on a
-# fresh rig the first lm capture is ALREADY post-tuning and a second
-# identical run would just burn the relay window
-have_before_lm=$([ -f BENCH_LOCAL_r03_lm.json ] && echo 1 || echo 0)
+# The *_tuned re-captures are before/after evidence, only meaningful
+# when the existing lm artifact is genuinely PRE-tuning. The check is
+# content-based (the pre-tuning config was heads=16, stamped into the
+# artifact's "model" field as ...h16-...), so it survives watcher
+# restarts: a fresh rig whose first lm capture is already post-tuning
+# (h8) never wastes a relay window on an identical second run.
+have_before_lm() {
+  grep -q 'h16-' BENCH_LOCAL_r03_lm.json 2>/dev/null
+}
 
 capture() {  # capture <out-file> <bench args...>
   local out="$1"; shift
@@ -38,10 +42,11 @@ while true; do
     # tuned re-captures (round-3 perf pass: flash block defaults
     # 128->512, LM head_dim 64->128, bf16-dot head, remat ladder):
     # keep the originals as the before/after record
-    if [ "$have_before_lm" = 1 ]; then
+    if have_before_lm; then
       [ -f BENCH_LOCAL_r03_lm_tuned.json ] || capture BENCH_LOCAL_r03_lm_tuned.json --model lm --steps 10 --no-attn-diag || ok=1
     fi
     [ -f BENCH_LOCAL_r03_vit_b256.json ] || capture BENCH_LOCAL_r03_vit_b256.json --model vit --batch 256 --steps 10 --no-attn-diag || ok=1
+    [ -f BENCH_LOCAL_r03_generate.json ] || capture BENCH_LOCAL_r03_generate.json --model generate --no-attn-diag || ok=1
     [ -f BENCH_LOCAL_r03_e2e.json ] || capture BENCH_LOCAL_r03_e2e.json --end2end --no-attn-diag --deadline 2300 || ok=1
     if [ "$ok" -eq 0 ]; then
       # bonus (non-gating): kernel block-size sweep for the tuning table

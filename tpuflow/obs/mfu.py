@@ -25,22 +25,52 @@ _PEAK_BF16 = {
     "v6 lite": 918e12,
 }
 
+# HBM bandwidth (bytes/s) per chip by generation (public specs) — the
+# denominator for bandwidth-bound metrics (autoregressive decode reads
+# every parameter once per token).
+_HBM_BW = {
+    "v2": 700e9,
+    "v3": 900e9,
+    "v4": 1228e9,
+    "v5e": 819e9,
+    "v5 lite": 819e9,
+    "v5p": 2765e9,
+    "v6e": 1640e9,
+    "v6 lite": 1640e9,
+}
 
-def device_peak_flops(device: Optional[Any] = None) -> float:
-    """Peak bf16 FLOP/s of one chip. Env override TPUFLOW_PEAK_FLOPS."""
-    env = os.environ.get("TPUFLOW_PEAK_FLOPS")
+
+def _device_spec(device, table, env_var: str, cpu_nominal: float,
+                 default: float) -> float:
+    """One lookup template for per-generation chip specs: env override,
+    device_kind substring match against ``table``, CPU nominal for
+    testability, v4 default otherwise — shared so the peak-FLOPs and
+    HBM-bandwidth lookups can never drift procedurally."""
+    env = os.environ.get(env_var)
     if env:
         return float(env)
     import jax
 
     device = device or jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()
-    for key, val in _PEAK_BF16.items():
+    for key, val in table.items():
         if key in kind:
             return val
     if device.platform == "cpu":
-        return 1e11  # nominal, keeps MFU math testable on CPU
-    return 275e12  # default to v4 (the baseline target hardware)
+        return cpu_nominal
+    return default
+
+
+def device_hbm_bandwidth(device: Optional[Any] = None) -> float:
+    """HBM bytes/s of one chip. Env override TPUFLOW_HBM_BW."""
+    return _device_spec(device, _HBM_BW, "TPUFLOW_HBM_BW",
+                        cpu_nominal=50e9, default=1228e9)
+
+
+def device_peak_flops(device: Optional[Any] = None) -> float:
+    """Peak bf16 FLOP/s of one chip. Env override TPUFLOW_PEAK_FLOPS."""
+    return _device_spec(device, _PEAK_BF16, "TPUFLOW_PEAK_FLOPS",
+                        cpu_nominal=1e11, default=275e12)
 
 
 def flops_of_compiled(compiled) -> float:
