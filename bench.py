@@ -1155,7 +1155,8 @@ def _bench_generate(args, devices) -> int:
     scan runs prompt+decode single-token steps against a fixed-length
     cache; each step reads every parameter once, so the natural anchor
     is the PARAM-BANDWIDTH decode roofline: steps/s <= HBM_BW /
-    param_bytes. ``value`` = newly generated tokens/s/chip;
+    streamed_bytes (all weights read whole per token, embedding table
+    gathered per row). ``value`` = newly generated tokens/s/chip;
     ``vs_baseline`` = measured step rate / roofline step rate (decode
     bandwidth utilization)."""
     import jax
@@ -1187,10 +1188,24 @@ def _bench_generate(args, devices) -> int:
             0, vocab, (batch, prompt_len), dtype=np.int32
         )
     )
-    params = model.init({"params": jax.random.key(0)}, prompt)["params"]
+    import flax.linen as nn
+
+    params = nn.unbox(
+        model.init({"params": jax.random.key(0)}, prompt)
+    )["params"]
+    # per-step streamed parameter bytes: every weight matrix is read
+    # whole each token, EXCEPT the embedding table, where a decode step
+    # only gathers `batch` rows (the vocab-wide LM head, by contrast,
+    # is a full read and stays counted)
+    embed = params["embed"]
     param_bytes = sum(
         leaf.size * leaf.dtype.itemsize
         for leaf in jax.tree.leaves(params)
+    )
+    stream_bytes = (
+        param_bytes
+        - embed.size * embed.dtype.itemsize
+        + batch * embed.shape[-1] * embed.dtype.itemsize
     )
 
     def _run():
@@ -1210,7 +1225,7 @@ def _bench_generate(args, devices) -> int:
         _run()
         best = min(best, _rtt_correct(time.time() - t0, rtt_ms))
         tok_s = batch * new_tokens / best / n_chips
-        roofline_steps = device_hbm_bandwidth(devices[0]) / param_bytes
+        roofline_steps = device_hbm_bandwidth(devices[0]) / stream_bytes
         util = (steps / best) / roofline_steps
         diag = {
             "device_kind": devices[0].device_kind,
@@ -1221,6 +1236,7 @@ def _bench_generate(args, devices) -> int:
             "prompt_len": prompt_len,
             "new_tokens": new_tokens,
             "param_bytes": param_bytes,
+            "streamed_bytes_per_step": stream_bytes,
             "step_ms": round(best / steps * 1e3, 3),
             "decode_steps_per_s": round(steps / best, 1),
             "roofline_steps_per_s": round(roofline_steps, 1),

@@ -39,13 +39,17 @@ _WORKER = textwrap.dedent(
     toks = ((start + stride * np.arange(16)[None, :]) % 64).astype(np.int32)
 
     mesh = build_nd_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    # interleaved runs 2 virtual chunks per process (depth 4: the
+    # chunk-wrap ppermute also crosses the process boundary)
+    depth = 4 if sched == "interleaved" else 2
+    vs = 2 if sched == "interleaved" else 1
     tr = PipelineTrainer(
-        build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=4,
+        build_transformer_lm(vocab_size=64, dim=32, depth=depth, heads=4,
                              mlp_ratio=2, dtype=jnp.float32),
         TrainConfig(optimizer="sgd", learning_rate=1e-2,
                     warmup_epochs=0, scale_lr_by_world_size=False,
                     seed=4),
-        mesh=mesh, n_microbatches=4, schedule=sched,
+        mesh=mesh, n_microbatches=4, schedule=sched, virtual_stages=vs,
     )
     m = tr.fit(toks, batch_size=8, epochs=2)
     with open(os.path.join(work, f"pp_metrics_{pid}.json"), "w") as f:
@@ -110,3 +114,34 @@ def test_two_process_pipeline_matches_single(tmp_path):
     loss_1p = tr.fit(toks, batch_size=8, epochs=2)["loss"]
     np.testing.assert_allclose(loss_2p, loss_1p, rtol=5e-4)
     np.testing.assert_allclose(loss_2p_1f1b, loss_1p, rtol=5e-4)
+
+
+def test_two_process_interleaved_matches_single(tmp_path):
+    """Interleaved virtual-stage schedule across REAL process
+    boundaries: with 2 chunks per process the chunk-wrap hop (last
+    chunk of process 1 -> first chunk of process 0's next virtual
+    stage) rides the same inter-process ppermute as the plain ring."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    loss_2p = _run_two_proc(tmp_path / "ilv", "interleaved", 8937)
+
+    rng = np.random.default_rng(5)
+    start = rng.integers(0, 64, (16, 1))
+    stride = rng.integers(1, 7, (16, 1))
+    toks = ((start + stride * np.arange(16)[None, :]) % 64).astype(np.int32)
+    tr = LMTrainer(
+        build_transformer_lm(vocab_size=64, dim=32, depth=4, heads=4,
+                             mlp_ratio=2, dtype=jnp.float32),
+        TrainConfig(optimizer="sgd", learning_rate=1e-2,
+                    warmup_epochs=0, scale_lr_by_world_size=False,
+                    seed=4),
+        mesh=build_nd_mesh({"data": 1}, devices=jax.devices()[:1]),
+    )
+    loss_1p = tr.fit(toks, batch_size=8, epochs=2)["loss"]
+    np.testing.assert_allclose(loss_2p, loss_1p, rtol=5e-4)
