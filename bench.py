@@ -1007,7 +1007,7 @@ def _bench_lm(args, devices) -> int:
     import numpy as np
     import optax
 
-    from tpuflow.models import build_transformer_lm, next_token_loss
+    from tpuflow.models import build_transformer_lm
     from tpuflow.obs.mfu import device_peak_flops, flops_of_jitted
 
     n_chips = len(devices)
@@ -1038,14 +1038,22 @@ def _bench_lm(args, devices) -> int:
     )
     tx = optax.adamw(3e-4)
 
+    from tpuflow.ops.xent import fused_linear_token_loss
+
     def _build(remat_mode: str):
         model = build_transformer_lm(
             vocab_size=vocab, dim=dim, depth=depth, heads=heads,
             attn_impl="auto", remat=remat_mode != "off",
             remat_policy="attn" if remat_mode == "attn" else "full",
         )
-        params = model.init(
-            {"params": jax.random.key(0)}, tokens[:1]
+        # fused vocab-chunked loss: the hidden-states twin shares the
+        # identical param tree; the (B*S, vocab) logits tensor is never
+        # materialized (tpuflow.ops.xent)
+        model_h = model.clone(skip_head=True)
+        import flax.linen as nn
+
+        params = nn.unbox(
+            model.init({"params": jax.random.key(0)}, tokens[:1])
         )["params"]
         params = jax.device_put(params, NamedSharding(mesh, P()))
 
@@ -1053,8 +1061,10 @@ def _bench_lm(args, devices) -> int:
             p, opt = carry
 
             def loss_fn(p):
-                logits = model.apply({"params": p}, tokens, train=True)
-                return next_token_loss(logits, tokens)
+                hidden = model_h.apply({"params": p}, tokens, train=True)
+                return fused_linear_token_loss(
+                    hidden[:, :-1], p["lm_head"]["kernel"], tokens[:, 1:]
+                )
 
             loss, grads = jax.value_and_grad(loss_fn)(p)
             updates, opt = tx.update(grads, opt, p)

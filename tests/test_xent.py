@@ -1,0 +1,224 @@
+"""Fused vocab-chunked linear+cross-entropy (tpuflow.ops.xent).
+
+The op must be a pure reorganization of
+``token_loss(lm_head_dot(hidden, W), targets)``: identical loss AND
+identical gradients (hidden + kernel) across masks, ignore_index,
+label smoothing, non-divisible vocab sizes, and dtypes — plus the
+LMTrainer integration reproducing the materialized-logits trainer
+step for step.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpuflow.models.transformer import lm_head_dot, token_loss
+from tpuflow.ops.xent import fused_linear_token_loss
+
+
+def _data(b=2, s=12, d=16, v=37, seed=0):
+    rng = np.random.default_rng(seed)
+    hidden = jnp.asarray(rng.normal(size=(b, s, d)), jnp.float32)
+    kernel = jnp.asarray(rng.normal(size=(d, v)), jnp.float32)
+    tgt = jnp.asarray(rng.integers(0, v, (b, s)), jnp.int32)
+    return hidden, kernel, tgt
+
+
+@pytest.mark.parametrize("ls", [0.0, 0.1])
+@pytest.mark.parametrize("chunk", [8, 16, 64])
+def test_matches_materialized_loss_and_grads(ls, chunk):
+    hidden, kernel, tgt = _data()
+    tgt = tgt.at[0, 3].set(-1)  # ignore_index
+    mask = jnp.asarray(
+        np.random.default_rng(1).integers(0, 2, tgt.shape), jnp.float32
+    )
+
+    def ref(h, k):
+        return token_loss(lm_head_dot(h, k), tgt, mask=mask,
+                          label_smoothing=ls)
+
+    def fus(h, k):
+        return fused_linear_token_loss(h, k, tgt, mask=mask,
+                                       label_smoothing=ls,
+                                       vocab_chunk=chunk)
+
+    l0, (gh0, gk0) = jax.value_and_grad(ref, argnums=(0, 1))(hidden, kernel)
+    l1, (gh1, gk1) = jax.value_and_grad(fus, argnums=(0, 1))(hidden, kernel)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    np.testing.assert_allclose(gh0, gh1, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gk0, gk1, rtol=1e-4, atol=1e-6)
+
+
+def test_bf16_hidden_path():
+    hidden, kernel, tgt = _data()
+    hb = hidden.astype(jnp.bfloat16)
+    l0 = token_loss(lm_head_dot(hb, kernel), tgt)
+    l1 = fused_linear_token_loss(hb, kernel, tgt, vocab_chunk=16)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-2)
+    g = jax.grad(
+        lambda h: fused_linear_token_loss(h, kernel, tgt, vocab_chunk=16)
+    )(hb)
+    assert g.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
+def test_all_masked_rows_are_safe():
+    hidden, kernel, tgt = _data(b=1, s=4)
+    tgt = jnp.full_like(tgt, -1)
+    loss = fused_linear_token_loss(hidden, kernel, tgt)
+    assert float(loss) == 0.0
+    g = jax.grad(
+        lambda h: fused_linear_token_loss(h, kernel, tgt)
+    )(hidden)
+    np.testing.assert_allclose(np.asarray(g), 0.0)
+
+
+def test_validation():
+    hidden, kernel, tgt = _data()
+    with pytest.raises(ValueError, match="label_smoothing"):
+        fused_linear_token_loss(hidden, kernel, tgt, label_smoothing=1.0)
+    with pytest.raises(ValueError, match="rows"):
+        fused_linear_token_loss(hidden, kernel, tgt[:, :-1])
+    with pytest.raises(ValueError, match="kernel"):
+        fused_linear_token_loss(hidden, kernel[:-1], tgt)
+
+
+def test_lm_trainer_fused_matches_plain():
+    """cfg.fused_loss must reproduce the materialized-logits trainer
+    exactly (DP shard_map path), and TP>1 must be rejected."""
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    def corpus(n, s, seed=0):
+        rng = np.random.default_rng(seed)
+        start = rng.integers(0, 64, (n, 1))
+        stride = rng.integers(1, 7, (n, 1))
+        return ((start + stride * np.arange(s)[None, :]) % 64).astype(
+            np.int32
+        )
+
+    def lm():
+        return build_transformer_lm(vocab_size=64, dim=32, depth=2,
+                                    heads=4, mlp_ratio=2,
+                                    dtype=jnp.float32)
+
+    def cfg(**kw):
+        return TrainConfig(optimizer="sgd", learning_rate=1e-2,
+                           warmup_epochs=0,
+                           scale_lr_by_world_size=False, seed=2, **kw)
+
+    toks = corpus(24, 16)
+    runs = {}
+    for fused in (False, True):
+        tr = LMTrainer(
+            lm(), cfg(fused_loss=fused, label_smoothing=0.05),
+            mesh=build_nd_mesh({"data": 2}, devices=jax.devices()[:2]),
+        )
+        h = []
+        tr.fit(toks, batch_size=8, epochs=2,
+               on_epoch=lambda e, m: h.append(m["loss"]))
+        runs[fused] = (h, tr.evaluate(toks[:8], batch_size=8)["loss"])
+    np.testing.assert_allclose(runs[True][0], runs[False][0], rtol=1e-5)
+    np.testing.assert_allclose(runs[True][1], runs[False][1], rtol=1e-5)
+
+    tr_tp = LMTrainer(
+        lm(), cfg(fused_loss=True),
+        mesh=build_nd_mesh({"data": 1, "model": 2},
+                           devices=jax.devices()[:2]),
+    )
+    with pytest.raises(ValueError, match="fused_loss"):
+        tr_tp._make_steps()
+
+
+def test_lm_trainer_fused_gspmd_and_moe_match_plain():
+    """The GSPMD branch of loss_of through the fused op: ZeRO-1
+    (replicated head, sharded moments) and the MoE train path (fused
+    LM loss + router aux losses)."""
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    rng = np.random.default_rng(7)
+    start = rng.integers(0, 64, (16, 1))
+    stride = rng.integers(1, 7, (16, 1))
+    toks = ((start + stride * np.arange(16)[None, :]) % 64).astype(
+        np.int32
+    )
+
+    def cfg(**kw):
+        return TrainConfig(optimizer="sgd", learning_rate=1e-2,
+                           warmup_epochs=0,
+                           scale_lr_by_world_size=False, seed=2, **kw)
+
+    # ZeRO-1 (tp=1): fused == plain, step for step
+    runs = {}
+    for fused in (False, True):
+        tr = LMTrainer(
+            build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=4,
+                                 mlp_ratio=2, dtype=jnp.float32),
+            cfg(fused_loss=fused),
+            mesh=build_nd_mesh({"data": 2, "model": 1},
+                               devices=jax.devices()[:2]),
+            zero="zero1",
+        )
+        h = []
+        tr.fit(toks, batch_size=8, epochs=2,
+               on_epoch=lambda e, m: h.append(m["loss"]))
+        runs[fused] = h
+    np.testing.assert_allclose(runs[True], runs[False], rtol=1e-5)
+
+    # MoE (expert-sharded): fused LM loss + aux == plain + aux
+    runs = {}
+    for fused in (False, True):
+        tr = LMTrainer(
+            build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=4,
+                                 mlp_ratio=2, dtype=jnp.float32,
+                                 n_experts=4, moe_every=2,
+                                 ep_axis="expert"),
+            cfg(fused_loss=fused),
+            mesh=build_nd_mesh({"data": 2, "expert": 2, "model": 1},
+                               devices=jax.devices()[:4]),
+        )
+        h = []
+        tr.fit(toks, batch_size=8, epochs=2,
+               on_epoch=lambda e, m: h.append(m["loss"]))
+        runs[fused] = h
+    np.testing.assert_allclose(runs[True], runs[False], rtol=1e-5)
+
+
+def test_lm_trainer_fused_striped_sp_matches_plain():
+    """The striped sequence-parallel loss path (permuted targets +
+    validity mask) through the fused op."""
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    rng = np.random.default_rng(3)
+    start = rng.integers(0, 64, (16, 1))
+    stride = rng.integers(1, 7, (16, 1))
+    toks = ((start + stride * np.arange(16)[None, :]) % 64).astype(
+        np.int32
+    )
+    runs = {}
+    for fused in (False, True):
+        tr = LMTrainer(
+            build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=4,
+                                 mlp_ratio=2, dtype=jnp.float32,
+                                 seq_axis="seq", sp_layout="striped"),
+            TrainConfig(optimizer="sgd", learning_rate=1e-2,
+                        warmup_epochs=0, scale_lr_by_world_size=False,
+                        seed=2, fused_loss=fused),
+            mesh=build_nd_mesh({"data": 1, "seq": 4},
+                               devices=jax.devices()[:4]),
+        )
+        h = []
+        tr.fit(toks, batch_size=8, epochs=2,
+               on_epoch=lambda e, m: h.append(m["loss"]))
+        runs[fused] = h
+    np.testing.assert_allclose(runs[True], runs[False], rtol=1e-5)

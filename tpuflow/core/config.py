@@ -83,6 +83,11 @@ class TrainConfig:
     # (exactly equivalent to the unaccumulated step for mean losses).
     # Honored by LMTrainer; 1 = off.
     grad_accum_steps: int = 1
+    # LMTrainer: compute the LM loss with the fused vocab-chunked
+    # linear+cross-entropy (tpuflow.ops.xent) — identical math, never
+    # materializes the (B*S, vocab) logits tensor (2+ GB at production
+    # shapes). Requires a replicated LM head (tensor-parallel size 1).
+    fused_loss: bool = False
     reduce_on_plateau_factor: float = 0.1
     early_stopping_patience: Optional[int] = None  # ≙ EarlyStopping, P2/03:397-401
     checkpoint_dir: Optional[str] = None

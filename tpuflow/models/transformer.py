@@ -276,10 +276,15 @@ def lm_head_dot(x, kernel):
 class LMHead(nn.Module):
     """Vocab projection (column-parallel under TP) via
     :func:`lm_head_dot`; the kernel param itself remains a float32
-    master weight."""
+    master weight. ``project=False`` CREATES the param but returns the
+    hidden states untouched — the skip_head mode of TransformerLM,
+    which keeps the parameter tree identical so checkpoints/packaging
+    see one layout while a fused loss (tpuflow.ops.xent) consumes the
+    kernel directly."""
 
     vocab_size: int
     tp: bool
+    project: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -289,7 +294,7 @@ class LMHead(nn.Module):
             (x.shape[-1], self.vocab_size),
             jnp.float32,
         )
-        return lm_head_dot(x, kernel)
+        return lm_head_dot(x, kernel) if self.project else x
 
 
 class TransformerLM(nn.Module):
@@ -312,6 +317,7 @@ class TransformerLM(nn.Module):
     remat: bool = False  # gradient checkpointing per block (long context)
     remat_policy: str = "full"  # 'full' | 'attn' (save attention outputs)
     sp_layout: str = "contiguous"  # see CausalAttention.sp_layout
+    skip_head: bool = False  # return final-norm hidden states, not logits
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -358,8 +364,13 @@ class TransformerLM(nn.Module):
                 name=f"block{i}",
             )(x)
         x = RMSNorm(self.dtype, name="norm_final")(x)
-        # vocab-sharded LM head (column-parallel); logits in float32
-        return LMHead(self.vocab_size, tp, name="lm_head")(x)
+        # vocab-sharded LM head (column-parallel); logits in float32.
+        # skip_head keeps the param (identical tree) but returns the
+        # hidden states for a fused linear+loss (tpuflow.ops.xent)
+        return LMHead(
+            self.vocab_size, tp, project=not self.skip_head,
+            name="lm_head",
+        )(x)
 
 
 def build_transformer_lm(

@@ -1,0 +1,225 @@
+"""Fused linear + cross-entropy over a vocab-chunked scan.
+
+The LM loss is the last large-tensor sink in a decoder training step:
+``logits = hidden @ W`` materializes a ``(B·S, vocab)`` float32 tensor
+(2.1 GB at the bench shapes) that XLA writes, reads for log-softmax,
+keeps as a backward residual, and touches again for ``dlogits`` — all
+HBM traffic that never needed to exist, because cross-entropy only
+needs one online logsumexp and one gathered target logit per row.
+
+:func:`fused_linear_token_loss` computes the SAME mean cross-entropy
+as ``token_loss(lm_head_dot(hidden, W), targets)`` (mask,
+ignore_index, label smoothing included) without ever materializing the
+full logits: the forward scans vocab chunks keeping a running
+(max, normalizer, target-logit, logit-sum) per row — the flash-
+attention trick applied to the classifier axis — and the custom-VJP
+backward rebuilds each chunk's logits from the saved ``(hidden, lse)``
+to form ``softmax - onehot`` chunk by chunk, accumulating ``dhidden``
+and ``dW`` with bf16 MXU dots. Peak extra memory is one
+``(rows, vocab_chunk)`` tile instead of the whole logits tensor.
+
+The matmuls run in the ACTIVATION dtype with float32 accumulation
+(tpuflow.models.transformer.lm_head_dot convention — full-rate MXU for
+bf16 models); every reduction is float32. The reference has no
+language-model surface at all (SURVEY.md §2c); this backs the
+beyond-reference LM family's loss path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_NEG_BIG = -1e30
+
+
+class _Cfg(NamedTuple):
+    vocab: int          # true vocab size (kernel may be padded past it)
+    chunk: int          # vocab chunk width (padded vocab divides by it)
+    label_smoothing: float
+
+
+def _chunked_kernel(kernel, cfg: _Cfg):
+    """(D, V) -> (n_chunks, D, chunk), zero-padding the vocab axis."""
+    d, v = kernel.shape
+    pad = (-v) % cfg.chunk
+    if pad:
+        kernel = jnp.pad(kernel, ((0, 0), (0, pad)))
+    n = (v + pad) // cfg.chunk
+    return kernel.reshape(d, n, cfg.chunk).transpose(1, 0, 2)
+
+
+def _fwd_scan(cfg: _Cfg, hidden, kernel, targets):
+    """Online-logsumexp pass. Returns (lse, target_logit, logit_sum),
+    all float32 of shape (rows,)."""
+    rows = hidden.shape[0]
+    wc = _chunked_kernel(kernel, cfg)
+
+    def step(carry, xs):
+        m, s, tl, tot = carry
+        ci, w_c = xs
+        base = ci * cfg.chunk
+        logits = lax.dot_general(
+            hidden, w_c.astype(hidden.dtype),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        col_ok = base + jnp.arange(cfg.chunk) < cfg.vocab
+        masked = jnp.where(col_ok[None, :], logits, _NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(masked, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.where(col_ok[None, :], jnp.exp(masked - m_new[:, None]),
+                      0.0),
+            axis=-1,
+        )
+        tot = tot + jnp.sum(
+            jnp.where(col_ok[None, :], logits, 0.0), axis=-1
+        )
+        off = targets - base
+        in_c = (off >= 0) & (off < cfg.chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(off, 0, cfg.chunk - 1)[:, None], axis=1
+        )[:, 0]
+        tl = tl + jnp.where(in_c, picked, 0.0)
+        return (m_new, s, tl, tot), None
+
+    n = wc.shape[0]
+    init = (
+        jnp.full((rows,), _NEG_BIG, jnp.float32),
+        jnp.zeros((rows,), jnp.float32),
+        jnp.zeros((rows,), jnp.float32),
+        jnp.zeros((rows,), jnp.float32),
+    )
+    (m, s, tl, tot), _ = lax.scan(step, init, (jnp.arange(n), wc))
+    lse = m + jnp.log(jnp.maximum(s, 1e-37))
+    return lse, tl, tot
+
+
+def _loss_from_stats(cfg: _Cfg, lse, tl, tot, valid):
+    nll_t = lse - tl
+    nll_u = lse - tot / cfg.vocab
+    eps = cfg.label_smoothing
+    losses = (1.0 - eps) * nll_t + eps * nll_u
+    return jnp.sum(losses * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fused_core(cfg: _Cfg, hidden, kernel, targets, valid):
+    lse, tl, tot = _fwd_scan(cfg, hidden, kernel, targets)
+    return _loss_from_stats(cfg, lse, tl, tot, valid)
+
+
+def _fused_core_fwd(cfg: _Cfg, hidden, kernel, targets, valid):
+    lse, tl, tot = _fwd_scan(cfg, hidden, kernel, targets)
+    loss = _loss_from_stats(cfg, lse, tl, tot, valid)
+    return loss, (hidden, kernel, targets, valid, lse)
+
+
+def _fused_core_bwd(cfg: _Cfg, res, g):
+    hidden, kernel, targets, valid, lse = res
+    rows = hidden.shape[0]
+    wc = _chunked_kernel(kernel, cfg)
+    eps = cfg.label_smoothing
+    # d(loss)/d(logit[r, v]) = w_r * (softmax - (1-eps)*onehot - eps/V)
+    w = g * valid / jnp.maximum(jnp.sum(valid), 1.0)
+
+    def step(dh, xs):
+        ci, w_c = xs
+        base = ci * cfg.chunk
+        logits = lax.dot_general(
+            hidden, w_c.astype(hidden.dtype),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        col_ok = base + jnp.arange(cfg.chunk) < cfg.vocab
+        p = jnp.where(
+            col_ok[None, :], jnp.exp(logits - lse[:, None]), 0.0
+        )
+        off = targets - base
+        in_c = (off >= 0) & (off < cfg.chunk)
+        onehot = (
+            jnp.arange(cfg.chunk)[None, :]
+            == jnp.clip(off, 0, cfg.chunk - 1)[:, None]
+        ) & in_c[:, None]
+        d = p - (1.0 - eps) * onehot - jnp.where(
+            col_ok[None, :], eps / cfg.vocab, 0.0
+        )
+        dl = (d * w[:, None]).astype(hidden.dtype)
+        dh = dh + lax.dot_general(
+            dl, w_c.astype(hidden.dtype),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dw_c = lax.dot_general(
+            hidden, dl, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dh, dw_c
+
+    n = wc.shape[0]
+    dh, dw_chunks = lax.scan(
+        step, jnp.zeros(hidden.shape, jnp.float32), (jnp.arange(n), wc)
+    )
+    d_v = kernel.shape[1]
+    dw = dw_chunks.transpose(1, 0, 2).reshape(kernel.shape[0], -1)
+    dw = dw[:, :d_v].astype(kernel.dtype)
+    ct_int = np.zeros(targets.shape, jax.dtypes.float0)
+    return dh.astype(hidden.dtype), dw, ct_int, jnp.zeros_like(valid)
+
+
+_fused_core.defvjp(_fused_core_fwd, _fused_core_bwd)
+
+
+def fused_linear_token_loss(
+    hidden,
+    kernel,
+    targets,
+    mask=None,
+    ignore_index: int = -1,
+    label_smoothing: float = 0.0,
+    vocab_chunk: int = 8192,
+):
+    """Mean cross-entropy of ``(hidden @ kernel)[i]`` predicting
+    ``targets[i]`` — identical semantics to
+    ``token_loss(lm_head_dot(hidden, kernel), targets, ...)``
+    (tpuflow.models.transformer) — WITHOUT materializing the logits.
+
+    ``hidden``: (..., D) activations; ``kernel``: (D, vocab);
+    ``targets``: (...) int32 (same leading shape as hidden); ``mask``
+    broadcastable to targets. Differentiable w.r.t. hidden and kernel.
+    The caller applies any next-token shift (as with token_loss).
+    """
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}"
+        )
+    if hidden.shape[:-1] != targets.shape:
+        raise ValueError(
+            f"hidden rows {hidden.shape[:-1]} != targets {targets.shape}"
+        )
+    d = hidden.shape[-1]
+    vocab = kernel.shape[1]
+    if kernel.shape[0] != d:
+        raise ValueError(
+            f"kernel {kernel.shape} does not match hidden dim {d}"
+        )
+    rows_shape = targets.shape
+    h2 = hidden.reshape(-1, d)
+    t2 = targets.reshape(-1)
+    valid = (t2 != ignore_index).astype(jnp.float32)
+    if mask is not None:
+        valid = valid * jnp.broadcast_to(
+            mask, rows_shape
+        ).reshape(-1).astype(jnp.float32)
+    t2 = jnp.where(t2 == ignore_index, 0, t2)
+    cfg = _Cfg(
+        vocab=vocab,
+        chunk=min(int(vocab_chunk), max(128, vocab)),
+        label_smoothing=float(label_smoothing),
+    )
+    return _fused_core(cfg, h2, kernel, t2, valid)

@@ -267,6 +267,34 @@ class LMTrainer:
         mesh = self.mesh
         out_shardings = None
 
+        fused = bool(self.cfg.fused_loss)
+        if fused:
+            if self._gspmd and self.tp > 1:
+                raise ValueError(
+                    "fused_loss needs a replicated LM head; it cannot "
+                    "combine with tensor parallelism (the vocab-chunked "
+                    "scan conflicts with the column-sharded kernel) — "
+                    "drop fused_loss or set model axis size 1"
+                )
+            from tpuflow.ops.xent import fused_linear_token_loss
+
+            # identical param tree (LMHead still creates 'kernel');
+            # apply returns the final-norm hidden states instead
+            model_h = model.clone(skip_head=True)
+
+            def _fused(p, hidden, targets, mask, ls):
+                return fused_linear_token_loss(
+                    hidden, p["lm_head"]["kernel"], targets, mask=mask,
+                    label_smoothing=ls,
+                )
+
+        def _shifted_loss(p, out, tokens, ls):
+            """The next-token tail shared by every non-striped path:
+            ``out`` is logits (plain) or hidden states (fused)."""
+            if fused:
+                return _fused(p, out[:, :-1], tokens[:, 1:], None, ls)
+            return next_token_loss(out, tokens, label_smoothing=ls)
+
         if self._gspmd:
             # GSPMD: ONE jitted program over the (data, model[, expert])
             # mesh — XLA's partitioner inserts the data-axis grad
@@ -274,11 +302,13 @@ class LMTrainer:
             # sharded matmuls, the expert all-to-alls, and ZeRO's
             # scatter/gather around the update.
             def loss_of(p, tokens, train):
+                ls = self.cfg.label_smoothing if train else 0.0
+                net = model_h if fused else model
                 if model.n_experts > 0 and train:
                     # MoE training: LM loss + the routers' load-balance
                     # aux losses (sown into the mutable 'losses'
                     # collection by tpuflow.models.moe)
-                    logits, coll = model.apply(
+                    out, coll = net.apply(
                         {"params": p}, tokens, train=True,
                         mutable=["losses"],
                     )
@@ -286,22 +316,15 @@ class LMTrainer:
                         jnp.sum(a)
                         for a in jax.tree.leaves(coll.get("losses", {}))
                     )
-                    return next_token_loss(
-                        logits, tokens,
-                        label_smoothing=self.cfg.label_smoothing,
-                    ) + aux
-                return next_token_loss(
-                    model.apply({"params": p}, tokens, train=train),
-                    tokens,
-                    label_smoothing=(
-                        self.cfg.label_smoothing if train else 0.0
-                    ),
-                )
+                    return _shifted_loss(p, out, tokens, ls) + aux
+                out = net.apply({"params": p}, tokens, train=train)
+                return _shifted_loss(p, out, tokens, ls)
 
             out_shardings = (self._state_shardings, None)
         else:
+            net = model_h if fused else model
             fwd = shard_map(
-                lambda p, t, train: model.apply(
+                lambda p, t, train: net.apply(
                     {"params": p}, t, train=train
                 ),
                 mesh=mesh,
@@ -341,7 +364,7 @@ class LMTrainer:
 
                     s = tokens.shape[1]
                     perm = striped_permutation(s, self.sp)
-                    logits = fwd(
+                    out = fwd(
                         p, jnp.take(tokens, perm, axis=1), train
                     )
                     tgt_pos = np.minimum(perm + 1, s - 1)
@@ -349,12 +372,13 @@ class LMTrainer:
                     valid = jnp.asarray(
                         (perm + 1 < s).astype(np.float32)
                     )[None, :]
+                    if fused:
+                        return _fused(p, out, targets, valid, ls)
                     return token_loss(
-                        logits, targets, mask=valid, label_smoothing=ls
+                        out, targets, mask=valid, label_smoothing=ls
                     )
-                return next_token_loss(
-                    fwd(p, tokens, train), tokens, label_smoothing=ls
-                )
+                out = fwd(p, tokens, train)
+                return _shifted_loss(p, out, tokens, ls)
 
         accum = max(1, int(self.cfg.grad_accum_steps))
 

@@ -155,6 +155,13 @@ class PipelineTrainer(LMTrainer):
                 "microbatching already splits the batch — raise "
                 "n_microbatches instead"
             )
+        if self.cfg.fused_loss:
+            raise ValueError(
+                "fused_loss is not honored by PipelineTrainer: the "
+                "loss head runs inside the last pipeline stage's "
+                "backward — per-microbatch logits are already "
+                "chunk-sized there"
+            )
         self.n_stages = n_stages
         self.virtual_stages = v
         self.blocks_per_stage = model.depth // (n_stages * v)
