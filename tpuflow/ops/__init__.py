@@ -14,3 +14,4 @@ from tpuflow.ops.attention import (  # noqa: F401
     mha_xla,
     pick_attn_impl,
 )
+from tpuflow.ops.xent import fused_linear_token_loss  # noqa: F401
