@@ -46,6 +46,7 @@ import os
 import sys
 import threading
 import time
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -56,16 +57,37 @@ _EMITTED = False
 _PROVISIONAL: dict = {}
 
 
-def _last_known_good():
+# which bench mode this process is running ("cnn", "vit", "resnet50",
+# "lm", "generate", "e2e") — set by main(), stamped into emitted
+# records, and used to pick a like-for-like last-known-good artifact
+_MODE: Optional[str] = None
+
+
+def _last_known_good(metric: Optional[str] = None):
     """The most recent committed on-chip result (BENCH_LOCAL_*.json) —
     embedded in failure-path output so a dead TPU tunnel at bench time
-    doesn't erase the evidence that a measurement was captured."""
+    doesn't erase the evidence that a measurement was captured.
+    Preference order: same MODE as the failed run (three image models
+    share one metric, and a failed flagship run must not surface the
+    much-slower ViT number just because its capture is newer) > same
+    metric > any valid artifact. Mode matches via the record's "mode"
+    stamp or, for older artifacts, the capture filename."""
     import glob
+    import re
 
     here = os.path.dirname(os.path.abspath(__file__))
     paths = glob.glob(os.path.join(here, "BENCH_LOCAL_*.json"))
+
+    def mode_of(rec, path):
+        if rec.get("mode"):
+            return rec["mode"]
+        m = re.match(r"BENCH_LOCAL_r\d+_([a-z0-9]+)", os.path.basename(path))
+        return m.group(1) if m else None
+
     # newest first by mtime (lexicographic r9 > r10 would lie), falling
     # back through older artifacts if the newest is corrupt
+    by_metric = None
+    fallback = None
     for p in sorted(paths, key=os.path.getmtime, reverse=True):
         if "retracted" in os.path.basename(p):
             continue
@@ -77,10 +99,14 @@ def _last_known_good():
             if rec.get("retracted") or (rec.get("error") and not rec.get("value")):
                 continue
             rec["source_file"] = os.path.basename(p)
-            return rec
+            if _MODE is not None and mode_of(rec, p) == _MODE:
+                return rec
+            if metric is not None and rec.get("metric") == metric:
+                by_metric = by_metric or rec
+            fallback = fallback or rec
         except Exception:
             continue
-    return None
+    return by_metric or fallback
 
 
 def emit(value: float, vs_baseline: float, error=None, diagnostics=None,
@@ -98,9 +124,11 @@ def emit(value: float, vs_baseline: float, error=None, diagnostics=None,
             "unit": unit,
             "vs_baseline": round(float(vs_baseline), 4),
         }
+        if _MODE is not None:
+            rec["mode"] = _MODE
         if error is not None:
             rec["error"] = str(error)[:2000]
-            lkg = _last_known_good()
+            lkg = _last_known_good(metric)
             if lkg is not None:
                 rec["last_known_good"] = lkg
         if diagnostics:
@@ -626,6 +654,8 @@ def main() -> int:
                         "(serving loop; vs_baseline anchors to the "
                         "param-bandwidth decode roofline)")
     args = p.parse_args()
+    global _MODE
+    _MODE = "e2e" if args.end2end else args.model
     if args.end2end and args.model != "cnn":
         p.error("--end2end measures the cnn (MobileNetV2 transfer) "
                 "pipeline only; drop --model or use --model cnn")

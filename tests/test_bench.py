@@ -106,6 +106,37 @@ def test_last_known_good_selection(tmp_path, monkeypatch):
     rec = bench._last_known_good()
     assert rec["value"] == 111.0
 
+    # metric preference: a failed flagship run must surface the
+    # flagship artifact even when a tokens/s capture is newer,
+    # falling back to any valid record for an unknown metric
+    write("BENCH_LOCAL_r03_cnn.json",
+          {"value": 333.0, "metric": "train_images_per_sec_per_chip"},
+          age=60)
+    write("BENCH_LOCAL_r03_lm.json",
+          {"value": 444.0, "metric": "train_tokens_per_sec_per_chip"},
+          age=2)
+    rec = bench._last_known_good("train_images_per_sec_per_chip")
+    assert rec["value"] == 333.0
+    rec = bench._last_known_good("train_tokens_per_sec_per_chip")
+    assert rec["value"] == 444.0
+    rec = bench._last_known_good("no_such_metric")
+    assert rec["value"] == 444.0  # newest valid fallback
+
+    # mode preference outranks metric recency: three image models share
+    # one metric, and a failed cnn run must surface the CNN artifact
+    # even when the vit capture is newer (filename-derived mode for old
+    # artifacts, "mode" stamp for new ones)
+    write("BENCH_LOCAL_r03_vit.json",
+          {"value": 642.0, "metric": "train_images_per_sec_per_chip"},
+          age=1)
+    monkeypatch.setattr(bench, "_MODE", "cnn")
+    rec = bench._last_known_good("train_images_per_sec_per_chip")
+    assert rec["source_file"] == "BENCH_LOCAL_r03_cnn.json"
+    assert rec["value"] == 333.0
+    monkeypatch.setattr(bench, "_MODE", "vit")
+    rec = bench._last_known_good("train_images_per_sec_per_chip")
+    assert rec["value"] == 642.0
+
 
 @pytest.mark.slow
 @pytest.mark.parametrize("model", ["vit", "resnet50"])
