@@ -1,2 +1,2 @@
-from tpuflow.infer.batch import predict_table  # noqa: F401
+from tpuflow.infer.batch import generate_table, predict_table  # noqa: F401
 from tpuflow.infer.generate import clear_compile_cache, generate  # noqa: F401

@@ -19,46 +19,41 @@ shard-thinned or row-group-truncated record batches. In
 gets from Spark's per-partition UDF execution. (The return-a-table
 mode necessarily holds the shard's result in memory; use
 ``output_table`` for beyond-memory tables.)
+
+Two frontends share the machinery: :func:`predict_table` maps the
+image classifier's packaged model (bytes → class-name strings), and
+:func:`generate_table` maps a packaged LM's text surface (prompt
+strings → continuations) — the LM family's C16, which the reference
+cannot express at all (its only inference is image classification).
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import pyarrow as pa
 
 from tpuflow.data.loader import take_shard_rows
 from tpuflow.data.table import Table
-from tpuflow.packaging.model import PackagedModel, load_packaged_model
 
 
-def predict_table(
-    model: "PackagedModel | str",
+def _map_table_shard(
+    map_fn: Callable[[Sequence], List[str]],
+    out_field: pa.Field,
     table: Table,
-    content_col: str = "content",
-    batch_size: int = 64,
-    shard: Tuple[int, int] = (0, 1),
-    limit: Optional[int] = None,
-    output_table: Optional[Table] = None,
-    store=None,
-    registry=None,
-    flush_rows: int = 4096,
+    content_col: str,
+    batch_size: int,
+    shard: Tuple[int, int],
+    limit: Optional[int],
+    output_table: Optional[Table],
+    flush_rows: int,
 ) -> Optional[pa.Table]:
-    """Map a packaged model over one shard of ``table``, streaming.
-
-    Returns the shard's rows with a ``prediction`` string column
-    appended (≙ df.withColumn('prediction', udf('content')),
-    P2/03:468-472). ``limit`` mirrors the notebook's ``limit(1000)``
-    smoke runs (P2/03:470) and counts GLOBAL (pre-shard) rows. With
-    ``output_table``, prediction chunks are appended there in
-    ``flush_rows``-sized commits instead of being accumulated, and the
-    return value is ``None`` — the bounded-memory multi-host pattern
-    (every process writes its own shard; shard (i,n) rows are disjoint
-    by construction).
-    """
-    if isinstance(model, str):
-        model = load_packaged_model(model, store=store, registry=registry)
-
+    """Stream one shard of ``table`` through ``map_fn`` (a list of
+    ``content_col`` values in, one output string per row out), appending
+    the results as ``out_field``. The shared engine behind
+    predict_table/generate_table — sharding, full-batch buffering,
+    limit, and the bounded-memory output_table protocol live here
+    exactly once."""
     chunks: List[pa.Table] = []  # return path only
     out_pending: List[pa.Table] = []  # output_table path only
     out_pending_rows = 0
@@ -101,11 +96,9 @@ def predict_table(
         allt = pa.concat_tables(ready)
         head, rest = allt.slice(0, take), allt.slice(take)
         # by-name lookup raises KeyError on a missing/misspelled column
-        preds = model.predict(
-            head.column(content_col).to_pylist(), batch_size
-        )
+        outs = map_fn(head.column(content_col).to_pylist())
         deliver(
-            head.append_column("prediction", pa.array(preds, pa.string()))
+            head.append_column(out_field, pa.array(outs, out_field.type))
         )
         ready = [rest] if rest.num_rows else []
         n_ready = rest.num_rows
@@ -132,11 +125,90 @@ def predict_table(
         # readers never race a missing _latest; ensure() is atomic and
         # never clobbers rows a sibling shard already appended
         if not ensured:
-            output_table.ensure(
-                table.schema().append(pa.field("prediction", pa.string()))
-            )
+            output_table.ensure(table.schema().append(out_field))
         return None
     if not chunks:
-        schema = table.schema().append(pa.field("prediction", pa.string()))
-        return schema.empty_table()
+        return table.schema().append(out_field).empty_table()
     return pa.concat_tables(chunks)
+
+
+def predict_table(
+    model,
+    table: Table,
+    content_col: str = "content",
+    batch_size: int = 64,
+    shard: Tuple[int, int] = (0, 1),
+    limit: Optional[int] = None,
+    output_table: Optional[Table] = None,
+    store=None,
+    registry=None,
+    flush_rows: int = 4096,
+) -> Optional[pa.Table]:
+    """Map a packaged image model over one shard of ``table``, streaming.
+
+    Returns the shard's rows with a ``prediction`` string column
+    appended (≙ df.withColumn('prediction', udf('content')),
+    P2/03:468-472). ``limit`` mirrors the notebook's ``limit(1000)``
+    smoke runs (P2/03:470) and counts GLOBAL (pre-shard) rows. With
+    ``output_table``, prediction chunks are appended there in
+    ``flush_rows``-sized commits instead of being accumulated, and the
+    return value is ``None`` — the bounded-memory multi-host pattern
+    (every process writes its own shard; shard (i,n) rows are disjoint
+    by construction).
+    """
+    from tpuflow.packaging.model import load_packaged_model
+
+    if isinstance(model, str):
+        model = load_packaged_model(model, store=store, registry=registry)
+    return _map_table_shard(
+        lambda vals: model.predict(vals, batch_size),
+        pa.field("prediction", pa.string()),
+        table, content_col, batch_size, shard, limit, output_table,
+        flush_rows,
+    )
+
+
+def generate_table(
+    model,
+    table: Table,
+    text_col: str = "text",
+    batch_size: int = 16,
+    shard: Tuple[int, int] = (0, 1),
+    limit: Optional[int] = None,
+    output_table: Optional[Table] = None,
+    store=None,
+    registry=None,
+    flush_rows: int = 4096,
+    max_new_tokens: Optional[int] = None,
+    **generate_kwargs,
+) -> Optional[pa.Table]:
+    """Map a packaged LM's TEXT surface over one shard of ``table``:
+    each row of ``text_col`` (a prompt string) gains a ``generation``
+    string column holding prompt + continuation (generate_text's
+    contract — the prompt is INCLUDED, strip it by prefix length if
+    only the new text is wanted) — the LM-family C16, same
+    sharding/streaming/output_table semantics as :func:`predict_table`
+    (shard (i, n) rows are disjoint, so every process writes its own
+    part). Rows inside each engine batch are grouped by exact prompt
+    token length, so the decode scan compiles once per distinct length
+    and runs batched. ``model`` is a PackagedLM, a path, or a
+    ``runs:/`` / ``models:/`` URI; sampling kwargs (temperature, top_k,
+    top_p, seed, eos_id) default to the packaged ``generate_defaults``.
+    """
+    from tpuflow.packaging.lm import PackagedLM, load_packaged_lm
+
+    if isinstance(model, str):
+        model = load_packaged_lm(model, store=store, registry=registry)
+    if not isinstance(model, PackagedLM):
+        raise TypeError(
+            f"generate_table needs a PackagedLM (or a path/URI to one), "
+            f"got {type(model).__name__}"
+        )
+    return _map_table_shard(
+        lambda texts: model.generate_text(
+            texts, max_new_tokens=max_new_tokens, **generate_kwargs
+        ),
+        pa.field("generation", pa.string()),
+        table, text_col, batch_size, shard, limit, output_table,
+        flush_rows,
+    )

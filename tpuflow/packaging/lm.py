@@ -171,26 +171,38 @@ class PackagedLM:
         max_new_tokens: Optional[int] = None,
         **kwargs,
     ) -> "list[str]":
-        """Raw strings in -> continued strings out (prompt included) —
-        the text symmetry of the image packaged model's bytes-in
-        contract. Prompts are encoded with the bundled tokenizer and
-        generated one by one (each distinct prompt length compiles once
-        via the memoized decode scan)."""
+        """Raw strings in -> continued strings out (prompt INCLUDED,
+        like generate()) — the text symmetry of the image packaged
+        model's bytes-in contract. Prompts are encoded with the bundled
+        tokenizer and BATCHED by exact token length (ragged batching
+        without pad-token conditioning: rows of equal length share one
+        (B, P) generate() call, so a table-scale run compiles once per
+        DISTINCT prompt length and batches the forward instead of
+        looping rows — the engine behind infer.generate_table). Output
+        order matches input order; sampled rows draw from their group's
+        batch, so per-row outputs can differ from a one-at-a-time loop
+        at temperature > 0 (greedy output is identical)."""
         tok = self._require_tokenizer()
         eos = kwargs.get("eos_id", self.generate_defaults.get("eos_id"))
-        out = []
-        for p in prompts:
-            ids = tok.encode(p)[None, :]
-            full = self.generate(ids, max_new_tokens=max_new_tokens,
-                                 **kwargs)[0]
-            if eos is not None:
-                # after a row emits eos the remaining fixed-length
-                # positions repeat it — truncate before decoding
-                cont = full[ids.shape[1]:]
-                hits = np.nonzero(cont == int(eos))[0]
-                if len(hits):
-                    full = full[: ids.shape[1] + int(hits[0])]
-            out.append(tok.decode(full).decode("utf-8", "replace"))
+        encoded = [np.asarray(tok.encode(p), np.int32) for p in prompts]
+        by_len: "dict[int, list[int]]" = {}
+        for i, ids in enumerate(encoded):
+            by_len.setdefault(len(ids), []).append(i)
+        out: "list[Optional[str]]" = [None] * len(prompts)
+        for plen, idxs in by_len.items():
+            batch = np.stack([encoded[i] for i in idxs])
+            fulls = self.generate(batch, max_new_tokens=max_new_tokens,
+                                  **kwargs)
+            for row, i in enumerate(idxs):
+                full = fulls[row]
+                if eos is not None:
+                    # after a row emits eos the remaining fixed-length
+                    # positions repeat it — truncate before decoding
+                    cont = full[plen:]
+                    hits = np.nonzero(cont == int(eos))[0]
+                    if len(hits):
+                        full = full[: plen + int(hits[0])]
+                out[i] = tok.decode(full).decode("utf-8", "replace")
         return out
 
     def score_text(self, texts: "Sequence[str]") -> Dict[str, float]:
