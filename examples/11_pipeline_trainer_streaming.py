@@ -20,7 +20,10 @@ at all, SURVEY.md §2c):
    plain 1F1B and GPipe are one keyword away;
 4. the trained stages reassemble into the plain TransformerLM
    (``unpipelined_params``) for greedy KV-cache generation, decoded
-   back to text with the same tokenizer.
+   back to text with the same tokenizer;
+5. the weights + tokenizer package (``save_packaged_lm``) maps its
+   text surface over a PROMPT TABLE in disjoint shards
+   (``infer.generate_table`` — the LM family's batch-inference C16).
 
 Run on CPU:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
@@ -77,9 +80,9 @@ def main() -> None:
           f"{ds.steps_per_epoch()} steps/epoch")
 
     # 2 virtual chunks per device: depth must divide stages x chunks
-    lm = build_transformer_lm(vocab_size=bpe.vocab_size, dim=32,
-                              depth=2 * n_stages, heads=4, mlp_ratio=2,
-                              dtype=jnp.float32)
+    lm_cfg = dict(vocab_size=bpe.vocab_size, dim=32, depth=2 * n_stages,
+                  heads=4, mlp_ratio=2, dtype=jnp.float32)
+    lm = build_transformer_lm(**lm_cfg)
     mesh = build_nd_mesh({"pipe": n_stages},
                          devices=jax.devices()[:n_stages])
     trainer = PipelineTrainer(
@@ -104,6 +107,34 @@ def main() -> None:
     tail = np.asarray(out)[0, prompt_ids.shape[1]:]
     continuation = bpe.decode(tail).decode("utf-8", "replace")
     print(f"generated continuation: {continuation!r}")
+
+    # 5) package (weights + tokenizer) and map the text surface over a
+    # PROMPT TABLE in disjoint shards — the LM family's batch-inference
+    # finale (≙ predict_table for images; shard (i, n) rows are
+    # disjoint, so multi-process runs write disjoint parts)
+    import pyarrow as pa
+
+    from tpuflow.data.table import TableStore
+    from tpuflow.infer import generate_table
+    from tpuflow.packaging.lm import save_packaged_lm
+
+    pkg = os.path.join(work, "pkg")
+    # same cfg the model was built from (the saver normalizes the real
+    # dtype to its JSON-safe name)
+    save_packaged_lm(pkg, flat, dict(lm_cfg), tokenizer=bpe)
+    t = TableStore(os.path.join(work, "tables"), "db").table("prompts")
+    t.write(pa.table({"text": pa.array(
+        ["the cat sat", "the dog sat", "the cat saw", "the dog saw"],
+        pa.string(),
+    )}))
+    parts = [
+        generate_table(pkg, t, shard=(i, 2), max_new_tokens=6, seed=0)
+        for i in range(2)
+    ]
+    for part in parts:
+        for row in part.column("generation").to_pylist():
+            print(f"  table generation: {row!r}")
+    assert sum(p.num_rows for p in parts) == 4
     print("pipeline-trainer streaming example OK")
 
 
