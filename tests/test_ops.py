@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tpuflow.ops import flash_attention, mha_reference
+from tpuflow.ops import flash_attention, mha_reference, mha_xla
 
 
 def _rand(shape, key, dtype=jnp.float32):
@@ -245,3 +245,64 @@ def test_streamed_kernel_fuzz_parity():
             out.astype(np.float32), ref.astype(np.float32), **tol,
             err_msg=f"config: {(trial, b, h, s, d, bq, bk, causal, dtype)}",
         )
+
+
+def test_sliding_window_matches_dense_oracle():
+    """window=w: each query sees its last w keys (itself included); the
+    kernels must match a dense masked softmax in fwd AND both grads,
+    across window/block alignments including w=1."""
+    import numpy as np
+
+    def oracle(q, k, v, window):
+        b, h, s, d = q.shape
+        sc = jnp.einsum(
+            "bhqd,bhkd->bhqk",
+            q.astype(jnp.float32), k.astype(jnp.float32),
+        ) * (d ** -0.5)
+        row = np.arange(s)[:, None]
+        col = np.arange(s)[None, :]
+        mask = (col <= row) & (col > row - window)
+        sc = jnp.where(jnp.asarray(mask), sc, -1e30)
+        p = jax.nn.softmax(sc, axis=-1)
+        return jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+        )
+
+    ks = jax.random.split(jax.random.key(0), 3)
+    for s, w, bq, bk in [(256, 64, 64, 64), (256, 100, 64, 32),
+                         (192, 1, 64, 64), (320, 200, 128, 64)]:
+        q, k, v = (jax.random.normal(kk, (1, 2, s, 64), jnp.float32)
+                   for kk in ks)
+        o = flash_attention(q, k, v, causal=True, window=w,
+                            block_q=bq, block_k=bk, interpret=True)
+        r = oracle(q, k, v, w)
+        np.testing.assert_allclose(o, r, atol=2e-5, rtol=1e-5)
+        gq = jax.grad(lambda q: flash_attention(
+            q, k, v, causal=True, window=w, block_q=bq, block_k=bk,
+            interpret=True).sum())(q)
+        gqr = jax.grad(lambda q: oracle(q, k, v, w).sum())(q)
+        np.testing.assert_allclose(gq, gqr, atol=2e-5, rtol=1e-4)
+        gk = jax.grad(lambda k: flash_attention(
+            q, k, v, causal=True, window=w, block_q=bq, block_k=bk,
+            interpret=True).sum())(k)
+        gkr = jax.grad(lambda k: oracle(q, k, v, w).sum())(k)
+        np.testing.assert_allclose(gk, gkr, atol=2e-5, rtol=1e-4)
+        # the einsum path applies the identical mask
+        x = mha_xla(q, k, v, causal=True, window=w)
+        np.testing.assert_allclose(x, r, atol=2e-5, rtol=1e-5)
+
+
+def test_sliding_window_validation():
+    import pytest
+
+    q = jnp.zeros((1, 1, 16, 8))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, q, q, window=4, interpret=True)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, q, q, causal=True, window=0, interpret=True)
+    # the einsum impl enforces the SAME contract (pick_attn_impl can
+    # swap impls; the error behavior must not change with it)
+    with pytest.raises(ValueError, match="causal"):
+        mha_xla(q, q, q, window=4)
+    with pytest.raises(ValueError, match="window"):
+        mha_xla(q, q, q, causal=True, window=0)

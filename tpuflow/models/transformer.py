@@ -96,6 +96,7 @@ class CausalAttention(nn.Module):
     # tokens [d*s,(d+1)*s)) or 'striped' (shard d holds d, d+n, ... —
     # balances the causal ring; the TRAINER permutes tokens/logits)
     sp_layout: str = "contiguous"
+    attn_window: Optional[int] = None  # sliding-window (local) attention
 
     @nn.compact
     def __call__(self, x):
@@ -140,6 +141,11 @@ class CausalAttention(nn.Module):
                 # position (causal within the chunk, full to the past)
                 key_pos = jnp.arange(max_len)[None, :]
                 ok = key_pos <= positions[:, None]  # (s, max_len)
+                if self.attn_window is not None:
+                    # sliding window holds in decode too: each new token
+                    # sees only its last attn_window cache entries
+                    ok = ok & (key_pos > positions[:, None]
+                               - self.attn_window)
                 scores = jnp.einsum(
                     "bhqd,bhkd->bhqk",
                     q.astype(jnp.float32), ck.value.astype(jnp.float32),
@@ -153,7 +159,8 @@ class CausalAttention(nn.Module):
                 # init pass: shapes only (cache created above)
                 positions = jnp.arange(s, dtype=jnp.int32)
                 q, k = rotary_embed(q, k, positions, self.rope_theta)
-                o = mha_xla(q, k, v, causal=True)
+                o = mha_xla(q, k, v, causal=True,
+                            window=self.attn_window)
         else:
             if self.seq_axis is not None:
                 # absolute positions of this shard's tokens
@@ -168,12 +175,22 @@ class CausalAttention(nn.Module):
             q, k = rotary_embed(q, k, positions, self.rope_theta)
 
             if self.seq_axis is not None:
+                if self.attn_window is not None:
+                    # closes the direct-TransformerLM bypass of the
+                    # build_transformer_lm guard: a windowed ring would
+                    # silently run FULL causal attention otherwise
+                    raise ValueError(
+                        "attn_window and seq_axis (ring attention) "
+                        "cannot combine yet"
+                    )
                 o = ring_attention(q, k, v, axis_name=self.seq_axis,
                                    causal=True, layout=self.sp_layout)
             elif pick_attn_impl(s, self.attn_impl) == "flash":
-                o = flash_attention(q, k, v, causal=True)
+                o = flash_attention(q, k, v, causal=True,
+                                    window=self.attn_window)
             else:
-                o = mha_xla(q, k, v, causal=True)
+                o = mha_xla(q, k, v, causal=True,
+                            window=self.attn_window)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, self.dim)
         return nn.Dense(
             self.dim,
@@ -225,12 +242,14 @@ class DecoderBlock(nn.Module):
     decode: bool = False
     sp_layout: str = "contiguous"
     remat_mlp: bool = False  # checkpoint the MLP sub-block only
+    attn_window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x):
         x = x + CausalAttention(
             self.dim, self.heads, self.dtype, self.attn_impl, self.seq_axis,
-            self.rope_theta, self.decode, self.sp_layout, name="attn",
+            self.rope_theta, self.decode, self.sp_layout,
+            attn_window=self.attn_window, name="attn",
         )(RMSNorm(self.dtype, name="norm1")(x))
         y = RMSNorm(self.dtype, name="norm2")(x)
         if self.n_experts > 0:
@@ -318,6 +337,7 @@ class TransformerLM(nn.Module):
     remat_policy: str = "full"  # 'full' | 'attn' (save attention outputs)
     sp_layout: str = "contiguous"  # see CausalAttention.sp_layout
     skip_head: bool = False  # return final-norm hidden states, not logits
+    attn_window: Optional[int] = None  # sliding-window (local) attention
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -361,6 +381,7 @@ class TransformerLM(nn.Module):
                 moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
                 decode=self.decode, sp_layout=self.sp_layout,
                 remat_mlp=remat_mlp and not moe_block,
+                attn_window=self.attn_window,
                 name=f"block{i}",
             )(x)
         x = RMSNorm(self.dtype, name="norm_final")(x)
@@ -389,6 +410,7 @@ def build_transformer_lm(
     remat: bool = False,
     remat_policy: str = "full",
     sp_layout: str = "contiguous",
+    attn_window: Optional[int] = None,
 ) -> TransformerLM:
     if dim % heads:
         raise ValueError("dim must be a multiple of heads")
@@ -400,12 +422,22 @@ def build_transformer_lm(
         )
     if sp_layout == "striped" and seq_axis is None:
         raise ValueError("sp_layout='striped' requires seq_axis")
+    if attn_window is not None:
+        if seq_axis is not None:
+            raise ValueError(
+                "attn_window and seq_axis (ring attention) cannot "
+                "combine yet — a windowed ring would skip whole ring "
+                "hops; use one or the other"
+            )
+        if attn_window < 1:
+            raise ValueError(f"attn_window must be >= 1, got {attn_window}")
     return TransformerLM(
         vocab_size=vocab_size, dim=dim, depth=depth, heads=heads,
         mlp_ratio=mlp_ratio, dtype=dtype, attn_impl=attn_impl,
         seq_axis=seq_axis, n_experts=n_experts, moe_every=moe_every,
         moe_top_k=moe_top_k, ep_axis=ep_axis, remat=remat,
         remat_policy=remat_policy, sp_layout=sp_layout,
+        attn_window=attn_window,
     )
 
 

@@ -53,6 +53,12 @@ class _Cfg(NamedTuple):
     ``col <= row + causal_shift``. 0 is the standard mask; -1 is the
     STRICT mask (col < row) that striped ring attention needs for
     visits from later-striped shards (tpuflow.parallel.ring_attention).
+
+    ``window`` (sliding-window / local attention, requires ``causal``):
+    additionally visible iff ``col > row + causal_shift - window`` —
+    each query sees at most its last ``window`` keys (itself included),
+    and the kernels SKIP key/query blocks wholly outside the band, so
+    compute is O(S·window) instead of O(S²/2).
     """
 
     causal: bool
@@ -63,6 +69,7 @@ class _Cfg(NamedTuple):
     skv_valid: int  # unpadded key/value length
     interpret: bool
     causal_shift: int = 0
+    window: Optional[int] = None
 
 
 def _vma(*xs):
@@ -104,13 +111,24 @@ def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def mha_xla(q, k, v, causal: bool = False, scale: Optional[float] = None):
+def mha_xla(q, k, v, causal: bool = False, scale: Optional[float] = None,
+            window: Optional[int] = None):
     """Production XLA attention: einsums in the INPUT dtype with float32
     accumulation (full-rate MXU for bf16 models — upcasting operands to
     f32 first, as the oracle does, lands on the ~8x-slower f32 MXU
     path), float32 softmax. The right impl for short sequences where
     the O(S^2) score matrix fits comfortably (vision models); long
-    sequences go to :func:`flash_attention`."""
+    sequences go to :func:`flash_attention`. ``window`` applies the
+    same sliding-window mask as the kernel (no block skipping here —
+    at einsum lengths the full score matrix is already materialized)."""
+    if window is not None:
+        # same contract as flash_attention — swapping impls via
+        # pick_attn_impl must not change error behavior
+        if not causal:
+            raise ValueError("window (sliding-window attention) requires "
+                             "causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -118,6 +136,10 @@ def mha_xla(q, k, v, causal: bool = False, scale: Optional[float] = None):
     if causal:
         sq, sk = q.shape[2], k.shape[2]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        if window is not None:
+            mask = mask & jnp.triu(
+                jnp.ones((sq, sk), bool), k=sk - sq - window + 1
+            )
         s = jnp.where(mask, s, _NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum(
@@ -144,6 +166,8 @@ def _mask_for(cfg: _Cfg, sq: int, skv: int):
     mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
     if cfg.causal:
         mask = mask & (col <= row + cfg.causal_shift)
+        if cfg.window is not None:
+            mask = mask & (col > row + cfg.causal_shift - cfg.window)
     return mask
 
 
@@ -198,6 +222,16 @@ def _causal_last_j(qi: int, bq: int, bk: int, nk: int, shift: int = 0):
     return jnp.clip(lax.div(last_col, bk), 0, nk - 1)
 
 
+def _window_first_j(qi: int, bq: int, bk: int, nk: int, shift: int,
+                    window: int):
+    """Index of the FIRST key block any row of query block ``qi`` can
+    see under the sliding window col > row + shift - window (the inner
+    grid skips earlier blocks — this is what makes local attention
+    O(S·window))."""
+    first_col = qi * bq + shift - window + 1
+    return jnp.clip(lax.div(first_col, bk), 0, nk - 1)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                 cfg: _Cfg):
     # lse_ref block is the FULL padded row, shape (1, 1, sq_pad): TPU
@@ -216,6 +250,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         _causal_last_j(qi, bq, bk, nk, cfg.causal_shift)
         if cfg.causal else nk - 1
     )
+    first_j = (
+        _window_first_j(qi, bq, bk, nk, cfg.causal_shift, cfg.window)
+        if (cfg.causal and cfg.window is not None) else 0
+    )
 
     @pl.when(j == 0)
     def _init():
@@ -223,7 +261,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j <= last_j)
+    @pl.when((j >= first_j) & (j <= last_j))
     def _compute():
         q = q_ref[0]  # native dtype — bf16 in ⇒ full-rate MXU
         k_blk = k_ref[0]
@@ -235,6 +273,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         if cfg.causal:
             row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             mask = mask & (col <= row + cfg.causal_shift)
+            if cfg.window is not None:
+                mask = mask & (col > row + cfg.causal_shift - cfg.window)
         s = jnp.where(mask, s, _NEG_BIG)
         m = m_ref[:, :1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -318,12 +358,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         _causal_last_j(qi, bq, bk, nk, cfg.causal_shift)
         if cfg.causal else nk - 1
     )
+    first_j = (
+        _window_first_j(qi, bq, bk, nk, cfg.causal_shift, cfg.window)
+        if (cfg.causal and cfg.window is not None) else 0
+    )
 
     @pl.when(j == 0)
     def _init():
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    @pl.when(j <= last_j)
+    @pl.when((j >= first_j) & (j <= last_j))
     def _compute():
         q = q_ref[0]
         do = do_ref[0]
@@ -337,6 +381,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
         if cfg.causal:
             mask = mask & (col <= row + cfg.causal_shift)
+            if cfg.window is not None:
+                mask = mask & (col > row + cfg.causal_shift - cfg.window)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k_blk.dtype)
@@ -364,13 +410,21 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                  pl.num_programs(2) - 1)
         if cfg.causal else 0
     )
+    # sliding window: the LAST query block that can still see this key
+    # block (row < col - causal_shift + window) — later blocks skip
+    if cfg.causal and cfg.window is not None:
+        last_row = ki * bk + bk - 1 - cfg.causal_shift + cfg.window - 1
+        last_i = jnp.clip(lax.div(last_row, bq), 0,
+                          pl.num_programs(2) - 1)
+    else:
+        last_i = nq - 1
 
     @pl.when(i == first_i)
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    @pl.when(i >= first_i)
+    @pl.when((i >= first_i) & (i <= last_i))
     def _compute():
         k = k_ref[0]
         v = v_ref[0]
@@ -384,6 +438,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
         if cfg.causal:
             mask = mask & (col <= row + cfg.causal_shift)
+            if cfg.window is not None:
+                mask = mask & (col > row + cfg.causal_shift - cfg.window)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv_acc_ref[...] = dv_acc_ref[...] + jnp.dot(
             p.T.astype(do_blk.dtype), do_blk, preferred_element_type=jnp.float32
@@ -488,6 +544,7 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    window: Optional[int] = None,
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
@@ -510,6 +567,13 @@ def flash_attention(
     d=128 forward diag sat at ~3.7 TFLOP/s under 128x128). VMEM at
     512x512/d=128 is a few MB against the 128 MB budget; shorter
     sequences clamp down automatically.
+
+    ``window`` (requires ``causal``): sliding-window / local attention —
+    each query attends to at most its last ``window`` keys (itself
+    included). Key/query blocks wholly outside the band are SKIPPED in
+    all three kernels, so compute is O(S·window): the Mistral-style
+    long-context lever for sequences where even the causal half of
+    S² is too much.
     """
     if q.ndim != 4:
         raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
@@ -517,6 +581,12 @@ def flash_attention(
     skv = k.shape[2]
     if causal and sq != skv:
         raise ValueError("causal=True requires equal q/kv sequence lengths")
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window attention) requires "
+                             "causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if interpret is None:
         from tpuflow.core.hw import is_tpu_backend
 
@@ -532,6 +602,7 @@ def flash_attention(
         sq_valid=sq,
         skv_valid=skv,
         interpret=bool(interpret),
+        window=None if window is None else int(window),
     )
     qp = _pad_seq(q.reshape(b * h, sq, d), block_q)
     kp = _pad_seq(k.reshape(b * h, skv, d), block_k)
