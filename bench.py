@@ -333,11 +333,25 @@ def _long_context_diag(jax, jnp, flash_attention, fa_diag: dict,
             ql, 3, rtt_ms,
         )
         long_fl = 2 * sl * sl * 128  # causal half of 4*s^2*d
+        # sliding window at the same length: the kernels SKIP
+        # out-of-band blocks, so a 4k window over 64k tokens should run
+        # ~(s/2)/w times faster than full causal — the measured form of
+        # the O(S*window) claim
+        win = 4096
+        win_ms = _timed_scan(
+            jax,
+            lambda c: flash_attention(c, kk, vl, causal=True, window=win,
+                                      block_q=512, block_k=512),
+            ql, 3, rtt_ms,
+        )
         fa_diag["long_context"] = {
             "seq": sl,
             "fwd_max_abs_err_vs_chunked_xla": round(err_long, 5),
             "fwd_ms": round(long_ms, 3),
             "fwd_tflops": round(long_fl / (long_ms * 1e-3) / 1e12, 2),
+            "window": win,
+            "windowed_fwd_ms": round(win_ms, 3),
+            "windowed_speedup": round(long_ms / max(win_ms, 1e-9), 2),
         }
         print(f"# flash-attn 64k diag: {fa_diag['long_context']}",
               file=sys.stderr, flush=True)
