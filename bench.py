@@ -35,9 +35,23 @@ steady-state. If (b) wedges (e.g. remote-compile outage) the watchdog
 emits (a) instead of losing the artifact. The relay RTT itself is
 measured and reported in diagnostics.
 
+Supervisor architecture (round 4 — the r01/r02/r03 driver benches all
+died in ways an in-process watchdog cannot survive: a wedged relay
+BLOCKS ``jax.devices()`` inside a C call, unkillable from Python): the
+default entry point is a PARENT process that never imports jax. It
+spawns the actual bench as a child with ``--progress-file``, watches
+phase heartbeats, kills-and-respawns a child wedged in backend init
+(a fresh process gets a fresh dial to the relay), retries a child that
+exited with a structured failure while budget remains, and at the
+deadline emits the best value-bearing record the children produced.
+The child additionally wires the persistent XLA compilation cache
+(``.xla_cache/`` committed to the repo) so a driver run after a
+builder-side warm pays ~0 s recompile.
+
 Usage: python bench.py [--smoke] [--batch N] [--steps N]
        [--model cnn|vit|resnet50|lm] [--end2end] [--attn-sweep]
        [--trace DIR] [--init-retries N] [--deadline SECONDS]
+       [--no-supervisor] [--init-window SECONDS]
 """
 
 import argparse
@@ -61,6 +75,50 @@ _PROVISIONAL: dict = {}
 # "lm", "generate", "e2e") — set by main(), stamped into emitted
 # records, and used to pick a like-for-like last-known-good artifact
 _MODE: Optional[str] = None
+
+# child mode: append-only JSONL the supervisor reads (heartbeats,
+# provisional records, the final record) — None when unsupervised
+_PROGRESS_PATH: Optional[str] = None
+
+
+def _progress(rec: dict) -> None:
+    """Append one timestamped record to the supervisor's progress file
+    (no-op when unsupervised). Never raises — a full disk must not take
+    the bench down with it."""
+    if _PROGRESS_PATH is None:
+        return
+    try:
+        rec = {"t": round(time.time(), 2), **rec}
+        with open(_PROGRESS_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except Exception:
+        pass
+
+
+def _read_progress(path: str) -> list:
+    """Parse the child's progress JSONL, skipping torn/partial lines."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except Exception:
+                    continue
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def _set_provisional(**kw) -> None:
+    """Update the watchdog-fallback record AND stream it to the
+    supervisor, so even a SIGKILLed child leaves its best number."""
+    _PROVISIONAL.update(**kw)
+    _progress({"phase": "provisional", "record": {
+        k: v for k, v in kw.items() if k != "diagnostics"
+    }, "diagnostics": kw.get("diagnostics")})
 
 
 def _last_known_good(metric: Optional[str] = None):
@@ -133,6 +191,7 @@ def emit(value: float, vs_baseline: float, error=None, diagnostics=None,
                 rec["last_known_good"] = lkg
         if diagnostics:
             rec["diagnostics"] = diagnostics
+        _progress({"final": True, "record": rec})
         print(json.dumps(rec), flush=True)
 
 
@@ -144,6 +203,7 @@ def _init_devices(retries: int, backoff_s: float):
     last = None
     for attempt in range(retries):
         t0 = time.time()
+        _progress({"phase": "init_attempt", "attempt": attempt + 1})
         try:
             devs = jax.devices()
             print(
@@ -151,6 +211,8 @@ def _init_devices(retries: int, backoff_s: float):
                 f"(attempt {attempt + 1}, {time.time() - t0:.0f}s)",
                 file=sys.stderr, flush=True,
             )
+            _progress({"phase": "devices_up", "n": len(devs),
+                       "kind": devs[0].device_kind})
             return devs, None
         except Exception as e:  # UNAVAILABLE / RuntimeError from PJRT
             last = e
@@ -382,7 +444,22 @@ def _run_timing(args, jax, step1, state, rtt_ms, make_record,
     # at least one warmup step always runs: its scalar fetch is the sync
     # anchor that keeps prior work out of the timed window (and --warmup 0
     # would otherwise leave `loss` unbound)
-    for _ in range(max(1, args.warmup)):
+    state, loss = step1(state)
+    float(loss)
+    # FIRST provisional lands right here — one step after compile, so a
+    # watchdog fired any later reports a real (if RTT-inflated) number
+    # instead of 0.0 (VERDICT r03: three rounds of dead driver benches)
+    t0 = time.time()
+    state, loss = step1(state)
+    float(loss)
+    dt_first = time.time() - t0
+    value, vs, diag = make_record(dt_first, "single_step", dt_first,
+                                  float(loss))
+    _set_provisional(value=value, vs_baseline=vs, diagnostics=diag,
+                     metric=metric, unit=unit)
+    print(f"# provisional (single step): step={dt_first*1e3:.2f}ms",
+          file=sys.stderr, flush=True)
+    for _ in range(max(0, args.warmup - 2)):
         state, loss = step1(state)
     float(loss)
     t0 = time.time()
@@ -392,14 +469,15 @@ def _run_timing(args, jax, step1, state, rtt_ms, make_record,
     dt_loop = (time.time() - t0) / args.steps
 
     value, vs, diag = make_record(dt_loop, "loop_fetch", dt_loop, last_loss)
-    _PROVISIONAL.update(value=value, vs_baseline=vs, diagnostics=diag,
-                        metric=metric, unit=unit)
+    _set_provisional(value=value, vs_baseline=vs, diagnostics=diag,
+                     metric=metric, unit=unit)
     print(f"# provisional (loop+fetch): step={dt_loop*1e3:.2f}ms",
           file=sys.stderr, flush=True)
 
     dt, method = dt_loop, "loop_fetch"
     try:
         K = args.steps
+        _progress({"phase": "scan_start", "steps": K})
 
         @jax.jit
         def _many(s):
@@ -619,6 +697,124 @@ def _decode_scaling(hw: int, threads=None) -> dict:
     return out
 
 
+def _supervise(args) -> int:
+    """Parent watchdog process — never imports jax, so a wedged PJRT
+    client can never take IT down. Spawns the bench as a child with a
+    progress JSONL, kill+respawns a child stuck in backend init (the
+    wedge lives in a blocking C call; only a fresh process re-dials the
+    relay), retries structured child failures while budget remains, and
+    at the deadline prints the best value-bearing record produced."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    t0 = time.time()
+    margin = min(45.0, args.deadline * 0.1)
+    workdir = tempfile.mkdtemp(prefix="tpuflow_bench_")
+
+    def remaining():
+        return args.deadline - margin - (time.time() - t0)
+
+    attempts = 0
+    history = []
+    best_prov = None  # most REFINED provisional across all children
+    best_rank = -1
+
+    def _prov_rank(r):
+        # refinement order: a respawned child's crude single-step number
+        # must never displace an earlier child's RTT-amortized loop/scan
+        # measurement; records without a timing_method (e2e epochs,
+        # generate retries) improve monotonically so newest-wins there
+        meth = (r.get("diagnostics") or {}).get("timing_method", "")
+        return 0 if meth == "single_step" else 1
+
+    while remaining() > 5:
+        attempts += 1
+        pfile = os.path.join(workdir, f"progress.{attempts}.jsonl")
+        child_deadline = max(5.0, remaining() - 10)
+        argv = [
+            sys.executable, os.path.abspath(__file__), *sys.argv[1:],
+            "--progress-file", pfile,
+            "--deadline", f"{child_deadline:.1f}",  # last --deadline wins
+        ]
+        print(f"# supervisor: attempt {attempts}, child deadline "
+              f"{child_deadline:.0f}s", file=sys.stderr, flush=True)
+        spawn_t = time.time()
+        child = subprocess.Popen(argv, stdout=subprocess.DEVNULL)
+        killed_reason = None
+        last_phase = "spawn"
+        while True:
+            rc = child.poll()
+            recs = _read_progress(pfile)
+            for r in recs:
+                if r.get("phase") == "provisional":
+                    if _prov_rank(r) >= best_rank:
+                        best_prov, best_rank = r, _prov_rank(r)
+                elif r.get("phase"):
+                    last_phase = r["phase"]
+            if rc is not None:
+                break
+            if remaining() <= 0:
+                killed_reason = "deadline"
+                child.kill()
+                break
+            if (not any(r.get("phase") == "devices_up" for r in recs)
+                    and time.time() - spawn_t > args.init_window):
+                killed_reason = (f"init stalled >{args.init_window:.0f}s "
+                                 f"(phase {last_phase})")
+                child.kill()
+                break
+            time.sleep(2)
+        try:
+            child.wait(timeout=15)
+        except Exception:
+            pass
+        recs = _read_progress(pfile)
+        for r in recs:
+            if r.get("phase") == "provisional" and _prov_rank(r) >= best_rank:
+                best_prov, best_rank = r, _prov_rank(r)
+        final = next(
+            (r["record"] for r in reversed(recs) if r.get("final")), None
+        )
+        if final is not None and final.get("value", 0) > 0:
+            # success (possibly the child's own watchdog-provisional —
+            # its record carries the honest error field either way)
+            print(json.dumps(final), flush=True)
+            shutil.rmtree(workdir, ignore_errors=True)
+            return 0
+        if killed_reason:
+            history.append(f"attempt {attempts}: killed ({killed_reason})")
+            if killed_reason == "deadline":
+                break
+        elif final is not None:
+            history.append(
+                f"attempt {attempts}: child failed: "
+                f"{str(final.get('error', '?'))[:200]}"
+            )
+        else:
+            history.append(
+                f"attempt {attempts}: child exit rc={child.returncode} "
+                f"in phase {last_phase} without a final record"
+            )
+        # a deterministic fast failure (broken install, relay refusing
+        # with an instant error) would otherwise respawn in a tight
+        # loop and burn the whole deadline on imports — back off
+        time.sleep(min(15.0, 2.0 * attempts))
+    shutil.rmtree(workdir, ignore_errors=True)
+    err = (f"watchdog: supervisor deadline {args.deadline}s exhausted "
+           f"without a successful child run"
+           + ("; " + "; ".join(history[-5:]) if history else ""))
+    if best_prov is not None:
+        rec = best_prov.get("record", {})
+        emit(rec.get("value", 0.0), rec.get("vs_baseline", 0.0), error=err,
+             diagnostics=best_prov.get("diagnostics"),
+             metric=rec.get("metric", "train_images_per_sec_per_chip"),
+             unit=rec.get("unit", "images/s/chip"))
+    else:
+        emit(0.0, 0.0, error=err)
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--smoke", action="store_true",
@@ -667,18 +863,42 @@ def main() -> int:
                         "KV-cache autoregressive decode throughput "
                         "(serving loop; vs_baseline anchors to the "
                         "param-bandwidth decode roofline)")
+    p.add_argument("--no-supervisor", action="store_true",
+                   help="run the bench in-process (no parent watchdog "
+                        "process); the in-process watchdog still applies")
+    p.add_argument("--init-window", type=float, default=270.0,
+                   help="supervisor: kill+respawn a child that has not "
+                        "reached backend init within this window — a "
+                        "wedged relay blocks jax.devices() inside a C "
+                        "call, and only a fresh process re-dials")
+    p.add_argument("--compile-cache",
+                   default=os.path.join(
+                       os.path.dirname(os.path.abspath(__file__)),
+                       ".xla_cache"),
+                   help="persistent XLA compilation cache dir (committed "
+                        "to the repo so driver runs pay ~0s recompile; "
+                        "'' disables)")
+    p.add_argument("--progress-file", default=None, help=argparse.SUPPRESS)
     args = p.parse_args()
-    global _MODE
+    global _MODE, _PROGRESS_PATH
     _MODE = "e2e" if args.end2end else args.model
     if args.end2end and args.model != "cnn":
         p.error("--end2end measures the cnn (MobileNetV2 transfer) "
                 "pipeline only; drop --model or use --model cnn")
+
+    if args.progress_file is None and not args.no_supervisor:
+        return _supervise(args)
+    _PROGRESS_PATH = args.progress_file
+    _progress({"phase": "start", "mode": _MODE})
 
     if args.smoke:
         # FORCE cpu — the ambient env may pin JAX_PLATFORMS to a TPU
         # plugin platform; setdefault would leave the smoke run trying
         # (and possibly hanging) to claim real hardware
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # and keep CPU-compiled executables out of the repo-committed
+        # TPU cache (tests run from the repo root)
+        args.compile_cache = ""
 
     def watchdog():
         time.sleep(args.deadline)
@@ -712,6 +932,23 @@ def _bench(args) -> int:
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    if args.compile_cache:
+        # persistent executable cache: the r03 driver bench spent its
+        # whole 1500 s deadline in backend init + a 57-154 s compile;
+        # with the repo-committed cache a warm driver run re-loads the
+        # serialized executable instead of recompiling
+        try:
+            os.makedirs(args.compile_cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir",
+                              args.compile_cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception as e:
+            print(f"# compile cache unavailable: {e}", file=sys.stderr,
+                  flush=True)
+    _progress({"phase": "jax_imported"})
     import jax.numpy as jnp
     import numpy as np
 
@@ -803,11 +1040,13 @@ def _bench(args) -> int:
 
     step1 = jax.jit(_step1_impl, donate_argnums=0)
 
+    _progress({"phase": "compile_start"})
     t_compile = time.time()
     flops = flops_of_jitted(step1, trainer.state)
     state, loss = step1(trainer.state)
     float(loss)  # scalar fetch = real sync (relay-safe)
     compile_s = time.time() - t_compile
+    _progress({"phase": "compile_done", "compile_s": round(compile_s, 1)})
     peak = device_peak_flops(devices[0])
 
     def _diag_for(dt, method, dt_loop, last_loss):
@@ -1002,7 +1241,7 @@ def _bench_e2e(args, devices) -> int:
                 d = _diag(partial=True)
                 best = d.get("cached_img_per_s_chip",
                              d["epoch1_img_per_s_chip"])
-                _PROVISIONAL.update(
+                _set_provisional(
                     value=best,
                     vs_baseline=best / max(
                         d["epoch1_img_per_s_chip"], 1e-9),
@@ -1130,6 +1369,7 @@ def _bench_lm(args, devices) -> int:
     for remat_mode in ("off", "attn", "full") if not args.smoke else ("off",):
         step1 = state = None
         try:
+            _progress({"phase": "compile_start", "remat": remat_mode})
             t_compile = time.time()
             step1, state = _build(remat_mode)
             # probe through the JIT path (the scan in _run_timing must
@@ -1137,6 +1377,8 @@ def _bench_lm(args, devices) -> int:
             state, loss = step1(state)
             float(loss)
             compile_s = time.time() - t_compile
+            _progress({"phase": "compile_done",
+                       "compile_s": round(compile_s, 1)})
             # cost analysis via AOT lower().compile() — a second
             # lowering, but its HLO is identical so the XLA compilation
             # cache absorbs most of it, and it runs only on the
@@ -1144,7 +1386,12 @@ def _bench_lm(args, devices) -> int:
             flops = flops_of_jitted(step1, state)
             break
         except Exception as e:
-            if "RESOURCE_EXHAUSTED" not in str(e):
+            # XLA OOMs surface under several phrasings depending on the
+            # backend/allocator (ADVICE r03): match the PJRT status code
+            # AND the common prose forms before giving up on the rung
+            msg = str(e).lower()
+            if not ("resource_exhausted" in msg or "out of memory" in msg
+                    or "oom" in msg.split() or "exceeds the memory" in msg):
                 raise
             del step1, state
             print(f"# lm remat={remat_mode} OOM; stepping down",
@@ -1297,7 +1544,7 @@ def _bench_generate(args, devices) -> int:
             "rtt_ms": round(rtt_ms, 1),
             "compile_s": round(compile_s, 1),
         }
-        _PROVISIONAL.update(
+        _set_provisional(
             value=tok_s, vs_baseline=util, diagnostics=diag,
             metric="generate_tokens_per_sec_per_chip",
             unit="tokens/s/chip",

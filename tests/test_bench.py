@@ -59,7 +59,17 @@ def test_smoke_lm_metric_name():
 @pytest.mark.slow
 def test_watchdog_still_emits_json():
     # a 1-second deadline fires long before the model compiles; the
-    # bench must STILL print one JSON line and exit 0
+    # IN-PROCESS watchdog must STILL print one JSON line and exit 0
+    r = _run("--smoke", "--steps", "2", "--deadline", "1",
+             "--no-attn-diag", "--no-supervisor", timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert "error" in rec and "watchdog" in rec["error"]
+
+
+def test_supervisor_deadline_emits_json():
+    # supervised path with no budget for even one child: the PARENT
+    # must emit the structured watchdog line itself (no jax import)
     r = _run("--smoke", "--steps", "2", "--deadline", "1",
              "--no-attn-diag", timeout=120)
     assert r.returncode == 0, r.stderr[-2000:]
