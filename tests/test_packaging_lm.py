@@ -191,6 +191,26 @@ def test_packaged_lm_text_surface(tmp_path):
     assert len(outs) == 2
     assert outs[0].startswith("the cat") and outs[1].startswith("the dog sat")
 
+    # length groups are padded to power-of-two batch buckets so varying
+    # group sizes (generate_table chunking) reuse one compile per
+    # (length, bucket) — and pad rows never leak into the output
+    seen = []
+    orig = m.generate
+
+    def spy(batch, **kw):
+        seen.append(batch.shape[0])
+        return orig(batch, **kw)
+
+    m.generate = spy
+    outs3 = m.generate_text(
+        ["the cat", "the cat", "the cat", "a dog sat on"],
+        max_new_tokens=2, seed=0,
+    )
+    m.generate = orig
+    assert len(outs3) == 4 and all(o for o in outs3)
+    assert all(b & (b - 1) == 0 for b in seen), seen  # powers of two
+    assert 4 in seen  # the 3-row group padded up to the 4-bucket
+
     sc = m.score_text(["the cat sat on the mat.", "the dog"])
     assert np.isfinite(sc["loss"]) and sc["ppl"] > 0
     # ragged scoring == equivalent hand-masked computation

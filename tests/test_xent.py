@@ -75,6 +75,30 @@ def test_all_masked_rows_are_safe():
     np.testing.assert_allclose(np.asarray(g), 0.0)
 
 
+def test_out_of_range_targets_masked():
+    """Targets >= vocab (or negative, != ignore_index) are folded into
+    the ignore mask — same loss/grad as marking them ignore_index, and
+    NOT a silent divergence from token_loss's clamp (ADVICE r03)."""
+    hidden, kernel, tgt = _data(b=1, s=6, v=37)
+    corrupt = tgt.at[0, 1].set(37).at[0, 4].set(4000).at[0, 5].set(-7)
+    ignored = tgt.at[0, 1].set(-1).at[0, 4].set(-1).at[0, 5].set(-1)
+    l_c, g_c = jax.value_and_grad(
+        lambda h: fused_linear_token_loss(h, kernel, corrupt, vocab_chunk=16)
+    )(hidden)
+    l_i, g_i = jax.value_and_grad(
+        lambda h: fused_linear_token_loss(h, kernel, ignored, vocab_chunk=16)
+    )(hidden)
+    np.testing.assert_allclose(float(l_c), float(l_i), rtol=1e-6)
+    np.testing.assert_allclose(g_c, g_i, rtol=1e-5, atol=1e-7)
+    # and the UNFUSED path agrees on the same corrupt batch — both
+    # paths mask out-of-range, neither clamps (cross-path consistency)
+    l_u, g_u = jax.value_and_grad(
+        lambda h: token_loss(lm_head_dot(h, kernel), corrupt)
+    )(hidden)
+    np.testing.assert_allclose(float(l_c), float(l_u), rtol=1e-5)
+    np.testing.assert_allclose(g_c, g_u, rtol=1e-4, atol=1e-6)
+
+
 def test_validation():
     hidden, kernel, tgt = _data()
     with pytest.raises(ValueError, match="label_smoothing"):

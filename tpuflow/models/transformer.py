@@ -472,10 +472,15 @@ def token_loss(logits, targets, mask=None, ignore_index: int = -1,
             f"label_smoothing must be in [0, 1), got {label_smoothing}"
         )
     pred = logits.astype(jnp.float32)
-    valid = (targets != ignore_index).astype(jnp.float32)
+    # targets outside [0, vocab) fold into the ignore mask — the same
+    # convention as ops.xent.fused_linear_token_loss, so corrupt data
+    # gives the SAME (zero) contribution on both loss paths instead of
+    # two different wrong answers (ADVICE r03)
+    in_range = (targets >= 0) & (targets < pred.shape[-1])
+    valid = ((targets != ignore_index) & in_range).astype(jnp.float32)
     if mask is not None:
         valid = valid * mask.astype(jnp.float32)
-    safe_targets = jnp.where(targets == ignore_index, 0, targets)
+    safe_targets = jnp.where(in_range & (targets != ignore_index), targets, 0)
     if label_smoothing:
         logp = jax.nn.log_softmax(pred, axis=-1)
         nll_t = -jnp.take_along_axis(

@@ -193,6 +193,12 @@ def fused_linear_token_loss(
     ``targets``: (...) int32 (same leading shape as hidden); ``mask``
     broadcastable to targets. Differentiable w.r.t. hidden and kernel.
     The caller applies any next-token shift (as with token_loss).
+
+    Targets outside ``[0, vocab)`` are folded into the ignore mask
+    (contribute zero loss and zero gradient) rather than silently
+    picking a padded-column logit — corrupt data must not give a
+    DIFFERENT wrong answer here than in the unfused ``token_loss``
+    path (ADVICE r03).
     """
     if not 0.0 <= label_smoothing < 1.0:
         raise ValueError(
@@ -211,12 +217,13 @@ def fused_linear_token_loss(
     rows_shape = targets.shape
     h2 = hidden.reshape(-1, d)
     t2 = targets.reshape(-1)
-    valid = (t2 != ignore_index).astype(jnp.float32)
+    in_range = (t2 >= 0) & (t2 < vocab)
+    valid = ((t2 != ignore_index) & in_range).astype(jnp.float32)
     if mask is not None:
         valid = valid * jnp.broadcast_to(
             mask, rows_shape
         ).reshape(-1).astype(jnp.float32)
-    t2 = jnp.where(t2 == ignore_index, 0, t2)
+    t2 = jnp.where(in_range & (t2 != ignore_index), t2, 0)
     cfg = _Cfg(
         vocab=vocab,
         chunk=min(int(vocab_chunk), max(128, vocab)),

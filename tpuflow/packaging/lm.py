@@ -176,12 +176,15 @@ class PackagedLM:
         model's bytes-in contract. Prompts are encoded with the bundled
         tokenizer and BATCHED by exact token length (ragged batching
         without pad-token conditioning: rows of equal length share one
-        (B, P) generate() call, so a table-scale run compiles once per
-        DISTINCT prompt length and batches the forward instead of
-        looping rows — the engine behind infer.generate_table). Output
-        order matches input order; sampled rows draw from their group's
-        batch, so per-row outputs can differ from a one-at-a-time loop
-        at temperature > 0 (greedy output is identical)."""
+        (B, P) generate() call). Each group's batch is padded up to the
+        next power of two (pad rows repeat row 0 and are discarded), so
+        a table-scale run compiles once per (prompt length, batch
+        BUCKET) — without the bucketing, generate_table's chunking
+        makes group sizes vary per chunk and the same prompt length
+        recompiles repeatedly (ADVICE r03). Output order matches input
+        order; sampled rows draw from their group's batch, so per-row
+        outputs can differ from a one-at-a-time loop at temperature > 0
+        (greedy output is identical)."""
         tok = self._require_tokenizer()
         eos = kwargs.get("eos_id", self.generate_defaults.get("eos_id"))
         encoded = [np.asarray(tok.encode(p), np.int32) for p in prompts]
@@ -191,6 +194,14 @@ class PackagedLM:
         out: "list[Optional[str]]" = [None] * len(prompts)
         for plen, idxs in by_len.items():
             batch = np.stack([encoded[i] for i in idxs])
+            # next pow2 >= B, capped at the CALLER's total prompt count:
+            # generate_table sizes its chunks to the device-memory
+            # budget, and padding a full chunk past it could OOM
+            bucket = min(1 << (len(idxs) - 1).bit_length(), len(prompts))
+            if bucket > len(idxs):
+                batch = np.concatenate(
+                    [batch, np.tile(batch[:1], (bucket - len(idxs), 1))]
+                )
             fulls = self.generate(batch, max_new_tokens=max_new_tokens,
                                   **kwargs)
             for row, i in enumerate(idxs):
