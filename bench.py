@@ -121,6 +121,16 @@ def _set_provisional(**kw) -> None:
     }, "diagnostics": kw.get("diagnostics")})
 
 
+def _emit_provisional(error_msg: str) -> None:
+    """Emit the held provisional with an error annotation — the shared
+    fallback of the in-process watchdog and the catch-all handler."""
+    emit(_PROVISIONAL["value"], _PROVISIONAL["vs_baseline"],
+         error=error_msg,
+         diagnostics=_PROVISIONAL.get("diagnostics"),
+         metric=_PROVISIONAL.get("metric", "train_images_per_sec_per_chip"),
+         unit=_PROVISIONAL.get("unit", "images/s/chip"))
+
+
 def _last_known_good(metric: Optional[str] = None):
     """The most recent committed on-chip result (BENCH_LOCAL_*.json) —
     embedded in failure-path output so a dead TPU tunnel at bench time
@@ -744,7 +754,8 @@ def _supervise(args) -> int:
         # ever fail (full /tmp), the child's own emitted JSON line is
         # the fallback success channel
         out_path = pfile + ".stdout"
-        child = subprocess.Popen(argv, stdout=open(out_path, "w"))
+        with open(out_path, "w") as out_f:
+            child = subprocess.Popen(argv, stdout=out_f)
         killed_reason = None
         last_phase = "spawn"
         while True:
@@ -916,14 +927,9 @@ def main() -> int:
     def watchdog():
         time.sleep(args.deadline)
         if _PROVISIONAL:
-            emit(
-                _PROVISIONAL["value"], _PROVISIONAL["vs_baseline"],
-                error=f"watchdog: deadline {args.deadline}s hit during "
-                      f"refinement; reporting provisional loop-timed result",
-                diagnostics=_PROVISIONAL.get("diagnostics"),
-                metric=_PROVISIONAL.get(
-                    "metric", "train_images_per_sec_per_chip"),
-                unit=_PROVISIONAL.get("unit", "images/s/chip"),
+            _emit_provisional(
+                f"watchdog: deadline {args.deadline}s hit during "
+                f"refinement; reporting provisional loop-timed result"
             )
         else:
             emit(0.0, 0.0, error=f"watchdog: deadline {args.deadline}s "
@@ -939,12 +945,9 @@ def main() -> int:
         # and never DOWNGRADE it to 0.0 when a provisional measurement
         # already landed (same fallback the watchdog uses)
         if _PROVISIONAL:
-            emit(_PROVISIONAL["value"], _PROVISIONAL["vs_baseline"],
-                 error=f"{type(e).__name__}: {e} (reporting provisional)",
-                 diagnostics=_PROVISIONAL.get("diagnostics"),
-                 metric=_PROVISIONAL.get(
-                     "metric", "train_images_per_sec_per_chip"),
-                 unit=_PROVISIONAL.get("unit", "images/s/chip"))
+            _emit_provisional(
+                f"{type(e).__name__}: {e} (reporting provisional)"
+            )
         else:
             emit(0.0, 0.0, error=f"{type(e).__name__}: {e}")
         return 0

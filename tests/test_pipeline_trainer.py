@@ -86,6 +86,47 @@ def test_dp_x_pp_matches_unpipelined(schedule):
     np.testing.assert_allclose(losses_pp, losses_ref, rtol=2e-4)
 
 
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_dp_x_tp_x_pp_matches_unpipelined(schedule):
+    """All three dense axes on ONE mesh (dp2 x tp2 x pp2): rows over
+    'data', stages manual over 'pipe', block kernels GSPMD-sharded
+    over the auto 'model' axis inside each tick — same math as the
+    single-device run (VERDICT r3 #8: prove the axes compose)."""
+    toks = _corpus(24, 16)
+    mesh = build_nd_mesh({"data": 2, "pipe": 2, "model": 2},
+                         devices=jax.devices()[:8])
+    tr_pp = PipelineTrainer(_lm(depth=2), _cfg(), mesh=mesh,
+                            n_microbatches=4, schedule=schedule)
+    assert tr_pp.dp == 2 and tr_pp.tp == 2
+    losses_pp = _fit_losses(tr_pp, toks)
+
+    tr_ref = LMTrainer(_lm(depth=2), _cfg(),
+                       mesh=build_nd_mesh({"data": 1},
+                                          devices=jax.devices()[:1]))
+    losses_ref = _fit_losses(tr_ref, toks)
+    np.testing.assert_allclose(losses_pp, losses_ref, rtol=2e-4)
+
+
+def test_interleaved_dp_x_tp_x_pp_matches_unpipelined():
+    """The virtual-stage schedule composes with TP too: dp2 x tp2 x
+    pp2 x v2 (depth 8 = 2 stages x 2 chunks x 2 blocks)."""
+    toks = _corpus(24, 16)
+    mesh = build_nd_mesh({"data": 2, "pipe": 2, "model": 2},
+                         devices=jax.devices()[:8])
+    tr_il = PipelineTrainer(_lm(depth=8), _cfg(), mesh=mesh,
+                            n_microbatches=4, schedule="interleaved",
+                            virtual_stages=2)
+    losses_il = _fit_losses(tr_il, toks, epochs=1)
+    tr_ref = LMTrainer(_lm(depth=8), _cfg(),
+                       mesh=build_nd_mesh({"data": 1},
+                                          devices=jax.devices()[:1]))
+    losses_ref = _fit_losses(tr_ref, toks, epochs=1)
+    np.testing.assert_allclose(losses_il, losses_ref, rtol=2e-4)
+    # eval path under the 3-axis mesh
+    ev = tr_il.evaluate(toks, batch_size=8)
+    assert np.isfinite(ev["loss"])
+
+
 def test_size_one_data_axis_works():
     """A size-1 'data' axis still makes the microbatch rows
     data-varying inside shard_map — the pmean gating must follow the

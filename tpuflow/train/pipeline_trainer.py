@@ -86,6 +86,13 @@ class PipelineTrainer(LMTrainer):
     is global and must divide by ``n_microbatches`` x the data-axis
     size.
 
+    A ``model`` axis composes tensor parallelism with the pipeline
+    (DP x TP x PP on one mesh): the schedule's shard_map is manual
+    over pipe/data only, ``model`` stays a GSPMD auto axis, so the
+    blocks' existing ``with_partitioning`` annotations shard each
+    stage's kernels and XLA inserts the TP collectives inside every
+    pipeline tick. All three schedules support it.
+
     ``schedule='interleaved'`` additionally takes ``virtual_stages=v``:
     each device holds ``v`` round-robin model chunks (``depth`` must
     divide by ``n_stages*v``, ``n_microbatches`` by ``n_stages``) and
@@ -182,6 +189,25 @@ class PipelineTrainer(LMTrainer):
         # data-parallel degree (1 = pure PP); self.world from LMTrainer
         # already reads the data axis, so LR x world scaling Just Works
         self.dp = self.world
+        # tensor parallelism COMPOSES with the pipeline via partial-
+        # manual shard_map: the schedule is manual over pipe (+data)
+        # while 'model' stays a GSPMD auto axis — the blocks' existing
+        # with_partitioning annotations shard each stage's kernels and
+        # XLA inserts the TP collectives inside every pipeline tick
+        from tpuflow.parallel.mesh import MODEL_AXIS
+
+        self.tp = (mesh.shape[MODEL_AXIS]
+                   if MODEL_AXIS in mesh.axis_names else 1)
+        # manual axes for the schedule's shard_map; without a model
+        # axis this equals all mesh axes = shard_map's default
+        self._manual_axes = frozenset(mesh.axis_names) - {MODEL_AXIS}
+
+    def _smap(self, body, in_specs, out_specs):
+        """shard_map over the pipeline mesh — manual over pipe/data,
+        leaving 'model' (when present) to GSPMD inside the body."""
+        return shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs,
+                         axis_names=self._manual_axes)
 
     # token rows shard over 'data' (if present) and replicate over
     # 'pipe' (stage 0 ingests them)
@@ -214,9 +240,8 @@ class PipelineTrainer(LMTrainer):
             **self.cfg.optimizer_kwargs,
         )
         toks0 = jnp.zeros((1, 8), jnp.int32)
-        raw = nn.unbox(
-            self.model.init({"params": jax.random.key(seed)}, toks0)
-        )["params"]
+        boxed = self.model.init({"params": jax.random.key(seed)}, toks0)
+        raw = nn.unbox(boxed)["params"]
         outer = {k: v for k, v in raw.items() if not k.startswith("block")}
         per = self.blocks_per_stage
         stage_trees = [
@@ -227,14 +252,36 @@ class PipelineTrainer(LMTrainer):
             for s in self._stage_order
         ]
         stacked = stack_stage_params(stage_trees)
-        params = {
-            "outer": jax.device_put(
-                outer, NamedSharding(self.mesh, P())
-            ),
-            "stages": jax.device_put(
-                stacked, NamedSharding(self.mesh, P(PIPE_AXIS))
-            ),
-        }
+        if self.tp > 1:
+            # TP x PP: each leaf keeps its with_partitioning spec over
+            # 'model', with the stacked stage axis prepended over 'pipe'
+            spec = nn.get_partition_spec(boxed)["params"]
+            s0 = self._stage_order[0]
+            stage_spec = {
+                f"b{j}": spec[f"block{s0 * per + j}"] for j in range(per)
+            }
+            is_p = lambda x: isinstance(x, P)  # noqa: E731
+            outer_sh = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                {k: spec[k] for k in outer}, is_leaf=is_p,
+            )
+            stage_sh = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, P(PIPE_AXIS, *s)),
+                stage_spec, is_leaf=is_p,
+            )
+            params = {
+                "outer": jax.device_put(outer, outer_sh),
+                "stages": jax.device_put(stacked, stage_sh),
+            }
+        else:
+            params = {
+                "outer": jax.device_put(
+                    outer, NamedSharding(self.mesh, P())
+                ),
+                "stages": jax.device_put(
+                    stacked, NamedSharding(self.mesh, P(PIPE_AXIS))
+                ),
+            }
         self.state = TrainState(
             step=jnp.asarray(0, jnp.int32),
             params=params,
@@ -306,9 +353,8 @@ class PipelineTrainer(LMTrainer):
             outer, stages = params["outer"], params["stages"]
             x = jnp.take(outer["embed"], tokens, axis=0).astype(model.dtype)
             micro = split_microbatches(x, mm)
-            piped = shard_map(
+            piped = self._smap(
                 lambda sb, mi: from_last_stage(run_fwd(sb, mi), PIPE_AXIS),
-                mesh=mesh,
                 in_specs=(P(PIPE_AXIS), micro_spec),
                 out_specs=micro_spec,
             )
@@ -416,9 +462,8 @@ class PipelineTrainer(LMTrainer):
                 "norm_final": outer["norm_final"],
                 "lm_head": outer["lm_head"],
             }
-            piped = shard_map(
+            piped = self._smap(
                 run_wrapped,
-                mesh=mesh,
                 in_specs=(P(PIPE_AXIS), P(), P(),
                           micro_spec, micro_spec),
                 out_specs=(P(), P(PIPE_AXIS), P(), P()),
@@ -468,11 +513,10 @@ class PipelineTrainer(LMTrainer):
             self._check_micro(tokens)
             outer = state.params["outer"]
             tok_micro = split_microbatches(tokens, mm)
-            piped = shard_map(
+            piped = self._smap(
                 lambda sb, emb, mi: from_last_stage(
                     run_eval(sb, emb, mi), PIPE_AXIS
                 ),
-                mesh=mesh,
                 in_specs=(P(PIPE_AXIS), P(), micro_spec),
                 out_specs=micro_spec,
             )
