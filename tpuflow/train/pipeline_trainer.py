@@ -193,11 +193,10 @@ class PipelineTrainer(LMTrainer):
         # manual shard_map: the schedule is manual over pipe (+data)
         # while 'model' stays a GSPMD auto axis — the blocks' existing
         # with_partitioning annotations shard each stage's kernels and
-        # XLA inserts the TP collectives inside every pipeline tick
+        # XLA inserts the TP collectives inside every pipeline tick.
+        # (self.tp itself comes from the LMTrainer base.)
         from tpuflow.parallel.mesh import MODEL_AXIS
 
-        self.tp = (mesh.shape[MODEL_AXIS]
-                   if MODEL_AXIS in mesh.axis_names else 1)
         # manual axes for the schedule's shard_map; without a model
         # axis this equals all mesh axes = shard_map's default
         self._manual_axes = frozenset(mesh.axis_names) - {MODEL_AXIS}
@@ -425,7 +424,6 @@ class PipelineTrainer(LMTrainer):
         assembles the grads tree for the optimizer."""
         from tpuflow.parallel.mesh import DATA_AXIS
 
-        mesh = self.mesh
         mm = self.n_microbatches
 
         def run_wrapped(stages, embed, last_params, dm, tm):
@@ -492,7 +490,6 @@ class PipelineTrainer(LMTrainer):
         1F1B schedule over the device-major round-robin chunk layout
         (tables precomputed and verified by
         tpuflow.parallel.interleave.build_interleaved_schedule)."""
-        mesh = self.mesh
         mm = self.n_microbatches
         n, v = self.n_stages, self.virtual_stages
         sched = build_interleaved_schedule(n, v, mm)
