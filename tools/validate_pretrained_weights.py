@@ -101,12 +101,23 @@ def fetch_state_dict(model: str, cache_dir: str):
         print(f"  downloading {spec['url']} ...")
         tmp = path + ".part"
         urllib.request.urlretrieve(spec["url"], tmp)
+        # hash BEFORE promoting into the cache: a corrupt download must
+        # not wedge every later run behind the exists() fast path
+        digest = hashlib.sha256(open(tmp, "rb").read()).hexdigest()
+        if not digest.startswith(spec["sha256_8"]):
+            os.remove(tmp)
+            raise RuntimeError(
+                f"{model}: sha256 {digest[:8]}... does not match pinned "
+                f"{spec['sha256_8']} — corrupt or tampered download"
+            )
         os.replace(tmp, path)
     digest = hashlib.sha256(open(path, "rb").read()).hexdigest()
     if not digest.startswith(spec["sha256_8"]):
+        os.remove(path)  # stale/corrupt cache entry: clear for retry
         raise RuntimeError(
-            f"{model}: sha256 {digest[:8]}... does not match pinned "
-            f"{spec['sha256_8']} — corrupt or tampered download"
+            f"{model}: cached {os.path.basename(path)} sha256 "
+            f"{digest[:8]}... does not match pinned {spec['sha256_8']} "
+            "— removed; rerun to re-download"
         )
     print(f"  sha256 {digest[:16]}... ok (pinned {spec['sha256_8']})")
     return torch.load(path, map_location="cpu", weights_only=True)
