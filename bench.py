@@ -588,15 +588,22 @@ def _attention_sweep(diag: dict, rtt_ms: float = 0.0) -> None:
         if not is_tpu_backend():
             diag["attn_sweep"] = "skipped: not a TPU backend"
             return
-        b, h, s, d = 4, 8, 2048, 128
-        ks = jax.random.split(jax.random.key(1), 3)
-        q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
-        k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
-        v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+        from tpuflow.ops.attention import mha_xla
+
+        b, h, d = 4, 8, 128
         steps = 10
-        results = {}
-        for bq in (128, 256, 512, 1024):
-            for bk in (128, 256, 512, 1024):
+        sweep = {}
+        for s in (1024, 2048, 4096):
+            ks = jax.random.split(jax.random.key(1), 3)
+            q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+            k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+            v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+            results = {}
+            for bq, bk in ((128, 128), (256, 256), (512, 512),
+                           (1024, 1024), (512, 1024), (1024, 512),
+                           (256, 1024)):
+                if bq > s or bk > s:
+                    continue
                 ms = _timed_scan(
                     jax,
                     lambda c, bq=bq, bk=bk: flash_attention(
@@ -605,12 +612,23 @@ def _attention_sweep(diag: dict, rtt_ms: float = 0.0) -> None:
                     q, steps, rtt_ms,
                 )
                 results[f"q{bq}k{bk}"] = round(ms, 3)
-        best = min(results, key=results.get)
-        diag["attn_sweep"] = {
-            "shape": f"b{b}h{h}s{s}d{d}", "fwd_ms": results, "best": best
-        }
-        print(f"# attn sweep: best={best} {results}", file=sys.stderr,
-              flush=True)
+            # the materialized-einsum alternative: whichever wins at a
+            # given length is what pick_attn_impl's threshold should say
+            results["xla_einsum"] = round(_timed_scan(
+                jax, lambda c: mha_xla(c, k, v, causal=True),
+                q, steps, rtt_ms,
+            ), 3)
+            best = min(results, key=results.get)
+            fl = 2 * b * h * s * s * d  # causal half of 4*s^2*d
+            sweep[f"s{s}"] = {
+                "fwd_ms": results, "best": best,
+                "best_tflops": round(
+                    fl / (results[best] * 1e-3) / 1e12, 2
+                ),
+            }
+            print(f"# attn sweep s{s}: best={best} {results}",
+                  file=sys.stderr, flush=True)
+        diag["attn_sweep"] = {"shape": f"b{b}h{h}d{d}", **sweep}
     except Exception as e:
         diag["attn_sweep"] = f"failed: {e}"
         print(f"# attn sweep failed: {e}", file=sys.stderr, flush=True)
@@ -887,6 +905,16 @@ def main() -> int:
                         "KV-cache autoregressive decode throughput "
                         "(serving loop; vs_baseline anchors to the "
                         "param-bandwidth decode roofline)")
+    p.add_argument("--seq", type=int, default=None,
+                   help="lm only: sequence length (default 4096)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="lm only: gradient-accumulation chunks — raises "
+                        "tokens/step (MXU utilization) without raising "
+                        "peak activation memory")
+    p.add_argument("--lm-attn-impl", choices=["auto", "flash", "einsum"],
+                   default="auto",
+                   help="lm only: attention impl (tuning input — the "
+                        "watcher captures both and keeps the faster)")
     p.add_argument("--no-supervisor", action="store_true",
                    help="run the bench in-process (no parent watchdog "
                         "process); the in-process watchdog still applies")
@@ -1327,8 +1355,11 @@ def _bench_lm(args, devices) -> int:
         # runs the systolic array at half depth; 128 is the production
         # long-context head size and the kernel's native lane width
         seq, batch, dim, depth, heads, vocab = (
-            4096, args.batch or 4, 1024, 12, 8, 32000
+            args.seq or 4096, args.batch or 8, 1024, 12, 8, 32000
         )
+    # accum chunks of a full global batch each — any accum >= 1 works
+    # (tokens/step scale with accum; no batch splitting here)
+    accum = max(1, args.grad_accum)
     global_batch = batch * n_chips
     # batch-shard the tokens over all chips and replicate params — the
     # per-chip normalization below is only honest if every chip works
@@ -1337,13 +1368,16 @@ def _bench_lm(args, devices) -> int:
     from tpuflow.parallel.mesh import DATA_AXIS, build_nd_mesh
 
     mesh = build_nd_mesh({DATA_AXIS: n_chips}, devices=devices)
+    # (accum, global_batch, seq): grad accumulation scans CHUNKS of
+    # `global_batch` rows — tokens per optimizer step scale with accum
+    # while peak activation memory stays one chunk's worth
     tokens = jax.device_put(
         jnp.asarray(
             np.random.default_rng(0).integers(
-                0, vocab, (global_batch, seq), dtype=np.int32
+                0, vocab, (accum, global_batch, seq), dtype=np.int32
             )
         ),
-        NamedSharding(mesh, P(DATA_AXIS, None)),
+        NamedSharding(mesh, P(None, DATA_AXIS, None)),
     )
     tx = optax.adamw(3e-4)
 
@@ -1352,7 +1386,7 @@ def _bench_lm(args, devices) -> int:
     def _build(remat_mode: str):
         model = build_transformer_lm(
             vocab_size=vocab, dim=dim, depth=depth, heads=heads,
-            attn_impl="auto", remat=remat_mode != "off",
+            attn_impl=args.lm_attn_impl, remat=remat_mode != "off",
             remat_policy="attn" if remat_mode == "attn" else "full",
         )
         # fused vocab-chunked loss: the hidden-states twin shares the
@@ -1362,20 +1396,34 @@ def _bench_lm(args, devices) -> int:
         import flax.linen as nn
 
         params = nn.unbox(
-            model.init({"params": jax.random.key(0)}, tokens[:1])
+            model.init({"params": jax.random.key(0)}, tokens[0, :1])
         )["params"]
         params = jax.device_put(params, NamedSharding(mesh, P()))
 
+        def loss_fn(p, tok):
+            hidden = model_h.apply({"params": p}, tok, train=True)
+            return fused_linear_token_loss(
+                hidden[:, :-1], p["lm_head"]["kernel"], tok[:, 1:]
+            )
+
         def _step1_impl(carry):
             p, opt = carry
+            if accum == 1:
+                loss, grads = jax.value_and_grad(loss_fn)(p, tokens[0])
+            else:
+                def body(c, tok):
+                    l, g = jax.value_and_grad(loss_fn)(p, tok)
+                    cl, cg = c
+                    return (cl + l, jax.tree.map(jnp.add, cg, g)), ()
 
-            def loss_fn(p):
-                hidden = model_h.apply({"params": p}, tokens, train=True)
-                return fused_linear_token_loss(
-                    hidden[:, :-1], p["lm_head"]["kernel"], tokens[:, 1:]
+                zeros = jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), p
                 )
-
-            loss, grads = jax.value_and_grad(loss_fn)(p)
+                (loss, grads), _ = jax.lax.scan(
+                    body, (jnp.zeros((), jnp.float32), zeros), tokens
+                )
+                loss = loss / accum
+                grads = jax.tree.map(lambda g: g / accum, grads)
             updates, opt = tx.update(grads, opt, p)
             return (optax.apply_updates(p, updates), opt), loss
 
@@ -1436,16 +1484,19 @@ def _bench_lm(args, devices) -> int:
                 "model": f"lm-d{dim}x{depth}h{heads}-s{seq}",
                 "seq_len": seq,
                 "batch_per_chip": batch,
+                "grad_accum": accum,
+                "attn_impl": args.lm_attn_impl,
                 "remat": remat_mode,
                 "sequences_per_sec_per_chip": round(
-                    global_batch / dt / n_chips, 2
+                    global_batch * accum / dt / n_chips, 2
                 ),
             },
         )
 
     def _record(dt, method, dt_loop, last_loss):
         mfu_v, diag = _diag_for(dt, method, dt_loop, last_loss)
-        return global_batch * seq / dt / n_chips, mfu_v / 0.60, diag
+        return (global_batch * accum * seq / dt / n_chips,
+                mfu_v / 0.60, diag)
 
     state, dt, method, dt_loop, last_loss = _run_timing(
         args, jax, step1, state, rtt_ms, _record,
@@ -1463,9 +1514,9 @@ def _bench_lm(args, devices) -> int:
         diag["trace_dir"] = args.trace
     if args.attn_sweep:
         _attention_sweep(diag, rtt_ms=rtt_ms)
-    tok_s_chip = global_batch * seq / dt / n_chips
+    tok_s_chip = global_batch * accum * seq / dt / n_chips
     print(
-        f"# lm seq={seq} batch/chip={batch} step={dt*1e3:.2f}ms "
+        f"# lm seq={seq} batch/chip={batch}x{accum} step={dt*1e3:.2f}ms "
         f"tokens/s/chip={tok_s_chip:.0f} "
         f"MFU={mfu_val*100:.1f}% loss={last_loss:.4f}",
         file=sys.stderr, flush=True,

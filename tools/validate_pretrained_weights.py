@@ -111,14 +111,15 @@ def fetch_state_dict(model: str, cache_dir: str):
                 f"{spec['sha256_8']} — corrupt or tampered download"
             )
         os.replace(tmp, path)
-    digest = hashlib.sha256(open(path, "rb").read()).hexdigest()
-    if not digest.startswith(spec["sha256_8"]):
-        os.remove(path)  # stale/corrupt cache entry: clear for retry
-        raise RuntimeError(
-            f"{model}: cached {os.path.basename(path)} sha256 "
-            f"{digest[:8]}... does not match pinned {spec['sha256_8']} "
-            "— removed; rerun to re-download"
-        )
+    else:  # cache hit: re-verify (fresh downloads were hashed above)
+        digest = hashlib.sha256(open(path, "rb").read()).hexdigest()
+        if not digest.startswith(spec["sha256_8"]):
+            os.remove(path)  # stale/corrupt cache entry: clear for retry
+            raise RuntimeError(
+                f"{model}: cached {os.path.basename(path)} sha256 "
+                f"{digest[:8]}... does not match pinned {spec['sha256_8']} "
+                "— removed; rerun to re-download"
+            )
     print(f"  sha256 {digest[:16]}... ok (pinned {spec['sha256_8']})")
     return torch.load(path, map_location="cpu", weights_only=True)
 

@@ -153,6 +153,11 @@ class Dataset:
         # observability for the bounded-memory guarantee (tests)
         self.peak_buffered_rows = 0
         self.decode_calls = 0  # rows actually sent to the native decoder
+        # corrupt-row occurrences seen (each substituted by a valid row
+        # of the same batch — see _substitute_failures); cache mode
+        # remembers failed row indices so later epochs substitute too
+        self.decode_failures = 0
+        self._decode_failed: set = set()
 
         self._contents: list = []
         self._labels: list = []
@@ -362,11 +367,13 @@ class Dataset:
         """Assemble a batch from the decoded-row cache, decoding only
         rows not yet cached (epoch 1 fills it; epoch 2+ is pure memcpy).
         Cached rows come from fresh decode outputs (never the reuse
-        ring), so they stay valid for the Dataset's lifetime."""
+        ring), so they stay valid for the Dataset's lifetime. Returns
+        (images, ok) — failed rows stay remembered so every epoch's
+        batch substitution sees them, not just the one that decoded."""
         missing = [j for j, i in enumerate(idxs) if i not in self._decoded_cache]
         if missing:
             self.decode_calls += len(missing)
-            fresh, _ok = decode_resize_batch(
+            fresh, fok = decode_resize_batch(
                 [jpegs[j] for j in missing],
                 self.img_height,
                 self.img_width,
@@ -374,6 +381,8 @@ class Dataset:
             )
             for k, j in enumerate(missing):
                 self._decoded_cache[idxs[j]] = fresh[k]
+                if not fok[k]:
+                    self._decode_failed.add(idxs[j])
         images = (
             out
             if out is not None
@@ -381,9 +390,31 @@ class Dataset:
                 (len(idxs), self.img_height, self.img_width, 3), np.uint8
             )
         )
+        ok = np.ones((len(idxs),), np.uint8)
         for j, i in enumerate(idxs):
             images[j] = self._decoded_cache[i]
-        return images
+            if i in self._decode_failed:
+                ok[j] = 0
+        return images, ok
+
+    def _substitute_failures(self, images, labels, ok) -> None:
+        """Replace corrupt rows (ok=0) with a valid row of the SAME
+        batch — image and label together. A zero image under a real
+        label is silent label noise (the wild-corpus case the C++
+        error path exists for: truncated/CMYK/garbage files); a
+        bootstrap-resample of the batch is distribution-neutral and
+        keeps shapes static for jit. An all-corrupt batch stays zeroed
+        (nothing to substitute) — the counter still records it."""
+        bad = np.flatnonzero(ok == 0)
+        if not len(bad):
+            return
+        self.decode_failures += int(len(bad))
+        good = np.flatnonzero(ok != 0)
+        if not len(good):
+            return
+        for j, g in zip(bad, np.resize(good, len(bad))):
+            images[j] = images[g]
+            labels[j] = labels[g]
 
     @staticmethod
     def _stage_put(q: "queue.Queue", item, stop: threading.Event) -> bool:
@@ -475,19 +506,21 @@ class Dataset:
                     out = self._decode_out(pool, slot)
                     slot = (slot + 1) % len(pool)
                 if self.cache_decoded and idxs and idxs[0] is not None:
-                    images = self._decode_cached(idxs, jpegs, out)
+                    images, ok = self._decode_cached(idxs, jpegs, out)
                 else:
                     self.decode_calls += len(jpegs)
-                    images, _ok = decode_resize_batch(
+                    images, ok = decode_resize_batch(
                         jpegs,
                         self.img_height,
                         self.img_width,
                         num_threads=self.num_decode_workers,
                         out=out,
                     )
+                labels = np.asarray(labels, np.int32)
+                self._substitute_failures(images, labels, ok)
                 if not self._stage_put(
                     out_q,
-                    {"image": images, "label": np.asarray(labels, np.int32)},
+                    {"image": images, "label": labels},
                     stop,
                 ):
                     return
