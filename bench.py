@@ -551,6 +551,27 @@ def _base_diag(dt, method, dt_loop, last_loss, *, flops, n_chips, peak,
     return mfu_v, rec
 
 
+def _trace_attribution(args):
+    """Parse the just-captured profiler trace into the top-op/category
+    table (tools.trace_top_ops) — the committed artifact carries its
+    own time-sink attribution instead of a multi-MB trace dir. Never
+    raises."""
+    if not args.trace:
+        return None
+    try:
+        from tools.trace_top_ops import summarize
+
+        s = summarize(args.trace)
+        if s:
+            print(f"# trace attribution: {s.get('by_category_pct')}",
+                  file=sys.stderr, flush=True)
+        return s or None
+    except Exception as e:
+        print(f"# trace attribution failed: {e}", file=sys.stderr,
+              flush=True)
+        return None
+
+
 def _measure_rtt() -> float:
     """Host↔device round-trip (dispatch trivial op + fetch scalar), ms.
 
@@ -593,42 +614,51 @@ def _attention_sweep(diag: dict, rtt_ms: float = 0.0) -> None:
         b, h, d = 4, 8, 128
         steps = 10
         sweep = {}
-        for s in (1024, 2048, 4096):
-            ks = jax.random.split(jax.random.key(1), 3)
-            q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
-            k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
-            v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
-            results = {}
-            for bq, bk in ((128, 128), (256, 256), (512, 512),
-                           (1024, 1024), (512, 1024), (1024, 512),
-                           (256, 1024)):
-                if bq > s or bk > s:
-                    continue
-                ms = _timed_scan(
-                    jax,
-                    lambda c, bq=bq, bk=bk: flash_attention(
-                        c, k, v, causal=True, block_q=bq, block_k=bk
-                    ),
-                    q, steps, rtt_ms,
-                )
-                results[f"q{bq}k{bk}"] = round(ms, 3)
-            # the materialized-einsum alternative: whichever wins at a
-            # given length is what pick_attn_impl's threshold should say
-            results["xla_einsum"] = round(_timed_scan(
-                jax, lambda c: mha_xla(c, k, v, causal=True),
-                q, steps, rtt_ms,
-            ), 3)
-            best = min(results, key=results.get)
-            fl = 2 * b * h * s * s * d  # causal half of 4*s^2*d
-            sweep[f"s{s}"] = {
-                "fwd_ms": results, "best": best,
-                "best_tflops": round(
-                    fl / (results[best] * 1e-3) / 1e12, 2
-                ),
-            }
-            print(f"# attn sweep s{s}: best={best} {results}",
-                  file=sys.stderr, flush=True)
         diag["attn_sweep"] = {"shape": f"b{b}h{h}d{d}", **sweep}
+        for s in (1024, 2048, 4096):
+            # per-length try: an OOM at s=4096 (the einsum point builds
+            # the full score matrix) must not discard the completed
+            # shorter-length measurements — relay windows are scarce
+            try:
+                ks = jax.random.split(jax.random.key(1), 3)
+                q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+                k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+                v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+                results = {}
+                for bq, bk in ((128, 128), (256, 256), (512, 512),
+                               (1024, 1024), (512, 1024), (1024, 512),
+                               (256, 1024)):
+                    if bq > s or bk > s:
+                        continue
+                    ms = _timed_scan(
+                        jax,
+                        lambda c, bq=bq, bk=bk: flash_attention(
+                            c, k, v, causal=True, block_q=bq, block_k=bk
+                        ),
+                        q, steps, rtt_ms,
+                    )
+                    results[f"q{bq}k{bk}"] = round(ms, 3)
+                # the materialized-einsum alternative: whichever wins at
+                # a length is what pick_attn_impl's threshold should say
+                results["xla_einsum"] = round(_timed_scan(
+                    jax, lambda c: mha_xla(c, k, v, causal=True),
+                    q, steps, rtt_ms,
+                ), 3)
+                best = min(results, key=results.get)
+                fl = 2 * b * h * s * s * d  # causal half of 4*s^2*d
+                sweep[f"s{s}"] = {
+                    "fwd_ms": results, "best": best,
+                    "best_tflops": round(
+                        fl / (results[best] * 1e-3) / 1e12, 2
+                    ),
+                }
+                print(f"# attn sweep s{s}: best={best} {results}",
+                      file=sys.stderr, flush=True)
+            except Exception as e:
+                sweep[f"s{s}"] = f"failed: {e}"[:300]
+                print(f"# attn sweep s{s} failed: {e}", file=sys.stderr,
+                      flush=True)
+            diag["attn_sweep"] = {"shape": f"b{b}h{h}d{d}", **sweep}
     except Exception as e:
         diag["attn_sweep"] = f"failed: {e}"
         print(f"# attn sweep failed: {e}", file=sys.stderr, flush=True)
@@ -1128,6 +1158,7 @@ def _bench(args) -> int:
             float(loss)
 
     img_per_sec_chip = global_batch / dt / n_chips
+    trace_summary = _trace_attribution(args)
     mfu_val, diag = _diag_for(dt, method, dt_loop, last_loss)
     try:
         diag["decode_scaling_img_per_s"] = _decode_scaling(hw)
@@ -1139,6 +1170,8 @@ def _bench(args) -> int:
     _transport_diag(diag, rtt_ms, smoke=args.smoke)
     if args.trace:
         diag["trace_dir"] = args.trace  # captured AFTER the timed loop
+        if trace_summary:
+            diag["trace_top_ops"] = trace_summary
     if not args.no_attn_diag:
         _attention_diag(diag, small=args.smoke, rtt_ms=rtt_ms)
     if args.attn_sweep:
@@ -1512,6 +1545,9 @@ def _bench_lm(args, devices) -> int:
                 state, loss = step1(state)
             float(loss)
         diag["trace_dir"] = args.trace
+        ts = _trace_attribution(args)
+        if ts:
+            diag["trace_top_ops"] = ts
     if args.attn_sweep:
         _attention_sweep(diag, rtt_ms=rtt_ms)
     tok_s_chip = global_batch * accum * seq / dt / n_chips

@@ -171,3 +171,32 @@ def test_local_cluster_gang_restart(tmp_path):
     assert rc == 0
     assert marker.exists()
     assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
+
+
+def test_trace_top_ops_summarize(tmp_path):
+    """Trace attribution (tools.trace_top_ops): a profiler capture of a
+    jitted matmul chain must attribute device time to the dot ops, not
+    runtime wrappers — the evidence format behind MFU_ANALYSIS."""
+    import jax
+    import jax.numpy as jnp
+
+    from tools.trace_top_ops import summarize
+
+    @jax.jit
+    def f(x, w):
+        for _ in range(3):
+            x = jnp.tanh(x @ w)
+        return x
+
+    x = jnp.ones((128, 128))
+    f(x, x).block_until_ready()
+    d = str(tmp_path / "tr")
+    with jax.profiler.trace(d):
+        f(x, x).block_until_ready()
+    s = summarize(d)
+    assert s and s["device_total_ms"] > 0
+    names = " ".join(o["name"] for o in s["top_ops"])
+    assert "dot" in names or "fusion" in names.lower()
+    assert "ThunkExecutor" not in names  # runtime frames filtered
+    assert abs(sum(s["by_category_pct"].values()) - 100) < 1.5
+    assert summarize(str(tmp_path / "empty")) == {}

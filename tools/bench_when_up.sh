@@ -56,7 +56,18 @@ while true; do
     # --- 4: input plane + serving -------------------------------------
     [ -f BENCH_LOCAL_r04_e2e.json ] || capture BENCH_LOCAL_r04_e2e.json --end2end --no-attn-diag --deadline 2300 || ok=1
     [ -f BENCH_LOCAL_r04_generate.json ] || capture BENCH_LOCAL_r04_generate.json --model generate --no-attn-diag || true
-    if [ "$ok" -eq 0 ]; then
+    # exit only when EVERY queue artifact exists (a tunnel drop during
+    # a non-gating capture must resume next window, not end the watch)
+    all_present=1
+    for f in BENCH_LOCAL_r04_cnn.json CACHE_CHECK_r04.json \
+             BENCH_LOCAL_r04_lm.json BENCH_LOCAL_r04_lm_accum4.json \
+             BENCH_LOCAL_r04_lm_einsum.json BENCH_LOCAL_r04_sweep.json \
+             BENCH_LOCAL_r04_resnet50.json BENCH_LOCAL_r04_vit.json \
+             CONVERGENCE_r04.json BENCH_LOCAL_r04_e2e.json \
+             BENCH_LOCAL_r04_generate.json; do
+      [ -f "$f" ] || all_present=0
+    done
+    if [ "$all_present" -eq 1 ]; then
       echo "$(date) all r04 captures done" >> "$log"; exit 0
     fi
   else
