@@ -49,6 +49,9 @@ while true; do
     # --- 2: dense models with traces ----------------------------------
     [ -f BENCH_LOCAL_r04_resnet50.json ] || capture BENCH_LOCAL_r04_resnet50.json --model resnet50 --steps 20 --no-attn-diag --trace traces_r04/resnet50 || ok=1
     [ -f BENCH_LOCAL_r04_vit.json ] || capture BENCH_LOCAL_r04_vit.json --model vit --steps 15 --no-attn-diag --trace traces_r04/vit || ok=1
+    # batch-scaling probes (non-gating): is MFU batch-starved?
+    [ -f BENCH_LOCAL_r04_resnet50_b512.json ] || capture BENCH_LOCAL_r04_resnet50_b512.json --model resnet50 --batch 512 --steps 10 --no-attn-diag || true
+    [ -f BENCH_LOCAL_r04_vit_b256.json ] || capture BENCH_LOCAL_r04_vit_b256.json --model vit --batch 256 --steps 10 --no-attn-diag || true
     # --- 3: on-chip convergence ---------------------------------------
     [ -f CONVERGENCE_r04.json ] || timeout -k 30 2400 \
       python tools/convergence_run.py --round 4 --epochs 12 \
