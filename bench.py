@@ -246,7 +246,17 @@ def _rtt_correct(total_s: float, rtt_ms: float) -> float:
 def _timed_scan(jax, fn, carry, steps: int, rtt_ms: float) -> float:
     """ms per application of ``fn`` (carry -> carry), timed as `steps`
     chained calls inside ONE jitted lax.scan with a scalar-fetch sync
-    (the relay-safe methodology of the module docstring)."""
+    (the relay-safe methodology of the module docstring).
+
+    RTT-floor guard (round-4 fix): when the whole scan finishes in
+    less than ~4 relay round-trips, ``_rtt_correct``'s half-window cap
+    turns the correction into an artificial FLOOR of ~rtt/2 per call —
+    the r03 artifacts' 3.66 TF/s short-seq flash number and the
+    121-143 GB/s "HBM bandwidth" were exactly this floor, not real
+    measurements. The fix dispatches M chained scan calls (async,
+    carry fed forward, NO per-call fetch — a single scalar fetch at
+    the end pays the RTT once) so the timed window grows past the
+    relay noise without recompiling."""
 
     @jax.jit
     def _many(c):
@@ -260,9 +270,23 @@ def _timed_scan(jax, fn, carry, steps: int, rtt_ms: float) -> float:
         float(leaf.reshape(-1)[0])
 
     _sync(_many(carry))  # compile
-    t0 = time.time()
-    _sync(_many(carry))
-    return _rtt_correct(time.time() - t0, rtt_ms) / steps * 1e3
+
+    def run(m):
+        t0 = time.time()
+        c = carry
+        for _ in range(m):
+            c = _many(c)  # async dispatch; device chains on the carry
+        _sync(c)
+        return time.time() - t0
+
+    total, m = run(1), 1
+    rtt_s = rtt_ms * 1e-3
+    if rtt_s > 1e-4 and total < 4 * rtt_s:
+        # estimated pure-compute share of the first window
+        est = max(total - min(rtt_s, total / 2), 1e-4)
+        m = int(min(64, max(2, -(-6 * rtt_s // est))))
+        total = run(m)
+    return _rtt_correct(total, rtt_ms) / (m * steps) * 1e3
 
 
 def _attention_diag(diag: dict, small: bool = False,
@@ -500,15 +524,29 @@ def _run_timing(args, jax, step1, state, rtt_ms, make_record,
         state, losses = _many(state)
         last_loss = float(losses[-1])
         scan_compile_s = time.time() - t0
-        best = float("inf")
-        for _ in range(2):
+
+        def run(m):
+            nonlocal state, last_loss
             t0 = time.time()
-            state, losses = _many(state)
+            for _ in range(m):
+                # async dispatch, carry chained on-device; ONE scalar
+                # fetch at the end pays the relay RTT once for m scans
+                state, losses = _many(state)
             last_loss = float(losses[-1])
-            # one dispatch+fetch still rides the relay once per
-            # call — subtract it (_rtt_correct)
-            total = _rtt_correct(time.time() - t0, rtt_ms)
-            best = min(best, total / K)
+            return time.time() - t0
+
+        # corrected totals never under-subtract (the cap), so each
+        # estimate is an upper bound on the true per-step time and
+        # min() over window sizes is safe; growing the window past
+        # ~4 RTTs removes the rtt/2-per-call floor (see _timed_scan)
+        total, m = run(1), 1
+        best = _rtt_correct(total, rtt_ms) / (m * K)
+        rtt_s = rtt_ms * 1e-3
+        if rtt_s > 1e-4 and total < 4 * rtt_s:
+            est = max(total - min(rtt_s, total / 2), 1e-4)
+            m = int(min(32, max(2, -(-6 * rtt_s // est))))
+            total = run(m)
+            best = min(best, _rtt_correct(total, rtt_ms) / (m * K))
         if best < min_step_s:
             method = (f"loop_fetch (scan{K} rejected: {best*1e3:.3f} ms/step "
                       f"is below the 100%-MFU physics floor "
