@@ -589,6 +589,52 @@ def _base_diag(dt, method, dt_loop, last_loss, *, flops, n_chips, peak,
     return mfu_v, rec
 
 
+def _cleanup_progress_dir() -> None:
+    """Child-side cleanup of the supervisor's tempdir — ONLY when the
+    child has been orphaned (the supervisor returned early on the
+    final record and exited, reparenting the child to init). While the
+    supervisor is alive it still reads these files, and its own
+    success/exhaustion paths do the rmtree. Only touches tempfile-named
+    dirs; a SIGKILLed orphan leaks one small dir, acceptable."""
+    if _PROGRESS_PATH is None:
+        return
+    if os.getppid() != 1:
+        print(f"# progress-dir cleanup deferred to supervisor "
+              f"(ppid {os.getppid()})", file=sys.stderr, flush=True)
+        return
+    d = os.path.dirname(os.path.abspath(_PROGRESS_PATH))
+    if os.path.basename(d).startswith("tpuflow_bench_"):
+        import shutil
+
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _write_extended_diag(core_diag: dict, build_ext, out=None) -> None:
+    """Run the post-emit extended diagnostics and write them (plus the
+    core record they accompany) to ``BENCH_DIAG_<mode>.json`` at the
+    repo root (or ``out``). Runs AFTER the stdout line is out — a
+    failure or wedge here costs only the side artifact, never the
+    driver's record."""
+    try:
+        ext = build_ext()
+        rec = {"mode": _MODE, "core": core_diag, "extended": ext,
+               "written_at": time.strftime("%Y-%m-%d %H:%M:%S")}
+        path = out or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"BENCH_DIAG_{_MODE}.json")
+        # atomic: the design explicitly allows killing the child mid-
+        # extended-diag (watchdog os._exit, watcher drain) — a torn
+        # JSON artifact must never ship
+        with open(path + ".tmp", "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(path + ".tmp", path)
+        print(f"# extended diagnostics -> {path}", file=sys.stderr,
+              flush=True)
+    except Exception as e:
+        print(f"# extended diagnostics failed: {e}", file=sys.stderr,
+              flush=True)
+
+
 def _trace_attribution(args):
     """Parse the just-captured profiler trace into the top-op/category
     table (tools.trace_top_ops) — the committed artifact carries its
@@ -866,12 +912,28 @@ def _supervise(args) -> int:
         while True:
             rc = child.poll()
             recs = _read_progress(pfile)
+            early_final = None
             for r in recs:
                 if r.get("phase") == "provisional":
                     if _prov_rank(r) >= best_rank:
                         best_prov, best_rank = r, _prov_rank(r)
+                elif r.get("final") and r["record"].get("value", 0) > 0:
+                    early_final = r["record"]
                 elif r.get("phase"):
                     last_phase = r["phase"]
+            if early_final is not None:
+                # the headline line exists NOW — print it and return,
+                # leaving a still-running child to finish its post-emit
+                # extended diagnostics (side artifact) as an orphan (it
+                # removes the workdir itself once reparented); the
+                # driver must never wait on a wedged 64k-diag compile
+                print(json.dumps(early_final), flush=True)
+                try:  # child already done (or about to be): we clean
+                    child.wait(timeout=2)
+                    shutil.rmtree(workdir, ignore_errors=True)
+                except subprocess.TimeoutExpired:
+                    pass  # long diags: the orphan cleans after itself
+                return 0
             if rc is not None:
                 break
             if remaining() <= 0:
@@ -1017,6 +1079,10 @@ def main() -> int:
                    help="persistent XLA compilation cache dir (committed "
                         "to the repo so driver runs pay ~0s recompile; "
                         "'' disables)")
+    p.add_argument("--diag-out", default=None,
+                   help="path for the post-emit extended-diagnostics "
+                        "side artifact (default BENCH_DIAG_<mode>.json "
+                        "at the repo root)")
     p.add_argument("--progress-file", default=None, help=argparse.SUPPRESS)
     args = p.parse_args()
     global _MODE, _PROGRESS_PATH
@@ -1055,7 +1121,9 @@ def main() -> int:
     threading.Thread(target=watchdog, daemon=True).start()
 
     try:
-        return _bench(args)
+        rc = _bench(args)
+        _cleanup_progress_dir()
+        return rc
     except BaseException as e:  # never exit without the JSON line —
         # and never DOWNGRADE it to 0.0 when a provisional measurement
         # already landed (same fallback the watchdog uses)
@@ -1206,33 +1274,12 @@ def _bench(args) -> int:
         min_step_s=flops / (n_chips * peak) if flops else 0.0,
     )
 
-    if args.trace:
-        # profile a few EXTRA steps after the timed loop — capture
-        # overhead must not contaminate the reported step time/MFU
-        with jax.profiler.trace(args.trace):
-            for _ in range(min(5, args.steps)):
-                state, loss = step1(state)
-            float(loss)
-
     img_per_sec_chip = global_batch / dt / n_chips
-    trace_summary = _trace_attribution(args)
     mfu_val, diag = _diag_for(dt, method, dt_loop, last_loss)
     try:
-        diag["decode_scaling_img_per_s"] = _decode_scaling(hw)
-        diag["decode_img_per_s"] = diag["decode_scaling_img_per_s"].get(
-            str(os.cpu_count() or 1), 0.0
-        )
+        diag["decode_img_per_s"] = round(_decode_diag(hw), 1)  # quick point
     except Exception:
         diag["decode_img_per_s"] = 0.0
-    _transport_diag(diag, rtt_ms, smoke=args.smoke)
-    if args.trace:
-        diag["trace_dir"] = args.trace  # captured AFTER the timed loop
-        if trace_summary:
-            diag["trace_top_ops"] = trace_summary
-    if not args.no_attn_diag:
-        _attention_diag(diag, small=args.smoke, rtt_ms=rtt_ms)
-    if args.attn_sweep:
-        _attention_sweep(diag, rtt_ms=rtt_ms)
 
     print(
         f"# devices={n_chips} ({devices[0].device_kind}) hw={hw} width={width} "
@@ -1241,7 +1288,42 @@ def _bench(args) -> int:
         f"decode={diag['decode_img_per_s']:.0f} img/s loss={diag['loss']:.4f}",
         file=sys.stderr, flush=True,
     )
+    # the headline artifact goes out BEFORE the expensive diagnostics:
+    # a wedged 64k-attention compile or sweep must never cost the
+    # driver its error:null line (the r01-r03 streak's root shape)
     emit(img_per_sec_chip, mfu_val / 0.60, diagnostics=diag)
+
+    def _extended():
+        # every section guards itself — one failed diagnostic must not
+        # erase the others from the side artifact
+        ext = {}
+        if args.trace:
+            try:
+                # profile a few EXTRA steps after the timed loop —
+                # capture overhead must not contaminate the step time
+                s2, loss2 = step1(state)
+                with jax.profiler.trace(args.trace):
+                    for _ in range(min(5, args.steps)):
+                        s2, loss2 = step1(s2)
+                    float(loss2)
+                ext["trace_dir"] = args.trace
+                ts = _trace_attribution(args)
+                if ts:
+                    ext["trace_top_ops"] = ts
+            except Exception as e:
+                ext["trace"] = f"failed: {e}"[:300]
+        try:
+            ext["decode_scaling_img_per_s"] = _decode_scaling(hw)
+        except Exception:
+            pass
+        _transport_diag(ext, rtt_ms, smoke=args.smoke)
+        if not args.no_attn_diag:
+            _attention_diag(ext, small=args.smoke, rtt_ms=rtt_ms)
+        if args.attn_sweep:
+            _attention_sweep(ext, rtt_ms=rtt_ms)
+        return ext
+
+    _write_extended_diag(diag, _extended, out=args.diag_out)
     return 0
 
 
@@ -1401,9 +1483,6 @@ def _bench_e2e(args, devices) -> int:
         diag = _diag()
         diag["decode_img_per_s"] = round(_decode_diag(hw), 0)
         _phase("decode diag done")
-        _transport_diag(diag, rtt_ms, smoke=args.smoke)
-        if args.attn_sweep:
-            _attention_sweep(diag, rtt_ms=rtt_ms)
         print(f"# e2e: epoch_s={diag['epoch_s']} "
               f"epoch1={diag['epoch1_img_per_s_chip']:.0f} img/s/chip "
               f"cached={diag['cached_img_per_s_chip']:.0f} img/s/chip",
@@ -1416,6 +1495,15 @@ def _bench_e2e(args, devices) -> int:
         emit(diag["cached_img_per_s_chip"], speedup, diagnostics=diag,
              metric="train_images_per_sec_per_chip_e2e",
              unit="images/s/chip")
+
+        def _extended():
+            ext = {}
+            _transport_diag(ext, rtt_ms, smoke=args.smoke)
+            if args.attn_sweep:
+                _attention_sweep(ext, rtt_ms=rtt_ms)
+            return ext
+
+        _write_extended_diag(diag, _extended, out=args.diag_out)
         return 0
     finally:
         if conv is not None:
@@ -1594,19 +1682,6 @@ def _bench_lm(args, devices) -> int:
         min_step_s=flops / (n_chips * peak) if flops else 0.0,
     )
     mfu_val, diag = _diag_for(dt, method, dt_loop, last_loss)
-    _transport_diag(diag, rtt_ms, smoke=args.smoke)
-    if args.trace:
-        # extra steps AFTER the timed window (same as the image path)
-        with jax.profiler.trace(args.trace):
-            for _ in range(min(5, args.steps)):
-                state, loss = step1(state)
-            float(loss)
-        diag["trace_dir"] = args.trace
-        ts = _trace_attribution(args)
-        if ts:
-            diag["trace_top_ops"] = ts
-    if args.attn_sweep:
-        _attention_sweep(diag, rtt_ms=rtt_ms)
     tok_s_chip = global_batch * accum * seq / dt / n_chips
     print(
         f"# lm seq={seq} batch/chip={batch}x{accum} step={dt*1e3:.2f}ms "
@@ -1614,8 +1689,31 @@ def _bench_lm(args, devices) -> int:
         f"MFU={mfu_val*100:.1f}% loss={last_loss:.4f}",
         file=sys.stderr, flush=True,
     )
+    # headline line first; expensive diagnostics post-emit (side file)
     emit(tok_s_chip, mfu_val / 0.60, diagnostics=diag,
          metric="train_tokens_per_sec_per_chip", unit="tokens/s/chip")
+
+    def _extended():
+        ext = {}
+        _transport_diag(ext, rtt_ms, smoke=args.smoke)
+        if args.trace:
+            try:
+                s2, loss2 = step1(state)
+                with jax.profiler.trace(args.trace):
+                    for _ in range(min(5, args.steps)):
+                        s2, loss2 = step1(s2)
+                    float(loss2)
+                ext["trace_dir"] = args.trace
+                ts = _trace_attribution(args)
+                if ts:
+                    ext["trace_top_ops"] = ts
+            except Exception as e:
+                ext["trace"] = f"failed: {e}"[:300]
+        if args.attn_sweep:
+            _attention_sweep(ext, rtt_ms=rtt_ms)
+        return ext
+
+    _write_extended_diag(diag, _extended, out=args.diag_out)
     return 0
 
 

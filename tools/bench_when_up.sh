@@ -16,10 +16,30 @@
 cd "$(dirname "$0")/.."
 log=/tmp/bench_watch.log
 
+PGID=$(ps -o pgid= -p $$ | tr -d ' ')
+
+drain_children() {
+  # the supervisor returns as soon as the headline line exists, leaving
+  # its child finishing post-emit diagnostics ON THE CHIP — wait for it
+  # before the next capture dials in (bounded: diags are expendable).
+  # Scoped to THIS watcher's process group so a concurrent manual
+  # bench run is never waited on or killed.
+  local waited=0
+  while pgrep -g "$PGID" -f "bench.py .*--progress-file" >/dev/null 2>&1; do
+    sleep 10; waited=$((waited + 10))
+    if [ "$waited" -ge 900 ]; then
+      echo "$(date) draining stuck bench child (kill)" >> "$log"
+      pkill -9 -g "$PGID" -f "bench.py .*--progress-file" 2>/dev/null
+      break
+    fi
+  done
+}
+
 capture() {  # capture <out-file> <bench args...>
   local out="$1"; shift
   echo "$(date) start $out: $*" >> "$log"
   python bench.py "$@" > "$out.tmp" 2>>"$log"
+  drain_children
   if python - "$out.tmp" <<'PY'
 import json, sys
 rec = json.load(open(sys.argv[1]))
@@ -35,30 +55,30 @@ while true; do
     echo "$(date) tunnel up; running r04 queue" >> "$log"
     ok=0
     # --- 0: flagship + compile-cache warm/proof -----------------------
-    [ -f BENCH_LOCAL_r04_cnn.json ] || capture BENCH_LOCAL_r04_cnn.json --steps 30 || ok=1
+    [ -f BENCH_LOCAL_r04_cnn.json ] || capture BENCH_LOCAL_r04_cnn.json --steps 30 --diag-out BENCH_DIAG_r04_cnn.json || ok=1
     if [ -f BENCH_LOCAL_r04_cnn.json ] && [ ! -f CACHE_CHECK_r04.json ]; then
       # same config re-run: with the persistent cache the second
       # compile should be ~seconds, not ~60s — the in-run proof
-      capture CACHE_CHECK_r04.json --steps 3 --warmup 1 --no-attn-diag || true
+      capture CACHE_CHECK_r04.json --steps 3 --warmup 1 --no-attn-diag --diag-out /tmp/diag_cache_check.json || true
     fi
     # --- 1: lm default + tuning matrix --------------------------------
-    [ -f BENCH_LOCAL_r04_lm.json ] || capture BENCH_LOCAL_r04_lm.json --model lm --steps 10 --no-attn-diag || ok=1
-    [ -f BENCH_LOCAL_r04_lm_accum4.json ] || capture BENCH_LOCAL_r04_lm_accum4.json --model lm --steps 6 --grad-accum 4 --no-attn-diag || true
-    [ -f BENCH_LOCAL_r04_lm_einsum.json ] || capture BENCH_LOCAL_r04_lm_einsum.json --model lm --steps 10 --lm-attn-impl einsum --no-attn-diag || true
-    [ -f BENCH_LOCAL_r04_sweep.json ] || capture BENCH_LOCAL_r04_sweep.json --model vit --steps 10 --attn-sweep || true
+    [ -f BENCH_LOCAL_r04_lm.json ] || capture BENCH_LOCAL_r04_lm.json --model lm --steps 10 --no-attn-diag --trace traces_r04/lm --diag-out BENCH_DIAG_r04_lm.json || ok=1
+    [ -f BENCH_LOCAL_r04_lm_accum4.json ] || capture BENCH_LOCAL_r04_lm_accum4.json --model lm --steps 6 --grad-accum 4 --no-attn-diag --diag-out /tmp/diag_lm_accum4.json || true
+    [ -f BENCH_LOCAL_r04_lm_einsum.json ] || capture BENCH_LOCAL_r04_lm_einsum.json --model lm --steps 10 --lm-attn-impl einsum --no-attn-diag --diag-out /tmp/diag_lm_einsum.json || true
+    [ -f BENCH_LOCAL_r04_sweep.json ] || capture BENCH_LOCAL_r04_sweep.json --model vit --steps 10 --attn-sweep --diag-out BENCH_DIAG_r04_sweep.json || true
     # --- 2: dense models with traces ----------------------------------
-    [ -f BENCH_LOCAL_r04_resnet50.json ] || capture BENCH_LOCAL_r04_resnet50.json --model resnet50 --steps 20 --no-attn-diag --trace traces_r04/resnet50 || ok=1
-    [ -f BENCH_LOCAL_r04_vit.json ] || capture BENCH_LOCAL_r04_vit.json --model vit --steps 15 --no-attn-diag --trace traces_r04/vit || ok=1
+    [ -f BENCH_LOCAL_r04_resnet50.json ] || capture BENCH_LOCAL_r04_resnet50.json --model resnet50 --steps 20 --no-attn-diag --trace traces_r04/resnet50 --diag-out BENCH_DIAG_r04_resnet50.json || ok=1
+    [ -f BENCH_LOCAL_r04_vit.json ] || capture BENCH_LOCAL_r04_vit.json --model vit --steps 15 --no-attn-diag --trace traces_r04/vit --diag-out BENCH_DIAG_r04_vit.json || ok=1
     # batch-scaling probes (non-gating): is MFU batch-starved?
-    [ -f BENCH_LOCAL_r04_resnet50_b512.json ] || capture BENCH_LOCAL_r04_resnet50_b512.json --model resnet50 --batch 512 --steps 10 --no-attn-diag || true
-    [ -f BENCH_LOCAL_r04_vit_b256.json ] || capture BENCH_LOCAL_r04_vit_b256.json --model vit --batch 256 --steps 10 --no-attn-diag || true
+    [ -f BENCH_LOCAL_r04_resnet50_b512.json ] || capture BENCH_LOCAL_r04_resnet50_b512.json --model resnet50 --batch 512 --steps 10 --no-attn-diag --diag-out /tmp/diag_resnet_b512.json || true
+    [ -f BENCH_LOCAL_r04_vit_b256.json ] || capture BENCH_LOCAL_r04_vit_b256.json --model vit --batch 256 --steps 10 --no-attn-diag --diag-out /tmp/diag_vit_b256.json || true
     # --- 3: on-chip convergence ---------------------------------------
     [ -f CONVERGENCE_r04.json ] || timeout -k 30 2400 \
       python tools/convergence_run.py --round 4 --epochs 12 \
       --out CONVERGENCE_r04.json >> "$log" 2>&1 || ok=1
     # --- 4: input plane + serving -------------------------------------
-    [ -f BENCH_LOCAL_r04_e2e.json ] || capture BENCH_LOCAL_r04_e2e.json --end2end --no-attn-diag --deadline 2300 || ok=1
-    [ -f BENCH_LOCAL_r04_generate.json ] || capture BENCH_LOCAL_r04_generate.json --model generate --no-attn-diag || true
+    [ -f BENCH_LOCAL_r04_e2e.json ] || capture BENCH_LOCAL_r04_e2e.json --end2end --no-attn-diag --deadline 2300 --diag-out BENCH_DIAG_r04_e2e.json || ok=1
+    [ -f BENCH_LOCAL_r04_generate.json ] || capture BENCH_LOCAL_r04_generate.json --model generate --no-attn-diag --diag-out /tmp/diag_generate.json || true
     # exit only when EVERY queue artifact exists (a tunnel drop during
     # a non-gating capture must resume next window, not end the watch)
     all_present=1
