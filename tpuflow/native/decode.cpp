@@ -79,9 +79,17 @@ bool decode_jpeg(const uint8_t* data, size_t len, int min_h, int min_w,
   *w = cinfo.output_width;
   const int stride = cinfo.output_width * cinfo.output_components;
   out->resize(static_cast<size_t>(*h) * stride);
+  // Multi-row reads: hand libjpeg a window of row pointers per call
+  // (it consumes up to rec_outbuf_height — typically 1-4 — at once),
+  // trimming per-call overhead vs the one-scanline loop.
+  uint8_t* rows[8];
   while (cinfo.output_scanline < cinfo.output_height) {
-    uint8_t* row = out->data() + static_cast<size_t>(cinfo.output_scanline) * stride;
-    jpeg_read_scanlines(&cinfo, &row, 1);
+    const JDIMENSION base = cinfo.output_scanline;
+    const int want = std::min<JDIMENSION>(8, cinfo.output_height - base);
+    for (int r = 0; r < want; ++r) {
+      rows[r] = out->data() + (static_cast<size_t>(base) + r) * stride;
+    }
+    jpeg_read_scanlines(&cinfo, rows, want);
   }
   // Grayscale safety: libjpeg honors out_color_space=JCS_RGB for
   // grayscale sources too (3 components), so stride math above holds.
