@@ -423,3 +423,54 @@ def test_grad_clip_and_label_smoothing():
     np.testing.assert_allclose(float(_smoothed_ce(logits, labels, 0.0)),
                                float(base), rtol=1e-6)
     assert float(_smoothed_ce(logits, labels, 0.1)) != float(base)
+
+
+def test_cosine_decay_schedule():
+    """Warmup ramps to the scaled LR, cosine anneals to min_lr over the
+    run, plateau factor composes multiplicatively, and the default
+    schedule stays CONSTANT after warmup (reference parity)."""
+    from tpuflow.train.lr import LRController
+
+    c = LRController(1e-2, world_size=4, warmup_epochs=1,
+                     steps_per_epoch=10, decay="cosine",
+                     total_steps=110, min_lr=1e-4)
+    assert np.isclose(c.lr_for_step(0), 1e-2)
+    assert np.isclose(c.lr_for_step(10), 4e-2)       # warmup done
+    mid = c.lr_for_step(60)                          # halfway point
+    assert np.isclose(mid, (4e-2 + 1e-4) / 2, rtol=1e-6)
+    assert np.isclose(c.lr_for_step(110), 1e-4)      # floor
+    assert np.isclose(c.lr_for_step(10_000), 1e-4)   # clamped past end
+    # monotone non-increasing after warmup
+    lrs = [c.lr_for_step(s) for s in range(10, 111)]
+    assert all(a >= b for a, b in zip(lrs, lrs[1:]))
+    c.reduce(0.1)
+    assert np.isclose(c.lr_for_step(60), max(mid * 0.1, 1e-4))
+
+    const = LRController(1e-2, warmup_epochs=0, steps_per_epoch=10)
+    assert const.lr_for_step(5) == const.lr_for_step(500)
+
+    with pytest.raises(ValueError, match="decay"):
+        LRController(1e-2, decay="linear")
+
+
+def test_lm_trainer_cosine_decay_wires_through(tmp_path):
+    """cfg.lr_decay reaches the controller with the run's total steps."""
+    import jax.numpy as jnp
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    toks = np.random.default_rng(0).integers(0, 32, (8, 16)).astype(np.int32)
+    tr = LMTrainer(
+        build_transformer_lm(vocab_size=32, dim=16, depth=1, heads=2,
+                             mlp_ratio=2, dtype=jnp.float32),
+        TrainConfig(learning_rate=1e-3, warmup_epochs=1, lr_decay="cosine",
+                    min_lr=1e-5, scale_lr_by_world_size=False),
+        mesh=build_nd_mesh({"data": 1}, devices=jax.devices()[:1]),
+    )
+    tr.fit(toks, batch_size=8, epochs=3)
+    c = tr.lr_controller
+    assert c.decay == "cosine" and c.total_steps == 3 and c.min_lr == 1e-5
+    assert c.lr_for_step(3) == 1e-5  # fully annealed at run end

@@ -88,6 +88,11 @@ class TrainConfig:
     # materializes the (B*S, vocab) logits tensor (2+ GB at production
     # shapes). Requires a replicated LM head (tensor-parallel size 1).
     fused_loss: bool = False
+    # post-warmup LR schedule: 'none' (constant — reference parity) or
+    # 'cosine' (anneal to min_lr over the full run, the standard LM
+    # warmup+cosine recipe); composes with the plateau factor
+    lr_decay: str = "none"
+    min_lr: float = 0.0
     reduce_on_plateau_factor: float = 0.1
     early_stopping_patience: Optional[int] = None  # ≙ EarlyStopping, P2/03:397-401
     checkpoint_dir: Optional[str] = None

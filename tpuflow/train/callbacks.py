@@ -78,7 +78,9 @@ class ReduceLROnPlateau(Callback):
                     )
                 )
                 if self.verbose:
-                    print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+                    # peak LR: under cosine decay the per-step value
+                    # additionally follows the anneal (lr.py:reduce)
+                    print(f"ReduceLROnPlateau: peak lr -> {new_lr:.3e}")
 
 
 class EarlyStopping(Callback):

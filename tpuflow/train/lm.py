@@ -639,6 +639,9 @@ class LMTrainer:
             scale_by_world_size=cfg.scale_lr_by_world_size,
             warmup_epochs=cfg.warmup_epochs,
             steps_per_epoch=steps_per_epoch,
+            decay=cfg.lr_decay,
+            total_steps=epochs * steps_per_epoch,
+            min_lr=cfg.min_lr,
         )
         if start >= epochs:
             # nothing left to train — report eval metrics of the

@@ -10,9 +10,16 @@ Here all three compose in one host-side controller producing the LR for
 every step; the value enters the jitted step as a traced scalar so
 adjustments never recompile (per-BATCH warmup granularity, same as the
 Horovod callback).
+
+Beyond-reference knob: ``decay='cosine'`` anneals the post-warmup LR
+to ``min_lr`` over ``total_steps`` (the standard warmup+cosine LM
+recipe); it composes multiplicatively with the plateau factor, and the
+reference-parity default stays the constant schedule.
 """
 
 from __future__ import annotations
+
+import math
 
 
 class LRController:
@@ -23,22 +30,48 @@ class LRController:
         scale_by_world_size: bool = True,
         warmup_epochs: int = 5,
         steps_per_epoch: int = 1,
+        decay: str = "none",
+        total_steps: int = 0,
+        min_lr: float = 0.0,
     ):
+        if decay not in ("none", "cosine"):
+            raise ValueError(f"decay must be 'none' or 'cosine', got {decay!r}")
+        if decay == "cosine" and total_steps <= max(
+            0, int(warmup_epochs) * int(steps_per_epoch)
+        ):
+            raise ValueError(
+                f"decay='cosine' needs total_steps ({total_steps}) > "
+                f"warmup steps ({warmup_epochs}x{steps_per_epoch}) — "
+                "the requested anneal would otherwise silently never run"
+            )
         self.base_lr = float(base_lr)
         self.target_lr = float(base_lr) * (world_size if scale_by_world_size else 1)
         self.warmup_steps = max(0, int(warmup_epochs) * int(steps_per_epoch))
         self.plateau_factor = 1.0
-        self.min_lr = 0.0
+        self.decay = decay
+        self.total_steps = int(total_steps)
+        self.min_lr = float(min_lr)
 
     def lr_for_step(self, global_step: int) -> float:
         if self.warmup_steps > 0 and global_step < self.warmup_steps:
             frac = global_step / self.warmup_steps
             lr = self.base_lr + (self.target_lr - self.base_lr) * frac
+        elif self.decay == "cosine" and self.total_steps > self.warmup_steps:
+            p = (global_step - self.warmup_steps) / (
+                self.total_steps - self.warmup_steps
+            )
+            p = min(max(p, 0.0), 1.0)
+            lr = self.min_lr + (self.target_lr - self.min_lr) * 0.5 * (
+                1.0 + math.cos(math.pi * p)
+            )
         else:
             lr = self.target_lr
         return max(lr * self.plateau_factor, self.min_lr)
 
     def reduce(self, factor: float) -> float:
-        """Apply a plateau reduction; returns the new post-warmup LR."""
+        """Apply a plateau reduction; returns the new PEAK LR
+        (``target_lr x plateau_factor``) — under ``decay='cosine'`` the
+        actual per-step LR additionally follows the anneal curve and
+        the ``min_lr`` floor (:meth:`lr_for_step`)."""
         self.plateau_factor *= factor
         return self.target_lr * self.plateau_factor

@@ -335,6 +335,9 @@ class Trainer:
             scale_by_world_size=cfg.scale_lr_by_world_size,
             warmup_epochs=cfg.warmup_epochs,
             steps_per_epoch=steps_per_epoch,
+            decay=cfg.lr_decay,
+            total_steps=epochs * steps_per_epoch,
+            min_lr=cfg.min_lr,
         )
         # resume any checkpointed/prior plateau reduction (never restart
         # a resumed run at the full schedule LR)
