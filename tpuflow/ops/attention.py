@@ -111,16 +111,91 @@ def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _mha_mask(causal: bool, window, sq: int, sk: int):
+    if not causal:
+        return None
+    mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+    if window is not None:
+        mask = mask & jnp.triu(
+            jnp.ones((sq, sk), bool), k=sk - sq - window + 1
+        )
+    return mask
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _mha_xla_core(q, k, v, causal: bool, scale: float, window):
+    o, _ = _mha_xla_fwd_impl(q, k, v, causal, scale, window)
+    return o
+
+
+def _mha_xla_fwd_impl(q, k, v, causal, scale, window):
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = _mha_mask(causal, window, q.shape[2], k.shape[2])
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_BIG)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(s - m), axis=-1, keepdims=True))
+    p = jnp.exp(s - lse)
+    o = jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+    return o, lse[..., 0]
+
+
+def _mha_xla_fwd(q, k, v, causal, scale, window):
+    o, lse = _mha_xla_fwd_impl(q, k, v, causal, scale, window)
+    return o, (q, k, v, o, lse)
+
+
+def _mha_xla_bwd(causal, scale, window, res, do):
+    # custom backward with the SAME dtype discipline as the Pallas
+    # kernels: rebuild probabilities from the saved lse and cast p/ds
+    # to the input dtype before every einsum. Autodiff through the f32
+    # softmax would make the cotangent of the scores f32 and push the
+    # four O(S^2) backward dots onto the slow f32 MXU path — the exact
+    # leak the module docstring promises not to have.
+    q, k, v, o, lse = res
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)[..., None]
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = _mha_mask(causal, window, q.shape[2], k.shape[2])
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_BIG)
+    p = jnp.exp(s - lse[..., None])
+    pb = p.astype(q.dtype)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", pb, do,
+                    preferred_element_type=jnp.float32).astype(v.dtype)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", do, v,
+                    preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k,
+                    preferred_element_type=jnp.float32).astype(q.dtype)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q,
+                    preferred_element_type=jnp.float32).astype(k.dtype)
+    return dq, dk, dv
+
+
+_mha_xla_core.defvjp(_mha_xla_fwd, _mha_xla_bwd)
+
+
 def mha_xla(q, k, v, causal: bool = False, scale: Optional[float] = None,
             window: Optional[int] = None):
     """Production XLA attention: einsums in the INPUT dtype with float32
     accumulation (full-rate MXU for bf16 models — upcasting operands to
     f32 first, as the oracle does, lands on the ~8x-slower f32 MXU
-    path), float32 softmax. The right impl for short sequences where
-    the O(S^2) score matrix fits comfortably (vision models); long
-    sequences go to :func:`flash_attention`. ``window`` applies the
-    same sliding-window mask as the kernel (no block skipping here —
-    at einsum lengths the full score matrix is already materialized)."""
+    path), float32 softmax — in the FORWARD and, via a custom VJP
+    mirroring the flash kernels' backward, in every O(S^2) BACKWARD dot
+    too (autodiff through an f32 softmax would silently run them
+    f32×f32). The right impl for short sequences where the score matrix
+    fits comfortably (vision models); long sequences go to
+    :func:`flash_attention`. ``window`` applies the same sliding-window
+    mask as the kernel (no block skipping here — at einsum lengths the
+    full score matrix is already materialized)."""
     if window is not None:
         # same contract as flash_attention — swapping impls via
         # pick_attn_impl must not change error behavior
@@ -129,23 +204,8 @@ def mha_xla(q, k, v, causal: bool = False, scale: Optional[float] = None,
                              "causal=True")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        sq, sk = q.shape[2], k.shape[2]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        if window is not None:
-            mask = mask & jnp.triu(
-                jnp.ones((sq, sk), bool), k=sk - sq - window + 1
-            )
-        s = jnp.where(mask, s, _NEG_BIG)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum(
-        "bhqk,bhkd->bhqd", p.astype(q.dtype), v,
-        preferred_element_type=jnp.float32,
-    ).astype(q.dtype)
+    scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
+    return _mha_xla_core(q, k, v, causal, scale, window)
 
 
 # ---------------------------------------------------------------------------
