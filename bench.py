@@ -1481,6 +1481,32 @@ def _bench_e2e(args, devices) -> int:
                     callbacks=[_Times()])
         _phase("fit done")
         diag = _diag()
+        # phase split (VERDICT r3 #5): time the SAME number of steps on
+        # a staged device batch — the pure-compute epoch-equivalent.
+        # epoch_s minus this is the input plane's unoverlapped share,
+        # separating the framework's feed rate from the host/relay
+        # ceiling in the committed artifact.
+        try:
+            di2, dl2 = trainer._put(dummy)
+            lr2 = jnp.asarray(1e-3, jnp.float32)
+            st2, m2 = trainer._train_step(trainer.state, di2, dl2, lr2)
+            float(m2["loss"])  # sync (also re-warms post-donation)
+            t0 = time.time()
+            for _ in range(steps):
+                st2, m2 = trainer._train_step(st2, di2, dl2, lr2)
+            float(m2["loss"])
+            step_only_s = time.time() - t0
+            diag["step_only_epoch_s"] = round(step_only_s, 2)
+            best_epoch = min(epoch_times[1:] or epoch_times)
+            diag["input_unoverlapped_s"] = round(
+                max(0.0, best_epoch - step_only_s), 2
+            )
+            diag["input_share_of_epoch"] = round(
+                max(0.0, best_epoch - step_only_s) / max(best_epoch, 1e-9),
+                3,
+            )
+        except Exception as e:
+            diag["step_only_epoch_s"] = f"failed: {e}"[:200]
         diag["decode_img_per_s"] = round(_decode_diag(hw), 0)
         _phase("decode diag done")
         print(f"# e2e: epoch_s={diag['epoch_s']} "
