@@ -1102,8 +1102,15 @@ def main() -> int:
         # (and possibly hanging) to claim real hardware
         os.environ["JAX_PLATFORMS"] = "cpu"
         # and keep CPU-compiled executables out of the repo-committed
-        # TPU cache (tests run from the repo root)
+        # TPU cache, and CPU side artifacts out of the repo root
+        # (tests run from the repo root)
         args.compile_cache = ""
+        if args.diag_out is None:
+            import tempfile
+
+            args.diag_out = os.path.join(
+                tempfile.gettempdir(), f"tpuflow_smoke_diag_{_MODE}.json"
+            )
 
     def watchdog():
         time.sleep(args.deadline)
