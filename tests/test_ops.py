@@ -331,7 +331,7 @@ def test_mha_xla_custom_bwd_matches_autodiff_oracle():
             # impl (no custom VJP involved), window mask included
             from tpuflow.ops.attention import _mha_xla_fwd_impl
 
-            o, _ = _mha_xla_fwd_impl(q, k, v, causal,
+            o, _ = _mha_xla_fwd_impl(q, k, v, None, causal,
                                      q.shape[-1] ** -0.5, window)
             return o.astype(jnp.float32).sum()
 

@@ -88,6 +88,13 @@ class TrainConfig:
     # materializes the (B*S, vocab) logits tensor (2+ GB at production
     # shapes). Requires a replicated LM head (tensor-parallel size 1).
     fused_loss: bool = False
+    # LMTrainer sequence packing: when set, each training row is
+    # treated as EOS-delimited packed documents — attention is masked
+    # within documents (segment ids + per-document rotary positions
+    # derived ON DEVICE from the token stream), and the cross-document
+    # next-token prediction is excluded from the loss. None = off
+    # (rows are single sequences).
+    packed_eos_id: Optional[int] = None
     # post-warmup LR schedule: 'none' (constant — reference parity) or
     # 'cosine' (anneal to min_lr over the full run, the standard LM
     # warmup+cosine recipe); composes with the plateau factor

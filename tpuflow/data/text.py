@@ -269,7 +269,11 @@ def tokenize_corpus(
     (the writer streams; nothing is held whole). Documents are
     concatenated (optionally separated by ``eot_id``) and packed into
     ``(rows, seq_len)`` int32 rows, ragged tail dropped — the standard
-    next-token-training packing. ``tokenizer`` is a :class:`ByteBPE`
+    next-token-training packing. Train on it with
+    ``TrainConfig(packed_eos_id=eot_id)``: LMTrainer then derives
+    segment masks + per-document rotary positions on device, so packed
+    documents never attend across each other. ``tokenizer`` is a
+    :class:`ByteBPE`
     or any HuggingFace ``tokenizers``/``transformers`` tokenizer (see
     :func:`_encode_any`). Returns the corpus dir for
     :class:`tpuflow.data.tokens.TokenDataset`."""

@@ -64,14 +64,20 @@ def rotary_embed(q, k, positions, theta: float = 10000.0):
 
     ``positions``: (S,) int32 GLOBAL token positions — under sequence
     parallelism the caller passes the shard's absolute positions so
-    rotations agree across shards. Computed in float32.
+    rotations agree across shards — or (B, S) PER-ROW positions
+    (sequence packing: each packed document restarts at 0). Computed
+    in float32.
     """
     d = q.shape[-1]
     half = d // 2
     inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
-    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (S, half)
-    cos = jnp.cos(angles)[None, None, :, :]
-    sin = jnp.sin(angles)[None, None, :, :]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., S, half)
+    if angles.ndim == 2:  # (S, half): shared across batch and heads
+        cos = jnp.cos(angles)[None, None, :, :]
+        sin = jnp.sin(angles)[None, None, :, :]
+    else:  # (B, S, half): per-row packed positions, shared across heads
+        cos = jnp.cos(angles)[:, None, :, :]
+        sin = jnp.sin(angles)[:, None, :, :]
 
     def rot(t):
         t32 = t.astype(jnp.float32)
@@ -99,10 +105,16 @@ class CausalAttention(nn.Module):
     attn_window: Optional[int] = None  # sliding-window (local) attention
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segment_ids=None, positions_override=None):
         tp = self.seq_axis is None
         head_dim = self.dim // self.heads
         b, s, _ = x.shape
+        if segment_ids is not None and (
+                self.seq_axis is not None or self.decode):
+            raise ValueError(
+                "segment_ids is not supported with seq_axis (ring "
+                "attention) or decode mode"
+            )
 
         def proj_in(name):
             return nn.Dense(
@@ -172,6 +184,8 @@ class CausalAttention(nn.Module):
                     positions = shard * s + jnp.arange(s, dtype=jnp.int32)
             else:
                 positions = jnp.arange(s, dtype=jnp.int32)
+            if positions_override is not None:
+                positions = positions_override  # packed per-doc offsets
             q, k = rotary_embed(q, k, positions, self.rope_theta)
 
             if self.seq_axis is not None:
@@ -187,10 +201,12 @@ class CausalAttention(nn.Module):
                                    causal=True, layout=self.sp_layout)
             elif pick_attn_impl(s, self.attn_impl) == "flash":
                 o = flash_attention(q, k, v, causal=True,
-                                    window=self.attn_window)
+                                    window=self.attn_window,
+                                    segment_ids=segment_ids)
             else:
                 o = mha_xla(q, k, v, causal=True,
-                            window=self.attn_window)
+                            window=self.attn_window,
+                            segment_ids=segment_ids)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, self.dim)
         return nn.Dense(
             self.dim,
@@ -245,12 +261,12 @@ class DecoderBlock(nn.Module):
     attn_window: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segment_ids=None, positions=None):
         x = x + CausalAttention(
             self.dim, self.heads, self.dtype, self.attn_impl, self.seq_axis,
             self.rope_theta, self.decode, self.sp_layout,
             attn_window=self.attn_window, name="attn",
-        )(RMSNorm(self.dtype, name="norm1")(x))
+        )(RMSNorm(self.dtype, name="norm1")(x), segment_ids, positions)
         y = RMSNorm(self.dtype, name="norm2")(x)
         if self.n_experts > 0:
             from tpuflow.models.moe import MoEMlp
@@ -340,8 +356,15 @@ class TransformerLM(nn.Module):
     attn_window: Optional[int] = None  # sliding-window (local) attention
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, segment_ids=None,
+                 positions=None):
         tp = self.seq_axis is None
+        if segment_ids is not None and (
+                self.seq_axis is not None or self.decode):
+            raise ValueError(
+                "segment_ids (sequence packing) is not supported with "
+                "seq_axis (ring attention) or decode mode"
+            )
         embed = self.param(
             "embed",
             _part(nn.initializers.normal(0.02), (MODEL_AXIS, None), tp),
@@ -383,7 +406,7 @@ class TransformerLM(nn.Module):
                 remat_mlp=remat_mlp and not moe_block,
                 attn_window=self.attn_window,
                 name=f"block{i}",
-            )(x)
+            )(x, segment_ids, positions)
         x = RMSNorm(self.dtype, name="norm_final")(x)
         # vocab-sharded LM head (column-parallel); logits in float32.
         # skip_head keeps the param (identical tree) but returns the
@@ -493,6 +516,38 @@ def token_loss(logits, targets, mask=None, ignore_index: int = -1,
             pred, safe_targets
         )
     return jnp.sum(losses * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def packed_segments(tokens, eos_id: int):
+    """Derive sequence-packing metadata from EOS-delimited rows —
+    fully on-device (vectorized cumsum/cummax), so packed corpora need
+    NO extra arrays over the link: the token stream itself carries the
+    document structure.
+
+    Returns ``(segment_ids, positions, target_mask)``:
+
+    - ``segment_ids`` (B, S) int32: document index per position; the
+      EOS token belongs to the document it terminates.
+    - ``positions`` (B, S) int32: 0-based offset within the document
+      (rotary restarts per document).
+    - ``target_mask`` (B, S-1) float32, aligned with ``tokens[:, 1:]``
+      as next-token targets: 1 where target t+1 belongs to the SAME
+      document as position t — the prediction "first token of the next
+      document from my EOS" carries no signal and is masked.
+    """
+    is_eos = (tokens == eos_id).astype(jnp.int32)
+    seg = jnp.cumsum(is_eos, axis=1) - is_eos  # EOS stays in its doc
+    ar = jnp.broadcast_to(
+        jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+    )
+    is_start = jnp.concatenate(
+        [jnp.ones_like(seg[:, :1], bool), seg[:, 1:] != seg[:, :-1]],
+        axis=1,
+    )
+    start = jax.lax.cummax(jnp.where(is_start, ar, 0), axis=1)
+    positions = ar - start
+    target_mask = (seg[:, 1:] == seg[:, :-1]).astype(jnp.float32)
+    return seg, positions, target_mask
 
 
 def next_token_loss(logits, tokens, ignore_index: int = -1,

@@ -39,6 +39,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -70,6 +71,9 @@ class _Cfg(NamedTuple):
     interpret: bool
     causal_shift: int = 0
     window: Optional[int] = None
+    # sequence packing: a (BH, 1, S) segment-id row rides as an extra
+    # kernel input and positions attend only within their own segment
+    has_segments: bool = False
 
 
 def _vma(*xs):
@@ -111,28 +115,34 @@ def mha_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _mha_mask(causal: bool, window, sq: int, sk: int):
-    if not causal:
-        return None
-    mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-    if window is not None:
-        mask = mask & jnp.triu(
-            jnp.ones((sq, sk), bool), k=sk - sq - window + 1
-        )
+def _mha_mask(causal: bool, window, sq: int, sk: int, segs=None):
+    """(sq, sk) static band mask (None if unmasked), plus the optional
+    batched segment mask (B, 1, sq, sk): positions attend only within
+    their own packed document."""
+    mask = None
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        if window is not None:
+            mask = mask & jnp.triu(
+                jnp.ones((sq, sk), bool), k=sk - sq - window + 1
+            )
+    if segs is not None:
+        seg_mask = (segs[:, None, :, None] == segs[:, None, None, :])
+        mask = seg_mask if mask is None else (mask & seg_mask)
     return mask
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _mha_xla_core(q, k, v, causal: bool, scale: float, window):
-    o, _ = _mha_xla_fwd_impl(q, k, v, causal, scale, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _mha_xla_core(q, k, v, segs, causal: bool, scale: float, window):
+    o, _ = _mha_xla_fwd_impl(q, k, v, segs, causal, scale, window)
     return o
 
 
-def _mha_xla_fwd_impl(q, k, v, causal, scale, window):
+def _mha_xla_fwd_impl(q, k, v, segs, causal, scale, window):
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
-    mask = _mha_mask(causal, window, q.shape[2], k.shape[2])
+    mask = _mha_mask(causal, window, q.shape[2], k.shape[2], segs)
     if mask is not None:
         s = jnp.where(mask, s, _NEG_BIG)
     m = jnp.max(s, axis=-1, keepdims=True)
@@ -145,9 +155,9 @@ def _mha_xla_fwd_impl(q, k, v, causal, scale, window):
     return o, lse[..., 0]
 
 
-def _mha_xla_fwd(q, k, v, causal, scale, window):
-    o, lse = _mha_xla_fwd_impl(q, k, v, causal, scale, window)
-    return o, (q, k, v, o, lse)
+def _mha_xla_fwd(q, k, v, segs, causal, scale, window):
+    o, lse = _mha_xla_fwd_impl(q, k, v, segs, causal, scale, window)
+    return o, (q, k, v, segs, o, lse)
 
 
 def _mha_xla_bwd(causal, scale, window, res, do):
@@ -157,13 +167,13 @@ def _mha_xla_bwd(causal, scale, window, res, do):
     # softmax would make the cotangent of the scores f32 and push the
     # four O(S^2) backward dots onto the slow f32 MXU path — the exact
     # leak the module docstring promises not to have.
-    q, k, v, o, lse = res
+    q, k, v, segs, o, lse = res
     dof = do.astype(jnp.float32)
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)[..., None]
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
-    mask = _mha_mask(causal, window, q.shape[2], k.shape[2])
+    mask = _mha_mask(causal, window, q.shape[2], k.shape[2], segs)
     if mask is not None:
         s = jnp.where(mask, s, _NEG_BIG)
     p = jnp.exp(s - lse[..., None])
@@ -177,14 +187,16 @@ def _mha_xla_bwd(causal, scale, window, res, do):
                     preferred_element_type=jnp.float32).astype(q.dtype)
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q,
                     preferred_element_type=jnp.float32).astype(k.dtype)
-    return dq, dk, dv
+    d_segs = (None if segs is None
+              else np.zeros(segs.shape, jax.dtypes.float0))
+    return dq, dk, dv, d_segs
 
 
 _mha_xla_core.defvjp(_mha_xla_fwd, _mha_xla_bwd)
 
 
 def mha_xla(q, k, v, causal: bool = False, scale: Optional[float] = None,
-            window: Optional[int] = None):
+            window: Optional[int] = None, segment_ids=None):
     """Production XLA attention: einsums in the INPUT dtype with float32
     accumulation (full-rate MXU for bf16 models — upcasting operands to
     f32 first, as the oracle does, lands on the ~8x-slower f32 MXU
@@ -195,7 +207,10 @@ def mha_xla(q, k, v, causal: bool = False, scale: Optional[float] = None,
     fits comfortably (vision models); long sequences go to
     :func:`flash_attention`. ``window`` applies the same sliding-window
     mask as the kernel (no block skipping here — at einsum lengths the
-    full score matrix is already materialized)."""
+    full score matrix is already materialized). ``segment_ids``
+    (B, S) int32 masks attention to WITHIN each packed document —
+    sequence-packing correctness (positions never attend across the
+    documents sharing their training row)."""
     if window is not None:
         # same contract as flash_attention — swapping impls via
         # pick_attn_impl must not change error behavior
@@ -204,8 +219,14 @@ def mha_xla(q, k, v, causal: bool = False, scale: Optional[float] = None,
                              "causal=True")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if segment_ids is not None and segment_ids.shape != (
+            q.shape[0], q.shape[2]):
+        raise ValueError(
+            f"segment_ids must be (batch, seq)={q.shape[0], q.shape[2]}, "
+            f"got {segment_ids.shape}"
+        )
     scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
-    return _mha_xla_core(q, k, v, causal, scale, window)
+    return _mha_xla_core(q, k, v, segment_ids, causal, scale, window)
 
 
 # ---------------------------------------------------------------------------
@@ -292,8 +313,12 @@ def _window_first_j(qi: int, bq: int, bk: int, nk: int, shift: int,
     return jnp.clip(lax.div(first_col, bk), 0, nk - 1)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                cfg: _Cfg):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, cfg: _Cfg):
+    if cfg.has_segments:
+        seg_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
+        seg_ref = None
     # lse_ref block is the FULL padded row, shape (1, 1, sq_pad): TPU
     # block specs require the last two block dims divisible by (8, 128)
     # or equal to the array dims — a (1, block_q) tile of a (BH, S)
@@ -335,6 +360,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             mask = mask & (col <= row + cfg.causal_shift)
             if cfg.window is not None:
                 mask = mask & (col > row + cfg.causal_shift - cfg.window)
+        if cfg.has_segments:
+            qseg = seg_ref[0, 0, pl.ds(qi * bq, bq)]
+            kseg = seg_ref[0, 0, pl.ds(j * bk, bk)]
+            mask = mask & (qseg[:, None] == kseg[None, :])
         s = jnp.where(mask, s, _NEG_BIG)
         m = m_ref[:, :1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -364,18 +393,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[0, 0, pl.ds(qi * bq, bq)] = lse
 
 
-def _fwd(cfg: _Cfg, q, k, v):
+def _fwd(cfg: _Cfg, q, k, v, segs=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
     grid = (bh, sq // cfg.block_q, skv // cfg.block_k)
+    in_specs = [
+        pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = [q, k, v]
+    if cfg.has_segments:
+        # segment ids ride as a whole padded row, same legality
+        # reasoning as the lse block (see _fwd_kernel docstring)
+        in_specs.append(
+            pl.BlockSpec((1, 1, segs.shape[2]), lambda b, i, j: (b, 0, 0))
+        )
+        inputs.append(segs)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, cfg=cfg),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),
@@ -397,7 +435,7 @@ def _fwd(cfg: _Cfg, q, k, v):
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=cfg.interpret,
-    )(q, k, v)
+    )(*inputs)
     return o, lse[:, 0, :]
 
 
@@ -406,8 +444,13 @@ def _fwd(cfg: _Cfg, q, k, v):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc_ref, cfg: _Cfg):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               cfg: _Cfg):
+    if cfg.has_segments:
+        seg_ref, dq_ref, dq_acc_ref = rest
+    else:
+        dq_ref, dq_acc_ref = rest
+        seg_ref = None
     bq, d = q_ref.shape[1], q_ref.shape[2]
     bk = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -443,6 +486,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             mask = mask & (col <= row + cfg.causal_shift)
             if cfg.window is not None:
                 mask = mask & (col > row + cfg.causal_shift - cfg.window)
+        if cfg.has_segments:
+            qseg = seg_ref[0, 0, pl.ds(qi * bq, bq)]
+            kseg = seg_ref[0, 0, pl.ds(j * bk, bk)]
+            mask = mask & (qseg[:, None] == kseg[None, :])
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k_blk.dtype)
@@ -455,8 +502,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = (dq_acc_ref[...] * cfg.scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc_ref, dv_acc_ref, cfg: _Cfg):
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
+                cfg: _Cfg):
+    if cfg.has_segments:
+        seg_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+    else:
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+        seg_ref = None
     bk, d = k_ref.shape[1], k_ref.shape[2]
     bq = q_ref.shape[1]
     ki = pl.program_id(1)
@@ -500,6 +552,10 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             mask = mask & (col <= row + cfg.causal_shift)
             if cfg.window is not None:
                 mask = mask & (col > row + cfg.causal_shift - cfg.window)
+        if cfg.has_segments:
+            qseg = seg_ref[0, 0, pl.ds(i * bq, bq)]
+            kseg = seg_ref[0, 0, pl.ds(ki * bk, bk)]
+            mask = mask & (qseg[:, None] == kseg[None, :])
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv_acc_ref[...] = dv_acc_ref[...] + jnp.dot(
             p.T.astype(do_blk.dtype), do_blk, preferred_element_type=jnp.float32
@@ -516,7 +572,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do):
+def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -531,25 +587,40 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do):
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
 
+    dq_in_specs = [q_spec, k_stream, k_stream, q_spec, vec_row, vec_row]
+    dq_inputs = [q, k, v, do, lse3, delta3]
+    if cfg.has_segments:
+        dq_in_specs.append(
+            pl.BlockSpec((1, 1, segs.shape[2]), lambda b, i, j: (b, 0, 0))
+        )
+        dq_inputs.append(segs)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, cfg=cfg),
         grid=(bh, nq, nk),
-        in_specs=[q_spec, k_stream, k_stream, q_spec, vec_row, vec_row],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v, do)),
         scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
         compiler_params=semantics,
         interpret=cfg.interpret,
-    )(q, k, v, do, lse3, delta3)
+    )(*dq_inputs)
 
     # dk/dv: key blocks in the middle grid dim, queries stream innermost
     k_spec = pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0))
     q_stream = pl.BlockSpec((1, cfg.block_q, d), lambda b, j, i: (b, i, 0))
     vec_row_kv = pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0))
+    dkv_in_specs = [k_spec, k_spec, q_stream, q_stream, vec_row_kv,
+                    vec_row_kv]
+    dkv_inputs = [k, v, q, do, lse3, delta3]
+    if cfg.has_segments:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 1, segs.shape[2]), lambda b, j, i: (b, 0, 0))
+        )
+        dkv_inputs.append(segs)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, cfg=cfg),
         grid=(bh, nk, nq),
-        in_specs=[k_spec, k_spec, q_stream, q_stream, vec_row_kv, vec_row_kv],
+        in_specs=dkv_in_specs,
         out_specs=[k_spec, k_spec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, skv, d), k.dtype, vma=_vma(q, k, v, do)),
@@ -561,7 +632,7 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do):
         ],
         compiler_params=semantics,
         interpret=cfg.interpret,
-    )(k, v, q, do, lse3, delta3)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
@@ -571,19 +642,22 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _flash_core(cfg: _Cfg, q, k, v):
-    o, _ = _fwd(cfg, q, k, v)
+def _flash_core(cfg: _Cfg, q, k, v, segs):
+    o, _ = _fwd(cfg, q, k, v, segs)
     return o
 
 
-def _flash_core_fwd(cfg: _Cfg, q, k, v):
-    o, lse = _fwd(cfg, q, k, v)
-    return o, (q, k, v, o, lse)
+def _flash_core_fwd(cfg: _Cfg, q, k, v, segs):
+    o, lse = _fwd(cfg, q, k, v, segs)
+    return o, (q, k, v, segs, o, lse)
 
 
 def _flash_core_bwd(cfg: _Cfg, res, do):
-    q, k, v, o, lse = res
-    return _bwd_impl(cfg, q, k, v, o, lse, do)
+    q, k, v, segs, o, lse = res
+    dq, dk, dv = _bwd_impl(cfg, q, k, v, o, lse, do, segs)
+    d_segs = (None if segs is None
+              else np.zeros(segs.shape, jax.dtypes.float0))
+    return dq, dk, dv, d_segs
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -605,6 +679,7 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     window: Optional[int] = None,
+    segment_ids=None,
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
@@ -634,6 +709,12 @@ def flash_attention(
     all three kernels, so compute is O(S·window): the Mistral-style
     long-context lever for sequences where even the causal half of
     S² is too much.
+
+    ``segment_ids`` ((batch, seq) int32, requires equal q/kv lengths):
+    sequence-packing mask — positions attend only within their own
+    packed document. Rides into the kernels as a whole padded row per
+    (batch·head) and masks per (q, k) pair; no block skipping (packed
+    documents are block-unaligned by nature).
     """
     if q.ndim != 4:
         raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
@@ -647,6 +728,15 @@ def flash_attention(
                              "causal=True")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if segment_ids is not None:
+        if sq != skv:
+            raise ValueError("segment_ids (sequence packing) requires "
+                             "equal q/kv sequence lengths")
+        if segment_ids.shape != (b, sq):
+            raise ValueError(
+                f"segment_ids must be (batch, seq)={(b, sq)}, got "
+                f"{segment_ids.shape}"
+            )
     if interpret is None:
         from tpuflow.core.hw import is_tpu_backend
 
@@ -663,15 +753,28 @@ def flash_attention(
         skv_valid=skv,
         interpret=bool(interpret),
         window=None if window is None else int(window),
+        has_segments=segment_ids is not None,
     )
     qp = _pad_seq(q.reshape(b * h, sq, d), block_q)
     kp = _pad_seq(k.reshape(b * h, skv, d), block_k)
     vp = _pad_seq(v.reshape(b * h, skv, d), block_k)
+    segs = None
+    if segment_ids is not None:
+        # one padded row per (batch·head), fill -1 so padding can never
+        # alias a real segment; length covers BOTH padded extents
+        pad_len = max(qp.shape[1], kp.shape[1])
+        srow = jnp.pad(
+            segment_ids.astype(jnp.int32), ((0, 0), (0, pad_len - sq)),
+            constant_values=-1,
+        )
+        segs = jnp.broadcast_to(
+            srow[:, None, :], (b, h, pad_len)
+        ).reshape(b * h, 1, pad_len)
     if return_lse:
-        o, lse = _fwd(cfg, qp, kp, vp)
+        o, lse = _fwd(cfg, qp, kp, vp, segs)
         return (
             o[:, :sq].reshape(b, h, sq, d),
             lse[:, :sq].reshape(b, h, sq),
         )
-    o = _flash_core(cfg, qp, kp, vp)
+    o = _flash_core(cfg, qp, kp, vp, segs)
     return o[:, :sq].reshape(b, h, sq, d)

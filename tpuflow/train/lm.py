@@ -267,6 +267,16 @@ class LMTrainer:
         mesh = self.mesh
         out_shardings = None
 
+        packed_eos = self.cfg.packed_eos_id
+        if packed_eos is not None and model.seq_axis is not None:
+            raise ValueError(
+                "packed_eos_id (sequence packing) cannot combine with "
+                "seq_axis (ring attention) yet — pack shorter rows or "
+                "drop sequence parallelism"
+            )
+        if packed_eos is not None:
+            from tpuflow.models.transformer import packed_segments
+
         fused = bool(self.cfg.fused_loss)
         if fused:
             if self._gspmd and self.tp > 1:
@@ -288,11 +298,17 @@ class LMTrainer:
                     label_smoothing=ls,
                 )
 
-        def _shifted_loss(p, out, tokens, ls):
+        def _shifted_loss(p, out, tokens, ls, tmask=None):
             """The next-token tail shared by every non-striped path:
-            ``out`` is logits (plain) or hidden states (fused)."""
+            ``out`` is logits (plain) or hidden states (fused);
+            ``tmask`` excludes cross-document targets in packed mode."""
             if fused:
-                return _fused(p, out[:, :-1], tokens[:, 1:], None, ls)
+                return _fused(p, out[:, :-1], tokens[:, 1:], tmask, ls)
+            if tmask is not None:
+                from tpuflow.models.transformer import token_loss
+
+                return token_loss(out[:, :-1], tokens[:, 1:], mask=tmask,
+                                  label_smoothing=ls)
             return next_token_loss(out, tokens, label_smoothing=ls)
 
         if self._gspmd:
@@ -304,25 +320,43 @@ class LMTrainer:
             def loss_of(p, tokens, train):
                 ls = self.cfg.label_smoothing if train else 0.0
                 net = model_h if fused else model
+                kw, tmask = {}, None
+                if packed_eos is not None:
+                    seg, pos, tmask = packed_segments(tokens, packed_eos)
+                    kw = dict(segment_ids=seg, positions=pos)
                 if model.n_experts > 0 and train:
                     # MoE training: LM loss + the routers' load-balance
                     # aux losses (sown into the mutable 'losses'
                     # collection by tpuflow.models.moe)
                     out, coll = net.apply(
                         {"params": p}, tokens, train=True,
-                        mutable=["losses"],
+                        mutable=["losses"], **kw,
                     )
                     aux = sum(
                         jnp.sum(a)
                         for a in jax.tree.leaves(coll.get("losses", {}))
                     )
-                    return _shifted_loss(p, out, tokens, ls) + aux
-                out = net.apply({"params": p}, tokens, train=train)
-                return _shifted_loss(p, out, tokens, ls)
+                    return _shifted_loss(p, out, tokens, ls, tmask) + aux
+                out = net.apply({"params": p}, tokens, train=train, **kw)
+                return _shifted_loss(p, out, tokens, ls, tmask)
 
             out_shardings = (self._state_shardings, None)
         else:
             net = model_h if fused else model
+            if packed_eos is not None:
+                # packing metadata is row-local, so it shards exactly
+                # like the tokens and rides through the shard_map
+                fwd_packed = shard_map(
+                    lambda p, t, seg, pos, train: net.apply(
+                        {"params": p}, t, train=train,
+                        segment_ids=seg, positions=pos,
+                    ),
+                    mesh=mesh,
+                    in_specs=(P(), self._token_spec(),
+                              self._token_spec(), self._token_spec(),
+                              P()),
+                    out_specs=P(DATA_AXIS, None, None),
+                )
             fwd = shard_map(
                 lambda p, t, train: net.apply(
                     {"params": p}, t, train=train
@@ -377,6 +411,10 @@ class LMTrainer:
                     return token_loss(
                         out, targets, mask=valid, label_smoothing=ls
                     )
+                if packed_eos is not None:
+                    seg, pos, tmask = packed_segments(tokens, packed_eos)
+                    out = fwd_packed(p, tokens, seg, pos, train)
+                    return _shifted_loss(p, out, tokens, ls, tmask)
                 out = fwd(p, tokens, train)
                 return _shifted_loss(p, out, tokens, ls)
 

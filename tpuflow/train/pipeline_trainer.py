@@ -169,6 +169,11 @@ class PipelineTrainer(LMTrainer):
                 "backward — per-microbatch logits are already "
                 "chunk-sized there"
             )
+        if self.cfg.packed_eos_id is not None:
+            raise ValueError(
+                "packed_eos_id (sequence packing) is not supported by "
+                "PipelineTrainer yet — use LMTrainer for packed corpora"
+            )
         self.n_stages = n_stages
         self.virtual_stages = v
         self.blocks_per_stage = model.depth // (n_stages * v)
