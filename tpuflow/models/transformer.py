@@ -103,11 +103,18 @@ class CausalAttention(nn.Module):
     # balances the causal ring; the TRAINER permutes tokens/logits)
     sp_layout: str = "contiguous"
     attn_window: Optional[int] = None  # sliding-window (local) attention
+    # grouped-query attention: kv_heads < heads shares each K/V head
+    # across heads//kv_heads query heads (Llama-2/Mistral style) —
+    # the KV cache and the K/V projections shrink by the group factor,
+    # the decode step's dominant memory traffic. None = MHA.
+    kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions_override=None):
         tp = self.seq_axis is None
         head_dim = self.dim // self.heads
+        kvh = self.kv_heads or self.heads
+        group = self.heads // kvh
         b, s, _ = x.shape
         if segment_ids is not None and (
                 self.seq_axis is not None or self.decode):
@@ -116,19 +123,28 @@ class CausalAttention(nn.Module):
                 "attention) or decode mode"
             )
 
-        def proj_in(name):
+        def proj_in(name, n_heads):
             return nn.Dense(
-                self.dim,
+                n_heads * head_dim,
                 use_bias=False,
                 dtype=self.dtype,
                 kernel_init=_part(_dense_init, (None, MODEL_AXIS), tp),
                 name=name,
             )(x)
 
-        def heads_first(t):  # (B, S, C) → (B, H, S, D)
-            return t.reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
+        def heads_first(t, n_heads):  # (B, S, C) → (B, H, S, D)
+            return t.reshape(b, s, n_heads, head_dim).transpose(0, 2, 1, 3)
 
-        q, k, v = (heads_first(proj_in(n)) for n in ("query", "key", "value"))
+        q = heads_first(proj_in("query", self.heads), self.heads)
+        k = heads_first(proj_in("key", kvh), kvh)
+        v = heads_first(proj_in("value", kvh), kvh)
+
+        def expand_kv(t):
+            """(B, KVH, S, D) → (B, H, S, D): share each K/V head
+            across its query-head group (no-op for MHA)."""
+            if group == 1:
+                return t
+            return jnp.repeat(t, group, axis=1)
 
         if self.decode:
             # KV cache (flax idiom): created at init time with the FULL
@@ -158,20 +174,27 @@ class CausalAttention(nn.Module):
                     # sees only its last attn_window cache entries
                     ok = ok & (key_pos > positions[:, None]
                                - self.attn_window)
+                # grouped einsums against the SMALL (B, KVH, S, D)
+                # cache — each K/V head serves its `group` query heads
+                # without ever materializing an expanded cache (the
+                # whole point of GQA at decode time); group == 1 is
+                # plain MHA
+                qg = q.reshape(b, kvh, group, s, head_dim)
                 scores = jnp.einsum(
-                    "bhqd,bhkd->bhqk",
-                    q.astype(jnp.float32), ck.value.astype(jnp.float32),
+                    "bkgqd,bksd->bkgqs",
+                    qg.astype(jnp.float32), ck.value.astype(jnp.float32),
                 ) * (head_dim ** -0.5)
-                scores = jnp.where(ok[None, None], scores, -1e30)
+                scores = jnp.where(ok[None, None, None], scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1)
                 o = jnp.einsum(
-                    "bhqk,bhkd->bhqd", probs, cv.value.astype(jnp.float32)
-                ).astype(self.dtype)
+                    "bkgqs,bksd->bkgqd", probs,
+                    cv.value.astype(jnp.float32),
+                ).reshape(b, self.heads, s, head_dim).astype(self.dtype)
             else:
                 # init pass: shapes only (cache created above)
                 positions = jnp.arange(s, dtype=jnp.int32)
                 q, k = rotary_embed(q, k, positions, self.rope_theta)
-                o = mha_xla(q, k, v, causal=True,
+                o = mha_xla(q, expand_kv(k), expand_kv(v), causal=True,
                             window=self.attn_window)
         else:
             if self.seq_axis is not None:
@@ -187,6 +210,7 @@ class CausalAttention(nn.Module):
             if positions_override is not None:
                 positions = positions_override  # packed per-doc offsets
             q, k = rotary_embed(q, k, positions, self.rope_theta)
+            k, v = expand_kv(k), expand_kv(v)
 
             if self.seq_axis is not None:
                 if self.attn_window is not None:
@@ -259,13 +283,15 @@ class DecoderBlock(nn.Module):
     sp_layout: str = "contiguous"
     remat_mlp: bool = False  # checkpoint the MLP sub-block only
     attn_window: Optional[int] = None
+    kv_heads: Optional[int] = None  # grouped-query attention (GQA)
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
         x = x + CausalAttention(
             self.dim, self.heads, self.dtype, self.attn_impl, self.seq_axis,
             self.rope_theta, self.decode, self.sp_layout,
-            attn_window=self.attn_window, name="attn",
+            attn_window=self.attn_window, kv_heads=self.kv_heads,
+            name="attn",
         )(RMSNorm(self.dtype, name="norm1")(x), segment_ids, positions)
         y = RMSNorm(self.dtype, name="norm2")(x)
         if self.n_experts > 0:
@@ -354,6 +380,7 @@ class TransformerLM(nn.Module):
     sp_layout: str = "contiguous"  # see CausalAttention.sp_layout
     skip_head: bool = False  # return final-norm hidden states, not logits
     attn_window: Optional[int] = None  # sliding-window (local) attention
+    kv_heads: Optional[int] = None  # grouped-query attention (GQA/MQA)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, segment_ids=None,
@@ -405,6 +432,7 @@ class TransformerLM(nn.Module):
                 decode=self.decode, sp_layout=self.sp_layout,
                 remat_mlp=remat_mlp and not moe_block,
                 attn_window=self.attn_window,
+                kv_heads=self.kv_heads,
                 name=f"block{i}",
             )(x, segment_ids, positions)
         x = RMSNorm(self.dtype, name="norm_final")(x)
@@ -434,9 +462,16 @@ def build_transformer_lm(
     remat_policy: str = "full",
     sp_layout: str = "contiguous",
     attn_window: Optional[int] = None,
+    kv_heads: Optional[int] = None,
 ) -> TransformerLM:
     if dim % heads:
         raise ValueError("dim must be a multiple of heads")
+    if kv_heads is not None:
+        if kv_heads < 1 or heads % kv_heads:
+            raise ValueError(
+                f"kv_heads ({kv_heads}) must divide heads ({heads}) — "
+                "each K/V head serves heads//kv_heads query heads (GQA)"
+            )
     if (dim // heads) % 2:
         raise ValueError("head_dim must be even (rotary pairs)")
     if sp_layout not in ("contiguous", "striped"):
@@ -460,7 +495,7 @@ def build_transformer_lm(
         seq_axis=seq_axis, n_experts=n_experts, moe_every=moe_every,
         moe_top_k=moe_top_k, ep_axis=ep_axis, remat=remat,
         remat_policy=remat_policy, sp_layout=sp_layout,
-        attn_window=attn_window,
+        attn_window=attn_window, kv_heads=kv_heads,
     )
 
 
