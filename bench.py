@@ -1064,6 +1064,10 @@ def main() -> int:
                    default="auto",
                    help="lm only: attention impl (tuning input — the "
                         "watcher captures both and keeps the faster)")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="generate only: grouped-query attention — "
+                        "kv_heads < heads shrinks the KV cache and the "
+                        "K/V projections by the group factor")
     p.add_argument("--no-supervisor", action="store_true",
                    help="run the bench in-process (no parent watchdog "
                         "process); the in-process watchdog still applies")
@@ -1783,6 +1787,7 @@ def _bench_generate(args, devices) -> int:
     model = build_transformer_lm(
         vocab_size=vocab, dim=dim, depth=depth, heads=heads,
         attn_impl="einsum",  # single-token decode: no flash to win
+        kv_heads=args.kv_heads,  # GQA: cache/projection shrink knob
     )
     rtt_ms = _measure_rtt()
     prompt = jnp.asarray(
@@ -1833,7 +1838,8 @@ def _bench_generate(args, devices) -> int:
             "device_kind": devices[0].device_kind,
             "n_chips": n_chips,
             "n_host_chips": len(devices),
-            "model": f"lm-d{dim}x{depth}h{heads}",
+            "model": f"lm-d{dim}x{depth}h{heads}"
+                     + (f"kv{args.kv_heads}" if args.kv_heads else ""),
             "batch": batch,
             "prompt_len": prompt_len,
             "new_tokens": new_tokens,

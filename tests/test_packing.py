@@ -206,3 +206,25 @@ def test_lm_trainer_packed_loss_matches_per_document():
             mesh=build_nd_mesh({"pipe": 1}, devices=jax.devices()[:1]),
             n_microbatches=1,
         )
+
+
+def test_window_and_segments_compose():
+    """Sliding window + packing conjoin: attention is limited to the
+    last `window` keys AND the same document — equal to per-document
+    windowed attention."""
+    lens = (20, 12)
+    s = sum(lens)
+    q, k, v = _qkv(1, 2, s, 16, seed=9)
+    segs = _segs_for(lens, 1, s)
+    win = 5
+    o = flash_attention(q, k, v, causal=True, window=win,
+                        segment_ids=segs, block_q=16, block_k=16)
+    ox = mha_xla(q, k, v, causal=True, window=win, segment_ids=segs)
+    np.testing.assert_allclose(o, ox, atol=2e-6)
+    o0 = 0
+    for l in lens:
+        sl = slice(o0, o0 + l)
+        ref = mha_xla(q[:, :, sl], k[:, :, sl], v[:, :, sl],
+                      causal=True, window=win)
+        np.testing.assert_allclose(o[:, :, sl], ref, atol=2e-6)
+        o0 += l

@@ -79,6 +79,8 @@ while true; do
     # --- 4: input plane + serving -------------------------------------
     [ -f BENCH_LOCAL_r04_e2e.json ] || capture BENCH_LOCAL_r04_e2e.json --end2end --no-attn-diag --deadline 2300 --diag-out BENCH_DIAG_r04_e2e.json || ok=1
     [ -f BENCH_LOCAL_r04_generate.json ] || capture BENCH_LOCAL_r04_generate.json --model generate --no-attn-diag --diag-out /tmp/diag_generate.json || true
+    # GQA decode probe (non-gating): kv cache / projections at 1/4
+    [ -f BENCH_LOCAL_r04_generate_gqa.json ] || capture BENCH_LOCAL_r04_generate_gqa.json --model generate --kv-heads 2 --no-attn-diag --diag-out /tmp/diag_generate_gqa.json || true
     # exit only when EVERY queue artifact exists (a tunnel drop during
     # a non-gating capture must resume next window, not end the watch)
     all_present=1
@@ -87,7 +89,10 @@ while true; do
              BENCH_LOCAL_r04_lm_einsum.json BENCH_LOCAL_r04_sweep.json \
              BENCH_LOCAL_r04_resnet50.json BENCH_LOCAL_r04_vit.json \
              CONVERGENCE_r04.json BENCH_LOCAL_r04_e2e.json \
-             BENCH_LOCAL_r04_generate.json; do
+             BENCH_LOCAL_r04_generate.json \
+             BENCH_LOCAL_r04_generate_gqa.json \
+             BENCH_LOCAL_r04_resnet50_b512.json \
+             BENCH_LOCAL_r04_vit_b256.json; do
       [ -f "$f" ] || all_present=0
     done
     if [ "$all_present" -eq 1 ]; then
