@@ -38,6 +38,7 @@ def test_examples_import():
         "09_lm_pipeline",
         "10_pipeline_lm",
         "11_pipeline_trainer_streaming",
+        "12_packed_gqa_lm",
     ]:
         assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
 
@@ -127,3 +128,15 @@ def test_pipeline_trainer_streaming_example():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "pipeline-trainer streaming example OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_packed_gqa_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "12_packed_gqa_lm.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "packed + GQA + cosine recipe complete" in r.stdout
