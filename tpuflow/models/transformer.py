@@ -210,7 +210,6 @@ class CausalAttention(nn.Module):
             if positions_override is not None:
                 positions = positions_override  # packed per-doc offsets
             q, k = rotary_embed(q, k, positions, self.rope_theta)
-            k, v = expand_kv(k), expand_kv(v)
 
             if self.seq_axis is not None:
                 if self.attn_window is not None:
@@ -221,14 +220,17 @@ class CausalAttention(nn.Module):
                         "attn_window and seq_axis (ring attention) "
                         "cannot combine yet"
                     )
-                o = ring_attention(q, k, v, axis_name=self.seq_axis,
+                o = ring_attention(q, expand_kv(k), expand_kv(v),
+                                   axis_name=self.seq_axis,
                                    causal=True, layout=self.sp_layout)
             elif pick_attn_impl(s, self.attn_impl) == "flash":
+                # the kernels handle GQA natively (K/V head index maps)
+                # — the expanded K/V are never materialized
                 o = flash_attention(q, k, v, causal=True,
                                     window=self.attn_window,
                                     segment_ids=segment_ids)
             else:
-                o = mha_xla(q, k, v, causal=True,
+                o = mha_xla(q, expand_kv(k), expand_kv(v), causal=True,
                             window=self.attn_window,
                             segment_ids=segment_ids)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, self.dim)

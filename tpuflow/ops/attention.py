@@ -74,6 +74,12 @@ class _Cfg(NamedTuple):
     # sequence packing: a (BH, 1, S) segment-id row rides as an extra
     # kernel input and positions attend only within their own segment
     has_segments: bool = False
+    # grouped-query attention: q carries kv_group times more heads than
+    # k/v; the kernels read K/V blocks at head index b // kv_group (an
+    # index-map remap — K/V are NEVER materialized expanded), and the
+    # dK/dV kernel's inner grid enumerates (group member, q block) so
+    # the per-KV-head gradient accumulates across its whole group
+    kv_group: int = 1
 
 
 def _vma(*xs):
@@ -396,11 +402,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, cfg: _Cfg):
 def _fwd(cfg: _Cfg, q, k, v, segs=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
+    g = cfg.kv_group  # K/V head index = q-head index // g (GQA)
     grid = (bh, sq // cfg.block_q, skv // cfg.block_k)
     in_specs = [
         pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b // g, j, 0)),
+        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b // g, j, 0)),
     ]
     inputs = [q, k, v]
     if cfg.has_segments:
@@ -512,26 +519,29 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
     bk, d = k_ref.shape[1], k_ref.shape[2]
     bq = q_ref.shape[1]
     ki = pl.program_id(1)
-    i = pl.program_id(2)  # inner: revolving Q/dO window
-    nq = pl.num_programs(2)
+    # inner grid: (group member, q block) flattened — under GQA this
+    # key block's gradient accumulates over EVERY query head it serves
+    # (kv_group sweeps of nq q-blocks each); kv_group == 1 is MHA
+    t = pl.program_id(2)
+    nt = pl.num_programs(2)
+    nq = nt // cfg.kv_group
+    i = lax.rem(t, nq)  # q block within the current member's sweep
 
     # causal: the first query block whose rows can see this key block
     # (col c is visible to rows >= c - causal_shift)
     first_i = (
-        jnp.clip(lax.div(ki * bk - cfg.causal_shift, bq), 0,
-                 pl.num_programs(2) - 1)
+        jnp.clip(lax.div(ki * bk - cfg.causal_shift, bq), 0, nq - 1)
         if cfg.causal else 0
     )
     # sliding window: the LAST query block that can still see this key
     # block (row < col - causal_shift + window) — later blocks skip
     if cfg.causal and cfg.window is not None:
         last_row = ki * bk + bk - 1 - cfg.causal_shift + cfg.window - 1
-        last_i = jnp.clip(lax.div(last_row, bq), 0,
-                          pl.num_programs(2) - 1)
+        last_i = jnp.clip(lax.div(last_row, bq), 0, nq - 1)
     else:
         last_i = nq - 1
 
-    @pl.when(i == first_i)
+    @pl.when(t == 0)
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
@@ -566,7 +576,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
             ds.T, q_blk, preferred_element_type=jnp.float32
         )
 
-    @pl.when(i == nq - 1)
+    @pl.when(t == nt - 1)
     def _finalize():
         dk_ref[0] = (dk_acc_ref[...] * cfg.scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
@@ -575,13 +585,16 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
 def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
+    bh_kv = k.shape[0]  # under GQA: bh // kv_group
+    g = cfg.kv_group
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     # vectors ride as (BH, 1, S) whole-row blocks — see _fwd_kernel note
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
     nq, nk = sq // cfg.block_q, skv // cfg.block_k
     q_spec = pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0))
-    k_stream = pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b, j, 0))
+    k_stream = pl.BlockSpec((1, cfg.block_k, d),
+                            lambda b, i, j: (b // g, j, 0))
     vec_row = pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0))
     semantics = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -605,26 +618,35 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
         interpret=cfg.interpret,
     )(*dq_inputs)
 
-    # dk/dv: key blocks in the middle grid dim, queries stream innermost
-    k_spec = pl.BlockSpec((1, cfg.block_k, d), lambda b, j, i: (b, j, 0))
-    q_stream = pl.BlockSpec((1, cfg.block_q, d), lambda b, j, i: (b, i, 0))
-    vec_row_kv = pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0))
+    # dk/dv: key blocks in the middle grid dim; the innermost dim
+    # enumerates (group member, q block) so each KV head's gradient
+    # accumulates over every query head it serves (kv_group=1 ⇒ MHA)
+    k_spec = pl.BlockSpec((1, cfg.block_k, d), lambda b, j, t: (b, j, 0))
+    q_stream = pl.BlockSpec(
+        (1, cfg.block_q, d), lambda b, j, t: (b * g + t // nq, t % nq, 0)
+    )
+    vec_row_kv = pl.BlockSpec(
+        (1, 1, sq), lambda b, j, t: (b * g + t // nq, 0, 0)
+    )
     dkv_in_specs = [k_spec, k_spec, q_stream, q_stream, vec_row_kv,
                     vec_row_kv]
     dkv_inputs = [k, v, q, do, lse3, delta3]
     if cfg.has_segments:
         dkv_in_specs.append(
-            pl.BlockSpec((1, 1, segs.shape[2]), lambda b, j, i: (b, 0, 0))
+            pl.BlockSpec((1, 1, segs.shape[2]),
+                         lambda b, j, t: (b * g, 0, 0))
         )
         dkv_inputs.append(segs)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, cfg=cfg),
-        grid=(bh, nk, nq),
+        grid=(bh_kv, nk, nq * g),
         in_specs=dkv_in_specs,
         out_specs=[k_spec, k_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, skv, d), k.dtype, vma=_vma(q, k, v, do)),
-            jax.ShapeDtypeStruct((bh, skv, d), v.dtype, vma=_vma(q, k, v, do)),
+            jax.ShapeDtypeStruct((bh_kv, skv, d), k.dtype,
+                                 vma=_vma(q, k, v, do)),
+            jax.ShapeDtypeStruct((bh_kv, skv, d), v.dtype,
+                                 vma=_vma(q, k, v, do)),
         ],
         scratch_shapes=[
             pltpu.VMEM((cfg.block_k, d), jnp.float32),
@@ -715,11 +737,27 @@ def flash_attention(
     packed document. Rides into the kernels as a whole padded row per
     (batch·head) and masks per (q, k) pair; no block skipping (packed
     documents are block-unaligned by nature).
+
+    Grouped-query attention: pass ``k``/``v`` with FEWER heads than
+    ``q`` (``heads % kv_heads == 0``) — the kernels read each K/V head
+    at index ``q_head // group`` via their BlockSpec index maps (the
+    expanded K/V never materialize in HBM), and the dK/dV kernel's
+    inner grid enumerates (group member, q block) so each K/V head's
+    gradient accumulates over every query head it serves.
     """
     if q.ndim != 4:
         raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
     b, h, sq, d = q.shape
+    h_kv = k.shape[1]
     skv = k.shape[2]
+    if h_kv != h:
+        # grouped-query attention: q-head i reads K/V head i // group
+        # via the kernels' index maps — K/V are never expanded
+        if h_kv < 1 or h % h_kv or v.shape[1] != h_kv:
+            raise ValueError(
+                f"k/v heads ({h_kv}/{v.shape[1]}) must be equal and "
+                f"divide q heads ({h}) for grouped-query attention"
+            )
     if causal and sq != skv:
         raise ValueError("causal=True requires equal q/kv sequence lengths")
     if window is not None:
@@ -754,10 +792,11 @@ def flash_attention(
         interpret=bool(interpret),
         window=None if window is None else int(window),
         has_segments=segment_ids is not None,
+        kv_group=h // h_kv,
     )
     qp = _pad_seq(q.reshape(b * h, sq, d), block_q)
-    kp = _pad_seq(k.reshape(b * h, skv, d), block_k)
-    vp = _pad_seq(v.reshape(b * h, skv, d), block_k)
+    kp = _pad_seq(k.reshape(b * h_kv, skv, d), block_k)
+    vp = _pad_seq(v.reshape(b * h_kv, skv, d), block_k)
     segs = None
     if segment_ids is not None:
         # one padded row per (batch·head), fill -1 so padding can never
