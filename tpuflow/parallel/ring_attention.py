@@ -267,6 +267,14 @@ def ring_attention(
     balances the causal work across the ring: every visit is a half-
     masked diagonal instead of full-or-nothing, so wall time is ~n/2
     visits instead of n (Striped Attention).
+
+    GQA note: q/k/v must carry EQUAL head counts here — a GQA model
+    expands K/V before entering the ring (models/transformer.py). A
+    native grouped ring would shrink each ppermute hop's payload by the
+    group factor (the per-hop compute already supports kv_group via the
+    flash kernels); it is deliberately not wired yet because the
+    interpret-mode reference path and the ring's custom VJP both assume
+    uniform shard shapes — future work, noted rather than risked.
     """
     if layout not in ("contiguous", "striped"):
         raise ValueError(f"layout must be contiguous|striped, got {layout!r}")
