@@ -5,6 +5,7 @@ All on the 8-device virtual CPU mesh (SURVEY.md §4 discipline).
 """
 
 import flax.linen as nn
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -253,3 +254,67 @@ def test_sliding_window_model_trains_and_decodes():
     with pytest.raises(ValueError, match="attn_window"):
         build_transformer_lm(vocab_size=31, dim=16, depth=2, heads=4,
                              attn_window=0)
+
+
+KW = dict(vocab_size=64, dim=32, depth=2, heads=4, mlp_ratio=2,
+          dtype=jnp.float32, attn_impl="einsum")
+
+
+def test_tied_embeddings():
+    """tie_embeddings: the embedding table IS the head — logits equal
+    the untied model given kernel = embedᵀ, the (dim, vocab) head
+    param disappears, training+generation work, and the unsupported
+    combinations fail loudly."""
+    toks = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, (2, 20)), jnp.int32
+    )
+    tied = build_transformer_lm(tie_embeddings=True, **KW)
+    p = nn.unbox(tied.init({"params": jax.random.key(4)}, toks))["params"]
+    assert "lm_head" not in p  # the param is GONE, not just unused
+    out_tied = tied.apply({"params": p}, toks)
+
+    untied = build_transformer_lm(**KW)
+    p2 = dict(p)
+    p2["lm_head"] = {"kernel": jnp.asarray(np.asarray(p["embed"]).T)}
+    np.testing.assert_allclose(
+        untied.apply({"params": p2}, toks), out_tied, atol=2e-5
+    )
+
+    # trains and generates through the public surfaces
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.infer.generate import generate
+    from tpuflow.parallel.mesh import build_nd_mesh
+    from tpuflow.train import LMTrainer
+
+    rows = np.random.default_rng(6).integers(0, 64, (8, 16)).astype(
+        np.int32
+    )
+    tr = LMTrainer(
+        build_transformer_lm(tie_embeddings=True, **KW),
+        TrainConfig(optimizer="adamw", learning_rate=1e-3,
+                    warmup_epochs=0, scale_lr_by_world_size=False),
+        mesh=build_nd_mesh({"data": 1}, devices=jax.devices()[:1]),
+    )
+    hist = tr.fit(rows, batch_size=8, epochs=2)
+    assert np.isfinite(hist["loss"])
+    out = generate(tr.model, jax.device_get(tr.state.params),
+                   jnp.asarray(rows[:1, :4]), max_new_tokens=3,
+                   temperature=0.0)
+    assert out.shape == (1, 7)
+
+    # loud guards for the unsupported combinations
+    with pytest.raises(ValueError, match="tie_embeddings"):
+        LMTrainer(
+            build_transformer_lm(tie_embeddings=True, **KW),
+            TrainConfig(fused_loss=True),
+            mesh=build_nd_mesh({"data": 1}, devices=jax.devices()[:1]),
+        )._make_steps()
+    from tpuflow.train import PipelineTrainer
+
+    with pytest.raises(ValueError, match="tie_embeddings"):
+        PipelineTrainer(
+            build_transformer_lm(tie_embeddings=True, **KW),
+            TrainConfig(),
+            mesh=build_nd_mesh({"pipe": 1}, devices=jax.devices()[:1]),
+            n_microbatches=1,
+        )

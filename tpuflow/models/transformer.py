@@ -336,6 +336,18 @@ def lm_head_dot(x, kernel):
     )
 
 
+def lm_head_dot_tied(x, embed):
+    """Tied-embeddings head: logits = x · embedᵀ with the embedding
+    table used AS the head kernel — contraction over the last dim of
+    both operands, so the transpose never materializes. Same dtype
+    discipline as :func:`lm_head_dot`."""
+    return jax.lax.dot_general(
+        x, embed.astype(x.dtype),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 class LMHead(nn.Module):
     """Vocab projection (column-parallel under TP) via
     :func:`lm_head_dot`; the kernel param itself remains a float32
@@ -383,6 +395,9 @@ class TransformerLM(nn.Module):
     skip_head: bool = False  # return final-norm hidden states, not logits
     attn_window: Optional[int] = None  # sliding-window (local) attention
     kv_heads: Optional[int] = None  # grouped-query attention (GQA/MQA)
+    # weight tying: reuse the embedding table as the LM head (GPT-2 /
+    # Gemma style) — drops the (dim, vocab) head parameter entirely
+    tie_embeddings: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, segment_ids=None,
@@ -438,6 +453,11 @@ class TransformerLM(nn.Module):
                 name=f"block{i}",
             )(x, segment_ids, positions)
         x = RMSNorm(self.dtype, name="norm_final")(x)
+        if self.tie_embeddings:
+            # tied head: the embedding table IS the head kernel (its
+            # vocab-axis sharding makes the logits column-parallel,
+            # same as the untied head); no lm_head param exists
+            return x if self.skip_head else lm_head_dot_tied(x, embed)
         # vocab-sharded LM head (column-parallel); logits in float32.
         # skip_head keeps the param (identical tree) but returns the
         # hidden states for a fused linear+loss (tpuflow.ops.xent)
@@ -465,6 +485,7 @@ def build_transformer_lm(
     sp_layout: str = "contiguous",
     attn_window: Optional[int] = None,
     kv_heads: Optional[int] = None,
+    tie_embeddings: bool = False,
 ) -> TransformerLM:
     if dim % heads:
         raise ValueError("dim must be a multiple of heads")
@@ -498,6 +519,7 @@ def build_transformer_lm(
         moe_top_k=moe_top_k, ep_axis=ep_axis, remat=remat,
         remat_policy=remat_policy, sp_layout=sp_layout,
         attn_window=attn_window, kv_heads=kv_heads,
+        tie_embeddings=tie_embeddings,
     )
 
 

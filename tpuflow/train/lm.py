@@ -278,6 +278,13 @@ class LMTrainer:
             from tpuflow.models.transformer import packed_segments
 
         fused = bool(self.cfg.fused_loss)
+        if fused and getattr(model, "tie_embeddings", False):
+            raise ValueError(
+                "fused_loss cannot combine with tie_embeddings yet: the "
+                "vocab-chunked scan consumes a (dim, vocab) head kernel "
+                "and the tied head is the transposed embedding table — "
+                "drop one of the two"
+            )
         if fused:
             if self._gspmd and self.tp > 1:
                 raise ValueError(

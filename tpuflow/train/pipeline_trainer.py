@@ -115,6 +115,12 @@ class PipelineTrainer(LMTrainer):
                 "PipelineTrainer pipelines the dense DP-free decoder "
                 "stack; combine with seq_axis/MoE via LMTrainer instead"
             )
+        if getattr(model, "tie_embeddings", False):
+            raise ValueError(
+                "tie_embeddings is not supported by PipelineTrainer "
+                "yet: the tied head needs the embedding gradient "
+                "accumulated from BOTH pipeline ends — use LMTrainer"
+            )
         if schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
                 f"schedule must be 'gpipe', '1f1b' or 'interleaved', "
