@@ -1,0 +1,115 @@
+#!/bin/bash
+# Round-5 recovery watcher: poll the TPU relay; when a trivial jax
+# program succeeds, run the round's capture queue in VALUE order (relay
+# windows can be short — the most important artifact goes first). A
+# capture only counts if its JSON line has no "error" field; on tunnel
+# drop the loop resumes polling instead of burning the window.
+#
+# Round-5 queue (VERDICT r4 "Next round"):
+#  0. cnn flagship — also WARMS the repo-committed .xla_cache, then a
+#     tiny re-run records the warm compile time (cache proof, item #1)
+#  1. lm default (batch 8) + tuning matrix: grad-accum, einsum impl,
+#     flash-kernel variant — the ≥25% MFU hunt (item #3), plus the
+#     attention sweep table incl. the new batched-bh kernel (item #2)
+#  2. resnet50 + vit with traces, batch probes (item #4)
+#  3. flagship CNN levers A/B: BN folding, b512 (item #5)
+#  4. on-chip convergence → CONVERGENCE_r05.json (item #6)
+#  5. e2e epoch-scale input-plane capture (item #7), generate
+cd "$(dirname "$0")/.."
+log=/tmp/bench_watch_r05.log
+
+PGID=$(ps -o pgid= -p $$ | tr -d ' ')
+
+drain_children() {
+  # the supervisor returns as soon as the headline line exists, leaving
+  # its child finishing post-emit diagnostics ON THE CHIP — wait for it
+  # before the next capture dials in (bounded: diags are expendable).
+  # Scoped to THIS watcher's process group so a concurrent manual
+  # bench run is never waited on or killed.
+  local waited=0
+  while pgrep -g "$PGID" -f "bench.py .*--progress-file" >/dev/null 2>&1; do
+    sleep 10; waited=$((waited + 10))
+    if [ "$waited" -ge 900 ]; then
+      echo "$(date) draining stuck bench child (kill)" >> "$log"
+      pkill -9 -g "$PGID" -f "bench.py .*--progress-file" 2>/dev/null
+      break
+    fi
+  done
+}
+
+capture() {  # capture <out-file> <bench args...>
+  local out="$1"; shift
+  echo "$(date) start $out: $*" >> "$log"
+  python bench.py "$@" > "$out.tmp" 2>>"$log"
+  drain_children
+  if python - "$out.tmp" <<'PY'
+import json, sys
+rec = json.load(open(sys.argv[1]))
+sys.exit(1 if (rec.get("error") or not rec.get("value")) else 0)
+PY
+  then mv "$out.tmp" "$out"; echo "$(date) captured $out" >> "$log"; return 0
+  else echo "$(date) $out failed: $(cat "$out.tmp")" >> "$log"; rm -f "$out.tmp"; return 1
+  fi
+}
+
+while true; do
+  if timeout -k 10 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date) tunnel up; running r05 queue" >> "$log"
+    ok=0
+    # --- 0: flagship + compile-cache warm/proof -----------------------
+    [ -f BENCH_LOCAL_r05_cnn.json ] || capture BENCH_LOCAL_r05_cnn.json --steps 30 --diag-out BENCH_DIAG_r05_cnn.json || ok=1
+    if [ -f BENCH_LOCAL_r05_cnn.json ] && [ ! -f CACHE_CHECK_r05.json ]; then
+      # same config re-run: with the persistent cache the second
+      # compile should be ~seconds, not ~60s — the in-run proof
+      capture CACHE_CHECK_r05.json --steps 3 --warmup 1 --no-attn-diag --diag-out /tmp/diag_cache_check.json || true
+    fi
+    # --- 1: lm default + tuning matrix --------------------------------
+    [ -f BENCH_LOCAL_r05_lm.json ] || capture BENCH_LOCAL_r05_lm.json --model lm --steps 10 --no-attn-diag --trace traces_r05/lm --diag-out BENCH_DIAG_r05_lm.json || ok=1
+    [ -f BENCH_LOCAL_r05_lm_accum4.json ] || capture BENCH_LOCAL_r05_lm_accum4.json --model lm --steps 6 --grad-accum 4 --no-attn-diag --diag-out /tmp/diag_lm_accum4.json || true
+    [ -f BENCH_LOCAL_r05_lm_einsum.json ] || capture BENCH_LOCAL_r05_lm_einsum.json --model lm --steps 10 --lm-attn-impl einsum --no-attn-diag --diag-out /tmp/diag_lm_einsum.json || true
+    [ -f BENCH_LOCAL_r05_sweep.json ] || capture BENCH_LOCAL_r05_sweep.json --model vit --steps 10 --attn-sweep --diag-out BENCH_DIAG_r05_sweep.json || true
+    # --- 2: dense models with traces ----------------------------------
+    [ -f BENCH_LOCAL_r05_resnet50.json ] || capture BENCH_LOCAL_r05_resnet50.json --model resnet50 --steps 20 --no-attn-diag --trace traces_r05/resnet50 --diag-out BENCH_DIAG_r05_resnet50.json || ok=1
+    [ -f BENCH_LOCAL_r05_vit.json ] || capture BENCH_LOCAL_r05_vit.json --model vit --steps 15 --no-attn-diag --trace traces_r05/vit --diag-out BENCH_DIAG_r05_vit.json || ok=1
+    # batch-scaling probes (non-gating): is MFU batch-starved?
+    [ -f BENCH_LOCAL_r05_resnet50_b512.json ] || capture BENCH_LOCAL_r05_resnet50_b512.json --model resnet50 --batch 512 --steps 10 --no-attn-diag --diag-out /tmp/diag_resnet_b512.json || true
+    [ -f BENCH_LOCAL_r05_vit_b256.json ] || capture BENCH_LOCAL_r05_vit_b256.json --model vit --batch 256 --steps 10 --no-attn-diag --diag-out /tmp/diag_vit_b256.json || true
+    # --- 3: on-chip convergence ---------------------------------------
+    [ -f CONVERGENCE_r05.json ] || timeout -k 30 2400 \
+      python tools/convergence_run.py --round 5 --epochs 12 \
+      --out CONVERGENCE_r05.json >> "$log" 2>&1 || ok=1
+    # --- 4: input plane + serving -------------------------------------
+    [ -f BENCH_LOCAL_r05_e2e.json ] || capture BENCH_LOCAL_r05_e2e.json --end2end --no-attn-diag --deadline 2300 --diag-out BENCH_DIAG_r05_e2e.json || ok=1
+    [ -f BENCH_LOCAL_r05_generate.json ] || capture BENCH_LOCAL_r05_generate.json --model generate --no-attn-diag --diag-out /tmp/diag_generate.json || true
+    # GQA decode probe (non-gating): kv cache / projections at 1/4
+    [ -f BENCH_LOCAL_r05_generate_gqa.json ] || capture BENCH_LOCAL_r05_generate_gqa.json --model generate --kv-heads 2 --no-attn-diag --diag-out /tmp/diag_generate_gqa.json || true
+    # --- 5: round-5 levers (guarded: flags may land mid-round; a
+    #         capture of an unknown flag fails fast and is retried
+    #         next window once the flag exists) ------------------------
+    [ -f BENCH_LOCAL_r05_cnn_bnfold.json ] || capture BENCH_LOCAL_r05_cnn_bnfold.json --steps 20 --bn-fold --no-attn-diag --diag-out /tmp/diag_cnn_bnfold.json || true
+    [ -f BENCH_LOCAL_r05_cnn_b512.json ] || capture BENCH_LOCAL_r05_cnn_b512.json --steps 20 --batch 512 --no-attn-diag --diag-out /tmp/diag_cnn_b512.json || true
+    # exit only when EVERY gating queue artifact exists (a tunnel drop
+    # during a non-gating capture must resume next window, not end the
+    # watch)
+    all_present=1
+    for f in BENCH_LOCAL_r05_cnn.json CACHE_CHECK_r05.json \
+             BENCH_LOCAL_r05_lm.json BENCH_LOCAL_r05_lm_accum4.json \
+             BENCH_LOCAL_r05_lm_einsum.json BENCH_LOCAL_r05_sweep.json \
+             BENCH_LOCAL_r05_resnet50.json BENCH_LOCAL_r05_vit.json \
+             CONVERGENCE_r05.json BENCH_LOCAL_r05_e2e.json \
+             BENCH_LOCAL_r05_generate.json \
+             BENCH_LOCAL_r05_generate_gqa.json \
+             BENCH_LOCAL_r05_resnet50_b512.json \
+             BENCH_LOCAL_r05_vit_b256.json \
+             BENCH_LOCAL_r05_cnn_bnfold.json \
+             BENCH_LOCAL_r05_cnn_b512.json; do
+      [ -f "$f" ] || all_present=0
+    done
+    if [ "$all_present" -eq 1 ]; then
+      echo "$(date) all r05 captures done" >> "$log"; exit 0
+    fi
+  else
+    echo "$(date) tunnel down" >> "$log"
+  fi
+  sleep 120
+done
