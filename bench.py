@@ -722,6 +722,24 @@ def _attention_sweep(diag: dict, rtt_ms: float = 0.0) -> None:
                         q, steps, rtt_ms,
                     )
                     results[f"q{bq}k{bk}"] = round(ms, 3)
+                # batched-bh restructure (round-5 lever): same block
+                # pairs, G (batch·head) rows per grid cell — G× fewer
+                # cells at identical FLOPs. If these win at short s,
+                # per-grid-cell overhead was the bottleneck
+                # (ROUND4_NOTES §2 decision tree, branch 1).
+                for bq, bk in ((256, 256), (512, 512)):
+                    if bq > s or bk > s:
+                        continue
+                    for g in (4, 8, b * h):
+                        ms = _timed_scan(
+                            jax,
+                            lambda c, bq=bq, bk=bk, g=g: flash_attention(
+                                c, k, v, causal=True, block_q=bq,
+                                block_k=bk, bh_block=g,
+                            ),
+                            q, steps, rtt_ms,
+                        )
+                        results[f"q{bq}k{bk}g{g}"] = round(ms, 3)
                 # the materialized-einsum alternative: whichever wins at
                 # a length is what pick_attn_impl's threshold should say
                 results["xla_einsum"] = round(_timed_scan(
@@ -1064,6 +1082,10 @@ def main() -> int:
                    default="auto",
                    help="lm only: attention impl (tuning input — the "
                         "watcher captures both and keeps the faster)")
+    p.add_argument("--bh-block", type=int, default=1,
+                   help="batched-bh flash grid: (batch*heads) rows per "
+                        "kernel grid cell — the round-5 short-sequence "
+                        "per-cell-overhead amortizer (lm model + sweep)")
     p.add_argument("--kv-heads", type=int, default=None,
                    help="generate only: grouped-query attention — "
                         "kv_heads < heads shrinks the KV cache and the "
@@ -1603,6 +1625,7 @@ def _bench_lm(args, devices) -> int:
             vocab_size=vocab, dim=dim, depth=depth, heads=heads,
             attn_impl=args.lm_attn_impl, remat=remat_mode != "off",
             remat_policy="attn" if remat_mode == "attn" else "full",
+            attn_bh_block=args.bh_block,
         )
         # fused vocab-chunked loss: the hidden-states twin shares the
         # identical param tree; the (B*S, vocab) logits tensor is never
@@ -1701,6 +1724,7 @@ def _bench_lm(args, devices) -> int:
                 "batch_per_chip": batch,
                 "grad_accum": accum,
                 "attn_impl": args.lm_attn_impl,
+                "bh_block": args.bh_block,
                 "remat": remat_mode,
                 "sequences_per_sec_per_chip": round(
                     global_batch * accum / dt / n_chips, 2

@@ -377,3 +377,116 @@ def test_mha_xla_bwd_dots_stay_in_input_dtype():
         if "64x64xf32" in m
     ]
     assert not f32_square, f32_square
+
+
+# ---------------------------------------------------------------------------
+# batched-bh kernel (bh_block > 1): the round-5 short-sequence
+# restructure — G (batch·head) rows per grid cell, unrolled. Must be
+# numerically identical per row to the classic kernel (same op
+# sequence), and reference-parity like everything else.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("bh_block", [2, 4])
+def test_bh_block_forward_matches_reference(causal, bh_block):
+    b, h, s, d = 2, 4, 32, 16  # bh = 8: both G values divide
+    q, k, v = (_rand((b, h, s, d), i + 31) for i in range(3))
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16,
+                          bh_block=bh_block)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    # identical op sequence per row ⇒ bitwise-level agreement with G=1
+    base = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("bh_block", [2, 4])
+def test_bh_block_gradients_match_classic(bh_block):
+    b, h, s, d = 2, 2, 48, 16
+    q, k, v = (_rand((b, h, s, d), i + 41) for i in range(3))
+
+    def loss(impl_bh):
+        def f(q, k, v):
+            o = flash_attention(q, k, v, causal=True, block_q=16,
+                                block_k=16, bh_block=impl_bh)
+            return jnp.sum(jnp.sin(o))
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_batched = loss(bh_block)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(jnp.sin(mha_reference(q, k, v, causal=True))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b_ in zip(g_batched, g_ref):
+        np.testing.assert_allclose(a, b_, atol=5e-5, rtol=5e-4)
+    for a, b_ in zip(g_batched, loss(1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_bh_block_window_and_padding():
+    # sliding window + block-padded seq (36 → padded grids) under G>1
+    b, h, s, d = 2, 2, 36, 8
+    q, k, v = (_rand((b, h, s, d), i + 51) for i in range(3))
+
+    def g(impl_bh):
+        def f(q, k, v):
+            o = flash_attention(q, k, v, causal=True, window=9,
+                                block_q=16, block_k=16, bh_block=impl_bh)
+            return jnp.sum(o ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for a, b_ in zip(g(4), g(1)):
+        assert np.all(np.isfinite(a))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_bh_block_segments_match_classic():
+    b, h, s, d = 2, 2, 32, 8
+    q, k, v = (_rand((b, h, s, d), i + 61) for i in range(3))
+    segs = jnp.asarray(
+        [[0] * 10 + [1] * 12 + [2] * 10, [0] * 20 + [1] * 12], jnp.int32
+    )
+
+    def run(impl_bh):
+        return flash_attention(q, k, v, causal=True, segment_ids=segs,
+                               block_q=16, block_k=16, bh_block=impl_bh)
+
+    np.testing.assert_array_equal(np.asarray(run(4)), np.asarray(run(1)))
+    np.testing.assert_allclose(
+        run(4), mha_xla(q, k, v, causal=True, segment_ids=segs),
+        atol=2e-5, rtol=2e-5,
+    )
+
+
+def test_bh_block_clamps_and_gqa_forces_one():
+    # bh = 6: request 4 clamps to the largest divisor (3); non-square
+    # values must still be exact
+    q, k, v = (_rand((2, 3, 32, 8), i + 71) for i in range(3))
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                          bh_block=4)
+    np.testing.assert_allclose(
+        out, mha_reference(q, k, v, causal=True), atol=2e-5, rtol=2e-5
+    )
+    # GQA (kv heads < q heads) silently rides the classic G=1 path
+    kg, vg = (_rand((2, 1, 32, 8), i + 81) for i in range(2))
+    out_gqa = flash_attention(q, kg, vg, causal=True, block_q=16,
+                              block_k=16, bh_block=4)
+    ref_gqa = mha_reference(
+        q, jnp.repeat(kg, 3, axis=1), jnp.repeat(vg, 3, axis=1), causal=True
+    )
+    np.testing.assert_allclose(out_gqa, ref_gqa, atol=2e-5, rtol=2e-5)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, bh_block=0)
+
+
+def test_bh_block_return_lse():
+    q, k, v = (_rand((2, 2, 32, 8), i + 91) for i in range(3))
+    o1, lse1 = flash_attention(q, k, v, block_q=16, block_k=16,
+                               return_lse=True)
+    o4, lse4 = flash_attention(q, k, v, block_q=16, block_k=16,
+                               bh_block=4, return_lse=True)
+    np.testing.assert_array_equal(np.asarray(o4), np.asarray(o1))
+    np.testing.assert_array_equal(np.asarray(lse4), np.asarray(lse1))

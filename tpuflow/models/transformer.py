@@ -108,6 +108,10 @@ class CausalAttention(nn.Module):
     # the KV cache and the K/V projections shrink by the group factor,
     # the decode step's dominant memory traffic. None = MHA.
     kv_heads: Optional[int] = None
+    # batched-bh flash grid (ops.attention bh_block): (batch*heads)
+    # rows per kernel grid cell — the short-sequence per-cell-overhead
+    # amortizer. 1 = classic kernel; ignored by einsum/ring paths.
+    attn_bh_block: int = 1
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions_override=None):
@@ -228,7 +232,8 @@ class CausalAttention(nn.Module):
                 # — the expanded K/V are never materialized
                 o = flash_attention(q, k, v, causal=True,
                                     window=self.attn_window,
-                                    segment_ids=segment_ids)
+                                    segment_ids=segment_ids,
+                                    bh_block=self.attn_bh_block)
             else:
                 o = mha_xla(q, expand_kv(k), expand_kv(v), causal=True,
                             window=self.attn_window,
@@ -286,6 +291,7 @@ class DecoderBlock(nn.Module):
     remat_mlp: bool = False  # checkpoint the MLP sub-block only
     attn_window: Optional[int] = None
     kv_heads: Optional[int] = None  # grouped-query attention (GQA)
+    attn_bh_block: int = 1  # batched-bh flash grid (see CausalAttention)
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
@@ -293,6 +299,7 @@ class DecoderBlock(nn.Module):
             self.dim, self.heads, self.dtype, self.attn_impl, self.seq_axis,
             self.rope_theta, self.decode, self.sp_layout,
             attn_window=self.attn_window, kv_heads=self.kv_heads,
+            attn_bh_block=self.attn_bh_block,
             name="attn",
         )(RMSNorm(self.dtype, name="norm1")(x), segment_ids, positions)
         y = RMSNorm(self.dtype, name="norm2")(x)
@@ -395,6 +402,7 @@ class TransformerLM(nn.Module):
     skip_head: bool = False  # return final-norm hidden states, not logits
     attn_window: Optional[int] = None  # sliding-window (local) attention
     kv_heads: Optional[int] = None  # grouped-query attention (GQA/MQA)
+    attn_bh_block: int = 1  # batched-bh flash grid (see CausalAttention)
     # weight tying: reuse the embedding table as the LM head (GPT-2 /
     # Gemma style) — drops the (dim, vocab) head parameter entirely
     tie_embeddings: bool = False
@@ -450,6 +458,7 @@ class TransformerLM(nn.Module):
                 remat_mlp=remat_mlp and not moe_block,
                 attn_window=self.attn_window,
                 kv_heads=self.kv_heads,
+                attn_bh_block=self.attn_bh_block,
                 name=f"block{i}",
             )(x, segment_ids, positions)
         x = RMSNorm(self.dtype, name="norm_final")(x)
@@ -486,6 +495,7 @@ def build_transformer_lm(
     attn_window: Optional[int] = None,
     kv_heads: Optional[int] = None,
     tie_embeddings: bool = False,
+    attn_bh_block: int = 1,
 ) -> TransformerLM:
     if dim % heads:
         raise ValueError("dim must be a multiple of heads")
@@ -519,7 +529,7 @@ def build_transformer_lm(
         moe_top_k=moe_top_k, ep_axis=ep_axis, remat=remat,
         remat_policy=remat_policy, sp_layout=sp_layout,
         attn_window=attn_window, kv_heads=kv_heads,
-        tie_embeddings=tie_embeddings,
+        tie_embeddings=tie_embeddings, attn_bh_block=attn_bh_block,
     )
 
 

@@ -80,6 +80,16 @@ class _Cfg(NamedTuple):
     # dK/dV kernel's inner grid enumerates (group member, q block) so
     # the per-KV-head gradient accumulates across its whole group
     kv_group: int = 1
+    # batched-bh: each grid cell processes bh_block (batch·head) rows
+    # (an unrolled static loop over G sub-dots sharing one mask
+    # computation and one revolving-window DMA per cell). At short
+    # sequence the inner grid is tiny (s=1024 @ 512-blocks → 2×2) and
+    # per-grid-cell overhead (window-swap DMA setup + scalar control)
+    # dominates the MXU work — batching bh cuts the cell count G× at
+    # identical FLOPs. Requires kv_group == 1 (the GQA b//g index remap
+    # is incompatible with G-row blocks). G=1 is exactly the classic
+    # kernel.
+    bh_block: int = 1
 
 
 def _vma(*xs):
@@ -336,6 +346,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, cfg: _Cfg):
     qi = pl.program_id(1)
     j = pl.program_id(2)  # inner: revolving K/V window, sequential
     nk = pl.num_programs(2)
+    G = cfg.bh_block  # rows per grid cell (static unrolled loop)
 
     last_j = (
         _causal_last_j(qi, bq, bk, nk, cfg.causal_shift)
@@ -354,67 +365,77 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, cfg: _Cfg):
 
     @pl.when((j >= first_j) & (j <= last_j))
     def _compute():
-        q = q_ref[0]  # native dtype — bf16 in ⇒ full-rate MXU
-        k_blk = k_ref[0]
-        v_blk = v_ref[0]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        s = s * cfg.scale  # scale the f32 scores, not the bf16 operand
+        # band/bounds mask depends only on (qi, j) — computed ONCE and
+        # shared by all G rows of the cell
         col = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = col < cfg.skv_valid
+        band = col < cfg.skv_valid
         if cfg.causal:
             row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            mask = mask & (col <= row + cfg.causal_shift)
+            band = band & (col <= row + cfg.causal_shift)
             if cfg.window is not None:
-                mask = mask & (col > row + cfg.causal_shift - cfg.window)
-        if cfg.has_segments:
-            qseg = seg_ref[0, 0, pl.ds(qi * bq, bq)]
-            kseg = seg_ref[0, 0, pl.ds(j * bk, bk)]
-            mask = mask & (qseg[:, None] == kseg[None, :])
-        s = jnp.where(mask, s, _NEG_BIG)
-        m = m_ref[:, :1]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # explicit mask gate: a FULLY-masked row keeps m_new at the
-        # -1e30 sentinel, where exp(s - m_new) = exp(0) = 1 would count
-        # masked entries into l/acc (possible under causal_shift < 0,
-        # whose first row sees nothing)
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
-            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
-        )
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+                band = band & (col > row + cfg.causal_shift - cfg.window)
+        for gi in range(G):
+            q = q_ref[gi]  # native dtype — bf16 in ⇒ full-rate MXU
+            k_blk = k_ref[gi]  # G>1 requires kv_group==1: row gi's own K/V
+            v_blk = v_ref[gi]
+            s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+            s = s * cfg.scale  # scale the f32 scores, not the bf16 operand
+            mask = band
+            if cfg.has_segments:
+                qseg = seg_ref[gi, 0, pl.ds(qi * bq, bq)]
+                kseg = seg_ref[gi, 0, pl.ds(j * bk, bk)]
+                mask = mask & (qseg[:, None] == kseg[None, :])
+            s = jnp.where(mask, s, _NEG_BIG)
+            m = m_ref[gi, :, :1]
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            # explicit mask gate: a FULLY-masked row keeps m_new at the
+            # -1e30 sentinel, where exp(s - m_new) = exp(0) = 1 would
+            # count masked entries into l/acc (possible under
+            # causal_shift < 0, whose first row sees nothing)
+            p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l_ref[gi, :, :1] + jnp.sum(
+                p, axis=-1, keepdims=True
+            )
+            acc_ref[gi] = acc_ref[gi] * alpha + jnp.dot(
+                p.astype(v_blk.dtype), v_blk,
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[gi] = jnp.broadcast_to(m_new, (bq, _LANES))
+            l_ref[gi] = jnp.broadcast_to(l_new, (bq, _LANES))
 
     @pl.when(j == last_j)
     def _finalize():
-        l = l_ref[:, :1]
-        safe_l = jnp.where(l > 0, l, 1.0)
-        o_ref[0] = jnp.where(l > 0, acc_ref[...] / safe_l, 0.0).astype(
-            o_ref.dtype
-        )
-        lse = jnp.where(
-            l[:, 0] > 0, m_ref[:, 0] + jnp.log(safe_l[:, 0]), _NEG_BIG
-        )
-        lse_ref[0, 0, pl.ds(qi * bq, bq)] = lse
+        for gi in range(G):
+            l = l_ref[gi, :, :1]
+            safe_l = jnp.where(l > 0, l, 1.0)
+            o_ref[gi] = jnp.where(l > 0, acc_ref[gi] / safe_l, 0.0).astype(
+                o_ref.dtype
+            )
+            lse = jnp.where(
+                l[:, 0] > 0, m_ref[gi, :, 0] + jnp.log(safe_l[:, 0]),
+                _NEG_BIG,
+            )
+            lse_ref[gi, 0, pl.ds(qi * bq, bq)] = lse
 
 
 def _fwd(cfg: _Cfg, q, k, v, segs=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
     g = cfg.kv_group  # K/V head index = q-head index // g (GQA)
-    grid = (bh, sq // cfg.block_q, skv // cfg.block_k)
+    G = cfg.bh_block  # (batch·head) rows per grid cell; G>1 ⇒ g==1
+    grid = (bh // G, sq // cfg.block_q, skv // cfg.block_k)
     in_specs = [
-        pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b // g, j, 0)),
-        pl.BlockSpec((1, cfg.block_k, d), lambda b, i, j: (b // g, j, 0)),
+        pl.BlockSpec((G, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((G, cfg.block_k, d), lambda b, i, j: (b // g, j, 0)),
+        pl.BlockSpec((G, cfg.block_k, d), lambda b, i, j: (b // g, j, 0)),
     ]
     inputs = [q, k, v]
     if cfg.has_segments:
         # segment ids ride as a whole padded row, same legality
         # reasoning as the lse block (see _fwd_kernel docstring)
         in_specs.append(
-            pl.BlockSpec((1, 1, segs.shape[2]), lambda b, i, j: (b, 0, 0))
+            pl.BlockSpec((G, 1, segs.shape[2]), lambda b, i, j: (b, 0, 0))
         )
         inputs.append(segs)
     o, lse = pl.pallas_call(
@@ -422,17 +443,17 @@ def _fwd(cfg: _Cfg, q, k, v, segs=None):
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),
+            pl.BlockSpec((G, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((G, 1, sq), lambda b, i, j: (b, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v)),
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32, vma=_vma(q, k, v)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((cfg.block_q, _LANES), jnp.float32),  # running max
-            pltpu.VMEM((cfg.block_q, _LANES), jnp.float32),  # normalizer
-            pltpu.VMEM((cfg.block_q, d), jnp.float32),  # output accum
+            pltpu.VMEM((G, cfg.block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((G, cfg.block_q, _LANES), jnp.float32),  # normalizer
+            pltpu.VMEM((G, cfg.block_q, d), jnp.float32),  # output accum
         ],
         # the qi dim must stay 'arbitrary': the (1, 1, sq) lse OUTPUT
         # block's index map is invariant over qi, and a 'parallel' qi
@@ -463,6 +484,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     qi = pl.program_id(1)
     j = pl.program_id(2)  # inner: revolving K/V window
     nk = pl.num_programs(2)
+    G = cfg.bh_block
 
     last_j = (
         _causal_last_j(qi, bq, bk, nk, cfg.causal_shift)
@@ -479,34 +501,39 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when((j >= first_j) & (j <= last_j))
     def _compute():
-        q = q_ref[0]
-        do = do_ref[0]
-        k_blk = k_ref[0]
-        v_blk = v_ref[0]
-        lse = lse_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
-        delta = delta_ref[0, 0, pl.ds(qi * bq, bq)][:, None]
         row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * cfg.scale
         col = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
+        band = (col < cfg.skv_valid) & (row < cfg.sq_valid)
         if cfg.causal:
-            mask = mask & (col <= row + cfg.causal_shift)
+            band = band & (col <= row + cfg.causal_shift)
             if cfg.window is not None:
-                mask = mask & (col > row + cfg.causal_shift - cfg.window)
-        if cfg.has_segments:
-            qseg = seg_ref[0, 0, pl.ds(qi * bq, bq)]
-            kseg = seg_ref[0, 0, pl.ds(j * bk, bk)]
-            mask = mask & (qseg[:, None] == kseg[None, :])
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta)).astype(k_blk.dtype)
-        dq_acc_ref[...] = dq_acc_ref[...] + jnp.dot(
-            ds, k_blk, preferred_element_type=jnp.float32
-        )
+                band = band & (col > row + cfg.causal_shift - cfg.window)
+        for gi in range(G):
+            q = q_ref[gi]
+            do = do_ref[gi]
+            k_blk = k_ref[gi]
+            v_blk = v_ref[gi]
+            lse = lse_ref[gi, 0, pl.ds(qi * bq, bq)][:, None]
+            delta = delta_ref[gi, 0, pl.ds(qi * bq, bq)][:, None]
+            s = jnp.dot(
+                q, k_blk.T, preferred_element_type=jnp.float32
+            ) * cfg.scale
+            mask = band
+            if cfg.has_segments:
+                qseg = seg_ref[gi, 0, pl.ds(qi * bq, bq)]
+                kseg = seg_ref[gi, 0, pl.ds(j * bk, bk)]
+                mask = mask & (qseg[:, None] == kseg[None, :])
+            p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta)).astype(k_blk.dtype)
+            dq_acc_ref[gi] = dq_acc_ref[gi] + jnp.dot(
+                ds, k_blk, preferred_element_type=jnp.float32
+            )
 
     @pl.when(j == last_j)
     def _finalize():
-        dq_ref[0] = (dq_acc_ref[...] * cfg.scale).astype(dq_ref.dtype)
+        for gi in range(G):
+            dq_ref[gi] = (dq_acc_ref[gi] * cfg.scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
@@ -526,6 +553,7 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
     nt = pl.num_programs(2)
     nq = nt // cfg.kv_group
     i = lax.rem(t, nq)  # q block within the current member's sweep
+    G = cfg.bh_block  # G>1 requires kv_group==1, so then i == t
 
     # causal: the first query block whose rows can see this key block
     # (col c is visible to rows >= c - causal_shift)
@@ -548,38 +576,44 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when((i >= first_i) & (i <= last_i))
     def _compute():
-        k = k_ref[0]
-        v = v_ref[0]
-        q_blk = q_ref[0]
-        do_blk = do_ref[0]
-        lse = lse_ref[0, 0, pl.ds(i * bq, bq)][:, None]
-        delta = delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]
         col = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * cfg.scale
         row = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        mask = (col < cfg.skv_valid) & (row < cfg.sq_valid)
+        band = (col < cfg.skv_valid) & (row < cfg.sq_valid)
         if cfg.causal:
-            mask = mask & (col <= row + cfg.causal_shift)
+            band = band & (col <= row + cfg.causal_shift)
             if cfg.window is not None:
-                mask = mask & (col > row + cfg.causal_shift - cfg.window)
-        if cfg.has_segments:
-            qseg = seg_ref[0, 0, pl.ds(i * bq, bq)]
-            kseg = seg_ref[0, 0, pl.ds(ki * bk, bk)]
-            mask = mask & (qseg[:, None] == kseg[None, :])
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dv_acc_ref[...] = dv_acc_ref[...] + jnp.dot(
-            p.T.astype(do_blk.dtype), do_blk, preferred_element_type=jnp.float32
-        )
-        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta)).astype(q_blk.dtype)
-        dk_acc_ref[...] = dk_acc_ref[...] + jnp.dot(
-            ds.T, q_blk, preferred_element_type=jnp.float32
-        )
+                band = band & (col > row + cfg.causal_shift - cfg.window)
+        for gi in range(G):
+            k = k_ref[gi]
+            v = v_ref[gi]
+            q_blk = q_ref[gi]
+            do_blk = do_ref[gi]
+            lse = lse_ref[gi, 0, pl.ds(i * bq, bq)][:, None]
+            delta = delta_ref[gi, 0, pl.ds(i * bq, bq)][:, None]
+            s = jnp.dot(
+                q_blk, k.T, preferred_element_type=jnp.float32
+            ) * cfg.scale
+            mask = band
+            if cfg.has_segments:
+                qseg = seg_ref[gi, 0, pl.ds(i * bq, bq)]
+                kseg = seg_ref[gi, 0, pl.ds(ki * bk, bk)]
+                mask = mask & (qseg[:, None] == kseg[None, :])
+            p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            dv_acc_ref[gi] = dv_acc_ref[gi] + jnp.dot(
+                p.T.astype(do_blk.dtype), do_blk,
+                preferred_element_type=jnp.float32,
+            )
+            dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta)).astype(q_blk.dtype)
+            dk_acc_ref[gi] = dk_acc_ref[gi] + jnp.dot(
+                ds.T, q_blk, preferred_element_type=jnp.float32
+            )
 
     @pl.when(t == nt - 1)
     def _finalize():
-        dk_ref[0] = (dk_acc_ref[...] * cfg.scale).astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+        for gi in range(G):
+            dk_ref[gi] = (dk_acc_ref[gi] * cfg.scale).astype(dk_ref.dtype)
+            dv_ref[gi] = dv_acc_ref[gi].astype(dv_ref.dtype)
 
 
 def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
@@ -587,15 +621,16 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
     skv = k.shape[1]
     bh_kv = k.shape[0]  # under GQA: bh // kv_group
     g = cfg.kv_group
+    G = cfg.bh_block  # G>1 ⇒ g==1 (enforced in flash_attention)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     # vectors ride as (BH, 1, S) whole-row blocks — see _fwd_kernel note
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
     nq, nk = sq // cfg.block_q, skv // cfg.block_k
-    q_spec = pl.BlockSpec((1, cfg.block_q, d), lambda b, i, j: (b, i, 0))
-    k_stream = pl.BlockSpec((1, cfg.block_k, d),
+    q_spec = pl.BlockSpec((G, cfg.block_q, d), lambda b, i, j: (b, i, 0))
+    k_stream = pl.BlockSpec((G, cfg.block_k, d),
                             lambda b, i, j: (b // g, j, 0))
-    vec_row = pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0))
+    vec_row = pl.BlockSpec((G, 1, sq), lambda b, i, j: (b, 0, 0))
     semantics = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
@@ -604,16 +639,16 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
     dq_inputs = [q, k, v, do, lse3, delta3]
     if cfg.has_segments:
         dq_in_specs.append(
-            pl.BlockSpec((1, 1, segs.shape[2]), lambda b, i, j: (b, 0, 0))
+            pl.BlockSpec((G, 1, segs.shape[2]), lambda b, i, j: (b, 0, 0))
         )
         dq_inputs.append(segs)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, cfg=cfg),
-        grid=(bh, nq, nk),
+        grid=(bh // G, nq, nk),
         in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v, do)),
-        scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((G, cfg.block_q, d), jnp.float32)],
         compiler_params=semantics,
         interpret=cfg.interpret,
     )(*dq_inputs)
@@ -621,25 +656,25 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
     # dk/dv: key blocks in the middle grid dim; the innermost dim
     # enumerates (group member, q block) so each KV head's gradient
     # accumulates over every query head it serves (kv_group=1 ⇒ MHA)
-    k_spec = pl.BlockSpec((1, cfg.block_k, d), lambda b, j, t: (b, j, 0))
+    k_spec = pl.BlockSpec((G, cfg.block_k, d), lambda b, j, t: (b, j, 0))
     q_stream = pl.BlockSpec(
-        (1, cfg.block_q, d), lambda b, j, t: (b * g + t // nq, t % nq, 0)
+        (G, cfg.block_q, d), lambda b, j, t: (b * g + t // nq, t % nq, 0)
     )
     vec_row_kv = pl.BlockSpec(
-        (1, 1, sq), lambda b, j, t: (b * g + t // nq, 0, 0)
+        (G, 1, sq), lambda b, j, t: (b * g + t // nq, 0, 0)
     )
     dkv_in_specs = [k_spec, k_spec, q_stream, q_stream, vec_row_kv,
                     vec_row_kv]
     dkv_inputs = [k, v, q, do, lse3, delta3]
     if cfg.has_segments:
         dkv_in_specs.append(
-            pl.BlockSpec((1, 1, segs.shape[2]),
+            pl.BlockSpec((G, 1, segs.shape[2]),
                          lambda b, j, t: (b * g, 0, 0))
         )
         dkv_inputs.append(segs)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, cfg=cfg),
-        grid=(bh_kv, nk, nq * g),
+        grid=(bh_kv // G, nk, nq * g),
         in_specs=dkv_in_specs,
         out_specs=[k_spec, k_spec],
         out_shape=[
@@ -649,8 +684,8 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
                                  vma=_vma(q, k, v, do)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((cfg.block_k, d), jnp.float32),
-            pltpu.VMEM((cfg.block_k, d), jnp.float32),
+            pltpu.VMEM((G, cfg.block_k, d), jnp.float32),
+            pltpu.VMEM((G, cfg.block_k, d), jnp.float32),
         ],
         compiler_params=semantics,
         interpret=cfg.interpret,
@@ -704,6 +739,7 @@ def flash_attention(
     segment_ids=None,
     block_q: int = 512,
     block_k: int = 512,
+    bh_block: int = 1,
     interpret: Optional[bool] = None,
     return_lse: bool = False,
 ):
@@ -744,6 +780,20 @@ def flash_attention(
     expanded K/V never materialize in HBM), and the dK/dV kernel's
     inner grid enumerates (group member, q block) so each K/V head's
     gradient accumulates over every query head it serves.
+
+    ``bh_block`` (batched-bh restructure, round-5 short-sequence
+    lever): each grid cell processes ``bh_block`` (batch·head) rows as
+    an unrolled loop of sub-dots sharing one mask computation and one
+    revolving-window DMA per cell. At short sequence the inner grid is
+    tiny (s=1024 at 512-blocks → 2×2 per bh row) and per-grid-cell
+    overhead dominates the MXU work — the r03 diagnostic's 3.66 TF/s
+    at s=1024 vs 46.7 TF/s at 64k with identical block shapes
+    (MFU_ANALYSIS §7 / ROUND4_NOTES §2 decision tree). Batching bh
+    cuts the cell count ``bh_block``× at identical FLOPs. Clamped to
+    the largest divisor of batch·heads ≤ the request; forced to 1
+    under grouped-query attention (the ``b // group`` K/V index remap
+    addresses per-row, incompatible with multi-row blocks). ``1`` is
+    exactly the classic kernel.
     """
     if q.ndim != 4:
         raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
@@ -782,6 +832,16 @@ def flash_attention(
     scale = float(scale) if scale is not None else d**-0.5
     block_q = min(block_q, max(8, sq))
     block_k = min(block_k, max(8, skv))
+    if bh_block < 1:
+        raise ValueError(f"bh_block must be >= 1, got {bh_block}")
+    if h_kv != h:
+        bh_block = 1  # GQA: per-row b // group remap needs 1-row blocks
+    else:
+        # largest divisor of batch·heads ≤ the request — any value is
+        # safe to sweep; exact grid cover, no bh padding
+        bh_block = min(int(bh_block), b * h)
+        while (b * h) % bh_block:
+            bh_block -= 1
     cfg = _Cfg(
         causal=causal,
         scale=scale,
@@ -793,6 +853,7 @@ def flash_attention(
         window=None if window is None else int(window),
         has_segments=segment_ids is not None,
         kv_group=h // h_kv,
+        bh_block=bh_block,
     )
     qp = _pad_seq(q.reshape(b * h, sq, d), block_q)
     kp = _pad_seq(k.reshape(b * h_kv, skv, d), block_k)
