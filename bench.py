@@ -1082,6 +1082,10 @@ def main() -> int:
                    default="auto",
                    help="lm only: attention impl (tuning input — the "
                         "watcher captures both and keeps the faster)")
+    p.add_argument("--bn-fold", action="store_true",
+                   help="fold the frozen backbone's BatchNorms into "
+                        "their convs (flagship cnn model only) — the "
+                        "round-5 frozen-backbone lever A/B")
     p.add_argument("--bh-block", type=int, default=1,
                    help="batched-bh flash grid: (batch*heads) rows per "
                         "kernel grid cell — the round-5 short-sequence "
@@ -1256,7 +1260,10 @@ def _bench(args) -> int:
         else:
             # the reference's distributed per-worker batch (P1/03:81)
             hw, width, batch = 224, 1.0, args.batch or 256
-        model = build_model(num_classes=5, dropout=0.5, width_mult=width)
+        model = build_model(num_classes=5, dropout=0.5, width_mult=width,
+                            fold_bn=args.bn_fold)
+        if args.bn_fold:
+            width = f"{width}-bnfold"
     global_batch = batch * n_chips
 
     mesh = build_mesh(MeshSpec(data=n_chips, model=1))

@@ -92,3 +92,142 @@ def test_preprocess_input_range():
     np.testing.assert_allclose(
         np.asarray(y), [[-1.0, -0.00392157, 1.0]], atol=1e-5
     )
+
+
+# ---------------------------------------------------------------------------
+# BN folding (round-5 frozen-backbone lever): fold_bn=True models +
+# fold_bn_params/fold_backbone_variables converters
+# ---------------------------------------------------------------------------
+
+
+def _randomize_bn(variables, key=7):
+    """Give every BN layer non-trivial gamma/beta/mean/var so folding
+    parity is meaningful (init stats are the identity). Perturbations
+    are GENTLE (near-identity): wild stats (var ~0.1, mean ~N(0,1))
+    make each BN an ~5x amplifier, activations explode over 20 layers,
+    and rounding noise swamps the parity signal — the exact fold math
+    is pinned separately by the single-layer test below."""
+    rngs = iter(jax.random.split(jax.random.key(key), 4096))
+
+    def walk(node, in_stats):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if k == "bn" and not in_stats:
+                out[k] = {
+                    "scale": 0.9 + 0.2 * jax.random.uniform(
+                        next(rngs), v["scale"].shape),
+                    "bias": 0.1 * jax.random.normal(
+                        next(rngs), v["bias"].shape),
+                }
+            elif k == "bn" and in_stats:
+                out[k] = {
+                    "mean": 0.1 * jax.random.normal(
+                        next(rngs), v["mean"].shape),
+                    "var": 0.9 + 0.2 * jax.random.uniform(
+                        next(rngs), v["var"].shape),
+                }
+            else:
+                out[k] = walk(v, in_stats)
+        return out
+
+    return {
+        "params": walk(variables["params"], False),
+        "batch_stats": walk(variables["batch_stats"], True),
+    }
+
+
+@pytest.mark.smoke
+def test_fold_bn_single_layer_exact():
+    """The fold identity conv(x, W*s) + (beta - s*mean) == BN(conv(x, W))
+    is EXACT per layer (f32): dense and grouped (depthwise) convs."""
+    from tpuflow.models.mobilenet_v2 import ConvBN, fold_bn_params
+
+    x = jax.random.normal(jax.random.key(1), (2, 16, 16, 8))
+    for groups, feats in ((1, 12), (8, 8)):
+        m = ConvBN(feats, (3, 3), groups=groups, dtype=jnp.float32)
+        mf = ConvBN(feats, (3, 3), groups=groups, dtype=jnp.float32,
+                    fold_bn=True)
+        v = m.init({"params": jax.random.key(0)}, x, train=False)
+        ks = jax.random.split(jax.random.key(2), 4)
+        p = dict(v["params"])
+        p["bn"] = {
+            "scale": 0.5 + jax.random.uniform(ks[0], (feats,)),
+            "bias": jax.random.normal(ks[1], (feats,)),
+        }
+        bs = {"bn": {"mean": jax.random.normal(ks[2], (feats,)),
+                     "var": 0.1 + jax.random.uniform(ks[3], (feats,))}}
+        folded = fold_bn_params(p, bs, eps=1e-3)
+        y_ref = m.apply({"params": p, "batch_stats": bs}, x, train=False)
+        y_fold = mf.apply({"params": folded}, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(y_fold), np.asarray(y_ref), atol=1e-5, rtol=1e-5
+        )
+
+
+@pytest.mark.smoke
+def test_fold_bn_matches_unfolded_mobilenet():
+    from tpuflow.models.mobilenet_v2 import fold_bn_params
+
+    m = MobileNetV2(width_mult=0.25)
+    mf = MobileNetV2(width_mult=0.25, fold_bn=True)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    v = _randomize_bn(m.init({"params": jax.random.key(0)}, x, train=False))
+    folded = fold_bn_params(v["params"], v["batch_stats"], eps=1e-3)
+    # folded tree must exactly match the fold_bn=True module structure
+    expect = jax.tree.structure(
+        mf.init({"params": jax.random.key(0)}, x, train=False)["params"]
+    )
+    assert jax.tree.structure(folded) == expect
+    y_ref = m.apply(v, x, train=False)
+    y_fold = mf.apply({"params": folded}, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(y_fold, np.float32), np.asarray(y_ref, np.float32),
+        atol=5e-2, rtol=5e-2,  # bf16 compute; BN math reassociated
+    )
+
+
+def test_fold_backbone_variables_classifier_parity():
+    from tpuflow.models.classifier import fold_backbone_variables
+
+    for backbone, wm in (("mobilenet_v2", 0.25), ("resnet18", 1.0)):
+        m = build_model(num_classes=3, dropout=0.0, width_mult=wm,
+                        backbone=backbone)
+        mf = build_model(num_classes=3, dropout=0.0, width_mult=wm,
+                         backbone=backbone, fold_bn=True)
+        x = jax.random.normal(jax.random.key(2), (2, 32, 32, 3))
+        v = _randomize_bn(
+            m.init({"params": jax.random.key(0)}, x, train=False)
+        )
+        vf = fold_backbone_variables(v, backbone=backbone)
+        assert "batch_stats" not in vf
+        y_ref = m.apply(v, x, train=False)
+        y_fold = mf.apply(vf, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(y_fold, np.float32), np.asarray(y_ref, np.float32),
+            atol=5e-2, rtol=5e-2,
+        )
+
+
+def test_fold_bn_guards():
+    from tpuflow.models.classifier import fold_backbone_variables
+
+    m = MobileNetV2(width_mult=0.25, fold_bn=True)
+    x = jnp.zeros((1, 32, 32, 3))
+    with pytest.raises(ValueError, match="inference-only"):
+        m.init({"params": jax.random.key(0)}, x, train=True)
+    with pytest.raises(ValueError, match="freeze_backbone"):
+        build_model(fold_bn=True, freeze_backbone=False).init(
+            {"params": jax.random.key(0)}, x, train=False
+        )
+    # an unfolded checkpoint cannot flow into a folded model via
+    # weights= — the guard must name the conversion helper
+    with pytest.raises(ValueError, match="fold_backbone_variables"):
+        build_model(fold_bn=True, weights="/tmp/nope.npz").init(
+            {"params": jax.random.key(0)}, x, train=False
+        )
+    # folding a tree that carries no backbone batch_stats must fail
+    # loudly at the conversion site, not as a flax structure mismatch
+    with pytest.raises(ValueError, match="batch_stats"):
+        fold_backbone_variables({"params": {"backbone": {}}})

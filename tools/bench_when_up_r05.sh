@@ -70,7 +70,7 @@ while true; do
     # batched-bh flash in the full training step (the kernel
     # restructure A/B at model scale, not just the kernel sweep)
     [ -f BENCH_LOCAL_r05_lm_bh8.json ] || capture BENCH_LOCAL_r05_lm_bh8.json --model lm --steps 10 --lm-attn-impl flash --bh-block 8 --no-attn-diag --diag-out /tmp/diag_lm_bh8.json || true
-    [ -f BENCH_LOCAL_r05_lm_bh64.json ] || capture BENCH_LOCAL_r05_lm_bh64.json --model lm --steps 10 --lm-attn-impl flash --bh-block 64 --no-attn-diag --diag-out /tmp/diag_lm_bh64.json || true
+    [ -f BENCH_LOCAL_r05_lm_bh32.json ] || capture BENCH_LOCAL_r05_lm_bh32.json --model lm --steps 10 --lm-attn-impl flash --bh-block 32 --no-attn-diag --diag-out /tmp/diag_lm_bh32.json || true
     [ -f BENCH_LOCAL_r05_sweep.json ] || capture BENCH_LOCAL_r05_sweep.json --model vit --steps 10 --attn-sweep --diag-out BENCH_DIAG_r05_sweep.json || true
     # --- 2: dense models with traces ----------------------------------
     [ -f BENCH_LOCAL_r05_resnet50.json ] || capture BENCH_LOCAL_r05_resnet50.json --model resnet50 --steps 20 --no-attn-diag --trace traces_r05/resnet50 --diag-out BENCH_DIAG_r05_resnet50.json || ok=1

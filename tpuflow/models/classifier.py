@@ -40,17 +40,35 @@ class TransferClassifier(nn.Module):
     # 'resnet50' — every backbone shares the freeze/pretrained/trainer
     # machinery (params live under the BACKBONE subtree)
     backbone: str = "mobilenet_v2"
+    # fold the frozen backbone's BatchNorms into their convs (the BN
+    # layers vanish from the graph — see mobilenet_v2.ConvBN.fold_bn).
+    # Requires freeze_backbone=True: folded statistics cannot update.
+    # Convert unfolded checkpoints with ``fold_backbone_variables``.
+    fold_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.fold_bn and not self.freeze_backbone:
+            raise ValueError(
+                "fold_bn=True requires freeze_backbone=True — folded BN "
+                "statistics are constants baked into the conv weights"
+            )
+        if self.fold_bn and self.weights is not None:
+            raise ValueError(
+                "fold_bn=True cannot load an UNFOLDED checkpoint via "
+                "weights= (the folded model has no bn leaves to fill); "
+                "load into a fold_bn=False twin, convert with "
+                "fold_backbone_variables, and apply the result"
+            )
         # Frozen backbone always runs with train=False: BN uses running
         # averages and batch_stats stay immutable (Keras trainable=False).
         bb_train = train and not self.freeze_backbone
         if self.backbone == "mobilenet_v2":
-            bb = MobileNetV2(self.width_mult, dtype=self.dtype, name=BACKBONE)
+            bb = MobileNetV2(self.width_mult, dtype=self.dtype,
+                             fold_bn=self.fold_bn, name=BACKBONE)
         elif self.backbone in ("resnet18", "resnet34", "resnet50"):
             bb = ResNet(int(self.backbone[len("resnet"):]), dtype=self.dtype,
-                        name=BACKBONE)
+                        fold_bn=self.fold_bn, name=BACKBONE)
         else:
             raise ValueError(
                 f"unknown backbone {self.backbone!r}; expected "
@@ -80,6 +98,7 @@ def build_model(
     dtype: Any = jnp.bfloat16,
     weights: Optional[str] = None,
     backbone: str = "mobilenet_v2",
+    fold_bn: bool = False,
 ) -> TransferClassifier:
     """≙ build_model(img_height, img_width, img_channels, num_classes)
     (P1/02:159-178). Image size/channels are carried by the data, not the
@@ -100,7 +119,42 @@ def build_model(
         dtype=dtype,
         weights=weights,
         backbone=backbone,
+        fold_bn=fold_bn,
     )
+
+
+def fold_backbone_variables(variables: Dict, backbone: str = "mobilenet_v2",
+                            ) -> Dict:
+    """Convert an UNFOLDED classifier's variables for a ``fold_bn=True``
+    twin: the backbone subtree's BN layers fold into their convs
+    (``mobilenet_v2.fold_bn_params``, eps by backbone convention:
+    MobileNetV2 1e-3, ResNet 1e-5), the head passes through, and the
+    backbone's ``batch_stats`` are consumed. Use when applying a real
+    pretrained checkpoint to a folded model::
+
+        vars_folded = fold_backbone_variables(vars_unfolded)
+        folded.apply(vars_folded, x)  # == unfolded.apply(..., train=False)
+    """
+    from tpuflow.models.mobilenet_v2 import fold_bn_params
+
+    eps = 1e-3 if backbone == "mobilenet_v2" else 1e-5
+    params = dict(variables["params"])
+    stats = variables.get("batch_stats", {})
+    if not stats.get(BACKBONE):
+        raise ValueError(
+            "variables carry no backbone batch_stats to fold — already "
+            "folded, or stripped? fold_backbone_variables needs the "
+            "UNFOLDED model's full variables (params + batch_stats)"
+        )
+    params[BACKBONE] = fold_bn_params(
+        params[BACKBONE], stats.get(BACKBONE, {}), eps
+    )
+    out = {k: v for k, v in variables.items() if k != "batch_stats"}
+    out["params"] = params
+    rest_stats = {k: v for k, v in stats.items() if k != BACKBONE}
+    if rest_stats:
+        out["batch_stats"] = rest_stats
+    return out
 
 
 def backbone_param_mask(params: Dict) -> Dict:

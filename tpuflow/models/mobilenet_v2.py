@@ -64,26 +64,42 @@ class ConvBN(nn.Module):
     epsilon: float = 1e-3
     act_fn: Any = None
     padding: Any = "SAME"
+    # BN folding (round-5 inference/frozen-backbone lever): the conv
+    # absorbs the BN scale into its kernel and grows a bias — the BN
+    # layer disappears from the graph entirely (its per-element affine
+    # would otherwise survive as runtime-array multiplies XLA cannot
+    # constant-fold under jit). Only valid where BN statistics are
+    # frozen: inference, or the transfer classifier's frozen backbone
+    # (P1/02:167-169 trainable=False semantics). Convert unfolded
+    # checkpoints with ``fold_bn_params``.
+    fold_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.fold_bn and train:
+            raise ValueError(
+                "fold_bn=True is inference-only (BN statistics are "
+                "folded into the conv and can no longer update); run "
+                "with train=False or build with fold_bn=False"
+            )
         x = nn.Conv(
             self.features,
             self.kernel,
             strides=self.strides,
             padding=self.padding,
-            use_bias=False,
+            use_bias=self.fold_bn,
             feature_group_count=self.groups,
             dtype=self.dtype,
             name="conv",
         )(x)
-        x = nn.BatchNorm(
-            use_running_average=not train,
-            momentum=self.momentum,
-            epsilon=self.epsilon,
-            dtype=self.dtype,
-            name="bn",
-        )(x)
+        if not self.fold_bn:
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=self.momentum,
+                epsilon=self.epsilon,
+                dtype=self.dtype,
+                name="bn",
+            )(x)
         if self.act_fn is not None:
             x = self.act_fn(x)
         elif self.act:
@@ -96,6 +112,7 @@ class InvertedResidual(nn.Module):
     strides: Tuple[int, int]
     expand_ratio: int
     dtype: Dtype = jnp.bfloat16
+    fold_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -103,7 +120,8 @@ class InvertedResidual(nn.Module):
         hidden = in_ch * self.expand_ratio
         y = x
         if self.expand_ratio != 1:
-            y = ConvBN(hidden, (1, 1), act=True, dtype=self.dtype, name="expand")(
+            y = ConvBN(hidden, (1, 1), act=True, dtype=self.dtype,
+                       fold_bn=self.fold_bn, name="expand")(
                 y, train
             )
         y = ConvBN(
@@ -113,9 +131,11 @@ class InvertedResidual(nn.Module):
             groups=hidden,
             act=True,
             dtype=self.dtype,
+            fold_bn=self.fold_bn,
             name="depthwise",
         )(y, train)
-        y = ConvBN(self.features, (1, 1), act=False, dtype=self.dtype, name="project")(
+        y = ConvBN(self.features, (1, 1), act=False, dtype=self.dtype,
+                   fold_bn=self.fold_bn, name="project")(
             y, train
         )
         if self.strides == (1, 1) and in_ch == self.features:
@@ -132,12 +152,14 @@ class MobileNetV2(nn.Module):
 
     width_mult: float = 1.0
     dtype: Dtype = jnp.bfloat16
+    fold_bn: bool = False  # see ConvBN.fold_bn (inference-only)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         stem = make_divisible(32 * self.width_mult)
-        x = ConvBN(stem, (3, 3), strides=(2, 2), dtype=self.dtype, name="stem")(
+        x = ConvBN(stem, (3, 3), strides=(2, 2), dtype=self.dtype,
+                   fold_bn=self.fold_bn, name="stem")(
             x, train
         )
         for si, (t, c, n, s) in enumerate(_INVERTED_RESIDUAL_SETTINGS):
@@ -148,8 +170,69 @@ class MobileNetV2(nn.Module):
                     strides=(s, s) if i == 0 else (1, 1),
                     expand_ratio=t,
                     dtype=self.dtype,
+                    fold_bn=self.fold_bn,
                     name=f"block_{si}_{i}",
                 )(x, train)
         last = make_divisible(1280 * max(1.0, self.width_mult))
-        x = ConvBN(last, (1, 1), dtype=self.dtype, name="head_conv")(x, train)
+        x = ConvBN(last, (1, 1), dtype=self.dtype, fold_bn=self.fold_bn,
+                   name="head_conv")(x, train)
         return x
+
+
+def fold_bn_params(params, batch_stats, eps: float):
+    """Fold frozen BatchNorm layers into their preceding convs.
+
+    Walks an UNFOLDED backbone's ``params``/``batch_stats`` trees and,
+    at every ConvBN node (a dict holding both ``conv`` and ``bn``),
+    rewrites the conv for the ``fold_bn=True`` module structure::
+
+        s      = gamma / sqrt(var + eps)          # per out-channel
+        W'     = W * s          (broadcast on the out-channel axis —
+                                 last kernel axis, grouped convs
+                                 included)
+        bias'  = beta - s * mean
+
+    so ``conv(x, W') + bias' == BN(conv(x, W))`` exactly (inference
+    BN). Returns a NEW params tree with every ``bn`` subtree removed
+    and conv biases added — load it into a ``fold_bn=True`` model;
+    ``batch_stats`` is consumed entirely. ``eps`` must match the
+    module convention (MobileNetV2 1e-3, ResNet 1e-5).
+    """
+    def walk(p, bs):
+        if not isinstance(p, dict):
+            return p
+        out = {}
+        for key, sub in p.items():
+            if (
+                key == "bn"
+                and "conv" in p
+                and isinstance(sub, dict)
+                and isinstance(bs, dict)
+                and "bn" in bs
+            ):
+                continue  # consumed by the sibling conv below
+            if (
+                key == "conv"
+                and "bn" in p
+                and isinstance(bs, dict)
+                and "bn" in bs
+            ):
+                gamma = p["bn"]["scale"].astype(jnp.float32)
+                beta = p["bn"]["bias"].astype(jnp.float32)
+                mean = bs["bn"]["mean"].astype(jnp.float32)
+                var = bs["bn"]["var"].astype(jnp.float32)
+                s = gamma / jnp.sqrt(var + eps)
+                kern = sub["kernel"]
+                out[key] = {
+                    "kernel": (kern.astype(jnp.float32) * s).astype(
+                        kern.dtype
+                    ),
+                    "bias": (beta - s * mean).astype(kern.dtype),
+                }
+            else:
+                out[key] = walk(
+                    sub, bs.get(key) if isinstance(bs, dict) else None
+                )
+        return out
+
+    return walk(params, batch_stats)

@@ -38,7 +38,7 @@ _STAGE_WIDTHS = (64, 128, 256, 512)
 
 
 def _cbn(features, kernel=(3, 3), strides=(1, 1), act=True,
-         dtype=jnp.bfloat16, name=None):
+         dtype=jnp.bfloat16, fold_bn=False, name=None):
     """ResNet-convention ConvBN: BN momentum 0.9 / eps 1e-5 (torch
     defaults), plain ReLU, symmetric k//2 padding."""
     k = kernel[0]
@@ -52,6 +52,7 @@ def _cbn(features, kernel=(3, 3), strides=(1, 1), act=True,
         momentum=0.9,
         epsilon=1e-5,
         padding=((k // 2, k // 2), (k // 2, k // 2)),
+        fold_bn=fold_bn,
         name=name,
     )
 
@@ -60,16 +61,18 @@ class BasicBlock(nn.Module):
     features: int
     strides: Tuple[int, int]
     dtype: Dtype = jnp.bfloat16
+    fold_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         y = _cbn(self.features, (3, 3), self.strides, dtype=self.dtype,
-                 name="conv1")(x, train)
+                 fold_bn=self.fold_bn, name="conv1")(x, train)
         y = _cbn(self.features, (3, 3), act=False, dtype=self.dtype,
-                 name="conv2")(y, train)
+                 fold_bn=self.fold_bn, name="conv2")(y, train)
         if self.strides != (1, 1) or x.shape[-1] != self.features:
             x = _cbn(self.features, (1, 1), self.strides, act=False,
-                     dtype=self.dtype, name="down")(x, train)
+                     dtype=self.dtype, fold_bn=self.fold_bn,
+                     name="down")(x, train)
         return nn.relu(x + y)
 
 
@@ -77,19 +80,22 @@ class Bottleneck(nn.Module):
     features: int  # output width (4x the inner width)
     strides: Tuple[int, int]
     dtype: Dtype = jnp.bfloat16
+    fold_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         inner = self.features // 4
-        y = _cbn(inner, (1, 1), dtype=self.dtype, name="conv1")(x, train)
+        y = _cbn(inner, (1, 1), dtype=self.dtype,
+                 fold_bn=self.fold_bn, name="conv1")(x, train)
         # v1.5: stride lives on the 3x3 (torchvision), not the first 1x1
         y = _cbn(inner, (3, 3), self.strides, dtype=self.dtype,
-                 name="conv2")(y, train)
+                 fold_bn=self.fold_bn, name="conv2")(y, train)
         y = _cbn(self.features, (1, 1), act=False, dtype=self.dtype,
-                 name="conv3")(y, train)
+                 fold_bn=self.fold_bn, name="conv3")(y, train)
         if self.strides != (1, 1) or x.shape[-1] != self.features:
             x = _cbn(self.features, (1, 1), self.strides, act=False,
-                     dtype=self.dtype, name="down")(x, train)
+                     dtype=self.dtype, fold_bn=self.fold_bn,
+                     name="down")(x, train)
         return nn.relu(x + y)
 
 
@@ -103,6 +109,7 @@ class ResNet(nn.Module):
 
     depth: int = 50
     dtype: Dtype = jnp.bfloat16
+    fold_bn: bool = False  # see ConvBN.fold_bn (inference-only)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -116,7 +123,7 @@ class ResNet(nn.Module):
 
         x = x.astype(self.dtype)
         x = _cbn(64, (7, 7), strides=(2, 2), dtype=self.dtype,
-                 name="stem")(x, train)
+                 fold_bn=self.fold_bn, name="stem")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for si, (w, n) in enumerate(zip(_STAGE_WIDTHS, repeats)):
             for bi in range(n):
@@ -125,6 +132,7 @@ class ResNet(nn.Module):
                     w * expansion,
                     strides=strides,
                     dtype=self.dtype,
+                    fold_bn=self.fold_bn,
                     name=f"stage{si}_block{bi}",
                 )(x, train)
         return x

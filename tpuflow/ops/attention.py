@@ -837,9 +837,22 @@ def flash_attention(
     if h_kv != h:
         bh_block = 1  # GQA: per-row b // group remap needs 1-row blocks
     else:
-        # largest divisor of batch·heads ≤ the request — any value is
-        # safe to sweep; exact grid cover, no bh padding
-        bh_block = min(int(bh_block), b * h)
+        # VMEM-aware cap first: every input/output block and all three
+        # f32 scratch buffers scale with G — an unbounded G=64 at
+        # 512-blocks/d=128 is a ~115 MB cell that Mosaic cannot place.
+        # Estimate per-row bytes (q+k+v+o double-buffered at the input
+        # itemsize, plus the largest kernel's scratch) against a 64 MB
+        # budget (half of v5e-class VMEM, headroom for Pallas overhead).
+        itemsize = jnp.dtype(q.dtype).itemsize
+        per_row = (
+            2 * (2 * block_q * d + 2 * block_k * d) * itemsize
+            + (2 * block_q * _LANES + block_q * d) * 4  # fwd m/l/acc
+            + 2 * block_k * d * 4  # dkv dk/dv accumulators
+        )
+        vmem_cap = max(1, (64 << 20) // per_row)
+        # then the largest divisor of batch·heads ≤ the request — any
+        # value is safe to sweep; exact grid cover, no bh padding
+        bh_block = min(int(bh_block), b * h, vmem_cap)
         while (b * h) % bh_block:
             bh_block -= 1
     cfg = _Cfg(
