@@ -219,3 +219,19 @@ def test_tp_sharded_generation_matches_unsharded():
     )
     got = np.asarray(generate(lm, sharded, prompt, max_new_tokens=8))
     np.testing.assert_array_equal(got, ref)
+
+
+def test_sampled_rows_invariant_to_pad_rows():
+    """Per-row RNG (fold_in by row index): a prompt's sampled
+    continuation depends only on (seed, step, its row index) — never on
+    how many pad rows follow it in the batch. packaging/lm.py pads
+    length-buckets with copies of row 0; ADVICE r04 flagged that a
+    batch-shaped draw made the same prompt+seed sample differently per
+    bucket size."""
+    m = _tiny_lm()
+    params = _params(m, seed=5)
+    p2 = jnp.asarray([[1, 2], [7, 8]], jnp.int32)
+    p4 = jnp.concatenate([p2, p2[:1], p2[:1]])  # two pad copies of row 0
+    a = generate(m, params, p2, 6, temperature=1.0, top_k=5, seed=42)
+    b = generate(m, params, p4, 6, temperature=1.0, top_k=5, seed=42)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[:2])

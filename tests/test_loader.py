@@ -296,4 +296,7 @@ def test_corrupt_rows_substituted_not_zero_trained(tmp_path):
                 # no all-zero images ever reach training
                 assert (b["image"].reshape(len(b["label"]), -1).sum(1)
                         > 0).all()
-        assert ds.decode_failures == 2  # once per epoch
+        assert ds.decode_failures == 2  # occurrences: once per epoch
+        # headline metric: ONE distinct corrupt file (cache mode only —
+        # streaming has no row identity to dedupe on)
+        assert ds.unique_decode_failures == (1 if cache else None)

@@ -490,3 +490,19 @@ def test_bh_block_return_lse():
                                bh_block=4, return_lse=True)
     np.testing.assert_array_equal(np.asarray(o4), np.asarray(o1))
     np.testing.assert_array_equal(np.asarray(lse4), np.asarray(lse1))
+
+
+def test_traced_scale_raises_clear_typeerror():
+    """scale is a STATIC argument (baked into kernel config / custom
+    vjp); a traced value must fail with the contract error, not jax's
+    ConcretizationTypeError from deep inside float() (ADVICE r04)."""
+    q = jnp.zeros((1, 1, 16, 8))
+
+    for op in (
+        lambda s: mha_xla(q, q, q, scale=s),
+        lambda s: flash_attention(q, q, q, scale=s, block_q=8, block_k=8),
+    ):
+        with pytest.raises(TypeError, match="static Python number"):
+            jax.jit(op)(jnp.float32(0.35))
+        # concrete numbers (incl. numpy scalars) keep working
+        op(np.float32(0.35))

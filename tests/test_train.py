@@ -474,3 +474,25 @@ def test_lm_trainer_cosine_decay_wires_through(tmp_path):
     c = tr.lr_controller
     assert c.decay == "cosine" and c.total_steps == 3 and c.min_lr == 1e-5
     assert c.lr_for_step(3) == 1e-5  # fully annealed at run end
+
+
+def test_cosine_warmup_longer_than_run_clamps_with_warning():
+    """warmup_epochs=5 (the default) on a 3-epoch cosine run must not
+    be a hard fit()-time failure — the controller clamps warmup to the
+    run length and warns (ADVICE r04)."""
+    import warnings
+
+    from tpuflow.train.lr import LRController
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        c = LRController(1e-3, world_size=4, warmup_epochs=5,
+                         steps_per_epoch=10, decay="cosine",
+                         total_steps=30, min_lr=1e-5)
+    assert any("clamping warmup" in str(x.message) for x in w)
+    assert c.warmup_steps == 29  # as much of the requested ramp as fits
+    assert c.lr_for_step(0) < c.lr_for_step(28)  # warmup still ramps
+    # step 29 is the anneal's p=0 point (peak LR); past the run the
+    # curve lands on min_lr — the schedule is well-formed end to end
+    assert abs(c.lr_for_step(29) - c.target_lr) < 1e-12
+    assert abs(c.lr_for_step(30) - 1e-5) < 1e-9

@@ -153,9 +153,11 @@ class Dataset:
         # observability for the bounded-memory guarantee (tests)
         self.peak_buffered_rows = 0
         self.decode_calls = 0  # rows actually sent to the native decoder
-        # corrupt-row occurrences seen (each substituted by a valid row
-        # of the same batch — see _substitute_failures); cache mode
-        # remembers failed row indices so later epochs substitute too
+        # corrupt-row OCCURRENCES seen (each substituted by a valid row
+        # of the same batch — see _substitute_failures). In cache_decoded
+        # mode remembered bad rows re-count EVERY epoch (the counter is
+        # per-substitution, not per-file) — read unique_decode_failures
+        # for the number of distinct corrupt files
         self.decode_failures = 0
         self._decode_failed: set = set()
 
@@ -396,6 +398,16 @@ class Dataset:
             if i in self._decode_failed:
                 ok[j] = 0
         return images, ok
+
+    @property
+    def unique_decode_failures(self) -> Optional[int]:
+        """Number of DISTINCT corrupt source rows seen — the headline
+        corruption metric (``decode_failures`` counts substitution
+        occurrences, which re-count remembered rows every epoch in
+        cache_decoded mode). ``None`` when ``cache_decoded=False``:
+        streaming decode has no row-identity memory, so uniqueness is
+        unknowable there."""
+        return len(self._decode_failed) if self.cache_decoded else None
 
     def _substitute_failures(self, images, labels, ok) -> None:
         """Replace corrupt rows (ok=0) with a valid row of the SAME

@@ -60,7 +60,18 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
         keep = jnp.put_along_axis(keep, idx, keep_sorted, axis=-1,
                                   inplace=False)
         logits = jnp.where(keep, logits, -1e30)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    # per-ROW keys (fold_in by row index): row i's randomness depends
+    # only on (seed, step, i), never on the batch SHAPE — so a prompt's
+    # sampled continuation is invariant to how many other prompts share
+    # its batch (packaging/lm.py pads length-buckets with copies of row
+    # 0; a single batch-shaped categorical draw would give different
+    # outputs for the same prompt+seed depending on the pad count)
+    keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+        jnp.arange(logits.shape[0])
+    )
+    return jax.vmap(
+        lambda lg, k: jax.random.categorical(k, lg)
+    )(logits, keys).astype(jnp.int32)
 
 
 def generate(

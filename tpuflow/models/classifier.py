@@ -137,7 +137,18 @@ def fold_backbone_variables(variables: Dict, backbone: str = "mobilenet_v2",
     """
     from tpuflow.models.mobilenet_v2 import fold_bn_params
 
-    eps = 1e-3 if backbone == "mobilenet_v2" else 1e-5
+    if backbone == "mobilenet_v2":
+        eps = 1e-3
+    elif backbone in ("resnet18", "resnet34", "resnet50"):
+        eps = 1e-5
+    else:
+        # eps selection is numerics-critical (a wrong convention folds
+        # silently-wrong weights for small running vars) — never guess
+        raise ValueError(
+            f"unknown backbone {backbone!r}; expected 'mobilenet_v2', "
+            "'resnet18', 'resnet34', or 'resnet50' (BN eps convention "
+            "differs: 1e-3 vs 1e-5)"
+        )
     params = dict(variables["params"])
     stats = variables.get("batch_stats", {})
     if not stats.get(BACKBONE):

@@ -102,6 +102,25 @@ def _vma(*xs):
     return out
 
 
+def _static_scale(scale, head_dim: int) -> float:
+    """Resolve the softmax scale to a STATIC Python float. Both
+    attention impls carry scale as a nondiff/static argument (it bakes
+    into the kernel config / custom-vjp closure), so a traced jnp
+    scalar cannot flow here — fail with a clear contract error instead
+    of jax's ConcretizationTypeError deep in float()."""
+    if scale is None:
+        return head_dim ** -0.5
+    try:
+        return float(scale)
+    except Exception as e:
+        raise TypeError(
+            "scale must be a static Python number (it is a non-"
+            "differentiable static argument baked into the attention "
+            "config); got a traced/abstract value — hoist it out of "
+            "jit or pass a concrete float"
+        ) from e
+
+
 def pick_attn_impl(seq_len: int, requested: str = "auto") -> str:
     """Resolve an ``attn_impl`` request. ``'auto'`` chooses ``'flash'``
     on a TPU backend once the sequence is long enough that avoiding the
@@ -241,7 +260,7 @@ def mha_xla(q, k, v, causal: bool = False, scale: Optional[float] = None,
             f"segment_ids must be (batch, seq)={q.shape[0], q.shape[2]}, "
             f"got {segment_ids.shape}"
         )
-    scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
+    scale = _static_scale(scale, q.shape[-1])
     return _mha_xla_core(q, k, v, segment_ids, causal, scale, window)
 
 
@@ -829,7 +848,7 @@ def flash_attention(
         from tpuflow.core.hw import is_tpu_backend
 
         interpret = not is_tpu_backend()
-    scale = float(scale) if scale is not None else d**-0.5
+    scale = _static_scale(scale, d)
     block_q = min(block_q, max(8, sq))
     block_k = min(block_k, max(8, skv))
     if bh_block < 1:

@@ -44,6 +44,7 @@ from tpuflow.ops.attention import (
     _bwd_ref,
     _fwd,
     _fwd_ref,
+    _static_scale,
 )
 
 
@@ -297,7 +298,7 @@ def ring_attention(
         axis_name=axis_name,
         n=n,
         causal=causal,
-        scale=float(scale) if scale is not None else d**-0.5,
+        scale=_static_scale(scale, d),
         block_q=block_q,
         block_k=block_k,
         s_valid=s,

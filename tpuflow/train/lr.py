@@ -36,17 +36,31 @@ class LRController:
     ):
         if decay not in ("none", "cosine"):
             raise ValueError(f"decay must be 'none' or 'cosine', got {decay!r}")
-        if decay == "cosine" and total_steps <= max(
-            0, int(warmup_epochs) * int(steps_per_epoch)
-        ):
-            raise ValueError(
-                f"decay='cosine' needs total_steps ({total_steps}) > "
-                f"warmup steps ({warmup_epochs}x{steps_per_epoch}) — "
-                "the requested anneal would otherwise silently never run"
-            )
         self.base_lr = float(base_lr)
         self.target_lr = float(base_lr) * (world_size if scale_by_world_size else 1)
         self.warmup_steps = max(0, int(warmup_epochs) * int(steps_per_epoch))
+        if decay == "cosine" and total_steps <= 0:
+            # an unset/zero horizon is a programming error (the anneal
+            # has no endpoint), not a config-knob combination — fail
+            raise ValueError(
+                f"decay='cosine' requires total_steps > 0, got "
+                f"{total_steps}"
+            )
+        if decay == "cosine" and total_steps <= self.warmup_steps:
+            # e.g. the default warmup_epochs=5 on a 3-epoch run: a hard
+            # error here would fail a config-knob combination at fit()
+            # time, after data prep — clamp so the anneal still runs
+            # over the post-warmup remainder and say so
+            import warnings
+
+            clamped = int(total_steps) - 1
+            warnings.warn(
+                f"decay='cosine' with warmup steps ({self.warmup_steps}) "
+                f">= total_steps ({total_steps}): clamping warmup to "
+                f"{clamped} steps so the anneal runs",
+                stacklevel=2,
+            )
+            self.warmup_steps = clamped
         self.plateau_factor = 1.0
         self.decay = decay
         self.total_steps = int(total_steps)
