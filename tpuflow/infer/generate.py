@@ -60,12 +60,17 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
         keep = jnp.put_along_axis(keep, idx, keep_sorted, axis=-1,
                                   inplace=False)
         logits = jnp.where(keep, logits, -1e30)
-    # per-ROW keys (fold_in by row index): row i's randomness depends
+    # per-ROW keys (fold_in by row index): row i's RANDOMNESS depends
     # only on (seed, step, i), never on the batch SHAPE — so a prompt's
-    # sampled continuation is invariant to how many other prompts share
-    # its batch (packaging/lm.py pads length-buckets with copies of row
+    # sampled continuation no longer varies with pad-row count through
+    # the RNG (packaging/lm.py pads length-buckets with copies of row
     # 0; a single batch-shaped categorical draw would give different
-    # outputs for the same prompt+seed depending on the pad count)
+    # outputs for the same prompt+seed depending on the pad count).
+    # Caveat: the LOGITS themselves are only batch-shape-invariant up
+    # to the backend's reduction order — an ulp-level logit difference
+    # near a probability boundary can still flip a draw on some
+    # backends; the guarantee here is RNG invariance, not bitwise
+    # forward-pass invariance
     keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
         jnp.arange(logits.shape[0])
     )

@@ -25,6 +25,17 @@ from tpuflow.models.resnet import ResNet
 
 BACKBONE = "backbone"
 
+# the supported backbones and their BN epsilon conventions — ONE list
+# for both model construction and checkpoint folding (eps is
+# numerics-critical: folding with the wrong convention silently skews
+# small-variance channels by ~sqrt(eps_a/eps_b))
+BACKBONE_BN_EPS = {
+    "mobilenet_v2": 1e-3,  # Keras/MobileNet convention
+    "resnet18": 1e-5,  # torch convention
+    "resnet34": 1e-5,
+    "resnet50": 1e-5,
+}
+
 
 class TransferClassifier(nn.Module):
     num_classes: int = 5
@@ -66,13 +77,13 @@ class TransferClassifier(nn.Module):
         if self.backbone == "mobilenet_v2":
             bb = MobileNetV2(self.width_mult, dtype=self.dtype,
                              fold_bn=self.fold_bn, name=BACKBONE)
-        elif self.backbone in ("resnet18", "resnet34", "resnet50"):
+        elif self.backbone in BACKBONE_BN_EPS:
             bb = ResNet(int(self.backbone[len("resnet"):]), dtype=self.dtype,
                         fold_bn=self.fold_bn, name=BACKBONE)
         else:
             raise ValueError(
-                f"unknown backbone {self.backbone!r}; expected "
-                "'mobilenet_v2', 'resnet18', 'resnet34', or 'resnet50'"
+                f"unknown backbone {self.backbone!r}; expected one of "
+                f"{sorted(BACKBONE_BN_EPS)}"
             )
         feats = bb(x, train=bb_train)
         x = jnp.mean(feats, axis=(1, 2))  # GlobalAveragePooling2D
@@ -137,17 +148,13 @@ def fold_backbone_variables(variables: Dict, backbone: str = "mobilenet_v2",
     """
     from tpuflow.models.mobilenet_v2 import fold_bn_params
 
-    if backbone == "mobilenet_v2":
-        eps = 1e-3
-    elif backbone in ("resnet18", "resnet34", "resnet50"):
-        eps = 1e-5
-    else:
+    eps = BACKBONE_BN_EPS.get(backbone)
+    if eps is None:
         # eps selection is numerics-critical (a wrong convention folds
         # silently-wrong weights for small running vars) — never guess
         raise ValueError(
-            f"unknown backbone {backbone!r}; expected 'mobilenet_v2', "
-            "'resnet18', 'resnet34', or 'resnet50' (BN eps convention "
-            "differs: 1e-3 vs 1e-5)"
+            f"unknown backbone {backbone!r}; expected one of "
+            f"{sorted(BACKBONE_BN_EPS)} (BN eps conventions differ)"
         )
     params = dict(variables["params"])
     stats = variables.get("batch_stats", {})

@@ -112,7 +112,11 @@ def _static_scale(scale, head_dim: int) -> float:
         return head_dim ** -0.5
     try:
         return float(scale)
-    except Exception as e:
+    except (jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError) as e:
+        # only the concreteness failures get the contract message —
+        # a genuinely malformed scale (multi-element array, a string)
+        # surfaces as its own TypeError/ValueError undisturbed
         raise TypeError(
             "scale must be a static Python number (it is a non-"
             "differentiable static argument baked into the attention "

@@ -183,11 +183,13 @@ class PackagedLM:
         makes group sizes vary per chunk and the same prompt length
         recompiles repeatedly (ADVICE r03). Output order matches input
         order. Sampling (temperature > 0) draws per-ROW keys folded by
-        row index (infer/generate._sample), so a row's output is
-        INVARIANT to the pad rows appended after it — but a prompt's
-        row index within its length group still depends on which other
-        prompts share that length, so sampled outputs can differ from
-        a one-at-a-time loop (greedy output is identical either way)."""
+        row index (infer/generate._sample), so a row's RNG stream is
+        independent of the pad rows appended after it (logit-level
+        numerics can still vary with batch shape on some backends) —
+        and a prompt's row index within its length group depends on
+        which other prompts share that length, so sampled outputs can
+        differ from a one-at-a-time loop (greedy output is identical
+        either way)."""
         tok = self._require_tokenizer()
         eos = kwargs.get("eos_id", self.generate_defaults.get("eos_id"))
         encoded = [np.asarray(tok.encode(p), np.int32) for p in prompts]

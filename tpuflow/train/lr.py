@@ -49,15 +49,18 @@ class LRController:
         if decay == "cosine" and total_steps <= self.warmup_steps:
             # e.g. the default warmup_epochs=5 on a 3-epoch run: a hard
             # error here would fail a config-knob combination at fit()
-            # time, after data prep — clamp so the anneal still runs
-            # over the post-warmup remainder and say so
+            # time, after data prep. Clamp warmup to HALF the run so a
+            # real anneal window remains (total_steps - 1 would leave
+            # the anneal's p=0 point as the final step — peak LR on
+            # every executed step, decay='none' in effect)
             import warnings
 
-            clamped = int(total_steps) - 1
+            clamped = int(total_steps) // 2
             warnings.warn(
                 f"decay='cosine' with warmup steps ({self.warmup_steps}) "
                 f">= total_steps ({total_steps}): clamping warmup to "
-                f"{clamped} steps so the anneal runs",
+                f"{clamped} steps so the anneal runs over the second "
+                "half of the run",
                 stacklevel=2,
             )
             self.warmup_steps = clamped
