@@ -184,3 +184,32 @@ def test_smoke_end2end_emits_schema():
     assert rec["metric"] == "train_images_per_sec_per_chip_e2e"
     assert rec["value"] > 0
     assert "error" not in rec
+
+
+def test_hlo_fusion_census_on_uint8_conv():
+    """The uint8-fusion audit helper (round-5 CNN lever #3) parses a
+    real optimized-HLO text: a jitted uint8→normalize→conv graph must
+    yield a census that sees both the u8 convert and the convolution
+    (fusion structure itself is backend-specific — no fused/unfused
+    assertion here, just that the parse finds the ingredients)."""
+    import bench
+
+    import jax
+    import jax.numpy as jnp
+
+    def step(x, w):
+        xf = x.astype(jnp.float32) / 127.5 - 1.0
+        return jax.lax.conv_general_dilated(
+            xf, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ).sum()
+
+    x = jnp.zeros((2, 16, 16, 3), jnp.uint8)
+    w = jnp.zeros((3, 3, 3, 8), jnp.float32)
+    txt = jax.jit(step).lower(x, w).compile().as_text()
+    census = bench._hlo_fusion_census(txt)
+    assert census["computations"] > 0
+    assert census["conv_computations"] >= 1
+    # the u8 convert exists SOMEWHERE (fused or standalone)
+    assert (census["u8_convert_fused_with_conv"]
+            or census["standalone_u8_convert_computations"] >= 1), census
