@@ -1389,15 +1389,18 @@ def _hlo_fusion_census(txt: str) -> dict:
 
     blocks: dict = {}
     cur = None
+    entry = None
     for line in txt.splitlines():
         # greedy (.*) over the param list: tuple-typed params (while/
         # conditional bodies) nest parens that a [^)]* would stop at,
         # silently dropping those computations from the census
-        m = re.match(r"\s*(?:ENTRY\s+)?%?([\w.\-]+)\s+\(.*\)\s*->.*\{",
+        m = re.match(r"\s*(ENTRY\s+)?%?([\w.\-]+)\s+\(.*\)\s*->.*\{",
                      line)
         if m:
-            cur = m.group(1)
+            cur = m.group(2)
             blocks[cur] = []
+            if m.group(1):
+                entry = cur
         elif cur is not None:
             if line.strip().startswith("}"):
                 cur = None
@@ -1406,7 +1409,11 @@ def _hlo_fusion_census(txt: str) -> dict:
     # HLO instruction operands are referenced by NAME (the u8 type
     # shows on the parameter/producer line, not the convert line) — a
     # computation "converts u8" when it holds u8-typed values AND a
-    # convert op
+    # convert op. ENTRY is excluded from the fused-with-conv bit: it
+    # always holds the u8 image PARAMETER, and on backends that keep
+    # convolutions top-level (XLA:CPU) any stray unfused convert there
+    # would make the intersection spuriously true — ENTRY co-residency
+    # is not fusion
     u8_convert = {
         n for n, ls in blocks.items()
         if any("u8[" in l for l in ls) and any(" convert(" in l for l in ls)
@@ -1415,12 +1422,17 @@ def _hlo_fusion_census(txt: str) -> dict:
         n for n, ls in blocks.items()
         if any("convolution" in l for l in ls)
     }
+    fused = (u8_convert & conv) - {entry}
     return {
         "computations": len(blocks),
-        "u8_convert_computations": sorted(u8_convert)[:8],
+        "u8_convert_computations": sorted(u8_convert - {entry})[:8],
         "conv_computations": len(conv),
-        "u8_convert_fused_with_conv": bool(u8_convert & conv),
-        "standalone_u8_convert_computations": len(u8_convert - conv),
+        "u8_convert_fused_with_conv": bool(fused),
+        "standalone_u8_convert_computations": len(
+            u8_convert - conv - {entry}
+        ),
+        "u8_convert_in_entry": entry in u8_convert,
+        "conv_in_entry": entry in conv,
     }
 
 

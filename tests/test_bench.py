@@ -210,6 +210,8 @@ def test_hlo_fusion_census_on_uint8_conv():
     census = bench._hlo_fusion_census(txt)
     assert census["computations"] > 0
     assert census["conv_computations"] >= 1
-    # the u8 convert exists SOMEWHERE (fused or standalone)
+    # the u8 convert exists SOMEWHERE (fused computation, standalone
+    # computation, or top-level in ENTRY — backend-dependent)
     assert (census["u8_convert_fused_with_conv"]
-            or census["standalone_u8_convert_computations"] >= 1), census
+            or census["standalone_u8_convert_computations"] >= 1
+            or census["u8_convert_in_entry"]), census

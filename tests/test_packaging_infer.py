@@ -200,3 +200,34 @@ def test_generate_table_sharded_text_inference(tmp_path):
     # a non-LM model object is rejected loudly
     with pytest.raises(TypeError, match="PackagedLM"):
         generate_table(object(), t)
+
+
+def test_fold_bn_serving_parity(tmp_path, packaged_dir):
+    """Serving-time BN folding (r05): a REAL transfer classifier
+    packaged unfolded, loaded with fold_bn=True — the folded serving
+    graph predicts the same logits (disk format stays canonical
+    unfolded; folding happens at load)."""
+    from tpuflow.models import build_model
+
+    m = build_model(num_classes=3, dropout=0.0, width_mult=0.25)
+    v = m.init({"params": jax.random.key(0)}, jnp.zeros((1, 16, 16, 3)),
+               train=False)
+    d = str(tmp_path / "pkg_fold")
+    save_packaged_model(
+        d, jax.device_get(nn.unbox(v)["params"]),
+        jax.device_get(v["batch_stats"]), CLASSES,
+        img_height=16, img_width=16,
+        model_config={"num_classes": 3, "width_mult": 0.25,
+                      "dropout": 0.0},
+    )
+    blobs = [_jpeg((255, 0, 0)), _jpeg((0, 255, 0)), _jpeg((12, 200, 99))]
+    lo_ref = PackagedModel(d).predict_logits(blobs)
+    folded = load_packaged_model(d, fold_bn=True)
+    # the folded serving graph carries no batch_stats at all
+    assert "batch_stats" not in folded.variables
+    lo_fold = folded.predict_logits(blobs)
+    np.testing.assert_allclose(lo_fold, lo_ref, atol=5e-2, rtol=5e-2)
+    assert folded.predict(blobs) == PackagedModel(d).predict(blobs)
+    # non-CNN families refuse clearly (the tiny_test fixture package)
+    with pytest.raises(ValueError, match="transfer_classifier"):
+        PackagedModel(packaged_dir, fold_bn=True)

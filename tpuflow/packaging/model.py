@@ -56,6 +56,7 @@ def _default_builders():
                 width_mult=cfg.get("width_mult", 1.0),
                 freeze_backbone=cfg.get("freeze_backbone", True),
                 backbone=cfg.get("backbone", "mobilenet_v2"),
+                fold_bn=cfg.get("fold_bn", False),
             ),
         )
 
@@ -101,9 +102,16 @@ def save_packaged_model(
 
 
 class PackagedModel:
-    """Loaded packaged model: JPEG bytes → class-name strings."""
+    """Loaded packaged model: JPEG bytes → class-name strings.
 
-    def __init__(self, path: str):
+    ``fold_bn=True`` (serving-time BN folding, r05): the backbone's
+    BatchNorms fold into their convs AT LOAD — packaged weights stay
+    in the canonical unfolded format on disk, every BN layer leaves
+    the serving graph (tpuflow.models.classifier.fold_backbone_
+    variables; inference is exactly where folding is always valid).
+    transfer_classifier only."""
+
+    def __init__(self, path: str, fold_bn: bool = False):
         # ≙ FlowerPyFunc.load_context (P2/03:161-184)
         from flax import serialization
 
@@ -112,14 +120,33 @@ class PackagedModel:
         if self.meta.get("format_version", 0) > _FORMAT_VERSION:
             raise ValueError("packaged model from a newer format version")
         _default_builders()
+        cfg = self.meta["model_config"]
+        if fold_bn:
+            if self.meta["model_type"] != "transfer_classifier":
+                raise ValueError(
+                    "fold_bn serving is only defined for the "
+                    "transfer_classifier family (the CNN backbones)"
+                )
+            # the folded module: BN gone; freeze flag irrelevant at
+            # inference (train=False) but the module guard requires it
+            cfg = dict(cfg, fold_bn=True, freeze_backbone=True)
         builder = _MODEL_BUILDERS[self.meta["model_type"]]
-        self.model = builder(self.meta["model_config"])
+        self.model = builder(cfg)
         with open(os.path.join(path, "weights.msgpack"), "rb") as f:
             payload = serialization.msgpack_restore(f.read())
         self.variables = {
             "params": payload["params"],
             "batch_stats": payload.get("batch_stats", {}),
         }
+        if fold_bn:
+            from tpuflow.models.classifier import fold_backbone_variables
+
+            self.variables = fold_backbone_variables(
+                self.variables,
+                backbone=self.meta["model_config"].get(
+                    "backbone", "mobilenet_v2"
+                ),
+            )
         self.classes: List[str] = self.meta["classes"]
         ip = self.meta["img_params"]
         self.img_height, self.img_width = ip["img_height"], ip["img_width"]
@@ -189,10 +216,12 @@ class PackagedModel:
 
 
 def load_packaged_model(
-    uri_or_path: str, store=None, registry=None
+    uri_or_path: str, store=None, registry=None, fold_bn: bool = False
 ) -> PackagedModel:
     """Load by path, ``runs:/...`` or ``models:/...`` URI
-    (≙ mlflow.pyfunc.load_model, P2/03:446)."""
+    (≙ mlflow.pyfunc.load_model, P2/03:446). ``fold_bn=True`` folds
+    the backbone's BatchNorms into the convs at load (serving-time
+    folding — see PackagedModel)."""
     path = uri_or_path
     if uri_or_path.startswith("models:/"):
         if registry is None:
@@ -202,4 +231,4 @@ def load_packaged_model(
         if store is None:
             raise ValueError("runs:/ uri needs a tracking store")
         path = store.resolve_uri(uri_or_path)
-    return PackagedModel(path)
+    return PackagedModel(path, fold_bn=fold_bn)
