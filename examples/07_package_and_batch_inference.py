@@ -39,8 +39,11 @@ def main(workdir: str) -> None:
     print(f"packaged model at {result['model_uri']} "
           f"(val_acc={result['val_accuracy']:.4f})")
 
-    # single-host smoke inference (≙ load_model + predict, P2/03:446-450)
-    model = load_packaged_model(result["model_uri"], store=tracking)
+    # single-host smoke inference (≙ load_model + predict, P2/03:446-450).
+    # fold_bn=True folds the backbone's BatchNorms into the convs at
+    # load — the serving-time lever (weights stay canonical on disk)
+    model = load_packaged_model(result["model_uri"], store=tracking,
+                                fold_bn=True)
     sample = val_t.read(columns=["content", "label"]).slice(0, 10)
     preds = model.predict(sample.column("content").to_pylist())
     for label, pred in zip(sample.column("label").to_pylist(), preds):
