@@ -1,7 +1,9 @@
 from tpuflow.ckpt.checkpoint import (  # noqa: F401
     latest_checkpoint,
+    latest_resume_point,
     list_checkpoints,
     restore_checkpoint,
     restore_into_state,
     save_checkpoint,
+    save_step_checkpoint,
 )

@@ -118,8 +118,6 @@ def save_checkpoint(
     replicated/addressable state (the Trainer default) non-primary
     processes may skip the call entirely — there is no collective.
     """
-    from tpuflow.core.dist import is_primary
-
     if weights_only:
         payload = {
             "params": _host_fetch(state.params),
@@ -127,16 +125,82 @@ def save_checkpoint(
         }
     else:
         payload = _host_fetch(serialization.to_state_dict(_unkey(state)))
-    path = _path(checkpoint_dir, step)
+    return _atomic_save(checkpoint_dir, _path(checkpoint_dir, step), payload)
+
+
+_STEP_PAT = re.compile(r"checkpoint-step-(\d+)\.ckpt$")
+
+
+def _atomic_save(checkpoint_dir: str, path: str, payload: Any) -> str:
+    """Rank-0 atomic write shared by both checkpoint namespaces:
+    serialize → tempfile in the target dir → os.replace; the tempfile
+    is unlinked on any failure so aborted writes never litter the
+    checkpoint dir."""
+    from tpuflow.core.dist import is_primary
+
     if not is_primary():
         return path
     os.makedirs(checkpoint_dir, exist_ok=True)
     data = serialization.msgpack_serialize(payload)
     fd, tmp = tempfile.mkstemp(dir=checkpoint_dir, suffix=".tmp")
-    with os.fdopen(fd, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return path
+
+
+def save_step_checkpoint(checkpoint_dir: str, state: Any,
+                         global_step: int) -> str:
+    """Mid-epoch (preemption) checkpoint: ``checkpoint-step-{N}.ckpt``
+    where N is the GLOBAL step count — disjoint from the epoch-boundary
+    ``checkpoint-{epoch}.ckpt`` namespace (the reference's layout,
+    P2/02:206-211), so epoch-granular consumers never misread one.
+    Same atomic write + rank-0 discipline as :func:`save_checkpoint`;
+    always the full TrainState (exact resume is the whole point of a
+    preemption save)."""
+    payload = _host_fetch(serialization.to_state_dict(_unkey(state)))
+    return _atomic_save(
+        checkpoint_dir,
+        os.path.join(checkpoint_dir, f"checkpoint-step-{global_step}.ckpt"),
+        payload,
+    )
+
+
+def latest_resume_point(checkpoint_dir: str, steps_per_epoch: int
+                        ) -> Optional[tuple]:
+    """Newest checkpoint across BOTH namespaces, compared in global-
+    step units (epoch ckpt N ≙ step N·steps_per_epoch; ties prefer the
+    epoch file — a clean boundary). Returns ``(path, epoch,
+    skip_steps)`` where ``skip_steps`` is the position within epoch
+    ``epoch`` the stream must fast-forward to, or None when the
+    directory holds nothing."""
+    best = None  # (effective_step, is_step_ckpt, path)
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    for fn in os.listdir(checkpoint_dir):
+        m = _PAT.search(fn)
+        ms = _STEP_PAT.search(fn)
+        if ms:
+            cand = (int(ms.group(1)), 1, os.path.join(checkpoint_dir, fn))
+        elif m:
+            cand = (int(m.group(1)) * steps_per_epoch, 0,
+                    os.path.join(checkpoint_dir, fn))
+        else:
+            continue
+        # prefer higher step; at equal step prefer the epoch file
+        if best is None or (cand[0], -cand[1]) > (best[0], -best[1]):
+            best = cand
+    if best is None:
+        return None
+    step, _is_step, path = best
+    return path, step // steps_per_epoch, step % steps_per_epoch
 
 
 def list_checkpoints(checkpoint_dir: str) -> List[str]:

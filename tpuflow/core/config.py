@@ -103,6 +103,16 @@ class TrainConfig:
     reduce_on_plateau_factor: float = 0.1
     early_stopping_patience: Optional[int] = None  # ≙ EarlyStopping, P2/03:397-401
     checkpoint_dir: Optional[str] = None
+    # preemption-safe training (TPU pods are preemptible; the reference
+    # has no analogue): on SIGTERM the Trainer finishes the CURRENT
+    # step, writes a step-granular checkpoint-step-{N}.ckpt (atomic,
+    # rank-0), and stops cleanly; maybe_resume(steps_per_epoch=...)
+    # restores it EXACTLY — same epoch, same position in the stream
+    # (fit fast-forwards the skipped batches). Requires checkpoint_dir.
+    # SINGLE-PROCESS only for now: a per-process stop flag would break
+    # the identical-collective-schedule invariant; multi-process runs
+    # warn and keep gang-restart semantics (--restarts + epoch ckpts).
+    checkpoint_on_preempt: bool = False
     # >0: every N epochs assert replicas/processes hold identical state
     # and params are finite (tpuflow.core.debug — the checkable form of
     # the broadcast-init invariant, P1/03:305-308)

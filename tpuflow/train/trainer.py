@@ -275,7 +275,8 @@ class Trainer:
 
     # ---- fit/evaluate ----------------------------------------------------
 
-    def maybe_resume(self, checkpoint_dir: Optional[str] = None) -> int:
+    def maybe_resume(self, checkpoint_dir: Optional[str] = None,
+                     steps_per_epoch: Optional[int] = None) -> int:
         """Restore the newest checkpoint in ``checkpoint_dir`` (default:
         cfg.checkpoint_dir) into ``self.state`` and return the epoch to
         continue from — 0 when there is nothing to resume.
@@ -287,14 +288,35 @@ class Trainer:
         number (``checkpoint-{n}.ckpt``, the reference's layout at
         P2/02:206-211) is the count of COMPLETED epochs — which is
         exactly the next 0-based epoch index.
+
+        With ``steps_per_epoch``, mid-epoch PREEMPTION checkpoints
+        (``checkpoint-step-{N}.ckpt``, cfg.checkpoint_on_preempt) are
+        also considered, compared in global-step units; when one is
+        newest, the position within the epoch is stashed as
+        ``self._resume_skip_steps`` and the next ``fit`` call
+        fast-forwards the stream by that many batches — EXACT resume.
+        Without ``steps_per_epoch``, step checkpoints are ignored
+        (epoch-boundary semantics, as before).
         """
         import re
 
-        from tpuflow.ckpt import latest_checkpoint, restore_into_state
+        from tpuflow.ckpt import (latest_checkpoint, latest_resume_point,
+                                  restore_into_state)
 
         ckdir = checkpoint_dir or self.cfg.checkpoint_dir
+        self._resume_skip_steps = 0
         if not ckdir:
             return 0
+        if steps_per_epoch is not None:
+            found = latest_resume_point(ckdir, int(steps_per_epoch))
+            if found is None:
+                return 0
+            path, epoch, skip = found
+            if self.state is None:
+                raise RuntimeError("call init_state() before maybe_resume()")
+            self.state = restore_into_state(path, self.state)
+            self._resume_skip_steps = skip
+            return epoch
         path = latest_checkpoint(ckdir)
         if path is None:
             return 0
@@ -353,39 +375,118 @@ class Trainer:
         for cb in cbs:
             cb.on_train_begin()
 
-        train_iter = self._prefetch(iter(train_ds))
-        global_step = initial_epoch * steps_per_epoch
-        lr = self.lr_controller.lr_for_step(global_step)
-        exhausted = False
-        for epoch in range(initial_epoch, epochs):
-            step_metrics = []
-            for _ in range(steps_per_epoch):
-                lr = self.lr_controller.lr_for_step(global_step)
-                try:
-                    images, labels = next(train_iter)
-                except StopIteration:
-                    # finite (non-infinite) stream ran dry: end training
-                    # cleanly after this partial epoch (Keras semantics)
-                    exhausted = True
-                    break
-                self.state, m = self._train_step(
-                    self.state, images, labels, jnp.asarray(lr, jnp.float32)
+        # preemption-safe mode (cfg.checkpoint_on_preempt): SIGTERM
+        # sets a flag; the step loop finishes the CURRENT step, writes
+        # a step-granular checkpoint, and stops cleanly. The handler
+        # only flips the flag — all device/filesystem work happens in
+        # loop context. Installed only from the main thread (signal
+        # module restriction); restored on exit.
+        preempt = {"hit": False}
+        old_handler = None
+        use_preempt = bool(
+            self.cfg.checkpoint_on_preempt and self.cfg.checkpoint_dir
+        )
+        if use_preempt and jax.process_count() > 1:
+            # a per-process flag would break the identical-collective-
+            # schedule invariant (processes stopping at different steps
+            # → mismatched pmeans → deadlock); until a synchronized
+            # agreement step exists, multi-process preemption stays at
+            # GANG granularity: launcher --restarts + epoch checkpoints
+            # (tests/test_multiproc_killresume.py proves that path)
+            import warnings
+
+            warnings.warn(
+                "checkpoint_on_preempt is single-process only for now; "
+                "multi-process runs keep gang-restart semantics "
+                "(--restarts + epoch checkpoints)", stacklevel=2,
+            )
+            use_preempt = False
+        if use_preempt:
+            import signal
+            import threading
+
+            if threading.current_thread() is threading.main_thread():
+                old_handler = signal.signal(
+                    signal.SIGTERM,
+                    lambda *_a: preempt.__setitem__("hit", True),
                 )
-                step_metrics.append(m)
-                global_step += 1
-            if exhausted and not step_metrics:
+
+        # exact mid-epoch resume (maybe_resume with steps_per_epoch):
+        # fast-forward the stream to the checkpointed position — the
+        # discarded batches replay the interrupted epoch's prefix
+        skip_steps = int(getattr(self, "_resume_skip_steps", 0) or 0)
+        self._resume_skip_steps = 0
+
+        # fast-forward on the RAW host iterator — skipped batches must
+        # never pay the H2D transfer _prefetch's _put would issue
+        raw_iter = iter(train_ds)
+        exhausted = False
+        for _ in range(skip_steps):
+            try:
+                next(raw_iter)
+            except StopIteration:
+                exhausted = True
                 break
-            logs = _mean_metrics(step_metrics)
-            logs["lr"] = lr
-            if val_ds is not None:
-                val_logs = self.evaluate(val_ds, steps=validation_steps)
-                logs.update({f"val_{k}": v for k, v in val_logs.items()})
-            if verbose:
-                print(f"epoch {epoch}: " + " ".join(f"{k}={v:.4f}" for k, v in logs.items()))
-            for cb in cbs:
-                cb.on_epoch_end(epoch, logs)
-            if self.stop_training or exhausted:
-                break
+        train_iter = self._prefetch(raw_iter)
+        global_step = initial_epoch * steps_per_epoch + skip_steps
+        lr = self.lr_controller.lr_for_step(global_step)
+        preempted = False
+        try:
+            for epoch in range(initial_epoch, epochs):
+                step_metrics = []
+                steps_this_epoch = steps_per_epoch - (
+                    skip_steps if epoch == initial_epoch else 0
+                )
+                for _ in range(steps_this_epoch):
+                    if preempt["hit"]:
+                        preempted = True
+                        break
+                    lr = self.lr_controller.lr_for_step(global_step)
+                    try:
+                        images, labels = next(train_iter)
+                    except StopIteration:
+                        # finite (non-infinite) stream ran dry: end
+                        # training cleanly after this partial epoch
+                        # (Keras semantics)
+                        exhausted = True
+                        break
+                    self.state, m = self._train_step(
+                        self.state, images, labels,
+                        jnp.asarray(lr, jnp.float32),
+                    )
+                    step_metrics.append(m)
+                    global_step += 1
+                if preempted:
+                    from tpuflow.ckpt import save_step_checkpoint
+
+                    path = save_step_checkpoint(
+                        self.cfg.checkpoint_dir, self.state, global_step
+                    )
+                    history.history.setdefault("preempted_at_step", []
+                                               ).append(global_step)
+                    if verbose:
+                        print(f"preempted at step {global_step}; "
+                              f"saved {path}")
+                    break
+                if exhausted and not step_metrics:
+                    break
+                logs = _mean_metrics(step_metrics)
+                logs["lr"] = lr
+                if val_ds is not None:
+                    val_logs = self.evaluate(val_ds, steps=validation_steps)
+                    logs.update({f"val_{k}": v for k, v in val_logs.items()})
+                if verbose:
+                    print(f"epoch {epoch}: " + " ".join(
+                        f"{k}={v:.4f}" for k, v in logs.items()))
+                for cb in cbs:
+                    cb.on_epoch_end(epoch, logs)
+                if self.stop_training or exhausted:
+                    break
+        finally:
+            if old_handler is not None:
+                import signal
+
+                signal.signal(signal.SIGTERM, old_handler)
         for cb in cbs:
             cb.on_train_end()
         return history

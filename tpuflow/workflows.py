@@ -157,7 +157,13 @@ def train_and_evaluate(
         trainer.init_state(
             (cfg.data.img_height, cfg.data.img_width, cfg.data.img_channels)
         )
-        initial_epoch = trainer.maybe_resume()
+        # steps_per_epoch is derivable from the converter's row count
+        # (same formula as Dataset.steps_per_epoch), which makes the
+        # resume STEP-aware: a preemption checkpoint
+        # (cfg.train.checkpoint_on_preempt) restores to its exact
+        # mid-epoch position instead of being silently discarded
+        spe = max(1, conv_t.num_rows // (local_batch * procs))
+        initial_epoch = trainer.maybe_resume(steps_per_epoch=spe)
     # Datasets are built AFTER resume resolution so a resumed run's
     # stream starts at the (seed, initial_epoch) shuffle order instead
     # of replaying epoch 0 — per-epoch orders are seeded by
