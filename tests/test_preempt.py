@@ -150,3 +150,104 @@ def test_maybe_resume_without_spe_ignores_step_checkpoints(tmp_path):
     tr3 = _trainer(ckdir)
     assert tr3.maybe_resume(steps_per_epoch=8) == 1
     assert tr3._resume_skip_steps == 5
+
+
+@pytest.mark.slow
+def test_lm_sigterm_step_checkpoint_exact_resume(tmp_path):
+    """The LM family's preemption contract, same shape as the image
+    Trainer's: SIGTERM mid-epoch → checkpoint-step-{N}.ckpt → exact
+    resume via maybe_resume(steps_per_epoch=...) → same final params
+    as an uninterrupted run (deterministic (seed, epoch) batch order
+    makes the skipped prefix reproducible)."""
+    import numpy as _np
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.train import LMTrainer
+
+    toks = _np.random.default_rng(3).integers(
+        1, 64, (32, 16)).astype(_np.int32)
+    kw = dict(vocab_size=64, dim=32, depth=1, heads=2)
+
+    def trainer(preempt=False):
+        cfg = TrainConfig(learning_rate=1e-3, epochs=3, warmup_epochs=0,
+                          checkpoint_on_preempt=preempt)
+        return LMTrainer(build_transformer_lm(**kw), cfg)
+
+    ckdir = str(tmp_path / "ck")
+    spe = 32 // 8  # rows / batch
+
+    # uninterrupted oracle
+    tr_a = trainer()
+    tr_a.fit(toks, batch_size=8, epochs=3)
+    params_a = jax.device_get(tr_a.state.params)
+
+    # preempted run: SIGTERM during _put of step 7 (epoch 1, step 2) —
+    # the flag lands after that step completes, preempting at g=7
+    tr_b = trainer(preempt=True)
+    orig_put = tr_b._put
+    calls = {"n": 0}
+
+    def killing_put(rows):
+        calls["n"] += 1
+        if calls["n"] == 7:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return orig_put(rows)
+
+    tr_b._put = killing_put
+    m_b = tr_b.fit(toks, batch_size=8, epochs=3, checkpoint_dir=ckdir)
+    assert m_b.get("preempted_at_step") == 7.0, m_b
+    assert any("checkpoint-step-7" in f for f in os.listdir(ckdir))
+
+    # exact resume
+    tr_c = trainer(preempt=True)
+    initial = tr_c.maybe_resume(ckdir, steps_per_epoch=spe)
+    assert initial == 1 and tr_c._resume_skip_steps == 3
+    m_c = tr_c.fit(toks, batch_size=8, epochs=3, checkpoint_dir=ckdir)
+    assert "preempted_at_step" not in m_c
+    params_c = jax.device_get(tr_c.state.params)
+    for a, c in zip(jax.tree.leaves(params_a), jax.tree.leaves(params_c)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_resume_skip_mismatch_guards(tmp_path):
+    """A stashed mid-epoch position only fits the topology maybe_resume
+    was told about: a mismatched steps_per_epoch or an explicit
+    initial_epoch override must fail loudly, not silently train on the
+    wrong stream position."""
+    from tpuflow.ckpt import save_step_checkpoint
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.train import LMTrainer
+
+    import numpy as _np
+
+    toks = _np.random.default_rng(5).integers(
+        1, 64, (32, 16)).astype(_np.int32)
+    ckdir = str(tmp_path / "ck")
+    tr0 = LMTrainer(build_transformer_lm(vocab_size=64, dim=32, depth=1,
+                                         heads=2),
+                    TrainConfig(warmup_epochs=0))
+    tr0.init_state()
+    save_step_checkpoint(ckdir, tr0.state, global_step=7)
+
+    def fresh():
+        t = LMTrainer(build_transformer_lm(vocab_size=64, dim=32, depth=1,
+                                           heads=2),
+                      TrainConfig(warmup_epochs=0))
+        return t
+
+    # resumed with spe=8 (skip 7), but fit at batch 16 → spe=2: refuse
+    t1 = fresh()
+    assert t1.maybe_resume(ckdir, steps_per_epoch=8) == 0
+    assert t1._resume_skip_steps == 7
+    with pytest.raises(ValueError, match="different.*steps_per_epoch"):
+        t1.fit(toks, batch_size=16, epochs=2)
+
+    # explicit initial_epoch overriding the resumed position: refuse
+    t2 = fresh()
+    t2.maybe_resume(ckdir, steps_per_epoch=4)  # epoch 1, skip 3
+    assert t2._resume_skip_steps == 3
+    with pytest.raises(ValueError, match="overrides the resumed"):
+        t2.fit(toks, batch_size=8, epochs=3, initial_epoch=2)

@@ -182,7 +182,7 @@ class LMTrainer:
         params = nn.unbox(plain.init({"params": jax.random.key(seed)}, toks0))[
             "params"
         ]
-        self.state = TrainState(
+        state = TrainState(
             step=jnp.asarray(0, jnp.int32),
             params=params,
             batch_stats={},
@@ -190,6 +190,16 @@ class LMTrainer:
             rng=jax.random.key(seed),
             plateau_factor=jnp.asarray(1.0, jnp.float32),
         )
+        # commit the replicated placement explicitly (matches the
+        # shard_map step's P() state spec). Leaving leaves uncommitted
+        # happened to work for fresh fits, but restore_into_state maps
+        # the checkpoint onto the TEMPLATE's shardings — an uncommitted
+        # template commits the restored state to ONE device and the
+        # first multi-device step then fails on conflicting committed
+        # placements (surfaced by the r05 preemption-resume test).
+        from tpuflow.parallel.mesh import replicate_tree
+
+        self.state = replicate_tree(state, self.mesh)
         return self.state
 
     def _init_state_gspmd(self, seed: int) -> TrainState:
@@ -493,11 +503,39 @@ class LMTrainer:
 
     # ---- checkpoint / resume --------------------------------------------
 
-    def maybe_resume(self, checkpoint_dir: Optional[str]) -> int:
+    def maybe_resume(self, checkpoint_dir: Optional[str],
+                     steps_per_epoch: Optional[int] = None) -> int:
         """Restore the newest checkpoint if one exists; returns the
-        epoch to continue from (0 when starting fresh)."""
+        epoch to continue from (0 when starting fresh).
+
+        With ``steps_per_epoch``, mid-epoch PREEMPTION checkpoints
+        (``checkpoint-step-{N}.ckpt``, cfg.checkpoint_on_preempt) are
+        also considered, compared in global-step units; when one is
+        newest the position within the epoch is stashed as
+        ``self._resume_skip_steps`` and the next :meth:`fit`
+        fast-forwards to it — EXACT resume (the deterministic
+        (seed, epoch) batch order makes the skipped prefix
+        reproducible). Without it, step checkpoints are ignored."""
+        self._resume_skip_steps = 0
         if not checkpoint_dir:
             return 0
+        if steps_per_epoch is not None:
+            from tpuflow.ckpt.checkpoint import latest_resume_point
+
+            found = latest_resume_point(checkpoint_dir,
+                                        int(steps_per_epoch))
+            if found is None:
+                return 0
+            path, epoch, skip = found
+            if self.state is None:
+                self.init_state()
+            self.state = restore_into_state(path, self.state)
+            self._resume_skip_steps = skip
+            self._resume_epoch = epoch
+            self._initial_epoch = epoch
+            if is_primary():
+                print(f"resumed from {path} (epoch {epoch}, +{skip} steps)")
+            return epoch
         path = latest_checkpoint(checkpoint_dir)
         if path is None:
             return 0
@@ -699,100 +737,151 @@ class LMTrainer:
                     metrics["val_ppl"] = self._ppl(vl)
             return metrics
         metrics: Dict[str, float] = {}
-        global_step = start * steps_per_epoch
+        # exact mid-epoch resume (maybe_resume with steps_per_epoch)
+        skip_steps = int(getattr(self, "_resume_skip_steps", 0) or 0)
+        self._resume_skip_steps = 0
+        # preemption-safe mode: SIGTERM sets a flag; the step loop
+        # finishes the current step, writes a step checkpoint, stops
+        # cleanly (same contract as the image Trainer). Gates and
+        # handler install/restore are shared in train/preempt.py.
+        from tpuflow.train.preempt import sigterm_preempt_flag
+
+        use_preempt = bool(
+            getattr(cfg, "checkpoint_on_preempt", False) and checkpoint_dir
+        )
+        if skip_steps:
+            # the stashed mid-epoch position is only meaningful for the
+            # EXACT topology maybe_resume was told about — a different
+            # batch size / dataset (different steps_per_epoch) or an
+            # explicit initial_epoch override would apply the skip to
+            # the wrong stream position and silently break exact resume
+            if skip_steps >= steps_per_epoch:
+                raise ValueError(
+                    f"resume position (+{skip_steps} steps) does not fit "
+                    f"steps_per_epoch={steps_per_epoch}: maybe_resume was "
+                    "given a different steps_per_epoch — call fit with "
+                    "the same batch size and data"
+                )
+            resumed_epoch = getattr(self, "_resume_epoch", None)
+            if resumed_epoch is not None and start != resumed_epoch:
+                raise ValueError(
+                    f"initial_epoch={start} overrides the resumed "
+                    f"mid-epoch position (epoch {resumed_epoch} "
+                    f"+{skip_steps} steps) — drop initial_epoch or "
+                    "re-run maybe_resume"
+                )
+        global_step = start * steps_per_epoch + skip_steps
         # shapes are fixed within one fit but not across fits — stale
         # FLOPs (or a stale AOT executable) from a previous fit's
         # shapes would corrupt MFU / fail on call
         self._flops_per_step = None
         self._step_exec = None
-        for epoch in range(start, epochs):
-            if ds is not None:
-                batch_iter = ds.iter_epoch(epoch)
-            else:
-                order = np.random.default_rng(cfg.seed + epoch).permutation(n)
-            losses = []
-            t_epoch = None
-            timed_steps = 0
-            for i in range(steps_per_epoch):
+        preempted = False
+        with sigterm_preempt_flag(use_preempt) as preempt:
+            for epoch in range(start, epochs):
+                first_i = skip_steps if epoch == start else 0
                 if ds is not None:
-                    # shard-disjoint stream: this process's slice comes
-                    # from its own round-robin rows (≙ cur_shard=rank)
-                    local_rows = next(batch_iter)
+                    batch_iter = ds.iter_epoch(epoch)
+                    for _ in range(first_i):
+                        next(batch_iter)  # fast-forward to the resume point
                 else:
-                    # the shuffle order is seed-deterministic, so every
-                    # process slices the SAME global batch and takes its
-                    # own contiguous rows (≙ cur_shard=rank, P1/03:332-337)
-                    rows = order[i * batch_size : (i + 1) * batch_size]
-                    rows = rows[proc * b_local : (proc + 1) * b_local]
-                    local_rows = train_tokens[rows]
-                toks = self._put(local_rows)
-                lr = self.lr_controller.lr_for_step(global_step)
-                lr_arr = jnp.asarray(lr, jnp.float32)
-                if self._step_exec is None:
-                    # ONE compile per fit: the AOT executable both runs
-                    # every step (jax's AOT path does not share the jit
-                    # dispatch cache — compiling separately for cost
-                    # analysis would double the compile) and yields the
-                    # FLOPs for the throughput/MFU metrics (N11). NOTE
-                    # cost analysis reports PER-DEVICE flops when the
-                    # program is sharded.
-                    from tpuflow.obs.mfu import flops_of_compiled
+                    order = np.random.default_rng(cfg.seed + epoch).permutation(n)
+                losses = []
+                t_epoch = None
+                timed_steps = 0
+                for i in range(first_i, steps_per_epoch):
+                    if preempt["hit"]:
+                        preempted = True
+                        break
+                    if ds is not None:
+                        # shard-disjoint stream: this process's slice comes
+                        # from its own round-robin rows (≙ cur_shard=rank)
+                        local_rows = next(batch_iter)
+                    else:
+                        # the shuffle order is seed-deterministic, so every
+                        # process slices the SAME global batch and takes its
+                        # own contiguous rows (≙ cur_shard=rank, P1/03:332-337)
+                        rows = order[i * batch_size : (i + 1) * batch_size]
+                        rows = rows[proc * b_local : (proc + 1) * b_local]
+                        local_rows = train_tokens[rows]
+                    toks = self._put(local_rows)
+                    lr = self.lr_controller.lr_for_step(global_step)
+                    lr_arr = jnp.asarray(lr, jnp.float32)
+                    if self._step_exec is None:
+                        # ONE compile per fit: the AOT executable both runs
+                        # every step (jax's AOT path does not share the jit
+                        # dispatch cache — compiling separately for cost
+                        # analysis would double the compile) and yields the
+                        # FLOPs for the throughput/MFU metrics (N11). NOTE
+                        # cost analysis reports PER-DEVICE flops when the
+                        # program is sharded.
+                        from tpuflow.obs.mfu import flops_of_compiled
 
-                    self._step_exec = self._train_step.lower(
-                        self.state, toks, lr_arr
-                    ).compile()
-                    self._flops_per_step = flops_of_compiled(
-                        self._step_exec
-                    )
-                self.state, m = self._step_exec(self.state, toks, lr_arr)
-                losses.append(m["loss"])
-                global_step += 1
-                if i == 0:
-                    # sync, then time the REMAINING steps: step 0
-                    # carries trace+compile, which must not pollute the
-                    # throughput metrics logged to the run
-                    float(m["loss"])
-                    t_epoch = time.time()
-                    timed_steps = steps_per_epoch - 1
-            epoch_loss = float(jnp.mean(jnp.stack(losses)))
-            # the scalar fetch above syncs, so the wall time is real
-            epoch_s = time.time() - t_epoch if t_epoch is not None else 0.0
-            metrics = {"loss": epoch_loss, "lr": float(lr)}
-            if timed_steps > 0 and epoch_s > 0:
-                step_s = epoch_s / timed_steps
-                metrics["tokens_per_sec"] = batch_size * seq_len / step_s
-                if self._flops_per_step:
-                    from tpuflow.core.hw import is_tpu_backend
-                    from tpuflow.obs.mfu import mfu as _mfu
+                        self._step_exec = self._train_step.lower(
+                            self.state, toks, lr_arr
+                        ).compile()
+                        self._flops_per_step = flops_of_compiled(
+                            self._step_exec
+                        )
+                    self.state, m = self._step_exec(self.state, toks, lr_arr)
+                    losses.append(m["loss"])
+                    global_step += 1
+                    if i == first_i:
+                        # sync, then time the REMAINING steps: the first
+                        # executed step carries trace+compile, which must
+                        # not pollute the throughput metrics
+                        float(m["loss"])
+                        t_epoch = time.time()
+                        timed_steps = steps_per_epoch - first_i - 1
+                if preempted:
+                    from tpuflow.ckpt.checkpoint import save_step_checkpoint
 
-                    # n_chips=1: on TPU, cost analysis reports the
-                    # PER-DEVICE share of the SPMD-partitioned step. On
-                    # other backends (the CPU host-device meshes of the
-                    # test suite) it can report WHOLE-PROGRAM flops —
-                    # divide by mesh size there so the logged mfu is not
-                    # inflated by the device count (ADVICE r2).
-                    fl = self._flops_per_step
-                    if not is_tpu_backend():
-                        fl /= max(1, self.mesh.size)
-                    metrics["mfu"] = _mfu(
-                        fl, step_s, n_chips=1,
-                        device=self.mesh.devices.flat[0],
+                    spath = save_step_checkpoint(
+                        checkpoint_dir, self.state, global_step
                     )
-            if val_tokens is not None:
-                vl = self._eval_mean_loss(val_tokens, batch_size)
-                if vl is not None:
-                    metrics["val_loss"] = vl
-                    metrics["val_ppl"] = self._ppl(vl)
-            # rank-0-only tracking side effects (≙ P1/03:360-361);
-            # ``run`` is a tpuflow.track Run handle, same idiom as
-            # TrackingCallback on the image Trainer
-            if run is not None and is_primary():
-                for k, v in metrics.items():
-                    run.log_metric(k, float(v), step=epoch)
-            if checkpoint_dir:
-                save_checkpoint(checkpoint_dir, self.state, epoch + 1)
-            if on_epoch is not None:
-                on_epoch(epoch, metrics)
+                    metrics["preempted_at_step"] = float(global_step)
+                    if is_primary():
+                        print(f"preempted at step {global_step}; saved {spath}")
+                    break
+                epoch_loss = float(jnp.mean(jnp.stack(losses)))
+                # the scalar fetch above syncs, so the wall time is real
+                epoch_s = time.time() - t_epoch if t_epoch is not None else 0.0
+                metrics = {"loss": epoch_loss, "lr": float(lr)}
+                if timed_steps > 0 and epoch_s > 0:
+                    step_s = epoch_s / timed_steps
+                    metrics["tokens_per_sec"] = batch_size * seq_len / step_s
+                    if self._flops_per_step:
+                        from tpuflow.core.hw import is_tpu_backend
+                        from tpuflow.obs.mfu import mfu as _mfu
+
+                        # n_chips=1: on TPU, cost analysis reports the
+                        # PER-DEVICE share of the SPMD-partitioned step. On
+                        # other backends (the CPU host-device meshes of the
+                        # test suite) it can report WHOLE-PROGRAM flops —
+                        # divide by mesh size there so the logged mfu is not
+                        # inflated by the device count (ADVICE r2).
+                        fl = self._flops_per_step
+                        if not is_tpu_backend():
+                            fl /= max(1, self.mesh.size)
+                        metrics["mfu"] = _mfu(
+                            fl, step_s, n_chips=1,
+                            device=self.mesh.devices.flat[0],
+                        )
+                if val_tokens is not None:
+                    vl = self._eval_mean_loss(val_tokens, batch_size)
+                    if vl is not None:
+                        metrics["val_loss"] = vl
+                        metrics["val_ppl"] = self._ppl(vl)
+                # rank-0-only tracking side effects (≙ P1/03:360-361);
+                # ``run`` is a tpuflow.track Run handle, same idiom as
+                # TrackingCallback on the image Trainer
+                if run is not None and is_primary():
+                    for k, v in metrics.items():
+                        run.log_metric(k, float(v), step=epoch)
+                if checkpoint_dir:
+                    save_checkpoint(checkpoint_dir, self.state, epoch + 1)
+                if on_epoch is not None:
+                    on_epoch(epoch, metrics)
         return metrics
 
     # ---- evaluation ------------------------------------------------------

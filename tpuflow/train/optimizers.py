@@ -26,6 +26,10 @@ _OPTIMIZERS: Dict[str, Callable[..., optax.GradientTransformation]] = {
     "sgd": optax.sgd,
     "rmsprop": optax.rmsprop,
     "lamb": optax.lamb,
+    # LARS: the layer-wise adaptive rate classic for large-batch CNN
+    # training — the principled companion to the b512 batch probes
+    # (LR x N alone degrades as the global batch grows)
+    "lars": optax.lars,
     "lion": optax.lion,
     "nadam": optax.nadam,
 }

@@ -1,0 +1,60 @@
+"""Shared SIGTERM preemption machinery for the trainers (r05).
+
+One context manager serves both ``Trainer.fit`` and ``LMTrainer.fit``:
+it yields a mutable ``{"hit": bool}`` flag that a SIGTERM flips — the
+handler does nothing else; all device/filesystem work happens in the
+trainer's loop context — and restores the previous handler on exit,
+exceptions included. The gates live here so the two fit loops cannot
+drift apart:
+
+- multi-process: DISABLED with a warning. A per-process stop flag
+  breaks the identical-collective-schedule invariant (processes
+  stopping at different steps → mismatched pmeans → deadlock);
+  multi-process preemption stays at gang granularity (launcher
+  ``--restarts`` + epoch checkpoints — tests/test_multiproc_killresume
+  proves that path) until a synchronized agreement step exists.
+- non-main thread: DISABLED with a warning (``signal.signal`` is a
+  main-thread-only API). A threaded HPO driver believing its trials
+  are preemption-safe must hear otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def sigterm_preempt_flag(enabled: bool):
+    flag = {"hit": False}
+    if not enabled:
+        yield flag
+        return
+    import signal
+    import threading
+    import warnings
+
+    import jax
+
+    if jax.process_count() > 1:
+        warnings.warn(
+            "checkpoint_on_preempt is single-process only for now; "
+            "multi-process runs keep gang-restart semantics "
+            "(--restarts + epoch checkpoints)", stacklevel=3,
+        )
+        yield flag
+        return
+    if threading.current_thread() is not threading.main_thread():
+        warnings.warn(
+            "checkpoint_on_preempt needs fit() on the MAIN thread "
+            "(signal.signal is main-thread-only); preemption "
+            "protection is DISABLED for this run", stacklevel=3,
+        )
+        yield flag
+        return
+    old = signal.signal(
+        signal.SIGTERM, lambda *_a: flag.__setitem__("hit", True)
+    )
+    try:
+        yield flag
+    finally:
+        signal.signal(signal.SIGTERM, old)

@@ -316,6 +316,7 @@ class Trainer:
                 raise RuntimeError("call init_state() before maybe_resume()")
             self.state = restore_into_state(path, self.state)
             self._resume_skip_steps = skip
+            self._resume_epoch = epoch
             return epoch
         path = latest_checkpoint(ckdir)
         if path is None:
@@ -377,45 +378,42 @@ class Trainer:
 
         # preemption-safe mode (cfg.checkpoint_on_preempt): SIGTERM
         # sets a flag; the step loop finishes the CURRENT step, writes
-        # a step-granular checkpoint, and stops cleanly. The handler
-        # only flips the flag — all device/filesystem work happens in
-        # loop context. Installed only from the main thread (signal
-        # module restriction); restored on exit.
-        preempt = {"hit": False}
-        old_handler = None
+        # a step-granular checkpoint, and stops cleanly. Gates (multi-
+        # process and non-main-thread both disable with a warning) and
+        # handler install/restore live in train/preempt.py, shared
+        # with LMTrainer.
+        from tpuflow.train.preempt import sigterm_preempt_flag
+
         use_preempt = bool(
             self.cfg.checkpoint_on_preempt and self.cfg.checkpoint_dir
         )
-        if use_preempt and jax.process_count() > 1:
-            # a per-process flag would break the identical-collective-
-            # schedule invariant (processes stopping at different steps
-            # → mismatched pmeans → deadlock); until a synchronized
-            # agreement step exists, multi-process preemption stays at
-            # GANG granularity: launcher --restarts + epoch checkpoints
-            # (tests/test_multiproc_killresume.py proves that path)
-            import warnings
-
-            warnings.warn(
-                "checkpoint_on_preempt is single-process only for now; "
-                "multi-process runs keep gang-restart semantics "
-                "(--restarts + epoch checkpoints)", stacklevel=2,
-            )
-            use_preempt = False
-        if use_preempt:
-            import signal
-            import threading
-
-            if threading.current_thread() is threading.main_thread():
-                old_handler = signal.signal(
-                    signal.SIGTERM,
-                    lambda *_a: preempt.__setitem__("hit", True),
-                )
 
         # exact mid-epoch resume (maybe_resume with steps_per_epoch):
         # fast-forward the stream to the checkpointed position — the
         # discarded batches replay the interrupted epoch's prefix
         skip_steps = int(getattr(self, "_resume_skip_steps", 0) or 0)
         self._resume_skip_steps = 0
+        if skip_steps:
+            # the stashed position is only meaningful for the topology
+            # maybe_resume was told about — a mismatched
+            # steps_per_epoch or an explicit initial_epoch override
+            # would apply the skip to the wrong stream position
+            if skip_steps >= steps_per_epoch:
+                raise ValueError(
+                    f"resume position (+{skip_steps} steps) does not "
+                    f"fit steps_per_epoch={steps_per_epoch}: "
+                    "maybe_resume was given a different "
+                    "steps_per_epoch — call fit with the same batch "
+                    "size and data"
+                )
+            resumed_epoch = getattr(self, "_resume_epoch", None)
+            if resumed_epoch is not None and initial_epoch != resumed_epoch:
+                raise ValueError(
+                    f"initial_epoch={initial_epoch} overrides the "
+                    f"resumed mid-epoch position (epoch "
+                    f"{resumed_epoch} +{skip_steps} steps) — pass "
+                    "initial_epoch=maybe_resume(...) or drop it"
+                )
 
         # fast-forward on the RAW host iterator — skipped batches must
         # never pay the H2D transfer _prefetch's _put would issue
@@ -431,7 +429,7 @@ class Trainer:
         global_step = initial_epoch * steps_per_epoch + skip_steps
         lr = self.lr_controller.lr_for_step(global_step)
         preempted = False
-        try:
+        with sigterm_preempt_flag(use_preempt) as preempt:
             for epoch in range(initial_epoch, epochs):
                 step_metrics = []
                 steps_this_epoch = steps_per_epoch - (
@@ -482,11 +480,6 @@ class Trainer:
                     cb.on_epoch_end(epoch, logs)
                 if self.stop_training or exhausted:
                     break
-        finally:
-            if old_handler is not None:
-                import signal
-
-                signal.signal(signal.SIGTERM, old_handler)
         for cb in cbs:
             cb.on_train_end()
         return history
