@@ -287,7 +287,7 @@ def test_corrupt_rows_substituted_not_zero_trained(tmp_path):
     silver = store.table("silver")
     silver.write(t, compression=None)
 
-    for cache in (False, True):
+    for cache in (False, True, "memmap"):
         ds = make_dataset(silver, batch_size=4, infinite=False,
                           img_height=16, img_width=16, shuffle=False,
                           cache_decoded=cache)
@@ -297,6 +297,69 @@ def test_corrupt_rows_substituted_not_zero_trained(tmp_path):
                 assert (b["image"].reshape(len(b["label"]), -1).sum(1)
                         > 0).all()
         assert ds.decode_failures == 2  # occurrences: once per epoch
-        # headline metric: ONE distinct corrupt file (cache mode only —
+        # headline metric: ONE distinct corrupt file (cache modes only —
         # streaming has no row identity to dedupe on)
         assert ds.unique_decode_failures == (1 if cache else None)
+
+    # memmap persistence: a FRESH Dataset over the same files decodes
+    # NOTHING (rows + corrupt flags survive across instances/runs)
+    ds2 = make_dataset(silver, batch_size=4, infinite=False,
+                       img_height=16, img_width=16, shuffle=False,
+                       cache_decoded="memmap")
+    seen = 0
+    for b in ds2:
+        assert (b["image"].reshape(len(b["label"]), -1).sum(1) > 0).all()
+        seen += len(b["label"])
+    assert seen > 0
+    assert ds2.decode_calls == 0  # decode-once per shard x geometry
+    assert ds2.decode_failures == 1  # corrupt row remembered on disk
+    assert ds2.unique_decode_failures == 1
+
+
+def test_memmap_cache_digest_isolation(tmp_path, flower_dir):
+    """Two Datasets over DIFFERENT file lists rooted in the same
+    directory must use different memmap caches (the filename carries a
+    digest of basenames+sizes+rows): np.memmap silently extends or
+    prefix-maps on size mismatch, so an alias would serve wrong pixels
+    with no error."""
+    import pyarrow as pa
+
+    from tpuflow.data import TableStore
+    from tpuflow.data.loader import Dataset
+
+    jpgs = []
+    import glob
+    for pth in sorted(glob.glob(str(flower_dir) + "/**/*.jpg",
+                                recursive=True))[:8]:
+        jpgs.append(open(pth, "rb").read())
+    store = TableStore(str(tmp_path / "t"), "db")
+    t = store.table("t")
+    t.write(pa.table({"content": pa.array(jpgs, pa.binary()),
+                      "label_idx": pa.array(list(range(8)), pa.int32())}),
+            compression=None, rows_per_file=4)  # 2 parquet files
+    from tpuflow.data.loader import make_dataset
+
+    ds0 = make_dataset(t, batch_size=4, infinite=False, shuffle=False,
+                       img_height=16, img_width=16,
+                       cache_decoded="memmap")
+    files = ds0.files
+    list(ds0)  # populate the first (forward-order) cache
+    assert len(files) == 2
+
+    kw = dict(batch_size=4, infinite=False, shuffle=False, img_height=16,
+              img_width=16, cache_decoded="memmap")
+    a = Dataset(files, **kw)
+    batches_a = {i: b for i, b in enumerate(a)}
+    b = Dataset(list(reversed(files)), **kw)
+    batches_b = {i: bb for i, bb in enumerate(b)}
+    # reversed file order = different row identity = its own cache:
+    # batch 0 of B must equal batch 1 of A (the second file's rows)
+    np.testing.assert_array_equal(batches_b[0]["image"],
+                                  batches_a[1]["image"])
+    np.testing.assert_array_equal(batches_b[1]["image"],
+                                  batches_a[0]["image"])
+    # and two distinct cache files exist beside the parquet files
+    import os as _os
+    caches = [f for f in _os.listdir(_os.path.dirname(files[0]))
+              if f.startswith("decoded_") and f.endswith(".u8")]
+    assert len(caches) == 2, caches

@@ -38,9 +38,12 @@ class DataConfig:
     # alias numpy arrays zero-copy into device buffers
     reuse_decode_buffers: "bool | None" = None
     num_decode_workers: int = 8
-    # cache decoded uint8 rows in host RAM so epoch 2+ skips JPEG
-    # decode (rows x H x W x 3 bytes; incompatible with streaming)
-    cache_decoded: bool = False
+    # cache decoded uint8 rows so epoch 2+ skips JPEG decode
+    # (incompatible with streaming): True = host-RAM dict
+    # (rows x H x W x 3 bytes of RSS); 'memmap' = disk-backed beside
+    # the cache files — flat RSS and PERSISTENT across runs
+    # (decode-once per shard x geometry, corrupt flags included)
+    cache_decoded: "bool | str" = False
     prefetch: int = 2
     sample_fraction: float = 1.0
     split_seed: int = 42

@@ -112,7 +112,7 @@ class Dataset:
         streaming: bool = False,
         shuffle_buffer: int = 2048,
         reuse_buffers: bool = False,
-        cache_decoded: bool = False,
+        cache_decoded: "bool | str" = False,
     ):
         self.files = list(files)
         self.batch_size = batch_size
@@ -137,19 +137,35 @@ class Dataset:
         self.streaming = streaming
         self.shuffle_buffer = max(1, shuffle_buffer)
         self.reuse_buffers = reuse_buffers
+        if cache_decoded not in (False, True, "memmap"):
+            raise ValueError(
+                f"cache_decoded must be False, True, or 'memmap', got "
+                f"{cache_decoded!r}"
+            )
         if cache_decoded and streaming:
             raise ValueError(
-                "cache_decoded caches every decoded row in host memory — "
-                "incompatible with streaming=True (whose whole point is "
-                "beyond-memory tables)"
+                "cache_decoded needs stable shard-local row indices — "
+                "incompatible with streaming=True (whose reservoir "
+                "reshuffles row identity per epoch)"
             )
         # decoded-row cache: epoch 2+ skips JPEG decode entirely and
-        # assembles batches by memcpy from cached uint8 rows. Costs
-        # rows x H x W x 3 bytes of host RAM (tf_flowers at 224^2:
-        # ~275 MB) — the right trade when epochs revisit the same rows
-        # and host decode is the bottleneck (SURVEY.md §7 hard part 1).
+        # assembles batches by memcpy from cached uint8 rows.
+        #   True      — host-RAM dict (rows x H x W x 3 bytes of RSS;
+        #               tf_flowers at 224^2: ~275 MB)
+        #   'memmap'  — disk-backed np.memmap beside the source files:
+        #               flat RSS (pages ride the OS cache), PERSISTENT
+        #               across Dataset instances and runs (decode-once
+        #               per shard x geometry — epoch 1 of the NEXT run
+        #               is already memcpy), one file per shard so
+        #               processes never collide. A uint8 flag sidecar
+        #               records absent/ok/failed per row, so corrupt
+        #               rows stay remembered across runs too.
+        # The right trade when epochs revisit the same rows and host
+        # decode is the bottleneck (SURVEY.md §7 hard part 1).
         self.cache_decoded = cache_decoded
         self._decoded_cache: Dict[int, np.ndarray] = {}
+        self._mm_rows = None  # np.memmap (N, H, W, 3) u8, lazy
+        self._mm_flags = None  # np.memmap (N,) u8: 0=absent 1=ok 2=bad
         # observability for the bounded-memory guarantee (tests)
         self.peak_buffered_rows = 0
         self.decode_calls = 0  # rows actually sent to the native decoder
@@ -365,6 +381,113 @@ class Dataset:
             )
         return pool[slot]
 
+    def _ensure_memmap(self):
+        """Lazily open (or create) the shard's decoded-row memmap pair.
+
+        The filename carries shard + geometry + a DIGEST of the file
+        list (basenames, sizes, row count): two Datasets over different
+        file subsets/orders rooted in the same directory must never
+        alias one cache — np.memmap(mode='r+') silently extends or
+        prefix-maps on size mismatch, so a name collision would serve
+        wrong pixels with no error. First-touch creation runs under an
+        O_CREAT|O_EXCL lock file: without it, two same-shard processes
+        racing the exists-check could each rename fresh zeroed files
+        and one would then write rows into an unlinked inode while its
+        flags landed in the survivor (flag=ok over never-written rows).
+        """
+        if self._mm_rows is not None:
+            return
+        import hashlib
+        import tempfile
+        import time as _time
+
+        n = len(self._contents)
+        h, w = self.img_height, self.img_width
+        dig = hashlib.blake2b(digest_size=6)
+        for f in self.files:
+            dig.update(os.path.basename(f).encode())
+            dig.update(str(os.path.getsize(f)).encode())
+        dig.update(str(n).encode())
+        base = os.path.join(
+            os.path.dirname(os.path.abspath(self.files[0])),
+            f"decoded_{self.cur_shard}of{self.shard_count}_{h}x{w}_"
+            f"{dig.hexdigest()}",
+        )
+        rows_path, flags_path = base + ".u8", base + ".flags"
+        deadline = _time.time() + 60.0
+        while not (os.path.exists(rows_path)
+                   and os.path.exists(flags_path)):
+            try:
+                lock_fd = os.open(base + ".lock",
+                                  os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                if _time.time() > deadline:
+                    raise TimeoutError(
+                        f"memmap cache lock {base}.lock held for >60s — "
+                        "stale lock from a crashed first-touch? remove "
+                        "it to rebuild the cache"
+                    )
+                _time.sleep(0.05)
+                continue
+            try:
+                d = os.path.dirname(rows_path)
+                if not os.path.exists(rows_path):
+                    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+                    with os.fdopen(fd, "wb") as f:
+                        f.truncate(n * h * w * 3)
+                    os.replace(tmp, rows_path)
+                if not os.path.exists(flags_path):
+                    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+                    with os.fdopen(fd, "wb") as f:
+                        f.write(b"\x00" * n)
+                    os.replace(tmp, flags_path)
+            finally:
+                os.close(lock_fd)
+                os.unlink(base + ".lock")
+        self._mm_rows = np.memmap(rows_path, dtype=np.uint8, mode="r+",
+                                  shape=(n, h, w, 3))
+        self._mm_flags = np.memmap(flags_path, dtype=np.uint8, mode="r+",
+                                   shape=(n,))
+
+    def _decode_memmap(self, idxs, jpegs, out):
+        """Memmap twin of :meth:`_decode_cached`: rows live in the
+        disk-backed cache (decode-once per shard x geometry x file
+        digest, across Dataset instances AND runs), flags record
+        ok/failed per row. Deliberately vectorized rather than sharing
+        the dict path's per-row loop — one fancy-index gather per
+        batch is the memcpy-speed win the mode exists for. The
+        producer thread is the only writer in this process; the
+        digest-keyed per-shard filename keeps other datasets and
+        processes off this file."""
+        self._ensure_memmap()
+        ia = np.asarray(idxs, np.int64)
+        fl = self._mm_flags[ia]
+        missing = np.flatnonzero(fl == 0)
+        if len(missing):
+            self.decode_calls += int(len(missing))
+            fresh, fok = decode_resize_batch(
+                [jpegs[int(j)] for j in missing],
+                self.img_height,
+                self.img_width,
+                num_threads=self.num_decode_workers,
+            )
+            self._mm_rows[ia[missing]] = fresh
+            self._mm_flags[ia[missing]] = np.where(
+                np.asarray(fok, bool), 1, 2
+            ).astype(np.uint8)
+            fl = self._mm_flags[ia]
+        images = (
+            out
+            if out is not None
+            else np.empty(
+                (len(idxs), self.img_height, self.img_width, 3), np.uint8
+            )
+        )
+        images[: len(idxs)] = self._mm_rows[ia]
+        ok = (fl != 2).astype(np.uint8)
+        self._decode_failed.update(int(i) for i in ia[fl == 2])
+        return images, ok
+
     def _decode_cached(self, idxs, jpegs, out):
         """Assemble a batch from the decoded-row cache, decoding only
         rows not yet cached (epoch 1 fills it; epoch 2+ is pure memcpy).
@@ -372,7 +495,11 @@ class Dataset:
         ring), so they stay valid for the Dataset's lifetime. Returns
         (images, ok) — failed rows stay remembered so every epoch's
         batch substitution sees them, not just the one that decoded."""
-        missing = [j for j, i in enumerate(idxs) if i not in self._decoded_cache]
+        if self.cache_decoded == "memmap":
+            return self._decode_memmap(idxs, jpegs, out)
+        missing = [
+            j for j, i in enumerate(idxs) if i not in self._decoded_cache
+        ]
         if missing:
             self.decode_calls += len(missing)
             fresh, fok = decode_resize_batch(
