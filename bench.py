@@ -1082,6 +1082,12 @@ def main() -> int:
                    default="auto",
                    help="lm only: attention impl (tuning input — the "
                         "watcher captures both and keeps the faster)")
+    p.add_argument("--e2e-cache", choices=["ram", "memmap"], default="ram",
+                   help="decoded-row cache mode for --end2end: 'ram' "
+                        "(r03-comparable default) or 'memmap' (the r05 "
+                        "persistent disk-backed cache — a SECOND "
+                        "capture in the same workdir skips epoch-1 "
+                        "decode entirely)")
     p.add_argument("--bn-fold", action="store_true",
                    help="fold the frozen backbone's BatchNorms into "
                         "their convs (flagship cnn model only) — the "
@@ -1529,7 +1535,10 @@ def _bench_e2e(args, devices) -> int:
         _phase("converter ready")
         ds = conv.make_dataset(
             batch * n_chips, img_height=hw, img_width=hw,
-            cache_decoded=True, reuse_buffers=True,
+            cache_decoded=(
+                "memmap" if args.e2e_cache == "memmap" else True
+            ),
+            reuse_buffers=True,
         )
         mesh = build_mesh(MeshSpec(data=n_chips, model=1))
         trainer = Trainer(

@@ -84,6 +84,7 @@ while true; do
       --out CONVERGENCE_r05.json >> "$log" 2>&1 || ok=1
     # --- 4: input plane + serving -------------------------------------
     [ -f BENCH_LOCAL_r05_e2e.json ] || capture BENCH_LOCAL_r05_e2e.json --end2end --no-attn-diag --deadline 2300 --diag-out BENCH_DIAG_r05_e2e.json || ok=1
+    [ -f BENCH_LOCAL_r05_e2e_memmap.json ] || capture BENCH_LOCAL_r05_e2e_memmap.json --end2end --e2e-cache memmap --no-attn-diag --deadline 1200 --diag-out /tmp/diag_e2e_memmap.json || true
     [ -f BENCH_LOCAL_r05_generate.json ] || capture BENCH_LOCAL_r05_generate.json --model generate --no-attn-diag --diag-out /tmp/diag_generate.json || true
     # GQA decode probe (non-gating): kv cache / projections at 1/4
     [ -f BENCH_LOCAL_r05_generate_gqa.json ] || capture BENCH_LOCAL_r05_generate_gqa.json --model generate --kv-heads 2 --no-attn-diag --diag-out /tmp/diag_generate_gqa.json || true
