@@ -1,0 +1,183 @@
+"""Multi-process synchronized preemption (r05): SIGTERM on the PRIMARY
+→ lockstep stop at an agreement step → step checkpoint → exact resume.
+
+The multi-process half of the preemption story (single-process is
+tests/test_preempt.py): a per-process stop flag would break the
+identical-collective-schedule invariant, so the trainers broadcast the
+primary's flag every ``preempt_sync_every`` steps
+(train/preempt.agree_on_preempt) and the whole gang stops at the SAME
+global step. This test runs the full arc on a real 2-process gang:
+
+  1. first launch: rank 0 SIGTERMs ITSELF mid-epoch-1; both processes
+     agree at the next sync step, rank 0 writes checkpoint-step-N,
+     the gang exits CLEANLY (rc 0 — preemption is not a failure);
+  2. second launch (the relaunch after the preemption):
+     maybe_resume(steps_per_epoch=...) restores the exact position on
+     BOTH ranks and training finishes;
+  3. the final metrics parity-match an uninterrupted 2-process run.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent(
+    """
+    import json, os, signal, sys
+    sys.path.insert(0, os.environ["TPUFLOW_REPO"])
+    import tpuflow.core as core
+    core.initialize()
+    import jax
+    from tpuflow.core.config import Config
+    from tpuflow.data import TableStore
+    from tpuflow.data.loader import make_converter
+    from tpuflow.models import build_model
+    from tpuflow.train import Trainer
+
+    work = os.environ["TPUFLOW_TEST_WORK"]
+    sabotage = os.environ.get("TPUFLOW_SABOTAGE") == "1"
+    tag = os.environ["TPUFLOW_RUN_TAG"]
+    assert jax.process_count() == 2, jax.process_count()
+    pid = jax.process_index()
+
+    store = TableStore(os.path.join(work, "tables"), "db")
+    cfg = Config()
+    cfg.data.img_height = cfg.data.img_width = 32
+    cfg.data.batch_size = 4
+    cfg.data.shuffle = False
+    cfg.model.num_classes = 5
+    cfg.model.width_mult = 0.25
+    cfg.model.dropout = 0.0
+    cfg.train.epochs = 3
+    cfg.train.warmup_epochs = 0
+    ckdir = os.path.join(work, "ckpt") if tag != "oracle" else None
+    if ckdir:
+        cfg.train.checkpoint_dir = ckdir
+        cfg.train.checkpoint_on_preempt = True
+        cfg.train.preempt_sync_every = 2
+
+    model = build_model(num_classes=5, dropout=0.0, width_mult=0.25)
+    trainer = Trainer(model, cfg.train)
+    trainer.init_state((32, 32, 3))
+    spe = 4  # 32 rows / (batch 4 x 2 procs)
+    initial_epoch = (trainer.maybe_resume(ckdir, steps_per_epoch=spe)
+                     if ckdir else 0)
+
+    conv_t = make_converter(store.table("silver_train"),
+                            os.path.join(work, f"cache_{tag}_{pid}"),
+                            min_partitions=2)
+    kw = dict(cur_shard=pid, shard_count=2, img_height=32, img_width=32,
+              shuffle=False)
+    train_ds = conv_t.make_dataset(4, start_epoch=initial_epoch, **kw)
+    assert train_ds.steps_per_epoch() == spe, train_ds.steps_per_epoch()
+
+    class KillAt:
+        '''Rank 0 SIGTERMs ITSELF before yielding batch `at` — only the
+        PRIMARY sees the signal; the gang must still stop in lockstep
+        via the sync broadcast.'''
+        def __init__(self, ds, at):
+            self._ds, self._at = ds, at
+        def __getattr__(self, name):
+            return getattr(self._ds, name)
+        def __iter__(self):
+            for i, b in enumerate(self._ds):
+                if self._at is not None and i == self._at:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                yield b
+
+    kill = spe + 1 if (sabotage and pid == 0) else None
+    hist = trainer.fit(KillAt(train_ds, kill),
+                       initial_epoch=initial_epoch).history
+    conv_t.delete()
+
+    out = {
+        "initial_epoch": initial_epoch,
+        "epochs_trained": len(hist.get("loss", [])),
+        "preempted_at": hist.get("preempted_at_step"),
+        "final_loss": float(hist["loss"][-1]) if hist.get("loss") else None,
+        "params_sum": float(sum(
+            abs(jax.device_get(l)).sum()
+            for l in jax.tree.leaves(trainer.state.params)
+        )),
+    }
+    with open(os.path.join(work, f"out_{tag}_{pid}.json"), "w") as f:
+        json.dump(out, f)
+    print("proc", pid, tag, "done", out["epochs_trained"], flush=True)
+    """
+)
+
+
+def _make_tables(work, flower_dir):
+    from tpuflow.data import (TableStore, add_label_from_path,
+                              build_label_index, index_labels,
+                              ingest_images)
+
+    store = TableStore(os.path.join(work, "tables"), "db")
+    bronze = store.table("bronze")
+    ingest_images(str(flower_dir), bronze)
+    t = add_label_from_path(bronze.read())
+    t = index_labels(t, build_label_index(t))
+    store.table("silver_train").write(t.slice(0, 32), compression=None)
+
+
+def _launch(work, script, tag, sabotage, port):
+    from tpuflow.cli.launch import main
+
+    env_backup = dict(os.environ)
+    os.environ["TPUFLOW_REPO"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    os.environ["TPUFLOW_TEST_WORK"] = work
+    os.environ["TPUFLOW_RUN_TAG"] = tag
+    os.environ["TPUFLOW_SABOTAGE"] = "1" if sabotage else "0"
+    try:
+        return main(["--local", "2", "--port", str(port), "--",
+                     sys.executable, str(script)])
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+@pytest.mark.slow
+def test_multiproc_synchronized_preempt_and_resume(tmp_path, flower_dir):
+    work = str(tmp_path)
+    _make_tables(work, flower_dir)
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+
+    # 1. preempted launch: rank 0 self-SIGTERMs one step into epoch 1;
+    #    the gang must stop in lockstep and exit CLEANLY
+    rc = _launch(work, script, "pre", sabotage=True, port=8937)
+    assert rc == 0, "preemption must be a clean exit, not a gang failure"
+    a0 = json.load(open(os.path.join(work, "out_pre_0.json")))
+    a1 = json.load(open(os.path.join(work, "out_pre_1.json")))
+    # both ranks reported the SAME preemption step (lockstep stop at a
+    # sync point: sync_every=2)
+    assert a0["preempted_at"] and a0["preempted_at"] == a1["preempted_at"]
+    g = int(a0["preempted_at"][0])
+    assert 4 < g < 8 and g % 2 == 0, g  # inside epoch 1, on the cadence
+    assert any("checkpoint-step-" in f
+               for f in os.listdir(os.path.join(work, "ckpt")))
+
+    # 2. relaunch: exact resume on both ranks, finish epochs
+    rc = _launch(work, script, "post", sabotage=False, port=8941)
+    assert rc == 0
+    b0 = json.load(open(os.path.join(work, "out_post_0.json")))
+    b1 = json.load(open(os.path.join(work, "out_post_1.json")))
+    assert b0["initial_epoch"] == 1 and b1["initial_epoch"] == 1
+    assert b0["epochs_trained"] == 2  # epochs 1-2 only
+    np.testing.assert_allclose(b0["params_sum"], b1["params_sum"],
+                               rtol=1e-6)
+
+    # 3. uninterrupted oracle gang: same tables, no checkpointing
+    rc = _launch(work, script, "oracle", sabotage=False, port=8943)
+    assert rc == 0
+    c0 = json.load(open(os.path.join(work, "out_oracle_0.json")))
+    np.testing.assert_allclose(b0["final_loss"], c0["final_loss"],
+                               rtol=5e-4)
+    np.testing.assert_allclose(b0["params_sum"], c0["params_sum"],
+                               rtol=5e-5)

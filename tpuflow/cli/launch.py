@@ -113,10 +113,14 @@ def _run_local_cluster(n: int, port: int, cmd: List[str]) -> int:
                 pr.send_signal(signal.SIGTERM)
         for pr in procs:
             try:
-                pr.wait(timeout=30)
+                pr.wait(timeout=5)
             except subprocess.TimeoutExpired:
-                # a worker wedged in its SIGTERM handler must not hang
-                # the launcher (or orphan peers) — escalate; and a
+                # a worker wedged in its SIGTERM handler — including a
+                # preempt-enabled worker whose flag-only handler left
+                # it blocked inside a collective with a dead peer —
+                # must not hang the launcher (or orphan peers):
+                # escalate after a SHORT grace (clean exits are fast;
+                # wedged ones need SIGKILL anyway); and a
                 # worker that survives even SIGKILL (D-state I/O) must
                 # not abort the reap loop for its peers
                 pr.kill()

@@ -112,10 +112,16 @@ class TrainConfig:
     # rank-0), and stops cleanly; maybe_resume(steps_per_epoch=...)
     # restores it EXACTLY — same epoch, same position in the stream
     # (fit fast-forwards the skipped batches). Requires checkpoint_dir.
-    # SINGLE-PROCESS only for now: a per-process stop flag would break
-    # the identical-collective-schedule invariant; multi-process runs
-    # warn and keep gang-restart semantics (--restarts + epoch ckpts).
+    # Multi-process runs take the stop decision via a synchronized
+    # any-host OR-reduction of the SIGTERM flags every
+    # preempt_sync_every steps, so all processes stop at the SAME step
+    # (identical-collective-schedule invariant preserved; per-VM spot
+    # reclamation signals only one host — see tpuflow.train.preempt).
     checkpoint_on_preempt: bool = False
+    # step cadence of the multi-process preemption agreement broadcast
+    # (a host-sync per check — 16 amortizes it away while bounding the
+    # post-signal latency to <= 16 steps; ignored single-process)
+    preempt_sync_every: int = 16
     # >0: every N epochs assert replicas/processes hold identical state
     # and params are finite (tpuflow.core.debug — the checkable form of
     # the broadcast-init invariant, P1/03:305-308)

@@ -744,11 +744,14 @@ class LMTrainer:
         # finishes the current step, writes a step checkpoint, stops
         # cleanly (same contract as the image Trainer). Gates and
         # handler install/restore are shared in train/preempt.py.
-        from tpuflow.train.preempt import sigterm_preempt_flag
+        from tpuflow.train.preempt import (should_stop,
+                                           sigterm_preempt_flag)
 
         use_preempt = bool(
             getattr(cfg, "checkpoint_on_preempt", False) and checkpoint_dir
         )
+        preempt_mp = jax.process_count() > 1
+        sync_every = int(getattr(cfg, "preempt_sync_every", 16))
         if skip_steps:
             # the stashed mid-epoch position is only meaningful for the
             # EXACT topology maybe_resume was told about — a different
@@ -790,7 +793,8 @@ class LMTrainer:
                 t_epoch = None
                 timed_steps = 0
                 for i in range(first_i, steps_per_epoch):
-                    if preempt["hit"]:
+                    if use_preempt and should_stop(
+                            preempt, global_step, sync_every, preempt_mp):
                         preempted = True
                         break
                     if ds is not None:

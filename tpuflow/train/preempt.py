@@ -4,18 +4,24 @@ One context manager serves both ``Trainer.fit`` and ``LMTrainer.fit``:
 it yields a mutable ``{"hit": bool}`` flag that a SIGTERM flips — the
 handler does nothing else; all device/filesystem work happens in the
 trainer's loop context — and restores the previous handler on exit,
-exceptions included. The gates live here so the two fit loops cannot
-drift apart:
+exceptions included.
 
-- multi-process: DISABLED with a warning. A per-process stop flag
-  breaks the identical-collective-schedule invariant (processes
-  stopping at different steps → mismatched pmeans → deadlock);
-  multi-process preemption stays at gang granularity (launcher
-  ``--restarts`` + epoch checkpoints — tests/test_multiproc_killresume
-  proves that path) until a synchronized agreement step exists.
-- non-main thread: DISABLED with a warning (``signal.signal`` is a
-  main-thread-only API). A threaded HPO driver believing its trials
-  are preemption-safe must hear otherwise.
+Single-process: the loop checks the local flag every step.
+
+Multi-process: a per-process flag alone would break the identical-
+collective-schedule invariant (processes stopping at different steps →
+mismatched pmeans → deadlock), so the loop instead calls
+:func:`should_stop` at a fixed step cadence
+(``TrainConfig.preempt_sync_every``) — an OR-reduction of EVERY
+host's flag (allgather + max), so every process takes the stop
+decision at the SAME global step. Any-host semantics matter: per-VM
+spot reclamation SIGTERMs only the host being reclaimed, and a
+primary-only rule would sleep through exactly the notices the feature
+exists for.
+
+Non-main thread: DISABLED with a warning (``signal.signal`` is a
+main-thread-only API). A threaded HPO driver believing its trials are
+preemption-safe must hear otherwise.
 """
 
 from __future__ import annotations
@@ -33,16 +39,6 @@ def sigterm_preempt_flag(enabled: bool):
     import threading
     import warnings
 
-    import jax
-
-    if jax.process_count() > 1:
-        warnings.warn(
-            "checkpoint_on_preempt is single-process only for now; "
-            "multi-process runs keep gang-restart semantics "
-            "(--restarts + epoch checkpoints)", stacklevel=3,
-        )
-        yield flag
-        return
     if threading.current_thread() is not threading.main_thread():
         warnings.warn(
             "checkpoint_on_preempt needs fit() on the MAIN thread "
@@ -58,3 +54,32 @@ def sigterm_preempt_flag(enabled: bool):
         yield flag
     finally:
         signal.signal(signal.SIGTERM, old)
+
+
+def agree_on_preempt(flag: dict) -> bool:
+    """Multi-process stop agreement: OR-reduce every host's flag
+    (allgather + max) — ANY host's SIGTERM stops the whole gang at the
+    same step (per-VM spot reclamation signals only the reclaimed
+    host). The reduction is itself a collective: call it at the SAME
+    step on every process (the trainers' lockstep loops guarantee
+    this via :func:`should_stop`)."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    vals = multihost_utils.process_allgather(
+        np.int32(1 if flag["hit"] else 0)
+    )
+    return bool(np.max(vals))
+
+
+def should_stop(flag: dict, global_step: int, sync_every: int,
+                multiprocess: bool) -> bool:
+    """THE per-step stop decision, shared by both fit loops so their
+    cadence logic can never drift: single-process reads the local flag
+    every step; multi-process agrees collectively every
+    ``sync_every``-th global step."""
+    if not multiprocess:
+        return bool(flag["hit"])
+    if global_step % max(1, int(sync_every)):
+        return False
+    return agree_on_preempt(flag)

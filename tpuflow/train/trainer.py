@@ -378,15 +378,19 @@ class Trainer:
 
         # preemption-safe mode (cfg.checkpoint_on_preempt): SIGTERM
         # sets a flag; the step loop finishes the CURRENT step, writes
-        # a step-granular checkpoint, and stops cleanly. Gates (multi-
-        # process and non-main-thread both disable with a warning) and
-        # handler install/restore live in train/preempt.py, shared
-        # with LMTrainer.
-        from tpuflow.train.preempt import sigterm_preempt_flag
+        # a step-granular checkpoint, and stops cleanly. Multi-process
+        # gangs agree collectively (any-host OR) every
+        # preempt_sync_every steps so every process stops at the SAME
+        # step; handler install/restore and the stop decision live in
+        # train/preempt.py, shared with LMTrainer.
+        from tpuflow.train.preempt import (should_stop,
+                                           sigterm_preempt_flag)
 
         use_preempt = bool(
             self.cfg.checkpoint_on_preempt and self.cfg.checkpoint_dir
         )
+        preempt_mp = jax.process_count() > 1
+        sync_every = int(getattr(self.cfg, "preempt_sync_every", 16))
 
         # exact mid-epoch resume (maybe_resume with steps_per_epoch):
         # fast-forward the stream to the checkpointed position — the
@@ -436,7 +440,8 @@ class Trainer:
                     skip_steps if epoch == initial_epoch else 0
                 )
                 for _ in range(steps_this_epoch):
-                    if preempt["hit"]:
+                    if use_preempt and should_stop(
+                            preempt, global_step, sync_every, preempt_mp):
                         preempted = True
                         break
                     lr = self.lr_controller.lr_for_step(global_step)
