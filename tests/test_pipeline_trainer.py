@@ -302,3 +302,51 @@ def test_pipeline_trainer_validation():
             _lm(depth=2), _cfg(),
             mesh=build_nd_mesh({"data": 2}, devices=jax.devices()[:2]),
         )
+
+
+def test_pipeline_preempt_and_exact_resume(tmp_path):
+    """PipelineTrainer inherits the preemption contract from
+    LMTrainer.fit (r05): SIGTERM mid-epoch → step checkpoint → exact
+    resume → same final params as an uninterrupted pipelined run."""
+    import os
+    import signal
+
+    toks = _corpus(24, 16)
+    mesh = build_nd_mesh({"pipe": 4}, devices=jax.devices()[:4])
+
+    def trainer(preempt=False):
+        return PipelineTrainer(
+            _lm(), _cfg(checkpoint_on_preempt=preempt), mesh=mesh,
+            n_microbatches=4, schedule="1f1b",
+        )
+
+    ckdir = str(tmp_path / "ck")
+    spe = 24 // 8  # 3 steps/epoch
+
+    tr_a = trainer()
+    tr_a.fit(toks, batch_size=8, epochs=3)
+    params_a = jax.device_get(tr_a.state.params)
+
+    # SIGTERM during _put of global step 5 (epoch 1, step 2)
+    tr_b = trainer(preempt=True)
+    orig_put = tr_b._put
+    calls = {"n": 0}
+
+    def killing_put(rows):
+        calls["n"] += 1
+        if calls["n"] == 5:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return orig_put(rows)
+
+    tr_b._put = killing_put
+    m_b = tr_b.fit(toks, batch_size=8, epochs=3, checkpoint_dir=ckdir)
+    assert m_b.get("preempted_at_step") == 5.0, m_b
+
+    tr_c = trainer(preempt=True)
+    initial = tr_c.maybe_resume(ckdir, steps_per_epoch=spe)
+    assert initial == 1 and tr_c._resume_skip_steps == 2
+    tr_c.fit(toks, batch_size=8, epochs=3, checkpoint_dir=ckdir)
+    params_c = jax.device_get(tr_c.state.params)
+    for a, c in zip(jax.tree.leaves(params_a), jax.tree.leaves(params_c)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=1e-6, rtol=1e-6)

@@ -292,13 +292,37 @@ class PipelineTrainer(LMTrainer):
                     stacked, NamedSharding(self.mesh, P(PIPE_AXIS))
                 ),
             }
+        # commit EVERY leaf's placement explicitly: params carry their
+        # pipe/model shardings above; scalars (step/rng/plateau) and
+        # the optimizer's unsharded leaves (hyperparams, counts) get
+        # the replicated sharding. Leaving them uncommitted happened to
+        # work for fresh fits, but restore_into_state maps checkpoints
+        # onto the TEMPLATE's shardings — an uncommitted scalar commits
+        # the restored state to ONE device and the first step fails on
+        # conflicting placements (same bug class fixed in LMTrainer
+        # init_state, surfaced by the r05 preemption-resume tests).
+        from tpuflow.parallel.mesh import put_replicated
+
+        rep = NamedSharding(self.mesh, P())
+
+        def _commit_rep(x):
+            sh = getattr(x, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                return x  # already mesh-committed (follows its param)
+            # put_replicated, not raw device_put: multi-process meshes
+            # are non-addressable, and typed PRNG keys need the
+            # key-data round-trip either way
+            return put_replicated(x, rep)
+
         self.state = TrainState(
-            step=jnp.asarray(0, jnp.int32),
+            step=put_replicated(jnp.asarray(0, jnp.int32), rep),
             params=params,
             batch_stats={},
-            opt_state=self.tx.init(params),
-            rng=jax.random.key(seed),
-            plateau_factor=jnp.asarray(1.0, jnp.float32),
+            opt_state=jax.tree.map(_commit_rep, self.tx.init(params)),
+            rng=put_replicated(jax.random.key(seed), rep),
+            plateau_factor=put_replicated(
+                jnp.asarray(1.0, jnp.float32), rep
+            ),
         )
         return self.state
 
