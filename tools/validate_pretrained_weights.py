@@ -377,9 +377,44 @@ def validate_model(model: str, sd, hw: int) -> dict:
             f"{model}: feature parity FAILED: rel {rec['max_rel_err']:.3e}"
             f" > {tol} (abs {max_abs:.3e})"
         )
+
+    # r05: the production FOLD path must preserve parity too — fold the
+    # loaded (converted) weights into a fold_bn=True twin and compare
+    # features against the UNFOLDED flax forward (which step 4 just
+    # proved equals the torch oracle). Fold is exact per layer, so this
+    # tolerance is pure bf16-free f32 rounding — far tighter than the
+    # converter tolerance above.
+    from tpuflow.models.classifier import BACKBONE, fold_backbone_variables
+
+    folded_vars = fold_backbone_variables(
+        {
+            "params": {BACKBONE: wrapped["params"]["backbone"]},
+            "batch_stats": {BACKBONE: wrapped["batch_stats"]["backbone"]},
+        },
+        backbone=model,
+    )
+    folded_bb = (
+        MobileNetV2(width_mult=1.0, dtype=jnp.float32, fold_bn=True)
+        if model == "mobilenet_v2"
+        else ResNet(depth=int(model.replace("resnet", "")),
+                    dtype=jnp.float32, fold_bn=True)
+    )
+    feats_fold = np.asarray(
+        folded_bb.apply(
+            {"params": folded_vars["params"][BACKBONE]},
+            jnp.asarray(x), train=False,
+        )
+    )
+    fold_rel = float(np.abs(feats_fold - feats).max()) / denom
+    rec["fold_max_rel_err"] = fold_rel
+    if fold_rel > 1e-4:
+        raise RuntimeError(
+            f"{model}: BN-fold parity FAILED: rel {fold_rel:.3e} > 1e-4"
+        )
     print(f"  {model}: parity ok — max_rel_err {rec['max_rel_err']:.2e} "
           f"over {rec['n_converted_tensors']} tensors, "
-          f"features {tuple(feats.shape)}")
+          f"features {tuple(feats.shape)}; fold parity "
+          f"{fold_rel:.2e}")
     return rec
 
 
