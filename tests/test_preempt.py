@@ -251,3 +251,58 @@ def test_resume_skip_mismatch_guards(tmp_path):
     assert t2._resume_skip_steps == 3
     with pytest.raises(ValueError, match="overrides the resumed"):
         t2.fit(toks, batch_size=8, epochs=3, initial_epoch=2)
+
+
+def test_async_checkpoint_writes_identical_files(tmp_path):
+    """async_checkpoint=True overlaps serialize+write with training;
+    the files must be byte-identical in CONTENT semantics (same
+    restored state) to the synchronous path, durable at fit() return,
+    and resumable."""
+    import numpy as _np
+
+    from tpuflow.ckpt import latest_checkpoint, restore_into_state
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.train import LMTrainer
+
+    toks = _np.random.default_rng(9).integers(
+        1, 64, (16, 16)).astype(_np.int32)
+    kw = dict(vocab_size=64, dim=32, depth=1, heads=2)
+
+    outs = {}
+    for mode in ("sync", "async"):
+        ckdir = str(tmp_path / mode)
+        tr = LMTrainer(
+            build_transformer_lm(**kw),
+            TrainConfig(learning_rate=1e-3, warmup_epochs=0,
+                        async_checkpoint=(mode == "async")),
+        )
+        tr.fit(toks, batch_size=8, epochs=2, checkpoint_dir=ckdir)
+        # durable at return: restore immediately
+        t2 = LMTrainer(build_transformer_lm(**kw), TrainConfig())
+        t2.init_state()
+        t2.state = restore_into_state(latest_checkpoint(ckdir), t2.state)
+        outs[mode] = jax.device_get(t2.state.params)
+    for a, b in zip(jax.tree.leaves(outs["sync"]),
+                    jax.tree.leaves(outs["async"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_checkpoint_write_failure_surfaces(tmp_path):
+    """A failed background write must raise in the TRAINING thread at
+    the next save/wait — not vanish."""
+    from tpuflow.ckpt import AsyncCheckpointer
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.train import LMTrainer
+
+    tr = LMTrainer(build_transformer_lm(vocab_size=64, dim=32, depth=1,
+                                        heads=2),
+                   TrainConfig(warmup_epochs=0))
+    tr.init_state()
+    ck = AsyncCheckpointer()
+    bad = str(tmp_path / "not_a_dir_file")
+    open(bad, "w").write("file, not dir")
+    ck.save(bad, tr.state, step=1)  # background mkdir/tempfile fails
+    with pytest.raises(RuntimeError, match="async checkpoint write"):
+        ck.wait()

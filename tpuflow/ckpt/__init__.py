@@ -1,4 +1,5 @@
 from tpuflow.ckpt.checkpoint import (  # noqa: F401
+    AsyncCheckpointer,
     latest_checkpoint,
     latest_resume_point,
     list_checkpoints,

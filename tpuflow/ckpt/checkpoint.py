@@ -20,6 +20,7 @@ consistency story BroadcastGlobalVariablesCallback documents
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 import tempfile
@@ -118,14 +119,88 @@ def save_checkpoint(
     replicated/addressable state (the Trainer default) non-primary
     processes may skip the call entirely — there is no collective.
     """
+    payload = _build_payload(state, weights_only)
+    return _atomic_save(checkpoint_dir, _path(checkpoint_dir, step), payload)
+
+
+def _build_payload(state: Any, weights_only: bool):
+    """THE checkpoint payload (shared by the sync and async writers so
+    their file contents can never diverge): full host-fetched state
+    dict, or the reference's weights-only (params+batch_stats) form."""
     if weights_only:
-        payload = {
+        return {
             "params": _host_fetch(state.params),
             "batch_stats": _host_fetch(state.batch_stats),
         }
-    else:
-        payload = _host_fetch(serialization.to_state_dict(_unkey(state)))
-    return _atomic_save(checkpoint_dir, _path(checkpoint_dir, step), payload)
+    return _host_fetch(serialization.to_state_dict(_unkey(state)))
+
+
+@contextlib.contextmanager
+def join_async_writes(get_checkpointers):
+    """finally-join background checkpoint writes: stacked into the
+    trainers' fit ``with`` blocks so an EXCEPTIONAL exit still makes
+    the in-flight write durable (and surfaces its failure) instead of
+    abandoning a daemon thread mid-write — the sync path would have
+    completed that checkpoint before the exception propagated.
+    ``get_checkpointers`` is a callable (the checkpointer may be
+    created lazily inside the loop)."""
+    try:
+        yield
+    finally:
+        for c in get_checkpointers():
+            if c is not None:
+                c.wait()
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint WRITES with training (r05).
+
+    The device→host fetch — and, for cross-process-sharded ZeRO/FSDP
+    state, the assembling allgather — must stay synchronous (it is a
+    collective and it snapshots the state before the next step mutates
+    it), but the serialize + atomic write is pure host work:
+    :meth:`save` runs it on a background thread and returns once the
+    PAYLOAD is captured, so the train loop overlaps the disk write
+    with the next epoch. One write in flight at a time: ``save`` joins
+    the previous write first (ordering + error propagation), and
+    :meth:`wait` joins the last one — call it at train end (the
+    trainers do) or before reading the files back.
+    """
+
+    def __init__(self):
+        import threading
+
+        self._threading = threading
+        self._thread = None
+        self._err: "BaseException | None" = None
+
+    def save(self, checkpoint_dir: str, state: Any, step: int,
+             weights_only: bool = False) -> str:
+        self.wait()
+        payload = _build_payload(state, weights_only)
+        path = _path(checkpoint_dir, step)
+
+        def run():
+            try:
+                _atomic_save(checkpoint_dir, path, payload)
+            except BaseException as e:  # surfaced by the next wait()
+                self._err = e
+
+        self._thread = self._threading.Thread(
+            target=run, name=f"ckpt-write-{step}", daemon=True
+        )
+        self._thread.start()
+        return path
+
+    def wait(self) -> None:
+        """Join the in-flight write; re-raise its failure here (in the
+        caller's thread) if it had one."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError("async checkpoint write failed") from err
 
 
 _STEP_PAT = re.compile(r"checkpoint-step-(\d+)\.ckpt$")

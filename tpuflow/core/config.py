@@ -118,6 +118,11 @@ class TrainConfig:
     # (identical-collective-schedule invariant preserved; per-VM spot
     # reclamation signals only one host — see tpuflow.train.preempt).
     checkpoint_on_preempt: bool = False
+    # overlap epoch-checkpoint WRITES with training: the host fetch
+    # (and any ZeRO allgather) stays synchronous, the serialize+write
+    # runs on a background thread (tpuflow.ckpt.AsyncCheckpointer) —
+    # joined before the next write and at train end
+    async_checkpoint: bool = False
     # step cadence of the multi-process preemption agreement broadcast
     # (a host-sync per check — 16 amortizes it away while bounding the
     # post-signal latency to <= 16 steps; ignored single-process)

@@ -119,9 +119,18 @@ class ModelCheckpoint(Callback):
     ``save_weights_only=True`` gives the reference's weights-only files.
     """
 
-    def __init__(self, checkpoint_dir: str, save_weights_only: bool = False):
+    def __init__(self, checkpoint_dir: str, save_weights_only: bool = False,
+                 async_write: bool = False):
         self.checkpoint_dir = checkpoint_dir
         self.save_weights_only = save_weights_only
+        # async_write: the host fetch stays synchronous here (it is a
+        # snapshot AND, for ZeRO state, a collective), the serialize +
+        # atomic write overlaps the next epoch (ckpt.AsyncCheckpointer)
+        self._async = None
+        if async_write:
+            from tpuflow.ckpt import AsyncCheckpointer
+
+            self._async = AsyncCheckpointer()
 
     def on_epoch_end(self, epoch, logs):
         from tpuflow.core import is_primary
@@ -141,12 +150,22 @@ class ModelCheckpoint(Callback):
         )
         if not is_primary() and not is_cross_process_sharded(saved):
             return
+        if self._async is not None:
+            self._async.save(
+                self.checkpoint_dir, state, step=epoch + 1,
+                weights_only=self.save_weights_only,
+            )
+            return
         save_checkpoint(
             self.checkpoint_dir,
             state,
             step=epoch + 1,
             weights_only=self.save_weights_only,
         )
+
+    def on_train_end(self):
+        if self._async is not None:
+            self._async.wait()
 
 
 class TrackingCallback(Callback):

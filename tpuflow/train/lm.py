@@ -156,6 +156,7 @@ class LMTrainer:
         self._eval_step = None
         self.lr_controller: Optional[LRController] = None
         self._initial_epoch = 0
+        self._async_ckpt = None  # lazy AsyncCheckpointer (cfg.async_checkpoint)
         self._flops_per_step: Optional[float] = None  # XLA cost analysis
 
     # ---- initialization --------------------------------------------------
@@ -779,8 +780,11 @@ class LMTrainer:
         # shapes would corrupt MFU / fail on call
         self._flops_per_step = None
         self._step_exec = None
+        from tpuflow.ckpt.checkpoint import join_async_writes
+
         preempted = False
-        with sigterm_preempt_flag(use_preempt) as preempt:
+        with sigterm_preempt_flag(use_preempt) as preempt, \
+                join_async_writes(lambda: [self._async_ckpt]):
             for epoch in range(start, epochs):
                 first_i = skip_steps if epoch == start else 0
                 if ds is not None:
@@ -883,7 +887,18 @@ class LMTrainer:
                     for k, v in metrics.items():
                         run.log_metric(k, float(v), step=epoch)
                 if checkpoint_dir:
-                    save_checkpoint(checkpoint_dir, self.state, epoch + 1)
+                    if getattr(cfg, "async_checkpoint", False):
+                        if self._async_ckpt is None:
+                            from tpuflow.ckpt import AsyncCheckpointer
+
+                            self._async_ckpt = AsyncCheckpointer()
+                        self._async_ckpt.save(
+                            checkpoint_dir, self.state, epoch + 1
+                        )
+                    else:
+                        save_checkpoint(
+                            checkpoint_dir, self.state, epoch + 1
+                        )
                 if on_epoch is not None:
                     on_epoch(epoch, metrics)
         return metrics

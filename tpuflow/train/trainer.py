@@ -432,8 +432,12 @@ class Trainer:
         train_iter = self._prefetch(raw_iter)
         global_step = initial_epoch * steps_per_epoch + skip_steps
         lr = self.lr_controller.lr_for_step(global_step)
+        from tpuflow.ckpt.checkpoint import join_async_writes
+
         preempted = False
-        with sigterm_preempt_flag(use_preempt) as preempt:
+        with sigterm_preempt_flag(use_preempt) as preempt, \
+                join_async_writes(lambda: [
+                    getattr(cb, "_async", None) for cb in cbs]):
             for epoch in range(initial_epoch, epochs):
                 step_metrics = []
                 steps_this_epoch = steps_per_epoch - (
@@ -512,7 +516,10 @@ class Trainer:
         if cfg.early_stopping_patience and EarlyStopping not in have:
             out.append(EarlyStopping(patience=cfg.early_stopping_patience))
         if cfg.checkpoint_dir and ModelCheckpoint not in have:
-            out.append(ModelCheckpoint(cfg.checkpoint_dir))
+            out.append(ModelCheckpoint(
+                cfg.checkpoint_dir,
+                async_write=getattr(cfg, 'async_checkpoint', False),
+            ))
         if cfg.consistency_check_every > 0:
             from tpuflow.train.callbacks import ReplicaConsistencyCheck
 
