@@ -235,3 +235,35 @@ def test_sampled_rows_invariant_to_pad_rows():
     a = generate(m, params, p2, 6, temperature=1.0, top_k=5, seed=42)
     b = generate(m, params, p4, 6, temperature=1.0, top_k=5, seed=42)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[:2])
+
+
+def test_decode_cache_matches_full_forward_with_rope_scaling():
+    """The KV-cache decode path applies the SAME rope_scaling as the
+    full forward (r05 context extension): one-at-a-time decode must
+    reproduce the scaled model's full-sequence logits exactly."""
+    m = _tiny_lm().clone(rope_scaling=2.0)
+    rng = np.random.default_rng(4)
+    toks = jnp.asarray(rng.integers(0, 32, (2, 10)).astype(np.int32))
+    params = _params(m)
+    ref = m.apply({"params": params}, toks)
+
+    dm = m.clone(decode=True)
+    cache = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(
+            lambda: dm.init({"params": jax.random.key(0)}, toks)["cache"]
+        ),
+    )
+    outs = []
+    for t in range(toks.shape[1]):
+        logits, vars2 = dm.apply(
+            {"params": params, "cache": cache}, toks[:, t : t + 1],
+            mutable=["cache"],
+        )
+        cache = vars2["cache"]
+        outs.append(logits[:, 0])
+    got = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        atol=2e-4, rtol=2e-4,
+    )

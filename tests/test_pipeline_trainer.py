@@ -350,3 +350,28 @@ def test_pipeline_preempt_and_exact_resume(tmp_path):
     for a, c in zip(jax.tree.leaves(params_a), jax.tree.leaves(params_c)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    atol=1e-6, rtol=1e-6)
+
+
+def test_pipeline_threads_attention_fields():
+    """_stage_fn rebuilds DecoderBlock from the model's fields — every
+    attention-shaping field must thread (a silent default here would
+    make the pipelined model compute different math than the same
+    model under LMTrainer). Pinned via loss parity with
+    rope_scaling + attn_window set."""
+    toks = _corpus(24, 16)
+    kw = dict(rope_scaling=2.0, attn_window=8)
+    mesh = build_nd_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    tr_pp = PipelineTrainer(
+        build_transformer_lm(vocab_size=VOCAB, dim=32, depth=4, heads=4,
+                             mlp_ratio=2, dtype=jnp.float32, **kw),
+        _cfg(), mesh=mesh, n_microbatches=4, schedule="gpipe",
+    )
+    losses_pp = _fit_losses(tr_pp, toks)
+    tr_ref = LMTrainer(
+        build_transformer_lm(vocab_size=VOCAB, dim=32, depth=4, heads=4,
+                             mlp_ratio=2, dtype=jnp.float32, **kw),
+        _cfg(),
+        mesh=build_nd_mesh({"data": 1}, devices=jax.devices()[:1]),
+    )
+    losses_ref = _fit_losses(tr_ref, toks)
+    np.testing.assert_allclose(losses_pp, losses_ref, rtol=2e-4)

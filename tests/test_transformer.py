@@ -318,3 +318,52 @@ def test_tied_embeddings():
             mesh=build_nd_mesh({"pipe": 1}, devices=jax.devices()[:1]),
             n_microbatches=1,
         )
+
+
+# ---------------------------------------------------------------------------
+# linear RoPE position interpolation (rope_scaling, r05 context extension)
+# ---------------------------------------------------------------------------
+
+
+def test_rope_scaling_identity_and_interpolation():
+    from tpuflow.models.transformer import rotary_embed
+
+    q = jax.random.normal(jax.random.key(0), (2, 2, 8, 16))
+    k = jax.random.normal(jax.random.key(1), (2, 2, 8, 16))
+    pos = jnp.arange(8)
+    # 1.0 is bitwise the unscaled path
+    a = rotary_embed(q, k, pos)
+    b = rotary_embed(q, k, pos, scaling=1.0)
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    # the interpolation identity: rotations at positions s*p under
+    # scaling s == rotations at p unscaled
+    c = rotary_embed(q, k, pos * 4, scaling=4.0)
+    np.testing.assert_allclose(np.asarray(c[0]), np.asarray(a[0]),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(c[1]), np.asarray(a[1]),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_rope_scaling_model_level():
+    from tpuflow.models import build_transformer_lm
+
+    toks = jax.random.randint(jax.random.key(2), (2, 16), 0, 64)
+    m1 = build_transformer_lm(vocab_size=64, dim=32, depth=1, heads=2)
+    m2 = build_transformer_lm(vocab_size=64, dim=32, depth=1, heads=2,
+                              rope_scaling=2.0)
+    params = m1.init({"params": jax.random.key(3)}, toks)["params"]
+    y1 = m1.apply({"params": params}, toks)
+    y2 = m2.apply({"params": params}, toks)
+    assert np.all(np.isfinite(np.asarray(y1, np.float32)))
+    assert np.all(np.isfinite(np.asarray(y2, np.float32)))
+    # scaling changes the positional geometry (not a no-op)...
+    assert not np.allclose(np.asarray(y1, np.float32),
+                           np.asarray(y2, np.float32))
+    # ...but position 0 rotations are identity either way: the FIRST
+    # token's logits agree exactly
+    np.testing.assert_allclose(np.asarray(y1[:, 0], np.float32),
+                               np.asarray(y2[:, 0], np.float32),
+                               atol=1e-5, rtol=1e-5)
+    with pytest.raises(ValueError, match="rope_scaling"):
+        build_transformer_lm(vocab_size=64, dim=32, depth=1, heads=2,
+                             rope_scaling=0.5)

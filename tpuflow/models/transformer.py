@@ -59,7 +59,8 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(self.dtype)
 
 
-def rotary_embed(q, k, positions, theta: float = 10000.0):
+def rotary_embed(q, k, positions, theta: float = 10000.0,
+                 scaling: float = 1.0):
     """Apply rotary position embeddings to q, k of shape (B, H, S, D).
 
     ``positions``: (S,) int32 GLOBAL token positions — under sequence
@@ -67,11 +68,21 @@ def rotary_embed(q, k, positions, theta: float = 10000.0):
     rotations agree across shards — or (B, S) PER-ROW positions
     (sequence packing: each packed document restarts at 0). Computed
     in float32.
+
+    ``scaling`` (linear RoPE position interpolation, Chen et al. 2023):
+    positions are divided by the factor before the rotation, squeezing
+    an s×-longer context into the angle range the model trained on —
+    the standard cheap context-extension lever (fine-tune briefly at
+    the new length). Identity at 1.0; rotations at position s·p under
+    scaling s equal rotations at p unscaled.
     """
     d = q.shape[-1]
     half = d // 2
     inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
-    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., S, half)
+    pos = positions.astype(jnp.float32)
+    if scaling != 1.0:
+        pos = pos / scaling
+    angles = pos[..., None] * inv_freq  # (..., S, half)
     if angles.ndim == 2:  # (S, half): shared across batch and heads
         cos = jnp.cos(angles)[None, None, :, :]
         sin = jnp.sin(angles)[None, None, :, :]
@@ -112,6 +123,9 @@ class CausalAttention(nn.Module):
     # rows per kernel grid cell — the short-sequence per-cell-overhead
     # amortizer. 1 = classic kernel; ignored by einsum/ring paths.
     attn_bh_block: int = 1
+    # linear RoPE position interpolation factor (context extension);
+    # 1.0 = off. Applies in training AND the KV-cache decode path.
+    rope_scaling: float = 1.0
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions_override=None):
@@ -165,7 +179,8 @@ class CausalAttention(nn.Module):
                 i = ci.value
                 max_len = ck.value.shape[2]
                 positions = i + jnp.arange(s, dtype=jnp.int32)
-                q, k = rotary_embed(q, k, positions, self.rope_theta)
+                q, k = rotary_embed(q, k, positions, self.rope_theta,
+                                self.rope_scaling)
                 ck.value = lax.dynamic_update_slice(ck.value, k, (0, 0, i, 0))
                 cv.value = lax.dynamic_update_slice(cv.value, v, (0, 0, i, 0))
                 ci.value = i + s
@@ -197,7 +212,8 @@ class CausalAttention(nn.Module):
             else:
                 # init pass: shapes only (cache created above)
                 positions = jnp.arange(s, dtype=jnp.int32)
-                q, k = rotary_embed(q, k, positions, self.rope_theta)
+                q, k = rotary_embed(q, k, positions, self.rope_theta,
+                                self.rope_scaling)
                 o = mha_xla(q, expand_kv(k), expand_kv(v), causal=True,
                             window=self.attn_window)
         else:
@@ -213,7 +229,8 @@ class CausalAttention(nn.Module):
                 positions = jnp.arange(s, dtype=jnp.int32)
             if positions_override is not None:
                 positions = positions_override  # packed per-doc offsets
-            q, k = rotary_embed(q, k, positions, self.rope_theta)
+            q, k = rotary_embed(q, k, positions, self.rope_theta,
+                                self.rope_scaling)
 
             if self.seq_axis is not None:
                 if self.attn_window is not None:
@@ -292,6 +309,7 @@ class DecoderBlock(nn.Module):
     attn_window: Optional[int] = None
     kv_heads: Optional[int] = None  # grouped-query attention (GQA)
     attn_bh_block: int = 1  # batched-bh flash grid (see CausalAttention)
+    rope_scaling: float = 1.0  # linear RoPE interpolation (see CausalAttention)
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
@@ -300,6 +318,7 @@ class DecoderBlock(nn.Module):
             self.rope_theta, self.decode, self.sp_layout,
             attn_window=self.attn_window, kv_heads=self.kv_heads,
             attn_bh_block=self.attn_bh_block,
+            rope_scaling=self.rope_scaling,
             name="attn",
         )(RMSNorm(self.dtype, name="norm1")(x), segment_ids, positions)
         y = RMSNorm(self.dtype, name="norm2")(x)
@@ -403,6 +422,7 @@ class TransformerLM(nn.Module):
     attn_window: Optional[int] = None  # sliding-window (local) attention
     kv_heads: Optional[int] = None  # grouped-query attention (GQA/MQA)
     attn_bh_block: int = 1  # batched-bh flash grid (see CausalAttention)
+    rope_scaling: float = 1.0  # linear RoPE interpolation (see CausalAttention)
     # weight tying: reuse the embedding table as the LM head (GPT-2 /
     # Gemma style) — drops the (dim, vocab) head parameter entirely
     tie_embeddings: bool = False
@@ -459,6 +479,7 @@ class TransformerLM(nn.Module):
                 attn_window=self.attn_window,
                 kv_heads=self.kv_heads,
                 attn_bh_block=self.attn_bh_block,
+                rope_scaling=self.rope_scaling,
                 name=f"block{i}",
             )(x, segment_ids, positions)
         x = RMSNorm(self.dtype, name="norm_final")(x)
@@ -496,6 +517,7 @@ def build_transformer_lm(
     kv_heads: Optional[int] = None,
     tie_embeddings: bool = False,
     attn_bh_block: int = 1,
+    rope_scaling: float = 1.0,
 ) -> TransformerLM:
     if dim % heads:
         raise ValueError("dim must be a multiple of heads")
@@ -507,6 +529,11 @@ def build_transformer_lm(
             )
     if (dim // heads) % 2:
         raise ValueError("head_dim must be even (rotary pairs)")
+    if rope_scaling < 1.0:
+        raise ValueError(
+            f"rope_scaling must be >= 1.0 (a context-EXTENSION factor), "
+            f"got {rope_scaling}"
+        )
     if sp_layout not in ("contiguous", "striped"):
         raise ValueError(
             f"sp_layout must be contiguous|striped, got {sp_layout!r}"
@@ -530,6 +557,7 @@ def build_transformer_lm(
         remat_policy=remat_policy, sp_layout=sp_layout,
         attn_window=attn_window, kv_heads=kv_heads,
         tie_embeddings=tie_embeddings, attn_bh_block=attn_bh_block,
+        rope_scaling=rope_scaling,
     )
 
 

@@ -336,11 +336,20 @@ class PipelineTrainer(LMTrainer):
             nn.remat(DecoderBlock)
             if m.remat and m.remat_policy == "full" else DecoderBlock
         )
+        # thread EVERY attention-shaping field the model carries — a
+        # field silently defaulting here would make the pipelined model
+        # compute different math than the same model under LMTrainer
+        # (kv_heads/attn_window/attn_bh_block/rope_scaling were exactly
+        # that gap)
         blk = cls(
             m.dim, m.heads, m.mlp_ratio, m.dtype,
             attn_impl=m.attn_impl, seq_axis=None,
             rope_theta=m.rope_theta,
             remat_mlp=m.remat and m.remat_policy == "attn",
+            attn_window=m.attn_window,
+            kv_heads=m.kv_heads,
+            attn_bh_block=m.attn_bh_block,
+            rope_scaling=m.rope_scaling,
         )
 
         def stage_fn(stage_params, x):
