@@ -186,3 +186,66 @@ def test_report_none_when_no_pruner():
 
     fmin(objective, {"x": hp.uniform(0, 1)}, max_evals=2, trials=Trials())
     assert seen == [None, None]
+
+
+def test_asha_pruner_rungs_and_fmin():
+    """ASHA: rung-based geometric early stopping — unit rung math plus
+    the same fmin drop-in contract as the median rule."""
+    from tpuflow.tune import (AshaPruner, STATUS_PRUNED, Trials, fmin, hp)
+    from tpuflow.tune.pruning import Pruned
+
+    # unit: rungs at 1, 3, 9; with eta=3, only the top third survives
+    # a populated rung
+    p = AshaPruner(min_resource=1, reduction_factor=3, min_peers=3)
+    assert p._rung_steps(9) == [1, 3, 9]
+    for tid, v in enumerate((1.0, 2.0)):
+        p.report(tid, 1, v)  # cold start: below min_peers, pass
+        p.finish(tid)
+    with pytest.raises(Pruned):  # 3rd arrival, worst of 3 → pruned
+        p.report(2, 1, 3.0)
+    p.report(3, 1, 0.5)  # 4th arrival, best of 4 → survives
+
+    # NaN = diverged: pruned immediately, never poisons the rung
+    with pytest.raises(Pruned):
+        p.report(4, 1, float("nan"))
+    p.report(5, 1, 0.4)  # new best of the rung → survives cleanly
+
+    # a FAILED trial's bogus rung record is withdrawn by discard()
+    p2 = AshaPruner(min_resource=1, reduction_factor=3, min_peers=3)
+    p2.report(0, 1, 0.0)  # spuriously perfect...
+    p2.discard(0)  # ...then the trial crashes
+    p2.report(1, 1, 2.0)
+    # had the 0.0 stayed, this third-arrival 2.1 would be judged
+    # against cutoff 0.0 and pruned; with it withdrawn the rung has
+    # only 2 values (below min_peers) and the trial passes
+    p2.report(2, 1, 2.1)
+    with pytest.raises(Pruned):
+        p2.report(3, 1, 2.2)  # worst of a healthy trio: normal ASHA
+
+    # drop-in: same sweep as the median test; bad x gets rung-stopped
+    def objective(params, report=None):
+        final = params["x"]
+        value = final
+        for step in range(1, 10):
+            value = final + (5.0 - final) * (0.5 ** step)
+            if report is not None:
+                report(step, value)
+        return {"loss": final, "status": "ok"}
+
+    trials = Trials()
+    best = fmin(
+        objective,
+        {"x": hp.uniform(0.0, 10.0)},
+        max_evals=20,
+        trials=trials,
+        seed=0,
+        pruner=AshaPruner(min_resource=1, reduction_factor=3),
+    )
+    statuses = [t.status for t in trials.results]
+    assert STATUS_PRUNED in statuses, statuses
+    ok = [t for t in trials.results if t.status == "ok"]
+    assert ok and best["x"] == min(ok, key=lambda t: t.loss).params["x"]
+    # pruned trials stopped strictly before the final step
+    for t in trials.results:
+        if t.status == STATUS_PRUNED:
+            assert t.extra["pruned_at"] < 9

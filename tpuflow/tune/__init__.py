@@ -6,4 +6,4 @@ from tpuflow.tune.trials import (  # noqa: F401
     STATUS_PRUNED,
     Trials,
 )
-from tpuflow.tune.pruning import MedianPruner, Pruned  # noqa: F401
+from tpuflow.tune.pruning import AshaPruner, MedianPruner, Pruned  # noqa: F401

@@ -103,3 +103,111 @@ class MedianPruner:
         trial of the same id."""
         with self._lock:
             self._live.pop(tid, None)
+
+
+class AshaPruner:
+    """Asynchronous Successive Halving (ASHA — Li et al. 2018), the
+    rung-based complement to the median rule: aggressive geometric
+    budget allocation for LARGE sweeps.
+
+    Rungs sit at steps ``min_resource * reduction_factor**k``. When a
+    trial first reports at (or past) a rung it records its best value
+    so far there and continues only if that value places in the top
+    ``1/reduction_factor`` of everything recorded at that rung —
+    ASYNCHRONOUSLY: the comparison runs against whatever has arrived,
+    never waiting for a cohort (the 'A' that makes successive halving
+    usable with parallel trials). ``min_peers`` guards the cold start
+    (the first trials through a rung pass unjudged). Same contract as
+    MedianPruner (``report``/``finish``/``discard``; ``report`` raises
+    :class:`Pruned`), so it drops into ``fmin(pruner=...)`` and every
+    trial topology unchanged. Thread-safe.
+    """
+
+    def __init__(self, min_resource: int = 1, reduction_factor: int = 3,
+                 min_peers: int = 3):
+        if min_resource < 1:
+            raise ValueError(f"min_resource must be >= 1, got {min_resource}")
+        if reduction_factor < 2:
+            raise ValueError(
+                f"reduction_factor must be >= 2, got {reduction_factor}"
+            )
+        self.min_resource = int(min_resource)
+        self.eta = int(reduction_factor)
+        self.min_peers = max(1, int(min_peers))
+        self._lock = threading.Lock()
+        self._rungs: Dict[int, List[float]] = {}  # rung step -> values
+        self._best: Dict[int, float] = {}  # live tid -> best so far
+        # live tid -> {rung: contributed value}: finish() keeps these
+        # in the rung history (they ARE the comparison record — pruned
+        # trials' true values included, canonical ASHA), discard()
+        # REMOVES them (a failed trial's values may be bogus — one
+        # spurious 0.0 from a crashed eval would otherwise prune every
+        # healthy successor at that rung forever)
+        self._contrib: Dict[int, Dict[int, float]] = {}
+
+    def _rung_steps(self, step: int) -> List[int]:
+        out, r = [], self.min_resource
+        while r <= step:
+            out.append(r)
+            r *= self.eta
+        return out
+
+    def report(self, tid: int, step: int, value: float) -> None:
+        """Record an intermediate value; raise Pruned at a rung the
+        trial does not survive."""
+        import math
+
+        value = float(value)
+        with self._lock:
+            if not math.isfinite(value):
+                # a NaN/inf intermediate is a DIVERGED trial — the
+                # canonical prune target. Never let it into the rung
+                # history (NaN makes sorted() orderings arbitrary and
+                # would silently disable the rung's cutoff forever)
+                best = self._best.get(tid, value)
+                self._drop_live(tid)
+                raise Pruned(step, best)
+            best = min(value, self._best.get(tid, float("inf")))
+            self._best[tid] = best
+            contrib = self._contrib.setdefault(tid, {})
+            for rung in self._rung_steps(step):
+                if rung in contrib:
+                    continue
+                vals = self._rungs.setdefault(rung, [])
+                vals.append(best)
+                contrib[rung] = best
+                if len(vals) < self.min_peers:
+                    continue
+                keep = max(1, len(vals) // self.eta)
+                cutoff = sorted(vals)[keep - 1]
+                if best > cutoff:
+                    # rung history keeps this value (it IS the record
+                    # later arrivals compare against); only the live
+                    # per-trial state drops
+                    self._drop_live(tid)
+                    raise Pruned(step, best)
+
+    def _drop_live(self, tid: int) -> None:
+        self._best.pop(tid, None)
+        self._contrib.pop(tid, None)
+
+    def finish(self, tid: int) -> None:
+        """Trial completed: drop live state (rung records persist —
+        they are the comparison history)."""
+        with self._lock:
+            self._drop_live(tid)
+
+    def discard(self, tid: int) -> None:
+        """Trial FAILED (or was pruned outside report): remove its
+        rung contributions — bogus values from a crashed objective
+        must not become the cutoff every healthy successor is judged
+        against."""
+        with self._lock:
+            for rung, v in self._contrib.pop(tid, {}).items():
+                vals = self._rungs.get(rung)
+                if vals is not None:
+                    try:
+                        vals.remove(v)
+                    except ValueError:
+                        pass
+            self._best.pop(tid, None)
