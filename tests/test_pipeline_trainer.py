@@ -359,7 +359,7 @@ def test_pipeline_threads_attention_fields():
     model under LMTrainer). Pinned via loss parity with
     rope_scaling + attn_window set."""
     toks = _corpus(24, 16)
-    kw = dict(rope_scaling=2.0, attn_window=8)
+    kw = dict(rope_scaling=2.0, rope_scaling_kind="ntk", attn_window=8)
     mesh = build_nd_mesh({"pipe": 4}, devices=jax.devices()[:4])
     tr_pp = PipelineTrainer(
         build_transformer_lm(vocab_size=VOCAB, dim=32, depth=4, heads=4,

@@ -367,3 +367,35 @@ def test_rope_scaling_model_level():
     with pytest.raises(ValueError, match="rope_scaling"):
         build_transformer_lm(vocab_size=64, dim=32, depth=1, heads=2,
                              rope_scaling=0.5)
+    with pytest.raises(ValueError, match="rope_scaling_kind"):
+        build_transformer_lm(vocab_size=64, dim=32, depth=1, heads=2,
+                             rope_scaling_kind="yarn")
+
+
+def test_rope_ntk_scaling():
+    """NTK-aware kind: identity at 1.0, distinct geometry from linear
+    at s>1, and the LOWEST frequency stretches while the highest stays
+    (asymptotically) put — the property that preserves local attention
+    without fine-tuning."""
+    from tpuflow.models.transformer import rotary_embed
+
+    q = jax.random.normal(jax.random.key(0), (1, 1, 8, 16))
+    k = jax.random.normal(jax.random.key(1), (1, 1, 8, 16))
+    pos = jnp.arange(8)
+    base = rotary_embed(q, k, pos)
+    ntk1 = rotary_embed(q, k, pos, scaling=1.0, scaling_kind="ntk")
+    np.testing.assert_array_equal(np.asarray(base[0]), np.asarray(ntk1[0]))
+    lin = rotary_embed(q, k, pos, scaling=4.0)
+    ntk = rotary_embed(q, k, pos, scaling=4.0, scaling_kind="ntk")
+    assert not np.allclose(np.asarray(lin[0]), np.asarray(ntk[0]))
+    # frequency spectrum check on theta' = theta * s^(d/(d-2)):
+    # inv_freq[j] = theta'^(-j/half) — at j=0 (highest freq) identical,
+    # at j=half-1 (lowest) shrunk by ~1/s or more
+    d, half, s, theta = 16, 8, 4.0, 10000.0
+    t2 = theta * s ** (d / (d - 2))
+    f_hi0, f_hi1 = theta ** (-0 / half), t2 ** (-0 / half)
+    assert f_hi0 == f_hi1 == 1.0
+    f_lo0, f_lo1 = theta ** (-(half - 1) / half), t2 ** (-(half - 1) / half)
+    assert f_lo1 < f_lo0 / (s * 0.9)
+    with pytest.raises(ValueError, match="scaling_kind"):
+        rotary_embed(q, k, pos, scaling=2.0, scaling_kind="bogus")

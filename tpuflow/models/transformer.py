@@ -60,7 +60,7 @@ class RMSNorm(nn.Module):
 
 
 def rotary_embed(q, k, positions, theta: float = 10000.0,
-                 scaling: float = 1.0):
+                 scaling: float = 1.0, scaling_kind: str = "linear"):
     """Apply rotary position embeddings to q, k of shape (B, H, S, D).
 
     ``positions``: (S,) int32 GLOBAL token positions — under sequence
@@ -69,18 +69,32 @@ def rotary_embed(q, k, positions, theta: float = 10000.0,
     (sequence packing: each packed document restarts at 0). Computed
     in float32.
 
-    ``scaling`` (linear RoPE position interpolation, Chen et al. 2023):
-    positions are divided by the factor before the rotation, squeezing
-    an s×-longer context into the angle range the model trained on —
-    the standard cheap context-extension lever (fine-tune briefly at
-    the new length). Identity at 1.0; rotations at position s·p under
-    scaling s equal rotations at p unscaled.
+    ``scaling`` — the RoPE context-extension factor; identity at 1.0.
+    ``scaling_kind`` selects the interpolation:
+
+    - ``'linear'`` (Chen et al. 2023 position interpolation): positions
+      divide by the factor before the rotation — rotations at position
+      s·p under scaling s equal rotations at p unscaled. Uniformly
+      compresses ALL frequencies (the high-frequency/local detail
+      channels included), so a brief fine-tune at the new length is
+      the standard companion.
+    - ``'ntk'`` (NTK-aware, fixed): the base theta is raised to
+      ``theta · s^(d/(d-2))`` instead — low frequencies stretch to
+      cover the longer context while the highest frequency is left
+      (asymptotically) untouched, which tends to preserve local
+      attention patterns better WITHOUT fine-tuning.
     """
     d = q.shape[-1]
     half = d // 2
-    inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if scaling_kind not in ("linear", "ntk"):
+        raise ValueError(
+            f"scaling_kind must be 'linear' or 'ntk', got {scaling_kind!r}"
+        )
     pos = positions.astype(jnp.float32)
-    if scaling != 1.0:
+    if scaling != 1.0 and scaling_kind == "ntk":
+        theta = theta * scaling ** (d / max(1, d - 2))
+    inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if scaling != 1.0 and scaling_kind == "linear":
         pos = pos / scaling
     angles = pos[..., None] * inv_freq  # (..., S, half)
     if angles.ndim == 2:  # (S, half): shared across batch and heads
@@ -123,9 +137,11 @@ class CausalAttention(nn.Module):
     # rows per kernel grid cell — the short-sequence per-cell-overhead
     # amortizer. 1 = classic kernel; ignored by einsum/ring paths.
     attn_bh_block: int = 1
-    # linear RoPE position interpolation factor (context extension);
-    # 1.0 = off. Applies in training AND the KV-cache decode path.
+    # RoPE context-extension factor (1.0 = off) + interpolation kind
+    # ('linear' position interpolation | 'ntk' theta scaling); applies
+    # in training AND the KV-cache decode path.
     rope_scaling: float = 1.0
+    rope_scaling_kind: str = "linear"
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions_override=None):
@@ -180,7 +196,7 @@ class CausalAttention(nn.Module):
                 max_len = ck.value.shape[2]
                 positions = i + jnp.arange(s, dtype=jnp.int32)
                 q, k = rotary_embed(q, k, positions, self.rope_theta,
-                                self.rope_scaling)
+                                self.rope_scaling, self.rope_scaling_kind)
                 ck.value = lax.dynamic_update_slice(ck.value, k, (0, 0, i, 0))
                 cv.value = lax.dynamic_update_slice(cv.value, v, (0, 0, i, 0))
                 ci.value = i + s
@@ -213,7 +229,7 @@ class CausalAttention(nn.Module):
                 # init pass: shapes only (cache created above)
                 positions = jnp.arange(s, dtype=jnp.int32)
                 q, k = rotary_embed(q, k, positions, self.rope_theta,
-                                self.rope_scaling)
+                                self.rope_scaling, self.rope_scaling_kind)
                 o = mha_xla(q, expand_kv(k), expand_kv(v), causal=True,
                             window=self.attn_window)
         else:
@@ -230,7 +246,7 @@ class CausalAttention(nn.Module):
             if positions_override is not None:
                 positions = positions_override  # packed per-doc offsets
             q, k = rotary_embed(q, k, positions, self.rope_theta,
-                                self.rope_scaling)
+                                self.rope_scaling, self.rope_scaling_kind)
 
             if self.seq_axis is not None:
                 if self.attn_window is not None:
@@ -309,7 +325,8 @@ class DecoderBlock(nn.Module):
     attn_window: Optional[int] = None
     kv_heads: Optional[int] = None  # grouped-query attention (GQA)
     attn_bh_block: int = 1  # batched-bh flash grid (see CausalAttention)
-    rope_scaling: float = 1.0  # linear RoPE interpolation (see CausalAttention)
+    rope_scaling: float = 1.0  # RoPE context extension (see CausalAttention)
+    rope_scaling_kind: str = "linear"  # linear | ntk
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None):
@@ -319,6 +336,7 @@ class DecoderBlock(nn.Module):
             attn_window=self.attn_window, kv_heads=self.kv_heads,
             attn_bh_block=self.attn_bh_block,
             rope_scaling=self.rope_scaling,
+            rope_scaling_kind=self.rope_scaling_kind,
             name="attn",
         )(RMSNorm(self.dtype, name="norm1")(x), segment_ids, positions)
         y = RMSNorm(self.dtype, name="norm2")(x)
@@ -422,7 +440,8 @@ class TransformerLM(nn.Module):
     attn_window: Optional[int] = None  # sliding-window (local) attention
     kv_heads: Optional[int] = None  # grouped-query attention (GQA/MQA)
     attn_bh_block: int = 1  # batched-bh flash grid (see CausalAttention)
-    rope_scaling: float = 1.0  # linear RoPE interpolation (see CausalAttention)
+    rope_scaling: float = 1.0  # RoPE context extension (see CausalAttention)
+    rope_scaling_kind: str = "linear"  # linear | ntk
     # weight tying: reuse the embedding table as the LM head (GPT-2 /
     # Gemma style) — drops the (dim, vocab) head parameter entirely
     tie_embeddings: bool = False
@@ -480,6 +499,7 @@ class TransformerLM(nn.Module):
                 kv_heads=self.kv_heads,
                 attn_bh_block=self.attn_bh_block,
                 rope_scaling=self.rope_scaling,
+                rope_scaling_kind=self.rope_scaling_kind,
                 name=f"block{i}",
             )(x, segment_ids, positions)
         x = RMSNorm(self.dtype, name="norm_final")(x)
@@ -518,6 +538,7 @@ def build_transformer_lm(
     tie_embeddings: bool = False,
     attn_bh_block: int = 1,
     rope_scaling: float = 1.0,
+    rope_scaling_kind: str = "linear",
 ) -> TransformerLM:
     if dim % heads:
         raise ValueError("dim must be a multiple of heads")
@@ -533,6 +554,11 @@ def build_transformer_lm(
         raise ValueError(
             f"rope_scaling must be >= 1.0 (a context-EXTENSION factor), "
             f"got {rope_scaling}"
+        )
+    if rope_scaling_kind not in ("linear", "ntk"):
+        raise ValueError(
+            f"rope_scaling_kind must be 'linear' or 'ntk', got "
+            f"{rope_scaling_kind!r}"
         )
     if sp_layout not in ("contiguous", "striped"):
         raise ValueError(
@@ -557,7 +583,7 @@ def build_transformer_lm(
         remat_policy=remat_policy, sp_layout=sp_layout,
         attn_window=attn_window, kv_heads=kv_heads,
         tie_embeddings=tie_embeddings, attn_bh_block=attn_bh_block,
-        rope_scaling=rope_scaling,
+        rope_scaling=rope_scaling, rope_scaling_kind=rope_scaling_kind,
     )
 
 

@@ -350,6 +350,7 @@ class PipelineTrainer(LMTrainer):
             kv_heads=m.kv_heads,
             attn_bh_block=m.attn_bh_block,
             rope_scaling=m.rope_scaling,
+            rope_scaling_kind=m.rope_scaling_kind,
         )
 
         def stage_fn(stage_params, x):
