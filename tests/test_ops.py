@@ -461,7 +461,7 @@ def test_bh_block_segments_match_classic():
     )
 
 
-def test_bh_block_clamps_and_gqa_forces_one():
+def test_bh_block_clamps_and_gqa_grouping():
     # bh = 6: request 4 clamps to the largest divisor (3); non-square
     # values must still be exact
     q, k, v = (_rand((2, 3, 32, 8), i + 71) for i in range(3))
@@ -470,7 +470,8 @@ def test_bh_block_clamps_and_gqa_forces_one():
     np.testing.assert_allclose(
         out, mha_reference(q, k, v, causal=True), atol=2e-5, rtol=2e-5
     )
-    # GQA (kv heads < q heads) silently rides the classic G=1 path
+    # GQA (kv heads < q heads): G clamps to a multiple of the group
+    # (here group=3, bh=6 → request 4 clamps to G=3, the batched path)
     kg, vg = (_rand((2, 1, 32, 8), i + 81) for i in range(2))
     out_gqa = flash_attention(q, kg, vg, causal=True, block_q=16,
                               block_k=16, bh_block=4)
@@ -535,5 +536,79 @@ def test_bh_block_under_gspmd_data_sharding():
     out = f(qs, ks, vs)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(mha_reference(q, k, v, causal=True)),
+        atol=2e-5, rtol=2e-5,
+    )
+
+
+@pytest.mark.smoke
+def test_bh_block_with_gqa_matches_expanded_oracle():
+    """r05: bh_block composes with grouped-query attention when the
+    group divides G — the cell's K/V block carries G/group rows (row
+    gi reads gi//group; dK/dV sweeps the group in-kernel). Forward and
+    grads pinned against the expanded-MHA oracle AND bitwise against
+    the classic G=1 GQA path."""
+    b, h, kv, s, d = 2, 4, 2, 32, 16  # group=2; bh=8
+    q = _rand((b, h, s, d), 111)
+    k = _rand((b, kv, s, d), 112)
+    v = _rand((b, kv, s, d), 113)
+    ke = jnp.repeat(k, h // kv, axis=1)
+    ve = jnp.repeat(v, h // kv, axis=1)
+
+    def loss(impl_bh):
+        def f(q, k, v):
+            o = flash_attention(q, k, v, causal=True, block_q=16,
+                                block_k=16, bh_block=impl_bh)
+            return jnp.sum(jnp.sin(o))
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    out4 = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                           bh_block=4)
+    np.testing.assert_allclose(
+        out4, mha_reference(q, ke, ve, causal=True), atol=2e-5, rtol=2e-5
+    )
+    out1 = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    np.testing.assert_array_equal(np.asarray(out4), np.asarray(out1))
+
+    g4, g1 = loss(4), loss(1)
+    g_ref = jax.grad(
+        lambda q, ke, ve: jnp.sum(jnp.sin(
+            mha_reference(q, ke, ve, causal=True))),
+        argnums=(0, 1, 2),
+    )(q, ke, ve)
+    # dq direct; dk/dv oracle sums over each head's group
+    np.testing.assert_allclose(g4[0], g_ref[0], atol=5e-5, rtol=5e-4)
+    dk_ref = g_ref[1].reshape(b, kv, h // kv, s, d).sum(axis=2)
+    dv_ref = g_ref[2].reshape(b, kv, h // kv, s, d).sum(axis=2)
+    np.testing.assert_allclose(g4[1], dk_ref, atol=1e-4, rtol=5e-4)
+    np.testing.assert_allclose(g4[2], dv_ref, atol=1e-4, rtol=5e-4)
+    for a, c in zip(g4, g1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_bh_block_gqa_clamp_and_segments():
+    # bh=8, group=2: a request of 6 clamps to 4 (divides 8, multiple
+    # of 2); the result must still be exact
+    b, h, kv, s, d = 2, 4, 2, 32, 8
+    q = _rand((b, h, s, d), 121)
+    k = _rand((b, kv, s, d), 122)
+    v = _rand((b, kv, s, d), 123)
+    ke = jnp.repeat(k, 2, axis=1)
+    ve = jnp.repeat(v, 2, axis=1)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                          bh_block=6)
+    np.testing.assert_allclose(
+        out, mha_reference(q, ke, ve, causal=True), atol=2e-5, rtol=2e-5
+    )
+    # packing + GQA + batched grid together
+    segs = jnp.asarray([[0] * 20 + [1] * 12, [0] * 8 + [1] * 24],
+                       jnp.int32)
+    a = flash_attention(q, k, v, causal=True, segment_ids=segs,
+                        block_q=16, block_k=16, bh_block=4)
+    c = flash_attention(q, k, v, causal=True, segment_ids=segs,
+                        block_q=16, block_k=16)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    np.testing.assert_allclose(
+        a, mha_xla(q, ke, ve, causal=True, segment_ids=segs),
         atol=2e-5, rtol=2e-5,
     )

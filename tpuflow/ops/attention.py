@@ -86,9 +86,10 @@ class _Cfg(NamedTuple):
     # sequence the inner grid is tiny (s=1024 @ 512-blocks → 2×2) and
     # per-grid-cell overhead (window-swap DMA setup + scalar control)
     # dominates the MXU work — batching bh cuts the cell count G× at
-    # identical FLOPs. Requires kv_group == 1 (the GQA b//g index remap
-    # is incompatible with G-row blocks). G=1 is exactly the classic
-    # kernel.
+    # identical FLOPs. Under GQA, G must be a multiple of kv_group:
+    # the cell's K/V block then carries G/group rows, row gi reads
+    # gi//group, and the dK/dV kernel runs the group sweep in-kernel.
+    # G=1 is exactly the classic kernel.
     bh_block: int = 1
 
 
@@ -399,8 +400,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, cfg: _Cfg):
                 band = band & (col > row + cfg.causal_shift - cfg.window)
         for gi in range(G):
             q = q_ref[gi]  # native dtype — bf16 in ⇒ full-rate MXU
-            k_blk = k_ref[gi]  # G>1 requires kv_group==1: row gi's own K/V
-            v_blk = v_ref[gi]
+            # GQA: row gi's K/V lives at gi // group within the cell's
+            # K/V block (G==1: index 0 either way — the classic path)
+            k_blk = k_ref[gi // cfg.kv_group]
+            v_blk = v_ref[gi // cfg.kv_group]
             s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
             s = s * cfg.scale  # scale the f32 scores, not the bf16 operand
             mask = band
@@ -446,12 +449,20 @@ def _fwd(cfg: _Cfg, q, k, v, segs=None):
     bh, sq, d = q.shape
     skv = k.shape[1]
     g = cfg.kv_group  # K/V head index = q-head index // g (GQA)
-    G = cfg.bh_block  # (batch·head) rows per grid cell; G>1 ⇒ g==1
+    G = cfg.bh_block  # (batch·head) rows per grid cell; G>1 ⇒ g | G
+    # K/V blocks: G>1 carries the cell's OWN G//g kv rows at block
+    # index b (q rows [bG,(b+1)G) ↔ kv rows [bG/g,(b+1)G/g)); G==1
+    # keeps the classic per-row b//g remap (1-row blocks)
+    Gkv = G // g if G > 1 else 1
+    kv_map = (
+        (lambda b, i, j: (b, j, 0)) if G > 1
+        else (lambda b, i, j: (b // g, j, 0))
+    )
     grid = (bh // G, sq // cfg.block_q, skv // cfg.block_k)
     in_specs = [
         pl.BlockSpec((G, cfg.block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((G, cfg.block_k, d), lambda b, i, j: (b // g, j, 0)),
-        pl.BlockSpec((G, cfg.block_k, d), lambda b, i, j: (b // g, j, 0)),
+        pl.BlockSpec((Gkv, cfg.block_k, d), kv_map),
+        pl.BlockSpec((Gkv, cfg.block_k, d), kv_map),
     ]
     inputs = [q, k, v]
     if cfg.has_segments:
@@ -534,8 +545,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         for gi in range(G):
             q = q_ref[gi]
             do = do_ref[gi]
-            k_blk = k_ref[gi]
-            v_blk = v_ref[gi]
+            k_blk = k_ref[gi // cfg.kv_group]
+            v_blk = v_ref[gi // cfg.kv_group]
             lse = lse_ref[gi, 0, pl.ds(qi * bq, bq)][:, None]
             delta = delta_ref[gi, 0, pl.ds(qi * bq, bq)][:, None]
             s = jnp.dot(
@@ -569,14 +580,21 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
     bk, d = k_ref.shape[1], k_ref.shape[2]
     bq = q_ref.shape[1]
     ki = pl.program_id(1)
-    # inner grid: (group member, q block) flattened — under GQA this
-    # key block's gradient accumulates over EVERY query head it serves
-    # (kv_group sweeps of nq q-blocks each); kv_group == 1 is MHA
+    G = cfg.bh_block
     t = pl.program_id(2)
     nt = pl.num_programs(2)
-    nq = nt // cfg.kv_group
-    i = lax.rem(t, nq)  # q block within the current member's sweep
-    G = cfg.bh_block  # G>1 requires kv_group==1, so then i == t
+    if G > 1:
+        # block path: the cell holds G//g kv rows and ALL their g
+        # query-head members — the group sweep runs in-kernel, so the
+        # inner grid enumerates q blocks only
+        nq = nt
+        i = t
+    else:
+        # classic per-row path: inner grid flattens (group member,
+        # q block) — this key row's gradient accumulates over every
+        # query head it serves (kv_group sweeps of nq q-blocks each)
+        nq = nt // cfg.kv_group
+        i = lax.rem(t, nq)  # q block within the current member's sweep
 
     # causal: the first query block whose rows can see this key block
     # (col c is visible to rows >= c - causal_shift)
@@ -606,37 +624,45 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
             band = band & (col <= row + cfg.causal_shift)
             if cfg.window is not None:
                 band = band & (col > row + cfg.causal_shift - cfg.window)
-        for gi in range(G):
-            k = k_ref[gi]
-            v = v_ref[gi]
-            q_blk = q_ref[gi]
-            do_blk = do_ref[gi]
-            lse = lse_ref[gi, 0, pl.ds(i * bq, bq)][:, None]
-            delta = delta_ref[gi, 0, pl.ds(i * bq, bq)][:, None]
-            s = jnp.dot(
-                q_blk, k.T, preferred_element_type=jnp.float32
-            ) * cfg.scale
-            mask = band
-            if cfg.has_segments:
-                qseg = seg_ref[gi, 0, pl.ds(i * bq, bq)]
-                kseg = seg_ref[gi, 0, pl.ds(ki * bk, bk)]
-                mask = mask & (qseg[:, None] == kseg[None, :])
-            p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-            dv_acc_ref[gi] = dv_acc_ref[gi] + jnp.dot(
-                p.T.astype(do_blk.dtype), do_blk,
-                preferred_element_type=jnp.float32,
-            )
-            dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
-            ds = (p * (dp - delta)).astype(q_blk.dtype)
-            dk_acc_ref[gi] = dk_acc_ref[gi] + jnp.dot(
-                ds.T, q_blk, preferred_element_type=jnp.float32
-            )
+        g = cfg.kv_group
+        n_kv = (G // g) if G > 1 else 1
+        for gk in range(n_kv):
+            k = k_ref[gk]
+            v = v_ref[gk]
+            for m in range(g if G > 1 else 1):
+                # q-row index within the cell: classic path has ONE q
+                # row per cell (its member sweep lives in the grid);
+                # block path enumerates all g members of kv row gk
+                gq = gk * g + m if G > 1 else 0
+                q_blk = q_ref[gq]
+                do_blk = do_ref[gq]
+                lse = lse_ref[gq, 0, pl.ds(i * bq, bq)][:, None]
+                delta = delta_ref[gq, 0, pl.ds(i * bq, bq)][:, None]
+                s = jnp.dot(
+                    q_blk, k.T, preferred_element_type=jnp.float32
+                ) * cfg.scale
+                mask = band
+                if cfg.has_segments:
+                    qseg = seg_ref[gq, 0, pl.ds(i * bq, bq)]
+                    kseg = seg_ref[gq, 0, pl.ds(ki * bk, bk)]
+                    mask = mask & (qseg[:, None] == kseg[None, :])
+                p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+                dv_acc_ref[gk] = dv_acc_ref[gk] + jnp.dot(
+                    p.T.astype(do_blk.dtype), do_blk,
+                    preferred_element_type=jnp.float32,
+                )
+                dp = jnp.dot(do_blk, v.T,
+                             preferred_element_type=jnp.float32)
+                ds = (p * (dp - delta)).astype(q_blk.dtype)
+                dk_acc_ref[gk] = dk_acc_ref[gk] + jnp.dot(
+                    ds.T, q_blk, preferred_element_type=jnp.float32
+                )
 
     @pl.when(t == nt - 1)
     def _finalize():
-        for gi in range(G):
-            dk_ref[gi] = (dk_acc_ref[gi] * cfg.scale).astype(dk_ref.dtype)
-            dv_ref[gi] = dv_acc_ref[gi].astype(dv_ref.dtype)
+        for gk in range((G // cfg.kv_group) if G > 1 else 1):
+            dk_ref[gk] = (dk_acc_ref[gk] * cfg.scale).astype(dk_ref.dtype)
+            dv_ref[gk] = dv_acc_ref[gk].astype(dv_ref.dtype)
 
 
 def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
@@ -644,15 +670,19 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
     skv = k.shape[1]
     bh_kv = k.shape[0]  # under GQA: bh // kv_group
     g = cfg.kv_group
-    G = cfg.bh_block  # G>1 ⇒ g==1 (enforced in flash_attention)
+    G = cfg.bh_block  # G>1 ⇒ g | G (enforced in flash_attention)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     # vectors ride as (BH, 1, S) whole-row blocks — see _fwd_kernel note
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
     nq, nk = sq // cfg.block_q, skv // cfg.block_k
+    Gkv = G // g if G > 1 else 1
     q_spec = pl.BlockSpec((G, cfg.block_q, d), lambda b, i, j: (b, i, 0))
-    k_stream = pl.BlockSpec((G, cfg.block_k, d),
-                            lambda b, i, j: (b // g, j, 0))
+    k_stream = pl.BlockSpec(
+        (Gkv, cfg.block_k, d),
+        (lambda b, i, j: (b, j, 0)) if G > 1
+        else (lambda b, i, j: (b // g, j, 0)),
+    )
     vec_row = pl.BlockSpec((G, 1, sq), lambda b, i, j: (b, 0, 0))
     semantics = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -676,28 +706,49 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
         interpret=cfg.interpret,
     )(*dq_inputs)
 
-    # dk/dv: key blocks in the middle grid dim; the innermost dim
-    # enumerates (group member, q block) so each KV head's gradient
-    # accumulates over every query head it serves (kv_group=1 ⇒ MHA)
-    k_spec = pl.BlockSpec((G, cfg.block_k, d), lambda b, j, t: (b, j, 0))
-    q_stream = pl.BlockSpec(
-        (G, cfg.block_q, d), lambda b, j, t: (b * g + t // nq, t % nq, 0)
-    )
-    vec_row_kv = pl.BlockSpec(
-        (G, 1, sq), lambda b, j, t: (b * g + t // nq, 0, 0)
-    )
+    # dk/dv: key blocks in the middle grid dim. Classic (G==1): the
+    # innermost dim enumerates (group member, q block) so each KV
+    # head's gradient accumulates over every query head it serves.
+    # Block path (G>1): the cell holds G//g kv rows plus ALL their
+    # members' q rows (one G-row q block), the group sweep runs
+    # in-kernel, and the inner dim enumerates q blocks only.
+    if G > 1:
+        k_spec = pl.BlockSpec((Gkv, cfg.block_k, d),
+                              lambda b, j, t: (b, j, 0))
+        q_stream = pl.BlockSpec((G, cfg.block_q, d),
+                                lambda b, j, t: (b, t, 0))
+        vec_row_kv = pl.BlockSpec((G, 1, sq), lambda b, j, t: (b, 0, 0))
+        seg_spec_kv = pl.BlockSpec(
+            (G, 1, segs.shape[2]) if segs is not None else (1, 1, 1),
+            lambda b, j, t: (b, 0, 0),
+        )
+        dkv_grid = (bh_kv // Gkv, nk, nq)
+        dkv_out_lead = Gkv
+    else:
+        k_spec = pl.BlockSpec((1, cfg.block_k, d),
+                              lambda b, j, t: (b, j, 0))
+        q_stream = pl.BlockSpec(
+            (1, cfg.block_q, d),
+            lambda b, j, t: (b * g + t // nq, t % nq, 0),
+        )
+        vec_row_kv = pl.BlockSpec(
+            (1, 1, sq), lambda b, j, t: (b * g + t // nq, 0, 0)
+        )
+        seg_spec_kv = pl.BlockSpec(
+            (1, 1, segs.shape[2]) if segs is not None else (1, 1, 1),
+            lambda b, j, t: (b * g, 0, 0),
+        )
+        dkv_grid = (bh_kv, nk, nq * g)
+        dkv_out_lead = 1
     dkv_in_specs = [k_spec, k_spec, q_stream, q_stream, vec_row_kv,
                     vec_row_kv]
     dkv_inputs = [k, v, q, do, lse3, delta3]
     if cfg.has_segments:
-        dkv_in_specs.append(
-            pl.BlockSpec((G, 1, segs.shape[2]),
-                         lambda b, j, t: (b * g, 0, 0))
-        )
+        dkv_in_specs.append(seg_spec_kv)
         dkv_inputs.append(segs)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, cfg=cfg),
-        grid=(bh_kv // G, nk, nq * g),
+        grid=dkv_grid,
         in_specs=dkv_in_specs,
         out_specs=[k_spec, k_spec],
         out_shape=[
@@ -707,8 +758,8 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
                                  vma=_vma(q, k, v, do)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((G, cfg.block_k, d), jnp.float32),
-            pltpu.VMEM((G, cfg.block_k, d), jnp.float32),
+            pltpu.VMEM((dkv_out_lead, cfg.block_k, d), jnp.float32),
+            pltpu.VMEM((dkv_out_lead, cfg.block_k, d), jnp.float32),
         ],
         compiler_params=semantics,
         interpret=cfg.interpret,
@@ -813,10 +864,11 @@ def flash_attention(
     at s=1024 vs 46.7 TF/s at 64k with identical block shapes
     (MFU_ANALYSIS §7 / ROUND4_NOTES §2 decision tree). Batching bh
     cuts the cell count ``bh_block``× at identical FLOPs. Clamped to
-    the largest divisor of batch·heads ≤ the request; forced to 1
-    under grouped-query attention (the ``b // group`` K/V index remap
-    addresses per-row, incompatible with multi-row blocks). ``1`` is
-    exactly the classic kernel.
+    the largest value ≤ the request dividing batch·heads exactly —
+    and, under grouped-query attention, additionally a multiple of the
+    group (the cell's K/V block then carries ``G/group`` rows and the
+    dK/dV kernel sweeps the group in-kernel). ``1`` is exactly the
+    classic kernel.
     """
     if q.ndim != 4:
         raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
@@ -857,27 +909,29 @@ def flash_attention(
     block_k = min(block_k, max(8, skv))
     if bh_block < 1:
         raise ValueError(f"bh_block must be >= 1, got {bh_block}")
-    if h_kv != h:
-        bh_block = 1  # GQA: per-row b // group remap needs 1-row blocks
-    else:
-        # VMEM-aware cap first: every input/output block and all three
-        # f32 scratch buffers scale with G — an unbounded G=64 at
-        # 512-blocks/d=128 is a ~115 MB cell that Mosaic cannot place.
-        # Estimate per-row bytes (q+k+v+o double-buffered at the input
-        # itemsize, plus the largest kernel's scratch) against a 64 MB
-        # budget (half of v5e-class VMEM, headroom for Pallas overhead).
-        itemsize = jnp.dtype(q.dtype).itemsize
-        per_row = (
-            2 * (2 * block_q * d + 2 * block_k * d) * itemsize
-            + (2 * block_q * _LANES + block_q * d) * 4  # fwd m/l/acc
-            + 2 * block_k * d * 4  # dkv dk/dv accumulators
-        )
-        vmem_cap = max(1, (64 << 20) // per_row)
-        # then the largest divisor of batch·heads ≤ the request — any
-        # value is safe to sweep; exact grid cover, no bh padding
-        bh_block = min(int(bh_block), b * h, vmem_cap)
-        while (b * h) % bh_block:
-            bh_block -= 1
+    group = h // h_kv
+    # VMEM-aware cap first: every input/output block and all three
+    # f32 scratch buffers scale with G — an unbounded G=64 at
+    # 512-blocks/d=128 is a ~115 MB cell that Mosaic cannot place.
+    # Estimate per-row bytes (q+k+v+o double-buffered at the input
+    # itemsize, plus the largest kernel's scratch) against a 64 MB
+    # budget (half of v5e-class VMEM, headroom for Pallas overhead).
+    itemsize = jnp.dtype(q.dtype).itemsize
+    per_row = (
+        2 * (2 * block_q * d + 2 * block_k * d) * itemsize
+        + (2 * block_q * _LANES + block_q * d) * 4  # fwd m/l/acc
+        + 2 * block_k * d * 4  # dkv dk/dv accumulators
+    )
+    vmem_cap = max(1, (64 << 20) // per_row)
+    # then the largest G ≤ the request with exact grid cover: G must
+    # divide batch·heads, and under GQA additionally be a MULTIPLE of
+    # the group (the cell's K/V block carries G/group rows; a
+    # non-multiple would make that block zero rows). G=1 is always
+    # legal — the classic per-row b//group path.
+    bh_block = min(int(bh_block), b * h, vmem_cap)
+    while bh_block > 1 and ((b * h) % bh_block or bh_block % group):
+        bh_block -= 1
+    bh_block = max(1, bh_block)
     cfg = _Cfg(
         causal=causal,
         scale=scale,
