@@ -39,6 +39,7 @@ def test_examples_import():
         "10_pipeline_lm",
         "11_pipeline_trainer_streaming",
         "12_packed_gqa_lm",
+        "13_preempt_resume",
     ]:
         assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
 
@@ -140,3 +141,15 @@ def test_packed_gqa_example():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "packed + GQA + cosine recipe complete" in r.stdout
+
+
+@pytest.mark.slow
+def test_preempt_resume_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "13_preempt_resume.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "preempt/resume recipe complete" in r.stdout
