@@ -740,6 +740,24 @@ def _attention_sweep(diag: dict, rtt_ms: float = 0.0) -> None:
                             q, steps, rtt_ms,
                         )
                         results[f"q{bq}k{bk}g{g}"] = round(ms, 3)
+                # GQA (kv=2, group 4) through the batched grid — the
+                # generate/packed-GQA families' shape; r05 lifted the
+                # G=1 restriction (group folded in-kernel)
+                try:
+                    kg = k[:, : max(1, h // 4)]
+                    vg = v[:, : max(1, h // 4)]
+                    for gq in (1, 8):
+                        ms = _timed_scan(
+                            jax,
+                            lambda c, gq=gq: flash_attention(
+                                c, kg, vg, causal=True, block_q=512,
+                                block_k=512, bh_block=gq,
+                            ),
+                            q, steps, rtt_ms,
+                        )
+                        results[f"gqa4_g{gq}"] = round(ms, 3)
+                except Exception as e:
+                    results["gqa4"] = f"n/a: {e}"[:120]
                 # the materialized-einsum alternative: whichever wins at
                 # a length is what pick_attn_impl's threshold should say
                 results["xla_einsum"] = round(_timed_scan(
