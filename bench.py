@@ -1818,6 +1818,15 @@ def _bench_lm(args, devices) -> int:
             # cache absorbs most of it, and it runs only on the
             # SUCCESSFUL rung
             flops = flops_of_jitted(step1, state)
+            if accum > 1:
+                # XLA cost analysis counts a lax.scan body ONCE (a
+                # 4-chunk accum scan reports ~1.2x the single-chunk
+                # FLOPs, verified on CPU), so the accum loop's FLOPs
+                # must be scaled by hand; the optimizer's share is
+                # over-counted (accum-1)x but is <<1% of a
+                # transformer step. Without this the accum4 capture
+                # reports mfu/4 at identical tokens/s (r05).
+                flops *= accum
             break
         except Exception as e:
             # XLA OOMs surface under several phrasings depending on the
@@ -1828,7 +1837,8 @@ def _bench_lm(args, devices) -> int:
                     or "oom" in msg.split() or "exceeds the memory" in msg):
                 raise
             del step1, state
-            print(f"# lm remat={remat_mode} OOM; stepping down",
+            print(f"# lm remat={remat_mode} OOM; stepping down "
+                  f"({type(e).__name__}: {str(e)[:200]})",
                   file=sys.stderr, flush=True)
     else:
         raise RuntimeError("lm bench OOM even with full remat")
