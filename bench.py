@@ -822,7 +822,7 @@ def _transport_diag(diag: dict, rtt_ms: float, smoke: bool = False) -> None:
 
         n_dev = len(jax.devices())
         if n_dev >= 2:
-            from jax import shard_map
+            from tpuflow.core.compat import shard_map
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
             import numpy as np
@@ -1090,6 +1090,16 @@ def main() -> int:
                         "KV-cache autoregressive decode throughput "
                         "(serving loop; vs_baseline anchors to the "
                         "param-bandwidth decode roofline)")
+    p.add_argument("--decode", action="store_true",
+                   help="serving-path microbench: blockwise prefill + "
+                        "early-exit decode (tpuflow.infer.generate, the "
+                        "default engine) vs the stepwise single-token-"
+                        "scan oracle at a couple of (prompt, new-tokens) "
+                        "shapes — reports prefill tokens/s, decode "
+                        "steps/s, and time-to-first-token per engine; "
+                        "value = blockwise generated tokens/s/chip, "
+                        "vs_baseline = blockwise/stepwise end-to-end "
+                        "speedup (ignores --model)")
     p.add_argument("--seq", type=int, default=None,
                    help="lm only: sequence length (default 4096)")
     p.add_argument("--grad-accum", type=int, default=1,
@@ -1140,7 +1150,8 @@ def main() -> int:
     p.add_argument("--progress-file", default=None, help=argparse.SUPPRESS)
     args = p.parse_args()
     global _MODE, _PROGRESS_PATH
-    _MODE = "e2e" if args.end2end else args.model
+    _MODE = ("e2e" if args.end2end
+             else "decode" if args.decode else args.model)
     if args.end2end and args.model != "cnn":
         p.error("--end2end measures the cnn (MobileNetV2 transfer) "
                 "pipeline only; drop --model or use --model cnn")
@@ -1235,6 +1246,8 @@ def _bench(args) -> int:
         return 0
 
     n_chips = len(devices)
+    if args.decode:
+        return _bench_decode(args, devices)
     if args.model == "lm":
         return _bench_lm(args, devices)
     if args.model == "generate":
@@ -1907,6 +1920,125 @@ def _bench_lm(args, devices) -> int:
         return ext
 
     _write_extended_diag(diag, _extended, out=args.diag_out)
+    return 0
+
+
+def _bench_decode(args, devices) -> int:
+    """--decode: the serving-path microbench behind ISSUE 1's tentpole.
+    For a couple of (prompt_len, new_tokens) shapes it times BOTH
+    engines of tpuflow.infer.generate — ``blockwise`` (chunked
+    multi-token prefill + early-exit segment decode, the default) and
+    ``stepwise`` (the original P+N-1 single-token scan, the parity
+    oracle) — and reports per engine:
+
+    - ``ttft_ms``: time-to-first-token (a ``max_new_tokens=1`` call —
+      prefill + one sample; for stepwise that is a P-step scan, for
+      blockwise ceil(P/chunk) matmul passes),
+    - ``prefill_tok_s``: batch * P / ttft,
+    - ``decode_steps_s``: the marginal post-first-token step rate,
+      (N - 1) / (t_full - ttft).
+
+    ``value`` = blockwise generated tokens/s/chip at the largest shape;
+    ``vs_baseline`` = blockwise / stepwise end-to-end tokens/s at that
+    shape (the before/after of the tentpole — the old engine IS the
+    baseline). Like --model generate, the jitted loop is unsharded:
+    per-chip numbers normalize by 1."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuflow.infer import generate
+    from tpuflow.models import build_transformer_lm
+
+    n_chips = 1
+    if args.smoke:
+        dim, depth, heads, vocab = 64, 2, 4, 256
+        batch = args.batch or 2
+        shapes = [(16, 8), (32, 8)]
+    else:
+        dim, depth, heads, vocab = 1024, 12, 8, 32000
+        batch = args.batch or 8
+        shapes = [(128, 128), (512, 64)]
+    model = build_transformer_lm(
+        vocab_size=vocab, dim=dim, depth=depth, heads=heads,
+        attn_impl="einsum",  # decode-mode chunks use the cache einsum
+        kv_heads=args.kv_heads,
+    )
+    rtt_ms = _measure_rtt()
+    rng = np.random.default_rng(0)
+    params = nn.unbox(
+        model.init({"params": jax.random.key(0)},
+                   jnp.zeros((batch, 8), jnp.int32))
+    )["params"]
+
+    def timed(prompt, new_tokens, engine):
+        def _run():
+            out = generate(model, params, prompt,
+                           max_new_tokens=new_tokens, temperature=0.8,
+                           top_k=40, seed=0, eos_id=None, engine=engine)
+            int(out[0, -1])  # data-dependent fetch = real sync
+        t0 = time.time()
+        _run()  # compile
+        compile_s = time.time() - t0
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.time()
+            _run()
+            best = min(best, _rtt_correct(time.time() - t0, rtt_ms))
+        return best, compile_s
+
+    per_shape = []
+    for p_len, new_tokens in shapes:
+        prompt = jnp.asarray(
+            rng.integers(0, vocab, (batch, p_len), dtype=np.int32)
+        )
+        rec = {"batch": batch, "prompt_len": p_len,
+               "new_tokens": new_tokens}
+        for engine in ("blockwise", "stepwise"):
+            ttft, c1 = timed(prompt, 1, engine)
+            t_full, c2 = timed(prompt, new_tokens, engine)
+            decode_s = max(t_full - ttft, 1e-9)
+            rec[engine] = {
+                "ttft_ms": round(ttft * 1e3, 3),
+                "prefill_tok_s": round(batch * p_len / ttft, 1),
+                "decode_steps_s": round((new_tokens - 1) / decode_s, 1),
+                "tok_s_per_chip": round(
+                    batch * new_tokens / t_full / n_chips, 1),
+                "compile_s": round(c1 + c2, 1),
+            }
+            _progress({"phase": "decode_shape", "record": rec})
+        rec["speedup"] = round(
+            rec["blockwise"]["tok_s_per_chip"]
+            / max(rec["stepwise"]["tok_s_per_chip"], 1e-9), 3)
+        per_shape.append(rec)
+        diag = {
+            "device_kind": devices[0].device_kind,
+            "n_chips": n_chips,
+            "n_host_chips": len(devices),
+            "model": f"lm-d{dim}x{depth}h{heads}"
+                     + (f"kv{args.kv_heads}" if args.kv_heads else ""),
+            "rtt_ms": round(rtt_ms, 1),
+            "shapes": per_shape,
+        }
+        tok_s = rec["blockwise"]["tok_s_per_chip"]
+        speedup = rec["speedup"]
+        _set_provisional(
+            value=tok_s, vs_baseline=speedup, diagnostics=diag,
+            metric="decode_tokens_per_sec_per_chip",
+            unit="tokens/s/chip",
+        )
+        print(
+            f"# decode P={p_len} N={new_tokens} blockwise "
+            f"ttft={rec['blockwise']['ttft_ms']}ms "
+            f"prefill={rec['blockwise']['prefill_tok_s']:.0f}tok/s "
+            f"steps={rec['blockwise']['decode_steps_s']:.0f}/s | "
+            f"stepwise ttft={rec['stepwise']['ttft_ms']}ms | "
+            f"speedup={speedup:.2f}x",
+            file=sys.stderr, flush=True,
+        )
+    emit(tok_s, speedup, diagnostics=diag,
+         metric="decode_tokens_per_sec_per_chip", unit="tokens/s/chip")
     return 0
 
 

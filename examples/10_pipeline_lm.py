@@ -40,7 +40,7 @@ def main() -> None:
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from tpuflow.core.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from tpuflow.models import build_transformer_lm, next_token_loss

@@ -177,6 +177,27 @@ def test_smoke_generate_emits_schema():
 
 
 @pytest.mark.slow
+def test_smoke_decode_emits_schema():
+    """--decode: the blockwise-vs-stepwise serving microbench reports
+    prefill/decode/TTFT per engine and anchors vs_baseline to the
+    stepwise (old-engine) tokens/s."""
+    r = _run("--smoke", "--decode", "--no-attn-diag")
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "decode_tokens_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 0  # blockwise/stepwise speedup
+    shapes = rec["diagnostics"]["shapes"]
+    assert len(shapes) == 2
+    for s in shapes:
+        for eng in ("blockwise", "stepwise"):
+            assert s[eng]["ttft_ms"] > 0
+            assert s[eng]["prefill_tok_s"] > 0
+            assert s[eng]["decode_steps_s"] > 0
+    assert "error" not in rec
+
+
+@pytest.mark.slow
 def test_smoke_end2end_emits_schema():
     r = _run("--smoke", "--end2end", "--e2e-images", "32", "--no-attn-diag")
     assert r.returncode == 0, r.stderr[-2000:]

@@ -144,6 +144,20 @@ def test_packed_gqa_example():
 
 
 @pytest.mark.slow
+def test_bucketed_lm_serving_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_EXAMPLES, "14_bucketed_lm_serving.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "serve_slots=2 wave draining matches" in r.stdout
+    assert "bucketed serving example OK" in r.stdout
+
+
+@pytest.mark.slow
 def test_preempt_resume_example():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

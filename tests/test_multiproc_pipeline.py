@@ -13,6 +13,7 @@ import sys
 import textwrap
 
 import numpy as np
+import pytest
 
 _WORKER = textwrap.dedent(
     """
@@ -85,6 +86,10 @@ def _run_two_proc(tmp_path, sched: str, port: int) -> float:
     return m0["loss"]
 
 
+# slow tier like its test_multiproc_train siblings: spawns a
+# real 2-process rig (old CPU jaxlibs cannot run multiprocess
+# collectives at all and fail it outright)
+@pytest.mark.slow
 def test_two_process_pipeline_matches_single(tmp_path):
     import jax
     import jax.numpy as jnp
@@ -116,6 +121,10 @@ def test_two_process_pipeline_matches_single(tmp_path):
     np.testing.assert_allclose(loss_2p_1f1b, loss_1p, rtol=5e-4)
 
 
+# slow tier like its test_multiproc_train siblings: spawns a
+# real 2-process rig (old CPU jaxlibs cannot run multiprocess
+# collectives at all and fail it outright)
+@pytest.mark.slow
 def test_two_process_interleaved_matches_single(tmp_path):
     """Interleaved virtual-stage schedule across REAL process
     boundaries: with 2 chunks per process the chunk-wrap hop (last

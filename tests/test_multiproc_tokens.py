@@ -14,6 +14,7 @@ import sys
 import textwrap
 
 import numpy as np
+import pytest
 
 _WORKER = textwrap.dedent(
     """
@@ -53,6 +54,10 @@ _WORKER = textwrap.dedent(
 )
 
 
+# slow tier like its test_multiproc_train siblings: spawns a
+# real 2-process rig (old CPU jaxlibs cannot run multiprocess
+# collectives at all and fail it outright)
+@pytest.mark.slow
 def test_two_process_token_stream_matches_single(tmp_path):
     import jax
     import jax.numpy as jnp

@@ -247,3 +247,101 @@ def test_packaged_lm_text_surface(tmp_path):
     m2 = PackagedLM(d2)
     with pytest.raises(ValueError, match="no bundled tokenizer"):
         m2.generate_text(["x"])
+
+
+def _text_pkg(tmp_path):
+    """A packaged LM with a bundled tokenizer (shared fixture for the
+    bucketed-serving tests)."""
+    import flax.linen as nn
+
+    from tpuflow.data.text import ByteBPE
+
+    corpus = "the cat sat on the mat. the dog sat on the log. " * 30
+    bpe = ByteBPE.train(corpus, vocab_size=300)
+    cfg = dict(vocab_size=bpe.vocab_size, dim=32, depth=1, heads=2,
+               mlp_ratio=2, dtype=jnp.float32)
+    lm = build_transformer_lm(**cfg)
+    params = lm.init(
+        {"params": jax.random.key(0)}, jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    d = str(tmp_path / "pkg_bucketed")
+    save_packaged_lm(d, nn.unbox(params), cfg, tokenizer=bpe)
+    return PackagedLM(d)
+
+
+def test_bucketed_text_invariant_to_batch_composition(tmp_path):
+    """The bucketed-serving pin: same prompt + same seed -> same text
+    no matter which other prompts share the call — served alone, with a
+    same-bucket neighbor of a DIFFERENT token length (left-pad amounts
+    differ), or with a different-bucket prompt. Extends the pad-row
+    RNG-invariance property (infer/generate._sample) to the text
+    surface."""
+    m = _text_pkg(tmp_path)
+    long_p = "the dog sat on the log and the cat sat on the mat again"
+    for kw in (dict(temperature=0.0),
+               dict(temperature=0.8, top_k=20, seed=7)):
+        solo = m.generate_text(["the cat"], max_new_tokens=4, **kw)[0]
+        same_bucket = m.generate_text(["the cat", "a dog"],
+                                      max_new_tokens=4, **kw)
+        cross_bucket = m.generate_text(["the cat", long_p],
+                                       max_new_tokens=4, **kw)
+        assert same_bucket[0] == solo, kw
+        assert cross_bucket[0] == solo, kw
+
+
+def test_bucketed_lengths_share_one_generate_call(tmp_path):
+    """Prompts of DIFFERENT token lengths that share a power-of-two
+    bucket are served by ONE engine call at the bucket length (the
+    compile-once-per-bucket contract), and the bucket floor keeps tiny
+    prompts in the 8-bucket."""
+    from tpuflow.packaging.lm import _bucket_len
+
+    assert [_bucket_len(n) for n in (1, 8, 9, 16, 17)] == [8, 8, 16, 16, 32]
+
+    m = _text_pkg(tmp_path)
+    prompts = ["the cat", "a dog sat on"]  # distinct token lengths
+    lens = {len(m.tokenizer.encode(p)) for p in prompts}
+    assert len(lens) == 2 and max(lens) <= 8  # really distinct, one bucket
+    seen = []
+    orig = m.generate
+
+    def spy(batch, **kw):
+        seen.append((batch.shape, tuple(kw.get("pad_lens"))))
+        return orig(batch, **kw)
+
+    m.generate = spy
+    outs = m.generate_text(prompts, max_new_tokens=3, seed=0)
+    m.generate = orig
+    assert len(outs) == 2 and all(outs)
+    assert len(seen) == 1, seen  # one call for both lengths
+    (shape, pads), = seen
+    assert shape == (2, 8)
+    assert pads[0] != pads[1]  # per-row left-pad, not per-group shape
+
+
+def test_serve_slots_waves_match_single_wave(tmp_path):
+    """Continuous batching at wave granularity: draining a bucket in
+    serve_slots-sized waves refilled from the pending queue returns the
+    same texts (in the same order) as one monolithic wave."""
+    m = _text_pkg(tmp_path)
+    prompts = ["the cat", "a dog", "the mat.", "the dog sat on",
+               "the dog sat on the log and the cat sat on the mat again"]
+    one = m.generate_text(prompts, max_new_tokens=3, seed=0)
+    calls = []
+    orig = m.generate
+
+    def spy(batch, **kw):
+        calls.append(batch.shape)
+        return orig(batch, **kw)
+
+    m.generate = spy
+    waved = m.generate_text(prompts, max_new_tokens=3, seed=0,
+                            serve_slots=2)
+    m.generate = orig
+    assert waved == one
+    # 4 same-bucket prompts over 2 slots -> 2 waves; the long prompt's
+    # bucket drains in its own wave
+    assert all(b <= 2 for b, _ in calls), calls
+    assert len(calls) >= 3, calls
+    with pytest.raises(ValueError, match="serve_slots"):
+        m.generate_text(prompts, serve_slots=0)

@@ -7,7 +7,8 @@ All on the 8-device virtual CPU mesh (SURVEY.md §4 discipline).
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+from tpuflow.core.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpuflow.parallel.pipeline import (

@@ -6,6 +6,8 @@ step (same init seed, same batch order) — for BOTH schedules
 (VERDICT r2 #4's loss-parity requirement).
 """
 
+import functools
+
 import numpy as np
 import pytest
 
@@ -18,6 +20,42 @@ from tpuflow.parallel.mesh import build_nd_mesh
 from tpuflow.train import LMTrainer, PipelineTrainer
 
 VOCAB = 64
+
+
+@functools.lru_cache(maxsize=1)
+def _partial_manual_spmd_works() -> bool:
+    """TP-inside-PP needs shard_map with a non-empty auto set AND
+    ``lax.axis_index`` over a manual axis (the stage id); that lowers
+    to a PartitionId instruction, which old XLA:CPU rejects under SPMD
+    partitioning ("UNIMPLEMENTED: PartitionId instruction is not
+    supported..."). Probe the exact pattern once per session."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tpuflow.core.compat import shard_map
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("a", "b"))
+    f = jax.jit(shard_map(
+        lambda x: x + jax.lax.axis_index("a"), mesh=mesh,
+        in_specs=P("a"), out_specs=P("a"),
+        axis_names=frozenset({"a"}), check_vma=False,
+    ))
+    try:
+        f(jnp.zeros((4, 4), jnp.int32))
+        return True
+    except Exception:
+        return False
+
+
+@pytest.fixture
+def partial_manual_spmd():
+    """Lazy capability gate (a fixture, not skipif, so that merely
+    COLLECTING this file never pays the probe's jit compile)."""
+    if not _partial_manual_spmd_works():
+        pytest.skip(
+            "XLA backend cannot compile PartitionId under partial-manual "
+            "SPMD (TP-inside-PP); needs a newer jaxlib or a real mesh"
+        )
 
 
 def _corpus(n, seq_len, seed=0):
@@ -87,7 +125,7 @@ def test_dp_x_pp_matches_unpipelined(schedule):
 
 
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
-def test_dp_x_tp_x_pp_matches_unpipelined(schedule):
+def test_dp_x_tp_x_pp_matches_unpipelined(schedule, partial_manual_spmd):
     """All three dense axes on ONE mesh (dp2 x tp2 x pp2): rows over
     'data', stages manual over 'pipe', block kernels GSPMD-sharded
     over the auto 'model' axis inside each tick — same math as the
@@ -107,7 +145,7 @@ def test_dp_x_tp_x_pp_matches_unpipelined(schedule):
     np.testing.assert_allclose(losses_pp, losses_ref, rtol=2e-4)
 
 
-def test_interleaved_dp_x_tp_x_pp_matches_unpipelined():
+def test_interleaved_dp_x_tp_x_pp_matches_unpipelined(partial_manual_spmd):
     """The virtual-stage schedule composes with TP too: dp2 x tp2 x
     pp2 x v2 (depth 8 = 2 stages x 2 chunks x 2 blocks)."""
     toks = _corpus(24, 16)

@@ -106,7 +106,10 @@ def test_keras_mnv2_legacy_fixture_roundtrip(tmp_path):
     np.testing.assert_array_equal(gotd, np.transpose(srcd, (0, 1, 3, 2)))
 
 
-@pytest.mark.parametrize("depth", [18, 50])
+# depth 18 exercises the whole conversion path; the deeper fixture
+# adds only size, so it is slow-tier
+@pytest.mark.parametrize(
+    "depth", [18, pytest.param(50, marks=pytest.mark.slow)])
 def test_torchvision_resnet_fixture_roundtrip(tmp_path, depth):
     import torch
 

@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from tpuflow.core.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpuflow.ops import mha_reference
@@ -36,7 +36,10 @@ def _ring_fn(mesh, **kw):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("n_dev", [1, 4, 8])
+# 8-shard variants are slow-tier: same algorithm as 4-shard at ~2x
+# the CPU compile cost
+@pytest.mark.parametrize(
+    "n_dev", [1, 4, pytest.param(8, marks=pytest.mark.slow)])
 def test_matches_full_attention(causal, n_dev):
     b, h, s, d = 1, 2, 32, 8
     q, k, v = (_rand((b, h, s, d), i) for i in range(3))
@@ -83,7 +86,8 @@ def _stripe(x, perm):
     return x[:, :, perm, :]
 
 
-@pytest.mark.parametrize("n_dev", [2, 4, 8])
+@pytest.mark.parametrize(
+    "n_dev", [2, 4, pytest.param(8, marks=pytest.mark.slow)])
 def test_striped_matches_full_attention(n_dev):
     """Striped layout: tokens pre-permuted round-robin, every causal
     ring visit half-visible (the balanced schedule) — unstriped output

@@ -262,7 +262,7 @@ def test_hybrid_mesh_single_slice_fallback():
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from tpuflow.core.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tpuflow.parallel.mesh import build_hybrid_mesh

@@ -9,7 +9,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from tpuflow.core.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuflow.models.transformer import (
@@ -111,6 +111,7 @@ def test_tp_forward_matches_single_device():
     assert fwd.lower(v, toks).compile()  # compiles clean
 
 
+@pytest.mark.slow
 def test_sequence_parallel_matches_standard():
     """Causal ring attention inside the full LM under shard_map with
     tokens sharded along the sequence == the standard model."""

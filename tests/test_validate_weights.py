@@ -11,6 +11,7 @@ import pytest
 import tools.validate_pretrained_weights as vw  # noqa: E402
 
 
+@pytest.mark.slow
 def test_offline_mnv2_parity():
     sd = vw.synth_mnv2_state_dict(seed=3)
     rec = vw.validate_model("mobilenet_v2", sd, hw=65)

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from tpuflow.core.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpuflow.core.config import TrainConfig
@@ -88,6 +88,7 @@ def test_spmd_trainer_tp_matches_single_device():
     assert tuple(spec) == (None, "model")
 
 
+@pytest.mark.slow
 def test_sequence_parallel_forward_matches_standard():
     """Full ViT under shard_map with images sharded along H: ring
     attention + pos-table slicing + psum pooling == the standard model."""

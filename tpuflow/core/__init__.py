@@ -1,3 +1,4 @@
+from tpuflow.core.compat import shard_map  # noqa: F401
 from tpuflow.core.dist import (  # noqa: F401
     barrier,
     initialize,

@@ -1,0 +1,89 @@
+"""Version compatibility shims for the JAX API surface.
+
+One place absorbs upstream API moves so a JAX upgrade (or downgrade)
+breaks ONE import instead of every call site: ``shard_map`` graduated
+from ``jax.experimental.shard_map`` to the top-level ``jax.shard_map``
+namespace, and the repo targets both — newer JAX first, experimental
+fallback for the 0.4.x line. Everything in tpuflow (and the tests /
+examples / bench) imports ``shard_map`` from HERE, never from jax
+directly; tests/test_import_health.py turns any future break of this
+kind into one clear failure instead of a pile of opaque collection
+errors.
+"""
+
+from __future__ import annotations
+
+import jax
+
+import inspect as _inspect
+
+try:  # jax >= 0.5: public top-level API
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "axis_names" in _inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(f, *, mesh, in_specs, out_specs,
+                  axis_names=None, check_vma=None, **kw):
+        """Accept the new-API kwargs on jax 0.4.x: ``axis_names`` (the
+        manual axes) is the complement of the old ``auto`` set, and
+        ``check_vma`` was called ``check_rep``."""
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kw["auto"] = auto
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+try:  # jax >= 0.5
+    axis_size = jax.lax.axis_size
+except AttributeError:  # jax 0.4.x: psum of a constant constant-folds
+    # to the axis size at trace time (no collective is emitted)
+    def axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+
+try:  # jax >= 0.6: public aval-of API (carries .vma under shard_map)
+    typeof = jax.typeof
+except AttributeError:  # jax 0.4.x: the aval has no .vma — callers
+    # already guard with getattr(..., "vma", frozenset())
+    def typeof(x):
+        return jax.core.get_aval(x)
+
+try:
+    _SDS_HAS_VMA = "vma" in _inspect.signature(
+        jax.ShapeDtypeStruct.__init__
+    ).parameters
+except (ValueError, TypeError):  # C-level signature: probe directly
+    try:
+        jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+        _SDS_HAS_VMA = True
+    except TypeError:
+        _SDS_HAS_VMA = False
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """jax.ShapeDtypeStruct with the ``vma`` kwarg dropped on JAX
+    versions that predate varying-manual-axes tracking (0.4.x uses
+    check_rep instead, so the annotation is simply not needed)."""
+    if vma and _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def tpu_compiler_params(**kwargs):
+    """Pallas-TPU compiler params across the rename:
+    ``pltpu.CompilerParams`` (new) vs ``pltpu.TPUCompilerParams``
+    (jax 0.4.x). Imported lazily so CPU-only processes never pay for
+    (or break on) the Pallas TPU import."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+__all__ = ["shard_map", "axis_size", "typeof", "shape_dtype_struct",
+           "tpu_compiler_params"]

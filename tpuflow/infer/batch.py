@@ -180,6 +180,7 @@ def generate_table(
     registry=None,
     flush_rows: int = 4096,
     max_new_tokens: Optional[int] = None,
+    serve_slots: Optional[int] = None,
     **generate_kwargs,
 ) -> Optional[pa.Table]:
     """Map a packaged LM's TEXT surface over one shard of ``table``:
@@ -189,9 +190,16 @@ def generate_table(
     only the new text is wanted) — the LM-family C16, same
     sharding/streaming/output_table semantics as :func:`predict_table`
     (shard (i, n) rows are disjoint, so every process writes its own
-    part). Rows inside each engine batch are grouped by exact prompt
-    token length, so the decode scan compiles once per distinct length
-    and runs batched. ``model`` is a PackagedLM, a path, or a
+    part).
+
+    Rows inside each engine batch are served BUCKETED: prompts group
+    into power-of-two token-length buckets, left-padded with the pad
+    slots attention-masked, so the blockwise prefill + early-exit
+    decode engine (tpuflow.infer.generate) compiles once per (length
+    bucket, batch bucket) instead of once per distinct prompt length,
+    and each bucket drains in ``serve_slots``-sized waves refilled from
+    the pending queue — batch-granularity continuous batching (``None``
+    = one wave per bucket). ``model`` is a PackagedLM, a path, or a
     ``runs:/`` / ``models:/`` URI; sampling kwargs (temperature, top_k,
     top_p, seed, eos_id) default to the packaged ``generate_defaults``.
     """
@@ -206,7 +214,8 @@ def generate_table(
         )
     return _map_table_shard(
         lambda texts: model.generate_text(
-            texts, max_new_tokens=max_new_tokens, **generate_kwargs
+            texts, max_new_tokens=max_new_tokens, serve_slots=serve_slots,
+            **generate_kwargs
         ),
         pa.field("generation", pa.string()),
         table, text_col, batch_size, shard, limit, output_table,

@@ -3,21 +3,42 @@
 The reference has no generative model at all (its inference path is
 image classification via a packaged pyfunc, P2/03); this rounds out the
 transformer-LM family (tpuflow.models.transformer) with the standard
-serving loop, TPU-idiomatically:
+serving loop, TPU-idiomatically. Two engines share one contract:
 
-- one jitted ``lax.scan`` covers prefill AND sampling — static trip
-  count (``max_len``), static shapes throughout, single compilation;
+- ``engine='blockwise'`` (default): the prompt is fed through the
+  decode-mode model in ``ceil(P / prefill_chunk)`` multi-token forward
+  passes that populate the KV cache at ``cache_index`` — matmul-shaped
+  prefill on the MXU instead of P sequential matvecs — and only the
+  ``max_new_tokens`` sampling steps run as single-token scan steps.
+  The decode scan itself is chunked into ``decode_segment``-step
+  segments under a ``lax.while_loop`` with an all-rows-done check
+  between segments, so a batch that emits EOS early stops paying for
+  dead steps (bounded by GENERATED length, not total length).
+- ``engine='stepwise'``: the original reference loop — ONE jitted
+  ``lax.scan`` of ``P + max_new_tokens - 1`` single-token steps covers
+  prefill AND sampling. Kept as the parity oracle (the blockwise
+  engine is token-identical to it; tests/test_generate.py pins this)
+  and as the conservative fallback.
+
+Shared mechanics:
+
 - the KV cache is a flax ``cache`` collection created at trace time
-  with the full target length (decode steps ``dynamic_update_slice``
-  into it), so XLA sees one fixed buffer per layer — no growing
-  tensors, no host round-trips per token;
+  with the full target length (chunks ``dynamic_update_slice`` into it
+  at ``cache_index``), so XLA sees one fixed buffer per layer — no
+  growing tensors, no host round-trips per token;
 - sampling is temperature + optional top-k and nucleus (top-p)
-  filtering over float32 logits with a counter-derived ``jax.random``
-  key per step.
+  filtering over float32 logits, with a per-ROW key derived from
+  (seed, logical step, row index) — a row's RNG stream is independent
+  of batch shape AND of bucket padding (``pad_lens``, below);
+- ``pad_lens`` (blockwise only) marks per-row LEFT padding for
+  bucketed serving (tpuflow.packaging.lm buckets prompt lengths to
+  powers of two): pad slots are masked out of attention, rotary
+  positions and sampling steps are logical (pad-free), so a padded row
+  generates the same tokens as its unpadded run.
 
 Greedy (temperature=0) decode is exact argmax; the cache-consistency
-property (stepwise logits == full-forward logits) is tested in
-tests/test_generate.py.
+property (stepwise logits == full-forward logits) and the
+blockwise==stepwise parity are tested in tests/test_generate.py.
 """
 
 from __future__ import annotations
@@ -31,7 +52,7 @@ from jax import lax
 
 
 def _sample(logits, rng, temperature: float, top_k: Optional[int],
-            top_p: Optional[float] = None):
+            top_p: Optional[float] = None, step=None):
     logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -66,14 +87,26 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
     # the RNG (packaging/lm.py pads length-buckets with copies of row
     # 0; a single batch-shaped categorical draw would give different
     # outputs for the same prompt+seed depending on the pad count).
+    # ``step`` (scalar or per-row (B,)) folds the step index here too:
+    # the blockwise engine passes the LOGICAL (pad-free) step so a
+    # left-padded row draws the same stream as its unpadded run; the
+    # stepwise engine pre-folds the step into ``rng`` (equivalent key
+    # derivation — fold_in(fold_in(rng, t), i) either way).
     # Caveat: the LOGITS themselves are only batch-shape-invariant up
     # to the backend's reduction order — an ulp-level logit difference
     # near a probability boundary can still flip a draw on some
     # backends; the guarantee here is RNG invariance, not bitwise
     # forward-pass invariance
-    keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
-        jnp.arange(logits.shape[0])
-    )
+    rows = jnp.arange(logits.shape[0])
+    if step is None:
+        keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(rows)
+    else:
+        steps = jnp.broadcast_to(
+            jnp.asarray(step, jnp.int32), rows.shape
+        )
+        keys = jax.vmap(
+            lambda s, i: jax.random.fold_in(jax.random.fold_in(rng, s), i)
+        )(steps, rows)
     return jax.vmap(
         lambda lg, k: jax.random.categorical(k, lg)
     )(logits, keys).astype(jnp.int32)
@@ -89,6 +122,10 @@ def generate(
     top_p: Optional[float] = None,
     seed: int = 0,
     eos_id: Optional[int] = None,
+    pad_lens=None,
+    prefill_chunk: Optional[int] = None,
+    decode_segment: int = 32,
+    engine: str = "blockwise",
 ) -> jnp.ndarray:
     """Generate continuations for a batch of prompts.
 
@@ -98,15 +135,32 @@ def generate(
     max_new_tokens) int32 — prompts with sampled continuations; after a
     row emits ``eos_id`` its remaining positions repeat ``eos_id``.
 
-    The whole prompt+generate loop is ONE jitted scan of
-    ``P + max_new_tokens - 1`` single-token steps against a
-    fixed-length KV cache. (A blockwise prefill is a future
-    optimization; generation cost is dominated by the sampling steps.)
+    ``engine='blockwise'`` (default) prefills the prompt in
+    ``ceil(P / prefill_chunk)`` multi-token forward passes
+    (``prefill_chunk=None`` = the whole prompt in one pass; set it to
+    bound the chunk's score-matrix VMEM) and then scans ONLY the
+    sampling steps, in ``decode_segment``-step segments with an
+    all-rows-done early exit between segments (``eos_id`` set). The
+    scan trip count is bounded by the GENERATED length.
+
+    ``pad_lens`` (blockwise only): optional (B,) int32 per-row count of
+    LEFT pad slots — the bucketed-serving contract
+    (tpuflow.packaging.lm). Row r's real prompt occupies positions
+    ``pad_lens[r]:P``; pad slots are masked out of attention and the
+    row's rotary positions / RNG steps are logical (pad-free), so its
+    output tokens (at ``out[r, pad_lens[r]:]``) match the unpadded run.
+
+    ``engine='stepwise'``: the original single-token scan over
+    ``P + max_new_tokens - 1`` steps — the parity oracle.
     """
     dm = model.clone(decode=True, seq_axis=None)
     b, p = prompt.shape
     if p < 1:
         raise ValueError("prompt must have at least one token")
+    if engine not in ("blockwise", "stepwise"):
+        raise ValueError(
+            f"engine must be 'blockwise' or 'stepwise', got {engine!r}"
+        )
     if top_k is not None:
         vocab = getattr(model, "vocab_size", None)
         if top_k < 1 or (vocab is not None and top_k > vocab):
@@ -116,12 +170,50 @@ def generate(
             )
     if top_p is not None and not (0.0 < top_p <= 1.0):
         raise ValueError(f"top_p={top_p} must be in (0, 1]")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    rng = jax.random.key(seed)
     max_len = p + max_new_tokens
-    run = _compiled_run(dm, b, p, max_len, float(temperature),
-                        None if top_k is None else int(top_k),
-                        None if top_p is None else float(top_p), eos_id)
-    return run(params, jnp.asarray(prompt, jnp.int32),
-               jax.random.key(seed))
+    temperature = float(temperature)
+    top_k = None if top_k is None else int(top_k)
+    top_p = None if top_p is None else float(top_p)
+
+    if pad_lens is not None:
+        if engine != "blockwise":
+            raise ValueError(
+                "pad_lens (bucketed left-padding) requires "
+                "engine='blockwise'"
+            )
+        import numpy as np
+
+        pl = np.asarray(pad_lens, np.int32)
+        if pl.shape != (b,):
+            raise ValueError(
+                f"pad_lens must have shape ({b},), got {pl.shape}"
+            )
+        if pl.min() < 0 or pl.max() >= p:
+            raise ValueError(
+                "pad_lens entries must be in [0, P): every row needs "
+                "at least one real prompt token"
+            )
+        pad_lens = jnp.asarray(pl)
+
+    if max_new_tokens < 1:
+        return prompt
+
+    if engine == "stepwise":
+        run = _compiled_run(dm, b, p, max_len, temperature, top_k, top_p,
+                            eos_id)
+        return run(params, prompt, rng)
+
+    chunk = p if prefill_chunk is None else max(1, int(prefill_chunk))
+    seg = max(1, int(decode_segment))
+    run = _compiled_blockwise(
+        dm, b, p, max_len, temperature, top_k, top_p, eos_id,
+        min(chunk, p), seg, pad_lens is not None,
+    )
+    if pad_lens is not None:
+        return run(params, prompt, rng, pad_lens)
+    return run(params, prompt, rng)
 
 
 def clear_compile_cache() -> None:
@@ -130,34 +222,160 @@ def clear_compile_cache() -> None:
     distinct prompt shapes / sampling configs can call this to bound
     resident compile-cache growth; bucketing prompt lengths before
     calling :func:`generate` keeps the cache small in the first place
-    (ADVICE r2)."""
+    (tpuflow.packaging.lm does this for the text surface)."""
     _compiled_run.cache_clear()
+    _compiled_blockwise.cache_clear()
 
 
-@functools.lru_cache(maxsize=64)
-def _compiled_run(dm, b: int, p: int, max_len: int, temperature: float,
-                  top_k: Optional[int], top_p: Optional[float],
-                  eos_id: Optional[int]):
-    """The jitted prompt+decode scan, memoized on (model, shapes,
-    sampling config) — a serving loop calling generate() per request
-    with identical shapes must compile ONCE, not per call (flax modules
-    are frozen dataclasses, so ``dm`` is a valid cache key). Bounded at
-    64 entries; :func:`clear_compile_cache` empties it on demand."""
-
-    # cache struct at full length via eval_shape (no FLOPs), then zeros
+def _cache_zeros(dm, b: int, max_len: int):
+    """Zero KV cache with the decode model's full-length cache struct,
+    via eval_shape (no FLOPs). Built INSIDE the jitted runs so the
+    memoized closures hold only ShapeDtypeStructs, not device buffers."""
     cache_shapes = jax.eval_shape(
         lambda: dm.init(
             {"params": jax.random.key(0)},
             jnp.zeros((b, max_len), jnp.int32),
         )["cache"]
     )
+    return jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_blockwise(dm, b: int, p: int, max_len: int,
+                        temperature: float, top_k: Optional[int],
+                        top_p: Optional[float], eos_id: Optional[int],
+                        chunk: int, seg: int, has_pads: bool):
+    """The blockwise-prefill + early-exit decode engine, memoized on
+    (model, shapes, sampling config, chunking) — a serving loop calling
+    generate() per request with identical shapes compiles ONCE (flax
+    modules are frozen dataclasses, so ``dm`` is a valid cache key).
+    ``pad_lens`` is a RUNTIME argument (``has_pads`` only selects the
+    signature), so one bucket shape serves every pad combination.
+    Bounded at 64 entries; :func:`clear_compile_cache` empties it."""
+    total = max_len - p - 1  # decode steps AFTER the prefill-sampled token
+
+    def _impl(params, prompt, rng, pads):
+        cache = _cache_zeros(dm, b, max_len)
+        out = jnp.zeros((b, max_len), jnp.int32)
+        out = lax.dynamic_update_slice(out, prompt, (0, 0))
+
+        # ---- blockwise prefill: ceil(p/chunk) multi-token passes ----
+        # (python loop over STATIC chunk offsets, unrolled at trace
+        # time; every pass is an MXU-shaped matmul against the cache)
+        logits = None
+        for start in range(0, p, chunk):
+            width = min(chunk, p - start)
+            tok = lax.slice(prompt, (0, start), (b, start + width))
+            logits, vars2 = dm.apply(
+                {"params": params, "cache": cache}, tok,
+                mutable=["cache"], pad_lens=pads,
+            )
+            cache = vars2["cache"]
+
+        def logical(t):
+            # sampling-step index as the row sees it: slot minus pads
+            return t - pads if pads is not None else t
+
+        # first generated token: sampled from the LAST prompt
+        # position's prefill logits (slot p-1) — no scan step spent
+        nxt = _sample(logits[:, -1], rng, temperature, top_k, top_p,
+                      step=logical(jnp.int32(p - 1)))
+        done = jnp.zeros((b,), jnp.bool_)
+        if eos_id is not None:
+            done = nxt == eos_id
+        out = lax.dynamic_update_slice(out, nxt[:, None], (0, p))
+
+        # ---- early-exit decode: segment scans under a while_loop ----
+        def step(carry, t):
+            cache, out, done = carry
+            tok = lax.dynamic_slice(out, (0, t), (b, 1))
+            lg, vars2 = dm.apply(
+                {"params": params, "cache": cache}, tok,
+                mutable=["cache"], pad_lens=pads,
+            )
+            nxt = _sample(lg[:, -1], rng, temperature, top_k, top_p,
+                          step=logical(t))
+            if eos_id is not None:
+                nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+                done = done | (nxt == eos_id)
+            out = lax.dynamic_update_slice(out, nxt[:, None], (0, t + 1))
+            return (vars2["cache"], out, done), None
+
+        def run_seg(cache, out, done, t0, n):
+            (cache, out, done), _ = lax.scan(
+                lambda c, i: step(c, t0 + i), (cache, out, done),
+                jnp.arange(n),
+            )
+            return cache, out, done
+
+        if total > 0:
+            seg_n = min(seg, total)
+            nfull, rem = divmod(total, seg_n)
+            if eos_id is None:
+                # no EOS → no early exit possible: one flat scan
+                cache, out, done = run_seg(cache, out, done,
+                                           jnp.int32(p), total)
+            else:
+                def cond(c):
+                    k, _cache, _out, done = c
+                    return (k < nfull) & ~jnp.all(done)
+
+                def body(c):
+                    k, cache, out, done = c
+                    cache, out, done = run_seg(
+                        cache, out, done, p + k * seg_n, seg_n
+                    )
+                    return (k + 1, cache, out, done)
+
+                _, cache, out, done = lax.while_loop(
+                    cond, body, (jnp.int32(0), cache, out, done)
+                )
+                if rem:
+                    cache, out, done = lax.cond(
+                        jnp.all(done),
+                        lambda c: c,
+                        lambda c: run_seg(*c, p + nfull * seg_n, rem),
+                        (cache, out, done),
+                    )
+
+        if eos_id is not None:
+            # early exit leaves post-EOS slots unwritten (zeros); the
+            # contract says they repeat eos_id — backfill every slot
+            # strictly after a row's first generated EOS (a no-op for
+            # slots the scan already filled)
+            gen = out[:, p:]
+            hit = (gen == eos_id).astype(jnp.int32)
+            after = jnp.cumsum(hit, axis=1) - hit
+            gen = jnp.where(after > 0, jnp.int32(eos_id), gen)
+            out = jnp.concatenate([out[:, :p], gen], axis=1)
+        return out
+
+    if has_pads:
+        @jax.jit
+        def run(params, prompt, rng, pad_lens):
+            return _impl(params, prompt, rng, pad_lens)
+    else:
+        @jax.jit
+        def run(params, prompt, rng):
+            return _impl(params, prompt, rng, None)
+
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_run(dm, b: int, p: int, max_len: int, temperature: float,
+                  top_k: Optional[int], top_p: Optional[float],
+                  eos_id: Optional[int]):
+    """The stepwise prompt+decode scan (the original engine), memoized
+    on (model, shapes, sampling config). ONE scan of ``max_len - 1``
+    single-token steps covers prefill and sampling; kept as the parity
+    oracle for the blockwise engine and as the conservative fallback."""
+
     @jax.jit
     def run(params, prompt, rng):
-        # zeros built INSIDE the jit: the memoized closure then holds
-        # only ShapeDtypeStructs, not live device buffers
-        cache0 = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
-        )
+        cache0 = _cache_zeros(dm, b, max_len)
         out0 = jnp.zeros((b, max_len), jnp.int32)
         out0 = lax.dynamic_update_slice(out0, prompt, (0, 0))
         done0 = jnp.zeros((b,), jnp.bool_)

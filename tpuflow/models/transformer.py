@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpuflow.core.compat import axis_size as _axis_size
 from tpuflow.ops.attention import flash_attention, mha_xla, pick_attn_impl
 from tpuflow.parallel.mesh import MODEL_AXIS
 from tpuflow.parallel.ring_attention import ring_attention
@@ -144,7 +145,8 @@ class CausalAttention(nn.Module):
     rope_scaling_kind: str = "linear"
 
     @nn.compact
-    def __call__(self, x, segment_ids=None, positions_override=None):
+    def __call__(self, x, segment_ids=None, positions_override=None,
+                 pad_lens=None):
         tp = self.seq_axis is None
         head_dim = self.dim // self.heads
         kvh = self.kv_heads or self.heads
@@ -155,6 +157,11 @@ class CausalAttention(nn.Module):
             raise ValueError(
                 "segment_ids is not supported with seq_axis (ring "
                 "attention) or decode mode"
+            )
+        if pad_lens is not None and not self.decode:
+            raise ValueError(
+                "pad_lens (bucketed left-padding) is a decode-mode "
+                "feature; training paths mask pads via segment_ids"
             )
 
         def proj_in(name, n_heads):
@@ -194,7 +201,19 @@ class CausalAttention(nn.Module):
             if ready:
                 i = ci.value
                 max_len = ck.value.shape[2]
-                positions = i + jnp.arange(s, dtype=jnp.int32)
+                slots = i + jnp.arange(s, dtype=jnp.int32)  # cache slots
+                if pad_lens is None:
+                    positions = slots
+                else:
+                    # bucketed serving: rows are LEFT-padded to a shared
+                    # bucket length, pad_lens[r] pad slots preceding row
+                    # r's real tokens. Rotary positions are the LOGICAL
+                    # (pad-free) offsets, so a padded row rotates exactly
+                    # like its unpadded run; pad slots clamp to 0 (they
+                    # are masked out of every attention read below).
+                    positions = jnp.maximum(
+                        slots[None, :] - pad_lens[:, None], 0
+                    )
                 q, k = rotary_embed(q, k, positions, self.rope_theta,
                                 self.rope_scaling, self.rope_scaling_kind)
                 ck.value = lax.dynamic_update_slice(ck.value, k, (0, 0, i, 0))
@@ -202,13 +221,27 @@ class CausalAttention(nn.Module):
                 ci.value = i + s
                 # q rows attend to cache positions <= their own absolute
                 # position (causal within the chunk, full to the past)
-                key_pos = jnp.arange(max_len)[None, :]
-                ok = key_pos <= positions[:, None]  # (s, max_len)
-                if self.attn_window is not None:
-                    # sliding window holds in decode too: each new token
-                    # sees only its last attn_window cache entries
-                    ok = ok & (key_pos > positions[:, None]
-                               - self.attn_window)
+                key_pos = jnp.arange(max_len)
+                ok = key_pos[None, :] <= slots[:, None]  # (s, max_len)
+                if pad_lens is None:
+                    if self.attn_window is not None:
+                        # sliding window holds in decode too: each new
+                        # token sees only its last attn_window entries
+                        ok = ok & (key_pos[None, :] > slots[:, None]
+                                   - self.attn_window)
+                    mask = ok[None, None, None]  # (1,1,1,s,max_len)
+                else:
+                    # per-row mask: pad slots are never valid keys, and
+                    # the sliding window counts LOGICAL distance so pads
+                    # consume none of it
+                    okb = ok[None] & (key_pos[None, None, :]
+                                      >= pad_lens[:, None, None])
+                    if self.attn_window is not None:
+                        key_log = (key_pos[None, None, :]
+                                   - pad_lens[:, None, None])
+                        okb = okb & (key_log > positions[:, :, None]
+                                     - self.attn_window)
+                    mask = okb[:, None, None]  # (b,1,1,s,max_len)
                 # grouped einsums against the SMALL (B, KVH, S, D)
                 # cache — each K/V head serves its `group` query heads
                 # without ever materializing an expanded cache (the
@@ -219,7 +252,7 @@ class CausalAttention(nn.Module):
                     "bkgqd,bksd->bkgqs",
                     qg.astype(jnp.float32), ck.value.astype(jnp.float32),
                 ) * (head_dim ** -0.5)
-                scores = jnp.where(ok[None, None, None], scores, -1e30)
+                scores = jnp.where(mask, scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1)
                 o = jnp.einsum(
                     "bkgqs,bksd->bkgqd", probs,
@@ -237,7 +270,7 @@ class CausalAttention(nn.Module):
                 # absolute positions of this shard's tokens
                 shard = lax.axis_index(self.seq_axis)
                 if self.sp_layout == "striped":
-                    nsh = lax.axis_size(self.seq_axis)
+                    nsh = _axis_size(self.seq_axis)
                     positions = shard + jnp.arange(s, dtype=jnp.int32) * nsh
                 else:
                     positions = shard * s + jnp.arange(s, dtype=jnp.int32)
@@ -329,7 +362,7 @@ class DecoderBlock(nn.Module):
     rope_scaling_kind: str = "linear"  # linear | ntk
 
     @nn.compact
-    def __call__(self, x, segment_ids=None, positions=None):
+    def __call__(self, x, segment_ids=None, positions=None, pad_lens=None):
         x = x + CausalAttention(
             self.dim, self.heads, self.dtype, self.attn_impl, self.seq_axis,
             self.rope_theta, self.decode, self.sp_layout,
@@ -338,7 +371,8 @@ class DecoderBlock(nn.Module):
             rope_scaling=self.rope_scaling,
             rope_scaling_kind=self.rope_scaling_kind,
             name="attn",
-        )(RMSNorm(self.dtype, name="norm1")(x), segment_ids, positions)
+        )(RMSNorm(self.dtype, name="norm1")(x), segment_ids, positions,
+          pad_lens)
         y = RMSNorm(self.dtype, name="norm2")(x)
         if self.n_experts > 0:
             from tpuflow.models.moe import MoEMlp
@@ -448,13 +482,17 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, segment_ids=None,
-                 positions=None):
+                 positions=None, pad_lens=None):
         tp = self.seq_axis is None
         if segment_ids is not None and (
                 self.seq_axis is not None or self.decode):
             raise ValueError(
                 "segment_ids (sequence packing) is not supported with "
                 "seq_axis (ring attention) or decode mode"
+            )
+        if pad_lens is not None and not self.decode:
+            raise ValueError(
+                "pad_lens (bucketed left-padding) requires decode mode"
             )
         embed = self.param(
             "embed",
@@ -501,7 +539,7 @@ class TransformerLM(nn.Module):
                 rope_scaling=self.rope_scaling,
                 rope_scaling_kind=self.rope_scaling_kind,
                 name=f"block{i}",
-            )(x, segment_ids, positions)
+            )(x, segment_ids, positions, pad_lens)
         x = RMSNorm(self.dtype, name="norm_final")(x)
         if self.tie_embeddings:
             # tied head: the embedding table IS the head kernel (its

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpuflow.core.compat import axis_size as _axis_size
 from tpuflow.ops.attention import flash_attention, mha_xla, pick_attn_impl
 from tpuflow.parallel.mesh import MODEL_AXIS
 from tpuflow.parallel.ring_attention import ring_attention
@@ -192,7 +193,7 @@ class ViTClassifier(nn.Module):
         )
         if self.seq_axis is not None:
             # slice this shard's rows of the global positional table
-            n_shards = lax.axis_size(self.seq_axis)
+            n_shards = _axis_size(self.seq_axis)
             if hh * ww * n_shards != self.num_patches:
                 raise ValueError(
                     f"got {hh * ww} local patches x {n_shards} shards, model "
@@ -239,7 +240,7 @@ class ViTClassifier(nn.Module):
             # (uniform shards ⇒ the divisor is static)
             local = jnp.sum(x, axis=1)
             total = lax.psum(local, self.seq_axis)
-            x = total / (hh * ww * lax.axis_size(self.seq_axis))
+            x = total / (hh * ww * _axis_size(self.seq_axis))
         else:
             x = jnp.mean(x, axis=1)
         x = nn.Dropout(self.dropout)(x, deterministic=not train)

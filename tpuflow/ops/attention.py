@@ -44,6 +44,10 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpuflow.core.compat import shape_dtype_struct as _sds
+from tpuflow.core.compat import tpu_compiler_params as _tpu_compiler_params
+from tpuflow.core.compat import typeof as _typeof
+
 _NEG_BIG = -1e30
 
 
@@ -99,7 +103,7 @@ def _vma(*xs):
     attention) and an empty set otherwise."""
     out = frozenset()
     for x in xs:
-        out = out | getattr(jax.typeof(x), "vma", frozenset())
+        out = out | getattr(_typeof(x), "vma", frozenset())
     return out
 
 
@@ -481,8 +485,8 @@ def _fwd(cfg: _Cfg, q, k, v, segs=None):
             pl.BlockSpec((G, 1, sq), lambda b, i, j: (b, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v)),
-            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32, vma=_vma(q, k, v)),
+            _sds((bh, sq, d), q.dtype, vma=_vma(q, k, v)),
+            _sds((bh, 1, sq), jnp.float32, vma=_vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((G, cfg.block_q, _LANES), jnp.float32),  # running max
@@ -493,7 +497,7 @@ def _fwd(cfg: _Cfg, q, k, v, segs=None):
         # block's index map is invariant over qi, and a 'parallel' qi
         # would let megacore give each core a private copy of that
         # shared window — each core's flush clobbering the other's rows
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=cfg.interpret,
@@ -684,7 +688,7 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
         else (lambda b, i, j: (b // g, j, 0)),
     )
     vec_row = pl.BlockSpec((G, 1, sq), lambda b, i, j: (b, 0, 0))
-    semantics = pltpu.CompilerParams(
+    semantics = _tpu_compiler_params(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
 
@@ -700,7 +704,7 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
         grid=(bh // G, nq, nk),
         in_specs=dq_in_specs,
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=_vma(q, k, v, do)),
+        out_shape=_sds((bh, sq, d), q.dtype, vma=_vma(q, k, v, do)),
         scratch_shapes=[pltpu.VMEM((G, cfg.block_q, d), jnp.float32)],
         compiler_params=semantics,
         interpret=cfg.interpret,
@@ -752,10 +756,8 @@ def _bwd_impl(cfg: _Cfg, q, k, v, o, lse, do, segs=None):
         in_specs=dkv_in_specs,
         out_specs=[k_spec, k_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((bh_kv, skv, d), k.dtype,
-                                 vma=_vma(q, k, v, do)),
-            jax.ShapeDtypeStruct((bh_kv, skv, d), v.dtype,
-                                 vma=_vma(q, k, v, do)),
+            _sds((bh_kv, skv, d), k.dtype, vma=_vma(q, k, v, do)),
+            _sds((bh_kv, skv, d), v.dtype, vma=_vma(q, k, v, do)),
         ],
         scratch_shapes=[
             pltpu.VMEM((dkv_out_lead, cfg.block_k, d), jnp.float32),

@@ -8,6 +8,17 @@ next-token loss / perplexity. Same directory format family as
 tpuflow.packaging.model (MODEL.json + weights.msgpack), same registry
 story (register the directory, stage it, load by URI).
 
+The TEXT surface (``generate_text``) is the bucketed serving frontend
+of the blockwise engine: prompts are grouped into POWER-OF-TWO token-
+length buckets, each row LEFT-padded to its bucket with the pad slots
+masked out of attention (``pad_lens`` — tpuflow.infer.generate), so a
+table-scale run compiles once per (length bucket, batch bucket)
+instead of once per distinct prompt length. Buckets drain in
+``serve_slots``-sized waves refilled from the pending queue, the
+batch-granularity form of continuous batching (finished waves free
+their slots for queued prompts immediately; in-scan slot swapping is
+the engine-level next step).
+
 Directory layout:
   MODEL.json        format metadata, model_config, generate_defaults
   weights.msgpack   params
@@ -29,6 +40,17 @@ from tpuflow.track.store import _atomic_json
 
 _FORMAT_VERSION = 1
 _MODEL_TYPE = "transformer_lm"
+
+# smallest prompt-length bucket: prompts shorter than this pad up to it
+# (one compile covers every prompt of 1..8 tokens; the pad slots are
+# attention-masked, so outputs are unchanged)
+_MIN_LEN_BUCKET = 8
+
+
+def _bucket_len(plen: int) -> int:
+    """Next power of two >= plen, floored at _MIN_LEN_BUCKET — the
+    prompt-CAPACITY bucket shared by every prompt that pads to it."""
+    return max(_MIN_LEN_BUCKET, 1 << (max(1, plen) - 1).bit_length())
 
 
 def save_packaged_lm(
@@ -169,56 +191,83 @@ class PackagedLM:
         self,
         prompts: "Sequence[str]",
         max_new_tokens: Optional[int] = None,
+        serve_slots: Optional[int] = None,
         **kwargs,
     ) -> "list[str]":
         """Raw strings in -> continued strings out (prompt INCLUDED,
         like generate()) — the text symmetry of the image packaged
-        model's bytes-in contract. Prompts are encoded with the bundled
-        tokenizer and BATCHED by exact token length (ragged batching
-        without pad-token conditioning: rows of equal length share one
-        (B, P) generate() call). Each group's batch is padded up to the
-        next power of two (pad rows repeat row 0 and are discarded), so
-        a table-scale run compiles once per (prompt length, batch
-        BUCKET) — without the bucketing, generate_table's chunking
-        makes group sizes vary per chunk and the same prompt length
-        recompiles repeatedly (ADVICE r03). Output order matches input
-        order. Sampling (temperature > 0) draws per-ROW keys folded by
-        row index (infer/generate._sample), so a row's RNG stream is
-        independent of the pad rows appended after it (logit-level
-        numerics can still vary with batch shape on some backends) —
-        and a prompt's row index within its length group depends on
-        which other prompts share that length, so sampled outputs can
-        differ from a one-at-a-time loop (greedy output is identical
-        either way)."""
+        model's bytes-in contract.
+
+        Prompts are encoded with the bundled tokenizer and grouped into
+        POWER-OF-TWO token-length buckets: each row is LEFT-padded to
+        its bucket length and the engine masks the pad slots out of
+        attention (``pad_lens`` — tpuflow.infer.generate), so one
+        compile covers EVERY prompt length that shares a bucket instead
+        of one compile per distinct length. Each bucket drains in
+        ``serve_slots``-sized waves refilled from the bucket's pending
+        queue (continuous batching at wave granularity: a finished wave
+        frees all its slots for queued prompts at once; ``None`` serves
+        each bucket in a single wave). Wave batches are padded up to
+        the next power of two (pad rows repeat row 0 and are
+        discarded), so a table-scale run compiles once per (length
+        bucket, batch bucket) — without this, generate_table's chunking
+        makes group sizes vary per chunk and recompiles repeatedly
+        (ADVICE r03). Output order matches input order.
+
+        Sampling (temperature > 0) draws per-ROW keys folded by
+        (logical step, row index) (infer/generate._sample), so a row's
+        RNG stream is independent of the pad rows appended after it AND
+        of how much left-padding its bucket added (logit-level numerics
+        can still vary with batch shape on some backends) — but a
+        prompt's ROW INDEX within its wave depends on which other
+        prompts share the bucket, so sampled outputs can differ from a
+        one-at-a-time loop (greedy output is identical either way)."""
         tok = self._require_tokenizer()
         eos = kwargs.get("eos_id", self.generate_defaults.get("eos_id"))
         encoded = [np.asarray(tok.encode(p), np.int32) for p in prompts]
-        by_len: "dict[int, list[int]]" = {}
+        by_bucket: "dict[int, list[int]]" = {}
         for i, ids in enumerate(encoded):
-            by_len.setdefault(len(ids), []).append(i)
+            by_bucket.setdefault(_bucket_len(len(ids)), []).append(i)
         out: "list[Optional[str]]" = [None] * len(prompts)
-        for plen, idxs in by_len.items():
-            batch = np.stack([encoded[i] for i in idxs])
-            # next pow2 >= B, capped at the CALLER's total prompt count:
-            # generate_table sizes its chunks to the device-memory
-            # budget, and padding a full chunk past it could OOM
-            bucket = min(1 << (len(idxs) - 1).bit_length(), len(prompts))
-            if bucket > len(idxs):
-                batch = np.concatenate(
-                    [batch, np.tile(batch[:1], (bucket - len(idxs), 1))]
-                )
-            fulls = self.generate(batch, max_new_tokens=max_new_tokens,
-                                  **kwargs)
-            for row, i in enumerate(idxs):
-                full = fulls[row]
-                if eos is not None:
-                    # after a row emits eos the remaining fixed-length
-                    # positions repeat it — truncate before decoding
-                    cont = full[plen:]
-                    hits = np.nonzero(cont == int(eos))[0]
-                    if len(hits):
-                        full = full[: plen + int(hits[0])]
-                out[i] = tok.decode(full).decode("utf-8", "replace")
+        if serve_slots is not None and serve_slots < 1:
+            raise ValueError(f"serve_slots must be >= 1, got {serve_slots}")
+        wave = serve_slots or max(1, len(prompts))
+        for blen, queue in by_bucket.items():
+            while queue:
+                idxs, queue = queue[:wave], queue[wave:]
+                batch = np.zeros((len(idxs), blen), np.int32)
+                pads = np.empty((len(idxs),), np.int32)
+                for row, i in enumerate(idxs):
+                    ids = encoded[i]
+                    pads[row] = blen - len(ids)
+                    batch[row, pads[row]:] = ids
+                # next pow2 >= B, capped at the CALLER's total prompt
+                # count: generate_table sizes its chunks to the device-
+                # memory budget, and padding past it could OOM
+                bucket = min(1 << (len(idxs) - 1).bit_length(),
+                             len(prompts))
+                if bucket > len(idxs):
+                    batch = np.concatenate(
+                        [batch, np.tile(batch[:1], (bucket - len(idxs), 1))]
+                    )
+                    pads = np.concatenate(
+                        [pads, np.tile(pads[:1], bucket - len(idxs))]
+                    )
+                fulls = self.generate(batch, max_new_tokens=max_new_tokens,
+                                      pad_lens=pads, **kwargs)
+                for row, i in enumerate(idxs):
+                    # strip the row's left pads: logical prompt + gen
+                    full = fulls[row][int(pads[row]):]
+                    plen = len(encoded[i])
+                    if eos is not None:
+                        # after a row emits eos the remaining fixed-
+                        # length positions repeat it — truncate before
+                        # decoding
+                        cont = full[plen:]
+                        hits = np.nonzero(cont == int(eos))[0]
+                        if len(hits):
+                            full = full[: plen + int(hits[0])]
+                    out[i] = tok.decode(full).decode("utf-8", "replace")
         return out
 
     def score_text(self, texts: "Sequence[str]") -> Dict[str, float]:

@@ -16,6 +16,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from tpuflow.core.compat import typeof as _typeof
 from tpuflow.parallel.mesh import DATA_AXIS
 
 
@@ -26,14 +27,22 @@ def pvary(x, axis_names) -> Any:
     value's varying-manual-axes. Idempotent: axes the value already
     varies over are skipped (pcast rejects varying→varying)."""
     axes = (axis_names,) if isinstance(axis_names, str) else tuple(axis_names)
-    have = getattr(jax.typeof(x), "vma", frozenset())
+    have = getattr(_typeof(x), "vma", frozenset())
     axes = tuple(a for a in axes if a not in have)
     if not axes:
         return x
     try:
         return jax.lax.pcast(x, axes, to="varying")
     except (AttributeError, TypeError):
+        pass
+    try:
         return jax.lax.pvary(x, axes)
+    except AttributeError:
+        # jax 0.4.x: no varying-manual-axes tracking at all (shard_map
+        # uses check_rep instead), so there is nothing to tag — the
+        # value is already valid wherever newer JAX would demand a vma
+        # annotation
+        return x
 
 
 def pvary_like(x, *refs) -> Any:
@@ -42,8 +51,8 @@ def pvary_like(x, *refs) -> Any:
     caller knows about, e.g. 'data' on a data x seq mesh)."""
     want = frozenset()
     for r in refs:
-        want = want | getattr(jax.typeof(r), "vma", frozenset())
-    have = getattr(jax.typeof(x), "vma", frozenset())
+        want = want | getattr(_typeof(r), "vma", frozenset())
+    have = getattr(_typeof(x), "vma", frozenset())
     missing = tuple(want - have)
     return pvary(x, missing) if missing else x
 

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpuflow.core.compat import axis_size as _axis_size
+from tpuflow.core.compat import typeof as _typeof
 from tpuflow.parallel.collectives import pvary as _pvary
 
 PIPE_AXIS = "pipe"
@@ -70,7 +72,7 @@ def pipeline(
     def run(stacked_params, x):
         params = jax.tree.map(lambda a: a[0], stacked_params)
         idx = lax.axis_index(axis_name)
-        n = lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         n_micro = x.shape[0]
         if n_micro != n_microbatches:
             raise ValueError(
@@ -102,7 +104,7 @@ def pipeline(
         # the carry must vary over the pipe axis AND any axes the input
         # already varies over (e.g. 'data' under DP x PP row sharding)
         axes = tuple(
-            getattr(jax.typeof(x), "vma", frozenset()) | {axis_name}
+            getattr(_typeof(x), "vma", frozenset()) | {axis_name}
         )
         state0 = _pvary(jnp.zeros(x.shape[1:], x.dtype), axes)
         out0 = _pvary(jnp.zeros_like(x), axes)
@@ -161,7 +163,7 @@ def pipeline_1f1b(
         # axes the microbatch data already varies over (e.g. 'data'
         # under DP x PP row sharding)
         axes = tuple(
-            getattr(jax.typeof(data_micro), "vma", frozenset())
+            getattr(_typeof(data_micro), "vma", frozenset())
             | {axis_name}
         )
         # stage params too: they are pipe-sharded but replicated over
@@ -182,7 +184,7 @@ def pipeline_1f1b(
             lambda p: _pvary(p, axes), last_params
         )
         idx = lax.axis_index(axis_name)
-        n = lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         m_total = data_micro.shape[0]
         if m_total != n_microbatches:
             raise ValueError(
@@ -426,16 +428,16 @@ def pipeline_interleaved(
                 f"built for {m_total}"
             )
         axes = tuple(
-            getattr(jax.typeof(data_micro), "vma", frozenset())
+            getattr(_typeof(data_micro), "vma", frozenset())
             | {axis_name}
         )
         params = jax.tree.map(lambda a: _pvary(a, axes), stacked_params)
         first_params = jax.tree.map(lambda p: _pvary(p, axes), first_params)
         last_params = jax.tree.map(lambda p: _pvary(p, axes), last_params)
         idx = lax.axis_index(axis_name)
-        if lax.axis_size(axis_name) != n:
+        if _axis_size(axis_name) != n:
             raise ValueError(
-                f"axis {axis_name!r} has size {lax.axis_size(axis_name)}, "
+                f"axis {axis_name!r} has size {_axis_size(axis_name)}, "
                 f"schedule built for {n}"
             )
         fwd_perm = [(i, (i + 1) % n) for i in range(n)]
@@ -623,7 +625,7 @@ def pipeline_interleaved_fwd(
 
     def run(stacked_params, first_params, data_micro):
         axes = tuple(
-            getattr(jax.typeof(data_micro), "vma", frozenset())
+            getattr(_typeof(data_micro), "vma", frozenset())
             | {axis_name}
         )
         params = jax.tree.map(lambda a: _pvary(a, axes), stacked_params)
@@ -688,7 +690,7 @@ def from_last_stage(x, axis_name: str = PIPE_AXIS):
     """Replicate a value held by the last pipeline stage to all stages
     (psum of a one-hot mask — a single small collective)."""
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     return lax.psum(jnp.where(idx == n - 1, x, jnp.zeros_like(x)), axis_name)
 
 

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpuflow.core.compat import axis_size as _axis_size
 from tpuflow.ops.attention import (
     _NEG_BIG,
     _Cfg,
@@ -284,7 +285,7 @@ def ring_attention(
     if q.shape != k.shape or k.shape != v.shape:
         raise ValueError("ring attention requires uniform q/k/v shard shapes")
     b, h, s, d = q.shape
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if interpret is None:
         from tpuflow.core.hw import is_tpu_backend
 

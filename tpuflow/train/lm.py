@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
+from tpuflow.core.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from tpuflow.ckpt.checkpoint import (

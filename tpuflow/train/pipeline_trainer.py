@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
+from tpuflow.core.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpuflow.core.config import TrainConfig

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from tpuflow.core.compat import shard_map
 
 from tpuflow.core.config import TrainConfig
 from tpuflow.models.classifier import backbone_param_mask, stop_gradient_frozen
