@@ -41,7 +41,8 @@ def test_smoke_emits_schema():
     assert "error" not in rec
     d = rec["diagnostics"]
     for key in ("step_ms", "timing_method", "mfu", "flops_per_step",
-                "rtt_ms", "loss"):
+                "rtt_ms", "loss", "host_dispatches_per_step",
+                "dispatch_bound", "dispatch_floor_ms"):
         assert key in d, key
 
 
@@ -205,6 +206,66 @@ def test_smoke_end2end_emits_schema():
     assert rec["metric"] == "train_images_per_sec_per_chip_e2e"
     assert rec["value"] > 0
     assert "error" not in rec
+
+
+def test_base_diag_dispatch_fields():
+    """Every capture's shared diagnostics carry the dispatch accounting
+    (ISSUE 2 satellite): host_dispatches_per_step (1/K for a scanK
+    headline, 1.0 for a loop one), the measured per-call floor, and the
+    dispatch-bound flag (device step below the floor ⇒ a per-step
+    python loop cannot deliver the benched rate)."""
+    import bench
+
+    class _Dev:
+        device_kind = "cpu"
+
+    def diag(dt, method, dt_loop, rtt=0.0):
+        _, rec = bench._base_diag(
+            dt, method, dt_loop, 1.0, flops=1e9, n_chips=1, peak=1e12,
+            rtt_ms=rtt, compile_s=0.0, devices=[_Dev()], extras={},
+        )
+        return rec
+
+    # scan headline: 30 steps rode one dispatch
+    rec = diag(0.002, "scan30", 0.005)
+    assert rec["host_dispatches_per_step"] == round(1 / 30, 4)
+    # floor = loop-minus-scan overhead (3 ms) > 2 ms step ⇒ dispatch-bound
+    assert rec["dispatch_floor_ms"] == 3.0
+    assert rec["dispatch_bound"] is True
+
+    # loop headline, no overhead gap, no rtt ⇒ not dispatch-bound
+    rec = diag(0.010, "loop_fetch", 0.010)
+    assert rec["host_dispatches_per_step"] == 1.0
+    assert rec["dispatch_bound"] is False
+
+    # relay rtt dominates a thin loop-scan gap
+    rec = diag(0.002, "scan30", 0.0025, rtt=80.0)
+    assert rec["dispatch_floor_ms"] == 80.0
+    assert rec["dispatch_bound"] is True
+
+
+@pytest.mark.slow
+def test_smoke_superstep_emits_schema():
+    """--superstep K: the fused-dispatch A/B must emit the standard
+    schema with the dispatch-reduction diagnostics — K× fewer host
+    dispatches, wall-clock no worse than the step loop (a modest
+    tolerance absorbs CI timer noise)."""
+    r = _run("--smoke", "--superstep", "4", "--steps", "8",
+             "--no-attn-diag")
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "train_images_per_sec_per_chip"
+    assert rec["mode"] == "superstep"
+    assert rec["value"] > 0
+    assert "error" not in rec
+    d = rec["diagnostics"]
+    assert d["superstep_k"] == 4
+    assert d["host_dispatches_superstep"] * 4 == d["host_dispatches_loop"]
+    assert d["host_dispatches_per_step"] == 0.25
+    assert "dispatch_bound" in d
+    # end-to-end wall-clock no worse than the step-loop (10% slack for
+    # shared-CI scheduling jitter on the tiny smoke shapes)
+    assert rec["vs_baseline"] > 0.9
 
 
 def test_hlo_fusion_census_on_uint8_conv():

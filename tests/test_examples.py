@@ -40,6 +40,7 @@ def test_examples_import():
         "11_pipeline_trainer_streaming",
         "12_packed_gqa_lm",
         "13_preempt_resume",
+        "15_superstep_training",
     ]:
         assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
 
@@ -155,6 +156,21 @@ def test_bucketed_lm_serving_example():
     assert r.returncode == 0, r.stderr[-2000:]
     assert "serve_slots=2 wave draining matches" in r.stdout
     assert "bucketed serving example OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_superstep_training_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_EXAMPLES, "15_superstep_training.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "dispatches reduced" in r.stdout
+    assert "fewer host round-trips" in r.stdout
 
 
 @pytest.mark.slow

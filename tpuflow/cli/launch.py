@@ -56,6 +56,14 @@ def _parse(argv: List[str]) -> tuple:
     p.add_argument("--restarts", type=int, default=0,
                    help="relaunch the gang up to N times after a failure "
                         "(checkpoint resume continues the run)")
+    p.add_argument("--compile-cache", type=str, default=None,
+                   metavar="DIR",
+                   help="persistent XLA compilation cache dir for every "
+                        "launched process (JAX_COMPILATION_CACHE_DIR "
+                        "with the cache-everything thresholds) — "
+                        "relaunches and multi-host gangs deserialize "
+                        "executables instead of recompiling; same knob "
+                        "as TrainConfig.compilation_cache_dir")
     if "--" not in argv:
         p.error("command required after --")
     split = argv.index("--")
@@ -142,6 +150,13 @@ def _run_local_cluster(n: int, port: int, cmd: List[str]) -> int:
 
 def main(argv: List[str] | None = None) -> int:
     args, cmd = _parse(argv if argv is not None else sys.argv[1:])
+    if args.compile_cache:
+        # set on OUR env so every launch path below inherits it (the
+        # local gang copies os.environ; jax reads these at import)
+        os.makedirs(args.compile_cache, exist_ok=True)
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = args.compile_cache
+        os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+        os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
     if args.local and args.local > 0:
         rc = 0
         for attempt in range(max(0, args.restarts) + 1):

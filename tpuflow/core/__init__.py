@@ -1,4 +1,8 @@
 from tpuflow.core.compat import shard_map  # noqa: F401
+from tpuflow.core.hw import (  # noqa: F401
+    enable_compilation_cache,
+    is_tpu_backend,
+)
 from tpuflow.core.dist import (  # noqa: F401
     barrier,
     initialize,

@@ -98,6 +98,36 @@ class TrainConfig:
     # next-token prediction is excluded from the loss. None = off
     # (rows are single sequences).
     packed_eos_id: Optional[int] = None
+    # superstep execution: fuse this many training steps into ONE jitted
+    # lax.scan dispatch over a stacked (K, batch, ...) block — a single
+    # host dispatch (and a single device-resident metrics block) per K
+    # steps instead of K per-call round-trips. The win is pure framework
+    # overhead: when the device step is shorter than the per-call
+    # dispatch floor (the flagship's 2.14 ms step vs a ~1.75-2.8 ms
+    # floor, MFU_ANALYSIS.md), the python step loop is dispatch-bound
+    # and throughput scales ~K× back to the benched steady state.
+    # Semantics: K=1 is exactly the classic per-step loop; K>1 runs the
+    # SAME step function (same math, same per-step RNG fold-in) as the
+    # scan body — bitwise-identical per-step losses/params under a
+    # fixed compilation config (pinned by tests/test_superstep.py; at
+    # higher XLA opt levels the fused scan body may round differently
+    # at the last ulp, the same class of difference as any recompile).
+    # Blocks
+    # never cross epoch / preempt-sync boundaries, so callback,
+    # checkpoint and eval cadence are unchanged; the trade is metric
+    # LATENCY (the first loss of a block lands after K steps, and a
+    # SIGTERM preemption stop is taken at block granularity).
+    superstep: int = 1
+    # opt-in persistent XLA compilation cache directory
+    # (jax_compilation_cache_dir): compiled executables are reused
+    # across processes AND runs — the suite and benches are
+    # compile-dominated (72 s LM compile, BENCH_LOCAL_r05_lm.json), so
+    # a warm cache turns repeat runs into ~0 s loads. None = off.
+    # TPU-proven (bench.py's committed .xla_cache); NOTE on jax 0.4.37
+    # XLA:CPU a cache hit can segfault upstream — tests/conftest.py
+    # documents the repro, so CPU use is at-your-own-risk until a jax
+    # bump.
+    compilation_cache_dir: Optional[str] = None
     # post-warmup LR schedule: 'none' (constant — reference parity) or
     # 'cosine' (anneal to min_lr over the full run, the standard LM
     # warmup+cosine recipe); composes with the plateau factor

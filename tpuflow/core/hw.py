@@ -32,3 +32,48 @@ def is_tpu_backend() -> bool:
     # "tpu v4" / "TPU v5 lite" / bare generation tags like "v5e" — but
     # NOT arbitrary v-prefixed kinds (e.g. "vgpu"): require v<digit>
     return d.platform == "tpu" or "tpu" in kind or bool(re.match(r"v\d", kind))
+
+
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (created if absent) with the thresholds zeroed so EVERY executable
+    is cached — the suite and the benches are compile-dominated (72 s
+    LM compile recorded in BENCH_LOCAL_r05_lm.json), and a warm cache
+    turns repeat compiles into ~0 s deserializes.
+
+    Opt-in via ``TrainConfig.compilation_cache_dir`` (the trainers call
+    this at fit time), the launcher's ``--compile-cache`` flag, or
+    directly. Safe to call repeatedly; returns False (never raises)
+    when the running jax build lacks the config knobs — callers must
+    not die over a missing cache.
+
+    Caveat: proven on the TPU path (bench.py has committed ``.xla_cache``
+    since r03), but on THIS container's jax 0.4.37 XLA:CPU a
+    persistent-cache HIT of an AOT executable can SEGFAULT (reproduced
+    at a pristine checkout; see tests/conftest.py) — which is why the
+    test suite's enablement is opt-in rather than default.
+    """
+    import os
+
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        if jax.config.jax_compilation_cache_dir != cache_dir:
+            # jax memoizes the cache object on FIRST use: a compile
+            # that ran before this call (dir unset, or another dir)
+            # freezes that state and later config updates silently
+            # write nothing (measured on 0.4.37) — drop the memo so
+            # mid-process enablement actually takes effect
+            try:
+                from jax._src.compilation_cache import reset_cache
+
+                reset_cache()
+            except Exception:
+                pass  # private API; worst case the memo wins as before
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        return True
+    except Exception:
+        return False

@@ -625,7 +625,13 @@ class Dataset:
         # ring of reused decode buffers: at most ``prefetch`` batches sit
         # in the queue + 1 at the consumer, so a period of prefetch + 3
         # never overwrites a batch still in flight (the extra slot is
-        # headroom for an async H2D transfer still reading the oldest)
+        # headroom for an async H2D transfer still reading the oldest).
+        # The trainers' staging depth follows THIS ``prefetch`` knob
+        # (Trainer._staging_depth, capped for HBM), and superstep block
+        # staging copies each pulled batch into its stacked block
+        # IMMEDIATELY (_stage_superstep) — never more than one
+        # un-copied batch at the consumer, exactly what this pool
+        # sizing assumes.
         pool: List[Optional[np.ndarray]] = [None] * (self.prefetch + 3)
         slot = 0
         try:

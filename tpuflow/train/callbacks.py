@@ -24,6 +24,17 @@ class Callback:
 
     def on_epoch_end(self, epoch: int, logs: Dict[str, float]) -> None: ...
 
+    def on_superstep_end(self, global_step: int, metrics) -> None:
+        """Fused-dispatch cadence hook (TrainConfig.superstep > 1): runs
+        once per K-step scan block with the global step AFTER the block
+        and the block's DEVICE-RESIDENT stacked metrics (dict of (k,)
+        arrays). Deliberately not a per-step hook — superstep mode
+        exists to eliminate per-step host round-trips, so a callback
+        that fetches here pays one sync per block, not per step. The
+        epoch-level hooks above are unaffected (blocks never cross an
+        epoch boundary)."""
+        ...
+
     def on_train_end(self) -> None: ...
 
 

@@ -273,6 +273,31 @@ class LMTrainer:
             sharding, np.ascontiguousarray(toks_np, dtype=np.int32)
         )
 
+    def _put_block(self, rows_list):
+        """K stacked local token batches → one global (K, batch, seq)
+        block for the superstep scan; dim 0 is the step axis (never
+        sharded), dims 1+ shard exactly like a ``_put`` batch."""
+        from jax.sharding import NamedSharding
+
+        blk = np.stack([
+            np.ascontiguousarray(r, dtype=np.int32) for r in rows_list
+        ])
+        n_data = self.mesh.shape.get(DATA_AXIS, 1)
+        global_rows = blk.shape[1] * jax.process_count()
+        if global_rows % n_data:
+            raise ValueError(
+                f"global batch {global_rows} not divisible by mesh data "
+                f"axis {n_data}; choose batch_size as a multiple of "
+                f"{n_data}"
+            )
+        if blk.shape[2] % self.sp:
+            raise ValueError(
+                f"seq_len {blk.shape[2]} not divisible by the "
+                f"sequence-parallel degree {self.sp}"
+            )
+        sharding = NamedSharding(self.mesh, P(None, *self._token_spec()))
+        return jax.make_array_from_process_local_data(sharding, blk)
+
     def _make_steps(self) -> None:
         model = self.model
         mesh = self.mesh
@@ -501,6 +526,33 @@ class LMTrainer:
         else:
             self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
+        self._build_superstep(train_step, out_shardings)
+
+    def _build_superstep(self, train_step, out_shardings=None) -> None:
+        """Superstep program (cfg.superstep > 1): K chained train steps
+        in ONE jitted ``lax.scan`` over a stacked (K, batch, seq) token
+        block — one host dispatch per K steps, per-step losses stacked
+        into a device-resident (K,) block. The body is the SAME
+        ``train_step`` the per-step path jits, so per-step losses match
+        the K=1 loop — bitwise under a fixed compilation config
+        (tests/test_superstep.py).
+        Shared with PipelineTrainer, whose schedules all expose the same
+        ``(state, tokens, lr) -> (state, metrics)`` pure step. Tracing
+        is lazy — K=1 runs never touch this."""
+
+        def superstep(state, tokens, lrs):
+            def body(c, x):
+                t, lr = x
+                return train_step(c, t, lr)
+
+            return jax.lax.scan(body, state, (tokens, lrs))
+
+        if out_shardings is not None:
+            self._superstep = jax.jit(
+                superstep, donate_argnums=0, out_shardings=out_shardings
+            )
+        else:
+            self._superstep = jax.jit(superstep, donate_argnums=0)
 
     # ---- checkpoint / resume --------------------------------------------
 
@@ -672,6 +724,13 @@ class LMTrainer:
         always carry ``loss``."""
         cfg = self.cfg
         epochs = epochs if epochs is not None else cfg.epochs
+        K = max(1, int(getattr(cfg, "superstep", 1)))
+        if getattr(cfg, "superstep", 1) < 1:
+            raise ValueError(f"superstep must be >= 1, got {cfg.superstep}")
+        if getattr(cfg, "compilation_cache_dir", None):
+            from tpuflow.core.hw import enable_compilation_cache
+
+            enable_compilation_cache(cfg.compilation_cache_dir)
         if self.state is None:
             self.init_state()
         if self._train_step is None:
@@ -780,6 +839,9 @@ class LMTrainer:
         # shapes would corrupt MFU / fail on call
         self._flops_per_step = None
         self._step_exec = None
+        # superstep AOT executables, one per block size (the full-K
+        # program plus at most one remainder-tail size per fit)
+        self._sstep_execs = {}
         from tpuflow.ckpt.checkpoint import join_async_writes
 
         preempted = False
@@ -796,51 +858,75 @@ class LMTrainer:
                 losses = []
                 t_epoch = None
                 timed_steps = 0
-                for i in range(first_i, steps_per_epoch):
-                    if use_preempt and should_stop(
-                            preempt, global_step, sync_every, preempt_mp):
-                        preempted = True
-                        break
+
+                def _host_rows(i):
+                    """Local token rows for global step index ``i`` of
+                    this epoch — the SAME selection the per-step loop
+                    makes (stream order / seeded shuffle slice)."""
                     if ds is not None:
                         # shard-disjoint stream: this process's slice comes
                         # from its own round-robin rows (≙ cur_shard=rank)
-                        local_rows = next(batch_iter)
-                    else:
-                        # the shuffle order is seed-deterministic, so every
-                        # process slices the SAME global batch and takes its
-                        # own contiguous rows (≙ cur_shard=rank, P1/03:332-337)
-                        rows = order[i * batch_size : (i + 1) * batch_size]
-                        rows = rows[proc * b_local : (proc + 1) * b_local]
-                        local_rows = train_tokens[rows]
-                    toks = self._put(local_rows)
-                    lr = self.lr_controller.lr_for_step(global_step)
-                    lr_arr = jnp.asarray(lr, jnp.float32)
-                    if self._step_exec is None:
-                        # ONE compile per fit: the AOT executable both runs
-                        # every step (jax's AOT path does not share the jit
-                        # dispatch cache — compiling separately for cost
-                        # analysis would double the compile) and yields the
-                        # FLOPs for the throughput/MFU metrics (N11). NOTE
-                        # cost analysis reports PER-DEVICE flops when the
-                        # program is sharded.
-                        from tpuflow.obs.mfu import flops_of_compiled
+                        return next(batch_iter)
+                    # the shuffle order is seed-deterministic, so every
+                    # process slices the SAME global batch and takes its
+                    # own contiguous rows (≙ cur_shard=rank, P1/03:332-337)
+                    rows = order[i * batch_size : (i + 1) * batch_size]
+                    rows = rows[proc * b_local : (proc + 1) * b_local]
+                    return train_tokens[rows]
 
-                        self._step_exec = self._train_step.lower(
-                            self.state, toks, lr_arr
-                        ).compile()
-                        self._flops_per_step = flops_of_compiled(
-                            self._step_exec
+                if K > 1:
+                    # superstep mode: one fused K-step scan dispatch per
+                    # block (device-resident (k,) loss blocks; the only
+                    # per-epoch host sync is the timing anchor after the
+                    # first block), double-buffered staging, and blocks
+                    # chunked so multi-process preempt-sync agreement
+                    # points always land on block edges
+                    preempted, global_step, lr, t_epoch, timed_steps = (
+                        self._run_superstep_epoch(
+                            K, first_i, steps_per_epoch, global_step,
+                            losses, _host_rows, preempt, use_preempt,
+                            sync_every, preempt_mp,
                         )
-                    self.state, m = self._step_exec(self.state, toks, lr_arr)
-                    losses.append(m["loss"])
-                    global_step += 1
-                    if i == first_i:
-                        # sync, then time the REMAINING steps: the first
-                        # executed step carries trace+compile, which must
-                        # not pollute the throughput metrics
-                        float(m["loss"])
-                        t_epoch = time.time()
-                        timed_steps = steps_per_epoch - first_i - 1
+                    )
+                else:
+                    for i in range(first_i, steps_per_epoch):
+                        if use_preempt and should_stop(
+                                preempt, global_step, sync_every,
+                                preempt_mp):
+                            preempted = True
+                            break
+                        local_rows = _host_rows(i)
+                        toks = self._put(local_rows)
+                        lr = self.lr_controller.lr_for_step(global_step)
+                        lr_arr = jnp.asarray(lr, jnp.float32)
+                        if self._step_exec is None:
+                            # ONE compile per fit: the AOT executable both
+                            # runs every step (jax's AOT path does not share
+                            # the jit dispatch cache — compiling separately
+                            # for cost analysis would double the compile)
+                            # and yields the FLOPs for the throughput/MFU
+                            # metrics (N11). NOTE cost analysis reports
+                            # PER-DEVICE flops when the program is sharded.
+                            from tpuflow.obs.mfu import flops_of_compiled
+
+                            self._step_exec = self._train_step.lower(
+                                self.state, toks, lr_arr
+                            ).compile()
+                            self._flops_per_step = flops_of_compiled(
+                                self._step_exec
+                            )
+                        self.state, m = self._step_exec(
+                            self.state, toks, lr_arr
+                        )
+                        losses.append(m["loss"])
+                        global_step += 1
+                        if i == first_i:
+                            # sync, then time the REMAINING steps: the first
+                            # executed step carries trace+compile, which must
+                            # not pollute the throughput metrics
+                            float(m["loss"])
+                            t_epoch = time.time()
+                            timed_steps = steps_per_epoch - first_i - 1
                 if preempted:
                     from tpuflow.ckpt.checkpoint import save_step_checkpoint
 
@@ -851,7 +937,9 @@ class LMTrainer:
                     if is_primary():
                         print(f"preempted at step {global_step}; saved {spath}")
                     break
-                epoch_loss = float(jnp.mean(jnp.stack(losses)))
+                epoch_loss = float(jnp.mean(jnp.concatenate(
+                    [jnp.atleast_1d(l) for l in losses]
+                )))
                 # the scalar fetch above syncs, so the wall time is real
                 epoch_s = time.time() - t_epoch if t_epoch is not None else 0.0
                 metrics = {"loss": epoch_loss, "lr": float(lr)}
@@ -902,6 +990,93 @@ class LMTrainer:
                 if on_epoch is not None:
                     on_epoch(epoch, metrics)
         return metrics
+
+    def _run_superstep_epoch(self, K, first_i, steps_per_epoch,
+                             global_step, losses, host_rows, preempt,
+                             use_preempt, sync_every, preempt_mp):
+        """One epoch of superstep execution (cfg.superstep > 1): fused
+        K-step scan dispatches over stacked token blocks.
+
+        - ``host_rows(i)`` supplies the SAME local rows the per-step
+          loop would feed at step index ``i`` — parity by construction;
+        - staging is double-buffered: block i+1 is assembled and
+          ``device_put`` while the device still executes block i (the
+          dispatch below is async; nothing blocks until the timing
+          anchor after the first block);
+        - the per-step losses stay device-resident as (k,) blocks in
+          ``losses`` (fetched once at epoch end);
+        - blocks are AOT-compiled once per distinct size (the full-K
+          program + at most one remainder tail) and chunked so
+          multi-process preemption agreement points land on block
+          edges — the collective schedule across processes is identical
+          to the K=1 loop's.
+
+        Returns ``(preempted, global_step, lr, t_epoch, timed_steps)``.
+        """
+        import collections
+
+        from tpuflow.train.preempt import should_stop, superstep_sizes
+
+        sizes = superstep_sizes(
+            steps_per_epoch - first_i, K, global_step,
+            sync_every if (use_preempt and preempt_mp) else 0,
+        )
+        depth = 2  # classic double buffer: assemble i+1 while i runs
+
+        def blocks():
+            buf = collections.deque()
+            i = first_i
+            for want in sizes:
+                rows = [host_rows(i + j) for j in range(want)]
+                i += want
+                buf.append((want, self._put_block(rows)))
+                if len(buf) >= depth:
+                    yield buf.popleft()
+            while buf:
+                yield buf.popleft()
+
+        blk_iter = blocks()
+        preempted = False
+        t_epoch = None
+        timed_steps = 0
+        lr = self.lr_controller.lr_for_step(global_step)
+        for _ in sizes:
+            if use_preempt and should_stop(
+                    preempt, global_step, sync_every, preempt_mp):
+                preempted = True
+                break
+            k, toks = next(blk_iter)
+            lr_list = [
+                self.lr_controller.lr_for_step(global_step + j)
+                for j in range(k)
+            ]
+            lr = lr_list[-1]
+            lrs_arr = jnp.asarray(lr_list, jnp.float32)
+            ex = self._sstep_execs.get(k)
+            if ex is None:
+                from tpuflow.obs.mfu import flops_of_compiled
+
+                ex = self._superstep.lower(
+                    self.state, toks, lrs_arr
+                ).compile()
+                self._sstep_execs[k] = ex
+                if self._flops_per_step is None:
+                    # XLA cost analysis counts a lax.scan body ONCE, so
+                    # the K-step program reports ~one step's FLOPs —
+                    # exactly the per-step number the MFU metrics want
+                    # (same convention as the grad-accum scan, bench.py)
+                    self._flops_per_step = flops_of_compiled(ex)
+            self.state, m = ex(self.state, toks, lrs_arr)
+            losses.append(m["loss"])
+            global_step += k
+            if t_epoch is None:
+                # sync after the FIRST block only: compile stays out of
+                # the timed window, and this is the epoch's single
+                # mid-flight host fetch
+                float(m["loss"][-1])
+                t_epoch = time.time()
+                timed_steps = steps_per_epoch - first_i - k
+        return preempted, global_step, lr, t_epoch, timed_steps
 
     # ---- evaluation ------------------------------------------------------
 

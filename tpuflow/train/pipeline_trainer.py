@@ -437,6 +437,11 @@ class PipelineTrainer(LMTrainer):
 
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
+        # every schedule exposes the same pure (state, tokens, lr) ->
+        # (state, metrics) step, so superstep fusion (cfg.superstep > 1:
+        # K steps in one scanned dispatch) composes with the pipeline
+        # unchanged — the LMTrainer fit loop drives it
+        self._build_superstep(train_step)
 
     def _first_last_fns(self):
         """The embed/loss-head halves shared by every manual-VJP
@@ -571,6 +576,7 @@ class PipelineTrainer(LMTrainer):
 
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
+        self._build_superstep(train_step)
 
     def _apply_grads(self, state: TrainState, grads, lr, loss):
         opt_state = set_learning_rate(state.opt_state, lr)

@@ -83,3 +83,26 @@ def should_stop(flag: dict, global_step: int, sync_every: int,
     if global_step % max(1, int(sync_every)):
         return False
     return agree_on_preempt(flag)
+
+
+def superstep_sizes(n_steps: int, K: int, step0: int,
+                    sync_every: int = 0) -> list:
+    """Chunk ``n_steps`` (starting at global step ``step0``) into
+    superstep block sizes <= K such that a block never crosses a
+    preemption agreement point (multiples of ``sync_every`` when > 0):
+    K is auto-lowered at the boundaries, so every cadence the K=1 loop
+    honors per step lands on a block edge. Shared by Trainer and
+    LMTrainer so their block boundaries (and thus the multi-process
+    collective agreement schedule) can never drift apart."""
+    sizes = []
+    g, left = step0, int(n_steps)
+    K = max(1, int(K))
+    while left > 0:
+        k = min(K, left)
+        if sync_every > 0:
+            to_sync = (-g) % sync_every or sync_every
+            k = min(k, to_sync)
+        sizes.append(k)
+        g += k
+        left -= k
+    return sizes

@@ -246,6 +246,25 @@ class Trainer:
         self._train_step = jax.jit(train_sm, donate_argnums=0)
         self._eval_step = jax.jit(eval_sm)
 
+        # superstep program (cfg.superstep > 1): K chained train steps
+        # inside ONE jitted lax.scan over a stacked (K, batch, ...)
+        # block — one host dispatch per K steps, per-step metrics
+        # accumulated into a device-resident (K,) block. The scan body
+        # is the SAME train_sm as the per-step path (per-step RNG folds
+        # on state.step, carried in the scan), so per-step losses and
+        # params match the K=1 loop — bitwise under a fixed compilation
+        # config (tests/test_superstep.py); XLA may fuse the body
+        # differently at high opt levels (recompile-class ulp noise).
+        # Tracing is lazy: K=1 runs never touch this.
+        def superstep(state, images, labels, lrs):
+            def body(c, x):
+                im, lb, lr = x
+                return train_sm(c, im, lb, lr)
+
+            return jax.lax.scan(body, state, (images, labels, lrs))
+
+        self._superstep = jax.jit(superstep, donate_argnums=0)
+
     # ---- data movement ---------------------------------------------------
 
     def _put(self, batch: Dict[str, np.ndarray]):
@@ -263,6 +282,43 @@ class Trainer:
         labels = jax.make_array_from_process_local_data(sharding, batch["label"])
         return images, labels
 
+    def _put_block(self, batches: List[Dict[str, np.ndarray]]):
+        """K stacked local batches → one global (K, batch, ...) block,
+        batch-sharded on dim 1 (the scan's per-step slice shards exactly
+        like a ``_put`` batch)."""
+        return self._put_block_stacked(
+            np.stack([b["image"] for b in batches]),
+            np.stack([b["label"] for b in batches]),
+        )
+
+    def _put_block_stacked(self, images_np: np.ndarray,
+                           labels_np: np.ndarray):
+        sharding = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        n_data = self.mesh.shape[DATA_AXIS]
+        local = images_np.shape[1]
+        if (local * jax.process_count()) % n_data != 0:
+            raise ValueError(
+                f"global batch {local * jax.process_count()} not divisible by "
+                f"mesh data axis {n_data}; choose batch_size as a multiple of "
+                f"devices-per-process (= {n_data // jax.process_count()})"
+            )
+        images = jax.make_array_from_process_local_data(sharding, images_np)
+        labels = jax.make_array_from_process_local_data(sharding, labels_np)
+        return images, labels
+
+    @staticmethod
+    def _staging_depth(ds) -> int:
+        """Device-put staging depth: follow the dataset's own
+        ``prefetch`` knob so the loader's host queue and the trainer's
+        in-flight H2D count describe the SAME pipeline — the old
+        hardcoded depth=2 silently disagreed with any non-default
+        Dataset(prefetch=...). Capped at 4: ``prefetch`` is a
+        HOST-queue throughput knob, and letting a large value pin that
+        many full batches in device memory would turn it into a silent
+        HBM-footprint knob (a 256x224² batch is ~38 MB; nothing past
+        double-buffering-with-headroom helps the device anyway)."""
+        return min(4, max(1, int(getattr(ds, "prefetch", 2) or 2)))
+
     def _prefetch(self, it: Iterable, depth: int = 2):
         """Device-put ahead of compute: double-buffered H2D (N5)."""
         buf: collections.deque = collections.deque()
@@ -272,6 +328,56 @@ class Trainer:
                 yield buf.popleft()
         while buf:
             yield buf.popleft()
+
+    def _stage_superstep(self, host_iter, sizes, depth: int = 2):
+        """Superstep block staging with double buffering: yields
+        ``(k, images, labels)`` device blocks following the ``sizes``
+        schedule. With depth >= 2, block i+1 is assembled and
+        ``device_put`` while the device still executes block i (the
+        consumer dispatches asynchronously) — the H2D link never sits
+        behind the scan. Each host batch is copied into the stacked
+        block array AS IT IS PULLED (not held and np.stack'ed at the
+        end): the loader's reuse ring (data/loader.py) sizes its
+        buffer pool for ONE batch outstanding at the consumer, and
+        holding K un-copied batches would let the decode thread
+        recycle a slot still referenced by the block — silent pixel
+        corruption. Same total copy work as np.stack, safe ordering.
+        A dried-up host stream yields a final SHORT block
+        (k < scheduled) and stops."""
+        buf: collections.deque = collections.deque()
+        for want in sizes:
+            images = labels = None
+            got = 0
+            for j in range(want):
+                try:
+                    b = next(host_iter)
+                except StopIteration:
+                    break
+                if images is None:
+                    images = np.empty((want, *b["image"].shape),
+                                      b["image"].dtype)
+                    labels = np.empty((want, *b["label"].shape),
+                                      b["label"].dtype)
+                images[j] = b["image"]  # copy NOW (ring safety)
+                labels[j] = b["label"]
+                got += 1
+            if got:
+                buf.append((got, *self._put_block_stacked(
+                    images[:got], labels[:got]
+                )))
+            if got < want:
+                break
+            if len(buf) >= depth:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+
+    @staticmethod
+    def _superstep_sizes(n_steps: int, K: int, step0: int,
+                         sync_every: int = 0) -> List[int]:
+        from tpuflow.train.preempt import superstep_sizes
+
+        return superstep_sizes(n_steps, K, step0, sync_every)
 
     # ---- fit/evaluate ----------------------------------------------------
 
@@ -347,6 +453,14 @@ class Trainer:
         cfg = self.cfg
         epochs = epochs if epochs is not None else cfg.epochs
         steps_per_epoch = steps_per_epoch or train_ds.steps_per_epoch()
+        if getattr(cfg, "superstep", 1) < 1:
+            raise ValueError(
+                f"superstep must be >= 1, got {cfg.superstep}"
+            )
+        if getattr(cfg, "compilation_cache_dir", None):
+            from tpuflow.core.hw import enable_compilation_cache
+
+            enable_compilation_cache(cfg.compilation_cache_dir)
         if self.state is None:
             b = train_ds
             self.init_state((b.img_height, b.img_width, 3))
@@ -429,7 +543,12 @@ class Trainer:
             except StopIteration:
                 exhausted = True
                 break
-        train_iter = self._prefetch(raw_iter)
+        K = max(1, int(getattr(cfg, "superstep", 1)))
+        depth = self._staging_depth(train_ds)
+        # K=1 keeps the classic per-step dispatch loop (exact legacy
+        # behavior); K>1 pulls RAW host batches and stages stacked
+        # blocks for the fused scan instead
+        train_iter = None if K > 1 else self._prefetch(raw_iter, depth)
         global_step = initial_epoch * steps_per_epoch + skip_steps
         lr = self.lr_controller.lr_for_step(global_step)
         from tpuflow.ckpt.checkpoint import join_async_writes
@@ -443,26 +562,67 @@ class Trainer:
                 steps_this_epoch = steps_per_epoch - (
                     skip_steps if epoch == initial_epoch else 0
                 )
-                for _ in range(steps_this_epoch):
-                    if use_preempt and should_stop(
-                            preempt, global_step, sync_every, preempt_mp):
-                        preempted = True
-                        break
-                    lr = self.lr_controller.lr_for_step(global_step)
-                    try:
-                        images, labels = next(train_iter)
-                    except StopIteration:
-                        # finite (non-infinite) stream ran dry: end
-                        # training cleanly after this partial epoch
-                        # (Keras semantics)
-                        exhausted = True
-                        break
-                    self.state, m = self._train_step(
-                        self.state, images, labels,
-                        jnp.asarray(lr, jnp.float32),
+                if K > 1:
+                    # superstep mode: one fused scan dispatch per block;
+                    # blocks are chunked so every preempt-sync boundary
+                    # falls on a block edge (cadence preserved)
+                    sizes = self._superstep_sizes(
+                        steps_this_epoch, K, global_step,
+                        sync_every if (use_preempt and preempt_mp) else 0,
                     )
-                    step_metrics.append(m)
-                    global_step += 1
+                    blocks = self._stage_superstep(raw_iter, sizes, depth)
+                    for want in sizes:
+                        if use_preempt and should_stop(
+                                preempt, global_step, sync_every,
+                                preempt_mp):
+                            preempted = True
+                            break
+                        blk = next(blocks, None)
+                        if blk is None:
+                            exhausted = True
+                            break
+                        k, images, labels = blk
+                        lrs = [
+                            self.lr_controller.lr_for_step(global_step + j)
+                            for j in range(k)
+                        ]
+                        lr = lrs[-1]
+                        self.state, m = self._superstep(
+                            self.state, images, labels,
+                            jnp.asarray(lrs, jnp.float32),
+                        )
+                        # m holds (k,)-stacked per-step metrics, still
+                        # device-resident — the epoch-end _mean_metrics
+                        # fetch is the only host sync
+                        step_metrics.append(m)
+                        global_step += k
+                        for cb in cbs:
+                            cb.on_superstep_end(global_step, m)
+                        if k < want:
+                            exhausted = True
+                            break
+                else:
+                    for _ in range(steps_this_epoch):
+                        if use_preempt and should_stop(
+                                preempt, global_step, sync_every,
+                                preempt_mp):
+                            preempted = True
+                            break
+                        lr = self.lr_controller.lr_for_step(global_step)
+                        try:
+                            images, labels = next(train_iter)
+                        except StopIteration:
+                            # finite (non-infinite) stream ran dry: end
+                            # training cleanly after this partial epoch
+                            # (Keras semantics)
+                            exhausted = True
+                            break
+                        self.state, m = self._train_step(
+                            self.state, images, labels,
+                            jnp.asarray(lr, jnp.float32),
+                        )
+                        step_metrics.append(m)
+                        global_step += 1
                 if preempted:
                     from tpuflow.ckpt import save_step_checkpoint
 
@@ -534,7 +694,7 @@ class Trainer:
         if self._eval_step is None:
             self._make_steps()
         steps = steps or ds.steps_per_epoch()
-        it = self._prefetch(iter(ds))
+        it = self._prefetch(iter(ds), self._staging_depth(ds))
         ms = []
         for _ in range(steps):
             images, labels = next(it)
@@ -555,10 +715,15 @@ class Trainer:
 
 
 def _mean_metrics(ms: List[Dict[str, jax.Array]]) -> Dict[str, float]:
+    """Per-step mean over a mixed list of scalar metric dicts (the
+    per-step loop) and (k,)-stacked superstep blocks — every STEP
+    weighs equally either way."""
     out: Dict[str, float] = {}
     if not ms:
         return out
     host = jax.device_get(ms)
     for k in host[0]:
-        out[k] = float(np.mean([m[k] for m in host]))
+        out[k] = float(np.mean(
+            np.concatenate([np.atleast_1d(m[k]) for m in host])
+        ))
     return out
