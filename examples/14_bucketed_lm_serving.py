@@ -96,12 +96,19 @@ def main() -> None:
     for o in outs:
         print(f"  generated: {o!r}")
 
-    # 3) continuous batching at wave granularity: 2 serving slots per
-    # bucket, waves refilled from the pending queue — same outputs
+    # 3) continuous batching: 2 serving slots per bucket. The default
+    # scheduler is now SLOT-level (tpuflow.serve: finished rows free
+    # their slot at decode-segment boundaries; examples/16 shows the
+    # online server on top); scheduler='wave' keeps the original
+    # wave-drain loop. Both are token-identical — checked live here.
     waved = m.generate_text(prompts, max_new_tokens=8, seed=0,
-                            serve_slots=2)
+                            serve_slots=2, scheduler="wave")
     assert waved == outs, "wave-drained outputs must match one-shot"
     print("serve_slots=2 wave draining matches single-wave outputs")
+    slotted = m.generate_text(prompts, max_new_tokens=8, seed=0,
+                              serve_slots=2)
+    assert slotted == outs, "slot scheduler must match the wave oracle"
+    print("serve_slots=2 slot scheduler matches the wave oracle")
 
     # 5) batch-composition invariance: served alone == served batched
     solo = m.generate_text([prompts[0]], max_new_tokens=8, seed=0)[0]

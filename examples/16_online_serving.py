@@ -1,0 +1,165 @@
+"""Online serving: slot-level continuous batching over HTTP (ISSUE 3).
+
+BEYOND-REFERENCE capability: the reference's only inference story is
+offline batch scoring (P2/03); examples/14 rebuilt that offline path.
+This example runs the ONLINE half — the request-lifecycle runtime in
+``tpuflow.serve``:
+
+1. a tiny ByteBPE LM is overfit and packaged (as in examples/14);
+2. a :class:`~tpuflow.serve.scheduler.ServeScheduler` is built from the
+   packaged artifact: a fixed pool of decode slots per prompt bucket,
+   where finished rows free their slot at decode-SEGMENT boundaries
+   and queued requests prefill into them mid-flight — the slot-level
+   refinement of example 14's wave draining (token-identical outputs,
+   pinned in tests/test_serve.py);
+3. the stdlib HTTP frontend serves concurrent clients: plain JSON
+   generation, NDJSON token STREAMING, and 429-with-Retry-After
+   backpressure when the bounded admission queue fills;
+4. per-request metrics (queue wait, TTFT, decode latency) and the
+   scheduler's occupancy/batch-efficiency gauges — exported through
+   tpuflow.obs — are printed at the end.
+
+Run on CPU:
+
+  JAX_PLATFORMS=cpu python examples/16_online_serving.py
+
+Long-running server form (same runtime):
+
+  python -m tpuflow.serve --model /path/to/packaged_lm --port 8000
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import http.client
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import flax.linen as nn
+
+    from tpuflow.data.text import ByteBPE
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.models.transformer import next_token_loss
+    from tpuflow.packaging.lm import save_packaged_lm
+    from tpuflow.serve.http import start_http_server
+    from tpuflow.serve.scheduler import ServeScheduler
+
+    # 1) tiny LM, overfit so continuations echo the corpus
+    corpus = "the cat sat on the mat. the dog sat on the log. " * 40
+    bpe = ByteBPE.train(corpus, vocab_size=300)
+    cfg = dict(vocab_size=bpe.vocab_size, dim=64, depth=2, heads=4,
+               mlp_ratio=2, dtype=jnp.float32)
+    lm = build_transformer_lm(**cfg)
+    toks = jnp.asarray(np.asarray(bpe.encode(corpus)[:256], np.int32)[None])
+    params = nn.unbox(lm.init({"params": jax.random.key(0)}, toks))["params"]
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        loss, g = jax.value_and_grad(
+            lambda p: next_token_loss(lm.apply({"params": p}, toks), toks)
+        )(params)
+        upd, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, upd), opt, loss
+
+    for _ in range(120):
+        params, opt, loss = step(params, opt)
+    print(f"overfit loss: {float(loss):.3f}")
+    pkg = os.path.join(tempfile.mkdtemp(prefix="tpuflow_serve_"), "pkg")
+    save_packaged_lm(pkg, params, cfg, tokenizer=bpe)
+
+    # 2) the serving runtime: 2 slots/bucket, 4-step segments
+    sched = ServeScheduler.from_packaged(
+        pkg, slots=2, seg=4, max_new_cap=16, max_queue=8,
+    )
+    sched.prepare(8)  # compile the hot bucket before opening the door
+    server = start_http_server(sched)
+    base = f"http://127.0.0.1:{server.port}"
+    print(f"serving on {base}")
+
+    # 3) concurrent clients
+    def post(path, body):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    results = {}
+
+    def client(name, prompt):
+        results[name] = post("/v1/generate",
+                             {"prompt": prompt, "max_new_tokens": 8})
+
+    threads = [threading.Thread(target=client, args=(f"c{i}", p))
+               for i, p in enumerate(["the cat", "the dog", "the mat"])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for name in sorted(results):
+        r = results[name]
+        print(f"  {name}: {r['text']!r}  "
+              f"(ttft {r['metrics']['ttft_ms']}ms, "
+              f"queue {r['metrics']['queue_wait_ms']}ms, "
+              f"e2e {r['metrics']['e2e_ms']}ms)")
+        assert r["state"] == "done" and r["n_tokens"] == 8
+
+    # streaming: tokens arrive as NDJSON lines at segment boundaries
+    conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                      timeout=120)
+    conn.request("POST", "/v1/generate",
+                 json.dumps({"prompt": "the cat sat", "stream": True,
+                             "max_new_tokens": 8}),
+                 {"Content-Type": "application/json"})
+    lines = [json.loads(x) for x in
+             conn.getresponse().read().decode().strip().splitlines()]
+    conn.close()
+    chunks = [e["tokens"] for e in lines[1:-1]]
+    assert sum(map(len, chunks)) == 8 and lines[-1]["done"]
+    print(f"  streamed {len(chunks)} segment chunks: "
+          f"{[len(c) for c in chunks]} tokens each -> "
+          f"{lines[-1]['text']!r}")
+
+    # backpressure: a full admission queue answers 429 + Retry-After
+    sched.max_queue = 0
+    try:
+        post("/v1/generate", {"prompt": "x", "max_new_tokens": 2})
+        raise AssertionError("expected 429")
+    except urllib.error.HTTPError as e:
+        assert e.code == 429
+        print(f"  queue full -> 429, Retry-After {e.headers['Retry-After']}s")
+    finally:
+        sched.max_queue = 8
+
+    # 4) the observability surface
+    snap = post("/v1/cancel", {"id": "ghost"})  # clean no-op answer
+    assert snap["cancelled"] is False
+    with urllib.request.urlopen(base + "/v1/metrics", timeout=10) as r:
+        metrics = json.loads(r.read())
+    keep = ("serve.done", "serve.rejected", "serve.ttft_ms_p50",
+            "serve.queue_wait_ms_p50", "serve.batch_efficiency",
+            "serve.tokens_out")
+    print("server metrics:",
+          {k: metrics[k] for k in keep if k in metrics})
+    assert metrics["serve.done"] >= 4
+
+    server.shutdown()
+    sched.stop(drain=False)
+    print("online serving example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -151,6 +151,13 @@ def pytest_collection_modifyitems(config, items):
             )
 
 
+def pytest_collection_finish(session):
+    # final POST-deselection selection size, for the tier-1 budget
+    # guard (tests/test_tier_budget.py): new tests must land in their
+    # tier deliberately, not silently grow the 870s tier-1 wall budget
+    session.config._tpuflow_selected_count = len(session.items)
+
+
 @pytest.fixture(scope="session")
 def flower_dir(tmp_path_factory):
     """Synthetic stand-in for the tf_flowers directory tree.

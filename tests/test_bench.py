@@ -13,9 +13,14 @@ import pytest
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(*args, timeout=600):
+def _run(*args, timeout=600, default_xla_flags=False):
     env = dict(os.environ)
     env["PALLAS_AXON_POOL_IPS"] = ""  # never dial the TPU relay in tests
+    if default_xla_flags:
+        # drop the test harness's XLA_FLAGS (8 virtual devices +
+        # backend opt level 0) so the subprocess measures what a real
+        # `python bench.py` invocation measures
+        env.pop("XLA_FLAGS", None)
     r = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "bench.py"), *args],
         env=env, capture_output=True, text=True, timeout=timeout,
@@ -196,6 +201,61 @@ def test_smoke_decode_emits_schema():
             assert s[eng]["prefill_tok_s"] > 0
             assert s[eng]["decode_steps_s"] > 0
     assert "error" not in rec
+
+
+def test_serve_workload_seeded_and_mixed():
+    """--serve's open-loop trace generator: deterministic under a seed,
+    arrivals sorted, prompt lengths span the 8- AND 16-token buckets,
+    output budgets mixed and within the cap (pure host — tier-1)."""
+    sys.path.insert(0, _ROOT)
+    try:
+        from bench import _serve_workload
+    finally:
+        sys.path.remove(_ROOT)
+
+    w1 = _serve_workload(seed=0, n=48, max_new_cap=32)
+    w2 = _serve_workload(seed=0, n=48, max_new_cap=32)
+    assert w1 == w2  # seeded: the A/B replays one identical trace
+    assert w1 != _serve_workload(seed=1, n=48, max_new_cap=32)
+    arr = [a for a, _, _ in w1]
+    assert arr == sorted(arr) and arr[0] > 0
+    plens = {p for _, p, _ in w1}
+    assert min(plens) >= 3 and max(plens) <= 14
+    assert any(p <= 8 for p in plens) and any(p > 8 for p in plens)
+    budgets = {b for _, _, b in w1}
+    assert len(budgets) > 1 and max(budgets) == 32 and min(budgets) >= 1
+
+
+@pytest.mark.slow
+def test_smoke_serve_emits_schema(tmp_path):
+    """--serve: the slot-vs-wave A/B emits the serving record (p50/95/99
+    TTFT + e2e, useful tok/s, occupancy, the measured cost table) and
+    writes the BENCH_*_serve.json artifact; the CPU-smoke acceptance
+    bar is slot >= wave on tok/s OR p95 TTFT."""
+    out = str(tmp_path / "BENCH_TEST_serve.json")
+    r = _run("--smoke", "--serve", "--serve-out", out, timeout=580,
+             default_xla_flags=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "serve_useful_tokens_per_sec"
+    assert rec["value"] > 0
+    assert "error" not in rec
+    d = rec["diagnostics"]
+    for side in ("slot", "wave"):
+        assert d[side]["useful_tok_s"] > 0
+        for pk in ("p50", "p95", "p99"):
+            assert d[side]["ttft_ms"][pk] > 0
+            assert d[side]["e2e_ms"][pk] >= d[side]["ttft_ms"][pk] * 0.99
+    assert d["slot"]["tokens"] == d["wave"]["tokens"]  # same workload
+    assert 0 < d["slot"]["batch_efficiency"] <= 1
+    assert d["cost_table_ms"]["segment"] and d["cost_table_ms"]["wave"]
+    # regression tripwire for the acceptance axes (the committed
+    # BENCH_*_serve.json is the record; this tolerates shared-box
+    # noise but catches a scheduler that stops competing)
+    assert (d["tok_s_ratio"] > 0.9 or d["p95_ttft_ratio"] > 0.9), d
+    with open(out) as f:
+        disk = json.load(f)
+    assert disk["mode"] == "serve" and disk["diagnostics"]["slot"]
 
 
 @pytest.mark.slow

@@ -41,6 +41,7 @@ def test_examples_import():
         "12_packed_gqa_lm",
         "13_preempt_resume",
         "15_superstep_training",
+        "16_online_serving",
     ]:
         assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
 
@@ -155,7 +156,22 @@ def test_bucketed_lm_serving_example():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "serve_slots=2 wave draining matches" in r.stdout
+    assert "slot scheduler matches the wave oracle" in r.stdout
     assert "bucketed serving example OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_online_serving_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_EXAMPLES, "16_online_serving.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "queue full -> 429" in r.stdout
+    assert "online serving example OK" in r.stdout
 
 
 @pytest.mark.slow

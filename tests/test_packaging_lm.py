@@ -320,9 +320,11 @@ def test_bucketed_lengths_share_one_generate_call(tmp_path):
 
 
 def test_serve_slots_waves_match_single_wave(tmp_path):
-    """Continuous batching at wave granularity: draining a bucket in
-    serve_slots-sized waves refilled from the pending queue returns the
-    same texts (in the same order) as one monolithic wave."""
+    """Continuous batching at wave granularity (scheduler='wave' — the
+    original loop, kept as the slot scheduler's parity oracle; see
+    tests/test_serve.py): draining a bucket in serve_slots-sized waves
+    refilled from the pending queue returns the same texts (in the
+    same order) as one monolithic wave."""
     m = _text_pkg(tmp_path)
     prompts = ["the cat", "a dog", "the mat.", "the dog sat on",
                "the dog sat on the log and the cat sat on the mat again"]
@@ -336,7 +338,7 @@ def test_serve_slots_waves_match_single_wave(tmp_path):
 
     m.generate = spy
     waved = m.generate_text(prompts, max_new_tokens=3, seed=0,
-                            serve_slots=2)
+                            serve_slots=2, scheduler="wave")
     m.generate = orig
     assert waved == one
     # 4 same-bucket prompts over 2 slots -> 2 waves; the long prompt's

@@ -1,0 +1,466 @@
+"""tpuflow.serve — slot-level continuous batching + request lifecycle.
+
+Tier discipline (the tier-1 wall budget is guarded by
+tests/test_tier_budget.py): the parity pin, the lifecycle edges and the
+host-only surfaces (queue bounds, metrics, compile-cache LRU) run in
+tier-1 against ONE tiny shared model; the HTTP integration rides the
+slow tier.
+
+The load-bearing pins:
+
+- the slot scheduler's outputs are TOKEN-IDENTICAL to the wave-drained
+  ``serve_slots`` oracle under pinned seeds (greedy AND sampled) — the
+  ISSUE 3 acceptance criterion;
+- deadline expiry mid-queue and mid-decode, and cancellation mid-decode,
+  FREE the slot and the next queued request reuses it immediately with
+  unchanged (oracle-equal) output tokens;
+- admission past ``max_queue`` raises QueueFull with a retry-after hint.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.models import build_transformer_lm
+
+KW = dict(vocab_size=128, dim=32, depth=1, heads=2, mlp_ratio=2,
+          dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import flax.linen as nn
+
+    lm = build_transformer_lm(**KW)
+    params = nn.unbox(
+        lm.init({"params": jax.random.key(0)}, jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    return lm, params
+
+
+class FakeClock:
+    """Manually advanced time source — deterministic deadlines."""
+
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def _sched(tiny_lm, **kw):
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = tiny_lm
+    kw.setdefault("slots", 1)
+    kw.setdefault("seg", 4)
+    kw.setdefault("max_new_cap", 24)
+    return ServeScheduler(lm, params, **kw)
+
+
+# ---------------------------------------------------------------------
+# acceptance parity: slot scheduler == wave oracle, token-identical
+# ---------------------------------------------------------------------
+
+def test_slot_scheduler_matches_wave_oracle(tmp_path):
+    """generate_text(serve_slots=2, scheduler='slot') returns EXACTLY
+    the strings of scheduler='wave' (the original wave-drain loop) for
+    mixed-length prompts spanning two buckets, greedy AND sampled —
+    the slot runtime changes latency structure, never tokens."""
+    import flax.linen as nn
+
+    from tpuflow.data.text import ByteBPE
+    from tpuflow.packaging.lm import PackagedLM, save_packaged_lm
+
+    corpus = "the cat sat on the mat. the dog sat on the log. " * 30
+    bpe = ByteBPE.train(corpus, vocab_size=300)
+    cfg = dict(vocab_size=bpe.vocab_size, dim=32, depth=1, heads=2,
+               mlp_ratio=2, dtype=jnp.float32)
+    lm = build_transformer_lm(**cfg)
+    params = lm.init({"params": jax.random.key(0)},
+                     jnp.zeros((1, 8), jnp.int32))["params"]
+    d = str(tmp_path / "pkg")
+    save_packaged_lm(d, nn.unbox(params), cfg, tokenizer=bpe)
+    m = PackagedLM(d)
+    prompts = ["the cat", "a dog", "the mat.", "the dog sat on",
+               "the dog sat on the log and the cat sat on the mat again"]
+    for kw in (dict(seed=0), dict(temperature=0.8, top_k=20, seed=7)):
+        wave = m.generate_text(prompts, max_new_tokens=3, serve_slots=2,
+                               scheduler="wave", **kw)
+        slot = m.generate_text(prompts, max_new_tokens=3, serve_slots=2,
+                               scheduler="slot", **kw)
+        assert slot == wave, kw
+        assert all(s.startswith(p) for s, p in zip(slot, prompts))
+    with pytest.raises(ValueError, match="scheduler"):
+        m.generate_text(prompts, serve_slots=2, scheduler="surf")
+    # engine-tuning kwargs belong to the wave path only — loud error,
+    # not silent drop
+    with pytest.raises(ValueError, match="wave"):
+        m.generate_text(prompts, serve_slots=2, prefill_chunk=4)
+    # ... but a PACKAGE whose generate_defaults carry engine-tuning
+    # keys (valid for generate()/the wave path) must keep serving on
+    # the slot route — only explicit kwargs can reject the call
+    d2 = str(tmp_path / "pkg_defaults")
+    save_packaged_lm(d2, nn.unbox(params), cfg, tokenizer=bpe,
+                     generate_defaults={"engine": "blockwise",
+                                        "prefill_chunk": 4})
+    m2 = PackagedLM(d2)
+    assert m2.generate_text(["the cat"], max_new_tokens=3,
+                            serve_slots=2)[0].startswith("the cat")
+
+
+# ---------------------------------------------------------------------
+# request lifecycle edges
+# ---------------------------------------------------------------------
+
+def test_queue_full_rejection_with_retry_after(tiny_lm):
+    from tpuflow.serve import QueueFull
+
+    sched = _sched(tiny_lm, max_queue=2)
+    ids = np.ones((3,), np.int32)
+    sched.submit(ids, 4)
+    sched.submit(ids, 4)
+    with pytest.raises(QueueFull) as ei:
+        sched.submit(ids, 4)
+    assert ei.value.retry_after_s > 0
+    assert ei.value.depth == 2
+    assert sched.metrics.counts["rejected"] == 1
+    # never-servable requests are ValueError, not backpressure
+    with pytest.raises(ValueError, match="max_new_cap"):
+        sched.submit(ids, 10_000)
+    with pytest.raises(ValueError, match="max_bucket"):
+        _sched(tiny_lm, max_bucket=8).submit(np.ones((9,), np.int32), 2)
+
+
+def test_deadline_expiry_mid_queue(tiny_lm):
+    """A request whose deadline passes while still queued is finalized
+    EXPIRED without ever occupying a slot (no pool is even built)."""
+    clock = FakeClock()
+    sched = _sched(tiny_lm, clock=clock)
+    req = sched.submit(np.ones((3,), np.int32), 4, deadline_s=5.0)
+    clock.now += 10.0
+    assert sched.step() is True  # the expiry IS the progress
+    assert req.state.value == "expired"
+    assert req.result(timeout=0)["state"] == "expired"
+    assert sched.pools == {}  # expired in queue: no slot was spent
+    events = [e["event"] for e in sched.metrics.events(req.id)]
+    assert events[0] == "submit" and "finish" in events
+
+
+def test_cancel_mid_queue(tiny_lm):
+    sched = _sched(tiny_lm)
+    # two queued; slot pool never built, so both sit in the queue
+    a = sched.submit(np.ones((3,), np.int32), 4)
+    assert sched.cancel(a.id) is True
+    assert a.state.value == "cancelled"
+    assert sched.cancel(a.id) is False  # already terminal
+    assert sched.cancel("nope") is False
+
+
+def test_lifecycle_mid_decode_and_slot_reuse(tiny_lm):
+    """The full slot-reuse chain on ONE slot: A is cancelled
+    mid-decode, B's deadline expires mid-decode, C then joins the same
+    freed slot and finishes with tokens equal to the solo oracle —
+    eviction really frees the slot, and reuse does not perturb decode.
+    C's stream callback sees its tokens incrementally."""
+    from tpuflow.infer.generate import generate
+
+    lm, params = tiny_lm
+    clock = FakeClock()
+    sched = _sched(tiny_lm, clock=clock)
+    rng = np.random.default_rng(5)
+    pa, pb, pc = (rng.integers(1, 128, (n,)).astype(np.int32)
+                  for n in (3, 4, 5))
+    streamed = []
+    a = sched.submit(pa, 20)
+    b = sched.submit(pb, 20, deadline_s=50.0)
+    c = sched.submit(pc, 6,
+                     stream_cb=lambda r, new, fin: streamed.append(
+                         (list(new), fin)))
+    # A joins (slots=1) and decodes a couple of segments
+    for _ in range(2):
+        assert sched.step()
+    assert a.state.value == "running" and a.slot == 0
+    assert b.state.value == "queued"
+    sched.cancel(a)
+    assert sched.step()  # evict A at the boundary; B reuses slot 0
+    assert a.state.value == "cancelled"
+    assert len(a.tokens) > 0  # partial output was produced + kept
+    assert b.state.value == "running" and b.slot == 0
+    clock.now += 100.0  # blow B's deadline mid-decode
+    assert sched.step()
+    assert b.state.value == "expired"
+    assert c.state.value == "running" and c.slot == 0
+    sched.run_until_idle()
+    assert c.state.value == "done"
+    assert len(c.tokens) == 6
+    # oracle: the same prompt served alone, greedy
+    bucket = 8
+    prompt = np.zeros((1, bucket), np.int32)
+    prompt[0, bucket - len(pc):] = pc
+    pads = np.asarray([bucket - len(pc)], np.int32)
+    want = np.asarray(generate(lm, params, jnp.asarray(prompt),
+                               max_new_tokens=6, temperature=0.0,
+                               pad_lens=pads))[0, bucket:]
+    assert np.array_equal(np.asarray(c.tokens), want)
+    # streaming delivered exactly C's tokens, in order, then a final
+    got = [t for chunk, _ in streamed for t in chunk]
+    assert got == c.tokens
+    assert streamed[-1][1] is True
+    # metrics recorded every lifecycle flavor
+    cnt = sched.metrics.counts
+    assert (cnt["cancelled"], cnt["expired"], cnt["done"]) == (1, 1, 1)
+    snap = sched.metrics.snapshot()
+    assert snap["serve.ttft_ms_p50"] >= 0
+    assert 0 < snap["serve.batch_efficiency"] <= 1
+
+
+def test_first_token_eos_still_stamps_ttft(tiny_lm):
+    """A request whose FIRST sampled token is the EOS finishes with
+    zero output tokens — but it completed a decode step, so TTFT is
+    stamped and the histogram keeps the fastest requests."""
+    from tpuflow.infer.generate import generate
+
+    lm, params = tiny_lm
+    ids = np.asarray([7, 3, 11], np.int32)
+    prompt = np.zeros((1, 8), np.int32)
+    prompt[0, 5:] = ids
+    first = int(np.asarray(generate(
+        lm, params, jnp.asarray(prompt), max_new_tokens=1,
+        temperature=0.0, pad_lens=np.asarray([5], np.int32)))[0, 8])
+    sched = _sched(tiny_lm, eos_id=first)
+    req = sched.submit(ids, 8)
+    sched.run_until_idle()
+    assert req.state.value == "done" and req.tokens == []
+    assert req.ts_first_token is not None
+    assert req.timing()["ttft_ms"] is not None
+    assert len(sched.metrics.ttft_ms) == 1
+
+
+def test_stop_before_start_finalizes_queued(tiny_lm):
+    """stop() on a never-started scheduler still drives queued
+    requests to a terminal state — a result() waiter must never hang
+    on a server that was torn down before its loop began."""
+    sched = _sched(tiny_lm)
+    req = sched.submit(np.ones((3,), np.int32), 4)
+    sched.stop(drain=False)
+    assert req.result(timeout=5.0)["state"] == "cancelled"
+    assert sched.pools == {}  # no device work was ever done
+
+
+def test_background_thread_submit_result(tiny_lm):
+    """Online mode: scheduler thread drives decode; submit/result from
+    this thread; stop(drain=False) cancels outstanding work."""
+    sched = _sched(tiny_lm, slots=2)
+    sched.start()
+    try:
+        reqs = [sched.submit(np.full((3,), i + 1, np.int32), 4)
+                for i in range(3)]
+        for r in reqs:
+            assert r.result(timeout=60.0)["state"] == "done"
+            assert len(r.tokens) == 4
+    finally:
+        sched.stop(drain=False)
+    with pytest.raises(RuntimeError, match="stopped"):
+        sched.submit(np.ones((3,), np.int32), 2)
+
+
+# ---------------------------------------------------------------------
+# compile-cache LRU (satellite): bounded + observable evictions
+# ---------------------------------------------------------------------
+
+def test_compile_cache_lru_evicts_and_counts():
+    from tpuflow.infer.generate import _LRU
+
+    calls = []
+
+    def build(key):
+        calls.append(key)
+        return f"built-{key}"
+
+    lru = _LRU("t", build, maxsize=2)
+    try:
+        assert lru(1) == "built-1" and lru(2) == "built-2"
+        assert lru(1) == "built-1"  # hit refreshes recency
+        assert lru.stats() == {"size": 2, "maxsize": 2, "hits": 1,
+                               "misses": 2, "evictions": 0}
+        lru(3)  # evicts 2 (least recently used), not 1
+        assert lru.stats()["evictions"] == 1
+        assert lru(1) == "built-1" and lru.stats()["hits"] == 2
+        lru(2)  # rebuild after eviction works
+        assert calls.count(2) == 2
+        assert len(lru) == 2  # never exceeds the bound
+    finally:
+        from tpuflow.infer.generate import _LRU_REGISTRY
+
+        _LRU_REGISTRY.remove(lru)  # keep module-global stats clean
+
+
+def test_compile_cache_stats_and_resize(tiny_lm):
+    from tpuflow.infer.generate import (
+        compile_cache_stats,
+        serve_segment_fn,
+        set_compile_cache_size,
+    )
+
+    lm, _params = tiny_lm
+    stats = compile_cache_stats()
+    assert {"blockwise", "stepwise", "serve_join",
+            "serve_segment"} <= set(stats)
+    for rec in stats.values():
+        assert {"size", "maxsize", "hits", "misses",
+                "evictions"} <= set(rec)
+    before = compile_cache_stats()["serve_segment"]
+    # same key twice: second is a HIT, no rebuild (the memo works for
+    # serve factories — a respawned pool reuses the executable)
+    f1 = serve_segment_fn(lm, 1, 16, 2, 0.0, None, None, None)
+    f2 = serve_segment_fn(lm, 1, 16, 2, 0.0, None, None, None)
+    assert f1 is f2
+    after = compile_cache_stats()["serve_segment"]
+    assert after["hits"] >= before["hits"] + 1
+    with pytest.raises(ValueError):
+        set_compile_cache_size(0)
+
+
+# ---------------------------------------------------------------------
+# metrics + obs gauges
+# ---------------------------------------------------------------------
+
+def test_percentiles_and_gauges_export():
+    from tpuflow.obs import clear_gauges, sample_system_metrics, set_gauge
+    from tpuflow.obs.gauges import inc_counter, snapshot_gauges
+    from tpuflow.serve.metrics import percentiles
+
+    assert percentiles([]) == {}
+    p = percentiles(list(range(1, 101)))
+    assert (p["p50"], p["p95"], p["p99"]) == (50, 95, 99)
+    clear_gauges("t.")
+    set_gauge("t.x", 1.5)
+    inc_counter("t.n")
+    inc_counter("t.n", 2)
+    assert snapshot_gauges("t.") == {"t.x": 1.5, "t.n": 3.0}
+    # pushed gauges ride the sysmetrics sampler (one metrics channel)
+    m = sample_system_metrics(include_devices=False)
+    assert m["t.x"] == 1.5
+    assert "t.x" not in sample_system_metrics(include_devices=False,
+                                              include_gauges=False)
+    clear_gauges("t.")
+    assert snapshot_gauges("t.") == {}
+
+
+# ---------------------------------------------------------------------
+# HTTP frontend (slow tier: sockets + a compiled pool)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_http_server_generate_stream_metrics_backpressure(tiny_lm):
+    import http.client
+    import json
+    import urllib.error
+    import urllib.request
+
+    from tpuflow.serve.http import start_http_server
+
+    sched = _sched(tiny_lm, slots=2, max_queue=64)
+    server = start_http_server(sched)
+    port = server.port
+
+    def post(path, body, timeout=120):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+
+    try:
+        st, out = post("/v1/generate",
+                       {"prompt": [1, 2, 3], "max_new_tokens": 5})
+        assert st == 200 and out["state"] == "done"
+        assert out["n_tokens"] == 5 and len(out["tokens"]) == 5
+        assert out["metrics"]["ttft_ms"] is not None
+        assert out["text"] is None  # no tokenizer on this scheduler
+
+        # streaming: NDJSON chunks, token events sum to the budget
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/v1/generate",
+                     json.dumps({"prompt": [4, 5], "max_new_tokens": 6,
+                                 "stream": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        lines = [json.loads(x)
+                 for x in resp.read().decode().strip().splitlines()]
+        conn.close()
+        assert "id" in lines[0] and lines[-1]["done"] is True
+        assert sum(len(e.get("tokens", [])) for e in lines[1:-1]) == 6
+
+        # backpressure: saturate the queue → 429 + Retry-After
+        sched.max_queue = 0
+        try:
+            post("/v1/generate", {"prompt": [1], "max_new_tokens": 2})
+            assert False, "expected 429"
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert float(e.headers["Retry-After"]) >= 1
+            assert json.loads(e.read())["retry_after_s"] > 0
+        finally:
+            sched.max_queue = 64
+
+        # bad request → 400; unknown route → 404
+        try:
+            post("/v1/generate", {"max_new_tokens": 2})
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert json.loads(r.read())["ok"] is True
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/metrics", timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["serve.done"] >= 2
+        assert "serve.ttft_ms_p50" in snap
+
+        # cancel endpoint: unknown id is a clean no-op answer
+        st, out = post("/v1/cancel", {"id": "ghost"})
+        assert st == 200 and out["cancelled"] is False
+    finally:
+        server.shutdown()
+        sched.stop(drain=False)
+
+
+@pytest.mark.slow
+def test_scheduler_churn_matches_solo_oracle(tiny_lm):
+    """Property pin under churn: many greedy requests with staggered
+    fake-clock arrivals, mixed budgets and one slot pool — every
+    finished request's tokens equal its solo-served oracle, no matter
+    which boundary it joined at or which slot it reused."""
+    from tpuflow.infer.generate import generate
+
+    lm, params = tiny_lm
+    clock = FakeClock()
+    sched = _sched(tiny_lm, slots=2, seg=4, max_new_cap=12, clock=clock,
+                   max_queue=64)
+    rng = np.random.default_rng(11)
+    reqs = []
+    for k in range(10):
+        ids = rng.integers(1, 128, (int(rng.integers(2, 9)),)).astype(
+            np.int32)
+        reqs.append((sched.submit(ids, int(rng.integers(2, 13))), ids))
+        clock.now += 0.1
+        sched.step()
+    sched.run_until_idle()
+    bucket = 8
+    for req, ids in reqs:
+        assert req.state.value == "done"
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, bucket - len(ids):] = ids
+        pads = np.asarray([bucket - len(ids)], np.int32)
+        want = np.asarray(generate(
+            lm, params, jnp.asarray(prompt),
+            max_new_tokens=req.max_new_tokens, temperature=0.0,
+            pad_lens=pads))[0, bucket:]
+        assert np.array_equal(np.asarray(req.tokens), want), req.id

@@ -1,0 +1,45 @@
+"""Tier-1 selection-budget guard.
+
+The tier-1 gate (``-m 'not slow'``) runs under a hard 870s wall budget
+that past rounds have hit at 97% (CHANGES.md PR 2) — tests that land in
+tier-1 by DEFAULT, because nobody chose a tier, are how the budget
+dies. This guard pins the tier-1 selection COUNT: growing it past the
+recorded ceiling fails until someone deliberately updates
+``tests/tier1_budget.json`` (the review point where "does this belong
+in tier-1, or in the slow tier?" gets asked). Shrinkage just lowers
+the bar for free next update.
+
+The check only arms when the run IS the tier-1 selection (markexpr
+``not slow`` over the whole tests/ tree); single-file runs and other
+marker expressions skip it.
+"""
+
+import json
+import os
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUDGET_FILE = os.path.join(_HERE, "tier1_budget.json")
+
+
+def test_tier1_selection_within_budget(request):
+    config = request.config
+    if (config.option.markexpr or "").strip() != "not slow":
+        import pytest
+
+        pytest.skip("budget guard arms only under -m 'not slow'")
+    n = getattr(config, "_tpuflow_selected_count", None)
+    assert n is not None, "conftest pytest_collection_finish missing"
+    with open(_BUDGET_FILE) as f:
+        budget = json.load(f)
+    ceiling = budget["max_tier1_tests"]
+    if n <= max(50, ceiling // 3):
+        # a sub-tree run (pytest tests/test_x.py -m 'not slow') is not
+        # the tier-1 gate; don't bless or block anything from it
+        return
+    assert n <= ceiling, (
+        f"tier-1 now selects {n} tests > recorded ceiling {ceiling}. "
+        f"New tests land in a tier DELIBERATELY: either mark them "
+        f"@pytest.mark.slow, or raise max_tier1_tests in "
+        f"{os.path.basename(_BUDGET_FILE)} in the same PR and account "
+        f"for the 870s tier-1 wall budget (ROADMAP.md)."
+    )

@@ -64,7 +64,8 @@ def test_flash_impl_matches_auto():
            "GSPMD dp2xtp4 ViT loss diverges ~14% from the 1x1 run "
            "ALREADY AT STEP 0 on jax 0.4.37 XLA:CPU — the partitioned "
            "forward computes measurably different math, not float "
-           "reduction noise; strict so a stack fix surfaces as XPASS",
+           "reduction noise; strict so a stack fix surfaces as XPASS. "
+           "Runnable repro: python tools/gspmd_cpu_tp_drift.py",
 )
 def test_spmd_trainer_tp_matches_single_device():
     """dp2 × tp4 training must follow the 1×1 trajectory numerically."""

@@ -80,7 +80,8 @@ def test_zero1_matches_replicated():
            "(data-sharded params) drifts 0.9%->7% from replicated over "
            "3 steps on jax 0.4.37 XLA:CPU while zero1 (sharded moments "
            "only) matches at 1e-5 — the param all-gather path's "
-           "numerics, pinned; strict so a stack fix surfaces as XPASS",
+           "numerics, pinned; strict so a stack fix surfaces as XPASS. "
+           "Runnable repro: python tools/gspmd_cpu_tp_drift.py",
 )
 def test_fsdp_matches_replicated():
     losses_rep, _ = _run(zero=None)

@@ -1,0 +1,10 @@
+"""``python -m tpuflow.cli.serve`` — alias of ``python -m tpuflow.serve``
+(the serving CLI lives with the runtime; this keeps the cli/ namespace
+complete: launch, runs, serve)."""
+
+from tpuflow.serve.__main__ import main
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

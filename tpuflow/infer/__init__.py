@@ -1,2 +1,10 @@
 from tpuflow.infer.batch import generate_table, predict_table  # noqa: F401
-from tpuflow.infer.generate import clear_compile_cache, generate  # noqa: F401
+from tpuflow.infer.generate import (  # noqa: F401
+    clear_compile_cache,
+    compile_cache_stats,
+    generate,
+    serve_join_fn,
+    serve_pool_arrays,
+    serve_segment_fn,
+    set_compile_cache_size,
+)

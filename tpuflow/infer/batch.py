@@ -181,6 +181,7 @@ def generate_table(
     flush_rows: int = 4096,
     max_new_tokens: Optional[int] = None,
     serve_slots: Optional[int] = None,
+    scheduler: str = "slot",
     **generate_kwargs,
 ) -> Optional[pa.Table]:
     """Map a packaged LM's TEXT surface over one shard of ``table``:
@@ -197,11 +198,17 @@ def generate_table(
     slots attention-masked, so the blockwise prefill + early-exit
     decode engine (tpuflow.infer.generate) compiles once per (length
     bucket, batch bucket) instead of once per distinct prompt length,
-    and each bucket drains in ``serve_slots``-sized waves refilled from
-    the pending queue — batch-granularity continuous batching (``None``
-    = one wave per bucket). ``model`` is a PackagedLM, a path, or a
-    ``runs:/`` / ``models:/`` URI; sampling kwargs (temperature, top_k,
-    top_p, seed, eos_id) default to the packaged ``generate_defaults``.
+    and with ``serve_slots`` set each bucket is served at SLOT
+    granularity by default (``scheduler='slot'`` — the tpuflow.serve
+    continuous-batching runtime: finished rows free their slot at
+    decode-segment boundaries and queued prompts prefill into them
+    mid-flight; token-identical to wave draining under pinned seeds).
+    ``scheduler='wave'`` keeps the original wave-drain loop — required
+    when passing engine-tuning kwargs (engine, prefill_chunk,
+    decode_segment), which the slot route rejects. ``model`` is a
+    PackagedLM, a path, or a ``runs:/`` / ``models:/`` URI; sampling
+    kwargs (temperature, top_k, top_p, seed, eos_id) default to the
+    packaged ``generate_defaults``.
     """
     from tpuflow.packaging.lm import PackagedLM, load_packaged_lm
 
@@ -215,7 +222,7 @@ def generate_table(
     return _map_table_shard(
         lambda texts: model.generate_text(
             texts, max_new_tokens=max_new_tokens, serve_slots=serve_slots,
-            **generate_kwargs
+            scheduler=scheduler, **generate_kwargs
         ),
         pa.field("generation", pa.string()),
         table, text_col, batch_size, shard, limit, output_table,

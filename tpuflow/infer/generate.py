@@ -43,16 +43,99 @@ blockwise==stepwise parity are tested in tests/test_generate.py.
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Optional
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 
+class _LRU:
+    """Small LRU memo for compiled decode closures with an EVICTION
+    counter — the observable the serving runtime watches.
+
+    ``functools.lru_cache`` bounds growth but hides evictions (its
+    ``currsize`` saturates silently); a long-lived server cycling many
+    (bucket, slot-shape, sampling) keys wants to KNOW when executables
+    are being dropped and recompiled (each rebuild is seconds of
+    latency), so this keeps hit/miss/eviction counts per cache and
+    exposes them through :func:`compile_cache_stats`. Thread-safe: the
+    builder runs outside the lock (tracing/compiling can take seconds;
+    a racing duplicate build is wasted work, never wrong work)."""
+
+    def __init__(self, name: str, builder: Callable, maxsize: int):
+        self.name = name
+        self._builder = builder
+        self.maxsize = int(maxsize)
+        self._d: "OrderedDict[tuple, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = self.misses = self.evictions = 0
+        _LRU_REGISTRY.append(self)
+
+    def __call__(self, *key):
+        with self._lock:
+            if key in self._d:
+                self._d.move_to_end(key)
+                self.hits += 1
+                return self._d[key]
+            self.misses += 1
+        val = self._builder(*key)
+        with self._lock:
+            self._d[key] = val
+            self._d.move_to_end(key)
+            while len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
+                self.evictions += 1
+        return val
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def cache_clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def stats(self) -> Dict[str, int]:
+        return {"size": len(self._d), "maxsize": self.maxsize,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions}
+
+
+_LRU_REGISTRY: "list[_LRU]" = []
+
+
+def _lru(name: str, maxsize: int):
+    def wrap(fn):
+        return _LRU(name, fn, maxsize)
+    return wrap
+
+
+def compile_cache_stats() -> Dict[str, Dict[str, int]]:
+    """Per-cache ``{size, maxsize, hits, misses, evictions}`` for every
+    memoized compiled-closure cache in this module. A growing
+    ``evictions`` count under a steady workload means the working set
+    of (shape, sampling) keys exceeds the cache — widen buckets or
+    raise the cache size via :func:`set_compile_cache_size`."""
+    return {c.name: c.stats() for c in _LRU_REGISTRY}
+
+
+def set_compile_cache_size(maxsize: int) -> None:
+    """Rebound every compiled-closure cache (existing entries beyond
+    the new bound evict oldest-first)."""
+    if maxsize < 1:
+        raise ValueError(f"maxsize must be >= 1, got {maxsize}")
+    for c in _LRU_REGISTRY:
+        with c._lock:
+            c.maxsize = int(maxsize)
+            while len(c._d) > c.maxsize:
+                c._d.popitem(last=False)
+                c.evictions += 1
+
+
 def _sample(logits, rng, temperature: float, top_k: Optional[int],
-            top_p: Optional[float] = None, step=None):
+            top_p: Optional[float] = None, step=None, row_ids=None):
     logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -96,8 +179,17 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
     # to the backend's reduction order — an ulp-level logit difference
     # near a probability boundary can still flip a draw on some
     # backends; the guarantee here is RNG invariance, not bitwise
-    # forward-pass invariance
-    rows = jnp.arange(logits.shape[0])
+    # forward-pass invariance.
+    # ``row_ids`` (optional (B,) int32) replaces the physical row index
+    # in the key derivation: the serving scheduler (tpuflow.serve)
+    # assigns each REQUEST a stream id at admission, so a request's RNG
+    # stream follows it to whatever decode slot it lands in — the
+    # property that makes slot-level scheduling token-identical to the
+    # wave-drained path under sampling.
+    if row_ids is None:
+        rows = jnp.arange(logits.shape[0])
+    else:
+        rows = jnp.asarray(row_ids, jnp.int32)
     if step is None:
         keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(rows)
     else:
@@ -222,9 +314,11 @@ def clear_compile_cache() -> None:
     distinct prompt shapes / sampling configs can call this to bound
     resident compile-cache growth; bucketing prompt lengths before
     calling :func:`generate` keeps the cache small in the first place
-    (tpuflow.packaging.lm does this for the text surface)."""
-    _compiled_run.cache_clear()
-    _compiled_blockwise.cache_clear()
+    (tpuflow.packaging.lm does this for the text surface). Growth is
+    ALSO bounded passively: every cache here is a small LRU
+    (:func:`compile_cache_stats` / :func:`set_compile_cache_size`)."""
+    for c in _LRU_REGISTRY:
+        c.cache_clear()
 
 
 def _cache_zeros(dm, b: int, max_len: int):
@@ -242,7 +336,7 @@ def _cache_zeros(dm, b: int, max_len: int):
     )
 
 
-@functools.lru_cache(maxsize=64)
+@_lru("blockwise", maxsize=64)
 def _compiled_blockwise(dm, b: int, p: int, max_len: int,
                         temperature: float, top_k: Optional[int],
                         top_p: Optional[float], eos_id: Optional[int],
@@ -364,7 +458,186 @@ def _compiled_blockwise(dm, b: int, p: int, max_len: int,
     return run
 
 
-@functools.lru_cache(maxsize=64)
+# --------------------------------------------------------------------
+# Serve engine: segment-granular resume + per-slot cache writes.
+#
+# The building blocks of tpuflow.serve's slot-level continuous
+# batching. A SLOT POOL is a fixed (slots, length) decode state —
+# KV cache + token buffer — that the scheduler drives in SEGMENTS of a
+# fixed step count, with control returning to the host at every
+# boundary. All rows share ONE physical write position t (the scalar
+# flax cache_index), so the state machine stays compile-stable: exactly
+# two executables per pool, regardless of how requests come and go.
+# What makes rows independent anyway is the bucketed-serving machinery
+# above: a request JOINING at boundary t is LEFT-padded so its prompt
+# ENDS at position t (pad_lens[row] = t - prompt_len + 1), its rotary
+# positions / attention window / RNG steps are logical (pad-free), and
+# its per-request ``stream_id`` replaces the physical row in the
+# sampling key — so the tokens it generates are identical to the same
+# request served in a wave-drained batch (the parity the scheduler
+# tests pin).
+#
+# Per-slot cache writes: the join executable runs ONE (slots,
+# bucket-1)-shaped prefill pass over the tail window ending at t and
+# merges the resulting KV rows into the live cache ONLY for joining
+# rows (everything else keeps its in-flight state). The last prompt
+# token is deliberately left to the next decode step — it appends that
+# token's KV at position t exactly like every other row's step, which
+# is what lets joined and in-flight rows share one step function.
+# Stale KV from a slot's previous occupant needs no zeroing: positions
+# before the new request's pads and after the current index are both
+# masked out of every attention read (CausalAttention decode mask).
+
+
+def _set_cache_index(cache, value):
+    """Rewrite every scalar ``cache_index`` leaf to ``value`` (the
+    decode-attention cache tree is (B, ...) arrays + one scalar index
+    per layer, so ndim==0 identifies the index leaves)."""
+    v = jnp.asarray(value, jnp.int32)
+    return jax.tree.map(lambda leaf: v if leaf.ndim == 0 else leaf, cache)
+
+
+def _merge_rows(new, old, row_mask):
+    """Per-row select between two identically-shaped cache/state trees:
+    rows where ``row_mask`` is True take ``new``. Scalar leaves (the
+    cache indices) take ``new`` unconditionally — join and decode leave
+    them equal by construction."""
+    def pick(n, o):
+        if n.ndim == 0:
+            return n
+        m = row_mask.reshape((-1,) + (1,) * (n.ndim - 1))
+        return jnp.where(m, n, o)
+
+    return jax.tree.map(pick, new, old)
+
+
+def serve_pool_arrays(model, slots: int, length: int):
+    """Fresh device state for one slot pool: (KV cache, token buffer).
+    ``length`` is the pool's whole physical horizon (bucket + decode
+    room); the cache is the decode twin's full-length buffer, the token
+    buffer is (slots, length) int32 zeros."""
+    dm = model.clone(decode=True, seq_axis=None)
+    return (_cache_zeros(dm, slots, length),
+            jnp.zeros((slots, length), jnp.int32))
+
+
+def serve_join_fn(model, slots: int, length: int, bucket: int):
+    """Compiled per-slot prefill: admit requests into freed slots of a
+    live pool at boundary ``t0``.
+
+    Returns ``join(params, cache, out, pad_lens, prompts, join_mask,
+    t0) -> (cache, out)`` where ``prompts`` is (slots, bucket) int32
+    rows LEFT-padded to the bucket (only rows with ``join_mask`` True
+    are read), ``pad_lens`` is the POST-join (slots,) pad vector
+    (pad_lens[r] = t0 - prompt_len_r + 1 for joining rows, unchanged
+    for the rest), and ``t0`` is the boundary step index — the joining
+    prompt's last token lands at buffer position t0, so the next decode
+    step treats joined and in-flight rows identically. The prefill
+    pass covers window [t0-bucket+1, t0) (the last prompt token's KV is
+    appended by that next step); its cache rows merge in ONLY where
+    ``join_mask`` is set."""
+    if bucket < 2:
+        raise ValueError(f"bucket must be >= 2, got {bucket}")
+    if length < bucket:
+        raise ValueError(f"length ({length}) must be >= bucket ({bucket})")
+    dm = model.clone(decode=True, seq_axis=None)
+    return _compiled_serve_join(dm, int(slots), int(length), int(bucket))
+
+
+@_lru("serve_join", maxsize=32)
+def _compiled_serve_join(dm, b: int, length: int, bucket: int):
+    @jax.jit
+    def join(params, cache, out, pad_lens, prompts, join_mask, t0):
+        start = t0 - bucket + 1
+        out_new = lax.dynamic_update_slice(out, prompts, (0, start))
+        out = jnp.where(join_mask[:, None], out_new, out)
+        # prefill the window ENDING at t0 (exclusive): bucket-1 tokens,
+        # so the next decode step appends the last prompt token's KV at
+        # t0 for joined rows exactly as it does for in-flight rows
+        chunk = lax.dynamic_slice(out, (0, start), (b, bucket - 1))
+        _, vars2 = dm.apply(
+            {"params": params, "cache": _set_cache_index(cache, start)},
+            chunk, mutable=["cache"], pad_lens=pad_lens,
+        )
+        # per-slot cache write: joining rows take the prefilled KV,
+        # in-flight rows keep their live state (the scalar index leaves
+        # agree: start + (bucket-1) == t0 == the live index)
+        cache = _merge_rows(vars2["cache"], cache, join_mask)
+        return cache, out
+
+    return join
+
+
+def serve_segment_fn(model, slots: int, length: int, seg: int,
+                     temperature: float, top_k: Optional[int],
+                     top_p: Optional[float], eos_id: Optional[int]):
+    """Compiled decode segment: advance a pool ``seg`` steps from
+    boundary ``t0``, then return control to the host.
+
+    Returns ``segment(params, cache, out, done, pad_lens, stream_ids,
+    last_pos, rng, t0) -> (cache, out, done, toks)``:
+
+    - ``done`` (slots,) bool: finished/empty rows keep stepping (fixed
+      shapes) but write ``eos_id`` (or 0) and never un-finish;
+    - ``stream_ids`` (slots,) int32: the per-REQUEST sampling stream id
+      (replaces the physical row in ``_sample``'s key derivation);
+    - ``last_pos`` (slots,) int32: the row's final allowed buffer
+      position (join boundary + its max_new_tokens) — writing it sets
+      ``done`` (per-request token budgets at slot granularity);
+    - ``toks``: the (slots, seg) block written this segment (buffer
+      positions [t0+1, t0+seg]) — the host streams per-request slices
+      of it at every boundary.
+
+    The caller aligns segments to the grid (t0 = bucket-1 + k*seg and
+    t0 + seg <= length-1): ``lax.dynamic_update_slice`` CLAMPS
+    out-of-range starts, so an unaligned tail segment would silently
+    rewrite position length-1."""
+    dm = model.clone(decode=True, seq_axis=None)
+    return _compiled_serve_segment(
+        dm, int(slots), int(length), int(seg), float(temperature),
+        None if top_k is None else int(top_k),
+        None if top_p is None else float(top_p),
+        None if eos_id is None else int(eos_id),
+    )
+
+
+@_lru("serve_segment", maxsize=32)
+def _compiled_serve_segment(dm, b: int, length: int, seg: int,
+                            temperature: float, top_k: Optional[int],
+                            top_p: Optional[float],
+                            eos_id: Optional[int]):
+    fill = jnp.int32(eos_id if eos_id is not None else 0)
+
+    @jax.jit
+    def segment(params, cache, out, done, pad_lens, stream_ids,
+                last_pos, rng, t0):
+        def step(carry, i):
+            cache, out, done = carry
+            t = t0 + i
+            tok = lax.dynamic_slice(out, (0, t), (b, 1))
+            lg, vars2 = dm.apply(
+                {"params": params, "cache": cache}, tok,
+                mutable=["cache"], pad_lens=pad_lens,
+            )
+            nxt = _sample(lg[:, -1], rng, temperature, top_k, top_p,
+                          step=t - pad_lens, row_ids=stream_ids)
+            nxt = jnp.where(done, fill, nxt)
+            done = done | (t + 1 >= last_pos)
+            if eos_id is not None:
+                done = done | (nxt == eos_id)
+            out = lax.dynamic_update_slice(out, nxt[:, None], (0, t + 1))
+            return (vars2["cache"], out, done), None
+
+        (cache, out, done), _ = lax.scan(
+            step, (cache, out, done), jnp.arange(seg)
+        )
+        toks = lax.dynamic_slice(out, (0, t0 + 1), (b, seg))
+        return cache, out, done, toks
+
+    return segment
+
+
+@_lru("stepwise", maxsize=64)
 def _compiled_run(dm, b: int, p: int, max_len: int, temperature: float,
                   top_k: Optional[int], top_p: Optional[float],
                   eos_id: Optional[int]):

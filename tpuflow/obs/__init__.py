@@ -5,3 +5,9 @@ from tpuflow.obs.mfu import (  # noqa: F401
     mfu,
 )
 from tpuflow.obs.sysmetrics import sample_system_metrics  # noqa: F401
+from tpuflow.obs.gauges import (  # noqa: F401
+    clear_gauges,
+    inc_counter,
+    set_gauge,
+    snapshot_gauges,
+)

@@ -1,0 +1,60 @@
+"""Process-wide gauge/counter registry — the push half of obs.
+
+:func:`sample_system_metrics` (tpuflow.obs.sysmetrics) PULLS host and
+device numbers at sample time; long-lived runtimes (the serving
+scheduler, trainers with background staging) instead PUSH their
+operational gauges here as they change, and any metrics consumer —
+run-metric logging, the serve HTTP ``/v1/metrics`` endpoint, a test —
+reads one merged snapshot. Names follow the sysmetrics dotted
+convention (``serve.slot_occupancy``, ``serve.batch_efficiency``) so a
+tracking store ingests both sources identically.
+
+Thread-safe; values are plain floats (gauges overwrite, counters add).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+_LOCK = threading.Lock()
+_GAUGES: Dict[str, float] = {}
+_COUNTERS: Dict[str, float] = {}
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Overwrite gauge ``name`` (last write wins)."""
+    with _LOCK:
+        _GAUGES[name] = float(value)
+
+
+def inc_counter(name: str, by: float = 1.0) -> float:
+    """Add ``by`` to counter ``name`` (created at 0); returns the new
+    value."""
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0.0) + float(by)
+        return _COUNTERS[name]
+
+
+def snapshot_gauges(prefix: Optional[str] = None) -> Dict[str, float]:
+    """One merged dict of every gauge and counter (optionally filtered
+    to names starting with ``prefix``)."""
+    with _LOCK:
+        merged = dict(_GAUGES)
+        merged.update(_COUNTERS)
+    if prefix is not None:
+        merged = {k: v for k, v in merged.items() if k.startswith(prefix)}
+    return merged
+
+
+def clear_gauges(prefix: Optional[str] = None) -> None:
+    """Drop gauges/counters (all, or those under ``prefix``) — test
+    isolation and runtime restarts."""
+    with _LOCK:
+        if prefix is None:
+            _GAUGES.clear()
+            _COUNTERS.clear()
+        else:
+            for d in (_GAUGES, _COUNTERS):
+                for k in [k for k in d if k.startswith(prefix)]:
+                    del d[k]

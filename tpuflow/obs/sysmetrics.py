@@ -50,10 +50,18 @@ def _cpu_percent() -> float:
     return 100.0 * (1 - di / dt) if dt > 0 else 0.0
 
 
-def sample_system_metrics(include_devices: bool = True) -> Dict[str, float]:
+def sample_system_metrics(include_devices: bool = True,
+                          include_gauges: bool = True) -> Dict[str, float]:
     """One snapshot: host cpu/mem + per-device HBM, prefixed for
-    run-metric logging (sys.* / device<i>.*)."""
+    run-metric logging (sys.* / device<i>.*). ``include_gauges`` merges
+    the process-wide pushed gauges (tpuflow.obs.gauges — e.g. the
+    serving runtime's serve.* occupancy/queue numbers), so one sampler
+    covers pulled AND pushed sources."""
     m: Dict[str, float] = {"sys.cpu_percent": _cpu_percent(), "sys.time": time.time()}
+    if include_gauges:
+        from tpuflow.obs.gauges import snapshot_gauges
+
+        m.update(snapshot_gauges())
     mem = _proc_meminfo()
     if mem:
         total = mem.get("MemTotal", 0.0)

@@ -192,6 +192,7 @@ class PackagedLM:
         prompts: "Sequence[str]",
         max_new_tokens: Optional[int] = None,
         serve_slots: Optional[int] = None,
+        scheduler: str = "slot",
         **kwargs,
     ) -> "list[str]":
         """Raw strings in -> continued strings out (prompt INCLUDED,
@@ -221,16 +222,58 @@ class PackagedLM:
         can still vary with batch shape on some backends) — but a
         prompt's ROW INDEX within its wave depends on which other
         prompts share the bucket, so sampled outputs can differ from a
-        one-at-a-time loop (greedy output is identical either way)."""
+        one-at-a-time loop (greedy output is identical either way).
+
+        ``scheduler`` selects the ``serve_slots`` engine: ``'slot'``
+        (default) routes through the slot-level continuous-batching
+        scheduler (tpuflow.serve — finished rows free their slot at
+        decode-SEGMENT boundaries and queued prompts prefill into them
+        mid-flight), ``'wave'`` keeps the original wave-drain loop
+        here. The two are token-identical under pinned seeds (each
+        request's RNG stream is keyed by its admission index, not its
+        physical slot; tests/test_serve.py pins the parity), so 'wave'
+        doubles as the slot scheduler's oracle."""
         tok = self._require_tokenizer()
+        if scheduler not in ("slot", "wave"):
+            raise ValueError(
+                f"scheduler must be 'slot' or 'wave', got {scheduler!r}"
+            )
+        if serve_slots is not None and serve_slots < 1:
+            raise ValueError(f"serve_slots must be >= 1, got {serve_slots}")
+        if serve_slots is not None and scheduler == "slot":
+            from tpuflow.serve.scheduler import serve_texts
+
+            opts = dict(self.generate_defaults)
+            opts.update(kwargs)
+            if max_new_tokens is None:
+                max_new_tokens = int(opts.pop("max_new_tokens", 32))
+            else:
+                opts.pop("max_new_tokens", None)
+            known = {"temperature", "top_k", "top_p", "seed", "eos_id"}
+            # only EXPLICIT kwargs can reject the call: a package whose
+            # generate_defaults carry engine-tuning keys (engine,
+            # prefill_chunk, ... — valid for generate()/the wave path)
+            # must keep serving; those defaults simply don't apply to
+            # the slot engine
+            extra = set(kwargs) - known
+            if extra:
+                raise ValueError(
+                    f"scheduler='slot' takes sampling kwargs "
+                    f"{sorted(known)} only (got {sorted(extra)}); "
+                    "engine-tuning kwargs need scheduler='wave'"
+                )
+            return serve_texts(
+                self, list(prompts), int(max_new_tokens), int(serve_slots),
+                temperature=float(opts.get("temperature", 0.0)),
+                top_k=opts.get("top_k"), top_p=opts.get("top_p"),
+                eos_id=opts.get("eos_id"), seed=int(opts.get("seed", 0)),
+            )
         eos = kwargs.get("eos_id", self.generate_defaults.get("eos_id"))
         encoded = [np.asarray(tok.encode(p), np.int32) for p in prompts]
         by_bucket: "dict[int, list[int]]" = {}
         for i, ids in enumerate(encoded):
             by_bucket.setdefault(_bucket_len(len(ids)), []).append(i)
         out: "list[Optional[str]]" = [None] * len(prompts)
-        if serve_slots is not None and serve_slots < 1:
-            raise ValueError(f"serve_slots must be >= 1, got {serve_slots}")
         wave = serve_slots or max(1, len(prompts))
         for blen, queue in by_bucket.items():
             while queue:

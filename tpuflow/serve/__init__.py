@@ -1,0 +1,19 @@
+"""tpuflow.serve — online serving runtime.
+
+The request-lifecycle layer the offline batch path (infer.batch /
+packaging.lm) lacks: slot-level continuous batching over the decode
+engine's segment-resume + per-slot-prefill primitives
+(tpuflow.infer.generate), a bounded admission queue with backpressure,
+per-request deadlines/cancellation/streaming, serving metrics exported
+through tpuflow.obs, and a thin stdlib HTTP frontend
+(``python -m tpuflow.serve``).
+"""
+
+from tpuflow.serve.metrics import ServeMetrics, percentiles  # noqa: F401
+from tpuflow.serve.request import (  # noqa: F401
+    QueueFull,
+    Request,
+    RequestState,
+)
+from tpuflow.serve.scheduler import ServeScheduler, serve_texts  # noqa: F401
+from tpuflow.serve.slots import SlotPool  # noqa: F401

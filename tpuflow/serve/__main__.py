@@ -1,0 +1,68 @@
+"""``python -m tpuflow.serve`` — serve a packaged LM over HTTP.
+
+Loads a packaged LM directory / ``runs:/`` / ``models:/`` URI
+(tpuflow.packaging.lm), builds the slot-level continuous-batching
+scheduler around it, and exposes the stdlib HTTP frontend::
+
+  python -m tpuflow.serve --model /path/to/packaged_lm --port 8000 \
+      --slots 4 --max-new 64
+
+  curl -s localhost:8000/v1/generate -d '{"prompt": "the cat"}'
+  curl -s localhost:8000/v1/metrics
+
+Equivalent entry point: ``python -m tpuflow.cli.serve``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="tpuflow.serve", description=__doc__)
+    p.add_argument("--model", required=True,
+                   help="packaged LM directory or runs:/ / models:/ URI")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 binds an ephemeral port (printed on start)")
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode slots per prompt-length bucket")
+    p.add_argument("--seg", type=int, default=8,
+                   help="decode steps between scheduler boundaries")
+    p.add_argument("--rounds", type=int, default=3,
+                   help="decode-budget multiples in each pool's horizon")
+    p.add_argument("--max-new", type=int, default=64,
+                   help="per-request max_new_tokens cap")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="admission queue bound (429 beyond it)")
+    p.add_argument("--request-timeout", type=float, default=120.0)
+    args = p.parse_args(argv)
+
+    from tpuflow.serve.http import start_http_server
+    from tpuflow.serve.scheduler import ServeScheduler
+
+    sched = ServeScheduler.from_packaged(
+        args.model, slots=args.slots, seg=args.seg, rounds=args.rounds,
+        max_new_cap=args.max_new, max_queue=args.max_queue,
+    )
+    server = start_http_server(sched, args.host, args.port,
+                               request_timeout_s=args.request_timeout)
+    print(f"serving {args.model} on http://{args.host}:{server.port} "
+          f"(slots={args.slots} seg={args.seg} max_new={args.max_new} "
+          f"queue<={args.max_queue})", flush=True)
+    try:
+        import threading
+
+        threading.Event().wait()  # serve until interrupted
+    except KeyboardInterrupt:
+        print("shutting down", flush=True)
+    finally:
+        server.shutdown()
+        sched.stop(drain=False, timeout=10.0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

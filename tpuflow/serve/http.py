@@ -1,0 +1,225 @@
+"""Stdlib HTTP frontend for the serving scheduler.
+
+Deliberately thin — demo + integration-test surface, not a production
+gateway: ``http.server.ThreadingHTTPServer`` (one handler thread per
+connection) over a running :class:`~tpuflow.serve.scheduler.
+ServeScheduler`; every request is a thread-safe ``submit``/``cancel``
+into the scheduler thread, so the device never sees HTTP concurrency.
+
+Endpoints::
+
+  POST /v1/generate   {"prompt": str|[ids], "max_new_tokens"?, "stream"?,
+                       "deadline_s"?, "id"?}
+      → 200 JSON {id, state, text?, tokens, n_tokens, metrics}
+      → stream=true: chunked NDJSON — one {"tokens": [...]} line per
+        decode segment, then a final {"done": true, ...} summary line
+      → 429 + Retry-After on admission-queue backpressure (QueueFull)
+      → 400 on never-servable requests (too long, bad budget)
+  POST /v1/cancel     {"id": ...} → {"cancelled": bool}
+  GET  /v1/metrics    scheduler + gauge snapshot (JSON)
+  GET  /v1/events/ID  structured event log for one request id
+  GET  /healthz       {"ok": true, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from tpuflow.serve.request import QueueFull, RequestState
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # required for chunked streaming
+    server_version = "tpuflow-serve/0.1"
+
+    # ---- plumbing ---------------------------------------------------
+    def log_message(self, fmt, *args):  # route access noise to events
+        self.server.scheduler.metrics.event(
+            "-http-", "access", line=(fmt % args)
+        )
+
+    def _json(self, code: int, payload: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b"{}"
+        try:
+            body = json.loads(raw.decode() or "{}")
+        except json.JSONDecodeError as e:
+            raise ValueError(f"invalid JSON body: {e}")
+        if not isinstance(body, dict):
+            raise ValueError("body must be a JSON object")
+        return body
+
+    def _chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+    def _end_chunks(self) -> None:
+        self.wfile.write(b"0\r\n\r\n")
+
+    def _text_of(self, req) -> Optional[str]:
+        tok = self.server.scheduler.tokenizer
+        if tok is None:
+            return None
+        import numpy as np
+
+        full = np.concatenate(
+            [req.prompt_ids, np.asarray(req.tokens, np.int32)]
+        ) if req.tokens else req.prompt_ids
+        return tok.decode(full).decode("utf-8", "replace")
+
+    # ---- routes -----------------------------------------------------
+    def do_GET(self):
+        sched = self.server.scheduler
+        if self.path == "/healthz":
+            self._json(200, {"ok": True, "idle": sched.idle()})
+        elif self.path == "/v1/metrics":
+            from tpuflow.obs.gauges import snapshot_gauges
+
+            snap = sched.metrics_snapshot()
+            snap.update(snapshot_gauges("serve"))
+            self._json(200, snap)
+        elif self.path.startswith("/v1/events/"):
+            rid = self.path[len("/v1/events/"):]
+            self._json(200, {"id": rid,
+                             "events": sched.metrics.events(rid)})
+        else:
+            self._json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        sched = self.server.scheduler
+        self._response_started = False
+        try:
+            body = self._read_body()
+            if self.path == "/v1/generate":
+                return self._generate(sched, body)
+            if self.path == "/v1/cancel":
+                rid = body.get("id")
+                if not rid:
+                    raise ValueError("cancel needs an 'id'")
+                return self._json(200, {"id": rid,
+                                        "cancelled": sched.cancel(rid)})
+            return self._json(404, {"error": f"no route {self.path}"})
+        except QueueFull as e:
+            self._json(
+                429,
+                {"error": "queue full", "retry_after_s": e.retry_after_s},
+                headers={"Retry-After": f"{max(1, round(e.retry_after_s))}"},
+            )
+        except ValueError as e:
+            self._json(400, {"error": str(e)})
+        except Exception as e:  # pragma: no cover - defensive
+            if self._response_started:
+                # headers already on the wire (streaming): a second
+                # send_response would corrupt the connection — drop it
+                self.close_connection = True
+            else:
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _generate(self, sched, body: Dict[str, Any]) -> None:
+        prompt = body.get("prompt")
+        if prompt is None:
+            raise ValueError("generate needs a 'prompt'")
+        kwargs: Dict[str, Any] = {}
+        if body.get("max_new_tokens") is not None:
+            kwargs["max_new_tokens"] = int(body["max_new_tokens"])
+        if body.get("deadline_s") is not None:
+            kwargs["deadline_s"] = float(body["deadline_s"])
+        if body.get("id"):
+            kwargs["request_id"] = str(body["id"])
+        timeout = float(self.server.request_timeout_s
+                        if body.get("timeout_s") is None
+                        else body["timeout_s"])
+
+        if body.get("stream"):
+            events: "queue.Queue" = queue.Queue()
+            req = sched.submit(
+                prompt, stream_cb=lambda r, new, fin:
+                    events.put((list(new), fin)),
+                **kwargs,
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            self._response_started = True
+            try:
+                self._chunk(json.dumps({"id": req.id}).encode() + b"\n")
+                finished = False
+                while not finished:
+                    try:
+                        new, finished = events.get(timeout=timeout)
+                    except queue.Empty:
+                        sched.cancel(req)
+                        break
+                    if new:
+                        self._chunk(
+                            json.dumps({"tokens": new}).encode() + b"\n"
+                        )
+                req.wait(timeout=1.0)
+                summary = req.summary()
+                summary["done"] = True
+                summary["text"] = self._text_of(req)
+                self._chunk(json.dumps(summary).encode() + b"\n")
+                self._end_chunks()
+            except OSError:
+                # client went away mid-stream: free the decode slot
+                # instead of burning it on a request nobody is reading
+                # (the connection is dead — no error response possible)
+                sched.cancel(req)
+                self.close_connection = True
+            return
+
+        req = sched.submit(prompt, **kwargs)
+        try:
+            summary = req.result(timeout=timeout)
+        except TimeoutError:
+            sched.cancel(req)
+            req.wait(timeout=5.0)
+            summary = req.summary()
+            summary["error"] = summary["error"] or "server timeout"
+        summary["text"] = self._text_of(req)
+        code = 200 if req.state is RequestState.DONE else 504
+        self._json(code, summary)
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer bound to one scheduler."""
+
+    daemon_threads = True
+
+    def __init__(self, scheduler, host: str = "127.0.0.1", port: int = 0,
+                 request_timeout_s: float = 120.0):
+        super().__init__((host, port), _Handler)
+        self.scheduler = scheduler
+        self.request_timeout_s = request_timeout_s
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+def start_http_server(scheduler, host: str = "127.0.0.1", port: int = 0,
+                      request_timeout_s: float = 120.0) -> ServeHTTPServer:
+    """Start the scheduler loop (if needed) and an HTTP server thread;
+    ``port=0`` binds an ephemeral port (read it back from ``.port``).
+    Stop with ``server.shutdown()`` (scheduler stays up — stop it via
+    ``scheduler.stop()``)."""
+    scheduler.start()
+    server = ServeHTTPServer(scheduler, host, port, request_timeout_s)
+    threading.Thread(target=server.serve_forever, name="tpuflow-serve-http",
+                     daemon=True).start()
+    return server

@@ -1,0 +1,192 @@
+"""Serving observability: per-request latency, pool efficiency, events.
+
+Three surfaces, all fed by the scheduler thread:
+
+- **latency histograms** — TTFT, queue wait, decode latency, end-to-end
+  per finished request, summarized as p50/p95/p99 (the numbers
+  ``bench.py --serve`` A/Bs against wave draining);
+- **pool gauges** — slot occupancy and batch efficiency (live rows /
+  slot rows per decode segment: the fraction of the fixed-shape batch
+  doing useful work — the quantity slot-level scheduling exists to
+  raise), published through :mod:`tpuflow.obs.gauges` so
+  ``sample_system_metrics`` and run-metric logging pick them up like
+  any host/device metric;
+- **a structured event log per request id** — submit/admit/first-token/
+  finish/reject/cancel/expire with timestamps, bounded to the most
+  recent requests (a server must not grow without limit).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from tpuflow.obs.gauges import inc_counter, set_gauge
+from tpuflow.serve.request import Request
+
+
+def percentiles(values: List[float],
+                pcts=(50.0, 95.0, 99.0)) -> Dict[str, float]:
+    """Nearest-rank percentiles of ``values`` keyed ``p50``/``p95``/...
+    (empty input → empty dict)."""
+    if not values:
+        return {}
+    import math
+
+    s = sorted(values)
+    out = {}
+    for p in pcts:
+        rank = max(0, min(len(s) - 1, math.ceil(p / 100.0 * len(s)) - 1))
+        out[f"p{p:g}"] = s[rank]
+    return out
+
+
+def _bounded_append(lst: list, value, cap: int) -> None:
+    """Append keeping only the most recent ``cap`` entries — every
+    per-request series here is a sliding window, never an unbounded
+    log (the 'a server must not grow without limit' contract)."""
+    lst.append(value)
+    if len(lst) > cap:
+        del lst[: len(lst) - cap]
+
+
+class ServeMetrics:
+    """Aggregate + per-request serving metrics (thread-safe).
+
+    Memory is bounded on every axis: latency histograms keep the most
+    recent ``max_samples`` points (percentiles are over that sliding
+    window), the event log keeps ``max_event_requests`` request ids and
+    ``max_events_per_request`` entries per id — so shared ids (the
+    ``-http-`` access log, a chatty client reusing one id) cannot grow
+    without limit either."""
+
+    def __init__(self, max_event_requests: int = 512,
+                 gauge_prefix: str = "serve",
+                 max_samples: int = 4096,
+                 max_events_per_request: int = 128):
+        self._lock = threading.Lock()
+        self.prefix = gauge_prefix
+        self.max_samples = max_samples
+        self.max_events_per_request = max_events_per_request
+        self.counts: Dict[str, int] = {
+            "submitted": 0, "rejected": 0, "admitted": 0, "done": 0,
+            "cancelled": 0, "expired": 0,
+        }
+        self.ttft_ms: List[float] = []
+        self.queue_wait_ms: List[float] = []
+        self.decode_ms: List[float] = []
+        self.e2e_ms: List[float] = []
+        self.tokens_out = 0
+        self.segments = 0
+        self.segment_live_rows = 0
+        self.segment_slot_rows = 0
+        self.queue_depth = 0
+        self._events: "OrderedDict[str, List[Dict[str, Any]]]" = OrderedDict()
+        self._max_event_requests = max_event_requests
+
+    # ---- event log --------------------------------------------------
+    def event(self, request_id: str, name: str, **detail: Any) -> None:
+        rec = {"ts": time.time(), "event": name}
+        if detail:
+            rec.update(detail)
+        with self._lock:
+            log = self._events.get(request_id)
+            if log is None:
+                self._events[request_id] = log = []
+                while len(self._events) > self._max_event_requests:
+                    self._events.popitem(last=False)
+            _bounded_append(log, rec, self.max_events_per_request)
+
+    def events(self, request_id: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events.get(request_id, []))
+
+    # ---- lifecycle hooks (scheduler thread) -------------------------
+    def on_submit(self, req: Request) -> None:
+        with self._lock:
+            self.counts["submitted"] += 1
+        self.event(req.id, "submit", prompt_tokens=int(req.prompt_ids.size),
+                   max_new_tokens=req.max_new_tokens, bucket=req.bucket)
+
+    def on_reject(self, depth: int, retry_after_s: float) -> None:
+        with self._lock:
+            self.counts["rejected"] += 1
+        inc_counter(f"{self.prefix}.rejected_total")
+        self.event("-rejected-", "reject", depth=depth,
+                   retry_after_s=retry_after_s)
+
+    def on_admit(self, req: Request) -> None:
+        with self._lock:
+            self.counts["admitted"] += 1
+            if req.ts_admitted is not None:
+                _bounded_append(self.queue_wait_ms,
+                                (req.ts_admitted - req.ts_arrival) * 1e3,
+                                self.max_samples)
+        self.event(req.id, "admit", slot=req.slot, stream_id=req.stream_id)
+
+    def on_first_token(self, req: Request) -> None:
+        with self._lock:
+            if req.ts_first_token is not None:
+                _bounded_append(self.ttft_ms,
+                                (req.ts_first_token - req.ts_arrival) * 1e3,
+                                self.max_samples)
+        self.event(req.id, "first_token")
+
+    def on_finish(self, req: Request) -> None:
+        key = {"done": "done", "cancelled": "cancelled",
+               "expired": "expired"}.get(req.state.value)
+        t = req.timing()
+        with self._lock:
+            if key:
+                self.counts[key] += 1
+            self.tokens_out += len(req.tokens)
+            if req.state.value == "done":
+                if t["decode_ms"] is not None:
+                    _bounded_append(self.decode_ms, t["decode_ms"],
+                                    self.max_samples)
+                if t["e2e_ms"] is not None:
+                    _bounded_append(self.e2e_ms, t["e2e_ms"],
+                                    self.max_samples)
+        inc_counter(f"{self.prefix}.requests_{req.state.value}_total")
+        self.event(req.id, "finish", state=req.state.value,
+                   n_tokens=len(req.tokens), error=req.error, **t)
+
+    def on_segment(self, live_rows: int, slot_rows: int) -> None:
+        with self._lock:
+            self.segments += 1
+            self.segment_live_rows += live_rows
+            self.segment_slot_rows += slot_rows
+            eff = (self.segment_live_rows / self.segment_slot_rows
+                   if self.segment_slot_rows else 0.0)
+        set_gauge(f"{self.prefix}.slot_occupancy",
+                  live_rows / slot_rows if slot_rows else 0.0)
+        set_gauge(f"{self.prefix}.batch_efficiency", eff)
+
+    def on_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+        set_gauge(f"{self.prefix}.queue_depth", float(depth))
+
+    # ---- export -----------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        """Flat dotted-key snapshot (run-metric loggable as-is)."""
+        with self._lock:
+            m: Dict[str, float] = {
+                f"{self.prefix}.{k}": float(v) for k, v in self.counts.items()
+            }
+            m[f"{self.prefix}.queue_depth"] = float(self.queue_depth)
+            m[f"{self.prefix}.tokens_out"] = float(self.tokens_out)
+            m[f"{self.prefix}.segments"] = float(self.segments)
+            m[f"{self.prefix}.batch_efficiency"] = (
+                self.segment_live_rows / self.segment_slot_rows
+                if self.segment_slot_rows else 0.0
+            )
+            for name, vals in (("ttft_ms", self.ttft_ms),
+                               ("queue_wait_ms", self.queue_wait_ms),
+                               ("decode_ms", self.decode_ms),
+                               ("e2e_ms", self.e2e_ms)):
+                for pk, pv in percentiles(vals).items():
+                    m[f"{self.prefix}.{name}_{pk}"] = round(pv, 3)
+        return m

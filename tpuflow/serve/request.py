@@ -1,0 +1,158 @@
+"""Request lifecycle types for the online serving runtime.
+
+A :class:`Request` is the unit the scheduler moves through the
+pipeline::
+
+    submit() ──► QUEUED ──► RUNNING ──► DONE
+                   │            │
+                   │            ├──► CANCELLED   (cancel() frees the slot)
+                   │            └──► EXPIRED     (deadline hit mid-decode)
+                   ├──► CANCELLED                (cancel() while queued)
+                   └──► EXPIRED                  (deadline hit in queue)
+    submit() ──► QueueFull raised               (admission backpressure)
+
+Every terminal transition sets the request's done event, so
+:meth:`Request.result` unblocks exactly once; per-stage timestamps
+(arrival → admitted → first token → done) are recorded here and turned
+into TTFT / queue-wait / decode-latency metrics by
+:mod:`tpuflow.serve.metrics`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class RequestState(str, Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    CANCELLED = "cancelled"
+    EXPIRED = "expired"
+
+
+class QueueFull(RuntimeError):
+    """Admission rejected: the bounded queue is at capacity.
+
+    Carries ``retry_after_s`` — the backpressure contract (the HTTP
+    frontend maps this to 429 + ``Retry-After``; a well-behaved client
+    backs off instead of hammering a saturated server)."""
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(
+            f"admission queue full ({depth} queued); retry after "
+            f"{retry_after_s:.2f}s"
+        )
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+_req_counter = itertools.count()
+
+
+@dataclass(eq=False)  # identity equality: requests hold numpy fields
+class Request:
+    """One in-flight generation request (scheduler-owned mutable state).
+
+    ``stream_cb(request, new_token_ids, finished)`` fires on the
+    scheduler thread at every decode-segment boundary that produced
+    tokens for this request — the streaming surface; exceptions from it
+    are swallowed into the event log, never into the decode loop.
+    """
+
+    prompt_ids: np.ndarray
+    max_new_tokens: int
+    id: str = ""
+    deadline_ts: Optional[float] = None  # absolute time.time() deadline
+    stream_cb: Optional[Callable[["Request", List[int], bool], None]] = None
+
+    # lifecycle (scheduler-owned)
+    state: RequestState = RequestState.QUEUED
+    bucket: int = 0
+    stream_id: int = 0  # per-request sampling stream (infer._sample row_ids)
+    slot: Optional[int] = None
+    tokens: List[int] = field(default_factory=list)
+    error: Optional[str] = None
+    cancel_requested: bool = False
+
+    # timestamps (time.time)
+    ts_arrival: float = 0.0
+    ts_admitted: Optional[float] = None
+    ts_first_token: Optional[float] = None
+    ts_done: Optional[float] = None
+
+    _done_event: threading.Event = field(default_factory=threading.Event,
+                                         repr=False)
+
+    def __post_init__(self):
+        if not self.id:
+            self.id = f"req-{next(_req_counter)}"
+        if self.ts_arrival == 0.0:
+            self.ts_arrival = time.time()
+        self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
+        if self.prompt_ids.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+            )
+
+    # ---- blocking result surface (caller threads) -------------------
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done_event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Block until terminal, then return a summary dict. Raises
+        ``TimeoutError`` if the request is still in flight after
+        ``timeout`` seconds."""
+        if not self._done_event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} still {self.state.value} after "
+                f"{timeout}s"
+            )
+        return self.summary()
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "state": self.state.value,
+            "tokens": list(self.tokens),
+            "n_tokens": len(self.tokens),
+            "error": self.error,
+            "metrics": self.timing(),
+        }
+
+    def timing(self) -> Dict[str, Optional[float]]:
+        """Per-request latency breakdown in milliseconds."""
+        def ms(a, b):
+            return None if a is None or b is None else round((b - a) * 1e3, 3)
+
+        return {
+            "queue_wait_ms": ms(self.ts_arrival, self.ts_admitted),
+            "ttft_ms": ms(self.ts_arrival, self.ts_first_token),
+            "decode_ms": ms(self.ts_first_token, self.ts_done),
+            "e2e_ms": ms(self.ts_arrival, self.ts_done),
+        }
+
+    # ---- scheduler-side helpers -------------------------------------
+    def expired(self, now: float) -> bool:
+        return self.deadline_ts is not None and now > self.deadline_ts
+
+    def finalize(self, state: RequestState,
+                 error: Optional[str] = None) -> None:
+        """Terminal transition (scheduler thread): idempotent — the
+        first terminal state wins."""
+        if self._done_event.is_set():
+            return
+        self.state = state
+        self.error = error
+        if self.ts_done is None:  # the scheduler stamps with ITS clock
+            self.ts_done = time.time()
+        self._done_event.set()

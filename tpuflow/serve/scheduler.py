@@ -1,0 +1,567 @@
+"""Slot-level continuous-batching scheduler — the serving control loop.
+
+Replaces wave-drain granularity (packaging.lm.generate_text's
+``serve_slots`` waves: a finished wave frees ALL its slots at once)
+with slot granularity: a fixed pool of decode slots per prompt-length
+bucket, where each finished row frees its slot at the next decode-
+SEGMENT boundary and the head of the queue prefills into it mid-flight
+(Orca-style iteration-level scheduling, expressed through the bucketed
+pad_lens machinery that keeps every shape compile-stable — see
+tpuflow.infer.generate's serve engine).
+
+One scheduler owns:
+
+- the **admission queue** — bounded; :meth:`submit` raises
+  :class:`~tpuflow.serve.request.QueueFull` with a retry-after hint
+  when it is at capacity (backpressure, mapped to HTTP 429 upstream);
+- **per-bucket slot pools** (created lazily) and the boundary loop:
+  sweep deadlines/cancellations → admit into freed slots → run one
+  decode segment → stream new tokens → harvest finished rows;
+- the **request lifecycle**: deadline expiry in queue AND mid-decode,
+  cancellation that frees the slot for immediate reuse, streaming
+  callbacks at segment boundaries, terminal events that unblock
+  ``Request.result()``.
+
+Determinism contract: a request's sampling stream id is its per-bucket
+admission index mod ``slots`` — exactly the physical row index the
+wave-drained path would have given it — and its logical RNG steps are
+pad-free, so the scheduler's outputs are TOKEN-IDENTICAL to
+``generate_text(..., serve_slots=slots, scheduler='wave')`` under
+pinned seeds (tests/test_serve.py pins this; greedy and sampled).
+
+Drive it either offline (``run_until_idle()`` on the calling thread —
+what ``generate_text(scheduler='slot')`` does) or online (``start()``
+spawns the scheduler thread; ``submit`` is thread-safe; the HTTP
+frontend in tpuflow.serve.http sits on top).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tpuflow.serve.metrics import ServeMetrics
+from tpuflow.serve.request import QueueFull, Request, RequestState
+from tpuflow.serve.slots import SlotPool
+
+
+class ServeScheduler:
+    """Online serving runtime over one model's decode slot pools.
+
+    Gauges publish process-wide under ``serve.*`` by default; a process
+    running SEVERAL schedulers (multi-model serving) should give each
+    its own namespace — ``metrics=ServeMetrics(gauge_prefix="serve.b")``
+    — or their occupancy/queue gauges overwrite each other last-writer-
+    wins in the shared obs registry."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        tokenizer=None,
+        *,
+        slots: int = 4,
+        seg: int = 8,
+        rounds: int = 3,
+        max_new_cap: int = 64,
+        max_queue: int = 64,
+        max_bucket: int = 1024,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+        metrics: Optional[ServeMetrics] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer
+        self.slots = int(slots)
+        self.seg = int(seg)
+        self.rounds = int(rounds)
+        self.max_new_cap = int(max_new_cap)
+        self.max_queue = int(max_queue)
+        self.max_bucket = int(max_bucket)
+        self.sampling = dict(temperature=float(temperature), top_k=top_k,
+                             top_p=top_p, eos_id=eos_id, seed=int(seed))
+        self.metrics = metrics or ServeMetrics()
+        self.clock = clock
+        self.pools: Dict[int, SlotPool] = {}
+        self._queues: Dict[int, Deque[Request]] = {}
+        self._admit_counts: Dict[int, int] = {}  # per-bucket stream-id source
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._closed = False
+
+    @classmethod
+    def from_packaged(cls, lm, **kwargs) -> "ServeScheduler":
+        """Build from a :class:`tpuflow.packaging.lm.PackagedLM` (or a
+        path/URI to one): model, params, bundled tokenizer, and the
+        packaged ``generate_defaults`` sampling knobs (explicit kwargs
+        win)."""
+        from tpuflow.packaging.lm import PackagedLM, load_packaged_lm
+
+        if isinstance(lm, str):
+            lm = load_packaged_lm(lm)
+        if not isinstance(lm, PackagedLM):
+            raise TypeError(
+                f"from_packaged needs a PackagedLM or path/URI, got "
+                f"{type(lm).__name__}"
+            )
+        defaults = dict(lm.generate_defaults)
+        defaults.pop("max_new_tokens", None)
+        for k in ("temperature", "top_k", "top_p", "eos_id", "seed"):
+            if k in defaults and k not in kwargs:
+                kwargs[k] = defaults[k]
+        return cls(lm.model, lm.params, tokenizer=lm.tokenizer, **kwargs)
+
+    # ---- admission (any thread) -------------------------------------
+    def _encode(self, prompt) -> np.ndarray:
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError(
+                    "string prompts need a tokenizer; submit token ids "
+                    "or construct the scheduler with one"
+                )
+            return np.asarray(self.tokenizer.encode(prompt), np.int32)
+        return np.asarray(prompt, np.int32).reshape(-1)
+
+    @staticmethod
+    def _retry_hint(depth: int) -> float:
+        """Backpressure hint: a segment's worth of work per queued
+        request ahead, floored — deliberately rough (the client just
+        needs a sane backoff, not a promise). THE single definition:
+        QueueFull and the public surface must never diverge."""
+        return max(0.1, 0.05 * depth)
+
+    def retry_after_s(self) -> float:
+        with self._lock:
+            depth = sum(len(q) for q in self._queues.values())
+        return self._retry_hint(depth)
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: Optional[int] = None,
+        *,
+        deadline_s: Optional[float] = None,
+        stream_cb: Optional[Callable[[Request, List[int], bool], None]] = None,
+        request_id: Optional[str] = None,
+    ) -> Request:
+        """Queue one request. Raises :class:`QueueFull` when the
+        admission queue is at capacity (backpressure), ``ValueError``
+        for requests that can never be served (prompt longer than the
+        largest bucket, budget beyond the pool horizon)."""
+        from tpuflow.packaging.lm import _bucket_len
+
+        ids = self._encode(prompt)
+        if max_new_tokens is None:
+            max_new_tokens = self.max_new_cap
+        if not 1 <= int(max_new_tokens) <= self.max_new_cap:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} outside [1, "
+                f"max_new_cap={self.max_new_cap}]"
+            )
+        bucket = _bucket_len(int(ids.size))
+        if bucket > self.max_bucket:
+            raise ValueError(
+                f"prompt of {ids.size} tokens needs bucket {bucket} > "
+                f"max_bucket {self.max_bucket}"
+            )
+        now = self.clock()
+        req = Request(
+            prompt_ids=ids, max_new_tokens=int(max_new_tokens),
+            id=request_id or "",
+            deadline_ts=None if deadline_s is None else now + deadline_s,
+            stream_cb=stream_cb,
+        )
+        req.ts_arrival = now
+        req.bucket = bucket
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler is stopped")
+            depth = sum(len(q) for q in self._queues.values())
+            if depth >= self.max_queue:
+                retry = self._retry_hint(depth)
+                self.metrics.on_reject(depth, retry)
+                raise QueueFull(depth, retry)
+            n = self._admit_counts.get(bucket, 0)
+            self._admit_counts[bucket] = n + 1
+            # the wave path's physical row index, reproduced: stream
+            # ids are what make slot outputs == wave outputs under
+            # sampling (see module docstring)
+            req.stream_id = n % self.slots
+            self._queues.setdefault(bucket, deque()).append(req)
+            self.metrics.on_queue_depth(depth + 1)
+            self._work.notify_all()
+        self.metrics.on_submit(req)
+        return req
+
+    def cancel(self, request: "Request | str") -> bool:
+        """Cancel by request or id. Queued requests finalize
+        immediately; running ones are evicted (slot freed) at the next
+        segment boundary. Returns False for unknown/already-terminal
+        requests. Best-effort for RUNNING requests: True means the
+        cancellation was REQUESTED — a request racing its final
+        harvest may still complete DONE with full output (terminal
+        transitions are deliberately taken outside the lock so client
+        callbacks cannot deadlock the decode loop; check
+        ``result()['state']`` for the outcome)."""
+        with self._lock:
+            req = None
+            if isinstance(request, Request):
+                req = request
+            else:
+                for q in self._queues.values():
+                    for r in q:
+                        if r.id == request:
+                            req = r
+                            break
+                if req is None:
+                    for pool in self.pools.values():
+                        for r in pool.occupants:
+                            if r is not None and r.id == request:
+                                req = r
+                                break
+            if req is None or req.state not in (RequestState.QUEUED,
+                                                RequestState.RUNNING):
+                return False
+            req.cancel_requested = True
+            q = self._queues.get(req.bucket)
+            was_queued = q is not None and req in q
+            if was_queued:
+                q.remove(req)
+            else:
+                self._work.notify_all()
+        # finalize OUTSIDE the lock: _finalize fires the client's
+        # stream_cb, and a callback that re-enters the scheduler
+        # (submit/cancel/retry_after_s all take the lock) must not
+        # deadlock the server — same discipline as step()
+        if was_queued:
+            self._finalize(req, RequestState.CANCELLED,
+                           "cancelled while queued")
+            return True
+        self.metrics.event(req.id, "cancel_requested")
+        return True
+
+    # ---- lifecycle internals (scheduler thread) ---------------------
+    def _finalize(self, req: Request, state: RequestState,
+                  error: Optional[str] = None) -> None:
+        if req.ts_done is None:
+            req.ts_done = self.clock()
+        req.finalize(state, error)
+        self.metrics.on_finish(req)
+        if state is not RequestState.DONE:
+            # non-DONE terminals never reach the harvest path's final
+            # stream event — emit it here so streaming clients unblock
+            self._stream(req, [], True)
+
+    def _stream(self, req: Request, new: List[int], finished: bool) -> None:
+        if req.stream_cb is None or (not new and not finished):
+            return
+        try:
+            req.stream_cb(req, new, finished)
+        except Exception as e:  # a client's callback must never be
+            # able to stall or kill the decode loop
+            self.metrics.event(req.id, "stream_cb_error", error=repr(e))
+
+    def prepare(self, *buckets: int) -> None:
+        """Pre-build AND pre-compile the slot pools for the given
+        prompt buckets: a throwaway request is joined, one segment is
+        decoded, and the pool is rewound — so the first real request
+        pays neither pool construction nor the join/segment compiles.
+        Call BEFORE opening the server to traffic: like
+        :meth:`run_until_idle`, it drives device state and must not
+        race the scheduler thread."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                "prepare() while the background thread is running "
+                "would race the device state; call it before start()"
+            )
+        for b in buckets:
+            pool = self._pool(int(b))
+            if pool.segments_run == 0 and not pool.has_live():
+                pool.join([(0, Request(prompt_ids=np.ones(1, np.int32),
+                                       max_new_tokens=1))])
+                pool.run_segment()
+                pool.evict(0)
+                pool.reset()
+
+    def _pool(self, bucket: int) -> SlotPool:
+        pool = self.pools.get(bucket)
+        if pool is None:
+            s = self.sampling
+            # build OUTSIDE the lock (construction allocates device
+            # buffers); only the scheduler thread creates pools, so no
+            # duplicate-build race — but the INSERT takes the lock
+            # because cancel()/idle()/metrics_snapshot() iterate this
+            # dict from HTTP handler threads
+            pool = SlotPool(
+                self.model, self.params, bucket, self.slots,
+                self.max_new_cap, seg=self.seg, rounds=self.rounds,
+                temperature=s["temperature"], top_k=s["top_k"],
+                top_p=s["top_p"], eos_id=s["eos_id"], seed=s["seed"],
+            )
+            with self._lock:
+                self.pools[bucket] = pool
+        return pool
+
+    def _sweep(self, pool: SlotPool, now: float) -> bool:
+        """Evict cancelled/expired running requests (slot freed for
+        immediate reuse)."""
+        progress = False
+        for slot, req in enumerate(pool.occupants):
+            if req is None:
+                continue
+            if req.cancel_requested:
+                pool.evict(slot)
+                self._finalize(req, RequestState.CANCELLED,
+                               "cancelled mid-decode")
+                progress = True
+            elif req.expired(now):
+                pool.evict(slot)
+                self._finalize(req, RequestState.EXPIRED,
+                               "deadline hit mid-decode")
+                progress = True
+        return progress
+
+    def step(self) -> bool:
+        """One boundary iteration over every bucket with work: sweep →
+        admit → decode one segment → stream/harvest. Returns whether
+        any progress was made (False = idle)."""
+        now = self.clock()
+        progress = False
+        with self._lock:
+            buckets = set(self._queues) | set(self.pools)
+            # deadline expiry MID-QUEUE (before any slot is spent on it)
+            expired: List[Request] = []
+            for b in buckets:
+                q = self._queues.get(b)
+                if not q or not any(
+                    r.cancel_requested or r.deadline_ts is not None
+                    for r in q
+                ):  # the common no-deadline case: skip the rebuild
+                    continue
+                keep: Deque[Request] = deque()
+                for req in q:
+                    if req.cancel_requested:
+                        expired.append(req)  # finalize outside as cancel
+                    elif req.expired(now):
+                        expired.append(req)
+                    else:
+                        keep.append(req)
+                self._queues[b] = keep
+        for req in expired:
+            state = (RequestState.CANCELLED if req.cancel_requested
+                     else RequestState.EXPIRED)
+            self._finalize(req, state, f"{state.value} while queued")
+            progress = True
+
+        for b in sorted(buckets):
+            with self._lock:
+                has_pending = bool(self._queues.get(b))
+            if not has_pending and b not in self.pools:
+                continue
+            pool = self._pool(b)
+            progress |= self._sweep(pool, now)
+            admits = []
+            with self._lock:
+                q = self._queues.get(b, deque())
+                # horizon exhausted + fully drained → rewind for the
+                # queue (a fresh round restores full admission room)
+                if (q and not pool.has_live()
+                        and not pool.can_admit(q[0].max_new_tokens)):
+                    pool.reset()
+                # admit: freed slots take the queue head(s), FIFO
+                free = pool.free_slots()
+                while free and q and pool.can_admit(q[0].max_new_tokens):
+                    req = q.popleft()
+                    admits.append((free.pop(0), req))
+                self.metrics.on_queue_depth(
+                    sum(len(x) for x in self._queues.values())
+                )
+            if admits:
+                pool.join(admits)
+                t_adm = self.clock()
+                for _slot, req in admits:
+                    req.state = RequestState.RUNNING
+                    req.ts_admitted = t_adm
+                    self.metrics.on_admit(req)
+                progress = True
+            if pool.has_live():
+                events, live = pool.run_segment()
+                seg_ts = self.clock()
+                for slot, req, new, finished in events:
+                    if new:
+                        req.tokens.extend(new)
+                    # `finished` with no tokens = the first sampled
+                    # token WAS the EOS: still a completed decode step,
+                    # so TTFT must be stamped (or the histogram would
+                    # silently drop exactly the fastest requests)
+                    if (new or finished) and req.ts_first_token is None:
+                        req.ts_first_token = seg_ts
+                        self.metrics.on_first_token(req)
+                    if finished:
+                        pool.evict(slot)
+                        self._finalize(req, RequestState.DONE)
+                    self._stream(req, new, finished)
+                self.metrics.on_segment(live, pool.slots)
+                progress = True
+        return progress
+
+    # ---- drive modes ------------------------------------------------
+    def run_until_idle(self) -> None:
+        """Offline drive: loop :meth:`step` on the calling thread until
+        no queued or running work remains."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                "run_until_idle() while the background thread is "
+                "running would race the device state"
+            )
+        while self.step():
+            pass
+
+    def idle(self) -> bool:
+        with self._lock:
+            if any(self._queues.values()):
+                return False
+            pools = list(self.pools.values())
+        return not any(p.has_live() for p in pools)
+
+    def start(self) -> None:
+        """Online drive: scheduler loop on a background thread (all
+        device work stays on that thread; ``submit``/``cancel`` are
+        thread-safe entry points)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._closed = False
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    progress = self.step()
+                except Exception as e:
+                    # the only thread that decodes must never die
+                    # silently (submit() would keep queueing into a
+                    # black hole): record the fault, fail everything
+                    # outstanding so result() waiters unblock with an
+                    # error, and keep serving later arrivals
+                    self.metrics.event("-scheduler-", "step_error",
+                                       error=repr(e))
+                    self._fail_outstanding(f"scheduler step failed: "
+                                           f"{type(e).__name__}: {e}")
+                    progress = False
+                if not progress:
+                    with self._work:
+                        self._work.wait(timeout=0.02)
+
+        self._thread = threading.Thread(target=loop, name="tpuflow-serve",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the loop. ``drain=True`` serves out queued+running work
+        first; ``drain=False`` cancels everything outstanding (their
+        ``result()`` unblocks with state CANCELLED)."""
+        with self._lock:
+            self._closed = True  # no new admissions either way
+        deadline = time.time() + timeout
+        started = self._thread is not None and self._thread.is_alive()
+        if started and drain:
+            while not self.idle() and time.time() < deadline:
+                time.sleep(0.01)
+        self._stop.set()
+        if started:
+            with self._work:
+                self._work.notify_all()
+            self._thread.join(timeout=max(0.1, deadline - time.time()))
+        # leftover finalization runs EVEN when the loop never started:
+        # requests queued before start() must still reach a terminal
+        # state or their result() waiters hang forever
+        self._fail_outstanding("scheduler stopped")
+
+    def _fail_outstanding(self, error: str) -> None:
+        """Drive every queued AND running request to a terminal state
+        (queues emptied, slots evicted) — shutdown and fault paths."""
+        leftovers: List[Request] = []
+        with self._lock:
+            for q in self._queues.values():
+                leftovers.extend(q)
+                q.clear()
+            pools = list(self.pools.values())
+        for pool in pools:
+            for slot, req in enumerate(pool.occupants):
+                if req is not None:
+                    pool.evict(slot)
+                    leftovers.append(req)
+        for req in leftovers:
+            self._finalize(req, RequestState.CANCELLED, error)
+
+    # ---- introspection ----------------------------------------------
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        snap = self.metrics.snapshot()
+        with self._lock:
+            pools = list(self.pools.items())
+        pfx = self.metrics.prefix  # honor per-scheduler namespacing
+        for b, pool in pools:
+            snap[f"{pfx}.pool{b}.t"] = float(pool.t)
+            snap[f"{pfx}.pool{b}.live"] = float(pool.live_count())
+            snap[f"{pfx}.pool{b}.rounds"] = float(pool.rounds_started)
+        return snap
+
+
+def serve_texts(
+    packaged_lm,
+    prompts: Sequence[str],
+    max_new_tokens: int,
+    serve_slots: int,
+    *,
+    seg: int = 8,
+    rounds: int = 1,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
+    seed: int = 0,
+) -> List[str]:
+    """Offline text frontend over the slot scheduler — what
+    ``PackagedLM.generate_text(serve_slots=..., scheduler='slot')``
+    routes through. Returns prompt+continuation strings in input order,
+    token-identical to the wave-drained path under the same seed."""
+    tok = packaged_lm._require_tokenizer()
+    # rounds=1: an offline drain rewinds its horizon for free between
+    # rounds (reset() is bookkeeping, not device work), so the extra
+    # decode room a long-lived server buys with rounds>1 would only
+    # inflate every KV buffer (and each decode step's attention span)
+    # ~rounds-fold for nothing here
+    sched = ServeScheduler(
+        packaged_lm.model, packaged_lm.params, tokenizer=tok,
+        slots=serve_slots, seg=seg, rounds=rounds,
+        max_new_cap=max_new_tokens, max_queue=max(1, len(prompts)),
+        temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
+        seed=seed,
+    )
+    reqs = [sched.submit(p, max_new_tokens) for p in prompts]
+    sched.run_until_idle()
+    out = []
+    for req in reqs:
+        if req.state is not RequestState.DONE:  # pragma: no cover
+            raise RuntimeError(
+                f"request {req.id} ended {req.state.value}: {req.error}"
+            )
+        full = np.concatenate([req.prompt_ids,
+                               np.asarray(req.tokens, np.int32)])
+        out.append(tok.decode(full).decode("utf-8", "replace"))
+    return out

@@ -1,0 +1,217 @@
+"""Slot pool: the device-state half of slot-level continuous batching.
+
+One :class:`SlotPool` owns the fixed-shape decode state for a (prompt
+bucket, slot count) pair — KV cache, token buffer, and the per-row
+bookkeeping vectors — and drives it through the two compiled
+executables from :mod:`tpuflow.infer.generate`:
+
+- ``join``: admit requests into freed rows at a segment boundary via a
+  per-slot prefill merged into the shared cache;
+- ``segment``: advance ALL rows ``seg`` decode steps, then hand the
+  newly written token block back to the host.
+
+The pool is deliberately policy-free: WHICH requests join, deadline and
+cancellation sweeps, and metric accounting live in
+:mod:`tpuflow.serve.scheduler`. Everything here is shape discipline:
+
+- segments stay on the grid ``t ∈ {bucket-1 + k·seg}`` and never run
+  past ``length-1`` (``lax.dynamic_update_slice`` clamps out-of-range
+  writes, so an unaligned tail would silently corrupt the last column);
+  the horizon is therefore rounded UP to whole segments at build time;
+- a request may join at boundary ``t`` only if its whole budget fits
+  the remaining horizon (``t + max_new <= length-1``);
+- when the horizon is exhausted and every row has drained, ``reset()``
+  rewinds to a fresh round WITHOUT zeroing device buffers — stale KV
+  is unreachable by construction (masked below each row's pads, and
+  above the live cache index).
+
+NOT thread-safe: exactly one thread (the scheduler's) may touch a pool.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from tpuflow.serve.request import Request
+
+
+class SlotPool:
+    """Fixed pool of decode slots over one shared KV cache."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        bucket: int,
+        slots: int,
+        max_new_cap: int,
+        seg: int = 8,
+        rounds: int = 3,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ):
+        import jax
+
+        from tpuflow.infer.generate import (
+            serve_join_fn,
+            serve_pool_arrays,
+            serve_segment_fn,
+        )
+
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_new_cap < 1:
+            raise ValueError(f"max_new_cap must be >= 1, got {max_new_cap}")
+        if rounds < 1:
+            raise ValueError(f"rounds must be >= 1, got {rounds}")
+        self.bucket = int(bucket)
+        self.slots = int(slots)
+        self.seg = max(1, int(seg))
+        self.max_new_cap = int(max_new_cap)
+        # decode horizon: ``rounds`` budgets of room past the bucket,
+        # rounded up to whole segments so the step grid ends exactly at
+        # length-1 (the no-clamped-writes invariant)
+        decode_room = math.ceil(rounds * self.max_new_cap / self.seg) * self.seg
+        self.length = self.bucket + decode_room
+        self.eos_id = eos_id
+        self.params = params
+        self._rng = jax.random.key(int(seed))
+        self._join = serve_join_fn(model, self.slots, self.length, self.bucket)
+        self._segment = serve_segment_fn(
+            model, self.slots, self.length, self.seg, float(temperature),
+            top_k, top_p, eos_id,
+        )
+        self.cache, self.out = serve_pool_arrays(model, self.slots,
+                                                 self.length)
+        self.pad_lens = np.zeros((self.slots,), np.int32)
+        self.stream_ids = np.zeros((self.slots,), np.int32)
+        self.last_pos = np.zeros((self.slots,), np.int32)
+        self.done = np.ones((self.slots,), bool)
+        self.occupants: List[Optional[Request]] = [None] * self.slots
+        self.t = self.bucket - 1
+        self.rounds_started = 0
+        self.segments_run = 0
+
+    # ---- capacity queries ------------------------------------------
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.occupants) if r is None]
+
+    def has_live(self) -> bool:
+        return any(r is not None for r in self.occupants)
+
+    def live_count(self) -> int:
+        return sum(r is not None for r in self.occupants)
+
+    def can_admit(self, max_new_tokens: int) -> bool:
+        """Whether a request with this budget can join at the CURRENT
+        boundary and still finish inside the horizon."""
+        return (max_new_tokens <= self.max_new_cap
+                and self.t + max_new_tokens <= self.length - 1)
+
+    def can_step(self) -> bool:
+        return self.t + self.seg <= self.length - 1
+
+    def reset(self) -> None:
+        """Start a fresh round (only valid with every slot free). The
+        device buffers are NOT zeroed: stale KV/tokens are masked out
+        of every attention read and never re-read by the host."""
+        if self.has_live():
+            raise RuntimeError("reset() with occupied slots would drop "
+                               "in-flight requests")
+        self.t = self.bucket - 1
+        self.done[:] = True
+        self.last_pos[:] = 0
+        self.rounds_started += 1
+
+    # ---- the two device transitions --------------------------------
+    def join(self, admits: List[Tuple[int, Request]]) -> None:
+        """Admit ``(slot, request)`` pairs at the current boundary: one
+        per-slot prefill pass, merged into the live cache only for the
+        joining rows."""
+        import jax.numpy as jnp
+
+        if not admits:
+            return
+        prompts = np.zeros((self.slots, self.bucket), np.int32)
+        mask = np.zeros((self.slots,), bool)
+        for slot, req in admits:
+            if self.occupants[slot] is not None:
+                raise RuntimeError(f"slot {slot} is occupied")
+            p = int(req.prompt_ids.size)
+            if not 1 <= p <= self.bucket:
+                raise ValueError(
+                    f"prompt length {p} outside (0, bucket={self.bucket}]"
+                )
+            if not self.can_admit(req.max_new_tokens):
+                raise RuntimeError(
+                    f"request {req.id} (max_new={req.max_new_tokens}) "
+                    f"does not fit the horizon at t={self.t}"
+                )
+            prompts[slot, self.bucket - p:] = req.prompt_ids
+            mask[slot] = True
+            self.pad_lens[slot] = self.t - p + 1
+            self.stream_ids[slot] = req.stream_id
+            self.last_pos[slot] = self.t + req.max_new_tokens
+            self.done[slot] = False
+            self.occupants[slot] = req
+            req.slot = slot
+        self.cache, self.out = self._join(
+            self.params, self.cache, self.out, jnp.asarray(self.pad_lens),
+            jnp.asarray(prompts), jnp.asarray(mask), self.t,
+        )
+
+    def evict(self, slot: int) -> Optional[Request]:
+        """Free a slot WITHOUT waiting for its row to finish
+        (cancellation / deadline expiry): the row is marked done so the
+        next segment stops sampling it, and the slot is immediately
+        joinable."""
+        req = self.occupants[slot]
+        self.occupants[slot] = None
+        self.done[slot] = True
+        self.last_pos[slot] = 0
+        return req
+
+    def run_segment(self):
+        """Advance ``seg`` steps. Returns ``(events, live_before)``
+        where events is ``[(slot, request, new_token_ids, finished)]``
+        per occupied slot (``new_token_ids`` excludes the EOS token and
+        anything past the request's budget — the text-surface trimming
+        contract of packaging.lm.generate_text)."""
+        import jax.numpy as jnp
+
+        if not self.can_step():
+            raise RuntimeError(
+                f"segment would overrun the horizon (t={self.t}, "
+                f"seg={self.seg}, length={self.length})"
+            )
+        t0 = self.t
+        live_before = self.live_count()
+        self.cache, self.out, done_dev, toks = self._segment(
+            self.params, self.cache, self.out, jnp.asarray(self.done),
+            jnp.asarray(self.pad_lens), jnp.asarray(self.stream_ids),
+            jnp.asarray(self.last_pos), self._rng, t0,
+        )
+        self.t = t0 + self.seg
+        self.segments_run += 1
+        was_done = self.done
+        self.done = np.array(done_dev)
+        toks = np.asarray(toks)
+        events = []
+        for slot, req in enumerate(self.occupants):
+            if req is None or was_done[slot]:
+                continue
+            budget = int(self.last_pos[slot]) - t0  # row steps remaining
+            new: List[int] = []
+            finished = bool(self.done[slot])
+            for tok in toks[slot][: max(0, min(self.seg, budget))]:
+                if self.eos_id is not None and int(tok) == self.eos_id:
+                    break
+                new.append(int(tok))
+            events.append((slot, req, new, finished))
+        return events, live_before
