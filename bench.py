@@ -478,8 +478,9 @@ def _run_timing(args, jax, step1, state, rtt_ms, make_record,
     # at least one warmup step always runs: its scalar fetch is the sync
     # anchor that keeps prior work out of the timed window (and --warmup 0
     # would otherwise leave `loss` unbound)
-    state, loss = step1(state)
-    float(loss)
+    with _phase_span("bench.warmup"):
+        state, loss = step1(state)
+        float(loss)
     # FIRST provisional lands right here — one step after compile, so a
     # watchdog fired any later reports a real (if RTT-inflated) number
     # instead of 0.0 (VERDICT r03: three rounds of dead driver benches)
@@ -497,9 +498,10 @@ def _run_timing(args, jax, step1, state, rtt_ms, make_record,
         state, loss = step1(state)
     float(loss)
     t0 = time.time()
-    for _ in range(args.steps):
-        state, loss = step1(state)
-    last_loss = float(loss)
+    with _phase_span("bench.timed_loop", steps=args.steps):
+        for _ in range(args.steps):
+            state, loss = step1(state)
+        last_loss = float(loss)
     dt_loop = (time.time() - t0) / args.steps
 
     value, vs, diag = make_record(dt_loop, "loop_fetch", dt_loop, last_loss)
@@ -521,18 +523,21 @@ def _run_timing(args, jax, step1, state, rtt_ms, make_record,
             return jax.lax.scan(body, s, None, length=K)
 
         t0 = time.time()
-        state, losses = _many(state)
-        last_loss = float(losses[-1])
+        with _phase_span("bench.scan_compile"):
+            state, losses = _many(state)
+            last_loss = float(losses[-1])
         scan_compile_s = time.time() - t0
 
         def run(m):
             nonlocal state, last_loss
             t0 = time.time()
-            for _ in range(m):
-                # async dispatch, carry chained on-device; ONE scalar
-                # fetch at the end pays the relay RTT once for m scans
-                state, losses = _many(state)
-            last_loss = float(losses[-1])
+            with _phase_span("bench.timed_scan", scans=m, k=K):
+                for _ in range(m):
+                    # async dispatch, carry chained on-device; ONE
+                    # scalar fetch at the end pays the relay RTT once
+                    # for m scans
+                    state, losses = _many(state)
+                last_loss = float(losses[-1])
             return time.time() - t0
 
         # corrected totals never under-subtract (the cap), so each
@@ -566,6 +571,43 @@ def _run_timing(args, jax, step1, state, rtt_ms, make_record,
     return state, dt, method, dt_loop, last_loss
 
 
+def _phase_span(name: str, **attrs):
+    """A tpuflow.obs.trace span, exception-proof: a broken obs import
+    must never take the bench down (the artifact contract)."""
+    try:
+        from tpuflow.obs import trace
+
+        return trace.span(name, **attrs)
+    except Exception:
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def _enable_span_tracer() -> None:
+    """Child-side: turn the span tracer on so every capture's
+    diagnostics carry per-phase span totals (ISSUE 4)."""
+    try:
+        from tpuflow.obs import trace
+
+        trace.enable()
+    except Exception as e:
+        print(f"# span tracer unavailable: {e}", file=sys.stderr,
+              flush=True)
+
+
+def _span_totals() -> dict:
+    """Per-phase span totals (ms) captured so far — bench's own
+    bench.* phases plus whatever the driven subsystem emitted
+    (train.*, serve.*, infer.compile_miss...). {} when disabled."""
+    try:
+        from tpuflow.obs import trace
+
+        return trace.phase_totals_ms()
+    except Exception:
+        return {}
+
+
 def _base_diag(dt, method, dt_loop, last_loss, *, flops, n_chips, peak,
                rtt_ms, compile_s, devices, extras):
     """Shared diagnostics-record builder (the image and lm paths add
@@ -592,6 +634,9 @@ def _base_diag(dt, method, dt_loop, last_loss, *, flops, n_chips, peak,
         "timing_method": method,
         "step_ms_loop": round(dt_loop * 1e3, 3),
         "host_dispatches_per_step": round(1.0 / scan_k, 4),
+        # per-phase host-span totals (tpuflow.obs.trace) — where the
+        # capture's wall clock went, next to the dispatch accounting
+        "span_totals_ms": _span_totals(),
         "dispatch_floor_ms": round(floor_ms, 3),
         "dispatch_bound": bool(dt * 1e3 < floor_ms),
         "rtt_ms": round(rtt_ms, 1),
@@ -1198,6 +1243,10 @@ def main() -> int:
         return _supervise(args)
     _PROGRESS_PATH = args.progress_file
     _progress({"phase": "start", "mode": _MODE})
+    # child side: span tracer on, so every capture's diagnostics carry
+    # per-phase host-span totals (bench.* phases + the driven
+    # subsystem's train.*/serve.*/infer.* spans) — ISSUE 4
+    _enable_span_tracer()
 
     if args.smoke:
         # FORCE cpu — the ambient env may pin JAX_PLATFORMS to a TPU
@@ -1658,6 +1707,7 @@ def _bench_e2e(args, devices) -> int:
                 "compile_s": round(compile_s, 1),
                 "rtt_ms": round(rtt_ms, 1),
                 "host_cpus": os.cpu_count(),
+                "span_totals_ms": _span_totals(),
             }
             if len(rates) > 1:
                 d["cached_img_per_s_chip"] = round(max(rates[1:]), 1)
@@ -2079,6 +2129,7 @@ def _bench_superstep(args, devices) -> int:
             "host_dispatches_per_step": round(1.0 / K, 4),
             "dispatch_overhead_ms_per_call": round(overhead_ms, 3),
             "dispatch_bound": bool(step_super_ms < overhead_ms),
+            "span_totals_ms": _span_totals(),
         }
         value = global_batch * steps / wall_super / n_chips
         vs = wall_loop / max(wall_super, 1e-9)
@@ -2200,6 +2251,7 @@ def _bench_decode(args, devices) -> int:
                      + (f"kv{args.kv_heads}" if args.kv_heads else ""),
             "rtt_ms": round(rtt_ms, 1),
             "shapes": per_shape,
+            "span_totals_ms": _span_totals(),
         }
         tok_s = rec["blockwise"]["tok_s_per_chip"]
         speedup = rec["speedup"]
@@ -2561,6 +2613,7 @@ def _bench_serve(args, devices) -> int:
         "wave": wave_rec,
         "tok_s_ratio": round(tok_ratio, 3),
         "p95_ttft_ratio": round(ttft_ratio, 3),
+        "span_totals_ms": _span_totals(),
     }
     rec = {
         "metric": "serve_useful_tokens_per_sec",
@@ -2685,6 +2738,7 @@ def _bench_generate(args, devices) -> int:
             "roofline_steps_per_s": round(roofline_steps, 1),
             "rtt_ms": round(rtt_ms, 1),
             "compile_s": round(compile_s, 1),
+            "span_totals_ms": _span_totals(),
         }
         _set_provisional(
             value=tok_s, vs_baseline=util, diagnostics=diag,

@@ -1,9 +1,19 @@
-"""Monitoring & optimization: profiler traces, system metrics, MFU.
+"""Monitoring & optimization: spans, histograms, traces, metrics, MFU.
 
 ≙ P1/04_monitoring_and_optimization.py (prose-only in the reference:
 Ganglia dashboards + scale-up/scale-out guidance) plus the
-Horovod-Timeline hook (P1/03:407-409). tpuflow makes both executable:
+Horovod-Timeline hook (P1/03:407-409). tpuflow makes both executable,
+and ISSUE 4 unified them into one observability plane:
 
+- ``obs.trace`` — the structured span tracer: ``span(name, **attrs)``
+  around host work, near-zero overhead when disabled, Chrome-trace
+  export (``export_chrome_trace``) loadable in Perfetto alongside the
+  jax.profiler capture below;
+- ``obs.report`` — the step-time breakdown (host-dispatch vs device vs
+  data-wait fractions) from those spans; same output as
+  ``python -m tpuflow.cli.obs report <export.json>``;
+- ``obs.gauges`` — fixed-bucket histograms: ``observe(name, value)``
+  with p50/p95/p99 merged into every snapshot;
 - ``obs.profiler.trace`` wraps N steps in a jax.profiler capture
   (Perfetto/TensorBoard — the Horovod Timeline equivalent),
 - ``obs.sysmetrics.sample_system_metrics`` samples host CPU/mem and
@@ -27,8 +37,10 @@ import numpy as np
 
 def main(workdir: str) -> None:
     from tpuflow.models import build_model
+    from tpuflow.obs import report, trace
+    from tpuflow.obs.gauges import observe, snapshot_gauges
     from tpuflow.obs.mfu import device_peak_flops, flops_of_jitted
-    from tpuflow.obs.profiler import trace
+    from tpuflow.obs.profiler import trace as profiler_trace
     from tpuflow.obs.sysmetrics import sample_system_metrics
 
     model = build_model(num_classes=5, dropout=0.5, width_mult=0.25)
@@ -40,16 +52,52 @@ def main(workdir: str) -> None:
     peak = device_peak_flops(jax.devices()[0])
     print(f"forward flops/step = {flops:.3e}; device peak = {peak:.3e} FLOP/s")
 
+    # ---- span tracing (ISSUE 4): where does each step's time go? ----
+    # The trainers/serving runtime emit these spans themselves (phases:
+    # data_wait / dispatch / device / ...); a raw loop instruments the
+    # same way. Disabled (the default) a span costs one flag check.
+    trace.enable()
+    import time
+
+    for step in range(3):
+        with trace.span("demo.step", step=step):  # wrapper: no phase
+            with trace.span("demo.data_wait", phase="data_wait"):
+                batch = np.zeros((8, 64, 64, 3), np.float32)
+            with trace.span("demo.dispatch", phase="dispatch"):
+                out = fwd(variables, jnp.asarray(batch))
+            t0 = time.perf_counter()
+            with trace.span("demo.device", phase="device"):
+                out.block_until_ready()
+            # latency histogram: fixed buckets, p50/p95/p99 in snapshots
+            observe("demo.step_ms", (time.perf_counter() - t0) * 1e3)
+
+    export = trace.export_chrome_trace(
+        os.path.join(workdir, "host_spans.json"))
+    print(f"host-span chrome trace -> {export} "
+          "(open in Perfetto; or: python -m tpuflow.cli.obs trace "
+          f"{export})")
+
+    # the step-time breakdown those spans answer (also:
+    # `python -m tpuflow.cli.obs report <export>`)
+    print(report.format_report(report.step_breakdown(prefix="demo.")))
+    hist = {k: round(v, 3)
+            for k, v in snapshot_gauges("demo.step_ms").items()}
+    print(f"step-latency histogram summary: {hist}")
+    trace.disable()
+
+    # ---- the device-side twin: a jax.profiler capture ----
     logdir = os.path.join(workdir, "profile")
-    with trace(logdir):
+    with profiler_trace(logdir):
         for _ in range(3):
             fwd(variables, x).block_until_ready()
     print(f"profiler trace written under {logdir} "
-          "(open in TensorBoard / Perfetto)")
+          "(open in TensorBoard / Perfetto — XLA op attribution via "
+          f"tools/trace_top_ops.py {logdir})")
 
     metrics = sample_system_metrics()
     for k in sorted(metrics):
         print(f"  {k} = {metrics[k]:.3f}")
+    print("monitoring example OK")
 
 
 if __name__ == "__main__":

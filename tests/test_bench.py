@@ -47,8 +47,12 @@ def test_smoke_emits_schema():
     d = rec["diagnostics"]
     for key in ("step_ms", "timing_method", "mfu", "flops_per_step",
                 "rtt_ms", "loss", "host_dispatches_per_step",
-                "dispatch_bound", "dispatch_floor_ms"):
+                "dispatch_bound", "dispatch_floor_ms", "span_totals_ms"):
         assert key in d, key
+    # the child enables the span tracer, so the capture carries real
+    # per-phase totals (ISSUE 4): at least the bench timing phases
+    assert any(k.startswith("bench.") for k in d["span_totals_ms"]), d[
+        "span_totals_ms"]
 
 
 @pytest.mark.slow
@@ -286,8 +290,13 @@ def test_base_diag_dispatch_fields():
         )
         return rec
 
-    # scan headline: 30 steps rode one dispatch
+    # every capture carries the per-phase span-total accounting next to
+    # the dispatch fields (ISSUE 4 satellite) — a dict even when the
+    # tracer is off (empty), so consumers never key-error
     rec = diag(0.002, "scan30", 0.005)
+    assert isinstance(rec["span_totals_ms"], dict)
+
+    # scan headline: 30 steps rode one dispatch
     assert rec["host_dispatches_per_step"] == round(1 / 30, 4)
     # floor = loop-minus-scan overhead (3 ms) > 2 ms step ⇒ dispatch-bound
     assert rec["dispatch_floor_ms"] == 3.0

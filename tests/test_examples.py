@@ -72,6 +72,24 @@ def test_train_distributed_example(tmp_path):
 
 
 @pytest.mark.slow
+def test_monitoring_example(tmp_path):
+    """Example 04 demos the ISSUE 4 observability plane end-to-end:
+    spans -> breakdown report -> chrome export -> histogram snapshot."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "04_monitoring.py"),
+         str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "step-time breakdown" in r.stdout
+    assert "demo.step_ms_p50" in r.stdout
+    assert "monitoring example OK" in r.stdout
+    assert os.path.exists(os.path.join(str(tmp_path), "host_spans.json"))
+
+
+@pytest.mark.slow
 def test_long_context_example():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

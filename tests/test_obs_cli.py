@@ -15,8 +15,8 @@ from tpuflow.obs import (
     flops_of_jitted,
     mfu,
     sample_system_metrics,
-    trace,
 )
+from tpuflow.obs.profiler import trace
 from tpuflow.obs.mfu import mobilenet_v2_flops
 
 

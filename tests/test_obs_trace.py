@@ -1,0 +1,447 @@
+"""The obs package's own coverage (ISSUE 4 satellite).
+
+Pins the tracer's core contracts — disabled no-op (including the
+overhead guard that keeps instrumentation out of the hot-path budget),
+span nesting/ids within and across threads, ring bounding, Chrome
+export round-trip — plus the fixed-bucket histogram against a numpy
+reference, gauge prefix filtering, the step-time breakdown, the
+sysmetrics CPU-sampler thread-safety fix, and the obs CLI.
+
+Everything here is host-only and fast (tier-1); the traced
+train+serve acceptance run rides the slow tier
+(test_traced_train_and_serve_chrome_export).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpuflow.obs import report
+from tpuflow.obs import trace
+from tpuflow.obs.gauges import (
+    Histogram,
+    clear_gauges,
+    observe,
+    snapshot_gauges,
+)
+
+
+@pytest.fixture
+def tracer():
+    trace.enable(capacity=4096)
+    yield trace
+    trace.disable()
+    trace.clear()
+
+
+# ---------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------
+
+def test_disabled_tracer_is_noop():
+    assert not trace.is_enabled()
+    with trace.span("nope", a=1) as s:
+        assert s is None  # shared no-op cm yields None
+    assert trace.snapshot() == []
+    assert trace.begin("nope") is None
+    trace.end(None)  # must not raise
+    assert trace.phase_totals_ms() == {}
+    assert trace.current_trace_id() is None
+
+
+def test_disabled_tracer_overhead_guard():
+    """The tier-1 tripwire behind 'instrumentation stays in production
+    code': a DISABLED span() on a tight loop must cost <2% (or under
+    2µs/iteration absolute — the flake guard for this contended CI
+    box; the relative bound is the contract, the absolute bound only
+    forgives scheduler noise, not a slow no-op path)."""
+    assert not trace.is_enabled()
+    work = list(range(5000))  # ~tens of µs of real work per iteration
+
+    def plain(n):
+        acc = 0
+        for _ in range(n):
+            acc += sum(work)
+        return acc
+
+    def instrumented(n):
+        acc = 0
+        for _ in range(n):
+            with trace.span("guard.iter", phase="dispatch"):
+                acc += sum(work)
+        return acc
+
+    def best(fn, n, reps=9):
+        fn(10)  # warm
+        ts = []
+        for _ in range(reps):
+            # CPU time, not wall time: this box runs contended (the
+            # tier-1 suite itself has hit its wall budget purely from
+            # background load, CHANGES.md PR 2) and a descheduled
+            # wall-clock window would measure the scheduler, not the
+            # tracer
+            t0 = time.process_time()
+            fn(n)
+            ts.append(time.process_time() - t0)
+        return min(ts)
+
+    n = 100
+    tp = best(plain, n)
+    ti = best(instrumented, n)
+    per_iter_ns = max(0.0, (ti - tp) / n * 1e9)
+    assert ti <= tp * 1.02 or per_iter_ns < 2000, (
+        f"disabled tracer overhead too high: plain {tp * 1e3:.2f}ms vs "
+        f"instrumented {ti * 1e3:.2f}ms ({per_iter_ns:.0f}ns/iter)"
+    )
+
+
+# ---------------------------------------------------------------------
+# enabled path: ids, nesting, threads, bounding
+# ---------------------------------------------------------------------
+
+def test_span_nesting_ids_and_attrs(tracer):
+    with trace.span("outer", phase="dispatch", k=3) as so:
+        assert trace.current_trace_id() == so.trace
+        with trace.span("inner") as si:
+            assert si.parent == so.span
+            assert si.trace == so.trace
+    assert trace.current_trace_id() is None
+    inner, outer = trace.snapshot()  # finish order: inner first
+    assert (inner["name"], outer["name"]) == ("inner", "outer")
+    assert inner["parent"] == outer["span"]
+    assert outer["attrs"] == {"phase": "dispatch", "k": 3}
+    assert outer["dur_ms"] >= inner["dur_ms"] >= 0.0
+    # sibling top-level spans get distinct trace ids
+    with trace.span("a"):
+        pass
+    with trace.span("b"):
+        pass
+    a, b = trace.snapshot()[-2:]
+    assert a["trace"] != b["trace"]
+
+
+def test_cross_thread_begin_end(tracer):
+    """The serving idiom: begin on the submitting thread with an
+    explicit trace id (the request id), end on the scheduler thread."""
+    s = trace.begin("serve.queue", trace_id="req-x", phase="queue")
+
+    def worker():
+        time.sleep(0.005)
+        trace.end(s, slot=0)
+
+    t = threading.Thread(target=worker, name="sched-thread")
+    t.start()
+    t.join()
+    spans = trace.spans_for("req-x")
+    assert len(spans) == 1
+    assert spans[0]["dur_ms"] >= 5.0
+    assert spans[0]["attrs"]["slot"] == 0
+    # end() is idempotent — a second end must not double-record
+    trace.end(s)
+    assert len(trace.spans_for("req-x")) == 1
+    # spans from a worker thread carry that thread's track
+    def spawn():
+        with trace.span("in-thread"):
+            pass
+    t2 = threading.Thread(target=spawn, name="obs-worker")
+    t2.start()
+    t2.join()
+    rec = trace.snapshot(name="in-thread")[0]
+    assert rec["thread"] == "obs-worker"
+
+
+def test_ring_buffer_is_bounded():
+    trace.enable(capacity=16)
+    try:
+        for i in range(40):
+            with trace.span("r", i=i):
+                pass
+        spans = trace.snapshot()
+        assert len(spans) == 16
+        # newest kept, oldest dropped
+        assert [s["attrs"]["i"] for s in spans] == list(range(24, 40))
+    finally:
+        trace.disable()
+        trace.clear()
+
+
+# ---------------------------------------------------------------------
+# export / report round-trip
+# ---------------------------------------------------------------------
+
+def test_chrome_export_roundtrips_through_json(tracer, tmp_path):
+    with trace.span("train.dispatch", phase="dispatch"):
+        time.sleep(0.002)
+    with trace.span("train.data_wait", phase="data_wait", k=np.int32(4)):
+        pass
+    path = trace.export_chrome_trace(str(tmp_path / "t.json"))
+    with open(path) as f:
+        doc = json.load(f)  # the round-trip contract: valid JSON
+    evs = doc["traceEvents"]
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in evs)
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in evs)
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"train.dispatch",
+                                       "train.data_wait"}
+    for e in xs:
+        assert e["ts"] > 0 and e["dur"] >= 0
+        assert "trace_id" in e["args"] and "span_id" in e["args"]
+    # numpy attrs were coerced to JSON scalars
+    dw = [e for e in xs if e["name"] == "train.data_wait"][0]
+    assert dw["args"]["k"] == 4 and isinstance(dw["args"]["k"], int)
+    # and the report loader recovers the same spans
+    spans = report.spans_from_events(report.load_trace_events(path))
+    assert {s["name"] for s in spans} == {"train.dispatch",
+                                          "train.data_wait"}
+    # directory search also finds the export
+    assert report.load_trace_events(str(tmp_path))
+    # tools/trace_top_ops must NOT tabulate host spans as device ops:
+    # a pure span export (its process lane is "... host spans") yields
+    # the empty summary, pointing users at the cli.obs host-span tools
+    from tools.trace_top_ops import summarize
+
+    assert summarize(path) == {}
+
+
+def test_step_breakdown_phases(tracer):
+    with trace.span("train.epoch", epoch=0):  # wrapper: NO phase attr
+        with trace.span("train.data_wait", phase="data_wait"):
+            time.sleep(0.004)
+        with trace.span("train.dispatch", phase="dispatch"):
+            time.sleep(0.008)
+    bd = report.step_breakdown(prefix="train.")
+    assert bd["n_spans"] == 3
+    ph = bd["phases"]
+    assert ph["dispatch"]["ms"] > ph["data_wait"]["ms"] > 0
+    # wrapper spans don't enter the fraction table; the window
+    # remainder is 'untracked'; fractions stay <= 1
+    assert "train.epoch" not in ph
+    tracked = sum(v["frac"] for v in ph.values())
+    assert 0.9 <= tracked <= 1.01
+    assert trace.phase_totals_ms("train.")["train.dispatch"] >= 8.0
+
+    # overlapping SAME-phase spans (concurrent serving requests all
+    # queued at once): frac comes from the interval UNION — "some
+    # request was queued X% of the window", never >100% — while ms
+    # keeps the summed span-time
+    trace.clear()
+    qs = [trace.begin("serve.queue", trace_id=f"r{i}", phase="queue")
+          for i in range(8)]
+    time.sleep(0.01)
+    for q in qs:
+        trace.end(q)
+    bd = report.step_breakdown(prefix="serve.")
+    q = bd["phases"]["queue"]
+    assert q["n"] == 8
+    assert q["ms"] >= 8 * 10 * 0.9  # summed: ~8 x 10ms of span-time
+    assert q["frac"] <= 1.0  # union: the window was covered once
+
+
+def test_obs_cli_trace_and_report(tracer, tmp_path, capsys):
+    from tpuflow.cli.obs import main
+
+    with trace.span("serve.decode_segment", phase="decode"):
+        time.sleep(0.002)
+    path = trace.export_chrome_trace(str(tmp_path / "cli.json"))
+    assert main(["trace", path, "--top", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "serve.decode_segment" in out and "total_ms" in out
+    assert main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert "decode" in out and "%" in out
+    assert main(["report", str(tmp_path / "missing.json")]) == 1
+
+
+# ---------------------------------------------------------------------
+# histograms / gauges
+# ---------------------------------------------------------------------
+
+def test_histogram_percentiles_vs_numpy():
+    rng = np.random.default_rng(7)
+    for dist in (rng.lognormal(3.0, 1.2, 4000),
+                 rng.uniform(0.5, 500.0, 4000)):
+        h = Histogram()
+        for v in dist:
+            h.observe(v)
+        assert len(h) == len(dist)
+        assert h.mean() == pytest.approx(float(np.mean(dist)), rel=1e-6)
+        for p in (50, 90, 95, 99):
+            ref = float(np.percentile(dist, p))
+            got = h.percentile(p)
+            # fixed 2**(1/8) buckets + in-bucket interpolation: well
+            # inside one bucket width of the exact percentile
+            assert got == pytest.approx(ref, rel=0.1), (p, ref, got)
+    # empty + single-sample edges
+    h = Histogram()
+    assert h.percentile(50) is None and h.percentiles() == {}
+    h.observe(42.0)
+    assert h.percentile(50) == pytest.approx(42.0)
+    assert h.percentiles() == {"p50": pytest.approx(42.0),
+                               "p95": pytest.approx(42.0),
+                               "p99": pytest.approx(42.0)}
+
+
+def test_histogram_merge():
+    a, b = Histogram(), Histogram()
+    for v in (1.0, 2.0, 3.0):
+        a.observe(v)
+    for v in (100.0, 200.0):
+        b.observe(v)
+    a.merge(b)
+    assert len(a) == 5
+    assert a.percentile(99) == pytest.approx(200.0, rel=0.1)
+    assert a.percentile(1) == pytest.approx(1.0, rel=0.1)
+    # reset(): the windowed-percentile hook for long-lived servers —
+    # cumulative state fully dropped, fresh observations dominate
+    a.reset()
+    assert len(a) == 0 and a.percentile(50) is None
+    a.observe(7.0)
+    assert a.percentile(99) == pytest.approx(7.0)
+
+
+def test_gauges_histogram_snapshot_and_prefix_filter():
+    clear_gauges("obs_t.")
+    try:
+        observe("obs_t.lat_ms", 10.0)
+        observe("obs_t.lat_ms", 20.0)
+        observe("other.lat_ms", 5.0)
+        snap = snapshot_gauges("obs_t.")
+        assert set(snap) == {"obs_t.lat_ms_p50", "obs_t.lat_ms_p95",
+                             "obs_t.lat_ms_p99", "obs_t.lat_ms_count",
+                             "obs_t.lat_ms_mean"}
+        assert snap["obs_t.lat_ms_count"] == 2.0
+        assert snap["obs_t.lat_ms_mean"] == pytest.approx(15.0)
+        assert 9.0 <= snap["obs_t.lat_ms_p50"] <= 21.0
+        # prefix clear drops only that namespace
+        clear_gauges("obs_t.")
+        assert snapshot_gauges("obs_t.") == {}
+        assert "other.lat_ms_p50" in snapshot_gauges("other.")
+    finally:
+        clear_gauges("obs_t.")
+        clear_gauges("other.")
+
+
+# ---------------------------------------------------------------------
+# sysmetrics thread-safety (the satellite bug fix)
+# ---------------------------------------------------------------------
+
+def test_cpu_percent_concurrent_samplers():
+    """_cpu_percent's delta state is now lock-guarded: hammering it
+    from the serve-metrics-thread + trainer-logging pattern must only
+    ever produce values in [0, 100] (interleaved read-modify-write on
+    the module global could yield garbage deltas before the fix)."""
+    from tpuflow.obs.sysmetrics import _cpu_percent
+
+    _cpu_percent()  # seed the anchor
+    vals, errs = [], []
+
+    def sample():
+        try:
+            for _ in range(200):
+                vals.append(_cpu_percent())
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=sample) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(vals) == 800
+    assert all(0.0 <= v <= 100.0 for v in vals), (
+        min(vals), max(vals)
+    )
+
+
+# ---------------------------------------------------------------------
+# acceptance: traced train run + served request -> one chrome trace
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_traced_train_and_serve_chrome_export(tmp_path):
+    """ISSUE 4 acceptance: export_chrome_trace of a traced 2-epoch
+    train run + one served request is valid trace-event JSON whose
+    serve spans agree with serve/metrics.py timings within tolerance."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.serve import ServeScheduler
+    from tpuflow.train.lm import LMTrainer
+
+    trace.enable()
+    try:
+        lm = build_transformer_lm(vocab_size=64, dim=16, depth=1,
+                                  heads=2, mlp_ratio=2,
+                                  dtype=jnp.float32)
+        tokens = np.random.default_rng(0).integers(
+            1, 64, (16, 16)).astype(np.int32)
+        trainer = LMTrainer(lm, TrainConfig(learning_rate=1e-3))
+        trainer.fit(tokens, batch_size=8, epochs=2,
+                    checkpoint_dir=str(tmp_path / "ckpt"))
+        params = nn.unbox(lm.init(
+            {"params": jax.random.key(0)}, jnp.zeros((1, 8), jnp.int32)
+        ))["params"]
+        sched = ServeScheduler(lm, params, slots=1, seg=4,
+                               max_new_cap=8)
+        req = sched.submit(np.arange(1, 6, dtype=np.int32), 5,
+                           request_id="req-acc")
+        sched.run_until_idle()
+        assert req.result(timeout=30)["state"] == "done"
+
+        # the train side: 2 epoch spans, dispatch + staging phases
+        epochs = trace.snapshot(name="train.epoch")
+        assert [s["attrs"]["epoch"] for s in epochs] == [0, 1]
+        totals = trace.phase_totals_ms("train.")
+        for k in ("train.dispatch", "train.data_wait",
+                  "train.device_put", "train.metrics_fetch",
+                  "train.checkpoint", "train.compile"):
+            assert k in totals, (k, totals)
+
+        # the serve side: request-id-correlated spans whose durations
+        # agree with the metrics derived from request timestamps
+        # (adjacent stamps, same wall clock — tolerance absorbs the
+        # few statements between them on a loaded box)
+        t = req.timing()
+        spans = {s["name"]: s for s in trace.spans_for("req-acc")}
+        assert {"serve.request", "serve.queue",
+                "serve.ttft"} <= set(spans)
+        assert spans["serve.queue"]["dur_ms"] == pytest.approx(
+            t["queue_wait_ms"], abs=250)
+        assert spans["serve.ttft"]["dur_ms"] == pytest.approx(
+            t["ttft_ms"], abs=250)
+        assert spans["serve.request"]["attrs"]["state"] == "done"
+        # the decode segments ran as host-boundary spans
+        assert trace.snapshot(name="serve.decode_segment")
+        assert trace.snapshot(name="serve.prefill_join")
+        # ... and the same numbers flow through the histogram snapshot
+        snap = sched.metrics.snapshot()
+        assert snap["serve.ttft_ms_p50"] == pytest.approx(
+            t["ttft_ms"], rel=0.12)
+
+        # one export carries BOTH subsystems, valid chrome-trace JSON
+        path = trace.export_chrome_trace(str(tmp_path / "all.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert "train.dispatch" in names
+        assert "serve.decode_segment" in names
+        assert "serve.request" in names
+        # and the breakdown answers the step-time question end to end
+        bd = report.step_breakdown(
+            report.spans_from_events(doc["traceEvents"]))
+        assert {"dispatch", "data_wait",
+                "decode"} <= set(bd["phases"])
+        sched.stop(drain=False)
+    finally:
+        trace.disable()
+        trace.clear()

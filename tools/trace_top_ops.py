@@ -14,6 +14,14 @@ Library use (bench.py embeds this into the artifact diagnostics):
 CLI:
     python tools/trace_top_ops.py traces_r04/resnet50 [--top 15]
 
+Trace discovery/parsing is shared with the host-span side
+(tpuflow.obs.report — ISSUE 4 de-duplicated the ad-hoc copy that lived
+here): ``summarize`` accepts a jax.profiler capture dir, a
+``*.trace.json.gz`` file, OR a ``tpuflow.obs.trace.export_chrome_trace``
+span export. Host-span files carry no XLA ops — attribute those with
+``python -m tpuflow.cli.obs trace/report`` instead; this tool is the
+device-op table.
+
 Heuristics: device lanes are processes whose metadata name contains
 "TPU"/"device"; if none exist (CPU-backend capture), every lane counts
 EXCEPT python-source events (names like ``$file.py:123 fn``), so the
@@ -21,13 +29,15 @@ tool degrades gracefully on the CPU test rig.
 """
 
 import argparse
-import glob
-import gzip
 import json
 import os
 import re
 import sys
 from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpuflow.obs.report import find_trace_json, load_trace_events  # noqa: E402,F401
 
 _CATEGORIES = (
     ("collective", re.compile(
@@ -62,37 +72,44 @@ def _base_name(name: str) -> str:
     return re.sub(r"\.\d+$", "", name)
 
 
-def find_trace_json(trace_dir: str):
-    """Newest trace.json.gz under a jax.profiler.trace output dir."""
-    hits = sorted(
-        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
-                  recursive=True),
-        key=os.path.getmtime,
-    )
-    return hits[-1] if hits else None
-
-
 def summarize(trace_dir: str, top: int = 12) -> dict:
     """Aggregate device-op durations. Returns {} when no trace exists.
     Never raises — attribution must not take a bench run down."""
     try:
-        path = find_trace_json(trace_dir)
-        if path is None:
+        path = trace_dir
+        if os.path.isdir(trace_dir):
+            path = find_trace_json(trace_dir)
+            if path is None:
+                return {}
+        events = load_trace_events(path)
+        if not events:
             return {}
-        with gzip.open(path) as f:
-            events = json.load(f).get("traceEvents", [])
         pid_name = {}
         for e in events:
             if e.get("ph") == "M" and e.get("name") == "process_name":
                 pid_name[e["pid"]] = e.get("args", {}).get("name", "")
+        # the span exporter's lane ("tpuflow host spans") carries
+        # python host spans, not XLA ops: it must match NEITHER the
+        # device set (its "tpuflow" substring would match "tpu") NOR
+        # the CPU-capture fallback — a pure span export yields {} here,
+        # not a bogus op table (`python -m tpuflow.cli.obs` is the
+        # host-span tool). Matched precisely: jax's own CPU capture
+        # names its op lane "/host:CPU", which must keep counting.
+        host_pids = {
+            p for p, n in pid_name.items()
+            if "host spans" in n.lower()
+        }
         device_pids = {
             p for p, n in pid_name.items()
-            if "tpu" in n.lower() or "device" in n.lower()
+            if ("tpu" in n.lower() or "device" in n.lower())
+            and p not in host_pids
         }
 
         def on_device(e):
             if device_pids:
                 return e.get("pid") in device_pids
+            if e.get("pid") in host_pids:
+                return False
             # CPU capture: keep XLA ops, drop python-source frames
             return not str(e.get("name", "")).startswith("$")
 

@@ -1,0 +1,71 @@
+"""``python -m tpuflow.cli.obs`` — inspect host-span traces (ISSUE 4).
+
+The read side of the observability plane: both subcommands consume a
+Chrome trace-event JSON — the file
+:func:`tpuflow.obs.trace.export_chrome_trace` writes, or a
+``jax.profiler`` capture directory (``*.trace.json.gz`` is found and
+parsed through the same loader, :mod:`tpuflow.obs.report`)::
+
+  python -m tpuflow.cli.obs trace  <file-or-dir> [--top N]
+      top host spans by total time (name / total / mean / count)
+
+  python -m tpuflow.cli.obs report <file-or-dir> [--prefix train.]
+      step-time breakdown: host-dispatch vs device vs data-wait (and
+      compile/checkpoint/eval, or queue/prefill/decode for a serving
+      capture) as fractions of the capture window
+
+For XLA *device-op* attribution of a jax.profiler capture, use
+``python tools/trace_top_ops.py <dir>`` — same loader, op-level table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="tpuflow.cli.obs",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pt = sub.add_parser("trace", help="top host spans by total time")
+    pt.add_argument("path", help="chrome-trace JSON file or capture dir")
+    pt.add_argument("--top", type=int, default=15)
+    pr = sub.add_parser("report", help="step-time breakdown by phase")
+    pr.add_argument("path", help="chrome-trace JSON file or capture dir")
+    pr.add_argument("--prefix", default=None,
+                    help="restrict to span names under this prefix "
+                         "(e.g. 'train.' or 'serve.')")
+    args = p.parse_args(argv)
+
+    from tpuflow.obs.report import (
+        format_report,
+        load_trace_events,
+        spans_from_events,
+        step_breakdown,
+        top_spans,
+    )
+
+    events = load_trace_events(args.path)
+    spans = spans_from_events(events)
+    if not spans:
+        print(f"no spans found under {args.path}", file=sys.stderr)
+        return 1
+
+    if args.cmd == "trace":
+        rows = top_spans(spans, top=args.top)
+        width = max(len(r["name"]) for r in rows)
+        print(f"{'span':<{width}}  {'total_ms':>10}  {'mean_ms':>9} "
+              f"{'count':>6}")
+        for r in rows:
+            print(f"{r['name']:<{width}}  {r['total_ms']:>10.3f}  "
+                  f"{r['mean_ms']:>9.3f} {r['count']:>6}")
+        return 0
+
+    print(format_report(step_breakdown(spans, prefix=args.prefix)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpuflow.obs import trace
+
 
 class _LRU:
     """Small LRU memo for compiled decode closures with an EVICTION
@@ -81,7 +83,11 @@ class _LRU:
                 self.hits += 1
                 return self._d[key]
             self.misses += 1
-        val = self._builder(*key)
+        # compile-cache MISS span: each rebuild is seconds of serving
+        # latency — the event the observability plane must make visible
+        with trace.span("infer.compile_miss", phase="compile",
+                        cache=self.name):
+            val = self._builder(*key)
         with self._lock:
             self._d[key] = val
             self._d.move_to_end(key)
@@ -295,7 +301,9 @@ def generate(
     if engine == "stepwise":
         run = _compiled_run(dm, b, p, max_len, temperature, top_k, top_p,
                             eos_id)
-        return run(params, prompt, rng)
+        with trace.span("infer.generate", engine="stepwise", rows=b,
+                        prompt=p, new=max_new_tokens):
+            return run(params, prompt, rng)
 
     chunk = p if prefill_chunk is None else max(1, int(prefill_chunk))
     seg = max(1, int(decode_segment))
@@ -303,9 +311,17 @@ def generate(
         dm, b, p, max_len, temperature, top_k, top_p, eos_id,
         min(chunk, p), seg, pad_lens is not None,
     )
-    if pad_lens is not None:
-        return run(params, prompt, rng, pad_lens)
-    return run(params, prompt, rng)
+    # the prefill passes and decode segments run INSIDE this one
+    # dispatch (host boundaries exist only in the serve engine — see
+    # SlotPool's serve.prefill_join / serve.decode_segment spans); the
+    # attrs carry the chunking so the span still answers "how was this
+    # call shaped"
+    with trace.span("infer.generate", engine="blockwise", rows=b,
+                    prompt=p, new=max_new_tokens,
+                    prefill_chunk=min(chunk, p), decode_segment=seg):
+        if pad_lens is not None:
+            return run(params, prompt, rng, pad_lens)
+        return run(params, prompt, rng)
 
 
 def clear_compile_cache() -> None:

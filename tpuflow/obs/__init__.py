@@ -1,4 +1,11 @@
-from tpuflow.obs.profiler import trace, annotate  # noqa: F401
+# NOTE ``tpuflow.obs.trace`` is the span-tracer MODULE (ISSUE 4); the
+# jax-profiler context manager formerly exported here under the same
+# name stays available as ``profiler_trace`` and at its home,
+# ``tpuflow.obs.profiler.trace``.
+import tpuflow.obs.report as report  # noqa: F401
+import tpuflow.obs.trace as trace  # noqa: F401
+from tpuflow.obs.profiler import annotate  # noqa: F401
+from tpuflow.obs.profiler import trace as profiler_trace  # noqa: F401
 from tpuflow.obs.mfu import (  # noqa: F401
     device_peak_flops,
     flops_of_jitted,
@@ -6,8 +13,11 @@ from tpuflow.obs.mfu import (  # noqa: F401
 )
 from tpuflow.obs.sysmetrics import sample_system_metrics  # noqa: F401
 from tpuflow.obs.gauges import (  # noqa: F401
+    Histogram,
     clear_gauges,
+    get_histogram,
     inc_counter,
+    observe,
     set_gauge,
     snapshot_gauges,
 )

@@ -1,25 +1,153 @@
-"""Process-wide gauge/counter registry — the push half of obs.
+"""Process-wide gauge/counter/histogram registry — the push half of obs.
 
 :func:`sample_system_metrics` (tpuflow.obs.sysmetrics) PULLS host and
 device numbers at sample time; long-lived runtimes (the serving
 scheduler, trainers with background staging) instead PUSH their
-operational gauges here as they change, and any metrics consumer —
+operational numbers here as they change, and any metrics consumer —
 run-metric logging, the serve HTTP ``/v1/metrics`` endpoint, a test —
 reads one merged snapshot. Names follow the sysmetrics dotted
 convention (``serve.slot_occupancy``, ``serve.batch_efficiency``) so a
 tracking store ingests both sources identically.
 
-Thread-safe; values are plain floats (gauges overwrite, counters add).
+Three primitives (ISSUE 4 added the third):
+
+- **gauges** — ``set_gauge``: last write wins;
+- **counters** — ``inc_counter``: monotonic adds;
+- **histograms** — ``observe(name, value)``: FIXED log-spaced buckets
+  (~9% per bucket over 1e-3..1e7, so latencies in ms and throughputs
+  both fit), O(1) memory regardless of sample count, p50/p95/p99
+  merged into every snapshot as ``<name>_p50`` etc. This is what
+  :mod:`tpuflow.serve.metrics` percentiles ride on — one histogram
+  implementation instead of per-module percentile math.
+
+Thread-safe; values are plain floats.
 """
 
 from __future__ import annotations
 
+import bisect
+import math
 import threading
-from typing import Dict, Optional
+from typing import Dict, Iterable, Optional
 
 _LOCK = threading.Lock()
 _GAUGES: Dict[str, float] = {}
 _COUNTERS: Dict[str, float] = {}
+_HISTS: Dict[str, "Histogram"] = {}
+
+# fixed bucket grid, shared by every Histogram: upper bounds growing by
+# 2**(1/8) (~9.05%) from 1e-3 to past 1e7 — FIXED so histograms from
+# different sources/processes merge by plain counter addition
+_HIST_FACTOR = 2.0 ** 0.125
+_HIST_BOUNDS: list = []
+_b = 1e-3
+while _b < 1e7:
+    _HIST_BOUNDS.append(_b)
+    _b *= _HIST_FACTOR
+del _b
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated percentiles.
+
+    Memory is O(#buckets) forever — unlike a sample list there is no
+    sliding window and no cap to tune; the trades are resolution (a
+    percentile is exact to its bucket: ±~4.5% around the log-bucket
+    center, tightened by log-linear interpolation within the bucket
+    and clamped to the observed min/max) and RECENCY: counts are
+    cumulative over the histogram's lifetime, so after N observations
+    a behavior change needs O(N·(1-p)) new samples to move p-th
+    percentiles. A long-lived server that wants windowed percentiles
+    should :meth:`reset` on its scrape cadence (the Prometheus
+    counter idiom: the scraper differences/rotates, the process
+    accumulates) — or difference exported counts itself. ``merge``
+    adds another histogram's counts in — snapshot aggregation across
+    sources.
+    """
+
+    __slots__ = ("counts", "n", "total", "vmin", "vmax", "_lock")
+
+    def __init__(self):
+        self.counts = [0] * (len(_HIST_BOUNDS) + 1)  # +1: overflow
+        self.n = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(_HIST_BOUNDS, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.n += 1
+            self.total += v
+            if v < self.vmin:
+                self.vmin = v
+            if v > self.vmax:
+                self.vmax = v
+
+    def reset(self) -> None:
+        """Drop all counts — start a fresh accumulation window."""
+        with self._lock:
+            self.counts = [0] * (len(_HIST_BOUNDS) + 1)
+            self.n = 0
+            self.total = 0.0
+            self.vmin = math.inf
+            self.vmax = -math.inf
+
+    def merge(self, other: "Histogram") -> None:
+        with other._lock:
+            oc, on, ot = list(other.counts), other.n, other.total
+            ovmin, ovmax = other.vmin, other.vmax
+        with self._lock:
+            self.counts = [a + b for a, b in zip(self.counts, oc)]
+            self.n += on
+            self.total += ot
+            self.vmin = min(self.vmin, ovmin)
+            self.vmax = max(self.vmax, ovmax)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def mean(self) -> Optional[float]:
+        return self.total / self.n if self.n else None
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Nearest-rank percentile, log-interpolated within its bucket
+        and clamped to [observed min, observed max]. None when empty."""
+        with self._lock:
+            n = self.n
+            if n == 0:
+                return None
+            counts = list(self.counts)
+            vmin, vmax = self.vmin, self.vmax
+        rank = max(0, min(n - 1, math.ceil(p / 100.0 * n) - 1))
+        cum = 0
+        for i, c in enumerate(counts):
+            if cum + c > rank:
+                lo = _HIST_BOUNDS[i - 1] if i > 0 else vmin
+                hi = (_HIST_BOUNDS[i] if i < len(_HIST_BOUNDS) else vmax)
+                if lo <= 0 or hi <= lo:
+                    v = hi if hi > 0 else lo
+                else:
+                    f = (rank - cum + 0.5) / c
+                    v = lo * (hi / lo) ** f  # log-linear within bucket
+                return float(min(max(v, vmin), vmax))
+            cum += c
+        return float(vmax)  # pragma: no cover - unreachable
+
+    def percentiles(self, pcts: Iterable[float] = (50.0, 95.0, 99.0)
+                    ) -> Dict[str, float]:
+        """``{"p50": ..., "p95": ..., "p99": ...}`` (empty when empty)
+        — the same key format as :func:`tpuflow.serve.metrics.
+        percentiles`."""
+        out: Dict[str, float] = {}
+        for p in pcts:
+            v = self.percentile(p)
+            if v is not None:
+                out[f"p{p:g}"] = v
+        return out
 
 
 def set_gauge(name: str, value: float) -> None:
@@ -36,25 +164,49 @@ def inc_counter(name: str, by: float = 1.0) -> float:
         return _COUNTERS[name]
 
 
+def observe(name: str, value: float) -> None:
+    """Record ``value`` into histogram ``name`` (created on first use).
+    Snapshots surface it as ``<name>_p50/_p95/_p99/_count/_mean``."""
+    with _LOCK:
+        h = _HISTS.get(name)
+        if h is None:
+            h = _HISTS[name] = Histogram()
+    h.observe(value)
+
+
+def get_histogram(name: str) -> Optional[Histogram]:
+    """The registered histogram (None if never observed)."""
+    with _LOCK:
+        return _HISTS.get(name)
+
+
 def snapshot_gauges(prefix: Optional[str] = None) -> Dict[str, float]:
-    """One merged dict of every gauge and counter (optionally filtered
-    to names starting with ``prefix``)."""
+    """One merged dict of every gauge, counter and histogram summary
+    (optionally filtered to names starting with ``prefix``)."""
     with _LOCK:
         merged = dict(_GAUGES)
         merged.update(_COUNTERS)
+        hists = list(_HISTS.items())
+    for name, h in hists:
+        for pk, pv in h.percentiles().items():
+            merged[f"{name}_{pk}"] = round(pv, 3)
+        if len(h):
+            merged[f"{name}_count"] = float(len(h))
+            merged[f"{name}_mean"] = round(h.mean(), 3)
     if prefix is not None:
         merged = {k: v for k, v in merged.items() if k.startswith(prefix)}
     return merged
 
 
 def clear_gauges(prefix: Optional[str] = None) -> None:
-    """Drop gauges/counters (all, or those under ``prefix``) — test
-    isolation and runtime restarts."""
+    """Drop gauges/counters/histograms (all, or those under
+    ``prefix``) — test isolation and runtime restarts."""
     with _LOCK:
         if prefix is None:
             _GAUGES.clear()
             _COUNTERS.clear()
+            _HISTS.clear()
         else:
-            for d in (_GAUGES, _COUNTERS):
+            for d in (_GAUGES, _COUNTERS, _HISTS):
                 for k in [k for k in d if k.startswith(prefix)]:
                     del d[k]

@@ -1,0 +1,237 @@
+"""Step-time breakdown: where did the wall clock go? (ISSUE 4)
+
+Turns host spans (live from :mod:`tpuflow.obs.trace`, or re-loaded from
+an exported Chrome trace) into the question the ROADMAP north star
+actually asks — host-dispatch vs device vs data-wait fractions of a
+training run, and queue/prefill/decode fractions of a served request.
+Instrumentation sites tag every span with a ``phase`` attr
+(``data_wait`` / ``dispatch`` / ``device`` / ``compile`` /
+``checkpoint`` / ``eval`` / ``prefill`` / ``decode``); the report
+aggregates by phase over the capture window.
+
+Also the ONE chrome-trace loader in the repo:
+:func:`load_trace_events` reads both this repo's span exports
+(:func:`tpuflow.obs.trace.export_chrome_trace`) and ``jax.profiler``
+capture directories (``**/*.trace.json.gz``) — tools/trace_top_ops.py
+parses XLA op events through it instead of keeping its own copy.
+
+CLI surface: ``python -m tpuflow.cli.obs trace/report <file-or-dir>``.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+# canonical phase order for reports (anything else lands under its own
+# name; uninstrumented wall time lands under "untracked")
+PHASES = ("data_wait", "dispatch", "device", "compile", "checkpoint",
+          "eval", "prefill", "decode", "queue")
+
+
+# ---- chrome-trace loading (shared with tools/trace_top_ops.py) ------
+
+def find_trace_json(trace_dir: str) -> Optional[str]:
+    """Newest chrome-trace file under a directory: a ``jax.profiler``
+    ``*.trace.json.gz`` capture, or a plain ``*.json`` span export."""
+    hits = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(trace_dir, "*.json")),
+        key=os.path.getmtime,
+    )
+    return hits[-1] if hits else None
+
+
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    """``traceEvents`` list from a chrome-trace JSON: a file (.json or
+    .trace.json.gz) or a directory to search (newest capture wins).
+    Returns [] when nothing is found."""
+    if os.path.isdir(path):
+        found = find_trace_json(path)
+        if found is None:
+            return []
+        path = found
+    opener = gzip.open if path.endswith(".gz") else open
+    try:
+        with opener(path, "rt") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, EOFError):
+        return []
+    if isinstance(doc, dict):
+        return doc.get("traceEvents", []) or []
+    return doc if isinstance(doc, list) else []
+
+
+def spans_from_events(events: List[Dict[str, Any]]
+                      ) -> List[Dict[str, Any]]:
+    """Complete (``ph: "X"``) events → span dicts ``{name, dur_ms,
+    ts_us, tid, thread, attrs}`` — the inverse of
+    :func:`tpuflow.obs.trace.export_chrome_trace` (lossy only in the
+    ns-resolution tail)."""
+    tnames: Dict[Any, str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tnames[e.get("tid")] = e.get("args", {}).get("name", "")
+    out = []
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        out.append({
+            "name": str(e.get("name", "")),
+            "dur_ms": float(e["dur"]) / 1e3,
+            "ts_us": float(e.get("ts", 0.0)),
+            "tid": e.get("tid"),
+            "thread": tnames.get(e.get("tid"), ""),
+            "attrs": e.get("args", {}) or {},
+        })
+    return out
+
+
+def _live_spans() -> List[Dict[str, Any]]:
+    from tpuflow.obs.trace import snapshot
+
+    spans = snapshot()
+    return [{
+        "name": s["name"], "dur_ms": s["dur_ms"],
+        "ts_us": s["t0_ns"] / 1e3, "tid": s["tid"],
+        "thread": s["thread"], "attrs": s["attrs"],
+    } for s in spans]
+
+
+# ---- the breakdown --------------------------------------------------
+
+def step_breakdown(spans: Optional[List[Dict[str, Any]]] = None,
+                   prefix: Optional[str] = None) -> Dict[str, Any]:
+    """Aggregate spans into per-phase totals and fractions of the
+    capture window.
+
+    ``spans``: dicts from :func:`spans_from_events` / :func:`_live_spans`
+    (None = the live tracer ring). ``prefix`` restricts to span names
+    under it (e.g. ``"train."``). Fractions are of the WALL window
+    (first span start → last span end) and are computed from each
+    phase's interval UNION, not its summed durations: a serving
+    capture has many concurrent requests whose queue spans overlap in
+    wall time (64 requests queued for 2s is 128s of span-time inside a
+    2s window), and summed durations would print 6400% — the union
+    says "some request was queued during X% of the window", which is
+    the honest wall-attribution. The summed span-time still ships as
+    ``ms`` (it IS the right number for single-threaded train loops and
+    for cost accounting); ``frac`` uses the union coverage.
+    Instrumentation sites put the ``phase`` attr ONLY on leaf work
+    spans (dispatch calls, host batch pulls, blocking fetches) —
+    wrapper spans (``train.epoch``, ``serve.request``) carry none — so
+    only phased spans enter the fraction table, and the window not
+    covered by ANY phased span is reported as ``untracked``. When NO
+    span carries a phase (a generic capture), everything aggregates by
+    span name instead.
+    """
+    if spans is None:
+        spans = _live_spans()
+    if prefix is not None:
+        spans = [s for s in spans if s["name"].startswith(prefix)]
+    if not spans:
+        return {"total_ms": 0.0, "phases": {}, "n_spans": 0}
+    t0 = min(s["ts_us"] for s in spans)
+    t1 = max(s["ts_us"] + s["dur_ms"] * 1e3 for s in spans)
+    total_ms = (t1 - t0) / 1e3
+    phased = [s for s in spans if s["attrs"].get("phase")]
+    keyed = (
+        [(s["attrs"]["phase"], s) for s in phased] if phased
+        else [(s["name"], s) for s in spans]
+    )
+    by_phase: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    intervals: Dict[str, List] = {}
+    for key, s in keyed:
+        by_phase[key] = by_phase.get(key, 0.0) + s["dur_ms"]
+        counts[key] = counts.get(key, 0) + 1
+        intervals.setdefault(key, []).append(
+            (s["ts_us"], s["ts_us"] + s["dur_ms"] * 1e3)
+        )
+    covered = {
+        ph: _union_ms(iv) for ph, iv in intervals.items()
+    }
+    phases = {
+        ph: {
+            "ms": round(ms, 3),
+            "frac": (round(covered[ph] / total_ms, 4)
+                     if total_ms > 0 else 0.0),
+            "n": counts[ph],
+        }
+        for ph, ms in sorted(by_phase.items(), key=lambda kv: -kv[1])
+    }
+    if phased:
+        tracked = _union_ms(
+            [iv for ivs in intervals.values() for iv in ivs]
+        )
+        if total_ms > tracked:
+            rest = total_ms - tracked
+            phases["untracked"] = {
+                "ms": round(rest, 3),
+                "frac": round(rest / total_ms, 4),
+                "n": 0,
+            }
+    return {
+        "total_ms": round(total_ms, 3),
+        "phases": phases,
+        "n_spans": len(spans),
+    }
+
+
+def _union_ms(intervals: List) -> float:
+    """Total length (ms) of the union of (start_us, end_us) intervals."""
+    if not intervals:
+        return 0.0
+    out = 0.0
+    cur_s = cur_e = None
+    for s, e in sorted(intervals):
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                out += cur_e - cur_s
+            cur_s, cur_e = s, e
+        elif e > cur_e:
+            cur_e = e
+    out += cur_e - cur_s
+    return out / 1e3
+
+
+def format_report(bd: Dict[str, Any]) -> str:
+    """Human-readable table of a :func:`step_breakdown` result."""
+    if not bd.get("phases"):
+        return "no spans captured (is the tracer enabled?)"
+    lines = [
+        f"step-time breakdown over {bd['total_ms'] / 1e3:.3f} s window "
+        f"({bd['n_spans']} spans):"
+    ]
+    for ph, rec in bd["phases"].items():
+        lines.append(
+            f"  {ph:<16} {rec['ms'] / 1e3:8.3f} s  "
+            f"{100 * rec['frac']:5.1f}%  (n={rec['n']})"
+        )
+    return "\n".join(lines)
+
+
+def top_spans(spans: Optional[List[Dict[str, Any]]] = None,
+              top: int = 15) -> List[Dict[str, Any]]:
+    """Per-name total/mean/count table, heaviest first — the host-span
+    twin of tools/trace_top_ops' XLA-op table."""
+    if spans is None:
+        spans = _live_spans()
+    agg: Dict[str, List[float]] = {}
+    for s in spans:
+        agg.setdefault(s["name"], []).append(s["dur_ms"])
+    rows = [
+        {
+            "name": name,
+            "total_ms": round(sum(ds), 3),
+            "mean_ms": round(sum(ds) / len(ds), 3),
+            "count": len(ds),
+        }
+        for name, ds in agg.items()
+    ]
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows[:top]

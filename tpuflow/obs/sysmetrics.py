@@ -10,6 +10,7 @@ separate dashboard.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict
 
@@ -29,10 +30,18 @@ def _proc_meminfo() -> Dict[str, float]:
 
 
 _last_cpu = None
+_CPU_LOCK = threading.Lock()
 
 
 def _cpu_percent() -> float:
-    """System-wide CPU utilization since the previous call."""
+    """System-wide CPU utilization since the previous call.
+
+    The delta state (``_last_cpu``) is read-modify-written under a
+    lock: concurrent samplers — the serve metrics thread and trainer
+    logging both call :func:`sample_system_metrics` — would otherwise
+    interleave on the module global and return garbage deltas (two
+    threads both subtracting the SAME stale anchor, or one reading the
+    tuple mid-replacement)."""
     global _last_cpu
     try:
         with open("/proc/stat") as f:
@@ -42,11 +51,15 @@ def _cpu_percent() -> float:
         return 0.0
     idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
     total = sum(vals)
-    if _last_cpu is None:
-        _last_cpu = (total, idle)
-        return 0.0
-    dt, di = total - _last_cpu[0], idle - _last_cpu[1]
-    _last_cpu = (total, idle)
+    with _CPU_LOCK:
+        prev = _last_cpu
+        # monotonic guard: /proc/stat reads from two racing threads can
+        # complete out of order; never step the anchor backwards
+        if prev is None or total >= prev[0]:
+            _last_cpu = (total, idle)
+        if prev is None:
+            return 0.0
+        dt, di = total - prev[0], idle - prev[1]
     return 100.0 * (1 - di / dt) if dt > 0 else 0.0
 
 

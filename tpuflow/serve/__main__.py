@@ -38,7 +38,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--max-queue", type=int, default=64,
                    help="admission queue bound (429 beyond it)")
     p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument("--trace-spans", action="store_true",
+                   help="enable the tpuflow.obs.trace span tracer "
+                        "(request ids become trace ids; inspect via "
+                        "GET /v1/trace/<id>)")
     args = p.parse_args(argv)
+
+    if args.trace_spans:
+        from tpuflow.obs import trace as _trace
+
+        _trace.enable()
 
     from tpuflow.serve.http import start_http_server
     from tpuflow.serve.scheduler import ServeScheduler

@@ -18,6 +18,9 @@ Endpoints::
   POST /v1/cancel     {"id": ...} → {"cancelled": bool}
   GET  /v1/metrics    scheduler + gauge snapshot (JSON)
   GET  /v1/events/ID  structured event log for one request id
+  GET  /v1/trace/ID   host spans of one request (trace id == request
+                      id — tpuflow.obs.trace; [] unless the tracer is
+                      enabled: TPUFLOW_TRACE_SPANS=1 or --trace-spans)
   GET  /healthz       {"ok": true, ...}
 """
 
@@ -96,6 +99,13 @@ class _Handler(BaseHTTPRequestHandler):
             rid = self.path[len("/v1/events/"):]
             self._json(200, {"id": rid,
                              "events": sched.metrics.events(rid)})
+        elif self.path.startswith("/v1/trace/"):
+            from tpuflow.obs import trace
+
+            rid = self.path[len("/v1/trace/"):]
+            self._json(200, {"id": rid,
+                             "tracer_enabled": trace.is_enabled(),
+                             "spans": trace.spans_for(rid)})
         else:
             self._json(404, {"error": f"no route {self.path}"})
 

@@ -4,7 +4,10 @@ Three surfaces, all fed by the scheduler thread:
 
 - **latency histograms** — TTFT, queue wait, decode latency, end-to-end
   per finished request, summarized as p50/p95/p99 (the numbers
-  ``bench.py --serve`` A/Bs against wave draining);
+  ``bench.py --serve`` A/Bs against wave draining). These are
+  :class:`tpuflow.obs.gauges.Histogram` instances (ISSUE 4): fixed
+  log-spaced buckets, O(1) memory forever — the per-module percentile
+  math and sliding sample windows this file used to carry are gone;
 - **pool gauges** — slot occupancy and batch efficiency (live rows /
   slot rows per decode segment: the fraction of the fixed-shape batch
   doing useful work — the quantity slot-level scheduling exists to
@@ -13,7 +16,9 @@ Three surfaces, all fed by the scheduler thread:
   any host/device metric;
 - **a structured event log per request id** — submit/admit/first-token/
   finish/reject/cancel/expire with timestamps, bounded to the most
-  recent requests (a server must not grow without limit).
+  recent requests (a server must not grow without limit). Request ids
+  double as TRACE ids in :mod:`tpuflow.obs.trace`, so these events and
+  the request's spans describe the same lifecycle.
 """
 
 from __future__ import annotations
@@ -23,14 +28,16 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
-from tpuflow.obs.gauges import inc_counter, set_gauge
+from tpuflow.obs.gauges import Histogram, inc_counter, set_gauge
 from tpuflow.serve.request import Request
 
 
 def percentiles(values: List[float],
                 pcts=(50.0, 95.0, 99.0)) -> Dict[str, float]:
-    """Nearest-rank percentiles of ``values`` keyed ``p50``/``p95``/...
-    (empty input → empty dict)."""
+    """EXACT nearest-rank percentiles of a concrete sample list, keyed
+    ``p50``/``p95``/... (empty input → empty dict). The aggregate
+    histograms above quote bucket-resolution percentiles; this helper
+    stays for callers holding the raw samples (bench's A/B)."""
     if not values:
         return {}
     import math
@@ -55,29 +62,34 @@ def _bounded_append(lst: list, value, cap: int) -> None:
 class ServeMetrics:
     """Aggregate + per-request serving metrics (thread-safe).
 
-    Memory is bounded on every axis: latency histograms keep the most
-    recent ``max_samples`` points (percentiles are over that sliding
-    window), the event log keeps ``max_event_requests`` request ids and
-    ``max_events_per_request`` entries per id — so shared ids (the
-    ``-http-`` access log, a chatty client reusing one id) cannot grow
-    without limit either."""
+    Memory is bounded on every axis: the latency histograms are
+    fixed-bucket (O(#buckets) regardless of request count — no sliding
+    window to tune), the event log keeps ``max_event_requests`` request
+    ids and ``max_events_per_request`` entries per id — so shared ids
+    (the ``-http-`` access log, a chatty client reusing one id) cannot
+    grow without limit either.
+
+    The histograms accumulate over the PROCESS lifetime (the old
+    4096-sample sliding window is gone): after a long healthy run a
+    regression moves the p95/p99 only slowly. A monitoring consumer
+    that wants windowed percentiles should difference the exported
+    ``_count`` between scrapes or call :meth:`reset_latency` on its
+    scrape cadence."""
 
     def __init__(self, max_event_requests: int = 512,
                  gauge_prefix: str = "serve",
-                 max_samples: int = 4096,
                  max_events_per_request: int = 128):
         self._lock = threading.Lock()
         self.prefix = gauge_prefix
-        self.max_samples = max_samples
         self.max_events_per_request = max_events_per_request
         self.counts: Dict[str, int] = {
             "submitted": 0, "rejected": 0, "admitted": 0, "done": 0,
             "cancelled": 0, "expired": 0,
         }
-        self.ttft_ms: List[float] = []
-        self.queue_wait_ms: List[float] = []
-        self.decode_ms: List[float] = []
-        self.e2e_ms: List[float] = []
+        self.ttft_ms = Histogram()
+        self.queue_wait_ms = Histogram()
+        self.decode_ms = Histogram()
+        self.e2e_ms = Histogram()
         self.tokens_out = 0
         self.segments = 0
         self.segment_live_rows = 0
@@ -120,18 +132,17 @@ class ServeMetrics:
     def on_admit(self, req: Request) -> None:
         with self._lock:
             self.counts["admitted"] += 1
-            if req.ts_admitted is not None:
-                _bounded_append(self.queue_wait_ms,
-                                (req.ts_admitted - req.ts_arrival) * 1e3,
-                                self.max_samples)
+        if req.ts_admitted is not None:
+            self.queue_wait_ms.observe(
+                (req.ts_admitted - req.ts_arrival) * 1e3
+            )
         self.event(req.id, "admit", slot=req.slot, stream_id=req.stream_id)
 
     def on_first_token(self, req: Request) -> None:
-        with self._lock:
-            if req.ts_first_token is not None:
-                _bounded_append(self.ttft_ms,
-                                (req.ts_first_token - req.ts_arrival) * 1e3,
-                                self.max_samples)
+        if req.ts_first_token is not None:
+            self.ttft_ms.observe(
+                (req.ts_first_token - req.ts_arrival) * 1e3
+            )
         self.event(req.id, "first_token")
 
     def on_finish(self, req: Request) -> None:
@@ -142,13 +153,11 @@ class ServeMetrics:
             if key:
                 self.counts[key] += 1
             self.tokens_out += len(req.tokens)
-            if req.state.value == "done":
-                if t["decode_ms"] is not None:
-                    _bounded_append(self.decode_ms, t["decode_ms"],
-                                    self.max_samples)
-                if t["e2e_ms"] is not None:
-                    _bounded_append(self.e2e_ms, t["e2e_ms"],
-                                    self.max_samples)
+        if req.state.value == "done":
+            if t["decode_ms"] is not None:
+                self.decode_ms.observe(t["decode_ms"])
+            if t["e2e_ms"] is not None:
+                self.e2e_ms.observe(t["e2e_ms"])
         inc_counter(f"{self.prefix}.requests_{req.state.value}_total")
         self.event(req.id, "finish", state=req.state.value,
                    n_tokens=len(req.tokens), error=req.error, **t)
@@ -169,6 +178,14 @@ class ServeMetrics:
             self.queue_depth = depth
         set_gauge(f"{self.prefix}.queue_depth", float(depth))
 
+    def reset_latency(self) -> None:
+        """Start a fresh accumulation window for every latency
+        histogram (counts/events/gauges untouched) — the windowed-
+        percentile hook for long-lived servers (see class docstring)."""
+        for h in (self.ttft_ms, self.queue_wait_ms, self.decode_ms,
+                  self.e2e_ms):
+            h.reset()
+
     # ---- export -----------------------------------------------------
     def snapshot(self) -> Dict[str, float]:
         """Flat dotted-key snapshot (run-metric loggable as-is)."""
@@ -183,10 +200,10 @@ class ServeMetrics:
                 self.segment_live_rows / self.segment_slot_rows
                 if self.segment_slot_rows else 0.0
             )
-            for name, vals in (("ttft_ms", self.ttft_ms),
-                               ("queue_wait_ms", self.queue_wait_ms),
-                               ("decode_ms", self.decode_ms),
-                               ("e2e_ms", self.e2e_ms)):
-                for pk, pv in percentiles(vals).items():
-                    m[f"{self.prefix}.{name}_{pk}"] = round(pv, 3)
+        for name, hist in (("ttft_ms", self.ttft_ms),
+                           ("queue_wait_ms", self.queue_wait_ms),
+                           ("decode_ms", self.decode_ms),
+                           ("e2e_ms", self.e2e_ms)):
+            for pk, pv in hist.percentiles().items():
+                m[f"{self.prefix}.{name}_{pk}"] = round(pv, 3)
         return m

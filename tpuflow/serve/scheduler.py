@@ -44,6 +44,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from tpuflow.obs import trace
 from tpuflow.serve.metrics import ServeMetrics
 from tpuflow.serve.request import QueueFull, Request, RequestState
 from tpuflow.serve.slots import SlotPool
@@ -188,13 +189,38 @@ class ServeScheduler:
         )
         req.ts_arrival = now
         req.bucket = bucket
+        # request-lifecycle spans, TRACE ID = REQUEST ID — so the
+        # /v1/metrics event log and /v1/trace/<id> spans correlate.
+        # Created BEFORE the request enters the queue: the scheduler
+        # thread may admit it the instant the lock drops, and the
+        # admit path must find the queue span to end. begin() returns
+        # None when the tracer is off and end(None) no-ops, so this
+        # stays in production code. begin here (caller thread), end on
+        # the scheduler thread: the cross-thread contract of
+        # tpuflow.obs.trace.
+        root = trace.begin("serve.request", trace_id=req.id,
+                           bucket=bucket,
+                           prompt_tokens=int(ids.size),
+                           max_new_tokens=int(max_new_tokens))
+        parent = root.span if root is not None else None
+        req._span_request = root
+        req._span_queue = trace.begin("serve.queue", trace_id=req.id,
+                                      parent_id=parent, phase="queue")
+        req._span_ttft = trace.begin("serve.ttft", trace_id=req.id,
+                                     parent_id=parent)
         with self._lock:
             if self._closed:
+                trace.end(req._span_queue)
+                trace.end(req._span_ttft)
+                trace.end(root, state="rejected", error="stopped")
                 raise RuntimeError("scheduler is stopped")
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.max_queue:
                 retry = self._retry_hint(depth)
                 self.metrics.on_reject(depth, retry)
+                trace.end(req._span_queue)
+                trace.end(req._span_ttft)
+                trace.end(root, state="rejected", depth=depth)
                 raise QueueFull(depth, retry)
             n = self._admit_counts.get(bucket, 0)
             self._admit_counts[bucket] = n + 1
@@ -262,6 +288,12 @@ class ServeScheduler:
             req.ts_done = self.clock()
         req.finalize(state, error)
         self.metrics.on_finish(req)
+        # close any still-open lifecycle spans (idempotent: a DONE
+        # request already ended queue/ttft at admit/first-token)
+        trace.end(getattr(req, "_span_queue", None))
+        trace.end(getattr(req, "_span_ttft", None))
+        trace.end(getattr(req, "_span_request", None),
+                  state=state.value, n_tokens=len(req.tokens))
         if state is not RequestState.DONE:
             # non-DONE terminals never reach the harvest path's final
             # stream event — emit it here so streaming clients unblock
@@ -398,6 +430,11 @@ class ServeScheduler:
                     req.state = RequestState.RUNNING
                     req.ts_admitted = t_adm
                     self.metrics.on_admit(req)
+                    # queue-wait span ends where ts_admitted is stamped
+                    # — span duration and metrics queue_wait_ms
+                    # describe the same interval
+                    trace.end(getattr(req, "_span_queue", None),
+                              slot=_slot)
                 progress = True
             if pool.has_live():
                 events, live = pool.run_segment()
@@ -412,6 +449,7 @@ class ServeScheduler:
                     if (new or finished) and req.ts_first_token is None:
                         req.ts_first_token = seg_ts
                         self.metrics.on_first_token(req)
+                        trace.end(getattr(req, "_span_ttft", None))
                     if finished:
                         pool.evict(slot)
                         self._finalize(req, RequestState.DONE)

@@ -35,6 +35,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from tpuflow.obs import trace
 from tpuflow.serve.request import Request
 
 
@@ -161,10 +162,16 @@ class SlotPool:
             self.done[slot] = False
             self.occupants[slot] = req
             req.slot = slot
-        self.cache, self.out = self._join(
-            self.params, self.cache, self.out, jnp.asarray(self.pad_lens),
-            jnp.asarray(prompts), jnp.asarray(mask), self.t,
-        )
+        # one span per prefill-join pass — the serve-side "prefill
+        # chunk"; request ids ride as attrs so the pass is attributable
+        with trace.span("serve.prefill_join", phase="prefill",
+                        bucket=self.bucket, n=len(admits), t=self.t,
+                        requests=",".join(r.id for _, r in admits)):
+            self.cache, self.out = self._join(
+                self.params, self.cache, self.out,
+                jnp.asarray(self.pad_lens), jnp.asarray(prompts),
+                jnp.asarray(mask), self.t,
+            )
 
     def evict(self, slot: int) -> Optional[Request]:
         """Free a slot WITHOUT waiting for its row to finish
@@ -192,16 +199,21 @@ class SlotPool:
             )
         t0 = self.t
         live_before = self.live_count()
-        self.cache, self.out, done_dev, toks = self._segment(
-            self.params, self.cache, self.out, jnp.asarray(self.done),
-            jnp.asarray(self.pad_lens), jnp.asarray(self.stream_ids),
-            jnp.asarray(self.last_pos), self._rng, t0,
-        )
-        self.t = t0 + self.seg
-        self.segments_run += 1
-        was_done = self.done
-        self.done = np.array(done_dev)
-        toks = np.asarray(toks)
+        # the decode-segment span covers dispatch AND the host fetch of
+        # done/toks — i.e. the real wall cost of seg decode steps
+        with trace.span("serve.decode_segment", phase="decode",
+                        bucket=self.bucket, t0=t0, seg=self.seg,
+                        live=live_before):
+            self.cache, self.out, done_dev, toks = self._segment(
+                self.params, self.cache, self.out, jnp.asarray(self.done),
+                jnp.asarray(self.pad_lens), jnp.asarray(self.stream_ids),
+                jnp.asarray(self.last_pos), self._rng, t0,
+            )
+            self.t = t0 + self.seg
+            self.segments_run += 1
+            was_done = self.done
+            self.done = np.array(done_dev)
+            toks = np.asarray(toks)
         events = []
         for slot, req in enumerate(self.occupants):
             if req is None or was_done[slot]:

@@ -161,18 +161,22 @@ class ModelCheckpoint(Callback):
         )
         if not is_primary() and not is_cross_process_sharded(saved):
             return
-        if self._async is not None:
-            self._async.save(
-                self.checkpoint_dir, state, step=epoch + 1,
+        from tpuflow.obs import trace
+
+        with trace.span("train.checkpoint", phase="checkpoint",
+                        epoch=epoch):
+            if self._async is not None:
+                self._async.save(
+                    self.checkpoint_dir, state, step=epoch + 1,
+                    weights_only=self.save_weights_only,
+                )
+                return
+            save_checkpoint(
+                self.checkpoint_dir,
+                state,
+                step=epoch + 1,
                 weights_only=self.save_weights_only,
             )
-            return
-        save_checkpoint(
-            self.checkpoint_dir,
-            state,
-            step=epoch + 1,
-            weights_only=self.save_weights_only,
-        )
 
     def on_train_end(self):
         if self._async is not None:

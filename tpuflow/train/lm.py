@@ -49,6 +49,7 @@ from tpuflow.core.config import TrainConfig
 from tpuflow.core.dist import is_primary
 from tpuflow.data.tokens import TokenDataset
 from tpuflow.models.transformer import TransformerLM, next_token_loss
+from tpuflow.obs import trace
 from tpuflow.parallel.mesh import DATA_AXIS, MODEL_AXIS, build_nd_mesh
 from tpuflow.train.lr import LRController
 from tpuflow.train.optimizers import get_optimizer, set_learning_rate
@@ -671,24 +672,26 @@ class LMTrainer:
                     f"({tokens.cur_shard}/{tokens.shard_count}) does not "
                     f"match the expected ({want_cur}/{want_count})"
                 )
-            losses = [
-                self._eval_step(self.state, self._put(b))["loss"]
-                for b in tokens.iter_epoch(0)
-            ]
-            return (
-                float(jnp.mean(jnp.stack(losses))) if losses else None
-            )
+            with trace.span("train.eval", phase="eval"):
+                losses = [
+                    self._eval_step(self.state, self._put(b))["loss"]
+                    for b in tokens.iter_epoch(0)
+                ]
+                return (
+                    float(jnp.mean(jnp.stack(losses))) if losses else None
+                )
         b_local, proc = self._local_slice(batch_size)
         losses = []
-        for j in range(max(1, int(tokens.shape[0]) // int(batch_size))):
-            rows = tokens[j * batch_size : (j + 1) * batch_size]
-            if rows.shape[0] < batch_size:
-                break
-            t = self._put(rows[proc * b_local : (proc + 1) * b_local])
-            losses.append(self._eval_step(self.state, t)["loss"])
-        if not losses:
-            return None
-        return float(jnp.mean(jnp.stack(losses)))
+        with trace.span("train.eval", phase="eval"):
+            for j in range(max(1, int(tokens.shape[0]) // int(batch_size))):
+                rows = tokens[j * batch_size : (j + 1) * batch_size]
+                if rows.shape[0] < batch_size:
+                    break
+                t = self._put(rows[proc * b_local : (proc + 1) * b_local])
+                losses.append(self._eval_step(self.state, t)["loss"])
+            if not losses:
+                return None
+            return float(jnp.mean(jnp.stack(losses)))
 
     @staticmethod
     def _ppl(loss: float) -> float:
@@ -848,6 +851,9 @@ class LMTrainer:
         with sigterm_preempt_flag(use_preempt) as preempt, \
                 join_async_writes(lambda: [self._async_ckpt]):
             for epoch in range(start, epochs):
+                # explicit begin/end (idempotent) — the body exits
+                # through break paths too
+                ep_span = trace.begin("train.epoch", epoch=epoch)
                 first_i = skip_steps if epoch == start else 0
                 if ds is not None:
                     batch_iter = ds.iter_epoch(epoch)
@@ -895,8 +901,12 @@ class LMTrainer:
                                 preempt_mp):
                             preempted = True
                             break
-                        local_rows = _host_rows(i)
-                        toks = self._put(local_rows)
+                        with trace.span("train.data_wait",
+                                        phase="data_wait"):
+                            local_rows = _host_rows(i)
+                        with trace.span("train.device_put",
+                                        phase="data_wait"):
+                            toks = self._put(local_rows)
                         lr = self.lr_controller.lr_for_step(global_step)
                         lr_arr = jnp.asarray(lr, jnp.float32)
                         if self._step_exec is None:
@@ -909,37 +919,47 @@ class LMTrainer:
                             # PER-DEVICE flops when the program is sharded.
                             from tpuflow.obs.mfu import flops_of_compiled
 
-                            self._step_exec = self._train_step.lower(
-                                self.state, toks, lr_arr
-                            ).compile()
+                            with trace.span("train.compile",
+                                            phase="compile"):
+                                self._step_exec = self._train_step.lower(
+                                    self.state, toks, lr_arr
+                                ).compile()
                             self._flops_per_step = flops_of_compiled(
                                 self._step_exec
                             )
-                        self.state, m = self._step_exec(
-                            self.state, toks, lr_arr
-                        )
+                        with trace.span("train.dispatch",
+                                        phase="dispatch"):
+                            self.state, m = self._step_exec(
+                                self.state, toks, lr_arr
+                            )
                         losses.append(m["loss"])
                         global_step += 1
                         if i == first_i:
                             # sync, then time the REMAINING steps: the first
                             # executed step carries trace+compile, which must
                             # not pollute the throughput metrics
-                            float(m["loss"])
+                            with trace.span("train.sync",
+                                            phase="device"):
+                                float(m["loss"])
                             t_epoch = time.time()
                             timed_steps = steps_per_epoch - first_i - 1
                 if preempted:
                     from tpuflow.ckpt.checkpoint import save_step_checkpoint
 
-                    spath = save_step_checkpoint(
-                        checkpoint_dir, self.state, global_step
-                    )
+                    with trace.span("train.checkpoint",
+                                    phase="checkpoint"):
+                        spath = save_step_checkpoint(
+                            checkpoint_dir, self.state, global_step
+                        )
                     metrics["preempted_at_step"] = float(global_step)
                     if is_primary():
                         print(f"preempted at step {global_step}; saved {spath}")
+                    trace.end(ep_span, preempted=True)
                     break
-                epoch_loss = float(jnp.mean(jnp.concatenate(
-                    [jnp.atleast_1d(l) for l in losses]
-                )))
+                with trace.span("train.metrics_fetch", phase="device"):
+                    epoch_loss = float(jnp.mean(jnp.concatenate(
+                        [jnp.atleast_1d(l) for l in losses]
+                    )))
                 # the scalar fetch above syncs, so the wall time is real
                 epoch_s = time.time() - t_epoch if t_epoch is not None else 0.0
                 metrics = {"loss": epoch_loss, "lr": float(lr)}
@@ -975,20 +995,23 @@ class LMTrainer:
                     for k, v in metrics.items():
                         run.log_metric(k, float(v), step=epoch)
                 if checkpoint_dir:
-                    if getattr(cfg, "async_checkpoint", False):
-                        if self._async_ckpt is None:
-                            from tpuflow.ckpt import AsyncCheckpointer
+                    with trace.span("train.checkpoint",
+                                    phase="checkpoint"):
+                        if getattr(cfg, "async_checkpoint", False):
+                            if self._async_ckpt is None:
+                                from tpuflow.ckpt import AsyncCheckpointer
 
-                            self._async_ckpt = AsyncCheckpointer()
-                        self._async_ckpt.save(
-                            checkpoint_dir, self.state, epoch + 1
-                        )
-                    else:
-                        save_checkpoint(
-                            checkpoint_dir, self.state, epoch + 1
-                        )
+                                self._async_ckpt = AsyncCheckpointer()
+                            self._async_ckpt.save(
+                                checkpoint_dir, self.state, epoch + 1
+                            )
+                        else:
+                            save_checkpoint(
+                                checkpoint_dir, self.state, epoch + 1
+                            )
                 if on_epoch is not None:
                     on_epoch(epoch, metrics)
+                trace.end(ep_span)
         return metrics
 
     def _run_superstep_epoch(self, K, first_i, steps_per_epoch,
@@ -1027,9 +1050,13 @@ class LMTrainer:
             buf = collections.deque()
             i = first_i
             for want in sizes:
-                rows = [host_rows(i + j) for j in range(want)]
+                with trace.span("train.data_wait", phase="data_wait",
+                                k=want):
+                    rows = [host_rows(i + j) for j in range(want)]
                 i += want
-                buf.append((want, self._put_block(rows)))
+                with trace.span("train.device_put", phase="data_wait",
+                                k=want):
+                    buf.append((want, self._put_block(rows)))
                 if len(buf) >= depth:
                     yield buf.popleft()
             while buf:
@@ -1056,9 +1083,10 @@ class LMTrainer:
             if ex is None:
                 from tpuflow.obs.mfu import flops_of_compiled
 
-                ex = self._superstep.lower(
-                    self.state, toks, lrs_arr
-                ).compile()
+                with trace.span("train.compile", phase="compile", k=k):
+                    ex = self._superstep.lower(
+                        self.state, toks, lrs_arr
+                    ).compile()
                 self._sstep_execs[k] = ex
                 if self._flops_per_step is None:
                     # XLA cost analysis counts a lax.scan body ONCE, so
@@ -1066,14 +1094,16 @@ class LMTrainer:
                     # exactly the per-step number the MFU metrics want
                     # (same convention as the grad-accum scan, bench.py)
                     self._flops_per_step = flops_of_compiled(ex)
-            self.state, m = ex(self.state, toks, lrs_arr)
+            with trace.span("train.superstep", phase="dispatch", k=k):
+                self.state, m = ex(self.state, toks, lrs_arr)
             losses.append(m["loss"])
             global_step += k
             if t_epoch is None:
                 # sync after the FIRST block only: compile stays out of
                 # the timed window, and this is the epoch's single
                 # mid-flight host fetch
-                float(m["loss"][-1])
+                with trace.span("train.sync", phase="device"):
+                    float(m["loss"][-1])
                 t_epoch = time.time()
                 timed_steps = steps_per_epoch - first_i - k
         return preempted, global_step, lr, t_epoch, timed_steps

@@ -376,8 +376,17 @@ class PipelineTrainer(LMTrainer):
             )
 
     def _make_steps(self) -> None:
+        from tpuflow.obs import trace
         from tpuflow.parallel.mesh import DATA_AXIS
 
+        # schedule construction is host work worth attributing: the
+        # inherited LMTrainer fit loop carries the epoch/dispatch/
+        # staging spans, this marks where the pipelined program itself
+        # is assembled (jit compile lands in the first dispatch span)
+        self._steps_span = trace.begin(
+            "train.make_steps", schedule=self.schedule,
+            stages=self.n_stages, virtual=self.virtual_stages,
+        )
         model = self.model
         mesh = self.mesh
         mm = self.n_microbatches
@@ -389,6 +398,7 @@ class PipelineTrainer(LMTrainer):
         stage_fn = self._stage_fn()
         if self.schedule == "interleaved":
             self._make_steps_interleaved(micro_spec, has_data, stage_fn)
+            trace.end(self._steps_span)
             return
         run_fwd = pipeline(stage_fn, mm, PIPE_AXIS)
 
@@ -442,6 +452,7 @@ class PipelineTrainer(LMTrainer):
         # K steps in one scanned dispatch) composes with the pipeline
         # unchanged — the LMTrainer fit loop drives it
         self._build_superstep(train_step)
+        trace.end(self._steps_span)
 
     def _first_last_fns(self):
         """The embed/loss-head halves shared by every manual-VJP

@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tpuflow.core.compat import shard_map
 
 from tpuflow.core.config import TrainConfig
+from tpuflow.obs import trace
 from tpuflow.models.classifier import backbone_param_mask, stop_gradient_frozen
 from tpuflow.models.preprocess import preprocess_input, random_flip
 from tpuflow.parallel.mesh import DATA_AXIS, build_mesh, world_size
@@ -320,10 +321,21 @@ class Trainer:
         return min(4, max(1, int(getattr(ds, "prefetch", 2) or 2)))
 
     def _prefetch(self, it: Iterable, depth: int = 2):
-        """Device-put ahead of compute: double-buffered H2D (N5)."""
+        """Device-put ahead of compute: double-buffered H2D (N5).
+
+        Span accounting: the host batch pull and the H2D put are the
+        two data_wait leaves; the consumer's ``next()`` on this
+        generator executes them, so the fit loop does not re-wrap it
+        (that would double-count the phase)."""
+        it = iter(it)
         buf: collections.deque = collections.deque()
-        for batch in it:
-            buf.append(self._put(batch))
+        while True:
+            with trace.span("train.data_wait", phase="data_wait"):
+                batch = next(it, None)
+            if batch is None:
+                break
+            with trace.span("train.device_put", phase="data_wait"):
+                buf.append(self._put(batch))
             if len(buf) >= depth:
                 yield buf.popleft()
         while buf:
@@ -348,23 +360,27 @@ class Trainer:
         for want in sizes:
             images = labels = None
             got = 0
-            for j in range(want):
-                try:
-                    b = next(host_iter)
-                except StopIteration:
-                    break
-                if images is None:
-                    images = np.empty((want, *b["image"].shape),
-                                      b["image"].dtype)
-                    labels = np.empty((want, *b["label"].shape),
-                                      b["label"].dtype)
-                images[j] = b["image"]  # copy NOW (ring safety)
-                labels[j] = b["label"]
-                got += 1
+            with trace.span("train.data_wait", phase="data_wait",
+                            k=want):
+                for j in range(want):
+                    try:
+                        b = next(host_iter)
+                    except StopIteration:
+                        break
+                    if images is None:
+                        images = np.empty((want, *b["image"].shape),
+                                          b["image"].dtype)
+                        labels = np.empty((want, *b["label"].shape),
+                                          b["label"].dtype)
+                    images[j] = b["image"]  # copy NOW (ring safety)
+                    labels[j] = b["label"]
+                    got += 1
             if got:
-                buf.append((got, *self._put_block_stacked(
-                    images[:got], labels[:got]
-                )))
+                with trace.span("train.device_put", phase="data_wait",
+                                k=got):
+                    buf.append((got, *self._put_block_stacked(
+                        images[:got], labels[:got]
+                    )))
             if got < want:
                 break
             if len(buf) >= depth:
@@ -558,6 +574,10 @@ class Trainer:
                 join_async_writes(lambda: [
                     getattr(cb, "_async", None) for cb in cbs]):
             for epoch in range(initial_epoch, epochs):
+                # explicit begin/end (not `with`): the body exits
+                # through several break paths; trace.end is idempotent
+                # so every path may close it
+                ep_span = trace.begin("train.epoch", epoch=epoch)
                 step_metrics = []
                 steps_this_epoch = steps_per_epoch - (
                     skip_steps if epoch == initial_epoch else 0
@@ -587,10 +607,12 @@ class Trainer:
                             for j in range(k)
                         ]
                         lr = lrs[-1]
-                        self.state, m = self._superstep(
-                            self.state, images, labels,
-                            jnp.asarray(lrs, jnp.float32),
-                        )
+                        with trace.span("train.superstep",
+                                        phase="dispatch", k=k):
+                            self.state, m = self._superstep(
+                                self.state, images, labels,
+                                jnp.asarray(lrs, jnp.float32),
+                            )
                         # m holds (k,)-stacked per-step metrics, still
                         # device-resident — the epoch-end _mean_metrics
                         # fetch is the only host sync
@@ -617,27 +639,35 @@ class Trainer:
                             # (Keras semantics)
                             exhausted = True
                             break
-                        self.state, m = self._train_step(
-                            self.state, images, labels,
-                            jnp.asarray(lr, jnp.float32),
-                        )
+                        with trace.span("train.dispatch",
+                                        phase="dispatch"):
+                            self.state, m = self._train_step(
+                                self.state, images, labels,
+                                jnp.asarray(lr, jnp.float32),
+                            )
                         step_metrics.append(m)
                         global_step += 1
                 if preempted:
                     from tpuflow.ckpt import save_step_checkpoint
 
-                    path = save_step_checkpoint(
-                        self.cfg.checkpoint_dir, self.state, global_step
-                    )
+                    with trace.span("train.checkpoint",
+                                    phase="checkpoint"):
+                        path = save_step_checkpoint(
+                            self.cfg.checkpoint_dir, self.state,
+                            global_step
+                        )
                     history.history.setdefault("preempted_at_step", []
                                                ).append(global_step)
                     if verbose:
                         print(f"preempted at step {global_step}; "
                               f"saved {path}")
+                    trace.end(ep_span, preempted=True)
                     break
                 if exhausted and not step_metrics:
+                    trace.end(ep_span, exhausted=True)
                     break
-                logs = _mean_metrics(step_metrics)
+                with trace.span("train.metrics_fetch", phase="device"):
+                    logs = _mean_metrics(step_metrics)
                 logs["lr"] = lr
                 if val_ds is not None:
                     val_logs = self.evaluate(val_ds, steps=validation_steps)
@@ -647,6 +677,7 @@ class Trainer:
                         f"{k}={v:.4f}" for k, v in logs.items()))
                 for cb in cbs:
                     cb.on_epoch_end(epoch, logs)
+                trace.end(ep_span)
                 if self.stop_training or exhausted:
                     break
         for cb in cbs:
@@ -696,10 +727,11 @@ class Trainer:
         steps = steps or ds.steps_per_epoch()
         it = self._prefetch(iter(ds), self._staging_depth(ds))
         ms = []
-        for _ in range(steps):
-            images, labels = next(it)
-            ms.append(self._eval_step(self.state, images, labels))
-        return _mean_metrics(ms)
+        with trace.span("train.eval", steps=steps):
+            for _ in range(steps):
+                images, labels = next(it)
+                ms.append(self._eval_step(self.state, images, labels))
+            return _mean_metrics(ms)
 
     def predict_logits(self, images: np.ndarray) -> np.ndarray:
         """Forward pass on a host batch (single-process convenience)."""
