@@ -646,6 +646,14 @@ def _base_diag(dt, method, dt_loop, last_loss, *, flops, n_chips, peak,
         "peak_flops_assumed": peak,
         "loss": round(last_loss, 4),
     }
+    # first-class plane gauges (ISSUE 5): the same numbers the
+    # trainers publish live, so a bench child's exporter/flight dump
+    # carries its MFU too
+    from tpuflow.obs.gauges import set_gauge
+
+    if flops:
+        set_gauge("train.flops_per_step", float(flops))
+        set_gauge("train.mfu", float(mfu_v))
     return mfu_v, rec
 
 

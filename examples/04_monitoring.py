@@ -1,9 +1,11 @@
-"""Monitoring & optimization: spans, histograms, traces, metrics, MFU.
+"""Monitoring & optimization: spans, histograms, traces, metrics, MFU,
+Prometheus scrape, watchdogs, flight recorder.
 
 ≙ P1/04_monitoring_and_optimization.py (prose-only in the reference:
 Ganglia dashboards + scale-up/scale-out guidance) plus the
-Horovod-Timeline hook (P1/03:407-409). tpuflow makes both executable,
-and ISSUE 4 unified them into one observability plane:
+Horovod-Timeline hook (P1/03:407-409). tpuflow makes both executable;
+ISSUE 4 unified them into one observability plane and ISSUE 5 added
+the production metrics/health half:
 
 - ``obs.trace`` — the structured span tracer: ``span(name, **attrs)``
   around host work, near-zero overhead when disabled, Chrome-trace
@@ -14,6 +16,14 @@ and ISSUE 4 unified them into one observability plane:
   ``python -m tpuflow.cli.obs report <export.json>``;
 - ``obs.gauges`` — fixed-bucket histograms: ``observe(name, value)``
   with p50/p95/p99 merged into every snapshot;
+- ``obs.timeseries`` — the snapshot ring that turns those cumulative
+  histograms into *windowed* (trailing-window) percentiles;
+- ``obs.prom`` — Prometheus text exposition of the whole registry and
+  the standalone ``GET /metrics`` exporter demo'd below (the serving
+  frontend exposes the same text at its own ``/metrics``);
+- ``obs.health`` / ``obs.flight`` — watchdogs (non-finite guard, loss
+  spike, stall) whose trips dump an atomic post-mortem bundle; forced
+  below and pretty-printed via the ``postmortem`` CLI;
 - ``obs.profiler.trace`` wraps N steps in a jax.profiler capture
   (Perfetto/TensorBoard — the Horovod Timeline equivalent),
 - ``obs.sysmetrics.sample_system_metrics`` samples host CPU/mem and
@@ -83,6 +93,56 @@ def main(workdir: str) -> None:
     hist = {k: round(v, 3)
             for k, v in snapshot_gauges("demo.step_ms").items()}
     print(f"step-latency histogram summary: {hist}")
+
+    # ---- the scrape-able half (ISSUE 5): a LIVE /metrics endpoint ----
+    # Trainers start this with TrainConfig(metrics_port=...); the serve
+    # frontend exposes the same text at its own GET /metrics. Here:
+    # standalone exporter on an ephemeral port + a real HTTP scrape.
+    import urllib.request
+
+    from tpuflow.obs import prom, timeseries
+
+    exporter = prom.start_exporter(port=0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    n_samples = sum(1 for line in text.splitlines()
+                    if line and not line.startswith("#"))
+    demo_lines = [line for line in text.splitlines()
+                  if line.startswith("demo_step_ms_bucket")][:2]
+    print(f"prometheus scrape OK: {n_samples} samples from "
+          f":{exporter.port}/metrics, e.g.")
+    for line in demo_lines:
+        print(f"  {line}")
+    # windowed vs cumulative: tick the snapshot ring, observe a spike,
+    # and watch the PRIMARY p50 move while _cum barely does
+    timeseries.start(thread=False).tick()
+    for _ in range(3):
+        observe("demo.step_ms", 250.0)  # a sudden regression
+    snap = snapshot_gauges("demo.step_ms")
+    print(f"windowed p50 {snap['demo.step_ms_p50']:.1f}ms vs "
+          f"cumulative {snap['demo.step_ms_p50_cum']:.1f}ms "
+          "(the window sees the regression immediately)")
+
+    # ---- watchdog + flight recorder: a forced post-mortem ----
+    from tpuflow.obs import flight, health
+
+    flight_dir = os.path.join(workdir, "flight")
+    monitor = health.HealthMonitor()
+    monitor.watchdog.on_trip.append(flight.trip_dumper(flight_dir))
+    # trainers do this per step ON DEVICE (TrainConfig(watchdog=True)
+    # rides the metrics fetch); here we hand the guard a bad host value
+    monitor.check_host(3, {"loss": float("nan")})
+    assert monitor.tripped
+    bundle = flight.load(flight_dir)
+    print(f"watchdog tripped -> post-mortem bundle "
+          f"{os.path.basename(bundle['_path'])} "
+          f"(sections: {', '.join(bundle['manifest']['sections'])})")
+    print("postmortem CLI: python -m tpuflow.cli.obs postmortem "
+          f"{flight_dir}")
+    monitor.close()
+    exporter.shutdown()
+    timeseries.stop()
     trace.disable()
 
     # ---- the device-side twin: a jax.profiler capture ----

@@ -85,8 +85,15 @@ def test_monitoring_example(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     assert "step-time breakdown" in r.stdout
     assert "demo.step_ms_p50" in r.stdout
+    assert "prometheus scrape OK" in r.stdout
+    assert "windowed p50" in r.stdout
+    assert "post-mortem bundle" in r.stdout
     assert "monitoring example OK" in r.stdout
     assert os.path.exists(os.path.join(str(tmp_path), "host_spans.json"))
+    # the forced watchdog trip left a loadable flight bundle behind
+    from tpuflow.obs import flight
+
+    assert flight.list_bundles(os.path.join(str(tmp_path), "flight"))
 
 
 @pytest.mark.slow

@@ -311,12 +311,20 @@ def test_gauges_histogram_snapshot_and_prefix_filter():
         observe("obs_t.lat_ms", 20.0)
         observe("other.lat_ms", 5.0)
         snap = snapshot_gauges("obs_t.")
+        # primary keys are the (windowed-capable) summaries; ISSUE 5
+        # added the explicit cumulative twins under _cum. With no
+        # snapshot ring ticking both views are the same numbers.
         assert set(snap) == {"obs_t.lat_ms_p50", "obs_t.lat_ms_p95",
                              "obs_t.lat_ms_p99", "obs_t.lat_ms_count",
-                             "obs_t.lat_ms_mean"}
+                             "obs_t.lat_ms_mean",
+                             "obs_t.lat_ms_p50_cum",
+                             "obs_t.lat_ms_p95_cum",
+                             "obs_t.lat_ms_p99_cum",
+                             "obs_t.lat_ms_count_cum"}
         assert snap["obs_t.lat_ms_count"] == 2.0
         assert snap["obs_t.lat_ms_mean"] == pytest.approx(15.0)
         assert 9.0 <= snap["obs_t.lat_ms_p50"] <= 21.0
+        assert snap["obs_t.lat_ms_p50_cum"] == snap["obs_t.lat_ms_p50"]
         # prefix clear drops only that namespace
         clear_gauges("obs_t.")
         assert snapshot_gauges("obs_t.") == {}

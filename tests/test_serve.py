@@ -418,6 +418,19 @@ def test_http_server_generate_stream_metrics_backpressure(tiny_lm):
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
             assert json.loads(r.read())["ok"] is True
+        # the ISSUE 5 split: readiness is its own endpoint and a
+        # healthy live server passes it
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=10) as r:
+            ready = json.loads(r.read())
+        assert ready["ready"] is True and "queue_depth" in ready
+        # ... and /metrics speaks Prometheus text format
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "# TYPE serve_ttft_ms histogram" in text
+        assert 'serve_ttft_ms_bucket{le="+Inf"}' in text
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/v1/metrics", timeout=10) as r:
             snap = json.loads(r.read())

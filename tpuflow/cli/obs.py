@@ -14,6 +14,12 @@ parsed through the same loader, :mod:`tpuflow.obs.report`)::
       compile/checkpoint/eval, or queue/prefill/decode for a serving
       capture) as fractions of the capture window
 
+  python -m tpuflow.cli.obs postmortem <bundle-or-root> [--spans N]
+      pretty-print a flight-record bundle (tpuflow.obs.flight): trip
+      reason, watchdog history, heartbeat ages, the last spans before
+      the dump, gauge snapshot, in-flight serve requests. Given a dump
+      ROOT directory, the newest bundle inside is shown.
+
 For XLA *device-op* attribution of a jax.profiler capture, use
 ``python tools/trace_top_ops.py <dir>`` — same loader, op-level table.
 """
@@ -37,7 +43,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     pr.add_argument("--prefix", default=None,
                     help="restrict to span names under this prefix "
                          "(e.g. 'train.' or 'serve.')")
+    pp = sub.add_parser("postmortem",
+                        help="pretty-print a flight-record bundle")
+    pp.add_argument("path", help="bundle directory (or the dump root — "
+                                 "newest bundle wins)")
+    pp.add_argument("--spans", type=int, default=12,
+                    help="how many of the last spans to show")
     args = p.parse_args(argv)
+
+    if args.cmd == "postmortem":
+        from tpuflow.obs.flight import format_postmortem, load
+
+        try:
+            bundle = load(args.path)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        print(format_postmortem(bundle, top_spans=args.spans))
+        return 0
 
     from tpuflow.obs.report import (
         format_report,

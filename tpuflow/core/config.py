@@ -165,6 +165,31 @@ class TrainConfig:
     # prefix (≙ the Ganglia dashboards, P1/04:25-30, recorded with the
     # run instead of living in a cluster UI)
     log_system_metrics: bool = False
+    # ---- metrics/health plane (ISSUE 5) ----
+    # Prometheus text-exposition exporter port (tpuflow.obs.prom):
+    # the trainer starts a scrape endpoint at GET :port/metrics when
+    # set (0 = ephemeral; the exporter also starts the windowed
+    # snapshot ring). None = no exporter thread.
+    metrics_port: Optional[int] = None
+    # arm the training watchdogs (tpuflow.obs.health): a device-side
+    # isfinite(loss) & isfinite(grad_norm) flag rides the step's
+    # existing metrics block (zero extra host syncs — a worker thread
+    # pays the fetch) and an EWMA loss-spike detector watches the
+    # fetched losses. Default off: the flag adds a global-norm
+    # reduction to the compiled step, so parity-pinned runs stay
+    # bit-identical.
+    watchdog: bool = False
+    # with watchdog mode: also trip when no training step completes
+    # for this many seconds (hung collective / wedged host). Epoch-end
+    # eval/checkpoint and mid-fit compiles are excluded (the monitor
+    # pauses around them); set this ABOVE the wall time of one
+    # superstep block — a fused K-step dispatch is one "step" to the
+    # stall clock. None = no stall thread.
+    stall_timeout_s: Optional[float] = None
+    # where watchdog trips dump their flight-record bundle
+    # (tpuflow.obs.flight; inspect with `python -m tpuflow.cli.obs
+    # postmortem <dir>`). None = trip without a dump.
+    flight_dir: Optional[str] = None
     seed: int = 0
     optimizer_kwargs: Dict[str, Any] = field(default_factory=dict)
 

@@ -2,7 +2,11 @@
 # jax-profiler context manager formerly exported here under the same
 # name stays available as ``profiler_trace`` and at its home,
 # ``tpuflow.obs.profiler.trace``.
+import tpuflow.obs.flight as flight  # noqa: F401
+import tpuflow.obs.health as health  # noqa: F401
+import tpuflow.obs.prom as prom  # noqa: F401
 import tpuflow.obs.report as report  # noqa: F401
+import tpuflow.obs.timeseries as timeseries  # noqa: F401
 import tpuflow.obs.trace as trace  # noqa: F401
 from tpuflow.obs.profiler import annotate  # noqa: F401
 from tpuflow.obs.profiler import trace as profiler_trace  # noqa: F401
@@ -18,6 +22,7 @@ from tpuflow.obs.gauges import (  # noqa: F401
     get_histogram,
     inc_counter,
     observe,
+    register_histogram,
     set_gauge,
     snapshot_gauges,
 )

@@ -1,0 +1,331 @@
+"""Flight recorder (ISSUE 5 tentpole): automatic post-mortems.
+
+The paper's L6 layer makes a run inspectable AFTER the fact only if
+someone was logging the right thing before it died. The flight
+recorder closes that gap: when a watchdog trips, an unhandled
+exception escapes, or a SIGTERM lands, :func:`dump` ATOMICALLY writes
+one post-mortem bundle directory holding everything the live plane
+knew at that moment:
+
+- ``manifest.json`` — reason, wall time, pid, watchdog state, caller
+  context;
+- ``spans.json`` — the span ring as a Chrome trace export (what the
+  process was doing in the seconds before the trip; present when the
+  tracer is enabled);
+- ``gauges.json`` — the full gauge/counter/histogram snapshot
+  (windowed + ``_cum``);
+- ``timeseries.json`` — the snapshot ring export, when one is ticking
+  (how the numbers MOVED leading up to the trip);
+- ``sysmetrics.json`` — host CPU/mem + device HBM;
+- one ``<provider>.json`` per registered provider — e.g. the serving
+  scheduler's in-flight request states.
+
+Atomicity is the directory-rename idiom (stage into ``<dir>.tmp-pid``,
+``os.replace`` into place): a reader never sees a torn bundle, and a
+crash mid-dump leaves only a ``.tmp-`` turd. Read bundles back with
+:func:`load` / ``python -m tpuflow.cli.obs postmortem <dir>``.
+
+Arming is explicit: :func:`install` hooks ``sys.excepthook`` (and
+optionally SIGTERM, chaining any previous handler — the preemption
+machinery in train/preempt.py installs its own and must keep working);
+watchdog-trip dumps are wired by handing :func:`trip_dumper` to a
+:class:`~tpuflow.obs.health.Watchdog`. Nothing is hooked by default.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_LOCK = threading.Lock()
+_PROVIDERS: Dict[str, Callable[[], Any]] = {}
+_SEQ = 0
+
+_BUNDLE_FILES = ("manifest.json", "gauges.json", "sysmetrics.json")
+
+
+def add_provider(name: str, fn: Callable[[], Any]) -> None:
+    """Register ``fn`` (→ JSON-able) to be captured into
+    ``<name>.json`` in every future bundle. Last registration per name
+    wins; a raising provider is recorded as its error, never aborts
+    the dump."""
+    with _LOCK:
+        _PROVIDERS[name] = fn
+
+
+def remove_provider(name: str) -> None:
+    with _LOCK:
+        _PROVIDERS.pop(name, None)
+
+
+def _write_json(path: str, obj: Any) -> None:
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=1, default=str)
+
+
+def dump(out_dir: str, reason: str,
+         context: Optional[Dict[str, Any]] = None) -> str:
+    """Write one post-mortem bundle under ``out_dir`` (a NEW
+    subdirectory per dump — ``postmortem-<epochsecs>-<seq>``); returns
+    its path. Never raises: best-effort capture of every section, with
+    per-section errors recorded in the manifest."""
+    global _SEQ
+    with _LOCK:
+        _SEQ += 1
+        seq = _SEQ
+        providers = dict(_PROVIDERS)
+    name = f"postmortem-{int(time.time())}-{os.getpid()}-{seq}"
+    final = os.path.join(out_dir, name)
+    tmp = final + f".tmp-{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    errors: Dict[str, str] = {}
+    sections: List[str] = []
+
+    def section(fname: str, fn: Callable[[], Any]) -> None:
+        try:
+            obj = fn()
+        except Exception as e:
+            errors[fname] = f"{type(e).__name__}: {e}"
+            return
+        if obj is None:
+            return
+        try:
+            _write_json(os.path.join(tmp, fname), obj)
+            sections.append(fname)
+        except Exception as e:  # pragma: no cover - disk-full class
+            errors[fname] = f"{type(e).__name__}: {e}"
+
+    from tpuflow.obs import trace
+    from tpuflow.obs.gauges import snapshot_gauges
+
+    def spans():
+        if not trace.snapshot():
+            return None
+        # reuse the one chrome exporter (atomic on its own file), then
+        # fold the file into the staged bundle
+        p = os.path.join(tmp, "spans.json")
+        trace.export_chrome_trace(p)
+        sections.append("spans.json")
+        return None
+
+    section("_spans", spans)
+    section("gauges.json", lambda: snapshot_gauges())
+
+    def ts():
+        from tpuflow.obs import timeseries
+
+        ring = timeseries.default_ring()
+        return ring.export() if ring is not None else None
+
+    section("timeseries.json", ts)
+
+    def sysm():
+        from tpuflow.obs.sysmetrics import sample_system_metrics
+
+        return sample_system_metrics()
+
+    section("sysmetrics.json", sysm)
+    for pname, fn in providers.items():
+        section(f"{pname}.json", fn)
+
+    from tpuflow.obs.health import default_watchdog, heartbeat_ages
+
+    manifest = {
+        "reason": reason,
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "context": context or {},
+        "watchdog": default_watchdog().state(),
+        "heartbeat_ages_s": {
+            k: round(v, 3) for k, v in heartbeat_ages().items()
+        },
+        "tracer_enabled": trace.is_enabled(),
+        "sections": sorted(sections),
+        "errors": errors,
+    }
+    _write_json(os.path.join(tmp, "manifest.json"), manifest)
+    os.replace(tmp, final)  # atomic: a bundle either exists whole or not
+    return final
+
+
+def trip_dumper(out_dir: str) -> Callable[[Dict[str, Any]], None]:
+    """A ``Watchdog.on_trip`` callback that dumps into ``out_dir`` —
+    the standard wiring: ``watchdog.on_trip.append(flight.
+    trip_dumper(dir))``."""
+
+    def on_trip(rec: Dict[str, Any]) -> None:
+        dump(out_dir, rec.get("reason", "watchdog trip"), context=rec)
+
+    # records the target dir on the hook (introspection); the
+    # trainer-side fit-to-fit dedupe tags its own hooks separately
+    # (_trainer_flight, tpuflow.obs.health.monitor_from_config)
+    on_trip._flight_dir = out_dir
+    return on_trip
+
+
+# ---- global hooks (explicitly armed) --------------------------------
+
+_INSTALLED: Dict[str, Any] = {}
+
+
+def install(out_dir: str, signals: bool = False) -> None:
+    """Arm process-level capture into ``out_dir``: ``sys.excepthook``
+    (unhandled exception → bundle, then the previous hook runs) and,
+    with ``signals=True`` on the main thread, SIGTERM (bundle, then
+    the PREVIOUS handler — the trainers' preemption flag keeps
+    working; default action re-raised when there was none).
+    Idempotent; :func:`uninstall` restores."""
+    import sys
+
+    with _LOCK:
+        already = "dir" in _INSTALLED
+        _INSTALLED["dir"] = out_dir
+    if not already:
+        prev_hook = sys.excepthook
+
+        def hook(etype, evalue, tb):
+            try:
+                # read the CURRENT dir: a re-install may have moved it
+                dump(_INSTALLED.get("dir", out_dir),
+                     f"unhandled {etype.__name__}: {evalue}")
+            except Exception:
+                pass
+            prev_hook(etype, evalue, tb)
+
+        _INSTALLED["excepthook_prev"] = prev_hook
+        sys.excepthook = hook
+    # signals arm independently of the excepthook, so a re-install
+    # that newly asks for them still gets them
+    if signals and "sigterm_prev" not in _INSTALLED:
+        import signal
+
+        if threading.current_thread() is threading.main_thread():
+            def on_term(signum, frame):
+                try:
+                    dump(_INSTALLED.get("dir", out_dir), "SIGTERM")
+                except Exception:
+                    pass
+                prev = _INSTALLED.get("sigterm_prev")
+                if callable(prev):
+                    prev(signum, frame)
+                elif prev == signal.SIG_DFL:
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            _INSTALLED["sigterm_prev"] = signal.signal(
+                signal.SIGTERM, on_term
+            )
+
+
+def uninstall() -> None:
+    import sys
+
+    with _LOCK:
+        if "dir" not in _INSTALLED:
+            return
+        prev_hook = _INSTALLED.pop("excepthook_prev", None)
+        sig_prev = _INSTALLED.pop("sigterm_prev", "-none-")
+        _INSTALLED.pop("dir", None)
+    if prev_hook is not None:
+        sys.excepthook = prev_hook
+    if sig_prev != "-none-":
+        import signal
+
+        if threading.current_thread() is threading.main_thread():
+            signal.signal(signal.SIGTERM, sig_prev)
+
+
+# ---- read side ------------------------------------------------------
+
+def list_bundles(out_dir: str) -> List[str]:
+    """Bundle subdirectories under ``out_dir``, oldest first."""
+    if not os.path.isdir(out_dir):
+        return []
+    out = []
+    for d in sorted(os.listdir(out_dir)):
+        p = os.path.join(out_dir, d)
+        if (d.startswith("postmortem-") and ".tmp-" not in d
+                and os.path.isfile(os.path.join(p, "manifest.json"))):
+            out.append(p)
+    return out
+
+
+def load(bundle_dir: str) -> Dict[str, Any]:
+    """Parse a bundle (or the NEWEST bundle inside a dump root) into
+    ``{section_name: parsed_json}``; raises FileNotFoundError when
+    there is no manifest to anchor on."""
+    if not os.path.isfile(os.path.join(bundle_dir, "manifest.json")):
+        inner = list_bundles(bundle_dir)
+        if not inner:
+            raise FileNotFoundError(
+                f"no flight-record bundle under {bundle_dir}"
+            )
+        bundle_dir = inner[-1]
+    out: Dict[str, Any] = {"_path": bundle_dir}
+    for fn in sorted(os.listdir(bundle_dir)):
+        if fn.endswith(".json"):
+            with open(os.path.join(bundle_dir, fn)) as f:
+                out[fn[:-5]] = json.load(f)
+    return out
+
+
+def format_postmortem(bundle: Dict[str, Any], top_spans: int = 12,
+                      top_gauges: int = 20) -> str:
+    """Human post-mortem: reason, watchdog trips, heartbeat ages, the
+    LAST spans before the dump (what the process was doing), the top
+    gauges, and any in-flight serve requests."""
+    man = bundle.get("manifest", {})
+    lines = [
+        f"flight record: {bundle.get('_path', '?')}",
+        f"  reason : {man.get('reason', '?')}",
+        f"  time   : {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(man.get('ts', 0)))}"
+        f"  (pid {man.get('pid', '?')})",
+    ]
+    wd = man.get("watchdog", {})
+    if wd.get("trips"):
+        lines.append("  watchdog trips:")
+        for t in wd["trips"][-5:]:
+            lines.append(f"    - {t.get('reason')}")
+    hbs = man.get("heartbeat_ages_s", {})
+    if hbs:
+        lines.append("  heartbeat ages (s): " + ", ".join(
+            f"{k}={v:g}" for k, v in sorted(hbs.items())
+        ))
+    if man.get("errors"):
+        lines.append(f"  capture errors: {man['errors']}")
+    spans = bundle.get("spans", {}).get("traceEvents", [])
+    xs = [e for e in spans if e.get("ph") == "X"]
+    if xs:
+        xs.sort(key=lambda e: e.get("ts", 0) + e.get("dur", 0))
+        lines.append(f"  last {min(top_spans, len(xs))} spans before "
+                     "the dump:")
+        for e in xs[-top_spans:]:
+            lines.append(
+                f"    {e['name']:<28} {e.get('dur', 0) / 1e3:>10.3f} ms"
+                f"  [{e.get('args', {}).get('trace_id', '')}]"
+            )
+    gauges = bundle.get("gauges", {})
+    if gauges:
+        lines.append("  gauges (subset):")
+        for k in sorted(gauges)[:top_gauges]:
+            lines.append(f"    {k} = {gauges[k]}")
+        if len(gauges) > top_gauges:
+            lines.append(f"    ... {len(gauges) - top_gauges} more")
+    for key in sorted(bundle):
+        # any scheduler's provider section, whatever its gauge prefix
+        # ("serve_requests", "serve.b_requests", ...)
+        if not key.endswith("_requests"):
+            continue
+        reqs = bundle[key]
+        if not reqs:
+            continue
+        lines.append(f"  in-flight requests [{key}] ({len(reqs)}):")
+        for r in reqs[:10]:
+            lines.append(
+                f"    {r.get('id', '?'):<14} state={r.get('state', '?')}"
+                f" tokens={r.get('n_tokens', 0)}"
+            )
+    return "\n".join(lines)

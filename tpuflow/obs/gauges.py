@@ -57,12 +57,15 @@ class Histogram:
     and clamped to the observed min/max) and RECENCY: counts are
     cumulative over the histogram's lifetime, so after N observations
     a behavior change needs O(N·(1-p)) new samples to move p-th
-    percentiles. A long-lived server that wants windowed percentiles
-    should :meth:`reset` on its scrape cadence (the Prometheus
-    counter idiom: the scraper differences/rotates, the process
-    accumulates) — or difference exported counts itself. ``merge``
+    percentiles. The RECENCY half of that trade is closed by
+    :mod:`tpuflow.obs.timeseries` (ISSUE 5): a snapshot ring captures
+    :meth:`state` on a fixed cadence and delta-differences bucket
+    counts between snapshots into *windowed* percentiles — the
+    Prometheus counter idiom (the process accumulates, the consumer
+    differences) done in-process, so no consumer has to. ``merge``
     adds another histogram's counts in — snapshot aggregation across
-    sources.
+    sources; :meth:`reset` stays for callers that want a hard
+    accumulation restart instead of a window.
     """
 
     __slots__ = ("counts", "n", "total", "vmin", "vmax", "_lock")
@@ -95,6 +98,19 @@ class Histogram:
             self.total = 0.0
             self.vmin = math.inf
             self.vmax = -math.inf
+
+    def state(self) -> Dict[str, object]:
+        """Consistent copy of the raw accumulation state —
+        ``{"counts": [...], "n": int, "total": float, "vmin": float,
+        "vmax": float}`` — the unit the timeseries snapshot ring
+        records and :mod:`tpuflow.obs.prom` renders as cumulative
+        ``le`` buckets. ``counts[i]`` counts observations <=
+        ``bucket_bounds()[i]`` exclusive-of-lower; the final slot is
+        the overflow bucket."""
+        with self._lock:
+            return {"counts": list(self.counts), "n": self.n,
+                    "total": self.total, "vmin": self.vmin,
+                    "vmax": self.vmax}
 
     def merge(self, other: "Histogram") -> None:
         with other._lock:
@@ -180,19 +196,101 @@ def get_histogram(name: str) -> Optional[Histogram]:
         return _HISTS.get(name)
 
 
+def register_histogram(name: str, hist: Histogram) -> Histogram:
+    """Adopt an externally-owned :class:`Histogram` into the registry
+    under ``name`` (last registration wins) — how
+    :class:`tpuflow.serve.metrics.ServeMetrics` publishes its latency
+    histograms so the snapshot ring, the Prometheus exposition and
+    ``snapshot_gauges`` all see ONE instance instead of a copy."""
+    with _LOCK:
+        _HISTS[name] = hist
+    return hist
+
+
+def histograms(prefix: Optional[str] = None) -> Dict[str, Histogram]:
+    """Shallow copy of the histogram registry (live instances — treat
+    as read-only via :meth:`Histogram.state`/percentiles)."""
+    with _LOCK:
+        items = dict(_HISTS)
+    if prefix is not None:
+        items = {k: v for k, v in items.items() if k.startswith(prefix)}
+    return items
+
+
+def bucket_bounds() -> list:
+    """The shared fixed bucket upper bounds (ascending; observations
+    above the last bound land in the overflow slot). Returned list is
+    the module constant — do not mutate."""
+    return _HIST_BOUNDS
+
+
+def counters(prefix: Optional[str] = None) -> Dict[str, float]:
+    """Copy of the counters alone (the Prometheus exposition needs to
+    tell them apart from gauges; ``snapshot_gauges`` merges both)."""
+    with _LOCK:
+        out = dict(_COUNTERS)
+    if prefix is not None:
+        out = {k: v for k, v in out.items() if k.startswith(prefix)}
+    return out
+
+
+def scalar_gauges(prefix: Optional[str] = None) -> Dict[str, float]:
+    """Copy of the plain gauges alone — consumers that already hold
+    histogram summaries (the timeseries export) use this instead of
+    re-deriving them through ``snapshot_gauges``."""
+    with _LOCK:
+        out = dict(_GAUGES)
+    if prefix is not None:
+        out = {k: v for k, v in out.items() if k.startswith(prefix)}
+    return out
+
+
 def snapshot_gauges(prefix: Optional[str] = None) -> Dict[str, float]:
     """One merged dict of every gauge, counter and histogram summary
-    (optionally filtered to names starting with ``prefix``)."""
+    (optionally filtered to names starting with ``prefix``).
+
+    Histogram percentiles are WINDOWED when the
+    :mod:`tpuflow.obs.timeseries` default ring is ticking (trailing
+    ``window_s`` of observations — the number a live dashboard wants),
+    and fall back to the all-time cumulative values when it is not;
+    the cumulative values are always present under a ``_cum`` suffix
+    (``<name>_p50_cum``/``_count_cum``), so consumers that difference
+    across scrapes keep their monotone series either way."""
     with _LOCK:
         merged = dict(_GAUGES)
         merged.update(_COUNTERS)
         hists = list(_HISTS.items())
+    if prefix is not None:
+        # filter BEFORE the windowed walk: delta-differencing every
+        # registry histogram just to discard the keys is the waste
+        # scalar_gauges/counters exist to avoid
+        hists = [(k, v) for k, v in hists if k.startswith(prefix)]
+    windowed = {}
+    if hists:
+        from tpuflow.obs import timeseries
+
+        windowed = timeseries.windowed_summaries(prefix)
     for name, h in hists:
-        for pk, pv in h.percentiles().items():
+        cum_p = h.percentiles()
+        win = windowed.get(name)
+        # all-or-nothing per histogram: an EMPTY window (ring ticking,
+        # no samples lately) falls back to the cumulative summary
+        # WHOLESALE, so the primary keys never vanish on a quiet lull
+        # and count/mean always describe the same samples as the
+        # percentiles beside them
+        use_win = bool(win and win["count"])
+        for pk, pv in (win["percentiles"] if use_win else cum_p).items():
             merged[f"{name}_{pk}"] = round(pv, 3)
-        if len(h):
+        for pk, pv in cum_p.items():
+            merged[f"{name}_{pk}_cum"] = round(pv, 3)
+        if use_win:
+            merged[f"{name}_count"] = float(win["count"])
+            merged[f"{name}_mean"] = round(win["mean"], 3)
+        elif len(h):
             merged[f"{name}_count"] = float(len(h))
             merged[f"{name}_mean"] = round(h.mean(), 3)
+        if len(h):
+            merged[f"{name}_count_cum"] = float(len(h))
     if prefix is not None:
         merged = {k: v for k, v in merged.items() if k.startswith(prefix)}
     return merged

@@ -1,0 +1,595 @@
+"""Health watchdogs (ISSUE 5 tentpole): non-finite guard, loss-spike
+detector, stall detector — the automatic half of observability.
+
+Goyal et al. (*Accurate, Large Minibatch SGD*, 2017) motivates the
+loss half: large-batch LR scaling is exactly the regime where a run
+diverges silently, and every unwatched step after the first NaN is a
+wasted chip-hour. The serving half is the wedged-scheduler problem: a
+thread that stops making progress keeps passing a liveness check
+forever. Three detectors, one :class:`Watchdog` trip surface:
+
+- **non-finite guard** — trainers (``TrainConfig.watchdog=True``) roll
+  a device-side ``isfinite(loss) & isfinite(grad_norm)`` flag into the
+  SAME metrics block every step already computes, so detection costs
+  zero extra host syncs; the still-device-resident block is handed to
+  :meth:`HealthMonitor.watch_device`, whose worker THREAD fetches it —
+  the training thread never blocks, and a NaN at step i is attributed
+  to step i (within-one-step granularity) as soon as the device
+  finishes it;
+- **EWMA loss-spike detector** (:class:`LossSpikeDetector`) — an
+  exponentially-weighted mean + absolute-deviation band; a loss far
+  above the band after warmup trips (divergence looks like this long
+  before it reaches inf);
+- **stall detector** (:class:`StallDetector`) — hot loops stamp
+  :func:`heartbeat` (one lock + dict store per DISPATCH, not per op);
+  a monitor thread trips when no registered heartbeat advanced within
+  ``timeout_s`` (no step / no decode segment completed — the wedge a
+  readiness probe must surface).
+
+A trip sets ``health.watchdog_tripped``/``health.trips_total`` gauges,
+records the reason + step, fires registered callbacks (the flight
+recorder's dump hook — :mod:`tpuflow.obs.flight`), and is visible to
+the serve frontend's readiness endpoint. Nothing in this module runs
+unless armed; the tier-1 overhead guard pins the disarmed cost.
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from tpuflow.obs.gauges import inc_counter, set_gauge
+
+# ---- heartbeats -----------------------------------------------------
+
+_HB_LOCK = threading.Lock()
+_HEARTBEATS: Dict[str, float] = {}
+
+
+def heartbeat(name: str, now: Optional[float] = None) -> None:
+    """Stamp liveness for ``name`` (monotonic clock). Called once per
+    trainer step / serve decode segment — cheap enough to stay
+    unconditional in production loops."""
+    t = time.monotonic() if now is None else now
+    with _HB_LOCK:
+        _HEARTBEATS[name] = t
+
+
+def heartbeat_ts(name: str) -> Optional[float]:
+    """Raw monotonic stamp of ``name``'s last beat (None = never) —
+    detectors compare this against their own arming anchor so a stamp
+    from a PREVIOUS run cannot read as current liveness."""
+    with _HB_LOCK:
+        return _HEARTBEATS.get(name)
+
+
+def heartbeat_age(name: str, now: Optional[float] = None
+                  ) -> Optional[float]:
+    """Seconds since ``name`` last beat (None = never)."""
+    t0 = heartbeat_ts(name)
+    if t0 is None:
+        return None
+    return (time.monotonic() if now is None else now) - t0
+
+
+def heartbeat_ages(prefix: Optional[str] = None,
+                   now: Optional[float] = None) -> Dict[str, float]:
+    t = time.monotonic() if now is None else now
+    with _HB_LOCK:
+        items = dict(_HEARTBEATS)
+    return {
+        k: t - v for k, v in items.items()
+        if prefix is None or k.startswith(prefix)
+    }
+
+
+def clear_heartbeats(prefix: Optional[str] = None) -> None:
+    with _HB_LOCK:
+        if prefix is None:
+            _HEARTBEATS.clear()
+        else:
+            for k in [k for k in _HEARTBEATS if k.startswith(prefix)]:
+                del _HEARTBEATS[k]
+
+
+# ---- trip surface ---------------------------------------------------
+
+class Watchdog:
+    """Latched trip state shared by every detector in a process.
+
+    ``trip`` is idempotent-ish (every call records, the FIRST sets the
+    latched reason), publishes ``health.*`` gauges, and fires
+    ``on_trip`` callbacks OUTSIDE the lock (a flight-recorder dump
+    must not deadlock a detector thread). A process-wide default
+    instance backs the trainers/serving runtime unless callers inject
+    their own."""
+
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self._lock = threading.Lock()
+        self.clock = clock
+        self.tripped = False
+        self.reason: Optional[str] = None
+        self.trips: List[Dict[str, Any]] = []
+        # monotonic, never reset: consumers that only care about trips
+        # since their own arming (a new fit on the shared process
+        # surface) remember this and compare — no global reset needed
+        self.trip_count = 0
+        self.on_trip: List[Callable[[Dict[str, Any]], None]] = []
+
+    def trip(self, reason: str, **detail: Any) -> Dict[str, Any]:
+        rec = {"reason": reason, "ts": self.clock(), **detail}
+        with self._lock:
+            first = not self.tripped
+            self.tripped = True
+            if first:
+                self.reason = reason
+            self.trips.append(rec)
+            self.trip_count += 1
+            if len(self.trips) > 64:
+                del self.trips[0]
+            cbs = list(self.on_trip)
+        set_gauge("health.watchdog_tripped", 1.0)
+        inc_counter("health.trips_total")
+        for cb in cbs:
+            try:
+                cb(rec)
+            except Exception:
+                pass  # a broken dump hook must not mask the trip
+        return rec
+
+    def reset(self) -> None:
+        with self._lock:
+            self.tripped = False
+            self.reason = None
+            self.trips.clear()
+        set_gauge("health.watchdog_tripped", 0.0)
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-able trip state (readiness endpoints, flight manifest)."""
+        with self._lock:
+            return {
+                "tripped": self.tripped,
+                "reason": self.reason,
+                "trips": [dict(t) for t in self.trips],
+            }
+
+
+_DEFAULT_WATCHDOG = Watchdog()
+
+
+def default_watchdog() -> Watchdog:
+    return _DEFAULT_WATCHDOG
+
+
+# ---- detectors ------------------------------------------------------
+
+class LossSpikeDetector:
+    """EWMA mean + EWMA absolute-deviation band over a loss series.
+
+    Trips when, after ``warmup`` updates, a value exceeds
+    ``mean + factor * dev`` AND ``mean * min_ratio`` (the ratio guard
+    keeps a converged flat loss from tripping on deviation noise —
+    dev → 0 makes any wiggle a large z-score). Non-finite values are
+    NOT this detector's job (the non-finite guard trips first) and are
+    skipped so one NaN cannot poison the running statistics."""
+
+    def __init__(self, factor: float = 6.0, alpha: float = 0.05,
+                 warmup: int = 20, min_ratio: float = 1.25):
+        if not 0 < alpha <= 1:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.factor = float(factor)
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.min_ratio = float(min_ratio)
+        self.mean: Optional[float] = None
+        self.dev = 0.0
+        self.n = 0
+
+    def update(self, value: float) -> bool:
+        """Feed one loss; True = spike (statistics NOT updated with
+        the spiking value, so a plateau at the spike level keeps
+        tripping rather than normalizing it)."""
+        v = float(value)
+        if not math.isfinite(v):
+            return False
+        if self.mean is None:
+            self.mean = v
+            self.n = 1
+            return False
+        spiking = (
+            self.n >= self.warmup
+            and v > self.mean + self.factor * self.dev
+            and v > self.mean * self.min_ratio
+        )
+        if not spiking:
+            a = self.alpha
+            self.dev = (1 - a) * self.dev + a * abs(v - self.mean)
+            self.mean = (1 - a) * self.mean + a * v
+            self.n += 1
+        return spiking
+
+
+class StallDetector:
+    """Trips when a registered heartbeat stops advancing.
+
+    ``check(now)`` is the synchronous decision (unit-testable with an
+    injectable clock); :meth:`start` runs it on a poll thread.
+
+    Staleness is anchored, never absolute — heartbeats are
+    process-global and outlive the run that stamped them, so raw age
+    would misfire in exactly the healthy cases:
+
+    - stamps from BEFORE this detector was armed are ignored (a
+      previous fit's ``train.step`` beat is history, not liveness);
+    - an ``active``-gated name re-anchors on every idle→busy
+      transition (a serving scheduler that sat idle for 5 minutes has
+      an arbitrarily old segment stamp the moment traffic resumes —
+      the stall clock must start at the transition, not at the last
+      pre-idle segment);
+    - a name that has never beat *since its anchor* trips only when
+      it beat earlier within this arming (it proved the loop reaches
+      it) or was registered ``require=True`` — a run that has not
+      reached that loop yet is not stalled."""
+
+    def __init__(self, timeout_s: float,
+                 watchdog: Optional[Watchdog] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.watchdog = watchdog or default_watchdog()
+        self.clock = clock
+        self._names: Dict[str, tuple] = {}
+        self._armed_at = self.clock()
+        self._anchor: Dict[str, float] = {}
+        self._idle: Dict[str, bool] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def watch(self, name: str, require: bool = False,
+              active: Optional[Callable[[], bool]] = None
+              ) -> "StallDetector":
+        """Watch ``name``. ``active`` gates the check: when it returns
+        False the name is skipped and the stall clock re-anchors when
+        it next returns True — e.g. an idle serving scheduler
+        legitimately stops decoding, so its segment heartbeat only
+        counts while work is pending (``active=lambda: not
+        sched.idle()``)."""
+        self._names[name] = (require, active)
+        return self
+
+    def check(self, now: Optional[float] = None) -> Optional[str]:
+        """The stalled name (and a watchdog trip), or None."""
+        t = self.clock() if now is None else now
+        for name, (require, active) in self._names.items():
+            if active is not None:
+                if not active():
+                    self._idle[name] = True
+                    continue
+                if self._idle.get(name, True):
+                    # idle→busy (or first look): the stall clock
+                    # starts NOW, not at the last pre-idle beat
+                    self._anchor[name] = t
+                    self._idle[name] = False
+            anchor = self._anchor.get(name, self._armed_at)
+            ts = heartbeat_ts(name)
+            if ts is not None and ts >= anchor:
+                age = t - ts
+            elif require or (ts is not None and ts >= self._armed_at):
+                # no beat since the anchor, but the name is required
+                # or beat earlier within THIS arming (so the loop
+                # provably reaches it): silence since the anchor is
+                # the signal. A stamp from BEFORE arming is a previous
+                # run's history and counts as never-beat.
+                age = t - anchor
+            else:
+                continue  # never beat: the run hasn't reached it yet
+            if age > self.timeout_s:
+                self.watchdog.trip(
+                    f"stall: no {name} heartbeat in {age:.1f}s "
+                    f"(timeout {self.timeout_s:g}s)",
+                    kind="stall", heartbeat=name, age_s=round(age, 3),
+                )
+                return name
+        return None
+
+    def start(self, poll_s: Optional[float] = None) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        poll = poll_s if poll_s is not None else max(
+            0.25, self.timeout_s / 4
+        )
+
+        def loop():
+            while not self._stop.wait(poll):
+                if self.check() is not None:
+                    return  # latched — one trip is the signal
+
+        self._thread = threading.Thread(
+            target=loop, name="tpuflow-stall-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+# ---- the trainer-facing monitor -------------------------------------
+
+class HealthMonitor:
+    """Per-run composition of the detectors for a training loop.
+
+    The hot-path contract: :meth:`watch_device` takes the step's
+    STILL-DEVICE-RESIDENT metrics block and returns immediately (a
+    bounded-queue handoff); the worker thread pays the device fetch,
+    runs the non-finite guard and the spike detector, and stamps the
+    ``train.step`` heartbeat. If the worker falls behind the queue
+    drops the OLDEST block (guarding is best-effort sampling, training
+    throughput is not negotiable) and counts the drop.
+
+    Scalar-side (already-fetched) checks go through :meth:`check_host`
+    — also what the unit tests drive with an injectable clock.
+    """
+
+    HEARTBEAT = "train.step"
+
+    def __init__(
+        self,
+        watchdog: Optional[Watchdog] = None,
+        spike_factor: float = 6.0,
+        spike_warmup: int = 20,
+        stall_timeout_s: Optional[float] = None,
+        queue_cap: int = 64,
+        guard_metrics: bool = True,
+    ):
+        # default to the PROCESS trip surface: flight-record manifests
+        # and the serve /readyz gate read default_watchdog(), so a
+        # trainer trip must land there, not on a private island (pass
+        # an explicit Watchdog for isolation — unit tests do)
+        self.watchdog = watchdog or default_watchdog()
+        self.spike = LossSpikeDetector(factor=spike_factor,
+                                       warmup=spike_warmup)
+        # guard_metrics=False: heartbeat-only mode (the stall detector
+        # is wanted, the NaN/spike guards are not — TrainConfig's
+        # stall_timeout_s without watchdog=True)
+        self.guard_metrics = bool(guard_metrics)
+        # active-gates the stall watch: the trainers pause() around
+        # legitimate non-step phases (epoch-end eval, checkpointing)
+        # whose wall time is allowed to exceed stall_timeout_s — the
+        # same idle→busy re-anchoring discipline as the serve side
+        self._active = True
+        self.stall: Optional[StallDetector] = None
+        if stall_timeout_s:
+            self.stall = StallDetector(stall_timeout_s,
+                                       watchdog=self.watchdog)
+            self.stall.watch(self.HEARTBEAT,
+                             active=lambda: self._active)
+            self.stall.start()
+        # trips BEFORE this arming belong to other surfaces/runs on
+        # the shared process watchdog: .tripped/.trips() see only
+        # newer ones, so a serve-side latched trip neither halts a
+        # fresh fit at step 0 nor gets erased by it
+        self._trip0 = self.watchdog.trip_count
+        self.dropped = 0
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_cap)
+        # queued + in-flight blocks: drain() must wait for the worker
+        # to FINISH the popped item, not just for an empty queue
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        # import on the CONSTRUCTING thread: a lazy import inside the
+        # worker can race another thread's in-progress `import jax`
+        # and observe a partially initialized module
+        import jax as _jax
+
+        self._jax = _jax
+        self._worker = threading.Thread(
+            target=self._drain, name="tpuflow-health-monitor",
+            daemon=True,
+        )
+        self._worker.start()
+
+    @property
+    def tripped(self) -> bool:
+        """True when the watchdog tripped SINCE this monitor armed."""
+        return self.watchdog.trip_count > self._trip0
+
+    def trips(self) -> List[Dict[str, Any]]:
+        """Trip records from this arming only (see ``_trip0``)."""
+        n = self.watchdog.trip_count - self._trip0
+        if n <= 0:
+            return []
+        return self.watchdog.state()["trips"][-n:]
+
+    def pause(self) -> None:
+        """Suspend the stall watch (legitimate non-step phase: eval,
+        checkpoint). The stall clock re-anchors on :meth:`resume` —
+        the pause's duration never reads as silence."""
+        self._active = False
+
+    def resume(self) -> None:
+        self._active = True
+
+    # ---- hot path (training thread) ---------------------------------
+    def watch_device(self, step: int, metrics: Dict[str, Any]) -> None:
+        """Hand off a device-resident metrics dict (scalars or
+        (k,)-stacked superstep blocks; keys used: ``loss``,
+        ``nonfinite``, ``grad_norm``). Never blocks the caller."""
+        with self._pending_lock:
+            self._pending += 1
+        try:
+            self._q.put_nowait((step, metrics))
+        except queue.Full:
+            try:
+                self._q.get_nowait()  # drop oldest, keep newest
+                self.dropped += 1
+                with self._pending_lock:
+                    self._pending -= 1
+            except queue.Empty:
+                pass
+            try:
+                self._q.put_nowait((step, metrics))
+            except queue.Full:
+                self.dropped += 1
+                with self._pending_lock:
+                    self._pending -= 1
+
+    # ---- worker / host side -----------------------------------------
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            step, metrics = item
+            try:
+                host = self._jax.device_get(metrics)
+                self.check_host(step, host)
+                heartbeat(self.HEARTBEAT)
+            except Exception:
+                pass  # donated/deleted buffer during shutdown
+            finally:
+                with self._pending_lock:
+                    self._pending -= 1
+
+    def check_host(self, step: int, metrics: Dict[str, Any]) -> bool:
+        """Synchronous guard over HOST values. ``metrics`` values may
+        be python floats, 0-d arrays, or (k,) superstep blocks;
+        ``step`` is the global index of the block's LAST step (== the
+        step itself for scalars), so a bad entry at block index i is
+        attributed to ``step - (k - 1) + i`` — within-one-step
+        granularity even for fused dispatches. Returns True if a trip
+        fired."""
+        if not self.guard_metrics:
+            return False  # heartbeat-only mode (stall watch without
+            # the NaN/spike guards the `watchdog` flag opts into)
+        import numpy as np
+
+        losses = np.atleast_1d(
+            np.asarray(metrics.get("loss", np.nan), np.float64)
+        )
+        k = losses.shape[0]
+        flags = metrics.get("nonfinite")
+        bad = (
+            np.atleast_1d(np.asarray(flags, np.float64)) > 0
+            if flags is not None else ~np.isfinite(losses)
+        )
+        gn = metrics.get("grad_norm")
+        if gn is not None:
+            bad = bad | ~np.isfinite(
+                np.atleast_1d(np.asarray(gn, np.float64))
+            )
+        if bad.any():
+            i = int(np.argmax(bad))
+            at = step - k + 1 + i
+            self.watchdog.trip(
+                f"non-finite loss/grad at step {at} "
+                f"(loss={losses[min(i, k - 1)]!r})",
+                kind="nonfinite", step=at,
+            )
+            return True
+        for i, v in enumerate(losses):
+            if self.spike.update(float(v)):
+                at = step - k + 1 + i
+                self.watchdog.trip(
+                    f"loss spike at step {at}: {v:.4g} vs EWMA "
+                    f"{self.spike.mean:.4g} (±{self.spike.dev:.4g})",
+                    kind="loss_spike", step=at, loss=float(v),
+                    ewma=float(self.spike.mean),
+                )
+                return True
+        return False
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Block until queued AND in-flight blocks are fully checked
+        (epoch boundaries, tests) — the one place the training thread
+        may wait."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._pending_lock:
+                if self._pending <= 0:
+                    return
+            time.sleep(0.005)
+
+    def close(self) -> None:
+        self.drain()
+        if self.stall is not None:
+            self.stall.stop()
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+
+
+def closing(monitor: Optional[HealthMonitor]):
+    """Context manager closing ``monitor`` (None accepted) on exit —
+    the fit loops ride this inside their existing ``with`` so an
+    exception mid-epoch cannot leak the stall thread, which would
+    otherwise fire a spurious latched 'stall' trip (and flight dump)
+    once the heartbeats stop."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        try:
+            yield monitor
+        finally:
+            if monitor is not None:
+                monitor.close()
+
+    return _cm()
+
+
+def monitor_from_config(cfg) -> Optional[HealthMonitor]:
+    """The trainers' one-liner: build a :class:`HealthMonitor` from
+    ``TrainConfig``'s plane fields (``watchdog`` / ``stall_timeout_s``
+    / ``flight_dir``), start the Prometheus exporter when
+    ``metrics_port`` is set, and wire the flight recorder: watchdog
+    trips dump into ``flight_dir``, and ``flight.install`` captures
+    unhandled exceptions there too (SIGTERM stays the preemption
+    machinery's channel during a fit — train/preempt.py owns that
+    handler). Returns None when no watchdog is armed — the fit loop's
+    per-step cost is then a single ``is not None`` check."""
+    port = getattr(cfg, "metrics_port", None)
+    if port is not None:
+        from tpuflow.obs import prom
+
+        prom.start_exporter(port)
+    flight_dir = getattr(cfg, "flight_dir", None)
+    if flight_dir:
+        from tpuflow.obs import flight
+
+        flight.install(flight_dir)  # unhandled exception -> bundle
+    if not (getattr(cfg, "watchdog", False)
+            or getattr(cfg, "stall_timeout_s", None)):
+        return None
+    # the monitor rides the PROCESS default watchdog (so /readyz and
+    # flight manifests see trainer trips) but only reacts to trips
+    # NEWER than its own arming — a prior run's latched trip neither
+    # halts the new fit at step 0 nor gets erased here
+    mon = HealthMonitor(
+        stall_timeout_s=getattr(cfg, "stall_timeout_s", None),
+        # stall_timeout_s ALONE is heartbeat-only: the NaN/spike
+        # guards belong to the `watchdog` flag (config contract)
+        guard_metrics=bool(getattr(cfg, "watchdog", False)),
+    )
+    if flight_dir:
+        from tpuflow.obs import flight
+
+        wd = mon.watchdog
+        # the watchdog is process-shared: replace the dump hook a
+        # PREVIOUS FIT installed instead of stacking duplicates — but
+        # only ours (tagged _trainer_flight); a serve frontend's
+        # dumper on the same watchdog targets its own directory and
+        # must keep firing
+        wd.on_trip = [cb for cb in wd.on_trip
+                      if not getattr(cb, "_trainer_flight", False)]
+        hook = flight.trip_dumper(flight_dir)
+        hook._trainer_flight = True
+        wd.on_trip.append(hook)
+    return mon

@@ -1,0 +1,228 @@
+"""Prometheus / OpenMetrics text exposition of the gauge registry
+(ISSUE 5 tentpole) — the scrape-able half of the metrics plane.
+
+``/v1/metrics`` keeps its ad-hoc JSON for humans and tests;
+:func:`render` speaks the text exposition format (version 0.0.4) a
+Prometheus server actually ingests: ``# HELP``/``# TYPE`` headers,
+``gauge`` samples, monotone ``counter`` samples (``_total`` suffix
+enforced), and classic histogram families — cumulative ``le``-labelled
+``_bucket`` counts, ``_sum`` and ``_count`` — rendered straight from
+:meth:`tpuflow.obs.gauges.Histogram.state`. Windowing is deliberately
+NOT done here: Prometheus differences cumulative buckets itself
+(``histogram_quantile(rate(..._bucket[5m]))``); the in-process windowed
+view lives in :mod:`tpuflow.obs.timeseries`.
+
+Exposed bucket bounds are the shared fixed grid COARSENED by taking
+every ``stride``-th bound (default 8 → exact powers of two of 1e-3,
+~34 buckets instead of ~290): cumulative counts at surviving bounds
+are exact (fine buckets nest inside coarse ones), Prometheus's own
+interpolation error grows to the coarse bucket (~2x per bucket), and a
+scrape stays a few KB per histogram.
+
+Two servers can expose this text:
+
+- the serve HTTP frontend's ``GET /metrics``
+  (:mod:`tpuflow.serve.http`);
+- :func:`start_exporter` — a standalone stdlib HTTP thread for
+  processes with no serving frontend (trainers:
+  ``TrainConfig.metrics_port``).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterable, Optional
+
+from tpuflow.obs.gauges import (
+    bucket_bounds,
+    counters,
+    histograms,
+    scalar_gauges,
+)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def metric_name(name: str) -> str:
+    """Dotted registry name → valid Prometheus metric name
+    (``serve.ttft_ms`` → ``serve_ttft_ms``; leading digits guarded)."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not _NAME_OK.match(out):
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    """Sample value formatting: integers render bare (bucket counts),
+    specials as +Inf/-Inf/NaN per the text format."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render(prefix: Optional[str] = None, stride: int = 8) -> str:
+    """The full exposition: every gauge, counter and histogram
+    (optionally filtered to registry names under ``prefix``).
+    ``stride`` coarsens the exposed bucket grid (1 = every fine
+    bucket)."""
+    if stride < 1:
+        raise ValueError(f"stride must be >= 1, got {stride}")
+    lines = []
+    hists = histograms(prefix)
+    cntrs = counters(prefix)
+    # plain gauges only: histogram families are exported as buckets
+    # below (their derived p50/p95 summary keys are a JSON-surface
+    # convenience, re-derivable by any Prometheus consumer), and
+    # snapshot_gauges would pay a windowed-delta walk per scrape just
+    # to have its summary keys filtered back out here
+    scalars = scalar_gauges(prefix)
+    for name in sorted(scalars):
+        mn = metric_name(name)
+        lines.append(f"# HELP {mn} tpuflow gauge {name}")
+        lines.append(f"# TYPE {mn} gauge")
+        lines.append(f"{mn} {_fmt(scalars[name])}")
+    for name in sorted(cntrs):
+        mn = metric_name(name)
+        if not mn.endswith("_total"):
+            mn += "_total"
+        lines.append(f"# HELP {mn} tpuflow counter {name}")
+        lines.append(f"# TYPE {mn} counter")
+        lines.append(f"{mn} {_fmt(cntrs[name])}")
+    bounds = bucket_bounds()
+    # every stride-th bound STARTING AT THE FIRST: with the default
+    # stride 8 on the 2**(1/8) grid that is exactly 1e-3 * 2^k — the
+    # readable power-of-two labels the docstring promises. Cumulative
+    # counts are exact at ANY subset of the fine bounds.
+    coarse = list(range(0, len(bounds), stride))
+    for name in sorted(hists):
+        st = hists[name].state()
+        mn = metric_name(name)
+        lines.append(f"# HELP {mn} tpuflow histogram {name}")
+        lines.append(f"# TYPE {mn} histogram")
+        cum = 0
+        i0 = 0
+        for bi in coarse:
+            cum += sum(st["counts"][i0:bi + 1])
+            i0 = bi + 1
+            # 6 significant digits: the repeated-multiplication grid
+            # carries float dust (1e-3*2^1 accumulates to
+            # 0.0020000000000000005) that would make every le label
+            # 17 digits of noise in dashboards
+            lines.append(
+                f'{mn}_bucket{{le="{bounds[bi]:.6g}"}} {cum}'
+            )
+        cum += sum(st["counts"][i0:])
+        lines.append(f'{mn}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{mn}_sum {_fmt(st['total'])}")
+        lines.append(f"{mn}_count {st['n']}")
+    return "\n".join(lines) + "\n"
+
+
+# ---- standalone exporter (trainers / exporter-only processes) -------
+
+class _ExporterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "tpuflow-metrics/0.1"
+
+    def log_message(self, fmt, *args):  # scrapers are chatty
+        pass
+
+    def do_GET(self):
+        if self.path.split("?")[0] in ("/metrics", "/"):
+            body = render(self.server.metrics_prefix).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+        elif self.path == "/healthz":
+            body = b'{"ok": true}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        else:
+            body = b"not found\n"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MetricsExporter(ThreadingHTTPServer):
+    """Stdlib HTTP server exposing ``GET /metrics`` (+ a trivial
+    ``/healthz`` liveness probe) for one process's registry."""
+
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 prefix: Optional[str] = None):
+        super().__init__((host, port), _ExporterHandler)
+        self.metrics_prefix = prefix
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def shutdown(self):
+        with _STARTED_LOCK:  # so a later start_exporter(port) rebinds
+            _STARTED.pop(getattr(self, "_requested_port", self.port),
+                         None)
+        if getattr(self, "_ring_ref", False):
+            from tpuflow.obs import timeseries
+
+            self._ring_ref = False
+            timeseries.release()
+        super().shutdown()
+
+
+_STARTED: Dict[int, "MetricsExporter"] = {}
+_STARTED_LOCK = threading.Lock()
+
+
+def start_exporter(port: int = 0, host: str = "127.0.0.1",
+                   prefix: Optional[str] = None,
+                   start_ring: bool = True) -> MetricsExporter:
+    """Start the exporter thread (``port=0`` = ephemeral, read
+    ``.port`` back). Idempotent per REQUESTED port — a second fit()
+    on the same ``TrainConfig.metrics_port`` reuses the running
+    exporter instead of dying on EADDRINUSE, and repeated
+    ``port=0`` requests reuse the process's one ephemeral exporter
+    instead of leaking a server thread per fit. ``start_ring`` also
+    starts the default timeseries ring so the windowed surfaces stay
+    meaningful alongside the scrape. Stop with
+    ``exporter.shutdown()``."""
+    with _STARTED_LOCK:
+        if port in _STARTED:
+            cached = _STARTED[port]
+            if (cached.server_address[0], cached.metrics_prefix) != (
+                    host, prefix):
+                # silently returning a server bound elsewhere (or
+                # scoped differently) would hand the caller an
+                # endpoint that does not do what they asked
+                raise ValueError(
+                    f"exporter for port {port} already running on "
+                    f"{cached.server_address[0]} with prefix "
+                    f"{cached.metrics_prefix!r}; shutdown() it first "
+                    f"to rebind ({host!r}, {prefix!r})"
+                )
+            return cached
+        server = MetricsExporter(host, port, prefix)
+        server._requested_port = port
+        _STARTED[port] = server
+    if start_ring:
+        from tpuflow.obs import timeseries
+
+        timeseries.ensure()  # released in server.shutdown()
+        server._ring_ref = True
+    threading.Thread(target=server.serve_forever,
+                     name="tpuflow-metrics-exporter",
+                     daemon=True).start()
+    return server

@@ -1,0 +1,337 @@
+"""Windowed time-series over the gauge registry (ISSUE 5 tentpole).
+
+:mod:`tpuflow.obs.gauges` histograms accumulate over the process
+lifetime — O(1) memory, but after a long healthy run a regression moves
+the p95/p99 only slowly (the cumulative-vs-windowed trade their
+docstring documents). This module closes it WITHOUT giving up the
+fixed-bucket representation: a :class:`SnapshotRing` captures every
+registered histogram's raw bucket counts (plus gauges and counters) on
+a fixed cadence, and a *windowed* percentile is computed by
+DELTA-DIFFERENCING bucket counts between the live state and the
+snapshot one window ago — exactly the rate()/increase() idiom a
+Prometheus server applies to exported ``le`` buckets, done in-process
+so ``/v1/metrics`` and ``snapshot_gauges`` can quote trailing-window
+p50/p95/p99 directly.
+
+Resolution is unchanged (same bucket grid, same log-interpolated
+nearest-rank math — the documented ±~one-bucket error); the window
+boundary is quantized to the snapshot cadence (a "60 s" window over a
+10 s cadence covers 60±10 s of observations). The windowed min/max are
+unknowable from count deltas, so interpolation clamps to the delta's
+occupied bucket bounds instead of observed extremes — still within one
+bucket of exact.
+
+One process-wide default ring (`start`/`stop`/`tick`) feeds
+``snapshot_gauges``'s primary percentile keys; serve and trainer
+runtimes start it when their metrics surface comes up. Nothing here
+runs unless started: an idle module costs one dict lookup per
+``snapshot_gauges`` call.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from tpuflow.obs.gauges import (
+    _HIST_BOUNDS,
+    Histogram,
+    counters as _counters,
+    histograms as _histograms,
+    scalar_gauges as _scalar_gauges,
+)
+
+
+def delta_histogram(cur: Dict[str, Any],
+                    base: Optional[Dict[str, Any]]) -> Histogram:
+    """Histogram holding the observations BETWEEN two
+    :meth:`Histogram.state` captures (``base`` None = since process
+    start). Bucket counts subtract (clamped at 0: a reset/replaced
+    histogram under-reports until the baseline rotates out rather than
+    going negative); min/max come from the delta's occupied buckets."""
+    h = Histogram()
+    bc = base["counts"] if base else None
+    deltas = [
+        max(0, c - (bc[i] if bc else 0))
+        for i, c in enumerate(cur["counts"])
+    ]
+    h.counts = deltas
+    h.n = sum(deltas)
+    h.total = max(0.0, cur["total"] - (base["total"] if base else 0.0))
+    lo_i = next((i for i, c in enumerate(deltas) if c), None)
+    if lo_i is not None:
+        hi_i = max(i for i, c in enumerate(deltas) if c)
+        # window extremes are unknowable from count deltas: clamp to
+        # bucket bounds (cumulative vmin/vmax still tighten the outer
+        # buckets, whose bounds are the anchor values)
+        h.vmin = (_HIST_BOUNDS[lo_i - 1] if lo_i > 0
+                  else min(cur["vmin"], _HIST_BOUNDS[0]))
+        h.vmax = (_HIST_BOUNDS[hi_i] if hi_i < len(_HIST_BOUNDS)
+                  else max(cur["vmax"], _HIST_BOUNDS[-1]))
+    return h
+
+
+class SnapshotRing:
+    """Fixed-interval snapshot ring over the gauge registry.
+
+    Each :meth:`tick` appends ``{ts, hists: {name: state}, gauges,
+    counters}``; the ring keeps ``capacity`` newest (default sized so
+    the whole ring spans ~2x the window). Thread-safe; the clock is
+    injectable for tests. Drive it manually (:meth:`tick`) or with
+    :meth:`start`'s daemon thread."""
+
+    def __init__(self, interval_s: float = 10.0, window_s: float = 60.0,
+                 capacity: Optional[int] = None,
+                 clock=time.time):
+        if interval_s <= 0 or window_s <= 0:
+            raise ValueError(
+                f"interval_s/window_s must be > 0, got "
+                f"{interval_s}/{window_s}"
+            )
+        self.interval_s = float(interval_s)
+        self.window_s = float(window_s)
+        if capacity is None:
+            capacity = max(8, int(2 * window_s / interval_s) + 2)
+        self.capacity = int(capacity)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._snaps: List[Dict[str, Any]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ---- capture ----------------------------------------------------
+    def tick(self) -> None:
+        """Capture one snapshot of every registered histogram's raw
+        state plus the scalar gauges/counters."""
+        snap = {
+            "ts": self.clock(),
+            "hists": {n: h.state() for n, h in _histograms().items()},
+            "gauges": _scalar_gauges(),
+            "counters": _counters(),
+        }
+        with self._lock:
+            self._snaps.append(snap)
+            if len(self._snaps) > self.capacity:
+                del self._snaps[: len(self._snaps) - self.capacity]
+
+    def start(self) -> None:
+        """Spawn the fixed-interval ticker thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.tick()
+
+        self._thread = threading.Thread(
+            target=loop, name="tpuflow-metrics-ring", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._snaps.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._snaps)
+
+    # ---- windowed reads ---------------------------------------------
+    def _baseline(self, window_s: Optional[float],
+                  now: Optional[float]) -> Optional[Dict[str, Any]]:
+        """The NEWEST snapshot at least ``window_s`` old (so the delta
+        spans >= one window), else the oldest available, else None
+        (ring empty → delta degenerates to the cumulative state)."""
+        w = self.window_s if window_s is None else float(window_s)
+        t = self.clock() if now is None else now
+        with self._lock:
+            if not self._snaps:
+                return None
+            older = [s for s in self._snaps if t - s["ts"] >= w]
+            return older[-1] if older else self._snaps[0]
+
+    def windowed(self, name: str, window_s: Optional[float] = None,
+                 now: Optional[float] = None) -> Optional[Histogram]:
+        """Histogram of roughly the last ``window_s`` of observations
+        of registry histogram ``name`` (None if never registered)."""
+        h = _histograms().get(name)
+        if h is None:
+            return None
+        base = self._baseline(window_s, now)
+        return delta_histogram(
+            h.state(), (base or {}).get("hists", {}).get(name)
+        )
+
+    def windowed_percentiles(
+        self, name: str, window_s: Optional[float] = None,
+        pcts=(50.0, 95.0, 99.0),
+    ) -> Dict[str, float]:
+        """``{"p50": ...}`` over the trailing window (empty when the
+        histogram is unknown or saw no samples in the window)."""
+        h = self.windowed(name, window_s)
+        return h.percentiles(pcts) if h is not None else {}
+
+    def summaries(self, window_s: Optional[float] = None,
+                  prefix: Optional[str] = None
+                  ) -> Dict[str, Dict[str, Any]]:
+        """Windowed percentiles + count + mean for every registered
+        histogram (optionally only those under ``prefix`` — the
+        delta-differencing is the expensive part, so callers filter
+        BEFORE it, not after) — what ``snapshot_gauges`` merges as its
+        primary percentile keys."""
+        base = self._baseline(window_s, None)
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, h in _histograms(prefix).items():
+            d = delta_histogram(
+                h.state(), (base or {}).get("hists", {}).get(name)
+            )
+            out[name] = {
+                "percentiles": d.percentiles(),
+                "count": d.n,
+                "mean": (d.total / d.n) if d.n else math.nan,
+            }
+        return out
+
+    def counter_rate(self, name: str,
+                     window_s: Optional[float] = None) -> Optional[float]:
+        """Per-second increase of counter ``name`` over the window
+        (None without a baseline — a rate needs two points in time)."""
+        base = self._baseline(window_s, None)
+        if base is None or name not in base["counters"]:
+            return None
+        dt = self.clock() - base["ts"]
+        if dt <= 0:
+            return None
+        cur = _counters().get(name, 0.0)
+        return max(0.0, cur - base["counters"][name]) / dt
+
+    # ---- export -----------------------------------------------------
+    def export(self) -> Dict[str, Any]:
+        """JSON-able dump of the ring — per-snapshot counters and
+        histogram counts/totals (bucket arrays elided: the flight
+        recorder wants the series shape, not 300 ints per hist per
+        tick) plus the current windowed summaries. The run-scoped
+        persistence payload (track/ store artifacts) and the flight
+        recorder both write this."""
+        with self._lock:
+            snaps = list(self._snaps)
+        series = [{
+            "ts": s["ts"],
+            "gauges": dict(s.get("gauges", {})),
+            "counters": dict(s["counters"]),
+            "hists": {
+                n: {"n": st["n"], "total": st["total"]}
+                for n, st in s["hists"].items()
+            },
+        } for s in snaps]
+        summ = {
+            n: {"percentiles": d["percentiles"], "count": d["count"],
+                "mean": None if math.isnan(d["mean"]) else d["mean"]}
+            for n, d in self.summaries().items()
+        }
+        return {
+            "interval_s": self.interval_s,
+            "window_s": self.window_s,
+            "n_snapshots": len(series),
+            "snapshots": series,
+            "windowed": summ,
+            # scalars + counters directly: the histogram summaries are
+            # already in `windowed`, and snapshot_gauges would re-walk
+            # every registry delta a second time for nothing
+            "gauges": {**_scalar_gauges(), **_counters()},
+        }
+
+
+# ---- process-wide default ring --------------------------------------
+
+_DEFAULT: Optional[SnapshotRing] = None
+_DEFAULT_LOCK = threading.Lock()
+# ensure()/release() refcount: metrics surfaces (serve frontend, prom
+# exporter) acquire the ring for their lifetime; the LAST release of
+# an ensure-created ring stops it, so no surface is ever left with a
+# leaked ticker thread OR has a shared ring stopped out from under it
+_REFS = 0
+_OWNED = False  # ring was created through ensure() (refcount applies)
+
+
+def default_ring() -> Optional[SnapshotRing]:
+    """The process default ring (None until :func:`start`)."""
+    return _DEFAULT
+
+
+def start(interval_s: float = 10.0, window_s: float = 60.0,
+          thread: bool = True) -> SnapshotRing:
+    """Start (or return) the process default ring, un-refcounted — for
+    drivers that own the process lifetime (tests; epoch-cadence
+    trainers with ``thread=False`` driving :meth:`~SnapshotRing.tick`
+    themselves). Surfaces with a shutdown path should pair
+    :func:`ensure`/:func:`release` instead."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = SnapshotRing(interval_s, window_s)
+        if thread:
+            _DEFAULT.start()
+        return _DEFAULT
+
+
+def ensure(interval_s: float = 10.0, window_s: float = 60.0,
+           thread: bool = True) -> SnapshotRing:
+    """Acquire the default ring (creating it if needed) and hold a
+    reference; pair with :func:`release`. Creation and the ownership
+    decision happen atomically under one lock — two surfaces starting
+    concurrently cannot both believe they created it."""
+    global _DEFAULT, _REFS, _OWNED
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = SnapshotRing(interval_s, window_s)
+            _OWNED = True
+        if thread:
+            _DEFAULT.start()
+        _REFS += 1
+        return _DEFAULT
+
+
+def release() -> None:
+    """Drop one :func:`ensure` reference; the last one out stops an
+    ensure-created ring (a plain :func:`start` ring is never stopped
+    here — its creator owns the process lifetime)."""
+    global _REFS
+    last = False
+    with _DEFAULT_LOCK:
+        _REFS = max(0, _REFS - 1)
+        last = _REFS == 0 and _OWNED and _DEFAULT is not None
+    if last:
+        stop()
+
+
+def stop() -> None:
+    """Force-stop and drop the default ring regardless of references
+    (test isolation; process shutdown)."""
+    global _DEFAULT, _REFS, _OWNED
+    with _DEFAULT_LOCK:
+        if _DEFAULT is not None:
+            _DEFAULT.stop()
+            _DEFAULT = None
+        _REFS = 0
+        _OWNED = False
+
+
+def windowed_summaries(prefix: Optional[str] = None
+                       ) -> Dict[str, Dict[str, Any]]:
+    """Default-ring windowed summaries, ``{}`` when no ring is ticking
+    or it has no baseline yet — the ``snapshot_gauges`` fast path (one
+    None check when the plane is idle)."""
+    ring = _DEFAULT
+    if ring is None or not len(ring):
+        return {}
+    return ring.summaries(prefix=prefix)

@@ -42,12 +42,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="enable the tpuflow.obs.trace span tracer "
                         "(request ids become trace ids; inspect via "
                         "GET /v1/trace/<id>)")
+    p.add_argument("--stall-timeout", type=float, default=None,
+                   metavar="S",
+                   help="arm the stall watchdog: trip (latched; fail "
+                        "/readyz) when S seconds pass without a decode "
+                        "segment completing, once one ever has. Set S "
+                        "above the worst-case first-touch pool compile "
+                        "of a NEW bucket — that window pauses segments "
+                        "legitimately. (/readyz itself also reports "
+                        "not-ready during such pauses and self-heals; "
+                        "only the watchdog latches.)")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="arm the flight recorder: dump a post-mortem "
+                        "bundle under DIR on watchdog trip, unhandled "
+                        "exception or SIGTERM (inspect via python -m "
+                        "tpuflow.cli.obs postmortem DIR)")
     args = p.parse_args(argv)
 
     if args.trace_spans:
         from tpuflow.obs import trace as _trace
 
         _trace.enable()
+    if args.flight_dir:
+        from tpuflow.obs import flight as _flight
+        from tpuflow.obs.health import default_watchdog
+
+        _flight.install(args.flight_dir, signals=True)
+        default_watchdog().on_trip.append(
+            _flight.trip_dumper(args.flight_dir)
+        )
 
     from tpuflow.serve.http import start_http_server
     from tpuflow.serve.scheduler import ServeScheduler
@@ -56,6 +79,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.model, slots=args.slots, seg=args.seg, rounds=args.rounds,
         max_new_cap=args.max_new, max_queue=args.max_queue,
     )
+    if args.stall_timeout:
+        from tpuflow.obs.health import StallDetector
+
+        sched.stall_after_s = float(args.stall_timeout)
+        # watch SEGMENTS, not the loop: the loop heartbeat goes quiet
+        # during a first-touch pool compile too, and a latched trip on
+        # a cold start would 503 /readyz forever. The segment name
+        # only starts counting once a segment has ever completed
+        # (require=False), so the cold-compile window cannot false-
+        # trip; a pre-first-segment wedge is still caught by /readyz's
+        # (non-latching) loop-age fallback.
+        detector = StallDetector(float(args.stall_timeout))
+        detector.watch(f"{sched.metrics.prefix}.segment",
+                       active=lambda: not sched.idle())
+        detector.start()
     server = start_http_server(sched, args.host, args.port,
                                request_timeout_s=args.request_timeout)
     print(f"serving {args.model} on http://{args.host}:{server.port} "

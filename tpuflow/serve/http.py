@@ -16,12 +16,20 @@ Endpoints::
       → 429 + Retry-After on admission-queue backpressure (QueueFull)
       → 400 on never-servable requests (too long, bad budget)
   POST /v1/cancel     {"id": ...} → {"cancelled": bool}
-  GET  /v1/metrics    scheduler + gauge snapshot (JSON)
+  GET  /v1/metrics    scheduler + gauge snapshot (JSON; windowed
+                      percentiles primary, cumulative under _cum)
+  GET  /metrics       Prometheus/OpenMetrics text exposition of the
+                      whole gauge registry (tpuflow.obs.prom)
   GET  /v1/events/ID  structured event log for one request id
   GET  /v1/trace/ID   host spans of one request (trace id == request
                       id — tpuflow.obs.trace; [] unless the tracer is
                       enabled: TPUFLOW_TRACE_SPANS=1 or --trace-spans)
-  GET  /healthz       {"ok": true, ...}
+  GET  /healthz       LIVENESS: {"ok": true, ...} whenever the process
+                      answers — never consults scheduler progress
+  GET  /readyz        READINESS: 200 only while the scheduler is open,
+                      unwedged and watchdog-clean; 503 + the reason
+                      otherwise (wire THIS one to the load balancer —
+                      a wedged scheduler keeps passing /healthz)
 """
 
 from __future__ import annotations
@@ -88,12 +96,33 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         sched = self.server.scheduler
         if self.path == "/healthz":
-            self._json(200, {"ok": True, "idle": sched.idle()})
+            # liveness ONLY: answering at all is the signal (progress
+            # lives in /readyz). `ok` is kept for old callers.
+            self._json(200, {"ok": True, "live": True,
+                             "idle": sched.idle()})
+        elif self.path == "/readyz":
+            r = sched.readiness()
+            self._json(200 if r["ready"] else 503, r)
+        elif self.path == "/metrics":
+            from tpuflow.obs.prom import CONTENT_TYPE, render
+
+            body = render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif self.path == "/v1/metrics":
-            from tpuflow.obs.gauges import snapshot_gauges
+            # scalars + counters only: metrics_snapshot already carries
+            # the latency percentiles (windowed + _cum), and a full
+            # snapshot_gauges would re-walk every registry histogram's
+            # windowed delta just to overwrite those keys with equal
+            # values
+            from tpuflow.obs.gauges import counters, scalar_gauges
 
             snap = sched.metrics_snapshot()
-            snap.update(snapshot_gauges("serve"))
+            snap.update(scalar_gauges("serve"))
+            snap.update(counters("serve"))
             self._json(200, snap)
         elif self.path.startswith("/v1/events/"):
             rid = self.path[len("/v1/events/"):]
@@ -221,15 +250,36 @@ class ServeHTTPServer(ThreadingHTTPServer):
     def port(self) -> int:
         return self.server_address[1]
 
+    def shutdown(self):
+        # drop this frontend's reference on the process snapshot ring:
+        # the last surface out stops it (no leaked ticker thread), and
+        # another live surface's reference keeps it ticking. Guarded
+        # so repeated shutdown() calls (a natural finally-block
+        # pattern) release exactly the one reference we acquired.
+        if getattr(self, "_ring_ref", False):
+            from tpuflow.obs import timeseries
+
+            self._ring_ref = False
+            timeseries.release()
+        super().shutdown()
+
 
 def start_http_server(scheduler, host: str = "127.0.0.1", port: int = 0,
                       request_timeout_s: float = 120.0) -> ServeHTTPServer:
     """Start the scheduler loop (if needed) and an HTTP server thread;
     ``port=0`` binds an ephemeral port (read it back from ``.port``).
     Stop with ``server.shutdown()`` (scheduler stays up — stop it via
-    ``scheduler.stop()``)."""
+    ``scheduler.stop()``). Starts the metrics-plane snapshot ring so
+    ``/v1/metrics`` percentiles are windowed for a long-lived server
+    (one daemon thread, one registry walk per tick)."""
+    from tpuflow.obs import timeseries
+
     scheduler.start()
+    # bind FIRST: acquiring the ring reference before a failing bind
+    # (EADDRINUSE) would leak the ref and its ticker thread
     server = ServeHTTPServer(scheduler, host, port, request_timeout_s)
+    timeseries.ensure()  # released in server.shutdown()
+    server._ring_ref = True
     threading.Thread(target=server.serve_forever, name="tpuflow-serve-http",
                      daemon=True).start()
     return server

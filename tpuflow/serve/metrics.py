@@ -28,7 +28,12 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
-from tpuflow.obs.gauges import Histogram, inc_counter, set_gauge
+from tpuflow.obs.gauges import (
+    Histogram,
+    inc_counter,
+    register_histogram,
+    set_gauge,
+)
 from tpuflow.serve.request import Request
 
 
@@ -69,12 +74,18 @@ class ServeMetrics:
     (the ``-http-`` access log, a chatty client reusing one id) cannot
     grow without limit either.
 
-    The histograms accumulate over the PROCESS lifetime (the old
-    4096-sample sliding window is gone): after a long healthy run a
-    regression moves the p95/p99 only slowly. A monitoring consumer
-    that wants windowed percentiles should difference the exported
-    ``_count`` between scrapes or call :meth:`reset_latency` on its
-    scrape cadence."""
+    The histograms accumulate over the PROCESS lifetime and are
+    REGISTERED in the process gauge registry (``<prefix>.ttft_ms``
+    etc.), so the metrics plane's consumers all read the same
+    instances: the Prometheus exposition (``GET /metrics``) renders
+    their cumulative ``le`` buckets, the :mod:`tpuflow.obs.timeseries`
+    snapshot ring delta-differences them into *windowed* percentiles,
+    and :meth:`snapshot` quotes those windowed numbers as its primary
+    ``_p50/_p95/_p99`` keys (cumulative kept under a ``_cum`` suffix)
+    — closing the cumulative-vs-windowed trade this docstring used to
+    document as the consumer's problem. Without a ticking ring the
+    windowed view degenerates to cumulative (same keys, same values);
+    :meth:`reset_latency` stays for hard restarts."""
 
     def __init__(self, max_event_requests: int = 512,
                  gauge_prefix: str = "serve",
@@ -86,10 +97,14 @@ class ServeMetrics:
             "submitted": 0, "rejected": 0, "admitted": 0, "done": 0,
             "cancelled": 0, "expired": 0,
         }
-        self.ttft_ms = Histogram()
-        self.queue_wait_ms = Histogram()
-        self.decode_ms = Histogram()
-        self.e2e_ms = Histogram()
+        self.ttft_ms = register_histogram(
+            f"{gauge_prefix}.ttft_ms", Histogram())
+        self.queue_wait_ms = register_histogram(
+            f"{gauge_prefix}.queue_wait_ms", Histogram())
+        self.decode_ms = register_histogram(
+            f"{gauge_prefix}.decode_ms", Histogram())
+        self.e2e_ms = register_histogram(
+            f"{gauge_prefix}.e2e_ms", Histogram())
         self.tokens_out = 0
         self.segments = 0
         self.segment_live_rows = 0
@@ -188,7 +203,15 @@ class ServeMetrics:
 
     # ---- export -----------------------------------------------------
     def snapshot(self) -> Dict[str, float]:
-        """Flat dotted-key snapshot (run-metric loggable as-is)."""
+        """Flat dotted-key snapshot (run-metric loggable as-is).
+        Latency percentiles are WINDOWED when the timeseries default
+        ring is ticking (``_cum`` carries all-time); without a ring
+        both views are the cumulative values (see class docstring)."""
+        from tpuflow.obs import timeseries
+
+        # ONE windowed pass over this prefix's histograms (summaries
+        # filters before the expensive delta-differencing)
+        windowed = timeseries.windowed_summaries(f"{self.prefix}.")
         with self._lock:
             m: Dict[str, float] = {
                 f"{self.prefix}.{k}": float(v) for k, v in self.counts.items()
@@ -204,6 +227,11 @@ class ServeMetrics:
                            ("queue_wait_ms", self.queue_wait_ms),
                            ("decode_ms", self.decode_ms),
                            ("e2e_ms", self.e2e_ms)):
-            for pk, pv in hist.percentiles().items():
+            cum = hist.percentiles()
+            win = windowed.get(f"{self.prefix}.{name}")
+            prim = (win["percentiles"] if win else {}) or cum
+            for pk, pv in prim.items():
                 m[f"{self.prefix}.{name}_{pk}"] = round(pv, 3)
+            for pk, pv in cum.items():
+                m[f"{self.prefix}.{name}_{pk}_cum"] = round(pv, 3)
         return m

@@ -45,6 +45,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 import numpy as np
 
 from tpuflow.obs import trace
+from tpuflow.obs import health as _health
 from tpuflow.serve.metrics import ServeMetrics
 from tpuflow.serve.request import QueueFull, Request, RequestState
 from tpuflow.serve.slots import SlotPool
@@ -104,6 +105,28 @@ class ServeScheduler:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._closed = False
+        # readiness threshold: a decode segment (or idle loop pass)
+        # older than this while work is pending marks the scheduler
+        # NOT READY (see readiness()); generous default — a segment is
+        # seg device steps, normally milliseconds-to-seconds
+        self.stall_after_s = 30.0
+        # post-mortem capture: the flight recorder snapshots in-flight
+        # request states through this provider (one per gauge prefix,
+        # so multi-model schedulers don't clobber each other). Weakly
+        # bound: the provider registry is process-global and must not
+        # pin a dead scheduler's pools (and their KV device buffers)
+        import weakref
+
+        from tpuflow.obs import flight as _flight
+
+        ref = weakref.ref(self)
+
+        def _provider():
+            s = ref()
+            return s._requests_snapshot() if s is not None else None
+
+        _flight.add_provider(f"{self.metrics.prefix}_requests",
+                             _provider)
 
     @classmethod
     def from_packaged(cls, lm, **kwargs) -> "ServeScheduler":
@@ -438,6 +461,7 @@ class ServeScheduler:
                 progress = True
             if pool.has_live():
                 events, live = pool.run_segment()
+                _health.heartbeat(f"{self.metrics.prefix}.segment")
                 seg_ts = self.clock()
                 for slot, req, new, finished in events:
                     if new:
@@ -488,6 +512,7 @@ class ServeScheduler:
 
         def loop():
             while not self._stop.is_set():
+                _health.heartbeat(f"{self.metrics.prefix}.loop")
                 try:
                     progress = self.step()
                 except Exception as e:
@@ -548,6 +573,93 @@ class ServeScheduler:
             self._finalize(req, RequestState.CANCELLED, error)
 
     # ---- introspection ----------------------------------------------
+    def readiness(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Readiness (vs liveness) probe state — the ``/readyz`` half
+        of the split health check (ISSUE 5). NOT ready when:
+
+        - the scheduler is closed/stopping (drain in progress);
+        - the watchdog tripped (NaN guard / stall — a post-mortem is
+          the right next step, not more traffic);
+        - work is pending but no decode segment completed within
+          ``stall_after_s`` (wedged device/thread: queue fills while
+          ``/healthz`` keeps answering — exactly the failure liveness
+          cannot see);
+        - the background loop thread exists but stopped beating.
+
+        Returns ``{"ready": bool, ...detail}``; detail carries queue
+        depth, running rows, watchdog state and heartbeat ages so the
+        probe's reason is in the probe body."""
+        t = time.monotonic() if now is None else now
+        pfx = self.metrics.prefix
+        with self._lock:
+            depth = sum(len(q) for q in self._queues.values())
+            closed = self._closed
+            pools = list(self.pools.values())
+        running = sum(p.live_count() for p in pools)
+        seg_age = _health.heartbeat_age(f"{pfx}.segment", now=t)
+        loop_age = _health.heartbeat_age(f"{pfx}.loop", now=t)
+        wd = _health.default_watchdog()
+        threaded = self._thread is not None and self._thread.is_alive()
+        # progress signal while work is pending: the FRESHEST of the
+        # last segment and the loop heartbeat. The loop beats between
+        # step() calls even while idle, so the first request after an
+        # idle gap sees a fresh loop (ready — the stale segment stamp
+        # is history, not a wedge); a thread stuck inside step()
+        # (hung collective, first-touch pool compile) stops beating
+        # both, and goes not-ready after stall_after_s. Readiness is
+        # NOT latched: it recovers on the next probe once progress
+        # resumes.
+        ages = [a for a in (seg_age, loop_age) if a is not None]
+        progress_age = min(ages) if ages else None
+        stalled = bool(
+            (depth or running)
+            and progress_age is not None
+            and progress_age > self.stall_after_s
+        )
+        # a launched-then-dead loop thread is a stall even with no
+        # pending work: the next submit would queue into a black hole
+        wedged_loop = bool(
+            loop_age is not None and not threaded and not closed
+            and loop_age > self.stall_after_s
+        )
+        ready = not (closed or wd.tripped or stalled or wedged_loop)
+        return {
+            "ready": ready,
+            "closed": closed,
+            "watchdog": wd.state(),
+            "queue_depth": depth,
+            "running": running,
+            "last_segment_age_s": (
+                None if seg_age is None else round(seg_age, 3)
+            ),
+            "last_loop_age_s": (
+                None if loop_age is None else round(loop_age, 3)
+            ),
+            "stall_after_s": self.stall_after_s,
+        }
+
+    def _requests_snapshot(self) -> List[Dict[str, Any]]:
+        """JSON-able states of every queued + running request — the
+        flight recorder's ``<prefix>_requests.json`` section (what was
+        in flight when the process died)."""
+        with self._lock:
+            queued = [r for q in self._queues.values() for r in q]
+            pools = list(self.pools.items())
+        out = []
+        for req in queued:
+            out.append({"id": req.id, "state": "queued",
+                        "bucket": req.bucket,
+                        "prompt_tokens": int(req.prompt_ids.size),
+                        "n_tokens": len(req.tokens)})
+        for b, pool in pools:
+            for slot, req in enumerate(pool.occupants):
+                if req is not None:
+                    out.append({"id": req.id, "state": req.state.value,
+                                "bucket": b, "slot": slot,
+                                "prompt_tokens": int(req.prompt_ids.size),
+                                "n_tokens": len(req.tokens)})
+        return out
+
     def metrics_snapshot(self) -> Dict[str, Any]:
         snap = self.metrics.snapshot()
         with self._lock:

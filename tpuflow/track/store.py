@@ -80,6 +80,19 @@ class Run:
         for k, v in metrics.items():
             self.log_metric(k, v, step)
 
+    def log_gauges(self, prefix: "str | None" = None,
+                   step: int = 0) -> None:
+        """Flush the process metrics plane (tpuflow.obs.gauges —
+        windowed histogram percentiles, counters, pushed gauges) into
+        this run as step-stamped metrics; the MetricsLogger callback's
+        epoch flush, callable directly by any driver."""
+        from tpuflow.obs.gauges import snapshot_gauges
+
+        for k, v in snapshot_gauges(prefix).items():
+            v = float(v)
+            if v == v:  # NaN-valued summaries have no metric meaning
+                self.log_metric(k, v, step)
+
     def set_tag(self, key: str, value: str) -> None:
         with _run_lock(self.path):
             meta = self.meta()

@@ -200,6 +200,46 @@ class TrackingCallback(Callback):
             self.run.log_metric(k, float(v), step=epoch)
 
 
+class MetricsLogger(Callback):
+    """Run-scoped persistence of the METRICS PLANE (ISSUE 5), primary-
+    only: each epoch, every gauge/counter/histogram summary from
+    :mod:`tpuflow.obs.gauges` (windowed percentiles primary, ``_cum``
+    cumulative) lands in the tracking run as step-stamped metrics, and
+    — when the :mod:`tpuflow.obs.timeseries` default ring is ticking —
+    the ring itself is archived as a JSON artifact
+    (``metrics_plane/epoch_NNNN.json``). This is the live half of the
+    reference's MLflow role: serve and trainer operational numbers
+    stored BESIDE the run's params/losses, so a post-hoc reader gets
+    the same picture a scraper had. ``tick=True`` (default) also ticks
+    the ring each epoch, so epoch cadence produces windowed deltas
+    even without the interval thread."""
+
+    def __init__(self, run, prefix: Optional[str] = None,
+                 artifacts: bool = True, tick: bool = True):
+        self.run = run
+        self.prefix = prefix
+        self.artifacts = artifacts
+        self.tick = tick
+
+    def on_epoch_end(self, epoch, logs):
+        from tpuflow.core import is_primary
+
+        if not is_primary() or self.run is None:
+            return
+        from tpuflow.obs import timeseries
+
+        ring = timeseries.default_ring()
+        if ring is None and self.tick:
+            ring = timeseries.start(thread=False)
+        if ring is not None and self.tick:
+            ring.tick()
+        self.run.log_gauges(self.prefix, step=epoch)
+        if self.artifacts and ring is not None:
+            self.run.log_dict(
+                ring.export(), f"metrics_plane/epoch_{epoch:04d}.json"
+            )
+
+
 class SystemMetricsCallback(Callback):
     """Per-epoch host/device utilization into the tracking run,
     primary-only (≙ the Ganglia dashboards the reference points

@@ -159,6 +159,7 @@ class LMTrainer:
         self._initial_epoch = 0
         self._async_ckpt = None  # lazy AsyncCheckpointer (cfg.async_checkpoint)
         self._flops_per_step: Optional[float] = None  # XLA cost analysis
+        self.health = None  # HealthMonitor, armed per-fit (cfg.watchdog)
 
     # ---- initialization --------------------------------------------------
 
@@ -463,6 +464,19 @@ class LMTrainer:
                 return _shifted_loss(p, out, tokens, ls)
 
         accum = max(1, int(self.cfg.grad_accum_steps))
+        # watchdog mode (ISSUE 5): grad-norm + a non-finite flag join
+        # the step's metrics block ON DEVICE, so the health monitor's
+        # guard rides the fetch that already happens — zero extra
+        # syncs. Off by default: the global-norm reduction changes the
+        # compiled program, and parity-pinned runs must stay bitwise.
+        watch = bool(getattr(self.cfg, "watchdog", False))
+
+        def _health_metrics(loss, grads):
+            gn = optax.global_norm(grads)
+            bad = jnp.logical_not(
+                jnp.isfinite(loss) & jnp.isfinite(gn)
+            ).astype(jnp.float32)
+            return {"grad_norm": gn, "nonfinite": bad}
 
         def train_step(state: TrainState, tokens, lr):
             if accum == 1:
@@ -507,6 +521,9 @@ class LMTrainer:
                 )
                 loss = loss_sum / accum
                 grads = jax.tree.map(lambda g: g / accum, gsum)
+            metrics = {"loss": loss}
+            if watch:
+                metrics.update(_health_metrics(loss, grads))
             opt_state = set_learning_rate(state.opt_state, lr)
             updates, opt_state = self.tx.update(
                 grads, opt_state, state.params
@@ -515,7 +532,7 @@ class LMTrainer:
             new_state = state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state
             )
-            return new_state, {"loss": loss}
+            return new_state, metrics
 
         def eval_step(state: TrainState, tokens):
             return {"loss": loss_of(state.params, tokens, False)}
@@ -845,15 +862,28 @@ class LMTrainer:
         # superstep AOT executables, one per block size (the full-K
         # program plus at most one remainder-tail size per fit)
         self._sstep_execs = {}
+        # metrics/health plane (ISSUE 5): Prometheus exporter
+        # (cfg.metrics_port) + watchdogs (cfg.watchdog /
+        # cfg.stall_timeout_s / cfg.flight_dir). None when disarmed —
+        # the loop then pays one `is not None` check per step.
+        from tpuflow.obs.health import monitor_from_config
+
+        self.health = monitor_from_config(cfg)
         from tpuflow.ckpt.checkpoint import join_async_writes
+
+        from tpuflow.obs.health import closing as _closing_monitor
 
         preempted = False
         with sigterm_preempt_flag(use_preempt) as preempt, \
-                join_async_writes(lambda: [self._async_ckpt]):
+                join_async_writes(lambda: [self._async_ckpt]), \
+                _closing_monitor(self.health):
             for epoch in range(start, epochs):
                 # explicit begin/end (idempotent) — the body exits
                 # through break paths too
                 ep_span = trace.begin("train.epoch", epoch=epoch)
+                if self.health is not None:
+                    # stepping resumes: the stall clock re-anchors
+                    self.health.resume()
                 first_i = skip_steps if epoch == start else 0
                 if ds is not None:
                     batch_iter = ds.iter_epoch(epoch)
@@ -901,6 +931,9 @@ class LMTrainer:
                                 preempt_mp):
                             preempted = True
                             break
+                        if (self.health is not None
+                                and self.health.tripped):
+                            break
                         with trace.span("train.data_wait",
                                         phase="data_wait"):
                             local_rows = _host_rows(i)
@@ -933,6 +966,11 @@ class LMTrainer:
                                 self.state, toks, lr_arr
                             )
                         losses.append(m["loss"])
+                        if self.health is not None:
+                            # device-resident handoff — the monitor's
+                            # worker thread pays the fetch, this
+                            # thread keeps dispatching
+                            self.health.watch_device(global_step, m)
                         global_step += 1
                         if i == first_i:
                             # sync, then time the REMAINING steps: the first
@@ -956,6 +994,28 @@ class LMTrainer:
                         print(f"preempted at step {global_step}; saved {spath}")
                     trace.end(ep_span, preempted=True)
                     break
+                if self.health is not None:
+                    # the step loop is over: pause the stall watch so
+                    # an epoch-end eval/checkpoint longer than the
+                    # timeout never reads as a stall, then settle the
+                    # async guard so a trip in this epoch's tail stops
+                    # the run NOW, not one epoch of chip-hours later
+                    self.health.pause()
+                    self.health.drain()
+                    if self.health.tripped:
+                        trips = self.health.trips()
+                        tstep = next(
+                            (t["step"] for t in trips
+                             if "step" in t), global_step
+                        )
+                        metrics = dict(metrics)
+                        metrics["watchdog_tripped_at"] = float(tstep)
+                        if is_primary():
+                            print(f"watchdog tripped: "
+                                  f"{trips[0]['reason']}; "
+                                  f"stopping at step {global_step}")
+                        trace.end(ep_span, watchdog_tripped=True)
+                        break
                 with trace.span("train.metrics_fetch", phase="device"):
                     epoch_loss = float(jnp.mean(jnp.concatenate(
                         [jnp.atleast_1d(l) for l in losses]
@@ -983,6 +1043,20 @@ class LMTrainer:
                             fl, step_s, n_chips=1,
                             device=self.mesh.devices.flat[0],
                         )
+                # first-class plane gauges (ISSUE 5 satellite): the
+                # exporter/ring see live MFU + FLOPs without a run
+                # handle — bench computes the same numbers, this makes
+                # them scrape-able during any fit
+                from tpuflow.obs.gauges import set_gauge
+
+                set_gauge("train.loss", epoch_loss)
+                set_gauge("train.epoch", float(epoch))
+                if self._flops_per_step:
+                    set_gauge("train.flops_per_step",
+                              float(self._flops_per_step))
+                for gk in ("tokens_per_sec", "mfu"):
+                    if gk in metrics:
+                        set_gauge(f"train.{gk}", float(metrics[gk]))
                 if val_tokens is not None:
                     vl = self._eval_mean_loss(val_tokens, batch_size)
                     if vl is not None:
@@ -1012,6 +1086,8 @@ class LMTrainer:
                 if on_epoch is not None:
                     on_epoch(epoch, metrics)
                 trace.end(ep_span)
+        # the stall thread stopped with the closing() cm above (even on
+        # exception paths); trip state stays readable on self.health
         return metrics
 
     def _run_superstep_epoch(self, K, first_i, steps_per_epoch,
@@ -1072,6 +1148,8 @@ class LMTrainer:
                     preempt, global_step, sync_every, preempt_mp):
                 preempted = True
                 break
+            if self.health is not None and self.health.tripped:
+                break
             k, toks = next(blk_iter)
             lr_list = [
                 self.lr_controller.lr_for_step(global_step + j)
@@ -1083,10 +1161,17 @@ class LMTrainer:
             if ex is None:
                 from tpuflow.obs.mfu import flops_of_compiled
 
+                if self.health is not None:
+                    # a mid-epoch compile (the remainder-tail block
+                    # size) may legitimately exceed stall_timeout_s;
+                    # it is not step silence
+                    self.health.pause()
                 with trace.span("train.compile", phase="compile", k=k):
                     ex = self._superstep.lower(
                         self.state, toks, lrs_arr
                     ).compile()
+                if self.health is not None:
+                    self.health.resume()
                 self._sstep_execs[k] = ex
                 if self._flops_per_step is None:
                     # XLA cost analysis counts a lax.scan body ONCE, so
@@ -1097,6 +1182,10 @@ class LMTrainer:
             with trace.span("train.superstep", phase="dispatch", k=k):
                 self.state, m = ex(self.state, toks, lrs_arr)
             losses.append(m["loss"])
+            if self.health is not None:
+                # whole (k,)-stacked block, still device-resident; the
+                # guard attributes a bad entry to its exact step
+                self.health.watch_device(global_step + k - 1, m)
             global_step += k
             if t_epoch is None:
                 # sync after the FIRST block only: compile stays out of

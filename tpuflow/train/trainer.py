@@ -89,6 +89,7 @@ class Trainer:
         self.lr_controller: Optional[LRController] = None
         self._train_step = None
         self._eval_step = None
+        self.health = None  # HealthMonitor, armed per-fit (cfg.watchdog)
 
     # ---- initialization --------------------------------------------------
 
@@ -157,6 +158,10 @@ class Trainer:
         mesh = self.mesh
         model = self.model
         mask = getattr(self, "param_mask", None)
+        # watchdog mode (ISSUE 5): non-finite flag + grad norm join the
+        # metrics block on device (zero extra syncs; default off so
+        # parity runs keep the exact legacy program)
+        watch = bool(getattr(self.cfg, "watchdog", False))
 
         def train_step(state: TrainState, images, labels, lr):
             x = preprocess_input(images, dtype=getattr(model, "dtype", jnp.bfloat16))
@@ -205,6 +210,15 @@ class Trainer:
             metrics = jax.lax.pmean(
                 {"loss": loss, "accuracy": acc}, DATA_AXIS
             )
+            if watch:
+                # grads are already pmean'd (replicated) here, so the
+                # norm and the flag are too — no extra collective
+                gn = optax.global_norm(grads)
+                metrics = dict(metrics)
+                metrics["grad_norm"] = gn
+                metrics["nonfinite"] = jnp.logical_not(
+                    jnp.isfinite(metrics["loss"]) & jnp.isfinite(gn)
+                ).astype(jnp.float32)
             new_bs = new_vars.get("batch_stats", state.batch_stats)
             # cross-replica BN stats (upgrade over Horovod local stats)
             new_bs = jax.lax.pmean(new_bs, DATA_AXIS)
@@ -505,6 +519,11 @@ class Trainer:
         self.stop_training = False
         for cb in cbs:
             cb.on_train_begin()
+        # metrics/health plane (ISSUE 5): exporter + watchdogs; None
+        # when disarmed (one `is not None` check per step then)
+        from tpuflow.obs.health import monitor_from_config
+
+        self.health = monitor_from_config(cfg)
 
         # preemption-safe mode (cfg.checkpoint_on_preempt): SIGTERM
         # sets a flag; the step loop finishes the CURRENT step, writes
@@ -569,15 +588,21 @@ class Trainer:
         lr = self.lr_controller.lr_for_step(global_step)
         from tpuflow.ckpt.checkpoint import join_async_writes
 
+        from tpuflow.obs.health import closing as _closing_monitor
+
         preempted = False
         with sigterm_preempt_flag(use_preempt) as preempt, \
                 join_async_writes(lambda: [
-                    getattr(cb, "_async", None) for cb in cbs]):
+                    getattr(cb, "_async", None) for cb in cbs]), \
+                _closing_monitor(self.health):
             for epoch in range(initial_epoch, epochs):
                 # explicit begin/end (not `with`): the body exits
                 # through several break paths; trace.end is idempotent
                 # so every path may close it
                 ep_span = trace.begin("train.epoch", epoch=epoch)
+                if self.health is not None:
+                    # stepping resumes: the stall clock re-anchors
+                    self.health.resume()
                 step_metrics = []
                 steps_this_epoch = steps_per_epoch - (
                     skip_steps if epoch == initial_epoch else 0
@@ -597,6 +622,9 @@ class Trainer:
                                 preempt_mp):
                             preempted = True
                             break
+                        if (self.health is not None
+                                and self.health.tripped):
+                            break
                         blk = next(blocks, None)
                         if blk is None:
                             exhausted = True
@@ -615,8 +643,13 @@ class Trainer:
                             )
                         # m holds (k,)-stacked per-step metrics, still
                         # device-resident — the epoch-end _mean_metrics
-                        # fetch is the only host sync
+                        # fetch is the only host sync (the health
+                        # monitor's fetch rides its own worker thread)
                         step_metrics.append(m)
+                        if self.health is not None:
+                            self.health.watch_device(
+                                global_step + k - 1, m
+                            )
                         global_step += k
                         for cb in cbs:
                             cb.on_superstep_end(global_step, m)
@@ -629,6 +662,9 @@ class Trainer:
                                 preempt, global_step, sync_every,
                                 preempt_mp):
                             preempted = True
+                            break
+                        if (self.health is not None
+                                and self.health.tripped):
                             break
                         lr = self.lr_controller.lr_for_step(global_step)
                         try:
@@ -646,6 +682,8 @@ class Trainer:
                                 jnp.asarray(lr, jnp.float32),
                             )
                         step_metrics.append(m)
+                        if self.health is not None:
+                            self.health.watch_device(global_step, m)
                         global_step += 1
                 if preempted:
                     from tpuflow.ckpt import save_step_checkpoint
@@ -666,6 +704,28 @@ class Trainer:
                 if exhausted and not step_metrics:
                     trace.end(ep_span, exhausted=True)
                     break
+                if self.health is not None:
+                    # step loop over: pause the stall watch (epoch-end
+                    # eval/checkpoint may legitimately exceed the
+                    # timeout), then settle the async guard — a trip
+                    # in this epoch stops the run now (training past a
+                    # NaN only burns chip-hours)
+                    self.health.pause()
+                    self.health.drain()
+                    if self.health.tripped:
+                        trips = self.health.trips()
+                        history.history.setdefault(
+                            "watchdog_tripped_at", []
+                        ).append(float(next(
+                            (t["step"] for t in trips
+                             if "step" in t), global_step
+                        )))
+                        if verbose:
+                            print(f"watchdog tripped: "
+                                  f"{trips[0]['reason']}; "
+                                  f"stopping at step {global_step}")
+                        trace.end(ep_span, watchdog_tripped=True)
+                        break
                 with trace.span("train.metrics_fetch", phase="device"):
                     logs = _mean_metrics(step_metrics)
                 logs["lr"] = lr
@@ -680,6 +740,8 @@ class Trainer:
                 trace.end(ep_span)
                 if self.stop_training or exhausted:
                     break
+        # the closing() cm above stopped the stall thread (exception
+        # paths included); trip state stays readable on self.health
         for cb in cbs:
             cb.on_train_end()
         return history
