@@ -1180,6 +1180,13 @@ def main() -> int:
     p.add_argument("--serve-out", default=None,
                    help="--serve: A/B record path (default "
                         "BENCH_LOCAL_r06_serve.json at the repo root)")
+    p.add_argument("--serve-paged", action="store_true",
+                   help="paged-KV serving A/B (ISSUE 6): paged vs "
+                        "contiguous ServeScheduler on the same "
+                        "virtual-clock trace, PLUS a shared-system-"
+                        "prompt trace variant (prefix-cache hit rate, "
+                        "prefill tokens saved, TTFT deltas, KV-memory "
+                        "headroom); writes BENCH_*_serve_paged.json")
     p.add_argument("--superstep", type=int, default=0, metavar="K",
                    help="A/B the superstep trainers (ISSUE 2): drive "
                         "the SAME compiled flagship train step as (a) a "
@@ -1241,6 +1248,7 @@ def main() -> int:
     global _MODE, _PROGRESS_PATH
     _MODE = ("e2e" if args.end2end
              else "decode" if args.decode
+             else "serve_paged" if args.serve_paged
              else "serve" if args.serve
              else "superstep" if args.superstep else args.model)
     if args.end2end and args.model != "cnn":
@@ -1343,6 +1351,8 @@ def _bench(args) -> int:
     n_chips = len(devices)
     if args.superstep:
         return _bench_superstep(args, devices)
+    if args.serve_paged:
+        return _bench_serve_paged(args, devices)
     if args.serve:
         return _bench_serve(args, devices)
     if args.decode:
@@ -2647,6 +2657,397 @@ def _bench_serve(args, devices) -> int:
     )
     emit(slot_rec["useful_tok_s"], tok_ratio, diagnostics=diag,
          metric="serve_useful_tokens_per_sec", unit="tokens/s")
+    return 0
+
+
+def _bench_serve_paged(args, devices) -> int:
+    """--serve-paged: the ISSUE 6 A/B — paged-KV ServeScheduler
+    (fixed-size pages + per-slot page tables + copy-on-write prefix
+    sharing, ``kv='paged'``) vs the contiguous per-bucket cache, on
+    the SAME seeded virtual-clock traces:
+
+    - the ``--serve`` mixed-length trace (policy-neutral: measures the
+      paged engine's throughput overhead and the KV-memory headroom —
+      contiguous reserves ``buckets × slots × horizon`` whether or not
+      tokens exist, paged pays only for pages in use);
+    - a SHARED-SYSTEM-PROMPT variant (every prompt = one 24-token
+      system prefix + a unique 3..7-token suffix — the dominant
+      pattern at scale): requests after the first hit the prefix cache
+      and prefill only their suffix through a narrower compiled
+      window, so the record reports hit rate, prefill tokens saved,
+      and the TTFT deltas that saving buys.
+
+    Costs are billed from a pre-measured min-of-k table exactly like
+    ``--serve`` (live wall-timing on a contended box measures the
+    background load, not the policy); paged join costs are keyed by
+    (bucket, compiled width) so a prefix hit's narrower prefill is
+    billed at its own measured cost. ``value`` = the KV-memory headroom
+    ratio (contiguous bytes / paged peak bytes at the same trace) —
+    the acceptance criterion's ≥2×."""
+    import numpy as np
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.serve.metrics import percentiles
+    from tpuflow.serve.scheduler import ServeScheduler
+
+    if args.smoke:
+        dim, depth, heads, vocab = 256, 4, 4, 1024
+        n_req, cap, arrival_s = args.serve_requests or 24, 32, 0.03
+    else:
+        dim, depth, heads, vocab = 512, 6, 8, 32000
+        n_req, cap, arrival_s = args.serve_requests or 96, 32, 0.01
+    slots, seg, ps = args.batch or 4, 4, 8
+    # store sizing matters on XLA:CPU: the functional page-scatter
+    # copies the WHOLE store per decode step (no buffer donation on
+    # this backend), so segment cost scales with kv_pages — size for
+    # expected concurrency (~3x the observed peak here), not "as big
+    # as possible". A TPU deployment donates the cache through the
+    # jit boundary and fuses the page lookup into the attention
+    # kernel, where this coupling disappears.
+    kv_pages = 1 + 96
+    sampling = dict(temperature=0.8, top_k=40, seed=0)
+    model = build_transformer_lm(
+        vocab_size=vocab, dim=dim, depth=depth, heads=heads,
+        attn_impl="einsum", kv_heads=args.kv_heads,
+    )
+    params = nn.unbox(
+        model.init({"params": jax.random.key(0)},
+                   jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+
+    work = _serve_workload(seed=0, n=n_req, max_new_cap=cap,
+                           arrival_scale_s=arrival_s)
+    prng = np.random.default_rng(1)
+    mixed_prompts = [prng.integers(1, vocab, (p,)).astype(np.int32)
+                     for _, p, _ in work]
+    sys_prefix = prng.integers(1, vocab, (24,)).astype(np.int32)
+    shared_prompts = [
+        np.concatenate([sys_prefix, prng.integers(
+            1, vocab, (int(prng.integers(3, 8)),)).astype(np.int32)])
+        for _ in work
+    ]
+
+    def bucket_of(plen: int) -> int:
+        from tpuflow.packaging.lm import _bucket_len
+
+        return _bucket_len(plen)
+
+    def _min_rounds(ops: dict, k: int = 4) -> dict:
+        best = {name: float("inf") for name in ops}
+        for _ in range(k):
+            for name, fn in ops.items():
+                t0 = time.perf_counter()
+                fn()
+                best[name] = min(best[name], time.perf_counter() - t0)
+        return best
+
+    all_buckets = sorted({bucket_of(len(p))
+                          for p in mixed_prompts + shared_prompts})
+
+    # ---- cost tables: one per engine, measured on warmed pools -----
+    cont_cost = {"seg": {}, "join": {}}
+    paged_cost = {"seg": {}, "join": {}, "copy": 0.0}
+
+    def _measure() -> None:
+        from tpuflow.infer.generate import paged_copy
+        from tpuflow.serve.pages import PagedKV, PagedKVSpec
+        from tpuflow.serve.request import Request
+        from tpuflow.serve.slots import PagedSlotPool, SlotPool
+
+        s = sampling
+        ops: dict = {}
+        kv = PagedKV(model, PagedKVSpec(pages=kv_pages, page_size=ps),
+                     prefix_cache=False)
+        for b in all_buckets:
+            cpool = SlotPool(
+                model, params, b, slots, cap, seg=seg, rounds=3,
+                temperature=s["temperature"], top_k=s["top_k"],
+                seed=s["seed"])
+
+            def _cseg(pool=cpool):
+                if not pool.can_step():
+                    pool.reset()
+                pool.run_segment()
+
+            def _cjoin(pool=cpool):
+                if not pool.can_admit(1):
+                    pool.reset()
+                pool.join([(0, Request(prompt_ids=np.ones(3, np.int32),
+                                       max_new_tokens=1))])
+                pool.evict(0)
+                jax.block_until_ready((pool.cache, pool.out))
+
+            ops[("cseg", b)] = _cseg
+            ops[("cjoin", b)] = _cjoin
+            ppool = PagedSlotPool(
+                model, params, kv, b, slots, cap, seg=seg,
+                temperature=s["temperature"], top_k=s["top_k"],
+                seed=s["seed"])
+            ppool.warm()
+
+            def _pseg(pool=ppool):
+                pool.run_segment()
+
+            ops[("pseg", b)] = _pseg
+            for w in ppool._widths:
+                def _pjoin(pool=ppool, w=w):
+                    plan = kv.plan(np.ones(w, np.int32), 1)
+                    pool.join([(0, Request(
+                        prompt_ids=np.ones(w, np.int32),
+                        max_new_tokens=1), plan)])
+                    pool.evict(0)
+                    jax.block_until_ready((kv.cache, pool.out))
+
+                ops[("pjoin", b, w)] = _pjoin
+
+        def _copy():
+            kv.cache = paged_copy(kv.cache, [0], [0])
+            jax.block_until_ready(jax.tree.leaves(kv.cache)[0])
+
+        ops[("copy",)] = _copy
+        best = _min_rounds(ops, k=6)
+        for key, v in best.items():
+            if key[0] == "cseg":
+                cont_cost["seg"][key[1]] = v
+            elif key[0] == "cjoin":
+                cont_cost["join"][key[1]] = v
+            elif key[0] == "pseg":
+                paged_cost["seg"][key[1]] = v
+            elif key[0] == "pjoin":
+                paged_cost["join"][(key[1], key[2])] = v
+            else:
+                paged_cost["copy"] = v
+        # a wider prefill window strictly contains a narrower one's
+        # work, so join cost must be nondecreasing in width — enforce
+        # it (right-to-left cummin) so one background-load burst during
+        # measurement cannot bill narrow (prefix-hit) joins ABOVE full
+        # prefills and silently invert the A/B
+        for b in all_buckets:
+            ws = sorted(w for (bb, w) in paged_cost["join"] if bb == b)
+            floor = float("inf")
+            for w in reversed(ws):
+                floor = min(floor, paged_cost["join"][(b, w)])
+                paged_cost["join"][(b, w)] = floor
+
+    class _VClock:
+        now = 0.0
+
+        def __call__(self):
+            return self.now
+
+    def run(kv_mode: str, prompts: list, prefix_cache: bool = True) -> dict:
+        from tpuflow.serve.slots import PagedSlotPool
+
+        vc = _VClock()
+        kw = dict(slots=slots, seg=seg, rounds=3, max_new_cap=cap,
+                  max_queue=n_req, clock=vc, **sampling)
+        if kv_mode == "paged":
+            kw.update(kv="paged", kv_page_size=ps, kv_pages=kv_pages,
+                      kv_prefix_cache=prefix_cache)
+        sched = ServeScheduler(model, params, **kw)
+        sched.prepare(*sorted({bucket_of(len(p)) for p in prompts}))
+        for b, pool in sched.pools.items():
+            def _wrap(pool=pool, b=b):
+                oseg, ojoin = pool.run_segment, pool.join
+                if isinstance(pool, PagedSlotPool):
+                    def rs():
+                        vc.now += paged_cost["seg"][b]
+                        return oseg()
+
+                    def jn(admits):
+                        need = max([pl.width
+                                    for _s, _r, pl in admits] + [1])
+                        w = next(wd for wd in pool._widths if wd >= need)
+                        vc.now += paged_cost["join"][(b, w)]
+                        vc.now += paged_cost["copy"] * sum(
+                            len(pl.forks) for _s, _r, pl in admits)
+                        return ojoin(admits)
+                else:
+                    def rs():
+                        vc.now += cont_cost["seg"][b]
+                        return oseg()
+
+                    def jn(admits):
+                        vc.now += cont_cost["join"][b]
+                        return ojoin(admits)
+                pool.run_segment, pool.join = rs, jn
+            _wrap()
+        reqs, i = [], 0
+        peak_pages = 0
+        while len(reqs) < n_req or not sched.idle():
+            while i < n_req and work[i][0] <= vc.now:
+                reqs.append(sched.submit(prompts[i],
+                                         max_new_tokens=work[i][2]))
+                reqs[-1].ts_arrival = work[i][0]
+                i += 1
+            t_pre = vc.now
+            moved = sched.step()
+            if sched.kv_state is not None:
+                peak_pages = max(peak_pages,
+                                 sched.kv_state.allocator.in_use())
+            if not moved:
+                if i < n_req:
+                    vc.now = work[i][0]
+            elif vc.now == t_pre:
+                vc.now += 1e-6
+        assert all(r.state.value == "done" for r in reqs)
+        makespan = vc.now
+        ttft = [r.timing()["ttft_ms"] for r in reqs]
+        e2e = [r.timing()["e2e_ms"] for r in reqs]
+        toks = sum(len(r.tokens) for r in reqs)
+
+        def _pctl(vals) -> dict:
+            return {k: round(v, 2) for k, v in percentiles(vals).items()}
+
+        rec = {
+            "makespan_s": round(makespan, 3),
+            "useful_tok_s": round(toks / makespan, 1),
+            "tokens": toks,
+            "ttft_ms": _pctl(ttft),
+            "e2e_ms": _pctl(e2e),
+        }
+        if sched.kv_state is not None:
+            m = sched.metrics
+            total_prefill = sum(len(p) - 1 for p in prompts)
+            rec.update({
+                "kv_pages_peak": int(peak_pages),
+                "kv_bytes_peak": int(peak_pages
+                                     * sched.kv_state.page_bytes),
+                "prefix_hits": m.prefix_hits,
+                "prefix_misses": m.prefix_misses,
+                "prefix_hit_rate": round(
+                    m.prefix_hits
+                    / max(1, m.prefix_hits + m.prefix_misses), 4),
+                "prefill_tokens_saved": m.prefill_tokens_saved,
+                "prefill_tokens_total": total_prefill,
+                "prefill_savings_frac": round(
+                    m.prefill_tokens_saved / max(1, total_prefill), 4),
+            })
+        else:
+            rec["kv_bytes_reserved"] = int(sum(
+                sum(leaf.nbytes for leaf in jax.tree.leaves(p.cache))
+                for p in sched.pools.values()))
+        return rec
+
+    _progress({"phase": "serve_paged_warmup"})
+    _measure()
+    _progress({"phase": "serve_paged_costs", "costs_ms": {
+        "cont_seg": {b: round(v * 1e3, 2)
+                     for b, v in cont_cost["seg"].items()},
+        "paged_seg": {b: round(v * 1e3, 2)
+                      for b, v in paged_cost["seg"].items()},
+        "paged_join": {f"{b}w{w}": round(v * 1e3, 2)
+                       for (b, w), v in paged_cost["join"].items()},
+    }})
+
+    results = {}
+    for trace_name, prompts in (("mixed", mixed_prompts),
+                                ("shared_prefix", shared_prompts)):
+        for kv_mode in ("contiguous", "paged"):
+            results[(trace_name, kv_mode)] = run(kv_mode, prompts)
+            _progress({"phase": f"serve_paged_{trace_name}_{kv_mode}",
+                       "record": results[(trace_name, kv_mode)]})
+    # isolate the PREFIX CACHE's effect at fixed engine cost: the same
+    # paged engine on the shared trace with the cache disabled — the
+    # TTFT delta between these two runs is purely the skipped prefill
+    results[("shared_prefix", "paged_nocache")] = run(
+        "paged", shared_prompts, prefix_cache=False)
+    _progress({"phase": "serve_paged_shared_nocache",
+               "record": results[("shared_prefix", "paged_nocache")]})
+
+    def _ratio(a, b):
+        return round(a / max(b, 1e-9), 3)
+
+    mixed_c, mixed_p = results[("mixed", "contiguous")], results[
+        ("mixed", "paged")]
+    sh_c, sh_p = results[("shared_prefix", "contiguous")], results[
+        ("shared_prefix", "paged")]
+    sh_nc = results[("shared_prefix", "paged_nocache")]
+    headroom = _ratio(mixed_c["kv_bytes_reserved"],
+                      mixed_p["kv_bytes_peak"])
+    diag = {
+        "device_kind": devices[0].device_kind,
+        "model": f"lm-d{dim}x{depth}h{heads}",
+        "workload": {"n_requests": n_req, "max_new_cap": cap,
+                     "arrival_scale_s": arrival_s, "seed": 0,
+                     "shared_prefix_tokens": int(sys_prefix.size)},
+        "slots": slots, "seg": seg, "page_size": ps,
+        "kv_pages": kv_pages,
+        "cost_table_ms": {
+            "cont_seg": {str(b): round(v * 1e3, 2)
+                         for b, v in cont_cost["seg"].items()},
+            "cont_join": {str(b): round(v * 1e3, 2)
+                          for b, v in cont_cost["join"].items()},
+            "paged_seg": {str(b): round(v * 1e3, 2)
+                          for b, v in paged_cost["seg"].items()},
+            "paged_join": {f"{b}w{w}": round(v * 1e3, 2)
+                           for (b, w), v in paged_cost["join"].items()},
+            "paged_copy": round(paged_cost["copy"] * 1e3, 2),
+        },
+        "mixed": {"contiguous": mixed_c, "paged": mixed_p,
+                  "tok_s_ratio": _ratio(mixed_p["useful_tok_s"],
+                                        mixed_c["useful_tok_s"])},
+        "shared_prefix": {
+            "contiguous": sh_c, "paged": sh_p,
+            "paged_nocache": sh_nc,
+            "tok_s_ratio": _ratio(sh_p["useful_tok_s"],
+                                  sh_c["useful_tok_s"]),
+            "ttft_p50_delta_ms": round(
+                sh_c["ttft_ms"].get("p50", 0.0)
+                - sh_p["ttft_ms"].get("p50", 0.0), 2),
+            "p95_ttft_ratio": _ratio(sh_c["ttft_ms"].get("p95", 0.0),
+                                     sh_p["ttft_ms"].get("p95", 1e-9)),
+            # prefix cache on vs off, SAME engine: the TTFT the cache
+            # itself buys (everything else held fixed)
+            "prefix_ttft_p50_delta_ms": round(
+                sh_nc["ttft_ms"].get("p50", 0.0)
+                - sh_p["ttft_ms"].get("p50", 0.0), 2),
+            "prefix_p95_ttft_ratio": _ratio(
+                sh_nc["ttft_ms"].get("p95", 0.0),
+                sh_p["ttft_ms"].get("p95", 1e-9)),
+        },
+        "kv_memory": {
+            "contiguous_bytes_mixed": mixed_c["kv_bytes_reserved"],
+            "contiguous_bytes_shared": sh_c["kv_bytes_reserved"],
+            "paged_peak_bytes_mixed": mixed_p["kv_bytes_peak"],
+            "paged_peak_bytes_shared": sh_p["kv_bytes_peak"],
+            "headroom_x_mixed": headroom,
+            "headroom_x_shared": _ratio(sh_c["kv_bytes_reserved"],
+                                        sh_p["kv_bytes_peak"]),
+        },
+        "span_totals_ms": _span_totals(),
+    }
+    rec = {
+        "metric": "serve_paged_kv_headroom",
+        "value": headroom,
+        "unit": "x",
+        "vs_baseline": headroom,
+        "mode": "serve_paged",
+        "smoke": bool(args.smoke),
+        "diagnostics": diag,
+    }
+    out_path = args.serve_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_LOCAL_r07_serve_paged.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(
+        f"# serve-paged kv_headroom x{headroom:.1f} | mixed tok/s "
+        f"paged={mixed_p['useful_tok_s']} vs cont="
+        f"{mixed_c['useful_tok_s']} | shared-prefix hit_rate="
+        f"{sh_p['prefix_hit_rate']} prefill_saved="
+        f"{sh_p['prefill_savings_frac']:.0%} p50_ttft "
+        f"paged={sh_p['ttft_ms'].get('p50')}ms vs cont="
+        f"{sh_c['ttft_ms'].get('p50')}ms vs nocache="
+        f"{sh_nc['ttft_ms'].get('p50')}ms -> {out_path}",
+        file=sys.stderr, flush=True,
+    )
+    emit(headroom, headroom, diagnostics=diag,
+         metric="serve_paged_kv_headroom", unit="x")
     return 0
 
 

@@ -527,14 +527,242 @@ def _merge_rows(new, old, row_mask):
     return jax.tree.map(pick, new, old)
 
 
-def serve_pool_arrays(model, slots: int, length: int):
+def serve_pool_arrays(model, slots: int, length: int, kv_spec=None):
     """Fresh device state for one slot pool: (KV cache, token buffer).
     ``length`` is the pool's whole physical horizon (bucket + decode
-    room); the cache is the decode twin's full-length buffer, the token
-    buffer is (slots, length) int32 zeros."""
-    dm = model.clone(decode=True, seq_axis=None)
+    room); the token buffer is (slots, length) int32 zeros.
+
+    ``kv_spec=None`` (the contiguous cache): the cache is the decode
+    twin's full-length per-row buffer — memory is ``slots × length``
+    whether or not tokens exist.
+
+    ``kv_spec`` set (any object with ``pages``/``page_size``/``quant``
+    attributes — :class:`tpuflow.serve.pages.PagedKVSpec`): the cache
+    is a PAGED pool of ``kv_spec.pages`` fixed-size pages per layer,
+    shared by every row (and every bucket) through per-call page
+    tables — memory scales with pages actually allocated, and the pool
+    is batch-size-independent (ONE store serves all slot pools; see
+    MIGRATION.md for this signature change)."""
+    dm = _serve_decode_model(model, kv_spec)
     return (_cache_zeros(dm, slots, length),
             jnp.zeros((slots, length), jnp.int32))
+
+
+# --------------------------------------------------------------------
+# Paged serve engine: page-indexed gather/scatter variants of the
+# serve functions above (ISSUE 6). The contiguous pool gives every
+# slot `length` KV positions whether or not tokens exist; here the KV
+# store is a process-wide pool of fixed-size PAGES and each slot maps
+# its logical positions onto physical pages through a per-call
+# ``page_table`` (vLLM's PagedAttention idea on the blockwise engine).
+# Differences from the contiguous serve engine:
+#
+# - rows live at their LOGICAL positions (physical == logical, no
+#   left-pads, no shared scalar cache_index): each row carries its own
+#   write position, so admission is never quantized to a shared
+#   horizon and a pool needs no reset/rounds machinery;
+# - page 0 is the RESERVED WRITE SINK: masked writes (empty slots,
+#   done rows, prefill tails past a row's width) are redirected there
+#   instead of corrupting live pages — which is what makes pages
+#   SHARABLE between rows (copy-on-write prefix reuse, serve/pages.py);
+# - the join executable is WIDTH-BUCKETED: a request admitted with a
+#   prefix-cache hit prefills only its uncached suffix through the
+#   narrowest compiled window that fits (width=1 = token-write only,
+#   no model pass at all) — the prefill-skip that makes shared system
+#   prompts cheap;
+# - sampling streams are unchanged (`_sample` row_ids + logical
+#   steps), so paged outputs stay token-identical to the wave oracle.
+
+
+def _serve_decode_model(model, kv_spec=None):
+    if kv_spec is None:
+        return model.clone(decode=True, seq_axis=None)
+    return model.clone(
+        decode=True, seq_axis=None, kv_pages=int(kv_spec.pages),
+        kv_page_size=int(kv_spec.page_size), kv_quant=kv_spec.quant,
+    )
+
+
+def paged_kv_arrays(model, kv_spec):
+    """Fresh device page store for ``model``: the per-layer page pools
+    ((pages, KVH, page_size, head_dim) keys/values, + (pages,
+    page_size) scale vectors under ``quant='int8'``). Batch-size
+    independent — ONE store is threaded through every pool's join and
+    segment executables."""
+    dm = _serve_decode_model(model, kv_spec)
+    return _cache_zeros(dm, 1, 1)
+
+
+def paged_page_bytes(kv_cache) -> int:
+    """Device bytes per page across all layers/leaves of a store built
+    by :func:`paged_kv_arrays` — the unit of the serve runtime's KV
+    memory accounting (tools/kv_memory_report.py)."""
+    leaves = jax.tree.leaves(kv_cache)
+    if not leaves:
+        return 0
+    pages = leaves[0].shape[0]
+    return sum(leaf.nbytes for leaf in leaves) // pages
+
+
+def paged_join_fn(model, kv_spec, slots: int, out_len: int,
+                  n_row_pages: int, width: int):
+    """Compiled paged admission: write each joining row's uncached
+    prompt SUFFIX and prefill its KV through the page table.
+
+    Returns ``join(params, cache, out, tokens, starts, widths,
+    page_table) -> (cache, out)``:
+
+    - ``tokens`` (slots, width) int32: row r's suffix tokens
+      (prompt[m_r:p_r], left-justified, zero-padded right) where m_r
+      is its prefix-cache match length; only ``widths[r]`` entries are
+      real (0 = row not joining);
+    - ``starts`` (slots,) int32: m_r — the row's KV length before this
+      join (its first uncached position);
+    - the LAST suffix token (the final prompt token) is written into
+      ``out`` but its KV is left to the first decode step, exactly
+      like the contiguous join — so ``widths[r] - 1`` positions
+      prefill, and ``width == 1`` is the full-prefix-hit fast path
+      that runs NO model pass at all.
+
+    Non-joining rows (width 0) keep their buffers: token writes are
+    masked per-position and KV writes ride the attention layer's
+    write-mask → page-0 sink redirection."""
+    dm = _serve_decode_model(model, kv_spec)
+    if width < 1:
+        raise ValueError(f"width must be >= 1, got {width}")
+    return _compiled_paged_join(dm, int(slots), int(out_len),
+                                int(n_row_pages), int(width))
+
+
+# keyspace is (bucket × pow2-width): ~log2(bucket)+2 entries PER
+# bucket, so the bound is several times the per-bucket caches' — an
+# eviction here recompiles on the admission hot path
+@_lru("paged_join", maxsize=128)
+def _compiled_paged_join(dm, b: int, out_len: int, n_row_pages: int,
+                         w: int):
+    @jax.jit
+    def join(params, cache, out, tokens, starts, widths, page_table):
+        idx = starts[:, None] + jnp.arange(w, dtype=jnp.int32)
+        live = jnp.arange(w)[None, :] < widths[:, None]
+        idxc = jnp.clip(idx, 0, out_len - 1)
+        cur = jnp.take_along_axis(out, idxc, axis=1)
+        out = jnp.put_along_axis(out, idxc, jnp.where(live, tokens, cur),
+                                 axis=1, inplace=False)
+        if w > 1:
+            # prefill the suffix MINUS its last token (that token's KV
+            # is appended by the next decode step, which also yields
+            # the logits the first sample needs)
+            chunk = lax.slice(tokens, (0, 0), (b, w - 1))
+            wm = jnp.arange(w - 1)[None, :] < (widths[:, None] - 1)
+            _, vars2 = dm.apply(
+                {"params": params, "cache": cache}, chunk,
+                mutable=["cache"], page_table=page_table,
+                write_pos=starts, write_mask=wm,
+            )
+            cache = vars2["cache"]
+        return cache, out
+
+    return join
+
+
+def paged_segment_fn(model, kv_spec, slots: int, out_len: int,
+                     n_row_pages: int, seg: int, temperature: float,
+                     top_k: Optional[int], top_p: Optional[float],
+                     eos_id: Optional[int]):
+    """Compiled paged decode segment: advance every row ``seg`` steps
+    at its OWN position, then return control to the host.
+
+    Returns ``segment(params, cache, out, done, pos, kv_limit,
+    last_tok, stream_ids, rng, page_table) -> (cache, out, done,
+    toks)``:
+
+    - ``pos`` (slots,) int32: each row's KV length = the index of its
+      next input token (rows are NOT aligned to a shared boundary);
+    - ``kv_limit`` (slots,) int32: first KV position the row must NOT
+      write (p + max_new - 1) — writes at/after it go to the page-0
+      sink, so a row never needs pages past its own budget;
+    - ``last_tok`` (slots,) int32: index of the row's final allowed
+      token (p + max_new - 1); emitting it sets ``done``;
+    - ``toks`` (slots, seg): the per-row token windows written this
+      segment (``out[r, pos[r]+1 : pos[r]+seg+1]``)."""
+    dm = _serve_decode_model(model, kv_spec)
+    return _compiled_paged_segment(
+        dm, int(slots), int(out_len), int(n_row_pages), int(seg),
+        float(temperature),
+        None if top_k is None else int(top_k),
+        None if top_p is None else float(top_p),
+        None if eos_id is None else int(eos_id),
+    )
+
+
+@_lru("paged_segment", maxsize=32)
+def _compiled_paged_segment(dm, b: int, out_len: int, n_row_pages: int,
+                            seg: int, temperature: float,
+                            top_k: Optional[int], top_p: Optional[float],
+                            eos_id: Optional[int]):
+    fill = jnp.int32(eos_id if eos_id is not None else 0)
+
+    @jax.jit
+    def segment(params, cache, out, done, pos0, kv_limit, last_tok,
+                stream_ids, rng, page_table):
+        def step(carry, i):
+            cache, out, done = carry
+            pos = pos0 + i
+            posc = jnp.clip(pos, 0, out_len - 1)
+            tok = jnp.take_along_axis(out, posc[:, None], axis=1)
+            wm = (~done & (pos < kv_limit))[:, None]
+            lg, vars2 = dm.apply(
+                {"params": params, "cache": cache}, tok,
+                mutable=["cache"], page_table=page_table,
+                write_pos=pos, write_mask=wm,
+            )
+            # the sampling step is the row's LOGICAL position — the
+            # same value the wave oracle derives as t - pad_lens — so
+            # a request's RNG stream is identical in both engines
+            nxt = _sample(lg[:, -1], rng, temperature, top_k, top_p,
+                          step=pos, row_ids=stream_ids)
+            nxt = jnp.where(done, fill, nxt)
+            done = done | (pos + 1 >= last_tok)
+            if eos_id is not None:
+                done = done | (nxt == eos_id)
+            outw = jnp.clip(pos + 1, 0, out_len - 1)
+            out = jnp.put_along_axis(out, outw[:, None], nxt[:, None],
+                                     axis=1, inplace=False)
+            return (vars2["cache"], out, done), None
+
+        (cache, out, done), _ = lax.scan(
+            step, (cache, out, done), jnp.arange(seg)
+        )
+        tix = jnp.clip(pos0[:, None] + 1 + jnp.arange(seg)[None, :],
+                       0, out_len - 1)
+        toks = jnp.take_along_axis(out, tix, axis=1)
+        return cache, out, done, toks
+
+    return segment
+
+
+@jax.jit
+def _paged_copy_jit(cache, src, dst):
+    return jax.tree.map(lambda a: a.at[dst].set(a[src]), cache)
+
+
+def paged_copy(kv_cache, src_pages, dst_pages, width: int = 8):
+    """Copy-on-write device fork: duplicate whole pages across every
+    layer/leaf (``cache[dst[i]] = cache[src[i]]``). Pairs are padded
+    to fixed ``width`` chunks with 0→0 no-ops (page 0 is the write
+    sink) so the executable compiles once per store shape, not once
+    per fork count."""
+    n = len(src_pages)
+    if n != len(dst_pages):
+        raise ValueError("src/dst page lists must have equal length")
+    for ofs in range(0, n, width):
+        s = list(src_pages[ofs:ofs + width])
+        d = list(dst_pages[ofs:ofs + width])
+        pad = width - len(s)
+        s = jnp.asarray(s + [0] * pad, jnp.int32)
+        d = jnp.asarray(d + [0] * pad, jnp.int32)
+        kv_cache = _paged_copy_jit(kv_cache, s, d)
+    return kv_cache
 
 
 def serve_join_fn(model, slots: int, length: int, bucket: int):

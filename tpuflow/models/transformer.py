@@ -143,10 +143,24 @@ class CausalAttention(nn.Module):
     # in training AND the KV-cache decode path.
     rope_scaling: float = 1.0
     rope_scaling_kind: str = "linear"
+    # paged KV cache (decode only): kv_pages physical pages of
+    # kv_page_size tokens each, shared by EVERY sequence in the
+    # process — the cache collection holds (kv_pages, KVH, page_size,
+    # head_dim) pools instead of per-row (B, KVH, max_len, head_dim)
+    # buffers, and each call carries a per-row ``page_table``
+    # indirection + ``write_pos``. KV memory then scales with tokens
+    # that exist, not with rows × horizon (vLLM's PagedAttention idea;
+    # tpuflow.serve.pages owns the allocator/prefix-sharing policy).
+    # kv_quant='int8' stores pages as int8 with a per-page scale
+    # vector (one f32 scale per token slot), dequantized in the read.
+    kv_pages: Optional[int] = None
+    kv_page_size: int = 16
+    kv_quant: Optional[str] = None  # None | 'int8'
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions_override=None,
-                 pad_lens=None):
+                 pad_lens=None, page_table=None, write_pos=None,
+                 write_mask=None):
         tp = self.seq_axis is None
         head_dim = self.dim // self.heads
         kvh = self.kv_heads or self.heads
@@ -187,7 +201,126 @@ class CausalAttention(nn.Module):
                 return t
             return jnp.repeat(t, group, axis=1)
 
-        if self.decode:
+        paged = self.decode and self.kv_pages is not None
+        if (page_table is not None or write_pos is not None) and not paged:
+            raise ValueError(
+                "page_table/write_pos require decode mode with kv_pages "
+                "set (paged KV cache)"
+            )
+        if paged and pad_lens is not None:
+            raise ValueError(
+                "pad_lens (bucketed left-padding) does not combine with "
+                "the paged KV cache — paged rows live at their logical "
+                "positions (no pads)"
+            )
+        if paged:
+            # ---- paged KV decode -------------------------------------
+            # The cache collection is a PROCESS-WIDE pool of fixed-size
+            # pages; each row's logical KV sequence maps to physical
+            # pages through ``page_table`` (B, n_pages) and rows write
+            # at their own ``write_pos`` (B,) — physical position ==
+            # logical position, no shared scalar index, no left-pads.
+            # Writes whose ``write_mask`` is False are redirected to
+            # page 0, the RESERVED write-sink: the allocator never maps
+            # it into a live row's table, so masked rows (empty slots,
+            # done rows, prefill tails past a row's width) scribble
+            # garbage nobody ever reads instead of corrupting shared
+            # pages. Reads gather the row's pages back into a dense
+            # (B, KVH, L, D) view and ride the exact einsum+mask path
+            # of the contiguous cache below (a fused TPU kernel would
+            # replace the gather; on the XLA path the gather is the
+            # page-table lookup).
+            if self.kv_quant not in (None, "int8"):
+                raise ValueError(
+                    f"kv_quant must be None or 'int8', got {self.kv_quant!r}"
+                )
+            ps = int(self.kv_page_size)
+            npages = int(self.kv_pages)
+            store_dtype = jnp.int8 if self.kv_quant == "int8" else self.dtype
+            # checked BEFORE self.variable() below creates the pools —
+            # the init pass must take the shapes-only branch
+            ready = self.has_variable("cache", "key_pages")
+            kp = self.variable("cache", "key_pages", jnp.zeros,
+                               (npages, kvh, ps, head_dim), store_dtype)
+            vp = self.variable("cache", "value_pages", jnp.zeros,
+                               (npages, kvh, ps, head_dim), store_dtype)
+            if self.kv_quant == "int8":
+                ksc = self.variable("cache", "key_scales", jnp.zeros,
+                                    (npages, ps), jnp.float32)
+                vsc = self.variable("cache", "value_scales", jnp.zeros,
+                                    (npages, ps), jnp.float32)
+            if ready:
+                if page_table is None or write_pos is None:
+                    raise ValueError(
+                        "paged decode needs page_table and write_pos"
+                    )
+                n_row_pages = page_table.shape[1]
+                max_len = n_row_pages * ps
+                pos = write_pos[:, None] + jnp.arange(s, dtype=jnp.int32)
+                # rotary positions ARE the logical positions (pad-free
+                # by construction)
+                q, k = rotary_embed(q, k, pos, self.rope_theta,
+                                    self.rope_scaling,
+                                    self.rope_scaling_kind)
+                wm = (jnp.ones((b, s), bool) if write_mask is None
+                      else write_mask)
+                pg = jnp.take_along_axis(
+                    page_table, jnp.clip(pos // ps, 0, n_row_pages - 1),
+                    axis=1,
+                )  # (B, s) physical page of each written position
+                pg = jnp.where(wm, pg, 0)  # masked writes → sink page
+                off = pos % ps
+                kt = k.transpose(0, 2, 1, 3)  # (B, s, KVH, D)
+                vt = v.transpose(0, 2, 1, 3)
+                if self.kv_quant == "int8":
+                    kq, ks_ = _kv_quant_int8(kt)
+                    vq, vs_ = _kv_quant_int8(vt)
+                    kp.value = kp.value.at[pg, :, off, :].set(kq)
+                    vp.value = vp.value.at[pg, :, off, :].set(vq)
+                    ksc.value = ksc.value.at[pg, off].set(ks_)
+                    vsc.value = vsc.value.at[pg, off].set(vs_)
+                    kf = (kp.value[page_table].astype(jnp.float32)
+                          * ksc.value[page_table][:, :, None, :, None])
+                    vf = (vp.value[page_table].astype(jnp.float32)
+                          * vsc.value[page_table][:, :, None, :, None])
+                else:
+                    kp.value = kp.value.at[pg, :, off, :].set(kt)
+                    vp.value = vp.value.at[pg, :, off, :].set(vt)
+                    kf = kp.value[page_table]
+                    vf = vp.value[page_table]
+                # (B, n_pages, KVH, ps, D) → dense (B, KVH, L, D) view
+                kf = kf.transpose(0, 2, 1, 3, 4).reshape(
+                    b, kvh, max_len, head_dim)
+                vf = vf.transpose(0, 2, 1, 3, 4).reshape(
+                    b, kvh, max_len, head_dim)
+                key_pos = jnp.arange(max_len)
+                # causal at logical granularity; stale page tails and
+                # table slots pointing at the sink page sit ABOVE each
+                # row's live index, so this one comparison masks them
+                ok = key_pos[None, None, :] <= pos[:, :, None]  # (B,s,L)
+                if self.attn_window is not None:
+                    ok = ok & (key_pos[None, None, :]
+                               > pos[:, :, None] - self.attn_window)
+                mask = ok[:, None, None]  # (B,1,1,s,L)
+                qg = q.reshape(b, kvh, group, s, head_dim)
+                scores = jnp.einsum(
+                    "bkgqd,bksd->bkgqs",
+                    qg.astype(jnp.float32), kf.astype(jnp.float32),
+                ) * (head_dim ** -0.5)
+                scores = jnp.where(mask, scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1)
+                o = jnp.einsum(
+                    "bkgqs,bksd->bkgqd", probs, vf.astype(jnp.float32),
+                ).reshape(b, self.heads, s, head_dim).astype(self.dtype)
+            else:
+                # init pass: shapes only (page pools created above)
+                positions = jnp.arange(s, dtype=jnp.int32)
+                q, k = rotary_embed(q, k, positions, self.rope_theta,
+                                    self.rope_scaling,
+                                    self.rope_scaling_kind)
+                o = mha_xla(q, expand_kv(k), expand_kv(v), causal=True,
+                            window=self.attn_window)
+        elif self.decode:
             # KV cache (flax idiom): created at init time with the FULL
             # target length; decode calls then feed s<=full chunks which
             # are written at cache_index. The cache shapes fix max_len.
@@ -314,6 +447,20 @@ class CausalAttention(nn.Module):
         )(o)
 
 
+def _kv_quant_int8(t):
+    """Per-token symmetric int8 quantization for paged KV storage:
+    ``t`` (B, S, KVH, D) → ``(q int8, scale f32 (B, S))`` with one
+    scale per TOKEN (= per page slot once scattered: the page's scale
+    vector), amax over that token's (KVH, D) values. Dequant is
+    ``q * scale`` in the attention read."""
+    t32 = t.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(t32), axis=(2, 3))
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(t32 / scale[:, :, None, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 class SwiGLU(nn.Module):
     dim: int
     hidden: int
@@ -360,9 +507,13 @@ class DecoderBlock(nn.Module):
     attn_bh_block: int = 1  # batched-bh flash grid (see CausalAttention)
     rope_scaling: float = 1.0  # RoPE context extension (see CausalAttention)
     rope_scaling_kind: str = "linear"  # linear | ntk
+    kv_pages: Optional[int] = None  # paged KV cache (see CausalAttention)
+    kv_page_size: int = 16
+    kv_quant: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, segment_ids=None, positions=None, pad_lens=None):
+    def __call__(self, x, segment_ids=None, positions=None, pad_lens=None,
+                 page_table=None, write_pos=None, write_mask=None):
         x = x + CausalAttention(
             self.dim, self.heads, self.dtype, self.attn_impl, self.seq_axis,
             self.rope_theta, self.decode, self.sp_layout,
@@ -370,9 +521,11 @@ class DecoderBlock(nn.Module):
             attn_bh_block=self.attn_bh_block,
             rope_scaling=self.rope_scaling,
             rope_scaling_kind=self.rope_scaling_kind,
+            kv_pages=self.kv_pages, kv_page_size=self.kv_page_size,
+            kv_quant=self.kv_quant,
             name="attn",
         )(RMSNorm(self.dtype, name="norm1")(x), segment_ids, positions,
-          pad_lens)
+          pad_lens, page_table, write_pos, write_mask)
         y = RMSNorm(self.dtype, name="norm2")(x)
         if self.n_experts > 0:
             from tpuflow.models.moe import MoEMlp
@@ -479,10 +632,16 @@ class TransformerLM(nn.Module):
     # weight tying: reuse the embedding table as the LM head (GPT-2 /
     # Gemma style) — drops the (dim, vocab) head parameter entirely
     tie_embeddings: bool = False
+    # paged KV cache for decode mode (see CausalAttention.kv_pages):
+    # page pools + per-call page_table/write_pos indirection
+    kv_pages: Optional[int] = None
+    kv_page_size: int = 16
+    kv_quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, segment_ids=None,
-                 positions=None, pad_lens=None):
+                 positions=None, pad_lens=None, page_table=None,
+                 write_pos=None, write_mask=None):
         tp = self.seq_axis is None
         if segment_ids is not None and (
                 self.seq_axis is not None or self.decode):
@@ -538,8 +697,11 @@ class TransformerLM(nn.Module):
                 attn_bh_block=self.attn_bh_block,
                 rope_scaling=self.rope_scaling,
                 rope_scaling_kind=self.rope_scaling_kind,
+                kv_pages=self.kv_pages, kv_page_size=self.kv_page_size,
+                kv_quant=self.kv_quant,
                 name=f"block{i}",
-            )(x, segment_ids, positions, pad_lens)
+            )(x, segment_ids, positions, pad_lens, page_table,
+              write_pos, write_mask)
         x = RMSNorm(self.dtype, name="norm_final")(x)
         if self.tie_embeddings:
             # tied head: the embedding table IS the head kernel (its

@@ -10,10 +10,16 @@ through tpuflow.obs, and a thin stdlib HTTP frontend
 """
 
 from tpuflow.serve.metrics import ServeMetrics, percentiles  # noqa: F401
+from tpuflow.serve.pages import (  # noqa: F401
+    PagedKV,
+    PagedKVSpec,
+    PageAllocator,
+    PrefixCache,
+)
 from tpuflow.serve.request import (  # noqa: F401
     QueueFull,
     Request,
     RequestState,
 )
 from tpuflow.serve.scheduler import ServeScheduler, serve_texts  # noqa: F401
-from tpuflow.serve.slots import SlotPool  # noqa: F401
+from tpuflow.serve.slots import PagedSlotPool, SlotPool  # noqa: F401

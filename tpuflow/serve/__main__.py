@@ -38,6 +38,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--max-queue", type=int, default=64,
                    help="admission queue bound (429 beyond it)")
     p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument("--kv", choices=("contiguous", "paged"),
+                   default="contiguous",
+                   help="KV memory model: 'paged' = fixed-size pages "
+                        "+ per-slot page tables + copy-on-write "
+                        "prefix sharing (KV bytes scale with live "
+                        "tokens, shared system prompts skip prefill); "
+                        "'contiguous' = the per-bucket slab cache")
+    p.add_argument("--kv-pages", type=int, default=None,
+                   help="--kv paged: physical page count of the store "
+                        "(default sizes for ~4x slots concurrent "
+                        "worst-case requests)")
+    p.add_argument("--kv-page-size", type=int, default=16,
+                   help="--kv paged: tokens per page")
+    p.add_argument("--kv-quant", choices=("int8",), default=None,
+                   help="--kv paged: store pages as int8 with "
+                        "per-page scale vectors (~2x more capacity "
+                        "on bf16 models, ~4x on f32)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="--kv paged: disable shared-prefix page reuse")
     p.add_argument("--trace-spans", action="store_true",
                    help="enable the tpuflow.obs.trace span tracer "
                         "(request ids become trace ids; inspect via "
@@ -78,6 +97,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     sched = ServeScheduler.from_packaged(
         args.model, slots=args.slots, seg=args.seg, rounds=args.rounds,
         max_new_cap=args.max_new, max_queue=args.max_queue,
+        kv=args.kv, kv_pages=args.kv_pages,
+        kv_page_size=args.kv_page_size, kv_quant=args.kv_quant,
+        kv_prefix_cache=not args.no_prefix_cache,
     )
     if args.stall_timeout:
         from tpuflow.obs.health import StallDetector
@@ -98,7 +120,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                request_timeout_s=args.request_timeout)
     print(f"serving {args.model} on http://{args.host}:{server.port} "
           f"(slots={args.slots} seg={args.seg} max_new={args.max_new} "
-          f"queue<={args.max_queue})", flush=True)
+          f"queue<={args.max_queue} kv={args.kv})", flush=True)
     try:
         import threading
 

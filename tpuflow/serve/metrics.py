@@ -110,6 +110,12 @@ class ServeMetrics:
         self.segment_live_rows = 0
         self.segment_slot_rows = 0
         self.queue_depth = 0
+        # paged-KV counters (ISSUE 6): prefix-cache hit accounting and
+        # prefill tokens the cache saved (KV positions NOT recomputed)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefill_tokens_saved = 0
+        self.page_waits = 0
         self._events: "OrderedDict[str, List[Dict[str, Any]]]" = OrderedDict()
         self._max_event_requests = max_event_requests
 
@@ -193,6 +199,51 @@ class ServeMetrics:
             self.queue_depth = depth
         set_gauge(f"{self.prefix}.queue_depth", float(depth))
 
+    # ---- paged-KV hooks (scheduler thread, kv='paged' only) ---------
+    def on_prefix(self, req: Request, plan) -> None:
+        """One admission's prefix-cache outcome: hit/miss counters +
+        prefill tokens saved (= KV positions served from shared pages
+        instead of recomputed) + the rolling hit-rate gauge."""
+        with self._lock:
+            if plan.hit:
+                self.prefix_hits += 1
+            else:
+                self.prefix_misses += 1
+            self.prefill_tokens_saved += plan.matched_tokens
+            hits, misses = self.prefix_hits, self.prefix_misses
+        inc_counter(f"{self.prefix}.prefix_cache_"
+                    f"{'hits' if plan.hit else 'misses'}_total")
+        if plan.matched_tokens:
+            inc_counter(f"{self.prefix}.prefix_tokens_saved_total",
+                        plan.matched_tokens)
+        set_gauge(f"{self.prefix}.prefix_hit_rate",
+                  hits / (hits + misses) if hits + misses else 0.0)
+        self.event(req.id, "prefix_match", hit=plan.hit,
+                   matched_tokens=plan.matched_tokens,
+                   cow_forks=len(plan.forks))
+
+    def on_page_wait(self, bucket: int) -> None:
+        """The allocator could not cover the queue head this boundary:
+        the request stays QUEUED until pages free (the admission-
+        control fix — the contiguous path's only answer was horizon
+        math)."""
+        with self._lock:
+            self.page_waits += 1
+        inc_counter(f"{self.prefix}.kv_page_waits_total")
+        self.event("-pages-", "page_wait", bucket=bucket)
+
+    def on_kv(self, kv_state) -> None:
+        """Publish the page store's occupancy gauges (fed once per
+        scheduler boundary; Prometheus/v1/metrics/flight all read the
+        same registry entries)."""
+        a = kv_state.allocator
+        set_gauge(f"{self.prefix}.kv_pages_total", float(a.total))
+        set_gauge(f"{self.prefix}.kv_pages_in_use", float(a.in_use()))
+        set_gauge(f"{self.prefix}.kv_bytes_in_use",
+                  float(kv_state.bytes_in_use()))
+        set_gauge(f"{self.prefix}.kv_bytes_total",
+                  float(kv_state.bytes_total()))
+
     def reset_latency(self) -> None:
         """Start a fresh accumulation window for every latency
         histogram (counts/events/gauges untouched) — the windowed-
@@ -217,6 +268,14 @@ class ServeMetrics:
                 f"{self.prefix}.{k}": float(v) for k, v in self.counts.items()
             }
             m[f"{self.prefix}.queue_depth"] = float(self.queue_depth)
+            m[f"{self.prefix}.prefix_hits"] = float(self.prefix_hits)
+            m[f"{self.prefix}.prefix_misses"] = float(self.prefix_misses)
+            m[f"{self.prefix}.prefix_hit_rate"] = (
+                self.prefix_hits / (self.prefix_hits + self.prefix_misses)
+                if self.prefix_hits + self.prefix_misses else 0.0
+            )
+            m[f"{self.prefix}.prefill_tokens_saved"] = float(
+                self.prefill_tokens_saved)
             m[f"{self.prefix}.tokens_out"] = float(self.tokens_out)
             m[f"{self.prefix}.segments"] = float(self.segments)
             m[f"{self.prefix}.batch_efficiency"] = (

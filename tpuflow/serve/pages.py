@@ -1,0 +1,531 @@
+"""Paged KV memory management: allocator, prefix cache, COW forks.
+
+The host-side policy half of the paged serve engine (ISSUE 6; the
+device half — page-indexed gather/scatter attention and the paged
+join/segment executables — lives in :mod:`tpuflow.infer.generate` and
+:mod:`tpuflow.models.transformer`). Three pieces:
+
+- :class:`PageAllocator` — a refcounted free-list over the physical
+  pages of one :func:`~tpuflow.infer.generate.paged_kv_arrays` store.
+  Page 0 is RESERVED as the write sink (masked device writes land
+  there; it is never handed out), so ``pages - 1`` pages are usable.
+  Freed-page events feed a sliding window so admission control can
+  quote a Retry-After from the measured page FREE RATE instead of a
+  queue-depth guess.
+
+- :class:`PrefixCache` — a radix tree over page-sized token chunks
+  mapping prompt prefixes to the page chains that already hold their
+  KV. A request whose prompt shares a cached prefix SKIPS that part of
+  its prefill entirely (the dominant pattern at scale: shared system
+  prompts) and holds a refcount on the shared pages; the partial tail
+  page of a match is reused COPY-ON-WRITE — the plan forks it onto a
+  fresh page before the request's first divergent write, so the parent
+  chain (and any request still decoding against it) is never touched.
+  KV content at position j depends only on tokens [0..j] (positions
+  are logical in the paged engine — no pads), which is exactly the
+  property that makes token-prefix keyed sharing sound.
+
+- :class:`PagedKV` — owns one device page store + allocator + prefix
+  tree for one model, plans admissions (:meth:`PagedKV.plan` →
+  :class:`PagePlan`), executes COW forks, and answers the memory
+  accounting questions (bytes in use, bytes per live token) that
+  ``tools/kv_memory_report.py`` and the ``serve.kv_*`` gauges quote.
+
+Thread discipline: like the slot pools, ONE thread (the scheduler's)
+may mutate the allocator/tree; read-only stat snapshots are safe from
+other threads (single numpy/int reads).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: physical page id reserved as the masked-write sink — never allocated,
+#: never mapped into a live row's table beyond padding slots.
+SINK_PAGE = 0
+
+
+def pages_needed(prompt_len: int, max_new: int, page_size: int) -> int:
+    """Worst-case pages for one request (no sharing): its KV spans
+    positions [0, p + max_new - 1) — the last generated token's KV is
+    never written. THE single definition: the planner, the scheduler's
+    never-servable check, and the default store sizing must agree."""
+    return math.ceil((prompt_len + max_new - 1) / page_size)
+
+
+@dataclass(frozen=True)
+class PagedKVSpec:
+    """Shape of one paged KV store: ``pages`` physical pages of
+    ``page_size`` token slots each; ``quant='int8'`` stores pages as
+    int8 with per-page scale vectors (≈4× smaller than f32 KV, 2× than
+    bf16 — capacity doubles again on top of paging)."""
+
+    pages: int
+    page_size: int = 16
+    quant: Optional[str] = None  # None | 'int8'
+
+    def __post_init__(self):
+        if self.pages < 2:
+            raise ValueError(
+                f"pages must be >= 2 (page 0 is the reserved write "
+                f"sink), got {self.pages}"
+            )
+        if self.page_size < 1:
+            raise ValueError(
+                f"page_size must be >= 1, got {self.page_size}"
+            )
+        if self.quant not in (None, "int8"):
+            raise ValueError(
+                f"quant must be None or 'int8', got {self.quant!r}"
+            )
+
+
+class PageAllocator:
+    """Refcounted free-list over ``pages`` physical pages (page 0
+    reserved). ``alloc`` is all-or-nothing; ``release`` returns pages
+    to the free list when their refcount reaches zero and records the
+    free event for :meth:`free_rate`."""
+
+    def __init__(self, pages: int, clock: Callable[[], float] = time.time,
+                 free_window_s: float = 10.0):
+        if pages < 2:
+            raise ValueError(f"pages must be >= 2, got {pages}")
+        self.pages = int(pages)
+        self.clock = clock
+        self.free_window_s = float(free_window_s)
+        # LIFO free list: recently freed pages are re-used first (their
+        # contents are hottest in any cache hierarchy)
+        self._free: List[int] = list(range(1, self.pages))
+        self.refs = np.zeros(self.pages, np.int64)
+        self.refs[SINK_PAGE] = 1  # pinned forever
+        # freed-event window shared with foreign readers (the HTTP
+        # frontend quotes Retry-After from free_rate()) — everything
+        # else in the allocator is scheduler-thread-only
+        self._freed: "deque[Tuple[float, int]]" = deque()
+        self._rate_lock = threading.Lock()
+        self.allocs = 0
+        self.frees = 0
+        self.alloc_failures = 0
+
+    # ---- capacity ---------------------------------------------------
+    @property
+    def total(self) -> int:
+        """Usable pages (the sink is not one)."""
+        return self.pages - 1
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def in_use(self) -> int:
+        return self.total - len(self._free)
+
+    # ---- alloc / refcounts ------------------------------------------
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh pages with refcount 1, or None (all-or-nothing)
+        if the free list is short."""
+        if n < 0:
+            raise ValueError(f"n must be >= 0, got {n}")
+        if n > len(self._free):
+            self.alloc_failures += 1
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self.refs[p] = 1
+        self.allocs += n
+        return out
+
+    def retain(self, pages) -> None:
+        for p in pages:
+            if self.refs[p] <= 0:
+                raise RuntimeError(
+                    f"retain of unallocated page {p} (refcount "
+                    f"{int(self.refs[p])}) — use-after-free"
+                )
+            self.refs[p] += 1
+
+    def release(self, pages) -> int:
+        """Drop one reference per page; pages reaching zero return to
+        the free list. Returns the number of pages actually freed."""
+        freed = 0
+        for p in pages:
+            if p == SINK_PAGE:
+                raise RuntimeError("the sink page is never released")
+            if self.refs[p] <= 0:
+                raise RuntimeError(
+                    f"release of free page {p} — double free"
+                )
+            self.refs[p] -= 1
+            if self.refs[p] == 0:
+                self._free.append(p)
+                freed += 1
+        if freed:
+            self.frees += freed
+            now = self.clock()
+            with self._rate_lock:
+                self._freed.append((now, freed))
+                self._trim(now)
+        return freed
+
+    # ---- windowed free-rate (Retry-After math) ----------------------
+    def _trim(self, now: float) -> None:
+        horizon = now - self.free_window_s
+        while self._freed and self._freed[0][0] < horizon:
+            self._freed.popleft()
+
+    def free_rate(self, now: Optional[float] = None) -> float:
+        """Pages freed per second over the sliding window — the
+        denominator of the out-of-pages Retry-After estimate. Safe
+        from any thread."""
+        now = self.clock() if now is None else now
+        with self._rate_lock:
+            self._trim(now)
+            total = sum(n for _, n in self._freed)
+        return total / max(self.free_window_s, 1e-9)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "pages_total": self.total,
+            "pages_in_use": self.in_use(),
+            "pages_free": self.free_count(),
+            "allocs": self.allocs,
+            "frees": self.frees,
+            "alloc_failures": self.alloc_failures,
+            "free_rate_per_s": round(self.free_rate(), 4),
+        }
+
+
+class _Node:
+    __slots__ = ("tokens", "key", "page", "children", "parent",
+                 "last_used")
+
+    def __init__(self, tokens: np.ndarray, key: bytes, page: int,
+                 parent: Optional["_Node"], last_used: float):
+        self.tokens = tokens
+        self.key = key
+        self.page = page
+        self.children: Dict[bytes, "_Node"] = {}
+        self.parent = parent
+        self.last_used = last_used
+
+
+class PrefixCache:
+    """Radix tree over page-sized token chunks → physical pages.
+
+    Every node is one FULL page of prompt KV, keyed by that page's
+    token chunk under its parent chain (so the path root→node spells
+    the token prefix the page's KV was computed from). The tree holds
+    one refcount per node page; requests matching a prefix add their
+    own. Eviction is leaf-LRU, only of pages nobody else references —
+    called when the allocator runs dry, never on the hot path."""
+
+    def __init__(self, page_size: int, allocator: PageAllocator,
+                 clock: Callable[[], float] = time.time):
+        self.ps = int(page_size)
+        self.alloc = allocator
+        self.clock = clock
+        self.root: Dict[bytes, _Node] = {}
+        self.nodes = 0
+        self.inserts = 0
+        self.evictions = 0
+        # guards tree-STRUCTURE mutation vs foreign-thread stats():
+        # the flight recorder dumps kv_snapshot from its own thread at
+        # trip/SIGTERM time, possibly mid-insert on the scheduler
+        # thread — an unguarded dict walk would raise 'dictionary
+        # changed size during iteration' exactly when the post-mortem
+        # matters. match() stays lock-free (scheduler-thread-only).
+        self._mutate_lock = threading.Lock()
+
+    # ---- lookup -----------------------------------------------------
+    def match(self, tokens: np.ndarray):
+        """Longest cached prefix of ``tokens``. Returns ``(full_pages,
+        matched_tokens, partial)``: the chain of fully matched pages,
+        the token count they cover, and — when the next page's first
+        ``q > 0`` tokens also match — ``(page, q)``, the COPY-ON-WRITE
+        fork candidate (the caller duplicates that page and appends
+        into its own copy; the shared parent is never written)."""
+        tokens = np.asarray(tokens, np.int32)
+        level = self.root
+        pages: List[int] = []
+        i = 0
+        now = self.clock()
+        while i + self.ps <= tokens.size:
+            nd = level.get(tokens[i:i + self.ps].tobytes())
+            if nd is None:
+                break
+            nd.last_used = now
+            pages.append(nd.page)
+            i += self.ps
+            level = nd.children
+        partial = None
+        rem = tokens[i:]
+        if rem.size:
+            best_q, best_nd = 0, None
+            for nd in level.values():
+                n = min(rem.size, nd.tokens.size)
+                neq = np.nonzero(nd.tokens[:n] != rem[:n])[0]
+                q = int(neq[0]) if neq.size else n
+                if q > best_q:
+                    best_q, best_nd = q, nd
+            if best_nd is not None:
+                best_nd.last_used = now
+                partial = (best_nd.page, best_q)
+        return pages, i, partial
+
+    # ---- insert -----------------------------------------------------
+    def insert(self, tokens: np.ndarray, pages: List[int]) -> int:
+        """Register ``pages[j]`` as holding the KV of token chunk
+        ``tokens[j*ps:(j+1)*ps]`` (under the preceding chunks). Chunks
+        already present keep their EXISTING page (the caller's
+        duplicate page stays private and dies with its request); new
+        nodes retain their page on behalf of the tree. Returns the
+        number of new nodes."""
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.size < len(pages) * self.ps:
+            raise ValueError(
+                f"{len(pages)} pages need {len(pages) * self.ps} "
+                f"tokens, got {tokens.size}"
+            )
+        level = self.root
+        parent = None
+        new = 0
+        now = self.clock()
+        with self._mutate_lock:
+            for j, pg in enumerate(pages):
+                chunk = tokens[j * self.ps:(j + 1) * self.ps]
+                key = chunk.tobytes()
+                nd = level.get(key)
+                if nd is None:
+                    nd = _Node(chunk.copy(), key, int(pg), parent, now)
+                    level[key] = nd
+                    self.alloc.retain([int(pg)])
+                    self.nodes += 1
+                    new += 1
+                else:
+                    nd.last_used = now
+                parent = nd
+                level = nd.children
+            self.inserts += new
+        return new
+
+    # ---- eviction ---------------------------------------------------
+    def _leaves(self) -> List[_Node]:
+        out: List[_Node] = []
+        stack = list(self.root.values())
+        while stack:
+            nd = stack.pop()
+            if nd.children:
+                stack.extend(nd.children.values())
+            else:
+                out.append(nd)
+        return out
+
+    def _drop(self, nd: _Node) -> None:
+        # callers hold _mutate_lock
+        siblings = nd.parent.children if nd.parent else self.root
+        del siblings[nd.key]
+        self.nodes -= 1
+        self.evictions += 1
+        self.alloc.release([nd.page])
+
+    def evict_lru(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` pages by dropping least-recently-used
+        LEAF nodes whose page only the tree references (refcount 1 —
+        no live request shares it). Dropping a leaf can expose its
+        parent as the next candidate."""
+        freed = 0
+        with self._mutate_lock:
+            while freed < n_pages:
+                # one tree walk + one sort per ROUND (a round drains
+                # every current candidate; dropping leaves can expose
+                # parents, which the next round picks up) — not one
+                # full walk per page freed
+                cands = sorted(
+                    (nd for nd in self._leaves()
+                     if self.alloc.refs[nd.page] == 1),
+                    key=lambda x: x.last_used,
+                )
+                if not cands:
+                    break
+                for nd in cands:
+                    self._drop(nd)
+                    freed += 1
+                    if freed >= n_pages:
+                        break
+        return freed
+
+    def clear(self) -> int:
+        """Release every tree reference (deepest first). Pages shared
+        with live requests survive until those requests release them."""
+        freed = 0
+        # leaves-first teardown keeps the parent links consistent
+        with self._mutate_lock:
+            while self.root:
+                for nd in self._leaves():
+                    if self.alloc.refs[nd.page] == 1:
+                        freed += 1
+                    self._drop(nd)
+                    self.evictions -= 1  # clear() is not an eviction
+        return freed
+
+    def stats(self) -> Dict[str, float]:
+        """Safe from any thread (the flight recorder calls this on its
+        own thread at trip/SIGTERM time)."""
+        with self._mutate_lock:
+            depth = 0
+            stack = [(nd, 1) for nd in self.root.values()]
+            while stack:
+                nd, d = stack.pop()
+                depth = max(depth, d)
+                stack.extend((c, d + 1) for c in nd.children.values())
+            return {
+                "nodes": self.nodes,
+                "max_depth": depth,
+                "inserts": self.inserts,
+                "evictions": self.evictions,
+            }
+
+
+@dataclass
+class PagePlan:
+    """One admission's page assignment (built by :meth:`PagedKV.plan`,
+    consumed by ``PagedSlotPool.join``)."""
+
+    table: List[int]  # page chain, position-ordered (shared + fresh)
+    owned: List[int] = field(default_factory=list)  # refs THIS request holds
+    start: int = 0  # m — KV positions already cached (prefill skips them)
+    width: int = 0  # p - m suffix tokens still to write (incl. last)
+    forks: List[Tuple[int, int]] = field(default_factory=list)  # (src, dst)
+    n_full: int = 0  # leading pages that will hold a full prompt chunk
+    matched_tokens: int = 0
+    hit: bool = False
+
+
+class PagedKV:
+    """One model's paged KV universe: device page store + allocator +
+    prefix tree + the admission planner. Shared by every
+    ``PagedSlotPool`` (all buckets) of one scheduler — that sharing is
+    the point: admission asks THIS object for pages, not a per-bucket
+    pool for a slot-shaped slab."""
+
+    def __init__(self, model, spec: PagedKVSpec, *,
+                 prefix_cache: bool = True,
+                 clock: Callable[[], float] = time.time):
+        from tpuflow.infer.generate import paged_kv_arrays, paged_page_bytes
+
+        self.model = model
+        self.spec = spec
+        self.cache = paged_kv_arrays(model, spec)  # device pytree
+        self.page_bytes = paged_page_bytes(self.cache)
+        self.allocator = PageAllocator(spec.pages, clock=clock)
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(spec.page_size, self.allocator, clock=clock)
+            if prefix_cache else None
+        )
+
+    # ---- admission planning -----------------------------------------
+    def pages_needed(self, prompt_len: int, max_new: int) -> int:
+        return pages_needed(prompt_len, max_new, self.spec.page_size)
+
+    def plan(self, prompt: np.ndarray, max_new: int) -> Optional[PagePlan]:
+        """Match the prefix cache, fork the partial tail COW, allocate
+        the fresh remainder — or return None when the allocator cannot
+        cover it even after LRU-evicting unreferenced tree pages (the
+        caller keeps the request QUEUED; nothing is retained on
+        failure)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = int(prompt.size)
+        ps = self.spec.page_size
+        # only positions [0, p-1) are reusable: position p-1 is written
+        # by the request's own first decode step (which also produces
+        # the logits its first sample needs)
+        full_pages: List[int] = []
+        m_full = 0
+        partial = None
+        if self.prefix is not None and p > 1:
+            full_pages, m_tok, partial = self.prefix.match(prompt[:p - 1])
+            m_full = m_tok // ps
+        need_total = self.pages_needed(p, max_new)
+        n_fresh = need_total - len(full_pages)
+        # retain the matched chain BEFORE any eviction/allocation: a
+        # nearly-dry allocator may otherwise LRU-evict the very pages
+        # we just matched (tree-only refcount 1) and hand them back as
+        # this plan's FRESH pages — the same physical page would then
+        # sit in the table as shared prefix AND prefill target
+        self.allocator.retain(full_pages)
+        fresh = self.allocator.alloc(n_fresh)
+        if fresh is None and self.prefix is not None:
+            short = n_fresh - self.allocator.free_count()
+            self.prefix.evict_lru(short)
+            fresh = self.allocator.alloc(n_fresh)
+        if fresh is None:
+            self.allocator.release(full_pages)
+            return None
+        m = m_full * ps
+        forks: List[Tuple[int, int]] = []
+        if partial is not None and partial[1] > 0:
+            # COW: duplicate the partially matching page; the request
+            # appends into ITS copy from offset q — the shared parent
+            # (possibly mid-decode in another slot) is never written
+            src, q = partial
+            forks.append((int(src), int(fresh[0])))
+            m += int(q)
+        plan = PagePlan(
+            table=full_pages + fresh,
+            owned=full_pages + fresh,
+            start=m,
+            width=p - m,
+            forks=forks,
+            n_full=(p - 1) // ps,
+            matched_tokens=m,
+            hit=m > 0,
+        )
+        return plan
+
+    def execute_forks(self, plan: PagePlan) -> None:
+        if plan.forks:
+            from tpuflow.infer.generate import paged_copy
+
+            src = [s for s, _ in plan.forks]
+            dst = [d for _, d in plan.forks]
+            self.cache = paged_copy(self.cache, src, dst)
+
+    def insert_prompt(self, prompt: np.ndarray, plan: PagePlan) -> int:
+        """After the join prefill: publish the request's full prompt
+        pages into the prefix tree (content for pages fully inside
+        [0, p-1) is complete the moment the join dispatch lands)."""
+        if self.prefix is None or plan.n_full == 0:
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        ps = self.spec.page_size
+        return self.prefix.insert(prompt[:plan.n_full * ps],
+                                  plan.table[:plan.n_full])
+
+    def release(self, plan_or_pages) -> int:
+        pages = (plan_or_pages.owned
+                 if isinstance(plan_or_pages, PagePlan) else plan_or_pages)
+        return self.allocator.release(pages)
+
+    # ---- accounting -------------------------------------------------
+    def bytes_in_use(self) -> int:
+        return self.allocator.in_use() * self.page_bytes
+
+    def bytes_total(self) -> int:
+        return self.allocator.total * self.page_bytes
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = {"page_size": self.spec.page_size,
+               "quant": self.spec.quant or "none",
+               "page_bytes": self.page_bytes,
+               "kv_bytes_in_use": self.bytes_in_use(),
+               "kv_bytes_total": self.bytes_total()}
+        out.update(self.allocator.stats())
+        if self.prefix is not None:
+            out["prefix"] = self.prefix.stats()
+        return out

@@ -47,8 +47,9 @@ import numpy as np
 from tpuflow.obs import trace
 from tpuflow.obs import health as _health
 from tpuflow.serve.metrics import ServeMetrics
+from tpuflow.serve.pages import PagedKV, PagedKVSpec, pages_needed
 from tpuflow.serve.request import QueueFull, Request, RequestState
-from tpuflow.serve.slots import SlotPool
+from tpuflow.serve.slots import PagedSlotPool, SlotPool
 
 
 class ServeScheduler:
@@ -79,11 +80,33 @@ class ServeScheduler:
         seed: int = 0,
         metrics: Optional[ServeMetrics] = None,
         clock: Callable[[], float] = time.time,
+        kv: str = "contiguous",
+        kv_pages: Optional[int] = None,
+        kv_page_size: int = 16,
+        kv_quant: Optional[str] = None,
+        kv_prefix_cache: bool = True,
     ):
+        """``kv='paged'`` switches the KV memory model (ISSUE 6): one
+        process-wide store of ``kv_pages`` fixed-size pages
+        (``kv_page_size`` tokens each) shared by EVERY bucket's slot
+        pool through per-row page tables — KV bytes scale with live
+        tokens, not ``buckets × slots × horizon`` — with copy-on-write
+        prefix sharing (``kv_prefix_cache``: requests with a cached
+        prompt prefix skip that prefill) and opt-in
+        ``kv_quant='int8'`` pages. Admission asks the page ALLOCATOR:
+        when it runs dry the head request stays QUEUED (Retry-After
+        quoted from the windowed page free-rate) instead of being
+        bucket-pool rejected; cancel/expiry frees a request's pages
+        the same boundary. ``kv_pages=None`` sizes the store for about
+        4×``slots`` concurrent worst-case requests."""
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if kv not in ("contiguous", "paged"):
+            raise ValueError(
+                f"kv must be 'contiguous' or 'paged', got {kv!r}"
+            )
         self.model = model
         self.params = params
         self.tokenizer = tokenizer
@@ -97,6 +120,31 @@ class ServeScheduler:
                              top_p=top_p, eos_id=eos_id, seed=int(seed))
         self.metrics = metrics or ServeMetrics()
         self.clock = clock
+        self.kv = kv
+        if kv == "paged":
+            ps = int(kv_page_size)
+            if kv_pages is None:
+                # default sizing: ~4×slots concurrent typical requests
+                # (cap-sized prompt + cap decode each), floored at ONE
+                # maximum-legal request (max_bucket prompt + cap) — any
+                # prompt the bucket config admits must be SERVABLE
+                # under default sizing (worst case: alone, with the
+                # rest queued), never a submit-time ValueError. A
+                # starting point, not a law; size deliberately for
+                # real traffic.
+                per_req = pages_needed(int(max_new_cap),
+                                       int(max_new_cap), ps)
+                per_max = pages_needed(int(max_bucket),
+                                       int(max_new_cap), ps)
+                kv_pages = 1 + max(4 * int(slots) * max(1, per_req),
+                                   per_max)
+            self.kv_spec: Optional[PagedKVSpec] = PagedKVSpec(
+                pages=int(kv_pages), page_size=ps, quant=kv_quant)
+            self.kv_prefix_cache = bool(kv_prefix_cache)
+        else:
+            self.kv_spec = None
+            self.kv_prefix_cache = False
+        self.kv_state: Optional[PagedKV] = None  # built with first pool
         self.pools: Dict[int, SlotPool] = {}
         self._queues: Dict[int, Deque[Request]] = {}
         self._admit_counts: Dict[int, int] = {}  # per-bucket stream-id source
@@ -127,6 +175,13 @@ class ServeScheduler:
 
         _flight.add_provider(f"{self.metrics.prefix}_requests",
                              _provider)
+        if kv == "paged":
+            def _kv_provider():
+                s = ref()
+                return s.kv_snapshot() if s is not None else None
+
+            _flight.add_provider(f"{self.metrics.prefix}_kv",
+                                 _kv_provider)
 
     @classmethod
     def from_packaged(cls, lm, **kwargs) -> "ServeScheduler":
@@ -169,10 +224,37 @@ class ServeScheduler:
         QueueFull and the public surface must never diverge."""
         return max(0.1, 0.05 * depth)
 
+    def _page_retry_from(self, need: int) -> Optional[float]:
+        """Out-of-pages Retry-After: pages still short of ``need`` over
+        the windowed page FREE-RATE (pages/s actually released lately)
+        — a measured drain estimate, not a queue-depth guess. None when
+        pages are not the constraint."""
+        kvs = self.kv_state
+        if kvs is None:
+            return None
+        short = need - kvs.allocator.free_count()
+        if short <= 0:
+            return None
+        rate = kvs.allocator.free_rate(now=self.clock())
+        if rate <= 0.0:
+            return 1.0  # nothing freed in the whole window: flat backoff
+        return min(30.0, max(0.1, short / rate))
+
     def retry_after_s(self) -> float:
+        head = None
         with self._lock:
             depth = sum(len(q) for q in self._queues.values())
-        return self._retry_hint(depth)
+            for q in self._queues.values():
+                if q and (head is None
+                          or q[0].ts_arrival < head.ts_arrival):
+                    head = q[0]
+        hint = self._retry_hint(depth)
+        if head is not None and self.kv_state is not None:
+            ph = self._page_retry_from(self.kv_state.pages_needed(
+                int(head.prompt_ids.size), head.max_new_tokens))
+            if ph is not None:
+                hint = max(hint, ph)
+        return hint
 
     def submit(
         self,
@@ -203,6 +285,20 @@ class ServeScheduler:
                 f"prompt of {ids.size} tokens needs bucket {bucket} > "
                 f"max_bucket {self.max_bucket}"
             )
+        page_hint = None
+        if self.kv_spec is not None:
+            # never-servable check: a request whose WORST-CASE page
+            # demand exceeds the whole store could queue forever —
+            # that is a config error, not backpressure
+            need = pages_needed(int(ids.size), int(max_new_tokens),
+                                self.kv_spec.page_size)
+            if need > self.kv_spec.pages - 1:
+                raise ValueError(
+                    f"request needs {need} KV pages > the store's "
+                    f"{self.kv_spec.pages - 1} usable pages; raise "
+                    f"kv_pages (or shrink the prompt/budget)"
+                )
+            page_hint = self._page_retry_from(need)
         now = self.clock()
         req = Request(
             prompt_ids=ids, max_new_tokens=int(max_new_tokens),
@@ -239,7 +335,7 @@ class ServeScheduler:
                 raise RuntimeError("scheduler is stopped")
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.max_queue:
-                retry = self._retry_hint(depth)
+                retry = max(self._retry_hint(depth), page_hint or 0.0)
                 self.metrics.on_reject(depth, retry)
                 trace.end(req._span_queue)
                 trace.end(req._span_ttft)
@@ -345,13 +441,7 @@ class ServeScheduler:
                 "would race the device state; call it before start()"
             )
         for b in buckets:
-            pool = self._pool(int(b))
-            if pool.segments_run == 0 and not pool.has_live():
-                pool.join([(0, Request(prompt_ids=np.ones(1, np.int32),
-                                       max_new_tokens=1))])
-                pool.run_segment()
-                pool.evict(0)
-                pool.reset()
+            self._pool(int(b)).warm()
 
     def _pool(self, bucket: int) -> SlotPool:
         pool = self.pools.get(bucket)
@@ -362,12 +452,28 @@ class ServeScheduler:
             # duplicate-build race — but the INSERT takes the lock
             # because cancel()/idle()/metrics_snapshot() iterate this
             # dict from HTTP handler threads
-            pool = SlotPool(
-                self.model, self.params, bucket, self.slots,
-                self.max_new_cap, seg=self.seg, rounds=self.rounds,
-                temperature=s["temperature"], top_k=s["top_k"],
-                top_p=s["top_p"], eos_id=s["eos_id"], seed=s["seed"],
-            )
+            if self.kv_spec is not None:
+                if self.kv_state is None:
+                    # ONE page store + allocator + prefix tree for the
+                    # whole scheduler — every bucket's pool shares it
+                    self.kv_state = PagedKV(
+                        self.model, self.kv_spec,
+                        prefix_cache=self.kv_prefix_cache,
+                        clock=self.clock,
+                    )
+                pool = PagedSlotPool(
+                    self.model, self.params, self.kv_state, bucket,
+                    self.slots, self.max_new_cap, seg=self.seg,
+                    temperature=s["temperature"], top_k=s["top_k"],
+                    top_p=s["top_p"], eos_id=s["eos_id"], seed=s["seed"],
+                )
+            else:
+                pool = SlotPool(
+                    self.model, self.params, bucket, self.slots,
+                    self.max_new_cap, seg=self.seg, rounds=self.rounds,
+                    temperature=s["temperature"], top_k=s["top_k"],
+                    top_p=s["top_p"], eos_id=s["eos_id"], seed=s["seed"],
+                )
             with self._lock:
                 self.pools[bucket] = pool
         return pool
@@ -430,26 +536,47 @@ class ServeScheduler:
                 continue
             pool = self._pool(b)
             progress |= self._sweep(pool, now)
-            admits = []
+            admits: List[tuple] = []
+            page_starved = False
             with self._lock:
                 q = self._queues.get(b, deque())
                 # horizon exhausted + fully drained → rewind for the
-                # queue (a fresh round restores full admission room)
+                # queue (a fresh round restores full admission room;
+                # paged pools have no shared horizon — reset no-ops)
                 if (q and not pool.has_live()
                         and not pool.can_admit(q[0].max_new_tokens)):
                     pool.reset()
                 # admit: freed slots take the queue head(s), FIFO
                 free = pool.free_slots()
                 while free and q and pool.can_admit(q[0].max_new_tokens):
-                    req = q.popleft()
-                    admits.append((free.pop(0), req))
+                    if self.kv_state is not None:
+                        # paged admission asks the ALLOCATOR, not the
+                        # pool: out of pages → the head stays QUEUED
+                        # (Retry-After from the page free-rate) until
+                        # finishing/cancelled requests release theirs
+                        plan = self.kv_state.plan(
+                            q[0].prompt_ids, q[0].max_new_tokens)
+                        if plan is None:
+                            page_starved = True
+                            break
+                        req = q.popleft()
+                        admits.append((free.pop(0), req, plan))
+                    else:
+                        req = q.popleft()
+                        admits.append((free.pop(0), req))
                 self.metrics.on_queue_depth(
                     sum(len(x) for x in self._queues.values())
                 )
+            if page_starved:
+                self.metrics.on_page_wait(b)
+            for adm in admits:
+                if len(adm) == 3:
+                    self.metrics.on_prefix(adm[1], adm[2])
             if admits:
                 pool.join(admits)
                 t_adm = self.clock()
-                for _slot, req in admits:
+                for adm in admits:
+                    _slot, req = adm[0], adm[1]
                     req.state = RequestState.RUNNING
                     req.ts_admitted = t_adm
                     self.metrics.on_admit(req)
@@ -480,6 +607,8 @@ class ServeScheduler:
                     self._stream(req, new, finished)
                 self.metrics.on_segment(live, pool.slots)
                 progress = True
+        if self.kv_state is not None:
+            self.metrics.on_kv(self.kv_state)
         return progress
 
     # ---- drive modes ------------------------------------------------
@@ -660,15 +789,63 @@ class ServeScheduler:
                                 "n_tokens": len(req.tokens)})
         return out
 
+    def kv_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Paged-KV accounting: allocator + prefix-tree stats, per-pool
+        page-table occupancy, and bytes-per-live-token — the payload of
+        ``tools/kv_memory_report.py`` and the flight recorder's
+        ``<prefix>_kv.json`` section. None under the contiguous cache."""
+        kvs = self.kv_state
+        if kvs is None:
+            return None
+        snap = kvs.snapshot()
+        with self._lock:
+            pools = list(self.pools.items())
+        live_tokens = 0
+        tables: Dict[str, Any] = {}
+        for b, pool in pools:
+            if not isinstance(pool, PagedSlotPool):
+                continue
+            rows = []
+            for slot, req in enumerate(pool.occupants):
+                if req is None:
+                    continue
+                plan = pool.plans[slot]
+                kv_len = int(min(pool.pos[slot], pool.kv_limit[slot]))
+                live_tokens += kv_len
+                rows.append({
+                    "slot": slot, "id": req.id, "kv_len": kv_len,
+                    "pages": 0 if plan is None else len(plan.owned),
+                    "shared_prefix_tokens":
+                        0 if plan is None else plan.matched_tokens,
+                })
+            tables[str(b)] = rows
+        snap["pools"] = tables
+        snap["live_kv_tokens"] = live_tokens
+        snap["bytes_per_live_token"] = (
+            round(kvs.bytes_in_use() / live_tokens, 1)
+            if live_tokens else None
+        )
+        return snap
+
     def metrics_snapshot(self) -> Dict[str, Any]:
         snap = self.metrics.snapshot()
         with self._lock:
             pools = list(self.pools.items())
         pfx = self.metrics.prefix  # honor per-scheduler namespacing
         for b, pool in pools:
-            snap[f"{pfx}.pool{b}.t"] = float(pool.t)
             snap[f"{pfx}.pool{b}.live"] = float(pool.live_count())
+            if isinstance(pool, PagedSlotPool):
+                continue  # no shared horizon/rounds to report
+            snap[f"{pfx}.pool{b}.t"] = float(pool.t)
             snap[f"{pfx}.pool{b}.rounds"] = float(pool.rounds_started)
+        if self.kv_state is not None:
+            a = self.kv_state.allocator
+            snap[f"{pfx}.kv_pages_total"] = float(a.total)
+            snap[f"{pfx}.kv_pages_in_use"] = float(a.in_use())
+            snap[f"{pfx}.kv_bytes_in_use"] = float(
+                self.kv_state.bytes_in_use())
+            snap[f"{pfx}.kv_bytes_total"] = float(
+                self.kv_state.bytes_total())
         return snap
 
 
@@ -685,11 +862,17 @@ def serve_texts(
     top_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     seed: int = 0,
+    kv: str = "contiguous",
+    kv_pages: Optional[int] = None,
+    kv_page_size: int = 16,
+    kv_quant: Optional[str] = None,
 ) -> List[str]:
     """Offline text frontend over the slot scheduler — what
     ``PackagedLM.generate_text(serve_slots=..., scheduler='slot')``
     routes through. Returns prompt+continuation strings in input order,
-    token-identical to the wave-drained path under the same seed."""
+    token-identical to the wave-drained path under the same seed.
+    ``kv='paged'`` serves through the paged KV store (same tokens,
+    different memory model — see :class:`ServeScheduler`)."""
     tok = packaged_lm._require_tokenizer()
     # rounds=1: an offline drain rewinds its horizon for free between
     # rounds (reset() is bookkeeping, not device work), so the extra
@@ -701,7 +884,8 @@ def serve_texts(
         slots=serve_slots, seg=seg, rounds=rounds,
         max_new_cap=max_new_tokens, max_queue=max(1, len(prompts)),
         temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
-        seed=seed,
+        seed=seed, kv=kv, kv_pages=kv_pages, kv_page_size=kv_page_size,
+        kv_quant=kv_quant,
     )
     reqs = [sched.submit(p, max_new_tokens) for p in prompts]
     sched.run_until_idle()

@@ -31,7 +31,7 @@ NOT thread-safe: exactly one thread (the scheduler's) may touch a pool.
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -184,6 +184,18 @@ class SlotPool:
         self.last_pos[slot] = 0
         return req
 
+    def warm(self) -> None:
+        """Pre-compile the pool's executables with a throwaway request
+        (join one, decode one segment, rewind) — server-startup work,
+        not first-request TTFT. No-op on a pool that has already run."""
+        if self.segments_run or self.has_live():
+            return
+        self.join([(0, Request(prompt_ids=np.ones(1, np.int32),
+                               max_new_tokens=1))])
+        self.run_segment()
+        self.evict(0)
+        self.reset()
+
     def run_segment(self):
         """Advance ``seg`` steps. Returns ``(events, live_before)``
         where events is ``[(slot, request, new_token_ids, finished)]``
@@ -219,6 +231,273 @@ class SlotPool:
             if req is None or was_done[slot]:
                 continue
             budget = int(self.last_pos[slot]) - t0  # row steps remaining
+            new: List[int] = []
+            finished = bool(self.done[slot])
+            for tok in toks[slot][: max(0, min(self.seg, budget))]:
+                if self.eos_id is not None and int(tok) == self.eos_id:
+                    break
+                new.append(int(tok))
+            events.append((slot, req, new, finished))
+        return events, live_before
+
+
+class PagedSlotPool:
+    """Slot pool over the PAGED KV store (ISSUE 6): same scheduler-
+    facing contract as :class:`SlotPool` (free_slots / join /
+    run_segment / evict / warm), completely different memory model.
+
+    - KV lives in the scheduler-wide :class:`tpuflow.serve.pages.
+      PagedKV` page store; this pool owns only the per-slot
+      bookkeeping (page tables, positions) and a (slots, length) token
+      buffer. Admission capacity is PAGES, not slot-shaped slabs — the
+      scheduler plans pages per request (``PagedKV.plan``) before
+      handing the plan to :meth:`join`.
+    - rows live at their LOGICAL positions with per-row write indexes:
+      no left-pads, no shared horizon, no reset/rounds machinery — a
+      freed slot restarts at position 0, so ``can_admit`` never
+      depends on how far other rows have decoded (the decoupling from
+      bucket quantization the contiguous pool cannot offer).
+    - the join is WIDTH-BUCKETED: prefix-cache hits prefill only their
+      uncached suffix through the narrowest compiled window, and a
+      full-prefix hit skips the model pass entirely (width 1 = token
+      write only).
+
+    NOT thread-safe: exactly one thread (the scheduler's) may touch a
+    pool — and all pools of one scheduler share one PagedKV, so that
+    single thread owns the allocator and device store too.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        kv,  # tpuflow.serve.pages.PagedKV (shared across pools)
+        bucket: int,
+        slots: int,
+        max_new_cap: int,
+        seg: int = 8,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from tpuflow.infer.generate import paged_join_fn, paged_segment_fn
+
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_new_cap < 1:
+            raise ValueError(f"max_new_cap must be >= 1, got {max_new_cap}")
+        self.bucket = int(bucket)
+        self.slots = int(slots)
+        self.seg = max(1, int(seg))
+        self.max_new_cap = int(max_new_cap)
+        self.kv = kv
+        self.eos_id = eos_id
+        self.params = params
+        ps = kv.spec.page_size
+        # token horizon: a row's final token index is p + max_new - 1
+        # <= bucket + cap - 1; its KV never exceeds p + max_new - 1
+        # positions. Each row's horizon is ITS OWN — nothing here
+        # depletes as other rows decode.
+        self.length = self.bucket + self.max_new_cap
+        self.n_row_pages = math.ceil((self.length - 1) / ps)
+        self._rng = jax.random.key(int(seed))
+        self._segment = paged_segment_fn(
+            model, kv.spec, self.slots, self.length, self.n_row_pages,
+            self.seg, float(temperature), top_k, top_p, eos_id,
+        )
+        # width menu (powers of two + the full bucket): the suffix a
+        # join must write is width = p - matched <= bucket tokens; the
+        # narrowest compiled window that fits is used, so prefix hits
+        # genuinely skip prefill compute (width 1 = no model pass)
+        menu = [1]
+        w = 2
+        while w < self.bucket:
+            menu.append(w)
+            w *= 2
+        menu.append(self.bucket)
+        self._join = {
+            wd: paged_join_fn(model, kv.spec, self.slots, self.length,
+                              self.n_row_pages, wd)
+            for wd in menu
+        }
+        self._widths = menu
+        self.out = jnp.zeros((self.slots, self.length), jnp.int32)
+        self.page_table = np.zeros((self.slots, self.n_row_pages),
+                                   np.int32)  # 0 = the write sink
+        self.pos = np.zeros((self.slots,), np.int32)
+        self.kv_limit = np.zeros((self.slots,), np.int32)
+        self.last_tok = np.zeros((self.slots,), np.int32)
+        self.stream_ids = np.zeros((self.slots,), np.int32)
+        self.done = np.ones((self.slots,), bool)
+        self.occupants: List[Optional[Request]] = [None] * self.slots
+        self.plans: List[Optional[Any]] = [None] * self.slots
+        self.segments_run = 0
+        self.last_join_width = 0  # observability: the window bench bills
+        self._warmed = False
+
+    # ---- capacity queries (SlotPool-compatible surface) -------------
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.occupants) if r is None]
+
+    def has_live(self) -> bool:
+        return any(r is not None for r in self.occupants)
+
+    def live_count(self) -> int:
+        return sum(r is not None for r in self.occupants)
+
+    def can_admit(self, max_new_tokens: int) -> bool:
+        """Budget sanity only — PAGE availability is the scheduler's
+        question to :meth:`PagedKV.plan` (which may say no even when a
+        slot is free: that request then stays queued)."""
+        return max_new_tokens <= self.max_new_cap
+
+    def can_step(self) -> bool:
+        return True  # per-row positions: no shared horizon to exhaust
+
+    def reset(self) -> None:
+        """No-op: the paged pool has no shared horizon to rewind."""
+
+    # ---- device transitions -----------------------------------------
+    def join(self, admits: List[Tuple[int, Request, Any]]) -> None:
+        """Admit ``(slot, request, plan)`` triples (plans from
+        :meth:`PagedKV.plan`): execute COW forks, write each row's
+        uncached suffix + prefill it through the page table, publish
+        completed prompt pages into the prefix tree."""
+        import jax.numpy as jnp
+
+        if not admits:
+            return
+        kv = self.kv
+        widths = np.zeros((self.slots,), np.int32)
+        starts = np.zeros((self.slots,), np.int32)
+        need_w = 1
+        for slot, req, plan in admits:
+            if self.occupants[slot] is not None:
+                raise RuntimeError(f"slot {slot} is occupied")
+            p = int(req.prompt_ids.size)
+            if not 1 <= p <= self.bucket:
+                raise ValueError(
+                    f"prompt length {p} outside (0, bucket={self.bucket}]"
+                )
+            if req.max_new_tokens > self.max_new_cap:
+                raise RuntimeError(
+                    f"request {req.id} exceeds max_new_cap"
+                )
+            kv.execute_forks(plan)
+            row = self.page_table[slot]
+            row[:] = 0
+            row[: len(plan.table)] = plan.table
+            starts[slot] = plan.start
+            widths[slot] = plan.width
+            need_w = max(need_w, plan.width)
+            self.pos[slot] = p - 1
+            self.kv_limit[slot] = p + req.max_new_tokens - 1
+            self.last_tok[slot] = p + req.max_new_tokens - 1
+            self.stream_ids[slot] = req.stream_id
+            self.done[slot] = False
+            self.occupants[slot] = req
+            self.plans[slot] = plan
+            req.slot = slot
+        w = next(wd for wd in self._widths if wd >= need_w)
+        self.last_join_width = w
+        tokens = np.zeros((self.slots, w), np.int32)
+        for slot, req, plan in admits:
+            tokens[slot, : plan.width] = req.prompt_ids[plan.start:]
+        with trace.span("serve.prefill_join", phase="prefill",
+                        bucket=self.bucket, n=len(admits), width=w,
+                        hits=sum(pl.hit for _, _, pl in admits),
+                        requests=",".join(r.id for _, r, _ in admits)):
+            self.kv.cache, self.out = self._join[w](
+                self.params, self.kv.cache, self.out,
+                jnp.asarray(tokens), jnp.asarray(starts),
+                jnp.asarray(widths), jnp.asarray(self.page_table),
+            )
+        for slot, req, plan in admits:
+            kv.insert_prompt(req.prompt_ids, plan)
+
+    def evict(self, slot: int) -> Optional[Request]:
+        """Free a slot AND its pages immediately (cancellation /
+        deadline expiry / harvest): shared pages just drop this
+        request's reference; exclusive ones return to the free list
+        the same instant — the next queued request can take them at
+        this very boundary."""
+        req = self.occupants[slot]
+        self.occupants[slot] = None
+        plan = self.plans[slot]
+        self.plans[slot] = None
+        if plan is not None:
+            self.kv.release(plan)
+        self.page_table[slot, :] = 0  # every write now hits the sink
+        self.done[slot] = True
+        self.pos[slot] = 0
+        self.kv_limit[slot] = 0
+        self.last_tok[slot] = 0
+        return req
+
+    def warm(self) -> None:
+        """Pre-compile join (narrow + full width), segment, and the
+        COW copy executable with a throwaway request."""
+        from tpuflow.infer.generate import paged_copy
+
+        # own flag, not segments_run: warm rewinds segments_run so the
+        # bench/metrics never count warm-up segments, and must still
+        # no-op on a second prepare() like SlotPool.warm() does
+        if self._warmed or self.segments_run or self.has_live():
+            return
+        self._warmed = True
+        plan = self.kv.plan(np.ones(1, np.int32), 1)
+        if plan is None:  # pragma: no cover - tiny pool misconfig
+            return
+        plan.n_full = 0  # NEVER publish the dummy warm-up prompt into
+        # the prefix tree — tree-retained garbage pages would inflate
+        # kv_pages_in_use until pressure evicts them
+        self.join([(0, Request(prompt_ids=np.ones(1, np.int32),
+                               max_new_tokens=1), plan)])
+        self.run_segment()
+        self.evict(0)
+        full = self.kv.plan(np.ones(self.bucket, np.int32), 1)
+        if full is not None:
+            full.n_full = 0
+            self.join([(0, Request(
+                prompt_ids=np.ones(self.bucket, np.int32),
+                max_new_tokens=1), full)])
+            self.run_segment()
+            self.evict(0)
+        self.kv.cache = paged_copy(self.kv.cache, [0], [0])  # sink no-op
+        self.segments_run = 0
+
+    def run_segment(self):
+        """Advance every occupied row ``seg`` steps at its own
+        position. Same event contract as :class:`SlotPool.run_segment`."""
+        import jax.numpy as jnp
+
+        pos0 = self.pos.copy()
+        live_before = self.live_count()
+        with trace.span("serve.decode_segment", phase="decode",
+                        bucket=self.bucket, seg=self.seg,
+                        live=live_before, paged=1):
+            self.kv.cache, self.out, done_dev, toks = self._segment(
+                self.params, self.kv.cache, self.out,
+                jnp.asarray(self.done), jnp.asarray(pos0),
+                jnp.asarray(self.kv_limit), jnp.asarray(self.last_tok),
+                jnp.asarray(self.stream_ids), self._rng,
+                jnp.asarray(self.page_table),
+            )
+            self.segments_run += 1
+            was_done = self.done
+            self.done = np.array(done_dev)
+            toks = np.asarray(toks)
+        self.pos = pos0 + self.seg
+        events = []
+        for slot, req in enumerate(self.occupants):
+            if req is None or was_done[slot]:
+                continue
+            budget = int(self.last_tok[slot]) - int(pos0[slot])
             new: List[int] = []
             finished = bool(self.done[slot])
             for tok in toks[slot][: max(0, min(self.seg, budget))]:
