@@ -586,7 +586,9 @@ def _phase_span(name: str, **attrs):
 
 def _enable_span_tracer() -> None:
     """Child-side: turn the span tracer on so every capture's
-    diagnostics carry per-phase span totals (ISSUE 4)."""
+    diagnostics carry per-phase span totals (ISSUE 4), and arm the
+    executable registry so they carry compile accounting too
+    (ISSUE 7)."""
     try:
         from tpuflow.obs import trace
 
@@ -594,6 +596,31 @@ def _enable_span_tracer() -> None:
     except Exception as e:
         print(f"# span tracer unavailable: {e}", file=sys.stderr,
               flush=True)
+    try:
+        from tpuflow.obs import executables
+
+        executables.enable()
+    except Exception as e:
+        print(f"# executable registry unavailable: {e}", file=sys.stderr,
+              flush=True)
+
+
+def _compile_totals() -> dict:
+    """Executable-registry roll-up for bench diagnostics: per-site
+    compile counts + wall, so an artifact answers "how much of this
+    capture was compilation, and of what" (ISSUE 7). {} when the
+    registry is disarmed or absent."""
+    try:
+        from tpuflow.obs import executables
+
+        snap = executables.snapshot()
+        return {
+            k: {"compiles": s["compiles"],
+                "wall_s": round(s["wall_s_total"], 2)}
+            for k, s in snap["sites"].items() if s["compiles"]
+        }
+    except Exception:
+        return {}
 
 
 def _span_totals() -> dict:
@@ -637,6 +664,8 @@ def _base_diag(dt, method, dt_loop, last_loss, *, flops, n_chips, peak,
         # per-phase host-span totals (tpuflow.obs.trace) — where the
         # capture's wall clock went, next to the dispatch accounting
         "span_totals_ms": _span_totals(),
+        # per-site compile accounting (tpuflow.obs.executables)
+        "compile_sites": _compile_totals(),
         "dispatch_floor_ms": round(floor_ms, 3),
         "dispatch_bound": bool(dt * 1e3 < floor_ms),
         "rtt_ms": round(rtt_ms, 1),

@@ -31,6 +31,20 @@ the production metrics/health half:
 - ``obs.mfu`` computes FLOPs/step from XLA cost analysis → MFU, the
   scale-up-vs-out decision input the reference leaves to eyeballing.
 
+ISSUE 7 added the third plane — memory & compile:
+
+- ``obs.memory`` — the device-buffer ledger: creation sites ``tag``
+  long-lived buffers by component (params/opt_state/kv_pages/...),
+  ``reconcile`` attributes every live device byte against
+  ``jax.live_arrays()`` with untagged bytes as a named residual, and
+  the timeline exports as Perfetto counter tracks beside the spans;
+- ``obs.executables`` — the compile registry: ``registered_jit`` sites
+  report every compile (shapes, wall, cost/memory analysis, roofline
+  verdict) and a key recompiling past the threshold trips the SAME
+  watchdog/flight surface as a NaN — demo'd below;
+- ``python -m tpuflow.cli.obs memreport <flight-dir>`` renders both
+  (plus the paged-KV sub-view) from any post-mortem bundle.
+
 Run: python examples/04_monitoring.py [workdir]
 """
 
@@ -124,6 +138,33 @@ def main(workdir: str) -> None:
           f"cumulative {snap['demo.step_ms_p50_cum']:.1f}ms "
           "(the window sees the regression immediately)")
 
+    # ---- memory & compile plane (ISSUE 7) ----
+    from tpuflow.obs import executables, memory
+
+    # tag long-lived buffers by component — the trainers/serve runtime
+    # do this at their creation sites; here the demo model's variables
+    # play "params" and a fake KV slab plays "kv_pages"
+    memory.tag("params", variables)
+    kv_slab = jnp.zeros((64, 4, 16, 8), jnp.float32)
+    memory.tag("kv_pages", kv_slab)
+    rep = memory.update_gauges()  # reconcile + publish mem.* gauges
+    print(memory.format_memory_section(rep))
+
+    # the compile registry: every jit site under tpuflow/ routes
+    # through registered_jit (a tier-1 guard pins that); arming it
+    # makes compiles — and recompile storms — first-class events
+    executables.enable()
+    executables.configure(threshold=3)
+    leaky = executables.registered_jit(lambda t: t * 2.0,
+                                       key="demo.shape_leak")
+    for n in (8, 16, 24, 32, 40):  # 5 distinct shapes = 5 compiles
+        leaky(jnp.ones((n,)))
+    from tpuflow.obs.health import default_watchdog
+
+    wd = default_watchdog()
+    print(f"recompile watchdog tripped: {wd.tripped} -> {wd.reason!r}")
+    wd.reset()  # demo only — a real trip should halt/503, not reset
+
     # ---- watchdog + flight recorder: a forced post-mortem ----
     from tpuflow.obs import flight, health
 
@@ -139,6 +180,10 @@ def main(workdir: str) -> None:
           f"{os.path.basename(bundle['_path'])} "
           f"(sections: {', '.join(bundle['manifest']['sections'])})")
     print("postmortem CLI: python -m tpuflow.cli.obs postmortem "
+          f"{flight_dir}")
+    # the bundle now also carries memory.json/executables.json — the
+    # memory-and-compile view of the same moment:
+    print("memreport  CLI: python -m tpuflow.cli.obs memreport "
           f"{flight_dir}")
     monitor.close()
     exporter.shutdown()

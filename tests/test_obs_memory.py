@@ -1,0 +1,487 @@
+"""Memory & compile plane (ISSUE 7): device-buffer ledger, executable
+registry, recompile watchdog, roofline math, and the satellite
+hardening of sysmetrics/mfu.
+
+Tier discipline: everything here is host-dominated except the
+train-then-serve ledger acceptance, which uses ONE tiny model and the
+smallest pool geometry so its compiles stay in the single-digit
+seconds. The acceptance pins (ISSUE 7):
+
+- tagged components account for >= 90% of the device bytes a smoke
+  train-then-serve run creates;
+- the recompile watchdog trips deterministically under an injectable
+  threshold, with the offending shapes in the message;
+- a flight bundle round-trips the ``memory``/``executables`` sections;
+- the Prometheus golden covers the ``mem.*``/``compile.*`` families;
+- the DISABLED overhead of the registered-jit wrapper stays < 2%
+  (process_time, like the PR 4/5 guards).
+"""
+
+import json
+import math
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpuflow.obs import executables, flight, memory
+from tpuflow.obs.gauges import clear_gauges, counters, snapshot_gauges
+from tpuflow.obs.health import Watchdog
+from tpuflow.obs.mfu import (
+    arithmetic_intensity,
+    cost_analysis_of,
+    device_hbm_bandwidth,
+    device_peak_flops,
+    flops_of_compiled,
+    roofline,
+)
+
+
+@pytest.fixture
+def registry():
+    """Armed registry with injectable state, fully restored after —
+    trips land on a PRIVATE watchdog so the process-default surface
+    (readiness probes elsewhere in the suite) never latches."""
+    old = (executables._ENABLED, executables._ANALYZE,
+           executables._THRESHOLD, executables._WATCHDOG)
+    wd = Watchdog()
+    executables.configure(threshold=1000, watchdog=wd, analyze="off")
+    executables.enable()
+    yield executables, wd
+    (executables._ENABLED, executables._ANALYZE,
+     executables._THRESHOLD, executables._WATCHDOG) = old
+    executables.clear()
+
+
+@pytest.fixture
+def ledger():
+    memory.clear()
+    yield memory
+    memory.clear()
+    clear_gauges("mem.")
+
+
+# ---------------------------------------------------------------------
+# ledger units (injectable live list — no reliance on process state)
+# ---------------------------------------------------------------------
+
+def test_ledger_reconcile_attribution_and_peaks(ledger):
+    a = jnp.ones((64, 64), jnp.float32)   # 16384 B
+    b = jnp.ones((32, 32), jnp.float32)   # 4096 B
+    c = jnp.ones((16, 16), jnp.float32)   # 1024 B (never tagged)
+    ledger.tag("params", {"w": a})
+    ledger.tag("kv_pages", [b])
+    rep = ledger.reconcile(live=[a, b, c])
+    assert rep["components"]["params"] == a.nbytes
+    assert rep["components"]["kv_pages"] == b.nbytes
+    assert rep["untagged_bytes"] == c.nbytes
+    assert rep["total_bytes"] == a.nbytes + b.nbytes + c.nbytes
+    assert rep["tagged_fraction"] == pytest.approx(
+        (a.nbytes + b.nbytes) / rep["total_bytes"]
+    )
+    # peaks latch the high-water mark even after buffers shrink away
+    rep2 = ledger.reconcile(live=[b])
+    assert rep2["components"]["params"] == 0
+    assert rep2["peaks"]["params"] == a.nbytes
+    # a DELETED (donated) array stops counting even while referenced
+    b.delete()
+    rep3 = ledger.reconcile(live=[b])
+    assert rep3["components"]["kv_pages"] == 0
+    # last tag wins: re-tagging moves an array between components
+    ledger.tag("eval", {"w": a})
+    rep4 = ledger.reconcile(live=[a])
+    assert rep4["components"]["eval"] == a.nbytes
+    assert rep4["components"]["params"] == 0
+
+
+def test_ledger_gauges_ride_sysmetrics(ledger):
+    from tpuflow.obs.sysmetrics import sample_system_metrics
+
+    a = jnp.ones((64, 64), jnp.float32)
+    ledger.tag("params", a)
+    m = sample_system_metrics(include_devices=False)
+    assert m["mem.params_bytes"] >= a.nbytes
+    assert "mem.untagged_bytes" in m
+    assert "mem.live_bytes" in m
+    # headroom exists even on XLA:CPU (host MemAvailable fallback) —
+    # the gauge the serve 429 path quotes
+    assert m["mem.hbm_headroom_bytes"] > 0
+
+
+def test_sysmetrics_device_stats_explicit_unavailable(monkeypatch):
+    """Satellite: ``memory_stats() or {}`` silently zeroed backends
+    that return None (XLA:CPU). Both paths must be distinguishable:
+    stats present -> per-device mem.* gauges; absent -> ONE explicit
+    unavailable marker and no byte keys."""
+    from tpuflow.obs.sysmetrics import sample_system_metrics
+
+    class Dev:
+        def __init__(self, i, stats):
+            self.id = i
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    devs = [Dev(0, {"bytes_in_use": 123.0, "bytes_limit": 1000.0}),
+            Dev(1, None)]
+    monkeypatch.setattr(jax, "local_devices", lambda: devs)
+    m = sample_system_metrics(include_gauges=False)
+    assert m["mem.device0.bytes_in_use"] == 123.0
+    assert m["mem.device0.bytes_limit"] == 1000.0
+    assert m["device0.hbm_in_use_bytes"] == 123.0  # legacy key kept
+    assert m["mem.device1.stats_unavailable"] == 1.0
+    assert not any(k.startswith("mem.device1.bytes") for k in m)
+    assert "mem.device0.stats_unavailable" not in m
+
+
+# ---------------------------------------------------------------------
+# executable registry + recompile watchdog
+# ---------------------------------------------------------------------
+
+def test_registered_jit_counts_and_aot_analysis(registry):
+    ex, _wd = registry
+    f = ex.registered_jit(lambda x: x @ x, key="obs_mem.mm")
+    f(jnp.ones((8, 8)))
+    f(jnp.ones((8, 8)))     # dispatch-cache hit
+    f(jnp.ones((16, 16)))   # second compile
+    site = ex.snapshot()["sites"]["obs_mem.mm"]
+    assert site["calls"] == 3
+    assert site["compiles"] == 2
+    assert site["shapes"][-1] == "(float32[16,16])"
+    # AOT registration carries full analysis: cost + roofline verdict
+    # + memory_analysis byte classes, at no extra compile for callers
+    # that wanted the compiled object anyway
+    compiled = f.aot_compile(jnp.ones((8, 8)))
+    out = compiled(jnp.ones((8, 8)))
+    assert out.shape == (8, 8)
+    site = ex.snapshot()["sites"]["obs_mem.mm"]
+    assert site["kind"] == "aot"
+    assert site["cost"]["flops"] > 0
+    assert site["cost"]["bytes_accessed"] > 0
+    assert site["cost"]["verdict"] in ("memory-bound", "compute-bound")
+    assert site["memory"]["argument_bytes"] == 8 * 8 * 4
+    assert site["memory"]["output_bytes"] == 8 * 8 * 4
+
+
+def test_recompile_watchdog_trips_with_shapes(registry):
+    ex, wd = registry
+    ex.configure(threshold=2)
+    trips0 = counters().get("compile.recompile_trips_total", 0.0)
+    f = ex.registered_jit(lambda x: x + 1, key="obs_mem.leak")
+    for n in (2, 3, 4):  # 3 compiles > threshold 2 -> deterministic trip
+        f(jnp.ones((n,)))
+    assert wd.tripped
+    assert "recompile storm" in wd.reason
+    assert "obs_mem.leak" in wd.reason
+    assert "float32[4]" in wd.reason  # the offending shapes, named
+    rec = wd.state()["trips"][0]
+    assert rec["kind"] == "recompile" and rec["compiles"] == 3
+    assert counters()["compile.recompile_trips_total"] == trips0 + 1
+    # latched once per site: more recompiles don't re-trip
+    f(jnp.ones((5,)))
+    assert counters()["compile.recompile_trips_total"] == trips0 + 1
+
+
+def test_registry_disabled_is_invisible(registry):
+    ex, wd = registry
+    ex.disable()
+    ex.configure(threshold=1)
+    f = ex.registered_jit(lambda x: x * 2, key="obs_mem.off")
+    for n in (2, 3, 4):
+        f(jnp.ones((n,)))
+    assert "obs_mem.off" not in ex.snapshot()["sites"]
+    assert not wd.tripped
+
+
+# ---------------------------------------------------------------------
+# mfu satellites: summed shares, error counter, spec lookups, roofline
+# ---------------------------------------------------------------------
+
+def test_cost_analysis_sums_per_device_shares():
+    class Fake:
+        def cost_analysis(self):
+            return [{"flops": 10.0, "bytes accessed": 100.0},
+                    {"flops": 30.0, "bytes accessed": 300.0}]
+
+    ca = cost_analysis_of(Fake())
+    assert ca == {"flops": 40.0, "bytes_accessed": 400.0,
+                  "per_device": 2}
+    assert flops_of_compiled(Fake()) == 40.0
+
+
+def test_cost_analysis_errors_are_counted_not_swallowed():
+    class Broken:
+        def cost_analysis(self):
+            raise RuntimeError("backend says no")
+
+    before = counters().get("compile.cost_analysis_errors_total", 0.0)
+    assert cost_analysis_of(Broken()) == {}
+    assert flops_of_compiled(Broken()) == 0.0
+    after = counters()["compile.cost_analysis_errors_total"]
+    assert after == before + 2
+
+
+class _FakeDev:
+    def __init__(self, kind, platform):
+        self.device_kind = kind
+        self.platform = platform
+
+
+def test_device_spec_lookup_paths(monkeypatch):
+    monkeypatch.delenv("TPUFLOW_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("TPUFLOW_HBM_BW", raising=False)
+    # device_kind substring match
+    assert device_peak_flops(_FakeDev("TPU v4", "tpu")) == 275e12
+    assert device_hbm_bandwidth(_FakeDev("TPU v5e", "tpu")) == 819e9
+    # CPU nominal (testability constant)
+    assert device_peak_flops(_FakeDev("epyc", "cpu")) == 1e11
+    # unknown accelerator falls back to the v4 default
+    assert device_peak_flops(_FakeDev("mystery9000", "tpu")) == 275e12
+    assert device_hbm_bandwidth(_FakeDev("mystery9000", "tpu")) == 1228e9
+    # env override beats everything
+    monkeypatch.setenv("TPUFLOW_PEAK_FLOPS", "42.5")
+    assert device_peak_flops(_FakeDev("TPU v4", "tpu")) == 42.5
+
+
+def test_roofline_hand_computed(monkeypatch):
+    monkeypatch.setenv("TPUFLOW_PEAK_FLOPS", "100")
+    monkeypatch.setenv("TPUFLOW_HBM_BW", "10")
+    # ridge = 100/10 = 10 FLOPs/byte
+    assert arithmetic_intensity(50.0, 10.0) == 5.0
+    r = roofline(50.0, 10.0)  # AI 5 < ridge 10 -> memory-bound
+    assert r["verdict"] == "memory-bound"
+    assert r["ridge_flops_per_byte"] == 10.0
+    assert r["attainable_flops_per_s"] == 50.0  # AI * BW
+    r2 = roofline(2000.0, 10.0)  # AI 200 > 10 -> compute-bound
+    assert r2["verdict"] == "compute-bound"
+    assert r2["attainable_flops_per_s"] == 100.0  # chip peak
+    assert roofline(0.0, 10.0) == {}
+    assert arithmetic_intensity(50.0, 0.0) is None
+
+
+# ---------------------------------------------------------------------
+# exports: prometheus families, chrome counters, flight round-trip, CLI
+# ---------------------------------------------------------------------
+
+def test_prometheus_covers_mem_and_compile_families(registry, ledger):
+    """The golden-parse acceptance for the new gauge families, using
+    the same strict parser as the PR 5 golden."""
+    from test_obs_metrics import _parse_prom
+
+    from tpuflow.obs import prom
+
+    ex, _wd = registry
+    a = jnp.ones((64, 64), jnp.float32)
+    ledger.tag("kv_pages", a)
+    ledger.update_gauges()
+    f = ex.registered_jit(lambda x: x + 1, key="obs_mem.prom")
+    f(jnp.ones((4,)))
+    samples, types = _parse_prom(prom.render("mem."))
+    names = {n for n, _, _ in samples}
+    assert types["mem_kv_pages_bytes"] == "gauge"
+    assert "mem_hbm_headroom_bytes" in names
+    assert "mem_untagged_bytes" in names
+    samples, types = _parse_prom(prom.render("compile."))
+    by = {n: v for n, _, v in samples}
+    assert types["compile_compiles_total"] == "counter"
+    assert by["compile_compiles_total"] >= 1
+    assert types["compile_sites"] == "gauge"
+
+
+def test_chrome_trace_carries_memory_counter_track(tmp_path, ledger):
+    from tpuflow.obs import trace
+
+    a = jnp.ones((64, 64), jnp.float32)
+    ledger.tag("params", a)
+    ledger.reconcile()
+    trace.enable()
+    try:
+        with trace.span("obs_mem.work"):
+            pass
+        path = trace.export_chrome_trace(str(tmp_path / "t.json"))
+    finally:
+        trace.disable()
+        trace.clear()
+    events = json.load(open(path))["traceEvents"]
+    counter = [e for e in events
+               if e.get("ph") == "C" and e["name"] == "mem.component_bytes"]
+    assert counter, "memory counter track missing from chrome export"
+    assert counter[-1]["args"]["params"] == float(a.nbytes)
+    assert "untagged" in counter[-1]["args"]
+
+
+def test_flight_bundle_memory_executables_roundtrip(
+        tmp_path, registry, ledger, capsys):
+    ex, _wd = registry
+    a = jnp.ones((64, 64), jnp.float32)
+    ledger.tag("opt_state", a)
+    f = ex.registered_jit(lambda x: x * 3, key="obs_mem.flight")
+    f(jnp.ones((4,)))
+    d = flight.dump(str(tmp_path), "obs_mem test")
+    bundle = flight.load(str(tmp_path))
+    assert bundle["manifest"]["reason"] == "obs_mem test"
+    assert "memory" in bundle and "executables" in bundle
+    assert bundle["memory"]["components"]["opt_state"] >= a.nbytes
+    assert bundle["memory"]["timeline"], "timeline missing"
+    assert bundle["executables"]["sites"]["obs_mem.flight"]["compiles"] == 1
+    # the memreport CLI renders ledger + registry + (any) KV sections
+    from tpuflow.cli.obs import main as obs_main
+
+    assert obs_main(["memreport", d]) == 0
+    out = capsys.readouterr().out
+    assert "device-buffer ledger:" in out
+    assert "opt_state" in out
+    assert "executable registry" in out
+    assert "obs_mem.flight" in out
+
+
+# ---------------------------------------------------------------------
+# static guard: no compile path may dodge the registry
+# ---------------------------------------------------------------------
+
+def test_all_jit_sites_route_through_registry():
+    """Grep-based guard: every ``jax.jit(`` / ``@jax.jit`` /
+    ``lower().compile()`` under tpuflow/ must route through
+    tpuflow.obs.executables (allowlist for the wrapper itself and the
+    mfu AOT helper) — a future compile site cannot silently dodge the
+    registry."""
+    root = os.path.join(os.path.dirname(__file__), "..", "tpuflow")
+    allow = {
+        # the registering wrapper's own jax.jit + aot lower().compile()
+        os.path.join("obs", "executables.py"),
+        # flops_of_jitted: a user-facing AOT helper over arbitrary
+        # jitted fns (bench/examples) — it has no stable site key
+        os.path.join("obs", "mfu.py"),
+    }
+    jit_pat = re.compile(r"(?:jax\.jit\s*\(|@jax\.jit\b)")
+    aot_pat = re.compile(r"\.lower\([^)]*\)\s*\.compile\(", re.DOTALL)
+    offenders = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            if rel in allow:
+                continue
+            src = open(path).read()
+            for pat, what in ((jit_pat, "jax.jit"),
+                              (aot_pat, "lower().compile()")):
+                for m in pat.finditer(src):
+                    line = src[:m.start()].count("\n") + 1
+                    offenders.append(f"{rel}:{line} ({what})")
+    assert not offenders, (
+        "unregistered compile sites — route through "
+        "tpuflow.obs.executables.registered_jit / register_compiled "
+        "(or extend the allowlist deliberately):\n  "
+        + "\n  ".join(offenders)
+    )
+
+
+# ---------------------------------------------------------------------
+# disabled-overhead guard (<2%, process_time — PR 4/5 methodology)
+# ---------------------------------------------------------------------
+
+def test_registered_jit_disabled_overhead_guard(registry):
+    """What a hot dispatch loop pays when the registry is DISARMED:
+    one module-flag read + delegation. process_time methodology of the
+    PR 4/5 guards — but this box's kernel quantizes CPU accounting to
+    10ms jiffies (clock_getres lies), so the iteration count is sized
+    so the 2µs/iter flake-forgiveness floor spans SEVERAL quanta
+    (full-suite contention observed tripping a finer-grained version
+    of this guard on pure quantization noise)."""
+    ex, _wd = registry
+    ex.disable()
+    x = jnp.ones((8, 8))
+    raw = jax.jit(lambda a: a + 1.0)
+    wrapped = ex.registered_jit(lambda a: a + 1.0, key="obs_mem.guard")
+    raw(x).block_until_ready()
+    wrapped(x).block_until_ready()
+    n = 20_000  # 2µs/iter allowance == 40ms == 4 clock quanta
+
+    def best(fn, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.process_time()
+            for _ in range(n):
+                fn(x)
+            fn(x).block_until_ready()
+            ts.append(time.process_time() - t0)
+        return min(ts)
+
+    tr = best(raw)
+    tw = best(wrapped)
+    per_iter_ns = max(0.0, (tw - tr) / n * 1e9)
+    assert tw <= tr * 1.02 or per_iter_ns < 2000, (
+        f"disarmed registered_jit too expensive: raw {tr * 1e3:.2f}ms "
+        f"vs wrapped {tw * 1e3:.2f}ms ({per_iter_ns:.0f}ns/iter)"
+    )
+
+
+# ---------------------------------------------------------------------
+# acceptance: smoke train-then-serve, ledger accounts >= 90%
+# ---------------------------------------------------------------------
+
+def test_train_then_serve_ledger_accounting(registry, ledger):
+    """ISSUE 7 acceptance: after a tiny LM fit and a few served
+    requests, the ledger's tagged components cover >= 90% of the
+    device bytes the run created (params + opt_state + kv_pages +
+    staging/eval; measured against a pre-run baseline so earlier
+    tests' stray live arrays don't pollute the denominator)."""
+    import gc
+
+    import flax.linen as nn
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.serve import ServeScheduler
+    from tpuflow.train import LMTrainer
+
+    gc.collect()
+    pre = ledger.reconcile()["total_bytes"]
+
+    lm = build_transformer_lm(vocab_size=64, dim=32, depth=2, heads=2,
+                              mlp_ratio=2, dtype=jnp.float32)
+    cfg = TrainConfig(optimizer="adamw", learning_rate=1e-3,
+                      warmup_epochs=0, scale_lr_by_world_size=False,
+                      seed=0)
+    tr = LMTrainer(lm, cfg)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, (16, 16)).astype(np.int32)
+    tr.fit(toks, batch_size=8, epochs=1,
+           val_tokens=rng.integers(0, 64, (8, 16)).astype(np.int32))
+
+    sched = ServeScheduler(lm, nn.unbox(tr.state.params), slots=2,
+                           seg=4, max_new_cap=8, kv="paged",
+                           kv_page_size=8)
+    reqs = [sched.submit(np.arange(1, 6, dtype=np.int32) * (i + 1) % 64,
+                         max_new_tokens=4) for i in range(3)]
+    sched.run_until_idle()
+    assert all(r.state.value == "done" for r in reqs)
+
+    gc.collect()
+    rep = ledger.update_gauges()
+    created = rep["total_bytes"] - pre
+    tagged = rep["tagged_bytes"]
+    assert created > 0
+    frac = tagged / created
+    assert frac >= 0.90, (
+        f"ledger attribution too low: tagged {tagged}B of {created}B "
+        f"created ({frac:.1%}); components={rep['components']} "
+        f"untagged={rep['untagged_bytes']}"
+    )
+    # the run's compiles all registered (trainer AOT + serve engine)
+    sites = executables.snapshot()["sites"]
+    assert any(k.startswith("lm.") for k in sites), sites.keys()
+    assert any(k.startswith("infer.") for k in sites), sites.keys()
+    # the trainer's AOT site carries the full analysis
+    aot = sites["lm.train_step"]
+    assert aot["kind"] == "aot" and aot["cost"]["flops"] > 0
+    assert aot["memory"] is not None
+    sched.stop(drain=False)

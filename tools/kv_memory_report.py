@@ -1,27 +1,21 @@
 #!/usr/bin/env python
-"""KV memory report: page-table occupancy, prefix-tree stats,
-bytes-per-live-token — from a RUNNING paged scheduler or a flight-
-recorder POST-MORTEM bundle (ISSUE 6 tooling).
+"""KV memory report — ABSORBED into the memory-and-compile plane
+(ISSUE 7): this tool is now a thin shim over
+``python -m tpuflow.cli.obs memreport <flight-dir-or-bundle>``, which
+prints the same KV sub-view PLUS the device-buffer ledger and the
+executable registry. See MIGRATION.md.
 
-Two entry points:
+Kept importable:
 
-- :func:`kv_report` (importable): pass a live ``ServeScheduler`` built
-  with ``kv='paged'`` — the same payload the scheduler registers as
-  its flight-recorder ``<prefix>_kv`` section;
-- CLI: ``python tools/kv_memory_report.py <flight-dir-or-bundle>``
-  pretty-prints the ``*_kv.json`` section of the newest post-mortem
-  bundle under a flight root (or of one specific bundle dir) — what
-  was the KV plane doing when the process died.
-
-The quantity that matters: ``bytes_per_live_token`` ≈ page_bytes/ps ×
-(1 + internal fragmentation). Under the contiguous cache the same
-number is ``slots × horizon / live_tokens`` × per-token bytes — the
-gap between the two is the capacity paging recovered.
+- :func:`kv_report` — snapshot a live ``ServeScheduler`` built with
+  ``kv='paged'`` (the same payload the scheduler registers as its
+  flight-recorder ``<prefix>_kv`` section);
+- :func:`format_report` — alias of
+  :func:`tpuflow.obs.memory.format_kv_section`.
 """
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 from typing import Any, Dict, Optional
@@ -29,66 +23,13 @@ from typing import Any, Dict, Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from tpuflow.obs.memory import format_kv_section as format_report  # noqa: E402,F401
+
 
 def kv_report(scheduler) -> Optional[Dict[str, Any]]:
     """Snapshot a live paged scheduler's KV plane (None under the
     contiguous cache)."""
     return scheduler.kv_snapshot()
-
-
-def format_report(snap: Dict[str, Any]) -> str:
-    lines = []
-    total, used = snap.get("pages_total", 0), snap.get("pages_in_use", 0)
-    pb = snap.get("page_bytes", 0)
-    lines.append(
-        f"pages: {used}/{total} in use "
-        f"({snap.get('kv_bytes_in_use', 0) / 1e6:.2f} / "
-        f"{snap.get('kv_bytes_total', 0) / 1e6:.2f} MB, "
-        f"{pb} B/page, page_size={snap.get('page_size')}, "
-        f"quant={snap.get('quant')})"
-    )
-    lines.append(
-        f"allocator: {snap.get('allocs', 0)} allocs, "
-        f"{snap.get('frees', 0)} frees, "
-        f"{snap.get('alloc_failures', 0)} failures, "
-        f"free-rate {snap.get('free_rate_per_s', 0)}/s"
-    )
-    live = snap.get("live_kv_tokens", 0)
-    bplt = snap.get("bytes_per_live_token")
-    lines.append(
-        f"live KV tokens: {live}"
-        + (f" -> {bplt} bytes/live-token" if bplt else "")
-    )
-    pfx = snap.get("prefix")
-    if pfx:
-        lines.append(
-            f"prefix tree: {pfx.get('nodes', 0)} nodes "
-            f"(depth {pfx.get('max_depth', 0)}), "
-            f"{pfx.get('inserts', 0)} inserts, "
-            f"{pfx.get('evictions', 0)} evictions"
-        )
-    pools = snap.get("pools") or {}
-    for b in sorted(pools, key=lambda x: int(x)):
-        rows = pools[b]
-        lines.append(f"pool bucket={b}: {len(rows)} live rows")
-        for r in rows:
-            lines.append(
-                f"  slot {r['slot']}: {r['id']} kv_len={r['kv_len']} "
-                f"pages={r['pages']} shared_prefix="
-                f"{r['shared_prefix_tokens']} tok"
-            )
-    return "\n".join(lines)
-
-
-def _load_bundle_kv(path: str) -> Dict[str, Dict[str, Any]]:
-    """``*_kv.json`` sections of one bundle dir, keyed by section
-    name."""
-    out = {}
-    for fn in sorted(os.listdir(path)):
-        if fn.endswith("_kv.json"):
-            with open(os.path.join(path, fn)) as f:
-                out[fn[:-len(".json")]] = json.load(f)
-    return out
 
 
 def main(argv=None) -> int:
@@ -102,29 +43,14 @@ def main(argv=None) -> int:
                         "picked) or one bundle directory")
     args = p.parse_args(argv)
 
-    path = args.path
-    if not os.path.isdir(path):
-        print(f"no such directory: {path}", file=sys.stderr)
+    if not os.path.isdir(args.path):
+        print(f"no such directory: {args.path}", file=sys.stderr)
         return 2
-    if not os.path.exists(os.path.join(path, "manifest.json")):
-        from tpuflow.obs import flight
+    print("note: kv_memory_report is now `python -m tpuflow.cli.obs "
+          "memreport` (full memory-and-compile report)", file=sys.stderr)
+    from tpuflow.cli.obs import main as obs_main
 
-        bundles = flight.list_bundles(path)
-        if not bundles:
-            print(f"no post-mortem bundles under {path}",
-                  file=sys.stderr)
-            return 2
-        path = bundles[-1]
-    sections = _load_bundle_kv(path)
-    if not sections:
-        print(f"{path}: no *_kv.json sections (scheduler not paged, "
-              f"or bundle predates ISSUE 6)", file=sys.stderr)
-        return 1
-    print(f"# {path}")
-    for name, snap in sections.items():
-        print(f"## {name}")
-        print(format_report(snap))
-    return 0
+    return obs_main(["memreport", args.path])
 
 
 if __name__ == "__main__":

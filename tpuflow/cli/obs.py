@@ -20,6 +20,14 @@ parsed through the same loader, :mod:`tpuflow.obs.report`)::
       the dump, gauge snapshot, in-flight serve requests. Given a dump
       ROOT directory, the newest bundle inside is shown.
 
+  python -m tpuflow.cli.obs memreport <bundle-or-root>
+      the memory-and-compile plane of a bundle (ISSUE 7): the
+      device-buffer ledger (per-component bytes + peaks + untagged
+      residual + HBM headroom), the executable registry (per-site
+      compiles / cost + roofline / memory analysis / compile-cache
+      stats), and the paged-KV sub-view (absorbing
+      ``tools/kv_memory_report.py`` — see MIGRATION.md).
+
 For XLA *device-op* attribution of a jax.profiler capture, use
 ``python tools/trace_top_ops.py <dir>`` — same loader, op-level table.
 """
@@ -49,6 +57,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                                  "newest bundle wins)")
     pp.add_argument("--spans", type=int, default=12,
                     help="how many of the last spans to show")
+    pm = sub.add_parser("memreport",
+                        help="memory-and-compile report of a bundle "
+                             "(ledger + executables + KV sub-view)")
+    pm.add_argument("path", help="bundle directory (or the dump root — "
+                                 "newest bundle wins)")
     args = p.parse_args(argv)
 
     if args.cmd == "postmortem":
@@ -60,6 +73,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(str(e), file=sys.stderr)
             return 1
         print(format_postmortem(bundle, top_spans=args.spans))
+        return 0
+
+    if args.cmd == "memreport":
+        from tpuflow.obs.flight import load
+        from tpuflow.obs.memory import format_memreport
+
+        try:
+            bundle = load(args.path)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        print(format_memreport(bundle))
         return 0
 
     from tpuflow.obs.report import (

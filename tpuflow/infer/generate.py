@@ -51,7 +51,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpuflow.obs import memory as _mem
 from tpuflow.obs import trace
+# the jit decorator with a compile-registry conscience: every engine
+# executable here registers under a stable site key (ISSUE 7), so
+# recompile storms — the bucket-menu-explosion failure mode — surface
+# in the executable registry and its watchdog instead of only as
+# mysterious serving latency
+from tpuflow.obs.executables import registered_jit as _rjit
 
 
 class _LRU:
@@ -463,11 +470,11 @@ def _compiled_blockwise(dm, b: int, p: int, max_len: int,
         return out
 
     if has_pads:
-        @jax.jit
+        @_rjit(key="infer.blockwise")
         def run(params, prompt, rng, pad_lens):
             return _impl(params, prompt, rng, pad_lens)
     else:
-        @jax.jit
+        @_rjit(key="infer.blockwise")
         def run(params, prompt, rng):
             return _impl(params, prompt, rng, None)
 
@@ -544,8 +551,10 @@ def serve_pool_arrays(model, slots: int, length: int, kv_spec=None):
     is batch-size-independent (ONE store serves all slot pools; see
     MIGRATION.md for this signature change)."""
     dm = _serve_decode_model(model, kv_spec)
-    return (_cache_zeros(dm, slots, length),
-            jnp.zeros((slots, length), jnp.int32))
+    arrays = (_cache_zeros(dm, slots, length),
+              jnp.zeros((slots, length), jnp.int32))
+    _mem.tag("kv_pages", arrays)  # device-buffer ledger (ISSUE 7)
+    return arrays
 
 
 # --------------------------------------------------------------------
@@ -590,7 +599,9 @@ def paged_kv_arrays(model, kv_spec):
     independent — ONE store is threaded through every pool's join and
     segment executables."""
     dm = _serve_decode_model(model, kv_spec)
-    return _cache_zeros(dm, 1, 1)
+    store = _cache_zeros(dm, 1, 1)
+    _mem.tag("kv_pages", store)  # device-buffer ledger (ISSUE 7)
+    return store
 
 
 def paged_page_bytes(kv_cache) -> int:
@@ -640,7 +651,7 @@ def paged_join_fn(model, kv_spec, slots: int, out_len: int,
 @_lru("paged_join", maxsize=128)
 def _compiled_paged_join(dm, b: int, out_len: int, n_row_pages: int,
                          w: int):
-    @jax.jit
+    @_rjit(key="infer.paged_join")
     def join(params, cache, out, tokens, starts, widths, page_table):
         idx = starts[:, None] + jnp.arange(w, dtype=jnp.int32)
         live = jnp.arange(w)[None, :] < widths[:, None]
@@ -702,7 +713,7 @@ def _compiled_paged_segment(dm, b: int, out_len: int, n_row_pages: int,
                             eos_id: Optional[int]):
     fill = jnp.int32(eos_id if eos_id is not None else 0)
 
-    @jax.jit
+    @_rjit(key="infer.paged_segment")
     def segment(params, cache, out, done, pos0, kv_limit, last_tok,
                 stream_ids, rng, page_table):
         def step(carry, i):
@@ -741,7 +752,7 @@ def _compiled_paged_segment(dm, b: int, out_len: int, n_row_pages: int,
     return segment
 
 
-@jax.jit
+@_rjit(key="infer.paged_copy")
 def _paged_copy_jit(cache, src, dst):
     return jax.tree.map(lambda a: a.at[dst].set(a[src]), cache)
 
@@ -790,7 +801,7 @@ def serve_join_fn(model, slots: int, length: int, bucket: int):
 
 @_lru("serve_join", maxsize=32)
 def _compiled_serve_join(dm, b: int, length: int, bucket: int):
-    @jax.jit
+    @_rjit(key="infer.serve_join")
     def join(params, cache, out, pad_lens, prompts, join_mask, t0):
         start = t0 - bucket + 1
         out_new = lax.dynamic_update_slice(out, prompts, (0, start))
@@ -852,7 +863,7 @@ def _compiled_serve_segment(dm, b: int, length: int, seg: int,
                             eos_id: Optional[int]):
     fill = jnp.int32(eos_id if eos_id is not None else 0)
 
-    @jax.jit
+    @_rjit(key="infer.serve_segment")
     def segment(params, cache, out, done, pad_lens, stream_ids,
                 last_pos, rng, t0):
         def step(carry, i):
@@ -890,7 +901,7 @@ def _compiled_run(dm, b: int, p: int, max_len: int, temperature: float,
     single-token steps covers prefill and sampling; kept as the parity
     oracle for the blockwise engine and as the conservative fallback."""
 
-    @jax.jit
+    @_rjit(key="infer.stepwise")
     def run(params, prompt, rng):
         cache0 = _cache_zeros(dm, b, max_len)
         out0 = jnp.zeros((b, max_len), jnp.int32)

@@ -2,8 +2,10 @@
 # jax-profiler context manager formerly exported here under the same
 # name stays available as ``profiler_trace`` and at its home,
 # ``tpuflow.obs.profiler.trace``.
+import tpuflow.obs.executables as executables  # noqa: F401
 import tpuflow.obs.flight as flight  # noqa: F401
 import tpuflow.obs.health as health  # noqa: F401
+import tpuflow.obs.memory as memory  # noqa: F401
 import tpuflow.obs.prom as prom  # noqa: F401
 import tpuflow.obs.report as report  # noqa: F401
 import tpuflow.obs.timeseries as timeseries  # noqa: F401

@@ -1,0 +1,380 @@
+"""Compile/executable registry + recompile watchdog (ISSUE 7
+tentpole) — the compile half of the memory-and-compile plane.
+
+The spans (ISSUE 4) and metrics plane (ISSUE 5) observe *time*;
+nothing observed *compilation* — the resource that silently eats
+serving latency (every _LRU eviction is seconds of rebuild) and the
+one whose pathologies (bucket-menu explosion, shape leaks retracing a
+trainer step every call) look exactly like "the job got slow" until
+someone diffs executable counts. This module is the one place every
+compile the repo performs reports to:
+
+- **registered jit sites** — :func:`registered_jit` wraps ``jax.jit``;
+  every call site under ``tpuflow/`` routes through it (a grep-based
+  tier-1 guard pins that). When the registry is DISABLED (default) the
+  wrapper is a single flag read + delegation — the same near-zero
+  contract as the tracer; when enabled, each call does one C-level
+  ``_cache_size()`` read, and a size increase == a compile event:
+  wall time (the miss call's wall — trace+compile+first dispatch),
+  the argument shape signature, and per-site hit/miss counts are
+  recorded. ``analyze='lower'`` additionally pays ONE retrace per
+  compile to harvest XLA's pre-compile ``cost_analysis`` (FLOPs,
+  bytes accessed → arithmetic intensity and a roofline verdict).
+- **AOT registrations** — sites that already compile ahead-of-time for
+  FLOPs accounting (the trainers' ``lower().compile()``) call
+  :meth:`RegisteredJit.aot_compile` / :func:`register_compiled`
+  instead, which captures the FULL picture from the compiled object:
+  ``cost_analysis()`` (summed across device shares —
+  :func:`tpuflow.obs.mfu.cost_analysis_of`), ``memory_analysis()``
+  (temp/argument/output/alias bytes — the numbers that would have
+  flagged the ISSUE 6 page-scatter copy), compile wall time. No extra
+  compile is ever paid: registration reads what the site already built.
+- **recompile watchdog** — the same registry key compiling across more
+  than ``recompile_threshold`` DISTINCT argument-shape signatures
+  (bucket-menu explosion, shape leaks — deliberate same-shape
+  re-compiles across fresh fits don't count) trips the (ISSUE 5)
+  watchdog with the offending shape signatures in the message; the
+  trip latches into
+  ``/readyz`` reasons and flight-recorder manifests exactly like a
+  NaN or stall trip because it rides the same
+  :func:`~tpuflow.obs.health.default_watchdog`. Trips only fire while
+  the registry is ENABLED (armed by the serve CLI, by
+  ``TrainConfig.watchdog``, by ``TPUFLOW_COMPILE_REGISTRY=1``, or
+  explicitly) so an unarmed test process can never latch one.
+
+Counters/gauges export through the shared registry (``compile.*`` in
+``/v1/metrics`` + Prometheus); :func:`snapshot` is the flight
+recorder's ``executables.json`` section and the ``memreport`` CLI's
+compile table.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from tpuflow.obs.gauges import inc_counter, set_gauge
+
+_LOCK = threading.Lock()
+_SITES: Dict[str, Dict[str, Any]] = {}
+_ENABLED = bool(os.environ.get("TPUFLOW_COMPILE_REGISTRY"))
+#: 'off' = count compiles only; 'lower' = also retrace once per compile
+#: event for pre-compile cost analysis (AOT registrations always carry
+#: full analysis — they never pay anything extra)
+_ANALYZE = "lower" if os.environ.get("TPUFLOW_COMPILE_ANALYSIS") else "off"
+_THRESHOLD = int(os.environ.get("TPUFLOW_RECOMPILE_THRESHOLD", "16"))
+_WATCHDOG = None  # None -> health.default_watchdog() at trip time
+_MAX_SIGS = 6  # recent shape signatures kept per site
+
+
+def enable(analyze: Optional[str] = None) -> None:
+    """Arm the registry (idempotent). ``analyze='lower'`` opts into
+    per-compile cost analysis on plain jit sites (one retrace per
+    compile event — compile-dominated test suites leave it off)."""
+    global _ENABLED
+    if analyze is not None:
+        configure(analyze=analyze)
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def configure(threshold: Optional[int] = None, watchdog=None,
+              analyze: Optional[str] = None) -> None:
+    """Adjust the recompile-trip threshold / trip surface / analysis
+    mode (tests inject a private Watchdog and a tiny threshold)."""
+    global _THRESHOLD, _WATCHDOG, _ANALYZE
+    if threshold is not None:
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        _THRESHOLD = int(threshold)
+    if watchdog is not None:
+        _WATCHDOG = watchdog
+    if analyze is not None:
+        if analyze not in ("off", "lower"):
+            raise ValueError(
+                f"analyze must be 'off' or 'lower', got {analyze!r}"
+            )
+        _ANALYZE = analyze
+
+
+def clear() -> None:
+    """Drop every site record (test isolation). Does not disarm."""
+    with _LOCK:
+        _SITES.clear()
+
+
+def _site(key: str) -> Dict[str, Any]:
+    # callers hold _LOCK
+    s = _SITES.get(key)
+    if s is None:
+        s = _SITES[key] = {
+            "key": key, "kind": "jit", "compiles": 0, "calls": 0,
+            "wall_s_total": 0.0, "last_wall_s": 0.0,
+            "shapes": [], "cost": None, "memory": None, "tripped": False,
+        }
+    return s
+
+
+def shape_signature(args: tuple, kwargs: Optional[dict] = None,
+                    limit: int = 16) -> str:
+    """Compact ``dtype[shape]`` signature of a call's array arguments —
+    what the recompile watchdog quotes so a trip names the offending
+    shapes, not just a count."""
+    import jax
+
+    parts: List[str] = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs or {})):
+        sh = getattr(leaf, "shape", None)
+        if sh is not None:
+            dt = getattr(getattr(leaf, "dtype", None), "name", "?")
+            parts.append(f"{dt}[{','.join(str(d) for d in sh)}]")
+        else:
+            parts.append(type(leaf).__name__)
+        if len(parts) >= limit:
+            parts.append("...")
+            break
+    return "(" + ", ".join(parts) + ")"
+
+
+def record_compile(key: str, wall_s: float = 0.0,
+                   sig: Optional[str] = None, kind: str = "jit",
+                   cost: Optional[Dict[str, Any]] = None,
+                   memory: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Record one compile event under ``key`` (every path — jit-miss
+    detection, AOT registration — funnels here). No-op while the
+    registry is DISARMED, like the span tracer: counts always mean
+    "since arming", so arming a long-lived process mid-flight cannot
+    inherit a history it never observed.
+
+    The watchdog trips on DISTINCT SHAPE SIGNATURES per site crossing
+    the threshold, not raw compile counts: bucket-menu explosion and
+    shape leaks grow the distinct-shape set, while N separate fits
+    re-AOT-compiling the same step at the SAME shapes is deliberate
+    work (same-shape cache thrash is the _LRU eviction counter's
+    signal instead)."""
+    if not _ENABLED:
+        return {}
+    with _LOCK:
+        s = _site(key)
+        s["compiles"] += 1
+        s["wall_s_total"] += float(wall_s)
+        s["last_wall_s"] = float(wall_s)
+        if kind == "aot":
+            s["kind"] = "aot"
+        sigset = s.setdefault("_sigset", set())
+        if sig:
+            if len(sigset) <= _THRESHOLD + 8:  # bounded bookkeeping
+                sigset.add(sig)
+            if not s["shapes"] or s["shapes"][-1] != sig:
+                s["shapes"].append(sig)
+                del s["shapes"][:-_MAX_SIGS]
+        if cost is not None:
+            s["cost"] = cost
+        if memory is not None:
+            s["memory"] = memory
+        n = s["compiles"]
+        distinct = len(sigset)
+        trip = (distinct > _THRESHOLD and not s["tripped"])
+        if trip:
+            s["tripped"] = True
+        shapes = list(s["shapes"])
+        set_gauge("compile.sites", float(len(_SITES)))
+    inc_counter("compile.compiles_total")
+    if n > 1:
+        inc_counter("compile.recompiles_total")
+    if trip:
+        inc_counter("compile.recompile_trips_total")
+        wd = _WATCHDOG
+        if wd is None:
+            from tpuflow.obs.health import default_watchdog
+
+            wd = default_watchdog()
+        wd.trip(
+            f"recompile storm: {key} compiled {n}x across {distinct} "
+            f"distinct shapes (threshold {_THRESHOLD}); recent shapes: "
+            f"{'; '.join(shapes) or '?'}",
+            kind="recompile", site=key, compiles=n,
+            distinct_shapes=distinct,
+            threshold=_THRESHOLD, shapes=shapes,
+        )
+    with _LOCK:
+        return _snapshot_site(_SITES[key])
+
+
+def _snapshot_site(s: Dict[str, Any]) -> Dict[str, Any]:
+    # callers hold _LOCK; JSON-able copy (the _sigset working set
+    # collapses to its count)
+    out = {k: v for k, v in s.items() if not k.startswith("_")}
+    out["distinct_shapes"] = len(s.get("_sigset", ()))
+    return out
+
+
+def register_compiled(key: str, compiled, wall_s: float = 0.0,
+                      sig: Optional[str] = None):
+    """Register an already-compiled executable (AOT sites): full XLA
+    cost analysis (FLOPs + bytes accessed, summed across device
+    shares), arithmetic intensity + roofline verdict, and
+    ``memory_analysis`` (temp/argument/output/alias bytes). Returns
+    ``compiled`` so the call site stays one expression. A no-op
+    passthrough while the registry is disarmed — the analyses would
+    be discarded anyway (callers that want FLOPs regardless read
+    ``cost_analysis_of`` themselves; see :func:`site_cost`)."""
+    if not _ENABLED:
+        return compiled
+    from tpuflow.obs.mfu import cost_analysis_of, roofline
+
+    cost = cost_analysis_of(compiled)
+    if cost.get("flops") and cost.get("bytes_accessed"):
+        cost.update(roofline(cost["flops"], cost["bytes_accessed"]))
+    mem = None
+    try:
+        ma = compiled.memory_analysis()
+        mem = {
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        }
+    except Exception:
+        pass  # backend without memory analysis: the cost half stands
+    record_compile(key, wall_s=wall_s, sig=sig, kind="aot",
+                   cost=cost or None, memory=mem)
+    return compiled
+
+
+class RegisteredJit:
+    """``jax.jit`` with a registry conscience.
+
+    Disabled (default): ``__call__`` is one module-flag read plus
+    delegation to the underlying jitted callable — the tier-1 overhead
+    guard pins this path. Enabled: each call reads the jit dispatch
+    cache size (a C call); growth is a compile event (jax's dispatch
+    cache is keyed exactly like its compiles, so the delta is the
+    truth, not a heuristic). ``aot_compile`` is the full-analysis
+    path for sites that want the compiled object anyway."""
+
+    __slots__ = ("key", "_jit", "_csize", "_seen")
+
+    def __init__(self, fn: Callable, key: str, **jit_kwargs: Any):
+        import jax
+
+        self.key = key
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self._csize = getattr(self._jit, "_cache_size", None)
+        self._seen = 0
+
+    def __call__(self, *args: Any, **kwargs: Any):
+        if not _ENABLED:
+            return self._jit(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._jit(*args, **kwargs)
+        with _LOCK:
+            _site(self.key)["calls"] += 1
+        if self._csize is not None:
+            try:
+                n = self._csize()
+            except Exception:  # pragma: no cover - C-API drift
+                n = self._seen
+            if n > self._seen:
+                self._seen = n
+                self._on_miss(time.perf_counter() - t0, args, kwargs)
+        return out
+
+    def _on_miss(self, wall_s: float, args: tuple, kwargs: dict) -> None:
+        sig = None
+        cost = None
+        try:
+            sig = shape_signature(args, kwargs)
+            if _ANALYZE == "lower":
+                from tpuflow.obs.mfu import cost_analysis_of, roofline
+
+                lowered = self._jit.lower(*args, **kwargs)
+                cost = cost_analysis_of(lowered)
+                if cost.get("flops") and cost.get("bytes_accessed"):
+                    cost.update(roofline(cost["flops"],
+                                         cost["bytes_accessed"]))
+        except Exception:
+            pass  # observing a compile must never fail the dispatch
+        record_compile(self.key, wall_s=wall_s, sig=sig,
+                       cost=cost or None)
+
+    def lower(self, *args: Any, **kwargs: Any):
+        return self._jit.lower(*args, **kwargs)
+
+    def eval_shape(self, *args: Any, **kwargs: Any):
+        return self._jit.eval_shape(*args, **kwargs)
+
+    def aot_compile(self, *args: Any, **kwargs: Any):
+        """``lower().compile()`` + registration in one step — what the
+        trainers' existing AOT-for-FLOPs sites route through, so the
+        registry's deepest records cost nothing extra."""
+        t0 = time.perf_counter()
+        compiled = self._jit.lower(*args, **kwargs).compile()
+        return register_compiled(
+            self.key, compiled, wall_s=time.perf_counter() - t0,
+            sig=shape_signature(args, kwargs),
+        )
+
+
+def registered_jit(fn: Optional[Callable] = None, *,
+                   key: Optional[str] = None, **jit_kwargs: Any):
+    """Drop-in for ``jax.jit`` that registers its compiles. Usable as
+    ``registered_jit(fn, key=..., donate_argnums=0)`` or as a
+    decorator ``@registered_jit(key=...)``."""
+    if fn is None:
+        def wrap(f: Callable) -> RegisteredJit:
+            return RegisteredJit(
+                f, key or getattr(f, "__qualname__", "anon"), **jit_kwargs
+            )
+
+        return wrap
+    return RegisteredJit(
+        fn, key or getattr(fn, "__qualname__", "anon"), **jit_kwargs
+    )
+
+
+def site_cost(key: str) -> Optional[Dict[str, Any]]:
+    """The cost-analysis dict an AOT registration already captured for
+    ``key`` (None when disarmed / never registered / analysis failed)
+    — so a call site that registered an executable one line ago does
+    not re-run XLA's analysis (or double-count its error counter) to
+    read the same numbers."""
+    with _LOCK:
+        s = _SITES.get(key)
+        cost = s.get("cost") if s else None
+        return dict(cost) if cost else None
+
+
+def snapshot() -> Dict[str, Any]:
+    """JSON-able registry state — the flight recorder's
+    ``executables.json`` section and the memreport compile table.
+    Includes the infer compile-cache (hit/miss/eviction) stats so one
+    section answers both "what compiled" and "what is cached"."""
+    with _LOCK:
+        sites = {k: _snapshot_site(v) for k, v in _SITES.items()}
+    caches: Dict[str, Any] = {}
+    try:
+        from tpuflow.infer.generate import compile_cache_stats
+
+        caches = compile_cache_stats()
+    except Exception:
+        pass
+    return {
+        "enabled": _ENABLED,
+        "analyze": _ANALYZE,
+        "recompile_threshold": _THRESHOLD,
+        "compiles_total": sum(s["compiles"] for s in sites.values()),
+        "sites": sites,
+        "caches": caches,
+    }

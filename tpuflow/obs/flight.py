@@ -17,6 +17,10 @@ knew at that moment:
 - ``timeseries.json`` — the snapshot ring export, when one is ticking
   (how the numbers MOVED leading up to the trip);
 - ``sysmetrics.json`` — host CPU/mem + device HBM;
+- ``memory.json`` — the device-buffer ledger's attribution + timeline
+  (ISSUE 7; present when anything was tagged);
+- ``executables.json`` — the compile/executable registry snapshot
+  (sites, cost/memory analyses, compile-cache stats);
 - one ``<provider>.json`` per registered provider — e.g. the serving
   scheduler's in-flight request states.
 
@@ -128,6 +132,21 @@ def dump(out_dir: str, reason: str,
         return sample_system_metrics()
 
     section("sysmetrics.json", sysm)
+
+    def memsec():
+        from tpuflow.obs import memory
+
+        return memory.snapshot()  # None when nothing was tagged
+
+    section("memory.json", memsec)
+
+    def exsec():
+        from tpuflow.obs import executables
+
+        snap = executables.snapshot()
+        return snap if (snap["sites"] or snap["caches"]) else None
+
+    section("executables.json", exsec)
     for pname, fn in providers.items():
         section(f"{pname}.json", fn)
 

@@ -568,6 +568,17 @@ def monitor_from_config(cfg) -> Optional[HealthMonitor]:
     if not (getattr(cfg, "watchdog", False)
             or getattr(cfg, "stall_timeout_s", None)):
         return None
+    if getattr(cfg, "watchdog", False):
+        # arming the watchdog also arms the compile registry (ISSUE 7):
+        # a recompile storm during a watched fit should trip the same
+        # surface a NaN does, and the armed per-dispatch cost is one
+        # C-level cache-size read. stall_timeout_s ALONE stays
+        # heartbeat-only — same contract as guard_metrics below: the
+        # user asked for stall detection, not a fit-halting compile
+        # guard.
+        from tpuflow.obs import executables
+
+        executables.enable()
     # the monitor rides the PROCESS default watchdog (so /readyz and
     # flight manifests see trainer trips) but only reacts to trips
     # NEWER than its own arming — a prior run's latched trip neither

@@ -73,17 +73,77 @@ def device_peak_flops(device: Optional[Any] = None) -> float:
                         cpu_nominal=1e11, default=275e12)
 
 
-def flops_of_compiled(compiled) -> float:
-    """FLOPs from an already-compiled executable's XLA cost analysis
-    (0.0 if the backend reports none). NOTE: for a sharded program this
-    is the PER-DEVICE share."""
+def cost_analysis_of(compiled) -> dict:
+    """XLA cost analysis of a ``Compiled`` (or ``Lowered``) object as
+    ``{"flops", "bytes_accessed", "per_device"}``.
+
+    Per-device lists are SUMMED across device shares (the whole
+    program's work, with ``per_device`` recording how many shares went
+    into it) instead of silently reading ``[0]`` — for an SPMD-
+    partitioned step the old single-share read under-reported sharded
+    programs by the device count. A backend that raises is no longer
+    swallowed silently either: the failure is counted on the
+    ``compile.cost_analysis_errors_total`` counter and an empty dict
+    comes back."""
     try:
         ca = compiled.cost_analysis()
     except Exception:
-        return 0.0
-    if isinstance(ca, list):  # per-device list on some backends
-        ca = ca[0] if ca else {}
-    return float(ca.get("flops", 0.0)) if ca else 0.0
+        from tpuflow.obs.gauges import inc_counter
+
+        inc_counter("compile.cost_analysis_errors_total")
+        return {}
+    shares = ca if isinstance(ca, list) else [ca]
+    shares = [s for s in shares if s]
+    if not shares:
+        return {}
+    return {
+        "flops": float(sum(s.get("flops", 0.0) for s in shares)),
+        "bytes_accessed": float(
+            sum(s.get("bytes accessed", 0.0) for s in shares)
+        ),
+        "per_device": len(shares),
+    }
+
+
+def flops_of_compiled(compiled) -> float:
+    """FLOPs from an already-compiled executable's XLA cost analysis
+    (0.0 if the backend reports none), summed across per-device
+    shares — see :func:`cost_analysis_of`."""
+    return cost_analysis_of(compiled).get("flops", 0.0)
+
+
+def arithmetic_intensity(flops: float,
+                         bytes_accessed: float) -> Optional[float]:
+    """FLOPs per byte moved — the x-axis of the roofline model. None
+    when either input is missing/zero."""
+    if not flops or not bytes_accessed:
+        return None
+    return float(flops) / float(bytes_accessed)
+
+
+def roofline(flops: float, bytes_accessed: float,
+             device: Optional[Any] = None) -> dict:
+    """Roofline verdict for one executable against ONE chip's specs:
+    ``arithmetic_intensity`` vs the ridge point
+    ``peak_flops / hbm_bandwidth``. Below the ridge the program cannot
+    reach peak FLOP/s no matter how good the kernels are — it is
+    ``memory-bound`` and its attainable FLOP/s ceiling is
+    ``AI × bandwidth``; above it, ``compute-bound`` with the chip's
+    peak as the ceiling. Empty dict when the inputs are missing."""
+    ai = arithmetic_intensity(flops, bytes_accessed)
+    if ai is None:
+        return {}
+    peak = device_peak_flops(device)
+    bw = device_hbm_bandwidth(device)
+    ridge = peak / bw
+    return {
+        "arithmetic_intensity": ai,
+        "ridge_flops_per_byte": ridge,
+        "verdict": "memory-bound" if ai < ridge else "compute-bound",
+        "attainable_flops_per_s": min(peak, ai * bw),
+        "peak_flops_assumed": peak,
+        "hbm_bandwidth_assumed": bw,
+    }
 
 
 def flops_of_jitted(jitted_fn, *args, **kwargs) -> float:

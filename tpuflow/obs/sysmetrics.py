@@ -72,8 +72,13 @@ def sample_system_metrics(include_devices: bool = True,
     covers pulled AND pushed sources."""
     m: Dict[str, float] = {"sys.cpu_percent": _cpu_percent(), "sys.time": time.time()}
     if include_gauges:
+        from tpuflow.obs import memory
         from tpuflow.obs.gauges import snapshot_gauges
 
+        # refresh the device-buffer ledger's mem.* gauges first so the
+        # merged snapshot below carries them; a no-op (one dict
+        # truthiness check) until something is tagged
+        memory.maybe_update_gauges()
         m.update(snapshot_gauges())
     mem = _proc_meminfo()
     if mem:
@@ -89,13 +94,23 @@ def sample_system_metrics(include_devices: bool = True,
         import jax
 
         for d in jax.local_devices():
-            stats = {}
             try:
-                stats = d.memory_stats() or {}
+                stats = d.memory_stats()
             except Exception:
-                pass
+                stats = None
+            if not stats:
+                # explicit marker instead of silently omitting the
+                # device: backends that return None (XLA:CPU) used to
+                # be indistinguishable from a device with no keys —
+                # "zero HBM pressure" and "no data" are different facts
+                m[f"mem.device{d.id}.stats_unavailable"] = 1.0
+                continue
             if "bytes_in_use" in stats:
-                m[f"device{d.id}.hbm_in_use_bytes"] = float(stats["bytes_in_use"])
+                v = float(stats["bytes_in_use"])
+                m[f"device{d.id}.hbm_in_use_bytes"] = v  # legacy key
+                m[f"mem.device{d.id}.bytes_in_use"] = v
             if "bytes_limit" in stats:
-                m[f"device{d.id}.hbm_limit_bytes"] = float(stats["bytes_limit"])
+                v = float(stats["bytes_limit"])
+                m[f"device{d.id}.hbm_limit_bytes"] = v  # legacy key
+                m[f"mem.device{d.id}.bytes_limit"] = v
     return m

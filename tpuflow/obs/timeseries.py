@@ -104,7 +104,18 @@ class SnapshotRing:
     # ---- capture ----------------------------------------------------
     def tick(self) -> None:
         """Capture one snapshot of every registered histogram's raw
-        state plus the scalar gauges/counters."""
+        state plus the scalar gauges/counters. Refreshes the
+        device-buffer ledger's ``mem.*`` gauges first (ISSUE 7) — the
+        ring's cadence is the one periodic heartbeat every long-lived
+        process (serve frontend, prom exporter, trainers with a
+        metrics port) already has, so the ledger needs no sampler of
+        its own; a no-op until something is tagged."""
+        try:
+            from tpuflow.obs import memory as _memory
+
+            _memory.maybe_update_gauges()
+        except Exception:
+            pass  # the ledger must never take the snapshot ring down
         snap = {
             "ts": self.clock(),
             "hists": {n: h.state() for n, h in _histograms().items()},

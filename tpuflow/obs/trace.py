@@ -327,6 +327,16 @@ def export_chrome_trace(path: str) -> str:
             "dur": round((s["t1_ns"] - s["t0_ns"]) / 1e3, 3),
             "args": args,
         })
+    # memory-ledger counter tracks (ISSUE 7): the per-component device
+    # byte timeline renders as stacked Perfetto counters beside the
+    # span tracks — lazy + guarded so the exporter never depends on
+    # the ledger being armed
+    try:
+        from tpuflow.obs import memory as _memory
+
+        events.extend(_memory.counter_events(pid))
+    except Exception:  # pragma: no cover - ledger must not break export
+        pass
     d = os.path.dirname(os.path.abspath(path))
     if d:
         os.makedirs(d, exist_ok=True)

@@ -339,8 +339,11 @@ class PackagedLM:
             tgt[i, : len(r)] = r
         if self._jit_text_loss is None:
             # one jitted closure; jax re-specializes per padded width
-            self._jit_text_loss = jax.jit(
-                lambda params, ids, tgt: token_loss(
+            from tpuflow.obs.executables import registered_jit
+
+            self._jit_text_loss = registered_jit(
+                key="packaging.score_text",
+            )(lambda params, ids, tgt: token_loss(
                     self.model.apply({"params": params}, ids)[:, :-1],
                     tgt[:, 1:], ignore_index=-1,
                 )
@@ -360,11 +363,13 @@ class PackagedLM:
 
         if self._jit_loss is None:
             # built once — score() in an eval loop must not retrace
-            self._jit_loss = jax.jit(
-                lambda params, toks: next_token_loss(
-                    self.model.apply({"params": params}, toks), toks
-                )
-            )
+            from tpuflow.obs.executables import registered_jit
+
+            self._jit_loss = registered_jit(
+                key="packaging.score",
+            )(lambda params, toks: next_token_loss(
+                self.model.apply({"params": params}, toks), toks
+            ))
         loss = float(
             self._jit_loss(self.params, jnp.asarray(tokens, jnp.int32))
         )

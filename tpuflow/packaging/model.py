@@ -186,8 +186,9 @@ class PackagedModel:
 
         if self._jit_forward is None:
             model = self.model
+            from tpuflow.obs.executables import registered_jit
 
-            @jax.jit
+            @registered_jit(key="packaging.predict_logits")
             def fwd(variables, x):
                 return model.apply(variables, preprocess_input(x), train=False)
 

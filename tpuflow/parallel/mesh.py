@@ -164,8 +164,11 @@ def put_replicated(x, sharding: NamedSharding):
             )
         data = np.asarray(jax.device_get(jax.random.key_data(x)))
         g = jax.make_array_from_process_local_data(sharding, data)
-        return jax.jit(
-            jax.random.wrap_key_data, out_shardings=sharding
+        from tpuflow.obs.executables import registered_jit
+
+        return registered_jit(
+            jax.random.wrap_key_data, key="mesh.wrap_key_data",
+            out_shardings=sharding,
         )(g)
     data = np.asarray(jax.device_get(x))
     return jax.make_array_from_callback(

@@ -82,6 +82,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tpuflow.obs import trace as _trace
 
         _trace.enable()
+    # memory-and-compile plane (ISSUE 7): a long-lived server always
+    # arms the executable registry — recompile storms (bucket-menu
+    # explosion) must trip /readyz, not read as mysterious latency.
+    # Per-call cost while armed is one C-level cache-size read.
+    from tpuflow.obs import executables as _executables
+
+    _executables.enable()
     if args.flight_dir:
         from tpuflow.obs import flight as _flight
         from tpuflow.obs.health import default_watchdog

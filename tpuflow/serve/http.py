@@ -153,9 +153,20 @@ class _Handler(BaseHTTPRequestHandler):
                                         "cancelled": sched.cancel(rid)})
             return self._json(404, {"error": f"no route {self.path}"})
         except QueueFull as e:
+            # backpressure telemetry: quote the current HBM headroom
+            # (and refresh the mem.hbm_headroom_bytes gauge) so a
+            # shedding client — or the operator reading 429 bodies —
+            # can tell queue pressure from memory pressure
+            from tpuflow.obs import memory as _memory
+            from tpuflow.obs.gauges import set_gauge
+
+            headroom = _memory.hbm_headroom_bytes()
+            if headroom is not None:
+                set_gauge("mem.hbm_headroom_bytes", float(headroom))
             self._json(
                 429,
-                {"error": "queue full", "retry_after_s": e.retry_after_s},
+                {"error": "queue full", "retry_after_s": e.retry_after_s,
+                 "hbm_headroom_bytes": headroom},
                 headers={"Retry-After": f"{max(1, round(e.retry_after_s))}"},
             )
         except ValueError as e:

@@ -491,10 +491,12 @@ class PagedKV:
     def execute_forks(self, plan: PagePlan) -> None:
         if plan.forks:
             from tpuflow.infer.generate import paged_copy
+            from tpuflow.obs import memory as _mem
 
             src = [s for s, _ in plan.forks]
             dst = [d for _, d in plan.forks]
             self.cache = paged_copy(self.cache, src, dst)
+            _mem.tag("kv_pages", self.cache)  # COW replaced the store
 
     def insert_prompt(self, prompt: np.ndarray, plan: PagePlan) -> int:
         """After the join prefill: publish the request's full prompt

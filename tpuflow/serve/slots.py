@@ -35,6 +35,7 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from tpuflow.obs import memory as _mem
 from tpuflow.obs import trace
 from tpuflow.serve.request import Request
 
@@ -172,6 +173,9 @@ class SlotPool:
                 jnp.asarray(self.pad_lens), jnp.asarray(prompts),
                 jnp.asarray(mask), self.t,
             )
+        # functional update replaced the buffers: keep the ledger's
+        # kv_pages tag on the LIVE arrays (the old ones just died)
+        _mem.tag("kv_pages", (self.cache, self.out))
 
     def evict(self, slot: int) -> Optional[Request]:
         """Free a slot WITHOUT waiting for its row to finish
@@ -226,6 +230,7 @@ class SlotPool:
             was_done = self.done
             self.done = np.array(done_dev)
             toks = np.asarray(toks)
+        _mem.tag("kv_pages", (self.cache, self.out))
         events = []
         for slot, req in enumerate(self.occupants):
             if req is None or was_done[slot]:
@@ -417,6 +422,7 @@ class PagedSlotPool:
                 jnp.asarray(tokens), jnp.asarray(starts),
                 jnp.asarray(widths), jnp.asarray(self.page_table),
             )
+        _mem.tag("kv_pages", (self.kv.cache, self.out))
         for slot, req, plan in admits:
             kv.insert_prompt(req.prompt_ids, plan)
 
@@ -469,6 +475,7 @@ class PagedSlotPool:
             self.run_segment()
             self.evict(0)
         self.kv.cache = paged_copy(self.kv.cache, [0], [0])  # sink no-op
+        _mem.tag("kv_pages", self.kv.cache)
         self.segments_run = 0
 
     def run_segment(self):
@@ -492,6 +499,7 @@ class PagedSlotPool:
             was_done = self.done
             self.done = np.array(done_dev)
             toks = np.asarray(toks)
+        _mem.tag("kv_pages", (self.kv.cache, self.out))
         self.pos = pos0 + self.seg
         events = []
         for slot, req in enumerate(self.occupants):

@@ -49,7 +49,9 @@ from tpuflow.core.config import TrainConfig
 from tpuflow.core.dist import is_primary
 from tpuflow.data.tokens import TokenDataset
 from tpuflow.models.transformer import TransformerLM, next_token_loss
+from tpuflow.obs import memory as _mem
 from tpuflow.obs import trace
+from tpuflow.obs.executables import registered_jit as _registered_jit
 from tpuflow.parallel.mesh import DATA_AXIS, MODEL_AXIS, build_nd_mesh
 from tpuflow.train.lr import LRController
 from tpuflow.train.optimizers import get_optimizer, set_learning_rate
@@ -203,7 +205,30 @@ class LMTrainer:
         from tpuflow.parallel.mesh import replicate_tree
 
         self.state = replicate_tree(state, self.mesh)
+        self._tag_state()
         return self.state
+
+    @staticmethod
+    def _aot_cost(rjit, compiled) -> dict:
+        """Cost analysis of an executable ``rjit.aot_compile`` just
+        built: reuse the dict the ARMED registry captured during
+        registration; analyze directly only when the registry is
+        disarmed (so XLA's analysis never runs twice, and a failing
+        backend bumps compile.cost_analysis_errors_total once)."""
+        from tpuflow.obs.executables import site_cost
+        from tpuflow.obs.mfu import cost_analysis_of
+
+        return site_cost(rjit.key) or cost_analysis_of(compiled)
+
+    def _tag_state(self) -> None:
+        """Device-buffer ledger tags (ISSUE 7): params/opt_state by
+        component. Donation replaces the state's arrays every step, so
+        the fit loop re-tags at epoch boundaries."""
+        if self.state is None:
+            return
+        _mem.tag("params", {"params": self.state.params,
+                            "batch_stats": self.state.batch_stats})
+        _mem.tag("opt_state", self.state.opt_state)
 
     def _init_state_gspmd(self, seed: int) -> TrainState:
         """Sharded-state init: param specs from the LM's
@@ -236,9 +261,11 @@ class LMTrainer:
         self._state_shardings = derive_state_shardings(
             self.mesh, boxed, abstract, self.world, self.zero
         )
-        self.state = jax.jit(
-            make_state, out_shardings=self._state_shardings
+        self.state = _registered_jit(
+            make_state, key="lm.init_state",
+            out_shardings=self._state_shardings,
         )(jax.random.key(seed))
+        self._tag_state()
         return self.state
 
     # ---- steps -----------------------------------------------------------
@@ -538,12 +565,15 @@ class LMTrainer:
             return {"loss": loss_of(state.params, tokens, False)}
 
         if out_shardings is not None:
-            self._train_step = jax.jit(
-                train_step, donate_argnums=0, out_shardings=out_shardings
+            self._train_step = _registered_jit(
+                train_step, key="lm.train_step", donate_argnums=0,
+                out_shardings=out_shardings,
             )
         else:
-            self._train_step = jax.jit(train_step, donate_argnums=0)
-        self._eval_step = jax.jit(eval_step)
+            self._train_step = _registered_jit(
+                train_step, key="lm.train_step", donate_argnums=0
+            )
+        self._eval_step = _registered_jit(eval_step, key="lm.eval_step")
         self._build_superstep(train_step, out_shardings)
 
     def _build_superstep(self, train_step, out_shardings=None) -> None:
@@ -566,11 +596,14 @@ class LMTrainer:
             return jax.lax.scan(body, state, (tokens, lrs))
 
         if out_shardings is not None:
-            self._superstep = jax.jit(
-                superstep, donate_argnums=0, out_shardings=out_shardings
+            self._superstep = _registered_jit(
+                superstep, key="lm.superstep", donate_argnums=0,
+                out_shardings=out_shardings,
             )
         else:
-            self._superstep = jax.jit(superstep, donate_argnums=0)
+            self._superstep = _registered_jit(
+                superstep, key="lm.superstep", donate_argnums=0
+            )
 
     # ---- checkpoint / resume --------------------------------------------
 
@@ -690,10 +723,13 @@ class LMTrainer:
                     f"match the expected ({want_cur}/{want_count})"
                 )
             with trace.span("train.eval", phase="eval"):
-                losses = [
-                    self._eval_step(self.state, self._put(b))["loss"]
-                    for b in tokens.iter_epoch(0)
-                ]
+                losses = []
+                for b in tokens.iter_epoch(0):
+                    t = self._put(b)
+                    _mem.tag("eval", t)
+                    losses.append(
+                        self._eval_step(self.state, t)["loss"]
+                    )
                 return (
                     float(jnp.mean(jnp.stack(losses))) if losses else None
                 )
@@ -705,6 +741,7 @@ class LMTrainer:
                 if rows.shape[0] < batch_size:
                     break
                 t = self._put(rows[proc * b_local : (proc + 1) * b_local])
+                _mem.tag("eval", t)
                 losses.append(self._eval_step(self.state, t)["loss"])
             if not losses:
                 return None
@@ -940,6 +977,7 @@ class LMTrainer:
                         with trace.span("train.device_put",
                                         phase="data_wait"):
                             toks = self._put(local_rows)
+                            _mem.tag("data_staging", toks)
                         lr = self.lr_controller.lr_for_step(global_step)
                         lr_arr = jnp.asarray(lr, jnp.float32)
                         if self._step_exec is None:
@@ -947,19 +985,22 @@ class LMTrainer:
                             # runs every step (jax's AOT path does not share
                             # the jit dispatch cache — compiling separately
                             # for cost analysis would double the compile)
-                            # and yields the FLOPs for the throughput/MFU
-                            # metrics (N11). NOTE cost analysis reports
-                            # PER-DEVICE flops when the program is sharded.
-                            from tpuflow.obs.mfu import flops_of_compiled
-
+                            # and feeds the executable registry + the FLOPs
+                            # for the throughput/MFU metrics (N11). MFU
+                            # keeps the PER-DEVICE share (mean across the
+                            # cost-analysis device shares).
                             with trace.span("train.compile",
                                             phase="compile"):
-                                self._step_exec = self._train_step.lower(
-                                    self.state, toks, lr_arr
-                                ).compile()
-                            self._flops_per_step = flops_of_compiled(
-                                self._step_exec
-                            )
+                                self._step_exec = (
+                                    self._train_step.aot_compile(
+                                        self.state, toks, lr_arr
+                                    )
+                                )
+                            ca = self._aot_cost(self._train_step,
+                                                self._step_exec)
+                            self._flops_per_step = ca.get(
+                                "flops", 0.0
+                            ) / max(1, ca.get("per_device", 1))
                         with trace.span("train.dispatch",
                                         phase="dispatch"):
                             self.state, m = self._step_exec(
@@ -1023,6 +1064,9 @@ class LMTrainer:
                 # the scalar fetch above syncs, so the wall time is real
                 epoch_s = time.time() - t_epoch if t_epoch is not None else 0.0
                 metrics = {"loss": epoch_loss, "lr": float(lr)}
+                # re-tag the (donation-replaced) state at the epoch
+                # boundary so the ledger's params/opt_state stay honest
+                self._tag_state()
                 if timed_steps > 0 and epoch_s > 0:
                     step_s = epoch_s / timed_steps
                     metrics["tokens_per_sec"] = batch_size * seq_len / step_s
@@ -1132,7 +1176,9 @@ class LMTrainer:
                 i += want
                 with trace.span("train.device_put", phase="data_wait",
                                 k=want):
-                    buf.append((want, self._put_block(rows)))
+                    blk = self._put_block(rows)
+                    _mem.tag("data_staging", blk)
+                    buf.append((want, blk))
                 if len(buf) >= depth:
                     yield buf.popleft()
             while buf:
@@ -1159,17 +1205,15 @@ class LMTrainer:
             lrs_arr = jnp.asarray(lr_list, jnp.float32)
             ex = self._sstep_execs.get(k)
             if ex is None:
-                from tpuflow.obs.mfu import flops_of_compiled
-
                 if self.health is not None:
                     # a mid-epoch compile (the remainder-tail block
                     # size) may legitimately exceed stall_timeout_s;
                     # it is not step silence
                     self.health.pause()
                 with trace.span("train.compile", phase="compile", k=k):
-                    ex = self._superstep.lower(
+                    ex = self._superstep.aot_compile(
                         self.state, toks, lrs_arr
-                    ).compile()
+                    )
                 if self.health is not None:
                     self.health.resume()
                 self._sstep_execs[k] = ex
@@ -1178,7 +1222,10 @@ class LMTrainer:
                     # the K-step program reports ~one step's FLOPs —
                     # exactly the per-step number the MFU metrics want
                     # (same convention as the grad-accum scan, bench.py)
-                    self._flops_per_step = flops_of_compiled(ex)
+                    ca = self._aot_cost(self._superstep, ex)
+                    self._flops_per_step = ca.get(
+                        "flops", 0.0
+                    ) / max(1, ca.get("per_device", 1))
             with trace.span("train.superstep", phase="dispatch", k=k):
                 self.state, m = ex(self.state, toks, lrs_arr)
             losses.append(m["loss"])

@@ -48,6 +48,7 @@ from tpuflow.core.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpuflow.core.config import TrainConfig
+from tpuflow.obs.executables import registered_jit as _registered_jit
 from tpuflow.models.transformer import (
     DecoderBlock,
     RMSNorm,
@@ -445,8 +446,11 @@ class PipelineTrainer(LMTrainer):
                 run_1f1b, micro_spec, has_data
             )
 
-        self._train_step = jax.jit(train_step, donate_argnums=0)
-        self._eval_step = jax.jit(eval_step)
+        self._train_step = _registered_jit(
+            train_step, key="pipeline.train_step", donate_argnums=0
+        )
+        self._eval_step = _registered_jit(eval_step,
+                                          key="pipeline.eval_step")
         # every schedule exposes the same pure (state, tokens, lr) ->
         # (state, metrics) step, so superstep fusion (cfg.superstep > 1:
         # K steps in one scanned dispatch) composes with the pipeline
@@ -585,8 +589,11 @@ class PipelineTrainer(LMTrainer):
             )
             return {"loss": next_token_loss(logits, tokens)}
 
-        self._train_step = jax.jit(train_step, donate_argnums=0)
-        self._eval_step = jax.jit(eval_step)
+        self._train_step = _registered_jit(
+            train_step, key="pipeline.train_step", donate_argnums=0
+        )
+        self._eval_step = _registered_jit(eval_step,
+                                          key="pipeline.eval_step")
         self._build_superstep(train_step)
 
     def _apply_grads(self, state: TrainState, grads, lr, loss):

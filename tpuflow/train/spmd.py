@@ -31,6 +31,8 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpuflow.core.config import TrainConfig
+from tpuflow.obs import memory as _mem
+from tpuflow.obs.executables import registered_jit as _registered_jit
 from tpuflow.models.classifier import backbone_param_mask, stop_gradient_frozen
 from tpuflow.models.preprocess import preprocess_input, random_flip
 from tpuflow.parallel.mesh import DATA_AXIS
@@ -205,9 +207,13 @@ class SpmdTrainer(Trainer):
             self.mesh, boxed, abstract, self.mesh.shape[DATA_AXIS],
             self.zero,
         )
-        self.state = jax.jit(
-            make_state, out_shardings=self._state_shardings
+        self.state = _registered_jit(
+            make_state, key="spmd.init_state",
+            out_shardings=self._state_shardings,
         )(jax.random.key(cfg.seed))
+        _mem.tag("params", {"params": self.state.params,
+                            "batch_stats": self.state.batch_stats})
+        _mem.tag("opt_state", self.state.opt_state)
         return self.state
 
     def _make_steps(self):
@@ -273,8 +279,8 @@ class SpmdTrainer(Trainer):
         # sharding (observed under ZeRO), breaking the next call's
         # in_shardings contract.
         replicated = NamedSharding(self.mesh, P())
-        self._train_step = jax.jit(
-            train_step,
+        self._train_step = _registered_jit(
+            train_step, key="spmd.train_step",
             in_shardings=(self._state_shardings, data_sh, data_sh, None),
             out_shardings=(
                 self._state_shardings,
@@ -282,6 +288,7 @@ class SpmdTrainer(Trainer):
             ),
             donate_argnums=0,
         )
-        self._eval_step = jax.jit(
-            eval_step, in_shardings=(self._state_shardings, data_sh, data_sh)
+        self._eval_step = _registered_jit(
+            eval_step, key="spmd.eval_step",
+            in_shardings=(self._state_shardings, data_sh, data_sh),
         )

@@ -36,7 +36,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tpuflow.core.compat import shard_map
 
 from tpuflow.core.config import TrainConfig
+from tpuflow.obs import memory as _mem
 from tpuflow.obs import trace
+from tpuflow.obs.executables import registered_jit as _registered_jit
 from tpuflow.models.classifier import backbone_param_mask, stop_gradient_frozen
 from tpuflow.models.preprocess import preprocess_input, random_flip
 from tpuflow.parallel.mesh import DATA_AXIS, build_mesh, world_size
@@ -150,7 +152,21 @@ class Trainer:
         # non-addressable meshes); host state is identical on every
         # process by seeded construction
         self.state = replicate_tree(state, self.mesh)
+        self._tag_state()
         return self.state
+
+    def _tag_state(self) -> None:
+        """Device-buffer ledger tags (ISSUE 7): params/opt_state by
+        component. Donation replaces the state's arrays every step, so
+        fit re-tags at epoch boundaries — mid-epoch the current state
+        shows up as ``untagged`` residual, which is accurate enough
+        for the per-epoch accounting the ledger serves."""
+        if self.state is None:
+            return
+        _mem.tag("params", {"params": self.state.params,
+                            "batch_stats": getattr(self.state,
+                                                   "batch_stats", {})})
+        _mem.tag("opt_state", self.state.opt_state)
 
     # ---- jitted steps ----------------------------------------------------
 
@@ -258,8 +274,10 @@ class Trainer:
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=P(),
         )
-        self._train_step = jax.jit(train_sm, donate_argnums=0)
-        self._eval_step = jax.jit(eval_sm)
+        self._train_step = _registered_jit(train_sm,
+                                           key="trainer.train_step",
+                                           donate_argnums=0)
+        self._eval_step = _registered_jit(eval_sm, key="trainer.eval_step")
 
         # superstep program (cfg.superstep > 1): K chained train steps
         # inside ONE jitted lax.scan over a stacked (K, batch, ...)
@@ -278,7 +296,9 @@ class Trainer:
 
             return jax.lax.scan(body, state, (images, labels, lrs))
 
-        self._superstep = jax.jit(superstep, donate_argnums=0)
+        self._superstep = _registered_jit(superstep,
+                                          key="trainer.superstep",
+                                          donate_argnums=0)
 
     # ---- data movement ---------------------------------------------------
 
@@ -334,13 +354,16 @@ class Trainer:
         double-buffering-with-headroom helps the device anyway)."""
         return min(4, max(1, int(getattr(ds, "prefetch", 2) or 2)))
 
-    def _prefetch(self, it: Iterable, depth: int = 2):
+    def _prefetch(self, it: Iterable, depth: int = 2,
+                  component: str = "data_staging"):
         """Device-put ahead of compute: double-buffered H2D (N5).
 
         Span accounting: the host batch pull and the H2D put are the
         two data_wait leaves; the consumer's ``next()`` on this
         generator executes them, so the fit loop does not re-wrap it
-        (that would double-count the phase)."""
+        (that would double-count the phase). Staged buffers are tagged
+        ``component`` in the device-buffer ledger (eval feeds pass
+        ``"eval"``)."""
         it = iter(it)
         buf: collections.deque = collections.deque()
         while True:
@@ -349,7 +372,9 @@ class Trainer:
             if batch is None:
                 break
             with trace.span("train.device_put", phase="data_wait"):
-                buf.append(self._put(batch))
+                put = self._put(batch)
+                _mem.tag(component, put)
+                buf.append(put)
             if len(buf) >= depth:
                 yield buf.popleft()
         while buf:
@@ -392,9 +417,10 @@ class Trainer:
             if got:
                 with trace.span("train.device_put", phase="data_wait",
                                 k=got):
-                    buf.append((got, *self._put_block_stacked(
-                        images[:got], labels[:got]
-                    )))
+                    blk = self._put_block_stacked(images[:got],
+                                                  labels[:got])
+                    _mem.tag("data_staging", blk)
+                    buf.append((got, *blk))
             if got < want:
                 break
             if len(buf) >= depth:
@@ -729,6 +755,9 @@ class Trainer:
                 with trace.span("train.metrics_fetch", phase="device"):
                     logs = _mean_metrics(step_metrics)
                 logs["lr"] = lr
+                # re-tag the (donation-replaced) state at the epoch
+                # boundary so the ledger's params/opt_state stay honest
+                self._tag_state()
                 if val_ds is not None:
                     val_logs = self.evaluate(val_ds, steps=validation_steps)
                     logs.update({f"val_{k}": v for k, v in val_logs.items()})
@@ -787,7 +816,8 @@ class Trainer:
         if self._eval_step is None:
             self._make_steps()
         steps = steps or ds.steps_per_epoch()
-        it = self._prefetch(iter(ds), self._staging_depth(ds))
+        it = self._prefetch(iter(ds), self._staging_depth(ds),
+                            component="eval")
         ms = []
         with trace.span("train.eval", steps=steps):
             for _ in range(steps):
