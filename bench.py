@@ -1216,6 +1216,15 @@ def main() -> int:
                         "prompt trace variant (prefix-cache hit rate, "
                         "prefill tokens saved, TTFT deltas, KV-memory "
                         "headroom); writes BENCH_*_serve_paged.json")
+    p.add_argument("--serve-router", action="store_true",
+                   help="multi-replica router A/B (ISSUE 8): 1 vs 2 "
+                        "paged replicas behind the load-aware router "
+                        "on the saturating mixed trace (throughput "
+                        "scaling, per-replica virtual clocks) and the "
+                        "shared-system-prompt trace (prefix-affinity "
+                        "aggregate hit rate vs a hash-spray control); "
+                        "placement/affinity counters ride the "
+                        "diagnostics; writes BENCH_*_serve_router.json")
     p.add_argument("--superstep", type=int, default=0, metavar="K",
                    help="A/B the superstep trainers (ISSUE 2): drive "
                         "the SAME compiled flagship train step as (a) a "
@@ -1277,6 +1286,7 @@ def main() -> int:
     global _MODE, _PROGRESS_PATH
     _MODE = ("e2e" if args.end2end
              else "decode" if args.decode
+             else "serve_router" if args.serve_router
              else "serve_paged" if args.serve_paged
              else "serve" if args.serve
              else "superstep" if args.superstep else args.model)
@@ -1380,6 +1390,8 @@ def _bench(args) -> int:
     n_chips = len(devices)
     if args.superstep:
         return _bench_superstep(args, devices)
+    if args.serve_router:
+        return _bench_serve_router(args, devices)
     if args.serve_paged:
         return _bench_serve_paged(args, devices)
     if args.serve:
@@ -3077,6 +3089,367 @@ def _bench_serve_paged(args, devices) -> int:
     )
     emit(headroom, headroom, diagnostics=diag,
          metric="serve_paged_kv_headroom", unit="x")
+    return 0
+
+
+def _bench_serve_router(args, devices) -> int:
+    """--serve-router: the ISSUE 8 A/B — 1 vs 2 paged ServeScheduler
+    replicas behind the load-aware router, on the SAME seeded
+    virtual-clock traces as ``--serve-paged``:
+
+    - the saturating MIXED trace measures horizontal throughput
+      scaling: each replica runs on its OWN virtual clock (device ops
+      billed from one shared pre-measured min-of-k cost table), so two
+      replicas genuinely overlap — acceptance wants 2 replicas ≥1.6×
+      tok/s with p95 TTFT no worse;
+    - the SHARED-SYSTEM-PROMPT trace measures prefix-affinity routing:
+      the router hashes prompt chunks the way the replicas' prefix
+      trees do, so shared-prefix traffic sticks where its pages live —
+      the aggregate hit rate must stay within 10 points of the
+      single-replica rate, with a hash-spray placement control
+      (locality-blind) in the same record.
+
+    The drive loop steps the most-behind busy replica and injects
+    arrivals at the simulation frontier (idle replicas' clocks advance
+    to the arrival — they were waiting); placement/affinity/per-replica
+    counters ride the diagnostics. ``value`` = 2-vs-1 mixed tok/s
+    ratio."""
+    import numpy as np
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.serve.metrics import ServeMetrics, percentiles
+    from tpuflow.serve.replica import InProcessReplica
+    from tpuflow.serve.router import Router
+    from tpuflow.serve.scheduler import ServeScheduler
+
+    if args.smoke:
+        dim, depth, heads, vocab = 256, 4, 4, 1024
+        # the MIXED trace (--serve's 32-request smoke count) must
+        # genuinely SATURATE one replica — its arrival window far
+        # shorter than one replica's total service — or the 2-replica
+        # makespan is arrival-bound and the scaling headroom vanishes;
+        # the SHARED trace keeps the --serve-paged shape (24 requests
+        # at 0.03) the single-replica 95.8% hit-rate figure comes from
+        n_mixed, n_shared, cap = args.serve_requests or 32, 24, 32
+        arr_mixed, arr_shared = 0.005, 0.03
+    else:
+        dim, depth, heads, vocab = 512, 6, 8, 32000
+        n_mixed, n_shared, cap = args.serve_requests or 96, 96, 32
+        arr_mixed, arr_shared = 0.002, 0.01
+    slots, seg, ps = args.batch or 4, 4, 8
+    kv_pages = 1 + 96  # per replica (PR 6 sizing note applies)
+    sampling = dict(temperature=0.8, top_k=40, seed=0)
+    model = build_transformer_lm(
+        vocab_size=vocab, dim=dim, depth=depth, heads=heads,
+        attn_impl="einsum", kv_heads=args.kv_heads,
+    )
+    params = nn.unbox(
+        model.init({"params": jax.random.key(0)},
+                   jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    work_mixed = _serve_workload(seed=0, n=n_mixed, max_new_cap=cap,
+                                 arrival_scale_s=arr_mixed)
+    work_shared = _serve_workload(seed=0, n=n_shared, max_new_cap=cap,
+                                  arrival_scale_s=arr_shared)
+    prng = np.random.default_rng(1)
+    mixed_prompts = [prng.integers(1, vocab, (p,)).astype(np.int32)
+                     for _, p, _ in work_mixed]
+    sys_prefix = prng.integers(1, vocab, (24,)).astype(np.int32)
+    shared_prompts = [
+        np.concatenate([sys_prefix, prng.integers(
+            1, vocab, (int(prng.integers(3, 8)),)).astype(np.int32)])
+        for _ in work_shared
+    ]
+
+    def bucket_of(plen: int) -> int:
+        from tpuflow.packaging.lm import _bucket_len
+
+        return _bucket_len(plen)
+
+    all_buckets = sorted({bucket_of(len(p))
+                          for p in mixed_prompts + shared_prompts})
+
+    # ---- shared cost table (one warmed pool set, min-of-k) ----------
+    paged_cost = {"seg": {}, "join": {}, "copy": 0.0}
+
+    def _measure() -> None:
+        from tpuflow.infer.generate import paged_copy
+        from tpuflow.serve.pages import PagedKV, PagedKVSpec
+        from tpuflow.serve.request import Request
+        from tpuflow.serve.slots import PagedSlotPool
+
+        s = sampling
+        ops: dict = {}
+        kv = PagedKV(model, PagedKVSpec(pages=kv_pages, page_size=ps),
+                     prefix_cache=False)
+        for b in all_buckets:
+            ppool = PagedSlotPool(
+                model, params, kv, b, slots, cap, seg=seg,
+                temperature=s["temperature"], top_k=s["top_k"],
+                seed=s["seed"])
+            ppool.warm()
+
+            def _pseg(pool=ppool):
+                pool.run_segment()
+
+            ops[("pseg", b)] = _pseg
+            for w in ppool._widths:
+                def _pjoin(pool=ppool, w=w):
+                    plan = kv.plan(np.ones(w, np.int32), 1)
+                    pool.join([(0, Request(
+                        prompt_ids=np.ones(w, np.int32),
+                        max_new_tokens=1), plan)])
+                    pool.evict(0)
+                    jax.block_until_ready((kv.cache, pool.out))
+
+                ops[("pjoin", b, w)] = _pjoin
+
+        def _copy():
+            kv.cache = paged_copy(kv.cache, [0], [0])
+            jax.block_until_ready(jax.tree.leaves(kv.cache)[0])
+
+        ops[("copy",)] = _copy
+        best = {name: float("inf") for name in ops}
+        for _ in range(6):  # interleaved min-of-k (see --serve notes)
+            for name, fn in ops.items():
+                t0 = time.perf_counter()
+                fn()
+                best[name] = min(best[name], time.perf_counter() - t0)
+        for key, v in best.items():
+            if key[0] == "pseg":
+                paged_cost["seg"][key[1]] = v
+            elif key[0] == "pjoin":
+                paged_cost["join"][(key[1], key[2])] = v
+            else:
+                paged_cost["copy"] = v
+        # width-monotone cleanup (the PR 6 lesson: one background-load
+        # burst must not bill narrow prefix-hit joins above full
+        # prefills and invert the A/B)
+        for b in all_buckets:
+            ws = sorted(w for (bb, w) in paged_cost["join"] if bb == b)
+            floor = float("inf")
+            for w in reversed(ws):
+                floor = min(floor, paged_cost["join"][(b, w)])
+                paged_cost["join"][(b, w)] = floor
+
+    class _VClock:
+        def __init__(self):
+            self.now = 0.0
+
+        def __call__(self):
+            return self.now
+
+    def run(n_replicas: int, work: list, prompts: list,
+            placement: str) -> dict:
+        clocks = [_VClock() for _ in range(n_replicas)]
+        reps = []
+        for r in range(n_replicas):
+            sched = ServeScheduler(
+                model, params, slots=slots, seg=seg, max_new_cap=cap,
+                max_queue=len(work), clock=clocks[r], kv="paged",
+                kv_page_size=ps, kv_pages=kv_pages,
+                metrics=ServeMetrics(gauge_prefix=f"serve.replica{r}"),
+                **sampling,
+            )
+            sched.prepare(*sorted({bucket_of(len(p)) for p in prompts}))
+            for b, pool in sched.pools.items():
+                def _wrap(pool=pool, b=b, vc=clocks[r]):
+                    oseg, ojoin = pool.run_segment, pool.join
+
+                    def rs():
+                        vc.now += paged_cost["seg"][b]
+                        return oseg()
+
+                    def jn(admits):
+                        need = max([pl.width
+                                    for _s, _r, pl in admits] + [1])
+                        w = next(wd for wd in pool._widths
+                                 if wd >= need)
+                        vc.now += paged_cost["join"][(b, w)]
+                        vc.now += paged_cost["copy"] * sum(
+                            len(pl.forks) for _s, _r, pl in admits)
+                        return ojoin(admits)
+
+                    pool.run_segment, pool.join = rs, jn
+                _wrap()
+            reps.append(InProcessReplica(sched, name=f"replica{r}"))
+        router = Router(reps, placement=placement,
+                        clock=lambda: min(c.now for c in clocks))
+        rrs, i = [], 0
+        peak_pages = [0] * n_replicas
+        n_work = len(work)
+        while i < n_work or not router.idle():
+            busy = [r for r in range(n_replicas)
+                    if not reps[r].idle()]
+            if busy:
+                t = min(clocks[r].now for r in busy)
+            else:
+                t = work[i][0]
+                for c in clocks:
+                    c.now = max(c.now, t)
+            while i < n_work and work[i][0] <= t:
+                # an idle replica was WAITING: its clock advances to
+                # the arrival instant, so admission/TTFT stamps start
+                # at the arrival, not at its last activity
+                for q in range(n_replicas):
+                    if reps[q].idle():
+                        clocks[q].now = max(clocks[q].now, work[i][0])
+                from tpuflow.serve.request import QueueFull
+
+                try:
+                    rr = router.submit(prompts[i],
+                                       max_new_tokens=work[i][2])
+                except QueueFull:
+                    break  # tier saturated: retry after some service
+                rr.ts_arrival = work[i][0]
+                rr.inner.ts_arrival = work[i][0]
+                rrs.append(rr)
+                i += 1
+            busy = [r for r in range(n_replicas)
+                    if not reps[r].idle()]
+            if not busy:
+                continue
+            r = min(busy, key=lambda q: clocks[q].now)
+            t_pre = clocks[r].now
+            moved = reps[r].step()
+            kvs = reps[r].sched.kv_state
+            if kvs is not None:
+                peak_pages[r] = max(peak_pages[r],
+                                    kvs.allocator.in_use())
+            if not moved:
+                # starved boundary (pages): jump to the next event so
+                # arrival injection cannot livelock
+                nxt = [clocks[q].now for q in busy if q != r]
+                if i < n_work:
+                    nxt.append(work[i][0])
+                clocks[r].now = max(
+                    clocks[r].now + 1e-6,
+                    min(nxt) if nxt else clocks[r].now + 1e-3)
+            elif clocks[r].now == t_pre:
+                clocks[r].now += 1e-6
+        assert all(rr.state.value == "done" for rr in rrs)
+        makespan = max(rr.inner.ts_done for rr in rrs)
+        ttft = [rr.timing()["ttft_ms"] for rr in rrs]
+        toks = sum(len(rr.tokens) for rr in rrs)
+        hits = sum(rep.sched.metrics.prefix_hits for rep in reps)
+        misses = sum(rep.sched.metrics.prefix_misses for rep in reps)
+        saved = sum(rep.sched.metrics.prefill_tokens_saved
+                    for rep in reps)
+
+        def _pctl(vals) -> dict:
+            return {k: round(v, 2) for k, v in percentiles(vals).items()}
+
+        return {
+            "replicas": n_replicas,
+            "placement": placement,
+            "makespan_s": round(makespan, 3),
+            "useful_tok_s": round(toks / makespan, 1),
+            "tokens": toks,
+            "ttft_ms": _pctl(ttft),
+            "e2e_ms": _pctl([rr.timing()["e2e_ms"] for rr in rrs]),
+            "prefix_hits": hits,
+            "prefix_misses": misses,
+            "prefix_hit_rate": round(hits / max(1, hits + misses), 4),
+            "prefill_tokens_saved": saved,
+            "kv_pages_peak": peak_pages,
+            "router": {k: v for k, v in router.snapshot().items()},
+        }
+
+    _progress({"phase": "serve_router_warmup"})
+    _measure()
+    _progress({"phase": "serve_router_costs", "costs_ms": {
+        "paged_seg": {b: round(v * 1e3, 2)
+                      for b, v in paged_cost["seg"].items()},
+        "paged_join": {f"{b}w{w}": round(v * 1e3, 2)
+                       for (b, w), v in paged_cost["join"].items()},
+    }})
+
+    results = {}
+    for key, n_rep, work, prompts, placement in (
+            ("mixed_1", 1, work_mixed, mixed_prompts, "load"),
+            ("mixed_2", 2, work_mixed, mixed_prompts, "load"),
+            ("shared_1", 1, work_shared, shared_prompts, "load"),
+            ("shared_2_affinity", 2, work_shared, shared_prompts,
+             "load"),
+            ("shared_2_spray", 2, work_shared, shared_prompts,
+             "spray")):
+        results[key] = run(n_rep, work, prompts, placement)
+        _progress({"phase": f"serve_router_{key}",
+                   "record": results[key]})
+
+    def _ratio(a, b):
+        return round(a / max(b, 1e-9), 3)
+
+    m1, m2 = results["mixed_1"], results["mixed_2"]
+    s1 = results["shared_1"]
+    s2a, s2s = results["shared_2_affinity"], results["shared_2_spray"]
+    scaling = _ratio(m2["useful_tok_s"], m1["useful_tok_s"])
+    diag = {
+        "device_kind": devices[0].device_kind,
+        "model": f"lm-d{dim}x{depth}h{heads}",
+        "workload": {"n_requests_mixed": n_mixed,
+                     "n_requests_shared": n_shared, "max_new_cap": cap,
+                     "arrival_scale_s_mixed": arr_mixed,
+                     "arrival_scale_s_shared": arr_shared, "seed": 0,
+                     "shared_prefix_tokens": int(sys_prefix.size)},
+        "slots": slots, "seg": seg, "page_size": ps,
+        "kv_pages_per_replica": kv_pages,
+        "cost_table_ms": {
+            "paged_seg": {str(b): round(v * 1e3, 2)
+                          for b, v in paged_cost["seg"].items()},
+            "paged_join": {f"{b}w{w}": round(v * 1e3, 2)
+                           for (b, w), v in paged_cost["join"].items()},
+            "paged_copy": round(paged_cost["copy"] * 1e3, 2),
+        },
+        "mixed": {
+            "replicas_1": m1, "replicas_2": m2,
+            "tok_s_scaling_2v1": scaling,
+            "p95_ttft_ratio_2v1": _ratio(
+                m1["ttft_ms"].get("p95", 0.0),
+                m2["ttft_ms"].get("p95", 1e-9)),
+        },
+        "shared_prefix": {
+            "replicas_1": s1,
+            "replicas_2_affinity": s2a,
+            "replicas_2_spray": s2s,
+            "hit_rate_1": s1["prefix_hit_rate"],
+            "hit_rate_2_affinity": s2a["prefix_hit_rate"],
+            "hit_rate_2_spray": s2s["prefix_hit_rate"],
+            "affinity_hit_rate_delta_vs_1": round(
+                s1["prefix_hit_rate"] - s2a["prefix_hit_rate"], 4),
+        },
+        "span_totals_ms": _span_totals(),
+    }
+    rec = {
+        "metric": "serve_router_tok_s_scaling_2v1",
+        "value": scaling,
+        "unit": "x",
+        "vs_baseline": scaling,
+        "mode": "serve_router",
+        "smoke": bool(args.smoke),
+        "diagnostics": diag,
+    }
+    out_path = args.serve_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_LOCAL_r08_serve_router.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(
+        f"# serve-router mixed tok/s x{scaling:.2f} (2 reps "
+        f"{m2['useful_tok_s']} vs 1 rep {m1['useful_tok_s']}) | "
+        f"p95 ttft 2rep={m2['ttft_ms'].get('p95')}ms vs "
+        f"1rep={m1['ttft_ms'].get('p95')}ms | shared-prefix hit rate "
+        f"1rep={s1['prefix_hit_rate']:.1%} "
+        f"affinity={s2a['prefix_hit_rate']:.1%} "
+        f"spray={s2s['prefix_hit_rate']:.1%} -> {out_path}",
+        file=sys.stderr, flush=True,
+    )
+    emit(scaling, scaling, diagnostics=diag,
+         metric="serve_router_tok_s_scaling_2v1", unit="x")
     return 0
 
 

@@ -1,0 +1,173 @@
+"""Multi-replica serving tier: router, affinity, drain (ISSUE 8).
+
+BEYOND-REFERENCE capability, one layer above example 16's single
+scheduler: the front-tier :class:`~tpuflow.serve.router.Router` owns
+TWO in-process replicas (each a full ServeScheduler with its own slot
+pools and paged KV store, sharing the loaded weights) behind the same
+submit/stream/cancel surface — the layer that opens horizontal scale
+(ROADMAP item 3):
+
+1. a tiny ByteBPE LM is overfit and packaged (as in examples/14/16);
+2. two replicas + the router are built; placement is LEAST-LOADED over
+   each replica's ``load_snapshot()`` sensor (queue depth, running
+   rows, free KV pages, windowed TTFT p95 — a plain dict, no
+   Prometheus parsing);
+3. shared-system-prompt clients: the router hashes the prompt's
+   page-size token chunks exactly as the replicas' prefix trees chunk
+   them, so same-prefix traffic STICKS to the replica already holding
+   those KV pages — the placement/affinity counters and per-replica
+   prefix hit rates show it;
+4. the aggregate observability surface: ``/v1/metrics``-style snapshot
+   with per-replica namespaces (``serve.replica0.*``), router counters
+   (``router.*``), and the Prometheus exposition folding replicas into
+   ``replica="<i>"`` labels;
+5. graceful DRAIN: with requests still queued, ``router.drain()``
+   rejects new submits (503 over HTTP / SchedulerClosed in-process)
+   while every already-admitted request finishes — zero truncated
+   streams — and the flight recorder's manifest notes record the
+   drain.
+
+Run on CPU:
+
+  JAX_PLATFORMS=cpu python examples/17_router_serving.py
+
+Long-running tier form (same runtime; SIGTERM drains gracefully):
+
+  python -m tpuflow.serve --model /path/to/packaged_lm --replicas 2 \
+      --kv paged --port 8000
+  curl -s -X POST localhost:8000/v1/admin/drain
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import flax.linen as nn
+
+    from tpuflow.data.text import ByteBPE
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.models.transformer import next_token_loss
+    from tpuflow.packaging.lm import save_packaged_lm
+    from tpuflow.serve import (
+        InProcessReplica,
+        Router,
+        SchedulerClosed,
+        ServeScheduler,
+    )
+    from tpuflow.serve.metrics import ServeMetrics
+
+    # 1) tiny LM, overfit so continuations echo the corpus
+    corpus = "the cat sat on the mat. the dog sat on the log. " * 40
+    bpe = ByteBPE.train(corpus, vocab_size=300)
+    cfg = dict(vocab_size=bpe.vocab_size, dim=64, depth=2, heads=4,
+               mlp_ratio=2, dtype=jnp.float32)
+    lm = build_transformer_lm(**cfg)
+    toks = jnp.asarray(np.asarray(bpe.encode(corpus)[:256], np.int32)[None])
+    params = nn.unbox(lm.init({"params": jax.random.key(0)}, toks))["params"]
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        loss, g = jax.value_and_grad(
+            lambda p: next_token_loss(lm.apply({"params": p}, toks), toks)
+        )(params)
+        upd, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, upd), opt, loss
+
+    for _ in range(120):
+        params, opt, loss = step(params, opt)
+    print(f"overfit loss: {float(loss):.3f}")
+    pkg = os.path.join(tempfile.mkdtemp(prefix="tpuflow_router_"), "pkg")
+    save_packaged_lm(pkg, params, cfg, tokenizer=bpe)
+
+    # 2) two replicas behind one router — each with its own paged KV
+    # store and a serve.replica<i> metrics namespace (per-replica
+    # labels in the Prometheus exposition)
+    def make_replica(i):
+        sched = ServeScheduler.from_packaged(
+            pkg, slots=2, seg=4, max_new_cap=16, max_queue=16,
+            kv="paged", kv_page_size=4, kv_pages=65,
+            metrics=ServeMetrics(gauge_prefix=f"serve.replica{i}"),
+        )
+        return InProcessReplica(sched, name=f"replica{i}")
+
+    replicas = [make_replica(0), make_replica(1)]
+    router = Router(replicas)
+    print("replica load sensors:",
+          {r.name: r.load_snapshot() for r in replicas})
+
+    # 3) shared-system-prompt clients through the ONE router surface.
+    # The router pins each request's sampling stream from a tier-global
+    # counter, so outputs are token-identical to a single scheduler
+    # serving the same submissions (pinned in tests/test_serve_router).
+    system = "the dog sat on the log. "
+    users = ["the cat", "the dog", "the mat", "the log",
+             "the cat sat", "the dog sat"]
+    rrs = [router.submit(system + u, 8) for u in users]
+    router.run_until_idle()
+    for u, rr in zip(users, rrs):
+        res = rr.result(timeout=5.0)
+        assert res["state"] == "done" and res["n_tokens"] == 8
+        print(f"  {replicas[rr.replica].name}  {u!r:>14} -> "
+              f"{bpe.decode(np.concatenate([rr.prompt_ids, np.asarray(rr.tokens, np.int32)])).decode('utf-8', 'replace')!r}")
+    snap = router.metrics_snapshot()
+    print("router placement:",
+          {k: snap[k] for k in sorted(snap) if k.startswith(
+              ("router.placed", "router.affinity",
+               "router.placements"))})
+    hits = sum(snap.get(f"serve.replica{i}.prefix_hits", 0.0)
+               for i in range(2))
+    misses = sum(snap.get(f"serve.replica{i}.prefix_misses", 0.0)
+                 for i in range(2))
+    print(f"aggregate prefix hit rate: {hits:.0f}/{hits + misses:.0f}"
+          f" = {hits / max(1.0, hits + misses):.0%}")
+    assert snap["router.placed"] == len(users)
+
+    # 4) Prometheus: replicas fold into ONE family with labels
+    from tpuflow.obs.prom import render
+
+    labelled = [ln for ln in render("serve.replica").splitlines()
+                if ln.startswith("serve_queue_depth")]
+    print("prometheus per-replica samples:", labelled)
+    assert any('replica="0"' in ln for ln in labelled)
+    assert any('replica="1"' in ln for ln in labelled)
+
+    # 5) graceful drain with work still queued: everything admitted
+    # finishes, new submits 503, the flight manifest notes the drain
+    from tpuflow.obs import flight
+
+    draining = [router.submit(system + u, 8)
+                for u in ("the cat", "the mat", "the dog", "the log")]
+    router.drain()
+    try:
+        router.submit("the cat", 4)
+        raise AssertionError("expected SchedulerClosed")
+    except SchedulerClosed:
+        print("drain: new submits rejected (HTTP surface answers 503)")
+    router.run_until_idle()
+    for rr in draining:
+        res = rr.result(timeout=5.0)
+        assert res["state"] == "done" and res["n_tokens"] == 8
+    print(f"drain: all {len(draining)} admitted requests finished "
+          f"(zero truncated streams); drained={router.drained()}")
+    bundle_dir = tempfile.mkdtemp(prefix="tpuflow_flight_")
+    bundle = flight.load(flight.dump(bundle_dir, "example drain"))
+    assert "router.drain" in bundle["manifest"]["notes"]
+    print("flight manifest notes:",
+          sorted(bundle["manifest"]["notes"]))
+    flight.annotate("router.drain", None)
+    router.stop(drain=False, timeout=10.0)
+    print("router serving example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,7 @@ def test_examples_import():
         "13_preempt_resume",
         "15_superstep_training",
         "16_online_serving",
+        "17_router_serving",
     ]:
         assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
 
@@ -197,6 +198,21 @@ def test_online_serving_example():
     assert r.returncode == 0, r.stderr[-2000:]
     assert "queue full -> 429" in r.stdout
     assert "online serving example OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_router_serving_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_EXAMPLES, "17_router_serving.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "drain: new submits rejected" in r.stdout
+    assert "zero truncated streams" in r.stdout
+    assert "router serving example OK" in r.stdout
 
 
 @pytest.mark.slow

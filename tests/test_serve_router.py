@@ -1,0 +1,869 @@
+"""Multi-replica serving tier (ISSUE 8): router placement, prefix
+affinity, shedding, failover, graceful drain.
+
+Tier discipline: the router is PURE HOST POLICY, so nearly everything
+here runs tier-1 against FAKE replicas with injectable clocks — no
+device, no compiles. The few real-scheduler pins (load_snapshot shape,
+drain-through-decode) share ONE tiny model/pool geometry; the
+full-stack parity run (router over 2 real replicas == single
+scheduler, greedy AND sampled, including failover-resubmitted
+requests) and the generated-token prefix-insert hit-rate A/B ride the
+slow tier.
+
+The load-bearing pins:
+
+- placement is least-loaded over ``load_snapshot()``; prefix affinity
+  pulls chunk-chain matches to the replica that owns the pages and
+  YIELDS to load beyond the slack valve;
+- shedding/backpressure: all-replica QueueFull (and the
+  all-allocators-dry case) surface as ONE router QueueFull whose
+  Retry-After is the MIN across replicas;
+- failover: a failed replica's never-admitted requests are resubmitted
+  token-identically (pinned stream ids), the replica-shutdown terminal
+  never leaks to the client, and streaming sees exactly one final
+  event;
+- drain: everything admitted finishes, new submits raise
+  SchedulerClosed (503), the flight manifest notes record the drain;
+- the router/replica modules never touch device arrays (grep guard —
+  the PR 7 jit-site-guard idiom applied to the serving tier boundary).
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from tpuflow.serve.pages import chunk_keys
+from tpuflow.serve.request import (
+    QueueFull,
+    Request,
+    RequestState,
+    SchedulerClosed,
+)
+from tpuflow.serve.router import Router
+
+
+# ---------------------------------------------------------------------
+# fake replica: deterministic host-only backend
+# ---------------------------------------------------------------------
+
+def fake_tokens(prompt_ids: np.ndarray, stream_id: int, n: int):
+    """The fake 'model': tokens are a pure function of (prompt,
+    stream_id) — so two fakes given the same pinned stream id produce
+    IDENTICAL outputs, which is exactly the property failover's
+    token-identity pin needs to be observable without a device."""
+    base = int(np.sum(prompt_ids.astype(np.int64))) * 31 + stream_id * 7
+    return [(base + j) % 997 for j in range(n)]
+
+
+class FakeReplica:
+    """Replica-protocol fake: bounded queue, ``slots`` instant-serve
+    rows per :meth:`step`, a simulated prefix cache (chunk-chain set,
+    the same :func:`chunk_keys` chunking the real tree uses), and
+    hand-settable health/load/KV knobs."""
+
+    def __init__(self, name, *, slots=2, max_queue=8, page_size=4,
+                 kv_free=64, retry=1.0):
+        self.name = name
+        self.slots = slots
+        self.max_new_cap = 16
+        self.page_size = page_size
+        self.max_queue = max_queue
+        self.kv_free = kv_free
+        self.retry = retry
+        self.tokenizer = None
+        self.queue, self.running, self.finished = [], [], []
+        self.closed = False
+        self.is_draining = False
+        self.tripped = False
+        self.submits = []  # (request_id, stream_id) audit log
+        self.cache_chains = set()
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+        class _M:
+            @staticmethod
+            def events(rid):
+                return []
+
+        self.metrics = _M()
+
+    # -- protocol ------------------------------------------------------
+    def bucket_of(self, plen):
+        return max(8, 1 << (max(1, int(plen)) - 1).bit_length())
+
+    def pages_needed(self, plen, max_new):
+        return -(-(plen + max_new - 1) // self.page_size)
+
+    def submit(self, ids, max_new, *, deadline_s=None, stream_cb=None,
+               request_id=None, stream_id=None):
+        if self.closed:
+            raise SchedulerClosed("scheduler is stopped")
+        if len(self.queue) >= self.max_queue:
+            raise QueueFull(len(self.queue), self.retry)
+        req = Request(prompt_ids=np.asarray(ids, np.int32),
+                      max_new_tokens=int(max_new),
+                      id=request_id or "", stream_cb=stream_cb)
+        req.stream_id = int(stream_id or 0) % self.slots
+        self.queue.append(req)
+        self.submits.append((req.id, req.stream_id))
+        return req
+
+    def cancel(self, req):
+        if req in self.queue:
+            self.queue.remove(req)
+            req.finalize(RequestState.CANCELLED, "cancelled")
+            if req.stream_cb:
+                req.stream_cb(req, [], True)
+            return True
+        return False
+
+    def load_snapshot(self):
+        return {"queue_depth": len(self.queue),
+                "running": len(self.running),
+                "closed": self.closed or self.is_draining,
+                "draining": self.is_draining,
+                "max_queue": self.max_queue,
+                "kv_pages_free": self.kv_free,
+                "kv_pages_total": 64}
+
+    def readiness(self):
+        return {"ready": not (self.closed or self.tripped),
+                "closed": self.closed, "draining": self.is_draining}
+
+    def health(self):
+        return {"failed": self.tripped
+                or (self.closed and not self.is_draining),
+                "tripped": self.tripped, "closed": self.closed,
+                "draining": self.is_draining}
+
+    def retry_after_s(self):
+        return self.retry
+
+    def metrics_snapshot(self):
+        return {f"serve.{self.name}.done": float(len(self.finished))}
+
+    def start(self):
+        pass
+
+    def drain(self):
+        self.is_draining = True
+        self.closed = True
+
+    def fail_hard(self):
+        """Replica shutdown: cancel everything queued (what a real
+        ``stop(drain=False)`` does via ``_fail_outstanding``)."""
+        self.closed = True
+        for req in list(self.queue):
+            self.cancel(req)
+
+    def stop(self, drain=True, timeout=0.0):
+        self.closed = True
+
+    hold_running = False  # admit but never finish (dead-replica sims)
+
+    def step(self):
+        progress = False
+        while self.queue and len(self.running) < self.slots:
+            req = self.queue.pop(0)
+            req.state = RequestState.RUNNING
+            req.ts_admitted = 1.0
+            # simulated prefix cache: deepest known chain counts
+            keys = chunk_keys(req.prompt_ids[:req.prompt_ids.size - 1],
+                              self.page_size)
+            if keys and keys[0] in self.cache_chains:
+                self.cache_hits += 1
+            elif keys:
+                self.cache_misses += 1
+            self.cache_chains.update(keys)
+            self.running.append(req)
+            progress = True
+        if self.hold_running:
+            return progress
+        for req in list(self.running):
+            toks = fake_tokens(req.prompt_ids, req.stream_id,
+                               req.max_new_tokens)
+            req.tokens.extend(toks)
+            self.running.remove(req)
+            self.finished.append(req)
+            req.finalize(RequestState.DONE)
+            if req.stream_cb:
+                req.stream_cb(req, toks, True)
+            progress = True
+        return progress
+
+    def idle(self):
+        return not self.queue and not self.running
+
+
+def _ids(*vals):
+    return np.asarray(vals, np.int32)
+
+
+# ---------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------
+
+def test_placement_least_loaded():
+    a, b, c = (FakeReplica(n) for n in ("a", "b", "c"))
+    for rep, depth in ((a, 3), (b, 1), (c, 5)):
+        for k in range(depth):
+            rep.submit(_ids(1, k + 1), 2)
+    router = Router([a, b, c], clock=lambda: 0.0)
+    rr = router.submit(_ids(5, 6, 7), 4)
+    assert router.replicas[rr.replica].name == "b"
+    assert router.counts["placed"] == 1
+    assert router.placements["b"] == 1
+    router.run_until_idle()
+    assert rr.result(1.0)["state"] == "done"
+    # the event log tells the placement story, replica events merged in
+    evs = [e["event"] for e in router.metrics.events(rr.id)]
+    assert "placed" in evs
+
+
+def test_stream_id_pinning_matches_single_scheduler_counter():
+    """The tier's per-bucket stream counter assigns EXACTLY what one
+    scheduler with the same slot count would: submission k in a bucket
+    gets k % slots, independent of which replica serves it — the
+    whole-tier token-identity invariant."""
+    a, b = FakeReplica("a", slots=2), FakeReplica("b", slots=2)
+    router = Router([a, b], clock=lambda: 0.0)
+    rrs = [router.submit(_ids(1, 1, i + 1), 2) for i in range(6)]
+    assert [rr.stream_id for rr in rrs] == [0, 1, 0, 1, 0, 1]
+    # ... and the replicas received those pinned ids verbatim
+    seen = {rid: sid for rep in (a, b) for rid, sid in rep.submits}
+    assert [seen[rr.id] for rr in rrs] == [0, 1, 0, 1, 0, 1]
+    router.run_until_idle()
+    for i, rr in enumerate(rrs):
+        assert rr.tokens == fake_tokens(_ids(1, 1, i + 1),
+                                        i % 2, 2)
+
+
+# ---------------------------------------------------------------------
+# prefix affinity
+# ---------------------------------------------------------------------
+
+def test_affinity_sticks_then_yields_to_load():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    router = Router([a, b], affinity_slack=2, clock=lambda: 0.0)
+    prefix = list(range(1, 9))  # 2 full 4-token chunks
+    first = router.submit(_ids(*prefix, 50), 2)
+    home = first.replica
+    router.run_until_idle()
+    # same-prefix traffic sticks to the replica that owns the pages
+    for k in range(3):
+        rr = router.submit(_ids(*prefix, 60 + k), 2)
+        assert rr.replica == home, "affinity should pull to the home"
+        router.run_until_idle()
+    assert router.counts["affinity_hits"] == 3
+    # overload the home replica beyond the slack: affinity must yield
+    for k in range(4):
+        router.replicas[home].submit(_ids(2, 2, k + 1), 2)
+    rr = router.submit(_ids(*prefix, 99), 2)
+    assert rr.replica != home, "slack valve must spill to least-loaded"
+    assert router.counts["affinity_spills"] == 1
+
+
+def test_affinity_beats_hash_spray_on_shared_prefix_trace():
+    """The bench acceptance's mechanism, pinned deterministically:
+    per-prefix-group traffic concentrated by affinity pays ONE cold
+    miss per group; spray splits every group across replicas and pays
+    one per (group, replica)."""
+    rng = np.random.default_rng(3)
+    groups = [rng.integers(1, 200, (8,)).astype(np.int32)
+              for _ in range(4)]
+    trace = []
+    for k in range(32):
+        g = groups[k % len(groups)]
+        trace.append(np.concatenate(
+            [g, rng.integers(1, 200, (2,)).astype(np.int32)]))
+
+    def run(placement):
+        reps = [FakeReplica(f"{placement}{i}", max_queue=64)
+                for i in range(2)]
+        router = Router(reps, placement=placement, clock=lambda: 0.0)
+        for p in trace:
+            router.submit(p, 2)
+            router.run_until_idle()  # keep load flat: policy, not luck
+        hits = sum(r.cache_hits for r in reps)
+        misses = sum(r.cache_misses for r in reps)
+        return hits / (hits + misses), reps
+
+    aff_rate, _ = run("load")
+    spray_rate, spray_reps = run("spray")
+    # spray must actually have split at least one group for the A/B
+    # to mean anything (deterministic given the seeded trace)
+    assert all(r.cache_misses for r in spray_reps)
+    assert aff_rate > spray_rate
+    assert aff_rate >= (len(trace) - len(groups)) / len(trace)
+
+
+# ---------------------------------------------------------------------
+# shedding / backpressure aggregation
+# ---------------------------------------------------------------------
+
+def test_shed_and_retry_after_aggregation():
+    # (1) every replica QueueFull → ONE router QueueFull, min retry
+    a = FakeReplica("a", max_queue=0, retry=2.5)
+    b = FakeReplica("b", max_queue=0, retry=1.5)
+    router = Router([a, b], clock=lambda: 0.0)
+    with pytest.raises(QueueFull) as ei:
+        router.submit(_ids(1, 2), 2)
+    assert ei.value.retry_after_s == 1.5
+    assert router.counts["rejected"] == 1
+
+    # (2) tier-wide queue bound sheds BEFORE touching any replica
+    c, d = FakeReplica("c", max_queue=64), FakeReplica("d", max_queue=64)
+    router2 = Router([c, d], max_total_queue=4, clock=lambda: 0.0)
+    for k in range(4):
+        router2.submit(_ids(1, k + 1), 2)
+    with pytest.raises(QueueFull):
+        router2.submit(_ids(9, 9), 2)
+    assert router2.counts["shed"] == 1
+    assert not any("rt-5" == rid for rid, _ in c.submits + d.submits)
+
+    # (3) all KV allocators dry (and backed up) → 429 with min retry
+    e = FakeReplica("e", kv_free=0, retry=4.0, max_queue=64)
+    f = FakeReplica("f", kv_free=0, retry=3.0, max_queue=64)
+    router3 = Router([e, f], clock=lambda: 0.0)
+    e.submit(_ids(1, 1), 2)
+    e.submit(_ids(1, 3), 2)
+    f.submit(_ids(1, 2), 2)  # both have a backlog pages can't cover
+    with pytest.raises(QueueFull) as ei:
+        router3.submit(_ids(1, 2, 3), 4)
+    assert ei.value.retry_after_s == 3.0
+    assert router3.counts["shed_kv"] == 1
+    # one replica regaining pages clears the tier-level 429 (and the
+    # fresh pages land on the least-loaded survivor)
+    f.kv_free = 64
+    assert router3.submit(_ids(1, 2, 3), 4).replica == 1
+
+
+# ---------------------------------------------------------------------
+# failover
+# ---------------------------------------------------------------------
+
+def test_failover_resubmits_token_identical():
+    a, b = FakeReplica("a", slots=2), FakeReplica("b", slots=2)
+    router = Router([a, b], clock=lambda: 0.0)
+    # load a so placement sends the next requests to b, still QUEUED
+    for k in range(4):
+        a.submit(_ids(3, 3, k + 1), 2)
+    streamed = []
+    rrs = [router.submit(
+        _ids(10 + k, 20 + k), 3,
+        stream_cb=lambda r, new, fin: streamed.append((r.id, fin)))
+        for k in range(3)]
+    assert all(router.replicas[rr.replica].name == "b" for rr in rrs)
+    pinned = [rr.stream_id for rr in rrs]
+    b.tripped = True  # watchdog takes the replica out
+    assert router.maintain() is True
+    assert all(router.replicas[rr.replica].name == "a" for rr in rrs)
+    assert router.counts["replicas_failed"] == 1
+    assert router.counts["failovers"] == 3
+    # pinned stream ids travelled with the requests
+    assert [rr.stream_id for rr in rrs] == pinned
+    router.run_until_idle()
+    for k, rr in enumerate(rrs):
+        assert rr.result(1.0)["state"] == "done"
+        assert rr.summary()["resubmits"] == 1
+        # token identity: exactly what ANY replica produces for this
+        # (prompt, pinned stream) — the recorded-output pin
+        assert rr.tokens == fake_tokens(_ids(10 + k, 20 + k),
+                                        pinned[k], 3)
+    # streaming saw exactly ONE final event per request, post-failover
+    finals = [rid for rid, fin in streamed if fin]
+    assert sorted(finals) == sorted(rr.id for rr in rrs)
+
+
+def test_failover_suppresses_replica_shutdown_terminal():
+    """A replica hard-stop CANCELS its queued requests; that terminal
+    must not leak to the client of a router that can re-place them —
+    the held-back request finishes DONE elsewhere with full output."""
+    a, b = FakeReplica("a"), FakeReplica("b")
+    for k in range(4):
+        a.submit(_ids(4, 4, k + 1), 2)  # bias placement to b
+    router = Router([a, b], clock=lambda: 0.0)
+    finals = []
+    rr = router.submit(_ids(7, 8, 9), 4,
+                       stream_cb=lambda r, new, fin: finals.append(fin))
+    assert router.replicas[rr.replica].name == "b"
+    b.fail_hard()  # cancels the queued request on its way down
+    assert rr.inner.state is RequestState.CANCELLED
+    assert not rr.wait(0)  # ...but the CLIENT handle is still open
+    assert finals == []
+    router.maintain()
+    router.run_until_idle()
+    assert rr.result(1.0)["state"] == "done"
+    assert rr.tokens == fake_tokens(_ids(7, 8, 9), rr.stream_id, 4)
+    assert finals.count(True) == 1
+    # a CLIENT cancellation, by contrast, is a real outcome: no resub
+    c = FakeReplica("c")
+    router2 = Router([b, c], clock=lambda: 0.0)  # b already closed
+    router2.mark_failed(0, "closed")
+    rr2 = router2.submit(_ids(1, 2), 2)
+    assert router2.cancel(rr2) is True
+    router2.maintain()
+    assert rr2.wait(1.0) and rr2.state is RequestState.CANCELLED
+    assert rr2.resubmits == 0
+
+
+def test_failover_rebind_not_clobbered_by_dead_replica_sweep():
+    """The maintenance sweep runs failover FIRST, then fails admitted
+    work stuck on dead replicas — and must re-read each request's
+    CURRENT home: a request just rebound to a healthy replica (and
+    instantly admitted there) is not 'admitted on a failed replica',
+    however stale the pre-failover index says otherwise."""
+    class InstantAdmit(FakeReplica):
+        def submit(self, ids, max_new, **kw):
+            req = super().submit(ids, max_new, **kw)
+            self.queue.remove(req)
+            req.state = RequestState.RUNNING
+            req.ts_admitted = 1.0
+            self.running.append(req)
+            return req
+
+    a, b = InstantAdmit("a"), FakeReplica("b")
+    for k in range(4):
+        a.submit(_ids(5, 5, k + 1), 2)  # bias placement to b
+    router = Router([a, b], clock=lambda: 0.0)
+    rr = router.submit(_ids(8, 8, 8), 3)
+    assert router.replicas[rr.replica].name == "b"
+    b.closed = True  # dead WITHOUT drain: the finalize-stuck sweep arms
+    router.maintain()  # failover → a, which ADMITS instantly
+    assert router.replicas[rr.replica].name == "a"
+    assert rr.resubmits == 1
+    assert not rr.wait(0), "rebound request must not be failed"
+    router.run_until_idle()
+    assert rr.result(1.0)["state"] == "done"
+    assert rr.tokens == fake_tokens(_ids(8, 8, 8), rr.stream_id, 3)
+
+
+def test_admitted_on_dead_replica_fails_to_client_not_hangs():
+    """ADMITTED work on a DEAD (closed, not merely tripped) replica
+    cannot complete or be replayed token-identically: the router must
+    fail it to the client instead of hanging result() forever and
+    pinning idle()/drain() open — while a TRIPPED replica's running
+    rows (its loop keeps decoding) are left to finish."""
+    a, b = FakeReplica("a"), FakeReplica("b")
+    router = Router([a, b], clock=lambda: 0.0)
+    rr = router.submit(_ids(6, 6, 6), 3)
+    home = router.replicas[rr.replica]
+    home.hold_running = True
+    home.step()  # admitted: ts_admitted stamped, no terminal yet
+    assert rr.inner.ts_admitted is not None
+    home.closed = True  # dies without draining
+    router.maintain()
+    assert rr.wait(1.0)
+    assert "mid-decode" in (rr.error or "")
+    assert rr.resubmits == 0  # admitted work is never replayed
+    assert router.idle()
+    # tripped replica: running rows keep decoding and finish normally
+    c, d = FakeReplica("c"), FakeReplica("d")
+    router2 = Router([c, d], clock=lambda: 0.0)
+    rr2 = router2.submit(_ids(7, 7), 3)
+    home2 = router2.replicas[rr2.replica]
+    home2.hold_running = True
+    home2.step()
+    home2.tripped = True
+    router2.maintain()
+    assert not rr2.wait(0)  # NOT failed: the tripped loop still runs
+    home2.hold_running = False
+    home2.step()
+    assert rr2.result(1.0)["state"] == "done"
+
+
+def test_failover_with_no_replica_left_fails_the_request():
+    a = FakeReplica("a")
+    router = Router([a], clock=lambda: 0.0)
+    rr = router.submit(_ids(1, 2, 3), 2)
+    a.tripped = True
+    router.maintain()
+    assert rr.wait(1.0)
+    assert "no replica" in (rr.error or "")
+    assert router.idle()  # the failed request is not stuck in flight
+
+
+# ---------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------
+
+def test_drain_completes_inflight_then_503_and_flight_manifest(tmp_path):
+    from tpuflow.obs import flight
+
+    a, b = FakeReplica("a", max_queue=64), FakeReplica("b", max_queue=64)
+    router = Router([a, b], clock=lambda: 1234.0)
+    rrs = [router.submit(_ids(1, 1, k + 1), 3) for k in range(6)]
+    router.drain()
+    assert router.draining and not router.drained()
+    with pytest.raises(SchedulerClosed):
+        router.submit(_ids(9), 1)
+    assert a.is_draining and b.is_draining  # replicas got the drain
+    router.run_until_idle()
+    # every admitted request finished with its FULL budget — zero
+    # truncated streams (the acceptance criterion)
+    for rr in rrs:
+        assert rr.result(1.0)["state"] == "done"
+        assert len(rr.tokens) == 3
+    assert router.drained()
+    # the flight recorder captures the drain in the manifest notes,
+    # and the router provider section carries the tier state
+    bundle = flight.load(flight.dump(str(tmp_path), "test"))
+    note = bundle["manifest"]["notes"]["router.drain"]
+    assert note["queue_depth"] == 6 and note["ts"] == 1234.0
+    assert bundle["router"]["draining"] is True
+    assert bundle["router"]["counts"]["drains"] == 1
+    flight.annotate("router.drain", None)  # test isolation
+
+
+# ---------------------------------------------------------------------
+# introspection surfaces
+# ---------------------------------------------------------------------
+
+def test_router_snapshot_readiness_and_load():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    router = Router([a, b], clock=lambda: 0.0)
+    router.submit(_ids(1, 2), 2)
+    snap = router.metrics_snapshot()
+    assert snap["router.placed"] == 1.0
+    assert snap["router.replicas_live"] == 2.0
+    assert snap["serve.a.done"] == 0.0  # replica snapshots merged in
+    r = router.readiness()
+    assert r["ready"] is True and r["replicas_ready"] == 2
+    assert r["queue_depth"] == 1
+    load = router.load_snapshot()
+    assert load["queue_depth"] == 1 and load["kv_pages_free"] == 128
+    a.tripped = True
+    router.maintain()
+    r2 = router.readiness()
+    assert r2["ready"] is True and r2["replicas_ready"] == 1
+    assert r2["replicas"]["a"]["failed"]
+    b.tripped = True
+    router.maintain()
+    assert router.readiness()["ready"] is False
+
+
+# ---------------------------------------------------------------------
+# real-scheduler pins (ONE tiny shared model; compile-light)
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.models import build_transformer_lm
+
+    lm = build_transformer_lm(vocab_size=128, dim=32, depth=1, heads=2,
+                              mlp_ratio=2, dtype=jnp.float32)
+    params = nn.unbox(
+        lm.init({"params": jax.random.key(0)},
+                jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    return lm, params
+
+
+def _sched(tiny_lm, **kw):
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = tiny_lm
+    kw.setdefault("slots", 2)
+    kw.setdefault("seg", 4)
+    kw.setdefault("max_new_cap", 8)
+    return ServeScheduler(lm, params, **kw)
+
+
+def test_load_snapshot_real_scheduler(tiny_lm):
+    """Sensor shape without a single decode step (no pool is built for
+    queued-only work): the keys the router and any external LB place
+    on, including the paged-KV fields."""
+    sched = _sched(tiny_lm)
+    sched.submit(np.ones((3,), np.int32), 4)
+    snap = sched.load_snapshot()
+    assert snap["queue_depth"] == 1 and snap["running"] == 0
+    assert snap["closed"] is False and snap["draining"] is False
+    assert snap["slots_per_bucket"] == 2
+    assert "kv_pages_free" not in snap  # contiguous: pages never gate
+    assert snap["ttft_ms_p95"] is None  # no traffic served yet
+    paged = _sched(tiny_lm, kv="paged", kv_page_size=4, kv_pages=32)
+    assert paged.load_snapshot()["kv_pages_free"] == 31
+    assert paged.load_snapshot()["kv_pages_total"] == 31
+
+
+def test_scheduler_drain_real_decode(tiny_lm):
+    """drain() on a loaded scheduler: the admitted backlog decodes to
+    completion (offline drive), new submits raise SchedulerClosed, and
+    readiness/load_snapshot report the drain."""
+    sched = _sched(tiny_lm, slots=1)
+    reqs = [sched.submit(np.full((3,), k + 1, np.int32), 3)
+            for k in range(3)]
+    sched.drain()
+    assert sched.draining and not sched.drained()
+    with pytest.raises(SchedulerClosed, match="stopped"):
+        sched.submit(np.ones((2,), np.int32), 2)
+    assert sched.readiness()["ready"] is False
+    assert sched.load_snapshot()["draining"] is True
+    sched.run_until_idle()
+    for r in reqs:
+        assert r.result(1.0)["state"] == "done"
+        assert len(r.tokens) == 3
+    assert sched.drained()
+
+
+def test_generated_prefix_publish_host_semantics():
+    """Host-side pin of the kv_prefix_insert_generated satellite: a
+    prompt+completion chain inserted at finish deepens the tree beyond
+    the join-time prompt publish, and a follow-up's match covers the
+    completion (the full-stack scheduler A/B rides the slow tier)."""
+    from tpuflow.serve.pages import PageAllocator, PrefixCache
+
+    alloc = PageAllocator(pages=32, clock=lambda: 0.0)
+    tree = PrefixCache(4, alloc, clock=lambda: 0.0)
+    prompt = np.arange(1, 7, dtype=np.int32)       # p=6
+    completion = np.arange(50, 56, dtype=np.int32)  # 6 generated
+    full = np.concatenate([prompt, completion])
+    chain = alloc.alloc(3)  # pages_needed(6, 6, 4)
+    # join-time publish: full PROMPT chunks only → (p-1)//ps = 1 page
+    tree.insert(prompt[:4], chain[:1])
+    follow = np.concatenate([full, [99]])
+    pages, matched, _ = tree.match(follow[: follow.size - 1])
+    assert matched == 4
+    # finish-time publish: (len(full)-1)//ps = 2 pages — the
+    # completion's KV becomes hittable
+    tree.insert(full[:8], chain[:2])
+    pages, matched, _ = tree.match(follow[: follow.size - 1])
+    assert matched == 8 and len(pages) == 2
+
+
+def test_prom_replica_labels():
+    """serve.replica<i>.* registry names fold into ONE Prometheus
+    family per metric with replica labels (gauge, counter, histogram);
+    unlabeled names render exactly as before."""
+    from tpuflow.obs.gauges import (
+        Histogram,
+        clear_gauges,
+        inc_counter,
+        register_histogram,
+        set_gauge,
+    )
+    from tpuflow.obs.prom import render, split_replica
+
+    assert split_replica("s.replica3.ttft_ms") == ("s.ttft_ms", "3")
+    assert split_replica("s.replicaX.t") == ("s.replicaX.t", None)
+    try:
+        set_gauge("rt.replica0.queue_depth", 2.0)
+        set_gauge("rt.replica1.queue_depth", 5.0)
+        set_gauge("rt.plain", 7.0)
+        inc_counter("rt.replica1.requests_done_total", 3)
+        register_histogram("rt.replica0.ttft_ms", Histogram()).observe(10)
+        register_histogram("rt.replica1.ttft_ms", Histogram()).observe(20)
+        text = render("rt")
+        assert 'rt_queue_depth{replica="0"} 2' in text
+        assert 'rt_queue_depth{replica="1"} 5' in text
+        assert text.count("# TYPE rt_queue_depth gauge") == 1
+        assert "rt_plain 7" in text  # unlabeled stays bare
+        assert 'rt_requests_done_total{replica="1"} 3' in text
+        assert text.count("# TYPE rt_ttft_ms histogram") == 1
+        assert 'rt_ttft_ms_bucket{le="+Inf",replica="0"} 1' in text
+        assert 'rt_ttft_ms_count{replica="1"} 1' in text
+        assert 'rt_ttft_ms_sum{replica="1"} 20' in text
+    finally:
+        clear_gauges("rt.")
+
+
+# ---------------------------------------------------------------------
+# static guard: the router tier never touches device arrays
+# ---------------------------------------------------------------------
+
+def test_router_tier_never_touches_device_arrays():
+    """Grep guard (the PR 7 jit-site-guard idiom, applied to the
+    serving-tier boundary): tpuflow/serve/router.py and replica.py are
+    PURE HOST POLICY — no device-array imports or calls may appear.
+    All device work stays on the replica schedulers' threads; a future
+    'quick fix' that fetches device state in the router would put
+    device syncs on the placement path of every request."""
+    root = os.path.join(os.path.dirname(__file__), "..", "tpuflow",
+                        "serve")
+    pat = re.compile(
+        r"(?:\bimport\s+jax\b|\bfrom\s+jax\b|\bjax\s*\.|\bjnp\s*\.|"
+        r"\bblock_until_ready\b|\bdevice_put\b)"
+    )
+    offenders = []
+    for fn in ("router.py", "replica.py"):
+        src = open(os.path.join(root, fn)).read()
+        for m in pat.finditer(src):
+            line = src[:m.start()].count("\n") + 1
+            offenders.append(f"{fn}:{line} ({m.group(0)})")
+    assert not offenders, (
+        "device-array usage in the router tier — delegate to replica "
+        "scheduler methods instead (device work stays on scheduler "
+        "threads):\n  " + "\n  ".join(offenders)
+    )
+
+
+# ---------------------------------------------------------------------
+# full-stack parity + generated-insert A/B (slow tier)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_router_parity_with_single_scheduler_incl_failover(tiny_lm):
+    """ISSUE 8 acceptance: a mixed trace served through 2 replicas is
+    TOKEN-IDENTICAL to the same submissions served by one scheduler —
+    greedy AND sampled — including requests a failed replica handed
+    back through failover (their pinned stream ids travel along)."""
+    from tpuflow.serve import InProcessReplica, Router, ServeScheduler
+    from tpuflow.serve.metrics import ServeMetrics
+
+    lm, params = tiny_lm
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 128, (int(rng.integers(2, 9)),))
+               .astype(np.int32) for _ in range(8)]
+    budgets = [int(rng.integers(2, 9)) for _ in range(8)]
+    for sampling in (dict(),
+                     dict(temperature=0.8, top_k=20, seed=7)):
+        def mk(i):
+            return ServeScheduler(
+                lm, params, slots=2, seg=4, max_new_cap=8,
+                metrics=ServeMetrics(gauge_prefix=f"serve.replica{i}"),
+                **sampling)
+
+        # (a) both replicas serve: plain split parity
+        router = Router([InProcessReplica(mk(0), "r0"),
+                         InProcessReplica(mk(1), "r1")])
+        rrs = [router.submit(p, b) for p, b in zip(prompts, budgets)]
+        router.run_until_idle()
+        # (b) failover parity: all queued on r1 resubmit to r0
+        router2 = Router([InProcessReplica(mk(0), "r0"),
+                          InProcessReplica(mk(1), "r1")])
+        rrs2 = [router2.submit(p, b) for p, b in zip(prompts, budgets)]
+        moved = [rr for rr in rrs2 if rr.replica == 1]
+        assert moved  # placement really did spread
+        router2.mark_failed(1, "test-induced")
+        router2.maintain()
+        assert all(rr.replica == 0 for rr in rrs2)
+        router2.run_until_idle()
+        assert router2.counts["failovers"] == len(moved)
+        # control: ONE scheduler, same submission order
+        solo = ServeScheduler(lm, params, slots=2, seg=4,
+                              max_new_cap=8, **sampling)
+        ctrl = [solo.submit(p, b) for p, b in zip(prompts, budgets)]
+        solo.run_until_idle()
+        for rr, rr2, c in zip(rrs, rrs2, ctrl):
+            assert c.state.value == "done"
+            assert rr.result(1.0)["state"] == "done"
+            assert rr2.result(1.0)["state"] == "done"
+            assert rr.tokens == c.tokens, sampling
+            assert rr2.tokens == c.tokens, sampling
+
+
+@pytest.mark.slow
+def test_generated_prefix_insert_hit_rate(tiny_lm):
+    """kv_prefix_insert_generated full-stack A/B: a multi-turn
+    follow-up (prompt + completion + new turn) hits the cache past the
+    original prompt only with the flag on — and publishing never
+    perturbs tokens."""
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = tiny_lm
+
+    def run(flag):
+        s = ServeScheduler(lm, params, slots=1, seg=4, max_new_cap=8,
+                           kv="paged", kv_page_size=4, kv_pages=64,
+                           kv_prefix_insert_generated=flag)
+        pa = np.arange(1, 7, dtype=np.int32)
+        a = s.submit(pa, 6)
+        s.run_until_idle()
+        assert a.state.value == "done" and len(a.tokens) == 6
+        follow = np.concatenate([pa, np.asarray(a.tokens, np.int32),
+                                 np.asarray([99], np.int32)])
+        b = s.submit(follow, 4)
+        s.run_until_idle()
+        assert b.state.value == "done"
+        return s.metrics.prefill_tokens_saved, a.tokens, b.tokens
+
+    on_saved, a_on, b_on = run(True)
+    off_saved, a_off, b_off = run(False)
+    assert (a_on, b_on) == (a_off, b_off)  # flag never changes tokens
+    # flag off: only the join-time PROMPT pages can match the
+    # follow-up ((p-1)//ps = 1 page = 4 tokens); flag on: the
+    # prompt+completion chain ((p+n-1)//ps = 2 pages = 8 tokens)
+    assert off_saved == 4
+    assert on_saved == 8
+
+
+@pytest.mark.slow
+def test_router_http_tier_drain_endpoint(tiny_lm, tmp_path):
+    """The whole tier over HTTP: generate via the router frontend,
+    /readyz + /v1/metrics + Prometheus replica labels, then
+    POST /v1/admin/drain → new generates 503 while the flight manifest
+    notes record the drain."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from tpuflow.obs import flight
+    from tpuflow.serve import InProcessReplica, Router, ServeScheduler
+    from tpuflow.serve.http import start_http_server
+    from tpuflow.serve.metrics import ServeMetrics
+
+    lm, params = tiny_lm
+    reps = [InProcessReplica(ServeScheduler(
+        lm, params, slots=2, seg=4, max_new_cap=8,
+        metrics=ServeMetrics(gauge_prefix=f"serve.replica{i}")),
+        f"replica{i}") for i in range(2)]
+    router = Router(reps)
+    server = start_http_server(router)
+    port = server.port
+
+    def post(path, body, timeout=120):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+
+    try:
+        st, out = post("/v1/generate",
+                       {"prompt": [1, 2, 3], "max_new_tokens": 4})
+        assert st == 200 and out["state"] == "done"
+        assert out["n_tokens"] == 4
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=10) as r:
+            ready = json.loads(r.read())
+        assert ready["ready"] is True and ready["replicas_ready"] == 2
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/metrics", timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["router.placed"] >= 1
+        assert any(k.startswith("serve.replica0.") for k in snap)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert 'replica="0"' in text and 'replica="1"' in text
+        # graceful drain over the admin endpoint
+        st, out = post("/v1/admin/drain", {})
+        assert st == 200 and out["draining"] is True
+        try:
+            post("/v1/generate", {"prompt": [4], "max_new_tokens": 2})
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        # /readyz answers 503 with the drain reason in the body
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=10)
+            assert False, "expected 503 /readyz while draining"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["draining"] is True
+        # ... and the flight manifest notes record the drain
+        bundle = flight.load(flight.dump(str(tmp_path), "test"))
+        assert "router.drain" in bundle["manifest"]["notes"]
+    finally:
+        flight.annotate("router.drain", None)
+        server.shutdown()
+        router.stop(drain=False, timeout=10.0)

@@ -8,7 +8,7 @@ one post-mortem bundle directory holding everything the live plane
 knew at that moment:
 
 - ``manifest.json`` — reason, wall time, pid, watchdog state, caller
-  context;
+  context, and any :func:`annotate` notes (e.g. a serving drain);
 - ``spans.json`` — the span ring as a Chrome trace export (what the
   process was doing in the seconds before the trip; present when the
   tracer is enabled);
@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 _LOCK = threading.Lock()
 _PROVIDERS: Dict[str, Callable[[], Any]] = {}
+_NOTES: Dict[str, Any] = {}
 _SEQ = 0
 
 _BUNDLE_FILES = ("manifest.json", "gauges.json", "sysmetrics.json")
@@ -65,6 +66,20 @@ def remove_provider(name: str) -> None:
         _PROVIDERS.pop(name, None)
 
 
+def annotate(key: str, value: Any) -> None:
+    """Pin a JSON-able note onto every FUTURE bundle's manifest
+    (``manifest["notes"][key]``) — for process-lifecycle facts a
+    provider snapshot cannot carry because they happened as an EVENT
+    (e.g. a serving drain: the post-mortem of a SIGTERM'd server must
+    say the truncation-free drain ran, not just show empty queues).
+    Last value per key wins; ``annotate(key, None)`` removes."""
+    with _LOCK:
+        if value is None:
+            _NOTES.pop(key, None)
+        else:
+            _NOTES[key] = value
+
+
 def _write_json(path: str, obj: Any) -> None:
     with open(path, "w") as f:
         json.dump(obj, f, indent=1, default=str)
@@ -81,6 +96,7 @@ def dump(out_dir: str, reason: str,
         _SEQ += 1
         seq = _SEQ
         providers = dict(_PROVIDERS)
+        notes = dict(_NOTES)
     name = f"postmortem-{int(time.time())}-{os.getpid()}-{seq}"
     final = os.path.join(out_dir, name)
     tmp = final + f".tmp-{os.getpid()}"
@@ -162,6 +178,7 @@ def dump(out_dir: str, reason: str,
             k: round(v, 3) for k, v in heartbeat_ages().items()
         },
         "tracer_enabled": trace.is_enabled(),
+        "notes": notes,
         "sections": sorted(sections),
         "errors": errors,
     }

@@ -12,6 +12,13 @@ NOT done here: Prometheus differences cumulative buckets itself
 (``histogram_quantile(rate(..._bucket[5m]))``); the in-process windowed
 view lives in :mod:`tpuflow.obs.timeseries`.
 
+Per-replica metrics (ISSUE 8): registry names spelled
+``<prefix>.replica<i>.<metric>`` — what the multi-replica router tier
+gives each replica's ``ServeMetrics`` — are folded into ONE family per
+metric with a ``replica="<i>"`` label, so an aggregating dashboard
+queries ``sum by (replica) (rate(serve_ttft_ms_bucket[5m]))`` instead
+of regex-joining N metric names.
+
 Exposed bucket bounds are the shared fixed grid COARSENED by taking
 every ``stride``-th bound (default 8 → exact powers of two of 1e-3,
 ~34 buckets instead of ~290): cumulative counts at surviving bounds
@@ -45,6 +52,30 @@ from tpuflow.obs.gauges import (
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+#: registry-name marker that becomes a ``replica="<i>"`` label: the
+#: multi-replica serving tier (ISSUE 8) namespaces each replica's
+#: metrics as ``serve.replica<i>.*`` so they don't clobber each other
+#: in the shared registry — and the exposition folds them back into
+#: ONE Prometheus family per metric, labelled per replica, which is
+#: what a dashboard aggregating the tier actually wants to query.
+_REPLICA_RE = re.compile(r"\.replica(\d+)(?=\.)")
+
+
+def split_replica(name: str):
+    """``serve.replica0.ttft_ms`` → ``("serve.ttft_ms", "0")``; names
+    without the marker pass through as ``(name, None)``."""
+    m = _REPLICA_RE.search(name)
+    if m is None:
+        return name, None
+    return name[:m.start()] + name[m.end():], m.group(1)
+
+
+def _label(rep, extra: str = "") -> str:
+    parts = [p for p in (extra,
+                         None if rep is None else f'replica="{rep}"')
+             if p]
+    return "{" + ",".join(parts) + "}" if parts else ""
 
 
 def metric_name(name: str) -> str:
@@ -87,45 +118,58 @@ def render(prefix: Optional[str] = None, stride: int = 8) -> str:
     # snapshot_gauges would pay a windowed-delta walk per scrape just
     # to have its summary keys filtered back out here
     scalars = scalar_gauges(prefix)
-    for name in sorted(scalars):
-        mn = metric_name(name)
-        lines.append(f"# HELP {mn} tpuflow gauge {name}")
+
+    def _families(d: Dict[str, object]) -> "Dict[str, list]":
+        # fold serve.replica<i>.* members into one family per metric,
+        # keyed (replica_label, value); plain names stay label-free
+        fams: Dict[str, list] = {}
+        for name in sorted(d):
+            fam, rep = split_replica(name)
+            fams.setdefault(fam, []).append((rep, d[name]))
+        return fams
+
+    for fam, members in sorted(_families(scalars).items()):
+        mn = metric_name(fam)
+        lines.append(f"# HELP {mn} tpuflow gauge {fam}")
         lines.append(f"# TYPE {mn} gauge")
-        lines.append(f"{mn} {_fmt(scalars[name])}")
-    for name in sorted(cntrs):
-        mn = metric_name(name)
+        for rep, v in members:
+            lines.append(f"{mn}{_label(rep)} {_fmt(v)}")
+    for fam, members in sorted(_families(cntrs).items()):
+        mn = metric_name(fam)
         if not mn.endswith("_total"):
             mn += "_total"
-        lines.append(f"# HELP {mn} tpuflow counter {name}")
+        lines.append(f"# HELP {mn} tpuflow counter {fam}")
         lines.append(f"# TYPE {mn} counter")
-        lines.append(f"{mn} {_fmt(cntrs[name])}")
+        for rep, v in members:
+            lines.append(f"{mn}{_label(rep)} {_fmt(v)}")
     bounds = bucket_bounds()
     # every stride-th bound STARTING AT THE FIRST: with the default
     # stride 8 on the 2**(1/8) grid that is exactly 1e-3 * 2^k — the
     # readable power-of-two labels the docstring promises. Cumulative
     # counts are exact at ANY subset of the fine bounds.
     coarse = list(range(0, len(bounds), stride))
-    for name in sorted(hists):
-        st = hists[name].state()
-        mn = metric_name(name)
-        lines.append(f"# HELP {mn} tpuflow histogram {name}")
+    for fam, members in sorted(_families(hists).items()):
+        mn = metric_name(fam)
+        lines.append(f"# HELP {mn} tpuflow histogram {fam}")
         lines.append(f"# TYPE {mn} histogram")
-        cum = 0
-        i0 = 0
-        for bi in coarse:
-            cum += sum(st["counts"][i0:bi + 1])
-            i0 = bi + 1
-            # 6 significant digits: the repeated-multiplication grid
-            # carries float dust (1e-3*2^1 accumulates to
-            # 0.0020000000000000005) that would make every le label
-            # 17 digits of noise in dashboards
-            lines.append(
-                f'{mn}_bucket{{le="{bounds[bi]:.6g}"}} {cum}'
-            )
-        cum += sum(st["counts"][i0:])
-        lines.append(f'{mn}_bucket{{le="+Inf"}} {cum}')
-        lines.append(f"{mn}_sum {_fmt(st['total'])}")
-        lines.append(f"{mn}_count {st['n']}")
+        for rep, hist in members:
+            st = hist.state()
+            cum = 0
+            i0 = 0
+            for bi in coarse:
+                cum += sum(st["counts"][i0:bi + 1])
+                i0 = bi + 1
+                # 6 significant digits: the repeated-multiplication
+                # grid carries float dust (1e-3*2^1 accumulates to
+                # 0.0020000000000000005) that would make every le
+                # label 17 digits of noise in dashboards
+                le = f'le="{bounds[bi]:.6g}"'
+                lines.append(f"{mn}_bucket{_label(rep, le)} {cum}")
+            cum += sum(st["counts"][i0:])
+            le_inf = 'le="+Inf"'
+            lines.append(f"{mn}_bucket{_label(rep, le_inf)} {cum}")
+            lines.append(f"{mn}_sum{_label(rep)} {_fmt(st['total'])}")
+            lines.append(f"{mn}_count{_label(rep)} {st['n']}")
     return "\n".join(lines) + "\n"
 
 

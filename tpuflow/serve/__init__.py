@@ -5,8 +5,13 @@ packaging.lm) lacks: slot-level continuous batching over the decode
 engine's segment-resume + per-slot-prefill primitives
 (tpuflow.infer.generate), a bounded admission queue with backpressure,
 per-request deadlines/cancellation/streaming, serving metrics exported
-through tpuflow.obs, and a thin stdlib HTTP frontend
-(``python -m tpuflow.serve``).
+through tpuflow.obs, a thin stdlib HTTP frontend
+(``python -m tpuflow.serve``), and — above all of it — the
+multi-replica router tier (``python -m tpuflow.serve --replicas N``):
+load-aware placement over replica ``load_snapshot()`` sensors, prefix
+affinity aligned with the paged KV cache's chunking, tier-level
+shedding/backpressure, failover of never-admitted requests, and
+graceful drain on SIGTERM or ``POST /v1/admin/drain``.
 """
 
 from tpuflow.serve.metrics import ServeMetrics, percentiles  # noqa: F401
@@ -16,10 +21,17 @@ from tpuflow.serve.pages import (  # noqa: F401
     PageAllocator,
     PrefixCache,
 )
+from tpuflow.serve.replica import InProcessReplica, Replica  # noqa: F401
 from tpuflow.serve.request import (  # noqa: F401
     QueueFull,
     Request,
     RequestState,
+    SchedulerClosed,
+)
+from tpuflow.serve.router import (  # noqa: F401
+    Router,
+    RouterMetrics,
+    RouterRequest,
 )
 from tpuflow.serve.scheduler import ServeScheduler, serve_texts  # noqa: F401
 from tpuflow.serve.slots import PagedSlotPool, SlotPool  # noqa: F401

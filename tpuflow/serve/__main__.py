@@ -2,13 +2,22 @@
 
 Loads a packaged LM directory / ``runs:/`` / ``models:/`` URI
 (tpuflow.packaging.lm), builds the slot-level continuous-batching
-scheduler around it, and exposes the stdlib HTTP frontend::
+scheduler around it — or, with ``--replicas N``, a whole multi-replica
+tier (ISSUE 8): N schedulers behind the load-aware router with prefix
+affinity, shedding and failover — and exposes the stdlib HTTP
+frontend::
 
   python -m tpuflow.serve --model /path/to/packaged_lm --port 8000 \
-      --slots 4 --max-new 64
+      --replicas 2 --kv paged --slots 4 --max-new 64
 
   curl -s localhost:8000/v1/generate -d '{"prompt": "the cat"}'
   curl -s localhost:8000/v1/metrics
+  curl -s -X POST localhost:8000/v1/admin/drain   # graceful drain
+
+SIGTERM drains gracefully (train/preempt.py's signal channel): stop
+admitting (503), finish every admitted request, flip ``/readyz``, then
+exit — a rolling restart truncates zero streams. ``--drain-timeout``
+bounds the wait.
 
 Equivalent entry point: ``python -m tpuflow.cli.serve``.
 """
@@ -17,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import time
 from typing import List, Optional
 
 
@@ -27,8 +37,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="0 binds an ephemeral port (printed on start)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="scheduler replicas behind the front router "
+                        "(1 = the single-scheduler path, no router; "
+                        ">1 = load-aware placement + prefix affinity "
+                        "+ failover across N in-process replicas "
+                        "sharing the loaded weights)")
     p.add_argument("--slots", type=int, default=4,
-                   help="decode slots per prompt-length bucket")
+                   help="decode slots per prompt-length bucket "
+                        "(per replica)")
     p.add_argument("--seg", type=int, default=8,
                    help="decode steps between scheduler boundaries")
     p.add_argument("--rounds", type=int, default=3,
@@ -36,8 +53,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--max-new", type=int, default=64,
                    help="per-request max_new_tokens cap")
     p.add_argument("--max-queue", type=int, default=64,
-                   help="admission queue bound (429 beyond it)")
+                   help="admission queue bound PER REPLICA (429 beyond "
+                        "it; the router also sheds at the tier-wide "
+                        "sum)")
     p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument("--drain-timeout", type=float, default=60.0,
+                   metavar="S",
+                   help="max seconds a SIGTERM drain waits for the "
+                        "admitted backlog before exiting anyway")
     p.add_argument("--kv", choices=("contiguous", "paged"),
                    default="contiguous",
                    help="KV memory model: 'paged' = fixed-size pages "
@@ -48,7 +71,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--kv-pages", type=int, default=None,
                    help="--kv paged: physical page count of the store "
                         "(default sizes for ~4x slots concurrent "
-                        "worst-case requests)")
+                        "worst-case requests), per replica")
     p.add_argument("--kv-page-size", type=int, default=16,
                    help="--kv paged: tokens per page")
     p.add_argument("--kv-quant", choices=("int8",), default=None,
@@ -57,6 +80,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "on bf16 models, ~4x on f32)")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="--kv paged: disable shared-prefix page reuse")
+    p.add_argument("--kv-prefix-insert-generated", action="store_true",
+                   help="--kv paged: also publish finished requests' "
+                        "GENERATED pages into the prefix cache, so "
+                        "multi-turn follow-ups (prompt+completion+...) "
+                        "hit past the original prompt; completion "
+                        "pages then live in the tree until LRU "
+                        "pressure evicts them")
+    p.add_argument("--no-affinity", action="store_true",
+                   help="--replicas>1: disable prefix-affinity "
+                        "placement (pure least-loaded)")
     p.add_argument("--trace-spans", action="store_true",
                    help="enable the tpuflow.obs.trace span tracer "
                         "(request ids become trace ids; inspect via "
@@ -75,68 +108,125 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="arm the flight recorder: dump a post-mortem "
                         "bundle under DIR on watchdog trip, unhandled "
                         "exception or SIGTERM (inspect via python -m "
-                        "tpuflow.cli.obs postmortem DIR)")
+                        "tpuflow.cli.obs postmortem DIR); a graceful "
+                        "drain dumps a final 'drain complete' bundle "
+                        "whose manifest notes carry the drain")
     args = p.parse_args(argv)
 
     if args.trace_spans:
         from tpuflow.obs import trace as _trace
 
         _trace.enable()
-    # memory-and-compile plane (ISSUE 7): a long-lived server always
-    # arms the executable registry — recompile storms (bucket-menu
-    # explosion) must trip /readyz, not read as mysterious latency.
-    # Per-call cost while armed is one C-level cache-size read.
-    from tpuflow.obs import executables as _executables
+    # SIGTERM channel FIRST (train/preempt.py): the flag handler must
+    # be innermost so flight.install (which CHAINS the previous
+    # handler) dumps its bundle and then still flips the drain flag
+    from tpuflow.train.preempt import sigterm_preempt_flag
 
-    _executables.enable()
-    if args.flight_dir:
-        from tpuflow.obs import flight as _flight
-        from tpuflow.obs.health import default_watchdog
+    with sigterm_preempt_flag(True) as term_flag:
+        # memory-and-compile plane (ISSUE 7): a long-lived server
+        # always arms the executable registry — recompile storms
+        # (bucket-menu explosion) must trip /readyz, not read as
+        # mysterious latency. Per-call cost while armed is one C-level
+        # cache-size read.
+        from tpuflow.obs import executables as _executables
 
-        _flight.install(args.flight_dir, signals=True)
-        default_watchdog().on_trip.append(
-            _flight.trip_dumper(args.flight_dir)
+        _executables.enable()
+        if args.flight_dir:
+            from tpuflow.obs import flight as _flight
+            from tpuflow.obs.health import default_watchdog
+
+            _flight.install(args.flight_dir, signals=True)
+            default_watchdog().on_trip.append(
+                _flight.trip_dumper(args.flight_dir)
+            )
+
+        from tpuflow.packaging.lm import load_packaged_lm
+        from tpuflow.serve.http import start_http_server
+        from tpuflow.serve.metrics import ServeMetrics
+        from tpuflow.serve.scheduler import ServeScheduler
+
+        kw = dict(
+            slots=args.slots, seg=args.seg, rounds=args.rounds,
+            max_new_cap=args.max_new, max_queue=args.max_queue,
+            kv=args.kv, kv_pages=args.kv_pages,
+            kv_page_size=args.kv_page_size, kv_quant=args.kv_quant,
+            kv_prefix_cache=not args.no_prefix_cache,
+            kv_prefix_insert_generated=args.kv_prefix_insert_generated,
         )
+        n_rep = max(1, int(args.replicas))
+        if n_rep == 1:
+            front = sched = ServeScheduler.from_packaged(args.model, **kw)
+            schedulers = [sched]
+        else:
+            # load the package ONCE: every replica shares the weights
+            # (device buffers) and tokenizer; each gets its own
+            # scheduler thread, slot pools, KV store and a
+            # serve.replica<i> metrics namespace (→ replica="<i>"
+            # labels in the Prometheus exposition)
+            from tpuflow.serve.replica import InProcessReplica
+            from tpuflow.serve.router import Router
 
-    from tpuflow.serve.http import start_http_server
-    from tpuflow.serve.scheduler import ServeScheduler
+            lm = load_packaged_lm(args.model)
+            schedulers = []
+            for i in range(n_rep):
+                schedulers.append(ServeScheduler.from_packaged(
+                    lm,
+                    metrics=ServeMetrics(
+                        gauge_prefix=f"serve.replica{i}"),
+                    **kw,
+                ))
+            front = Router(
+                [InProcessReplica(s, name=f"replica{i}")
+                 for i, s in enumerate(schedulers)],
+                affinity=not args.no_affinity,
+            )
+        if args.stall_timeout:
+            from tpuflow.obs.health import StallDetector
 
-    sched = ServeScheduler.from_packaged(
-        args.model, slots=args.slots, seg=args.seg, rounds=args.rounds,
-        max_new_cap=args.max_new, max_queue=args.max_queue,
-        kv=args.kv, kv_pages=args.kv_pages,
-        kv_page_size=args.kv_page_size, kv_quant=args.kv_quant,
-        kv_prefix_cache=not args.no_prefix_cache,
-    )
-    if args.stall_timeout:
-        from tpuflow.obs.health import StallDetector
+            detector = StallDetector(float(args.stall_timeout))
+            for sched in schedulers:
+                sched.stall_after_s = float(args.stall_timeout)
+                # watch SEGMENTS, not the loop: the loop heartbeat
+                # goes quiet during a first-touch pool compile too,
+                # and a latched trip on a cold start would 503
+                # /readyz forever. The segment name only starts
+                # counting once a segment has ever completed
+                # (require=False), so the cold-compile window cannot
+                # false-trip; a pre-first-segment wedge is still
+                # caught by /readyz's (non-latching) loop-age
+                # fallback.
+                detector.watch(f"{sched.metrics.prefix}.segment",
+                               active=(lambda s=sched: not s.idle()))
+            detector.start()
+        server = start_http_server(front, args.host, args.port,
+                                   request_timeout_s=args.request_timeout)
+        print(f"serving {args.model} on http://{args.host}:{server.port} "
+              f"(replicas={n_rep} slots={args.slots} seg={args.seg} "
+              f"max_new={args.max_new} queue<={args.max_queue} "
+              f"kv={args.kv})", flush=True)
+        try:
+            while not term_flag["hit"]:
+                time.sleep(0.2)
+            # graceful drain (ISSUE 8): SIGTERM = stop admitting,
+            # finish everything admitted, then exit — a rolling
+            # restart truncates zero streams. The flight SIGTERM hook
+            # (if armed) already dumped the moment-of-signal bundle;
+            # a second bundle below records the drain's outcome.
+            print("SIGTERM: draining (new submits get 503)", flush=True)
+            front.drain(wait_s=args.drain_timeout)
+            drained = front.drained() if hasattr(front, "drained") else True
+            print(f"drain {'complete' if drained else 'TIMED OUT'}",
+                  flush=True)
+            if args.flight_dir:
+                from tpuflow.obs import flight as _flight
 
-        sched.stall_after_s = float(args.stall_timeout)
-        # watch SEGMENTS, not the loop: the loop heartbeat goes quiet
-        # during a first-touch pool compile too, and a latched trip on
-        # a cold start would 503 /readyz forever. The segment name
-        # only starts counting once a segment has ever completed
-        # (require=False), so the cold-compile window cannot false-
-        # trip; a pre-first-segment wedge is still caught by /readyz's
-        # (non-latching) loop-age fallback.
-        detector = StallDetector(float(args.stall_timeout))
-        detector.watch(f"{sched.metrics.prefix}.segment",
-                       active=lambda: not sched.idle())
-        detector.start()
-    server = start_http_server(sched, args.host, args.port,
-                               request_timeout_s=args.request_timeout)
-    print(f"serving {args.model} on http://{args.host}:{server.port} "
-          f"(slots={args.slots} seg={args.seg} max_new={args.max_new} "
-          f"queue<={args.max_queue} kv={args.kv})", flush=True)
-    try:
-        import threading
-
-        threading.Event().wait()  # serve until interrupted
-    except KeyboardInterrupt:
-        print("shutting down", flush=True)
-    finally:
-        server.shutdown()
-        sched.stop(drain=False, timeout=10.0)
+                _flight.dump(args.flight_dir, "drain complete"
+                             if drained else "drain timeout")
+        except KeyboardInterrupt:
+            print("shutting down", flush=True)
+        finally:
+            server.shutdown()
+            front.stop(drain=False, timeout=10.0)
     return 0
 
 

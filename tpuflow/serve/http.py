@@ -3,8 +3,11 @@
 Deliberately thin — demo + integration-test surface, not a production
 gateway: ``http.server.ThreadingHTTPServer`` (one handler thread per
 connection) over a running :class:`~tpuflow.serve.scheduler.
-ServeScheduler`; every request is a thread-safe ``submit``/``cancel``
-into the scheduler thread, so the device never sees HTTP concurrency.
+ServeScheduler` — or a :class:`~tpuflow.serve.router.Router`, which
+duck-types the same surface, so ``python -m tpuflow.serve --replicas N``
+serves a whole multi-replica tier through this one frontend; every
+request is a thread-safe ``submit``/``cancel`` into the scheduler
+thread(s), so the device never sees HTTP concurrency.
 
 Endpoints::
 
@@ -15,7 +18,12 @@ Endpoints::
         decode segment, then a final {"done": true, ...} summary line
       → 429 + Retry-After on admission-queue backpressure (QueueFull)
       → 400 on never-servable requests (too long, bad budget)
+      → 503 once a drain/stop began (SchedulerClosed — new work must
+        go elsewhere; the admitted backlog still finishes)
   POST /v1/cancel     {"id": ...} → {"cancelled": bool}
+  POST /v1/admin/drain  graceful drain (ISSUE 8): stop admitting,
+                      finish everything admitted, flip /readyz →
+                      {"draining": true, "drained": bool, ...}
   GET  /v1/metrics    scheduler + gauge snapshot (JSON; windowed
                       percentiles primary, cumulative under _cum)
   GET  /metrics       Prometheus/OpenMetrics text exposition of the
@@ -40,7 +48,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
-from tpuflow.serve.request import QueueFull, RequestState
+from tpuflow.serve.request import QueueFull, RequestState, SchedulerClosed
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -123,6 +131,10 @@ class _Handler(BaseHTTPRequestHandler):
             snap = sched.metrics_snapshot()
             snap.update(scalar_gauges("serve"))
             snap.update(counters("serve"))
+            # router-tier counters when this frontend serves a Router
+            # (empty prefixes cost one dict walk each otherwise)
+            snap.update(scalar_gauges("router"))
+            snap.update(counters("router"))
             self._json(200, snap)
         elif self.path.startswith("/v1/events/"):
             rid = self.path[len("/v1/events/"):]
@@ -151,7 +163,22 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError("cancel needs an 'id'")
                 return self._json(200, {"id": rid,
                                         "cancelled": sched.cancel(rid)})
+            if self.path == "/v1/admin/drain":
+                # graceful drain over HTTP (the SIGTERM channel's
+                # twin): stop admitting, finish the admitted backlog,
+                # flip /readyz — callers poll "drained" or /readyz
+                sched.drain()
+                return self._json(200, {
+                    "draining": True,
+                    "drained": bool(sched.drained()),
+                    "readiness": sched.readiness(),
+                })
             return self._json(404, {"error": f"no route {self.path}"})
+        except SchedulerClosed as e:
+            # draining/stopped: new work must go elsewhere — the LB
+            # watching /readyz already stopped sending; stragglers get
+            # the drain contract's 503 instead of a queue slot
+            self._json(503, {"error": str(e)})
         except QueueFull as e:
             # backpressure telemetry: quote the current HBM headroom
             # (and refresh the mem.hbm_headroom_bytes gauge) so a

@@ -60,6 +60,28 @@ def pages_needed(prompt_len: int, max_new: int, page_size: int) -> int:
     return math.ceil((prompt_len + max_new - 1) / page_size)
 
 
+def chunk_keys(tokens, page_size: int) -> List[bytes]:
+    """Chained digests of the FULL ``page_size``-token chunks of
+    ``tokens`` — ``keys[j]`` identifies the token prefix
+    ``tokens[:(j+1)*page_size]`` exactly as :class:`PrefixCache`
+    chunks it (node key = chunk bytes under its parent chain), so the
+    multi-replica router's affinity table and a replica's prefix tree
+    agree on what can hit. Callers wanting the CACHEABLE prefix of a
+    prompt pass ``prompt[:p-1]`` (position p-1 is written by the
+    request's own first decode step — :meth:`PagedKV.plan`). Pure
+    host math: tokens in, digests out."""
+    import hashlib
+
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    ps = int(page_size)
+    h = hashlib.blake2b(digest_size=16)
+    out: List[bytes] = []
+    for j in range(tokens.size // ps):
+        h.update(tokens[j * ps:(j + 1) * ps].tobytes())
+        out.append(h.digest())
+    return out
+
+
 @dataclass(frozen=True)
 class PagedKVSpec:
     """Shape of one paged KV store: ``pages`` physical pages of

@@ -1,0 +1,182 @@
+"""Replica transport shim for the multi-replica serving tier.
+
+The router (:mod:`tpuflow.serve.router`) never talks to a
+:class:`~tpuflow.serve.scheduler.ServeScheduler` directly — it talks to
+a :class:`Replica`, the narrow surface a serving backend must offer:
+submit / cancel / load_snapshot / health / drain, plus the offline
+drive hooks the deterministic tests and the virtual-clock bench use.
+:class:`InProcessReplica` is the one backend today (N schedulers in one
+process, each on its own scheduler thread); an HTTP backend speaking to
+a remote ``python -m tpuflow.serve`` instance implements the same
+methods over ``POST /v1/generate`` + ``GET /readyz`` + the
+``load_snapshot`` JSON and drops in without touching the router —
+which is exactly the seam where ROADMAP item 3's prefill/decode
+disaggregation becomes a config change.
+
+Thread discipline: everything here delegates to scheduler entry points
+that are already thread-safe (``submit``/``cancel``/``load_snapshot``)
+or documented single-thread (``step``/``run_until_idle`` — offline
+drive only). No device work happens in this module: the router tier is
+pure host policy, and a guard test pins that boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from tpuflow.serve.request import Request
+
+
+class Replica:
+    """Abstract replica surface (duck-typed; subclassing optional).
+
+    Required of every backend:
+
+    - ``name`` — stable identity for placement/affinity bookkeeping;
+    - :meth:`submit` / :meth:`cancel` — the request surface, raising
+      the scheduler's own ``QueueFull`` / ``SchedulerClosed`` /
+      ``ValueError`` taxonomy;
+    - :meth:`load_snapshot` — the placement sensor (queue depth,
+      running rows, free KV pages, windowed latency p95s);
+    - :meth:`health` — ``{"failed": bool, ...}``, the failover input;
+    - :meth:`drain` / :meth:`stop` / :meth:`start`;
+    - :meth:`bucket_of` and the ``slots`` / ``max_new_cap`` /
+      ``page_size`` attributes — what the router needs to pin stream
+      ids and hash prefix chunks the way the replica's cache does.
+    """
+
+    name: str = "?"
+
+    def submit(self, prompt, max_new_tokens=None, **kw) -> Request:
+        raise NotImplementedError
+
+    def cancel(self, request) -> bool:
+        raise NotImplementedError
+
+    def load_snapshot(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def health(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class InProcessReplica(Replica):
+    """One in-process :class:`ServeScheduler` behind the replica
+    surface. Give each replica its own metrics namespace
+    (``ServeMetrics(gauge_prefix="serve.replica0")`` etc.) or their
+    gauges overwrite each other in the shared registry — the
+    ``serve.replica<i>`` spelling additionally renders as a
+    ``replica="i"`` label in the Prometheus exposition."""
+
+    def __init__(self, scheduler, name: Optional[str] = None):
+        self.sched = scheduler
+        self.name = name or scheduler.metrics.prefix
+
+    # ---- request surface (any thread) -------------------------------
+    def submit(self, prompt, max_new_tokens=None, *,
+               deadline_s: Optional[float] = None,
+               stream_cb: Optional[Callable] = None,
+               request_id: Optional[str] = None,
+               stream_id: Optional[int] = None) -> Request:
+        return self.sched.submit(
+            prompt, max_new_tokens, deadline_s=deadline_s,
+            stream_cb=stream_cb, request_id=request_id,
+            stream_id=stream_id,
+        )
+
+    def cancel(self, request) -> bool:
+        return self.sched.cancel(request)
+
+    # ---- sensors -----------------------------------------------------
+    def load_snapshot(self) -> Dict[str, Any]:
+        return self.sched.load_snapshot()
+
+    def readiness(self) -> Dict[str, Any]:
+        return self.sched.readiness()
+
+    def health(self) -> Dict[str, Any]:
+        """Failover input: ``failed`` = watchdog-tripped, or closed
+        WITHOUT a drain (a draining replica serves its own backlog —
+        resubmitting it elsewhere would double-serve), or a launched
+        loop thread that DIED (``readiness()``'s ``wedged_loop``: the
+        thread-alive-aware signal — a live thread inside a long
+        first-touch compile or slow segment is stalled, not dead, and
+        must NOT cascade into failover). NOTE the watchdog is
+        process-global (PR 5): in-process replicas share it, so a
+        NaN/stall trip fails the whole in-process tier over at once —
+        per-replica watchdog isolation arrives with out-of-process
+        backends."""
+        r = self.sched.readiness()
+        wd = r.get("watchdog") or {}
+        tripped = bool(wd.get("tripped"))
+        closed = bool(r.get("closed"))
+        draining = bool(r.get("draining"))
+        dead_loop = bool(r.get("wedged_loop"))
+        return {
+            "failed": tripped or (closed and not draining) or dead_loop,
+            "tripped": tripped,
+            "closed": closed,
+            "draining": draining,
+            "ready": bool(r.get("ready")),
+        }
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        return self.sched.metrics_snapshot()
+
+    @property
+    def metrics(self):
+        return self.sched.metrics
+
+    # ---- shape facts the router pins placement on --------------------
+    @property
+    def slots(self) -> int:
+        return self.sched.slots
+
+    @property
+    def max_new_cap(self) -> int:
+        return self.sched.max_new_cap
+
+    @property
+    def page_size(self) -> Optional[int]:
+        spec = self.sched.kv_spec
+        return None if spec is None else spec.page_size
+
+    @property
+    def tokenizer(self):
+        return self.sched.tokenizer
+
+    def bucket_of(self, prompt_len: int) -> int:
+        from tpuflow.packaging.lm import _bucket_len
+
+        return _bucket_len(int(prompt_len))
+
+    def pages_needed(self, prompt_len: int, max_new: int) -> Optional[int]:
+        from tpuflow.serve.pages import pages_needed
+
+        spec = self.sched.kv_spec
+        if spec is None:
+            return None
+        return pages_needed(int(prompt_len), int(max_new), spec.page_size)
+
+    def retry_after_s(self) -> float:
+        return self.sched.retry_after_s()
+
+    # ---- lifecycle ---------------------------------------------------
+    def start(self) -> None:
+        self.sched.start()
+
+    def drain(self) -> None:
+        self.sched.drain()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        self.sched.stop(drain=drain, timeout=timeout)
+
+    def prepare(self, *buckets: int) -> None:
+        self.sched.prepare(*buckets)
+
+    # ---- offline drive (tests / virtual-clock bench) -----------------
+    def step(self) -> bool:
+        return self.sched.step()
+
+    def idle(self) -> bool:
+        return self.sched.idle()

@@ -54,6 +54,14 @@ class QueueFull(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class SchedulerClosed(RuntimeError):
+    """Submit rejected: the scheduler (or router) is stopped or
+    draining. The graceful-drain contract (ISSUE 8): everything already
+    admitted finishes, NEW work must go elsewhere — the HTTP frontend
+    maps this to 503 so a load balancer watching ``/readyz`` fails the
+    instance over instead of retrying into it."""
+
+
 _req_counter = itertools.count()
 
 

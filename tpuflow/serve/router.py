@@ -1,0 +1,954 @@
+"""Multi-replica serving tier: the load-aware front router (ISSUE 8).
+
+Everything below this module is ONE scheduler on one process; the
+router is the layer that opens horizontal scale (ROADMAP item 3): it
+owns N replicas (:class:`~tpuflow.serve.replica.Replica` — in-process
+``ServeScheduler`` backends today, HTTP backends later) behind the one
+submit/stream/cancel surface the HTTP frontend already speaks, and
+turns the observability planes into CONTROL inputs:
+
+- **placement** is least-loaded over each replica's
+  ``load_snapshot()`` (queue depth + running rows; free KV pages and
+  windowed TTFT p95 ride along for dashboards and external LBs) —
+  never a Prometheus text parse;
+- **prefix affinity**: the prompt's page-size token chunks are hashed
+  exactly the way ``serve/pages.py::PrefixCache`` chunks them
+  (:func:`tpuflow.serve.pages.chunk_keys`), and the deepest chain the
+  router has seen before pulls the request to the replica that already
+  holds those KV pages — shared-system-prompt traffic sticks where its
+  prefill is already cached, with a load-slack valve so a hot prefix
+  cannot starve the tier down to one replica;
+- **backpressure / shedding**: per-replica ``QueueFull`` is retried on
+  the next-best replica; when EVERY eligible replica rejects (or all
+  KV allocators are dry with backlogs, or the optional tier-wide queue
+  bound is hit) the router raises its own ``QueueFull`` carrying the
+  MIN across-replica Retry-After — the soonest any capacity frees;
+- **failover**: a replica that trips the watchdog or closes without
+  draining gets its still-QUEUED (never-admitted) requests resubmitted
+  elsewhere; the router pins every request's sampling ``stream_id``
+  from ONE tier-global per-bucket counter, so outputs — including
+  resubmitted ones — are TOKEN-IDENTICAL to the same trace served by a
+  single scheduler;
+- **graceful drain**: :meth:`Router.drain` stops admissions (503),
+  drains every replica (each finishes its admitted backlog — zero
+  truncated streams), flips ``/readyz`` and annotates the flight
+  recorder's manifest; wired to SIGTERM by ``python -m tpuflow.serve``
+  through train/preempt.py's signal channel and to HTTP via
+  ``POST /v1/admin/drain``.
+
+The router is PURE HOST POLICY: it never touches device arrays — all
+device work stays on the replica schedulers' threads (a grep guard in
+tests/test_serve_router.py pins this boundary the way PR 7's jit-site
+guard pins the compile registry).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tpuflow.serve.pages import chunk_keys
+from tpuflow.serve.request import (
+    QueueFull,
+    Request,
+    RequestState,
+    SchedulerClosed,
+)
+
+
+class RouterMetrics:
+    """Router-tier event log (bounded, same contract as
+    :class:`~tpuflow.serve.metrics.ServeMetrics`'s): per-request-id
+    placement/shed/failover events, merged with each replica's own
+    events on read so ``GET /v1/events/<id>`` tells one story."""
+
+    def __init__(self, max_event_requests: int = 512,
+                 max_events_per_request: int = 128):
+        self._lock = threading.Lock()
+        self._events: "OrderedDict[str, List[Dict[str, Any]]]" = OrderedDict()
+        self._max_requests = max_event_requests
+        self._max_per_request = max_events_per_request
+        # read-side merge hooks (the replicas' metrics.events fns)
+        self.merge_sources: List[Callable[[str], List[Dict[str, Any]]]] = []
+
+    def event(self, request_id: str, name: str, **detail: Any) -> None:
+        rec = {"ts": time.time(), "event": name}
+        if detail:
+            rec.update(detail)
+        with self._lock:
+            log = self._events.get(request_id)
+            if log is None:
+                self._events[request_id] = log = []
+                while len(self._events) > self._max_requests:
+                    self._events.popitem(last=False)
+            log.append(rec)
+            if len(log) > self._max_per_request:
+                del log[: len(log) - self._max_per_request]
+
+    def events(self, request_id: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._events.get(request_id, []))
+        for src in self.merge_sources:
+            try:
+                out.extend(src(request_id))
+            except Exception:
+                pass
+        out.sort(key=lambda r: r.get("ts", 0.0))
+        return out
+
+
+class RouterRequest:
+    """One tier-level request: a stable client handle whose UNDERLYING
+    replica request may be swapped by failover. The client surface
+    (``wait``/``result``/``summary``/``tokens``/``state``) always
+    describes the CURRENT inner request; stream callbacks from a
+    superseded inner are dropped, and a replica-shutdown cancellation
+    of a never-admitted request is held back from the client until the
+    router has had the chance to resubmit it elsewhere."""
+
+    def __init__(self, router: "Router", request_id: str,
+                 prompt_ids: np.ndarray, max_new_tokens: int,
+                 stream_id: int, bucket: int,
+                 deadline_ts: Optional[float],
+                 stream_cb: Optional[Callable]):
+        self.id = request_id
+        self.prompt_ids = prompt_ids
+        self.max_new_tokens = int(max_new_tokens)
+        self.stream_id = int(stream_id)
+        self.bucket = int(bucket)
+        self.deadline_ts = deadline_ts
+        self.stream_cb = stream_cb
+        self.client_cancelled = False
+        self.resubmits = 0
+        self.ts_arrival: Optional[float] = None
+        self._router = router
+        self._lock = threading.Lock()
+        self._gen = 0
+        self._inner: Optional[Request] = None
+        self._replica_idx: int = -1
+        self._done = threading.Event()
+        self._orphaned = False  # terminal held back pending failover
+        self._error: Optional[str] = None
+
+    # ---- wiring (router-owned) --------------------------------------
+    def _make_cb(self) -> Callable:
+        """A stream callback bound to the NEXT generation: events from
+        any earlier (superseded) inner request are dropped, and the
+        replica-shutdown terminal of a failover-eligible request is
+        suppressed until :meth:`Router.maintain` decides its fate."""
+        with self._lock:
+            self._gen += 1
+            gen = self._gen
+
+        def cb(inner: Request, new: List[int], finished: bool) -> None:
+            with self._lock:
+                if gen != self._gen:
+                    return  # stale generation: failover superseded it
+                if finished and self._failover_candidate(inner):
+                    self._orphaned = True
+                    return
+            if self.stream_cb is not None and (new or finished):
+                self.stream_cb(self, list(new), finished)
+            if finished:
+                self._done.set()
+                self._router._on_request_done(self)
+
+        return cb
+
+    def _failover_candidate(self, inner: Request) -> bool:
+        """A terminal that should NOT reach the client (yet): the
+        replica cancelled a request the CLIENT never cancelled, before
+        it was ever admitted and before any token existed — replica
+        shutdown, not a request outcome. Token-identity holds across a
+        resubmit because nothing was produced."""
+        return (inner.state is RequestState.CANCELLED
+                and not self.client_cancelled
+                and inner.ts_admitted is None
+                and not inner.tokens
+                and self._router._accepting_failover())
+
+    def _bind(self, replica_idx: int, inner: Request) -> None:
+        with self._lock:
+            self._inner = inner
+            self._replica_idx = replica_idx
+            self._orphaned = False
+
+    def _failover_pending(self) -> bool:
+        with self._lock:
+            inner = self._inner
+            if self._done.is_set() or self.client_cancelled:
+                return False
+            return self._orphaned or (
+                inner is not None
+                and inner.state is RequestState.QUEUED)
+
+    def _finalize_failed(self, error: str) -> None:
+        """No replica left to serve this request: surface the terminal
+        the suppression held back."""
+        with self._lock:
+            if self._done.is_set():
+                return
+            self._error = error
+        if self.stream_cb is not None:
+            try:
+                self.stream_cb(self, [], True)
+            except Exception:
+                pass
+        self._done.set()
+        self._router._on_request_done(self)
+
+    # ---- client surface ---------------------------------------------
+    @property
+    def inner(self) -> Request:
+        with self._lock:
+            return self._inner
+
+    @property
+    def replica(self) -> int:
+        with self._lock:
+            return self._replica_idx
+
+    @property
+    def state(self) -> RequestState:
+        return self.inner.state
+
+    @property
+    def tokens(self) -> List[int]:
+        return self.inner.tokens
+
+    @property
+    def error(self) -> Optional[str]:
+        return self._error or self.inner.error
+
+    def timing(self) -> Dict[str, Optional[float]]:
+        return self.inner.timing()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} still {self.state.value} after "
+                f"{timeout}s"
+            )
+        return self.summary()
+
+    def summary(self) -> Dict[str, Any]:
+        out = self.inner.summary()
+        out["id"] = self.id
+        if self._error:
+            out["error"] = out["error"] or self._error
+        if self.resubmits:
+            out["resubmits"] = self.resubmits
+        return out
+
+
+class Router:
+    """Front tier over N replicas — one submit/stream/cancel surface
+    with load-aware placement, prefix affinity, shedding, failover and
+    graceful drain (module docstring has the policy tour). Duck-types
+    the scheduler surface :mod:`tpuflow.serve.http` drives, so
+    ``start_http_server(router)`` serves the whole tier.
+
+    Drive it online (:meth:`start`: replica loops + a maintenance
+    thread that polls health and fails replicas over) or offline
+    (:meth:`run_until_idle` steps replicas + maintenance on the
+    calling thread — deterministic tests and the virtual-clock
+    bench)."""
+
+    def __init__(
+        self,
+        replicas: Sequence,
+        *,
+        tokenizer=None,
+        affinity: bool = True,
+        affinity_slack: int = 4,
+        affinity_capacity: int = 65536,
+        placement: str = "load",
+        max_total_queue: Optional[int] = None,
+        shed_on_dry_kv: bool = True,
+        clock: Callable[[], float] = time.time,
+        name: str = "router",
+    ):
+        """``placement='load'`` is the real policy (least-loaded with
+        prefix affinity when ``affinity``); ``'spray'`` hashes the
+        whole prompt to a replica — the locality-blind control the
+        bench A/Bs against. ``affinity_slack`` is the load valve: an
+        affinity candidate more than this many requests busier than
+        the least-loaded replica is passed over (cache locality is
+        worth a short wait, not a hot spot). ``max_total_queue``
+        (default: the sum of replica ``max_queue``) sheds at the tier
+        level before every replica must be tried; ``shed_on_dry_kv``
+        429s immediately when every eligible replica's page allocator
+        cannot cover the request AND already has a backlog — the
+        all-allocators-dry backpressure contract, with Retry-After =
+        the min across replicas (the soonest ANY of them frees
+        enough)."""
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        if placement not in ("load", "spray"):
+            raise ValueError(
+                f"placement must be 'load' or 'spray', got {placement!r}"
+            )
+        self.replicas = list(replicas)
+        self.clock = clock
+        # flight-provider/gauge identity: a process running SEVERAL
+        # router tiers (multi-model serving) must name them apart or
+        # the last tier's post-mortem section evicts the first's —
+        # the ServeMetrics gauge_prefix rule, one layer up
+        self.name = str(name)
+        self.metrics = RouterMetrics()
+        self.metrics.merge_sources = [
+            rep.metrics.events for rep in self.replicas
+            if getattr(rep, "metrics", None) is not None
+        ]
+        self._placement = placement
+        self.slots = int(getattr(self.replicas[0], "slots", 1))
+        self.max_new_cap = int(
+            getattr(self.replicas[0], "max_new_cap", 64))
+        self.tokenizer = tokenizer
+        if tokenizer is None:
+            self.tokenizer = getattr(self.replicas[0], "tokenizer", None)
+        ps = getattr(self.replicas[0], "page_size", None)
+        self.affinity_ps: Optional[int] = (
+            int(ps) if (affinity and ps) else None)
+        self.affinity_slack = int(affinity_slack)
+        self._affinity: "OrderedDict[bytes, int]" = OrderedDict()
+        self._affinity_cap = int(affinity_capacity)
+        if max_total_queue is None:
+            mq = [self._safe_snapshot(i).get("max_queue")
+                  for i in range(len(self.replicas))]
+            mq = [int(m) for m in mq if m]
+            max_total_queue = sum(mq) if mq else None
+        self.max_total_queue = max_total_queue
+        self.shed_on_dry_kv = bool(shed_on_dry_kv)
+        self._lock = threading.Lock()
+        # serializes [read stream counter → place → commit counter]:
+        # concurrent submits must get DISTINCT, submission-ordered
+        # stream ids (two racers sharing one id would sample from the
+        # same stream and desync the single-scheduler parity sequence
+        # forever). Never taken from replica callbacks → no inversion
+        # against _lock / RouterRequest._lock.
+        self._place_lock = threading.Lock()
+        self._inflight: Dict[str, RouterRequest] = {}
+        self._admit_counts: Dict[int, int] = {}  # tier-global stream ids
+        self._failed: Dict[int, str] = {}
+        self._seq = 0
+        self._draining = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        # counters (mirrored onto the obs registry as router.*)
+        self.counts: Dict[str, int] = {
+            "placed": 0, "affinity_hits": 0, "affinity_spills": 0,
+            "shed": 0, "shed_kv": 0, "rejected": 0, "failovers": 0,
+            "replicas_failed": 0, "drains": 0,
+        }
+        self.placements: Dict[str, int] = {
+            rep.name: 0 for rep in self.replicas}
+        # post-mortem: the flight recorder snapshots the tier state
+        # (weakly bound, like the scheduler's request provider)
+        import weakref
+
+        from tpuflow.obs import flight as _flight
+
+        ref = weakref.ref(self)
+
+        def _provider():
+            r = ref()
+            return r.flight_snapshot() if r is not None else None
+
+        _flight.add_provider(self.name, _provider)
+
+    # ---- small helpers ----------------------------------------------
+    def _safe_snapshot(self, idx: int) -> Dict[str, Any]:
+        try:
+            return self.replicas[idx].load_snapshot()
+        except Exception:
+            return {"queue_depth": 0, "running": 0, "closed": True}
+
+    def _count(self, key: str, by: int = 1) -> None:
+        from tpuflow.obs.gauges import inc_counter
+
+        with self._lock:
+            self.counts[key] = self.counts.get(key, 0) + by
+        inc_counter(f"router.{key}_total", by)
+
+    def _live_indices(self) -> List[int]:
+        with self._lock:
+            failed = set(self._failed)
+        return [i for i in range(len(self.replicas)) if i not in failed]
+
+    def _accepting_failover(self) -> bool:
+        with self._lock:
+            return not (self._closed or self._draining)
+
+    def _encode(self, prompt) -> np.ndarray:
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError(
+                    "string prompts need a tokenizer; submit token ids "
+                    "or construct the router with one"
+                )
+            return np.asarray(self.tokenizer.encode(prompt), np.int32)
+        return np.asarray(prompt, np.int32).reshape(-1)
+
+    # ---- admission (any thread) -------------------------------------
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: Optional[int] = None,
+        *,
+        deadline_s: Optional[float] = None,
+        stream_cb: Optional[Callable] = None,
+        request_id: Optional[str] = None,
+    ) -> RouterRequest:
+        """Place one request on the best replica (module docstring has
+        the policy). Raises the scheduler taxonomy: ``QueueFull``
+        (tier saturated / all allocators dry — Retry-After is the min
+        across replicas), :class:`SchedulerClosed` (draining/stopped),
+        ``ValueError`` (never servable)."""
+        ids = self._encode(prompt)
+        if max_new_tokens is None:
+            max_new_tokens = self.max_new_cap
+        with self._lock:
+            if self._closed or self._draining:
+                raise SchedulerClosed(
+                    "router is stopped"
+                    + (" (draining)" if self._draining else "")
+                )
+        live = self._live_indices()
+        if not live:
+            raise SchedulerClosed("router has no live replicas")
+        snaps = {i: self._safe_snapshot(i) for i in live}
+        eligible = [i for i in live if not snaps[i].get("closed")]
+        if not eligible:
+            raise SchedulerClosed("every replica is draining or closed")
+        depth = sum(int(snaps[i].get("queue_depth", 0)) for i in eligible)
+
+        def _min_retry() -> float:
+            vals = []
+            for i in eligible:
+                try:
+                    vals.append(float(self.replicas[i].retry_after_s()))
+                except Exception:
+                    pass
+            return min(vals) if vals else 1.0
+
+        if (self.max_total_queue is not None
+                and depth >= self.max_total_queue):
+            retry = _min_retry()
+            self._count("shed")
+            self.metrics.event("-shed-", "shed", kind="queue",
+                              depth=depth, retry_after_s=retry)
+            raise QueueFull(depth, retry)
+        if self.shed_on_dry_kv:
+            dry = []
+            for i in eligible:
+                free = snaps[i].get("kv_pages_free")
+                if free is None:
+                    dry = []
+                    break  # not a paged tier: pages never the gate
+                need = self.replicas[i].pages_needed(
+                    int(ids.size), int(max_new_tokens))
+                dry.append(free < (need or 0)
+                           and int(snaps[i].get("queue_depth", 0)) > 0)
+            if dry and all(dry):
+                retry = _min_retry()
+                self._count("shed")
+                self._count("shed_kv")
+                self.metrics.event("-shed-", "shed", kind="kv",
+                                  depth=depth, retry_after_s=retry)
+                raise QueueFull(depth, retry)
+
+        # ---- ordering: least-loaded, affinity-first, or spray -------
+        scores = {i: int(snaps[i].get("queue_depth", 0))
+                  + int(snaps[i].get("running", 0)) for i in eligible}
+        order = sorted(eligible, key=lambda i: (scores[i], i))
+        affinity_used = False
+        keys: List[bytes] = []
+        if self._placement == "spray":
+            import zlib
+
+            j = zlib.crc32(ids.tobytes()) % len(order)
+            order = sorted(eligible)[j:] + sorted(eligible)[:j]
+        elif self.affinity_ps is not None and ids.size > 1:
+            keys = chunk_keys(ids[: ids.size - 1], self.affinity_ps)
+            with self._lock:
+                tgt = None
+                for j in range(len(keys) - 1, -1, -1):
+                    tgt = self._affinity.get(keys[j])
+                    if tgt is not None:
+                        break
+            if tgt is not None and tgt in eligible:
+                if scores[tgt] <= scores[order[0]] + self.affinity_slack:
+                    order.remove(tgt)
+                    order.insert(0, tgt)
+                    affinity_used = True
+                else:
+                    self._count("affinity_spills")
+
+        # ---- place ---------------------------------------------------
+        bucket = self.replicas[order[0]].bucket_of(int(ids.size))
+        with self._lock:
+            self._seq += 1
+            rid = request_id or f"rt-{self._seq}"
+        last_qf: Optional[QueueFull] = None
+        saw_closed = False
+        placed: Optional[int] = None
+        # counter-read → place → counter-commit is ONE critical
+        # section (_place_lock): the tier-global per-bucket stream
+        # pinning hands this submission EXACTLY the id a single
+        # scheduler with the same slot count would — concurrent
+        # submits must serialize here or two racers share an id (same
+        # sampling stream) and every later id desyncs from the parity
+        # sequence. The counter advances only on successful placement,
+        # like the single scheduler's.
+        with self._place_lock:
+            with self._lock:
+                n = self._admit_counts.get(bucket, 0)
+            stream_id = n % self.slots
+            rr = RouterRequest(
+                self, rid, ids, int(max_new_tokens), stream_id, bucket,
+                None if deadline_s is None else self.clock() + deadline_s,
+                stream_cb,
+            )
+            for idx in order:
+                rep = self.replicas[idx]
+                cb = rr._make_cb()
+                try:
+                    inner = rep.submit(
+                        ids, int(max_new_tokens), deadline_s=deadline_s,
+                        stream_cb=cb, request_id=rid,
+                        stream_id=stream_id,
+                    )
+                except QueueFull as e:
+                    last_qf = e
+                    continue
+                except SchedulerClosed:
+                    saw_closed = True
+                    continue
+                rr._bind(idx, inner)
+                with self._lock:
+                    self._admit_counts[bucket] = n + 1
+                    self._inflight[rid] = rr
+                    self.placements[rep.name] = (
+                        self.placements.get(rep.name, 0) + 1)
+                    if keys:
+                        for k in keys:
+                            self._affinity[k] = idx
+                            self._affinity.move_to_end(k)
+                        while len(self._affinity) > self._affinity_cap:
+                            self._affinity.popitem(last=False)
+                placed = idx
+                break
+        if placed is not None:
+            self._count("placed")
+            if affinity_used and placed == order[0]:
+                self._count("affinity_hits")
+            self.metrics.event(rid, "placed",
+                              replica=self.replicas[placed].name,
+                              stream_id=stream_id, bucket=bucket,
+                              affinity=bool(affinity_used
+                                            and placed == order[0]),
+                              depth=scores.get(placed, 0))
+            return rr
+        # every eligible replica said no. If every refusal was a
+        # drain/stop that landed after the eligibility snapshot, this
+        # is the drain contract's 503 (go elsewhere), NOT a 429
+        # (retry here) — a 429 would tell the LB to retry into a
+        # draining tier.
+        if last_qf is None and saw_closed:
+            raise SchedulerClosed("every replica is draining or closed")
+        retry = _min_retry()
+        if last_qf is not None:
+            retry = min(retry, last_qf.retry_after_s)
+        self._count("rejected")
+        self.metrics.event("-rejected-", "reject", depth=depth,
+                          retry_after_s=retry)
+        raise QueueFull(depth, retry)
+
+    def cancel(self, request) -> bool:
+        """Cancel by :class:`RouterRequest` or id (any replica)."""
+        rr = request
+        if not isinstance(rr, RouterRequest):
+            with self._lock:
+                rr = self._inflight.get(str(request))
+        if rr is None:
+            return False
+        with rr._lock:
+            rr.client_cancelled = True
+            inner, idx = rr._inner, rr._replica_idx
+        if inner is None or idx < 0:
+            return False
+        try:
+            return self.replicas[idx].cancel(inner)
+        except Exception:
+            return False
+
+    def retry_after_s(self) -> float:
+        vals = []
+        for i in self._live_indices():
+            try:
+                vals.append(float(self.replicas[i].retry_after_s()))
+            except Exception:
+                pass
+        return min(vals) if vals else 1.0
+
+    def _on_request_done(self, rr: RouterRequest) -> None:
+        with self._lock:
+            self._inflight.pop(rr.id, None)
+
+    # ---- failover (maintenance) -------------------------------------
+    def mark_failed(self, replica: "int | str", reason: str = "") -> None:
+        """Exclude a replica from placement and make its queued
+        requests failover-eligible (also the operator's manual lever —
+        the watchdog path calls it from :meth:`maintain`)."""
+        idx = replica
+        if not isinstance(idx, int):
+            idx = next(i for i, r in enumerate(self.replicas)
+                       if r.name == replica)
+        with self._lock:
+            if idx in self._failed:
+                return
+            self._failed[idx] = reason or "marked failed"
+        self._count("replicas_failed")
+        self.metrics.event("-failover-", "replica_failed",
+                          replica=self.replicas[idx].name, reason=reason)
+
+    def maintain(self) -> bool:
+        """One health/failover sweep: poll every live replica's
+        :meth:`health`, fail the tripped/closed ones, resubmit their
+        never-admitted requests elsewhere. Returns whether anything
+        changed. The online maintenance thread calls this on a poll
+        interval; offline drivers interleave it with replica steps."""
+        progress = False
+        for idx in self._live_indices():
+            try:
+                h = self.replicas[idx].health()
+            except Exception as e:
+                h = {"failed": True, "error": repr(e)}
+            if h.get("failed"):
+                self.mark_failed(idx, reason=str(
+                    h.get("error")
+                    or ("tripped" if h.get("tripped")
+                        else "closed" if h.get("closed")
+                        else "wedged-loop")))
+                progress = True
+        with self._lock:
+            failed = dict(self._failed)
+            pending = [rr for rr in self._inflight.values()
+                       if rr._replica_idx in failed]
+        for rr in pending:
+            if rr._failover_pending():
+                progress |= self._failover(rr)
+        # ADMITTED work on a DEAD replica (closed / wedged loop — not
+        # merely watchdog-tripped, whose loop keeps decoding and will
+        # finish its rows) can neither complete nor be replayed
+        # token-identically (tokens were already streamed): fail it to
+        # the client now instead of hanging result() until the
+        # client's own timeout and pinning idle()/drain() open forever
+        for rr in pending:
+            # re-read the CURRENT home: the failover loop above may
+            # have just rebound this request to a healthy replica (and
+            # its scheduler may already have admitted it) — acting on
+            # the stale pre-failover index would cancel a perfectly
+            # good resubmission
+            if rr._replica_idx not in failed:
+                continue
+            why = failed.get(rr._replica_idx, "")
+            if "tripped" in why or rr._done.is_set():
+                continue
+            if rr._failover_pending():
+                continue  # queued: the next sweep retries placement
+            inner = rr.inner
+            if inner is not None and inner.ts_admitted is not None:
+                try:
+                    self.replicas[rr._replica_idx].cancel(inner)
+                except Exception:
+                    pass
+                rr._finalize_failed(
+                    "replica failed with this request mid-decode")
+                progress = True
+        from tpuflow.obs.gauges import set_gauge
+
+        set_gauge("router.replicas", float(len(self.replicas)))
+        set_gauge("router.replicas_failed", float(len(failed)))
+        return progress
+
+    def _failover(self, rr: RouterRequest) -> bool:
+        """Resubmit one never-admitted request off its failed replica.
+        Token-identity: the pinned ``stream_id`` travels with it, and
+        nothing had been produced (the candidate test guarantees it)."""
+        with rr._lock:
+            old_idx, old_inner = rr._replica_idx, rr._inner
+        candidates = [i for i in self._live_indices() if i != old_idx]
+        snaps = {i: self._safe_snapshot(i) for i in candidates}
+        order = sorted(
+            (i for i in candidates if not snaps[i].get("closed")),
+            key=lambda i: (int(snaps[i].get("queue_depth", 0))
+                           + int(snaps[i].get("running", 0)), i),
+        )
+        if not order:
+            if not self._accepting_failover() or not candidates:
+                rr._finalize_failed(
+                    "replica failed and no replica left to resubmit to")
+            return False
+        now = self.clock()
+        deadline_s = (None if rr.deadline_ts is None
+                      else max(0.0, rr.deadline_ts - now))
+        for idx in order:
+            rep = self.replicas[idx]
+            cb = rr._make_cb()  # invalidates the old generation FIRST
+            try:
+                inner = rep.submit(
+                    rr.prompt_ids, rr.max_new_tokens,
+                    deadline_s=deadline_s, stream_cb=cb,
+                    request_id=rr.id, stream_id=rr.stream_id,
+                )
+            except (QueueFull, SchedulerClosed):
+                continue
+            if rr.ts_arrival is not None:
+                inner.ts_arrival = rr.ts_arrival
+            rr._bind(idx, inner)
+            rr.resubmits += 1
+            with self._lock:
+                self.placements[rep.name] = (
+                    self.placements.get(rep.name, 0) + 1)
+            self._count("failovers")
+            self.metrics.event(rr.id, "failover",
+                              from_replica=self.replicas[old_idx].name,
+                              to_replica=rep.name,
+                              stream_id=rr.stream_id)
+            if old_inner is not None:
+                try:  # best-effort: the old home may be long dead
+                    self.replicas[old_idx].cancel(old_inner)
+                except Exception:
+                    pass
+            return True
+        return False  # nowhere to go right now; retried next sweep
+
+    # ---- drain / lifecycle ------------------------------------------
+    def drain(self, wait_s: Optional[float] = None) -> None:
+        """Tier-wide graceful drain: 503 new submits, drain every
+        replica (each finishes its admitted backlog), flip ``/readyz``,
+        annotate the flight manifest. Non-blocking unless ``wait_s``."""
+        with self._lock:
+            first = not self._draining
+            self._draining = True
+        if first:
+            from tpuflow.obs import flight as _flight
+            from tpuflow.obs.gauges import set_gauge
+
+            self._count("drains")
+            set_gauge("router.draining", 1.0)
+            depth = sum(int(self._safe_snapshot(i).get("queue_depth", 0))
+                        for i in self._live_indices())
+            self.metrics.event("-router-", "drain", queue_depth=depth)
+            _flight.annotate("router.drain", {
+                "ts": self.clock(),
+                "queue_depth": depth,
+                "inflight": len(self._inflight),
+                "replicas": [self.replicas[i].name
+                             for i in self._live_indices()],
+            })
+            for i in self._live_indices():
+                try:
+                    self.replicas[i].drain()
+                except Exception:
+                    pass
+        if wait_s is not None:
+            deadline = time.time() + wait_s
+            while not self.idle() and time.time() < deadline:
+                time.sleep(0.01)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drained(self) -> bool:
+        return self._draining and self.idle()
+
+    def idle(self) -> bool:
+        with self._lock:
+            if self._inflight:
+                return False
+        return all(self.replicas[i].idle() for i in self._live_indices())
+
+    def start(self, poll_s: float = 0.25) -> None:
+        """Online drive: start every replica's loop plus the router's
+        maintenance thread (health polling → failover)."""
+        for i in self._live_indices():
+            self.replicas[i].start()
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_evt.clear()
+
+        def loop():
+            while not self._stop_evt.is_set():
+                try:
+                    self.maintain()
+                except Exception:
+                    pass
+                self._stop_evt.wait(poll_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="tpuflow-router", daemon=True)
+        self._thread.start()
+
+    def run_until_idle(self) -> None:
+        """Offline drive: step every live replica and the maintenance
+        sweep on the calling thread until nothing makes progress (the
+        single-scheduler ``run_until_idle`` contract, tier-wide)."""
+        while True:
+            progress = False
+            for i in self._live_indices():
+                rep = self.replicas[i]
+                if not rep.idle():
+                    progress |= bool(rep.step())
+            progress |= self.maintain()
+            if not progress:
+                return
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        deadline = time.time() + timeout
+        if drain:
+            self.drain(wait_s=timeout)
+        with self._lock:
+            self._closed = True
+            self._draining = True
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(0.1, deadline - time.time()))
+        for i in range(len(self.replicas)):
+            try:
+                self.replicas[i].stop(
+                    drain=drain,
+                    timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                pass
+        with self._lock:
+            leftovers = list(self._inflight.values())
+        for rr in leftovers:
+            rr._finalize_failed("router stopped")
+
+    # ---- introspection ----------------------------------------------
+    def readiness(self) -> Dict[str, Any]:
+        """Tier ``/readyz``: ready while the router is open and at
+        least one live replica is ready; per-replica detail rides in
+        the body so the probe's reason is in the probe."""
+        per: Dict[str, Any] = {}
+        ready_n = 0
+        depth = 0
+        with self._lock:
+            failed = dict(self._failed)
+            draining, closed = self._draining, self._closed
+        for i, rep in enumerate(self.replicas):
+            try:
+                r = rep.readiness()
+            except Exception as e:
+                r = {"ready": False, "error": repr(e)}
+            snap = self._safe_snapshot(i)
+            depth += int(snap.get("queue_depth", 0))
+            ok = bool(r.get("ready")) and i not in failed
+            ready_n += ok
+            per[rep.name] = {
+                "ready": ok,
+                "failed": failed.get(i),
+                "queue_depth": snap.get("queue_depth"),
+                "running": snap.get("running"),
+                "draining": snap.get("draining"),
+            }
+        return {
+            "ready": bool(ready_n) and not (draining or closed),
+            "closed": closed,
+            "draining": draining,
+            "replicas_ready": ready_n,
+            "queue_depth": depth,
+            "running": sum(int(p.get("running") or 0)
+                           for p in per.values()),
+            "replicas": per,
+        }
+
+    def snapshot(self) -> Dict[str, float]:
+        """Router-tier gauges/counters as a flat dotted dict."""
+        with self._lock:
+            out = {f"router.{k}": float(v) for k, v in self.counts.items()}
+            out["router.inflight"] = float(len(self._inflight))
+            out["router.replicas"] = float(len(self.replicas))
+            out["router.replicas_live"] = float(
+                len(self.replicas) - len(self._failed))
+            out["router.affinity_table"] = float(len(self._affinity))
+            for name, n in self.placements.items():
+                out[f"router.placements.{name}"] = float(n)
+        return out
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """The tier's ``/v1/metrics`` body: every replica's snapshot
+        (their per-replica gauge prefixes keep them apart) plus the
+        router's own counters and the aggregate queue depth."""
+        snap: Dict[str, Any] = {}
+        for i in range(len(self.replicas)):
+            try:
+                snap.update(self.replicas[i].metrics_snapshot())
+            except Exception:
+                pass
+        snap.update(self.snapshot())
+        snap["router.queue_depth"] = float(sum(
+            int(self._safe_snapshot(i).get("queue_depth", 0))
+            for i in self._live_indices()))
+        return snap
+
+    def load_snapshot(self) -> Dict[str, Any]:
+        """Tier-aggregate load sensor (an LB in front of SEVERAL
+        routers composes the same way replicas compose under one)."""
+        per = {i: self._safe_snapshot(i) for i in self._live_indices()}
+        with self._lock:
+            closed, draining = self._closed, self._draining
+        out: Dict[str, Any] = {
+            "queue_depth": sum(int(s.get("queue_depth", 0))
+                               for s in per.values()),
+            "running": sum(int(s.get("running", 0))
+                           for s in per.values()),
+            "closed": closed,
+            "draining": draining,
+            "replicas": {self.replicas[i].name: s
+                         for i, s in per.items()},
+        }
+        frees = [s.get("kv_pages_free") for s in per.values()]
+        if frees and all(f is not None for f in frees):
+            out["kv_pages_free"] = int(sum(frees))
+        return out
+
+    def flight_snapshot(self) -> Dict[str, Any]:
+        """The flight recorder's ``router.json`` section."""
+        with self._lock:
+            inflight = [
+                {"id": rr.id, "replica": rr._replica_idx,
+                 "state": (rr._inner.state.value
+                           if rr._inner is not None else "?"),
+                 "resubmits": rr.resubmits,
+                 "orphaned": rr._orphaned}
+                for rr in self._inflight.values()
+            ]
+            failed = {self.replicas[i].name: why
+                      for i, why in self._failed.items()}
+            counts = dict(self.counts)
+            draining, closed = self._draining, self._closed
+        return {
+            "draining": draining,
+            "closed": closed,
+            "failed": failed,
+            "counts": counts,
+            "placements": dict(self.placements),
+            "replicas": {
+                self.replicas[i].name: self._safe_snapshot(i)
+                for i in range(len(self.replicas))
+            },
+            "inflight": inflight,
+        }

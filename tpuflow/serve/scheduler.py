@@ -48,7 +48,12 @@ from tpuflow.obs import trace
 from tpuflow.obs import health as _health
 from tpuflow.serve.metrics import ServeMetrics
 from tpuflow.serve.pages import PagedKV, PagedKVSpec, pages_needed
-from tpuflow.serve.request import QueueFull, Request, RequestState
+from tpuflow.serve.request import (
+    QueueFull,
+    Request,
+    RequestState,
+    SchedulerClosed,
+)
 from tpuflow.serve.slots import PagedSlotPool, SlotPool
 
 
@@ -85,6 +90,7 @@ class ServeScheduler:
         kv_page_size: int = 16,
         kv_quant: Optional[str] = None,
         kv_prefix_cache: bool = True,
+        kv_prefix_insert_generated: bool = False,
     ):
         """``kv='paged'`` switches the KV memory model (ISSUE 6): one
         process-wide store of ``kv_pages`` fixed-size pages
@@ -141,9 +147,18 @@ class ServeScheduler:
             self.kv_spec: Optional[PagedKVSpec] = PagedKVSpec(
                 pages=int(kv_pages), page_size=ps, quant=kv_quant)
             self.kv_prefix_cache = bool(kv_prefix_cache)
+            # ISSUE 8 satellite (the PR 6 known-limits follow-on):
+            # also publish a finished request's GENERATED pages into
+            # the prefix tree, so a multi-turn follow-up whose prompt
+            # is prompt+completion(+user turn) hits past the original
+            # prompt. Off by default: it retains completion pages in
+            # the tree until LRU pressure evicts them.
+            self.kv_insert_generated = bool(
+                kv_prefix_insert_generated) and self.kv_prefix_cache
         else:
             self.kv_spec = None
             self.kv_prefix_cache = False
+            self.kv_insert_generated = False
         self.kv_state: Optional[PagedKV] = None  # built with first pool
         self.pools: Dict[int, SlotPool] = {}
         self._queues: Dict[int, Deque[Request]] = {}
@@ -153,6 +168,7 @@ class ServeScheduler:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._closed = False
+        self._draining = False
         # readiness threshold: a decode segment (or idle loop pass)
         # older than this while work is pending marks the scheduler
         # NOT READY (see readiness()); generous default — a segment is
@@ -264,11 +280,22 @@ class ServeScheduler:
         deadline_s: Optional[float] = None,
         stream_cb: Optional[Callable[[Request, List[int], bool], None]] = None,
         request_id: Optional[str] = None,
+        stream_id: Optional[int] = None,
     ) -> Request:
         """Queue one request. Raises :class:`QueueFull` when the
-        admission queue is at capacity (backpressure), ``ValueError``
-        for requests that can never be served (prompt longer than the
-        largest bucket, budget beyond the pool horizon)."""
+        admission queue is at capacity (backpressure),
+        :class:`SchedulerClosed` once :meth:`drain`/:meth:`stop` ran
+        (→ HTTP 503), and ``ValueError`` for requests that can never
+        be served (prompt longer than the largest bucket, budget
+        beyond the pool horizon).
+
+        ``stream_id`` pins the request's sampling stream explicitly
+        (taken mod ``slots``) instead of drawing it from this
+        scheduler's per-bucket admission counter — the multi-replica
+        router's determinism hook: a tier that assigns stream ids from
+        ONE global per-bucket counter reproduces a single scheduler's
+        sampled outputs no matter which replica serves (or, after
+        failover, re-serves) the request."""
         from tpuflow.packaging.lm import _bucket_len
 
         ids = self._encode(prompt)
@@ -332,7 +359,10 @@ class ServeScheduler:
                 trace.end(req._span_queue)
                 trace.end(req._span_ttft)
                 trace.end(root, state="rejected", error="stopped")
-                raise RuntimeError("scheduler is stopped")
+                raise SchedulerClosed(
+                    "scheduler is stopped"
+                    + (" (draining)" if self._draining else "")
+                )
             depth = sum(len(q) for q in self._queues.values())
             if depth >= self.max_queue:
                 retry = max(self._retry_hint(depth), page_hint or 0.0)
@@ -341,12 +371,18 @@ class ServeScheduler:
                 trace.end(req._span_ttft)
                 trace.end(root, state="rejected", depth=depth)
                 raise QueueFull(depth, retry)
-            n = self._admit_counts.get(bucket, 0)
-            self._admit_counts[bucket] = n + 1
-            # the wave path's physical row index, reproduced: stream
-            # ids are what make slot outputs == wave outputs under
-            # sampling (see module docstring)
-            req.stream_id = n % self.slots
+            if stream_id is None:
+                n = self._admit_counts.get(bucket, 0)
+                self._admit_counts[bucket] = n + 1
+                # the wave path's physical row index, reproduced:
+                # stream ids are what make slot outputs == wave
+                # outputs under sampling (see module docstring)
+                req.stream_id = n % self.slots
+            else:
+                # router-pinned stream (see docstring): the local
+                # counter is NOT advanced — replica-local admissions
+                # and tier-pinned ones must not perturb each other
+                req.stream_id = int(stream_id) % self.slots
             self._queues.setdefault(bucket, deque()).append(req)
             self.metrics.on_queue_depth(depth + 1)
             self._work.notify_all()
@@ -602,6 +638,11 @@ class ServeScheduler:
                         self.metrics.on_first_token(req)
                         trace.end(getattr(req, "_span_ttft", None))
                     if finished:
+                        if self.kv_insert_generated:
+                            # publish the prompt+completion page chain
+                            # BEFORE evict releases this request's
+                            # references (the tree retains its own)
+                            pool.publish_generated(slot)
                         pool.evict(slot)
                         self._finalize(req, RequestState.DONE)
                     self._stream(req, new, finished)
@@ -662,6 +703,107 @@ class ServeScheduler:
         self._thread = threading.Thread(target=loop, name="tpuflow-serve",
                                         daemon=True)
         self._thread.start()
+
+    def drain(self, wait_s: Optional[float] = None) -> None:
+        """Graceful drain (ISSUE 8): stop admitting — :meth:`submit`
+        raises :class:`SchedulerClosed` (HTTP 503) — while everything
+        ALREADY submitted (queued and running) is served to completion
+        by the still-running loop; ``/readyz`` flips immediately so a
+        load balancer stops sending traffic. Non-blocking by default;
+        ``wait_s`` blocks up to that many seconds for :meth:`idle`.
+        The drain is recorded on the flight recorder's manifest notes
+        (a post-mortem bundle dumped during/after the drain says so).
+        Pair with :meth:`stop` once drained to tear the loop down;
+        offline callers drive the remaining work with
+        :meth:`run_until_idle` themselves."""
+        with self._lock:
+            first = not self._closed
+            self._closed = True
+            self._draining = True
+            depth = sum(len(q) for q in self._queues.values())
+            pools = list(self.pools.values())
+            self._work.notify_all()
+        if first:
+            from tpuflow.obs import flight as _flight
+            from tpuflow.obs.gauges import inc_counter, set_gauge
+
+            set_gauge(f"{self.metrics.prefix}.draining", 1.0)
+            inc_counter(f"{self.metrics.prefix}.drains_total")
+            self.metrics.event("-scheduler-", "drain", queue_depth=depth)
+            _flight.annotate(f"{self.metrics.prefix}.drain", {
+                "ts": self.clock(),
+                "queue_depth": depth,
+                "running": sum(p.live_count() for p in pools),
+            })
+        if wait_s is not None:
+            deadline = time.time() + wait_s
+            while not self.idle() and time.time() < deadline:
+                time.sleep(0.01)
+
+    @property
+    def draining(self) -> bool:
+        """True between :meth:`drain` and teardown — closed to new
+        work but still serving out the admitted backlog (a FAILED
+        replica is closed and NOT draining; the router's failover
+        telling them apart is the point of this property)."""
+        return self._draining
+
+    def drained(self) -> bool:
+        """True once a drain has both been requested and finished
+        serving everything it admitted — ``_draining``, not merely
+        closed: ``stop(drain=False)`` CANCELS outstanding work, and
+        the resulting idle closed scheduler must not read as a clean
+        zero-truncation drain."""
+        return self._draining and self.idle()
+
+    def load_snapshot(self) -> Dict[str, Any]:
+        """Lock-cheap load sensor (ISSUE 8): queue depth, running
+        rows, free/total KV pages and windowed TTFT / queue-wait p95 —
+        a plain dict, so the multi-replica router (or any external
+        load balancer) never parses Prometheus text to place a
+        request. Safe from any thread; one lock hop plus int reads.
+        Percentile keys are None until traffic exists; they quote the
+        metrics plane's WINDOWED view when the snapshot ring is
+        ticking and degrade to cumulative otherwise (PR 5
+        semantics)."""
+        from tpuflow.obs import timeseries
+
+        with self._lock:
+            depth = sum(len(q) for q in self._queues.values())
+            pools = list(self.pools.values())
+            closed, draining = self._closed, self._draining
+        out: Dict[str, Any] = {
+            "queue_depth": depth,
+            "running": sum(p.live_count() for p in pools),
+            "slots_per_bucket": self.slots,
+            "max_queue": self.max_queue,
+            "closed": closed,
+            "draining": draining,
+        }
+        if self.kv_state is not None:
+            a = self.kv_state.allocator
+            out["kv_pages_free"] = a.free_count()
+            out["kv_pages_total"] = a.total
+        elif self.kv_spec is not None:  # paged but no pool built yet
+            out["kv_pages_free"] = self.kv_spec.pages - 1
+            out["kv_pages_total"] = self.kv_spec.pages - 1
+        pfx = self.metrics.prefix
+        hists = (("ttft_ms", self.metrics.ttft_ms),
+                 ("queue_wait_ms", self.metrics.queue_wait_ms))
+        # cold sensor (no traffic yet): the percentile keys are None
+        # without paying the windowed-delta walk — this path runs once
+        # per replica per ROUTED REQUEST, so the empty case must be a
+        # couple of int reads
+        windowed = (timeseries.windowed_summaries(f"{pfx}.")
+                    if any(len(h) for _, h in hists) else {})
+        for key, hist in hists:
+            if not len(hist):
+                out[f"{key}_p95"] = None
+                continue
+            win = windowed.get(f"{pfx}.{key}")
+            pcts = (win["percentiles"] if win else {}) or hist.percentiles()
+            out[f"{key}_p95"] = pcts.get("p95")
+        return out
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop the loop. ``drain=True`` serves out queued+running work
@@ -755,6 +897,11 @@ class ServeScheduler:
         return {
             "ready": ready,
             "closed": closed,
+            "draining": self._draining,
+            # the loop THREAD died after launch (distinct from a slow
+            # step: a live thread inside a long compile/segment is
+            # stalled-not-dead) — the replica shim's failover input
+            "wedged_loop": wedged_loop,
             "watchdog": wd.state(),
             "queue_depth": depth,
             "running": running,

@@ -426,6 +426,34 @@ class PagedSlotPool:
         for slot, req, plan in admits:
             kv.insert_prompt(req.prompt_ids, plan)
 
+    def publish_generated(self, slot: int) -> int:
+        """At request FINISH (ISSUE 8 satellite — the PR 6 known-limit
+        follow-on): publish the prompt+completion page chain into the
+        prefix tree, so a multi-turn follow-up whose prompt extends
+        this request's transcript hits the cache past the original
+        prompt. Must run BEFORE :meth:`evict` (the tree retains its
+        own references; evict only drops this request's).
+
+        Only pages whose every KV position is KNOWN-written are
+        publishable: the final harvested token's KV may never have
+        been written (a budget-ended row's last token is produced but
+        not consumed), so the chain covers the first
+        ``len(prompt+tokens) - 1`` positions — conservative by at most
+        one token. Returns the number of new tree nodes."""
+        req = self.occupants[slot]
+        plan = self.plans[slot]
+        if (req is None or plan is None or self.kv.prefix is None
+                or not req.tokens):
+            return 0
+        full = np.concatenate(
+            [req.prompt_ids, np.asarray(req.tokens, np.int32)])
+        ps = self.kv.spec.page_size
+        n_full = (int(full.size) - 1) // ps
+        if n_full <= plan.n_full:
+            return 0  # nothing beyond the join-time prompt publish
+        return self.kv.prefix.insert(full[: n_full * ps],
+                                     plan.table[:n_full])
+
     def evict(self, slot: int) -> Optional[Request]:
         """Free a slot AND its pages immediately (cancellation /
         deadline expiry / harvest): shared pages just drop this
