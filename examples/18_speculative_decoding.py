@@ -1,0 +1,167 @@
+"""Speculative decoding: draft-proposed, blockwise-verified (ISSUE 9).
+
+BEYOND-REFERENCE capability over example 16's paged serving: a small
+DRAFT TransformerLM proposes ``k`` tokens per round and the target
+verifies all ``k+1`` positions in ONE blockwise pass through the paged
+engine, with an ORACLE-PARITY acceptance rule — the emitted tokens are
+bit-identical to plain decode no matter what the draft proposes, so
+speculation is purely a throughput knob (the decode-bound lever,
+ROADMAP item 2):
+
+1. a tiny ByteBPE target LM is overfit and packaged (as in 16/17);
+2. a draft is derived with :func:`tpuflow.models.draft_lm_config`
+   (inherits vocab/dtype/RoPE, shrinks depth to 1), grafts the
+   target's embedding + LM head via
+   :func:`~tpuflow.models.share_draft_embeddings` (shared device
+   buffers — the ledger bytes don't double), and is briefly trained on
+   the same corpus so its proposals track the target;
+3. the SAME prompts are served plain and speculative: tokens match
+   exactly while the scheduler's acceptance counters show how many
+   target passes the draft amortized;
+4. the honest caveat: a garbage (untrained) draft collapses the
+   acceptance rate toward zero and every round then pays draft +
+   verify overhead for ~1 token — speculation HURTS below break-even
+   (``bench.py --speculate`` records that regime beside the headline);
+5. per-request opt-out: ``submit(..., speculate=False)`` rows ride the
+   same continuous batch as speculative rows, tokens unchanged.
+
+Self-speculation (early-exit target layers as the draft — no second
+model) is the documented follow-on seam; see README.
+
+Run on CPU:
+
+  JAX_PLATFORMS=cpu python examples/18_speculative_decoding.py
+
+Server form (draft is a second packaged LM; see README):
+
+  python -m tpuflow.serve --model /path/to/target_pkg --kv paged \
+      --speculate-k 3 --draft-config /path/to/draft_pkg
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import flax.linen as nn
+
+    from tpuflow.data.text import ByteBPE
+    from tpuflow.models import (
+        build_transformer_lm,
+        draft_lm_config,
+        share_draft_embeddings,
+    )
+    from tpuflow.models.transformer import next_token_loss
+    from tpuflow.packaging.lm import save_packaged_lm
+    from tpuflow.serve import ServeScheduler
+
+    # 1) tiny target LM, overfit so continuations echo the corpus
+    corpus = "the cat sat on the mat. the dog sat on the log. " * 40
+    bpe = ByteBPE.train(corpus, vocab_size=300)
+    cfg = dict(vocab_size=bpe.vocab_size, dim=64, depth=2, heads=4,
+               mlp_ratio=2, dtype=jnp.float32)
+    lm = build_transformer_lm(**cfg)
+    toks = jnp.asarray(np.asarray(bpe.encode(corpus)[:256], np.int32)[None])
+    params = nn.unbox(lm.init({"params": jax.random.key(0)}, toks))["params"]
+
+    def overfit(model, params, steps, lr=3e-3):
+        tx = optax.adam(lr)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt):
+            loss, g = jax.value_and_grad(
+                lambda p: next_token_loss(
+                    model.apply({"params": p}, toks), toks)
+            )(params)
+            upd, opt = tx.update(g, opt, params)
+            return optax.apply_updates(params, upd), opt, loss
+
+        for _ in range(steps):
+            params, opt, loss = step(params, opt)
+        return params, float(loss)
+
+    params, loss = overfit(lm, params, 120)
+    print(f"target overfit loss: {loss:.3f}")
+    pkg = os.path.join(tempfile.mkdtemp(prefix="tpuflow_spec_"), "pkg")
+    save_packaged_lm(pkg, params, cfg, tokenizer=bpe)
+
+    # 2) the draft: derived config (depth 1, same dim so the embedding
+    # grafts), target's embedding + head shared (same device buffers),
+    # then briefly trained so its next-token guesses TRACK the target.
+    # Draft quality only moves the acceptance rate — never the tokens.
+    dcfg = draft_lm_config(cfg, dim=cfg["dim"], depth=1)
+    draft = build_transformer_lm(**dcfg)
+    dparams = nn.unbox(
+        draft.init({"params": jax.random.key(1)}, toks))["params"]
+    dparams = share_draft_embeddings(dparams, params)
+    dparams, dloss = overfit(draft, dparams, 80)
+    print(f"draft  ({dcfg['depth']} layer) loss: {dloss:.3f} "
+          f"(embedding + head shared with the target)")
+
+    # 3) plain vs speculative on the SAME prompts: tokens identical,
+    # counters show the amortization
+    prompts = ["the cat", "the dog sat", "the mat.", "a log",
+               "the dog", "the cat sat on"]
+    K = 3  # verify width K+1 = 4 rides the pow2 join-width menu
+
+    def serve(speculate_k=0, draft_kw=None, submit_kw=None):
+        kw = dict(slots=2, seg=4, max_new_cap=12, max_queue=16,
+                  kv="paged", kv_page_size=4, kv_pages=65)
+        if speculate_k:
+            kw.update(speculate_k=speculate_k, **(draft_kw or {}))
+        sched = ServeScheduler.from_packaged(pkg, **kw)
+        reqs = [sched.submit(p, 10, **(submit_kw or {})) for p in prompts]
+        sched.run_until_idle()
+        assert all(r.state.value == "done" for r in reqs)
+        return sched, [list(r.tokens) for r in reqs]
+
+    _, plain = serve()
+    sched, spec = serve(K, dict(draft_model=draft, draft_params=dparams))
+    assert spec == plain, "oracle-parity acceptance: tokens MUST match"
+    m = sched.metrics
+    rate = m.spec_accepted / max(1, m.spec_drafted)
+    toks_total = sum(len(t) for t in spec)
+    print(f"speculative == plain: {toks_total} tokens identical")
+    print(f"trained draft: {m.spec_rounds} rounds, "
+          f"{m.spec_accepted}/{m.spec_drafted} drafts accepted "
+          f"({rate:.0%}) -> {toks_total / max(1, m.spec_rounds):.1f} "
+          f"tokens per target pass (plain decode: 1.0)")
+    snap = sched.spec_snapshot()
+    print("flight-recorder spec section:", snap)
+    assert rate > 0.3, "trained draft should amortize some passes"
+
+    # 4) the break-even caveat: an UNTRAINED draft — same tokens, but
+    # acceptance collapses and every round pays for ~1 token
+    bad = nn.unbox(
+        draft.init({"params": jax.random.key(99)}, toks))["params"]
+    sched_bad, spec_bad = serve(K, dict(draft_model=draft,
+                                        draft_params=bad))
+    assert spec_bad == plain  # STILL identical — that's the parity rule
+    mb = sched_bad.metrics
+    bad_rate = mb.spec_accepted / max(1, mb.spec_drafted)
+    print(f"garbage draft: tokens STILL identical, acceptance "
+          f"{bad_rate:.0%} over {mb.spec_rounds} rounds — below "
+          f"break-even speculation only ADDS overhead (see bench.py "
+          f"--speculate's unfavorable record)")
+
+    # 5) per-request opt-out inside the speculating batch
+    sched_mix, mixed = serve(K, dict(draft_model=draft,
+                                     draft_params=dparams),
+                             submit_kw=dict(speculate=False))
+    assert mixed == plain
+    assert sched_mix.metrics.spec_drafted == 0  # every row opted out
+    print("submit(speculate=False): plain rows in the same batch, "
+          "same tokens")
+    print("speculative decoding example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,7 @@ def test_examples_import():
         "15_superstep_training",
         "16_online_serving",
         "17_router_serving",
+        "18_speculative_decoding",
     ]:
         assert hasattr(_load(name), "main" if name != "00_setup" else "setup")
 
@@ -213,6 +214,21 @@ def test_router_serving_example():
     assert "drain: new submits rejected" in r.stdout
     assert "zero truncated streams" in r.stdout
     assert "router serving example OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_speculative_decoding_example():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_EXAMPLES, "18_speculative_decoding.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "speculative == plain" in r.stdout
+    assert "tokens STILL identical" in r.stdout
+    assert "speculative decoding example OK" in r.stdout
 
 
 @pytest.mark.slow

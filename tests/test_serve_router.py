@@ -97,7 +97,7 @@ class FakeReplica:
         return -(-(plen + max_new - 1) // self.page_size)
 
     def submit(self, ids, max_new, *, deadline_s=None, stream_cb=None,
-               request_id=None, stream_id=None):
+               request_id=None, stream_id=None, speculate=True):
         if self.closed:
             raise SchedulerClosed("scheduler is stopped")
         if len(self.queue) >= self.max_queue:

@@ -1,0 +1,494 @@
+"""Speculative decoding (ISSUE 9): draft-proposed, blockwise-verified,
+ORACLE-PARITY acceptance.
+
+Tier discipline: everything tier-1 runs against ONE tiny shared model
+at ONE pool geometry (the test_serve_paged.py convention — compiled
+executables memoize on exactly those keys). The SELF-DRAFT (draft ==
+target model+params) doubles as the high-acceptance fixture: its
+depth-1 single-token passes compute the same logits as the k+1-wide
+verify on this backend, so acceptance is ~100% and the draft join
+executables are cache HITS of the target's. A fresh-random BAD draft
+exercises the opposite regime in one test.
+
+The load-bearing pins:
+
+- speculative outputs are TOKEN-IDENTICAL to the non-speculative
+  paged scheduler (itself pinned to the wave oracle transitively),
+  greedy AND sampled (seeded-identical under the oracle-parity
+  construction), including mid-flight joins and EOS early-stop —
+  REGARDLESS of draft quality (a garbage draft only lowers the
+  acceptance rate, never changes tokens);
+- the acceptance kernel's math (leading-match counts, budget clamp,
+  EOS truncation, per-row speculation opt-out) pinned directly;
+- rollback leaks nothing: after churn the allocator holds exactly the
+  prefix tree's pages (rejected positions are a write_pos rewind, not
+  an allocator event);
+- spec metrics: drafted/accepted/rounds counters, the windowed
+  accept-rate gauge, the flight provider, and the ledger's
+  draft_params/kv_draft components.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.models import build_transformer_lm
+
+KW = dict(vocab_size=128, dim=32, depth=1, heads=2, mlp_ratio=2,
+          dtype=jnp.float32)
+GEO = dict(slots=2, seg=4, max_new_cap=12)
+PS = 4  # kv page size
+K = 3   # draft tokens per round (verify width 4 — on the pow2 menu)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import flax.linen as nn
+
+    lm = build_transformer_lm(**KW)
+    params = nn.unbox(
+        lm.init({"params": jax.random.key(0)}, jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    return lm, params
+
+
+@pytest.fixture(scope="module")
+def bad_draft():
+    """An independently random draft: same architecture, useless
+    predictions — the acceptance-collapse regime."""
+    import flax.linen as nn
+
+    lm = build_transformer_lm(**KW)
+    params = nn.unbox(
+        lm.init({"params": jax.random.key(99)},
+                jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    return lm, params
+
+
+def _sched(tiny_lm, spec=True, draft=None, **kw):
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = tiny_lm
+    base = dict(GEO, kv="paged", kv_page_size=PS, kv_pages=49)
+    if spec:
+        dlm, dparams = draft if draft is not None else tiny_lm
+        base.update(speculate_k=K, draft_model=dlm, draft_params=dparams)
+    base.update(kw)
+    return ServeScheduler(lm, params, **base)
+
+
+def _run(sched, prompts, budget=8, interleave=True, **submit_kw):
+    reqs = []
+    for i, p in enumerate(prompts):
+        reqs.append(sched.submit(p, budget, **submit_kw))
+        if interleave and i % 2:
+            sched.step()  # later arrivals join mid-flight
+    sched.run_until_idle()
+    assert all(r.state.value == "done" for r in reqs)
+    return [list(r.tokens) for r in reqs]
+
+
+# ---------------------------------------------------------------------
+# acceptance parity: spec == plain paged (== wave, transitively),
+# greedy AND sampled, any draft quality, incl. mid-flight joins
+# ---------------------------------------------------------------------
+
+def test_spec_token_identity_greedy_and_sampled(tiny_lm, bad_draft):
+    """Speculative outputs equal the non-speculative paged scheduler's
+    (pinned to the wave oracle in test_serve_paged.py) token for
+    token, greedy AND sampled, with mid-flight joins — for a PERFECT
+    draft (self-draft, ~100% acceptance) and a GARBAGE draft (~0%):
+    draft quality is a throughput knob, never a correctness one."""
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 128, (n,)).astype(np.int32)
+               for n in (3, 6, 4, 7, 5)]
+    for kw in (dict(), dict(temperature=0.8, top_k=20, seed=7)):
+        plain = _run(_sched(tiny_lm, spec=False, **kw), prompts)
+        good = _run(_sched(tiny_lm, **kw), prompts)
+        assert good == plain, kw
+    # the garbage draft: one greedy pass (sampled adds nothing here —
+    # acceptance is already ~0) still token-identical
+    plain = _run(_sched(tiny_lm, spec=False), prompts[:3])
+    bad = _run(_sched(tiny_lm, draft=bad_draft), prompts[:3])
+    assert bad == plain
+    # self-draft accepts (nearly) everything; the bad draft (nearly)
+    # nothing — the machinery's two regimes in two numbers
+    s_good = _sched(tiny_lm)
+    _run(s_good, prompts)
+    m = s_good.metrics
+    assert m.spec_drafted > 0
+    assert m.spec_accepted / m.spec_drafted >= 0.9
+    s_bad = _sched(tiny_lm, draft=bad_draft)
+    _run(s_bad, prompts)
+    mb = s_bad.metrics
+    assert mb.spec_accepted / mb.spec_drafted <= 0.2
+
+
+def test_spec_eos_early_stop_matches_plain(tiny_lm):
+    """EOS through the speculative round: a row whose FIRST sampled
+    token is the EOS finishes with zero tokens (TTFT still stamped);
+    mid-round EOS truncates the round's emissions — identical to the
+    plain paged scheduler."""
+    from tpuflow.infer.generate import generate
+
+    lm, params = tiny_lm
+    ids = np.asarray([7, 3, 11], np.int32)
+    prompt = np.zeros((1, 8), np.int32)
+    prompt[0, 5:] = ids
+    first = int(np.asarray(generate(
+        lm, params, jnp.asarray(prompt), max_new_tokens=1,
+        temperature=0.0, pad_lens=np.asarray([5], np.int32)))[0, 8])
+    rng = np.random.default_rng(3)
+    other = rng.integers(1, 128, (5,)).astype(np.int32)
+    outs = {}
+    for spec in (True, False):
+        s = _sched(tiny_lm, spec=spec, eos_id=first)
+        a = s.submit(ids, 8)      # first sampled token IS the EOS
+        b = s.submit(other, 8)
+        s.run_until_idle()
+        assert a.state.value == "done" and a.tokens == []
+        assert a.ts_first_token is not None
+        outs[spec] = list(b.tokens)
+    assert outs[True] == outs[False]
+
+
+def test_spec_interleaves_nonspeculative_rows(tiny_lm):
+    """submit(speculate=False) pins a request to plain decode INSIDE
+    the speculating batch: both rows' tokens match the non-spec
+    scheduler, and only the speculative row contributes drafts."""
+    rng = np.random.default_rng(11)
+    pa = rng.integers(1, 128, (4,)).astype(np.int32)
+    pb = rng.integers(1, 128, (6,)).astype(np.int32)
+    s0 = _sched(tiny_lm, spec=False)
+    a0 = s0.submit(pa, 8)
+    b0 = s0.submit(pb, 8)
+    s0.run_until_idle()
+    s = _sched(tiny_lm)
+    a = s.submit(pa, 8, speculate=False)  # plain row
+    b = s.submit(pb, 8)                   # speculative row
+    s.step()  # both admitted into one pool before any round completes
+    s.run_until_idle()
+    assert a.tokens == a0.tokens and b.tokens == b0.tokens
+    m = s.metrics
+    # drafted counts K per round for the SPECULATIVE row only (the
+    # plain row advances 1 token/round inside the same dispatches);
+    # rounds where NO speculative row is live don't count as
+    # speculative rounds — b's 8 tokens at self-draft acceptance need
+    # at least ceil(8 / (K+1)) rounds, and a's plain tail adds none
+    assert m.spec_rounds >= (8 + K) // (K + 1)
+    assert m.spec_drafted == K * m.spec_rounds
+    assert m.spec_accepted <= m.spec_drafted
+
+
+# ---------------------------------------------------------------------
+# the acceptance kernel, pinned directly
+# ---------------------------------------------------------------------
+
+def test_spec_acceptance_kernel_units():
+    from tpuflow.infer.generate import _spec_accept
+
+    drafts = jnp.asarray([[5, 6, 7],    # all match
+                          [5, 9, 7],    # first matches, then diverges
+                          [1, 2, 3],    # nothing matches
+                          [5, 6, 7],    # spec_on False -> forced 0
+                          [5, 6, 7]])   # done row
+    xs = jnp.asarray([[5, 6, 7, 8],
+                      [5, 6, 7, 8],
+                      [5, 6, 7, 8],
+                      [5, 6, 7, 8],
+                      [5, 6, 7, 8]])
+    done = jnp.asarray([False, False, False, False, True])
+    spec_on = jnp.asarray([True, True, True, False, True])
+    pos0 = jnp.asarray([10, 10, 10, 10, 10])
+    last_tok = jnp.asarray([50, 50, 50, 50, 50])
+    n_acc, n_emit, new_done = _spec_accept(
+        drafts, xs, done, spec_on, pos0, last_tok, eos_id=None)
+    assert list(np.asarray(n_acc[:4])) == [3, 1, 0, 0]
+    # emissions = accepted + the correction/bonus oracle token
+    assert list(np.asarray(n_emit)) == [4, 2, 1, 1, 0]
+    assert list(np.asarray(new_done)) == [False] * 4 + [True]
+    # budget clamp: only 2 positions left -> at most 2 emitted, done
+    n_acc, n_emit, new_done = _spec_accept(
+        drafts, xs, done, spec_on, pos0,
+        jnp.asarray([12, 12, 12, 12, 12]), eos_id=None)
+    assert list(np.asarray(n_emit)) == [2, 2, 1, 1, 0]
+    assert list(np.asarray(new_done)) == [True, True, False, False, True]
+    # EOS truncation: oracle emits the EOS at index 1 -> 2 tokens
+    # (EOS included in the device buffer), row done
+    n_acc, n_emit, new_done = _spec_accept(
+        drafts, jnp.asarray([[5, 6, 7, 8]] * 5), done, spec_on, pos0,
+        last_tok, eos_id=6)
+    assert list(np.asarray(n_emit)) == [2, 2, 1, 1, 0]
+    assert list(np.asarray(new_done)) == [True, True, False, False, True]
+
+
+# ---------------------------------------------------------------------
+# rollback: refcounts balance after churn; draft store forks with COW
+# ---------------------------------------------------------------------
+
+def test_spec_rollback_refcount_leak_check_after_churn(tiny_lm):
+    """After 10 mixed speculative requests (shared prefixes included)
+    fully drain, the ONLY pages still held are the prefix tree's —
+    rejected draft positions are a write_pos rewind, never an
+    allocator event, so churn with rejections leaks nothing."""
+    sched = _sched(tiny_lm)
+    rng = np.random.default_rng(7)
+    shared = rng.integers(1, 128, (6,)).astype(np.int32)
+    reqs = []
+    for n in range(10):
+        if n % 3 == 0:
+            ids = np.concatenate(
+                [shared, rng.integers(1, 128, (2,)).astype(np.int32)])
+        else:
+            ids = rng.integers(1, 128,
+                               (int(rng.integers(2, 9)),)).astype(np.int32)
+        reqs.append(sched.submit(ids, int(rng.integers(2, 9))))
+    sched.run_until_idle()
+    assert all(r.state.value == "done" for r in reqs)
+    kvs = sched.kv_state
+    assert kvs.draft_cache is not None  # the draft store exists
+    assert kvs.allocator.in_use() == kvs.prefix.nodes
+    assert int(kvs.allocator.refs[1:].max(initial=0)) <= 1  # tree-only
+    kvs.prefix.clear()
+    assert kvs.allocator.in_use() == 0
+    assert kvs.allocator.free_count() == kvs.allocator.total
+    # accounting: a page costs BOTH stores' bytes when speculating
+    assert kvs.draft_page_bytes > 0
+    assert kvs.bytes_total() == kvs.allocator.total * (
+        kvs.page_bytes + kvs.draft_page_bytes)
+
+
+def test_spec_prefix_cache_hit_same_tokens(tiny_lm):
+    """A repeated prompt hits the prefix cache (skipping BOTH models'
+    prefill — the draft store shares the page tables) and still yields
+    identical tokens."""
+    sched = _sched(tiny_lm)
+    rng = np.random.default_rng(11)
+    ids = rng.integers(1, 128, (7,)).astype(np.int32)
+    a = sched.submit(ids, 4)
+    sched.run_until_idle()
+    b = sched.submit(ids, 4)
+    sched.run_until_idle()
+    assert a.tokens == b.tokens
+    assert sched.metrics.prefix_hits == 1
+    assert sched.metrics.prefill_tokens_saved >= PS
+
+
+# ---------------------------------------------------------------------
+# metrics plane + flight provider + ledger tags + config validation
+# ---------------------------------------------------------------------
+
+def test_spec_generated_publish_keeps_draft_acceptance(tiny_lm):
+    """kv_prefix_insert_generated + speculation: a published
+    transcript chain must carry BOTH stores' KV (shared page ids) — a
+    follow-up hitting a generated chain keeps tokens identical AND
+    self-draft acceptance high (garbage draft KV under the hit region
+    would silently collapse it); opt-out rows publish nothing beyond
+    their prompt pages (their generated draft KV was never written)."""
+    rng = np.random.default_rng(13)
+    ids = rng.integers(1, 128, (5,)).astype(np.int32)
+    plain = _sched(tiny_lm, spec=False, kv_prefix_insert_generated=True)
+    a0 = plain.submit(ids, 8)
+    plain.run_until_idle()
+    follow = np.concatenate([ids, np.asarray(a0.tokens, np.int32),
+                             rng.integers(1, 128, (2,)).astype(np.int32)])
+    b0 = plain.submit(follow, 8)
+    plain.run_until_idle()
+
+    s = _sched(tiny_lm, kv_prefix_insert_generated=True)
+    a = s.submit(ids, 8)
+    s.run_until_idle()
+    assert a.tokens == a0.tokens
+    drafted0, accepted0 = s.metrics.spec_drafted, s.metrics.spec_accepted
+    b = s.submit(follow, 8)
+    s.run_until_idle()
+    assert b.tokens == b0.tokens
+    assert s.metrics.prefix_hits >= 1  # the published chain was hit
+    d = s.metrics.spec_drafted - drafted0
+    acc = s.metrics.spec_accepted - accepted0
+    assert d > 0 and acc / d >= 0.9  # draft KV valid under the chain
+
+    # opt-out row: no generated pages published (vs the plain twin)
+    s2 = _sched(tiny_lm, kv_prefix_insert_generated=True)
+    o = s2.submit(ids, 8, speculate=False)
+    s2.run_until_idle()
+    p2 = _sched(tiny_lm, spec=False, kv_prefix_insert_generated=True)
+    op = p2.submit(ids, 8)
+    p2.run_until_idle()
+    assert o.tokens == op.tokens
+    assert s2.kv_state.prefix.nodes < p2.kv_state.prefix.nodes
+
+
+def test_spec_metrics_counters_gauge_and_flight_provider(tiny_lm):
+    from tpuflow.obs import flight
+    from tpuflow.obs.gauges import counters, snapshot_gauges
+
+    sched = _sched(tiny_lm)
+    rng = np.random.default_rng(2)
+    _run(sched, [rng.integers(1, 128, (5,)).astype(np.int32)],
+         interleave=False)
+    m = sched.metrics
+    assert m.spec_rounds >= 1 and m.spec_drafted >= K
+    cnt = counters("serve.")
+    assert cnt["serve.spec_rounds_total"] >= 1
+    assert cnt["serve.spec_drafted_total"] >= K
+    assert cnt["serve.spec_accepted_total"] >= 1  # self-draft accepts
+    g = snapshot_gauges("serve.")
+    assert g["serve.spec_accept_rate"] > 0.5
+    snap = sched.metrics_snapshot()
+    for key in ("serve.spec_rounds", "serve.spec_drafted",
+                "serve.spec_accepted", "serve.spec_accept_rate",
+                "serve.spec_accept_rate_cum"):
+        assert key in snap, key
+    # the flight provider: acceptance collapse must be in post-mortems
+    spec = sched.spec_snapshot()
+    assert spec["k"] == K and spec["rounds"] == m.spec_rounds
+    assert spec["accept_rate"] is not None
+    assert 0.0 <= spec["accept_rate_windowed"] <= 1.0
+    assert f"{m.prefix}_spec" in flight._PROVIDERS
+    assert flight._PROVIDERS[f"{m.prefix}_spec"]() == spec
+
+
+def test_spec_ledger_tags_draft_components(tiny_lm):
+    """The obs/memory ledger attributes the draft params and the draft
+    KV store under their own components (draft_params / kv_draft) —
+    the ISSUE 7 accounting discipline extended to speculation."""
+    from tpuflow.obs import memory as _mem
+
+    sched = _sched(tiny_lm)
+    rng = np.random.default_rng(3)
+    _run(sched, [rng.integers(1, 128, (5,)).astype(np.int32)],
+         interleave=False)
+    rep = _mem.reconcile()
+    assert rep["components"].get("draft_params", 0) > 0
+    assert rep["components"].get("kv_draft", 0) > 0
+
+
+def test_spec_config_validation_and_draft_helpers(tiny_lm, bad_draft):
+    from tpuflow.models import draft_lm_config, share_draft_embeddings
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = tiny_lm
+    dlm, dparams = bad_draft
+    # speculation needs the paged engine + a complete draft
+    with pytest.raises(ValueError, match="paged"):
+        ServeScheduler(lm, params, speculate_k=K, draft_model=dlm,
+                       draft_params=dparams, **GEO)
+    with pytest.raises(ValueError, match="draft_model"):
+        ServeScheduler(lm, params, kv="paged", speculate_k=K, **GEO)
+    with pytest.raises(ValueError, match="vocab"):
+        small = build_transformer_lm(**dict(KW, vocab_size=64))
+        ServeScheduler(lm, params, kv="paged", speculate_k=K,
+                       draft_model=small, draft_params=dparams, **GEO)
+    # draft_lm_config inherits the identity axes, shrinks the size axes
+    cfg = draft_lm_config(KW)
+    assert cfg["vocab_size"] == KW["vocab_size"]
+    assert cfg["depth"] == 1 and cfg["dim"] == 32  # floor at 32
+    assert cfg["dim"] % cfg["heads"] == 0
+    assert (cfg["dim"] // cfg["heads"]) % 2 == 0
+    # derived default dim is forced even (rotary needs even head_dim
+    # at any heads count) and an explicit odd dim is rejected outright
+    assert draft_lm_config(dict(KW, dim=132))["dim"] % 2 == 0
+    with pytest.raises(ValueError, match="even"):
+        draft_lm_config(KW, dim=33)
+    built = build_transformer_lm(**cfg)  # the config actually builds
+    assert built.vocab_size == KW["vocab_size"]
+    # shared embeddings: same-dim graft shares the target's arrays
+    shared = share_draft_embeddings(dparams, params)
+    assert shared["embed"] is params["embed"]
+    assert shared["lm_head"]["kernel"] is params["lm_head"]["kernel"]
+    wide = draft_lm_config(KW, dim=64)
+    import flax.linen as nn
+
+    wparams = nn.unbox(build_transformer_lm(**wide).init(
+        {"params": jax.random.key(1)},
+        jnp.zeros((1, 8), jnp.int32)))["params"]
+    with pytest.raises(ValueError, match="matching"):
+        share_draft_embeddings(wparams, params)
+
+
+# ---------------------------------------------------------------------
+# full-stack + tier parity (slow)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_spec_router_parity_incl_failover(tiny_lm):
+    """ISSUE 9 router satellite: a 2-replica tier with speculation ON
+    is token-identical to a single NON-speculative scheduler — greedy
+    AND sampled, including requests a failed replica handed back
+    through failover (stream ids pin the oracle keys; speculation
+    never touches them)."""
+    from tpuflow.serve import InProcessReplica, Router, ServeScheduler
+    from tpuflow.serve.metrics import ServeMetrics
+
+    lm, params = tiny_lm
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 128, (int(rng.integers(2, 9)),))
+               .astype(np.int32) for _ in range(8)]
+    budgets = [int(rng.integers(2, 9)) for _ in range(8)]
+    for sampling in (dict(), dict(temperature=0.8, top_k=20, seed=7)):
+        def mk(i):
+            return ServeScheduler(
+                lm, params, kv="paged", kv_page_size=PS, kv_pages=49,
+                speculate_k=K, draft_model=lm, draft_params=params,
+                metrics=ServeMetrics(gauge_prefix=f"serve.replica{i}"),
+                **dict(GEO, max_new_cap=8), **sampling)
+
+        router = Router([InProcessReplica(mk(0), "r0"),
+                         InProcessReplica(mk(1), "r1")])
+        rrs = [router.submit(p, b) for p, b in zip(prompts, budgets)]
+        moved = [rr for rr in rrs if rr.replica == 1]
+        assert moved  # placement really did spread
+        router.mark_failed(1, "test-induced")
+        router.maintain()
+        assert all(rr.replica == 0 for rr in rrs)
+        router.run_until_idle()
+        # control: ONE scheduler, NO speculation
+        solo = ServeScheduler(lm, params, **dict(GEO, max_new_cap=8),
+                              **sampling)
+        ctrl = [solo.submit(p, b) for p, b in zip(prompts, budgets)]
+        solo.run_until_idle()
+        for rr, c in zip(rrs, ctrl):
+            assert c.state.value == "done"
+            assert rr.result(1.0)["state"] == "done"
+            assert rr.tokens == c.tokens, sampling
+
+
+@pytest.mark.slow
+def test_spec_full_stack_wave_parity(tmp_path):
+    """serve_texts(speculate_k=K) == generate_text(scheduler='wave')
+    at the text surface — the acceptance criterion's parity chain,
+    end to end."""
+    import flax.linen as nn
+
+    from tpuflow.data.text import ByteBPE
+    from tpuflow.models import draft_lm_config
+    from tpuflow.packaging.lm import PackagedLM, save_packaged_lm
+    from tpuflow.serve.scheduler import serve_texts
+
+    corpus = "the cat sat on the mat. the dog sat on the log. " * 30
+    bpe = ByteBPE.train(corpus, vocab_size=300)
+    cfg = dict(vocab_size=bpe.vocab_size, dim=32, depth=1, heads=2,
+               mlp_ratio=2, dtype=jnp.float32)
+    lm = build_transformer_lm(**cfg)
+    params = lm.init({"params": jax.random.key(0)},
+                     jnp.zeros((1, 8), jnp.int32))["params"]
+    d = str(tmp_path / "pkg")
+    save_packaged_lm(d, nn.unbox(params), cfg, tokenizer=bpe)
+    m = PackagedLM(d)
+    dcfg = draft_lm_config(cfg, dim=32, depth=1)
+    draft = build_transformer_lm(**dcfg)
+    dparams = nn.unbox(draft.init(
+        {"params": jax.random.key(5)},
+        jnp.zeros((1, 8), jnp.int32)))["params"]
+    prompts = ["the cat", "a dog", "the mat.", "the dog sat on"]
+    for kw in (dict(seed=0), dict(temperature=0.8, top_k=20, seed=7)):
+        wave = m.generate_text(prompts, max_new_tokens=3, serve_slots=2,
+                               scheduler="wave", **kw)
+        spec = serve_texts(m, prompts, max_new_tokens=3, serve_slots=2,
+                           kv="paged", kv_page_size=4, kv_pages=49,
+                           speculate_k=2, draft_model=draft,
+                           draft_params=dparams, **kw)
+        assert spec == wave, kw

@@ -592,15 +592,17 @@ def _serve_decode_model(model, kv_spec=None):
     )
 
 
-def paged_kv_arrays(model, kv_spec):
+def paged_kv_arrays(model, kv_spec, component: str = "kv_pages"):
     """Fresh device page store for ``model``: the per-layer page pools
     ((pages, KVH, page_size, head_dim) keys/values, + (pages,
     page_size) scale vectors under ``quant='int8'``). Batch-size
     independent — ONE store is threaded through every pool's join and
-    segment executables."""
+    segment executables. ``component`` names the store in the device-
+    buffer ledger (``kv_pages`` for the target store, ``kv_draft`` for
+    a speculative-decoding draft store)."""
     dm = _serve_decode_model(model, kv_spec)
     store = _cache_zeros(dm, 1, 1)
-    _mem.tag("kv_pages", store)  # device-buffer ledger (ISSUE 7)
+    _mem.tag(component, store)  # device-buffer ledger (ISSUE 7)
     return store
 
 
@@ -750,6 +752,230 @@ def _compiled_paged_segment(dm, b: int, out_len: int, n_row_pages: int,
         return cache, out, done, toks
 
     return segment
+
+
+# --------------------------------------------------------------------
+# Speculative decoding (ISSUE 9): draft-proposed, blockwise-verified,
+# ORACLE-PARITY acceptance over the paged serve engine.
+#
+# One speculative ROUND replaces `k+1` sequential target decode steps:
+#
+# - the DRAFT step fn runs k single-token steps of a small TransformerLM
+#   (its KV lives in a second page store indexed by the SAME per-row
+#   page table, so pages are allocated/released/forked exactly once);
+# - the VERIFY fn is ONE blockwise target pass over the k+1 tokens
+#   [current input, d_1..d_k] — the same multi-token paged machinery
+#   the width-bucketed join prefill compiles, so the verify window is
+#   just another prefill width (k+1 rides the pow2 menu sizes);
+# - the ACCEPTANCE kernel computes, at every verified position, the
+#   exact token the stepwise oracle would emit — `_sample` with the
+#   row's logical step and admission-index `stream_id`, the SAME key
+#   derivation every other engine here uses — and accepts the draft's
+#   proposals only while they match. The emitted sequence is therefore
+#   the oracle's sequence BY CONSTRUCTION, greedy AND sampled (the
+#   draft proposes with the same per-(step, stream) keys, so a draft
+#   whose distribution tracks the target's reproduces the oracle's
+#   categorical draw through the shared Gumbel noise — that coupling
+#   is what acceptance rate measures); draft quality can only change
+#   THROUGHPUT, never tokens.
+#
+# Rollback is free: rejected draft tokens wrote target/draft KV at
+# positions above the row's new write_pos, which every attention read
+# masks (key_pos <= query pos) and the next round's verify REWRITES —
+# a per-row write_pos rewind, no page churn, no copies. Pages were
+# allocated for the row's full budget at admission, so rounds never
+# touch the allocator.
+
+
+def _spec_accept(drafts, xs, done, spec_on, pos0, last_tok,
+                 eos_id: Optional[int]):
+    """The acceptance kernel (pure jnp; unit-tested directly).
+
+    ``drafts`` (B, k): the draft's proposals; ``xs`` (B, k+1): the
+    oracle token at each verified position (``xs[:, i]`` is what the
+    stepwise oracle emits after the prefix ending at position
+    ``pos0 + i``). Returns ``(n_acc, n_emit, new_done)``:
+
+    - ``n_acc``: leading proposals equal to the oracle's tokens
+      (``spec_on`` False forces 0 — that row runs as a plain decode
+      step inside the same batch);
+    - ``n_emit``: tokens actually emitted this round — ``n_acc + 1``
+      (the correction/bonus token is always an oracle token), clamped
+      to the row's remaining budget and truncated at the first
+      generated EOS; ``done`` rows emit nothing;
+    - ``new_done``: rows that hit their budget or emitted the EOS.
+    """
+    k = drafts.shape[1]
+    w = k + 1
+    match = (drafts == xs[:, :k]) & spec_on[:, None]
+    n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    n_budget = jnp.clip(last_tok - pos0, 0, w)
+    n_emit = jnp.minimum(n_acc + 1, n_budget)
+    if eos_id is not None:
+        is_eos = xs == eos_id
+        first = jnp.argmax(is_eos, axis=1)
+        has = jnp.any(is_eos, axis=1)
+        n_emit = jnp.where(has, jnp.minimum(n_emit, first + 1), n_emit)
+    n_emit = jnp.where(done, 0, n_emit)
+    new_done = done | (~done & (pos0 + n_emit >= last_tok))
+    if eos_id is not None:
+        new_done = new_done | (has & (first < n_emit))
+    return n_acc, n_emit, new_done
+
+
+def spec_draft_fn(draft_model, kv_spec, slots: int, out_len: int,
+                  n_row_pages: int, k: int, temperature: float,
+                  top_k: Optional[int], top_p: Optional[float]):
+    """Compiled draft proposer: ``k`` single-token steps of the draft
+    model at each row's own position, through the draft page store.
+
+    Returns ``draft(params, dcache, out, done, pos0, kv_limit,
+    spec_on, stream_ids, rng, page_table) -> (dcache, drafts)`` with
+    ``drafts`` (slots, k) int32. Proposals use the SAME
+    ``_sample`` key derivation as the oracle (logical step +
+    ``stream_id``), so a draft that tracks the target reproduces the
+    oracle's draw through the shared noise. ``spec_on`` False masks a
+    row's draft KV writes (its proposals are discarded by the
+    acceptance kernel anyway)."""
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    ddm = _serve_decode_model(draft_model, kv_spec)
+    return _compiled_spec_draft(
+        ddm, int(slots), int(out_len), int(n_row_pages), int(k),
+        float(temperature),
+        None if top_k is None else int(top_k),
+        None if top_p is None else float(top_p),
+    )
+
+
+@_lru("spec_draft", maxsize=32)
+def _compiled_spec_draft(ddm, b: int, out_len: int, n_row_pages: int,
+                         k: int, temperature: float,
+                         top_k: Optional[int], top_p: Optional[float]):
+    @_rjit(key="infer.spec_draft")
+    def draft(params, dcache, out, done, pos0, kv_limit, spec_on,
+              stream_ids, rng, page_table):
+        posc = jnp.clip(pos0, 0, out_len - 1)
+        tok0 = jnp.take_along_axis(out, posc[:, None], axis=1)[:, 0]
+        # step 0 is a fixed 2-wide CATCH-UP window [prev, current]:
+        # after a fully-accepted round the bonus token advanced the
+        # row past the draft's written frontier (the draft generated
+        # that token but never consumed it), leaving exactly ONE
+        # position of draft KV unwritten. Rewriting an already-written
+        # slot is value-idempotent — KV at a position is a function of
+        # that position's token alone — so a constant-width window
+        # covers both cases with no per-row gap tracking. Row at
+        # position 0 (1-token prompt, full-hit join): the prev slot
+        # clamps and its write masks out.
+        poscm1 = jnp.clip(pos0 - 1, 0, out_len - 1)
+        tokm1 = jnp.take_along_axis(out, poscm1[:, None], axis=1)[:, 0]
+        live = ~done & spec_on
+        wm0 = jnp.stack(
+            [live & (pos0 - 1 >= 0) & (pos0 - 1 < kv_limit),
+             live & (pos0 < kv_limit)], axis=1)
+        lg, vars2 = ddm.apply(
+            {"params": params, "cache": dcache},
+            jnp.stack([tokm1, tok0], axis=1),
+            mutable=["cache"], page_table=page_table,
+            write_pos=pos0 - 1, write_mask=wm0,
+        )
+        dcache = vars2["cache"]
+        d1 = _sample(lg[:, -1], rng, temperature, top_k, top_p,
+                     step=pos0, row_ids=stream_ids)
+
+        def step(carry, i):
+            dcache, tok = carry
+            pos = pos0 + i
+            wm = (live & (pos < kv_limit))[:, None]
+            lg, vars2 = ddm.apply(
+                {"params": params, "cache": dcache}, tok[:, None],
+                mutable=["cache"], page_table=page_table,
+                write_pos=pos, write_mask=wm,
+            )
+            nxt = _sample(lg[:, -1], rng, temperature, top_k, top_p,
+                          step=pos, row_ids=stream_ids)
+            return (vars2["cache"], nxt), nxt
+
+        if k > 1:
+            (dcache, _), rest = lax.scan(step, (dcache, d1),
+                                         jnp.arange(1, k))
+            drafts = jnp.concatenate([d1[:, None], rest.T], axis=1)
+        else:
+            drafts = d1[:, None]
+        return dcache, drafts  # (B, k)
+
+    return draft
+
+
+def spec_verify_fn(model, kv_spec, slots: int, out_len: int,
+                   n_row_pages: int, k: int, temperature: float,
+                   top_k: Optional[int], top_p: Optional[float],
+                   eos_id: Optional[int]):
+    """Compiled blockwise verify + oracle-parity acceptance: ONE
+    target pass over the k+1 positions ``[input, d_1..d_k]``, then
+    :func:`_spec_accept`.
+
+    Returns ``verify(params, cache, out, drafts, done, pos0, kv_limit,
+    last_tok, spec_on, stream_ids, rng, page_table) -> (cache, out,
+    done, xs, n_emit, n_acc)`` where ``xs`` (slots, k+1) holds the
+    oracle tokens (``xs[r, :n_emit[r]]`` were emitted and written into
+    ``out`` at ``pos0[r]+1 ..``). Target KV for the verified window is
+    written through the page table exactly like a join prefill;
+    positions the acceptance rejects hold garbage ABOVE the row's new
+    write position — masked by every read and rewritten next round
+    (the free rollback)."""
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    dm = _serve_decode_model(model, kv_spec)
+    return _compiled_spec_verify(
+        dm, int(slots), int(out_len), int(n_row_pages), int(k),
+        float(temperature),
+        None if top_k is None else int(top_k),
+        None if top_p is None else float(top_p),
+        None if eos_id is None else int(eos_id),
+    )
+
+
+@_lru("spec_verify", maxsize=32)
+def _compiled_spec_verify(dm, b: int, out_len: int, n_row_pages: int,
+                          k: int, temperature: float,
+                          top_k: Optional[int], top_p: Optional[float],
+                          eos_id: Optional[int]):
+    w = k + 1
+
+    @_rjit(key="infer.spec_verify")
+    def verify(params, cache, out, drafts, done, pos0, kv_limit,
+               last_tok, spec_on, stream_ids, rng, page_table):
+        posc = jnp.clip(pos0, 0, out_len - 1)
+        tok0 = jnp.take_along_axis(out, posc[:, None], axis=1)[:, 0]
+        vtoks = jnp.concatenate([tok0[:, None], drafts], axis=1)
+        vpos = pos0[:, None] + jnp.arange(w, dtype=jnp.int32)
+        vwm = (~done)[:, None] & (vpos < kv_limit[:, None])
+        lg, vars2 = dm.apply(
+            {"params": params, "cache": cache}, vtoks,
+            mutable=["cache"], page_table=page_table,
+            write_pos=pos0, write_mask=vwm,
+        )
+        cache = vars2["cache"]
+        # the oracle token at every verified position: logits at
+        # pos0+i depend only on the prefix through pos0+i, which by
+        # induction is the oracle's prefix for all i <= n_acc — the
+        # key derivation is bit-for-bit the plain segment fn's
+        xs = jnp.stack(
+            [_sample(lg[:, i], rng, temperature, top_k, top_p,
+                     step=pos0 + i, row_ids=stream_ids)
+             for i in range(w)], axis=1)
+        n_acc, n_emit, new_done = _spec_accept(
+            drafts, xs, done, spec_on, pos0, last_tok, eos_id)
+        eidx = pos0[:, None] + 1 + jnp.arange(w, dtype=jnp.int32)
+        elive = jnp.arange(w)[None, :] < n_emit[:, None]
+        eidxc = jnp.clip(eidx, 0, out_len - 1)
+        cur = jnp.take_along_axis(out, eidxc, axis=1)
+        out = jnp.put_along_axis(out, eidxc, jnp.where(elive, xs, cur),
+                                 axis=1, inplace=False)
+        return cache, out, new_done, xs, n_emit, n_acc
+
+    return verify
 
 
 @_rjit(key="infer.paged_copy")

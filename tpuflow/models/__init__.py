@@ -15,5 +15,7 @@ from tpuflow.models.vit import ViTClassifier, build_vit  # noqa: F401
 from tpuflow.models.transformer import (  # noqa: F401
     TransformerLM,
     build_transformer_lm,
+    draft_lm_config,
     next_token_loss,
+    share_draft_embeddings,
 )

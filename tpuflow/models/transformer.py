@@ -29,7 +29,7 @@ op in it is shard-uniform (SP needs no per-position parameters).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import flax.linen as nn
 import jax
@@ -785,6 +785,85 @@ def build_transformer_lm(
         tie_embeddings=tie_embeddings, attn_bh_block=attn_bh_block,
         rope_scaling=rope_scaling, rope_scaling_kind=rope_scaling_kind,
     )
+
+
+def draft_lm_config(base_config: Dict[str, Any], *,
+                    dim: Optional[int] = None, depth: int = 1,
+                    heads: Optional[int] = None,
+                    mlp_ratio: Optional[int] = None,
+                    kv_heads: Optional[int] = None) -> Dict[str, Any]:
+    """Derive a DRAFT-model build config from a target's
+    :func:`build_transformer_lm` kwargs (speculative decoding,
+    ISSUE 9): the vocabulary, dtype, RoPE scaling and embedding-tying
+    are inherited (they must agree for the draft's token stream and
+    positions to mean the same thing), while the size knobs shrink —
+    default ``dim`` is a quarter of the target's (floored at 32) and
+    ``depth`` is 1. ``heads`` defaults to the largest power-of-two
+    divisor of the target's head count that keeps ``head_dim`` even.
+
+    Draft quality only moves the ACCEPTANCE RATE — the oracle-parity
+    acceptance rule makes outputs token-identical to the target's own
+    decode no matter what the draft proposes — so a draft config is a
+    throughput tuning knob, not a correctness surface."""
+    base = dict(base_config)
+    if dim is None:
+        # even: rotary halves head_dim, and heads=1 must stay legal
+        dim = max(32, (int(base.get("dim", 512)) // 4) & ~1)
+    dim = int(dim)
+    if dim % 2:
+        raise ValueError(
+            f"draft dim must be even (rotary splits head_dim in two; "
+            f"heads=1 would leave head_dim={dim}), got {dim}")
+    if heads is None:
+        h = int(base.get("heads", 8))
+        while h > 1 and (dim % h or (dim // h) % 2):
+            h //= 2
+        heads = max(1, h)
+    cfg: Dict[str, Any] = {
+        "vocab_size": base.get("vocab_size", 32000),
+        "dim": dim,
+        "depth": int(depth),
+        "heads": int(heads),
+        "mlp_ratio": int(mlp_ratio if mlp_ratio is not None
+                         else base.get("mlp_ratio", 4)),
+        "dtype": base.get("dtype", jnp.bfloat16),
+        "attn_impl": base.get("attn_impl", "auto"),
+        "rope_scaling": base.get("rope_scaling", 1.0),
+        "rope_scaling_kind": base.get("rope_scaling_kind", "linear"),
+        "tie_embeddings": base.get("tie_embeddings", False),
+    }
+    if kv_heads is not None:
+        cfg["kv_heads"] = int(kv_heads)
+    return cfg
+
+
+def share_draft_embeddings(draft_params, target_params):
+    """The shared-embedding option for draft models: graft the
+    TARGET's token-embedding table (and, when the shapes agree, its LM
+    head kernel) into a draft's param tree — the standard trick that
+    hands a fresh draft the target's token geometry for free. Returns
+    a NEW param dict sharing the target's arrays (no copies: the
+    device buffers are literally shared, so the ledger bytes don't
+    double). Requires ``draft dim == target dim`` — raises
+    ``ValueError`` otherwise (the embedding is (vocab, dim))."""
+    te = target_params["embed"]
+    de = draft_params["embed"]
+    if tuple(te.shape) != tuple(de.shape):
+        raise ValueError(
+            f"shared embeddings need matching (vocab, dim) tables: "
+            f"target {tuple(te.shape)} vs draft {tuple(de.shape)} — "
+            f"build the draft with the target's dim (draft_lm_config("
+            f"..., dim=target_dim)) or skip sharing"
+        )
+    out = dict(draft_params)
+    out["embed"] = te
+    th = target_params.get("lm_head")
+    dh = draft_params.get("lm_head")
+    if (isinstance(th, dict) and isinstance(dh, dict)
+            and "kernel" in th and "kernel" in dh
+            and tuple(th["kernel"].shape) == tuple(dh["kernel"].shape)):
+        out["lm_head"] = dict(dh, kernel=th["kernel"])
+    return out
 
 
 def perplexity(loss: float) -> float:

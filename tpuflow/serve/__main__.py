@@ -87,6 +87,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "hit past the original prompt; completion "
                         "pages then live in the tree until LRU "
                         "pressure evicts them")
+    p.add_argument("--speculate-k", type=int, default=0, metavar="K",
+                   help="draft-model speculative decoding (ISSUE 9): "
+                        "a small draft LM proposes K tokens per round "
+                        "and the target verifies all K+1 positions in "
+                        "one blockwise pass with oracle-parity "
+                        "acceptance — outputs are token-identical to "
+                        "plain decode, throughput scales with the "
+                        "draft's acceptance rate. Requires --kv paged "
+                        "and --draft-config. K+1 a power of two "
+                        "aligns the verify window with the join "
+                        "width menu (K=3 default choice)")
+    p.add_argument("--draft-config", default=None, metavar="PATH",
+                   help="--speculate-k: packaged LM directory (or "
+                        "runs:/ / models:/ URI) for the DRAFT model — "
+                        "must share the target's vocabulary; replicas "
+                        "share the loaded draft weights")
     p.add_argument("--no-affinity", action="store_true",
                    help="--replicas>1: disable prefix-affinity "
                         "placement (pure least-loaded)")
@@ -153,6 +169,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             kv_prefix_cache=not args.no_prefix_cache,
             kv_prefix_insert_generated=args.kv_prefix_insert_generated,
         )
+        if args.speculate_k:
+            # speculative decoding (ISSUE 9): load the draft package
+            # ONCE — with --replicas N every replica's scheduler
+            # shares the same draft device weights, and the router's
+            # tier-global stream-id pinning keeps tier outputs
+            # token-identical to a single scheduler with speculation
+            # on OR off (oracle-parity acceptance)
+            if not args.draft_config:
+                p.error("--speculate-k needs --draft-config "
+                        "(a packaged LM directory for the draft)")
+            if args.kv != "paged":
+                p.error("--speculate-k requires --kv paged")
+            draft = load_packaged_lm(args.draft_config)
+            kw.update(speculate_k=args.speculate_k,
+                      draft_model=draft.model,
+                      draft_params=draft.params)
         n_rep = max(1, int(args.replicas))
         if n_rep == 1:
             front = sched = ServeScheduler.from_packaged(args.model, **kw)

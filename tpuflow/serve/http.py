@@ -217,6 +217,11 @@ class _Handler(BaseHTTPRequestHandler):
             kwargs["deadline_s"] = float(body["deadline_s"])
         if body.get("id"):
             kwargs["request_id"] = str(body["id"])
+        if body.get("speculate") is not None:
+            # per-request opt-out of speculative decoding (ISSUE 9) —
+            # tokens are identical either way (oracle-parity
+            # acceptance); a no-op on non-speculating servers
+            kwargs["speculate"] = bool(body["speculate"])
         timeout = float(self.server.request_timeout_s
                         if body.get("timeout_s") is None
                         else body["timeout_s"])

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 from tpuflow.obs.gauges import (
@@ -116,6 +116,15 @@ class ServeMetrics:
         self.prefix_misses = 0
         self.prefill_tokens_saved = 0
         self.page_waits = 0
+        # speculative decoding (ISSUE 9): cumulative draft/accept
+        # counters plus a sliding window of recent rounds — the
+        # windowed accept-rate gauge is what a dashboard watches for
+        # ACCEPTANCE COLLAPSE (a drifting draft silently turning the
+        # speedup into pure overhead)
+        self.spec_rounds = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self._spec_window: "deque[tuple]" = deque(maxlen=128)
         self._events: "OrderedDict[str, List[Dict[str, Any]]]" = OrderedDict()
         self._max_event_requests = max_event_requests
 
@@ -232,6 +241,46 @@ class ServeMetrics:
         inc_counter(f"{self.prefix}.kv_page_waits_total")
         self.event("-pages-", "page_wait", bucket=bucket)
 
+    def on_spec_round(self, drafted: int, accepted: int) -> None:
+        """One speculative round's outcome: ``drafted`` proposals
+        (k per live speculative row), ``accepted`` of them matched the
+        oracle. Counters land in the registry (→ /v1/metrics +
+        Prometheus); the gauge is the WINDOWED accept rate over the
+        last rounds."""
+        with self._lock:
+            self.spec_rounds += 1
+            self.spec_drafted += int(drafted)
+            self.spec_accepted += int(accepted)
+            self._spec_window.append((int(drafted), int(accepted)))
+            rate = self._spec_rate_locked()
+        inc_counter(f"{self.prefix}.spec_rounds_total")
+        inc_counter(f"{self.prefix}.spec_drafted_total", int(drafted))
+        # unconditional: total acceptance collapse must export a
+        # flat-zero series, not a MISSING one (rate() over an absent
+        # counter is no-data — the exact dashboard this metric feeds)
+        inc_counter(f"{self.prefix}.spec_accepted_total", int(accepted))
+        set_gauge(f"{self.prefix}.spec_accept_rate", rate)
+
+    def _spec_rate_locked(self) -> float:
+        """Windowed accept rate over the recent-rounds deque. Caller
+        holds ``self._lock`` (non-reentrant — the one reason the three
+        consumers share this helper instead of a public method)."""
+        wd = sum(d for d, _ in self._spec_window)
+        wa = sum(a for _, a in self._spec_window)
+        return wa / wd if wd else 0.0
+
+    def spec_accept_rate_windowed(self) -> float:
+        with self._lock:
+            return self._spec_rate_locked()
+
+    def spec_totals(self):
+        """One consistent (rounds, drafted, accepted, windowed_rate)
+        read — snapshot consumers must not interleave with a
+        mid-``on_spec_round`` update (accepted > drafted reads)."""
+        with self._lock:
+            return (self.spec_rounds, self.spec_drafted,
+                    self.spec_accepted, self._spec_rate_locked())
+
     def on_kv(self, kv_state) -> None:
         """Publish the page store's occupancy gauges (fed once per
         scheduler boundary; Prometheus/v1/metrics/flight all read the
@@ -276,6 +325,19 @@ class ServeMetrics:
             )
             m[f"{self.prefix}.prefill_tokens_saved"] = float(
                 self.prefill_tokens_saved)
+            m[f"{self.prefix}.spec_rounds"] = float(self.spec_rounds)
+            m[f"{self.prefix}.spec_drafted"] = float(self.spec_drafted)
+            m[f"{self.prefix}.spec_accepted"] = float(self.spec_accepted)
+            # PR 5 key convention: the PRIMARY key is WINDOWED (it
+            # matches the registry gauge of the same name — one name,
+            # one semantics across /v1/metrics, Prometheus and flight
+            # bundles), all-time lives under `_cum`
+            m[f"{self.prefix}.spec_accept_rate"] = (
+                self._spec_rate_locked())
+            m[f"{self.prefix}.spec_accept_rate_cum"] = (
+                self.spec_accepted / self.spec_drafted
+                if self.spec_drafted else 0.0
+            )
             m[f"{self.prefix}.tokens_out"] = float(self.tokens_out)
             m[f"{self.prefix}.segments"] = float(self.segments)
             m[f"{self.prefix}.batch_efficiency"] = (

@@ -438,13 +438,26 @@ class PagedKV:
 
     def __init__(self, model, spec: PagedKVSpec, *,
                  prefix_cache: bool = True,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 draft_model=None):
         from tpuflow.infer.generate import paged_kv_arrays, paged_page_bytes
 
         self.model = model
         self.spec = spec
         self.cache = paged_kv_arrays(model, spec)  # device pytree
         self.page_bytes = paged_page_bytes(self.cache)
+        # speculative decoding (ISSUE 9): the draft model's KV lives in
+        # a SECOND page store indexed by the SAME page tables — one
+        # allocation covers both models' KV for a position, so plans,
+        # refcounts, COW forks and releases need no draft-side twin.
+        # Ledger component: kv_draft.
+        self.draft_model = draft_model
+        self.draft_cache = None
+        self.draft_page_bytes = 0
+        if draft_model is not None:
+            self.draft_cache = paged_kv_arrays(draft_model, spec,
+                                               component="kv_draft")
+            self.draft_page_bytes = paged_page_bytes(self.draft_cache)
         self.allocator = PageAllocator(spec.pages, clock=clock)
         self.prefix: Optional[PrefixCache] = (
             PrefixCache(spec.page_size, self.allocator, clock=clock)
@@ -519,6 +532,11 @@ class PagedKV:
             dst = [d for _, d in plan.forks]
             self.cache = paged_copy(self.cache, src, dst)
             _mem.tag("kv_pages", self.cache)  # COW replaced the store
+            if self.draft_cache is not None:
+                # the draft store forks the SAME page ids: the shared
+                # page table must stay valid for both models' KV
+                self.draft_cache = paged_copy(self.draft_cache, src, dst)
+                _mem.tag("kv_draft", self.draft_cache)
 
     def insert_prompt(self, prompt: np.ndarray, plan: PagePlan) -> int:
         """After the join prefill: publish the request's full prompt
@@ -538,10 +556,15 @@ class PagedKV:
 
     # ---- accounting -------------------------------------------------
     def bytes_in_use(self) -> int:
-        return self.allocator.in_use() * self.page_bytes
+        """Device bytes the allocated pages pin — the draft store's
+        share included when speculation is on (a page costs both
+        models' KV)."""
+        return self.allocator.in_use() * (self.page_bytes
+                                          + self.draft_page_bytes)
 
     def bytes_total(self) -> int:
-        return self.allocator.total * self.page_bytes
+        return self.allocator.total * (self.page_bytes
+                                       + self.draft_page_bytes)
 
     def snapshot(self) -> Dict[str, Any]:
         out = {"page_size": self.spec.page_size,
@@ -549,6 +572,8 @@ class PagedKV:
                "page_bytes": self.page_bytes,
                "kv_bytes_in_use": self.bytes_in_use(),
                "kv_bytes_total": self.bytes_total()}
+        if self.draft_cache is not None:
+            out["draft_page_bytes"] = self.draft_page_bytes
         out.update(self.allocator.stats())
         if self.prefix is not None:
             out["prefix"] = self.prefix.stats()

@@ -77,11 +77,12 @@ class InProcessReplica(Replica):
                deadline_s: Optional[float] = None,
                stream_cb: Optional[Callable] = None,
                request_id: Optional[str] = None,
-               stream_id: Optional[int] = None) -> Request:
+               stream_id: Optional[int] = None,
+               speculate: bool = True) -> Request:
         return self.sched.submit(
             prompt, max_new_tokens, deadline_s=deadline_s,
             stream_cb=stream_cb, request_id=request_id,
-            stream_id=stream_id,
+            stream_id=stream_id, speculate=speculate,
         )
 
     def cancel(self, request) -> bool:

@@ -85,6 +85,11 @@ class Request:
     state: RequestState = RequestState.QUEUED
     bucket: int = 0
     stream_id: int = 0  # per-request sampling stream (infer._sample row_ids)
+    # speculative decoding (ISSUE 9): False pins this request to plain
+    # one-token-per-round decode even on a speculating scheduler — it
+    # shares the batch with speculative rows (the acceptance kernel
+    # forces its accepted count to 0), tokens unchanged either way
+    speculate: bool = True
     slot: Optional[int] = None
     tokens: List[int] = field(default_factory=list)
     error: Optional[str] = None

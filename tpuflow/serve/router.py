@@ -123,6 +123,7 @@ class RouterRequest:
         self.deadline_ts = deadline_ts
         self.stream_cb = stream_cb
         self.client_cancelled = False
+        self.speculate = True  # per-request spec opt-out (ISSUE 9)
         self.resubmits = 0
         self.ts_arrival: Optional[float] = None
         self._router = router
@@ -407,12 +408,16 @@ class Router:
         deadline_s: Optional[float] = None,
         stream_cb: Optional[Callable] = None,
         request_id: Optional[str] = None,
+        speculate: bool = True,
     ) -> RouterRequest:
         """Place one request on the best replica (module docstring has
         the policy). Raises the scheduler taxonomy: ``QueueFull``
         (tier saturated / all allocators dry — Retry-After is the min
         across replicas), :class:`SchedulerClosed` (draining/stopped),
-        ``ValueError`` (never servable)."""
+        ``ValueError`` (never servable). ``speculate=False`` pins the
+        request to plain decode on speculating replicas (ISSUE 9) and
+        survives failover resubmission — tokens identical either
+        way."""
         ids = self._encode(prompt)
         if max_new_tokens is None:
             max_new_tokens = self.max_new_cap
@@ -518,6 +523,7 @@ class Router:
                 None if deadline_s is None else self.clock() + deadline_s,
                 stream_cb,
             )
+            rr.speculate = bool(speculate)
             for idx in order:
                 rep = self.replicas[idx]
                 cb = rr._make_cb()
@@ -525,7 +531,7 @@ class Router:
                     inner = rep.submit(
                         ids, int(max_new_tokens), deadline_s=deadline_s,
                         stream_cb=cb, request_id=rid,
-                        stream_id=stream_id,
+                        stream_id=stream_id, speculate=rr.speculate,
                     )
                 except QueueFull as e:
                     last_qf = e
@@ -710,6 +716,7 @@ class Router:
                     rr.prompt_ids, rr.max_new_tokens,
                     deadline_s=deadline_s, stream_cb=cb,
                     request_id=rr.id, stream_id=rr.stream_id,
+                    speculate=rr.speculate,
                 )
             except (QueueFull, SchedulerClosed):
                 continue

@@ -91,6 +91,9 @@ class ServeScheduler:
         kv_quant: Optional[str] = None,
         kv_prefix_cache: bool = True,
         kv_prefix_insert_generated: bool = False,
+        speculate_k: int = 0,
+        draft_model=None,
+        draft_params=None,
     ):
         """``kv='paged'`` switches the KV memory model (ISSUE 6): one
         process-wide store of ``kv_pages`` fixed-size pages
@@ -104,7 +107,20 @@ class ServeScheduler:
         quoted from the windowed page free-rate) instead of being
         bucket-pool rejected; cancel/expiry frees a request's pages
         the same boundary. ``kv_pages=None`` sizes the store for about
-        4×``slots`` concurrent worst-case requests."""
+        4×``slots`` concurrent worst-case requests.
+
+        ``speculate_k`` (ISSUE 9) turns on draft-model speculative
+        decoding: a small ``draft_model``/``draft_params``
+        TransformerLM (same vocabulary; see
+        :func:`tpuflow.models.draft_lm_config`) proposes ``k`` tokens
+        per round and the target verifies all k+1 positions in ONE
+        blockwise pass with ORACLE-PARITY acceptance — outputs are
+        token-identical to the non-speculative scheduler (greedy
+        bitwise; sampled seeded-identical), so speculation is purely a
+        throughput knob. Requires ``kv='paged'`` (rollback rides the
+        per-row write positions); draft KV shares the target's page
+        tables. Per-request opt-out: ``submit(..., speculate=False)``
+        rows run plain decode inside the same batch."""
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_queue < 1:
@@ -159,6 +175,33 @@ class ServeScheduler:
             self.kv_spec = None
             self.kv_prefix_cache = False
             self.kv_insert_generated = False
+        self.speculate_k = int(speculate_k)
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        if self.speculate_k:
+            if self.speculate_k < 1:
+                raise ValueError(
+                    f"speculate_k must be >= 1 (0 = off), got "
+                    f"{speculate_k}")
+            if kv != "paged":
+                raise ValueError(
+                    "speculate_k requires kv='paged' — speculative "
+                    "rollback rides the paged engine's per-row write "
+                    "positions")
+            if draft_model is None or draft_params is None:
+                raise ValueError(
+                    "speculate_k needs draft_model AND draft_params "
+                    "(a small TransformerLM over the same vocabulary; "
+                    "see tpuflow.models.draft_lm_config)")
+            dv = getattr(draft_model, "vocab_size", None)
+            tv = getattr(model, "vocab_size", None)
+            if dv is not None and tv is not None and int(dv) != int(tv):
+                raise ValueError(
+                    f"draft vocab_size {dv} != target vocab_size {tv} "
+                    f"— draft and target must share one tokenizer")
+            from tpuflow.obs import memory as _mem
+
+            _mem.tag("draft_params", draft_params)  # ledger (ISSUE 7)
         self.kv_state: Optional[PagedKV] = None  # built with first pool
         self.pools: Dict[int, SlotPool] = {}
         self._queues: Dict[int, Deque[Request]] = {}
@@ -198,6 +241,15 @@ class ServeScheduler:
 
             _flight.add_provider(f"{self.metrics.prefix}_kv",
                                  _kv_provider)
+        if self.speculate_k:
+            # post-mortems must show acceptance collapse: the bundle's
+            # <prefix>_spec.json carries cumulative + windowed rates
+            def _spec_provider():
+                s = ref()
+                return s.spec_snapshot() if s is not None else None
+
+            _flight.add_provider(f"{self.metrics.prefix}_spec",
+                                 _spec_provider)
 
     @classmethod
     def from_packaged(cls, lm, **kwargs) -> "ServeScheduler":
@@ -281,6 +333,7 @@ class ServeScheduler:
         stream_cb: Optional[Callable[[Request, List[int], bool], None]] = None,
         request_id: Optional[str] = None,
         stream_id: Optional[int] = None,
+        speculate: bool = True,
     ) -> Request:
         """Queue one request. Raises :class:`QueueFull` when the
         admission queue is at capacity (backpressure),
@@ -295,7 +348,13 @@ class ServeScheduler:
         router's determinism hook: a tier that assigns stream ids from
         ONE global per-bucket counter reproduces a single scheduler's
         sampled outputs no matter which replica serves (or, after
-        failover, re-serves) the request."""
+        failover, re-serves) the request.
+
+        ``speculate=False`` (speculating schedulers only) pins THIS
+        request to plain one-token-per-round decode while it shares
+        the continuous batch with speculative rows — tokens are
+        identical either way (oracle-parity acceptance); a no-op when
+        ``speculate_k`` is off."""
         from tpuflow.packaging.lm import _bucket_len
 
         ids = self._encode(prompt)
@@ -332,6 +391,7 @@ class ServeScheduler:
             id=request_id or "",
             deadline_ts=None if deadline_s is None else now + deadline_s,
             stream_cb=stream_cb,
+            speculate=bool(speculate),
         )
         req.ts_arrival = now
         req.bucket = bucket
@@ -492,16 +552,23 @@ class ServeScheduler:
                 if self.kv_state is None:
                     # ONE page store + allocator + prefix tree for the
                     # whole scheduler — every bucket's pool shares it
+                    # (and, when speculating, ONE draft store indexed
+                    # by the same page tables)
                     self.kv_state = PagedKV(
                         self.model, self.kv_spec,
                         prefix_cache=self.kv_prefix_cache,
                         clock=self.clock,
+                        draft_model=(self.draft_model
+                                     if self.speculate_k else None),
                     )
                 pool = PagedSlotPool(
                     self.model, self.params, self.kv_state, bucket,
                     self.slots, self.max_new_cap, seg=self.seg,
                     temperature=s["temperature"], top_k=s["top_k"],
                     top_p=s["top_p"], eos_id=s["eos_id"], seed=s["seed"],
+                    spec_k=self.speculate_k,
+                    draft_model=self.draft_model,
+                    draft_params=self.draft_params,
                 )
             else:
                 pool = SlotPool(
@@ -647,6 +714,10 @@ class ServeScheduler:
                         self._finalize(req, RequestState.DONE)
                     self._stream(req, new, finished)
                 self.metrics.on_segment(live, pool.slots)
+                if getattr(pool, "spec_k", 0):
+                    drafted, accepted = pool.last_spec_stats
+                    if drafted:
+                        self.metrics.on_spec_round(drafted, accepted)
                 progress = True
         if self.kv_state is not None:
             self.metrics.on_kv(self.kv_state)
@@ -974,6 +1045,23 @@ class ServeScheduler:
         )
         return snap
 
+    def spec_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Speculative-decoding state for post-mortems (the flight
+        recorder's ``<prefix>_spec.json`` section): cumulative and
+        windowed acceptance so a bundle shows whether a slow tail was
+        acceptance COLLAPSE. None when speculation is off."""
+        if not self.speculate_k:
+            return None
+        rounds, drafted, accepted, windowed = self.metrics.spec_totals()
+        return {
+            "k": self.speculate_k,
+            "rounds": rounds,
+            "drafted": drafted,
+            "accepted": accepted,
+            "accept_rate": (accepted / drafted if drafted else None),
+            "accept_rate_windowed": windowed,
+        }
+
     def metrics_snapshot(self) -> Dict[str, Any]:
         snap = self.metrics.snapshot()
         with self._lock:
@@ -1013,13 +1101,18 @@ def serve_texts(
     kv_pages: Optional[int] = None,
     kv_page_size: int = 16,
     kv_quant: Optional[str] = None,
+    speculate_k: int = 0,
+    draft_model=None,
+    draft_params=None,
 ) -> List[str]:
     """Offline text frontend over the slot scheduler — what
     ``PackagedLM.generate_text(serve_slots=..., scheduler='slot')``
     routes through. Returns prompt+continuation strings in input order,
     token-identical to the wave-drained path under the same seed.
     ``kv='paged'`` serves through the paged KV store (same tokens,
-    different memory model — see :class:`ServeScheduler`)."""
+    different memory model — see :class:`ServeScheduler`);
+    ``speculate_k`` adds draft-model speculative decoding on top
+    (still the same tokens — oracle-parity acceptance)."""
     tok = packaged_lm._require_tokenizer()
     # rounds=1: an offline drain rewinds its horizon for free between
     # rounds (reset() is bookkeeping, not device work), so the extra
@@ -1032,7 +1125,8 @@ def serve_texts(
         max_new_cap=max_new_tokens, max_queue=max(1, len(prompts)),
         temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
         seed=seed, kv=kv, kv_pages=kv_pages, kv_page_size=kv_page_size,
-        kv_quant=kv_quant,
+        kv_quant=kv_quant, speculate_k=speculate_k,
+        draft_model=draft_model, draft_params=draft_params,
     )
     reqs = [sched.submit(p, max_new_tokens) for p in prompts]
     sched.run_until_idle()

@@ -286,16 +286,30 @@ class PagedSlotPool:
         top_p: Optional[float] = None,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        spec_k: int = 0,
+        draft_model=None,
+        draft_params=None,
     ):
         import jax
         import jax.numpy as jnp
 
-        from tpuflow.infer.generate import paged_join_fn, paged_segment_fn
+        from tpuflow.infer.generate import (
+            paged_join_fn,
+            paged_segment_fn,
+            spec_draft_fn,
+            spec_verify_fn,
+        )
 
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_new_cap < 1:
             raise ValueError(f"max_new_cap must be >= 1, got {max_new_cap}")
+        if spec_k and (draft_model is None or draft_params is None
+                       or kv.draft_cache is None):
+            raise ValueError(
+                "spec_k > 0 needs a draft model AND its params AND a "
+                "PagedKV built with draft_model= (the draft page store)"
+            )
         self.bucket = int(bucket)
         self.slots = int(slots)
         self.seg = max(1, int(seg))
@@ -331,6 +345,29 @@ class PagedSlotPool:
             for wd in menu
         }
         self._widths = menu
+        # speculative decoding (ISSUE 9): one ROUND per boundary —
+        # k draft proposals, ONE blockwise target verify over k+1
+        # positions, oracle-parity acceptance. The draft's KV rides
+        # the same page tables (kv.draft_cache); its prompt prefill
+        # reuses the width-bucketed join menu against the draft model.
+        self.spec_k = int(spec_k)
+        self.draft_params = draft_params
+        if self.spec_k:
+            self._spec_draft = spec_draft_fn(
+                draft_model, kv.spec, self.slots, self.length,
+                self.n_row_pages, self.spec_k, float(temperature),
+                top_k, top_p)
+            self._spec_verify = spec_verify_fn(
+                model, kv.spec, self.slots, self.length,
+                self.n_row_pages, self.spec_k, float(temperature),
+                top_k, top_p, eos_id)
+            self._join_draft = {
+                wd: paged_join_fn(draft_model, kv.spec, self.slots,
+                                  self.length, self.n_row_pages, wd)
+                for wd in menu
+            }
+        self.spec_on = np.ones((self.slots,), bool)
+        self.last_spec_stats = (0, 0)  # (drafted, accepted) last round
         self.out = jnp.zeros((self.slots, self.length), jnp.int32)
         self.page_table = np.zeros((self.slots, self.n_row_pages),
                                    np.int32)  # 0 = the write sink
@@ -404,6 +441,7 @@ class PagedSlotPool:
             self.kv_limit[slot] = p + req.max_new_tokens - 1
             self.last_tok[slot] = p + req.max_new_tokens - 1
             self.stream_ids[slot] = req.stream_id
+            self.spec_on[slot] = bool(getattr(req, "speculate", True))
             self.done[slot] = False
             self.occupants[slot] = req
             self.plans[slot] = plan
@@ -422,7 +460,20 @@ class PagedSlotPool:
                 jnp.asarray(tokens), jnp.asarray(starts),
                 jnp.asarray(widths), jnp.asarray(self.page_table),
             )
+            if self.spec_k:
+                # draft prefill through the SAME page table/suffix
+                # window: shared-prefix pages then carry BOTH models'
+                # KV, so a prefix-cache hit skips both prefills. The
+                # out it returns is content-identical (same token
+                # writes) — keep the target join's.
+                self.kv.draft_cache, _ = self._join_draft[w](
+                    self.draft_params, self.kv.draft_cache, self.out,
+                    jnp.asarray(tokens), jnp.asarray(starts),
+                    jnp.asarray(widths), jnp.asarray(self.page_table),
+                )
         _mem.tag("kv_pages", (self.kv.cache, self.out))
+        if self.spec_k:
+            _mem.tag("kv_draft", self.kv.draft_cache)
         for slot, req, plan in admits:
             kv.insert_prompt(req.prompt_ids, plan)
 
@@ -439,16 +490,30 @@ class PagedSlotPool:
         been written (a budget-ended row's last token is produced but
         not consumed), so the chain covers the first
         ``len(prompt+tokens) - 1`` positions — conservative by at most
-        one token. Returns the number of new tree nodes."""
+        one token. Returns the number of new tree nodes.
+
+        With ``spec_k`` the bar covers the DRAFT store too (shared
+        page ids — a published chain a later hit trusts must carry
+        BOTH models' KV, or the draft attends to garbage and
+        acceptance silently collapses): opt-out rows
+        (``speculate=False``) never draft-write their generated
+        positions, so they publish nothing beyond the join-time prompt
+        pages; speculative rows trim ONE extra position — the draft's
+        written frontier ends at the last round's ``pos0 + k - 1``,
+        which a fully-accepted final round leaves one position behind
+        the target's."""
         req = self.occupants[slot]
         plan = self.plans[slot]
         if (req is None or plan is None or self.kv.prefix is None
                 or not req.tokens):
             return 0
+        if self.spec_k and not self.spec_on[slot]:
+            return 0  # no draft KV exists for the generated positions
         full = np.concatenate(
             [req.prompt_ids, np.asarray(req.tokens, np.int32)])
         ps = self.kv.spec.page_size
-        n_full = (int(full.size) - 1) // ps
+        covered = int(full.size) - 1 - (1 if self.spec_k else 0)
+        n_full = max(0, covered) // ps
         if n_full <= plan.n_full:
             return 0  # nothing beyond the join-time prompt publish
         return self.kv.prefix.insert(full[: n_full * ps],
@@ -471,6 +536,7 @@ class PagedSlotPool:
         self.pos[slot] = 0
         self.kv_limit[slot] = 0
         self.last_tok[slot] = 0
+        self.spec_on[slot] = True
         return req
 
     def warm(self) -> None:
@@ -507,10 +573,15 @@ class PagedSlotPool:
         self.segments_run = 0
 
     def run_segment(self):
-        """Advance every occupied row ``seg`` steps at its own
-        position. Same event contract as :class:`SlotPool.run_segment`."""
+        """Advance every occupied row. Same event contract as
+        :class:`SlotPool.run_segment`. With ``spec_k`` set, one call
+        is one SPECULATIVE ROUND (1..k+1 tokens per live row — draft
+        propose, blockwise verify, oracle-parity accept) instead of
+        ``seg`` plain steps."""
         import jax.numpy as jnp
 
+        if self.spec_k:
+            return self._run_spec_round()
         pos0 = self.pos.copy()
         live_before = self.live_count()
         with trace.span("serve.decode_segment", phase="decode",
@@ -541,4 +612,66 @@ class PagedSlotPool:
                     break
                 new.append(int(tok))
             events.append((slot, req, new, finished))
+        return events, live_before
+
+    def _run_spec_round(self):
+        """One speculative round: k draft steps (one dispatch), one
+        blockwise verify+accept (one dispatch). Rejected positions
+        need NO cleanup — each row's write position simply advances by
+        its emitted count, and the next round's verify rewrites
+        whatever the rejection left above it (per-row write_pos
+        rewind; the pages were the row's own all along)."""
+        import jax.numpy as jnp
+
+        pos0 = self.pos.copy()
+        live_before = self.live_count()
+        done0 = jnp.asarray(self.done)
+        jpos0 = jnp.asarray(pos0)
+        jlim = jnp.asarray(self.kv_limit)
+        jstreams = jnp.asarray(self.stream_ids)
+        jspec = jnp.asarray(self.spec_on)
+        jtable = jnp.asarray(self.page_table)
+        with trace.span("serve.spec_round", phase="decode",
+                        bucket=self.bucket, k=self.spec_k,
+                        live=live_before):
+            with trace.span("serve.spec_draft", phase="decode",
+                            bucket=self.bucket, k=self.spec_k):
+                self.kv.draft_cache, drafts = self._spec_draft(
+                    self.draft_params, self.kv.draft_cache, self.out,
+                    done0, jpos0, jlim, jspec, jstreams, self._rng,
+                    jtable,
+                )
+            with trace.span("serve.spec_verify", phase="decode",
+                            bucket=self.bucket, k=self.spec_k):
+                (self.kv.cache, self.out, done_dev, xs, n_emit,
+                 n_acc) = self._spec_verify(
+                    self.params, self.kv.cache, self.out, drafts,
+                    done0, jpos0, jlim, jnp.asarray(self.last_tok),
+                    jspec, jstreams, self._rng, jtable,
+                )
+            self.segments_run += 1
+            was_done = self.done
+            self.done = np.array(done_dev)
+            xs = np.asarray(xs)
+            n_emit = np.asarray(n_emit, np.int32)
+            n_acc = np.asarray(n_acc, np.int32)
+        _mem.tag("kv_pages", (self.kv.cache, self.out))
+        _mem.tag("kv_draft", self.kv.draft_cache)
+        self.pos = pos0 + n_emit
+        drafted = accepted = 0
+        events = []
+        for slot, req in enumerate(self.occupants):
+            if req is None or was_done[slot]:
+                continue
+            if self.spec_on[slot]:
+                drafted += self.spec_k
+                accepted += int(n_acc[slot])
+            new: List[int] = []
+            finished = bool(self.done[slot])
+            for tok in xs[slot][: int(n_emit[slot])]:
+                if self.eos_id is not None and int(tok) == self.eos_id:
+                    break
+                new.append(int(tok))
+            events.append((slot, req, new, finished))
+        self.last_spec_stats = (drafted, accepted)
         return events, live_before
