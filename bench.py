@@ -1232,6 +1232,17 @@ def main() -> int:
                    help="--speculate: draft tokens per round (K+1 = "
                         "the verify width; 3 keeps it on the pow2 "
                         "join-width menu)")
+    p.add_argument("--faults", action="store_true",
+                   help="fault-tolerance A/B (ISSUE 10): the same "
+                        "tiny-LM fit run clean vs with an injected "
+                        "NaN under cfg.recovery (watchdog trip -> "
+                        "rollback to the last good checkpoint -> "
+                        "replay) — recovery wall-time and lost-step "
+                        "goodput ride the record; writes "
+                        "BENCH_*_faults.json")
+    p.add_argument("--fault-step", type=int, default=None,
+                   help="--faults: global step the NaN is injected "
+                        "at (default: mid-run, epoch 1)")
     p.add_argument("--serve-router", action="store_true",
                    help="multi-replica router A/B (ISSUE 8): 1 vs 2 "
                         "paged replicas behind the load-aware router "
@@ -1303,6 +1314,7 @@ def main() -> int:
     _MODE = ("e2e" if args.end2end
              else "decode" if args.decode
              else "spec" if args.speculate
+             else "faults" if args.faults
              else "serve_router" if args.serve_router
              else "serve_paged" if args.serve_paged
              else "serve" if args.serve
@@ -1409,6 +1421,8 @@ def _bench(args) -> int:
         return _bench_superstep(args, devices)
     if args.speculate:
         return _bench_spec(args, devices)
+    if args.faults:
+        return _bench_faults(args, devices)
     if args.serve_router:
         return _bench_serve_router(args, devices)
     if args.serve_paged:
@@ -3488,6 +3502,155 @@ def _bench_spec(args, devices) -> int:
     )
     emit(speedup, speedup, diagnostics=diag,
          metric="spec_decode_speedup", unit="x")
+    return 0
+
+
+def _bench_faults(args, devices) -> int:
+    """--faults: the ISSUE 10 fault-tolerance A/B — the SAME tiny-LM
+    fit run twice on identical data/seed:
+
+    - clean: watchdog + recovery armed, no fault (the baseline wall);
+    - faulted: a ``train.metrics`` NaN injected at one mid-run step —
+      the watchdog trips, the RecoveryPolicy rolls back to the last
+      good epoch checkpoint and REPLAYS, and the fit completes with
+      final state verified IDENTICAL to the clean run (the replay is
+      deterministic; the NaN poisoned only the observed metrics).
+
+    ``value`` = lost-step goodput (useful steps / dispatched steps —
+    the fleet-level cost of absorbing one transient fault);
+    ``recovery_time_s`` (faulted wall − clean wall: detect + restore +
+    replay) and the rollback window ride the diagnostics."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuflow.core.config import TrainConfig
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.testing import faults
+    from tpuflow.train.lm import LMTrainer
+
+    if args.smoke:
+        dim, depth, heads, rows, seq = 64, 2, 4, 64, 32
+    else:
+        dim, depth, heads, rows, seq = 256, 4, 8, 128, 64
+    batch, epochs = 8, 3
+    spe = rows // batch
+    fault_step = (args.fault_step if args.fault_step is not None
+                  else spe + spe // 2)  # mid-epoch-1: a checkpoint exists
+    rng = np.random.default_rng(0)
+    toks = rng.integers(1, 512, (rows, seq)).astype(np.int32)
+
+    def run(inject: bool, workdir: str):
+        lm = build_transformer_lm(
+            vocab_size=512, dim=dim, depth=depth, heads=heads,
+            mlp_ratio=2, dtype=jnp.float32,
+        )
+        cfg = TrainConfig(
+            optimizer="adamw", learning_rate=1e-3, warmup_epochs=0,
+            scale_lr_by_world_size=False, seed=0, watchdog=True,
+            recovery=True, keep_last_checkpoints=3,
+        )
+        tr = LMTrainer(lm, cfg)
+        handle = None
+        if inject:
+            handle = faults.inject("train.metrics", "nan",
+                                   step=fault_step)
+        t0 = _time.perf_counter()
+        try:
+            m = tr.fit(toks, batch_size=batch, epochs=epochs,
+                       checkpoint_dir=workdir)
+        finally:
+            if handle is not None:
+                faults.remove(handle)
+        wall = _time.perf_counter() - t0
+        params = jax.device_get(tr.state.params)
+        hist = list(tr._recovery_policy.history)
+        rb_ms = _span_totals().get("train.rollback", 0.0)
+        return wall, m, params, hist, rb_ms
+
+    import tempfile
+
+    # warmup: pay every compile before either measured run (the two
+    # fits share the process-wide executable caches — without this the
+    # clean run eats the compiles and the faulted run reads FASTER)
+    _progress({"phase": "faults_warmup"})
+    with tempfile.TemporaryDirectory() as d:
+        run(False, d)
+    _progress({"phase": "faults_clean"})
+    with tempfile.TemporaryDirectory() as d:
+        wall_clean, m_clean, p_clean, _, rb_ms0 = run(False, d)
+    _progress({"phase": "faults_injected", "fault_step": fault_step})
+    with tempfile.TemporaryDirectory() as d:
+        wall_fault, m_fault, p_fault, hist, rb_ms1 = run(True, d)
+
+    leaves_a = jax.tree.leaves(p_clean)
+    leaves_b = jax.tree.leaves(p_fault)
+    parity = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(leaves_a, leaves_b)
+    )
+    rollbacks = [h for h in hist if h["action"] == "rollback"]
+    useful = epochs * spe
+    # steps dispatched a second time: trip step back to the restored
+    # checkpoint (the rollback window the fleet pays for the fault)
+    lost = sum(
+        max(0, int(h["step"]) - ((int(h["step"]) // spe) * spe) + 1)
+        for h in rollbacks
+    )
+    goodput = useful / max(1, useful + lost)
+    # recovery cost from its measured components (a wall-vs-wall diff
+    # drowns in shared-box noise at smoke scale): the train.rollback
+    # restore span of the faulted run + the replayed steps billed at
+    # the clean run's per-step rate
+    restore_s = max(0.0, (rb_ms1 - rb_ms0) / 1e3)
+    recovery_s = restore_s + lost * (wall_clean / max(1, useful))
+    diag = {
+        "device_kind": devices[0].device_kind,
+        "model": f"lm-d{dim}x{depth}h{heads}",
+        "workload": {"rows": rows, "seq": seq, "batch": batch,
+                     "epochs": epochs, "steps_per_epoch": spe,
+                     "fault_step": fault_step, "seed": 0},
+        "wall_clean_s": round(wall_clean, 3),
+        "wall_faulted_s": round(wall_fault, 3),
+        "recovery_time_s": round(recovery_s, 3),
+        "restore_time_s": round(restore_s, 4),
+        "lost_steps": lost,
+        "useful_steps": useful,
+        "goodput_frac": round(goodput, 4),
+        "rollbacks": len(rollbacks),
+        "recovery_history": [
+            {k: h[k] for k in ("step", "retry", "action", "lr_scale")}
+            for h in hist
+        ],
+        "final_state_parity": bool(parity),
+        "loss_clean": float(m_clean["loss"]),
+        "loss_faulted": float(m_fault["loss"]),
+        "span_totals_ms": _span_totals(),
+    }
+    rec = {
+        "metric": "fault_recovery_goodput",
+        "value": round(goodput, 4),
+        "unit": "frac",
+        "vs_baseline": round(goodput, 4),
+        "mode": "faults",
+        "smoke": bool(args.smoke),
+        "diagnostics": diag,
+    }
+    out_path = args.serve_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_LOCAL_r10_faults.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(
+        f"# faults: NaN@{fault_step} -> {len(rollbacks)} rollback(s), "
+        f"{lost} lost steps, goodput {goodput:.1%}, recovery "
+        f"{recovery_s:.2f}s, final-state parity={parity} -> {out_path}",
+        file=sys.stderr, flush=True,
+    )
+    emit(round(goodput, 4), round(goodput, 4), diagnostics=diag,
+         metric="fault_recovery_goodput", unit="frac")
     return 0
 
 

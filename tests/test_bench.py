@@ -308,6 +308,45 @@ def test_smoke_speculate_emits_schema(tmp_path):
 
 
 @pytest.mark.slow
+def test_smoke_faults_emits_schema(tmp_path):
+    """--faults: the ISSUE 10 fault-tolerance A/B emits the recovery
+    record — recovery wall-time and lost-step goodput IN the
+    diagnostics (the satellite's contract), the rollback history, and
+    the final-state-parity verdict (the injected NaN must cost a
+    rollback window, never the run's correctness)."""
+    out = str(tmp_path / "BENCH_TEST_faults.json")
+    r = _run("--smoke", "--faults", "--serve-out", out, timeout=580)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "fault_recovery_goodput"
+    assert rec["unit"] == "frac"
+    assert 0.0 < rec["value"] <= 1.0
+    assert "error" not in rec
+    d = rec["diagnostics"]
+    # the satellite's diag contract: recovery time + goodput fields
+    assert d["recovery_time_s"] >= 0.0
+    assert d["restore_time_s"] >= 0.0
+    # emit() rounds the headline value to 2 decimals; the full
+    # precision rides vs_baseline and the diagnostics
+    assert rec["vs_baseline"] == d["goodput_frac"]
+    assert abs(d["goodput_frac"] - rec["value"]) < 0.005
+    assert d["useful_steps"] > 0
+    assert 0 < d["lost_steps"] <= d["useful_steps"]
+    assert d["rollbacks"] == 1  # one injected NaN, one rollback
+    h = d["recovery_history"]
+    assert h and h[0]["action"] == "rollback"
+    assert h[0]["step"] == d["workload"]["fault_step"]
+    # the acceptance bar rides the bench too: the faulted run's final
+    # state must equal the clean run's bitwise (deterministic replay)
+    assert d["final_state_parity"] is True
+    assert d["loss_clean"] == d["loss_faulted"]
+    with open(out) as f:
+        disk = json.load(f)
+    assert disk["mode"] == "faults"
+    assert disk["diagnostics"]["goodput_frac"] == d["goodput_frac"]
+
+
+@pytest.mark.slow
 def test_smoke_end2end_emits_schema():
     r = _run("--smoke", "--end2end", "--e2e-images", "32", "--no-attn-diag")
     assert r.returncode == 0, r.stderr[-2000:]

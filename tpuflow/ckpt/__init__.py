@@ -1,5 +1,7 @@
 from tpuflow.ckpt.checkpoint import (  # noqa: F401
     AsyncCheckpointer,
+    CorruptCheckpointError,
+    gc_checkpoints,
     latest_checkpoint,
     latest_resume_point,
     list_checkpoints,
@@ -7,4 +9,11 @@ from tpuflow.ckpt.checkpoint import (  # noqa: F401
     restore_into_state,
     save_checkpoint,
     save_step_checkpoint,
+    verify_checkpoint,
+)
+from tpuflow.ckpt.sharded import (  # noqa: F401
+    list_sharded_checkpoints,
+    restore_sharded_into_state,
+    save_sharded_checkpoint,
+    verify_sharded,
 )

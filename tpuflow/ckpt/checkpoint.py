@@ -23,13 +23,92 @@ from __future__ import annotations
 import contextlib
 import os
 import re
+import struct
 import tempfile
+import zlib
 from typing import Any, List, Optional
 
 import jax
 from flax import serialization
 
 _PAT = re.compile(r"checkpoint-(\d+)\.ckpt$")
+
+
+class CorruptCheckpointError(ValueError):
+    """A checkpoint file failed its integrity check (CRC/length footer
+    mismatch, truncated payload, unreadable shard). Discovery
+    (:func:`latest_resume_point` / :func:`latest_checkpoint`) catches
+    this and falls back to the previous valid checkpoint instead of
+    dying in ``msgpack_restore`` — restore of an EXPLICIT path
+    surfaces it."""
+
+
+# ---- integrity footer (ISSUE 10 satellite) ---------------------------
+#
+# Every payload written by _atomic_save carries a fixed 20-byte
+# trailer: 8-byte magic + CRC32 + payload length. Readers strip and
+# verify it; files WITHOUT the magic are legacy pre-footer checkpoints
+# and still load unverified (MIGRATION.md r11). The footer turns a
+# torn/bit-flipped file into a detected CorruptCheckpointError at
+# DISCOVERY time rather than a msgpack exception mid-restore.
+
+_FOOTER_MAGIC = b"TPFWCRC1"
+_FOOTER_LEN = len(_FOOTER_MAGIC) + 12  # + u32 crc + u64 payload len
+
+
+def _with_footer(data: bytes) -> bytes:
+    return data + _FOOTER_MAGIC + struct.pack(
+        "<IQ", zlib.crc32(data) & 0xFFFFFFFF, len(data)
+    )
+
+
+def _strip_footer(data: bytes, path: str = "<bytes>") -> bytes:
+    """Verified payload of ``data``; legacy (no magic) passes through
+    unchecked, a PRESENT footer that fails CRC/length raises."""
+    if len(data) < _FOOTER_LEN or data[-_FOOTER_LEN:-12] != _FOOTER_MAGIC:
+        return data  # legacy single-file format keeps restoring
+    crc, n = struct.unpack("<IQ", data[-12:])
+    payload = data[:-_FOOTER_LEN]
+    if len(payload) != n:
+        raise CorruptCheckpointError(
+            f"{path}: truncated checkpoint (footer says {n} payload "
+            f"bytes, file holds {len(payload)})"
+        )
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise CorruptCheckpointError(
+            f"{path}: checkpoint CRC mismatch (corrupt payload)"
+        )
+    return payload
+
+
+def read_verified(path: str) -> bytes:
+    """Read ``path`` and verify/strip its integrity footer (legacy
+    files come back as-is). Raises :class:`CorruptCheckpointError` on
+    mismatch — shared by the single-file and sharded readers."""
+    with open(path, "rb") as f:
+        return _strip_footer(f.read(), path)
+
+
+def verify_checkpoint(path: str) -> bool:
+    """True iff ``path`` loads: footer files verify by CRC (cheap);
+    legacy footer-less files pay one msgpack parse (the only way to
+    detect their truncation)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return False
+    try:
+        payload = _strip_footer(data, path)
+    except CorruptCheckpointError:
+        return False
+    if len(data) >= _FOOTER_LEN and data[-_FOOTER_LEN:-12] == _FOOTER_MAGIC:
+        return True  # CRC already proved the payload
+    try:
+        serialization.msgpack_restore(payload)
+    except Exception:
+        return False
+    return True
 
 
 def checkpoint_number(path: str) -> int:
@@ -212,11 +291,13 @@ def _atomic_save(checkpoint_dir: str, path: str, payload: Any) -> str:
     is unlinked on any failure so aborted writes never litter the
     checkpoint dir."""
     from tpuflow.core.dist import is_primary
+    from tpuflow.testing import faults
 
     if not is_primary():
         return path
+    faults.fire("ckpt.write")  # raise/delay/kill injection point
     os.makedirs(checkpoint_dir, exist_ok=True)
-    data = serialization.msgpack_serialize(payload)
+    data = _with_footer(serialization.msgpack_serialize(payload))
     fd, tmp = tempfile.mkstemp(dir=checkpoint_dir, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
@@ -228,6 +309,7 @@ def _atomic_save(checkpoint_dir: str, path: str, payload: Any) -> str:
         except OSError:
             pass
         raise
+    faults.file_hook("ckpt.file", path)  # corrupt/truncate injection
     return path
 
 
@@ -248,34 +330,58 @@ def save_step_checkpoint(checkpoint_dir: str, state: Any,
     )
 
 
+def _resume_candidates(checkpoint_dir: str, steps_per_epoch: int
+                       ) -> List[tuple]:
+    """Every restorable checkpoint in ``checkpoint_dir`` as
+    ``(effective_step, prefer_rank, path)``, BEST FIRST: higher global
+    step wins; at equal step an epoch file beats a step file beats a
+    sharded manifest (clean boundary, then the cheaper reader)."""
+    out = []
+    if not os.path.isdir(checkpoint_dir):
+        return out
+    for fn in os.listdir(checkpoint_dir):
+        p = os.path.join(checkpoint_dir, fn)
+        ms = _STEP_PAT.search(fn)
+        m = _PAT.search(fn)
+        if ms:
+            out.append((int(ms.group(1)), 1, p))
+        elif m:
+            out.append((int(m.group(1)) * steps_per_epoch, 0, p))
+        else:
+            from tpuflow.ckpt.sharded import manifest_step
+
+            step = manifest_step(fn)
+            if step is not None:
+                out.append((step, 2, p))
+    out.sort(key=lambda c: (c[0], -c[1]), reverse=True)
+    return out
+
+
+def _candidate_valid(path: str) -> bool:
+    """Integrity gate shared by discovery: single files verify via
+    footer/parse, sharded manifests verify manifest + every shard."""
+    if path.endswith(".manifest.json"):
+        from tpuflow.ckpt.sharded import verify_sharded
+
+        return verify_sharded(path)
+    return verify_checkpoint(path)
+
+
 def latest_resume_point(checkpoint_dir: str, steps_per_epoch: int
                         ) -> Optional[tuple]:
-    """Newest checkpoint across BOTH namespaces, compared in global-
-    step units (epoch ckpt N ≙ step N·steps_per_epoch; ties prefer the
-    epoch file — a clean boundary). Returns ``(path, epoch,
-    skip_steps)`` where ``skip_steps`` is the position within epoch
-    ``epoch`` the stream must fast-forward to, or None when the
-    directory holds nothing."""
-    best = None  # (effective_step, is_step_ckpt, path)
-    if not os.path.isdir(checkpoint_dir):
-        return None
-    for fn in os.listdir(checkpoint_dir):
-        m = _PAT.search(fn)
-        ms = _STEP_PAT.search(fn)
-        if ms:
-            cand = (int(ms.group(1)), 1, os.path.join(checkpoint_dir, fn))
-        elif m:
-            cand = (int(m.group(1)) * steps_per_epoch, 0,
-                    os.path.join(checkpoint_dir, fn))
-        else:
-            continue
-        # prefer higher step; at equal step prefer the epoch file
-        if best is None or (cand[0], -cand[1]) > (best[0], -best[1]):
-            best = cand
-    if best is None:
-        return None
-    step, _is_step, path = best
-    return path, step // steps_per_epoch, step % steps_per_epoch
+    """Newest VALID checkpoint across all three namespaces (epoch
+    files, step files, sharded manifests), compared in global-step
+    units (epoch ckpt N ≙ step N·steps_per_epoch; ties prefer the
+    epoch file). Corrupt or truncated candidates — a torn write, a
+    bit-flip, a missing shard — are SKIPPED, falling back to the
+    previous valid one (ISSUE 10 satellite: a bad newest checkpoint
+    must cost one checkpoint interval, not the run). Returns ``(path,
+    epoch, skip_steps)`` or None when nothing valid exists."""
+    for step, _rank, path in _resume_candidates(
+            checkpoint_dir, steps_per_epoch):
+        if _candidate_valid(path):
+            return path, step // steps_per_epoch, step % steps_per_epoch
+    return None
 
 
 def list_checkpoints(checkpoint_dir: str) -> List[str]:
@@ -289,14 +395,29 @@ def list_checkpoints(checkpoint_dir: str) -> List[str]:
 
 
 def latest_checkpoint(checkpoint_dir: str) -> Optional[str]:
-    cks = list_checkpoints(checkpoint_dir)
-    return cks[-1] if cks else None
+    """Newest VALID epoch checkpoint (corrupt files skipped — same
+    fallback contract as :func:`latest_resume_point`)."""
+    for p in reversed(list_checkpoints(checkpoint_dir)):
+        if verify_checkpoint(p):
+            return p
+    return None
 
 
 def restore_checkpoint(path: str) -> dict:
-    """Raw payload (dict of numpy arrays)."""
-    with open(path, "rb") as f:
-        return serialization.msgpack_restore(f.read())
+    """Raw payload (dict of numpy arrays); integrity-verified when the
+    file carries the CRC footer (raises CorruptCheckpointError on
+    mismatch). Legacy footer-less files load as before — and a
+    TRUNCATED footer file looks footer-less (the trailer was cut off),
+    so an unparseable payload is also surfaced as
+    :class:`CorruptCheckpointError`, not a raw msgpack exception."""
+    try:
+        return serialization.msgpack_restore(read_verified(path))
+    except CorruptCheckpointError:
+        raise
+    except Exception as e:
+        raise CorruptCheckpointError(
+            f"{path}: unreadable checkpoint payload ({e})"
+        ) from e
 
 
 def restore_into_state(path: str, state: Any) -> Any:
@@ -306,7 +427,16 @@ def restore_into_state(path: str, state: Any) -> Any:
     payload supplies values, including optimizer state and step, so
     training continues exactly where it stopped — the capability the
     reference gestures at but never implements (SURVEY.md §5.4).
+
+    A ``*.manifest.json`` path routes to the SHARDED restore
+    (tpuflow.ckpt.sharded), which re-slices the saved shards under the
+    template's own mesh/sharding — a different process count or mesh
+    shape than the saver's is fine.
     """
+    if path.endswith(".manifest.json"):
+        from tpuflow.ckpt.sharded import restore_sharded_into_state
+
+        return restore_sharded_into_state(path, state)
     payload = restore_checkpoint(path)
     if set(payload.keys()) == {"params", "batch_stats"}:
         restored = state.replace(
@@ -330,3 +460,98 @@ def restore_into_state(path: str, state: Any) -> Any:
         restored,
         state,
     )
+
+
+# ---- retention (ISSUE 10 satellite) ----------------------------------
+
+
+def gc_checkpoints(checkpoint_dir: str, keep_last: int,
+                   just_wrote: Optional[str] = None) -> List[str]:
+    """Delete all but the newest ``keep_last`` checkpoints PER
+    NAMESPACE (epoch files; step files + sharded sets — a manifest and
+    its shard files count as ONE checkpoint) and return the removed
+    paths. Both file kinds accumulate unboundedly otherwise.
+
+    Safety rails: the newest VALID checkpoint of each namespace is
+    never deleted even when retention would name it (if the newest N
+    are all corrupt, the newest valid survivor is the only thing a
+    restart can restore); rank-0 discipline (non-primary is a no-op,
+    matching who wrote the files). ``just_wrote`` names a checkpoint
+    the caller finished writing moments ago — trusted valid without
+    re-reading it, so the per-save rail scan costs nothing instead of
+    a full CRC pass over the newest checkpoint.
+
+    Shard sets whose manifest never published (a killed save) are
+    invisible to discovery but must not leak past retention: they join
+    the step namespace as unrestorable candidates and age out like any
+    other checkpoint. A save IN PROGRESS is always the newest step
+    (global step is monotonic), so it sits inside the retention window
+    and is never collected mid-write."""
+    from tpuflow.core.dist import is_primary
+
+    if not is_primary() or keep_last < 1:
+        return []
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    from tpuflow.ckpt.sharded import (
+        _SHARD_PAT,
+        manifest_step,
+        meta_path,
+        sharded_set_files,
+    )
+
+    # candidates: (step_key, path, kind, orphan_files)
+    epoch_ns: List[tuple] = []
+    step_ns: List[tuple] = []
+    shard_files: dict = {}
+    manifest_steps = set()
+    for fn in os.listdir(checkpoint_dir):
+        p = os.path.join(checkpoint_dir, fn)
+        sm = _SHARD_PAT.search(fn)
+        if sm:
+            shard_files.setdefault(int(sm.group(1)), []).append(p)
+            continue
+        if _STEP_PAT.search(fn):
+            step_ns.append(
+                (int(_STEP_PAT.search(fn).group(1)), p, "file", ()))
+        elif _PAT.search(fn):
+            epoch_ns.append(
+                (int(_PAT.search(fn).group(1)), p, "file", ()))
+        else:
+            s = manifest_step(fn)
+            if s is not None:
+                manifest_steps.add(s)
+                step_ns.append((s, p, "manifest", ()))
+    for s, fl in shard_files.items():
+        if s not in manifest_steps:  # orphaned set: killed mid-save
+            step_ns.append((s, "", "orphan", tuple(sorted(fl))))
+    removed: List[str] = []
+    for ns in (epoch_ns, step_ns):
+        ns.sort(reverse=True)  # newest first
+        if not ns[keep_last:]:
+            continue  # nothing to delete: don't pay the validity scan
+        newest_valid = next(
+            (c for c in ns if c[2] != "orphan"
+             and (c[1] == just_wrote or _candidate_valid(c[1]))),
+            None,
+        )
+        for cand in ns[keep_last:]:
+            if cand is newest_valid:
+                continue
+            _step, path, kind, orphans = cand
+            if kind == "manifest":
+                doomed = sharded_set_files(path)
+            elif kind == "orphan":
+                doomed = list(orphans) + [
+                    meta_path(f) for f in orphans
+                    if os.path.exists(meta_path(f))
+                ]
+            else:
+                doomed = [path]
+            for f in doomed:
+                try:
+                    os.unlink(f)
+                    removed.append(f)
+                except OSError:
+                    pass
+    return removed

@@ -1,0 +1,540 @@
+"""Sharded checkpoints (ISSUE 10 tentpole piece 1).
+
+The single-file writer (tpuflow.ckpt.checkpoint) assembles
+cross-process-sharded ZeRO/FSDP state with a process allgather before
+rank 0 serializes the FULL state — at multi-slice scale that allgather
+is exactly the traffic ZeRO sharded the optimizer state to avoid
+(Rajbhandari et al., PAPERS.md), and the write wall-clock scales with
+total state, not with per-process state. This module writes what each
+process already holds:
+
+- every process serializes ONLY its addressable, replica-0 shards into
+  ``checkpoint-step-{N}.shard-{P}-of-{W}.ckpt`` (P = process index,
+  W = process count) — chunk keys carry the leaf path and the global
+  index of the slice, so the file set is self-describing and NO
+  assembling collective runs on save (pinned by test);
+- the primary then publishes ``checkpoint-step-{N}.manifest.json``
+  atomically (tempfile + ``os.replace``) once every shard file exists
+  — the manifest names each leaf's global shape/dtype and which file
+  holds which slice, plus a CRC per shard file. A checkpoint EXISTS
+  iff its manifest does; readers never see a torn set.
+
+Restore is layout-free: :func:`restore_sharded_into_state` assembles
+each template leaf from whatever chunks the manifest names and places
+it under the TEMPLATE's own sharding — a different process count, mesh
+shape, or ZeRO mode than the saver's re-slices transparently (the
+elastic-resize path rides exactly this property, and reuses the
+chunk/assembly helpers in-memory via :func:`host_state_dict`).
+
+The legacy single-file format stays fully supported beside this one
+(``latest_resume_point`` compares both in global-step units).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+from tpuflow.ckpt.checkpoint import (
+    CorruptCheckpointError,
+    _unkey,
+    _rekey,
+    _with_footer,
+    read_verified,
+)
+
+_MANIFEST_PAT = re.compile(r"checkpoint-step-(\d+)\.manifest\.json$")
+_SHARD_PAT = re.compile(
+    r"checkpoint-step-(\d+)\.shard-(\d+)-of-(\d+)\.ckpt$"
+)
+FORMAT = "tpuflow-sharded-ckpt-v1"
+
+
+def manifest_path(checkpoint_dir: str, global_step: int) -> str:
+    return os.path.join(
+        checkpoint_dir, f"checkpoint-step-{global_step}.manifest.json"
+    )
+
+
+def shard_path(checkpoint_dir: str, global_step: int, p: int,
+               w: int) -> str:
+    return os.path.join(
+        checkpoint_dir,
+        f"checkpoint-step-{global_step}.shard-{p}-of-{w}.ckpt",
+    )
+
+
+def manifest_step(filename: str) -> Optional[int]:
+    """The N of a ``checkpoint-step-{N}.manifest.json`` name (None for
+    anything else) — the discovery hook checkpoint.py's resume scan
+    uses."""
+    m = _MANIFEST_PAT.search(filename)
+    return int(m.group(1)) if m else None
+
+
+# ---- flat state-dict plumbing ---------------------------------------
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    """Nested state dict → flat ``{'a/b/c': leaf}``. ``/`` is safe as a
+    separator: flax collection/param names never contain it."""
+    out: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+        return out
+    out[prefix[:-1] if prefix else ""] = tree
+    return out
+
+
+def _apply_flat(template_sd: Any, flat: Dict[str, Any],
+                prefix: str = "") -> Any:
+    """Rebuild the TEMPLATE's nested state-dict structure with leaves
+    substituted from ``flat`` — structure-preserving where a plain
+    unflatten would drop empty collections (``batch_stats={}``)."""
+    if isinstance(template_sd, dict):
+        return {
+            k: _apply_flat(v, flat, f"{prefix}{k}/")
+            for k, v in template_sd.items()
+        }
+    return flat[prefix[:-1] if prefix else ""]
+
+
+def _norm_index(index: Tuple, shape: Tuple[int, ...]) -> Tuple[Tuple[int, int], ...]:
+    """Shard index (tuple of slices) → ((start, stop), ...) with Nones
+    resolved against the global shape — the canonical chunk id."""
+    out = []
+    for sl, dim in zip(index, shape):
+        out.append((sl.start or 0, dim if sl.stop is None else sl.stop))
+    return tuple(out)
+
+
+def _index_str(norm: Tuple[Tuple[int, int], ...]) -> str:
+    if not norm:
+        return "scalar"
+    return ",".join(f"{a}:{b}" for a, b in norm)
+
+
+def _parse_index(s: str) -> Tuple[Tuple[int, int], ...]:
+    if s == "scalar":
+        return ()
+    return tuple(
+        (int(a), int(b))
+        for a, b in (part.split(":") for part in s.split(","))
+    )
+
+
+def _owned_chunks(leaf: Any) -> List[Tuple[Tuple[Tuple[int, int], ...], np.ndarray]]:
+    """The (index, data) chunks THIS process must write for ``leaf``:
+    replica-0 addressable shards of a jax.Array (each global slice is
+    written exactly once across the gang), or the whole value when the
+    leaf is plain host data (only the primary calls us with those).
+    Never triggers a cross-process fetch — ``shard.data`` is local by
+    definition."""
+    if isinstance(leaf, jax.Array):
+        shape = tuple(leaf.shape)
+        out = []
+        seen = set()
+        for sh in leaf.addressable_shards:
+            if sh.replica_id != 0:
+                continue
+            norm = _norm_index(tuple(sh.index), shape)
+            if norm in seen:  # paranoia: one write per global slice
+                continue
+            seen.add(norm)
+            out.append((norm, np.asarray(sh.data)))
+        return out
+    arr = np.asarray(leaf)
+    return [(tuple((0, d) for d in arr.shape), arr)]
+
+
+# ---- save ------------------------------------------------------------
+
+
+def meta_path(shard: str) -> str:
+    """The tiny publish sidecar beside a shard file (chunk keys + CRC
+    the WRITER computed): ``<shard>.meta.json``. Deleted by the primary
+    after the manifest publishes; never matches the shard/step/manifest
+    name patterns, so discovery and retention ignore live ones."""
+    return shard + ".meta.json"
+
+
+def _atomic_write(checkpoint_dir: str, final: str, data: bytes) -> None:
+    fd, tmp = tempfile.mkstemp(dir=checkpoint_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, final)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def save_sharded_checkpoint(
+    checkpoint_dir: str,
+    state: Any,
+    global_step: int,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    publish_timeout_s: float = 120.0,
+) -> str:
+    """Write this process's shard file and (on the primary) publish the
+    manifest; returns the manifest path.
+
+    NO assembling allgather runs here — each process serializes only
+    shard data it already holds, and the publish is O(manifest), not
+    O(state): each writer drops a tiny ``.meta.json`` sidecar (its
+    chunk keys + the CRC32/length of the bytes it just wrote), and the
+    primary publishes the manifest from the W sidecars without ever
+    reading a shard payload (polling up to ``publish_timeout_s`` — a
+    shared checkpoint dir is already the operating assumption of the
+    single-file format). Every process must call this with the same
+    state/step, like ``save_checkpoint``.
+
+    A RE-save at the same step (a post-rollback replay re-reaching an
+    epoch boundary) must not let the primary record a STALE peer file:
+    every process unlinks its own previous shard/sidecar first, and a
+    gang barrier orders all unlinks before any write — any sidecar the
+    publish poll sees is from THIS save.
+
+    Fully-replicated leaves have exactly one replica-0 shard across
+    the gang, so they are written once, by whichever process holds it;
+    plain host leaves (non-jax) are written by the primary.
+    """
+    from tpuflow.core.dist import barrier, is_primary
+    from tpuflow.testing import faults
+
+    p = jax.process_index() if process_index is None else process_index
+    w = jax.process_count() if process_count is None else process_count
+    flat = _flatten(serialization.to_state_dict(_unkey(state)))
+    payload: Dict[str, np.ndarray] = {}
+    for key, leaf in sorted(flat.items()):
+        if not isinstance(leaf, jax.Array) and not is_primary():
+            continue  # host leaves are primary's to write
+        for norm, data in _owned_chunks(leaf):
+            payload[f"{key}|{_index_str(norm)}"] = data
+    faults.fire("ckpt.write")
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    final = shard_path(checkpoint_dir, global_step, p, w)
+    for stale in (final, meta_path(final)):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+    barrier(f"tpuflow_sharded_save_{global_step}")
+    data = _with_footer(serialization.msgpack_serialize(payload))
+    _atomic_write(checkpoint_dir, final, data)
+    faults.file_hook("ckpt.shard", final)
+    # the sidecar's CRC is of the bytes the writer MEANT to write — a
+    # corrupt/truncated landing (injected or real) therefore fails
+    # verify_sharded instead of being notarized into the manifest
+    _atomic_write(
+        checkpoint_dir, meta_path(final),
+        json.dumps({
+            "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+            "bytes": len(data),
+            "chunks": sorted(payload.keys()),
+        }).encode(),
+    )
+    mpath = manifest_path(checkpoint_dir, global_step)
+    if not is_primary():
+        return mpath
+    # primary: wait for the full sidecar set (sidecar lands after its
+    # shard, so sidecar existence == shard complete), then publish
+    # atomically. leaf metadata (global shape/dtype) comes from the
+    # primary's own state view — identical everywhere by contract.
+    leaf_meta = {
+        key: {
+            "shape": list(np.shape(leaf)),
+            "dtype": _leaf_dtype(leaf),
+            "chunks": [],
+        }
+        for key, leaf in flat.items()
+    }
+    deadline = time.monotonic() + publish_timeout_s
+    files: Dict[str, Dict[str, Any]] = {}
+    metas: List[str] = []
+    for q in range(w):
+        fpath = shard_path(checkpoint_dir, global_step, q, w)
+        mp = meta_path(fpath)
+        while not os.path.exists(mp):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shard {q}/{w} of step {global_step} did not land "
+                    f"within {publish_timeout_s:g}s — cannot publish "
+                    "manifest"
+                )
+            time.sleep(0.05)
+        with open(mp) as f:
+            meta = json.load(f)
+        metas.append(mp)
+        qname = os.path.basename(fpath)
+        files[qname] = {
+            "crc32": int(meta["crc32"]),
+            "bytes": int(meta["bytes"]),
+        }
+        for chunk_key in meta["chunks"]:
+            key, _, idx = chunk_key.rpartition("|")
+            if key not in leaf_meta:  # saver had a leaf we don't know
+                continue
+            leaf_meta[key]["chunks"].append(
+                {"index": [list(ab) for ab in _parse_index(idx)],
+                 "file": qname}
+            )
+    manifest = {
+        "format": FORMAT,
+        "global_step": int(global_step),
+        "shards": w,
+        "files": files,
+        "leaves": leaf_meta,
+    }
+    _atomic_write(checkpoint_dir, mpath,
+                  json.dumps(manifest, indent=1).encode())
+    for mp in metas:  # sidecars served their purpose
+        try:
+            os.unlink(mp)
+        except OSError:
+            pass
+    faults.file_hook("ckpt.file", mpath)
+    return mpath
+
+
+def _leaf_dtype(leaf: Any) -> str:
+    if isinstance(leaf, jax.Array):
+        return str(leaf.dtype)
+    return str(np.asarray(leaf).dtype)
+
+
+def _crc32_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _load_shard(path: str) -> Dict[str, np.ndarray]:
+    """Verified chunk dict of one shard file (CRC footer checked)."""
+    return serialization.msgpack_restore(read_verified(path))
+
+
+# ---- read side -------------------------------------------------------
+
+
+def load_manifest(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            man = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CorruptCheckpointError(f"{path}: unreadable manifest "
+                                     f"({e})") from e
+    if man.get("format") != FORMAT:
+        raise CorruptCheckpointError(
+            f"{path}: unknown sharded-checkpoint format "
+            f"{man.get('format')!r}"
+        )
+    return man
+
+
+def sharded_set_files(mpath: str) -> List[str]:
+    """The manifest plus every shard file it references (retention GC
+    deletes a set as one unit). Unreadable manifest → the manifest and
+    any shard files matching its step by NAME (a half-written set must
+    still be collectable)."""
+    d = os.path.dirname(mpath)
+    try:
+        man = load_manifest(mpath)
+        out = [mpath] + [os.path.join(d, fn) for fn in man["files"]]
+    except (CorruptCheckpointError, KeyError, TypeError):
+        step = manifest_step(os.path.basename(mpath))
+        out = [mpath]
+        if step is not None and os.path.isdir(d):
+            for fn in os.listdir(d):
+                m = _SHARD_PAT.search(fn)
+                if m and int(m.group(1)) == step:
+                    out.append(os.path.join(d, fn))
+    # a publish that crashed mid-way can leave .meta.json sidecars
+    return out + [meta_path(f) for f in out[1:]
+                  if os.path.exists(meta_path(f))]
+
+
+def verify_sharded(mpath: str) -> bool:
+    """Integrity gate for discovery: manifest parses AND every shard
+    file exists with the recorded byte count + CRC32. A missing or
+    bit-flipped shard invalidates the whole set — resume falls back to
+    the previous valid checkpoint."""
+    try:
+        man = load_manifest(mpath)
+    except CorruptCheckpointError:
+        return False
+    d = os.path.dirname(mpath)
+    for fn, rec in man.get("files", {}).items():
+        p = os.path.join(d, fn)
+        try:
+            if os.path.getsize(p) != int(rec["bytes"]):
+                return False
+            if _crc32_file(p) != int(rec["crc32"]):
+                return False
+        except (OSError, KeyError, TypeError, ValueError):
+            return False
+    return True
+
+
+def list_sharded_checkpoints(checkpoint_dir: str) -> List[str]:
+    """Manifest paths under ``checkpoint_dir``, oldest step first."""
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for fn in os.listdir(checkpoint_dir):
+        if manifest_step(fn) is not None:
+            out.append(os.path.join(checkpoint_dir, fn))
+    return sorted(
+        out, key=lambda p: manifest_step(os.path.basename(p))
+    )
+
+
+def assemble_leaves(mpath: str,
+                    want: Optional[List[str]] = None
+                    ) -> Dict[str, np.ndarray]:
+    """Full host arrays for manifest leaves (all, or just ``want``):
+    allocate the global shape, fill every chunk from its shard file.
+    This is the re-slice pivot — the caller places the result under
+    ANY target sharding, independent of the saver's layout."""
+    man = load_manifest(mpath)
+    d = os.path.dirname(mpath)
+    shard_cache: Dict[str, Dict[str, np.ndarray]] = {}
+    out: Dict[str, np.ndarray] = {}
+    for key, meta in man["leaves"].items():
+        if want is not None and key not in want:
+            continue
+        shape = tuple(meta["shape"])
+        full = np.empty(shape, np.dtype(meta["dtype"]))
+        covered = 0
+        for chunk in meta["chunks"]:
+            fn = chunk["file"]
+            if fn not in shard_cache:
+                try:
+                    shard_cache[fn] = _load_shard(os.path.join(d, fn))
+                except (OSError, CorruptCheckpointError) as e:
+                    raise CorruptCheckpointError(
+                        f"{mpath}: shard {fn} unreadable ({e})"
+                    ) from e
+            norm = tuple(tuple(ab) for ab in chunk["index"])
+            data = shard_cache[fn].get(f"{key}|{_index_str(norm)}")
+            if data is None:
+                raise CorruptCheckpointError(
+                    f"{mpath}: chunk {key}|{_index_str(norm)} missing "
+                    f"from {fn}"
+                )
+            sl = tuple(slice(a, b) for a, b in norm)
+            full[sl] = np.asarray(data).reshape(
+                tuple(b - a for a, b in norm)
+            )
+            covered += int(np.prod([b - a for a, b in norm],
+                                   dtype=np.int64)) if norm else 1
+        if covered < int(np.prod(shape, dtype=np.int64) if shape else 1):
+            raise CorruptCheckpointError(
+                f"{mpath}: leaf {key} chunks cover {covered} of "
+                f"{int(np.prod(shape)) if shape else 1} elements"
+            )
+        out[key] = full
+    return out
+
+
+def restore_sharded_into_state(mpath: str, state: Any) -> Any:
+    """Restore a sharded checkpoint into a template TrainState,
+    RE-SLICING under the template's own mesh/shardings — the saver's
+    process count and mesh shape are irrelevant (the manifest speaks
+    global indices). Parity with single-file restore is pinned by
+    test."""
+    from tpuflow.parallel.mesh import put_replicated
+
+    template_sd = serialization.to_state_dict(_unkey(state))
+    template_flat = _flatten(template_sd)
+    host = assemble_leaves(mpath, want=list(template_flat.keys()))
+    missing = [k for k in template_flat if k not in host]
+    if missing:
+        raise CorruptCheckpointError(
+            f"{mpath}: template leaves missing from manifest: "
+            f"{missing[:5]}{'...' if len(missing) > 5 else ''}"
+        )
+    restored = serialization.from_state_dict(
+        _unkey(state), _apply_flat(template_sd, host)
+    )
+    restored = _rekey(state, restored)
+    return jax.tree.map(
+        lambda v, t: put_replicated(v, t.sharding)
+        if hasattr(t, "sharding") else v,
+        restored,
+        state,
+    )
+
+
+# ---- in-memory twin (elastic resize) ---------------------------------
+
+
+def host_state_dict(state: Any) -> Dict[str, np.ndarray]:
+    """Flat ``{key: full host array}`` of a (possibly sharded) state,
+    assembled from ADDRESSABLE shards only — the in-memory twin of
+    save-then-assemble that elastic resize uses at a block boundary
+    (no files, and in the single-controller case no collective).
+    Raises if this process cannot see every element (a true
+    multi-process resize goes through the on-disk shard set
+    instead)."""
+    flat = _flatten(serialization.to_state_dict(_unkey(state)))
+    out: Dict[str, np.ndarray] = {}
+    for key, leaf in flat.items():
+        if not isinstance(leaf, jax.Array):
+            out[key] = np.asarray(leaf)
+            continue
+        shape = tuple(leaf.shape)
+        if leaf.is_fully_addressable:
+            out[key] = np.asarray(jax.device_get(leaf))
+            continue
+        full = np.empty(shape, leaf.dtype)
+        covered = 0
+        for norm, data in _owned_chunks(leaf):
+            sl = tuple(slice(a, b) for a, b in norm)
+            full[sl] = data.reshape(tuple(b - a for a, b in norm))
+            covered += int(np.prod([b - a for a, b in norm],
+                                   dtype=np.int64)) if norm else 1
+        total = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        if covered < total:
+            raise ValueError(
+                f"host_state_dict: leaf {key} is only {covered}/{total} "
+                "addressable from this process — use the on-disk "
+                "sharded checkpoint for multi-process re-sharding"
+            )
+        out[key] = full
+    return out
+
+
+def place_state_dict(host: Dict[str, np.ndarray], template: Any) -> Any:
+    """Flat host arrays → a state shaped and SHARDED like ``template``
+    (the restore half of :func:`host_state_dict`; elastic resize calls
+    this with the NEW mesh's template)."""
+    from tpuflow.parallel.mesh import put_replicated
+
+    template_sd = serialization.to_state_dict(_unkey(template))
+    restored = serialization.from_state_dict(
+        _unkey(template), _apply_flat(template_sd, dict(host))
+    )
+    restored = _rekey(template, restored)
+    return jax.tree.map(
+        lambda v, t: put_replicated(v, t.sharding)
+        if hasattr(t, "sharding") else v,
+        restored,
+        template,
+    )

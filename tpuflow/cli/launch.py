@@ -56,6 +56,14 @@ def _parse(argv: List[str]) -> tuple:
     p.add_argument("--restarts", type=int, default=0,
                    help="relaunch the gang up to N times after a failure "
                         "(checkpoint resume continues the run)")
+    p.add_argument("--faults", type=str, default=None, metavar="SPEC",
+                   help="arm the fault-injection harness in every "
+                        "launched process (sets TPUFLOW_FAULTS; "
+                        "spec: 'point=kind[@step][xTIMES];...', e.g. "
+                        "'train.step=kill@7' — see tpuflow.testing."
+                        "faults). Chaos-test a gang: paired with "
+                        "--restarts and checkpoint resume the job "
+                        "must survive the injected failure")
     p.add_argument("--compile-cache", type=str, default=None,
                    metavar="DIR",
                    help="persistent XLA compilation cache dir for every "
@@ -157,9 +165,22 @@ def main(argv: List[str] | None = None) -> int:
         os.environ["JAX_COMPILATION_CACHE_DIR"] = args.compile_cache
         os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
         os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
+    if args.faults:
+        # arm the fault-injection harness (ISSUE 10) in every launched
+        # process — tpuflow.testing.faults parses TPUFLOW_FAULTS at
+        # import, so the trainer under test needs no code change
+        os.environ["TPUFLOW_FAULTS"] = args.faults
     if args.local and args.local > 0:
         rc = 0
         for attempt in range(max(0, args.restarts) + 1):
+            if attempt == 1 and args.faults:
+                # sabotage arms the FIRST launch only: a step-gated
+                # kill would otherwise fire again on every resumed
+                # relaunch (resume replays the fault's step) and the
+                # chaos drive could never demonstrate survival.
+                # Deterministic every-launch faults are still one
+                # `export TPUFLOW_FAULTS=...` away.
+                os.environ.pop("TPUFLOW_FAULTS", None)
             # fresh port per attempt: the previous coordinator socket can
             # linger in TIME_WAIT and refuse the bind
             rc = _run_local_cluster(args.local, args.port + attempt, cmd)

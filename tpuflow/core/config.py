@@ -190,6 +190,38 @@ class TrainConfig:
     # (tpuflow.obs.flight; inspect with `python -m tpuflow.cli.obs
     # postmortem <dir>`). None = trip without a dump.
     flight_dir: Optional[str] = None
+    # ---- fault-tolerance plane (ISSUE 10) ----
+    # sharded checkpoints (tpuflow.ckpt.sharded): every process writes
+    # ONLY its addressable replica-0 shards
+    # (checkpoint-step-{N}.shard-{P}-of-{W}.ckpt + atomic manifest) —
+    # no assembling allgather on save, and restore re-slices under a
+    # DIFFERENT process count/mesh shape (the elastic-resize and
+    # ZeRO-at-scale path). LMTrainer writes its epoch-boundary and
+    # preemption checkpoints in this format when set; resume needs
+    # maybe_resume(steps_per_epoch=...) (manifests live in the
+    # step-number namespace). The legacy single-file format keeps
+    # restoring either way.
+    sharded_checkpoint: bool = False
+    # checkpoint retention: keep only the newest N checkpoints per
+    # namespace (epoch files; step files + sharded sets), GC'd after
+    # each successful save — the newest VALID checkpoint is never
+    # deleted. None = keep everything (legacy behavior).
+    keep_last_checkpoints: Optional[int] = None
+    # auto-recovery (tpuflow.train.recovery): turn a watchdog trip
+    # (NaN / loss spike / stall) into rollback-to-last-good-checkpoint
+    # with bounded retries instead of halt-and-dump. Requires
+    # watchdog=True and checkpoint_dir; escalation ladder: after
+    # recovery_lr_drop_after consecutive trips also drop the LR by
+    # recovery_lr_drop_factor, after recovery_skip_batch_after also
+    # skip the poisoned step's batch on replay, past
+    # recovery_max_retries halt with the classic post-mortem.
+    # recovery_backoff_s sleeps before each restore (doubling).
+    recovery: bool = False
+    recovery_max_retries: int = 3
+    recovery_backoff_s: float = 0.0
+    recovery_lr_drop_after: int = 2
+    recovery_lr_drop_factor: float = 0.5
+    recovery_skip_batch_after: int = 3
     seed: int = 0
     optimizer_kwargs: Dict[str, Any] = field(default_factory=dict)
 

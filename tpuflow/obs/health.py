@@ -407,6 +407,23 @@ class HealthMonitor:
             return []
         return self.watchdog.state()["trips"][-n:]
 
+    def acknowledge(self) -> None:
+        """Consume the current trip(s) and re-arm (ISSUE 10 recovery):
+        after a rollback the fit loop keeps THIS monitor — worker
+        thread, stall watch, exporter wiring all stay — but
+        ``tripped`` flips back to False by re-anchoring ``_trip0`` at
+        the current trip count (the process watchdog's latched state
+        is untouched, so flight manifests / /readyz still show the
+        history). The spike detector restarts fresh: its EWMA was fed
+        by the pre-rollback trajectory, and the replayed steps would
+        otherwise be judged against poisoned statistics."""
+        self.drain()
+        self._trip0 = self.watchdog.trip_count
+        self.spike = LossSpikeDetector(factor=self.spike.factor,
+                                       alpha=self.spike.alpha,
+                                       warmup=self.spike.warmup,
+                                       min_ratio=self.spike.min_ratio)
+
     def pause(self) -> None:
         """Suspend the stall watch (legitimate non-step phase: eval,
         checkpoint). The stall clock re-anchors on :meth:`resume` —

@@ -1,0 +1,6 @@
+"""Deterministic test harnesses for the fault-tolerance plane
+(ISSUE 10). ``tpuflow.testing.faults`` is the fault-injection
+registry; importing this package must stay side-effect-free (the
+trainers import it on their hot paths)."""
+
+from tpuflow.testing import faults  # noqa: F401

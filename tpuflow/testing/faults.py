@@ -1,0 +1,327 @@
+"""Fault-injection harness (ISSUE 10 tentpole piece 4).
+
+Recovery code is only trustworthy if its failure paths run
+DETERMINISTICALLY under test — "unplug a replica and see" does not
+regress-test. This module gives the trainer/checkpoint hot paths named
+injection points; tests (and ``bench.py --faults``) arm faults against
+those names and the production code path itself takes the failure.
+
+Injection points are cheap when disarmed: every hook starts with one
+truthiness check of the module-level spec dict (the same discipline as
+the disarmed tracer/registry, pinned by the tier-1 overhead guards of
+those planes). Points currently threaded:
+
+- ``train.step``      — per step, both trainers, K=1 and superstep
+  loops (``raise`` / ``kill`` faults fire here);
+- ``train.metrics``   — mutation hook over the step's device metrics
+  (``nan`` faults poison the loss the health monitor sees WITHOUT
+  touching device state — the rollback-replay parity tests depend on
+  the replay being fault-free);
+- ``ckpt.write``      — before a checkpoint payload hits disk
+  (``raise`` / ``delay`` / ``kill``);
+- ``ckpt.file``       — after a checkpoint file is durably in place,
+  with its path (``corrupt`` / ``truncate`` flip real bytes — the
+  integrity-footer fallback tests eat these);
+- ``ckpt.shard``      — same, per sharded-checkpoint shard file;
+- ``elastic.boundary``— superstep block boundaries (elastic resize
+  tests schedule world changes here).
+
+Faults are one-shot by default (``times=1``): a NaN injected at step N
+trips the watchdog once, and the post-rollback REPLAY of step N runs
+clean — exactly the transient-fault model auto-recovery exists for.
+``times=-1`` repeats forever (the escalation-ladder tests use it).
+
+Subprocess harnesses (kill-9 resume tests, ``bench.py --faults``
+children) arm faults via ``TPUFLOW_FAULTS`` — a ``;``-separated list
+of ``point=kind@step[xTIMES]`` specs parsed once at import, e.g.
+``TPUFLOW_FAULTS="train.step=kill@7"``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_LOCK = threading.Lock()
+_SPECS: Dict[str, List["Fault"]] = {}
+_FIRED: Dict[str, int] = {}
+
+KINDS = ("raise", "nan", "corrupt", "truncate", "delay", "kill")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a ``raise``-kind fault — distinguishable from real
+    failures so tests can assert the injected path specifically."""
+
+
+class Fault:
+    """One armed fault: ``kind`` at injection point ``point``,
+    optionally gated on ``step`` (the hook's ``step=`` kwarg), firing
+    at most ``times`` times (-1 = unbounded). ``delay_s`` is the sleep
+    of a ``delay`` fault."""
+
+    def __init__(self, point: str, kind: str, step: Optional[int] = None,
+                 times: int = 1, delay_s: float = 0.05):
+        if kind not in KINDS:
+            raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
+        self.point = point
+        self.kind = kind
+        self.step = None if step is None else int(step)
+        self.times = int(times)
+        self.delay_s = float(delay_s)
+        self.remaining = self.times
+
+    def matches(self, step: Optional[int]) -> bool:
+        if self.remaining == 0:
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        return True
+
+    def consume(self) -> None:
+        if self.remaining > 0:
+            self.remaining -= 1
+
+    def __repr__(self) -> str:  # test/debug readability
+        gate = f"@{self.step}" if self.step is not None else ""
+        return f"Fault({self.point}={self.kind}{gate} x{self.times})"
+
+
+def inject(point: str, kind: str, step: Optional[int] = None,
+           times: int = 1, delay_s: float = 0.05) -> Fault:
+    """Arm a fault. Returns the handle (``remove(handle)`` disarms)."""
+    f = Fault(point, kind, step=step, times=times, delay_s=delay_s)
+    with _LOCK:
+        _SPECS.setdefault(point, []).append(f)
+    return f
+
+
+def remove(fault: Fault) -> None:
+    with _LOCK:
+        lst = _SPECS.get(fault.point)
+        if lst and fault in lst:
+            lst.remove(fault)
+        if lst is not None and not lst:
+            _SPECS.pop(fault.point, None)
+
+
+def clear(point: Optional[str] = None) -> None:
+    """Disarm everything (or one point) — tests call this in teardown
+    so a leaked fault can never poison the next test."""
+    with _LOCK:
+        if point is None:
+            _SPECS.clear()
+            _FIRED.clear()
+        else:
+            _SPECS.pop(point, None)
+
+
+def fired(point: Optional[str] = None) -> "int | Dict[str, int]":
+    """How many faults fired (per point, or the one point's count) —
+    the assertion surface for 'the injection actually took'."""
+    with _LOCK:
+        if point is not None:
+            return _FIRED.get(point, 0)
+        return dict(_FIRED)
+
+
+class injected:
+    """Context-manager arming: ``with faults.injected("train.step",
+    "raise", step=3): ...`` — disarmed on exit, exceptions included."""
+
+    def __init__(self, point: str, kind: str, **kw: Any):
+        self._args = (point, kind)
+        self._kw = kw
+        self._fault: Optional[Fault] = None
+
+    def __enter__(self) -> Fault:
+        self._fault = inject(*self._args, **self._kw)
+        return self._fault
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._fault is not None:
+            remove(self._fault)
+
+
+def _take(point: str, step: Optional[int],
+          kinds: Optional[tuple] = None) -> List[Fault]:
+    """Matching faults at ``point`` (consumed under the lock).
+    ``kinds`` restricts which kinds a hook consumes — a hook must
+    never eat (and count as fired) a fault kind it cannot act on."""
+    with _LOCK:
+        lst = _SPECS.get(point)
+        if not lst:
+            return []
+        hits = [
+            f for f in lst
+            if f.matches(step) and (kinds is None or f.kind in kinds)
+        ]
+        for f in hits:
+            f.consume()
+            _FIRED[point] = _FIRED.get(point, 0) + 1
+        return hits
+
+
+def _take_range(point: str, lo: int, hi: int,
+                kinds: Optional[tuple] = None) -> List[Fault]:
+    """Matching faults whose step gate is None or within [lo, hi] —
+    the superstep-block form of :func:`_take` (a fused K-step dispatch
+    covers K global steps at once)."""
+    with _LOCK:
+        lst = _SPECS.get(point)
+        if not lst:
+            return []
+        hits = [
+            f for f in lst
+            if f.remaining != 0 and (f.step is None or lo <= f.step <= hi)
+            and (kinds is None or f.kind in kinds)
+        ]
+        for f in hits:
+            f.consume()
+            _FIRED[point] = _FIRED.get(point, 0) + 1
+        return hits
+
+
+def _kill() -> None:  # pragma: no cover - the process dies here
+    import signal
+
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def fire(point: str, step: Optional[int] = None) -> None:
+    """Control-flow injection point: a matching ``raise`` fault raises
+    :class:`FaultInjected`, ``delay`` sleeps, ``kill`` SIGKILLs the
+    process (the kill-9 harness). Disarmed cost: one dict-truthiness
+    check."""
+    if not _SPECS:
+        return
+    for f in _take(point, step, kinds=("raise", "delay", "kill")):
+        if f.kind == "delay":
+            time.sleep(f.delay_s)
+        elif f.kind == "kill":
+            _kill()
+        elif f.kind == "raise":
+            raise FaultInjected(
+                f"injected fault at {point}"
+                + (f" step {step}" if step is not None else "")
+            )
+
+
+def mutate_metrics(point: str, metrics: Any,
+                   step: Optional[int] = None, k: int = 1) -> Any:
+    """Value injection point for the trainers' step-metrics dict: a
+    matching ``nan`` fault poisons ``loss`` (and the ``nonfinite``
+    guard flag when present) with NaN, which the health monitor's
+    non-finite guard then trips on. The DEVICE state is untouched, so
+    the post-rollback replay is bit-identical to an uninterrupted run
+    — the acceptance criterion's fault model.
+
+    ``step``/``k`` follow ``HealthMonitor.check_host``'s convention:
+    ``step`` is the global index of the block's LAST step (== the step
+    itself for scalars), ``k`` the block length — so a fault gated on
+    step N poisons exactly entry ``N - (step - k + 1)`` of a fused
+    superstep block, and the trip attributes to step N."""
+    if not _SPECS:
+        return metrics
+    if step is None:
+        hits = _take(point, None, kinds=("nan",))
+    else:
+        hits = _take_range(point, int(step) - int(k) + 1, int(step),
+                           kinds=("nan",))
+    if not hits:
+        return metrics
+    import numpy as np
+
+    out = dict(metrics)
+    lo = (int(step) - int(k) + 1) if step is not None else 0
+
+    def _poison(val, fill):
+        arr = np.array(val, np.float32)
+        if arr.ndim == 0:
+            return np.float32(fill)
+        for f in hits:
+            if f.step is None:
+                arr[:] = fill
+            else:
+                arr[f.step - lo] = fill
+        return arr
+
+    if out.get("loss") is not None:
+        out["loss"] = _poison(out["loss"], np.nan)
+    if "nonfinite" in out:
+        out["nonfinite"] = _poison(out["nonfinite"], 1.0)
+    return out
+
+
+def file_hook(point: str, path: str, step: Optional[int] = None) -> None:
+    """Post-write injection point: a matching ``corrupt`` fault XORs a
+    byte in the middle of ``path`` (checksum-detectable, length
+    preserved); ``truncate`` chops the file's tail (the torn-write
+    shape a crashed writer without atomic-replace leaves behind)."""
+    if not _SPECS:
+        return
+    for f in _take(point, step,
+                   kinds=("corrupt", "truncate", "delay", "kill")):
+        if f.kind == "corrupt":
+            corrupt_file(path)
+        elif f.kind == "truncate":
+            truncate_file(path)
+        elif f.kind == "delay":
+            time.sleep(f.delay_s)
+        elif f.kind == "kill":  # pragma: no cover
+            _kill()
+
+
+def corrupt_file(path: str, offset: Optional[int] = None) -> None:
+    """Flip one byte of ``path`` in place (middle of the file unless
+    ``offset``) — shared by the ``corrupt`` fault and the integrity
+    tests so both corrupt the same way."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    pos = size // 2 if offset is None else offset
+    with open(path, "r+b") as fh:
+        fh.seek(pos)
+        b = fh.read(1)
+        fh.seek(pos)
+        fh.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> None:
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(max(0, int(size * keep_fraction)))
+
+
+def install_from_env(env: Optional[str] = None) -> List[Fault]:
+    """Parse ``TPUFLOW_FAULTS`` (or ``env``) and arm the specs —
+    ``point=kind[@step][xTIMES]`` joined by ``;``. Subprocess
+    harnesses (kill-9 tests, bench --faults children) use this; the
+    parse happens at module import so a trainer subprocess needs no
+    code change to be sabotaged."""
+    spec = os.environ.get("TPUFLOW_FAULTS", "") if env is None else env
+    out: List[Fault] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, rhs = part.partition("=")
+        if not rhs:
+            raise ValueError(f"bad fault spec {part!r} "
+                             "(want point=kind[@step][xTIMES])")
+        times = 1
+        if "x" in rhs:
+            rhs, _, t = rhs.rpartition("x")
+            times = int(t)
+        step: Optional[int] = None
+        if "@" in rhs:
+            rhs, _, s = rhs.partition("@")
+            step = int(s)
+        out.append(inject(point.strip(), rhs.strip(), step=step,
+                          times=times))
+    return out
+
+
+install_from_env()

@@ -170,13 +170,32 @@ class ModelCheckpoint(Callback):
                     self.checkpoint_dir, state, step=epoch + 1,
                     weights_only=self.save_weights_only,
                 )
+                self._gc()
                 return
-            save_checkpoint(
+            wrote = save_checkpoint(
                 self.checkpoint_dir,
                 state,
                 step=epoch + 1,
                 weights_only=self.save_weights_only,
             )
+        self._gc(just_wrote=wrote)
+
+    def _gc(self, just_wrote=None) -> None:
+        """Retention (ISSUE 10 satellite): cfg.keep_last_checkpoints
+        caps both checkpoint namespaces after each save — the newest
+        VALID checkpoint is never deleted (gc_checkpoints' rail;
+        ``just_wrote`` spares it re-reading the file this save
+        produced — async saves pass None, the write may be in
+        flight)."""
+        keep = getattr(
+            getattr(self.trainer, "cfg", None),
+            "keep_last_checkpoints", None,
+        )
+        if keep:
+            from tpuflow.ckpt.checkpoint import gc_checkpoints
+
+            gc_checkpoints(self.checkpoint_dir, keep,
+                           just_wrote=just_wrote)
 
     def on_train_end(self):
         if self._async is not None:

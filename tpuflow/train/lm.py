@@ -652,6 +652,57 @@ class LMTrainer:
             print(f"resumed from {path} (step {step})")
         return self._initial_epoch
 
+    # ---- elastic resize (ISSUE 10) ---------------------------------------
+
+    def _resize_world(self, new_world: int) -> None:
+        """Rebuild the trainer for ``new_world`` data-parallel replicas
+        IN-PROCESS (single-controller elastic resize at a block
+        boundary): snapshot the full state to host, rebuild the mesh
+        with the resized data axis over the process's own devices,
+        re-derive shardings by re-running init on the new mesh, then
+        place the snapshot back under the new layout
+        (``sharded.place_state_dict`` — the in-memory twin of the
+        on-disk sharded restore). Compiled executables are invalidated
+        — a resize is a recompile by construction; the multi-process
+        path (gang membership changes) instead persists a sharded
+        checkpoint and exits for the relauncher (see fit)."""
+        from tpuflow.ckpt.sharded import host_state_dict, place_state_dict
+
+        if self.model.seq_axis is not None:
+            raise ValueError(
+                "elastic resize with sequence parallelism is not "
+                "supported: the ring-attention degree is part of the "
+                "model's math, not just its layout"
+            )
+        host = host_state_dict(self.state)
+        axes = {
+            name: (int(new_world) if name == DATA_AXIS
+                   else int(self.mesh.shape[name]))
+            for name in self.mesh.axis_names
+        }
+        need = int(np.prod(list(axes.values())))
+        devices = list(jax.devices())
+        if need > len(devices):
+            raise ValueError(
+                f"elastic resize to world={new_world} needs {need} "
+                f"devices, have {len(devices)}"
+            )
+        self.mesh = build_nd_mesh(axes, devices=devices[:need])
+        self.world = int(new_world)
+        # re-init on the new mesh: re-derives _state_shardings (GSPMD)
+        # / the replicated template, then the snapshot overwrites every
+        # value — including step/rng, so training continues, not
+        # restarts
+        self.init_state()
+        self.state = place_state_dict(host, self.state)
+        self._tag_state()
+        self._train_step = None
+        self._eval_step = None
+        self._step_exec = None
+        self._sstep_execs = {}
+        self._flops_per_step = None
+        self._make_steps()
+
     # ---- fit -------------------------------------------------------------
 
     def _local_slice(self, batch_size: int) -> Tuple[int, int]:
@@ -763,6 +814,7 @@ class LMTrainer:
         run=None,
         initial_epoch: Optional[int] = None,
         on_epoch: Optional[Callable[[int, Dict[str, float]], None]] = None,
+        elastic=None,
     ) -> Dict[str, float]:
         """Train on ``(N, seq_len)`` int32 token rows — either in-memory
         (a numpy array) or streamed from disk (a
@@ -778,7 +830,17 @@ class LMTrainer:
         (pass it explicitly for full control, ≙ Trainer.fit). If no
         epochs remain (a restart landed on the final checkpoint), the
         restored model is evaluated instead so the returned metrics
-        always carry ``loss``."""
+        always carry ``loss``.
+
+        ``elastic`` is an optional
+        :class:`tpuflow.train.recovery.ElasticController`: polled at
+        superstep block boundaries (every step for K=1 — each boundary
+        is clean), a world change re-shards the state under a rebuilt
+        mesh and rescales the LR per Goyal et al. (single-controller
+        in-process; multi-process runs persist a sharded checkpoint
+        and exit for the relauncher). ``cfg.recovery`` arms the
+        watchdog-trip → rollback-to-last-good-checkpoint ladder
+        (tpuflow.train.recovery.RecoveryPolicy)."""
         cfg = self.cfg
         epochs = epochs if epochs is not None else cfg.epochs
         K = max(1, int(getattr(cfg, "superstep", 1)))
@@ -906,22 +968,48 @@ class LMTrainer:
         from tpuflow.obs.health import monitor_from_config
 
         self.health = monitor_from_config(cfg)
+        # fault-tolerance plane (ISSUE 10): the recovery ladder turns
+        # watchdog trips into rollback-and-replay instead of the
+        # halt-and-dump below; the fault-injection hooks in the step
+        # loops cost one dict-truthiness check when disarmed.
+        from tpuflow.testing import faults
+        from tpuflow.train.recovery import (policy_from_config,
+                                            record_recovery)
+
+        policy = policy_from_config(cfg)
+        if policy is not None and self.health is None:
+            raise ValueError(
+                "cfg.recovery has no trip source: arm watchdog=True "
+                "(or stall_timeout_s) so there is something to "
+                "recover from"
+            )
+        self._recovery_policy = policy  # introspection (tests, bench)
+        self._recovery_skip: set = set()  # steps whose batch replay drops
+        rollback_anchor = global_step  # ladder progress accounting
         from tpuflow.ckpt.checkpoint import join_async_writes
 
         from tpuflow.obs.health import closing as _closing_monitor
 
         preempted = False
+        sharded = bool(getattr(cfg, "sharded_checkpoint", False))
+        keep_last = getattr(cfg, "keep_last_checkpoints", None)
+        # epoch cursor is a while loop: a recovery rollback or an
+        # elastic resize re-enters an earlier/same epoch at an exact
+        # step position (resume_epoch/resume_skip generalize the
+        # mid-epoch preemption-resume fast-forward)
+        epoch = start
+        resume_epoch, resume_skip = start, skip_steps
         with sigterm_preempt_flag(use_preempt) as preempt, \
                 join_async_writes(lambda: [self._async_ckpt]), \
                 _closing_monitor(self.health):
-            for epoch in range(start, epochs):
+            while epoch < epochs:
                 # explicit begin/end (idempotent) — the body exits
                 # through break paths too
                 ep_span = trace.begin("train.epoch", epoch=epoch)
                 if self.health is not None:
                     # stepping resumes: the stall clock re-anchors
                     self.health.resume()
-                first_i = skip_steps if epoch == start else 0
+                first_i = resume_skip if epoch == resume_epoch else 0
                 if ds is not None:
                     batch_iter = ds.iter_epoch(epoch)
                     for _ in range(first_i):
@@ -947,6 +1035,7 @@ class LMTrainer:
                     rows = rows[proc * b_local : (proc + 1) * b_local]
                     return train_tokens[rows]
 
+                resize = None  # (new_world, step_index) from elastic
                 if K > 1:
                     # superstep mode: one fused K-step scan dispatch per
                     # block (device-resident (k,) loss blocks; the only
@@ -954,11 +1043,13 @@ class LMTrainer:
                     # first block), double-buffered staging, and blocks
                     # chunked so multi-process preempt-sync agreement
                     # points always land on block edges
-                    preempted, global_step, lr, t_epoch, timed_steps = (
+                    (preempted, global_step, lr, t_epoch, timed_steps,
+                     resize) = (
                         self._run_superstep_epoch(
                             K, first_i, steps_per_epoch, global_step,
                             losses, _host_rows, preempt, use_preempt,
-                            sync_every, preempt_mp,
+                            sync_every, preempt_mp, policy, elastic,
+                            rollback_anchor,
                         )
                     )
                 else:
@@ -971,14 +1062,33 @@ class LMTrainer:
                         if (self.health is not None
                                 and self.health.tripped):
                             break
+                        # every K=1 step edge is a clean resize point
+                        # (the degenerate superstep block boundary)
+                        faults.fire("elastic.boundary",
+                                    step=global_step)
+                        if elastic is not None:
+                            nw = elastic.check(self.world)
+                            if nw is not None:
+                                resize = (nw, i)
+                                break
                         with trace.span("train.data_wait",
                                         phase="data_wait"):
                             local_rows = _host_rows(i)
+                        if global_step in self._recovery_skip:
+                            # skip-batch escalation: the poisoned
+                            # step's batch is consumed from the stream
+                            # but never trained on — the only forward
+                            # path past a deterministically toxic batch
+                            global_step += 1
+                            continue
+                        faults.fire("train.step", step=global_step)
                         with trace.span("train.device_put",
                                         phase="data_wait"):
                             toks = self._put(local_rows)
                             _mem.tag("data_staging", toks)
                         lr = self.lr_controller.lr_for_step(global_step)
+                        if policy is not None:
+                            lr *= policy.lr_scale  # escalation drop
                         lr_arr = jnp.asarray(lr, jnp.float32)
                         if self._step_exec is None:
                             # ONE compile per fit: the AOT executable both
@@ -1006,6 +1116,8 @@ class LMTrainer:
                             self.state, m = self._step_exec(
                                 self.state, toks, lr_arr
                             )
+                        m = faults.mutate_metrics("train.metrics", m,
+                                                  step=global_step)
                         losses.append(m["loss"])
                         if self.health is not None:
                             # device-resident handoff — the monitor's
@@ -1022,14 +1134,106 @@ class LMTrainer:
                                 float(m["loss"])
                             t_epoch = time.time()
                             timed_steps = steps_per_epoch - first_i - 1
+                if resize is not None:
+                    # elastic data-parallel resize (ISSUE 10): a
+                    # replica was lost/joined and the controller agreed
+                    # on a new world at this block boundary
+                    new_world, at_i = resize
+                    old_world = self.world
+                    if (jax.process_count() == 1
+                            and batch_size % new_world):
+                        # an incompatible target world must not tear
+                        # down a healthy run — refuse and train on;
+                        # the controller suppresses the refused target
+                        # until its oracle changes its answer (a
+                        # zero-interval controller would otherwise
+                        # re-ask at every boundary and starve the fit)
+                        elastic.refuse(new_world)
+                        if is_primary():
+                            print(
+                                f"elastic resize to world={new_world} "
+                                f"refused: global batch {batch_size} "
+                                "not divisible by the new data axis"
+                            )
+                        resume_epoch, resume_skip = epoch, at_i
+                        trace.end(ep_span, resize_refused=True)
+                        continue
+                    if jax.process_count() > 1:
+                        # multi-process: the gang itself must change, so
+                        # persist a SHARDED checkpoint (restore under
+                        # the new process count re-slices it) and exit
+                        # for the relauncher
+                        if checkpoint_dir:
+                            from tpuflow.ckpt.sharded import (
+                                save_sharded_checkpoint)
+
+                            with trace.span("train.checkpoint",
+                                            phase="checkpoint"):
+                                save_sharded_checkpoint(
+                                    checkpoint_dir, self.state,
+                                    global_step)
+                        metrics = dict(metrics)
+                        metrics["elastic_exit_at_step"] = float(
+                            global_step)
+                        # fit RETURNS (a library cannot sys.exit);
+                        # the driver script must see this key and exit
+                        # nonzero / re-exec so the cluster manager
+                        # relaunches with the new process count — the
+                        # --local relauncher cannot (it replays the
+                        # SAME world), which is why this is the
+                        # multi-process path only
+                        metrics["elastic_desired_world"] = float(
+                            new_world)
+                        if is_primary():
+                            print(f"elastic resize {old_world}->"
+                                  f"{new_world} at step {global_step}: "
+                                  "sharded checkpoint saved; caller "
+                                  "must relaunch the gang at the new "
+                                  "world (metrics carry "
+                                  "elastic_desired_world)")
+                        trace.end(ep_span, elastic_exit=True)
+                        break
+                    # single-controller: rebuild the mesh in-process,
+                    # re-shard the state under it, rescale the LR per
+                    # Goyal et al. (the LRController's world scaling)
+                    self._resize_world(new_world)
+                    self.lr_controller = LRController(
+                        cfg.learning_rate,
+                        world_size=self.world,
+                        scale_by_world_size=cfg.scale_lr_by_world_size,
+                        warmup_epochs=cfg.warmup_epochs,
+                        steps_per_epoch=steps_per_epoch,
+                        decay=cfg.lr_decay,
+                        total_steps=epochs * steps_per_epoch,
+                        min_lr=cfg.min_lr,
+                    )
+                    b_local, proc = self._local_slice(batch_size)
+                    elastic.note_resize(old_world, new_world,
+                                        global_step)
+                    if is_primary():
+                        print(f"elastic resize {old_world}->{new_world} "
+                              f"at step {global_step} (lr x"
+                              f"{new_world / old_world:g} via world "
+                              "scaling)")
+                    resume_epoch, resume_skip = epoch, at_i
+                    trace.end(ep_span, resized=True)
+                    continue
                 if preempted:
                     from tpuflow.ckpt.checkpoint import save_step_checkpoint
 
                     with trace.span("train.checkpoint",
                                     phase="checkpoint"):
-                        spath = save_step_checkpoint(
-                            checkpoint_dir, self.state, global_step
-                        )
+                        if sharded:
+                            from tpuflow.ckpt.sharded import (
+                                save_sharded_checkpoint)
+
+                            spath = save_sharded_checkpoint(
+                                checkpoint_dir, self.state, global_step
+                            )
+                        else:
+                            spath = save_step_checkpoint(
+                                checkpoint_dir, self.state, global_step
+                            )
                     metrics["preempted_at_step"] = float(global_step)
                     if is_primary():
                         print(f"preempted at step {global_step}; saved {spath}")
@@ -1045,15 +1249,94 @@ class LMTrainer:
                     self.health.drain()
                     if self.health.tripped:
                         trips = self.health.trips()
-                        tstep = next(
+                        tstep = int(next(
                             (t["step"] for t in trips
                              if "step" in t), global_step
-                        )
+                        ))
+                        reason = (trips[0].get("reason",
+                                               "watchdog trip")
+                                  if trips else "watchdog trip")
+                        act = (policy.on_trip(tstep, reason=reason)
+                               if policy is not None else None)
+                        if act is not None and act.kind == "rollback":
+                            # auto-recovery (ISSUE 10): rollback to the
+                            # last GOOD checkpoint and replay, instead
+                            # of halt-and-dump. Corrupt/truncated files
+                            # are skipped by discovery; nothing on disk
+                            # yet ⇒ restart from the seed init.
+                            if act.backoff_s > 0:
+                                time.sleep(act.backoff_s)
+                            from tpuflow.ckpt.checkpoint import (
+                                latest_resume_point)
+
+                            found = (latest_resume_point(
+                                checkpoint_dir, steps_per_epoch)
+                                if checkpoint_dir else None)
+                            if found is not None:
+                                rpath, r_epoch, r_skip = found
+                                with trace.span("train.rollback",
+                                                phase="checkpoint"):
+                                    self.state = restore_into_state(
+                                        rpath, self.state)
+                            else:
+                                rpath, r_epoch, r_skip = None, 0, 0
+                                self.init_state()
+                            self._tag_state()
+                            rollback_to = (r_epoch * steps_per_epoch
+                                           + r_skip)
+                            if int(self.state.step) != rollback_to:
+                                # weights-only checkpoint (the restore
+                                # branch that keeps step/opt_state):
+                                # the POISONED optimizer moments would
+                                # re-NaN every replay — re-init the
+                                # optimizer fresh at the rollback
+                                # point. Fresh moments follow the
+                                # params' layout, not a zero1/fsdp
+                                # spec, so the AOT executables must
+                                # re-derive from the actual state
+                                self.state = self.state.replace(
+                                    step=rollback_to,
+                                    opt_state=self.tx.init(
+                                        self.state.params),
+                                )
+                                self._step_exec = None
+                                self._sstep_execs = {}
+                            if act.skip_step is not None:
+                                self._recovery_skip.add(act.skip_step)
+                            record_recovery(
+                                policy, rollback_from=global_step,
+                                rollback_to=rollback_to)
+                            # consume the trip: the monitor re-arms
+                            # (fresh spike EWMA) but the process
+                            # watchdog keeps the latched history for
+                            # flight manifests / post-mortems
+                            self.health.acknowledge()
+                            if is_primary():
+                                print(
+                                    f"watchdog tripped ({reason}); "
+                                    f"rollback #{act.retry} to step "
+                                    f"{rollback_to} "
+                                    + (f"[{rpath}]" if rpath
+                                       else "[re-init]")
+                                    + (f", lr x{act.lr_scale:g}"
+                                       if act.lr_scale != 1.0 else "")
+                                    + (f", skipping batch of step "
+                                       f"{act.skip_step}"
+                                       if act.skip_step is not None
+                                       else "")
+                                )
+                            global_step = rollback_to
+                            epoch = r_epoch
+                            resume_epoch, resume_skip = r_epoch, r_skip
+                            rollback_anchor = rollback_to
+                            trace.end(ep_span, rollback=True)
+                            continue
                         metrics = dict(metrics)
                         metrics["watchdog_tripped_at"] = float(tstep)
                         if is_primary():
-                            print(f"watchdog tripped: "
-                                  f"{trips[0]['reason']}; "
+                            why = (act.reason if act is not None
+                                   else reason)
+                            print(f"watchdog tripped: {why}; "
                                   f"stopping at step {global_step}")
                         trace.end(ep_span, watchdog_tripped=True)
                         break
@@ -1115,7 +1398,19 @@ class LMTrainer:
                 if checkpoint_dir:
                     with trace.span("train.checkpoint",
                                     phase="checkpoint"):
-                        if getattr(cfg, "async_checkpoint", False):
+                        wrote = None
+                        if sharded:
+                            # sharded epoch-boundary checkpoint: step
+                            # namespace (manifests speak global steps);
+                            # resume via maybe_resume(steps_per_epoch=)
+                            from tpuflow.ckpt.sharded import (
+                                save_sharded_checkpoint)
+
+                            wrote = save_sharded_checkpoint(
+                                checkpoint_dir, self.state,
+                                (epoch + 1) * steps_per_epoch,
+                            )
+                        elif getattr(cfg, "async_checkpoint", False):
                             if self._async_ckpt is None:
                                 from tpuflow.ckpt import AsyncCheckpointer
 
@@ -1124,19 +1419,34 @@ class LMTrainer:
                                 checkpoint_dir, self.state, epoch + 1
                             )
                         else:
-                            save_checkpoint(
+                            wrote = save_checkpoint(
                                 checkpoint_dir, self.state, epoch + 1
                             )
+                    if keep_last:
+                        from tpuflow.ckpt.checkpoint import gc_checkpoints
+
+                        # just_wrote: the file this save produced needs
+                        # no re-read for the newest-valid rail (async
+                        # saves pass None — the write may be in flight)
+                        gc_checkpoints(checkpoint_dir, keep_last,
+                                       just_wrote=wrote)
+                if policy is not None:
+                    # clean steps since the last rollback: past the
+                    # reset threshold the escalation ladder clears
+                    policy.note_progress(global_step - rollback_anchor)
                 if on_epoch is not None:
                     on_epoch(epoch, metrics)
                 trace.end(ep_span)
+                epoch += 1
         # the stall thread stopped with the closing() cm above (even on
         # exception paths); trip state stays readable on self.health
         return metrics
 
     def _run_superstep_epoch(self, K, first_i, steps_per_epoch,
                              global_step, losses, host_rows, preempt,
-                             use_preempt, sync_every, preempt_mp):
+                             use_preempt, sync_every, preempt_mp,
+                             policy=None, elastic=None,
+                             rollback_anchor=0):
         """One epoch of superstep execution (cfg.superstep > 1): fused
         K-step scan dispatches over stacked token blocks.
 
@@ -1152,33 +1462,70 @@ class LMTrainer:
           program + at most one remainder tail) and chunked so
           multi-process preemption agreement points land on block
           edges — the collective schedule across processes is identical
-          to the K=1 loop's.
+          to the K=1 loop's;
+        - block edges are the clean boundaries of the fault-tolerance
+          plane (ISSUE 10): the elastic controller is polled there, and
+          a recovery-skip step (escalation level 3) splits its block —
+          the poisoned batch is consumed from the stream but never
+          dispatched (the split may add one compile size per distinct
+          sub-run length; only reachable after ``skip_batch_after``
+          consecutive trips).
 
-        Returns ``(preempted, global_step, lr, t_epoch, timed_steps)``.
+        Returns ``(preempted, global_step, lr, t_epoch, timed_steps,
+        resize)`` where ``resize`` is ``(new_world, epoch_step_index)``
+        or None.
         """
         import collections
 
+        from tpuflow.testing import faults
         from tpuflow.train.preempt import should_stop, superstep_sizes
 
         sizes = superstep_sizes(
             steps_per_epoch - first_i, K, global_step,
             sync_every if (use_preempt and preempt_mp) else 0,
         )
+        if self._recovery_skip:
+            # split each planned block at recovery-skip steps (sync
+            # edges of the original plan are preserved — splitting only
+            # subdivides within a block)
+            plan = []
+            consumed = 0
+            for sz in sizes:
+                run = 0
+                for _j in range(sz):
+                    if (global_step + consumed) in self._recovery_skip:
+                        if run:
+                            plan.append(("train", run))
+                            run = 0
+                        plan.append(("skip", 1))
+                    else:
+                        run += 1
+                    consumed += 1
+                if run:
+                    plan.append(("train", run))
+        else:
+            plan = [("train", sz) for sz in sizes]
         depth = 2  # classic double buffer: assemble i+1 while i runs
 
         def blocks():
             buf = collections.deque()
             i = first_i
-            for want in sizes:
-                with trace.span("train.data_wait", phase="data_wait",
-                                k=want):
-                    rows = [host_rows(i + j) for j in range(want)]
-                i += want
-                with trace.span("train.device_put", phase="data_wait",
-                                k=want):
-                    blk = self._put_block(rows)
-                    _mem.tag("data_staging", blk)
-                    buf.append((want, blk))
+            for kind, want in plan:
+                if kind == "skip":
+                    # consume the poisoned step's rows, stage nothing
+                    host_rows(i)
+                    i += 1
+                    buf.append(("skip", 1, None))
+                else:
+                    with trace.span("train.data_wait",
+                                    phase="data_wait", k=want):
+                        rows = [host_rows(i + j) for j in range(want)]
+                    i += want
+                    with trace.span("train.device_put",
+                                    phase="data_wait", k=want):
+                        blk = self._put_block(rows)
+                        _mem.tag("data_staging", blk)
+                        buf.append(("train", want, blk))
                 if len(buf) >= depth:
                     yield buf.popleft()
             while buf:
@@ -1186,21 +1533,39 @@ class LMTrainer:
 
         blk_iter = blocks()
         preempted = False
+        resize = None
         t_epoch = None
         timed_steps = 0
+        i_epoch = first_i
         lr = self.lr_controller.lr_for_step(global_step)
-        for _ in sizes:
+        for _ in plan:
             if use_preempt and should_stop(
                     preempt, global_step, sync_every, preempt_mp):
                 preempted = True
                 break
             if self.health is not None and self.health.tripped:
                 break
-            k, toks = next(blk_iter)
+            faults.fire("elastic.boundary", step=global_step)
+            if elastic is not None:
+                nw = elastic.check(self.world)
+                if nw is not None:
+                    resize = (nw, i_epoch)
+                    break
+            kind, k, toks = next(blk_iter)
+            if kind == "skip":
+                # skip-batch escalation: stream consumed, step counted,
+                # nothing trained
+                global_step += 1
+                i_epoch += 1
+                continue
+            for j in range(k):
+                faults.fire("train.step", step=global_step + j)
             lr_list = [
                 self.lr_controller.lr_for_step(global_step + j)
                 for j in range(k)
             ]
+            if policy is not None and policy.lr_scale != 1.0:
+                lr_list = [v * policy.lr_scale for v in lr_list]
             lr = lr_list[-1]
             lrs_arr = jnp.asarray(lr_list, jnp.float32)
             ex = self._sstep_execs.get(k)
@@ -1228,21 +1593,25 @@ class LMTrainer:
                     ) / max(1, ca.get("per_device", 1))
             with trace.span("train.superstep", phase="dispatch", k=k):
                 self.state, m = ex(self.state, toks, lrs_arr)
+            m = faults.mutate_metrics("train.metrics", m,
+                                      step=global_step + k - 1, k=k)
             losses.append(m["loss"])
             if self.health is not None:
                 # whole (k,)-stacked block, still device-resident; the
                 # guard attributes a bad entry to its exact step
                 self.health.watch_device(global_step + k - 1, m)
             global_step += k
+            i_epoch += k
             if t_epoch is None:
                 # sync after the FIRST block only: compile stays out of
                 # the timed window, and this is the epoch's single
                 # mid-flight host fetch
                 with trace.span("train.sync", phase="device"):
-                    float(m["loss"][-1])
+                    float(np.asarray(m["loss"])[-1])
                 t_epoch = time.time()
                 timed_steps = steps_per_epoch - first_i - k
-        return preempted, global_step, lr, t_epoch, timed_steps
+        return (preempted, global_step, lr, t_epoch, timed_steps,
+                resize)
 
     # ---- evaluation ------------------------------------------------------
 

@@ -85,6 +85,20 @@ def should_stop(flag: dict, global_step: int, sync_every: int,
     return agree_on_preempt(flag)
 
 
+def agree_on_world(desired: int) -> int:
+    """Multi-process agreement on an elastic-resize target (ISSUE 10):
+    all-process MIN of each host's desired data-parallel world — the
+    conservative merge (a host that lost a replica wins over hosts
+    that have not noticed yet), and, like :func:`agree_on_preempt`, a
+    COLLECTIVE: call it at the same block boundary on every process
+    (ElasticController does)."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    vals = multihost_utils.process_allgather(np.int32(desired))
+    return int(np.min(vals))
+
+
 def superstep_sizes(n_steps: int, K: int, step0: int,
                     sync_every: int = 0) -> list:
     """Chunk ``n_steps`` (starting at global step ``step0``) into

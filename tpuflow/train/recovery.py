@@ -1,0 +1,289 @@
+"""Auto-recovery + elastic-resize policy (ISSUE 10 tentpole pieces 2-3).
+
+PR 5's watchdogs DETECT a bad run (NaN, loss spike, stall) and halt it
+with a post-mortem; at fleet scale the halt itself is the cost — every
+trip that a rollback would have absorbed becomes a human page plus the
+queue time of a manual restart. This module is the decision layer that
+makes the watchdogs load-bearing:
+
+- :class:`RecoveryPolicy` — turns a watchdog trip into a bounded,
+  escalating response: rollback to the last good checkpoint and replay
+  (transient faults: a cosmic-ray NaN, a bad host read); after
+  ``lr_drop_after`` consecutive trips also drop the LR by
+  ``lr_drop_factor`` (instability: the large-batch divergence regime
+  of Goyal et al., PAPERS.md — the same knob ReduceLROnPlateau turns,
+  pulled by the trip instead of a plateau); after
+  ``skip_batch_after`` consecutive trips also SKIP the poisoned
+  step's batch on replay (data faults: one toxic batch deterministically
+  NaNs every replay — dropping it is the only forward path); past
+  ``max_retries`` consecutive trips, halt with the classic post-mortem
+  (a policy that never gives up turns a hard bug into an infinite
+  chip-hour burn). Progress resets the ladder: a rollback that then
+  trains ``progress_reset_steps`` clean steps was a recovery, not a
+  loop.
+
+- :class:`ElasticController` — the resize decision for replica
+  loss/join. ``check(now_world)`` returns the new desired
+  data-parallel world (or None); the trainers poll it ONLY at
+  superstep block boundaries (PR 2's clean resize points — no
+  in-flight collective to tear). The LR rescale follows Goyal et al.'s
+  linear rule via :func:`goyal_lr_scale` — the LRController already
+  scales by world size, so a resized fit rebuilds it with the new
+  world and the schedule follows.
+
+The policies are pure host state machines (injectable, unit-testable);
+the trainers wire them in ``fit`` (tpuflow/train/lm.py, trainer.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+def goyal_lr_scale(old_world: int, new_world: int) -> float:
+    """Linear LR scaling across a data-parallel resize (Goyal et al.,
+    *Accurate, Large Minibatch SGD*): LR ∝ number of replicas, so a
+    resize from W→W' multiplies the LR by W'/W. The trainers get this
+    for free by rebuilding the LRController with the new world size
+    when ``scale_lr_by_world_size`` is on; this helper is the explicit
+    form (used when scaling is off, and by tests pinning the rule)."""
+    if old_world < 1 or new_world < 1:
+        raise ValueError(
+            f"world sizes must be >= 1, got {old_world} -> {new_world}"
+        )
+    return float(new_world) / float(old_world)
+
+
+@dataclasses.dataclass
+class RecoveryAction:
+    """One trip's verdict. ``kind`` is ``'rollback'`` or ``'halt'``;
+    on rollback, ``lr_scale`` multiplies the run's LR (cumulative
+    across the ladder, 1.0 = no drop), ``skip_step`` names a global
+    step whose batch the replay must drop (None = replay everything),
+    ``backoff_s`` is the pre-restore sleep."""
+
+    kind: str
+    retry: int = 0
+    lr_scale: float = 1.0
+    skip_step: Optional[int] = None
+    backoff_s: float = 0.0
+    reason: str = ""
+
+
+class RecoveryPolicy:
+    """Bounded-retry escalation ladder over watchdog trips.
+
+    Consecutive-failure accounting: ``on_trip`` increments the retry
+    count; ``note_progress(steps)`` resets it once a post-rollback run
+    survives ``progress_reset_steps`` steps — so a month-long run may
+    absorb many ISOLATED faults while a tight trip loop still halts
+    after ``max_retries``.
+    """
+
+    def __init__(
+        self,
+        max_retries: int = 3,
+        backoff_s: float = 0.0,
+        backoff_mult: float = 2.0,
+        lr_drop_after: int = 2,
+        lr_drop_factor: float = 0.5,
+        skip_batch_after: int = 3,
+        progress_reset_steps: int = 64,
+    ):
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        if not 0.0 < lr_drop_factor <= 1.0:
+            raise ValueError(
+                f"lr_drop_factor must be in (0, 1], got {lr_drop_factor}"
+            )
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_mult = float(backoff_mult)
+        self.lr_drop_after = int(lr_drop_after)
+        self.lr_drop_factor = float(lr_drop_factor)
+        self.skip_batch_after = int(skip_batch_after)
+        self.progress_reset_steps = int(progress_reset_steps)
+        self.retries = 0          # consecutive trips since progress
+        self.lr_scale = 1.0       # cumulative drop applied so far
+        self.history: List[Dict[str, Any]] = []  # flight-note feed
+
+    def on_trip(self, tripped_step: int,
+                reason: str = "watchdog trip") -> RecoveryAction:
+        """The decision for one trip at ``tripped_step``."""
+        self.retries += 1
+        if self.retries > self.max_retries:
+            act = RecoveryAction(
+                kind="halt", retry=self.retries,
+                lr_scale=self.lr_scale,
+                reason=f"{reason}: retry budget exhausted "
+                       f"({self.max_retries})",
+            )
+        else:
+            if self.retries >= self.lr_drop_after:
+                self.lr_scale *= self.lr_drop_factor
+            act = RecoveryAction(
+                kind="rollback",
+                retry=self.retries,
+                lr_scale=self.lr_scale,
+                skip_step=(
+                    tripped_step
+                    if self.retries >= self.skip_batch_after else None
+                ),
+                backoff_s=self.backoff_s
+                * (self.backoff_mult ** (self.retries - 1)),
+                reason=reason,
+            )
+        self.history.append({
+            "step": int(tripped_step),
+            "retry": self.retries,
+            "action": act.kind,
+            "lr_scale": act.lr_scale,
+            "skip_step": act.skip_step,
+            "reason": reason,
+            "ts": time.time(),
+        })
+        return act
+
+    def note_progress(self, steps_since_rollback: int) -> None:
+        """Training survived ``steps_since_rollback`` steps after the
+        last rollback: once past the reset threshold the ladder state
+        clears (the NEXT fault starts at retry 1 with the full LR —
+        the drop was an escalation device, not a permanent schedule
+        change; a genuinely unstable run re-earns it in two trips)."""
+        if (self.retries and
+                steps_since_rollback >= self.progress_reset_steps):
+            self.retries = 0
+            self.lr_scale = 1.0
+
+
+def policy_from_config(cfg) -> Optional[RecoveryPolicy]:
+    """The trainers' one-liner: a :class:`RecoveryPolicy` from
+    ``TrainConfig``'s recovery fields, or None when disarmed
+    (``cfg.recovery`` false)."""
+    if not getattr(cfg, "recovery", False):
+        return None
+    return RecoveryPolicy(
+        max_retries=getattr(cfg, "recovery_max_retries", 3),
+        backoff_s=getattr(cfg, "recovery_backoff_s", 0.0),
+        lr_drop_after=getattr(cfg, "recovery_lr_drop_after", 2),
+        lr_drop_factor=getattr(cfg, "recovery_lr_drop_factor", 0.5),
+        skip_batch_after=getattr(cfg, "recovery_skip_batch_after", 3),
+    )
+
+
+def record_recovery(policy: RecoveryPolicy, *, rollback_from: int,
+                    rollback_to: int, kind: str = "rollback") -> None:
+    """Publish one recovery event to the observability plane:
+    ``train.recoveries_total`` / ``train.rollback_steps_total``
+    counters (Prometheus + /v1/metrics for free via the registry) and
+    a ``recovery`` note on every future flight-record manifest — the
+    post-mortem of a run that recovered five times must SHOW the five
+    recoveries (ISSUE 10 satellite)."""
+    from tpuflow.obs import flight
+    from tpuflow.obs.gauges import inc_counter
+
+    inc_counter("train.recoveries_total")
+    inc_counter("train.rollback_steps_total",
+                max(0, int(rollback_from) - int(rollback_to)))
+    flight.annotate("recovery", list(policy.history))
+
+
+class ElasticController:
+    """Desired-world oracle for elastic data-parallel resize.
+
+    ``desired`` is a zero-arg callable returning the CURRENT desired
+    number of data-parallel replicas (a cluster-manager hook, a
+    membership file's line count, a test's scripted schedule...).
+    :meth:`check` compares it against the running world and returns
+    the agreed new world when they differ — at most once per
+    ``min_interval_s`` so a flapping oracle cannot thrash recompiles.
+
+    Multi-process gangs must AGREE on the resize step (the same
+    identical-collective-schedule invariant the preemption flag
+    honors): ``check`` routes the desired value through
+    :func:`tpuflow.train.preempt.agree_on_world` — an all-process MIN
+    — when ``multiprocess`` is set, so every process resizes at the
+    same block boundary or none does."""
+
+    def __init__(self, desired: Callable[[], int],
+                 min_interval_s: float = 0.0,
+                 multiprocess: Optional[bool] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.desired = desired
+        self.min_interval_s = float(min_interval_s)
+        self.clock = clock
+        if multiprocess is None:
+            import jax
+
+            multiprocess = jax.process_count() > 1
+        self.multiprocess = bool(multiprocess)
+        self._last_check = -float("inf")
+        self._refused: Optional[int] = None
+        self.resizes: List[Dict[str, Any]] = []
+
+    def check(self, current_world: int) -> Optional[int]:
+        """The agreed new world size, or None (no change / throttled).
+        Call ONLY at superstep block boundaries — a resize tears down
+        the compiled step."""
+        now = self.clock()
+        if self.multiprocess:
+            # the agreement collective must run on EVERY process at
+            # EVERY boundary (the identical-collective-schedule
+            # invariant): a per-host wall-clock throttle deciding
+            # whether to ENTER the allgather would let one process
+            # skip it while another blocks in it forever. Instead the
+            # throttle verdict itself is merged through the collective
+            # — a throttled process contributes 0, the MIN makes the
+            # whole gang stand down together.
+            from tpuflow.train.preempt import agree_on_world
+
+            ready = now - self._last_check >= self.min_interval_s
+            want = agree_on_world(
+                int(self.desired()) if ready else 0)
+            if want < 1:
+                return None
+            self._last_check = now
+        else:
+            if now - self._last_check < self.min_interval_s:
+                return None
+            self._last_check = now
+            want = int(self.desired())
+        if self._refused is not None:
+            # a refused target stays suppressed until the oracle asks
+            # for something else — the refusal came from an invariant
+            # (batch divisibility) that re-asking cannot change, and a
+            # zero-interval controller would otherwise re-ask at every
+            # boundary and starve training
+            if want == self._refused:
+                return None
+            self._refused = None
+        if want < 1 or want == int(current_world):
+            return None
+        return want
+
+    def refuse(self, world: int) -> None:
+        """The trainer could not honor a resize to ``world`` (e.g. the
+        global batch is not divisible by it): suppress that target
+        until :attr:`desired` changes its answer."""
+        self._refused = int(world)
+
+    def note_resize(self, old_world: int, new_world: int,
+                    global_step: int) -> None:
+        """Publish one resize to the plane (counter + flight note) and
+        remember it for tests/introspection."""
+        from tpuflow.obs import flight
+        from tpuflow.obs.gauges import inc_counter, set_gauge
+
+        rec = {
+            "step": int(global_step),
+            "from_world": int(old_world),
+            "to_world": int(new_world),
+            "lr_scale": goyal_lr_scale(old_world, new_world),
+            "ts": time.time(),
+        }
+        self.resizes.append(rec)
+        inc_counter("train.elastic_resizes_total")
+        set_gauge("train.world_size", float(new_world))
+        flight.annotate("elastic_resize", list(self.resizes))

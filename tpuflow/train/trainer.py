@@ -550,6 +550,26 @@ class Trainer:
         from tpuflow.obs.health import monitor_from_config
 
         self.health = monitor_from_config(cfg)
+        # fault-tolerance plane (ISSUE 10): cfg.recovery turns a
+        # watchdog trip into rollback-to-last-good-checkpoint with the
+        # bounded escalation ladder (tpuflow.train.recovery). The image
+        # trainer's feed is a forward-only stream, so the replay is
+        # BEST-EFFORT: state rolls back exactly, the stream continues
+        # from where it is (exact-replay parity is the LM trainer's
+        # contract — its epoch order is deterministic and seekable).
+        # The skip-batch escalation level is likewise LM-only.
+        from tpuflow.testing import faults
+        from tpuflow.train.recovery import (policy_from_config,
+                                            record_recovery)
+
+        policy = policy_from_config(cfg)
+        if policy is not None and self.health is None:
+            raise ValueError(
+                "cfg.recovery has no trip source: arm watchdog=True "
+                "(or stall_timeout_s) so there is something to "
+                "recover from"
+            )
+        self._recovery_policy = policy  # introspection (tests, bench)
 
         # preemption-safe mode (cfg.checkpoint_on_preempt): SIGTERM
         # sets a flag; the step loop finishes the CURRENT step, writes
@@ -617,11 +637,17 @@ class Trainer:
         from tpuflow.obs.health import closing as _closing_monitor
 
         preempted = False
+        # epoch cursor is a while loop (ISSUE 10): a recovery rollback
+        # re-enters an earlier epoch number with restored state (the
+        # stream itself only moves forward — best-effort, see above)
+        epoch = initial_epoch
+        pending_skip = skip_steps  # consumed by the first epoch only
+        rollback_anchor = global_step
         with sigterm_preempt_flag(use_preempt) as preempt, \
                 join_async_writes(lambda: [
                     getattr(cb, "_async", None) for cb in cbs]), \
                 _closing_monitor(self.health):
-            for epoch in range(initial_epoch, epochs):
+            while epoch < epochs:
                 # explicit begin/end (not `with`): the body exits
                 # through several break paths; trace.end is idempotent
                 # so every path may close it
@@ -630,9 +656,8 @@ class Trainer:
                     # stepping resumes: the stall clock re-anchors
                     self.health.resume()
                 step_metrics = []
-                steps_this_epoch = steps_per_epoch - (
-                    skip_steps if epoch == initial_epoch else 0
-                )
+                steps_this_epoch = steps_per_epoch - pending_skip
+                pending_skip = 0
                 if K > 1:
                     # superstep mode: one fused scan dispatch per block;
                     # blocks are chunked so every preempt-sync boundary
@@ -656,10 +681,15 @@ class Trainer:
                             exhausted = True
                             break
                         k, images, labels = blk
+                        for j in range(k):
+                            faults.fire("train.step",
+                                        step=global_step + j)
                         lrs = [
                             self.lr_controller.lr_for_step(global_step + j)
                             for j in range(k)
                         ]
+                        if policy is not None and policy.lr_scale != 1.0:
+                            lrs = [v * policy.lr_scale for v in lrs]
                         lr = lrs[-1]
                         with trace.span("train.superstep",
                                         phase="dispatch", k=k):
@@ -667,6 +697,9 @@ class Trainer:
                                 self.state, images, labels,
                                 jnp.asarray(lrs, jnp.float32),
                             )
+                        m = faults.mutate_metrics(
+                            "train.metrics", m,
+                            step=global_step + k - 1, k=k)
                         # m holds (k,)-stacked per-step metrics, still
                         # device-resident — the epoch-end _mean_metrics
                         # fetch is the only host sync (the health
@@ -693,6 +726,8 @@ class Trainer:
                                 and self.health.tripped):
                             break
                         lr = self.lr_controller.lr_for_step(global_step)
+                        if policy is not None:
+                            lr *= policy.lr_scale  # escalation drop
                         try:
                             images, labels = next(train_iter)
                         except StopIteration:
@@ -701,12 +736,15 @@ class Trainer:
                             # (Keras semantics)
                             exhausted = True
                             break
+                        faults.fire("train.step", step=global_step)
                         with trace.span("train.dispatch",
                                         phase="dispatch"):
                             self.state, m = self._train_step(
                                 self.state, images, labels,
                                 jnp.asarray(lr, jnp.float32),
                             )
+                        m = faults.mutate_metrics("train.metrics", m,
+                                                  step=global_step)
                         step_metrics.append(m)
                         if self.health is not None:
                             self.health.watch_device(global_step, m)
@@ -740,15 +778,92 @@ class Trainer:
                     self.health.drain()
                     if self.health.tripped:
                         trips = self.health.trips()
-                        history.history.setdefault(
-                            "watchdog_tripped_at", []
-                        ).append(float(next(
+                        tstep = int(next(
                             (t["step"] for t in trips
                              if "step" in t), global_step
-                        )))
+                        ))
+                        reason = (trips[0].get("reason",
+                                               "watchdog trip")
+                                  if trips else "watchdog trip")
+                        act = (policy.on_trip(tstep, reason=reason)
+                               if policy is not None else None)
+                        if act is not None and act.kind == "rollback":
+                            # auto-recovery (ISSUE 10): roll state back
+                            # to the last VALID checkpoint and keep
+                            # training (stream continues forward —
+                            # best-effort, see fit docstring); nothing
+                            # on disk yet ⇒ restart from a fresh init
+                            if act.backoff_s > 0:
+                                import time as _time
+
+                                _time.sleep(act.backoff_s)
+                            from tpuflow.ckpt.checkpoint import (
+                                latest_resume_point, restore_into_state)
+
+                            found = (latest_resume_point(
+                                self.cfg.checkpoint_dir,
+                                steps_per_epoch)
+                                if self.cfg.checkpoint_dir else None)
+                            if found is not None:
+                                rpath, r_epoch, r_skip = found
+                                with trace.span("train.rollback",
+                                                phase="checkpoint"):
+                                    self.state = restore_into_state(
+                                        rpath, self.state)
+                            else:
+                                rpath, r_epoch, r_skip = None, 0, 0
+                                self.init_state((train_ds.img_height,
+                                                 train_ds.img_width, 3))
+                            self._tag_state()
+                            rollback_to = (r_epoch * steps_per_epoch
+                                           + r_skip)
+                            if int(self.state.step) != rollback_to:
+                                # weights-only checkpoint: the restore's
+                                # {params, batch_stats} branch kept the
+                                # POISONED step/opt_state — a NaN'd
+                                # Adam moment would re-NaN every
+                                # replay, so re-init the optimizer
+                                # fresh at the rollback point
+                                # (params-only recovery)
+                                self.state = self.state.replace(
+                                    step=rollback_to,
+                                    opt_state=self.tx.init(
+                                        self.state.params),
+                                )
+                            record_recovery(
+                                policy, rollback_from=global_step,
+                                rollback_to=rollback_to)
+                            self.health.acknowledge()
+                            history.history.setdefault(
+                                "recovered_at_step", []
+                            ).append(float(tstep))
+                            if verbose:
+                                print(
+                                    f"watchdog tripped ({reason}); "
+                                    f"rollback #{act.retry} to step "
+                                    f"{rollback_to} "
+                                    + (f"[{rpath}]" if rpath
+                                       else "[re-init]")
+                                )
+                            global_step = rollback_to
+                            epoch = r_epoch
+                            # a mid-epoch step checkpoint restores at
+                            # r_skip steps INTO epoch r_epoch: the
+                            # re-entered epoch must run the remainder,
+                            # or global_step drifts off the epoch grid
+                            # (LR schedule, future checkpoints, resume
+                            # math all key on it)
+                            pending_skip = r_skip
+                            rollback_anchor = rollback_to
+                            trace.end(ep_span, rollback=True)
+                            continue
+                        history.history.setdefault(
+                            "watchdog_tripped_at", []
+                        ).append(float(tstep))
                         if verbose:
-                            print(f"watchdog tripped: "
-                                  f"{trips[0]['reason']}; "
+                            why = (act.reason if act is not None
+                                   else reason)
+                            print(f"watchdog tripped: {why}; "
                                   f"stopping at step {global_step}")
                         trace.end(ep_span, watchdog_tripped=True)
                         break
@@ -766,7 +881,12 @@ class Trainer:
                         f"{k}={v:.4f}" for k, v in logs.items()))
                 for cb in cbs:
                     cb.on_epoch_end(epoch, logs)
+                if policy is not None:
+                    # clean steps since the last rollback: past the
+                    # reset threshold the escalation ladder clears
+                    policy.note_progress(global_step - rollback_anchor)
                 trace.end(ep_span)
+                epoch += 1
                 if self.stop_training or exhausted:
                     break
         # the closing() cm above stopped the stall thread (exception
