@@ -2735,21 +2735,37 @@ def _bench_serve(args, devices) -> int:
 
 
 def _bench_serve_paged(args, devices) -> int:
-    """--serve-paged: the ISSUE 6 A/B — paged-KV ServeScheduler
-    (fixed-size pages + per-slot page tables + copy-on-write prefix
-    sharing, ``kv='paged'``) vs the contiguous per-bucket cache, on
-    the SAME seeded virtual-clock traces:
+    """--serve-paged: the paged-KV ServeScheduler vs the contiguous
+    per-bucket cache on the SAME seeded virtual-clock traces (ISSUE 6
+    A/B, re-run for ISSUE 11 with the paged path as the FAST path:
+    donated in-place page stores + incremental per-segment page
+    allocation):
 
     - the ``--serve`` mixed-length trace (policy-neutral: measures the
-      paged engine's throughput overhead and the KV-memory headroom —
-      contiguous reserves ``buckets × slots × horizon`` whether or not
-      tokens exist, paged pays only for pages in use);
+      paged engine's throughput — acceptance now ≥ 1.0x of contiguous
+      tok/s, was a documented 0.92x when every decode step copied the
+      store — and the KV-memory headroom: contiguous reserves
+      ``buckets × slots × horizon`` whether or not tokens exist);
     - a SHARED-SYSTEM-PROMPT variant (every prompt = one 24-token
       system prefix + a unique 3..7-token suffix — the dominant
       pattern at scale): requests after the first hit the prefix cache
       and prefill only their suffix through a narrower compiled
       window, so the record reports hit rate, prefill tokens saved,
-      and the TTFT deltas that saving buys.
+      and the TTFT deltas that saving buys;
+    - SEGMENT-COST FLATNESS: the paged decode segment re-measured with
+      ``kv_pages`` DOUBLED at fixed concurrency — in-place donation
+      means the ratio must be ~1.0 (±10%), the PR 6 scaling cliff
+      gone;
+    - a MULTI-TURN ``kv_prefix_insert_generated`` A/B (the PR 8
+      carry-forward): follow-up prompts extending finished transcripts
+      with the flag on vs off, recording phase-2 prefill tokens saved
+      and the tree-retention cost — the data the default gets decided
+      on (``insert_generated.verdict``);
+    - HELD-VS-BUDGET: mean pages a mixed-trace request actually held
+      across its decode boundaries, both over its OWN worst-case
+      budget and over the max_new_cap provisioning a contiguous slab
+      makes per slot (< 0.6 acceptance) — what incremental allocation
+      saves.
 
     Costs are billed from a pre-measured min-of-k table exactly like
     ``--serve`` (live wall-timing on a contended box measures the
@@ -2775,13 +2791,15 @@ def _bench_serve_paged(args, devices) -> int:
         dim, depth, heads, vocab = 512, 6, 8, 32000
         n_req, cap, arrival_s = args.serve_requests or 96, 32, 0.01
     slots, seg, ps = args.batch or 4, 4, 8
-    # store sizing matters on XLA:CPU: the functional page-scatter
-    # copies the WHOLE store per decode step (no buffer donation on
-    # this backend), so segment cost scales with kv_pages — size for
-    # expected concurrency (~3x the observed peak here), not "as big
-    # as possible". A TPU deployment donates the cache through the
-    # jit boundary and fuses the page lookup into the attention
-    # kernel, where this coupling disappears.
+    if slots < 2:
+        print("# --serve-paged needs --batch >= 2: the width-keyed "
+              "segment cost table holds a permanent occupant in slot 0 "
+              "and measures joins in slot 1", file=sys.stderr)
+        return 2
+    # kept at the r07 size for comparability; sizing is no longer a
+    # latency knob — the paged executables donate the store (in-place
+    # scatter, ISSUE 11), so segment cost is flat in kv_pages (the
+    # flatness record below PINS that at 2x). Size for capacity alone.
     kv_pages = 1 + 96
     sampling = dict(temperature=0.8, top_k=40, seed=0)
     model = build_transformer_lm(
@@ -2862,18 +2880,34 @@ def _bench_serve_paged(args, devices) -> int:
                 temperature=s["temperature"], top_k=s["top_k"],
                 seed=s["seed"])
             ppool.warm()
+            # a PERMANENT occupant in slot 0 whose position each
+            # segment op pins: hoisted segments compile per TABLE
+            # WIDTH (the dense window young rows attend over —
+            # ISSUE 11), so paged seg cost is keyed (bucket, width)
+            # exactly like joins and billed at the width the replay's
+            # pool actually picks
+            pr0 = np.ones(min(b, 4), np.int32)
+            ppool.join([(0, Request(prompt_ids=pr0,
+                                    max_new_tokens=cap),
+                         kv.plan(pr0, cap))])
+            limit0 = int(ppool.kv_limit[0])
+            for w in ppool._seg_widths:
+                posv = max(int(pr0.size) - 1,
+                           min(w * ps - seg, limit0 - 1))
 
-            def _pseg(pool=ppool):
-                pool.run_segment()
+                def _pseg(pool=ppool, posv=posv):
+                    pool.pos[0] = posv
+                    pool.done[0] = False
+                    pool.run_segment()
 
-            ops[("pseg", b)] = _pseg
+                ops[("pseg", b, w)] = _pseg
             for w in ppool._widths:
                 def _pjoin(pool=ppool, w=w):
                     plan = kv.plan(np.ones(w, np.int32), 1)
-                    pool.join([(0, Request(
+                    pool.join([(1, Request(
                         prompt_ids=np.ones(w, np.int32),
                         max_new_tokens=1), plan)])
-                    pool.evict(0)
+                    pool.evict(1)
                     jax.block_until_ready((kv.cache, pool.out))
 
                 ops[("pjoin", b, w)] = _pjoin
@@ -2890,22 +2924,24 @@ def _bench_serve_paged(args, devices) -> int:
             elif key[0] == "cjoin":
                 cont_cost["join"][key[1]] = v
             elif key[0] == "pseg":
-                paged_cost["seg"][key[1]] = v
+                paged_cost["seg"][(key[1], key[2])] = v
             elif key[0] == "pjoin":
                 paged_cost["join"][(key[1], key[2])] = v
             else:
                 paged_cost["copy"] = v
-        # a wider prefill window strictly contains a narrower one's
-        # work, so join cost must be nondecreasing in width — enforce
-        # it (right-to-left cummin) so one background-load burst during
-        # measurement cannot bill narrow (prefix-hit) joins ABOVE full
-        # prefills and silently invert the A/B
-        for b in all_buckets:
-            ws = sorted(w for (bb, w) in paged_cost["join"] if bb == b)
-            floor = float("inf")
-            for w in reversed(ws):
-                floor = min(floor, paged_cost["join"][(b, w)])
-                paged_cost["join"][(b, w)] = floor
+        # a wider window strictly contains a narrower one's work, so
+        # join AND segment cost must be nondecreasing in width —
+        # enforce it (right-to-left cummin) so one background-load
+        # burst during measurement cannot bill narrow (prefix-hit /
+        # young-row) ops ABOVE full-width ones and silently invert
+        # the A/B
+        for table in (paged_cost["join"], paged_cost["seg"]):
+            for b in all_buckets:
+                ws = sorted(w for (bb, w) in table if bb == b)
+                floor = float("inf")
+                for w in reversed(ws):
+                    floor = min(floor, table[(b, w)])
+                    table[(b, w)] = floor
 
     class _VClock:
         now = 0.0
@@ -2929,7 +2965,11 @@ def _bench_serve_paged(args, devices) -> int:
                 oseg, ojoin = pool.run_segment, pool.join
                 if isinstance(pool, PagedSlotPool):
                     def rs():
-                        vc.now += paged_cost["seg"][b]
+                        # segment_width() is None on the per-step path
+                        # (fused kernel active / int8): bill the full
+                        # window — the widest measured class
+                        w = pool.segment_width() or pool._seg_widths[-1]
+                        vc.now += paged_cost["seg"][(b, w)]
                         return oseg()
 
                     def jn(admits):
@@ -3000,6 +3040,13 @@ def _bench_serve_paged(args, devices) -> int:
                 "prefill_tokens_total": total_prefill,
                 "prefill_savings_frac": round(
                     m.prefill_tokens_saved / max(1, total_prefill), 4),
+                # incremental allocation (ISSUE 11): growth churn and
+                # what requests actually held vs worst-case reserves
+                "page_extends": sched.kv_state.extends,
+                "mid_decode_evictions": m.mid_decode_evictions,
+                "held_vs_budget_mean":
+                    sched.kv_state.held_vs_budget_mean(),
+                "held_vs_cap_mean": sched.kv_state.held_vs_cap_mean(),
             })
         else:
             rec["kv_bytes_reserved"] = int(sum(
@@ -3012,8 +3059,8 @@ def _bench_serve_paged(args, devices) -> int:
     _progress({"phase": "serve_paged_costs", "costs_ms": {
         "cont_seg": {b: round(v * 1e3, 2)
                      for b, v in cont_cost["seg"].items()},
-        "paged_seg": {b: round(v * 1e3, 2)
-                      for b, v in paged_cost["seg"].items()},
+        "paged_seg": {f"{b}w{w}": round(v * 1e3, 2)
+                      for (b, w), v in paged_cost["seg"].items()},
         "paged_join": {f"{b}w{w}": round(v * 1e3, 2)
                        for (b, w), v in paged_cost["join"].items()},
     }})
@@ -3032,6 +3079,117 @@ def _bench_serve_paged(args, devices) -> int:
         "paged", shared_prompts, prefix_cache=False)
     _progress({"phase": "serve_paged_shared_nocache",
                "record": results[("shared_prefix", "paged_nocache")]})
+
+    # ---- segment-cost flatness: kv_pages DOUBLED, fixed concurrency.
+    # The r07 cliff was the functional store copy per step (paged_seg
+    # cost grew with kv_pages); donated in-place stores make the
+    # doubled-store segment cost equal within noise — pinned ±10%.
+    def _flatness() -> dict:
+        from tpuflow.serve.pages import PagedKV, PagedKVSpec
+        from tpuflow.serve.request import Request
+        from tpuflow.serve.slots import PagedSlotPool
+
+        out: dict = {}
+        b = 16
+        for tag, pages in (("1x", kv_pages), ("2x", 2 * kv_pages)):
+            kv = PagedKV(model, PagedKVSpec(pages=pages, page_size=ps),
+                         prefix_cache=False)
+            pool = PagedSlotPool(
+                model, params, kv, b, slots, cap, seg=seg,
+                temperature=sampling["temperature"],
+                top_k=sampling["top_k"], seed=sampling["seed"])
+            pool.warm()
+            admits = []
+            for s_ in range(slots):
+                pr = (np.ones(8, np.int32) + s_)
+                plan = kv.plan(pr, cap)
+                admits.append((s_, Request(prompt_ids=pr,
+                                           max_new_tokens=cap), plan))
+            pool.join(admits)
+            best = float("inf")
+            for _ in range(8):
+                t0 = time.perf_counter()
+                pool.run_segment()
+                best = min(best, time.perf_counter() - t0)
+                pool.pos[:] = 7  # hold position: identical work/rep
+                pool.done[:] = False
+            out[f"seg_ms_{tag}"] = round(best * 1e3, 3)
+        out["ratio_2x_over_1x"] = round(
+            out["seg_ms_2x"] / max(out["seg_ms_1x"], 1e-9), 3)
+        out["flat_within_10pct"] = bool(
+            abs(out["ratio_2x_over_1x"] - 1.0) <= 0.10)
+        return out
+
+    flatness = _flatness()
+    _progress({"phase": "serve_paged_flatness", "record": flatness})
+
+    # ---- kv_prefix_insert_generated multi-turn A/B (PR 8 carry-
+    # forward): phase 1 drains base requests, phase 2 submits
+    # follow-ups whose prompts EXTEND the finished transcripts
+    # (prompt + completion + new user turn). The flag's entire value
+    # is phase-2 prefill skipped PAST the original prompt; its cost is
+    # completion pages retained in the tree. Deterministic policy
+    # counts (same seed/stream ids both arms → identical transcripts),
+    # so no virtual clock is needed to decide the default.
+    def run_multiturn(insert_generated: bool) -> dict:
+        sched = ServeScheduler(
+            model, params, slots=slots, seg=seg, max_new_cap=8,
+            max_queue=64, kv="paged", kv_page_size=ps,
+            kv_pages=kv_pages,
+            kv_prefix_insert_generated=insert_generated, **sampling)
+        rng2 = np.random.default_rng(3)
+        sysp = rng2.integers(1, vocab, (12,)).astype(np.int32)
+        base_prompts = [
+            np.concatenate([sysp, rng2.integers(
+                1, vocab, (int(rng2.integers(2, 5)),)).astype(np.int32)])
+            for _ in range(8)
+        ]
+        phase1 = [sched.submit(p, 8) for p in base_prompts]
+        sched.run_until_idle()
+        assert all(r.state.value == "done" for r in phase1)
+        saved_p1 = sched.metrics.prefill_tokens_saved
+        follow = [
+            np.concatenate([p, np.asarray(r.tokens, np.int32),
+                            rng2.integers(1, vocab, (3,)).astype(
+                                np.int32)])
+            for p, r in zip(base_prompts, phase1)
+        ]
+        total2 = sum(len(p) - 1 for p in follow)
+        phase2 = [sched.submit(p, 8) for p in follow]
+        sched.run_until_idle()
+        assert all(r.state.value == "done" for r in phase2)
+        saved2 = sched.metrics.prefill_tokens_saved - saved_p1
+        return {
+            "insert_generated": insert_generated,
+            "phase2_prefill_tokens_total": int(total2),
+            "phase2_prefill_tokens_saved": int(saved2),
+            "phase2_savings_frac": round(saved2 / max(1, total2), 4),
+            "tree_pages_retained": int(
+                sched.kv_state.allocator.in_use()),
+            "tokens": sum(len(r.tokens) for r in phase1 + phase2),
+        }
+
+    mt_on = run_multiturn(True)
+    mt_off = run_multiturn(False)
+    gain = (mt_on["phase2_savings_frac"]
+            - mt_off["phase2_savings_frac"])
+    retain_delta = (mt_on["tree_pages_retained"]
+                    - mt_off["tree_pages_retained"])
+    # decision rule, applied to the data: default ON iff the flag buys
+    # >= 15 extra points of phase-2 prefill savings AND its completion
+    # pages retain <= 25% of the store (LRU-evictable, but resident
+    # until pressure). Both sides of the trade in the record.
+    verdict = ("enable_by_default"
+               if gain >= 0.15 and retain_delta <= (kv_pages - 1) * 0.25
+               else "keep_default_off")
+    insert_rec = {
+        "on": mt_on, "off": mt_off,
+        "phase2_savings_gain_frac": round(gain, 4),
+        "tree_pages_retained_delta": int(retain_delta),
+        "verdict": verdict,
+    }
+    _progress({"phase": "serve_paged_insert_generated",
+               "record": insert_rec})
 
     def _ratio(a, b):
         return round(a / max(b, 1e-9), 3)
@@ -3056,8 +3214,8 @@ def _bench_serve_paged(args, devices) -> int:
                          for b, v in cont_cost["seg"].items()},
             "cont_join": {str(b): round(v * 1e3, 2)
                           for b, v in cont_cost["join"].items()},
-            "paged_seg": {str(b): round(v * 1e3, 2)
-                          for b, v in paged_cost["seg"].items()},
+            "paged_seg": {f"{b}w{w}": round(v * 1e3, 2)
+                          for (b, w), v in paged_cost["seg"].items()},
             "paged_join": {f"{b}w{w}": round(v * 1e3, 2)
                            for (b, w), v in paged_cost["join"].items()},
             "paged_copy": round(paged_cost["copy"] * 1e3, 2),
@@ -3093,6 +3251,17 @@ def _bench_serve_paged(args, devices) -> int:
             "headroom_x_shared": _ratio(sh_c["kv_bytes_reserved"],
                                         sh_p["kv_bytes_peak"]),
         },
+        # ISSUE 11 records: the fast-path acceptance numbers
+        "segment_flatness": flatness,
+        "insert_generated": insert_rec,
+        "incremental_allocation": {
+            "page_extends_mixed": mixed_p.get("page_extends"),
+            "mid_decode_evictions_mixed":
+                mixed_p.get("mid_decode_evictions"),
+            "held_vs_budget_mean_mixed":
+                mixed_p.get("held_vs_budget_mean"),
+            "held_vs_cap_mean_mixed": mixed_p.get("held_vs_cap_mean"),
+        },
         "span_totals_ms": _span_totals(),
     }
     rec = {
@@ -3106,14 +3275,19 @@ def _bench_serve_paged(args, devices) -> int:
     }
     out_path = args.serve_out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
-        "BENCH_LOCAL_r07_serve_paged.json")
+        "BENCH_LOCAL_r11_serve_paged.json")
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     print(
         f"# serve-paged kv_headroom x{headroom:.1f} | mixed tok/s "
         f"paged={mixed_p['useful_tok_s']} vs cont="
-        f"{mixed_c['useful_tok_s']} | shared-prefix hit_rate="
-        f"{sh_p['prefix_hit_rate']} prefill_saved="
+        f"{mixed_c['useful_tok_s']} "
+        f"(ratio {diag['mixed']['tok_s_ratio']}) | seg flat 2x-pages "
+        f"ratio {flatness['ratio_2x_over_1x']} | held/cap "
+        f"{mixed_p.get('held_vs_cap_mean')} held/own "
+        f"{mixed_p.get('held_vs_budget_mean')} | insert_generated "
+        f"{verdict} (+{gain:.0%} phase-2 saved) | shared-prefix "
+        f"hit_rate={sh_p['prefix_hit_rate']} prefill_saved="
         f"{sh_p['prefill_savings_frac']:.0%} p50_ttft "
         f"paged={sh_p['ttft_ms'].get('p50')}ms vs cont="
         f"{sh_c['ttft_ms'].get('p50')}ms vs nocache="

@@ -1,0 +1,363 @@
+"""Paged path as the FAST path (ISSUE 11): fused decode kernel,
+in-place (donated) page stores, incremental page allocation.
+
+Tier discipline: same tiny shared model config and pool geometry as
+test_serve_paged.py (flax modules are frozen dataclasses, so equal
+configs share the LRU-memoized executables across files); the kernel
+tests run the real Pallas kernel in interpret mode on CPU like
+tests/test_ops.py does for the flash kernels.
+
+The load-bearing pins:
+
+- ``paged_flash_decode`` (write + page-table read fused in one kernel
+  call) matches the portable scatter+gather+einsum decode oracle at
+  TWO geometries (MHA, GQA + sliding window): outputs to float
+  tolerance with argmax equality, page stores BIT-identical —
+  including the masked-write row and the aliased pass-through of
+  untouched pages;
+- the whole serve engine with ``kv_kernel=True`` (interpret mode) is
+  TOKEN-IDENTICAL to the portable path, greedy AND sampled, incl.
+  mid-flight joins;
+- the paged executables DONATE the store: after a segment the previous
+  buffer is deleted (updated in place), never copied — the fix for the
+  PR 6 O(kv_pages) segment-cost cliff;
+- incremental allocation: admission reserves prompt + first-segment
+  pages; plans grow at boundaries; a row the store cannot cover
+  mid-decode is evicted BACK TO THE QUEUE with its prefix published
+  and completes TOKEN-IDENTICALLY after retry; refcounts balance
+  after churn with incrementally-grown chains; a COW fork of a
+  partially-budgeted (still-growing) chain perturbs nobody.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.models import build_transformer_lm
+
+KW = dict(vocab_size=128, dim=32, depth=1, heads=2, mlp_ratio=2,
+          dtype=jnp.float32)
+GEO = dict(slots=2, seg=4, max_new_cap=12)
+PS = 4
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import flax.linen as nn
+
+    lm = build_transformer_lm(**KW)
+    params = nn.unbox(
+        lm.init({"params": jax.random.key(0)}, jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    return lm, params
+
+
+def _sched(tiny_lm, **kw):
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = tiny_lm
+    base = dict(GEO)
+    base.update(kv="paged", kv_page_size=PS, kv_pages=49)
+    base.update(kw)
+    return ServeScheduler(lm, params, **base)
+
+
+# ---------------------------------------------------------------------
+# kernel parity: fused write+read vs the portable oracle, 2 geometries
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("geom", [
+    "mha",
+    pytest.param("gqa_window", marks=pytest.mark.slow),
+])
+def test_paged_flash_decode_matches_portable_oracle(geom):
+    """Interpret-mode kernel parity at two geometries (the satellite
+    pin): MHA, and GQA + sliding window (the block-skipping paths).
+    Output within float tolerance with exact argmax; the page stores
+    — INCLUDING the written token slot, pages mapped by other rows,
+    and pages no row maps (aliased pass-through) — bit-identical to
+    the oracle's, except the sink page the oracle dirties on masked
+    writes (the kernel skips those entirely; nothing reads the sink)."""
+    from tpuflow.ops.attention import _paged_decode_ref, paged_flash_decode
+
+    if geom == "mha":
+        B, H, KVH, D, ps, NP, PAGES, window = 3, 4, 4, 16, 4, 5, 20, None
+    else:
+        B, H, KVH, D, ps, NP, PAGES, window = 2, 4, 2, 8, 8, 3, 9, 5
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((PAGES, KVH, ps, D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((PAGES, KVH, ps, D)), jnp.float32)
+    # distinct exclusive pages per row (the allocator invariant)
+    table = jnp.asarray(
+        rng.permutation(np.arange(1, PAGES))[: B * NP].reshape(B, NP),
+        jnp.int32)
+    # positions exercise: mid-page, the very last slot, masked row
+    pos = jnp.asarray([3, ps * NP - 1, 7][:B], jnp.int32)
+    wm = jnp.asarray([True, True, False][:B])
+    o, kp2, vp2 = paged_flash_decode(q, kn, vn, kp, vp, table, pos, wm,
+                                     window=window)
+    oref, kpr, vpr = _paged_decode_ref(q, kn, vn, kp, vp, table, pos,
+                                       np.asarray(wm), D ** -0.5,
+                                       window=window)
+    assert float(jnp.max(jnp.abs(o - oref))) < 1e-5
+    assert bool(jnp.all(jnp.argmax(o, -1) == jnp.argmax(oref, -1)))
+    # stores bit-identical on every real page (sink excluded: the
+    # oracle scatters masked writes there, the kernel skips them)
+    assert bool(jnp.all(kp2[1:] == kpr[1:]))
+    assert bool(jnp.all(vp2[1:] == vpr[1:]))
+    # the written token actually landed (row 0's page of position 3)
+    pg0 = int(np.asarray(table)[0, 3 // ps])
+    assert bool(jnp.all(kp2[pg0, :, 3 % ps, :] == kn[0]))
+
+
+def _kernel_engine_run(tiny_lm, kernel, prompts, **kw):
+    # kernel=None is the suite-wide default config (auto → portable on
+    # CPU): its executables memoize across files; kernel=True compiles
+    # the interpret-mode kernel engine (the thing under test)
+    s = _sched(tiny_lm, kv_kernel=kernel, **kw)
+    reqs = []
+    for i, p in enumerate(prompts):
+        reqs.append(s.submit(p, 8))
+        if i % 2:
+            s.step()  # later arrivals join mid-flight
+    s.run_until_idle()
+    assert all(r.state.value == "done" for r in reqs)
+    return [list(r.tokens) for r in reqs]
+
+
+def test_kernel_engine_token_parity_greedy(tiny_lm):
+    """The whole paged serve engine with the fused kernel forced on
+    (``kv_kernel=True``, Pallas interpret mode on CPU) emits exactly
+    the portable path's tokens, incl. mid-flight joins — the
+    engine-level half of the kernel parity pin (sampled parity rides
+    the slow tier: a second full kernel-engine compile set)."""
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 128, (n,)).astype(np.int32)
+               for n in (3, 6, 4, 7)]
+    assert (_kernel_engine_run(tiny_lm, True, prompts)
+            == _kernel_engine_run(tiny_lm, None, prompts))
+
+
+@pytest.mark.slow
+def test_kernel_engine_token_parity_sampled(tiny_lm):
+    """Sampled twin of the kernel-engine parity pin (seeded
+    categorical draws survive the kernel's online-softmax ulps)."""
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 128, (n,)).astype(np.int32)
+               for n in (3, 6, 4, 7)]
+    kw = dict(temperature=0.8, top_k=20, seed=7)
+    assert (_kernel_engine_run(tiny_lm, True, prompts, **kw)
+            == _kernel_engine_run(tiny_lm, None, prompts, **kw))
+
+
+# ---------------------------------------------------------------------
+# in-place stores: donation replaces, never copies
+# ---------------------------------------------------------------------
+
+def test_segment_and_copy_donate_the_store_in_place(tiny_lm):
+    """After a decode segment (and a COW page copy) the PREVIOUS store
+    buffer is deleted — the executables donate it and XLA updates in
+    place, so per-step cost no longer scales with ``kv_pages`` (the
+    PR 6 KNOWN LIMIT; bench pins the flatness at trace scale). The
+    ledger keeps attributing the LIVE buffers (re-tagged at every
+    donation site)."""
+    from tpuflow.infer.generate import paged_copy
+    from tpuflow.obs import memory as _mem
+
+    sched = _sched(tiny_lm)
+    req = sched.submit(np.arange(1, 6, dtype=np.int32), 8)
+    sched.step()
+    old_leaf = jax.tree.leaves(sched.kv_state.cache)[0]
+    sched.step()  # one decode segment
+    assert old_leaf.is_deleted()  # donated, not copied
+    new_leaf = jax.tree.leaves(sched.kv_state.cache)[0]
+    assert not new_leaf.is_deleted()
+    rec = _mem.reconcile(live=jax.live_arrays())
+    assert rec["components"].get("kv_pages", 0) >= new_leaf.nbytes
+    # COW copy executable donates too
+    before = jax.tree.leaves(sched.kv_state.cache)[0]
+    sched.kv_state.cache = paged_copy(sched.kv_state.cache, [0], [0])
+    assert before.is_deleted()
+    sched.cancel(req)
+    sched.run_until_idle()
+
+
+# ---------------------------------------------------------------------
+# incremental allocation: extend units, mid-decode evict+requeue,
+# churn refcounts, partially-budgeted COW
+# ---------------------------------------------------------------------
+
+def test_extend_units_and_failure_cleanliness(tiny_lm):
+    """PagedKV.extend: grows table+owned with fresh refcount-1 pages,
+    falls back to LRU-evicting tree-only pages under pressure, and
+    fails CLEANLY (nothing retained, plan untouched) when the store is
+    genuinely dry. plan(initial_new=) reserves prompt+first-segment
+    pages and records the worst-case budget."""
+    from tpuflow.serve.pages import PagedKV, PagedKVSpec
+
+    lm, _params = tiny_lm
+    kv = PagedKV(lm, PagedKVSpec(pages=1 + 6, page_size=PS))
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, 128, (5,)).astype(np.int32)
+    plan = kv.plan(prompt, 12, initial_new=4)
+    # covers min(4+4, 16) = 8 positions → 2 pages; budget ceil(16/4)=4
+    assert plan is not None and len(plan.table) == 2
+    assert plan.budget_pages == 4
+    got = kv.extend(plan, 1)
+    assert got and len(plan.table) == 3 == len(plan.owned)
+    assert kv.extends == 1
+    hold = kv.allocator.alloc(3)  # dry the store
+    assert kv.allocator.free_count() == 0
+    before = list(plan.table)
+    assert kv.extend(plan, 1) is None  # dry: clean failure
+    assert plan.table == before
+    assert kv.allocator.in_use() == 6  # nothing leaked
+    # publish a chain, release it (tree-only), extend can now LRU it
+    kv.insert_prompt(prompt, plan)
+    kv.release(plan)
+    assert kv.allocator.refs[plan.table[0]] == 1  # tree-only now
+    plan2 = kv.plan(rng.integers(1, 128, (5,)).astype(np.int32), 12,
+                    initial_new=4)
+    assert plan2 is not None  # LRU eviction made room
+    kv.release(plan2)
+    kv.allocator.release(hold)
+    # held-vs-budget accounting: plans with boundary samples fold in
+    plan3 = kv.plan(prompt, 12, initial_new=4)
+    plan3.held_sum, plan3.held_n = 4, 2  # 2 boundaries, mean 2 pages
+    kv.release(plan3)
+    assert kv.held_vs_budget_mean() == pytest.approx(0.5)  # 2 of 4
+    snap = kv.snapshot()
+    assert snap["page_extends"] == kv.extends
+    assert snap["held_vs_budget_mean"] == 0.5
+
+
+def _resume_roundtrip(tiny_lm, **kw):
+    """Starve a small store so one row is evicted mid-decode and
+    resumes; return (starved scheduler, its tokens, oracle tokens)."""
+    rng = np.random.default_rng(11)
+    # 3-token prompts: the evicted row's transcript (3 prompt + 4
+    # generated at the starved boundary) stays inside bucket 8, so the
+    # resume re-joins the SAME pool — no extra bucket class compiled
+    p1 = rng.integers(1, 128, (3,)).astype(np.int32)
+    p2 = rng.integers(1, 128, (3,)).astype(np.int32)
+
+    def drain(s):
+        a = s.submit(p1, 8)
+        b = s.submit(p2, 8)
+        s.run_until_idle()
+        assert a.state.value == "done" and b.state.value == "done"
+        return [list(a.tokens), list(b.tokens)]
+
+    # the starved store: (p=3, new=8, seg=4) → initial reserve 2 pages
+    # each, worst case 3 each → 4 usable pages admit both but CANNOT
+    # finish both: one must be evicted mid-decode, requeue, and resume
+    small = _sched(tiny_lm, kv_pages=1 + 4, max_new_cap=8, **kw)
+    got = drain(small)
+    # uncontended oracle at the SUITE-WIDE geometry (49 pages, cap 12
+    # — store size and cap change executables and capacity, never
+    # tokens): reuses the files' shared compiles
+    oracle = _sched(tiny_lm, **kw)
+    want = drain(oracle)
+    assert oracle.metrics.mid_decode_evictions == 0
+    return small, got, want
+
+
+def test_mid_decode_eviction_requeues_and_completes_identically(tiny_lm):
+    """THE resume pin: with a store too small for two full budgets,
+    one row runs dry mid-decode, is evicted back to the queue with its
+    prefix published (pages released, eviction counter moves), and
+    after retry BOTH requests complete with tokens identical to an
+    uncontended (big-store) run. SAMPLED is the tier-1 config — the
+    resume claim is about RNG streams landing exactly where the
+    uninterrupted run's were; greedy (positions-only) rides the slow
+    tier."""
+    small, got, want = _resume_roundtrip(
+        tiny_lm, temperature=0.8, top_k=20, seed=7)
+    assert small.metrics.mid_decode_evictions >= 1
+    assert got == want
+    assert len(got[0]) == 8 and len(got[1]) == 8
+    from tpuflow.obs.gauges import counters
+
+    assert counters("serve.").get(
+        "serve.kv_mid_decode_evictions_total", 0) >= 1
+
+
+@pytest.mark.slow
+def test_mid_decode_eviction_greedy_variant(tiny_lm):
+    """Greedy twin of the mid-decode resume pin."""
+    small, got, want = _resume_roundtrip(tiny_lm)
+    assert small.metrics.mid_decode_evictions >= 1
+    assert got == want
+
+
+def test_refcount_balance_after_incremental_churn(tiny_lm):
+    """After mixed churn with incremental growth (extends firing —
+    budgets larger than the first-segment reserve), the only pages
+    still held are the prefix tree's — every path (shared, forked,
+    extended) balanced its references — and clearing the tree returns
+    the allocator to empty. Runs at the suite-wide geometry so the
+    pool executables memoize; eviction-path refcounts are covered by
+    the mid-decode test above."""
+    sched = _sched(tiny_lm)
+    rng = np.random.default_rng(7)
+    shared = rng.integers(1, 128, (6,)).astype(np.int32)
+    reqs = []
+    for k in range(10):
+        if k % 3 == 0:
+            ids = np.concatenate(
+                [shared, rng.integers(1, 128, (2,)).astype(np.int32)])
+        else:
+            ids = rng.integers(1, 128,
+                               (int(rng.integers(2, 9)),)).astype(np.int32)
+        reqs.append(sched.submit(ids, int(rng.integers(4, 9))))
+    sched.run_until_idle()
+    assert all(r.state.value == "done" for r in reqs)
+    assert sched.kv_state.extends >= 1  # incremental growth happened
+    kvs = sched.kv_state
+    assert kvs.allocator.in_use() == kvs.prefix.nodes
+    assert int(kvs.allocator.refs[1:].max(initial=0)) <= 1  # tree-only
+    hb = kvs.held_vs_budget_mean()
+    assert hb is not None and 0.0 < hb <= 1.0
+    kvs.prefix.clear()
+    assert kvs.allocator.in_use() == 0
+    assert kvs.allocator.free_count() == kvs.allocator.total
+
+
+def test_cow_fork_of_partially_budgeted_chain(tiny_lm):
+    """COW fork where the PARENT's plan is still growing (holds fewer
+    pages than its worst-case budget — the incremental-allocation
+    state PR 6's tests could never produce): B diverges mid-page from
+    A's published prompt chain while A decodes with a partial plan.
+    Fork executes, neither party's tokens change vs a fresh-tree
+    oracle, and A later extends past the fork point unharmed."""
+    lm, params = tiny_lm
+    rng = np.random.default_rng(9)
+    # 10-token prompt → 2 FULL published pages (positions [0, 9) →
+    # chunks [0:4) and [4:8)), so B's 6-token share diverges MID-page-2
+    base = rng.integers(1, 128, (10,)).astype(np.int32)
+    b_ids = base.copy()
+    b_ids[6] = (int(b_ids[6]) % 126) + 1
+    if b_ids[6] == base[6]:
+        b_ids[6] += 1
+
+    def run(prefix_cache):
+        s = _sched(tiny_lm, kv_prefix_cache=prefix_cache)
+        a = s.submit(base, 12)
+        s.step()
+        # A mid-decode: holds its initial reserve, less than budget
+        plan_a = next(p for p in s.pools[16].plans if p is not None)
+        assert len(plan_a.table) < plan_a.budget_pages
+        b = s.submit(b_ids, 12)
+        s.run_until_idle()
+        if prefix_cache:
+            ev = [e for e in s.metrics.events(b.id)
+                  if e["event"] == "prefix_match"]
+            assert ev and ev[0]["hit"] and ev[0]["cow_forks"] == 1
+            assert ev[0]["matched_tokens"] == 6  # 1 full page + 2 part
+        return [list(a.tokens), list(b.tokens)]
+
+    assert run(True) == run(False)  # fork perturbed nobody

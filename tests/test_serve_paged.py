@@ -194,21 +194,29 @@ def test_cow_fork_under_concurrent_parent_decode(tiny_lm):
 # ---------------------------------------------------------------------
 
 def test_out_of_pages_queues_then_cancel_frees_same_boundary(tiny_lm):
-    """With pages for only ONE request in flight, the second stays
-    QUEUED (kv_page_waits counter moves, Retry-After is quoted) — not
+    """With pages for only ONE request's INITIAL reserve in flight
+    (incremental allocation reserves prompt + first segment, not the
+    worst-case budget — ISSUE 11), the second stays QUEUED
+    (kv_page_waits counter moves, Retry-After is quoted) — not
     rejected; cancelling the runner releases its pages immediately and
     the queued request admits at the very next boundary (PR 3's
-    cancel→immediate-reuse pin, extended to pages)."""
+    cancel→immediate-reuse pin, extended to pages). The survivor then
+    GROWS its plan past the initial reserve to finish its full budget
+    (kv_page_extends counter moves)."""
     clk = FakeClock()
     rng = np.random.default_rng(2)
     sched = _sched(tiny_lm, kv_pages=1 + 4, kv_prefix_cache=False,
                    max_new_cap=8, clock=clk)
-    # (p=5, new=8): ceil((5+8-1)/4) = 3 pages each → 4 usable fit one
-    r1 = sched.submit(rng.integers(1, 128, (5,)).astype(np.int32), 8)
-    r2 = sched.submit(rng.integers(1, 128, (5,)).astype(np.int32), 8)
+    # (p=8, new=8, seg=4): initial reserve covers min(p-1+seg, p+new-1)
+    # = 11 positions → 3 pages; worst case ceil(15/4) = 4 → 4 usable
+    # pages fit ONE initial reserve with 1 spare (< the 3 a second
+    # needs), and the runner must extend 3→4 mid-decode to finish
+    r1 = sched.submit(rng.integers(1, 128, (8,)).astype(np.int32), 8)
+    r2 = sched.submit(rng.integers(1, 128, (8,)).astype(np.int32), 8)
     sched.step()
     assert r1.state.value == "running"
     assert r2.state.value == "queued"  # queued, NOT rejected
+    assert sched.kv_state.allocator.in_use() == 3  # not the 4 worst-case
     assert sched.metrics.page_waits >= 1
     assert sched.retry_after_s() > 0
     sched.cancel(r1)
@@ -217,8 +225,13 @@ def test_out_of_pages_queues_then_cancel_frees_same_boundary(tiny_lm):
     assert r2.state.value == "running"
     sched.run_until_idle()
     assert r2.state.value == "done" and len(r2.tokens) == 8
+    assert sched.metrics.page_extends >= 1  # grew 3 → 4 mid-decode
+    from tpuflow.obs.gauges import counters
+
+    assert counters("serve.").get("serve.kv_page_extends_total", 0) >= 1
     # a request that could NEVER fit is a config error, not queueing
-    # (checked at submit, before any pool/device work exists)
+    # (checked at submit against the WORST case: incremental growth
+    # must always be able to finish what admission started)
     tiny_store = _sched(tiny_lm, kv_pages=1 + 2, max_new_cap=8)
     with pytest.raises(ValueError, match="KV pages"):
         tiny_store.submit(rng.integers(1, 128, (5,)).astype(np.int32), 8)

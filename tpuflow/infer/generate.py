@@ -589,6 +589,9 @@ def _serve_decode_model(model, kv_spec=None):
     return model.clone(
         decode=True, seq_axis=None, kv_pages=int(kv_spec.pages),
         kv_page_size=int(kv_spec.page_size), kv_quant=kv_spec.quant,
+        # fused paged-decode kernel policy (ISSUE 11): None = auto
+        # (TPU only); kv_spec may predate the field (duck-typed specs)
+        paged_kernel=getattr(kv_spec, "kernel", None),
     )
 
 
@@ -653,7 +656,14 @@ def paged_join_fn(model, kv_spec, slots: int, out_len: int,
 @_lru("paged_join", maxsize=128)
 def _compiled_paged_join(dm, b: int, out_len: int, n_row_pages: int,
                          w: int):
-    @_rjit(key="infer.paged_join")
+    # the page store is DONATED (ISSUE 11): XLA updates it in place
+    # instead of copying the whole pool per call, so join cost stops
+    # scaling with kv_pages. Contract: the caller must drop its
+    # reference (reassign from the return value) — PagedSlotPool.join
+    # and PagedKV hold the only references and do exactly that. ``out``
+    # is NOT donated: the speculative draft join reads the target
+    # join's out afterwards (and it is a small int32 buffer anyway).
+    @_rjit(key="infer.paged_join", donate_argnums=(1,))
     def join(params, cache, out, tokens, starts, widths, page_table):
         idx = starts[:, None] + jnp.arange(w, dtype=jnp.int32)
         live = jnp.arange(w)[None, :] < widths[:, None]
@@ -678,10 +688,93 @@ def _compiled_paged_join(dm, b: int, out_len: int, n_row_pages: int,
     return join
 
 
+def _rows_view(cache, page_table):
+    """Hoisted gather (ISSUE 11): turn a paged cache collection into a
+    dense per-row WINDOW collection — every ``key_pages``/
+    ``value_pages`` leaf (npages, KVH, ps, D) becomes ``key_rows``/
+    ``value_rows`` (B, KVH, W*ps, D) gathered through ``page_table``
+    (B, W). Paid ONCE per decode segment instead of once per step; the
+    rowwise branch of CausalAttention consumes the result."""
+    ren = {"key_pages": "key_rows", "value_pages": "value_rows"}
+
+    def walk(node):
+        out = {}
+        for name, leaf in node.items():
+            if name in ren:
+                b, w = page_table.shape
+                ps, d = leaf.shape[2], leaf.shape[3]
+                kvh = leaf.shape[1]
+                g = leaf[page_table]  # (B, W, KVH, ps, D)
+                out[ren[name]] = g.transpose(0, 2, 1, 3, 4).reshape(
+                    b, kvh, w * ps, d)
+            elif isinstance(leaf, dict):
+                out[name] = walk(leaf)
+            else:  # scale leaves etc. are absent on this path (no int8)
+                out[name] = leaf
+        return out
+
+    return walk(dict(cache))
+
+
+def _rows_scatter_back(cache, rows, page_table, pos0, kv_limit, done0,
+                       seg: int):
+    """Hoisted scatter (ISSUE 11): write back the pages a segment
+    could have touched — positions ``[pos0, min(pos0+seg, kv_limit))``
+    per row, i.e. at most ``(seg-1)//ps + 2`` pages — from the dense
+    window into the store. Written pages are row-EXCLUSIVE (allocator
+    invariant: shared prefix pages are read-only and live strictly
+    below the write range), rows done at segment entry redirect to the
+    sink, and untouched window slots scatter back their own gathered
+    content (identity)."""
+    ren = {"key_rows": "key_pages", "value_rows": "value_pages"}
+
+    def walk(cnode, rnode):
+        out = {}
+        for name, leaf in cnode.items():
+            if name in ("key_pages", "value_pages"):
+                rname = ("key_rows" if name == "key_pages"
+                         else "value_rows")
+                dense = rnode[rname]
+                b, w = page_table.shape
+                ps = leaf.shape[2]
+                kvh, d = leaf.shape[1], leaf.shape[3]
+                pages = dense.reshape(b, kvh, w, ps, d).transpose(
+                    0, 2, 1, 3, 4)  # (B, W, KVH, ps, D)
+                j0 = pos0 // ps
+                # last position actually writable this segment
+                last = jnp.minimum(pos0 + seg, kv_limit) - 1
+                j1 = last // ps
+                n_touch = (seg - 1) // ps + 2
+                st = leaf
+                for t in range(n_touch):
+                    j = j0 + t
+                    jc = jnp.clip(j, 0, w - 1)
+                    valid = (j <= j1) & (j < w) & ~done0
+                    pg = jnp.where(
+                        valid,
+                        jnp.take_along_axis(page_table, jc[:, None],
+                                            axis=1)[:, 0],
+                        0,
+                    )
+                    content = jnp.take_along_axis(
+                        pages, jc[:, None, None, None, None], axis=1
+                    )[:, 0]  # (B, KVH, ps, D)
+                    st = st.at[pg].set(content)
+                out[name] = st
+            elif isinstance(leaf, dict):
+                out[name] = walk(leaf, rnode[name])
+            else:
+                out[name] = leaf
+        return out
+
+    return walk(dict(cache), dict(rows))
+
+
 def paged_segment_fn(model, kv_spec, slots: int, out_len: int,
                      n_row_pages: int, seg: int, temperature: float,
                      top_k: Optional[int], top_p: Optional[float],
-                     eos_id: Optional[int]):
+                     eos_id: Optional[int],
+                     table_width: Optional[int] = None):
     """Compiled paged decode segment: advance every row ``seg`` steps
     at its OWN position, then return control to the host.
 
@@ -697,7 +790,20 @@ def paged_segment_fn(model, kv_spec, slots: int, out_len: int,
     - ``last_tok`` (slots,) int32: index of the row's final allowed
       token (p + max_new - 1); emitting it sets ``done``;
     - ``toks`` (slots, seg): the per-row token windows written this
-      segment (``out[r, pos[r]+1 : pos[r]+seg+1]``)."""
+      segment (``out[r, pos[r]+1 : pos[r]+seg+1]``).
+
+    ``table_width`` (ISSUE 11, the hoisted fast path): compile the
+    segment for a (slots, table_width) page-table window — the pages
+    are gathered into dense per-row (B, KVH, W*ps, D) windows ONCE,
+    the ``seg`` steps run against the dense window (the rowwise branch
+    of CausalAttention — per-step cost is the contiguous path's, no
+    per-step gather/scatter), and the pages the segment wrote scatter
+    back ONCE at the end. The caller slices its page table to the
+    narrowest width covering every live row's need this segment
+    (:meth:`~tpuflow.serve.slots.PagedSlotPool.segment_width`), so
+    young rows attend over short windows. ``None`` keeps the per-step
+    paged path (the int8 store, and the fused-kernel path where the
+    kernel IS the per-step fast path)."""
     dm = _serve_decode_model(model, kv_spec)
     return _compiled_paged_segment(
         dm, int(slots), int(out_len), int(n_row_pages), int(seg),
@@ -705,30 +811,48 @@ def paged_segment_fn(model, kv_spec, slots: int, out_len: int,
         None if top_k is None else int(top_k),
         None if top_p is None else float(top_p),
         None if eos_id is None else int(eos_id),
+        None if table_width is None else int(table_width),
     )
 
 
-@_lru("paged_segment", maxsize=32)
+@_lru("paged_segment", maxsize=64)
 def _compiled_paged_segment(dm, b: int, out_len: int, n_row_pages: int,
                             seg: int, temperature: float,
                             top_k: Optional[int], top_p: Optional[float],
-                            eos_id: Optional[int]):
+                            eos_id: Optional[int],
+                            table_width: Optional[int] = None):
     fill = jnp.int32(eos_id if eos_id is not None else 0)
+    hoist = table_width is not None
 
-    @_rjit(key="infer.paged_segment")
+    # donated page store (ISSUE 11): the KV writes happen in place —
+    # this is what killed the O(kv_pages) segment-cost coupling the
+    # PR 6 KNOWN LIMIT documented (the functional update used to copy
+    # the whole store every decode step, even on XLA:CPU). With
+    # ``table_width`` the gather/scatter is additionally HOISTED to
+    # the segment boundary (see paged_segment_fn).
+    @_rjit(key="infer.paged_segment", donate_argnums=(1,))
     def segment(params, cache, out, done, pos0, kv_limit, last_tok,
                 stream_ids, rng, page_table):
+        if hoist:
+            rows = _rows_view(cache, page_table)
+
         def step(carry, i):
-            cache, out, done = carry
+            kv, out, done = carry
             pos = pos0 + i
             posc = jnp.clip(pos, 0, out_len - 1)
             tok = jnp.take_along_axis(out, posc[:, None], axis=1)
             wm = (~done & (pos < kv_limit))[:, None]
-            lg, vars2 = dm.apply(
-                {"params": params, "cache": cache}, tok,
-                mutable=["cache"], page_table=page_table,
-                write_pos=pos, write_mask=wm,
-            )
+            if hoist:
+                lg, vars2 = dm.apply(
+                    {"params": params, "cache": kv}, tok,
+                    mutable=["cache"], write_pos=pos, write_mask=wm,
+                )
+            else:
+                lg, vars2 = dm.apply(
+                    {"params": params, "cache": kv}, tok,
+                    mutable=["cache"], page_table=page_table,
+                    write_pos=pos, write_mask=wm,
+                )
             # the sampling step is the row's LOGICAL position — the
             # same value the wave oracle derives as t - pad_lens — so
             # a request's RNG stream is identical in both engines
@@ -743,13 +867,19 @@ def _compiled_paged_segment(dm, b: int, out_len: int, n_row_pages: int,
                                      axis=1, inplace=False)
             return (vars2["cache"], out, done), None
 
-        (cache, out, done), _ = lax.scan(
-            step, (cache, out, done), jnp.arange(seg)
+        carry0 = (rows if hoist else cache, out, done)
+        (kv_out, out, done2), _ = lax.scan(
+            step, carry0, jnp.arange(seg)
         )
+        if hoist:
+            cache = _rows_scatter_back(cache, kv_out, page_table,
+                                       pos0, kv_limit, done, seg)
+        else:
+            cache = kv_out
         tix = jnp.clip(pos0[:, None] + 1 + jnp.arange(seg)[None, :],
                        0, out_len - 1)
         toks = jnp.take_along_axis(out, tix, axis=1)
-        return cache, out, done, toks
+        return cache, out, done2, toks
 
     return segment
 
@@ -852,7 +982,9 @@ def spec_draft_fn(draft_model, kv_spec, slots: int, out_len: int,
 def _compiled_spec_draft(ddm, b: int, out_len: int, n_row_pages: int,
                          k: int, temperature: float,
                          top_k: Optional[int], top_p: Optional[float]):
-    @_rjit(key="infer.spec_draft")
+    # draft page store donated (ISSUE 11) — same in-place contract as
+    # the segment fn; ``out`` is read-only here (verify reads it next)
+    @_rjit(key="infer.spec_draft", donate_argnums=(1,))
     def draft(params, dcache, out, done, pos0, kv_limit, spec_on,
               stream_ids, rng, page_table):
         posc = jnp.clip(pos0, 0, out_len - 1)
@@ -943,7 +1075,9 @@ def _compiled_spec_verify(dm, b: int, out_len: int, n_row_pages: int,
                           eos_id: Optional[int]):
     w = k + 1
 
-    @_rjit(key="infer.spec_verify")
+    # target page store donated (ISSUE 11): the verify pass is a paged
+    # join by construction — it rides the same in-place fast path
+    @_rjit(key="infer.spec_verify", donate_argnums=(1,))
     def verify(params, cache, out, drafts, done, pos0, kv_limit,
                last_tok, spec_on, stream_ids, rng, page_table):
         posc = jnp.clip(pos0, 0, out_len - 1)
@@ -978,8 +1112,9 @@ def _compiled_spec_verify(dm, b: int, out_len: int, n_row_pages: int,
     return verify
 
 
-@_rjit(key="infer.paged_copy")
+@_rjit(key="infer.paged_copy", donate_argnums=(0,))
 def _paged_copy_jit(cache, src, dst):
+    # donated: a COW fork copies WIDTH pages, not the whole store
     return jax.tree.map(lambda a: a.at[dst].set(a[src]), cache)
 
 

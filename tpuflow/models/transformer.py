@@ -156,6 +156,16 @@ class CausalAttention(nn.Module):
     kv_pages: Optional[int] = None
     kv_page_size: int = 16
     kv_quant: Optional[str] = None  # None | 'int8'
+    # fused paged-attention decode kernel (ops.attention.
+    # paged_flash_decode): the single-token decode step writes the new
+    # K/V and reads through the page table INSIDE one Pallas call —
+    # no dense (B, KVH, L, D) gather. None = auto (TPU backend; off-
+    # TPU the portable scatter+gather path stays the bitwise-pinned
+    # production path and the kernel runs only under interpret-mode
+    # tests); True forces it (interpret off-TPU); False never. Multi-
+    # token calls (join prefill, speculative verify) and int8 stores
+    # always take the portable path.
+    paged_kernel: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions_override=None,
@@ -213,7 +223,72 @@ class CausalAttention(nn.Module):
                 "the paged KV cache — paged rows live at their logical "
                 "positions (no pads)"
             )
-        if paged:
+        if paged and self.has_variable("cache", "key_rows"):
+            # ---- rowwise dense-window decode (ISSUE 11) --------------
+            # The hoisted-gather fast path: the SEGMENT executable
+            # gathers each row's pages into a dense (B, KVH, L, D)
+            # window ONCE per segment (infer.generate's hoisted
+            # segment fn), the per-token steps run against that window
+            # here — write via one-hot select at the row's own
+            # position, read via the same masked einsum as the paged
+            # path below — and the segment scatters written pages back
+            # to the store ONCE at the end. Per-step cost is then the
+            # contiguous path's (no per-step gather/scatter), with the
+            # window length W*page_size chosen per segment (shorter
+            # than the full horizon while rows are young). The caller
+            # provides the window in the cache collection; page
+            # variables are never touched on this path.
+            if write_pos is None:
+                raise ValueError(
+                    "rowwise dense-window decode needs write_pos")
+            if s != 1:
+                raise ValueError(
+                    "rowwise dense-window decode is the single-token "
+                    "segment step (s=1); multi-token paged calls go "
+                    "through the page table")
+            kr = self.variable("cache", "key_rows", lambda: None)
+            vr = self.variable("cache", "value_rows", lambda: None)
+            L = kr.value.shape[2]
+            pos = write_pos[:, None] + jnp.arange(s, dtype=jnp.int32)
+            q, k = rotary_embed(q, k, pos, self.rope_theta,
+                                self.rope_scaling,
+                                self.rope_scaling_kind)
+            wm = (jnp.ones((b, s), bool) if write_mask is None
+                  else write_mask)
+            # SCATTER the token into its window slot — O(B·KVH·D) and
+            # in place on the scan carry. (A full-window one-hot
+            # select here rewrites the whole dense window every step
+            # and hands the hoisting win straight back.) Masked rows
+            # read-modify-write their current slot content unchanged.
+            bidx = jnp.arange(b)
+            posc = jnp.clip(pos[:, 0], 0, L - 1)
+            kt0 = k[:, :, 0, :]  # (B, KVH, D)
+            vt0 = v[:, :, 0, :]
+            wmc = wm[:, 0][:, None, None]
+            cur_k = kr.value[bidx, :, posc, :]
+            cur_v = vr.value[bidx, :, posc, :]
+            kr.value = kr.value.at[bidx, :, posc, :].set(
+                jnp.where(wmc, kt0.astype(kr.value.dtype), cur_k))
+            vr.value = vr.value.at[bidx, :, posc, :].set(
+                jnp.where(wmc, vt0.astype(vr.value.dtype), cur_v))
+            key_pos = jnp.arange(L)
+            ok = key_pos[None, None, :] <= pos[:, :, None]  # (B,s,L)
+            if self.attn_window is not None:
+                ok = ok & (key_pos[None, None, :]
+                           > pos[:, :, None] - self.attn_window)
+            mask = ok[:, None, None]  # (B,1,1,s,L)
+            qg = q.reshape(b, kvh, group, s, head_dim)
+            scores = jnp.einsum(
+                "bkgqd,bksd->bkgqs",
+                qg.astype(jnp.float32), kr.value.astype(jnp.float32),
+            ) * (head_dim ** -0.5)
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum(
+                "bkgqs,bksd->bkgqd", probs,
+                vr.value.astype(jnp.float32),
+            ).reshape(b, self.heads, s, head_dim).astype(self.dtype)
+        elif paged:
             # ---- paged KV decode -------------------------------------
             # The cache collection is a PROCESS-WIDE pool of fixed-size
             # pages; each row's logical KV sequence maps to physical
@@ -264,54 +339,81 @@ class CausalAttention(nn.Module):
                                     self.rope_scaling_kind)
                 wm = (jnp.ones((b, s), bool) if write_mask is None
                       else write_mask)
-                pg = jnp.take_along_axis(
-                    page_table, jnp.clip(pos // ps, 0, n_row_pages - 1),
-                    axis=1,
-                )  # (B, s) physical page of each written position
-                pg = jnp.where(wm, pg, 0)  # masked writes → sink page
-                off = pos % ps
-                kt = k.transpose(0, 2, 1, 3)  # (B, s, KVH, D)
-                vt = v.transpose(0, 2, 1, 3)
-                if self.kv_quant == "int8":
-                    kq, ks_ = _kv_quant_int8(kt)
-                    vq, vs_ = _kv_quant_int8(vt)
-                    kp.value = kp.value.at[pg, :, off, :].set(kq)
-                    vp.value = vp.value.at[pg, :, off, :].set(vq)
-                    ksc.value = ksc.value.at[pg, off].set(ks_)
-                    vsc.value = vsc.value.at[pg, off].set(vs_)
-                    kf = (kp.value[page_table].astype(jnp.float32)
-                          * ksc.value[page_table][:, :, None, :, None])
-                    vf = (vp.value[page_table].astype(jnp.float32)
-                          * vsc.value[page_table][:, :, None, :, None])
+                use_kernel = self.paged_kernel
+                if use_kernel is None:
+                    from tpuflow.core.hw import is_tpu_backend
+
+                    use_kernel = is_tpu_backend()
+                if use_kernel and s == 1 and self.kv_quant is None:
+                    # fused path (ISSUE 11): token write + page-table-
+                    # indirected blockwise online-softmax read in ONE
+                    # kernel call — no dense (B, KVH, L, D) gather;
+                    # the stores alias through input_output_aliases,
+                    # so under the serve executables' buffer donation
+                    # the page write is genuinely in place
+                    from tpuflow.ops.attention import paged_flash_decode
+
+                    o, kp.value, vp.value = paged_flash_decode(
+                        q[:, :, 0, :], k[:, :, 0, :], v[:, :, 0, :],
+                        kp.value, vp.value, page_table, pos[:, 0],
+                        wm[:, 0], window=self.attn_window,
+                    )
+                    o = o[:, :, None, :].astype(self.dtype)
                 else:
-                    kp.value = kp.value.at[pg, :, off, :].set(kt)
-                    vp.value = vp.value.at[pg, :, off, :].set(vt)
-                    kf = kp.value[page_table]
-                    vf = vp.value[page_table]
-                # (B, n_pages, KVH, ps, D) → dense (B, KVH, L, D) view
-                kf = kf.transpose(0, 2, 1, 3, 4).reshape(
-                    b, kvh, max_len, head_dim)
-                vf = vf.transpose(0, 2, 1, 3, 4).reshape(
-                    b, kvh, max_len, head_dim)
-                key_pos = jnp.arange(max_len)
-                # causal at logical granularity; stale page tails and
-                # table slots pointing at the sink page sit ABOVE each
-                # row's live index, so this one comparison masks them
-                ok = key_pos[None, None, :] <= pos[:, :, None]  # (B,s,L)
-                if self.attn_window is not None:
-                    ok = ok & (key_pos[None, None, :]
-                               > pos[:, :, None] - self.attn_window)
-                mask = ok[:, None, None]  # (B,1,1,s,L)
-                qg = q.reshape(b, kvh, group, s, head_dim)
-                scores = jnp.einsum(
-                    "bkgqd,bksd->bkgqs",
-                    qg.astype(jnp.float32), kf.astype(jnp.float32),
-                ) * (head_dim ** -0.5)
-                scores = jnp.where(mask, scores, -1e30)
-                probs = jax.nn.softmax(scores, axis=-1)
-                o = jnp.einsum(
-                    "bkgqs,bksd->bkgqd", probs, vf.astype(jnp.float32),
-                ).reshape(b, self.heads, s, head_dim).astype(self.dtype)
+                    pg = jnp.take_along_axis(
+                        page_table,
+                        jnp.clip(pos // ps, 0, n_row_pages - 1),
+                        axis=1,
+                    )  # (B, s) physical page of each written position
+                    pg = jnp.where(wm, pg, 0)  # masked writes → sink
+                    off = pos % ps
+                    kt = k.transpose(0, 2, 1, 3)  # (B, s, KVH, D)
+                    vt = v.transpose(0, 2, 1, 3)
+                    if self.kv_quant == "int8":
+                        kq, ks_ = _kv_quant_int8(kt)
+                        vq, vs_ = _kv_quant_int8(vt)
+                        kp.value = kp.value.at[pg, :, off, :].set(kq)
+                        vp.value = vp.value.at[pg, :, off, :].set(vq)
+                        ksc.value = ksc.value.at[pg, off].set(ks_)
+                        vsc.value = vsc.value.at[pg, off].set(vs_)
+                        kf = (kp.value[page_table].astype(jnp.float32)
+                              * ksc.value[page_table][:, :, None, :,
+                                                      None])
+                        vf = (vp.value[page_table].astype(jnp.float32)
+                              * vsc.value[page_table][:, :, None, :,
+                                                      None])
+                    else:
+                        kp.value = kp.value.at[pg, :, off, :].set(kt)
+                        vp.value = vp.value.at[pg, :, off, :].set(vt)
+                        kf = kp.value[page_table]
+                        vf = vp.value[page_table]
+                    # (B, n_pages, KVH, ps, D) → dense (B, KVH, L, D)
+                    kf = kf.transpose(0, 2, 1, 3, 4).reshape(
+                        b, kvh, max_len, head_dim)
+                    vf = vf.transpose(0, 2, 1, 3, 4).reshape(
+                        b, kvh, max_len, head_dim)
+                    key_pos = jnp.arange(max_len)
+                    # causal at logical granularity; stale page tails
+                    # and table slots pointing at the sink page sit
+                    # ABOVE each row's live index, so this one
+                    # comparison masks them
+                    ok = key_pos[None, None, :] <= pos[:, :, None]
+                    if self.attn_window is not None:
+                        ok = ok & (key_pos[None, None, :]
+                                   > pos[:, :, None] - self.attn_window)
+                    mask = ok[:, None, None]  # (B,1,1,s,L)
+                    qg = q.reshape(b, kvh, group, s, head_dim)
+                    scores = jnp.einsum(
+                        "bkgqd,bksd->bkgqs",
+                        qg.astype(jnp.float32), kf.astype(jnp.float32),
+                    ) * (head_dim ** -0.5)
+                    scores = jnp.where(mask, scores, -1e30)
+                    probs = jax.nn.softmax(scores, axis=-1)
+                    o = jnp.einsum(
+                        "bkgqs,bksd->bkgqd", probs,
+                        vf.astype(jnp.float32),
+                    ).reshape(b, self.heads, s, head_dim).astype(
+                        self.dtype)
             else:
                 # init pass: shapes only (page pools created above)
                 positions = jnp.arange(s, dtype=jnp.int32)
@@ -510,6 +612,7 @@ class DecoderBlock(nn.Module):
     kv_pages: Optional[int] = None  # paged KV cache (see CausalAttention)
     kv_page_size: int = 16
     kv_quant: Optional[str] = None
+    paged_kernel: Optional[bool] = None  # fused decode (CausalAttention)
 
     @nn.compact
     def __call__(self, x, segment_ids=None, positions=None, pad_lens=None,
@@ -522,7 +625,7 @@ class DecoderBlock(nn.Module):
             rope_scaling=self.rope_scaling,
             rope_scaling_kind=self.rope_scaling_kind,
             kv_pages=self.kv_pages, kv_page_size=self.kv_page_size,
-            kv_quant=self.kv_quant,
+            kv_quant=self.kv_quant, paged_kernel=self.paged_kernel,
             name="attn",
         )(RMSNorm(self.dtype, name="norm1")(x), segment_ids, positions,
           pad_lens, page_table, write_pos, write_mask)
@@ -637,6 +740,7 @@ class TransformerLM(nn.Module):
     kv_pages: Optional[int] = None
     kv_page_size: int = 16
     kv_quant: Optional[str] = None
+    paged_kernel: Optional[bool] = None  # fused decode (CausalAttention)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, segment_ids=None,
@@ -698,7 +802,7 @@ class TransformerLM(nn.Module):
                 rope_scaling=self.rope_scaling,
                 rope_scaling_kind=self.rope_scaling_kind,
                 kv_pages=self.kv_pages, kv_page_size=self.kv_page_size,
-                kv_quant=self.kv_quant,
+                kv_quant=self.kv_quant, paged_kernel=self.paged_kernel,
                 name=f"block{i}",
             )(x, segment_ids, positions, pad_lens, page_table,
               write_pos, write_mask)

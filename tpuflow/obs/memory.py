@@ -369,6 +369,13 @@ def format_kv_section(snap: Dict[str, Any]) -> str:
         f"{snap.get('alloc_failures', 0)} failures, "
         f"free-rate {snap.get('free_rate_per_s', 0)}/s"
     )
+    if snap.get("page_extends") or snap.get("held_vs_budget_mean"):
+        hb = snap.get("held_vs_budget_mean")
+        lines.append(
+            f"incremental allocation: {snap.get('page_extends', 0)} "
+            f"extends, mean held/budget "
+            f"{'n/a' if hb is None else hb} (released requests)"
+        )
     live = snap.get("live_kv_tokens", 0)
     bplt = snap.get("bytes_per_live_token")
     lines.append(
@@ -388,10 +395,13 @@ def format_kv_section(snap: Dict[str, Any]) -> str:
         rows = pools[b]
         lines.append(f"pool bucket={b}: {len(rows)} live rows")
         for r in rows:
+            hb = r.get("held_vs_budget")
             lines.append(
                 f"  slot {r['slot']}: {r['id']} kv_len={r['kv_len']} "
-                f"pages={r['pages']} shared_prefix="
-                f"{r['shared_prefix_tokens']} tok"
+                f"pages={r['pages']}"
+                + (f"/{r['budget_pages']} budget ({hb}x)"
+                   if r.get("budget_pages") else "")
+                + f" shared_prefix={r['shared_prefix_tokens']} tok"
             )
     return "\n".join(lines)
 

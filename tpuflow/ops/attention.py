@@ -970,3 +970,264 @@ def flash_attention(
         )
     o = _flash_core(cfg, qp, kp, vp, segs)
     return o[:, :sq].reshape(b, h, sq, d)
+
+
+# ---------------------------------------------------------------------------
+# paged-attention decode kernel (ISSUE 11)
+#
+# The serve engine's paged KV cache (tpuflow.serve.pages) stores KV in
+# a process-wide pool of fixed-size pages; each decode row maps its
+# logical positions onto physical pages through a per-row page table.
+# The portable path in tpuflow.models.transformer scatters the new
+# token's K/V into the pool, gathers the row's pages back into a dense
+# (B, KVH, L, D) view, and runs plain einsum attention — an O(L)
+# materialization per step that a fused kernel makes unnecessary.
+#
+# ``paged_flash_decode`` is that kernel (vLLM's PagedAttention idea on
+# the repo's own online-softmax flash machinery above): ONE fused call
+# per decode step that (a) lands the new token's K/V in its page slot
+# and (b) runs the blockwise online-softmax read THROUGH the page
+# table — the K/V blocks are fetched page-by-page via a scalar-
+# prefetched page table driving the BlockSpec index maps, so the
+# gather IS the grid walk and nothing dense ever materializes. Page
+# blocks above the row's live length are skipped, and the page stores
+# ride input_output_aliasing so the token write is in place (composes
+# with the serve executables' buffer donation — no O(store) copy).
+# ---------------------------------------------------------------------------
+
+
+class _PagedCfg(NamedTuple):
+    """Static config of the paged decode kernel (hashable)."""
+
+    scale: float
+    page_size: int
+    kv_group: int  # query heads per K/V head (GQA); 1 = MHA
+    window: Optional[int]
+    interpret: bool
+
+
+def _paged_decode_ref(q, k_new, v_new, key_pages, value_pages,
+                      page_table, pos, write_mask, scale,
+                      window: Optional[int] = None):
+    """jnp oracle with the kernel's exact contract (tests): scatter the
+    new token, gather the dense view, masked softmax — the same math
+    the portable einsum path in CausalAttention runs."""
+    b = q.shape[0]
+    h = q.shape[1]
+    kvh = k_new.shape[1]
+    g = h // kvh
+    ps = key_pages.shape[2]
+    d = q.shape[-1]
+    n_row = page_table.shape[1]
+    pg = jnp.take_along_axis(
+        page_table, jnp.clip(pos[:, None] // ps, 0, n_row - 1), axis=1
+    )[:, 0]
+    pg = jnp.where(write_mask, pg, 0)
+    off = pos % ps
+    key_pages = key_pages.at[pg, :, off, :].set(k_new)
+    value_pages = value_pages.at[pg, :, off, :].set(v_new)
+    kf = key_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, kvh, n_row * ps, d).astype(jnp.float32)
+    vf = value_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(
+        b, kvh, n_row * ps, d).astype(jnp.float32)
+    key_pos = jnp.arange(n_row * ps)
+    ok = key_pos[None, :] <= pos[:, None]
+    if window is not None:
+        ok = ok & (key_pos[None, :] > pos[:, None] - window)
+    qg = q.reshape(b, kvh, g, d).astype(jnp.float32)
+    s = jnp.einsum("bkgd,bksd->bkgs", qg, kf) * scale
+    s = jnp.where(ok[:, None, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgs,bksd->bkgd", p, vf).reshape(b, h, d)
+    return o.astype(q.dtype), key_pages, value_pages
+
+
+def _paged_decode_kernel(table_ref, pos_ref, wm_ref, q_ref, kn_ref,
+                         vn_ref, kp_ref, vp_ref, o_ref, kout_ref,
+                         vout_ref, m_ref, l_ref, acc_ref, *,
+                         cfg: _PagedCfg):
+    b = pl.program_id(0)
+    j = pl.program_id(1)  # inner: this row's page blocks, sequential
+    ps = cfg.page_size
+    g = cfg.kv_group
+    kvh = kp_ref.shape[1]
+    t = pos_ref[b]  # the row's query == write position (clipped by caller)
+    last_j = lax.div(t, ps)  # last page block holding visible keys
+    first_j = (
+        jnp.maximum(lax.div(t - cfg.window + 1, ps), 0)
+        if cfg.window is not None else 0
+    )
+
+    # pass the page block through (aliased write-back: untouched pages
+    # must round-trip bit-identical) ...
+    kout_ref[...] = kp_ref[...]
+    vout_ref[...] = vp_ref[...]
+    # ... and the block owning position t additionally lands the new
+    # token's K/V at its slot BEFORE the read below — the fused write.
+    # Skipped entirely for masked rows (done / past budget): the
+    # portable path scribbles the sink page instead; nobody reads
+    # either, and not-writing keeps shared page content bit-stable.
+    @pl.when((j == last_j) & (wm_ref[b] != 0))
+    def _write():
+        off = t - last_j * ps
+        sel = lax.broadcasted_iota(jnp.int32, (1, 1, ps, 1), 2) == off
+        kout_ref[...] = jnp.where(sel, kn_ref[...][:, :, None, :],
+                                  kout_ref[...])
+        vout_ref[...] = jnp.where(sel, vn_ref[...][:, :, None, :],
+                                  vout_ref[...])
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_BIG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # online softmax over the row's LIVE page blocks only — blocks
+    # above the live length (incl. incremental-allocation tail slots
+    # still pointing at the sink) are skipped, so per-step work scales
+    # with the row's tokens, never with its table width
+    @pl.when((j >= first_j) & (j <= last_j))
+    def _compute():
+        col = j * ps + lax.broadcasted_iota(jnp.int32, (1, ps), 1)[0]
+        band = col <= t
+        if cfg.window is not None:
+            band = band & (col > t - cfg.window)
+        for gk in range(kvh):
+            # decode is memory-bound (matvec-shaped): everything runs
+            # f32 like the portable einsum path it must agree with
+            kb = kout_ref[0, gk].astype(jnp.float32)  # (ps, D)
+            vb = vout_ref[0, gk].astype(jnp.float32)
+            qg = q_ref[0, gk * g:(gk + 1) * g].astype(jnp.float32)
+            s = jnp.dot(qg, kb.T,
+                        preferred_element_type=jnp.float32) * cfg.scale
+            s = jnp.where(band[None, :], s, _NEG_BIG)
+            m = m_ref[gk * g:(gk + 1) * g, :1]
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.where(band[None, :], jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l_ref[gk * g:(gk + 1) * g, :1] + jnp.sum(
+                p, axis=-1, keepdims=True)
+            acc_ref[gk * g:(gk + 1) * g] = (
+                acc_ref[gk * g:(gk + 1) * g] * alpha
+                + jnp.dot(p, vb, preferred_element_type=jnp.float32))
+            m_ref[gk * g:(gk + 1) * g] = jnp.broadcast_to(
+                m_new, (g, _LANES))
+            l_ref[gk * g:(gk + 1) * g] = jnp.broadcast_to(
+                l_new, (g, _LANES))
+
+    @pl.when(j == last_j)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = jnp.where(l > 0, acc_ref[...] / safe, 0.0).astype(
+            o_ref.dtype)
+
+
+def paged_flash_decode(q, k_new, v_new, key_pages, value_pages,
+                       page_table, pos, write_mask=None, *,
+                       scale: Optional[float] = None,
+                       window: Optional[int] = None,
+                       interpret: Optional[bool] = None):
+    """Fused paged-attention decode step (one query token per row).
+
+    ``q`` (B, H, D); ``k_new``/``v_new`` (B, KVH, D) — the new token's
+    post-rotary K/V; ``key_pages``/``value_pages`` (pages, KVH,
+    page_size, D) — the process-wide page pools; ``page_table``
+    (B, n_row_pages) int32; ``pos`` (B,) int32 — each row's logical
+    write == query position; ``write_mask`` (B,) bool — False rows
+    skip the KV write (done rows, rows past their budget).
+
+    Returns ``(o, key_pages, value_pages)`` with ``o`` (B, H, D) and
+    the page stores carrying the new token — aliased to the inputs
+    (``input_output_aliases``), so under the serve executables' buffer
+    donation the write is genuinely in place: per-step cost scales
+    with each row's LIVE length (page blocks above it are skipped),
+    never with the store size.
+
+    Grouped-query attention is native (``H % KVH == 0``; q-head i
+    reads K/V head ``i // group``); ``window`` applies the sliding-
+    window mask AND skips page blocks wholly below it. Like every
+    kernel in this module it runs in Pallas interpret mode off-TPU,
+    where tests pin it against the portable scatter+gather+einsum
+    decode path (:func:`_paged_decode_ref` is that oracle).
+
+    Correctness invariant inherited from the page allocator: a page
+    WRITTEN this step (a row's exclusive tail page) is mapped by
+    exactly one row's table; pages shared between rows (prefix-cache
+    chains) are read-only, so every cell's unconditional block
+    write-back round-trips them bit-identical. int8-quantized stores
+    take the portable path (per-page scale dequant is not fused here).
+    """
+    if q.ndim != 3:
+        raise ValueError(f"expected q (batch, heads, head_dim), got "
+                         f"{q.shape}")
+    b, h, d = q.shape
+    kvh = k_new.shape[1]
+    if h % kvh or v_new.shape[1] != kvh:
+        raise ValueError(
+            f"k/v heads ({kvh}/{v_new.shape[1]}) must be equal and "
+            f"divide q heads ({h})")
+    npages, kvh_p, ps, d_p = key_pages.shape
+    if (kvh_p, d_p) != (kvh, d) or value_pages.shape != key_pages.shape:
+        raise ValueError(
+            f"page stores {key_pages.shape}/{value_pages.shape} do not "
+            f"match (pages, {kvh}, page_size, {d})")
+    n_row = page_table.shape[1]
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    if interpret is None:
+        from tpuflow.core.hw import is_tpu_backend
+
+        interpret = not is_tpu_backend()
+    cfg = _PagedCfg(
+        scale=_static_scale(scale, d), page_size=ps, kv_group=h // kvh,
+        window=None if window is None else int(window),
+        interpret=bool(interpret),
+    )
+    # clip so last_j stays inside the table even for rows stepped past
+    # their budget (their write is masked; their output is discarded)
+    posc = jnp.clip(jnp.asarray(pos, jnp.int32), 0, n_row * ps - 1)
+    wm = (jnp.ones((b,), jnp.int32) if write_mask is None
+          else jnp.asarray(write_mask).astype(jnp.int32))
+    kv_spec = pl.BlockSpec((1, kvh, ps, d),
+                           lambda b, j, t, p, w: (t[b, j], 0, 0, 0))
+    row_spec = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda b, j, t, p, w: (b,) + (0,) * (len(shape) - 1))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, n_row),
+        in_specs=[
+            row_spec((1, h, d)),    # q
+            row_spec((1, kvh, d)),  # k_new
+            row_spec((1, kvh, d)),  # v_new
+            kv_spec,                # key_pages (via page table)
+            kv_spec,                # value_pages
+        ],
+        out_specs=[row_spec((1, h, d)), kv_spec, kv_spec],
+        scratch_shapes=[
+            pltpu.VMEM((h, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((h, _LANES), jnp.float32),  # normalizer
+            pltpu.VMEM((h, d), jnp.float32),       # output accumulator
+        ],
+    )
+    # both grid dims 'arbitrary' (sequential): rows sharing prefix
+    # pages write those blocks back concurrently under a parallel b —
+    # identical bytes, but nothing here needs to rely on that
+    o, kp2, vp2 = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, cfg=cfg),
+        grid_spec=grid_spec,
+        out_shape=[
+            _sds((b, h, d), q.dtype),
+            _sds(key_pages.shape, key_pages.dtype),
+            _sds(value_pages.shape, value_pages.dtype),
+        ],
+        # operand indices INCLUDE the scalar-prefetch args: the stores
+        # are operands 6/7 of (table, pos, wm, q, k_new, v_new, kp, vp)
+        input_output_aliases={6: 1, 7: 2},
+        compiler_params=_tpu_compiler_params(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=cfg.interpret,
+    )(jnp.asarray(page_table, jnp.int32), posc, wm, q, k_new, v_new,
+      key_pages, value_pages)
+    return o, kp2, vp2

@@ -116,6 +116,12 @@ class ServeMetrics:
         self.prefix_misses = 0
         self.prefill_tokens_saved = 0
         self.page_waits = 0
+        # incremental page allocation (ISSUE 11): per-segment plan
+        # growth events and out-of-pages mid-decode evictions (a row
+        # requeued with its prefix published — the churn signal a
+        # too-small store shows before anything actually fails)
+        self.page_extends = 0
+        self.mid_decode_evictions = 0
         # speculative decoding (ISSUE 9): cumulative draft/accept
         # counters plus a sliding window of recent rounds — the
         # windowed accept-rate gauge is what a dashboard watches for
@@ -241,6 +247,30 @@ class ServeMetrics:
         inc_counter(f"{self.prefix}.kv_page_waits_total")
         self.event("-pages-", "page_wait", bucket=bucket)
 
+    def on_page_extends(self, n_events: int) -> None:
+        """``n_events`` rows grew their page plan at this boundary
+        (incremental allocation) — allocation-churn accounting: a
+        steadily climbing rate at stable traffic means segments are
+        long relative to the page size (each extend is host work plus
+        an allocator walk, though never a device copy)."""
+        with self._lock:
+            self.page_extends += int(n_events)
+        inc_counter(f"{self.prefix}.kv_page_extends_total",
+                    int(n_events))
+
+    def on_mid_decode_eviction(self, bucket: int,
+                               resumable: bool = True) -> None:
+        """A running row ran the store dry mid-decode and was evicted
+        back to the queue (prefix published, pages released) — or, for
+        ``resumable=False``, failed because its transcript outgrew
+        every bucket. Nonzero at steady state means the store is
+        undersized for the offered concurrency."""
+        with self._lock:
+            self.mid_decode_evictions += 1
+        inc_counter(f"{self.prefix}.kv_mid_decode_evictions_total")
+        self.event("-pages-", "mid_decode_eviction", bucket=bucket,
+                   resumable=resumable)
+
     def on_spec_round(self, drafted: int, accepted: int) -> None:
         """One speculative round's outcome: ``drafted`` proposals
         (k per live speculative row), ``accepted`` of them matched the
@@ -325,6 +355,10 @@ class ServeMetrics:
             )
             m[f"{self.prefix}.prefill_tokens_saved"] = float(
                 self.prefill_tokens_saved)
+            m[f"{self.prefix}.kv_page_extends"] = float(
+                self.page_extends)
+            m[f"{self.prefix}.kv_mid_decode_evictions"] = float(
+                self.mid_decode_evictions)
             m[f"{self.prefix}.spec_rounds"] = float(self.spec_rounds)
             m[f"{self.prefix}.spec_drafted"] = float(self.spec_drafted)
             m[f"{self.prefix}.spec_accepted"] = float(self.spec_accepted)

@@ -60,6 +60,19 @@ def pages_needed(prompt_len: int, max_new: int, page_size: int) -> int:
     return math.ceil((prompt_len + max_new - 1) / page_size)
 
 
+def initial_pages_needed(prompt_len: int, max_new: int, advance: int,
+                         page_size: int) -> int:
+    """Pages the INCREMENTAL reserve covers (ISSUE 11): the prompt
+    plus the first ``advance`` decode tokens, clamped to the budget —
+    KV positions ``[0, min(p-1+advance, p+max_new-1))``. THE single
+    definition: :meth:`PagedKV.plan`'s ``initial_new`` branch and the
+    scheduler's Retry-After hints must quote the same number, or
+    clients back off against capacity admission would grant."""
+    cover = min(prompt_len - 1 + max(1, int(advance)),
+                prompt_len + max_new - 1)
+    return max(1, math.ceil(cover / page_size))
+
+
 def chunk_keys(tokens, page_size: int) -> List[bytes]:
     """Chained digests of the FULL ``page_size``-token chunks of
     ``tokens`` — ``keys[j]`` identifies the token prefix
@@ -87,11 +100,18 @@ class PagedKVSpec:
     """Shape of one paged KV store: ``pages`` physical pages of
     ``page_size`` token slots each; ``quant='int8'`` stores pages as
     int8 with per-page scale vectors (≈4× smaller than f32 KV, 2× than
-    bf16 — capacity doubles again on top of paging)."""
+    bf16 — capacity doubles again on top of paging). ``kernel``
+    selects the fused paged-attention decode kernel
+    (:func:`tpuflow.ops.attention.paged_flash_decode`): ``None`` =
+    auto (TPU backend only — off-TPU the portable einsum path stays
+    the bitwise-pinned production path), ``True`` forces it (Pallas
+    interpret mode off-TPU — what the kernel parity tests run),
+    ``False`` never. int8 stores always take the portable path."""
 
     pages: int
     page_size: int = 16
     quant: Optional[str] = None  # None | 'int8'
+    kernel: Optional[bool] = None  # fused decode kernel (None = auto)
 
     def __post_init__(self):
         if self.pages < 2:
@@ -417,7 +437,12 @@ class PrefixCache:
 @dataclass
 class PagePlan:
     """One admission's page assignment (built by :meth:`PagedKV.plan`,
-    consumed by ``PagedSlotPool.join``)."""
+    grown by :meth:`PagedKV.extend`, consumed by
+    ``PagedSlotPool.join``). Under incremental allocation (ISSUE 11)
+    ``table`` starts at prompt + first-segment coverage and grows at
+    segment boundaries; ``budget_pages`` records the worst-case need
+    (what admission used to reserve up front) so the held-vs-budget
+    accounting can show what incrementality saves."""
 
     table: List[int]  # page chain, position-ordered (shared + fresh)
     owned: List[int] = field(default_factory=list)  # refs THIS request holds
@@ -427,6 +452,12 @@ class PagePlan:
     n_full: int = 0  # leading pages that will hold a full prompt chunk
     matched_tokens: int = 0
     hit: bool = False
+    budget_pages: int = 0  # worst-case pages_needed (the old reserve)
+    # worst case at the POOL's max_new_cap — what a contiguous slab (or
+    # the old reserve at cap) provisions per slot; set by the scheduler
+    cap_budget_pages: int = 0
+    held_sum: int = 0  # Σ len(table) over decode boundaries…
+    held_n: int = 0  # …and the boundary count (mean held = sum/n)
 
 
 class PagedKV:
@@ -463,17 +494,35 @@ class PagedKV:
             PrefixCache(spec.page_size, self.allocator, clock=clock)
             if prefix_cache else None
         )
+        # incremental-allocation accounting (ISSUE 11): per-segment
+        # extend events, and the mean held-vs-budget ratio over
+        # released plans — the number that says what incrementality
+        # saves vs the old worst-case reserve (bench acceptance < 0.6)
+        self.extends = 0
+        self._held_ratio_sum = 0.0
+        self._held_ratio_n = 0
+        self._held_cap_sum = 0.0
+        self._held_cap_n = 0
 
     # ---- admission planning -----------------------------------------
     def pages_needed(self, prompt_len: int, max_new: int) -> int:
         return pages_needed(prompt_len, max_new, self.spec.page_size)
 
-    def plan(self, prompt: np.ndarray, max_new: int) -> Optional[PagePlan]:
+    def plan(self, prompt: np.ndarray, max_new: int,
+             initial_new: Optional[int] = None) -> Optional[PagePlan]:
         """Match the prefix cache, fork the partial tail COW, allocate
         the fresh remainder — or return None when the allocator cannot
         cover it even after LRU-evicting unreferenced tree pages (the
         caller keeps the request QUEUED; nothing is retained on
-        failure)."""
+        failure).
+
+        ``initial_new`` (ISSUE 11, incremental allocation): reserve
+        pages covering only the prompt plus the first ``initial_new``
+        decode tokens instead of the full ``max_new`` budget — the
+        scheduler passes its segment advance and grows the plan at
+        later boundaries via :meth:`extend`, so a request holds pages
+        proportional to tokens GENERATED. ``None`` keeps the original
+        worst-case reserve (offline callers, warm-up)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = int(prompt.size)
         ps = self.spec.page_size
@@ -487,7 +536,16 @@ class PagedKV:
             full_pages, m_tok, partial = self.prefix.match(prompt[:p - 1])
             m_full = m_tok // ps
         need_total = self.pages_needed(p, max_new)
-        n_fresh = need_total - len(full_pages)
+        if initial_new is None:
+            need_init = need_total
+        else:
+            # KV positions that must be writable before the first
+            # extend opportunity: the join prefill writes [m, p-1) and
+            # the first segment writes [p-1, p-1+initial_new), clamped
+            # to the row's budget limit p + max_new - 1
+            need_init = initial_pages_needed(p, max_new,
+                                             int(initial_new), ps)
+        n_fresh = need_init - len(full_pages)
         # retain the matched chain BEFORE any eviction/allocation: a
         # nearly-dry allocator may otherwise LRU-evict the very pages
         # we just matched (tree-only refcount 1) and hand them back as
@@ -520,8 +578,31 @@ class PagedKV:
             n_full=(p - 1) // ps,
             matched_tokens=m,
             hit=m > 0,
+            budget_pages=need_total,
         )
         return plan
+
+    def extend(self, plan: PagePlan, n: int) -> Optional[List[int]]:
+        """Grow ``plan`` by ``n`` fresh pages at a segment boundary
+        (incremental allocation, ISSUE 11) — LRU-evicting unreferenced
+        prefix-tree pages under pressure exactly like :meth:`plan`.
+        Returns the new pages (appended to the plan's table/owned), or
+        None with NOTHING retained when the store is genuinely dry —
+        the caller's cue to evict a row back to the queue instead of
+        letting the pool deadlock."""
+        if n < 1:
+            return []
+        fresh = self.allocator.alloc(n)
+        if fresh is None and self.prefix is not None:
+            short = n - self.allocator.free_count()
+            self.prefix.evict_lru(short)
+            fresh = self.allocator.alloc(n)
+        if fresh is None:
+            return None
+        plan.table.extend(fresh)
+        plan.owned.extend(fresh)
+        self.extends += 1
+        return fresh
 
     def execute_forks(self, plan: PagePlan) -> None:
         if plan.forks:
@@ -550,9 +631,39 @@ class PagedKV:
                                   plan.table[:plan.n_full])
 
     def release(self, plan_or_pages) -> int:
-        pages = (plan_or_pages.owned
-                 if isinstance(plan_or_pages, PagePlan) else plan_or_pages)
+        if isinstance(plan_or_pages, PagePlan):
+            plan = plan_or_pages
+            if plan.held_n and plan.budget_pages:
+                # held-vs-budget sample: mean pages this request held
+                # across its decode boundaries over its worst-case need
+                mean_held = plan.held_sum / plan.held_n
+                self._held_ratio_sum += mean_held / plan.budget_pages
+                self._held_ratio_n += 1
+                if plan.cap_budget_pages:
+                    self._held_cap_sum += (mean_held
+                                           / plan.cap_budget_pages)
+                    self._held_cap_n += 1
+            pages = plan.owned
+        else:
+            pages = plan_or_pages
         return self.allocator.release(pages)
+
+    def held_vs_budget_mean(self) -> Optional[float]:
+        """Mean over released plans of (mean pages held / worst-case
+        budget) — < 1 is what incremental allocation buys; None before
+        any decoded request released."""
+        if not self._held_ratio_n:
+            return None
+        return self._held_ratio_sum / self._held_ratio_n
+
+    def held_vs_cap_mean(self) -> Optional[float]:
+        """Same numerator over the POOL-CAP worst case
+        (``pages_needed(p, max_new_cap)`` — the per-slot provisioning
+        a contiguous slab, or cap-budget reserve, must make). The
+        capacity-planning view of the same saving."""
+        if not self._held_cap_n:
+            return None
+        return self._held_cap_sum / self._held_cap_n
 
     # ---- accounting -------------------------------------------------
     def bytes_in_use(self) -> int:
@@ -567,11 +678,18 @@ class PagedKV:
                                        + self.draft_page_bytes)
 
     def snapshot(self) -> Dict[str, Any]:
+        hb = self.held_vs_budget_mean()
+        hc = self.held_vs_cap_mean()
         out = {"page_size": self.spec.page_size,
                "quant": self.spec.quant or "none",
                "page_bytes": self.page_bytes,
                "kv_bytes_in_use": self.bytes_in_use(),
-               "kv_bytes_total": self.bytes_total()}
+               "kv_bytes_total": self.bytes_total(),
+               "page_extends": self.extends,
+               "held_vs_budget_mean": (
+                   None if hb is None else round(hb, 4)),
+               "held_vs_cap_mean": (
+                   None if hc is None else round(hc, 4))}
         if self.draft_cache is not None:
             out["draft_page_bytes"] = self.draft_page_bytes
         out.update(self.allocator.stats())

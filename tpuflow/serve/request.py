@@ -158,6 +158,29 @@ class Request:
     def expired(self, now: float) -> bool:
         return self.deadline_ts is not None and now > self.deadline_ts
 
+    def effective_prompt(self) -> np.ndarray:
+        """Prompt + already-generated tokens — what a mid-decode-
+        evicted request (paged pool ran dry, ISSUE 11) re-joins with:
+        the next sample's logical position and RNG key are then
+        exactly where the uninterrupted run's would be, so the retry
+        completes token-identically (and the published prefix pages
+        make the re-prefill a cache hit)."""
+        if not self.tokens:
+            return self.prompt_ids
+        return np.concatenate(
+            [self.prompt_ids, np.asarray(self.tokens, np.int32)])
+
+    def effective_len(self) -> int:
+        """``effective_prompt().size`` without materializing the
+        concatenation (hint/accounting paths that only need the
+        length)."""
+        return int(self.prompt_ids.size) + len(self.tokens)
+
+    def remaining_new(self) -> int:
+        """Decode budget still unspent (= ``max_new_tokens`` for a
+        fresh request)."""
+        return self.max_new_tokens - len(self.tokens)
+
     def finalize(self, state: RequestState,
                  error: Optional[str] = None) -> None:
         """Terminal transition (scheduler thread): idempotent — the

@@ -89,6 +89,7 @@ class ServeScheduler:
         kv_pages: Optional[int] = None,
         kv_page_size: int = 16,
         kv_quant: Optional[str] = None,
+        kv_kernel: Optional[bool] = None,
         kv_prefix_cache: bool = True,
         kv_prefix_insert_generated: bool = False,
         speculate_k: int = 0,
@@ -161,7 +162,8 @@ class ServeScheduler:
                 kv_pages = 1 + max(4 * int(slots) * max(1, per_req),
                                    per_max)
             self.kv_spec: Optional[PagedKVSpec] = PagedKVSpec(
-                pages=int(kv_pages), page_size=ps, quant=kv_quant)
+                pages=int(kv_pages), page_size=ps, quant=kv_quant,
+                kernel=kv_kernel)
             self.kv_prefix_cache = bool(kv_prefix_cache)
             # ISSUE 8 satellite (the PR 6 known-limits follow-on):
             # also publish a finished request's GENERATED pages into
@@ -292,6 +294,18 @@ class ServeScheduler:
         QueueFull and the public surface must never diverge."""
         return max(0.1, 0.05 * depth)
 
+    def _initial_pages_needed(self, prompt_len: int, max_new: int) -> int:
+        """The pages ADMISSION actually gates on under incremental
+        allocation (ISSUE 11): prompt + first-segment coverage — THE
+        same helper ``PagedKV.plan(initial_new=segment_advance())``
+        reserves through. Retry-After hints must quote this, not the
+        worst case the request may never grow into."""
+        from tpuflow.serve.pages import initial_pages_needed
+
+        adv = (self.speculate_k + 1) if self.speculate_k else self.seg
+        return initial_pages_needed(prompt_len, max_new, adv,
+                                    self.kv_spec.page_size)
+
     def _page_retry_from(self, need: int) -> Optional[float]:
         """Out-of-pages Retry-After: pages still short of ``need`` over
         the windowed page FREE-RATE (pages/s actually released lately)
@@ -318,8 +332,8 @@ class ServeScheduler:
                     head = q[0]
         hint = self._retry_hint(depth)
         if head is not None and self.kv_state is not None:
-            ph = self._page_retry_from(self.kv_state.pages_needed(
-                int(head.prompt_ids.size), head.max_new_tokens))
+            ph = self._page_retry_from(self._initial_pages_needed(
+                head.effective_len(), head.remaining_new()))
             if ph is not None:
                 hint = max(hint, ph)
         return hint
@@ -375,7 +389,8 @@ class ServeScheduler:
         if self.kv_spec is not None:
             # never-servable check: a request whose WORST-CASE page
             # demand exceeds the whole store could queue forever —
-            # that is a config error, not backpressure
+            # that is a config error, not backpressure (incremental
+            # growth must always be able to finish what it admits)
             need = pages_needed(int(ids.size), int(max_new_tokens),
                                 self.kv_spec.page_size)
             if need > self.kv_spec.pages - 1:
@@ -384,7 +399,10 @@ class ServeScheduler:
                     f"{self.kv_spec.pages - 1} usable pages; raise "
                     f"kv_pages (or shrink the prompt/budget)"
                 )
-            page_hint = self._page_retry_from(need)
+            # …but the RETRY hint quotes what admission actually gates
+            # on — the incremental first-segment reserve (ISSUE 11)
+            page_hint = self._page_retry_from(self._initial_pages_needed(
+                int(ids.size), int(max_new_tokens)))
         now = self.clock()
         req = Request(
             prompt_ids=ids, max_new_tokens=int(max_new_tokens),
@@ -513,6 +531,43 @@ class ServeScheduler:
             # non-DONE terminals never reach the harvest path's final
             # stream event — emit it here so streaming clients unblock
             self._stream(req, [], True)
+
+    def _requeue_mid_decode(self, req: Request) -> None:
+        """The paged store ran dry under this row mid-decode
+        (``extend_for_segment`` could not cover its next segment): the
+        request goes BACK TO THE QUEUE with its generated tokens kept.
+        Its re-join uses the effective prompt (prompt + generated) and
+        remaining budget, so positions and sampling keys land exactly
+        where the uninterrupted run's would — the retry completes
+        TOKEN-IDENTICALLY, and since its prefix pages were published
+        before eviction the re-prefill is normally a cache hit (pages
+        released to the allocator; Retry-After for new arrivals keeps
+        quoting the windowed free-rate). Requeued at the FRONT of its
+        bucket: it has sunk cost and its next starvation check happens
+        at plan() time, so it cannot spin."""
+        from tpuflow.packaging.lm import _bucket_len
+
+        bucket = _bucket_len(req.effective_len())
+        if bucket > self.max_bucket or req.remaining_new() < 1:
+            # the transcript outgrew the largest bucket — not
+            # resumable under this scheduler's config (rare: needs a
+            # prompt already at max_bucket); fail it honestly instead
+            # of requeueing something no pool can ever re-admit
+            self.metrics.on_mid_decode_eviction(req.bucket,
+                                                resumable=False)
+            self._finalize(
+                req, RequestState.CANCELLED,
+                f"out of KV pages mid-decode and the transcript needs "
+                f"bucket {bucket} > max_bucket {self.max_bucket} — "
+                f"not resumable")
+            return
+        req.state = RequestState.QUEUED
+        req.slot = None
+        req.bucket = bucket
+        with self._lock:
+            self._queues.setdefault(bucket, deque()).appendleft(req)
+            self._work.notify_all()
+        self.metrics.on_mid_decode_eviction(bucket)
 
     def _stream(self, req: Request, new: List[int], finished: bool) -> None:
         if req.stream_cb is None or (not new and not finished):
@@ -656,12 +711,26 @@ class ServeScheduler:
                         # paged admission asks the ALLOCATOR, not the
                         # pool: out of pages → the head stays QUEUED
                         # (Retry-After from the page free-rate) until
-                        # finishing/cancelled requests release theirs
+                        # finishing/cancelled requests release theirs.
+                        # INCREMENTAL reserve (ISSUE 11): prompt +
+                        # first-segment pages only — the plan grows at
+                        # decode boundaries (extend_for_segment), so a
+                        # request holds pages proportional to tokens
+                        # generated, not its worst-case budget. A
+                        # mid-decode-evicted head re-plans with its
+                        # effective prompt + remaining budget (resume).
                         plan = self.kv_state.plan(
-                            q[0].prompt_ids, q[0].max_new_tokens)
+                            q[0].effective_prompt(),
+                            q[0].remaining_new(),
+                            initial_new=pool.segment_advance())
                         if plan is None:
                             page_starved = True
                             break
+                        # cap-provisioning baseline for the held-vs-
+                        # budget accounting (what a per-slot slab at
+                        # max_new_cap would have reserved)
+                        plan.cap_budget_pages = self.kv_state.pages_needed(
+                            q[0].effective_len(), self.max_new_cap)
                         req = q.popleft()
                         admits.append((free.pop(0), req, plan))
                     else:
@@ -689,6 +758,28 @@ class ServeScheduler:
                     trace.end(getattr(req, "_span_queue", None),
                               slot=_slot)
                 progress = True
+            if pool.has_live() and self.kv_state is not None:
+                # incremental allocation (ISSUE 11): cover every live
+                # row's next-segment writes BEFORE dispatch — a row the
+                # store cannot cover is evicted back to the queue with
+                # its prefix published (resume machinery), never left
+                # to scatter KV into the sink or deadlock the pool.
+                # Evictions go ONE AT A TIME with a re-sweep between:
+                # the freed pages usually rescue the rest of the batch.
+                while True:
+                    starved, n_ext = pool.extend_for_segment()
+                    if n_ext:
+                        self.metrics.on_page_extends(n_ext)
+                    if not starved:
+                        break
+                    slot, req = starved[0]
+                    # publish BEFORE evict: the tree retains its own
+                    # references, so the retry's re-prefill is a hit
+                    # (pages stay LRU-evictable under pressure)
+                    pool.publish_generated(slot)
+                    pool.evict(slot)
+                    self._requeue_mid_decode(req)
+                    progress = True
             if pool.has_live():
                 events, live = pool.run_segment()
                 _health.heartbeat(f"{self.metrics.prefix}.segment")
@@ -1030,11 +1121,18 @@ class ServeScheduler:
                 plan = pool.plans[slot]
                 kv_len = int(min(pool.pos[slot], pool.kv_limit[slot]))
                 live_tokens += kv_len
+                held = 0 if plan is None else len(plan.table)
+                budget = 0 if plan is None else plan.budget_pages
                 rows.append({
                     "slot": slot, "id": req.id, "kv_len": kv_len,
                     "pages": 0 if plan is None else len(plan.owned),
                     "shared_prefix_tokens":
                         0 if plan is None else plan.matched_tokens,
+                    # incremental allocation (ISSUE 11): what the row
+                    # holds NOW vs the worst case it used to reserve
+                    "budget_pages": budget,
+                    "held_vs_budget": (round(held / budget, 3)
+                                       if budget else None),
                 })
             tables[str(b)] = rows
         snap["pools"] = tables
@@ -1101,6 +1199,7 @@ def serve_texts(
     kv_pages: Optional[int] = None,
     kv_page_size: int = 16,
     kv_quant: Optional[str] = None,
+    kv_kernel: Optional[bool] = None,
     speculate_k: int = 0,
     draft_model=None,
     draft_params=None,
@@ -1125,7 +1224,7 @@ def serve_texts(
         max_new_cap=max_new_tokens, max_queue=max(1, len(prompts)),
         temperature=temperature, top_k=top_k, top_p=top_p, eos_id=eos_id,
         seed=seed, kv=kv, kv_pages=kv_pages, kv_page_size=kv_page_size,
-        kv_quant=kv_quant, speculate_k=speculate_k,
+        kv_quant=kv_quant, kv_kernel=kv_kernel, speculate_k=speculate_k,
         draft_model=draft_model, draft_params=draft_params,
     )
     reqs = [sched.submit(p, max_new_tokens) for p in prompts]

@@ -325,10 +325,46 @@ class PagedSlotPool:
         self.length = self.bucket + self.max_new_cap
         self.n_row_pages = math.ceil((self.length - 1) / ps)
         self._rng = jax.random.key(int(seed))
-        self._segment = paged_segment_fn(
-            model, kv.spec, self.slots, self.length, self.n_row_pages,
-            self.seg, float(temperature), top_k, top_p, eos_id,
-        )
+        # hoisted dense-window segments (ISSUE 11): gather the rows'
+        # pages ONCE per segment into per-row dense windows and run
+        # the steps contiguous-style, with a pow2 TABLE-WIDTH menu so
+        # young rows attend over short windows. Disabled for int8
+        # stores (the window would need requantization on the way
+        # back) and when the fused decode kernel is active (the kernel
+        # IS the per-step fast path and reads pages directly).
+        kernel_on = getattr(kv.spec, "kernel", None)
+        if kernel_on is None:
+            from tpuflow.core.hw import is_tpu_backend
+
+            kernel_on = is_tpu_backend()
+        # no hoisted menu for speculative pools: run_segment routes to
+        # _run_spec_round (draft + verify dispatches) before ever
+        # consulting a plain segment, so the menu would only be built
+        # and warmed for nothing
+        self._hoist = (kv.spec.quant is None and not kernel_on
+                       and not int(spec_k))
+        # three width classes, not a full pow2 ladder: each class is a
+        # compiled executable (per sampling config per bucket), and the
+        # win concentrates at the bottom — brand-new rows (w=1..2)
+        # attend over a tiny window while full-budget rows pay the
+        # whole horizon anyway. {1, 2, NP} keeps the compile budget at
+        # 3x the old single class.
+        wmenu = [w for w in (1, 2) if w < self.n_row_pages]
+        wmenu.append(self.n_row_pages)
+        self._seg_widths = wmenu
+        if self._hoist:
+            self._segment = {
+                wd: paged_segment_fn(
+                    model, kv.spec, self.slots, self.length,
+                    self.n_row_pages, self.seg, float(temperature),
+                    top_k, top_p, eos_id, table_width=wd)
+                for wd in wmenu
+            }
+        else:
+            self._segment = {None: paged_segment_fn(
+                model, kv.spec, self.slots, self.length,
+                self.n_row_pages, self.seg, float(temperature),
+                top_k, top_p, eos_id)}
         # width menu (powers of two + the full bucket): the suffix a
         # join must write is width = p - matched <= bucket tokens; the
         # narrowest compiled window that fits is used, so prefix hits
@@ -409,7 +445,13 @@ class PagedSlotPool:
         """Admit ``(slot, request, plan)`` triples (plans from
         :meth:`PagedKV.plan`): execute COW forks, write each row's
         uncached suffix + prefill it through the page table, publish
-        completed prompt pages into the prefix tree."""
+        completed prompt pages into the prefix tree.
+
+        A request carrying already-generated tokens (mid-decode page
+        eviction, ISSUE 11) joins with its EFFECTIVE prompt
+        (prompt + generated) and its REMAINING budget — positions,
+        sampling keys and the kv limit land exactly where the
+        uninterrupted run's would, so the retry is token-identical."""
         import jax.numpy as jnp
 
         if not admits:
@@ -417,18 +459,23 @@ class PagedSlotPool:
         kv = self.kv
         widths = np.zeros((self.slots,), np.int32)
         starts = np.zeros((self.slots,), np.int32)
+        fulls = {}
         need_w = 1
         for slot, req, plan in admits:
             if self.occupants[slot] is not None:
                 raise RuntimeError(f"slot {slot} is occupied")
-            p = int(req.prompt_ids.size)
+            full = req.effective_prompt()
+            fulls[slot] = full
+            p = int(full.size)
+            budget = req.remaining_new()
             if not 1 <= p <= self.bucket:
                 raise ValueError(
                     f"prompt length {p} outside (0, bucket={self.bucket}]"
                 )
-            if req.max_new_tokens > self.max_new_cap:
+            if budget > self.max_new_cap or budget < 1:
                 raise RuntimeError(
-                    f"request {req.id} exceeds max_new_cap"
+                    f"request {req.id} budget {budget} outside the "
+                    f"pool's (0, max_new_cap={self.max_new_cap}]"
                 )
             kv.execute_forks(plan)
             row = self.page_table[slot]
@@ -438,8 +485,8 @@ class PagedSlotPool:
             widths[slot] = plan.width
             need_w = max(need_w, plan.width)
             self.pos[slot] = p - 1
-            self.kv_limit[slot] = p + req.max_new_tokens - 1
-            self.last_tok[slot] = p + req.max_new_tokens - 1
+            self.kv_limit[slot] = p + budget - 1
+            self.last_tok[slot] = p + budget - 1
             self.stream_ids[slot] = req.stream_id
             self.spec_on[slot] = bool(getattr(req, "speculate", True))
             self.done[slot] = False
@@ -450,7 +497,7 @@ class PagedSlotPool:
         self.last_join_width = w
         tokens = np.zeros((self.slots, w), np.int32)
         for slot, req, plan in admits:
-            tokens[slot, : plan.width] = req.prompt_ids[plan.start:]
+            tokens[slot, : plan.width] = fulls[slot][plan.start:]
         with trace.span("serve.prefill_join", phase="prefill",
                         bucket=self.bucket, n=len(admits), width=w,
                         hits=sum(pl.hit for _, _, pl in admits),
@@ -475,7 +522,73 @@ class PagedSlotPool:
         if self.spec_k:
             _mem.tag("kv_draft", self.kv.draft_cache)
         for slot, req, plan in admits:
-            kv.insert_prompt(req.prompt_ids, plan)
+            # publish the EFFECTIVE prompt (a resumed request's
+            # includes its generated tokens — the plan's n_full was
+            # computed against exactly this sequence)
+            kv.insert_prompt(fulls[slot], plan)
+
+    def segment_advance(self) -> int:
+        """KV positions one boundary can write per row: a speculative
+        round's verify covers ``spec_k + 1`` positions (for EVERY live
+        row — opt-out rows' windows are rewritten too), a plain
+        segment ``seg``."""
+        return (self.spec_k + 1) if self.spec_k else self.seg
+
+    def segment_width(self) -> Optional[int]:
+        """Narrowest compiled table width covering every live row's
+        pages THIS segment (reads span ``[0, pos)``, writes reach
+        ``min(pos + advance, kv_limit)``) — the hoisted segment's
+        dense window is ``width × page_size`` positions long, so young
+        rows attend over short windows. None on the per-step path."""
+        if not self._hoist:
+            return None
+        ps = self.kv.spec.page_size
+        adv = self.segment_advance()
+        need = 1
+        for slot, req in enumerate(self.occupants):
+            if req is None or self.done[slot]:
+                continue
+            cover = min(int(self.pos[slot]) + adv,
+                        int(self.kv_limit[slot]))
+            need = max(need, -(-cover // ps))
+        return next(w for w in self._seg_widths if w >= need)
+
+    def extend_for_segment(self) -> Tuple[List[Tuple[int, Request]], int]:
+        """Incremental page allocation (ISSUE 11): before a segment
+        runs, grow every live row's plan to cover the positions this
+        boundary will write (``pos .. min(pos+advance, kv_limit)-1``)
+        — a position whose table slot still points at the sink would
+        silently scatter its KV there and corrupt the row's reads.
+
+        Returns ``(starved, extend_events)``: rows the allocator could
+        not cover even after LRU pressure on the prefix tree. The
+        SCHEDULER owns what happens to them (publish prefix → evict ONE
+        → re-sweep: a single eviction's freed pages usually rescue the
+        rest of the batch, so the pool can never deadlock against
+        itself). Idempotent for covered rows — safe to re-run after an
+        eviction."""
+        ps = self.kv.spec.page_size
+        adv = self.segment_advance()
+        starved: List[Tuple[int, Request]] = []
+        events = 0
+        for slot, req in enumerate(self.occupants):
+            if req is None or self.done[slot]:
+                continue
+            plan = self.plans[slot]
+            if plan is None:  # pragma: no cover - defensive
+                continue
+            cover = min(int(self.pos[slot]) + adv,
+                        int(self.kv_limit[slot]))
+            need = max(1, -(-cover // ps))  # ceil
+            if need > len(plan.table):
+                have = len(plan.table)
+                got = self.kv.extend(plan, need - have)
+                if got is None:
+                    starved.append((slot, req))
+                    continue
+                self.page_table[slot, have:have + len(got)] = got
+                events += 1
+        return starved, events
 
     def publish_generated(self, slot: int) -> int:
         """At request FINISH (ISSUE 8 satellite — the PR 6 known-limit
@@ -556,6 +669,8 @@ class PagedSlotPool:
         plan.n_full = 0  # NEVER publish the dummy warm-up prompt into
         # the prefix tree — tree-retained garbage pages would inflate
         # kv_pages_in_use until pressure evicts them
+        plan.budget_pages = 0  # …and keep warm-up rows out of the
+        # held-vs-budget accounting (they would read as ratio 1.0)
         self.join([(0, Request(prompt_ids=np.ones(1, np.int32),
                                max_new_tokens=1), plan)])
         self.run_segment()
@@ -563,11 +678,35 @@ class PagedSlotPool:
         full = self.kv.plan(np.ones(self.bucket, np.int32), 1)
         if full is not None:
             full.n_full = 0
+            full.budget_pages = 0
             self.join([(0, Request(
                 prompt_ids=np.ones(self.bucket, np.int32),
                 max_new_tokens=1), full)])
             self.run_segment()
             self.evict(0)
+        if self._hoist and len(self._seg_widths) > 1:
+            # warm EVERY hoisted width class: the dummies above only
+            # reach width 1..2, and the first production segment
+            # landing on a cold class would otherwise pay its XLA
+            # compile at a live decode boundary — the exact stall
+            # warm() exists to prevent. Positions pinned per class
+            # like the bench's cost-table ops; writes past the dummy
+            # plan's pages hit the sink (garbage nobody reads).
+            dummy = np.ones(self.bucket, np.int32)
+            plan3 = self.kv.plan(dummy, self.max_new_cap)
+            if plan3 is not None:
+                plan3.n_full = 0
+                plan3.budget_pages = 0
+                self.join([(0, Request(
+                    prompt_ids=dummy,
+                    max_new_tokens=self.max_new_cap), plan3)])
+                ps = self.kv.spec.page_size
+                for w in self._seg_widths:
+                    self.pos[0] = max(0, min(
+                        w * ps - self.seg, int(self.kv_limit[0]) - 1))
+                    self.done[0] = False
+                    self.run_segment()
+                self.evict(0)
         self.kv.cache = paged_copy(self.kv.cache, [0], [0])  # sink no-op
         _mem.tag("kv_pages", self.kv.cache)
         self.segments_run = 0
@@ -580,19 +719,23 @@ class PagedSlotPool:
         ``seg`` plain steps."""
         import jax.numpy as jnp
 
+        self._record_held()
         if self.spec_k:
             return self._run_spec_round()
         pos0 = self.pos.copy()
         live_before = self.live_count()
+        w = self.segment_width()
+        seg_fn = self._segment[w]
+        table = self.page_table if w is None else self.page_table[:, :w]
         with trace.span("serve.decode_segment", phase="decode",
                         bucket=self.bucket, seg=self.seg,
-                        live=live_before, paged=1):
-            self.kv.cache, self.out, done_dev, toks = self._segment(
+                        live=live_before, paged=1, width=w or 0):
+            self.kv.cache, self.out, done_dev, toks = seg_fn(
                 self.params, self.kv.cache, self.out,
                 jnp.asarray(self.done), jnp.asarray(pos0),
                 jnp.asarray(self.kv_limit), jnp.asarray(self.last_tok),
                 jnp.asarray(self.stream_ids), self._rng,
-                jnp.asarray(self.page_table),
+                jnp.asarray(table),
             )
             self.segments_run += 1
             was_done = self.done
@@ -613,6 +756,19 @@ class PagedSlotPool:
                 new.append(int(tok))
             events.append((slot, req, new, finished))
         return events, live_before
+
+    def _record_held(self) -> None:
+        """One held-pages sample per live plan per boundary — the
+        held-vs-budget accounting (:meth:`PagedKV.held_vs_budget_mean`
+        folds these at release). Warm-up plans opt out by zeroing
+        ``budget_pages``."""
+        for slot, req in enumerate(self.occupants):
+            if req is None or self.done[slot]:
+                continue
+            plan = self.plans[slot]
+            if plan is not None and plan.budget_pages:
+                plan.held_sum += len(plan.table)
+                plan.held_n += 1
 
     def _run_spec_round(self):
         """One speculative round: k draft steps (one dispatch), one
