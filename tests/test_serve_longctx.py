@@ -1,0 +1,322 @@
+"""Long-context serving (ISSUE 13): chunked prefill scheduling +
+ring-attention prefill offload.
+
+Tier discipline: ONE tiny shared model at the test_serve_paged.py pool
+geometry (slots=2, seg=4, cap=12, page_size=4, kv_pages=49 — the
+compiled join/segment executables are LRU-memoized process-wide, so
+these tests reuse test_serve_paged's compiles) and the SAME sampled
+config (temperature=0.8, top_k=20, seed=7). The ring harvest runs on
+the conftest 8-device virtual CPU mesh at a 16-token bucket.
+
+The load-bearing pins:
+
+- CHUNKED joins are TOKEN-IDENTICAL to atomic joins (greedy AND
+  sampled, mid-flight joins included): a chunk is the same
+  suffix-join executable an atomic admission compiles, dispatched
+  with an advancing frontier — same KV, position by position;
+- a prefix-cache hit whose cached prefix ends MID-CHUNK resumes the
+  chunked suffix from the match frontier, token-identically;
+- partially-prefilled rows publish completed page chunks at CHUNK
+  boundaries: a duplicate prompt queued mid-prefill hits the partial
+  chain, a cancel mid-prefill balances every refcount;
+- RING-prefill-then-paged-decode == single-device
+  prefill-then-decode, bitwise on the decoded tokens (greedy AND
+  sampled), with the prompt published for later single-device hits;
+- the serve.itl_ms histogram (the SLO knob's other side) feeds
+  /v1/metrics, Prometheus and load_snapshot().
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.models import build_transformer_lm
+
+KW = dict(vocab_size=128, dim=32, depth=1, heads=2, mlp_ratio=2,
+          dtype=jnp.float32)
+# test_serve_paged.py's pool geometry + store size (compile reuse)
+GEO = dict(slots=2, seg=4, max_new_cap=12)
+PS = 4
+SAMPLED = dict(temperature=0.8, top_k=20, seed=7)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import flax.linen as nn
+
+    lm = build_transformer_lm(**KW)
+    params = nn.unbox(
+        lm.init({"params": jax.random.key(0)}, jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    return lm, params
+
+
+class TickClock:
+    """Monotonic fake clock: every read advances 50 ms, so segment-
+    boundary deltas (the ITL samples) are deterministic nonzero."""
+
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        self.now += 0.05
+        return self.now
+
+
+def _sched(tiny_lm, **kw):
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = tiny_lm
+    base = dict(GEO, kv="paged", kv_page_size=PS, kv_pages=49)
+    base.update(kw)
+    return ServeScheduler(lm, params, **base)
+
+
+# ---------------------------------------------------------------------
+# chunked joins: token identity vs atomic, mid-flight joins included
+# ---------------------------------------------------------------------
+
+def test_chunked_join_token_identity_vs_unchunked(tiny_lm):
+    """A 13-token prompt (bucket 16, suffix >> budget) chunked at 3
+    KV positions per boundary, sharing the engine with short rows that
+    join mid-flight: every request's tokens equal the atomic-join
+    run's, greedy AND sampled — and the chunk counters moved."""
+    rng = np.random.default_rng(5)
+    long_p = rng.integers(1, 128, (13,)).astype(np.int32)
+    shorts = [rng.integers(1, 128, (n,)).astype(np.int32) for n in (3, 6)]
+
+    def run(**kw):
+        s = _sched(tiny_lm, **kw)
+        r0 = s.submit(shorts[0], 8)
+        s.step()  # r0 decoding; the long prompt joins mid-flight
+        r1 = s.submit(long_p, 8)
+        r2 = s.submit(shorts[1], 8)
+        s.run_until_idle()
+        assert all(r.state.value == "done" for r in (r0, r1, r2))
+        return [list(r.tokens) for r in (r0, r1, r2)], s
+
+    for kw in (dict(), SAMPLED):
+        base, _ = run(**kw)
+        chunked, sc = run(prefill_budget_tokens=3, **kw)
+        assert base == chunked, kw
+        # the long suffix (12 uncached positions) genuinely chunked:
+        # ceil(12/3) = 4 dispatches at least
+        assert sc.metrics.prefill_chunks >= 4
+        assert sc.metrics.prefill_chunk_tokens >= 12
+    from tpuflow.obs.gauges import counters
+
+    assert counters("serve.").get("serve.prefill_chunks_total", 0) >= 4
+
+
+def test_chunked_prefix_hit_ending_mid_chunk(tiny_lm):
+    """A second request shares 6 tokens (1 full page + 2 into the
+    next: the cached prefix ends mid-page AND mid-chunk) with a
+    finished one, then continues CHUNKED from the COW-forked frontier
+    — tokens equal the atomic run's, and the hit genuinely skipped
+    the matched positions (fewer chunk tokens than the full suffix)."""
+    rng = np.random.default_rng(11)
+    a_ids = rng.integers(1, 128, (10,)).astype(np.int32)
+    b_ids = np.concatenate(
+        [a_ids[:6], rng.integers(1, 128, (7,)).astype(np.int32)])
+
+    def run(budget):
+        s = _sched(tiny_lm, prefill_budget_tokens=budget)
+        a = s.submit(a_ids, 6)
+        s.run_until_idle()
+        b = s.submit(b_ids, 6)
+        s.run_until_idle()
+        assert a.state.value == b.state.value == "done"
+        ev = [e for e in s.metrics.events(b.id)
+              if e["event"] == "prefix_match"]
+        return list(a.tokens), list(b.tokens), ev[0], s
+
+    a_c, b_c, ev_c, s_c = run(budget=3)
+    a_o, b_o, ev_o, _ = run(budget=None)
+    assert (a_c, b_c) == (a_o, b_o)
+    assert ev_c["hit"] and ev_c["matched_tokens"] == 6
+    assert ev_c["matched_tokens"] == ev_o["matched_tokens"]
+    # b's chunked suffix started at the match frontier: 13 - 6 = 7
+    # uncached positions at budget 3 → 3 dispatches for b (a took 3)
+    assert s_c.metrics.prefill_chunk_tokens < (len(a_ids) - 1) + (
+        len(b_ids) - 1)
+
+
+# ---------------------------------------------------------------------
+# chunk-boundary publish + refcount balance under mid-prefill eviction
+# ---------------------------------------------------------------------
+
+def test_chunk_boundary_publish_and_refcount_balance(tiny_lm):
+    """Mid-prefill, completed page chunks are ALREADY in the prefix
+    tree: a duplicate prompt submitted while the first is still
+    prefilling gets a hit on the partial chain; cancelling the
+    original mid-prefill releases its pages (tree retains its own) and
+    the duplicate completes with the tokens a fresh run produces.
+    After the drain, refcounts balance to tree-only."""
+    rng = np.random.default_rng(7)
+    ids = rng.integers(1, 128, (13,)).astype(np.int32)
+
+    oracle = _sched(tiny_lm)
+    o = oracle.submit(ids, 8)
+    oracle.run_until_idle()
+
+    s = _sched(tiny_lm, prefill_budget_tokens=2)
+    a = s.submit(ids, 8)
+    for _ in range(3):  # 3 chunks of 2 → frontier 6: one full page
+        s.step()
+    pool = s.pools[16]
+    assert pool.prefilling[a.slot]  # still mid-prefill
+    assert int(pool.prefill_next[a.slot]) >= PS
+    # the partial chain is published: a duplicate matches >= one page
+    b = s.submit(ids, 8)
+    assert s.cancel(a)
+    s.run_until_idle()
+    assert a.state.value == "cancelled"
+    assert b.state.value == "done"
+    ev = [e for e in s.metrics.events(b.id)
+          if e["event"] == "prefix_match"]
+    assert ev and ev[0]["hit"] and ev[0]["matched_tokens"] >= PS
+    assert list(b.tokens) == list(o.tokens)
+    # balance: only tree-held pages remain, each at refcount 1
+    kvs = s.kv_state
+    assert kvs.allocator.in_use() == kvs.prefix.nodes
+    assert int(kvs.allocator.refs[1:].max(initial=0)) <= 1
+    kvs.prefix.clear()
+    assert kvs.allocator.in_use() == 0
+
+
+# ---------------------------------------------------------------------
+# ring-attention prefill offload: parity + publish
+# ---------------------------------------------------------------------
+
+def test_ring_prefill_matches_single_device(tiny_lm):
+    """ring-prefill-then-paged-decode == single-device prefill-then-
+    decode, bitwise on the decoded tokens (greedy AND sampled), with a
+    short concurrent row unperturbed; the harvest's prompt pages
+    publish, so a later below-threshold prompt sharing the prefix hits
+    the cache on the normal path."""
+    rng = np.random.default_rng(3)
+    long_p = rng.integers(1, 128, (13,)).astype(np.int32)
+    short = rng.integers(1, 128, (5,)).astype(np.int32)
+
+    def run(**kw):
+        s = _sched(tiny_lm, **kw)
+        r0 = s.submit(short, 6)
+        s.step()
+        r1 = s.submit(long_p, 8)
+        s.run_until_idle()
+        assert r0.state.value == r1.state.value == "done"
+        return [list(r0.tokens), list(r1.tokens)], s
+
+    for kw in (dict(), SAMPLED):
+        base, _ = run(**kw)
+        ringed, sr = run(ring_prefill=4, ring_prefill_min_tokens=10,
+                         **kw)
+        assert base == ringed, kw
+        assert sr.metrics.ring_prefills == 1
+    # publish check: a shorter prompt sharing the long one's prefix
+    # (below the ring threshold → normal join) hits the landed pages
+    follow = long_p[:9]  # 2 full pages of the published chain
+    r2 = sr.submit(follow, 4)
+    sr.run_until_idle()
+    assert r2.state.value == "done"
+    ev = [e for e in sr.metrics.events(r2.id)
+          if e["event"] == "prefix_match"]
+    assert ev and ev[0]["hit"] and ev[0]["matched_tokens"] >= PS
+    # an exact duplicate of the long prompt is a FULL hit: the ring
+    # gate is the uncached suffix, so it never re-rings — it admits as
+    # the width-1 fast path off the published chain
+    r3 = sr.submit(long_p, 8)
+    sr.run_until_idle()
+    assert r3.state.value == "done"
+    ev3 = [e for e in sr.metrics.events(r3.id)
+           if e["event"] == "prefix_match"]
+    assert ev3 and ev3[0]["hit"]
+    assert ev3[0]["matched_tokens"] == long_p.size - 1
+    assert sr.metrics.ring_prefills == 1  # no second ring pass
+    from tpuflow.obs.gauges import counters
+
+    assert counters("serve.").get("serve.ring_prefills_total", 0) >= 1
+
+
+def test_ring_prefill_kv_matches_prefill_oracle(tiny_lm):
+    """Unit pin under the scheduler: the ring harvest's per-layer K/V
+    (striped layout, 4 shards) matches a single-device decode-twin
+    prefill's cache content to numerical tolerance — the landing-path
+    contract (same tensors, ring-merge rounding only)."""
+    from tpuflow.infer.generate import ring_prefill_kv
+
+    lm, params = tiny_lm
+    rng = np.random.default_rng(9)
+    toks = rng.integers(1, 128, (1, 16)).astype(np.int32)
+    harvest = ring_prefill_kv(lm, params, toks, n_shards=4)
+    # oracle: the dense decode twin's cache after one full prefill
+    dm = lm.clone(decode=True, seq_axis=None)
+    cache = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: dm.init(
+            {"params": jax.random.key(0)},
+            jnp.zeros((1, 16), jnp.int32))["cache"]))
+    _, vars2 = dm.apply({"params": params, "cache": cache},
+                        jnp.asarray(toks), mutable=["cache"])
+    ref = vars2["cache"]
+    for blk, sub in harvest.items():
+        hk = np.asarray(sub["attn"]["k"][0])  # (1, KVH, S, D)
+        hv = np.asarray(sub["attn"]["v"][0])
+        rk = np.asarray(ref[blk]["attn"]["cached_key"])
+        rv = np.asarray(ref[blk]["attn"]["cached_value"])
+        np.testing.assert_allclose(hk, rk, atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(hv, rv, atol=2e-5, rtol=2e-5)
+
+
+def test_longctx_config_validation(tiny_lm):
+    """Host-only config edges: the chunking/ring knobs demand the
+    paged engine and sane values — and the insert-generated default is
+    now ON (the r11 verdict), with the opt-out honored."""
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = tiny_lm
+    with pytest.raises(ValueError, match="paged"):
+        ServeScheduler(lm, params, prefill_budget_tokens=4, **GEO)
+    with pytest.raises(ValueError, match=">= 1"):
+        _sched(tiny_lm, prefill_budget_tokens=0)
+    with pytest.raises(ValueError, match="paged"):
+        ServeScheduler(lm, params, ring_prefill=4, **GEO)
+    with pytest.raises(ValueError, match="power of two"):
+        _sched(tiny_lm, ring_prefill=3)
+    with pytest.raises(ValueError, match="int8"):
+        _sched(tiny_lm, ring_prefill=4, kv_quant="int8")
+    with pytest.raises(ValueError, match="power of two"):
+        _sched(tiny_lm, ring_prefill=16)  # > 8: cannot divide bucket 8
+    # the r11 default flip: generated-page insertion ON unless opted out
+    assert _sched(tiny_lm).kv_insert_generated is True
+    assert _sched(
+        tiny_lm, kv_prefix_insert_generated=False
+    ).kv_insert_generated is False
+
+
+# ---------------------------------------------------------------------
+# serve.itl histogram: the SLO knob's counter-metric
+# ---------------------------------------------------------------------
+
+def test_itl_histogram_feeds_every_surface(tiny_lm):
+    """Per-row segment-boundary deltas land in serve.itl_ms and reach
+    /v1/metrics (windowed p95 primary), load_snapshot() and the
+    Prometheus exposition — the metric the prefill SLO knob trades
+    the long prompt's TTFT against."""
+    clk = TickClock()
+    s = _sched(tiny_lm, clock=clk)
+    n0 = len(s.metrics.itl_ms)
+    r = s.submit(np.arange(1, 8, dtype=np.int32), 12)
+    s.run_until_idle()
+    assert r.state.value == "done" and len(r.tokens) == 12
+    # 12 tokens over seg=4 → 3 token-producing boundaries → 2 deltas
+    assert len(s.metrics.itl_ms) >= n0 + 2
+    snap = s.metrics_snapshot()
+    assert snap["serve.itl_ms_p95"] > 0
+    assert "serve.itl_ms_p95_cum" in snap
+    load = s.load_snapshot()
+    assert "itl_ms_p95" in load and load["itl_ms_p95"] > 0
+    from tpuflow.obs.prom import render
+
+    assert "serve_itl_ms" in render().replace(".", "_")

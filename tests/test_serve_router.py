@@ -794,6 +794,51 @@ def test_generated_prefix_insert_hit_rate(tiny_lm):
 
 
 @pytest.mark.slow
+def test_insert_generated_default_router_soak(tiny_lm):
+    """Router-tier soak for the ISSUE 13 default flip (the ROADMAP
+    standing-debt condition on flipping kv_prefix_insert_generated ON):
+    three multi-turn rounds across 2 real replicas under the DEFAULT
+    config — follow-ups extend finished transcripts, affinity routes
+    them back to the replica holding the chain, and after a full drain
+    every replica's allocator balances to tree-only refcounts (the
+    retention the flag costs is exactly the tree's, nothing leaks)."""
+    from tpuflow.serve import InProcessReplica, Router, ServeScheduler
+    from tpuflow.serve.metrics import ServeMetrics
+
+    lm, params = tiny_lm
+    scheds = [
+        ServeScheduler(lm, params, slots=2, seg=4, max_new_cap=8,
+                       kv="paged", kv_page_size=4, kv_pages=64,
+                       metrics=ServeMetrics(
+                           gauge_prefix=f"serve.soak{i}"))
+        for i in range(2)
+    ]
+    assert all(s.kv_insert_generated for s in scheds)  # the default
+    router = Router([InProcessReplica(s, name=f"soak{i}")
+                     for i, s in enumerate(scheds)])
+    rng = np.random.default_rng(13)
+    transcripts = [rng.integers(1, 128, (4,)).astype(np.int32).tolist()
+                   for _ in range(6)]
+    for _round in range(3):
+        reqs = []
+        for t in transcripts:
+            reqs.append(router.submit(np.asarray(t, np.int32), 4))
+        router.run_until_idle()
+        for t, r in zip(transcripts, reqs):
+            assert r.result(5.0)["state"] == "done"
+            t.extend(int(x) for x in r.tokens)
+            t.append(int(rng.integers(1, 128)))  # the next user turn
+    saved = sum(s.metrics.prefill_tokens_saved for s in scheds)
+    assert saved > 0  # follow-ups genuinely hit past the first round
+    for s in scheds:
+        kvs = s.kv_state
+        assert kvs.allocator.in_use() == kvs.prefix.nodes
+        assert int(kvs.allocator.refs[1:].max(initial=0)) <= 1
+        kvs.prefix.clear()
+        assert kvs.allocator.in_use() == 0
+
+
+@pytest.mark.slow
 def test_router_http_tier_drain_endpoint(tiny_lm, tmp_path):
     """The whole tier over HTTP: generate via the router frontend,
     /readyz + /v1/metrics + Prometheus replica labels, then

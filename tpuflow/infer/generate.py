@@ -1137,6 +1137,128 @@ def paged_copy(kv_cache, src_pages, dst_pages, width: int = 8):
     return kv_cache
 
 
+# --------------------------------------------------------------------
+# Ring-attention prefill offload (ISSUE 13): prompts beyond one
+# device's prefill budget run their prompt pass SEQUENCE-PARALLEL over
+# the training tier's causal ring attention (parallel/ring_attention,
+# striped layout for ring balance) and land the resulting per-layer
+# K/V straight into KV pages, so single-device paged decode proceeds
+# normally afterward. The harvest rides a mutable 'ring_kv' collection
+# each CausalAttention layer sows its post-rotary K/V into (KV-head
+# granularity — exactly the tensors the page store holds); right-pad
+# tokens are harmless under the causal mask and their landed garbage
+# is overwritten by decode before any read can see it.
+
+
+@_lru("ring_prefill", maxsize=16)
+def _compiled_ring_prefill(sm, b: int, s: int, n: int, layout: str):
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tpuflow.core.compat import shard_map
+    from tpuflow.parallel.ring_attention import ring_prefill_layout
+
+    if s % n:
+        raise ValueError(
+            f"padded prompt length {s} must divide by the ring size "
+            f"{n} (pad to the pow2 bucket)")
+    mesh = Mesh(np.array(jax.devices()[:n]), ("ringpf",))
+    perm, inv = ring_prefill_layout(s, n, layout)
+    permj = None if perm is None else jnp.asarray(perm)
+    invj = None if inv is None else jnp.asarray(inv)
+
+    def shard_fwd(params, toks):
+        _, vars2 = sm.apply({"params": params}, toks,
+                            mutable=["ring_kv"])
+        return vars2["ring_kv"]
+
+    smapped = shard_map(
+        shard_fwd, mesh=mesh,
+        in_specs=(P(), P(None, "ringpf")),
+        out_specs=P(None, None, "ringpf", None),
+    )
+
+    @_rjit(key="infer.ring_prefill")
+    def run(params, tokens):
+        if permj is not None:
+            tokens = tokens[:, permj]
+        kv = smapped(params, tokens)
+
+        def unstripe(leaf):  # back to logical token order (seq axis 2)
+            return leaf if invj is None else leaf[:, :, invj, :]
+
+        return jax.tree.map(unstripe, kv)
+
+    return run
+
+
+def ring_prefill_kv(model, params, tokens, *, n_shards: int,
+                    layout: str = "striped"):
+    """Sequence-parallel prompt prefill: run ``tokens`` (B=1, S with
+    ``S % n_shards == 0``) through the model's ring-attention form
+    over ``n_shards`` devices and return the ``ring_kv`` collection —
+    per layer, post-rotary K/V ``(B, KVH, S, D)`` tuples in LOGICAL
+    token order, the exact values a single-device prefill writes into
+    the KV cache (up to ring-merge rounding). Per-device residency is
+    O(S / n_shards). ``layout='striped'`` (default) balances the
+    causal ring's wall time (~n/2 visits instead of ~n). Feed the
+    result to :meth:`tpuflow.serve.pages.PagedKV.land_ring`."""
+    sm = model.clone(decode=False, seq_axis="ringpf", sp_layout=layout,
+                     skip_head=True)
+    b, s = tokens.shape
+    run = _compiled_ring_prefill(sm, int(b), int(s), int(n_shards),
+                                 str(layout))
+    with trace.span("infer.ring_prefill", phase="prefill", rows=b,
+                    tokens=s, n_shards=n_shards, layout=layout):
+        return run(params, jnp.asarray(tokens, jnp.int32))
+
+
+@_rjit(key="infer.paged_land", donate_argnums=(0,))
+def _paged_land_jit(cache, harvest, pages):
+    # pages: (n_row_pages,) physical page of each landed row-page slot,
+    # 0 (the write sink) past the landed chain — duplicate sink writes
+    # scribble garbage nobody reads, which is what keeps the scatter
+    # ONE fixed-shape executable per pool instead of one per prompt
+    # length. Donated store: the landing is in place (ISSUE 11's
+    # contract — the caller reassigns from the return value).
+    def walk(cnode, hnode):
+        out = {}
+        for name, leaf in cnode.items():
+            if name in ("key_pages", "value_pages"):
+                src = hnode["k" if name == "key_pages" else "v"]
+                if isinstance(src, (tuple, list)):  # flax sow tuple
+                    src = src[0]
+                n = pages.shape[0]
+                kvh, ps, d = leaf.shape[1], leaf.shape[2], leaf.shape[3]
+                s = src.shape[2]
+                content = src[0]  # (KVH, S, D)
+                if n * ps > s:
+                    content = jnp.pad(
+                        content, ((0, 0), (0, n * ps - s), (0, 0)))
+                content = content[:, : n * ps].reshape(
+                    kvh, n, ps, d).transpose(1, 0, 2, 3)
+                out[name] = leaf.at[pages].set(
+                    content.astype(leaf.dtype))
+            elif isinstance(leaf, dict):
+                out[name] = walk(leaf, hnode[name])
+            else:  # int8 scale leaves never reach this path (gated)
+                out[name] = leaf
+        return out
+
+    return walk(dict(cache), dict(harvest))
+
+
+def paged_land(kv_cache, harvest, pages):
+    """Scatter a :func:`ring_prefill_kv` harvest into the page store:
+    row-page slot j of ``pages`` receives the harvest's positions
+    ``[j*ps, (j+1)*ps)``. See ``PagedKV.land_ring`` for the policy
+    half (which pages, how many, the sink-tail contract)."""
+    import numpy as np
+
+    return _paged_land_jit(kv_cache, harvest,
+                           jnp.asarray(np.asarray(pages, np.int32)))
+
+
 def serve_join_fn(model, slots: int, length: int, bucket: int):
     """Compiled per-slot prefill: admit requests into freed slots of a
     live pool at boundary ``t0``.

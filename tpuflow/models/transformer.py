@@ -517,6 +517,16 @@ class CausalAttention(nn.Module):
                                 self.rope_scaling, self.rope_scaling_kind)
 
             if self.seq_axis is not None:
+                # ring-prefill KV harvest (ISSUE 13): when the caller
+                # passes mutable=['ring_kv'], expose this layer's
+                # post-rotary K/V at KV-head granularity — the exact
+                # tensors the paged decode cache stores — so a
+                # sequence-parallel prompt pass can land its KV into
+                # pages (infer.generate.ring_prefill_kv). sow into an
+                # immutable collection is a no-op, so training paths
+                # pay nothing.
+                self.sow("ring_kv", "k", k)
+                self.sow("ring_kv", "v", v)
                 if self.attn_window is not None:
                     # closes the direct-TransformerLM bypass of the
                     # build_transformer_lm guard: a windowed ring would

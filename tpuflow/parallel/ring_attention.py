@@ -244,6 +244,27 @@ def inverse_permutation(perm):
     return np.argsort(np.asarray(perm))
 
 
+def ring_prefill_layout(seq_len: int, n: int, layout: str = "striped"):
+    """The (permute, unpermute) index pair a sequence-parallel PREFILL
+    pass applies around the ring (ISSUE 13 — the serve tier's
+    ring-prefill offload in :func:`tpuflow.infer.generate.
+    ring_prefill_kv`): tokens permute BEFORE contiguous sharding, the
+    harvested per-layer K/V unpermute back to logical token order
+    before landing into KV pages. ``'striped'`` (default) balances the
+    causal ring — a one-shot prompt pass is exactly the workload the
+    striped schedule halves (~n/2 visits of wall time vs ~n,
+    Brandon et al. 2023); ``'contiguous'`` returns identity (None,
+    None). ``seq_len`` must divide by ``n`` (the caller pads the
+    prompt to its pow2 bucket, which every pow2 ring size divides)."""
+    if layout not in ("contiguous", "striped"):
+        raise ValueError(
+            f"layout must be contiguous|striped, got {layout!r}")
+    if layout == "contiguous":
+        return None, None
+    perm = striped_permutation(seq_len, n)
+    return perm, inverse_permutation(perm)
+
+
 def ring_attention(
     q,
     k,

@@ -12,6 +12,14 @@ load-aware placement over replica ``load_snapshot()`` sensors, prefix
 affinity aligned with the paged KV cache's chunking, tier-level
 shedding/backpressure, failover of never-admitted requests, and
 graceful drain on SIGTERM or ``POST /v1/admin/drain``.
+
+Long-context serving (ISSUE 13): prefill is a schedulable, budget-
+bounded resource — ``prefill_budget_tokens`` chunks a long prompt's
+join across scheduler boundaries interleaved with decode segments
+(the ``--prefill-slo`` TTFT-vs-ITL knob; ``serve.itl_ms`` measures
+the ITL side), and ``ring_prefill=N`` runs prompts beyond one
+device's budget sequence-parallel over causal ring attention with
+the K/V landed straight into pages. Token-identical either way.
 """
 
 from tpuflow.serve.metrics import ServeMetrics, percentiles  # noqa: F401

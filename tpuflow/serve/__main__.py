@@ -81,12 +81,43 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="--kv paged: disable shared-prefix page reuse")
     p.add_argument("--kv-prefix-insert-generated", action="store_true",
-                   help="--kv paged: also publish finished requests' "
-                        "GENERATED pages into the prefix cache, so "
-                        "multi-turn follow-ups (prompt+completion+...) "
-                        "hit past the original prompt; completion "
-                        "pages then live in the tree until LRU "
-                        "pressure evicts them")
+                   help="deprecated no-op: generated-page insertion is "
+                        "the DEFAULT since the r11 A/B verdict "
+                        "(BENCH_LOCAL_r11 insert_generated.verdict = "
+                        "enable_by_default); see the --no- variant")
+    p.add_argument("--no-kv-prefix-insert-generated", action="store_true",
+                   help="--kv paged: do NOT publish finished requests' "
+                        "GENERATED pages into the prefix cache "
+                        "(default ON: multi-turn follow-ups "
+                        "prompt+completion+... hit past the original "
+                        "prompt; completion pages stay in the tree "
+                        "until LRU pressure evicts them)")
+    p.add_argument("--prefill-slo", type=int, default=None,
+                   metavar="TOKENS",
+                   help="--kv paged: chunked-prefill SLO knob (ISSUE "
+                        "13) — a join whose uncached prompt suffix "
+                        "exceeds TOKENS is prefilled in chunks of at "
+                        "most TOKENS KV positions, one per scheduler "
+                        "boundary, interleaved with decode segments: "
+                        "one long prompt stops stalling every "
+                        "in-flight row's ITL (serve.itl_ms measures "
+                        "it). Smaller = flatter concurrent ITL, "
+                        "longer long-prompt TTFT; outputs are "
+                        "token-identical either way")
+    p.add_argument("--ring-prefill", type=int, default=None, metavar="N",
+                   help="--kv paged: prefill long prompts "
+                        "sequence-parallel over N devices (causal "
+                        "ring attention, striped layout) with the "
+                        "K/V landed directly into pages — per-device "
+                        "prefill residency drops to O(p/N), so "
+                        "prompts beyond one device's budget become "
+                        "servable. N a power of two in [2, 8]; "
+                        "excludes --kv-quant and --speculate-k")
+    p.add_argument("--ring-prefill-min", type=int, default=512,
+                   metavar="TOKENS",
+                   help="--ring-prefill: prompts at or above this "
+                        "length take the ring path (shorter ones "
+                        "prefill single-device as usual)")
     p.add_argument("--speculate-k", type=int, default=0, metavar="K",
                    help="draft-model speculative decoding (ISSUE 9): "
                         "a small draft LM proposes K tokens per round "
@@ -129,6 +160,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "whose manifest notes carry the drain")
     args = p.parse_args(argv)
 
+    if args.prefill_slo is not None and args.kv != "paged":
+        p.error("--prefill-slo (chunked prefill) requires --kv paged")
+    if args.prefill_slo is not None and args.prefill_slo < 1:
+        p.error("--prefill-slo must be >= 1 (omit it for atomic joins)")
+    if args.ring_prefill is not None:
+        n = args.ring_prefill
+        if args.kv != "paged":
+            p.error("--ring-prefill requires --kv paged")
+        if args.kv_quant is not None:
+            p.error("--ring-prefill does not combine with --kv-quant "
+                    "(the harvest lands unquantized KV)")
+        if args.speculate_k:
+            p.error("--ring-prefill does not combine with "
+                    "--speculate-k (the draft store has no ring "
+                    "harvest)")
+        if n < 2 or n & (n - 1) or n > 8:
+            p.error(f"--ring-prefill must be a power of two in "
+                    f"[2, 8], got {n}")
+
     if args.trace_spans:
         from tpuflow.obs import trace as _trace
 
@@ -167,7 +217,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             kv=args.kv, kv_pages=args.kv_pages,
             kv_page_size=args.kv_page_size, kv_quant=args.kv_quant,
             kv_prefix_cache=not args.no_prefix_cache,
-            kv_prefix_insert_generated=args.kv_prefix_insert_generated,
+            kv_prefix_insert_generated=(
+                not args.no_kv_prefix_insert_generated),
+            prefill_budget_tokens=args.prefill_slo,
+            ring_prefill=args.ring_prefill,
+            ring_prefill_min_tokens=args.ring_prefill_min,
         )
         if args.speculate_k:
             # speculative decoding (ISSUE 9): load the draft package

@@ -25,7 +25,11 @@ Endpoints::
                       finish everything admitted, flip /readyz →
                       {"draining": true, "drained": bool, ...}
   GET  /v1/metrics    scheduler + gauge snapshot (JSON; windowed
-                      percentiles primary, cumulative under _cum)
+                      percentiles primary, cumulative under _cum —
+                      incl. serve.itl_ms, the per-row inter-token
+                      latency the chunked-prefill SLO knob trades
+                      against, and the prefill_chunks / ring_prefills
+                      counters; ISSUE 13)
   GET  /metrics       Prometheus/OpenMetrics text exposition of the
                       whole gauge registry (tpuflow.obs.prom)
   GET  /v1/events/ID  structured event log for one request id

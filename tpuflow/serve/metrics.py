@@ -105,6 +105,13 @@ class ServeMetrics:
             f"{gauge_prefix}.decode_ms", Histogram())
         self.e2e_ms = register_histogram(
             f"{gauge_prefix}.e2e_ms", Histogram())
+        # inter-token latency (ISSUE 13): per-row segment-boundary
+        # deltas normalized per emitted token — the metric the chunked-
+        # prefill SLO knob (prefill_budget_tokens) trades the long
+        # prompt's TTFT against. Registered like the others: Prometheus
+        # buckets, /v1/metrics windowed p95, load_snapshot().
+        self.itl_ms = register_histogram(
+            f"{gauge_prefix}.itl_ms", Histogram())
         self.tokens_out = 0
         self.segments = 0
         self.segment_live_rows = 0
@@ -122,6 +129,10 @@ class ServeMetrics:
         # too-small store shows before anything actually fails)
         self.page_extends = 0
         self.mid_decode_evictions = 0
+        # chunked prefill + ring offload (ISSUE 13)
+        self.prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
+        self.ring_prefills = 0
         # speculative decoding (ISSUE 9): cumulative draft/accept
         # counters plus a sliding window of recent rounds — the
         # windowed accept-rate gauge is what a dashboard watches for
@@ -271,6 +282,40 @@ class ServeMetrics:
         self.event("-pages-", "mid_decode_eviction", bucket=bucket,
                    resumable=resumable)
 
+    def on_itl(self, req: Request, delta_ms: float, n_new: int) -> None:
+        """One row's segment-boundary delta: ``delta_ms`` since this
+        request's previous token-producing boundary, over the
+        ``n_new`` tokens this boundary emitted — observed as per-token
+        ITL. Scheduler thread, once per (row, boundary): O(1)."""
+        self.itl_ms.observe(delta_ms / max(1, int(n_new)))
+
+    def on_prefill_chunk(self, bucket: int, tokens: int,
+                         completed: bool) -> None:
+        """One chunked-prefill dispatch (ISSUE 13): ``tokens`` KV
+        positions prefilled this boundary; ``completed`` = the row's
+        prompt finished and it decodes from the next segment."""
+        with self._lock:
+            self.prefill_chunks += 1
+            self.prefill_chunk_tokens += int(tokens)
+        inc_counter(f"{self.prefix}.prefill_chunks_total")
+        inc_counter(f"{self.prefix}.prefill_chunk_tokens_total",
+                    int(tokens))
+        if completed:
+            inc_counter(f"{self.prefix}.prefill_chunked_joins_total")
+
+    def on_ring_prefill(self, req: Request, tokens: int,
+                        n_shards: int) -> None:
+        """One ring-attention prefill offload (ISSUE 13): ``tokens``
+        prompt positions prefilled sequence-parallel over ``n_shards``
+        devices, KV landed into pages."""
+        with self._lock:
+            self.ring_prefills += 1
+        inc_counter(f"{self.prefix}.ring_prefills_total")
+        inc_counter(f"{self.prefix}.ring_prefill_tokens_total",
+                    int(tokens))
+        self.event(req.id, "ring_prefill", tokens=int(tokens),
+                   n_shards=int(n_shards))
+
     def on_spec_round(self, drafted: int, accepted: int) -> None:
         """One speculative round's outcome: ``drafted`` proposals
         (k per live speculative row), ``accepted`` of them matched the
@@ -328,7 +373,7 @@ class ServeMetrics:
         histogram (counts/events/gauges untouched) — the windowed-
         percentile hook for long-lived servers (see class docstring)."""
         for h in (self.ttft_ms, self.queue_wait_ms, self.decode_ms,
-                  self.e2e_ms):
+                  self.e2e_ms, self.itl_ms):
             h.reset()
 
     # ---- export -----------------------------------------------------
@@ -359,6 +404,11 @@ class ServeMetrics:
                 self.page_extends)
             m[f"{self.prefix}.kv_mid_decode_evictions"] = float(
                 self.mid_decode_evictions)
+            m[f"{self.prefix}.prefill_chunks"] = float(
+                self.prefill_chunks)
+            m[f"{self.prefix}.prefill_chunk_tokens"] = float(
+                self.prefill_chunk_tokens)
+            m[f"{self.prefix}.ring_prefills"] = float(self.ring_prefills)
             m[f"{self.prefix}.spec_rounds"] = float(self.spec_rounds)
             m[f"{self.prefix}.spec_drafted"] = float(self.spec_drafted)
             m[f"{self.prefix}.spec_accepted"] = float(self.spec_accepted)
@@ -381,7 +431,8 @@ class ServeMetrics:
         for name, hist in (("ttft_ms", self.ttft_ms),
                            ("queue_wait_ms", self.queue_wait_ms),
                            ("decode_ms", self.decode_ms),
-                           ("e2e_ms", self.e2e_ms)):
+                           ("e2e_ms", self.e2e_ms),
+                           ("itl_ms", self.itl_ms)):
             cum = hist.percentiles()
             win = windowed.get(f"{self.prefix}.{name}")
             prim = (win["percentiles"] if win else {}) or cum

@@ -509,7 +509,8 @@ class PagedKV:
         return pages_needed(prompt_len, max_new, self.spec.page_size)
 
     def plan(self, prompt: np.ndarray, max_new: int,
-             initial_new: Optional[int] = None) -> Optional[PagePlan]:
+             initial_new: Optional[int] = None,
+             use_prefix: bool = True) -> Optional[PagePlan]:
         """Match the prefix cache, fork the partial tail COW, allocate
         the fresh remainder — or return None when the allocator cannot
         cover it even after LRU-evicting unreferenced tree pages (the
@@ -522,7 +523,14 @@ class PagedKV:
         scheduler passes its segment advance and grows the plan at
         later boundaries via :meth:`extend`, so a request holds pages
         proportional to tokens GENERATED. ``None`` keeps the original
-        worst-case reserve (offline callers, warm-up)."""
+        worst-case reserve (offline callers, warm-up).
+
+        ``use_prefix=False`` skips the prefix-cache match (every page
+        fresh and row-exclusive) — for callers that want wholesale
+        private page chains (the ring landing path itself only ever
+        writes a plan's private pages, so the serve scheduler plans
+        ring admissions WITH the prefix and rings only the uncached
+        suffix; this flag stays for direct callers)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = int(prompt.size)
         ps = self.spec.page_size
@@ -532,7 +540,7 @@ class PagedKV:
         full_pages: List[int] = []
         m_full = 0
         partial = None
-        if self.prefix is not None and p > 1:
+        if use_prefix and self.prefix is not None and p > 1:
             full_pages, m_tok, partial = self.prefix.match(prompt[:p - 1])
             m_full = m_tok // ps
         need_total = self.pages_needed(p, max_new)
@@ -618,6 +626,42 @@ class PagedKV:
                 # page table must stay valid for both models' KV
                 self.draft_cache = paged_copy(self.draft_cache, src, dst)
                 _mem.tag("kv_draft", self.draft_cache)
+
+    def land_ring(self, plan: PagePlan, harvest, n_row_pages: int,
+                  prompt_len: int) -> None:
+        """Ring-prefill landing path (ISSUE 13): scatter a sequence-
+        parallel prefill's per-layer K/V (the ``ring_kv`` collection
+        from :func:`tpuflow.infer.generate.ring_prefill_kv`, logical
+        token order) into this plan's PRIVATE pages — positions
+        ``[matched_tokens//ps * ps, p-1)``; the plan's fully-matched
+        shared prefix pages are never written (their slots redirect to
+        the sink), a partially-matched tail page is the plan's own
+        fresh page and the landing rewrites it wholesale (so the COW
+        copy is unnecessary — the caller clears ``plan.forks``), and
+        position p-1 is left to the row's first decode step as
+        always. Page slots past the landed chain point at the write
+        sink, and the tail page's slots beyond p-1 hold pad-token
+        garbage every decode step overwrites before any read can see
+        it (causal mask + write-before-read). Fixed shapes per pool:
+        ONE compiled scatter regardless of prompt length."""
+        from tpuflow.infer.generate import paged_land
+        from tpuflow.obs import memory as _mem
+
+        if self.spec.quant is not None:
+            raise ValueError(
+                "ring prefill does not combine with int8 pages yet — "
+                "the harvest lands unquantized KV")
+        ps = self.spec.page_size
+        n_land = max(0, math.ceil((prompt_len - 1) / ps))
+        start_page = int(plan.matched_tokens) // ps
+        if n_land > len(plan.table):  # pragma: no cover - defensive
+            raise RuntimeError(
+                f"plan covers {len(plan.table)} pages < the "
+                f"{n_land} the harvest lands")
+        pages = np.zeros((int(n_row_pages),), np.int32)
+        pages[start_page:n_land] = plan.table[start_page:n_land]
+        self.cache = paged_land(self.cache, harvest, pages)
+        _mem.tag("kv_pages", self.cache)
 
     def insert_prompt(self, prompt: np.ndarray, plan: PagePlan) -> int:
         """After the join prefill: publish the request's full prompt

@@ -99,6 +99,9 @@ class Request:
     ts_arrival: float = 0.0
     ts_admitted: Optional[float] = None
     ts_first_token: Optional[float] = None
+    # last token-producing segment boundary — the previous edge of the
+    # per-row ITL delta (serve.itl_ms, ISSUE 13); scheduler-stamped
+    ts_last_tokens: Optional[float] = None
     ts_done: Optional[float] = None
 
     _done_event: threading.Event = field(default_factory=threading.Event,

@@ -91,10 +91,13 @@ class ServeScheduler:
         kv_quant: Optional[str] = None,
         kv_kernel: Optional[bool] = None,
         kv_prefix_cache: bool = True,
-        kv_prefix_insert_generated: bool = False,
+        kv_prefix_insert_generated: bool = True,
         speculate_k: int = 0,
         draft_model=None,
         draft_params=None,
+        prefill_budget_tokens: Optional[int] = None,
+        ring_prefill: Optional[int] = None,
+        ring_prefill_min_tokens: int = 512,
     ):
         """``kv='paged'`` switches the KV memory model (ISSUE 6): one
         process-wide store of ``kv_pages`` fixed-size pages
@@ -109,6 +112,33 @@ class ServeScheduler:
         bucket-pool rejected; cancel/expiry frees a request's pages
         the same boundary. ``kv_pages=None`` sizes the store for about
         4×``slots`` concurrent worst-case requests.
+
+        ``prefill_budget_tokens`` (ISSUE 13, the chunked-prefill SLO
+        knob, CLI ``--prefill-slo``): a join whose uncached prompt
+        suffix exceeds this many tokens is admitted as a CHUNKED
+        prefill — at most one chunk of at most this many KV positions
+        is dispatched per scheduler boundary, interleaved with the
+        other rows' decode segments, so one long prompt stops stalling
+        every in-flight row's inter-token latency (``serve.itl_ms``
+        now measures it). Smaller budget = flatter concurrent ITL,
+        longer TTFT for the long prompt; ``None`` keeps atomic joins.
+        Chunked outputs are token-identical to unchunked (same
+        executables, same KV, position by position); partially
+        prefilled prompts publish completed page chunks into the
+        prefix tree at every chunk boundary. Requires ``kv='paged'``.
+
+        ``ring_prefill`` (ISSUE 13, the offload half): prompts whose
+        UNCACHED suffix (after the prefix-cache match) is at least
+        ``ring_prefill_min_tokens`` tokens prefill SEQUENCE-PARALLEL
+        over this many devices (causal ring attention, striped
+        layout) with the harvested K/V landed directly into the
+        plan's private pages — per-device prefill residency drops to
+        O(p/n), so prompts beyond one device's budget become
+        servable, and decode afterwards is plain single-device paged
+        decode, token-identical to a single-device prefill of the
+        same prompt. Duplicates and multi-turn follow-ups hit the
+        prefix tree like any other request (a full hit never rings).
+        Requires ``kv='paged'``, no int8 pages, no speculation.
 
         ``speculate_k`` (ISSUE 9) turns on draft-model speculative
         decoding: a small ``draft_model``/``draft_params``
@@ -169,14 +199,59 @@ class ServeScheduler:
             # also publish a finished request's GENERATED pages into
             # the prefix tree, so a multi-turn follow-up whose prompt
             # is prompt+completion(+user turn) hits past the original
-            # prompt. Off by default: it retains completion pages in
-            # the tree until LRU pressure evicts them.
+            # prompt. ON by default since ISSUE 13: the r11 wider A/B
+            # recorded verdict enable_by_default
+            # (BENCH_LOCAL_r11_serve_paged.json insert_generated) —
+            # completion pages retained in the tree stay LRU-evictable
+            # under pressure; opt out per scheduler (CLI
+            # --no-kv-prefix-insert-generated).
             self.kv_insert_generated = bool(
                 kv_prefix_insert_generated) and self.kv_prefix_cache
         else:
             self.kv_spec = None
             self.kv_prefix_cache = False
             self.kv_insert_generated = False
+        self.prefill_budget_tokens = (
+            None if prefill_budget_tokens is None
+            else int(prefill_budget_tokens))
+        if self.prefill_budget_tokens is not None:
+            if kv != "paged":
+                raise ValueError(
+                    "prefill_budget_tokens (chunked prefill) requires "
+                    "kv='paged' — chunks ride the width-bucketed "
+                    "suffix-join menu")
+            if self.prefill_budget_tokens < 1:
+                raise ValueError(
+                    f"prefill_budget_tokens must be >= 1 (None = "
+                    f"atomic joins), got {prefill_budget_tokens}")
+        self.ring_prefill = None if not ring_prefill else int(ring_prefill)
+        self.ring_prefill_min_tokens = int(ring_prefill_min_tokens)
+        if self.ring_prefill is not None:
+            if kv != "paged":
+                raise ValueError(
+                    "ring_prefill requires kv='paged' — the harvest "
+                    "lands into KV pages")
+            if kv_quant is not None:
+                raise ValueError(
+                    "ring_prefill does not combine with int8 pages "
+                    "yet — the harvest lands unquantized KV")
+            if speculate_k:
+                raise ValueError(
+                    "ring_prefill does not combine with speculate_k — "
+                    "the draft store has no ring harvest, so drafted "
+                    "rows would attend to garbage prompt KV")
+            n = self.ring_prefill
+            if n < 2 or n & (n - 1) or n > 8:
+                raise ValueError(
+                    f"ring_prefill must be a power of two in [2, 8] "
+                    f"(it must divide every pow2 prompt bucket, min "
+                    f"8), got {ring_prefill}")
+            import jax as _jax
+
+            if n > len(_jax.devices()):
+                raise ValueError(
+                    f"ring_prefill={n} > {len(_jax.devices())} "
+                    f"available devices")
         self.speculate_k = int(speculate_k)
         self.draft_model = draft_model
         self.draft_params = draft_params
@@ -564,6 +639,12 @@ class ServeScheduler:
         req.state = RequestState.QUEUED
         req.slot = None
         req.bucket = bucket
+        # the eviction + queue wait + re-prefill interval is NOT
+        # inter-token latency (queue_wait_ms measures it): a stale
+        # stamp here would record one giant itl_ms sample at the
+        # retry's first boundary and poison the windowed p95 the
+        # router places against
+        req.ts_last_tokens = None
         with self._lock:
             self._queues.setdefault(bucket, deque()).appendleft(req)
             self._work.notify_all()
@@ -695,6 +776,8 @@ class ServeScheduler:
             pool = self._pool(b)
             progress |= self._sweep(pool, now)
             admits: List[tuple] = []
+            chunk_admits: List[tuple] = []  # chunked prefill (ISSUE 13)
+            ring_admits: List[tuple] = []  # ring prefill offload
             page_starved = False
             with self._lock:
                 q = self._queues.get(b, deque())
@@ -726,13 +809,35 @@ class ServeScheduler:
                         if plan is None:
                             page_starved = True
                             break
+                        # Ring offload (ISSUE 13) gates on the
+                        # UNCACHED suffix (= plan.width tokens), after
+                        # the prefix match: a duplicate (or multi-turn
+                        # follow-up) of a long prompt hits the tree
+                        # like any other request instead of re-running
+                        # the whole sequence-parallel pass — a full
+                        # hit (width 1) never rings; the landing only
+                        # ever writes the plan's private pages.
+                        ring = (self.ring_prefill is not None
+                                and plan.width > 1
+                                and plan.width
+                                >= self.ring_prefill_min_tokens)
                         # cap-provisioning baseline for the held-vs-
                         # budget accounting (what a per-slot slab at
                         # max_new_cap would have reserved)
                         plan.cap_budget_pages = self.kv_state.pages_needed(
                             q[0].effective_len(), self.max_new_cap)
                         req = q.popleft()
-                        admits.append((free.pop(0), req, plan))
+                        budget = self.prefill_budget_tokens
+                        if ring:
+                            ring_admits.append((free.pop(0), req, plan))
+                        elif (budget is not None
+                                and plan.width - 1 > budget):
+                            # the uncached suffix exceeds one
+                            # boundary's budget: chunked admission
+                            chunk_admits.append((free.pop(0), req,
+                                                 plan))
+                        else:
+                            admits.append((free.pop(0), req, plan))
                     else:
                         req = q.popleft()
                         admits.append((free.pop(0), req))
@@ -741,13 +846,20 @@ class ServeScheduler:
                 )
             if page_starved:
                 self.metrics.on_page_wait(b)
-            for adm in admits:
+            for adm in admits + chunk_admits + ring_admits:
                 if len(adm) == 3:
                     self.metrics.on_prefix(adm[1], adm[2])
             if admits:
                 pool.join(admits)
+            for slot, req, plan in ring_admits:
+                pool.join_ring(slot, req, plan, self.ring_prefill)
+                self.metrics.on_ring_prefill(req, req.effective_len(),
+                                             self.ring_prefill)
+            for slot, req, plan in chunk_admits:
+                pool.begin_chunked(slot, req, plan)
+            if admits or chunk_admits or ring_admits:
                 t_adm = self.clock()
-                for adm in admits:
+                for adm in admits + chunk_admits + ring_admits:
                     _slot, req = adm[0], adm[1]
                     req.state = RequestState.RUNNING
                     req.ts_admitted = t_adm
@@ -758,7 +870,19 @@ class ServeScheduler:
                     trace.end(getattr(req, "_span_queue", None),
                               slot=_slot)
                 progress = True
-            if pool.has_live() and self.kv_state is not None:
+            if (self.prefill_budget_tokens is not None
+                    and isinstance(pool, PagedSlotPool)
+                    and pool.prefilling.any()):
+                # chunked prefill (ISSUE 13): ONE budget-bounded chunk
+                # per boundary, round-robin over mid-prefill rows —
+                # the decode segment below runs in the same boundary,
+                # so chunks and segments strictly interleave
+                adv = pool.advance_prefill(self.prefill_budget_tokens)
+                if adv is not None:
+                    _slot_pf, n_pf, done_pf = adv
+                    self.metrics.on_prefill_chunk(b, n_pf, done_pf)
+                    progress = True
+            if pool.decode_live() and self.kv_state is not None:
                 # incremental allocation (ISSUE 11): cover every live
                 # row's next-segment writes BEFORE dispatch — a row the
                 # store cannot cover is evicted back to the queue with
@@ -780,13 +904,22 @@ class ServeScheduler:
                     pool.evict(slot)
                     self._requeue_mid_decode(req)
                     progress = True
-            if pool.has_live():
+            if pool.decode_live():
                 events, live = pool.run_segment()
                 _health.heartbeat(f"{self.metrics.prefix}.segment")
                 seg_ts = self.clock()
                 for slot, req, new, finished in events:
                     if new:
                         req.tokens.extend(new)
+                        # per-row ITL (ISSUE 13): delta since this
+                        # row's previous token-producing boundary,
+                        # normalized per emitted token
+                        if req.ts_last_tokens is not None:
+                            self.metrics.on_itl(
+                                req,
+                                (seg_ts - req.ts_last_tokens) * 1e3,
+                                len(new))
+                        req.ts_last_tokens = seg_ts
                     # `finished` with no tokens = the first sampled
                     # token WAS the EOS: still a completed decode step,
                     # so TTFT must be stamped (or the histogram would
@@ -951,7 +1084,8 @@ class ServeScheduler:
             out["kv_pages_total"] = self.kv_spec.pages - 1
         pfx = self.metrics.prefix
         hists = (("ttft_ms", self.metrics.ttft_ms),
-                 ("queue_wait_ms", self.metrics.queue_wait_ms))
+                 ("queue_wait_ms", self.metrics.queue_wait_ms),
+                 ("itl_ms", self.metrics.itl_ms))
         # cold sensor (no traffic yet): the percentile keys are None
         # without paying the windowed-delta walk — this path runs once
         # per replica per ROUTED REQUEST, so the empty case must be a
@@ -1092,10 +1226,17 @@ class ServeScheduler:
         for b, pool in pools:
             for slot, req in enumerate(pool.occupants):
                 if req is not None:
-                    out.append({"id": req.id, "state": req.state.value,
-                                "bucket": b, "slot": slot,
-                                "prompt_tokens": int(req.prompt_ids.size),
-                                "n_tokens": len(req.tokens)})
+                    rec = {"id": req.id, "state": req.state.value,
+                           "bucket": b, "slot": slot,
+                           "prompt_tokens": int(req.prompt_ids.size),
+                           "n_tokens": len(req.tokens)}
+                    if bool(getattr(pool, "prefilling",
+                                    np.zeros(0, bool))[slot:slot + 1].any()):
+                        # chunked prefill (ISSUE 13): a post-mortem
+                        # must tell a row mid-prompt from one decoding
+                        rec["prefilling"] = True
+                        rec["prefill_next"] = int(pool.prefill_next[slot])
+                    out.append(rec)
         return out
 
     def kv_snapshot(self) -> Optional[Dict[str, Any]]:
@@ -1203,6 +1344,9 @@ def serve_texts(
     speculate_k: int = 0,
     draft_model=None,
     draft_params=None,
+    prefill_budget_tokens: Optional[int] = None,
+    ring_prefill: Optional[int] = None,
+    ring_prefill_min_tokens: int = 512,
 ) -> List[str]:
     """Offline text frontend over the slot scheduler — what
     ``PackagedLM.generate_text(serve_slots=..., scheduler='slot')``
@@ -1226,6 +1370,9 @@ def serve_texts(
         seed=seed, kv=kv, kv_pages=kv_pages, kv_page_size=kv_page_size,
         kv_quant=kv_quant, kv_kernel=kv_kernel, speculate_k=speculate_k,
         draft_model=draft_model, draft_params=draft_params,
+        prefill_budget_tokens=prefill_budget_tokens,
+        ring_prefill=ring_prefill,
+        ring_prefill_min_tokens=ring_prefill_min_tokens,
     )
     reqs = [sched.submit(p, max_new_tokens) for p in prompts]
     sched.run_until_idle()

@@ -110,6 +110,11 @@ class SlotPool:
     def live_count(self) -> int:
         return sum(r is not None for r in self.occupants)
 
+    def decode_live(self) -> bool:
+        """Contiguous joins are atomic — every occupant decodes (the
+        chunked-prefill distinction exists only on the paged pool)."""
+        return self.has_live()
+
     def can_admit(self, max_new_tokens: int) -> bool:
         """Whether a request with this budget can join at the CURRENT
         boundary and still finish inside the horizon."""
@@ -414,6 +419,15 @@ class PagedSlotPool:
         self.done = np.ones((self.slots,), bool)
         self.occupants: List[Optional[Request]] = [None] * self.slots
         self.plans: List[Optional[Any]] = [None] * self.slots
+        # chunked prefill (ISSUE 13): rows admitted but still mid-
+        # prefill — they hold a slot, plan and page table like any
+        # occupant, but ride the segment fn's ``done`` mask (no decode,
+        # KV writes to the sink) until advance_prefill() finishes their
+        # prompt in budget-bounded chunks interleaved with segments
+        self.prefilling = np.zeros((self.slots,), bool)
+        self.prefill_next = np.zeros((self.slots,), np.int32)
+        self._prefill_full: List[Optional[np.ndarray]] = [None] * self.slots
+        self._prefill_cursor = 0  # round-robin over mid-prefill slots
         self.segments_run = 0
         self.last_join_width = 0  # observability: the window bench bills
         self._warmed = False
@@ -427,6 +441,14 @@ class PagedSlotPool:
 
     def live_count(self) -> int:
         return sum(r is not None for r in self.occupants)
+
+    def decode_live(self) -> bool:
+        """Any occupant actually DECODING — admitted and past its
+        chunked prefill. The scheduler runs a segment only when this
+        is true: a pool whose every occupant is still mid-prefill
+        makes progress through :meth:`advance_prefill`, not segments."""
+        return any(r is not None and not self.prefilling[i]
+                   for i, r in enumerate(self.occupants))
 
     def can_admit(self, max_new_tokens: int) -> bool:
         """Budget sanity only — PAGE availability is the scheduler's
@@ -553,6 +575,159 @@ class PagedSlotPool:
             need = max(need, -(-cover // ps))
         return next(w for w in self._seg_widths if w >= need)
 
+    # ---- chunked prefill (ISSUE 13) ---------------------------------
+    def begin_chunked(self, slot: int, req: Request, plan: Any) -> None:
+        """Admit one request as a CHUNKED-prefill occupant: all of
+        :meth:`join`'s bookkeeping (plan, page table, positions, COW
+        forks) but NO device dispatch — the prompt's uncached suffix is
+        prefilled by successive :meth:`advance_prefill` chunks, each a
+        bounded suffix-join through the existing width menu, so decode
+        segments for the other rows interleave between chunks instead
+        of waiting out one full-width join. The row rides the segment
+        fn's ``done`` mask until its prefill completes (KV writes to
+        the sink, emitted fill tokens discarded by the harvest)."""
+        if self.occupants[slot] is not None:
+            raise RuntimeError(f"slot {slot} is occupied")
+        full = req.effective_prompt()
+        p = int(full.size)
+        budget = req.remaining_new()
+        if not 1 <= p <= self.bucket:
+            raise ValueError(
+                f"prompt length {p} outside (0, bucket={self.bucket}]")
+        if plan.width < 2:
+            raise RuntimeError(
+                "chunked admission needs an uncached suffix (width >= "
+                "2); a full-prefix hit is already a width-1 join")
+        self.kv.execute_forks(plan)
+        row = self.page_table[slot]
+        row[:] = 0
+        row[: len(plan.table)] = plan.table
+        self.pos[slot] = p - 1
+        self.kv_limit[slot] = p + budget - 1
+        self.last_tok[slot] = p + budget - 1
+        self.stream_ids[slot] = req.stream_id
+        self.spec_on[slot] = bool(getattr(req, "speculate", True))
+        self.done[slot] = True  # not decoding yet
+        self.prefilling[slot] = True
+        self.prefill_next[slot] = int(plan.start)
+        self._prefill_full[slot] = full
+        self.occupants[slot] = req
+        self.plans[slot] = plan
+        req.slot = slot
+
+    def advance_prefill(self, budget: int) -> Optional[Tuple[int, int, bool]]:
+        """Run ONE budget-bounded prefill chunk for the next mid-
+        prefill slot (round-robin): a suffix-join dispatch covering at
+        most ``budget`` KV positions through the narrowest compiled
+        width that fits — the same executable (and the same KV values,
+        position by position) an atomic join would have used, so
+        chunked outputs are token-identical to unchunked ones.
+
+        Completed FULL pages publish into the prefix tree at every
+        chunk boundary, so an evicted or duplicate request hits the
+        partial prefix mid-flight. Returns ``(slot, positions_written,
+        completed)`` or None when no row is mid-prefill. On the final
+        chunk (frontier reaches p-1) the row flips live: the next
+        decode segment appends the last prompt token's KV and samples
+        its first token, exactly like an atomic join's row."""
+        import jax.numpy as jnp
+
+        pf = [i for i in range(self.slots) if self.prefilling[i]]
+        if not pf:
+            return None
+        slot = pf[self._prefill_cursor % len(pf)]
+        self._prefill_cursor += 1
+        req = self.occupants[slot]
+        plan = self.plans[slot]
+        full = self._prefill_full[slot]
+        p = int(full.size)
+        f = int(self.prefill_next[slot])
+        c = min(max(1, int(budget)), p - 1 - f)
+        w = next(wd for wd in self._widths if wd >= c + 1)
+        self.last_join_width = w
+        tokens = np.zeros((self.slots, w), np.int32)
+        tokens[slot, : c + 1] = full[f: f + c + 1]
+        starts = np.zeros((self.slots,), np.int32)
+        starts[slot] = f
+        widths = np.zeros((self.slots,), np.int32)
+        widths[slot] = c + 1
+        with trace.span("serve.prefill_chunk", phase="prefill",
+                        bucket=self.bucket, slot=slot, start=f,
+                        tokens=c, width=w, requests=req.id):
+            self.kv.cache, self.out = self._join[w](
+                self.params, self.kv.cache, self.out,
+                jnp.asarray(tokens), jnp.asarray(starts),
+                jnp.asarray(widths), jnp.asarray(self.page_table),
+            )
+            if self.spec_k:
+                # the draft prefills the same window (shared pages
+                # carry both models' KV — the publish contract)
+                self.kv.draft_cache, _ = self._join_draft[w](
+                    self.draft_params, self.kv.draft_cache, self.out,
+                    jnp.asarray(tokens), jnp.asarray(starts),
+                    jnp.asarray(widths), jnp.asarray(self.page_table),
+                )
+        _mem.tag("kv_pages", (self.kv.cache, self.out))
+        if self.spec_k:
+            _mem.tag("kv_draft", self.kv.draft_cache)
+        f2 = f + c
+        self.prefill_next[slot] = f2
+        # chunk-boundary publish: every page fully covered by the
+        # written frontier joins the tree NOW (insert is idempotent
+        # for chunks already present), bounded by the prompt's own
+        # n_full — a duplicate prompt queued behind this one hits the
+        # partial chain even if this row is later evicted
+        if self.kv.prefix is not None:
+            ps = self.kv.spec.page_size
+            n = min(f2 // ps, plan.n_full)
+            if n > 0:
+                self.kv.prefix.insert(full[: n * ps], plan.table[:n])
+        completed = f2 >= p - 1
+        if completed:
+            self.prefilling[slot] = False
+            self._prefill_full[slot] = None
+            self.done[slot] = False  # decodes from the next segment
+        return slot, c, completed
+
+    def join_ring(self, slot: int, req: Request, plan: Any,
+                  n_shards: int) -> None:
+        """Ring-attention prefill offload (ISSUE 13): prefill the
+        prompt SEQUENCE-PARALLEL over ``n_shards`` devices (causal
+        ring attention under shard_map — the training path's long-
+        context machinery, striped layout for ring balance), scatter
+        the harvested per-layer K/V into this plan's pages, and finish
+        admission with a width-1 join (token write only — exactly the
+        full-prefix-hit fast path). Per-device residency during
+        prefill is O(p / n_shards): prompts beyond one device's
+        prefill budget become servable, and paged decode afterwards is
+        plain single-device decode."""
+        from tpuflow.infer.generate import ring_prefill_kv
+
+        full = req.effective_prompt()
+        p = int(full.size)
+        if not 1 <= p <= self.bucket:
+            raise ValueError(
+                f"prompt length {p} outside (0, bucket={self.bucket}]")
+        padded = np.zeros((self.bucket,), np.int32)
+        padded[:p] = full
+        with trace.span("serve.ring_prefill", phase="prefill",
+                        bucket=self.bucket, n_shards=n_shards,
+                        tokens=p, requests=req.id):
+            harvest = ring_prefill_kv(self.kv.model, self.params,
+                                      padded[None, :], n_shards=n_shards)
+            # the landing wholesale-rewrites the plan's private pages
+            # from the matched frontier on — a partially-matched tail
+            # page's COW copy would only be clobbered, so drop the
+            # fork instead of executing it
+            plan.forks = []
+            self.kv.land_ring(plan, harvest, self.n_row_pages, p)
+        # the harvest covered [0, p-1); admission completes as a
+        # width-1 join (writes the final prompt token, whose KV the
+        # first decode step appends) — plan start/width say so
+        plan.start = p - 1
+        plan.width = 1
+        self.join([(slot, req, plan)])
+
     def extend_for_segment(self) -> Tuple[List[Tuple[int, Request]], int]:
         """Incremental page allocation (ISSUE 11): before a segment
         runs, grow every live row's plan to cover the positions this
@@ -650,6 +825,9 @@ class PagedSlotPool:
         self.kv_limit[slot] = 0
         self.last_tok[slot] = 0
         self.spec_on[slot] = True
+        self.prefilling[slot] = False
+        self.prefill_next[slot] = 0
+        self._prefill_full[slot] = None
         return req
 
     def warm(self) -> None:
@@ -743,6 +921,11 @@ class PagedSlotPool:
             toks = np.asarray(toks)
         _mem.tag("kv_pages", (self.kv.cache, self.out))
         self.pos = pos0 + self.seg
+        if self.prefilling.any():
+            # mid-prefill rows rode the segment as done rows (masked
+            # writes, discarded samples): their position is the
+            # prefill machinery's, not the segment's to advance
+            self.pos[self.prefilling] = pos0[self.prefilling]
         events = []
         for slot, req in enumerate(self.occupants):
             if req is None or was_done[slot]:
