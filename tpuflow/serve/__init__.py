@@ -20,6 +20,16 @@ join across scheduler boundaries interleaved with decode segments
 the ITL side), and ``ring_prefill=N`` runs prompts beyond one
 device's budget sequence-parallel over causal ring attention with
 the K/V landed straight into pages. Token-identical either way.
+
+Prefill/decode disaggregation (ISSUE 14): the tier splits into
+replica CLASSES — ``replica_class='prefill'`` replicas run prompt
+passes and export KV page chains over the wire (per-page CRC32,
+``serve/pages.py`` wire format), ``'decode'`` replicas import them
+and own the decode slots — with out-of-process replicas
+(``HTTPReplica`` over the ``/v1/worker/*`` endpoints, or
+``--connect host:port,...``) so decode throughput scales beyond one
+host's HBM. Every transfer failure falls back to a local prefill:
+token-identical either way.
 """
 
 from tpuflow.serve.metrics import ServeMetrics, percentiles  # noqa: F401
@@ -27,9 +37,18 @@ from tpuflow.serve.pages import (  # noqa: F401
     PagedKV,
     PagedKVSpec,
     PageAllocator,
+    PageWireError,
     PrefixCache,
+    split_chain,
+    wire_from_json,
+    wire_to_json,
 )
-from tpuflow.serve.replica import InProcessReplica, Replica  # noqa: F401
+from tpuflow.serve.replica import (  # noqa: F401
+    HTTPReplica,
+    InProcessReplica,
+    Replica,
+    launch_worker,
+)
 from tpuflow.serve.request import (  # noqa: F401
     QueueFull,
     Request,

@@ -32,8 +32,43 @@ from typing import List, Optional
 
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="tpuflow.serve", description=__doc__)
-    p.add_argument("--model", required=True,
-                   help="packaged LM directory or runs:/ / models:/ URI")
+    p.add_argument("--model", default=None,
+                   help="packaged LM directory or runs:/ / models:/ "
+                        "URI (required unless --connect fronts remote "
+                        "workers that loaded their own)")
+    p.add_argument("--connect", default=None, metavar="ADDR[,ADDR...]",
+                   help="front EXISTING out-of-process workers "
+                        "(host:port of other `python -m tpuflow.serve` "
+                        "instances) through the router instead of "
+                        "loading a model locally — the prefill/decode "
+                        "disaggregation deployment shape (ISSUE 14): "
+                        "each worker declares its --replica-class and "
+                        "the router does two-phase placement, shipping "
+                        "KV page chains from prefill- to decode-class "
+                        "replicas over the wire")
+    p.add_argument("--replica-class", default="mixed",
+                   metavar="CLASS[,CLASS...]",
+                   help="mixed | prefill | decode — this server's "
+                        "class (worker mode), or a comma list "
+                        "assigning one class per in-process replica "
+                        "(--replicas N): e.g. --replicas 3 "
+                        "--replica-class prefill,decode,decode builds "
+                        "a disaggregated tier in one process. "
+                        "Non-mixed classes require --kv paged")
+    p.add_argument("--transfer-min-tokens", type=int, default=None,
+                   metavar="TOKENS",
+                   help="disaggregated tiers: route a request through "
+                        "a prefill-class replica only when its "
+                        "estimated UNCACHED suffix is at least this "
+                        "long (default 2 pages) — shorter suffixes "
+                        "prefill locally on the decode replica, "
+                        "cheaper than shipping pages")
+    p.add_argument("--transfer-chunk-pages", type=int, default=8,
+                   metavar="PAGES",
+                   help="split exported page chains into chunks of at "
+                        "most this many pages: chunks land one "
+                        "scheduler boundary at a time, interleaved "
+                        "with decode segments (transfer overlap)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="0 binds an ephemeral port (printed on start)")
@@ -160,6 +195,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "whose manifest notes carry the drain")
     args = p.parse_args(argv)
 
+    if not args.model and not args.connect:
+        p.error("--model is required (or --connect to front remote "
+                "workers)")
+    classes = [c.strip() for c in str(args.replica_class).split(",")]
+    for c in classes:
+        if c not in ("mixed", "prefill", "decode"):
+            p.error(f"--replica-class must be mixed|prefill|decode, "
+                    f"got {c!r}")
+    if args.connect is None:
+        n_for_classes = max(1, int(args.replicas))
+        if len(classes) == 1:
+            classes = classes * n_for_classes
+        if len(classes) != n_for_classes:
+            p.error(f"--replica-class lists {len(classes)} classes "
+                    f"for --replicas {n_for_classes}")
+        if any(c != "mixed" for c in classes) and args.kv != "paged":
+            p.error("--replica-class prefill/decode requires --kv "
+                    "paged (KV pages are the wire format)")
+        if any(c != "mixed" for c in classes) and args.speculate_k:
+            p.error("--replica-class prefill/decode does not combine "
+                    "with --speculate-k")
     if args.prefill_slo is not None and args.kv != "paged":
         p.error("--prefill-slo (chunked prefill) requires --kv paged")
     if args.prefill_slo is not None and args.prefill_slo < 1:
@@ -223,7 +279,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             ring_prefill=args.ring_prefill,
             ring_prefill_min_tokens=args.ring_prefill_min,
         )
-        if args.speculate_k:
+        if args.speculate_k and not args.connect:
             # speculative decoding (ISSUE 9): load the draft package
             # ONCE — with --replicas N every replica's scheduler
             # shares the same draft device weights, and the router's
@@ -240,15 +296,38 @@ def main(argv: Optional[List[str]] = None) -> int:
                       draft_model=draft.model,
                       draft_params=draft.params)
         n_rep = max(1, int(args.replicas))
-        if n_rep == 1:
+        router_kw = dict(
+            affinity=not args.no_affinity,
+            transfer_chunk_pages=args.transfer_chunk_pages,
+        )
+        if args.transfer_min_tokens is not None:
+            router_kw["transfer_min_tokens"] = args.transfer_min_tokens
+        if args.connect:
+            # front EXISTING out-of-process workers (ISSUE 14): no
+            # local model load at all — each worker owns its weights,
+            # device state, watchdog and blast radius; the router is
+            # pure host policy over their /v1/worker/* surfaces
+            from tpuflow.serve.replica import HTTPReplica
+            from tpuflow.serve.router import Router
+
+            addrs = [a.strip() for a in args.connect.split(",")
+                     if a.strip()]
+            front = Router([HTTPReplica(a) for a in addrs],
+                           **router_kw)
+            schedulers = []
+        elif n_rep == 1:
+            kw["replica_class"] = classes[0]
             front = sched = ServeScheduler.from_packaged(args.model, **kw)
             schedulers = [sched]
         else:
             # load the package ONCE: every replica shares the weights
             # (device buffers) and tokenizer; each gets its own
-            # scheduler thread, slot pools, KV store and a
+            # scheduler thread, slot pools, KV store, a
             # serve.replica<i> metrics namespace (→ replica="<i>"
-            # labels in the Prometheus exposition)
+            # labels in the Prometheus exposition) AND its own
+            # watchdog (ISSUE 14: a trip fails over one replica, not
+            # the whole in-process tier)
+            from tpuflow.obs.health import Watchdog
             from tpuflow.serve.replica import InProcessReplica
             from tpuflow.serve.router import Router
 
@@ -259,12 +338,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     lm,
                     metrics=ServeMetrics(
                         gauge_prefix=f"serve.replica{i}"),
+                    replica_class=classes[i],
+                    watchdog=Watchdog(),
                     **kw,
                 ))
             front = Router(
                 [InProcessReplica(s, name=f"replica{i}")
                  for i, s in enumerate(schedulers)],
-                affinity=not args.no_affinity,
+                **router_kw,
             )
         if args.stall_timeout:
             from tpuflow.obs.health import StallDetector
@@ -286,10 +367,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             detector.start()
         server = start_http_server(front, args.host, args.port,
                                    request_timeout_s=args.request_timeout)
-        print(f"serving {args.model} on http://{args.host}:{server.port} "
+        what = args.model or f"workers[{args.connect}]"
+        print(f"serving {what} on http://{args.host}:{server.port} "
               f"(replicas={n_rep} slots={args.slots} seg={args.seg} "
               f"max_new={args.max_new} queue<={args.max_queue} "
-              f"kv={args.kv})", flush=True)
+              f"kv={args.kv} class={','.join(classes)})", flush=True)
         try:
             while not term_flag["hit"]:
                 time.sleep(0.2)

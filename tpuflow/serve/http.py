@@ -42,6 +42,29 @@ Endpoints::
                       unwedged and watchdog-clean; 503 + the reason
                       otherwise (wire THIS one to the load balancer —
                       a wedged scheduler keeps passing /healthz)
+
+Worker endpoints (ISSUE 14 — what an :class:`~tpuflow.serve.replica.
+HTTPReplica` speaks, making any serve instance an OUT-OF-PROCESS
+replica of a router tier; single-scheduler servers only)::
+
+  GET  /v1/worker/config         replica shape facts (slots, caps,
+                                 page_size, replica_class, tokenizer)
+  GET  /v1/worker/load_snapshot  the placement sensor, verbatim
+  GET  /v1/worker/health         the failover input (scheduler.health)
+  GET  /v1/worker/retry_after    {"retry_after_s": ...}
+  POST /v1/worker/encode|decode  tokenizer proxy (router-side string
+                                 prompts without local weights)
+  POST /v1/worker/submit         raw-token submit with stream_id /
+                                 speculate / await_transfer → chunked
+                                 NDJSON ({"tokens": [...]} per
+                                 boundary, then a {"done": true}
+                                 summary line)
+  POST /v1/worker/prefill        prefill-only request → the exported
+                                 KV page chain (serve/pages.py wire
+                                 format, base64 payloads)
+  POST /v1/worker/offer_chain    land a wire chunk into this
+                                 replica's page store / prefix tree
+  POST /v1/worker/stop           stop the scheduler (drain optional)
 """
 
 from __future__ import annotations
@@ -140,6 +163,34 @@ class _Handler(BaseHTTPRequestHandler):
             snap.update(scalar_gauges("router"))
             snap.update(counters("router"))
             self._json(200, snap)
+        elif self.path.startswith("/v1/worker/"):
+            if not hasattr(sched, "submit_prefill"):
+                return self._json(404, {
+                    "error": "worker endpoints front a single "
+                             "scheduler, not a router tier"})
+            if self.path == "/v1/worker/config":
+                spec = getattr(sched, "kv_spec", None)
+                self._json(200, {
+                    "name": sched.metrics.prefix,
+                    "replica_class": getattr(sched, "replica_class",
+                                             "mixed"),
+                    "slots": sched.slots,
+                    "max_new_cap": sched.max_new_cap,
+                    "max_queue": sched.max_queue,
+                    "page_size": (None if spec is None
+                                  else spec.page_size),
+                    "speculate_k": getattr(sched, "speculate_k", 0),
+                    "has_tokenizer": sched.tokenizer is not None,
+                })
+            elif self.path == "/v1/worker/load_snapshot":
+                self._json(200, sched.load_snapshot())
+            elif self.path == "/v1/worker/health":
+                self._json(200, sched.health())
+            elif self.path == "/v1/worker/retry_after":
+                self._json(200,
+                           {"retry_after_s": sched.retry_after_s()})
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
         elif self.path.startswith("/v1/events/"):
             rid = self.path[len("/v1/events/"):]
             self._json(200, {"id": rid,
@@ -167,6 +218,8 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError("cancel needs an 'id'")
                 return self._json(200, {"id": rid,
                                         "cancelled": sched.cancel(rid)})
+            if self.path.startswith("/v1/worker/"):
+                return self._worker_post(sched, body)
             if self.path == "/v1/admin/drain":
                 # graceful drain over HTTP (the SIGTERM channel's
                 # twin): stop admitting, finish the admitted backlog,
@@ -208,7 +261,154 @@ class _Handler(BaseHTTPRequestHandler):
                 # send_response would corrupt the connection — drop it
                 self.close_connection = True
             else:
-                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+                import traceback
+
+                self._json(500, {
+                    "error": f"{type(e).__name__}: {e}",
+                    # last frames only: enough to locate the fault
+                    # from a worker's 500 body without shipping logs
+                    "trace": traceback.format_exc().splitlines()[-6:],
+                })
+
+    def _worker_post(self, sched, body: Dict[str, Any]) -> None:
+        """POST half of the worker surface (ISSUE 14) — see module
+        docstring. Exceptions propagate to do_POST's taxonomy mapping
+        (QueueFull→429, SchedulerClosed→503, ValueError→400), which
+        the HTTPReplica un-maps back into the same exceptions."""
+        if not hasattr(sched, "submit_prefill"):
+            return self._json(404, {
+                "error": "worker endpoints front a single scheduler, "
+                         "not a router tier"})
+        if self.path == "/v1/worker/encode":
+            if sched.tokenizer is None:
+                raise ValueError("worker has no tokenizer")
+            ids = sched.tokenizer.encode(str(body.get("text", "")))
+            import numpy as np
+
+            return self._json(200, {
+                "ids": np.asarray(ids, np.int32).reshape(-1).tolist()})
+        if self.path == "/v1/worker/decode":
+            if sched.tokenizer is None:
+                raise ValueError("worker has no tokenizer")
+            import numpy as np
+
+            raw = sched.tokenizer.decode(
+                np.asarray(body.get("ids", []), np.int32))
+            return self._json(200, {
+                "text": raw.decode("utf-8", "replace")})
+        if self.path == "/v1/worker/submit":
+            return self._worker_submit(sched, body)
+        if self.path == "/v1/worker/prefill":
+            from tpuflow.serve.pages import wire_to_json
+
+            prompt = body.get("prompt")
+            if prompt is None:
+                raise ValueError("prefill needs a 'prompt'")
+            kw: Dict[str, Any] = {}
+            if body.get("deadline_s") is not None:
+                kw["deadline_s"] = float(body["deadline_s"])
+            if body.get("id"):
+                kw["request_id"] = str(body["id"])
+            req = sched.submit_prefill(prompt, **kw)
+            timeout = float(body.get("timeout_s")
+                            or self.server.request_timeout_s)
+            try:
+                summary = req.result(timeout=timeout)
+            except TimeoutError:
+                sched.cancel(req)
+                req.wait(timeout=5.0)
+                summary = req.summary()
+                summary["error"] = summary["error"] or "server timeout"
+            summary["wire"] = (None if req.export is None
+                               else wire_to_json(req.export))
+            code = 200 if req.export is not None else 504
+            return self._json(code, summary)
+        if self.path == "/v1/worker/offer_chain":
+            from tpuflow.serve.pages import wire_from_json
+
+            wire = body.get("wire")
+            if not isinstance(wire, dict):
+                raise ValueError("offer_chain needs a 'wire' object")
+            tid = sched.offer_chain(
+                wire_from_json(wire),
+                transfer_id=body.get("transfer_id"),
+                last=bool(body.get("last", True)))
+            return self._json(200, {"transfer_id": tid, "ok": True})
+        if self.path == "/v1/worker/fail_transfer":
+            tid = body.get("transfer_id")
+            if not tid:
+                raise ValueError("fail_transfer needs a 'transfer_id'")
+            sched.fail_transfer(str(tid),
+                                str(body.get("reason", "failed")))
+            return self._json(200, {"transfer_id": str(tid),
+                                    "ok": True})
+        if self.path == "/v1/worker/stop":
+            sched.stop(drain=bool(body.get("drain", True)),
+                       timeout=float(body.get("timeout", 30.0)))
+            return self._json(200, {"stopped": True})
+        return self._json(404, {"error": f"no route {self.path}"})
+
+    def _worker_submit(self, sched, body: Dict[str, Any]) -> None:
+        """Raw-token streaming submit — the HTTPReplica transport:
+        every scheduler kwarg the router pins (stream_id, speculate,
+        await_transfer) crosses the wire, tokens stream as NDJSON at
+        segment boundaries, and the final line carries the terminal
+        summary (authoritative token list included, so a reader that
+        missed a line still converges)."""
+        prompt = body.get("prompt")
+        if prompt is None:
+            raise ValueError("submit needs a 'prompt'")
+        kwargs: Dict[str, Any] = {}
+        if body.get("max_new_tokens") is not None:
+            kwargs["max_new_tokens"] = int(body["max_new_tokens"])
+        if body.get("deadline_s") is not None:
+            kwargs["deadline_s"] = float(body["deadline_s"])
+        if body.get("id"):
+            kwargs["request_id"] = str(body["id"])
+        if body.get("stream_id") is not None:
+            kwargs["stream_id"] = int(body["stream_id"])
+        if body.get("speculate") is not None:
+            kwargs["speculate"] = bool(body["speculate"])
+        if body.get("await_transfer") is not None:
+            kwargs["await_transfer"] = str(body["await_transfer"])
+        timeout = float(body.get("timeout_s")
+                        or self.server.request_timeout_s)
+        events: "queue.Queue" = queue.Queue()
+        req = sched.submit(
+            prompt,
+            stream_cb=lambda r, new, fin: events.put((list(new), fin)),
+            **kwargs,
+        )
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        self._response_started = True
+        try:
+            self._chunk(json.dumps({"id": req.id}).encode() + b"\n")
+            finished = False
+            while not finished:
+                try:
+                    new, finished = events.get(timeout=timeout)
+                except queue.Empty:
+                    sched.cancel(req)
+                    break
+                if new:
+                    self._chunk(
+                        json.dumps({"tokens": new}).encode() + b"\n")
+            req.wait(timeout=5.0)
+            final = {
+                "done": True,
+                "state": req.state.value,
+                "tokens": list(req.tokens),
+                "error": req.error,
+                "ts_admitted": req.ts_admitted,
+            }
+            self._chunk(json.dumps(final).encode() + b"\n")
+            self._end_chunks()
+        except OSError:
+            sched.cancel(req)
+            self.close_connection = True
 
     def _generate(self, sched, body: Dict[str, Any]) -> None:
         prompt = body.get("prompt")

@@ -112,6 +112,12 @@ class ServeMetrics:
         # buckets, /v1/metrics windowed p95, load_snapshot().
         self.itl_ms = register_histogram(
             f"{gauge_prefix}.itl_ms", Histogram())
+        # KV-page wire transfers (ISSUE 14, prefill/decode
+        # disaggregation): per-transfer wall (export serialize or
+        # import verify+land), registered like the others — Prometheus
+        # buckets, /v1/metrics windowed percentiles, load_snapshot()
+        self.kv_transfer_ms = register_histogram(
+            f"{gauge_prefix}.kv_transfer_ms", Histogram())
         self.tokens_out = 0
         self.segments = 0
         self.segment_live_rows = 0
@@ -133,6 +139,16 @@ class ServeMetrics:
         self.prefill_chunks = 0
         self.prefill_chunk_tokens = 0
         self.ring_prefills = 0
+        # KV-page wire transfers (ISSUE 14): pages/bytes shipped in
+        # either direction, chain exports/imports, and verify failures
+        # (CRC / header / gap / dry — each one a clean local-prefill
+        # fallback, so a nonzero steady rate means a corrupting
+        # transport, not corrupted outputs)
+        self.kv_transfer_pages = 0
+        self.kv_transfer_bytes = 0
+        self.kv_exports = 0
+        self.kv_imports = 0
+        self.kv_transfer_failures = 0
         # speculative decoding (ISSUE 9): cumulative draft/accept
         # counters plus a sliding window of recent rounds — the
         # windowed accept-rate gauge is what a dashboard watches for
@@ -316,6 +332,58 @@ class ServeMetrics:
         self.event(req.id, "ring_prefill", tokens=int(tokens),
                    n_shards=int(n_shards))
 
+    # ---- KV-page wire transfers (ISSUE 14) --------------------------
+    def _on_kv_transfer(self, pages: int, nbytes: int,
+                        ms: float) -> None:
+        with self._lock:
+            self.kv_transfer_pages += int(pages)
+            self.kv_transfer_bytes += int(nbytes)
+        inc_counter(f"{self.prefix}.kv_transfer_pages_total",
+                    int(pages))
+        inc_counter(f"{self.prefix}.kv_transfer_bytes_total",
+                    int(nbytes))
+        self.kv_transfer_ms.observe(float(ms))
+
+    def on_kv_export(self, req: Request, pages: int, nbytes: int,
+                     ms: float) -> None:
+        """One prefill-only request's page chain serialized to the
+        wire format (``ms`` = gather + serialize + CRC wall)."""
+        with self._lock:
+            self.kv_exports += 1
+        inc_counter(f"{self.prefix}.kv_exports_total")
+        self._on_kv_transfer(pages, nbytes, ms)
+        self.event(req.id, "kv_export", pages=int(pages),
+                   bytes=int(nbytes))
+
+    def on_kv_import(self, transfer_id: str, pages: int, nbytes: int,
+                     ms: float) -> None:
+        """One inbound chunk verified and landed (``pages`` excludes
+        chunks the prefix tree already held — transfer dedup)."""
+        with self._lock:
+            self.kv_imports += 1
+        inc_counter(f"{self.prefix}.kv_imports_total")
+        self._on_kv_transfer(pages, nbytes, ms)
+        self.event(f"-transfer-{transfer_id}-", "kv_import",
+                   pages=int(pages), bytes=int(nbytes))
+
+    def on_kv_transfer_failure(self, transfer_id: str, error: str,
+                               kind: str = "verify") -> None:
+        """A transfer failed and its waiting request falls back to a
+        LOCAL prefill — correctness is never at stake. Two counters so
+        an operator can tell a CORRUPTING TRANSPORT from routine
+        fallbacks: ``kind='verify'`` (CRC/header/gap/dry — the wire
+        payload itself failed import) additionally counts on
+        ``kv_transfer_crc_failures_total``; ``'timeout'``/``'abort'``
+        (chain never arrived, prefill side broke) count only on the
+        generic ``kv_transfer_failures_total``."""
+        with self._lock:
+            self.kv_transfer_failures += 1
+        inc_counter(f"{self.prefix}.kv_transfer_failures_total")
+        if kind == "verify":
+            inc_counter(f"{self.prefix}.kv_transfer_crc_failures_total")
+        self.event(f"-transfer-{transfer_id}-", "kv_transfer_failure",
+                   error=error, kind=kind)
+
     def on_spec_round(self, drafted: int, accepted: int) -> None:
         """One speculative round's outcome: ``drafted`` proposals
         (k per live speculative row), ``accepted`` of them matched the
@@ -373,7 +441,7 @@ class ServeMetrics:
         histogram (counts/events/gauges untouched) — the windowed-
         percentile hook for long-lived servers (see class docstring)."""
         for h in (self.ttft_ms, self.queue_wait_ms, self.decode_ms,
-                  self.e2e_ms, self.itl_ms):
+                  self.e2e_ms, self.itl_ms, self.kv_transfer_ms):
             h.reset()
 
     # ---- export -----------------------------------------------------
@@ -409,6 +477,14 @@ class ServeMetrics:
             m[f"{self.prefix}.prefill_chunk_tokens"] = float(
                 self.prefill_chunk_tokens)
             m[f"{self.prefix}.ring_prefills"] = float(self.ring_prefills)
+            m[f"{self.prefix}.kv_transfer_pages"] = float(
+                self.kv_transfer_pages)
+            m[f"{self.prefix}.kv_transfer_bytes"] = float(
+                self.kv_transfer_bytes)
+            m[f"{self.prefix}.kv_exports"] = float(self.kv_exports)
+            m[f"{self.prefix}.kv_imports"] = float(self.kv_imports)
+            m[f"{self.prefix}.kv_transfer_failures"] = float(
+                self.kv_transfer_failures)
             m[f"{self.prefix}.spec_rounds"] = float(self.spec_rounds)
             m[f"{self.prefix}.spec_drafted"] = float(self.spec_drafted)
             m[f"{self.prefix}.spec_accepted"] = float(self.spec_accepted)
@@ -432,7 +508,8 @@ class ServeMetrics:
                            ("queue_wait_ms", self.queue_wait_ms),
                            ("decode_ms", self.decode_ms),
                            ("e2e_ms", self.e2e_ms),
-                           ("itl_ms", self.itl_ms)):
+                           ("itl_ms", self.itl_ms),
+                           ("kv_transfer_ms", self.kv_transfer_ms)):
             cum = hist.percentiles()
             win = windowed.get(f"{self.prefix}.{name}")
             prim = (win["percentiles"] if win else {}) or cum

@@ -95,6 +95,74 @@ def chunk_keys(tokens, page_size: int) -> List[bytes]:
     return out
 
 
+#: wire-format version tag (ISSUE 14): importers reject anything else.
+PAGE_WIRE_VERSION = 1
+
+
+class PageWireError(RuntimeError):
+    """A page-chain wire payload failed validation — CRC mismatch,
+    header/shape mismatch, a chain gap, or an allocator too dry to
+    land it. The importer retains NOTHING from the failing chunk; the
+    serving-tier contract is a clean fallback to LOCAL prefill (the
+    request decodes correctly either way — the transfer is purely a
+    work-placement optimization), never a truncated stream."""
+
+
+def split_chain(wire: Dict[str, Any],
+                chunk_pages: int) -> List[Dict[str, Any]]:
+    """Split one :meth:`PagedKV.export_chain` wire into transferable
+    chunks of at most ``chunk_pages`` pages each. Every chunk carries
+    the token PREFIX through its own end (the radix path the importer
+    needs) plus only its own page payloads (``first_page`` says where
+    they sit in the chain), so chunks stream independently and land
+    one scheduler boundary at a time — the transfer-overlap half of
+    the disaggregation story."""
+    n = int(wire["n_pages"])
+    cp = max(1, int(chunk_pages))
+    if n <= cp:
+        return [wire] if n else []
+    ps = int(wire["page_size"])
+    out = []
+    for s in range(0, n, cp):
+        e = min(n, s + cp)
+        ch = {k: wire[k] for k in ("version", "page_size", "quant",
+                                   "leaves")}
+        ch.update(
+            n_pages=e - s, first_page=s,
+            tokens=wire["tokens"][: e * ps],
+            chunk_keys=wire["chunk_keys"][:e],
+            payloads=wire["payloads"][s:e],
+            crc32=wire["crc32"][s:e],
+        )
+        out.append(ch)
+    return out
+
+
+def wire_bytes(wire: Dict[str, Any]) -> int:
+    """Payload bytes one wire (or chunk) ships — the unit of the
+    ``serve.kv_transfer_bytes_total`` accounting."""
+    return sum(len(p) for p in wire.get("payloads", ()))
+
+
+def wire_to_json(wire: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-able form of a wire/chunk (payload bytes → base64) — what
+    the HTTP replica transport ships."""
+    import base64
+
+    out = dict(wire)
+    out["payloads"] = [base64.b64encode(p).decode("ascii")
+                       for p in wire["payloads"]]
+    return out
+
+
+def wire_from_json(obj: Dict[str, Any]) -> Dict[str, Any]:
+    import base64
+
+    out = dict(obj)
+    out["payloads"] = [base64.b64decode(p) for p in obj["payloads"]]
+    return out
+
+
 @dataclass(frozen=True)
 class PagedKVSpec:
     """Shape of one paged KV store: ``pages`` physical pages of
@@ -499,6 +567,11 @@ class PagedKV:
         # released plans — the number that says what incrementality
         # saves vs the old worst-case reserve (bench acceptance < 0.6)
         self.extends = 0
+        # wire-transport counts (ISSUE 14): chains serialized out of /
+        # landed into this store (per-call; pages/bytes ride the serve
+        # metrics plane)
+        self.exports = 0
+        self.imports = 0
         self._held_ratio_sum = 0.0
         self._held_ratio_n = 0
         self._held_cap_sum = 0.0
@@ -663,6 +736,180 @@ class PagedKV:
         self.cache = paged_land(self.cache, harvest, pages)
         _mem.tag("kv_pages", self.cache)
 
+    # ---- wire format (ISSUE 14, prefill/decode disaggregation) ------
+    def wire_header(self) -> Dict[str, Any]:
+        """Self-describing store header: what an importer checks a
+        wire against before touching its allocator — two stores
+        inter-operate iff their page geometry, quantization and leaf
+        shapes/dtypes agree (same model family, same spec)."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(self.cache)
+        return {
+            "version": PAGE_WIRE_VERSION,
+            "page_size": int(self.spec.page_size),
+            "quant": self.spec.quant or "none",
+            "leaves": [[list(leaf.shape[1:]), str(leaf.dtype)]
+                       for leaf in leaves],
+        }
+
+    def export_chain(self, tokens, pages) -> Dict[str, Any]:
+        """Serialize a page chain to the WIRE FORMAT: ``pages[j]``
+        holds the KV of token chunk ``tokens[j*ps:(j+1)*ps]`` (the
+        prefix-tree granularity — callers export FULL prompt pages,
+        ``plan.table[:plan.n_full]``). Each page's payload is the
+        concatenated bytes of its slice of every store leaf, guarded
+        by a CRC32 (zlib — the same checksum the ckpt footer uses), so
+        a decode replica verifies before landing a single byte.
+        Chained ``chunk_keys`` ride along: they ARE the router's
+        affinity keys, so the wire and the prefix tree agree on what
+        can hit."""
+        import zlib
+
+        import jax
+
+        from tpuflow.infer.generate import paged_gather
+
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        ps = self.spec.page_size
+        n = len(pages)
+        if tokens.size != n * ps:
+            raise ValueError(
+                f"{n} pages need exactly {n * ps} tokens, got "
+                f"{tokens.size}")
+        wire = self.wire_header()
+        payloads: List[bytes] = []
+        crcs: List[int] = []
+        if n:
+            host = paged_gather(self.cache, [int(p) for p in pages])
+            leaves = jax.tree_util.tree_leaves(host)
+            for j in range(n):
+                buf = b"".join(np.ascontiguousarray(leaf[j]).tobytes()
+                               for leaf in leaves)
+                payloads.append(buf)
+                crcs.append(zlib.crc32(buf) & 0xFFFFFFFF)
+        wire.update(
+            n_pages=n, first_page=0,
+            tokens=tokens.tolist(),
+            chunk_keys=[k.hex() for k in chunk_keys(tokens, ps)],
+            payloads=payloads, crc32=crcs,
+        )
+        self.exports += 1
+        return wire
+
+    def _check_header(self, wire: Dict[str, Any]) -> None:
+        mine = self.wire_header()
+        for key in ("version", "page_size", "quant", "leaves"):
+            theirs = wire.get(key)
+            if key == "leaves":
+                theirs = [[list(s), str(d)] for s, d in (theirs or ())]
+            if theirs != mine[key]:
+                raise PageWireError(
+                    f"wire {key} mismatch: got {theirs!r}, this store "
+                    f"has {mine[key]!r} — exporter and importer must "
+                    f"run the same model/spec")
+
+    def import_chain(self, wire: Dict[str, Any]) -> int:
+        """Verify and land one wire (or :func:`split_chain` chunk)
+        into THIS store: every payload CRC is checked FIRST (nothing
+        retained on any failure — the :class:`PageWireError` contract),
+        chunks the prefix tree already holds are skipped (transfer
+        dedup — the exporter shipped them because the router could not
+        know), fresh pages are allocated (LRU-evicting unreferenced
+        tree pages under pressure, exactly like :meth:`plan`), the
+        payloads scatter in place (donated store), and the landed
+        chain publishes into the prefix tree holding TREE-ONLY
+        references — imported pages are LRU-evictable like any cached
+        prefix, and the next admission matching the prompt completes
+        as a narrow (width-1 at best) join. Returns pages landed."""
+        import jax
+
+        import zlib
+
+        if self.prefix is None:
+            raise PageWireError(
+                "importer has no prefix cache — imported pages would "
+                "be unreachable")
+        self._check_header(wire)
+        ps = self.spec.page_size
+        tokens = np.asarray(wire["tokens"], np.int32).reshape(-1)
+        first = int(wire.get("first_page", 0))
+        n = int(wire["n_pages"])
+        payloads = wire["payloads"]
+        crcs = wire["crc32"]
+        if len(payloads) != n or len(crcs) != n:
+            raise PageWireError(
+                f"wire carries {len(payloads)} payloads / {len(crcs)} "
+                f"crcs for n_pages={n}")
+        if tokens.size != (first + n) * ps:
+            raise PageWireError(
+                f"wire tokens cover {tokens.size} positions, chain "
+                f"end needs {(first + n) * ps}")
+        leaves, treedef = jax.tree_util.tree_flatten(self.cache)
+        specs = [(tuple(leaf.shape[1:]), np.dtype(str(leaf.dtype)))
+                 for leaf in leaves]
+        page_nbytes = sum(int(np.prod(s)) * d.itemsize for s, d in specs)
+        for j, (buf, crc) in enumerate(zip(payloads, crcs)):
+            if len(buf) != page_nbytes:
+                raise PageWireError(
+                    f"page {first + j} payload is {len(buf)} bytes, "
+                    f"store pages are {page_nbytes}")
+            if zlib.crc32(buf) & 0xFFFFFFFF != int(crc):
+                raise PageWireError(
+                    f"page {first + j} payload failed its CRC — "
+                    f"corrupt in transit")
+        # dedup against what this store already caches: the match is
+        # the same radix walk an admission would do
+        full_pages, m_tok, _ = self.prefix.match(tokens)
+        m_full = m_tok // ps
+        if m_full < first:
+            raise PageWireError(
+                f"chain gap: this store holds {m_full} full pages of "
+                f"the prefix but the chunk starts at page {first} — "
+                f"an earlier chunk is missing or failed")
+        start = max(first, m_full)
+        end = first + n
+        if start >= end:
+            return 0  # everything already cached here
+        n_new = end - start
+        fresh = self.allocator.alloc(n_new)
+        if fresh is None:
+            short = n_new - self.allocator.free_count()
+            self.prefix.evict_lru(short)
+            fresh = self.allocator.alloc(n_new)
+        if fresh is None:
+            raise PageWireError(
+                f"allocator dry: {n_new} pages short even after LRU "
+                f"pressure — falling back to local prefill")
+        # payload bytes -> per-leaf host arrays (k pages each)
+        arrays = []
+        for shape, dtype in specs:
+            arrays.append(np.empty((n_new,) + shape, dtype))
+        for i in range(n_new):
+            buf = payloads[start - first + i]
+            ofs = 0
+            for li, (shape, dtype) in enumerate(specs):
+                nb = int(np.prod(shape)) * dtype.itemsize
+                arrays[li][i] = np.frombuffer(
+                    buf, dtype, count=int(np.prod(shape)),
+                    offset=ofs).reshape(shape)
+                ofs += nb
+        from tpuflow.infer.generate import paged_store_pages
+        from tpuflow.obs import memory as _mem
+
+        payload_tree = jax.tree_util.tree_unflatten(treedef, arrays)
+        self.cache = paged_store_pages(self.cache, fresh, payload_tree)
+        _mem.tag("kv_pages", self.cache)
+        # publish: existing chain + fresh pages spell the full path;
+        # the tree retains the fresh pages itself, so releasing OUR
+        # allocation reference leaves them tree-only (LRU-evictable) —
+        # and frees outright any page whose chunk was already present
+        self.prefix.insert(tokens[: end * ps],
+                           (full_pages + fresh)[:end])
+        self.allocator.release(fresh)
+        self.imports += 1
+        return n_new
+
     def insert_prompt(self, prompt: np.ndarray, plan: PagePlan) -> int:
         """After the join prefill: publish the request's full prompt
         pages into the prefix tree (content for pages fully inside
@@ -730,6 +977,8 @@ class PagedKV:
                "kv_bytes_in_use": self.bytes_in_use(),
                "kv_bytes_total": self.bytes_total(),
                "page_extends": self.extends,
+               "chain_exports": self.exports,
+               "chain_imports": self.imports,
                "held_vs_budget_mean": (
                    None if hb is None else round(hb, 4)),
                "held_vs_cap_mean": (

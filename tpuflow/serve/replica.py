@@ -5,26 +5,43 @@ The router (:mod:`tpuflow.serve.router`) never talks to a
 a :class:`Replica`, the narrow surface a serving backend must offer:
 submit / cancel / load_snapshot / health / drain, plus the offline
 drive hooks the deterministic tests and the virtual-clock bench use.
-:class:`InProcessReplica` is the one backend today (N schedulers in one
-process, each on its own scheduler thread); an HTTP backend speaking to
-a remote ``python -m tpuflow.serve`` instance implements the same
-methods over ``POST /v1/generate`` + ``GET /readyz`` + the
-``load_snapshot`` JSON and drops in without touching the router —
-which is exactly the seam where ROADMAP item 3's prefill/decode
-disaggregation becomes a config change.
+Two backends (ISSUE 8 built the seam; ISSUE 14 fills it):
+
+- :class:`InProcessReplica` — N schedulers in one process, each on its
+  own scheduler thread, sharing loaded weights;
+- :class:`HTTPReplica` — an OUT-OF-PROCESS worker (its own ``python -m
+  tpuflow.serve`` instance that loaded weights itself) behind the
+  ``/v1/worker/*`` endpoints of :mod:`tpuflow.serve.http`. The worker
+  process owns its device state, its own process-default watchdog and
+  its own blast radius: one worker dying fails over exactly one
+  replica, and the router's ``--connect host:port,...`` CLI turns the
+  tier into config. Streaming rides chunked NDJSON; KV page chains
+  cross as the serve/pages.py wire format (base64 over JSON) — the
+  prefill/decode disaggregation transport.
 
 Thread discipline: everything here delegates to scheduler entry points
 that are already thread-safe (``submit``/``cancel``/``load_snapshot``)
 or documented single-thread (``step``/``run_until_idle`` — offline
 drive only). No device work happens in this module: the router tier is
-pure host policy, and a guard test pins that boundary.
+pure host policy, and a guard test pins that boundary (HTTPReplica is
+host-only by construction — the device lives in another process).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
 
-from tpuflow.serve.request import Request
+import numpy as np
+
+from tpuflow.serve.request import (
+    QueueFull,
+    Request,
+    RequestState,
+    SchedulerClosed,
+)
 
 
 class Replica:
@@ -78,15 +95,39 @@ class InProcessReplica(Replica):
                stream_cb: Optional[Callable] = None,
                request_id: Optional[str] = None,
                stream_id: Optional[int] = None,
-               speculate: bool = True) -> Request:
+               speculate: bool = True,
+               await_transfer: Optional[str] = None) -> Request:
         return self.sched.submit(
             prompt, max_new_tokens, deadline_s=deadline_s,
             stream_cb=stream_cb, request_id=request_id,
             stream_id=stream_id, speculate=speculate,
+            await_transfer=await_transfer,
         )
 
     def cancel(self, request) -> bool:
         return self.sched.cancel(request)
+
+    # ---- prefill/decode disaggregation (ISSUE 14) -------------------
+    @property
+    def replica_class(self) -> str:
+        return getattr(self.sched, "replica_class", "mixed")
+
+    def submit_prefill(self, prompt, *,
+                       deadline_s: Optional[float] = None,
+                       stream_cb: Optional[Callable] = None,
+                       request_id: Optional[str] = None) -> Request:
+        return self.sched.submit_prefill(
+            prompt, deadline_s=deadline_s, stream_cb=stream_cb,
+            request_id=request_id)
+
+    def offer_chain(self, wire, *, transfer_id: Optional[str] = None,
+                    last: bool = True) -> str:
+        return self.sched.offer_chain(wire, transfer_id=transfer_id,
+                                      last=last)
+
+    def fail_transfer(self, transfer_id: str,
+                      reason: str = "transfer failed") -> None:
+        self.sched.fail_transfer(transfer_id, reason)
 
     # ---- sensors -----------------------------------------------------
     def load_snapshot(self) -> Dict[str, Any]:
@@ -96,30 +137,15 @@ class InProcessReplica(Replica):
         return self.sched.readiness()
 
     def health(self) -> Dict[str, Any]:
-        """Failover input: ``failed`` = watchdog-tripped, or closed
-        WITHOUT a drain (a draining replica serves its own backlog —
-        resubmitting it elsewhere would double-serve), or a launched
-        loop thread that DIED (``readiness()``'s ``wedged_loop``: the
-        thread-alive-aware signal — a live thread inside a long
-        first-touch compile or slow segment is stalled, not dead, and
-        must NOT cascade into failover). NOTE the watchdog is
-        process-global (PR 5): in-process replicas share it, so a
-        NaN/stall trip fails the whole in-process tier over at once —
-        per-replica watchdog isolation arrives with out-of-process
-        backends."""
-        r = self.sched.readiness()
-        wd = r.get("watchdog") or {}
-        tripped = bool(wd.get("tripped"))
-        closed = bool(r.get("closed"))
-        draining = bool(r.get("draining"))
-        dead_loop = bool(r.get("wedged_loop"))
-        return {
-            "failed": tripped or (closed and not draining) or dead_loop,
-            "tripped": tripped,
-            "closed": closed,
-            "draining": draining,
-            "ready": bool(r.get("ready")),
-        }
+        """Failover input — delegates to
+        :meth:`ServeScheduler.health`. Per-replica isolation (ISSUE
+        14, closing the PR 8 note): construct each scheduler with its
+        OWN ``watchdog=`` and a trip fails over only that replica;
+        without one, in-process replicas share the process default
+        and fail over together. A live thread inside a long
+        first-touch compile is stalled, not dead, and never cascades
+        into failover (``wedged_loop`` is thread-alive-aware)."""
+        return self.sched.health()
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         return self.sched.metrics_snapshot()
@@ -181,3 +207,424 @@ class InProcessReplica(Replica):
 
     def idle(self) -> bool:
         return self.sched.idle()
+
+
+class _RemoteTokenizer:
+    """Tokenizer proxy over a worker's ``/v1/worker/encode|decode`` —
+    the router never needs local weights OR a local tokenizer to front
+    remote workers (``--connect`` loads nothing)."""
+
+    def __init__(self, replica: "HTTPReplica"):
+        self._rep = replica
+
+    def encode(self, text: str):
+        out = self._rep._post_json("/v1/worker/encode", {"text": text})
+        return np.asarray(out["ids"], np.int32)
+
+    def decode(self, ids) -> bytes:
+        ids = np.asarray(ids, np.int32).reshape(-1).tolist()
+        out = self._rep._post_json("/v1/worker/decode", {"ids": ids})
+        return out["text"].encode("utf-8")
+
+
+class HTTPReplica(Replica):
+    """Out-of-process replica: the same 10-method surface spoken over
+    HTTP to a worker ``python -m tpuflow.serve`` instance (which
+    loaded its own weights — per-process device state, per-process
+    watchdog, real blast-radius containment). ``submit`` streams
+    chunked NDJSON on a per-request reader thread that mirrors the
+    remote request into a local shadow :class:`Request` (tokens,
+    terminal state, stream callbacks), so the router drives remote and
+    in-process replicas identically; a dropped connection finalizes
+    the shadow CANCELLED — never-admitted requests then ride the
+    router's normal failover resubmission, token-identically (their
+    pinned stream id travels with them). Page-chain transfers cross as
+    the serve/pages.py wire format, base64 over JSON.
+
+    Offline drive (``step``) is not available over HTTP — remote tiers
+    run online (``Router.start()``)."""
+
+    def __init__(self, address: str, *, name: Optional[str] = None,
+                 timeout_s: float = 120.0,
+                 connect_timeout_s: float = 10.0):
+        addr = address
+        for pfx in ("http://", "https://"):
+            if addr.startswith(pfx):
+                addr = addr[len(pfx):]
+        addr = addr.rstrip("/")
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"address must be host:port (got {address!r})")
+        self.host, self.port = host, int(port)
+        self.address = f"{self.host}:{self.port}"
+        self.timeout_s = float(timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.metrics = None  # no local event log to merge
+        cfg = self._get_json("/v1/worker/config")
+        self.name = name or str(cfg.get("name") or self.address)
+        self.slots = int(cfg.get("slots", 1))
+        self.max_new_cap = int(cfg.get("max_new_cap", 64))
+        self.page_size = cfg.get("page_size")
+        if self.page_size is not None:
+            self.page_size = int(self.page_size)
+        self.replica_class = str(cfg.get("replica_class", "mixed"))
+        self.tokenizer = (_RemoteTokenizer(self)
+                          if cfg.get("has_tokenizer") else None)
+
+    # ---- plumbing ----------------------------------------------------
+    def _open(self, method: str, path: str, body=None,
+              timeout: Optional[float] = None):
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=self.timeout_s if timeout is None else timeout)
+        payload = None if body is None else json.dumps(body).encode()
+        headers = ({"Content-Type": "application/json"}
+                   if payload is not None else {})
+        conn.request(method, path, body=payload, headers=headers)
+        return conn, conn.getresponse()
+
+    @staticmethod
+    def _raise_for(status: int, obj: Dict[str, Any]) -> None:
+        """Map worker HTTP statuses back onto the scheduler's own
+        exception taxonomy — the router's retry/shed/failover logic
+        must not care which transport a replica speaks."""
+        if status == 429:
+            raise QueueFull(int(obj.get("depth", 0)),
+                            float(obj.get("retry_after_s", 1.0)))
+        if status == 503:
+            raise SchedulerClosed(str(obj.get("error", "closed")))
+        if status == 400:
+            raise ValueError(str(obj.get("error", "bad request")))
+        if status >= 400:
+            trace = obj.get("trace")
+            raise RuntimeError(
+                f"worker returned {status}: {obj.get('error')}"
+                + (f" [{' | '.join(trace[-3:])}]" if trace else ""))
+
+    def _call(self, method: str, path: str, body=None,
+              timeout: Optional[float] = None) -> Dict[str, Any]:
+        conn, resp = self._open(method, path, body, timeout=timeout)
+        try:
+            raw = resp.read()
+        finally:
+            conn.close()
+        obj = json.loads(raw.decode() or "{}")
+        self._raise_for(resp.status, obj)
+        return obj
+
+    def _get_json(self, path: str) -> Dict[str, Any]:
+        return self._call("GET", path,
+                          timeout=self.connect_timeout_s)
+
+    def _post_json(self, path: str, body) -> Dict[str, Any]:
+        return self._call("POST", path, body)
+
+    # ---- request surface ---------------------------------------------
+    def _encode_prompt(self, prompt) -> np.ndarray:
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError(
+                    "string prompts need a worker-side tokenizer")
+            return self.tokenizer.encode(prompt)
+        return np.asarray(prompt, np.int32).reshape(-1)
+
+    def submit(self, prompt, max_new_tokens=None, *,
+               deadline_s: Optional[float] = None,
+               stream_cb: Optional[Callable] = None,
+               request_id: Optional[str] = None,
+               stream_id: Optional[int] = None,
+               speculate: bool = True,
+               await_transfer: Optional[str] = None) -> Request:
+        ids = self._encode_prompt(prompt)
+        if max_new_tokens is None:
+            max_new_tokens = self.max_new_cap
+        body: Dict[str, Any] = {
+            "prompt": ids.tolist(),
+            "max_new_tokens": int(max_new_tokens),
+            "speculate": bool(speculate),
+        }
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
+        if request_id:
+            body["id"] = str(request_id)
+        if stream_id is not None:
+            body["stream_id"] = int(stream_id)
+        if await_transfer is not None:
+            body["await_transfer"] = str(await_transfer)
+        conn, resp = self._open("POST", "/v1/worker/submit", body)
+        if resp.status != 200:
+            try:
+                obj = json.loads(resp.read().decode() or "{}")
+            finally:
+                conn.close()
+            self._raise_for(resp.status, obj)
+        shadow = Request(prompt_ids=ids,
+                         max_new_tokens=int(max_new_tokens),
+                         id=request_id or "", stream_cb=stream_cb)
+        shadow.stream_id = int(stream_id or 0) % max(1, self.slots)
+        shadow.speculate = bool(speculate)
+        threading.Thread(
+            target=self._reader, args=(conn, resp, shadow),
+            name=f"tpuflow-httprep-{self.name}-{shadow.id}",
+            daemon=True).start()
+        return shadow
+
+    def _reader(self, conn, resp, shadow: Request) -> None:
+        """Per-request stream reader: mirror NDJSON events into the
+        shadow request. A lost connection (worker died mid-flight)
+        finalizes CANCELLED — with no tokens and no admission stamp
+        that is exactly the router's failover-candidate shape."""
+        try:
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                ev = json.loads(line.decode())
+                if "tokens" in ev and not ev.get("done"):
+                    new = [int(t) for t in ev["tokens"]]
+                    if new and shadow.ts_admitted is None:
+                        shadow.ts_admitted = time.time()
+                        shadow.state = RequestState.RUNNING
+                    if new and shadow.ts_first_token is None:
+                        shadow.ts_first_token = time.time()
+                    shadow.tokens.extend(new)
+                    if shadow.stream_cb is not None and new:
+                        try:
+                            shadow.stream_cb(shadow, new, False)
+                        except Exception:
+                            pass
+                elif ev.get("done"):
+                    state = RequestState(ev.get("state", "done"))
+                    final = [int(t) for t in ev.get("tokens", [])]
+                    if len(final) >= len(shadow.tokens):
+                        extra = final[len(shadow.tokens):]
+                        shadow.tokens.extend(extra)
+                    if ev.get("ts_admitted") and shadow.ts_admitted is None:
+                        shadow.ts_admitted = float(ev["ts_admitted"])
+                    shadow.finalize(state, ev.get("error"))
+                    if shadow.stream_cb is not None:
+                        try:
+                            shadow.stream_cb(shadow, [], True)
+                        except Exception:
+                            pass
+                    return
+        except Exception:
+            pass
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        if shadow.state in (RequestState.QUEUED, RequestState.RUNNING):
+            shadow.finalize(RequestState.CANCELLED,
+                            "replica connection lost")
+            if shadow.stream_cb is not None:
+                try:
+                    shadow.stream_cb(shadow, [], True)
+                except Exception:
+                    pass
+
+    def cancel(self, request) -> bool:
+        # the frontend's own cancel route IS the worker cancel (same
+        # scheduler, same id semantics)
+        rid = request.id if isinstance(request, Request) else str(request)
+        try:
+            return bool(self._post_json("/v1/cancel",
+                                        {"id": rid}).get("cancelled"))
+        except Exception:
+            return False
+
+    # ---- prefill/decode disaggregation ------------------------------
+    def submit_prefill(self, prompt, *,
+                       deadline_s: Optional[float] = None,
+                       stream_cb: Optional[Callable] = None,
+                       request_id: Optional[str] = None) -> Request:
+        """Run a prefill-only request on the worker and mirror its
+        exported wire back (``shadow.export``); the blocking HTTP call
+        rides a background thread so the caller (the router, possibly
+        on another replica's scheduler thread) never blocks."""
+        ids = self._encode_prompt(prompt)
+        shadow = Request(prompt_ids=ids, max_new_tokens=1,
+                         id=request_id or "", stream_cb=stream_cb)
+        shadow.prefill_only = True
+
+        def run():
+            from tpuflow.serve.pages import wire_from_json
+
+            err = None
+            try:
+                out = self._post_json("/v1/worker/prefill", {
+                    "prompt": ids.tolist(),
+                    "id": shadow.id,
+                    **({"deadline_s": float(deadline_s)}
+                       if deadline_s is not None else {}),
+                })
+                if out.get("wire") is not None:
+                    shadow.export = wire_from_json(out["wire"])
+            except Exception as e:
+                err = f"{type(e).__name__}: {e}"
+            state = (RequestState.DONE if shadow.export is not None
+                     else RequestState.CANCELLED)
+            shadow.finalize(state, err)
+            if shadow.stream_cb is not None:
+                try:
+                    shadow.stream_cb(shadow, [], True)
+                except Exception:
+                    pass
+
+        threading.Thread(
+            target=run, daemon=True,
+            name=f"tpuflow-httprep-pf-{self.name}-{shadow.id}").start()
+        return shadow
+
+    def offer_chain(self, wire, *, transfer_id: Optional[str] = None,
+                    last: bool = True) -> str:
+        from tpuflow.serve.pages import wire_to_json
+
+        out = self._post_json("/v1/worker/offer_chain", {
+            "transfer_id": transfer_id, "last": bool(last),
+            "wire": wire_to_json(wire),
+        })
+        return str(out["transfer_id"])
+
+    def fail_transfer(self, transfer_id: str,
+                      reason: str = "transfer failed") -> None:
+        try:
+            self._post_json("/v1/worker/fail_transfer", {
+                "transfer_id": str(transfer_id), "reason": str(reason)})
+        except Exception:
+            pass  # an unreachable worker times the transfer out itself
+
+    # ---- sensors -----------------------------------------------------
+    def load_snapshot(self) -> Dict[str, Any]:
+        return self._get_json("/v1/worker/load_snapshot")
+
+    def readiness(self) -> Dict[str, Any]:
+        conn, resp = self._open("GET", "/readyz", None,
+                                timeout=self.connect_timeout_s)
+        try:
+            raw = resp.read()
+        finally:
+            conn.close()
+        return json.loads(raw.decode() or "{}")
+
+    def health(self) -> Dict[str, Any]:
+        """A worker that stopped answering IS failed — the process
+        boundary is the isolation unit (one dead worker fails over
+        exactly one replica; the others never see it)."""
+        try:
+            return self._get_json("/v1/worker/health")
+        except Exception as e:
+            return {"failed": True, "error": repr(e)}
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        return self._get_json("/v1/metrics")
+
+    # ---- shape facts -------------------------------------------------
+    def bucket_of(self, prompt_len: int) -> int:
+        from tpuflow.packaging.lm import _bucket_len
+
+        return _bucket_len(int(prompt_len))
+
+    def pages_needed(self, prompt_len: int, max_new: int) -> Optional[int]:
+        from tpuflow.serve.pages import pages_needed
+
+        if self.page_size is None:
+            return None
+        return pages_needed(int(prompt_len), int(max_new),
+                            self.page_size)
+
+    def retry_after_s(self) -> float:
+        try:
+            return float(self._get_json(
+                "/v1/worker/retry_after")["retry_after_s"])
+        except Exception:
+            return 1.0
+
+    # ---- lifecycle ---------------------------------------------------
+    def start(self) -> None:
+        """The worker process runs its own scheduler loop."""
+
+    def prepare(self, *buckets: int) -> None:
+        """Worker-side warm-up is the worker's own concern."""
+
+    def drain(self) -> None:
+        try:
+            self._post_json("/v1/admin/drain", {})
+        except Exception:
+            pass
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        try:
+            self._call("POST", "/v1/worker/stop",
+                       {"drain": bool(drain), "timeout": float(timeout)},
+                       timeout=timeout + 5.0)
+        except Exception:
+            pass
+
+    # ---- offline drive ----------------------------------------------
+    def step(self) -> bool:
+        return False  # remote tiers run online (Router.start())
+
+    def idle(self) -> bool:
+        try:
+            snap = self.load_snapshot()
+        except Exception:
+            return True
+        return (int(snap.get("queue_depth", 0)) == 0
+                and int(snap.get("running", 0)) == 0)
+
+
+def launch_worker(model: str, *, host: str = "127.0.0.1", port: int = 0,
+                  extra_args: Optional[List[str]] = None,
+                  startup_timeout_s: float = 180.0):
+    """Spawn an out-of-process worker — ``python -m tpuflow.serve
+    --model <model> --port 0 ...`` in a fresh process that loads
+    weights itself — and return ``(Popen, "host:port")`` once the
+    serving banner prints. The caller wraps the address in an
+    :class:`HTTPReplica` (and owns the process: terminate it to
+    simulate a replica death)."""
+    import re
+    import subprocess
+    import sys
+
+    import select
+
+    cmd = [sys.executable, "-m", "tpuflow.serve", "--model", str(model),
+           "--host", host, "--port", str(port)]
+    cmd.extend(extra_args or [])
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + startup_timeout_s
+    banner = []
+    while time.time() < deadline:
+        # select-gated read: a worker wedged BEFORE printing anything
+        # (device-init deadlock) must still hit the timeout — a bare
+        # readline() would block past it forever
+        ready, _, _ = select.select([proc.stdout], [], [], 0.25)
+        if not ready:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    "worker exited before serving:\n" + "".join(banner))
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    "worker exited before serving:\n" + "".join(banner))
+            time.sleep(0.05)
+            continue
+        banner.append(line)
+        m = re.search(r"http://([^\s:]+):(\d+)", line)
+        if m:
+            return proc, f"{m.group(1)}:{m.group(2)}"
+    proc.terminate()
+    raise RuntimeError(
+        f"worker did not serve within {startup_timeout_s}s:\n"
+        + "".join(banner))

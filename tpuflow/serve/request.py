@@ -90,6 +90,15 @@ class Request:
     # shares the batch with speculative rows (the acceptance kernel
     # forces its accepted count to 0), tokens unchanged either way
     speculate: bool = True
+    # prefill/decode disaggregation (ISSUE 14): a PREFILL-ONLY request
+    # runs its prompt pass, exports the resulting page chain to the
+    # wire format (``export`` — see serve/pages.py) and finalizes DONE
+    # with zero tokens; ``await_transfer`` holds a submitted request
+    # QUEUED until the named inbound page-chain transfer lands (or
+    # fails, when it falls back to a local prefill)
+    prefill_only: bool = False
+    await_transfer: Optional[str] = None
+    export: Optional[Dict[str, Any]] = None
     slot: Optional[int] = None
     tokens: List[int] = field(default_factory=list)
     error: Optional[str] = None

@@ -134,6 +134,14 @@ class RouterRequest:
         self._done = threading.Event()
         self._orphaned = False  # terminal held back pending failover
         self._error: Optional[str] = None
+        # prefill/decode disaggregation (ISSUE 14): a transferred
+        # request binds to its decode home IMMEDIATELY (the inner
+        # request queues there gated on the transfer id, keeping its
+        # FIFO position); _transfer tracks the PREFILL leg — phase
+        # 'prefill' (prompt pass in flight on the prefill replica) →
+        # 'landing' (claimed by completion/abort) → 'decode' (chunks
+        # shipped). Aborts release the inner via fail_transfer.
+        self._transfer: Optional[Dict[str, Any]] = None
 
     # ---- wiring (router-owned) --------------------------------------
     def _make_cb(self) -> Callable:
@@ -202,6 +210,17 @@ class RouterRequest:
         self._done.set()
         self._router._on_request_done(self)
 
+    def _claim_transfer(self, from_phase: str, to_phase: str) -> bool:
+        """CAS on the transfer phase: exactly one of a prefill
+        completion callback and a maintenance-sweep rescue may move
+        the request forward."""
+        with self._lock:
+            if (self._transfer is None
+                    or self._transfer.get("phase") != from_phase):
+                return False
+            self._transfer["phase"] = to_phase
+            return True
+
     # ---- client surface ---------------------------------------------
     @property
     def inner(self) -> Request:
@@ -215,18 +234,28 @@ class RouterRequest:
 
     @property
     def state(self) -> RequestState:
-        return self.inner.state
+        inner = self.inner
+        if inner is None:  # mid-transfer: not yet bound anywhere
+            return (RequestState.CANCELLED if self._done.is_set()
+                    else RequestState.QUEUED)
+        return inner.state
 
     @property
     def tokens(self) -> List[int]:
-        return self.inner.tokens
+        inner = self.inner
+        return [] if inner is None else inner.tokens
 
     @property
     def error(self) -> Optional[str]:
-        return self._error or self.inner.error
+        inner = self.inner
+        return self._error or (None if inner is None else inner.error)
 
     def timing(self) -> Dict[str, Optional[float]]:
-        return self.inner.timing()
+        inner = self.inner
+        if inner is None:
+            return {"queue_wait_ms": None, "ttft_ms": None,
+                    "decode_ms": None, "e2e_ms": None}
+        return inner.timing()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
@@ -240,10 +269,18 @@ class RouterRequest:
         return self.summary()
 
     def summary(self) -> Dict[str, Any]:
-        out = self.inner.summary()
-        out["id"] = self.id
-        if self._error:
-            out["error"] = out["error"] or self._error
+        inner = self.inner
+        if inner is None:
+            out: Dict[str, Any] = {
+                "id": self.id, "state": self.state.value,
+                "tokens": [], "n_tokens": 0, "error": self._error,
+                "metrics": self.timing(),
+            }
+        else:
+            out = inner.summary()
+            out["id"] = self.id
+            if self._error:
+                out["error"] = out["error"] or self._error
         if self.resubmits:
             out["resubmits"] = self.resubmits
         return out
@@ -275,6 +312,8 @@ class Router:
         shed_on_dry_kv: bool = True,
         clock: Callable[[], float] = time.time,
         name: str = "router",
+        transfer_min_tokens: Optional[int] = None,
+        transfer_chunk_pages: int = 8,
     ):
         """``placement='load'`` is the real policy (least-loaded with
         prefix affinity when ``affinity``); ``'spray'`` hashes the
@@ -289,7 +328,23 @@ class Router:
         cannot cover the request AND already has a backlog — the
         all-allocators-dry backpressure contract, with Retry-After =
         the min across replicas (the soonest ANY of them frees
-        enough)."""
+        enough).
+
+        PREFILL/DECODE DISAGGREGATION (ISSUE 14): replicas declaring
+        ``replica_class='prefill'`` are excluded from decode placement
+        and serve prompt passes only; when at least one prefill- and
+        one decode-capable replica exist, the tier is DISAGGREGATED
+        and placement is two-phase — the decode home is picked by
+        prefix affinity + load + page headroom, and a request whose
+        estimated uncached suffix is at least ``transfer_min_tokens``
+        (default two pages) prefills on the least-loaded prefill
+        replica, whose exported KV page chain streams to the decode
+        home in ``transfer_chunk_pages``-page chunks (landing between
+        that replica's decode segments — transfer overlap) before the
+        request admits there as a prefix hit. Every transfer failure
+        (prefill rejected, wire CRC, dead replica) falls back to a
+        plain local-prefill submit: tokens are identical either way,
+        so disaggregation is purely a placement optimization."""
         if not replicas:
             raise ValueError("router needs at least one replica")
         if placement not in ("load", "spray"):
@@ -321,6 +376,27 @@ class Router:
         self.affinity_slack = int(affinity_slack)
         self._affinity: "OrderedDict[bytes, int]" = OrderedDict()
         self._affinity_cap = int(affinity_capacity)
+        # replica classes (ISSUE 14): prefill-class replicas never
+        # decode; the tier is DISAGGREGATED when both phases exist
+        self.classes: List[str] = [
+            str(getattr(rep, "replica_class", "mixed") or "mixed")
+            for rep in self.replicas]
+        self._prefill_set = {i for i, c in enumerate(self.classes)
+                             if c == "prefill"}
+        self._decode_set = [i for i, c in enumerate(self.classes)
+                            if c != "prefill"]
+        if not self._decode_set:
+            raise ValueError(
+                "router needs at least one decode-capable replica "
+                "(every replica is prefill-class)")
+        self.disaggregated = bool(self._prefill_set)
+        if transfer_min_tokens is None:
+            transfer_min_tokens = 2 * int(ps) if ps else 1 << 30
+        self.transfer_min_tokens = int(transfer_min_tokens)
+        self.transfer_chunk_pages = max(1, int(transfer_chunk_pages))
+        # prefill-side affinity: repeated prefixes prefill where their
+        # pages already sit in the PREFILL replica's own tree
+        self._pf_affinity: "OrderedDict[bytes, int]" = OrderedDict()
         if max_total_queue is None:
             mq = [self._safe_snapshot(i).get("max_queue")
                   for i in range(len(self.replicas))]
@@ -349,6 +425,7 @@ class Router:
             "placed": 0, "affinity_hits": 0, "affinity_spills": 0,
             "shed": 0, "shed_kv": 0, "rejected": 0, "failovers": 0,
             "replicas_failed": 0, "drains": 0,
+            "transfers": 0, "transfer_fallbacks": 0,
         }
         self.placements: Dict[str, int] = {
             rep.name: 0 for rep in self.replicas}
@@ -431,9 +508,14 @@ class Router:
         if not live:
             raise SchedulerClosed("router has no live replicas")
         snaps = {i: self._safe_snapshot(i) for i in live}
-        eligible = [i for i in live if not snaps[i].get("closed")]
+        # DECODE placement candidates: prefill-class replicas never
+        # own a request's decode (ISSUE 14) — they serve prompt passes
+        # through _begin_transfer below
+        eligible = [i for i in live if not snaps[i].get("closed")
+                    and i not in self._prefill_set]
         if not eligible:
-            raise SchedulerClosed("every replica is draining or closed")
+            raise SchedulerClosed(
+                "every decode-capable replica is draining or closed")
         depth = sum(int(snaps[i].get("queue_depth", 0)) for i in eligible)
 
         def _min_retry() -> float:
@@ -474,7 +556,14 @@ class Router:
         # ---- ordering: least-loaded, affinity-first, or spray -------
         scores = {i: int(snaps[i].get("queue_depth", 0))
                   + int(snaps[i].get("running", 0)) for i in eligible}
-        order = sorted(eligible, key=lambda i: (scores[i], i))
+        # decode placement tie-break on PAGE HEADROOM (ISSUE 14): at
+        # equal load, the replica with the most free pages hosts the
+        # decode — that is the resource a decode-class replica sells
+        order = sorted(
+            eligible,
+            key=lambda i: (scores[i],
+                           -int(snaps[i].get("kv_pages_free") or 0),
+                           i))
         affinity_used = False
         keys: List[bytes] = []
         if self._placement == "spray":
@@ -497,6 +586,29 @@ class Router:
                     affinity_used = True
                 else:
                     self._count("affinity_spills")
+
+        # ---- two-phase placement (ISSUE 14) -------------------------
+        # the decode HOME is order[0] (affinity + load + headroom);
+        # whether the PROMPT PASS runs there too is a second decision:
+        # when the tier is disaggregated and the home's estimated
+        # uncached suffix is long enough to be worth shipping pages,
+        # the prefill goes to a prefill-class replica and the chain
+        # follows the request to its decode home over the wire
+        do_transfer = False
+        if self.disaggregated and self._placement != "spray":
+            pf_live = [i for i in live if i in self._prefill_set
+                       and not snaps[i].get("closed")]
+            if pf_live:
+                cached_tokens = 0
+                if keys:
+                    tgt0 = order[0]
+                    with self._lock:
+                        for j, k in enumerate(keys):
+                            if self._affinity.get(k) != tgt0:
+                                break
+                            cached_tokens = (j + 1) * self.affinity_ps
+                uncached = int(ids.size) - cached_tokens
+                do_transfer = uncached >= self.transfer_min_tokens
 
         # ---- place ---------------------------------------------------
         bucket = self.replicas[order[0]].bucket_of(int(ids.size))
@@ -524,6 +636,20 @@ class Router:
                 stream_cb,
             )
             rr.speculate = bool(speculate)
+            rr.ts_arrival = self.clock()
+            # transfer-overlap contract (ISSUE 14): a transferred
+            # request submits to its decode home IMMEDIATELY, gated on
+            # the transfer id — it keeps its FIFO position there while
+            # the prompt pass runs on the prefill replica and the
+            # chain's chunks stream in between that replica's decode
+            # segments; admission lands the boundary the last chunk
+            # does (or falls back to a local prefill if anything on
+            # the prefill path breaks — fail_transfer unblocks it)
+            await_tid = f"{rid}.tx" if do_transfer else None
+            # keyword added only when set: non-transferring tiers keep
+            # the PR 8 replica signature (duck-typed backends/fakes)
+            extra = ({"await_transfer": await_tid}
+                     if await_tid is not None else {})
             for idx in order:
                 rep = self.replicas[idx]
                 cb = rr._make_cb()
@@ -532,6 +658,7 @@ class Router:
                         ids, int(max_new_tokens), deadline_s=deadline_s,
                         stream_cb=cb, request_id=rid,
                         stream_id=stream_id, speculate=rr.speculate,
+                        **extra,
                     )
                 except QueueFull as e:
                     last_qf = e
@@ -540,6 +667,9 @@ class Router:
                     saw_closed = True
                     continue
                 rr._bind(idx, inner)
+                if do_transfer:
+                    rr._transfer = {"phase": "prefill", "tid": await_tid,
+                                    "prefill": None, "pf_req": None}
                 with self._lock:
                     self._admit_counts[bucket] = n + 1
                     self._inflight[rid] = rr
@@ -562,7 +692,10 @@ class Router:
                               stream_id=stream_id, bucket=bucket,
                               affinity=bool(affinity_used
                                             and placed == order[0]),
+                              transfer=bool(do_transfer),
                               depth=scores.get(placed, 0))
+            if do_transfer:
+                self._begin_transfer(rr, pf_live, keys)
             return rr
         # every eligible replica said no. If every refusal was a
         # drain/stop that landed after the eligibility snapshot, this
@@ -590,7 +723,20 @@ class Router:
         with rr._lock:
             rr.client_cancelled = True
             inner, idx = rr._inner, rr._replica_idx
+            tx = rr._transfer
         if inner is None or idx < 0:
+            if tx is not None:
+                # mid-transfer: best-effort cancel of the prefill leg;
+                # the transfer machinery surfaces the terminal when it
+                # next touches this request (client_cancelled gates
+                # every forward step)
+                pf_idx, pf_req = tx.get("prefill"), tx.get("pf_req")
+                if pf_idx is not None and pf_req is not None:
+                    try:
+                        self.replicas[pf_idx].cancel(pf_req)
+                    except Exception:
+                        pass
+                return True
             return False
         try:
             return self.replicas[idx].cancel(inner)
@@ -609,6 +755,133 @@ class Router:
     def _on_request_done(self, rr: RouterRequest) -> None:
         with self._lock:
             self._inflight.pop(rr.id, None)
+
+    # ---- prefill/decode transfers (ISSUE 14) ------------------------
+    def _begin_transfer(self, rr: RouterRequest,
+                        pf_candidates: List[int],
+                        keys: List[bytes]) -> None:
+        """Phase 1: run the prompt pass on a prefill-class replica.
+        Prefill placement is its own affinity+load decision (a
+        repeated prefix exports from the prefill replica's OWN tree
+        without recomputing); every rejection falls through to the
+        next candidate, and total rejection falls back to a local
+        prefill on the decode home — tokens identical either way."""
+        snaps = {i: self._safe_snapshot(i) for i in pf_candidates}
+        open_pf = [i for i in pf_candidates
+                   if not snaps[i].get("closed")]
+        if not open_pf:
+            return self._abort_transfer(
+                rr, "no open prefill replica", claim=True)
+        pf_scores = {i: int(snaps[i].get("queue_depth", 0))
+                     + int(snaps[i].get("running", 0))
+                     for i in open_pf}
+        order = sorted(open_pf, key=lambda i: (pf_scores[i], i))
+        if keys:
+            with self._lock:
+                tgt = None
+                for j in range(len(keys) - 1, -1, -1):
+                    tgt = self._pf_affinity.get(keys[j])
+                    if tgt is not None:
+                        break
+            if (tgt in pf_scores
+                    and pf_scores[tgt] <= pf_scores[order[0]]
+                    + self.affinity_slack):
+                order.remove(tgt)
+                order.insert(0, tgt)
+
+        def on_pf(inner, new, finished):
+            if finished:
+                self._finish_transfer(rr, inner)
+
+        for idx in order:
+            rep = self.replicas[idx]
+            with rr._lock:
+                if rr._transfer is not None:
+                    rr._transfer["prefill"] = idx
+            try:
+                pf_req = rep.submit_prefill(
+                    rr.prompt_ids, stream_cb=on_pf,
+                    request_id=f"{rr.id}.pf")
+            except Exception:
+                continue
+            with rr._lock:
+                if rr._transfer is not None:
+                    rr._transfer["pf_req"] = pf_req
+            with self._lock:
+                if keys:
+                    for k in keys:
+                        self._pf_affinity[k] = idx
+                        self._pf_affinity.move_to_end(k)
+                    while len(self._pf_affinity) > self._affinity_cap:
+                        self._pf_affinity.popitem(last=False)
+            self.metrics.event(rr.id, "prefill_placed",
+                              replica=rep.name)
+            return
+        self._abort_transfer(rr, "every prefill replica rejected",
+                             claim=True)
+
+    def _finish_transfer(self, rr: RouterRequest, pf_req) -> None:
+        """Phase 2 (fires on the prefill replica's completion): stream
+        the exported chain to the request's decode home — where it
+        already sits QUEUED at its FIFO position, gated on the
+        transfer id — in ``transfer_chunk_pages``-page chunks; its
+        admission lands the boundary the last chunk does, as a prefix
+        hit. Any breakage aborts the transfer instead: the decode home
+        runs the prefill locally, tokens identical."""
+        from tpuflow.serve.pages import split_chain
+
+        if not rr._claim_transfer("prefill", "landing"):
+            return  # a maintenance sweep already aborted this one
+        with rr._lock:
+            tid = (rr._transfer or {}).get("tid")
+        d_idx = rr.replica
+        wire = getattr(pf_req, "export", None)
+        if (pf_req.state is not RequestState.DONE or wire is None
+                or d_idx < 0 or tid is None):
+            return self._abort_transfer(
+                rr, f"prefill failed: "
+                    f"{pf_req.error or pf_req.state.value}")
+        rep = self.replicas[d_idx]
+        try:
+            chunks = split_chain(wire, self.transfer_chunk_pages)
+            for j, ch in enumerate(chunks):
+                rep.offer_chain(ch, transfer_id=tid,
+                                last=(j == len(chunks) - 1))
+            if not chunks:
+                # nothing cacheable to ship (sub-page prompt): unblock
+                # the waiting admission rather than time it out
+                return self._abort_transfer(rr, "empty chain")
+        except Exception as e:
+            return self._abort_transfer(rr, repr(e))
+        with rr._lock:
+            if rr._transfer is not None:
+                rr._transfer["phase"] = "decode"
+        self._count("transfers")
+        self.metrics.event(
+            rr.id, "transfer",
+            pages=int(wire.get("n_pages", 0)),
+            bytes=sum(len(p) for p in wire.get("payloads", ())),
+            to_replica=rep.name)
+
+    def _abort_transfer(self, rr: RouterRequest, reason: str,
+                        claim: bool = False) -> None:
+        """The prefill path broke (rejected everywhere, dead replica,
+        corrupt/empty export): tell the decode home to stop waiting —
+        its ``fail_transfer`` releases the request to a LOCAL prefill
+        at its next boundary. Purely a lost optimization: the pinned
+        stream id makes the tokens identical."""
+        if claim and not rr._claim_transfer("prefill", "landing"):
+            return
+        with rr._lock:
+            tid = (rr._transfer or {}).get("tid")
+        self._count("transfer_fallbacks")
+        self.metrics.event(rr.id, "transfer_fallback", reason=reason)
+        d_idx = rr.replica
+        if d_idx >= 0 and tid is not None:
+            try:
+                self.replicas[d_idx].fail_transfer(tid, reason)
+            except Exception:
+                pass
 
     # ---- failover (maintenance) -------------------------------------
     def mark_failed(self, replica: "int | str", reason: str = "") -> None:
@@ -681,6 +954,20 @@ class Router:
                 rr._finalize_failed(
                     "replica failed with this request mid-decode")
                 progress = True
+        # disaggregation sweep (ISSUE 14): transfers stranded on a
+        # FAILED prefill replica abort, releasing their decode-home
+        # admission to a local prefill (the completion callback is the
+        # normal path — this is the safety net when a replica dies
+        # without finalizing its prefill request)
+        with self._lock:
+            stranded = [rr for rr in self._inflight.values()
+                        if rr._transfer is not None
+                        and rr._transfer.get("phase") == "prefill"
+                        and rr._transfer.get("prefill") in failed]
+        for rr in stranded:
+            self._abort_transfer(rr, "prefill replica failed",
+                                 claim=True)
+            progress = True
         from tpuflow.obs.gauges import set_gauge
 
         set_gauge("router.replicas", float(len(self.replicas)))
@@ -693,7 +980,10 @@ class Router:
         nothing had been produced (the candidate test guarantees it)."""
         with rr._lock:
             old_idx, old_inner = rr._replica_idx, rr._inner
-        candidates = [i for i in self._live_indices() if i != old_idx]
+        # decode-capable candidates only: a prefill-class replica must
+        # never inherit a decode through failover either
+        candidates = [i for i in self._live_indices()
+                      if i != old_idx and i not in self._prefill_set]
         snaps = {i: self._safe_snapshot(i) for i in candidates}
         order = sorted(
             (i for i in candidates if not snaps[i].get("closed")),
@@ -867,12 +1157,19 @@ class Router:
             per[rep.name] = {
                 "ready": ok,
                 "failed": failed.get(i),
+                "class": self.classes[i],
                 "queue_depth": snap.get("queue_depth"),
                 "running": snap.get("running"),
                 "draining": snap.get("draining"),
             }
+        # a disaggregated tier with only its prefill replicas ready
+        # cannot serve a single token — readiness needs a DECODE home
+        decode_ready = sum(
+            1 for i, rep in enumerate(self.replicas)
+            if i in set(self._decode_set)
+            and per[rep.name]["ready"])
         return {
-            "ready": bool(ready_n) and not (draining or closed),
+            "ready": bool(decode_ready) and not (draining or closed),
             "closed": closed,
             "draining": draining,
             "replicas_ready": ready_n,
@@ -938,7 +1235,9 @@ class Router:
             inflight = [
                 {"id": rr.id, "replica": rr._replica_idx,
                  "state": (rr._inner.state.value
-                           if rr._inner is not None else "?"),
+                           if rr._inner is not None
+                           else "transfer:" + str(
+                               (rr._transfer or {}).get("phase", "?"))),
                  "resubmits": rr.resubmits,
                  "orphaned": rr._orphaned}
                 for rr in self._inflight.values()

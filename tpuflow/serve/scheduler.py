@@ -98,6 +98,9 @@ class ServeScheduler:
         prefill_budget_tokens: Optional[int] = None,
         ring_prefill: Optional[int] = None,
         ring_prefill_min_tokens: int = 512,
+        replica_class: str = "mixed",
+        watchdog=None,
+        transfer_wait_s: float = 30.0,
     ):
         """``kv='paged'`` switches the KV memory model (ISSUE 6): one
         process-wide store of ``kv_pages`` fixed-size pages
@@ -139,6 +142,26 @@ class ServeScheduler:
         same prompt. Duplicates and multi-turn follow-ups hit the
         prefix tree like any other request (a full hit never rings).
         Requires ``kv='paged'``, no int8 pages, no speculation.
+
+        ``replica_class`` (ISSUE 14, prefill/decode disaggregation):
+        an advisory class label — ``'prefill'`` replicas run prompt
+        passes and EXPORT the resulting KV page chains over the wire
+        (:meth:`submit_prefill`), ``'decode'`` replicas IMPORT chains
+        (:meth:`offer_chain`) and own the decode slots, ``'mixed'``
+        (default) does both locally. The multi-replica router reads
+        the class for two-phase placement; the scheduler itself only
+        validates the config (non-mixed classes require ``kv='paged'``
+        and no speculation — the draft store has no wire harvest) and
+        reports the class in ``load_snapshot()``.
+
+        ``watchdog`` (ISSUE 14 satellite, the PR 8 isolation note):
+        a dedicated :class:`tpuflow.obs.health.Watchdog` for THIS
+        scheduler — ``readiness()``/``health()`` consult it instead of
+        the process default, and a scheduler-loop step failure trips
+        it, so one in-process replica's fault fails over ONLY that
+        replica instead of the whole tier. ``None`` keeps the process
+        default (single-scheduler servers; out-of-process replicas are
+        isolated by their process boundary anyway).
 
         ``speculate_k`` (ISSUE 9) turns on draft-model speculative
         decoding: a small ``draft_model``/``draft_params``
@@ -252,6 +275,34 @@ class ServeScheduler:
                 raise ValueError(
                     f"ring_prefill={n} > {len(_jax.devices())} "
                     f"available devices")
+        if replica_class not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"replica_class must be 'mixed', 'prefill' or "
+                f"'decode', got {replica_class!r}")
+        if replica_class != "mixed":
+            if kv != "paged":
+                raise ValueError(
+                    f"replica_class={replica_class!r} requires "
+                    f"kv='paged' — KV pages are the wire format")
+            if speculate_k:
+                raise ValueError(
+                    "prefill/decode replica classes do not combine "
+                    "with speculate_k — the draft store has no wire "
+                    "harvest, so imported chains would leave drafted "
+                    "rows attending to garbage")
+            if replica_class == "decode" and not kv_prefix_cache:
+                raise ValueError(
+                    "replica_class='decode' requires the prefix cache "
+                    "— imported page chains land in it")
+        self.replica_class = replica_class
+        self._watchdog = watchdog
+        self.transfer_wait_s = float(transfer_wait_s)
+        # inbound page-chain transfers (ISSUE 14): chunks queue here
+        # from any thread; the scheduler thread lands them at boundary
+        # start (device scatter stays on the one device-owning thread)
+        self._chain_inbox: "Deque[tuple]" = deque()
+        self._transfers: Dict[str, Dict[str, Any]] = {}
+        self._transfer_seq = 0
         self.speculate_k = int(speculate_k)
         self.draft_model = draft_model
         self.draft_params = draft_params
@@ -423,6 +474,8 @@ class ServeScheduler:
         request_id: Optional[str] = None,
         stream_id: Optional[int] = None,
         speculate: bool = True,
+        await_transfer: Optional[str] = None,
+        prefill_only: bool = False,
     ) -> Request:
         """Queue one request. Raises :class:`QueueFull` when the
         admission queue is at capacity (backpressure),
@@ -443,9 +496,26 @@ class ServeScheduler:
         request to plain one-token-per-round decode while it shares
         the continuous batch with speculative rows — tokens are
         identical either way (oracle-parity acceptance); a no-op when
-        ``speculate_k`` is off."""
+        ``speculate_k`` is off.
+
+        ``await_transfer`` (ISSUE 14) names an inbound page-chain
+        transfer (:meth:`offer_chain`): the request stays QUEUED until
+        that transfer completes (its admission then hits the imported
+        prefix — cross-process cache routing) or fails/times out
+        (``transfer_wait_s``), when it admits with a LOCAL prefill —
+        tokens are identical either way. ``prefill_only`` admits a
+        prompt-pass-only request that exports its page chain
+        (:meth:`submit_prefill` is the public spelling)."""
         from tpuflow.packaging.lm import _bucket_len
 
+        if (await_transfer or prefill_only) and self.kv_spec is None:
+            raise ValueError(
+                "await_transfer/prefill_only require kv='paged' — KV "
+                "pages are the wire format")
+        if (await_transfer or prefill_only) and self.speculate_k:
+            raise ValueError(
+                "await_transfer/prefill_only do not combine with "
+                "speculate_k (no draft-side wire harvest)")
         ids = self._encode(prompt)
         if max_new_tokens is None:
             max_new_tokens = self.max_new_cap
@@ -485,7 +555,20 @@ class ServeScheduler:
             deadline_ts=None if deadline_s is None else now + deadline_s,
             stream_cb=stream_cb,
             speculate=bool(speculate),
+            prefill_only=bool(prefill_only),
+            await_transfer=await_transfer,
         )
+        if await_transfer is not None:
+            # placeholder so an unknown id reads as PENDING (the offer
+            # may still be in flight over the wire) — bounded by the
+            # transfer_wait_s fallback, never a hang
+            with self._lock:
+                self._transfers.setdefault(str(await_transfer), {
+                    "offered": 0, "processed": 0, "pages": 0,
+                    "done": False, "failed": None, "last_offered": False,
+                    "ts": now,
+                })
+                self._prune_transfers_locked()
         req.ts_arrival = now
         req.bucket = bucket
         # request-lifecycle spans, TRACE ID = REQUEST ID — so the
@@ -589,6 +672,262 @@ class ServeScheduler:
         self.metrics.event(req.id, "cancel_requested")
         return True
 
+    # ---- prefill/decode disaggregation (ISSUE 14) -------------------
+    def submit_prefill(
+        self,
+        prompt,
+        *,
+        deadline_s: Optional[float] = None,
+        stream_cb: Optional[Callable] = None,
+        request_id: Optional[str] = None,
+    ) -> Request:
+        """Queue a PREFILL-ONLY request: the scheduler admits it like
+        any other (prefix-cache match, atomic / chunked / ring prompt
+        pass — all three compose), then instead of decoding it exports
+        the full prompt page chain to the wire format
+        (``request.export``, see ``serve/pages.py``) and finalizes
+        DONE with zero tokens. The exported chain is what a decode
+        replica lands via :meth:`offer_chain`; the prefill replica's
+        own prefix tree keeps the pages too, so repeated prefixes
+        export without recomputing. Raises the :meth:`submit`
+        taxonomy."""
+        return self.submit(
+            prompt, 1, deadline_s=deadline_s, stream_cb=stream_cb,
+            request_id=request_id, speculate=False, prefill_only=True,
+        )
+
+    #: retained transfer records (a server must not grow without
+    #: limit): beyond this, the oldest COMPLETED/FAILED entries are
+    #: pruned — pending transfers are never dropped
+    _TRANSFER_KEEP = 1024
+
+    def _prune_transfers_locked(self) -> None:
+        if len(self._transfers) <= self._TRANSFER_KEEP:
+            return
+        excess = len(self._transfers) - self._TRANSFER_KEEP
+        drop = []
+        for tid, st in self._transfers.items():
+            if st["done"] or st["failed"]:
+                drop.append(tid)
+                if len(drop) >= excess:
+                    break
+        for tid in drop:
+            del self._transfers[tid]
+
+    def offer_chain(self, wire, *, transfer_id: Optional[str] = None,
+                    last: bool = True) -> str:
+        """Queue one page-chain wire (or :func:`split_chain` chunk)
+        for import at the next scheduler boundary — callable from any
+        thread; the device scatter stays on the scheduler thread.
+        Chunks sharing a ``transfer_id`` land in offer order,
+        interleaved with decode segments (the transfer-overlap half:
+        a long chain streams in while other rows keep decoding);
+        ``last=True`` marks the transfer complete once every offered
+        chunk landed, unblocking a request submitted with
+        ``await_transfer=`` that id. A verify failure (CRC, header,
+        gap, dry allocator) marks the transfer FAILED — the waiting
+        request falls back to a local prefill, never a truncated
+        stream. Returns the transfer id."""
+        if self.kv_spec is None:
+            raise ValueError(
+                "offer_chain requires kv='paged' — KV pages are the "
+                "wire format")
+        if self.speculate_k:
+            raise ValueError(
+                "offer_chain does not combine with speculate_k — the "
+                "draft store has no wire harvest")
+        now = self.clock()
+        with self._lock:
+            if transfer_id is None:
+                self._transfer_seq += 1
+                transfer_id = f"tx-{self._transfer_seq}"
+            tid = str(transfer_id)
+            st = self._transfers.setdefault(tid, {
+                "offered": 0, "processed": 0, "pages": 0,
+                "done": False, "failed": None, "last_offered": False,
+                "ts": now,
+            })
+            if st["done"]:
+                raise ValueError(f"transfer {tid} already completed")
+            st["offered"] += 1
+            if last:
+                st["last_offered"] = True
+            self._chain_inbox.append((tid, wire))
+            self._prune_transfers_locked()
+            self._work.notify_all()
+        return tid
+
+    def fail_transfer(self, transfer_id: str,
+                      reason: str = "transfer failed") -> None:
+        """Mark an inbound transfer FAILED from outside (the router's
+        hook when the PREFILL side broke — rejected, dead replica,
+        empty chain): a request submitted with ``await_transfer=`` on
+        that id admits at its next boundary with a LOCAL prefill
+        instead of waiting out ``transfer_wait_s``. Idempotent; a
+        no-op on transfers that already completed."""
+        with self._lock:
+            st = self._transfers.setdefault(str(transfer_id), {
+                "offered": 0, "processed": 0, "pages": 0,
+                "done": False, "failed": None, "last_offered": False,
+                "ts": self.clock(),
+            })
+            if st["done"] or st["failed"]:
+                return
+            st["failed"] = str(reason)
+            self._work.notify_all()
+        self.metrics.on_kv_transfer_failure(str(transfer_id),
+                                            str(reason), kind="abort")
+
+    def _drain_chain_inbox(self) -> bool:
+        """Land every queued transfer chunk (scheduler thread, one
+        boundary): CRC-verify → allocate → donated scatter → publish.
+        A failed chunk fails its whole transfer (later chunks of a
+        failed transfer are dropped unlanded — they would only raise
+        the same gap error)."""
+        from tpuflow.serve.pages import PageWireError, wire_bytes
+
+        progress = False
+        while True:
+            with self._lock:
+                if not self._chain_inbox:
+                    break
+                tid, wire = self._chain_inbox.popleft()
+                st = self._transfers[tid]
+            progress = True
+            nbytes = wire_bytes(wire)
+            if st["failed"]:
+                with self._lock:
+                    st["processed"] += 1
+                continue
+            kvs = self._ensure_kv()
+            t0 = self.clock()
+            try:
+                landed = kvs.import_chain(wire)
+            except PageWireError as e:
+                with self._lock:
+                    st["processed"] += 1
+                    st["failed"] = str(e)
+                self.metrics.on_kv_transfer_failure(tid, str(e))
+                continue
+            ms = (self.clock() - t0) * 1e3
+            with self._lock:
+                st["processed"] += 1
+                st["pages"] += landed
+                if (st["last_offered"]
+                        and st["processed"] >= st["offered"]):
+                    st["done"] = True
+            self.metrics.on_kv_import(tid, landed, nbytes, ms)
+        return progress
+
+    def _transfer_blocked(self, req: Request, now: float) -> bool:
+        """Whether an ``await_transfer`` request must stay queued:
+        True only while its transfer is genuinely pending AND young —
+        completed, failed and timed-out transfers all release the
+        request to (local-prefill) admission."""
+        tid = req.await_transfer
+        if tid is None or self.kv_spec is None:
+            return False
+        # NOTE called from the admission loop, which already holds
+        # self._lock (non-reentrant) — the reads here are plain dict /
+        # scalar reads, safe against offer_chain's locked writes
+        st = self._transfers.get(str(tid))
+        if st is None:
+            st = self._transfers.setdefault(str(tid), {
+                "offered": 0, "processed": 0, "pages": 0,
+                "done": False, "failed": None,
+                "last_offered": False, "ts": req.ts_arrival})
+        if st["done"] or st["failed"]:
+            return False
+        if now - min(st["ts"], req.ts_arrival) > self.transfer_wait_s:
+            st["failed"] = "transfer timeout"
+            self.metrics.on_kv_transfer_failure(
+                str(tid), "transfer timeout", kind="timeout")
+            return False
+        return True
+
+    def _complete_prefill(self, pool, slot: int, req: Request) -> None:
+        """A prefill-only row finished its prompt pass: export the
+        full-page chain to the wire format, free the slot (the prefix
+        tree keeps its own page references — the export survives the
+        evict on the exporter too), finalize DONE."""
+        plan = pool.plans[slot]
+        kvs = self.kv_state
+        ps = kvs.spec.page_size
+        n_full = 0 if plan is None else int(plan.n_full)
+        t0 = self.clock()
+        err = None
+        try:
+            wire = kvs.export_chain(
+                req.effective_prompt()[: n_full * ps],
+                [] if plan is None else plan.table[:n_full])
+        except Exception as e:  # defensive: an export must never
+            # kill the decode loop
+            wire, err = None, f"{type(e).__name__}: {e}"
+        ms = (self.clock() - t0) * 1e3
+        pool.evict(slot)
+        if wire is None:
+            self._finalize(req, RequestState.CANCELLED,
+                           f"prefill export failed: {err}")
+            return
+        from tpuflow.serve.pages import wire_bytes
+
+        req.export = wire
+        self.metrics.on_kv_export(req, n_full, wire_bytes(wire), ms)
+        if req.ts_first_token is None:
+            # the prompt pass IS this request's product: stamp TTFT at
+            # export so prefill-class latency is observable
+            req.ts_first_token = self.clock()
+            self.metrics.on_first_token(req)
+            trace.end(getattr(req, "_span_ttft", None))
+        self._finalize(req, RequestState.DONE)
+        self._stream(req, [], True)
+
+    def _ensure_kv(self) -> PagedKV:
+        """The scheduler-wide page universe, built on first need —
+        pool construction and chain import share it."""
+        if self.kv_state is None:
+            self.kv_state = PagedKV(
+                self.model, self.kv_spec,
+                prefix_cache=self.kv_prefix_cache,
+                clock=self.clock,
+                draft_model=(self.draft_model
+                             if self.speculate_k else None),
+            )
+        return self.kv_state
+
+    # ---- health (per-replica isolation, ISSUE 14 satellite) ---------
+    @property
+    def watchdog(self):
+        """THIS scheduler's trip surface: the injected per-replica
+        watchdog when one was given, else the process default."""
+        return (self._watchdog if self._watchdog is not None
+                else _health.default_watchdog())
+
+    def health(self) -> Dict[str, Any]:
+        """Failover input (the replica shim's contract): ``failed`` =
+        watchdog-tripped, or closed WITHOUT a drain (a draining
+        replica serves its own backlog — resubmitting it elsewhere
+        would double-serve), or a launched loop thread that DIED.
+        With an injected per-replica ``watchdog`` this is genuinely
+        per-replica (one in-process replica's trip no longer fails the
+        whole tier — the PR 8 note, closed); without one, in-process
+        replicas share the process default and a trip fails them over
+        together (out-of-process replicas are isolated by their
+        process boundary)."""
+        r = self.readiness()
+        wd = r.get("watchdog") or {}
+        tripped = bool(wd.get("tripped"))
+        closed = bool(r.get("closed"))
+        draining = bool(r.get("draining"))
+        dead_loop = bool(r.get("wedged_loop"))
+        return {
+            "failed": tripped or (closed and not draining) or dead_loop,
+            "tripped": tripped,
+            "closed": closed,
+            "draining": draining,
+            "ready": bool(r.get("ready")),
+        }
+
     # ---- lifecycle internals (scheduler thread) ---------------------
     def _finalize(self, req: Request, state: RequestState,
                   error: Optional[str] = None) -> None:
@@ -685,18 +1024,12 @@ class ServeScheduler:
             # because cancel()/idle()/metrics_snapshot() iterate this
             # dict from HTTP handler threads
             if self.kv_spec is not None:
-                if self.kv_state is None:
-                    # ONE page store + allocator + prefix tree for the
-                    # whole scheduler — every bucket's pool shares it
-                    # (and, when speculating, ONE draft store indexed
-                    # by the same page tables)
-                    self.kv_state = PagedKV(
-                        self.model, self.kv_spec,
-                        prefix_cache=self.kv_prefix_cache,
-                        clock=self.clock,
-                        draft_model=(self.draft_model
-                                     if self.speculate_k else None),
-                    )
+                # ONE page store + allocator + prefix tree for the
+                # whole scheduler — every bucket's pool shares it
+                # (and, when speculating, ONE draft store indexed by
+                # the same page tables); chain imports may have built
+                # it before any pool existed
+                self._ensure_kv()
                 pool = PagedSlotPool(
                     self.model, self.params, self.kv_state, bucket,
                     self.slots, self.max_new_cap, seg=self.seg,
@@ -742,6 +1075,12 @@ class ServeScheduler:
         any progress was made (False = idle)."""
         now = self.clock()
         progress = False
+        if self.kv_spec is not None and self._chain_inbox:
+            # land inbound page-chain chunks FIRST (ISSUE 14): a
+            # request awaiting its transfer admits the same boundary
+            # the last chunk lands, and chunks interleave with the
+            # segments below while their request is still queued
+            progress |= self._drain_chain_inbox()
         with self._lock:
             buckets = set(self._queues) | set(self.pools)
             # deadline expiry MID-QUEUE (before any slot is spent on it)
@@ -790,6 +1129,12 @@ class ServeScheduler:
                 # admit: freed slots take the queue head(s), FIFO
                 free = pool.free_slots()
                 while free and q and pool.can_admit(q[0].max_new_tokens):
+                    if self._transfer_blocked(q[0], now):
+                        # the head's inbound page chain is still
+                        # streaming: hold it (its admission will hit
+                        # the imported prefix) — bounded by the
+                        # transfer_wait_s local-prefill fallback
+                        break
                     if self.kv_state is not None:
                         # paged admission asks the ALLOCATOR, not the
                         # pool: out of pages → the head stays QUEUED
@@ -870,6 +1215,13 @@ class ServeScheduler:
                     trace.end(getattr(req, "_span_queue", None),
                               slot=_slot)
                 progress = True
+                # prefill-only rows (ISSUE 14) are complete the moment
+                # their prompt pass lands: export + free the slot
+                # BEFORE any segment runs (chunked ones complete below
+                # at their final chunk instead)
+                for adm in admits + ring_admits:
+                    if len(adm) == 3 and adm[1].prefill_only:
+                        self._complete_prefill(pool, adm[0], adm[1])
             if (self.prefill_budget_tokens is not None
                     and isinstance(pool, PagedSlotPool)
                     and pool.prefilling.any()):
@@ -881,6 +1233,12 @@ class ServeScheduler:
                 if adv is not None:
                     _slot_pf, n_pf, done_pf = adv
                     self.metrics.on_prefill_chunk(b, n_pf, done_pf)
+                    if done_pf:
+                        req_pf = pool.occupants[_slot_pf]
+                        if (req_pf is not None
+                                and req_pf.prefill_only):
+                            self._complete_prefill(pool, _slot_pf,
+                                                   req_pf)
                     progress = True
             if pool.decode_live() and self.kv_state is not None:
                 # incremental allocation (ISSUE 11): cover every live
@@ -988,6 +1346,16 @@ class ServeScheduler:
                     # error, and keep serving later arrivals
                     self.metrics.event("-scheduler-", "step_error",
                                        error=repr(e))
+                    if self._watchdog is not None:
+                        # flight isolation (ISSUE 14): a DEDICATED
+                        # watchdog latches the fault so health() fails
+                        # THIS replica over — the process default is
+                        # deliberately not tripped here (the legacy
+                        # single-scheduler contract: keep serving
+                        # later arrivals)
+                        self._watchdog.trip(
+                            f"{self.metrics.prefix}: scheduler step "
+                            f"failed: {type(e).__name__}: {e}")
                     self._fail_outstanding(f"scheduler step failed: "
                                            f"{type(e).__name__}: {e}")
                     progress = False
@@ -1074,6 +1442,12 @@ class ServeScheduler:
             "max_queue": self.max_queue,
             "closed": closed,
             "draining": draining,
+            # disaggregation sensors (ISSUE 14): the router's
+            # two-phase placement reads the class; transfer volume
+            # rides for dashboards/external LBs
+            "replica_class": self.replica_class,
+            "kv_transfer_pages": self.metrics.kv_transfer_pages,
+            "kv_transfer_bytes": self.metrics.kv_transfer_bytes,
         }
         if self.kv_state is not None:
             a = self.kv_state.allocator
@@ -1085,7 +1459,8 @@ class ServeScheduler:
         pfx = self.metrics.prefix
         hists = (("ttft_ms", self.metrics.ttft_ms),
                  ("queue_wait_ms", self.metrics.queue_wait_ms),
-                 ("itl_ms", self.metrics.itl_ms))
+                 ("itl_ms", self.metrics.itl_ms),
+                 ("kv_transfer_ms", self.metrics.kv_transfer_ms))
         # cold sensor (no traffic yet): the percentile keys are None
         # without paying the windowed-delta walk — this path runs once
         # per replica per ROUTED REQUEST, so the empty case must be a
@@ -1165,7 +1540,9 @@ class ServeScheduler:
         running = sum(p.live_count() for p in pools)
         seg_age = _health.heartbeat_age(f"{pfx}.segment", now=t)
         loop_age = _health.heartbeat_age(f"{pfx}.loop", now=t)
-        wd = _health.default_watchdog()
+        # per-replica isolation (ISSUE 14 satellite): an injected
+        # watchdog scopes the trip signal to THIS scheduler
+        wd = self.watchdog
         threaded = self._thread is not None and self._thread.is_alive()
         # progress signal while work is pending: the FRESHEST of the
         # last segment and the loop heartbeat. The loop beats between
@@ -1219,10 +1596,24 @@ class ServeScheduler:
             pools = list(self.pools.items())
         out = []
         for req in queued:
-            out.append({"id": req.id, "state": "queued",
-                        "bucket": req.bucket,
-                        "prompt_tokens": int(req.prompt_ids.size),
-                        "n_tokens": len(req.tokens)})
+            rec = {"id": req.id, "state": "queued",
+                   "bucket": req.bucket,
+                   "prompt_tokens": int(req.prompt_ids.size),
+                   "n_tokens": len(req.tokens)}
+            if req.prefill_only:
+                rec["prefill_only"] = True
+            if req.await_transfer is not None:
+                # transfer state (ISSUE 14): a post-mortem must tell a
+                # request waiting on its inbound page chain from one
+                # waiting on capacity
+                tid = str(req.await_transfer)
+                st = self._transfers.get(tid)
+                rec["await_transfer"] = tid
+                rec["transfer"] = (
+                    "pending" if st is None
+                    else "failed" if st.get("failed")
+                    else "landed" if st.get("done") else "pending")
+            out.append(rec)
         for b, pool in pools:
             for slot, req in enumerate(pool.occupants):
                 if req is not None:
