@@ -1264,6 +1264,19 @@ def main() -> int:
                         "count (>=1.5x 1p2d vs 1p1d) while p95 TTFT "
                         "does not regress vs symmetric; writes "
                         "BENCH_*_serve_disagg.json")
+    p.add_argument("--serve-deploy", action="store_true",
+                   help="zero-downtime deployment A/B (ISSUE 15): "
+                        "the same open-loop trace served by a "
+                        "2-active+1-standby tier twice — steady "
+                        "state vs with a live weight push "
+                        "(blue/green rollout: swap standby, replay "
+                        "hot prefixes, drain+recycle both actives) "
+                        "landing mid-trace, real swap/replay costs "
+                        "billed on per-replica virtual clocks — "
+                        "during-swap p95 TTFT must stay <=1.25x "
+                        "steady-state with ZERO truncated streams "
+                        "and zero tier-level 5xx; writes "
+                        "BENCH_*_deploy.json")
     p.add_argument("--serve-longctx", action="store_true",
                    help="long-context serving A/B (ISSUE 13): a "
                         "steady short-request trace with ONE long "
@@ -1344,6 +1357,7 @@ def main() -> int:
              else "faults" if args.faults
              else "serve_router" if args.serve_router
              else "serve_disagg" if args.serve_disagg
+             else "serve_deploy" if args.serve_deploy
              else "serve_longctx" if args.serve_longctx
              else "serve_paged" if args.serve_paged
              else "serve" if args.serve
@@ -1456,6 +1470,8 @@ def _bench(args) -> int:
         return _bench_serve_router(args, devices)
     if args.serve_disagg:
         return _bench_serve_disagg(args, devices)
+    if args.serve_deploy:
+        return _bench_serve_deploy(args, devices)
     if args.serve_longctx:
         return _bench_serve_longctx(args, devices)
     if args.serve_paged:
@@ -4637,6 +4653,394 @@ def _bench_serve_disagg(args, devices) -> int:
     )
     emit(scaling, scaling, diagnostics=diag,
          metric="serve_disagg_decode_tok_s_scaling", unit="x")
+    return 0
+
+
+def _bench_serve_deploy(args, devices) -> int:
+    """--serve-deploy: the ISSUE 15 record — a live weight push
+    through the router under load vs the same trace at steady state:
+
+    - a 2-active + 1-standby tier of real paged ServeSchedulers on
+      per-replica virtual clocks (the --serve-router cost-table
+      drive: measured seg/join walls billed per boundary), serving a
+      decode-heavy open-loop trace with a shared hot prefix (so the
+      rollout's hot-head replay has something to warm);
+    - the SAME trace runs twice: a steady-state control, and a run
+      where a new sharded checkpoint publishes mid-trace and the
+      DeploymentManager blue/greens it through the tier (swap standby
+      — real assemble+place wall billed on its clock — replay hot
+      heads, activate, drain + recycle BOTH actives in turn);
+    - acceptance (ISSUE 15): ZERO truncated streams (every request
+      completes with its full budget), zero tier-level 5xx beyond
+      the drain's internal routing (the router absorbs per-replica
+      503s), and during-swap p95 TTFT ≤ 1.25× steady-state — the
+      price of a model push is a bounded latency ripple, not an
+      outage.
+
+    ``value`` = during-swap p95 TTFT / steady-state p95 TTFT (of the
+    same arrival window)."""
+    import tempfile
+
+    import numpy as np
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.ckpt.sharded import save_sharded_checkpoint
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.serve.deploy import DeploymentManager
+    from tpuflow.serve.metrics import ServeMetrics, percentiles
+    from tpuflow.serve.replica import InProcessReplica
+    from tpuflow.serve.request import QueueFull, SchedulerClosed
+    from tpuflow.serve.router import Router
+    from tpuflow.serve.scheduler import ServeScheduler
+
+    if args.smoke:
+        dim, depth, heads, vocab = 256, 4, 4, 1024
+        n_req, cap = args.serve_requests or 48, 24
+        arrival = 0.004
+    else:
+        dim, depth, heads, vocab = 512, 6, 8, 32000
+        n_req, cap = args.serve_requests or 96, 24
+        arrival = 0.002
+    slots, seg, ps = args.batch or 4, 4, 8
+    kv_pages = 1 + 128  # per replica
+    sampling = dict(temperature=0.8, top_k=40, seed=0)
+    model = build_transformer_lm(
+        vocab_size=vocab, dim=dim, depth=depth, heads=heads,
+        attn_impl="einsum", kv_heads=args.kv_heads,
+    )
+    p_v1 = nn.unbox(
+        model.init({"params": jax.random.key(0)},
+                   jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    p_v2 = nn.unbox(
+        model.init({"params": jax.random.key(1)},
+                   jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    ckpt_dir = tempfile.mkdtemp(prefix="tpuflow_deploy_bench_")
+    m_v2 = save_sharded_checkpoint(ckpt_dir, {"params": p_v2}, 2)
+
+    # decode-heavy open-loop trace with a SHARED HOT PREFIX on 1-in-3
+    # requests (what the rollout's hot-head replay warms)
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(scale=arrival, size=n_req))
+    hot_prefix = rng.integers(1, vocab, (2 * ps,)).astype(np.int32)
+    work, prompts = [], []
+    for i, a in enumerate(arrivals):
+        if i % 3 == 0:
+            tail = rng.integers(1, vocab, (int(rng.integers(2, 6)),))
+            prompt = np.concatenate([hot_prefix,
+                                     tail.astype(np.int32)])
+        else:
+            prompt = rng.integers(
+                1, vocab, (int(rng.integers(3, 9)),)).astype(np.int32)
+        work.append((float(a), len(prompt), cap))
+        prompts.append(prompt)
+    # the swap lands mid-trace: after the first half has arrived
+    t_push = float(arrivals[n_req // 2])
+
+    def bucket_of(plen: int) -> int:
+        from tpuflow.packaging.lm import _bucket_len
+
+        return _bucket_len(plen)
+
+    all_buckets = sorted({bucket_of(len(p)) for p in prompts})
+
+    paged_cost = {"seg": {}, "join": {}, "copy": 0.0}
+
+    def _measure() -> None:
+        from tpuflow.infer.generate import paged_copy
+        from tpuflow.serve.pages import PagedKV, PagedKVSpec
+        from tpuflow.serve.request import Request
+        from tpuflow.serve.slots import PagedSlotPool
+
+        s = sampling
+        ops: dict = {}
+        kv = PagedKV(model, PagedKVSpec(pages=kv_pages, page_size=ps),
+                     prefix_cache=False)
+        for b in all_buckets:
+            ppool = PagedSlotPool(
+                model, p_v1, kv, b, slots, cap, seg=seg,
+                temperature=s["temperature"], top_k=s["top_k"],
+                seed=s["seed"])
+            ppool.warm()
+
+            def _pseg(pool=ppool):
+                pool.run_segment()
+
+            ops[("pseg", b)] = _pseg
+            for w in ppool._widths:
+                def _pjoin(pool=ppool, w=w):
+                    plan = kv.plan(np.ones(w, np.int32), 1)
+                    pool.join([(0, Request(
+                        prompt_ids=np.ones(w, np.int32),
+                        max_new_tokens=1), plan)])
+                    pool.evict(0)
+                    jax.block_until_ready((kv.cache, pool.out))
+
+                ops[("pjoin", b, w)] = _pjoin
+
+        def _copy():
+            kv.cache = paged_copy(kv.cache, [0], [0])
+            jax.block_until_ready(jax.tree.leaves(kv.cache)[0])
+
+        ops[("copy",)] = _copy
+        best = {name: float("inf") for name in ops}
+        for _ in range(6):  # interleaved min-of-k (see --serve notes)
+            for name, fn in ops.items():
+                t0 = time.perf_counter()
+                fn()
+                best[name] = min(best[name],
+                                 time.perf_counter() - t0)
+        for key, v in best.items():
+            if key[0] == "pseg":
+                paged_cost["seg"][key[1]] = v
+            elif key[0] == "pjoin":
+                paged_cost["join"][(key[1], key[2])] = v
+            else:
+                paged_cost["copy"] = v
+        for b in all_buckets:  # width-monotone cleanup (PR 6 lesson)
+            ws = sorted(w for (bb, w) in paged_cost["join"] if bb == b)
+            floor = float("inf")
+            for w in reversed(ws):
+                floor = min(floor, paged_cost["join"][(b, w)])
+                paged_cost["join"][(b, w)] = floor
+
+    def run(push: bool) -> dict:
+        n_rep = 3  # 2 active + 1 standby
+        clocks = [_VClock() for _ in range(n_rep)]
+        reps = []
+        for r in range(n_rep):
+            sched = ServeScheduler(
+                model, p_v1, slots=slots, seg=seg, max_new_cap=cap,
+                max_queue=len(work), clock=clocks[r], kv="paged",
+                kv_page_size=ps, kv_pages=kv_pages,
+                kv_prefix_insert_generated=False,  # r08-comparable
+                model_version={"step": 1, "digest": "seed",
+                               "label": "step1-seed"},
+                metrics=ServeMetrics(gauge_prefix=f"serve.replica{r}"),
+                **sampling,
+            )
+            sched.prepare(*all_buckets)
+            for b, pool in sched.pools.items():
+                def _wrap(pool=pool, b=b, vc=clocks[r]):
+                    oseg, ojoin = pool.run_segment, pool.join
+
+                    def rs():
+                        vc.now += paged_cost["seg"][b]
+                        return oseg()
+
+                    def jn(admits):
+                        need = max([pl.width
+                                    for _s, _r, pl in admits] + [1])
+                        w = next(wd for wd in pool._widths
+                                 if wd >= need)
+                        vc.now += paged_cost["join"][(b, w)]
+                        vc.now += paged_cost["copy"] * sum(
+                            len(pl.forks) for _s, _r, pl in admits)
+                        return ojoin(admits)
+
+                    pool.run_segment, pool.join = rs, jn
+                _wrap()
+            rep = InProcessReplica(sched, name=f"replica{r}")
+            # bill the REAL swap wall (assemble + place + prefix
+            # clear) on the standby's clock — the honest off-path
+            # cost of a restore
+            oswap = rep.swap_from_manifest
+
+            def _swap(mpath, draft=False, __o=oswap, vc=clocks[r]):
+                t0 = time.perf_counter()
+                out = __o(mpath, draft=draft)
+                vc.now += time.perf_counter() - t0
+                return out
+
+            rep.swap_from_manifest = _swap
+            reps.append(rep)
+        router = Router(reps, standby=(2,),
+                        clock=lambda: min(c.now for c in clocks))
+        mgr = DeploymentManager(router, replay_hot=4,
+                                clock=router.clock)
+        rrs, i = [], 0
+        pushed = False
+        shed_5xx = 0
+        n_work = len(work)
+        push_window = [None, None]
+        guard = 0
+        while i < n_work or not router.idle() or mgr.active:
+            guard += 1
+            assert guard < 500_000, "deploy bench drive wedged"
+            now = min(c.now for c in clocks)
+            if push and not pushed and now >= t_push:
+                pushed = True
+                push_window[0] = now
+                mgr.begin(m_v2, online=False)
+            if mgr.active:
+                mgr.tick()
+            elif push and pushed and push_window[1] is None:
+                push_window[1] = min(c.now for c in clocks)
+            busy = [r for r in range(len(reps))
+                    if not reps[r].idle()]
+            if busy:
+                t = min(clocks[r].now for r in busy)
+            else:
+                router.maintain()
+                if i >= n_work:
+                    if router.idle() and not mgr.active:
+                        break
+                    # rollout still draining an idle tier: advance
+                    # every clock so drain timeouts can elapse
+                    for c in clocks:
+                        c.now += 1e-3
+                    continue
+                t = work[i][0]
+                if push and not pushed and t_push > now:
+                    # don't jump an idle tier past the push point:
+                    # the rollout lands at its scheduled time even in
+                    # an arrival gap
+                    t = min(t, t_push)
+                for c in clocks:
+                    c.now = max(c.now, t)
+            while i < n_work and work[i][0] <= t:
+                for q in range(len(reps)):
+                    if reps[q].idle():
+                        clocks[q].now = max(clocks[q].now, work[i][0])
+                try:
+                    rr = router.submit(prompts[i],
+                                       max_new_tokens=work[i][2])
+                except (QueueFull, SchedulerClosed):
+                    shed_5xx += 1
+                    i += 1
+                    continue
+                rr.ts_arrival = work[i][0]
+                if rr.inner is not None:
+                    rr.inner.ts_arrival = work[i][0]
+                rrs.append(rr)
+                i += 1
+            busy = [r for r in range(len(reps))
+                    if not reps[r].idle()]
+            if not busy:
+                continue
+            r = min(busy, key=lambda q: clocks[q].now)
+            t_pre = clocks[r].now
+            moved = reps[r].step()
+            if not moved:
+                nxt = [clocks[q].now for q in busy if q != r]
+                if i < n_work:
+                    nxt.append(work[i][0])
+                clocks[r].now = max(
+                    clocks[r].now + 1e-6,
+                    min(nxt) if nxt else clocks[r].now + 1e-3)
+            elif clocks[r].now == t_pre:
+                clocks[r].now += 1e-6
+        if push and pushed and push_window[1] is None:
+            push_window[1] = min(c.now for c in clocks)
+        truncated = sum(
+            1 for rr in rrs
+            if rr.state.value != "done"
+            or len(rr.tokens) < rr.max_new_tokens)
+        ttft = [rr.timing()["ttft_ms"] for rr in rrs]
+
+        def _pctl(vals) -> dict:
+            return {k: round(v, 2)
+                    for k, v in percentiles(vals).items()}
+
+        out = {
+            "n_served": len(rrs),
+            "rejected_5xx": shed_5xx,
+            "truncated_streams": truncated,
+            "ttft_ms": _pctl(ttft),
+            "e2e_ms": _pctl([rr.timing()["e2e_ms"] for rr in rrs]),
+            "versions": router.versions(),
+            "router": dict(router.snapshot()),
+        }
+        if push:
+            out["push_window_s"] = [round(x, 4) for x in push_window]
+            w0, w1 = push_window
+            during = [rr.timing()["ttft_ms"] for rr in rrs
+                      if w0 <= rr.ts_arrival <= w1]
+            out["during_swap_ttft_ms"] = _pctl(during)
+            out["during_swap_n"] = len(during)
+            out["deploy"] = dict(mgr.history[-1]) if mgr.history else {}
+        out["window_ttft_ms"] = _pctl(
+            [rr.timing()["ttft_ms"] for rr in rrs
+             if rr.ts_arrival >= t_push])
+        return out
+
+    _progress({"phase": "serve_deploy_warmup"})
+    _measure()
+    _progress({"phase": "serve_deploy_costs", "costs_ms": {
+        "paged_seg": {b: round(v * 1e3, 2)
+                      for b, v in paged_cost["seg"].items()}}})
+    steady = run(push=False)
+    _progress({"phase": "serve_deploy_steady", "record": steady})
+    swap = run(push=True)
+    _progress({"phase": "serve_deploy_swap", "record": swap})
+
+    def _ratio(a, b):
+        return round(a / max(b, 1e-9), 3)
+
+    # during-swap p95 vs the steady control over the SAME arrival
+    # window (post-push tail) — arrival-pattern-matched, so the ratio
+    # isolates the rollout, not trace drift
+    during_p95 = swap["during_swap_ttft_ms"].get(
+        "p95", swap["ttft_ms"].get("p95", 0.0))
+    steady_p95 = steady["window_ttft_ms"].get(
+        "p95", steady["ttft_ms"].get("p95", 1e-9))
+    ratio = _ratio(during_p95, steady_p95)
+    diag = {
+        "device_kind": devices[0].device_kind,
+        "model": f"lm-d{dim}x{depth}h{heads}",
+        "workload": {"n_requests": n_req, "max_new_cap": cap,
+                     "arrival_scale_s": arrival, "seed": 0,
+                     "hot_prefix_tokens": int(2 * ps),
+                     "push_at_s": round(t_push, 4)},
+        "slots": slots, "seg": seg, "page_size": ps,
+        "kv_pages_per_replica": kv_pages,
+        "tier": "2 active + 1 standby (mixed)",
+        "cost_table_ms": {
+            "paged_seg": {str(b): round(v * 1e3, 2)
+                          for b, v in paged_cost["seg"].items()},
+            "paged_join": {f"{b}w{w}": round(v * 1e3, 2)
+                           for (b, w), v in
+                           paged_cost["join"].items()},
+        },
+        "steady": steady,
+        "swap": swap,
+        "during_swap_p95_ttft_ms": during_p95,
+        "steady_window_p95_ttft_ms": steady_p95,
+        "during_swap_p95_ttft_ratio": ratio,
+        "truncated_streams": swap["truncated_streams"],
+        "rejected_5xx": swap["rejected_5xx"],
+        "span_totals_ms": _span_totals(),
+    }
+    rec = {
+        "metric": "serve_deploy_swap_p95_ttft_ratio",
+        "value": ratio,
+        "unit": "x",
+        "vs_baseline": ratio,
+        "mode": "serve_deploy",
+        "smoke": bool(args.smoke),
+        "diagnostics": diag,
+    }
+    out_path = args.serve_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_LOCAL_r15_deploy.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(
+        f"# serve-deploy during-swap p95 TTFT x{ratio:.2f} "
+        f"({during_p95}ms vs steady {steady_p95}ms) | "
+        f"truncated={swap['truncated_streams']} "
+        f"5xx={swap['rejected_5xx']} "
+        f"deploy_ms={swap.get('deploy', {}).get('deploy_ms')} "
+        f"versions={sorted(set(swap['versions'].values()))} "
+        f"-> {out_path}",
+        file=sys.stderr, flush=True,
+    )
+    emit(ratio, ratio, diagnostics=diag,
+         metric="serve_deploy_swap_p95_ttft_ratio", unit="x")
     return 0
 
 

@@ -362,6 +362,45 @@ def test_smoke_serve_disagg_emits_schema(tmp_path):
 
 
 @pytest.mark.slow
+def test_smoke_serve_deploy_emits_schema(tmp_path):
+    """--serve-deploy: the ISSUE 15 record — a live weight push
+    (blue/green through the standby) landing mid-trace vs the same
+    trace at steady state. Acceptance axes: ZERO truncated streams,
+    zero tier-level 5xx, during-swap p95 TTFT <=1.25x steady-state,
+    and the tier ends fully on the pushed version."""
+    out = str(tmp_path / "BENCH_TEST_serve_deploy.json")
+    r = _run("--smoke", "--serve-deploy", "--serve-out", out,
+             timeout=1400)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "serve_deploy_swap_p95_ttft_ratio"
+    assert "error" not in rec
+    d = rec["diagnostics"]
+    # the acceptance criteria, verbatim from the issue
+    assert d["truncated_streams"] == 0
+    assert d["rejected_5xx"] == 0
+    assert rec["value"] <= 1.25, rec["value"]
+    # the push genuinely moved the whole active tier: both runs
+    # served every request, and the swap run's versions show the new
+    # label on the actives (the recycled standby keeps the old one)
+    steady, swap = d["steady"], d["swap"]
+    assert steady["n_served"] == swap["n_served"]
+    assert steady["truncated_streams"] == 0
+    new_labels = {v for v in swap["versions"].values()
+                  if v != "step1-seed"}
+    assert len(new_labels) == 1 and next(
+        iter(new_labels)).startswith("step2-")
+    dep = swap["deploy"]
+    assert dep["error"] is None
+    assert dep["activated"] and dep["recycled"]
+    assert dep["deploy_ms"] > 0
+    assert swap["during_swap_n"] > 0
+    with open(out) as f:
+        disk = json.load(f)
+    assert disk["mode"] == "serve_deploy"
+
+
+@pytest.mark.slow
 def test_smoke_serve_longctx_emits_schema(tmp_path):
     """--serve-longctx: the ISSUE 13 record — concurrent short-request
     p95 ITL flatness across the 8x long-prompt growth with chunking ON

@@ -117,7 +117,9 @@ def test_gqa_decode_cache_shrinks_and_generates():
            "from the unsharded run on jax 0.4.37 XLA:CPU — partitioner "
            "numerics, not a GQA bug (zero1-only parity at 1e-5 passes "
            "in test_zero.py); strict so a stack fix surfaces as XPASS. "
-           "Runnable repro: python tools/gspmd_cpu_tp_drift.py",
+           "Re-confirmed r15 (2026-08-04) on the same pins: 3.06% "
+           "drift, unchanged. Runnable repro: "
+           "python tools/gspmd_cpu_tp_drift.py",
 )
 def test_gqa_trains_under_tp_mesh():
     """GQA under GSPMD tensor parallelism: tp2 loss == single device

@@ -1,0 +1,848 @@
+"""Zero-downtime continuous deployment (ISSUE 15): ModelWatcher,
+live weight hot-swap, router blue/green rollout, version pinning.
+
+Tier discipline: the rollout state machine is PURE HOST POLICY, so it
+runs tier-1 against FAKE replicas (version-aware variants of
+test_serve_router.py's) with real on-disk manifests for the version
+digests; the watcher unit suite drives ``poll_once`` on tiny numpy
+checkpoints (no device at all). The real-scheduler swap pins ride ONE
+tiny shared model at the test_serve_paged.py pool geometry (slots=2,
+seg=4, cap=12, page_size=4, kv_pages=49) and the suite-shared sampled
+config so the compiled join/segment executables are process-wide LRU
+hits; the HTTP-loopback worker swap rides the slow tier.
+
+The load-bearing pins:
+
+- a swap is a buffer flip: same pools, outputs flip to the new
+  weights' oracle TOKEN-IDENTICALLY (greedy AND sampled), prefix
+  cache invalidated (a version bump invalidates cached KV);
+- config drift is refused LOUDLY (SwapMismatchError) with nothing
+  moved; busy replicas refuse to swap; drained replicas reopen;
+- the watcher fires once per verified new step (corrupt manifests and
+  partial sets are skipped, re-publish at the same step is
+  idempotent, a failing rollout is retried) and PINS the manifest so
+  retention can never delete a set mid-restore (the gc race, closed);
+- a weight push under a saturating trace truncates ZERO streams and
+  raises nothing beyond the drain-shaped placement the router already
+  handles; version-pinned requests are token-identical to a pure tier
+  of the pinned version (the A/B contract);
+- deploy observability: serve.deploys_total / deploy_failures_total /
+  deploy_ms + the serve.model_version gauge reach the registry and
+  the Prometheus exposition; flight notes carry the bounded deploy
+  history.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpuflow.serve.request import (
+    QueueFull,
+    Request,
+    RequestState,
+    SchedulerClosed,
+)
+
+
+def _save_np_ckpt(d, step, seed=0, shape=(4, 3)):
+    """Publish a tiny all-numpy sharded checkpoint (host-only: the
+    watcher/gc machinery never needs a model)."""
+    from tpuflow.ckpt.sharded import save_sharded_checkpoint
+
+    rng = np.random.default_rng(seed)
+    state = {"params": {"w": rng.normal(size=shape).astype(np.float32)}}
+    return save_sharded_checkpoint(str(d), state, int(step))
+
+
+# ---------------------------------------------------------------------
+# watcher units (injectable clocks, numpy checkpoints)
+# ---------------------------------------------------------------------
+
+def test_watcher_fires_once_per_step_and_is_idempotent(tmp_path):
+    from tpuflow.ckpt.sharded import latest_manifest
+    from tpuflow.serve.deploy import ModelWatcher
+
+    fired = []
+    w = ModelWatcher(str(tmp_path), lambda mp, v: fired.append((mp, v)))
+    assert w.poll_once() is None  # empty namespace
+    assert latest_manifest(str(tmp_path)) is None
+    m1 = _save_np_ckpt(tmp_path, 1, seed=1)
+    # the discovery primitive agrees with what the watcher deploys
+    assert latest_manifest(str(tmp_path)) == m1
+    assert w.poll_once() == m1
+    assert fired[-1][1]["step"] == 1
+    assert fired[-1][1]["label"].startswith("step1-")
+    # idempotent: same step never fires twice, even re-published
+    assert w.poll_once() is None
+    _save_np_ckpt(tmp_path, 1, seed=1)
+    assert w.poll_once() is None
+    # a NEWER step fires (and only the newest when several landed)
+    _save_np_ckpt(tmp_path, 2, seed=2)
+    m3 = _save_np_ckpt(tmp_path, 3, seed=3)
+    assert latest_manifest(str(tmp_path)) == m3
+    assert latest_manifest(str(tmp_path), min_step=3) is None
+    assert w.poll_once() == m3
+    assert len(fired) == 2 and w.fired == 2
+    # a republish at the DEPLOYED step with different bytes is a
+    # different digest but NOT a new step: still idempotent
+    _save_np_ckpt(tmp_path, 3, seed=99)
+    assert w.poll_once() is None
+
+
+def test_watcher_skips_corrupt_and_partial_sets(tmp_path):
+    from tpuflow.ckpt.sharded import latest_manifest
+    from tpuflow.serve.deploy import ModelWatcher
+
+    fired = []
+    w = ModelWatcher(str(tmp_path), lambda mp, v: fired.append(mp),
+                     bad_after=3)
+    m1 = _save_np_ckpt(tmp_path, 1, seed=1)
+    # corrupt the shard payload: verify_sharded fails, watcher skips
+    shard = next(str(tmp_path / f) for f in os.listdir(tmp_path)
+                 if "shard" in f)
+    good = open(shard, "rb").read()
+    with open(shard, "wb") as f:
+        f.write(b"\x00" + good[1:])
+    assert latest_manifest(str(tmp_path)) is None  # verify gate
+    assert w.poll_once() is None and not fired
+    assert w.skipped_invalid == 1
+    # a PARTIAL set (manifest published, shard missing — a copy in
+    # flight) is skipped the same way, not an error
+    os.unlink(shard)
+    assert w.poll_once() is None and w.skipped_invalid == 2
+    # the set heals (copy finished): fires on the next poll
+    with open(shard, "wb") as f:
+        f.write(good)
+    assert w.poll_once() == m1 and fired == [m1]
+    # a persistently bad newer step blacklists after bad_after polls
+    # and stops being re-verified
+    _save_np_ckpt(tmp_path, 2, seed=2)
+    shard2 = next(str(tmp_path / f) for f in os.listdir(tmp_path)
+                  if "step-2.shard" in f)
+    with open(shard2, "ab") as f:
+        f.write(b"junk")
+    for _ in range(3):
+        assert w.poll_once() is None
+    stuck = w.skipped_invalid
+    assert w.poll_once() is None
+    assert w.skipped_invalid == stuck  # blacklisted: no re-verify
+
+
+def test_watcher_callback_failure_is_retried_then_blacklisted(tmp_path):
+    from tpuflow.serve.deploy import ModelWatcher
+
+    calls = []
+
+    def flaky(mp, v):
+        calls.append(mp)
+        if len(calls) == 1:
+            raise RuntimeError("standby died mid-swap")
+
+    w = ModelWatcher(str(tmp_path), flaky)
+    m1 = _save_np_ckpt(tmp_path, 1, seed=1)
+    assert w.poll_once() is None  # failed: step NOT advanced
+    assert w.deployed_step == -1
+    assert w.poll_once() == m1  # retried and succeeded
+    assert len(calls) == 2 and w.deployed_step == 1
+    # a PERSISTENTLY failing rollout gives up after bad_after
+    # attempts — but only for MANIFEST-shaped failures (config
+    # drift); tier-side errors like the RuntimeError above retry
+    # forever and never blacklist
+    from tpuflow.serve.deploy import SwapMismatchError
+
+    def drift(mp, v):
+        raise SwapMismatchError("config drift")
+
+    w2 = ModelWatcher(str(tmp_path), drift, bad_after=2)
+    for _ in range(2):
+        assert w2.poll_once() is None
+    n_fails = dict(w2._step_fails)
+    assert w2.poll_once() is None  # blacklisted: callback not retried
+    assert w2._step_fails == n_fails and 1 in w2._bad_steps
+    # ...but a blacklist is not a death sentence: a RE-PUBLISHED set
+    # (changed fingerprint — e.g. the stalled publisher finished, or
+    # a fixed-config checkpoint landed at the same step) is retried
+    w2.on_manifest = lambda mp, v: None
+    _save_np_ckpt(tmp_path, 1, seed=42)
+    assert w2.poll_once() is not None
+    assert w2.deployed_step == 1 and 1 not in w2._bad_steps
+
+
+def test_gc_never_deletes_pinned_manifest(tmp_path):
+    """The gc-vs-watcher race (ISSUE 15 satellite): retention must
+    not delete a set the watcher is mid-restore on — the pin holds it
+    through any keep_last ranking; unpin releases it."""
+    from tpuflow.ckpt.checkpoint import (
+        gc_checkpoints,
+        pin_checkpoint,
+        pinned_checkpoints,
+        unpin_checkpoint,
+    )
+    from tpuflow.ckpt.sharded import sharded_set_files
+    from tpuflow.serve.deploy import ModelWatcher
+
+    m1 = _save_np_ckpt(tmp_path, 1, seed=1)
+    pin_checkpoint(m1)
+    try:
+        _save_np_ckpt(tmp_path, 2, seed=2)
+        removed = gc_checkpoints(str(tmp_path), keep_last=1)
+        assert all(os.path.exists(f) for f in sharded_set_files(m1)), (
+            removed)
+    finally:
+        unpin_checkpoint(m1)
+    removed = gc_checkpoints(str(tmp_path), keep_last=1)
+    assert not os.path.exists(m1) and any("step-1" in f
+                                          for f in removed)
+    # and the watcher holds the pin for the WHOLE callback (verify →
+    # restore window), releasing it on every path
+    seen = []
+    w = ModelWatcher(str(tmp_path), lambda mp, v: seen.append(
+        list(pinned_checkpoints())))
+    m3 = _save_np_ckpt(tmp_path, 3, seed=3)
+    assert w.poll_once() == m3
+    assert any(os.path.abspath(m3) in pins for pins in seen)
+    assert os.path.abspath(m3) not in pinned_checkpoints()
+    # CROSS-PROCESS: a pin is also a sidecar file, so retention run
+    # by ANOTHER process (empty in-memory pin set) still skips the
+    # set while the holder lives — and collects the sidecar of a
+    # DEAD holder instead of blocking retention forever
+    import json as _json
+
+    m4 = _save_np_ckpt(tmp_path, 4, seed=4)
+    pin_checkpoint(m3)
+    try:
+        assert os.path.exists(m3 + f".pin-{os.getpid()}")
+        from tpuflow.ckpt import checkpoint as _ck
+
+        with _ck._PIN_LOCK:  # simulate a foreign process's gc
+            saved = dict(_ck._PINNED)
+            _ck._PINNED.clear()
+        try:
+            gc_checkpoints(str(tmp_path), keep_last=1)
+            assert os.path.exists(m3)  # live sidecar held it
+        finally:
+            with _ck._PIN_LOCK:
+                _ck._PINNED.update(saved)
+    finally:
+        unpin_checkpoint(m3)
+    assert not os.path.exists(m3 + f".pin-{os.getpid()}")
+    # dead holder: sidecar names a pid that no longer exists
+    with open(m3 + ".pin-999999999", "w") as f:
+        import socket
+
+        _json.dump({"pid": 999999999, "host": socket.gethostname(),
+                    "ts": 0.0}, f)
+    gc_checkpoints(str(tmp_path), keep_last=1)
+    assert not os.path.exists(m3)  # stale pin collected with the set
+    assert not os.path.exists(m3 + ".pin-999999999")
+    assert os.path.exists(m4)
+
+
+# ---------------------------------------------------------------------
+# fake replicas: the rollout state machine, host-only
+# ---------------------------------------------------------------------
+
+def fake_tokens(prompt_ids, stream_id, n, version):
+    """Tokens as a pure function of (prompt, stream id, VERSION): two
+    replicas on the same version with the same pinned stream id are
+    token-identical, and a version bump visibly changes outputs —
+    exactly what the pin_version A/B contract needs observable
+    without a device."""
+    import zlib
+
+    base = (int(np.sum(np.asarray(prompt_ids, np.int64))) * 31
+            + int(stream_id) * 7
+            + zlib.crc32(str(version).encode()) % 1009)
+    return [(base + j) % 997 for j in range(int(n))]
+
+
+class FakeDeployReplica:
+    """Version-aware replica fake: instant-serve rows per step, a
+    drain that finishes its admitted backlog, swap_from_manifest with
+    the real quiescence guard, reopen, and a submit_prefill that
+    records replayed prefixes."""
+
+    def __init__(self, name, version, *, slots=2, max_queue=64,
+                 fail_swap=False):
+        from tpuflow.serve.deploy import normalize_version
+
+        self.name = name
+        self.version = normalize_version(version)
+        self.slots = slots
+        self.max_new_cap = 16
+        self.page_size = 4
+        self.max_queue = max_queue
+        self.tokenizer = None
+        self.queue, self.running, self.finished = [], [], []
+        self.closed = False
+        self.is_draining = False
+        self.hold_running = False  # wedge the drain (timeout path)
+        self.fail_swap = fail_swap
+        self.replayed = []
+        self.swaps = 0
+        self.metrics = type("_M", (), {
+            "events": staticmethod(lambda rid: [])})()
+
+    # -- protocol -----------------------------------------------------
+    def bucket_of(self, plen):
+        return max(8, 1 << (max(1, int(plen)) - 1).bit_length())
+
+    def pages_needed(self, plen, max_new):
+        return -(-(plen + max_new - 1) // self.page_size)
+
+    def submit(self, ids, max_new, *, deadline_s=None, stream_cb=None,
+               request_id=None, stream_id=None, speculate=True):
+        if self.closed:
+            raise SchedulerClosed("scheduler is stopped")
+        if len(self.queue) >= self.max_queue:
+            raise QueueFull(len(self.queue), 0.5)
+        req = Request(prompt_ids=np.asarray(ids, np.int32),
+                      max_new_tokens=int(max_new),
+                      id=request_id or "", stream_cb=stream_cb)
+        req.stream_id = int(stream_id or 0) % self.slots
+        self.queue.append(req)
+        return req
+
+    def submit_prefill(self, prompt, *, deadline_s=None,
+                       stream_cb=None, request_id=None):
+        self.replayed.append(np.asarray(prompt, np.int32))
+        req = Request(prompt_ids=np.asarray(prompt, np.int32),
+                     max_new_tokens=1, id=request_id or "")
+        req.finalize(RequestState.DONE)
+        return req
+
+    def cancel(self, req):
+        if req in self.queue:
+            self.queue.remove(req)
+            req.finalize(RequestState.CANCELLED, "cancelled")
+            if req.stream_cb:
+                req.stream_cb(req, [], True)
+            return True
+        return False
+
+    def load_snapshot(self):
+        return {"queue_depth": len(self.queue),
+                "running": len(self.running),
+                "closed": self.closed or self.is_draining,
+                "draining": self.is_draining,
+                "max_queue": self.max_queue,
+                "model_version": self.version,
+                "kv_pages_free": 64, "kv_pages_total": 64}
+
+    def readiness(self):
+        return {"ready": not self.closed, "closed": self.closed,
+                "draining": self.is_draining}
+
+    def health(self):
+        return {"failed": self.closed and not self.is_draining,
+                "tripped": False, "closed": self.closed,
+                "draining": self.is_draining}
+
+    def retry_after_s(self):
+        return 0.5
+
+    def metrics_snapshot(self):
+        return {}
+
+    # -- deploy surface -----------------------------------------------
+    @property
+    def model_version(self):
+        return self.version
+
+    def swap_from_manifest(self, mpath, *, draft=False):
+        from tpuflow.serve.deploy import (
+            SwapMismatchError,
+            manifest_version,
+        )
+
+        if self.fail_swap:
+            raise SwapMismatchError("config drift (injected)")
+        if self.queue or self.running:
+            raise RuntimeError("swap on a busy replica")
+        self.version = manifest_version(mpath)
+        self.swaps += 1
+        return self.version
+
+    def reopen(self):
+        if self.queue or self.running:
+            raise RuntimeError("reopen before drained")
+        self.closed = False
+        self.is_draining = False
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self):
+        pass
+
+    def drain(self):
+        self.is_draining = True
+        self.closed = True
+
+    def stop(self, drain=True, timeout=0.0):
+        self.closed = True
+
+    def step(self):
+        progress = False
+        while self.queue and len(self.running) < self.slots:
+            req = self.queue.pop(0)
+            req.state = RequestState.RUNNING
+            req.ts_admitted = 1.0
+            self.running.append(req)
+            progress = True
+        if self.hold_running:
+            return progress
+        for req in list(self.running):
+            toks = fake_tokens(req.prompt_ids, req.stream_id,
+                               req.max_new_tokens,
+                               (self.version or {}).get("label"))
+            req.tokens.extend(toks)
+            self.running.remove(req)
+            self.finished.append(req)
+            req.finalize(RequestState.DONE)
+            if req.stream_cb:
+                req.stream_cb(req, toks, True)
+            progress = True
+        return progress
+
+    def idle(self):
+        return not self.queue and not self.running
+
+
+def _fake_tier(tmp_path, n_active=2, **kw):
+    from tpuflow.serve.deploy import DeploymentManager
+    from tpuflow.serve.router import Router
+
+    m1 = _save_np_ckpt(tmp_path, 1, seed=1)
+    from tpuflow.serve.deploy import manifest_version
+
+    v1 = manifest_version(m1)
+    reps = [FakeDeployReplica(f"rep{i}", v1, **kw)
+            for i in range(n_active + 1)]
+    router = Router(reps, standby=(n_active,))
+    mgr = DeploymentManager(router, replay_hot=4, clock=lambda: 0.0)
+    return router, reps, mgr, v1
+
+
+def _drive(router, reps):
+    for rep in reps:
+        rep.step()
+    router.maintain()
+
+
+def test_rollout_under_saturating_trace_zero_truncations(tmp_path):
+    """The acceptance shape: a weight push while submits keep landing
+    — every request completes DONE with its FULL token budget (zero
+    truncated streams), no tier-level rejection beyond what the trace
+    offered (the drain is invisible at the tier surface: placement
+    just routes around the retiring replica), and the tier ends fully
+    on the new version with the old replica recycled as standby."""
+    router, reps, mgr, v1 = _fake_tier(tmp_path)
+    m2 = _save_np_ckpt(tmp_path, 2, seed=2)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 100, (int(n),)).astype(np.int32)
+               for n in rng.integers(3, 12, 40)]
+    rrs, rejected = [], 0
+    # pre-load: the tier is busy when the push lands
+    for p in prompts[:8]:
+        rrs.append(router.submit(p, 8))
+    v2 = mgr.begin(str(m2), online=False)
+    i = 8
+    guard = 0
+    while mgr.active or i < len(prompts):
+        # keep the trace saturating: a few submits between every beat
+        for p in prompts[i:i + 4]:
+            try:
+                rrs.append(router.submit(p, 8))
+            except (QueueFull, SchedulerClosed):
+                rejected += 1
+            i += 1
+        _drive(router, reps)
+        mgr.tick()
+        guard += 1
+        assert guard < 200, "rollout did not converge"
+    router.run_until_idle()
+    assert rejected == 0  # the drain never surfaced as a tier 5xx
+    assert all(rr.state.value == "done" for rr in rrs), [
+        (rr.id, rr.state.value, rr.error) for rr in rrs
+        if rr.state.value != "done"]
+    # zero truncated streams: every request got its FULL budget
+    assert all(len(rr.tokens) == 8 for rr in rrs)
+    assert mgr.history[-1]["error"] is None
+    assert mgr.history[-1]["recycled"] and mgr.history[-1]["activated"]
+    # the whole active tier is on v2; exactly one replica is standby
+    for i_ in router.active_indices():
+        assert (router.replica_version(i_) or {})["label"] == v2["label"]
+    assert len(router.standby_indices()) == 1
+    # hot heads were replayed onto each incoming replica
+    assert any(rep.replayed for rep in reps)
+    # re-deploying the ALREADY-LIVE version is a clean no-op that
+    # PRESERVES the standby (activating it would leave nothing for
+    # the next real push) and is counted apart from real rollouts
+    from tpuflow.obs.gauges import counters
+
+    sb = router.standby_indices()
+    deploys_before = counters("serve.").get("serve.deploys_total", 0)
+    v_again = mgr.deploy(str(m2), drive=lambda: _drive(router, reps))
+    assert v_again["label"] == v2["label"]
+    assert router.standby_indices() == sb
+    assert mgr.history[-1]["error"] is None
+    assert mgr.history[-1]["noop"] is True
+    assert mgr.history[-1]["activated"] == []
+    c = counters("serve.")
+    assert c.get("serve.deploys_total", 0) == deploys_before
+    assert c.get("serve.deploys_noop_total", 0) >= 1
+
+
+def test_rollout_version_pinned_ab_token_identity(tmp_path):
+    """submit(pin_version=) mid-rollout: pinned requests serve on
+    exactly that version and their tokens equal the deterministic
+    (prompt, stream_id, version) oracle — i.e. token-identical to a
+    pure tier of the pinned version; a pin nothing serves raises
+    SchedulerClosed (503)."""
+    router, reps, mgr, v1 = _fake_tier(tmp_path)
+    m2 = _save_np_ckpt(tmp_path, 2, seed=2)
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 100, (9,)).astype(np.int32)
+               for _ in range(6)]
+    v2 = mgr.begin(str(m2), online=False)
+    # mid-rollout: standby is active on v2, old replica draining —
+    # BOTH versions are live: the A/B window
+    pinned_v2 = [router.submit(p, 6, pin_version=v2["label"])
+                 for p in prompts[:3]]
+    pinned_v1 = [router.submit(p, 6, pin_version=v1["label"])
+                 for p in prompts[3:]]
+    while mgr.active:
+        _drive(router, reps)
+        mgr.tick()
+    router.run_until_idle()
+    for rr, p in zip(pinned_v2, prompts[:3]):
+        assert rr.state.value == "done"
+        assert list(rr.tokens) == fake_tokens(p, rr.stream_id, 6,
+                                              v2["label"])
+    for rr, p in zip(pinned_v1, prompts[3:]):
+        assert rr.state.value == "done"
+        assert list(rr.tokens) == fake_tokens(p, rr.stream_id, 6,
+                                              v1["label"])
+    # after the rollout v1 is gone: a v1 pin is a clean 503
+    with pytest.raises(SchedulerClosed, match="not served"):
+        router.submit(prompts[0], 6, pin_version=v1["label"])
+    # and v2 pins keep serving
+    rr = router.submit(prompts[0], 6, pin_version=v2["label"])
+    router.run_until_idle()
+    assert rr.state.value == "done"
+
+
+def test_rollout_failure_paths(tmp_path):
+    """Config drift on the standby refuses the rollout LOUDLY with
+    the tier untouched; a wedged drain times out into retire (the
+    rollout degrades — it never hangs the tier)."""
+    from tpuflow.obs.gauges import counters
+    from tpuflow.serve.deploy import DeploymentManager, SwapMismatchError
+
+    # drift: the standby's swap raises → begin() propagates, failure
+    # counted, actives stay on v1 and keep serving
+    router, reps, mgr, v1 = _fake_tier(tmp_path, fail_swap=True)
+    m2 = _save_np_ckpt(tmp_path, 2, seed=2)
+    before = counters("serve.").get("serve.deploy_failures_total", 0)
+    with pytest.raises(SwapMismatchError):
+        mgr.begin(str(m2), online=False)
+    assert not mgr.active
+    assert counters("serve.")["serve.deploy_failures_total"] == before + 1
+    assert mgr.history[-1]["error"]
+    rr = router.submit(np.arange(1, 8, dtype=np.int32), 4)
+    router.run_until_idle()
+    assert rr.state.value == "done"
+    for i in router.active_indices():
+        assert (router.replica_version(i) or {})["label"] == v1["label"]
+
+    # wedged drain: the old replica never idles → tick retires it
+    # after drain_timeout_s, the rollout finishes with the error
+    # recorded, and the blocking deploy() RAISES (a partial roll must
+    # read as a failure to its caller — the watcher must not advance
+    # the deployed step on a mixed-version tier)
+    from tpuflow.serve.deploy import DeployError
+
+    clock = {"now": 0.0}
+    router2, reps2, _, _ = _fake_tier(tmp_path)
+    mgr2 = DeploymentManager(router2, replay_hot=0,
+                             drain_timeout_s=10.0,
+                             clock=lambda: clock["now"])
+    stuck = router2.submit(np.arange(1, 10, dtype=np.int32), 4)
+    old_idx = stuck.replica
+    reps2[old_idx].hold_running = True
+    reps2[old_idx].step()  # admit, never finish
+
+    def drive():
+        clock["now"] += 60.0
+
+    with pytest.raises(DeployError, match="degraded"):
+        mgr2.deploy(str(m2), drive=drive, timeout_s=30.0)
+    assert not mgr2.active
+    assert "timed out" in (mgr2.history[-1]["error"] or "")
+    assert old_idx not in router2.active_indices()
+    rr = router2.submit(np.arange(1, 6, dtype=np.int32), 4)
+    router2.run_until_idle()
+    assert rr.state.value == "done"
+
+
+def test_router_standby_validation_and_surfaces(tmp_path):
+    from tpuflow.serve.router import Router
+
+    reps = [FakeDeployReplica(f"r{i}", "v1") for i in range(2)]
+    with pytest.raises(ValueError, match="out of range"):
+        Router(reps, standby=(5,))
+    with pytest.raises(ValueError, match="ACTIVE decode-capable"):
+        Router(reps, standby=(0, 1))
+    router = Router(reps, standby=(1,))
+    # standby takes no traffic, readiness names it, snapshot counts it
+    rr = router.submit(np.arange(1, 10, dtype=np.int32), 4)
+    assert rr.replica == 0
+    r = router.readiness()
+    assert r["replicas"]["r1"]["standby"] is True
+    assert r["replicas"]["r0"]["model_version"] == "v1"
+    snap = router.snapshot()
+    assert snap["router.replicas_standby"] == 1.0
+    fl = router.flight_snapshot()
+    assert fl["standby"] == ["r1"] and "versions" in fl
+    router.run_until_idle()
+    # hot-head ledger: repeated prefixes rank by count
+    hot = router.hot_heads(4)
+    assert hot and all(isinstance(h, np.ndarray) for h in hot)
+
+
+def test_deploy_obs_surfaces(tmp_path):
+    """Counters/histogram/info-gauge reach the registry and the
+    Prometheus exposition; flight notes keep a BOUNDED deploy
+    history."""
+    from tpuflow.obs import flight
+    from tpuflow.obs.gauges import counters, scalar_gauges
+    from tpuflow.obs.prom import render
+
+    router, reps, mgr, v1 = _fake_tier(tmp_path)
+    m2 = _save_np_ckpt(tmp_path, 2, seed=2)
+    before = counters("serve.").get("serve.deploys_total", 0)
+    mgr.begin(str(m2), online=False)
+    guard = 0
+    while mgr.active:
+        _drive(router, reps)
+        mgr.tick()
+        guard += 1
+        assert guard < 100
+    c = counters("serve.")
+    assert c["serve.deploys_total"] == before + 1
+    text = render()
+    assert "serve_deploys_total" in text
+    assert "serve_deploy_ms_bucket" in text
+    # bounded history note (flight.append_note)
+    for j in range(40):
+        flight.append_note("_test_deploy_note", {"j": j})
+    with flight._LOCK:
+        notes = list(flight._NOTES["_test_deploy_note"])
+    assert len(notes) == 16 and notes[-1]["j"] == 39
+    flight.annotate("_test_deploy_note", None)
+    # the real rollout appended its record
+    with flight._LOCK:
+        dep = list(flight._NOTES.get("deploy") or [])
+    assert dep and dep[-1]["version"].startswith("step2-")
+    # the model_version info gauge followed the fake tier's metrics?
+    # (fakes have no ServeMetrics — pin the REAL gauge spelling on a
+    # scratch instance instead)
+    from tpuflow.serve.metrics import ServeMetrics
+
+    sm = ServeMetrics(gauge_prefix="serve.depltest")
+    sm.on_model_version({"step": 42, "digest": "ab", "label": "x"})
+    assert scalar_gauges("serve.depltest")[
+        "serve.depltest.model_version"] == 42.0
+
+
+# ---------------------------------------------------------------------
+# real-scheduler swap: token identity, validation, reopen
+# ---------------------------------------------------------------------
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from tpuflow.models import build_transformer_lm  # noqa: E402
+
+KW = dict(vocab_size=128, dim=32, depth=1, heads=2, mlp_ratio=2,
+          dtype=jnp.float32)
+# test_serve_paged.py's pool geometry + store size (compile reuse)
+GEO = dict(slots=2, seg=4, max_new_cap=12)
+PS = 4
+SAMPLED = dict(temperature=0.8, top_k=20, seed=7)
+
+
+@pytest.fixture(scope="module")
+def two_params():
+    import flax.linen as nn
+
+    lm = build_transformer_lm(**KW)
+    z = jnp.zeros((1, 8), jnp.int32)
+    p1 = nn.unbox(lm.init({"params": jax.random.key(0)}, z))["params"]
+    p2 = nn.unbox(lm.init({"params": jax.random.key(1)}, z))["params"]
+    return lm, p1, p2
+
+
+def _sched(lm, params, **kw):
+    from tpuflow.serve import ServeScheduler
+
+    base = dict(GEO, kv="paged", kv_page_size=PS, kv_pages=49)
+    base.update(kw)
+    return ServeScheduler(lm, params, **base)
+
+
+@pytest.mark.parametrize("samp", [{}, SAMPLED],
+                         ids=["greedy", "sampled"])
+def test_swap_flips_to_new_weights_token_identically(
+        two_params, tmp_path, samp):
+    """After swap_from_manifest the SAME scheduler (same pools, same
+    executables — no rebuild) produces the new weights' oracle tokens
+    exactly; the prefix cache is invalidated (a version bump makes
+    cached KV garbage) and the version reaches load_snapshot."""
+    from tpuflow.ckpt.sharded import save_sharded_checkpoint
+
+    lm, p1, p2 = two_params
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, 128, (9,)).astype(np.int32)
+
+    def oracle(params):
+        s = _sched(lm, params, **samp)
+        r = s.submit(prompt, 6)
+        s.run_until_idle()
+        assert r.state.value == "done", (r.state, r.error)
+        return list(r.tokens)
+
+    o1, o2 = oracle(p1), oracle(p2)
+    assert o1 != o2  # the weights actually differ observably
+
+    mpath = save_sharded_checkpoint(str(tmp_path), {"params": p2}, 7)
+    s = _sched(lm, p1, **samp)
+    r = s.submit(prompt, 6)
+    s.run_until_idle()
+    assert list(r.tokens) == o1
+    pools = dict(s.pools)
+    assert s.kv_state.prefix.nodes > 0  # warm tree to invalidate
+    v = s.swap_from_manifest(mpath)
+    assert v["step"] == 7 and s.model_version["label"] == v["label"]
+    assert dict(s.pools) == pools  # buffer flip, no pool rebuild
+    assert s.kv_state.prefix.nodes == 0  # cached KV invalidated
+    # pin the sampling stream to the oracle's (stream_id 0 — the
+    # router's pin_version A/B pins stream ids the same way): the
+    # comparison isolates WEIGHTS, not the local admission counter
+    r2 = s.submit(prompt, 6, stream_id=0)
+    s.run_until_idle()
+    assert list(r2.tokens) == o2, (list(r2.tokens), o2)
+    snap = s.load_snapshot()
+    assert snap["model_version"]["step"] == 7
+    assert s.metrics.weight_swaps == 1
+
+
+def test_swap_validation_busy_guard_and_reopen(two_params, tmp_path):
+    from tpuflow.ckpt.sharded import save_sharded_checkpoint
+    from tpuflow.serve.deploy import SwapMismatchError
+
+    lm, p1, p2 = two_params
+    import flax.linen as nn
+
+    lm_small = build_transformer_lm(vocab_size=128, dim=16, depth=1,
+                                    heads=2, mlp_ratio=2,
+                                    dtype=jnp.float32)
+    p_small = nn.unbox(lm_small.init(
+        {"params": jax.random.key(2)},
+        jnp.zeros((1, 8), jnp.int32)))["params"]
+    bad = save_sharded_checkpoint(str(tmp_path / "bad"),
+                                  {"params": p_small}, 9)
+    good = save_sharded_checkpoint(str(tmp_path / "good"),
+                                   {"params": p2}, 11)
+    s = _sched(lm, p1)
+    # config drift: refused loudly, version unchanged, nothing moved
+    with pytest.raises(SwapMismatchError, match="mismatch"):
+        s.swap_from_manifest(bad)
+    assert s.model_version is None
+    # busy replicas refuse (the standby/drained quiescence contract)
+    r = s.submit(np.arange(1, 10, dtype=np.int32), 4)
+    with pytest.raises(RuntimeError, match="busy"):
+        s.swap_from_manifest(good)
+    s.run_until_idle()
+    assert r.state.value == "done"
+    # draft swap on a non-speculating scheduler is a config error
+    with pytest.raises(ValueError, match="draft"):
+        s.swap_from_manifest(good, draft=True)
+    # drain → swap → reopen: the recycle path of a blue/green rotation
+    s.drain()
+    with pytest.raises(SchedulerClosed):
+        s.submit(np.arange(1, 6, dtype=np.int32), 4)
+    s.swap_from_manifest(good)
+    s.reopen()
+    r2 = s.submit(np.arange(1, 6, dtype=np.int32), 4)
+    s.run_until_idle()
+    assert r2.state.value == "done"
+    assert s.load_snapshot()["model_version"]["step"] == 11
+    # reopen mid-backlog is refused
+    s2 = _sched(lm, p1)
+    s2.submit(np.arange(1, 6, dtype=np.int32), 4)
+    s2.drain()
+    with pytest.raises(RuntimeError, match="drain"):
+        s2.reopen()
+    s2.run_until_idle()
+
+
+# ---------------------------------------------------------------------
+# slow tier: the out-of-process swap surface
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_http_worker_swap_weights_loopback(two_params, tmp_path):
+    """HTTPReplica.swap_from_manifest against the real
+    /v1/worker/swap_weights endpoint: the worker validates, swaps and
+    reports its new version in config; a mismatching manifest comes
+    back as the 400 → ValueError taxonomy (loud reject over the
+    wire); reopen-after-drain works remotely too."""
+    import flax.linen as nn
+
+    from tpuflow.ckpt.sharded import save_sharded_checkpoint
+    from tpuflow.serve.http import start_http_server
+    from tpuflow.serve.replica import HTTPReplica
+
+    lm, p1, p2 = two_params
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, 128, (9,)).astype(np.int32)
+
+    def oracle(params):
+        s = _sched(lm, params)
+        r = s.submit(prompt, 6)
+        s.run_until_idle()
+        return list(r.tokens)
+
+    o2 = oracle(p2)
+    good = save_sharded_checkpoint(str(tmp_path / "good"),
+                                   {"params": p2}, 21)
+    lm_small = build_transformer_lm(vocab_size=128, dim=16, depth=1,
+                                    heads=2, mlp_ratio=2,
+                                    dtype=jnp.float32)
+    p_small = nn.unbox(lm_small.init(
+        {"params": jax.random.key(2)},
+        jnp.zeros((1, 8), jnp.int32)))["params"]
+    bad = save_sharded_checkpoint(str(tmp_path / "bad"),
+                                  {"params": p_small}, 22)
+
+    sched = _sched(lm, p1)
+    server = start_http_server(sched, port=0)
+    try:
+        rep = HTTPReplica(f"127.0.0.1:{server.port}")
+        assert rep.model_version is None
+        with pytest.raises(ValueError, match="mismatch"):
+            rep.swap_from_manifest(bad)
+        rep.drain()
+        v = rep.swap_from_manifest(good)
+        assert v["step"] == 21
+        assert rep.model_version["label"] == v["label"]
+        rep.reopen()
+        r = rep.submit(prompt, 6)
+        assert r.wait(timeout=120) and r.state.value == "done", (
+            r.state, r.error)
+        assert list(r.tokens) == o2
+        assert rep.load_snapshot()["model_version"]["step"] == 21
+    finally:
+        server.shutdown()
+        sched.stop(drain=False, timeout=5.0)

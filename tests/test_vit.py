@@ -65,7 +65,9 @@ def test_flash_impl_matches_auto():
            "ALREADY AT STEP 0 on jax 0.4.37 XLA:CPU — the partitioned "
            "forward computes measurably different math, not float "
            "reduction noise; strict so a stack fix surfaces as XPASS. "
-           "Runnable repro: python tools/gspmd_cpu_tp_drift.py",
+           "Re-confirmed r15 (2026-08-04) on the same pins: 14.38% "
+           "drift, unchanged. Runnable repro: "
+           "python tools/gspmd_cpu_tp_drift.py",
 )
 def test_spmd_trainer_tp_matches_single_device():
     """dp2 × tp4 training must follow the 1×1 trajectory numerically."""

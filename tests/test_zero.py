@@ -81,7 +81,9 @@ def test_zero1_matches_replicated():
            "3 steps on jax 0.4.37 XLA:CPU while zero1 (sharded moments "
            "only) matches at 1e-5 — the param all-gather path's "
            "numerics, pinned; strict so a stack fix surfaces as XPASS. "
-           "Runnable repro: python tools/gspmd_cpu_tp_drift.py",
+           "Re-confirmed r15 (2026-08-04) on the same pins: 7.14% "
+           "drift (zero1 control 0.0%), unchanged. Runnable repro: "
+           "python tools/gspmd_cpu_tp_drift.py",
 )
 def test_fsdp_matches_replicated():
     losses_rep, _ = _run(zero=None)

@@ -5,13 +5,17 @@ from tpuflow.ckpt.checkpoint import (  # noqa: F401
     latest_checkpoint,
     latest_resume_point,
     list_checkpoints,
+    pin_checkpoint,
+    pinned_checkpoints,
     restore_checkpoint,
     restore_into_state,
     save_checkpoint,
     save_step_checkpoint,
+    unpin_checkpoint,
     verify_checkpoint,
 )
 from tpuflow.ckpt.sharded import (  # noqa: F401
+    latest_manifest,
     list_sharded_checkpoints,
     restore_sharded_into_state,
     save_sharded_checkpoint,

@@ -25,6 +25,7 @@ import os
 import re
 import struct
 import tempfile
+import time
 import zlib
 from typing import Any, List, Optional
 
@@ -462,6 +463,128 @@ def restore_into_state(path: str, state: Any) -> Any:
     )
 
 
+# ---- deploy pins (ISSUE 15 satellite) --------------------------------
+#
+# The gc-vs-watcher race: a serving-side ModelWatcher that has SEEN a
+# manifest but not finished restoring it must be able to hold retention
+# off that set — otherwise a trainer's gc_checkpoints(keep_last=N) can
+# delete shard files out from under a half-read restore. Two layers:
+#
+# - an in-memory refcount (nested pin/unpin balance) guards the
+#   in-process shape (watcher and gc in one process);
+# - a PIN SIDECAR file (`<manifest>.pin-<pid>` holding pid + host)
+#   makes the pin visible to a gc running in ANOTHER process on the
+#   shared checkpoint filesystem (the trainer-publishes /
+#   server-watches shape). gc skips sets with a LIVE sidecar: same
+#   host + pid alive, or (other host / unreadable) younger than
+#   _PIN_STALE_S — and deletes stale ones, so a crashed reader never
+#   blocks retention forever. The sidecar name matches no discovery
+#   pattern, so resume scans and set listings never see it.
+
+import json as _json
+import threading as _threading
+
+_PIN_LOCK = _threading.Lock()
+_PINNED: dict = {}
+#: a foreign-host pin sidecar older than this is presumed crashed
+_PIN_STALE_S = 3600.0
+
+
+def _pin_sidecar(path: str) -> str:
+    return f"{path}.pin-{os.getpid()}"
+
+
+def pin_checkpoint(path: str) -> None:
+    """Hold retention off this checkpoint (a manifest path pins its
+    WHOLE shard set) until the matching :func:`unpin_checkpoint` —
+    including retention run by OTHER processes on the shared
+    checkpoint dir (best-effort sidecar; see module comment)."""
+    import socket
+
+    p = os.path.abspath(path)
+    with _PIN_LOCK:
+        n = _PINNED.get(p, 0) + 1
+        _PINNED[p] = n
+    if n == 1:
+        try:
+            with open(_pin_sidecar(p), "w") as f:
+                _json.dump({"pid": os.getpid(),
+                            "host": socket.gethostname(),
+                            "ts": time.time()}, f)
+        except OSError:
+            pass  # read-only namespace: in-memory pin still holds
+
+
+def unpin_checkpoint(path: str) -> None:
+    """Release one pin (no-op if not pinned — unpin must be safe on
+    every error path)."""
+    p = os.path.abspath(path)
+    with _PIN_LOCK:
+        n = _PINNED.get(p, 0) - 1
+        if n <= 0:
+            _PINNED.pop(p, None)
+        else:
+            _PINNED[p] = n
+    if n <= 0:
+        try:
+            os.unlink(_pin_sidecar(p))
+        except OSError:
+            pass
+
+
+def pinned_checkpoints() -> List[str]:
+    with _PIN_LOCK:
+        return sorted(_PINNED)
+
+
+def _pin_sidecars_of(path: str) -> List[str]:
+    d, base = os.path.split(os.path.abspath(path))
+    prefix = base + ".pin-"
+    try:
+        return [os.path.join(d, fn) for fn in os.listdir(d)
+                if fn.startswith(prefix)]
+    except OSError:
+        return []
+
+
+def _externally_pinned(path: str) -> bool:
+    """Whether ANY process holds a live pin sidecar on ``path`` —
+    stale sidecars (dead pid on this host; old mtime elsewhere) are
+    collected here so a crashed reader cannot block retention."""
+    import socket
+
+    host = socket.gethostname()
+    live = False
+    for sc in _pin_sidecars_of(path):
+        stale = False
+        try:
+            with open(sc) as f:
+                rec = _json.load(f)
+            if rec.get("host") == host:
+                try:
+                    os.kill(int(rec["pid"]), 0)
+                except PermissionError:
+                    pass  # ALIVE, just unsignalable (other user)
+                except (OSError, ValueError, TypeError):
+                    stale = True  # holder died on this host
+            elif time.time() - os.path.getmtime(sc) > _PIN_STALE_S:
+                stale = True  # foreign/ancient: presume crashed
+        except (OSError, ValueError):
+            try:
+                stale = (time.time() - os.path.getmtime(sc)
+                         > _PIN_STALE_S)
+            except OSError:
+                continue  # vanished: its holder just unpinned
+        if stale:
+            try:
+                os.unlink(sc)
+            except OSError:
+                pass
+        else:
+            live = True
+    return live
+
+
 # ---- retention (ISSUE 10 satellite) ----------------------------------
 
 
@@ -476,7 +599,9 @@ def gc_checkpoints(checkpoint_dir: str, keep_last: int,
     never deleted even when retention would name it (if the newest N
     are all corrupt, the newest valid survivor is the only thing a
     restart can restore); rank-0 discipline (non-primary is a no-op,
-    matching who wrote the files). ``just_wrote`` names a checkpoint
+    matching who wrote the files); PINNED checkpoints
+    (:func:`pin_checkpoint` — the serving ModelWatcher's mid-restore
+    guard, ISSUE 15) are skipped however retention ranks them. ``just_wrote`` names a checkpoint
     the caller finished writing moments ago — trusted valid without
     re-reading it, so the per-save rail scan costs nothing instead of
     a full CRC pass over the newest checkpoint.
@@ -526,6 +651,10 @@ def gc_checkpoints(checkpoint_dir: str, keep_last: int,
         if s not in manifest_steps:  # orphaned set: killed mid-save
             step_ns.append((s, "", "orphan", tuple(sorted(fl))))
     removed: List[str] = []
+    # deploy pins (ISSUE 15): a manifest the serving-side ModelWatcher
+    # is mid-restore on is untouchable, wherever retention would rank
+    # it — the watcher pins before verify and unpins after the swap
+    pinned = {os.path.abspath(p) for p in pinned_checkpoints()}
     for ns in (epoch_ns, step_ns):
         ns.sort(reverse=True)  # newest first
         if not ns[keep_last:]:
@@ -538,9 +667,14 @@ def gc_checkpoints(checkpoint_dir: str, keep_last: int,
         for cand in ns[keep_last:]:
             if cand is newest_valid:
                 continue
+            if cand[1] and (os.path.abspath(cand[1]) in pinned
+                            or _externally_pinned(cand[1])):
+                continue
             _step, path, kind, orphans = cand
             if kind == "manifest":
-                doomed = sharded_set_files(path)
+                # any sidecar still present is stale (a live one made
+                # us skip above): collect it with its set
+                doomed = sharded_set_files(path) + _pin_sidecars_of(path)
             elif kind == "orphan":
                 doomed = list(orphans) + [
                     meta_path(f) for f in orphans

@@ -392,6 +392,26 @@ def verify_sharded(mpath: str) -> bool:
     return True
 
 
+def latest_manifest(checkpoint_dir: str, *, min_step: int = -1,
+                    verify: bool = True) -> Optional[str]:
+    """The newest published manifest above ``min_step`` (optionally
+    only a :func:`verify_sharded`-clean one) — the one-call answer to
+    "what would a deployment pick up next?" for scripts and operator
+    tooling (ISSUE 15): publish is atomic and manifest-last, so a
+    manifest that verifies IS a promoted checkpoint. The serving
+    ``ModelWatcher`` runs its own sweep instead of this helper — it
+    must PIN each candidate before verifying (the gc race) and track
+    per-step failure state; semantic parity between the two is pinned
+    by tests/test_serve_deploy.py."""
+    for mp in reversed(list_sharded_checkpoints(checkpoint_dir)):
+        step = manifest_step(os.path.basename(mp))
+        if step is None or step <= min_step:
+            continue
+        if not verify or verify_sharded(mp):
+            return mp
+    return None
+
+
 def list_sharded_checkpoints(checkpoint_dir: str) -> List[str]:
     """Manifest paths under ``checkpoint_dir``, oldest step first."""
     if not os.path.isdir(checkpoint_dir):

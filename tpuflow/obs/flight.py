@@ -80,6 +80,22 @@ def annotate(key: str, value: Any) -> None:
             _NOTES[key] = value
 
 
+def append_note(key: str, value: Any, cap: int = 16) -> None:
+    """Append ``value`` to a BOUNDED list note on every future
+    bundle's manifest (``manifest["notes"][key]`` is the most recent
+    ``cap`` entries, oldest first) — for lifecycle facts that happen
+    repeatedly and whose HISTORY matters: e.g. deployments (ISSUE
+    15), where a post-mortem must show which model version was live
+    when, not just the latest. :func:`annotate` stays last-write-wins
+    for singular facts."""
+    with _LOCK:
+        cur = _NOTES.get(key)
+        if not isinstance(cur, list):
+            cur = [] if cur is None else [cur]
+        cur.append(value)
+        _NOTES[key] = cur[-max(1, int(cap)):]
+
+
 def _write_json(path: str, obj: Any) -> None:
     with open(path, "w") as f:
         json.dump(obj, f, indent=1, default=str)

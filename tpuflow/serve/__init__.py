@@ -30,8 +30,27 @@ and own the decode slots — with out-of-process replicas
 ``--connect host:port,...``) so decode throughput scales beyond one
 host's HBM. Every transfer failure falls back to a local prefill:
 token-identical either way.
+
+Zero-downtime deployment (ISSUE 15): a ``ModelWatcher`` polls a
+checkpoint namespace for newly published sharded manifests (publish
+is atomic — manifest existence IS the promotion signal) and the
+``DeploymentManager`` blue/greens them through the tier: restore into
+a STANDBY replica's device buffers (same config ⇒ same executables —
+no recompile; config drift is refused loudly), replay the hottest
+prefix-chain heads onto it (a version bump invalidates cached KV),
+activate it, drain one old-version replica and recycle it as the
+next standby. Every replica carries a ``model_version`` and
+``submit(pin_version=)`` gives token-identical per-version A/B
+mid-rollout. CLI: ``--watch-checkpoints DIR --standby``.
 """
 
+from tpuflow.serve.deploy import (  # noqa: F401
+    DeploymentManager,
+    DeployError,
+    ModelWatcher,
+    SwapMismatchError,
+    manifest_version,
+)
 from tpuflow.serve.metrics import ServeMetrics, percentiles  # noqa: F401
 from tpuflow.serve.pages import (  # noqa: F401
     PagedKV,

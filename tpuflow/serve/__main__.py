@@ -19,6 +19,15 @@ admitting (503), finish every admitted request, flip ``/readyz``, then
 exit — a rolling restart truncates zero streams. ``--drain-timeout``
 bounds the wait.
 
+Zero-downtime deployment (ISSUE 15): ``--standby`` adds one idle
+replica and ``--watch-checkpoints DIR`` polls a checkpoint namespace —
+every newly published sharded manifest (atomic, manifest-last) is
+blue/greened through the tier with no restart and no truncated
+stream::
+
+  python -m tpuflow.serve --model pkg --replicas 2 --kv paged \
+      --standby --watch-checkpoints /ckpts
+
 Equivalent entry point: ``python -m tpuflow.cli.serve``.
 """
 
@@ -69,6 +78,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "most this many pages: chunks land one "
                         "scheduler boundary at a time, interleaved "
                         "with decode segments (transfer overlap)")
+    p.add_argument("--watch-checkpoints", default=None, metavar="DIR",
+                   help="zero-downtime deployment (ISSUE 15): poll "
+                        "DIR for newly published sharded-checkpoint "
+                        "manifests (publish is atomic, so a verified "
+                        "manifest IS the promotion signal) and "
+                        "blue/green each one through the tier — "
+                        "restore into the standby replica (same "
+                        "config, no recompile; config drift is "
+                        "refused loudly), replay hot prefix heads, "
+                        "shift traffic, drain + recycle the old "
+                        "replica. Requires --standby")
+    p.add_argument("--standby", action="store_true",
+                   help="add one STANDBY replica to the tier (takes "
+                        "no traffic until a rollout activates it): "
+                        "with --replicas N the process runs N active "
+                        "+ 1 standby schedulers; with --connect the "
+                        "LAST listed worker is the standby. The cost "
+                        "of zero-downtime swaps is this one idle "
+                        "replica's memory")
+    p.add_argument("--deploy-poll", type=float, default=2.0,
+                   metavar="S",
+                   help="--watch-checkpoints: poll interval")
+    p.add_argument("--deploy-replay", type=int, default=8,
+                   metavar="N",
+                   help="--watch-checkpoints: hottest prefix-chain "
+                        "heads replayed (re-prefilled) onto a freshly "
+                        "swapped replica before traffic shifts — a "
+                        "version bump invalidates cached KV, so "
+                        "warmth is rebuilt, never transferred")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="0 binds an ephemeral port (printed on start)")
@@ -198,6 +236,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.model and not args.connect:
         p.error("--model is required (or --connect to front remote "
                 "workers)")
+    if args.watch_checkpoints and not args.standby:
+        p.error("--watch-checkpoints needs --standby (the rollout "
+                "restores into the standby replica's buffers)")
+    if args.standby and not args.connect and args.kv != "paged":
+        # hot prefix replay and prefix invalidation are paged-KV
+        # concepts; the swap itself would work, but an un-warmed
+        # contiguous tier mid-rollout is not the documented contract
+        print("note: --standby without --kv paged skips prefix "
+              "replay (no prefix cache to warm)", flush=True)
     classes = [c.strip() for c in str(args.replica_class).split(",")]
     for c in classes:
         if c not in ("mixed", "prefill", "decode"):
@@ -312,10 +359,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             addrs = [a.strip() for a in args.connect.split(",")
                      if a.strip()]
+            if args.standby:
+                # the LAST listed worker is the standby: out-of-
+                # process rollouts swap it over /v1/worker/
+                # swap_weights (shared checkpoint namespace)
+                if len(addrs) < 2:
+                    p.error("--standby with --connect needs at least "
+                            "2 workers (the last one is the standby)")
+                router_kw["standby"] = (len(addrs) - 1,)
             front = Router([HTTPReplica(a) for a in addrs],
                            **router_kw)
             schedulers = []
-        elif n_rep == 1:
+        elif n_rep == 1 and not args.standby:
             kw["replica_class"] = classes[0]
             front = sched = ServeScheduler.from_packaged(args.model, **kw)
             schedulers = [sched]
@@ -332,8 +387,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             from tpuflow.serve.router import Router
 
             lm = load_packaged_lm(args.model)
+            if args.standby:
+                # one extra scheduler, parked as standby (ISSUE 15):
+                # it shares the loaded weights until the first
+                # rollout swaps its own in. Mixed-class so it can
+                # stand in for any retiring replica.
+                classes = classes + ["mixed"]
+                router_kw["standby"] = (n_rep,)
             schedulers = []
-            for i in range(n_rep):
+            for i in range(len(classes)):
                 schedulers.append(ServeScheduler.from_packaged(
                     lm,
                     metrics=ServeMetrics(
@@ -367,9 +429,40 @@ def main(argv: Optional[List[str]] = None) -> int:
             detector.start()
         server = start_http_server(front, args.host, args.port,
                                    request_timeout_s=args.request_timeout)
+        watcher = None
+        if args.watch_checkpoints:
+            # zero-downtime deployment (ISSUE 15): poll the namespace;
+            # each verified new manifest runs a full blocking rollout
+            # on the watcher's own daemon thread (swap standby →
+            # replay hot heads → shift → drain+recycle)
+            from tpuflow.serve.deploy import (
+                DeploymentManager,
+                ModelWatcher,
+            )
+
+            manager = DeploymentManager(
+                front, replay_hot=args.deploy_replay,
+                drain_timeout_s=max(60.0, 2 * args.drain_timeout))
+            if hasattr(front, "on_maintain"):
+                # rollouts also advance on the router's maintenance
+                # cadence (tick() serializes against the watcher's
+                # own blocking deploy loop), so a rotation never
+                # stalls behind a slow poll interval
+                front.on_maintain.append(manager.tick)
+            watcher = ModelWatcher(
+                args.watch_checkpoints,
+                lambda mpath, version: manager.deploy(mpath),
+                poll_s=args.deploy_poll)
+            watcher.start()
+            print(f"watching {args.watch_checkpoints} for published "
+                  f"checkpoints (poll {args.deploy_poll:g}s, "
+                  f"standby=replica{len(front.replicas) - 1})",
+                  flush=True)
         what = args.model or f"workers[{args.connect}]"
         print(f"serving {what} on http://{args.host}:{server.port} "
-              f"(replicas={n_rep} slots={args.slots} seg={args.seg} "
+              f"(replicas={n_rep}"
+              f"{'+standby' if args.standby else ''} "
+              f"slots={args.slots} seg={args.seg} "
               f"max_new={args.max_new} queue<={args.max_queue} "
               f"kv={args.kv} class={','.join(classes)})", flush=True)
         try:
@@ -393,6 +486,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         except KeyboardInterrupt:
             print("shutting down", flush=True)
         finally:
+            if watcher is not None:
+                watcher.stop()
             server.shutdown()
             front.stop(drain=False, timeout=10.0)
     return 0

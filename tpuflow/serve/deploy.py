@@ -1,0 +1,744 @@
+"""Zero-downtime continuous deployment (ISSUE 15): live weight
+hot-swap through the router.
+
+ROADMAP item 3 closes the train → checkpoint → serve loop with no
+human and no downtime. Every ingredient already existed as a seam —
+this module composes them:
+
+- PR 10's sharded checkpoints publish ATOMICALLY (manifest-last, CRC
+  verified), so a manifest's existence IS the promotion signal: the
+  :class:`ModelWatcher` polls a checkpoint namespace and a newly
+  published, verified manifest triggers a rollout — no registry
+  service, no promote button;
+- PR 10's re-slice pivot (``assemble_leaves`` → place under the
+  template's own shardings) restores the new weights into a STANDBY
+  replica's device buffers without recompiling anything: same config ⇒
+  same shapes ⇒ same executables — the swap is a buffer refresh, and
+  :func:`load_host_params` validates exactly that (every template
+  leaf present with identical shape AND dtype) before a single byte
+  moves, raising :class:`SwapMismatchError` loudly when the published
+  config drifted from the loaded model (the full re-init path is a
+  process restart — deliberately NOT automated here: a config change
+  is a deployment decision, not a weight push);
+- PR 8's drain machinery makes the traffic shift truncation-free: the
+  :class:`DeploymentManager` blue/greens — activate the freshly
+  swapped standby, drain ONE old-version replica (it finishes its
+  admitted backlog; new submits route to the new version), recycle it
+  as the next standby, repeat until the whole tier serves the new
+  version. A version bump INVALIDATES cached KV (new weights ⇒ the
+  old pages are garbage for the new model), so prefix warmth is
+  rebuilt by REPLAYING the tier's hottest chain heads as prefill-only
+  requests on the incoming replica (PR 14's ``submit_prefill``) —
+  re-prefilled, never transferred;
+- every replica carries a ``model_version`` ({step, digest, label}
+  from the manifest) surfaced in ``load_snapshot()`` / ``/v1/metrics``
+  / Prometheus / flight bundles, and ``Router.submit(pin_version=)``
+  pins a request to a version for token-identical A/B during a
+  rollout (the pinned stream id plus identical weights make outputs
+  bitwise-reproducible per version).
+
+Draft models (PR 9) ride the same machinery: ``target='draft'``
+pushes a freshly distilled draft through the rotation so speculative
+acceptance rises live without touching target weights.
+
+The gc race (satellite): retention must never delete a manifest the
+watcher has seen but not finished restoring — the watcher PINS the
+manifest (:func:`tpuflow.ckpt.checkpoint.pin_checkpoint`) for the
+whole rollout and ``gc_checkpoints`` skips pinned sets.
+
+Everything here is pure host policy except the device placement
+inside ``ServeScheduler.swap_weights`` — which runs only on quiescent
+(standby / drained) replicas, preserving the device-thread discipline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from tpuflow.obs.gauges import Histogram, inc_counter, register_histogram
+
+__all__ = [
+    "DeployError",
+    "SwapMismatchError",
+    "manifest_version",
+    "version_label",
+    "load_host_params",
+    "place_like",
+    "ModelWatcher",
+    "DeploymentManager",
+]
+
+
+class DeployError(RuntimeError):
+    """A rollout step failed (replica died mid-roll, drain timed out).
+    The tier is left SERVING — on whatever version mix it reached —
+    and the failure is counted/annotated; a deploy must degrade to
+    'not yet rolled', never to an outage."""
+
+
+class SwapMismatchError(ValueError):
+    """The published manifest's config does not match the loaded
+    model (missing leaves, shape or dtype drift): the swap is refused
+    LOUDLY before any buffer moves. A ValueError so the worker HTTP
+    endpoint maps it to 400 — a config mismatch is a bad request, not
+    a server fault; the fallback is a full re-init (process restart
+    with the new config), which is a deployment decision."""
+
+
+#: deploy-plane wall-clock histogram (one per process, all tiers):
+#: begin() → finished, in ms — the number the README's standby-cost
+#: sizing note quotes
+deploy_ms = register_histogram("serve.deploy_ms", Histogram())
+
+
+# ---- versions --------------------------------------------------------
+
+
+def manifest_version(mpath: str) -> Dict[str, Any]:
+    """``{step, digest, label}`` of a sharded-checkpoint manifest —
+    the model version a replica carries after restoring it. The digest
+    is content-derived (CRC32 over the manifest bytes, which already
+    notarize every shard file's CRC), so a re-publish of identical
+    weights at the same step is the SAME version (idempotence) while
+    any weight change at the same step is a different one."""
+    import os
+
+    from tpuflow.ckpt.sharded import _crc32_file, manifest_step
+
+    step = manifest_step(os.path.basename(mpath))
+    if step is None:
+        raise ValueError(f"{mpath}: not a sharded-checkpoint manifest")
+    digest = f"{_crc32_file(mpath):08x}"
+    return {"step": int(step), "digest": digest,
+            "label": f"step{step}-{digest}"}
+
+
+def version_label(version: "Optional[Dict[str, Any] | str]") -> Optional[str]:
+    """The comparable string of a version in any of its spellings
+    (dict / bare label / None) — what ``pin_version=`` matches on."""
+    if version is None:
+        return None
+    if isinstance(version, str):
+        return version
+    return version.get("label")
+
+
+def normalize_version(version: "Optional[Dict[str, Any] | str]"
+                      ) -> Optional[Dict[str, Any]]:
+    """Version in canonical dict form ({step, digest, label}); bare
+    strings become ``{"label": s}``."""
+    if version is None or isinstance(version, dict):
+        return version
+    return {"step": None, "digest": None, "label": str(version)}
+
+
+# ---- manifest → placed params ---------------------------------------
+
+
+def _flat_template(template_params: Any) -> Dict[str, Any]:
+    from flax import serialization
+
+    from tpuflow.ckpt.checkpoint import _unkey
+    from tpuflow.ckpt.sharded import _flatten
+
+    return _flatten(serialization.to_state_dict(_unkey(template_params)))
+
+
+def load_host_params(mpath: str, template_params: Any) -> Dict[str, np.ndarray]:
+    """Assemble the manifest leaves matching ``template_params`` as
+    full host arrays, validating config compatibility LOUDLY first:
+    every template leaf must exist in the manifest (bare, or under the
+    ``params/`` prefix a TrainState checkpoint writes) with the exact
+    shape and dtype the loaded model compiled against. Raises
+    :class:`SwapMismatchError` listing the drift; on success the
+    result keys match the template's flat keys."""
+    from tpuflow.ckpt.sharded import assemble_leaves, load_manifest
+
+    flat = _flat_template(template_params)
+    man = load_manifest(mpath)
+    leaves = man.get("leaves", {})
+    prefix = None
+    for cand in ("", "params/"):
+        if all((cand + k) in leaves for k in flat):
+            prefix = cand
+            break
+    if prefix is None:
+        missing = [k for k in flat
+                   if k not in leaves and ("params/" + k) not in leaves]
+        raise SwapMismatchError(
+            f"{mpath}: manifest is missing {len(missing)} model "
+            f"leaves (config mismatch — a swap cannot reshape the "
+            f"compiled model): {missing[:4]}"
+            f"{'...' if len(missing) > 4 else ''}")
+    drift = []
+    for key, leaf in flat.items():
+        meta = leaves[prefix + key]
+        want_shape = tuple(int(d) for d in np.shape(leaf))
+        want_dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        got_shape = tuple(int(d) for d in meta.get("shape", ()))
+        got_dtype = str(meta.get("dtype"))
+        if got_shape != want_shape or got_dtype != want_dtype:
+            drift.append(f"{key}: manifest {got_shape}/{got_dtype} "
+                         f"vs loaded {want_shape}/{want_dtype}")
+    if drift:
+        raise SwapMismatchError(
+            f"{mpath}: {len(drift)} leaves mismatch the loaded model "
+            f"(shape/dtype drift — refuse the swap, re-init with the "
+            f"new config instead): {drift[:4]}"
+            f"{'...' if len(drift) > 4 else ''}")
+    host = assemble_leaves(mpath, want=[prefix + k for k in flat])
+    return {k: host[prefix + k] for k in flat}
+
+
+def place_like(host: Dict[str, np.ndarray], template_params: Any) -> Any:
+    """Flat host arrays → a params pytree shaped and DEVICE-PLACED
+    like ``template_params`` (same tree, same shardings — the
+    restore half of the swap; no recompile because nothing about the
+    shapes changed)."""
+    import jax
+    from flax import serialization
+
+    from tpuflow.ckpt.checkpoint import _rekey, _unkey
+    from tpuflow.ckpt.sharded import _apply_flat
+    from tpuflow.parallel.mesh import put_replicated
+
+    template_sd = serialization.to_state_dict(_unkey(template_params))
+    restored = serialization.from_state_dict(
+        _unkey(template_params), _apply_flat(template_sd, dict(host)))
+    restored = _rekey(template_params, restored)
+    return jax.tree.map(
+        lambda v, t: put_replicated(np.asarray(v), t.sharding)
+        if hasattr(t, "sharding") else v,
+        restored,
+        template_params,
+    )
+
+
+def check_tree_compatible(template: Any, new: Any, what: str = "model") -> None:
+    """Structure + shape + dtype equality of two params pytrees —
+    the in-memory twin of :func:`load_host_params`'s manifest check
+    (``swap_weights(params=...)`` callers hit this one)."""
+    a, b = _flat_template(template), _flat_template(new)
+    if set(a) != set(b):
+        missing = sorted(set(a) - set(b))
+        extra = sorted(set(b) - set(a))
+        raise SwapMismatchError(
+            f"{what} swap refused: leaf sets differ "
+            f"(missing {missing[:3]}, unexpected {extra[:3]})")
+    drift = [
+        k for k in a
+        if tuple(np.shape(a[k])) != tuple(np.shape(b[k]))
+        or str(getattr(a[k], "dtype", np.asarray(a[k]).dtype))
+        != str(getattr(b[k], "dtype", np.asarray(b[k]).dtype))
+    ]
+    if drift:
+        raise SwapMismatchError(
+            f"{what} swap refused: {len(drift)} leaves changed "
+            f"shape/dtype: {drift[:4]}")
+
+
+# ---- the watcher -----------------------------------------------------
+
+
+class ModelWatcher:
+    """Poll a checkpoint namespace for newly published sharded
+    manifests and hand each verified one to ``on_manifest(mpath,
+    version)`` — the promotion signal with no promoter.
+
+    Discipline (unit-pinned, deterministically driven via
+    :meth:`poll_once`):
+
+    - only manifests with step STRICTLY above the last deployed step
+      fire (a re-publish at the same step is idempotent — same step,
+      nothing to do);
+    - a manifest that fails :func:`verify_sharded` (corrupt manifest,
+      missing/bit-flipped shard, PARTIAL set still landing) is
+      SKIPPED this poll and re-checked next poll — a slow publisher
+      finishes eventually, a genuinely corrupt set never fires;
+    - the manifest is PINNED (:func:`tpuflow.ckpt.checkpoint.
+      pin_checkpoint`) for the whole callback — and the
+      DeploymentManager re-pins for the whole multi-rotation rollout
+      — so retention (``gc_checkpoints``) can never delete a set
+      mid-restore: the gc-vs-watcher race, closed;
+    - a raising callback does NOT advance the deployed step (the
+      next poll retries with a fresh verify); tier-side failures
+      (rollout still active, wedged drain, replica death) retry
+      indefinitely — they say nothing about the checkpoint;
+    - after ``bad_after`` consecutive MANIFEST-shaped failures —
+      verify failures or :class:`SwapMismatchError` (config drift) —
+      against an UNCHANGED set, the step is remembered as BAD and no
+      longer retried (counted on ``serve.deploy_bad_manifests_total``):
+      a config-drifted or bit-flipped publish must not re-CRC the
+      whole shard set and re-fail a rollout every poll forever. The
+      failure count resets whenever the set's on-disk fingerprint
+      (file sizes/mtimes) changes — and a blacklisted step whose set
+      later changes is UN-blacklisted and retried — so a SLOW
+      non-atomic publisher (rsync-style sync where the manifest lands
+      before the shards finish) keeps being re-checked for as long as
+      it keeps making progress: it finishes eventually and deploys.
+
+    Drive it online (:meth:`start` — a daemon poll thread) or
+    deterministically (:meth:`poll_once`)."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        on_manifest: Callable[[str, Dict[str, Any]], Any],
+        *,
+        poll_s: float = 2.0,
+        min_step: int = -1,
+        bad_after: int = 8,
+    ):
+        self.checkpoint_dir = str(checkpoint_dir)
+        self.on_manifest = on_manifest
+        self.poll_s = float(poll_s)
+        self.deployed_step = int(min_step)
+        self.bad_after = int(bad_after)
+        # per-step: (consecutive failures, set fingerprint the count
+        # applies to) — a changed fingerprint resets the count.
+        # _bad_steps maps step -> fingerprint AT blacklist time: a
+        # later change to the set (the stalled publisher resumed,
+        # someone re-published the step) un-blacklists it.
+        self._step_fails: Dict[int, tuple] = {}
+        self._bad_steps: Dict[int, tuple] = {}
+        self.polls = 0
+        self.fired = 0
+        self.skipped_invalid = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _candidates(self) -> List[str]:
+        from tpuflow.ckpt.sharded import (
+            list_sharded_checkpoints,
+            manifest_step,
+        )
+        import os
+
+        out = []
+        for mp in list_sharded_checkpoints(self.checkpoint_dir):
+            step = manifest_step(os.path.basename(mp))
+            if step is None or step <= self.deployed_step:
+                continue
+            bad_fp = self._bad_steps.get(step)
+            if bad_fp is not None:
+                # blacklisted — but a CHANGED set (stalled publisher
+                # resumed, step re-published) earns a fresh start:
+                # permanence would skip a valid checkpoint forever
+                if self._set_fingerprint(step) == bad_fp:
+                    continue
+                del self._bad_steps[step]
+                self._step_fails.pop(step, None)
+            out.append(mp)
+        return out
+
+    def poll_once(self) -> Optional[str]:
+        """One sweep: deploy the NEWEST verified undeployed manifest
+        (skipping invalid sets); returns the manifest path deployed,
+        or None. Never raises — a failing rollout is counted and
+        retried next poll."""
+        import os
+
+        from tpuflow.ckpt.checkpoint import pin_checkpoint, unpin_checkpoint
+        from tpuflow.ckpt.sharded import manifest_step, verify_sharded
+
+        self.polls += 1
+        for mpath in reversed(self._candidates()):  # newest first
+            step = manifest_step(os.path.basename(mpath))
+            # pin BEFORE verify: a retention sweep between verify and
+            # restore is exactly the race this guard exists to close
+            pin_checkpoint(mpath)
+            try:
+                if not verify_sharded(mpath):
+                    # corrupt OR still landing: skip this poll
+                    self.skipped_invalid += 1
+                    self._record_step_failure(step)
+                    continue
+                version = manifest_version(mpath)
+                try:
+                    self.on_manifest(mpath, version)
+                except Exception as e:
+                    # the DeploymentManager already counted its own
+                    # deploy_failures_total; this one counts callback
+                    # breakage generally and keeps the step
+                    # undeployed (the next poll retries). Only
+                    # MANIFEST-shaped failures (config drift) count
+                    # toward the static-set blacklist — tier-side
+                    # failures (rollout still active, wedged drain,
+                    # replica death) say nothing about the
+                    # checkpoint and must keep being retried
+                    inc_counter("serve.deploy_watch_errors_total")
+                    if isinstance(e, SwapMismatchError):
+                        self._record_step_failure(step)
+                    return None
+                self.deployed_step = step
+                self.fired += 1
+                self._step_fails.pop(step, None)
+                return mpath
+            finally:
+                unpin_checkpoint(mpath)
+        return None
+
+    def _set_fingerprint(self, step: int):
+        """Cheap progress signal for one step's file set (sizes +
+        mtimes of everything named for the step): a slow non-atomic
+        publisher keeps changing it, a corrupt/drifted static set
+        does not."""
+        import os
+
+        out = []
+        try:
+            for fn in sorted(os.listdir(self.checkpoint_dir)):
+                # our OWN pin sidecar is rewritten every poll — it is
+                # observer machinery, not publisher progress, and
+                # including it would defeat unchanged-set detection
+                if f"step-{step}." in fn and ".pin-" not in fn:
+                    try:
+                        st = os.stat(os.path.join(self.checkpoint_dir,
+                                                  fn))
+                        out.append((fn, st.st_size, st.st_mtime_ns))
+                    except OSError:
+                        out.append((fn, -1, -1))
+        except OSError:
+            pass
+        return tuple(out)
+
+    def _record_step_failure(self, step: int) -> None:
+        fp = self._set_fingerprint(step)
+        n, prev_fp = self._step_fails.get(step, (0, None))
+        # progress since the last failure (files grew/landed/were
+        # re-published): start the count over — only an UNCHANGED set
+        # that keeps failing is genuinely bad
+        n = n + 1 if fp == prev_fp else 1
+        self._step_fails[step] = (n, fp)
+        if n >= self.bad_after:
+            self._bad_steps[step] = fp
+            inc_counter("serve.deploy_bad_manifests_total")
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:
+                    pass  # the poll must never die
+                self._stop.wait(self.poll_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="tpuflow-model-watcher", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+# ---- the rollout -----------------------------------------------------
+
+
+class DeploymentManager:
+    """Router-driven blue/green rollout over one standby replica.
+
+    The tier runs N active replicas plus one STANDBY (registered with
+    the router but excluded from placement). A weight push rotates:
+
+    1. **swap** — restore the manifest into the standby's device
+       buffers (``replica.swap_from_manifest``: config validated,
+       same executables, prefix cache cleared — a version bump
+       invalidates cached KV);
+    2. **warm** — replay the router's hottest chain heads onto the
+       standby as prefill-only requests (PR 14's ``submit_prefill``),
+       re-prefilling — not transferring — so the first real requests
+       land on a warm tree;
+    3. **shift** — activate the standby (placement now prefers it as
+       least-loaded) and mark ONE old-version replica draining: its
+       admitted backlog finishes (zero truncated streams), new
+       submits see only live replicas (drain 503s are the router's
+       normal shed surface, nothing new);
+    4. **recycle** — once the drained replica idles, it becomes the
+       next standby; repeat from 1 until every active replica serves
+       the new version, then finish (counters, ``deploy_ms``, flight
+       note).
+
+    The rollout is a STATE MACHINE advanced by :meth:`tick` — wire it
+    into the router's maintenance cadence (online) or interleave it
+    with replica steps (offline tests); :meth:`deploy` is the
+    blocking convenience for scripts. ``target='draft'`` pushes draft
+    weights through the same rotation (speculative acceptance rises
+    live; target weights untouched)."""
+
+    def __init__(self, router, *, replay_hot: int = 8,
+                 drain_timeout_s: float = 300.0,
+                 clock: Callable[[], float] = time.time):
+        self.router = router
+        self.replay_hot = int(replay_hot)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        # serializes tick() bodies: the router's maintenance thread
+        # and a blocking deploy() may both pump the state machine
+        self._tick_lock = threading.Lock()
+        self._state: Optional[Dict[str, Any]] = None
+        self.history: List[Dict[str, Any]] = []
+
+    # -- introspection -------------------------------------------------
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._state is not None
+
+    def state(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return None if self._state is None else dict(
+                self._state, mpath=self._state["mpath"])
+
+    # -- rollout -------------------------------------------------------
+    def _standby_idx(self) -> int:
+        sb = self.router.standby_indices()
+        if not sb:
+            raise DeployError(
+                "no standby replica: construct the Router with "
+                "standby=(i,) (or set_standby) to enable rollouts")
+        return sb[0]
+
+    def _old_version_actives(self, label: str, target: str) -> List[int]:
+        out = []
+        for i in self.router.active_indices():
+            if version_label(self.router.replica_version(
+                    i, target=target)) != label:
+                out.append(i)
+        return out
+
+    def _swap_and_activate(self, st: Dict[str, Any]) -> None:
+        """One rotation's swap+warm+shift: standby gets the new
+        weights, replays hot heads, goes active; one old-version
+        replica starts draining. When NO active replica is on an old
+        version (operator retry of an already-live push), the standby
+        is swapped but stays PARKED — activating it would consume the
+        tier's only standby on a no-op and leave nothing for the next
+        real push."""
+        old = self._old_version_actives(st["label"], st["target"])
+        idx = self._standby_idx()
+        rep = self.router.replicas[idx]
+        rep.swap_from_manifest(st["mpath"], draft=(st["target"] == "draft"))
+        if not old:
+            st["old_idx"] = None
+            return
+        # the standby may be a recycled (drained → closed) replica:
+        # reopen + restart its loop before traffic shifts to it
+        try:
+            rep.reopen()
+        except Exception:
+            pass
+        if st["online"]:
+            rep.start()
+        # warm: replay the hottest chain heads as prefill-only
+        # requests — re-prefill (the version bump invalidated any
+        # cached KV), never transfer. Best-effort: a replica without
+        # the prefill surface (contiguous KV, speculation) just
+        # starts cold.
+        replayed = 0
+        for toks in self.router.hot_heads(self.replay_hot):
+            try:
+                rep.submit_prefill(np.asarray(toks, np.int32))
+                replayed += 1
+            except Exception:
+                break
+        st["replayed"] += replayed
+        self.router.activate(idx)
+        st["activated"].append(idx)
+        st["old_idx"] = old[0]
+        st["drain_t0"] = self.clock()
+        self.router.begin_retire(old[0])
+
+    def begin(self, mpath: str, *, target: str = "model",
+              online: Optional[bool] = None) -> Dict[str, Any]:
+        """Start a rollout of ``mpath``. Raises
+        :class:`SwapMismatchError` (config drift — counted, tier
+        untouched) or :class:`DeployError` (rollout already active /
+        no standby). Returns the version dict."""
+        if target not in ("model", "draft"):
+            raise ValueError(f"target must be 'model' or 'draft', "
+                             f"got {target!r}")
+        version = manifest_version(mpath)
+        # pin for the WHOLE rollout, not just this call: rotations
+        # 2..N re-read the manifest from tick() long after the
+        # watcher's own pin released — retention must stay off the
+        # set until _finish (which unpins on every path)
+        from tpuflow.ckpt.checkpoint import pin_checkpoint
+
+        with self._lock:
+            if self._state is not None:
+                raise DeployError(
+                    f"rollout of {self._state['label']} still active")
+            pin_checkpoint(mpath)
+            self._state = st = {
+                "mpath": str(mpath), "target": target,
+                "version": version, "label": version["label"],
+                "t0": self.clock(), "wall_t0": time.perf_counter(),
+                "old_idx": None, "drain_t0": None,
+                "activated": [], "recycled": [], "replayed": 0,
+                "online": (self.router.is_online()
+                           if online is None else bool(online)),
+            }
+        try:
+            self._swap_and_activate(st)
+        except Exception as e:
+            self._finish(st, error=f"{type(e).__name__}: {e}")
+            raise
+        self.router.metrics.event("-deploy-", "deploy_begin",
+                                  version=version["label"],
+                                  target=target)
+        if st["old_idx"] is None:
+            self._finish(st)
+        return version
+
+    def tick(self) -> bool:
+        """Advance the state machine one step (cheap; call from the
+        maintenance cadence). Returns True while a rollout is
+        active. Concurrent tickers (maintenance thread + a blocking
+        :meth:`deploy`) serialize; the loser skips the beat."""
+        if not self._tick_lock.acquire(blocking=False):
+            return self.active
+        try:
+            return self._tick()
+        finally:
+            self._tick_lock.release()
+
+    def _tick(self) -> bool:
+        with self._lock:
+            st = self._state
+        if st is None:
+            return False
+        old = st["old_idx"]
+        if old is None:
+            return False
+        rep = self.router.replicas[old]
+        try:
+            drained = rep.idle()
+        except Exception:
+            drained = True  # a dead replica has nothing left to drain
+        timed_out = (st["drain_t0"] is not None
+                     and self.clock() - st["drain_t0"]
+                     > self.drain_timeout_s)
+        if not drained and not timed_out:
+            return True
+        if timed_out and not drained:
+            # the old replica is wedged mid-drain: leave it retired
+            # (not recycled) and finish on the replicas we did move —
+            # a deploy degrades, never hangs the tier
+            self.router.retire(old)
+            self._finish(st, error=f"drain of replica {old} timed out "
+                                   f"after {self.drain_timeout_s:g}s")
+            return False
+        self.router.recycle_as_standby(old)
+        st["recycled"].append(old)
+        st["old_idx"] = None
+        remaining = self._old_version_actives(st["label"], st["target"])
+        if remaining:
+            try:
+                self._swap_and_activate(st)
+            except Exception as e:
+                self._finish(st, error=f"{type(e).__name__}: {e}")
+                raise
+            return True
+        self._finish(st)
+        return False
+
+    def deploy(self, mpath: str, *, target: str = "model",
+               timeout_s: float = 600.0, poll_s: float = 0.05,
+               drive: Optional[Callable[[], Any]] = None) -> Dict[str, Any]:
+        """Blocking convenience: :meth:`begin` + :meth:`tick` until
+        the rollout finishes (``drive`` pumps an offline tier between
+        ticks). Returns the version dict on a CLEAN finish; a rollout
+        that finished degraded (wedged drain → retire, mid-roll
+        replica death) raises :class:`DeployError` — callers like the
+        watcher must see a partial roll as a failure to retry, never
+        as a deployed version."""
+        version = self.begin(mpath, target=target,
+                             online=(drive is None) or None)
+        deadline = time.monotonic() + timeout_s
+        while self.active:
+            if drive is not None:
+                drive()
+            self.tick()
+            if not self.active:
+                break
+            if time.monotonic() > deadline:
+                raise DeployError(
+                    f"rollout of {version['label']} still active "
+                    f"after {timeout_s:g}s")
+            if drive is None:
+                time.sleep(poll_s)
+        err = self.history[-1]["error"] if self.history else None
+        if err is not None:
+            raise DeployError(
+                f"rollout of {version['label']} finished degraded: "
+                f"{err}")
+        return version
+
+    def abort(self, reason: str = "aborted") -> None:
+        """Drop an active rollout's bookkeeping (the tier keeps
+        whatever mix it reached; a retired-but-undrained replica is
+        recycled as standby so the NEXT rollout still has one)."""
+        with self._lock:
+            st = self._state
+        if st is None:
+            return
+        if st["old_idx"] is not None:
+            try:
+                self.router.recycle_as_standby(st["old_idx"])
+            except Exception:
+                pass
+        self._finish(st, error=reason)
+
+    # -- bookkeeping ---------------------------------------------------
+    def _finish(self, st: Dict[str, Any], error: Optional[str] = None) -> None:
+        from tpuflow.ckpt.checkpoint import unpin_checkpoint
+        from tpuflow.obs import flight
+
+        with self._lock:
+            if self._state is not st:
+                return
+            self._state = None
+        unpin_checkpoint(st["mpath"])
+        ms = (time.perf_counter() - st["wall_t0"]) * 1e3
+        noop = error is None and not st["activated"]
+        rec = {
+            "version": st["label"],
+            "target": st["target"],
+            "ts": st["t0"],
+            "deploy_ms": round(ms, 3),
+            "activated": list(st["activated"]),
+            "recycled": list(st["recycled"]),
+            "replayed_heads": st["replayed"],
+            "noop": noop,
+            "error": error,
+        }
+        self.history.append(rec)
+        del self.history[:-16]
+        if error is not None:
+            inc_counter("serve.deploy_failures_total")
+        elif noop:
+            # the version was already live: no traffic moved — a
+            # distinct counter, and NO deploy_ms sample (near-zero
+            # no-op walls would skew the rollout-duration histogram)
+            inc_counter("serve.deploys_noop_total")
+        else:
+            inc_counter("serve.deploys_total")
+            deploy_ms.observe(ms)
+        # post-mortems must show WHICH version was live (and when it
+        # became so): a bounded history note on every future bundle
+        flight.append_note("deploy", rec)
+        self.router.metrics.event(
+            "-deploy-", "deploy_finish" if error is None
+            else "deploy_failed", version=st["label"],
+            target=st["target"], deploy_ms=round(ms, 3), error=error)

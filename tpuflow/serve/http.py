@@ -64,7 +64,19 @@ replica of a router tier; single-scheduler servers only)::
                                  format, base64 payloads)
   POST /v1/worker/offer_chain    land a wire chunk into this
                                  replica's page store / prefix tree
+  POST /v1/worker/swap_weights   hot-swap weights from a sharded
+                                 manifest in the shared checkpoint
+                                 namespace (ISSUE 15; quiescent
+                                 workers only — 400 on config
+                                 mismatch, loudly)
+  POST /v1/worker/reopen         re-admit after a drain (the recycle
+                                 half of a blue/green rotation)
   POST /v1/worker/stop           stop the scheduler (drain optional)
+
+``POST /v1/generate`` additionally accepts ``pin_version`` (ISSUE
+15): serve this request on exactly that model version (router tiers
+place on matching replicas; a single scheduler 503s a mismatch) —
+the token-identical A/B surface during a rollout.
 """
 
 from __future__ import annotations
@@ -180,6 +192,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "page_size": (None if spec is None
                                   else spec.page_size),
                     "speculate_k": getattr(sched, "speculate_k", 0),
+                    "model_version": getattr(sched, "model_version",
+                                             None),
                     "has_tokenizer": sched.tokenizer is not None,
                 })
             elif self.path == "/v1/worker/load_snapshot":
@@ -342,6 +356,29 @@ class _Handler(BaseHTTPRequestHandler):
                                 str(body.get("reason", "failed")))
             return self._json(200, {"transfer_id": str(tid),
                                     "ok": True})
+        if self.path == "/v1/worker/swap_weights":
+            # zero-downtime deployment (ISSUE 15): hot-swap this
+            # worker's weights from a manifest in the shared
+            # checkpoint namespace. A config mismatch surfaces as the
+            # SwapMismatchError -> ValueError -> 400 taxonomy (loud
+            # reject, nothing moved); a busy worker (not drained /
+            # not standby) is the RuntimeError -> 500 path.
+            mpath = body.get("manifest")
+            if not mpath:
+                raise ValueError("swap_weights needs a 'manifest' "
+                                 "path")
+            version = sched.swap_from_manifest(
+                str(mpath), draft=bool(body.get("draft", False)))
+            return self._json(200, {
+                "ok": True,
+                "model_version": getattr(sched, "model_version", None),
+                "swapped": version,
+                "draft": bool(body.get("draft", False)),
+            })
+        if self.path == "/v1/worker/reopen":
+            sched.reopen()
+            return self._json(200, {"ok": True,
+                                    "readiness": sched.readiness()})
         if self.path == "/v1/worker/stop":
             sched.stop(drain=bool(body.get("drain", True)),
                        timeout=float(body.get("timeout", 30.0)))
@@ -426,6 +463,22 @@ class _Handler(BaseHTTPRequestHandler):
             # tokens are identical either way (oracle-parity
             # acceptance); a no-op on non-speculating servers
             kwargs["speculate"] = bool(body["speculate"])
+        if body.get("pin_version") is not None:
+            # version pin (ISSUE 15): token-identical A/B during a
+            # rollout. A router tier places on matching replicas;
+            # a single scheduler either IS that version or 503s —
+            # the pin means "this version or nothing", never "some
+            # other weights that happen to be loaded".
+            pv = str(body["pin_version"])
+            if hasattr(sched, "replicas"):
+                kwargs["pin_version"] = pv
+            else:
+                mv = getattr(sched, "model_version", None) or {}
+                label = mv.get("label") if isinstance(mv, dict) else mv
+                if label != pv:
+                    raise SchedulerClosed(
+                        f"model version {pv!r} is not served here "
+                        f"(loaded: {label!r})")
         timeout = float(self.server.request_timeout_s
                         if body.get("timeout_s") is None
                         else body["timeout_s"])

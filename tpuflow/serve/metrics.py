@@ -149,6 +149,9 @@ class ServeMetrics:
         self.kv_exports = 0
         self.kv_imports = 0
         self.kv_transfer_failures = 0
+        # live weight hot-swaps (ISSUE 15): model + draft combined;
+        # the per-kind split lives on the registry counters
+        self.weight_swaps = 0
         # speculative decoding (ISSUE 9): cumulative draft/accept
         # counters plus a sliding window of recent rounds — the
         # windowed accept-rate gauge is what a dashboard watches for
@@ -384,6 +387,38 @@ class ServeMetrics:
         self.event(f"-transfer-{transfer_id}-", "kv_transfer_failure",
                    error=error, kind=kind)
 
+    # ---- live weight hot-swap (ISSUE 15) ----------------------------
+    def on_model_version(self, version) -> None:
+        """Publish the served model version: the ``<prefix>.
+        model_version`` info gauge carries the manifest STEP (the
+        numeric a dashboard can plot/alert on; -1 = versionless), and
+        the full ``{step, digest, label}`` rides the JSON surfaces
+        (load_snapshot, /v1/metrics via this gauge + the event log,
+        flight bundles via the gauges section + the deploy note)."""
+        step = None
+        if isinstance(version, dict):
+            step = version.get("step")
+        set_gauge(f"{self.prefix}.model_version",
+                  float(-1 if step is None else step))
+        self.event("-deploy-", "model_version",
+                   version=(version.get("label")
+                            if isinstance(version, dict) else version))
+
+    def on_weight_swap(self, version, ms: float, *, draft: bool,
+                       cleared_pages: int = 0) -> None:
+        """One completed in-place weight swap (standby restore or
+        recycle): wall time, prefix pages invalidated (a version bump
+        invalidates cached KV), target-vs-draft counters."""
+        with self._lock:
+            self.weight_swaps += 1
+        inc_counter(f"{self.prefix}."
+                    f"{'draft_' if draft else ''}weight_swaps_total")
+        self.event("-deploy-", "weight_swap",
+                   version=(version.get("label")
+                            if isinstance(version, dict) else version),
+                   draft=bool(draft), ms=round(float(ms), 3),
+                   cleared_pages=int(cleared_pages))
+
     def on_spec_round(self, drafted: int, accepted: int) -> None:
         """One speculative round's outcome: ``drafted`` proposals
         (k per live speculative row), ``accepted`` of them matched the
@@ -485,6 +520,7 @@ class ServeMetrics:
             m[f"{self.prefix}.kv_imports"] = float(self.kv_imports)
             m[f"{self.prefix}.kv_transfer_failures"] = float(
                 self.kv_transfer_failures)
+            m[f"{self.prefix}.weight_swaps"] = float(self.weight_swaps)
             m[f"{self.prefix}.spec_rounds"] = float(self.spec_rounds)
             m[f"{self.prefix}.spec_drafted"] = float(self.spec_drafted)
             m[f"{self.prefix}.spec_accepted"] = float(self.spec_accepted)

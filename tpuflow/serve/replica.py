@@ -129,6 +129,21 @@ class InProcessReplica(Replica):
                       reason: str = "transfer failed") -> None:
         self.sched.fail_transfer(transfer_id, reason)
 
+    # ---- zero-downtime deployment (ISSUE 15) ------------------------
+    @property
+    def model_version(self):
+        return self.sched.model_version
+
+    def swap_from_manifest(self, mpath: str, *,
+                           draft: bool = False) -> Dict[str, Any]:
+        """Hot-swap this replica's weights from a published sharded
+        manifest (quiescent replicas only — the standby contract;
+        :meth:`ServeScheduler.swap_from_manifest`)."""
+        return self.sched.swap_from_manifest(mpath, draft=draft)
+
+    def reopen(self) -> None:
+        self.sched.reopen()
+
     # ---- sensors -----------------------------------------------------
     def load_snapshot(self) -> Dict[str, Any]:
         return self.sched.load_snapshot()
@@ -269,6 +284,7 @@ class HTTPReplica(Replica):
         if self.page_size is not None:
             self.page_size = int(self.page_size)
         self.replica_class = str(cfg.get("replica_class", "mixed"))
+        self.model_version = cfg.get("model_version")
         self.tokenizer = (_RemoteTokenizer(self)
                           if cfg.get("has_tokenizer") else None)
 
@@ -500,6 +516,29 @@ class HTTPReplica(Replica):
                 "transfer_id": str(transfer_id), "reason": str(reason)})
         except Exception:
             pass  # an unreachable worker times the transfer out itself
+
+    # ---- zero-downtime deployment (ISSUE 15) ------------------------
+    def swap_from_manifest(self, mpath: str, *,
+                           draft: bool = False) -> Dict[str, Any]:
+        """Hot-swap the WORKER's weights from a manifest path in the
+        shared checkpoint namespace (the same operating assumption the
+        sharded format already makes) — the worker validates config
+        compatibility itself and a mismatch comes back as the 400 →
+        ``ValueError`` (SwapMismatchError) taxonomy, loudly. The
+        restore can take a while on big models: ride the long request
+        timeout, not the connect timeout."""
+        out = self._call("POST", "/v1/worker/swap_weights",
+                         {"manifest": str(mpath), "draft": bool(draft)},
+                         timeout=max(self.timeout_s, 300.0))
+        if not draft:
+            self.model_version = out.get("model_version")
+        # "swapped" is the version this CALL installed (draft swaps
+        # leave model_version untouched) — the same contract as
+        # ServeScheduler.swap_from_manifest's return value
+        return out.get("swapped") or {}
+
+    def reopen(self) -> None:
+        self._post_json("/v1/worker/reopen", {})
 
     # ---- sensors -----------------------------------------------------
     def load_snapshot(self) -> Dict[str, Any]:

@@ -124,6 +124,10 @@ class RouterRequest:
         self.stream_cb = stream_cb
         self.client_cancelled = False
         self.speculate = True  # per-request spec opt-out (ISSUE 9)
+        # version pin (ISSUE 15): placement AND failover restricted to
+        # replicas serving exactly this model version — the
+        # token-identical A/B surface during a rollout
+        self.pin_version: Optional[str] = None
         self.resubmits = 0
         self.ts_arrival: Optional[float] = None
         self._router = router
@@ -314,6 +318,7 @@ class Router:
         name: str = "router",
         transfer_min_tokens: Optional[int] = None,
         transfer_chunk_pages: int = 8,
+        standby: Sequence[int] = (),
     ):
         """``placement='load'`` is the real policy (least-loaded with
         prefix affinity when ``affinity``); ``'spray'`` hashes the
@@ -389,6 +394,30 @@ class Router:
             raise ValueError(
                 "router needs at least one decode-capable replica "
                 "(every replica is prefill-class)")
+        # zero-downtime deployment (ISSUE 15): STANDBY replicas are
+        # registered (health-polled, swappable) but excluded from
+        # placement until a rollout activates them; RETIRING replicas
+        # are draining out of an old version (their backlog finishes,
+        # no new placements — the blue/green shift)
+        self._standby = {int(i) for i in standby}
+        bad = [i for i in self._standby
+               if not 0 <= i < len(self.replicas)]
+        if bad:
+            raise ValueError(f"standby indices out of range: {bad}")
+        self._retiring: set = set()
+        if not [i for i in self._decode_set if i not in self._standby]:
+            raise ValueError(
+                "router needs at least one ACTIVE decode-capable "
+                "replica (every decode replica is standby)")
+        # hottest chain heads (bounded): the rollout's prefix-warmth
+        # replay source — deepest chunk-chain key → hit count + the
+        # covering token prefix (a version bump invalidates cached KV,
+        # so warmth is REBUILT by re-prefilling these, not transferred)
+        self._hot: "OrderedDict[bytes, Dict[str, Any]]" = OrderedDict()
+        self._hot_cap = 512
+        # rollout hook: DeploymentManager.tick rides the maintenance
+        # cadence through here (online tiers)
+        self.on_maintain: List[Callable[[], Any]] = []
         self.disaggregated = bool(self._prefill_set)
         if transfer_min_tokens is None:
             transfer_min_tokens = 2 * int(ps) if ps else 1 << 30
@@ -486,6 +515,7 @@ class Router:
         stream_cb: Optional[Callable] = None,
         request_id: Optional[str] = None,
         speculate: bool = True,
+        pin_version: Optional[str] = None,
     ) -> RouterRequest:
         """Place one request on the best replica (module docstring has
         the policy). Raises the scheduler taxonomy: ``QueueFull``
@@ -494,7 +524,13 @@ class Router:
         ``ValueError`` (never servable). ``speculate=False`` pins the
         request to plain decode on speculating replicas (ISSUE 9) and
         survives failover resubmission — tokens identical either
-        way."""
+        way. ``pin_version`` (ISSUE 15) restricts placement — and any
+        later failover — to replicas whose ``model_version`` label
+        matches exactly: with the tier-global stream-id pinning this
+        makes a version A/B during a rollout token-identical per
+        version; a version nothing live serves raises
+        :class:`SchedulerClosed` (503 — go elsewhere, the version is
+        gone or not yet rolled)."""
         ids = self._encode(prompt)
         if max_new_tokens is None:
             max_new_tokens = self.max_new_cap
@@ -510,12 +546,23 @@ class Router:
         snaps = {i: self._safe_snapshot(i) for i in live}
         # DECODE placement candidates: prefill-class replicas never
         # own a request's decode (ISSUE 14) — they serve prompt passes
-        # through _begin_transfer below
+        # through _begin_transfer below; standby replicas (ISSUE 15)
+        # take no traffic until a rollout activates them
+        with self._lock:
+            standby = set(self._standby)
         eligible = [i for i in live if not snaps[i].get("closed")
-                    and i not in self._prefill_set]
+                    and i not in self._prefill_set
+                    and i not in standby]
         if not eligible:
             raise SchedulerClosed(
                 "every decode-capable replica is draining or closed")
+        if pin_version is not None:
+            eligible = [i for i in eligible
+                        if self._snap_version(snaps[i]) == pin_version]
+            if not eligible:
+                raise SchedulerClosed(
+                    f"model version {pin_version!r} is not served by "
+                    f"any live replica")
         depth = sum(int(snaps[i].get("queue_depth", 0)) for i in eligible)
 
         def _min_retry() -> float:
@@ -579,6 +626,24 @@ class Router:
                     tgt = self._affinity.get(keys[j])
                     if tgt is not None:
                         break
+                if keys:
+                    # hot-head accounting (ISSUE 15): the deepest
+                    # chain this prompt exercises, with its covering
+                    # token prefix — what a rollout replays onto a
+                    # freshly swapped replica to rebuild prefix warmth
+                    head = keys[-1]
+                    rec = self._hot.get(head)
+                    if rec is None:
+                        self._hot[head] = rec = {
+                            "count": 0,
+                            "tokens": np.asarray(
+                                ids[: len(keys) * self.affinity_ps],
+                                np.int32),
+                        }
+                    rec["count"] += 1
+                    self._hot.move_to_end(head)
+                    while len(self._hot) > self._hot_cap:
+                        self._hot.popitem(last=False)
             if tgt is not None and tgt in eligible:
                 if scores[tgt] <= scores[order[0]] + self.affinity_slack:
                     order.remove(tgt)
@@ -596,8 +661,15 @@ class Router:
         # follows the request to its decode home over the wire
         do_transfer = False
         if self.disaggregated and self._placement != "spray":
+            # version fence (ISSUE 15): a chain exported by a replica
+            # on a DIFFERENT model version is garbage for the decode
+            # home — mid-rollout, transfers only cross same-version
+            # pairs; everything else local-prefills (tokens identical)
+            home_v = self._snap_version(snaps[order[0]])
             pf_live = [i for i in live if i in self._prefill_set
-                       and not snaps[i].get("closed")]
+                       and not snaps[i].get("closed")
+                       and i not in standby
+                       and self._snap_version(snaps[i]) == home_v]
             if pf_live:
                 cached_tokens = 0
                 if keys:
@@ -636,6 +708,8 @@ class Router:
                 stream_cb,
             )
             rr.speculate = bool(speculate)
+            rr.pin_version = (None if pin_version is None
+                              else str(pin_version))
             rr.ts_arrival = self.clock()
             # transfer-overlap contract (ISSUE 14): a transferred
             # request submits to its decode home IMMEDIATELY, gated on
@@ -883,6 +957,108 @@ class Router:
             except Exception:
                 pass
 
+    # ---- deployment plane (ISSUE 15) --------------------------------
+    @staticmethod
+    def _snap_version(snap: Dict[str, Any]) -> Optional[str]:
+        """The comparable version label out of a load snapshot — ONE
+        normalization (serve.deploy.version_label) shared with the
+        deployment plane, so pin_version placement and the disagg
+        version fence can never drift from what a rollout records."""
+        from tpuflow.serve.deploy import version_label
+
+        return version_label(snap.get("model_version"))
+
+    def replica_version(self, idx: int, target: str = "model"):
+        """One replica's current model (or draft) version, as its
+        load snapshot reports it."""
+        snap = self._safe_snapshot(idx)
+        return snap.get("draft_version" if target == "draft"
+                        else "model_version")
+
+    def versions(self) -> Dict[str, Optional[str]]:
+        """``{replica_name: version label}`` across the tier — the
+        mid-rollout mix at a glance."""
+        return {self.replicas[i].name: self._snap_version(
+                    self._safe_snapshot(i))
+                for i in range(len(self.replicas))}
+
+    def standby_indices(self) -> List[int]:
+        with self._lock:
+            return sorted(self._standby)
+
+    def active_indices(self) -> List[int]:
+        """Replicas currently taking traffic (live, not standby, not
+        retiring) — the set a rollout must move to the new version."""
+        with self._lock:
+            failed = set(self._failed)
+            out = [i for i in range(len(self.replicas))
+                   if i not in failed and i not in self._standby
+                   and i not in self._retiring]
+        return out
+
+    def set_standby(self, idx: int) -> None:
+        """Park a replica as standby (no placement until
+        :meth:`activate`)."""
+        with self._lock:
+            self._standby.add(int(idx))
+
+    def activate(self, idx: int) -> None:
+        """Standby → active: the replica joins placement (least-
+        loaded, so traffic shifts to it naturally) — the blue half of
+        the blue/green shift."""
+        with self._lock:
+            self._standby.discard(int(idx))
+            self._retiring.discard(int(idx))
+            self._failed.pop(int(idx), None)
+        self.metrics.event("-deploy-", "replica_activated",
+                           replica=self.replicas[idx].name)
+
+    def begin_retire(self, idx: int) -> None:
+        """Active → retiring: drain the replica (its admitted backlog
+        finishes — zero truncated streams; new submits already route
+        elsewhere because its snapshot reads closed)."""
+        with self._lock:
+            self._retiring.add(int(idx))
+        try:
+            self.replicas[idx].drain()
+        except Exception:
+            pass
+        self.metrics.event("-deploy-", "replica_retiring",
+                           replica=self.replicas[idx].name)
+
+    def retire(self, idx: int) -> None:
+        """Give up on a retiring replica (wedged drain): excluded
+        from placement like any failed replica, never recycled."""
+        with self._lock:
+            self._retiring.discard(int(idx))
+        self.mark_failed(idx, reason="retired (deploy)")
+
+    def recycle_as_standby(self, idx: int) -> None:
+        """Drained-out replica → the next rollout's standby."""
+        with self._lock:
+            self._retiring.discard(int(idx))
+            self._standby.add(int(idx))
+            self._failed.pop(int(idx), None)
+        self.metrics.event("-deploy-", "replica_recycled",
+                           replica=self.replicas[idx].name)
+
+    def hot_heads(self, n: int = 8) -> List[np.ndarray]:
+        """The ``n`` hottest chain-head token prefixes the tier has
+        seen (by placement count) — the rollout's replay source: a
+        version bump invalidates cached KV, so warmth on the incoming
+        replica is rebuilt by RE-PREFILLING these, never by
+        transferring stale pages."""
+        with self._lock:
+            recs = sorted(self._hot.values(),
+                          key=lambda r: -int(r["count"]))[: max(0, int(n))]
+            return [np.array(r["tokens"], np.int32) for r in recs]
+
+    def is_online(self) -> bool:
+        """Whether the online maintenance thread is running (the
+        rollout manager starts freshly swapped replicas' loops only
+        on online tiers)."""
+        return self._thread is not None and self._thread.is_alive()
+
     # ---- failover (maintenance) -------------------------------------
     def mark_failed(self, replica: "int | str", reason: str = "") -> None:
         """Exclude a replica from placement and make its queued
@@ -972,6 +1148,13 @@ class Router:
 
         set_gauge("router.replicas", float(len(self.replicas)))
         set_gauge("router.replicas_failed", float(len(failed)))
+        # deployment hook (ISSUE 15): an active rollout's state
+        # machine advances on the same cadence as health/failover
+        for hook in list(self.on_maintain):
+            try:
+                hook()
+            except Exception:
+                pass
         return progress
 
     def _failover(self, rr: RouterRequest) -> bool:
@@ -981,10 +1164,19 @@ class Router:
         with rr._lock:
             old_idx, old_inner = rr._replica_idx, rr._inner
         # decode-capable candidates only: a prefill-class replica must
-        # never inherit a decode through failover either
+        # never inherit a decode through failover either; standby
+        # replicas take no traffic, and a version-pinned request only
+        # moves to a replica serving exactly that version (ISSUE 15)
+        with self._lock:
+            standby = set(self._standby)
         candidates = [i for i in self._live_indices()
-                      if i != old_idx and i not in self._prefill_set]
+                      if i != old_idx and i not in self._prefill_set
+                      and i not in standby]
         snaps = {i: self._safe_snapshot(i) for i in candidates}
+        if rr.pin_version is not None:
+            candidates = [i for i in candidates
+                          if self._snap_version(snaps[i])
+                          == rr.pin_version]
         order = sorted(
             (i for i in candidates if not snaps[i].get("closed")),
             key=lambda i: (int(snaps[i].get("queue_depth", 0))
@@ -1145,6 +1337,8 @@ class Router:
         with self._lock:
             failed = dict(self._failed)
             draining, closed = self._draining, self._closed
+            standby = set(self._standby)
+            retiring = set(self._retiring)
         for i, rep in enumerate(self.replicas):
             try:
                 r = rep.readiness()
@@ -1158,15 +1352,20 @@ class Router:
                 "ready": ok,
                 "failed": failed.get(i),
                 "class": self.classes[i],
+                "standby": i in standby,
+                "retiring": i in retiring,
+                "model_version": self._snap_version(snap),
                 "queue_depth": snap.get("queue_depth"),
                 "running": snap.get("running"),
                 "draining": snap.get("draining"),
             }
         # a disaggregated tier with only its prefill replicas ready
         # cannot serve a single token — readiness needs a DECODE home
+        # that is actually TAKING traffic (standby replicas don't)
         decode_ready = sum(
             1 for i, rep in enumerate(self.replicas)
             if i in set(self._decode_set)
+            and i not in standby
             and per[rep.name]["ready"])
         return {
             "ready": bool(decode_ready) and not (draining or closed),
@@ -1187,6 +1386,8 @@ class Router:
             out["router.replicas"] = float(len(self.replicas))
             out["router.replicas_live"] = float(
                 len(self.replicas) - len(self._failed))
+            out["router.replicas_standby"] = float(len(self._standby))
+            out["router.replicas_retiring"] = float(len(self._retiring))
             out["router.affinity_table"] = float(len(self._affinity))
             for name, n in self.placements.items():
                 out[f"router.placements.{name}"] = float(n)
@@ -1246,15 +1447,22 @@ class Router:
                       for i, why in self._failed.items()}
             counts = dict(self.counts)
             draining, closed = self._draining, self._closed
+            standby = [self.replicas[i].name for i in self._standby]
+            retiring = [self.replicas[i].name for i in self._retiring]
+        # ONE snapshot fetch per replica: versions derive from the
+        # same snaps (an HTTP replica pays a round-trip per fetch)
+        snaps = {self.replicas[i].name: self._safe_snapshot(i)
+                 for i in range(len(self.replicas))}
         return {
             "draining": draining,
             "closed": closed,
             "failed": failed,
             "counts": counts,
+            "standby": standby,
+            "retiring": retiring,
+            "versions": {name: self._snap_version(s)
+                         for name, s in snaps.items()},
             "placements": dict(self.placements),
-            "replicas": {
-                self.replicas[i].name: self._safe_snapshot(i)
-                for i in range(len(self.replicas))
-            },
+            "replicas": snaps,
             "inflight": inflight,
         }

@@ -101,6 +101,7 @@ class ServeScheduler:
         replica_class: str = "mixed",
         watchdog=None,
         transfer_wait_s: float = 30.0,
+        model_version=None,
     ):
         """``kv='paged'`` switches the KV memory model (ISSUE 6): one
         process-wide store of ``kv_pages`` fixed-size pages
@@ -297,6 +298,17 @@ class ServeScheduler:
         self.replica_class = replica_class
         self._watchdog = watchdog
         self.transfer_wait_s = float(transfer_wait_s)
+        # zero-downtime deployment (ISSUE 15): the model version this
+        # replica serves ({step, digest, label} — a bare string
+        # normalizes), surfaced in load_snapshot()/metrics/Prometheus/
+        # flight and advanced by swap_weights/swap_from_manifest
+        from tpuflow.serve.deploy import normalize_version
+
+        self.model_version: Optional[Dict[str, Any]] = (
+            normalize_version(model_version))
+        self.draft_version: Optional[Dict[str, Any]] = None
+        if self.model_version is not None:
+            self.metrics.on_model_version(self.model_version)
         # inbound page-chain transfers (ISSUE 14): chunks queue here
         # from any thread; the scheduler thread lands them at boundary
         # start (device scatter stays on the one device-owning thread)
@@ -895,6 +907,126 @@ class ServeScheduler:
             )
         return self.kv_state
 
+    # ---- live weight hot-swap (ISSUE 15) ----------------------------
+    def swap_weights(self, params, *, version=None,
+                     draft: bool = False) -> None:
+        """Replace the served weights with ``params`` — SAME config,
+        so the compiled join/segment executables are untouched: the
+        swap is a reference flip onto freshly placed device buffers,
+        validated (tree/shape/dtype) before anything moves and
+        refused with :class:`~tpuflow.serve.deploy.SwapMismatchError`
+        on drift.
+
+        Quiescence contract: the scheduler must hold NO work (empty
+        queues, no live rows) — the standby/drained state the
+        blue/green rollout guarantees by construction. A busy replica
+        raises instead of racing its own decode loop; the device
+        placement happens BEFORE the lock, so admissions stall only
+        for the reference flip itself.
+
+        The prefix cache is CLEARED on a model swap: a version bump
+        invalidates cached KV (old pages are garbage under new
+        weights) — warmth is rebuilt by replaying hot chain heads
+        (``DeploymentManager``), never by trusting stale pages.
+        ``draft=True`` swaps the draft model's weights instead
+        (speculative acceptance rises live; target weights, and
+        therefore output tokens, untouched) — the draft store shares
+        the target's page tables, so cached pages clear as well."""
+        import jax
+
+        from tpuflow.parallel.mesh import put_replicated
+        from tpuflow.serve.deploy import (
+            check_tree_compatible,
+            normalize_version,
+        )
+
+        target = self.draft_params if draft else self.params
+        if draft and target is None:
+            raise ValueError(
+                "draft swap on a non-speculating scheduler")
+        check_tree_compatible(target, params,
+                              what="draft" if draft else "model")
+        t0 = self.clock()
+        placed = jax.tree.map(
+            lambda t, v: put_replicated(v, t.sharding)
+            if hasattr(t, "sharding") else v,
+            target, params)
+        version = normalize_version(version)
+        with self._lock:
+            busy = any(self._queues.values())
+            pools = list(self.pools.values())
+            if not busy:
+                busy = any(p.live_count() for p in pools)
+            if busy:
+                raise RuntimeError(
+                    "swap_weights on a busy scheduler — swap the "
+                    "standby (or drain first): the decode loop must "
+                    "never race its own weights")
+            if draft:
+                self.draft_params = placed
+                for pool in pools:
+                    if getattr(pool, "draft_params", None) is not None:
+                        pool.draft_params = placed
+                self.draft_version = version
+            else:
+                self.params = placed
+                for pool in pools:
+                    pool.params = placed
+                self.model_version = version
+        cleared = 0
+        if self.kv_state is not None and self.kv_state.prefix is not None:
+            cleared = self.kv_state.prefix.clear()
+        ms = (self.clock() - t0) * 1e3
+        self.metrics.on_weight_swap(version, ms, draft=draft,
+                                    cleared_pages=cleared)
+        if not draft and version is not None:
+            self.metrics.on_model_version(version)
+
+    def swap_from_manifest(self, mpath: str, *,
+                           draft: bool = False) -> Dict[str, Any]:
+        """Restore a published sharded-checkpoint manifest (PR 10's
+        atomic format) into this replica's device buffers — the
+        checkpoint-namespace half of the hot swap: assemble the
+        manifest's leaves on host (config validated against the
+        loaded model FIRST — :class:`SwapMismatchError` on drift),
+        place them under the current params' own shardings, flip.
+        Returns the manifest's version dict ({step, digest,
+        label})."""
+        from tpuflow.serve.deploy import (
+            load_host_params,
+            manifest_version,
+            place_like,
+        )
+
+        target = self.draft_params if draft else self.params
+        if draft and target is None:
+            raise ValueError(
+                "draft swap on a non-speculating scheduler")
+        version = manifest_version(mpath)
+        host = load_host_params(mpath, target)
+        placed = place_like(host, target)
+        self.swap_weights(placed, version=version, draft=draft)
+        return version
+
+    def reopen(self) -> None:
+        """Re-admit after a drain — the recycle half of blue/green:
+        a drained-out old-version replica becomes the next standby,
+        gets the NEXT version swapped in, and reopens. Refused while
+        the admitted backlog is still in flight (reopening mid-drain
+        would un-503 a replica the router already routed around)."""
+        with self._lock:
+            if any(self._queues.values()) or any(
+                    p.live_count() for p in self.pools.values()):
+                raise RuntimeError(
+                    "reopen() before the drain finished — the "
+                    "admitted backlog is still in flight")
+            self._closed = False
+            self._draining = False
+        from tpuflow.obs.gauges import set_gauge
+
+        set_gauge(f"{self.metrics.prefix}.draining", 0.0)
+        self.metrics.event("-scheduler-", "reopen")
+
     # ---- health (per-replica isolation, ISSUE 14 satellite) ---------
     @property
     def watchdog(self):
@@ -1448,7 +1580,12 @@ class ServeScheduler:
             "replica_class": self.replica_class,
             "kv_transfer_pages": self.metrics.kv_transfer_pages,
             "kv_transfer_bytes": self.metrics.kv_transfer_bytes,
+            # deployment sensors (ISSUE 15): the router's version
+            # fence / pin_version placement reads these
+            "model_version": self.model_version,
         }
+        if self.speculate_k:
+            out["draft_version"] = self.draft_version
         if self.kv_state is not None:
             a = self.kv_state.allocator
             out["kv_pages_free"] = a.free_count()
