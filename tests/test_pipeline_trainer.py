@@ -240,6 +240,11 @@ def test_interleaved_matches_unpipelined():
     np.testing.assert_allclose(ev["loss"], ev_ref["loss"], rtol=2e-4)
 
 
+# demoted to slow tier in r16 (tier-1 wall-clock budget):
+# test_interleaved_matches_unpipelined keeps the interleaved parity
+# pin; this adds the dp-x-pp mesh and a deeper pipe on the same
+# schedule
+@pytest.mark.slow
 def test_interleaved_dp_x_pp_and_deep_pipe():
     """Interleaved over a 4-deep pipe (v=2, 8 model chunks) and under
     DP x PP row sharding — both must reproduce the unpipelined run."""

@@ -106,10 +106,11 @@ def test_keras_mnv2_legacy_fixture_roundtrip(tmp_path):
     np.testing.assert_array_equal(gotd, np.transpose(srcd, (0, 1, 3, 2)))
 
 
-# depth 18 exercises the whole conversion path; the deeper fixture
-# adds only size, so it is slow-tier
-@pytest.mark.parametrize(
-    "depth", [18, pytest.param(50, marks=pytest.mark.slow)])
+# demoted to slow tier in r16 (tier-1 wall-clock budget): the whole
+# fixture conversion path rides here at ResNet compile cost; the
+# keras fixture roundtrips keep the schema pins tier-1
+@pytest.mark.slow
+@pytest.mark.parametrize("depth", [18, 50])
 def test_torchvision_resnet_fixture_roundtrip(tmp_path, depth):
     import torch
 

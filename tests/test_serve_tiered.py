@@ -1,0 +1,396 @@
+"""Tiered KV hierarchy (ISSUE 16): host-RAM/disk spill pool under
+PagedKV + the router's tier-global prefix directory.
+
+Tier discipline: the test_serve_paged.py / test_serve_disagg.py pool
+geometry (slots=2, seg=4, cap=12, page_size=4, kv_pages=49) and the
+same sampled config so compiled join/segment executables are
+process-wide LRU hits.
+
+The load-bearing pins:
+
+- demote → promote round-trips BIT-IDENTICAL page payloads (f32 AND
+  int8): the spill pool stores the PR 14 wire verbatim, and a promote
+  replays EXACT pages, not equivalents;
+- a decode over a promoted chain is TOKEN-IDENTICAL (greedy and
+  sampled) to a never-evicted scheduler's — the hierarchy is pure
+  memory management;
+- the host pool enforces its byte budget LRU-first (overflow spills to
+  disk when configured, else drops), and a demote/promote churn leaves
+  allocator refcounts balanced (in_use == tree nodes; clear() -> 0);
+- the tier directory routes a prefix computed on a PARKED replica to
+  the placed one via a cross-replica pull — the destination imports
+  instead of recomputing, tokens still oracle-identical;
+- a corrupt spilled chain (host bytes flipped, or a mangled disk
+  file) falls back to plain prefill with NOTHING retained, the entry
+  dropped and the corruption counted.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpuflow.models import build_transformer_lm
+
+KW = dict(vocab_size=128, dim=32, depth=1, heads=2, mlp_ratio=2,
+          dtype=jnp.float32)
+GEO = dict(slots=2, seg=4, max_new_cap=12)
+PS = 4
+SAMPLED = dict(temperature=0.8, top_k=20, seed=7)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import flax.linen as nn
+
+    lm = build_transformer_lm(**KW)
+    params = nn.unbox(
+        lm.init({"params": jax.random.key(0)}, jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    return lm, params
+
+
+def _sched(tiny_lm, **kw):
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = tiny_lm
+    base = dict(GEO, kv="paged", kv_page_size=PS, kv_pages=49)
+    base.update(kw)
+    return ServeScheduler(lm, params, **base)
+
+
+def _drain(s, *reqs):
+    s.run_until_idle()
+    for r in reqs:
+        assert r.state.value == "done", (r.state.value, r.error)
+    return [list(r.tokens) for r in reqs]
+
+
+def _filled_kv(lm, quant=None, **kw):
+    """A PagedKV whose store holds KNOWN content (no model pass —
+    the wire does not care how page content got there)."""
+    from tpuflow.serve.pages import PagedKV, PagedKVSpec
+
+    kv = PagedKV(lm, PagedKVSpec(pages=16, page_size=PS, quant=quant),
+                 **kw)
+    rng = np.random.default_rng(3)
+
+    def fill(leaf):
+        if leaf.dtype == jnp.int8:
+            return jnp.asarray(
+                rng.integers(-127, 128, leaf.shape).astype(np.int8))
+        return jnp.asarray(rng.normal(size=leaf.shape).astype(
+            np.dtype(str(leaf.dtype))))
+
+    kv.cache = jax.tree.map(fill, kv.cache)
+    return kv
+
+
+# ---------------------------------------------------------------------
+# demote -> promote: bit-identical payloads, f32 and int8
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_demote_promote_roundtrip_bit_identical(tiny_lm, quant):
+    """An LRU-evicted chain lands in the host pool (demote) and a
+    later plan() over the same prefix imports it back (promote) —
+    payload bytes and CRCs identical to the pre-eviction export."""
+    lm, _ = tiny_lm
+    kv = _filled_kv(lm, quant=quant, host_bytes=1 << 20)
+    rng = np.random.default_rng(4)
+    toks = rng.integers(1, 128, (12,)).astype(np.int32)
+    pages = kv.allocator.alloc(3)
+    kv.prefix.insert(toks, pages)
+    kv.allocator.release(pages)  # tree-only: evictable
+    wire0 = kv.export_chain(toks, pages)
+
+    assert kv.prefix.evict_lru(3) == 3
+    assert kv.allocator.in_use() == 0
+    st = kv.tier.stats()
+    assert st["host_chains"] == 1 and st["demotes"] == 1
+    assert st["demoted_pages"] == 3
+    assert st["host_bytes_used"] > 0
+
+    prompt = np.concatenate([toks, [99]]).astype(np.int32)
+    plan = kv.plan(prompt, 1)
+    assert plan is not None and plan.matched_tokens == 12
+    st = kv.tier.stats()
+    assert st["promotes"] == 1 and st["promoted_pages"] == 3
+
+    back_pages, m_tok, _ = kv.prefix.match(toks)
+    assert m_tok == 12
+    back = kv.export_chain(toks, back_pages[:3])
+    assert back["payloads"] == wire0["payloads"]
+    assert back["crc32"] == wire0["crc32"]
+    kv.release(plan)
+    assert kv.allocator.in_use() == kv.prefix.nodes
+
+
+def test_demote_gating_and_dedup(tiny_lm):
+    """Chains below spill_min_pages never demote; re-evicting an
+    already-covered chain refreshes recency instead of re-exporting;
+    clear() (the weight-swap invalidation) discards, never spills."""
+    lm, _ = tiny_lm
+    kv = _filled_kv(lm, host_bytes=1 << 20)
+    rng = np.random.default_rng(5)
+    short = rng.integers(1, 128, (4,)).astype(np.int32)  # 1 page
+    p1 = kv.allocator.alloc(1)
+    kv.prefix.insert(short, p1)
+    kv.allocator.release(p1)
+    kv.prefix.evict_lru(1)
+    assert kv.tier.stats()["demotes"] == 0  # below the warmth gate
+
+    toks = rng.integers(1, 128, (8,)).astype(np.int32)  # 2 pages
+    for _ in range(2):
+        pg = kv.allocator.alloc(2)
+        kv.prefix.insert(toks, pg)
+        kv.allocator.release(pg)
+        kv.prefix.evict_lru(2)
+    assert kv.tier.stats()["demotes"] == 1  # second eviction deduped
+
+    pg = kv.allocator.alloc(2)
+    other = rng.integers(1, 128, (8,)).astype(np.int32)
+    kv.prefix.insert(other, pg)
+    kv.allocator.release(pg)
+    kv.prefix.clear()
+    assert kv.tier.stats()["demotes"] == 1  # clear() spilled nothing
+
+
+# ---------------------------------------------------------------------
+# promoted decode == never-evicted oracle, greedy and sampled
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("samp", [{}, SAMPLED],
+                         ids=["greedy", "sampled"])
+def test_promoted_decode_token_identical(tiny_lm, samp):
+    """Turn 2 of a conversation whose turn-1 chain was evicted (and
+    demoted) decodes token-identically to a scheduler that never
+    evicted — with the prefix coming back through a PROMOTE, not a
+    recompute."""
+    rng = np.random.default_rng(6)
+    p1 = rng.integers(1, 128, (13,)).astype(np.int32)
+    p2 = np.concatenate(
+        [p1[:12], rng.integers(1, 128, (5,))]).astype(np.int32)
+
+    o = _sched(tiny_lm, **samp)
+    r1 = o.submit(p1, 8)
+    _drain(o, r1)
+    r2 = o.submit(p2, 8)
+    [_, want] = [_drain(o, r1)[0], _drain(o, r2)[0]]
+
+    s = _sched(tiny_lm, kv_host_bytes=1 << 20, **samp)
+    q1 = s.submit(p1, 8)
+    _drain(s, q1)
+    evicted = s.kv_state.prefix.evict_lru(49)
+    assert evicted >= 3 and s.kv_state.tier.stats()["demotes"] >= 1
+    q2 = s.submit(p2, 8)
+    [got] = _drain(s, q2)
+    assert got == want
+    st = s.kv_state.tier.stats()
+    assert st["promotes"] >= 1 and st["promoted_pages"] >= 3
+    assert s.metrics.prefill_tokens_saved >= 12
+    assert s.kv_state.allocator.in_use() == s.kv_state.prefix.nodes
+
+
+# ---------------------------------------------------------------------
+# host-pool byte budget: LRU order, disk overflow, refcount balance
+# ---------------------------------------------------------------------
+
+def test_host_pool_budget_lru_and_disk_spill(tiny_lm, tmp_path):
+    """The pool drops (or disk-spills) LRU-first when the host budget
+    binds; a re-put refreshes recency; disk entries load back through
+    mmap and import bit-identically."""
+    from tpuflow.serve.pages import TieredChainPool, chunk_keys
+
+    lm, _ = tiny_lm
+    kv = _filled_kv(lm)
+    rng = np.random.default_rng(7)
+    wires = []
+    for i in range(4):
+        toks = rng.integers(1, 128, (8,)).astype(np.int32)
+        wires.append(kv.export_chain(toks, [2 * i + 1, 2 * i + 2]))
+    nb = sum(len(p) for p in wires[0]["payloads"])
+
+    pool = TieredChainPool(host_bytes=int(2.5 * nb))
+    assert pool.put(wires[0]) and pool.put(wires[1])
+    assert pool.put(wires[2])
+    st = pool.stats()
+    assert st["host_chains"] == 2 and st["drops"] == 1  # w0 was LRU
+    assert not pool.covers(wires[0]["chunk_keys"][-1])
+    assert pool.put(wires[1]) is False  # dedup: refresh only
+    assert pool.put(wires[3])  # now w2 is LRU -> dropped
+    assert pool.covers(wires[1]["chunk_keys"][-1])
+    assert not pool.covers(wires[2]["chunk_keys"][-1])
+    assert pool.stats()["host_bytes_used"] <= int(2.5 * nb)
+
+    disked = TieredChainPool(host_bytes=nb + nb // 2,
+                             disk_path=str(tmp_path / "spill"))
+    assert disked.put(wires[0]) and disked.put(wires[1])
+    st = disked.stats()
+    assert st["disk_spills"] == 1 and st["disk_chains"] == 1
+    assert st["host_chains"] == 1 and st["drops"] == 0
+    keys = chunk_keys(np.asarray(wires[0]["tokens"], np.int32), PS)
+    hit = disked.match(keys, min_pages=2)
+    assert hit is not None and hit["payloads"] == wires[0]["payloads"]
+    assert disked.stats()["disk_loads"] == 1
+    imp = _filled_kv(lm)
+    assert imp.import_chain(hit) == 2  # CRC-verified landing
+    assert disked.clear() == 2  # disk files unlinked too
+    assert list((tmp_path / "spill").glob("*.kvchain")) == []
+
+
+def test_churn_refcount_balance(tiny_lm):
+    """Several demote/promote cycles leave the device store balanced:
+    every resident page is tree-reachable, and clearing the tree (plus
+    the pool) frees everything."""
+    rng = np.random.default_rng(8)
+    s = _sched(tiny_lm, kv_host_bytes=1 << 20, **SAMPLED)
+    prompts = [rng.integers(1, 128, (13,)).astype(np.int32)
+               for _ in range(4)]
+    for round_ in range(2):
+        for p in prompts:
+            r = s.submit(p, 6)
+            _drain(s, r)
+        s.kv_state.prefix.evict_lru(49)
+    st = s.kv_state.tier.stats()
+    assert st["demotes"] >= 4 and st["promotes"] >= 1
+    kvs = s.kv_state
+    assert kvs.allocator.in_use() == kvs.prefix.nodes
+    kvs.prefix.clear()
+    assert kvs.allocator.in_use() == 0
+    kvs.tier.clear()
+    assert kvs.tier.stats()["host_chains"] == 0
+    snap = kvs.snapshot()
+    assert snap["tier"]["host_bytes_used"] == 0
+
+
+# ---------------------------------------------------------------------
+# tier-global prefix directory: cross-replica pull
+# ---------------------------------------------------------------------
+
+def test_directory_cross_replica_pull_token_identical(tiny_lm):
+    """Replica h computes a prefix, h parks standby, and the SAME
+    prefix routes to the other replica — which PULLS h's chain via
+    the directory instead of recomputing, token-identical to the
+    single-scheduler oracle."""
+    from tpuflow.obs.health import Watchdog
+    from tpuflow.serve.metrics import ServeMetrics
+    from tpuflow.serve.replica import InProcessReplica
+    from tpuflow.serve.router import Router
+
+    rng = np.random.default_rng(9)
+    p1 = rng.integers(1, 128, (13,)).astype(np.int32)
+    p2 = np.concatenate(
+        [p1[:12], rng.integers(1, 128, (5,))]).astype(np.int32)
+
+    o = _sched(tiny_lm, **SAMPLED)
+    want1 = _drain(o, o.submit(p1, 8))[0]
+    want2 = _drain(o, o.submit(p2, 8))[0]
+
+    # per-replica watchdogs (what the CLI injects): the router's
+    # health sweep must not read a PREVIOUS test's latched
+    # process-default trip as this tier's failure
+    scheds = [
+        _sched(tiny_lm, kv_host_bytes=1 << 20, watchdog=Watchdog(),
+               metrics=ServeMetrics(gauge_prefix=f"serve.replica{r}"),
+               **SAMPLED)
+        for r in range(2)
+    ]
+    reps = [InProcessReplica(sc, name=f"replica{r}")
+            for r, sc in enumerate(scheds)]
+    router = Router(reps, tier_directory=True)
+
+    def drive(rr):
+        for _ in range(5000):
+            if rr.state.value in ("done", "failed"):
+                return
+            for rep in reps:
+                if not rep.idle():
+                    rep.step()
+            router.maintain()
+        raise AssertionError("directory run wedged")
+
+    rr1 = router.submit(p1, max_new_tokens=8)
+    drive(rr1)
+    assert rr1.state.value == "done" and list(rr1.tokens) == want1
+    h = next(i for i in range(2)
+             if scheds[i].kv_state.allocator.in_use() > 0)
+    other = 1 - h
+    router.set_standby(h)
+    rr2 = router.submit(p2, max_new_tokens=8)
+    drive(rr2)
+    assert rr2.state.value == "done", rr2.error
+    assert list(rr2.tokens) == want2
+    snap = router.snapshot()
+    assert snap["router.pulls"] >= 1
+    assert snap.get("router.pull_fallbacks", 0) == 0
+    # the destination IMPORTED the prefix it never computed
+    assert scheds[other].kv_state.imports >= 1
+    assert scheds[other].metrics.prefix_hits >= 1
+    assert scheds[other].metrics.prefill_tokens_saved >= 12
+    assert snap["router.directory_table"] >= 1
+
+
+# ---------------------------------------------------------------------
+# corruption: fall back to prefill, nothing retained
+# ---------------------------------------------------------------------
+
+def test_corrupt_host_spill_falls_back(tiny_lm):
+    """Flipped payload bytes in a pooled chain fail the import CRC at
+    promote time: the entry drops (counted corrupt), the plan falls
+    back to plain prefill, tokens still match the oracle and no pages
+    leak."""
+    rng = np.random.default_rng(10)
+    p1 = rng.integers(1, 128, (13,)).astype(np.int32)
+    p2 = np.concatenate(
+        [p1[:12], rng.integers(1, 128, (5,))]).astype(np.int32)
+
+    o = _sched(tiny_lm, **SAMPLED)
+    _drain(o, o.submit(p1, 8))
+    want = _drain(o, o.submit(p2, 8))[0]
+
+    s = _sched(tiny_lm, kv_host_bytes=1 << 20, **SAMPLED)
+    _drain(s, s.submit(p1, 8))
+    s.kv_state.prefix.evict_lru(49)
+    tier = s.kv_state.tier
+    ent = next(iter(tier._entries.values()))
+    ent["wire"]["payloads"][1] = (
+        b"\xff" + ent["wire"]["payloads"][1][1:])
+    before = s.kv_state.allocator.in_use()
+    r2 = s.submit(p2, 8)
+    [got] = _drain(s, r2)
+    assert got == want  # recomputed, not truncated
+    st = tier.stats()
+    assert st["corrupt_drops"] == 1
+    assert st["host_chains"] == 0  # the bad chain is GONE
+    assert st["promoted_pages"] == 0  # nothing retained
+    assert s.kv_state.allocator.in_use() == s.kv_state.prefix.nodes
+    assert before <= s.kv_state.allocator.in_use()  # no leak from the
+    # failed import (the new request's chain is tree-held)
+
+
+def test_corrupt_disk_spill_drops_on_match(tiny_lm, tmp_path):
+    """A mangled spill file (bad magic) is rejected at load: match()
+    drops the entry, counts the corruption and reports no coverage —
+    the caller recomputes."""
+    from tpuflow.serve.pages import TieredChainPool, chunk_keys
+
+    lm, _ = tiny_lm
+    kv = _filled_kv(lm)
+    rng = np.random.default_rng(11)
+    toks = rng.integers(1, 128, (8,)).astype(np.int32)
+    wire = kv.export_chain(toks, [1, 2])
+    pool = TieredChainPool(host_bytes=1,
+                           disk_path=str(tmp_path / "spill"))
+    assert pool.put(wire)  # budget of 1 byte -> straight to disk
+    st = pool.stats()
+    assert st["disk_spills"] == 1 and st["host_chains"] == 0
+    [path] = (tmp_path / "spill").glob("*.kvchain")
+    blob = path.read_bytes()
+    path.write_bytes(b"XXXXXX" + blob[6:])  # clobber the magic
+    keys = chunk_keys(toks, PS)
+    assert pool.match(keys) is None
+    st = pool.stats()
+    assert st["corrupt_drops"] == 1 and st["disk_chains"] == 0
+    assert not pool.covers(wire["chunk_keys"][-1])

@@ -390,6 +390,28 @@ def format_kv_section(snap: Dict[str, Any]) -> str:
             f"{pfx.get('inserts', 0)} inserts, "
             f"{pfx.get('evictions', 0)} evictions"
         )
+    tier = snap.get("tier")
+    if tier:
+        lines.append(
+            f"tier host: {tier.get('host_bytes_used', 0) / 1e6:.2f}/"
+            f"{tier.get('host_bytes_budget', 0) / 1e6:.2f} MB, "
+            f"{tier.get('host_chains', 0)} chains"
+        )
+        if tier.get("disk_chains") or tier.get("disk_spills"):
+            lines.append(
+                f"tier disk: {tier.get('disk_bytes_used', 0) / 1e6:.2f} "
+                f"MB, {tier.get('disk_chains', 0)} chains "
+                f"({tier.get('disk_spills', 0)} spills, "
+                f"{tier.get('disk_loads', 0)} loads)"
+            )
+        lines.append(
+            f"tier flow: {tier.get('demotes', 0)} demotes "
+            f"({tier.get('demoted_pages', 0)} pages), "
+            f"{tier.get('promotes', 0)} promotes "
+            f"({tier.get('promoted_pages', 0)} pages), "
+            f"{tier.get('drops', 0)} drops, "
+            f"{tier.get('corrupt_drops', 0)} corrupt"
+        )
     pools = snap.get("pools") or {}
     for b in sorted(pools, key=lambda x: int(x)):
         rows = pools[b]

@@ -153,11 +153,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "on bf16 models, ~4x on f32)")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="--kv paged: disable shared-prefix page reuse")
-    p.add_argument("--kv-prefix-insert-generated", action="store_true",
-                   help="deprecated no-op: generated-page insertion is "
-                        "the DEFAULT since the r11 A/B verdict "
-                        "(BENCH_LOCAL_r11 insert_generated.verdict = "
-                        "enable_by_default); see the --no- variant")
     p.add_argument("--no-kv-prefix-insert-generated", action="store_true",
                    help="--kv paged: do NOT publish finished requests' "
                         "GENERATED pages into the prefix cache "
@@ -165,6 +160,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "prompt+completion+... hit past the original "
                         "prompt; completion pages stay in the tree "
                         "until LRU pressure evicts them)")
+    p.add_argument("--kv-host-bytes", type=int, default=0,
+                   metavar="BYTES",
+                   help="--kv paged: tiered KV (ISSUE 16) — spill "
+                        "warm prefix chains evicted from the device "
+                        "page store into a host-RAM pool of at most "
+                        "BYTES, and promote them back (import, no "
+                        "recompute) when a later prompt hits the "
+                        "spilled prefix. 0 disables the tier. Size it "
+                        "to a few times the device store: bytes per "
+                        "page = page_size * 2 * layers * heads * "
+                        "head_dim * dtype bytes")
+    p.add_argument("--kv-disk-path", default=None, metavar="DIR",
+                   help="--kv-host-bytes: second tier — when the host "
+                        "pool overflows, spill host-LRU chains to "
+                        "mmap'd files under DIR instead of dropping "
+                        "them (CRC-checked on load; corruption falls "
+                        "back to recompute)")
+    p.add_argument("--kv-tier-directory", action="store_true",
+                   help="--replicas>1: tier-global prefix directory — "
+                        "the router tracks which replica (and tier) "
+                        "holds each chunk-key chain and, on an "
+                        "affinity miss, pulls the chain from any "
+                        "holder onto the placed replica instead of "
+                        "recomputing the prefix")
     p.add_argument("--prefill-slo", type=int, default=None,
                    metavar="TOKENS",
                    help="--kv paged: chunked-prefill SLO knob (ISSUE "
@@ -231,6 +250,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "tpuflow.cli.obs postmortem DIR); a graceful "
                         "drain dumps a final 'drain complete' bundle "
                         "whose manifest notes carry the drain")
+    raw_argv = sys.argv[1:] if argv is None else list(argv)
+    if any(a == "--kv-prefix-insert-generated"
+           or a.startswith("--kv-prefix-insert-generated=")
+           for a in raw_argv):
+        # removed in r16: it had been a no-op since the r11 A/B
+        # verdict made generated-page insertion the default
+        p.error("--kv-prefix-insert-generated was removed: "
+                "generated-page insertion is the default; drop the "
+                "flag, or pass --no-kv-prefix-insert-generated to "
+                "turn it OFF")
     args = p.parse_args(argv)
 
     if not args.model and not args.connect:
@@ -265,6 +294,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "with --speculate-k")
     if args.prefill_slo is not None and args.kv != "paged":
         p.error("--prefill-slo (chunked prefill) requires --kv paged")
+    if (args.kv_host_bytes or args.kv_disk_path) and args.kv != "paged":
+        p.error("--kv-host-bytes / --kv-disk-path (tiered KV) require "
+                "--kv paged")
+    if args.kv_disk_path and not args.kv_host_bytes:
+        p.error("--kv-disk-path needs --kv-host-bytes (the disk tier "
+                "backs the host pool's overflow)")
+    if (args.kv_host_bytes or args.kv_disk_path) and args.no_prefix_cache:
+        p.error("tiered KV spills the prefix tree's evictions; it "
+                "cannot combine with --no-prefix-cache")
+    if (args.kv_tier_directory and args.connect is None
+            and max(1, int(args.replicas)) == 1 and not args.standby):
+        p.error("--kv-tier-directory is router policy: it needs "
+                "--replicas > 1, --standby or --connect")
     if args.prefill_slo is not None and args.prefill_slo < 1:
         p.error("--prefill-slo must be >= 1 (omit it for atomic joins)")
     if args.ring_prefill is not None:
@@ -322,6 +364,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             kv_prefix_cache=not args.no_prefix_cache,
             kv_prefix_insert_generated=(
                 not args.no_kv_prefix_insert_generated),
+            kv_host_bytes=args.kv_host_bytes,
+            kv_disk_path=args.kv_disk_path,
             prefill_budget_tokens=args.prefill_slo,
             ring_prefill=args.ring_prefill,
             ring_prefill_min_tokens=args.ring_prefill_min,
@@ -346,6 +390,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         router_kw = dict(
             affinity=not args.no_affinity,
             transfer_chunk_pages=args.transfer_chunk_pages,
+            tier_directory=args.kv_tier_directory,
         )
         if args.transfer_min_tokens is not None:
             router_kw["transfer_min_tokens"] = args.transfer_min_tokens

@@ -52,6 +52,13 @@ replica of a router tier; single-scheduler servers only)::
   GET  /v1/worker/load_snapshot  the placement sensor, verbatim
   GET  /v1/worker/health         the failover input (scheduler.health)
   GET  /v1/worker/retry_after    {"retry_after_s": ...}
+  GET  /v1/worker/chain_report   tiered-KV chunk-key inventory (ISSUE
+                                 16; feeds the router's tier-global
+                                 prefix directory)
+  POST /v1/worker/fetch_chain    deepest exportable chain covering the
+                                 posted tokens (resident pages or the
+                                 host/disk tier) — the donor half of a
+                                 directory-routed cross-replica pull
   POST /v1/worker/encode|decode  tokenizer proxy (router-side string
                                  prompts without local weights)
   POST /v1/worker/submit         raw-token submit with stream_id /
@@ -203,6 +210,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path == "/v1/worker/retry_after":
                 self._json(200,
                            {"retry_after_s": sched.retry_after_s()})
+            elif self.path == "/v1/worker/chain_report":
+                self._json(200, {"chains": sched.kv_chain_report()})
             else:
                 self._json(404, {"error": f"no route {self.path}"})
         elif self.path.startswith("/v1/events/"):
@@ -348,6 +357,22 @@ class _Handler(BaseHTTPRequestHandler):
                 transfer_id=body.get("transfer_id"),
                 last=bool(body.get("last", True)))
             return self._json(200, {"transfer_id": tid, "ok": True})
+        if self.path == "/v1/worker/fetch_chain":
+            # directory pull donor (ISSUE 16): answer with this
+            # worker's deepest coverage of the prefix (resident tree
+            # re-export or spilled chain). The scheduler answers at
+            # its next boundary; this handler thread blocks, the
+            # decode loop does not.
+            from tpuflow.serve.pages import wire_to_json
+
+            tokens = body.get("tokens")
+            if tokens is None:
+                raise ValueError("fetch_chain needs 'tokens'")
+            timeout = float(body.get("timeout_s")
+                            or self.server.request_timeout_s)
+            wire = sched.fetch_chain(tokens, timeout=timeout)
+            return self._json(200, {
+                "wire": None if wire is None else wire_to_json(wire)})
         if self.path == "/v1/worker/fail_transfer":
             tid = body.get("transfer_id")
             if not tid:

@@ -149,6 +149,11 @@ class ServeMetrics:
         self.kv_exports = 0
         self.kv_imports = 0
         self.kv_transfer_failures = 0
+        # tiered KV hierarchy (ISSUE 16): cumulative demote/promote
+        # counts mirrored off the store's spill pool at each boundary
+        # (on_kv delta-publishes them as registry counters too)
+        self.kv_demotes = 0
+        self.kv_promotes = 0
         # live weight hot-swaps (ISSUE 15): model + draft combined;
         # the per-kind split lives on the registry counters
         self.weight_swaps = 0
@@ -470,6 +475,29 @@ class ServeMetrics:
                   float(kv_state.bytes_in_use()))
         set_gauge(f"{self.prefix}.kv_bytes_total",
                   float(kv_state.bytes_total()))
+        tier = getattr(kv_state, "tier", None)
+        if tier is not None:
+            st = tier.stats()
+            set_gauge(f"{self.prefix}.kv_host_bytes",
+                      float(st["host_bytes_used"]))
+            set_gauge(f"{self.prefix}.kv_host_chains",
+                      float(st["host_chains"]))
+            set_gauge(f"{self.prefix}.kv_disk_bytes",
+                      float(st["disk_bytes_used"]))
+            set_gauge(f"{self.prefix}.kv_disk_chains",
+                      float(st["disk_chains"]))
+            # delta-publish the pool's cumulative counters so the
+            # registry's *_total counters stay monotone across
+            # boundaries (the mirror fields feed snapshot())
+            with self._lock:
+                d = int(st["demotes"]) - self.kv_demotes
+                p = int(st["promotes"]) - self.kv_promotes
+                self.kv_demotes = int(st["demotes"])
+                self.kv_promotes = int(st["promotes"])
+            if d > 0:
+                inc_counter(f"{self.prefix}.kv_demotes_total", d)
+            if p > 0:
+                inc_counter(f"{self.prefix}.kv_promotes_total", p)
 
     def reset_latency(self) -> None:
         """Start a fresh accumulation window for every latency
@@ -520,6 +548,8 @@ class ServeMetrics:
             m[f"{self.prefix}.kv_imports"] = float(self.kv_imports)
             m[f"{self.prefix}.kv_transfer_failures"] = float(
                 self.kv_transfer_failures)
+            m[f"{self.prefix}.kv_demotes"] = float(self.kv_demotes)
+            m[f"{self.prefix}.kv_promotes"] = float(self.kv_promotes)
             m[f"{self.prefix}.weight_swaps"] = float(self.weight_swaps)
             m[f"{self.prefix}.spec_rounds"] = float(self.spec_rounds)
             m[f"{self.prefix}.spec_drafted"] = float(self.spec_drafted)

@@ -41,7 +41,7 @@ from __future__ import annotations
 import math
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -108,6 +108,13 @@ class PageWireError(RuntimeError):
     work-placement optimization), never a truncated stream."""
 
 
+class PageStoreDry(PageWireError):
+    """The importer's allocator could not cover a VALID wire even
+    after LRU pressure. Split out from the corruption cases (ISSUE 16)
+    so the promote path can tell "drop this spilled chain, it is bad"
+    from "the store is merely full right now — keep the chain"."""
+
+
 def split_chain(wire: Dict[str, Any],
                 chunk_pages: int) -> List[Dict[str, Any]]:
     """Split one :meth:`PagedKV.export_chain` wire into transferable
@@ -161,6 +168,294 @@ def wire_from_json(obj: Dict[str, Any]) -> Dict[str, Any]:
     out = dict(obj)
     out["payloads"] = [base64.b64decode(p) for p in obj["payloads"]]
     return out
+
+
+class TieredChainPool:
+    """Host-RAM (and optional disk) spill tiers under one
+    :class:`PagedKV` (ISSUE 16). Entries are whole page chains in the
+    PR 14 WIRE FORMAT — the same self-describing, CRC-guarded unit the
+    disaggregation transfers ship — keyed by the chain's deepest chunk
+    key; an index from EVERY covered chunk key to its chain lets a
+    lookup match any prefix depth (the wire truncates cleanly at page
+    granularity). LRU within the pool under a byte budget: host
+    overflow spills to ``disk_path`` when set (payloads land in one
+    blob read back through ``mmap``), else the oldest chain drops.
+
+    Thread discipline: demote (:meth:`PrefixCache.evict_lru` →
+    ``on_evict``), promote (:meth:`PagedKV.plan`) and chain fetches
+    all run on the scheduler thread; a lock still guards every mutation
+    so foreign-thread :meth:`stats`/:meth:`report` reads (flight
+    recorder, router directory sweep) are safe."""
+
+    #: spill-file magic — a reader rejects anything else before parsing
+    DISK_MAGIC = b"TPKV1\n"
+
+    def __init__(self, host_bytes: int, *,
+                 disk_path: Optional[str] = None,
+                 disk_bytes: Optional[int] = None,
+                 clock: Callable[[], float] = time.time):
+        if host_bytes <= 0 and not disk_path:
+            raise ValueError(
+                "tiered pool needs a host byte budget > 0 and/or a "
+                "disk path")
+        self.host_bytes = int(max(0, host_bytes))
+        self.disk_path = disk_path
+        self.disk_bytes = None if disk_bytes is None else int(disk_bytes)
+        self.clock = clock
+        if disk_path:
+            import os
+
+            os.makedirs(disk_path, exist_ok=True)
+        self._lock = threading.Lock()
+        # head hex key -> entry; OrderedDict order IS the LRU order
+        # (host and disk entries share one recency stream: a disk hit
+        # is warmth too)
+        self._entries: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        # chunk hex key -> (head hex, page index within that chain)
+        self._index: Dict[str, Tuple[str, int]] = {}
+        self._host_used = 0
+        self._disk_used = 0
+        # counters (cumulative; the serve metrics plane mirrors them)
+        self.demotes = 0
+        self.promotes = 0
+        self.demoted_pages = 0
+        self.promoted_pages = 0
+        self.disk_spills = 0
+        self.disk_loads = 0
+        self.drops = 0  # chains evicted out of the hierarchy entirely
+        self.corrupt_drops = 0
+
+    # ---- internal helpers (callers hold _lock) ----------------------
+    def _unindex(self, head: str) -> None:
+        ent = self._entries.pop(head)
+        for k in ent["keys"]:
+            if self._index.get(k, (None,))[0] == head:
+                del self._index[k]
+        if ent["tier"] == "host":
+            self._host_used -= ent["bytes"]
+        else:
+            self._disk_used -= ent["bytes"]
+            if ent.get("path"):
+                import os
+
+                try:
+                    os.unlink(ent["path"])
+                except OSError:
+                    pass
+
+    def _spill_to_disk(self, head: str, ent: Dict[str, Any]) -> bool:
+        """Host → disk: payloads into one blob behind a JSON header,
+        written atomically (tmp + rename). Returns False (and the
+        entry drops) on any write failure."""
+        import json
+        import os
+
+        wire = ent["wire"]
+        header = {k: v for k, v in wire.items() if k != "payloads"}
+        header["payload_lens"] = [len(p) for p in wire["payloads"]]
+        path = os.path.join(self.disk_path, f"{head}.kvchain")
+        try:
+            hb = json.dumps(header).encode("utf-8")
+            with open(path + ".tmp", "wb") as f:
+                f.write(self.DISK_MAGIC)
+                f.write(len(hb).to_bytes(8, "big"))
+                f.write(hb)
+                for p in wire["payloads"]:
+                    f.write(p)
+            os.replace(path + ".tmp", path)
+        except OSError:
+            try:
+                os.unlink(path + ".tmp")
+            except OSError:
+                pass
+            return False
+        ent["wire"] = None
+        ent["path"] = path
+        ent["tier"] = "disk"
+        self._host_used -= ent["bytes"]
+        self._disk_used += ent["bytes"]
+        self.disk_spills += 1
+        return True
+
+    def _load_from_disk(self, ent: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Read one spilled chain back (payload blob through mmap).
+        Returns None — and the caller drops the entry — when the file
+        is missing/corrupt; payload CRCs are still verified later by
+        :meth:`PagedKV.import_chain` (the end-to-end guard)."""
+        import json
+        import mmap
+        import os
+
+        try:
+            with open(ent["path"], "rb") as f:
+                with mmap.mmap(f.fileno(), 0,
+                               access=mmap.ACCESS_READ) as mm:
+                    if mm[: len(self.DISK_MAGIC)] != self.DISK_MAGIC:
+                        return None
+                    o = len(self.DISK_MAGIC)
+                    hlen = int.from_bytes(mm[o:o + 8], "big")
+                    o += 8
+                    header = json.loads(mm[o:o + hlen].decode("utf-8"))
+                    o += hlen
+                    lens = header.pop("payload_lens")
+                    payloads = []
+                    for n in lens:
+                        payloads.append(bytes(mm[o:o + n]))
+                        o += n
+        except (OSError, ValueError, KeyError):
+            return None
+        wire = dict(header)
+        wire["payloads"] = payloads
+        self.disk_loads += 1
+        return wire
+
+    def _enforce_budgets(self) -> None:
+        while self._host_used > self.host_bytes:
+            head = next((h for h, e in self._entries.items()
+                         if e["tier"] == "host"), None)
+            if head is None:
+                break
+            ent = self._entries[head]
+            if not (self.disk_path and self._spill_to_disk(head, ent)):
+                self._unindex(head)
+                self.drops += 1
+        if self.disk_bytes is not None:
+            while self._disk_used > self.disk_bytes:
+                head = next((h for h, e in self._entries.items()
+                             if e["tier"] == "disk"), None)
+                if head is None:
+                    break
+                self._unindex(head)
+                self.drops += 1
+
+    # ---- write side (demote) ----------------------------------------
+    def covers(self, head_hex: str) -> bool:
+        """Whether a chain ending at this chunk key is already held —
+        the pre-export dedup check (skip the device gather)."""
+        with self._lock:
+            return head_hex in self._index
+
+    def put(self, wire: Dict[str, Any]) -> bool:
+        """Demote one exported chain into the host tier. A chain whose
+        head chunk is already covered only refreshes LRU recency (a
+        shallower chain is a prefix of a stored one — dedup)."""
+        keys = list(wire.get("chunk_keys") or ())
+        if not keys or not wire.get("n_pages"):
+            return False
+        head = keys[-1]
+        nbytes = wire_bytes(wire)
+        with self._lock:
+            hit = self._index.get(head)
+            if hit is not None:
+                self._entries[hit[0]]["last_used"] = self.clock()
+                self._entries.move_to_end(hit[0])
+                return False
+            ent = {"keys": keys, "wire": wire, "path": None,
+                   "bytes": nbytes, "tier": "host",
+                   "last_used": self.clock()}
+            self._entries[head] = ent
+            self._host_used += nbytes
+            for j, k in enumerate(keys):
+                # deeper chains win the index (a lookup through any of
+                # their keys must reach the deepest coverage)
+                self._index[k] = (head, j)
+            self.demotes += 1
+            self.demoted_pages += int(wire["n_pages"])
+            self._enforce_budgets()
+        return True
+
+    # ---- read side (promote / fetch) --------------------------------
+    def match(self, keys: List[bytes],
+              min_pages: int = 1) -> Optional[Dict[str, Any]]:
+        """Deepest stored coverage of a chunk-key chain, as an
+        importable wire truncated to the matched depth — or None when
+        nothing covers at least ``min_pages`` pages. A corrupt/missing
+        disk entry drops silently (the caller recomputes — the
+        PageWireError contract one level down)."""
+        with self._lock:
+            # index j covers j+1 pages, so the shallowest acceptable
+            # index is min_pages - 1
+            for j in range(len(keys) - 1, max(1, int(min_pages)) - 2, -1):
+                hit = self._index.get(keys[j].hex())
+                if hit is None:
+                    continue
+                head, idx = hit
+                ent = self._entries.get(head)
+                if ent is None:  # stale index row
+                    del self._index[keys[j].hex()]
+                    continue
+                wire = ent["wire"]
+                if wire is None:
+                    wire = self._load_from_disk(ent)
+                    if wire is None:
+                        self._unindex(head)
+                        self.corrupt_drops += 1
+                        continue
+                ent["last_used"] = self.clock()
+                self._entries.move_to_end(head)
+                n = idx + 1
+                ps = int(wire["page_size"])
+                out = {k: wire[k] for k in ("version", "page_size",
+                                            "quant", "leaves")}
+                out.update(
+                    n_pages=n, first_page=0,
+                    tokens=list(wire["tokens"][: n * ps]),
+                    chunk_keys=list(wire["chunk_keys"][:n]),
+                    payloads=list(wire["payloads"][:n]),
+                    crc32=list(wire["crc32"][:n]),
+                )
+                return out
+        return None
+
+    def drop(self, head_hex: str, corrupt: bool = False) -> bool:
+        """Remove one chain (the post-import-failure path: a CRC-bad
+        spill must not be retried forever)."""
+        with self._lock:
+            hit = self._index.get(head_hex)
+            if hit is None:
+                return False
+            self._unindex(hit[0])
+            self.drops += 1
+            if corrupt:
+                self.corrupt_drops += 1
+            return True
+
+    def clear(self) -> int:
+        """Drop every chain (disk files included) — the weight-swap
+        invalidation path: spilled KV under NEW weights is garbage."""
+        with self._lock:
+            n = len(self._entries)
+            for head in list(self._entries):
+                self._unindex(head)
+        return n
+
+    # ---- read-only views --------------------------------------------
+    def report(self) -> List[Dict[str, Any]]:
+        """Per-chain ``{'keys': [hex...], 'tier': ...}`` rows — what a
+        replica publishes to the router's tier-global directory."""
+        with self._lock:
+            return [{"keys": list(e["keys"]), "tier": e["tier"]}
+                    for e in self._entries.values()]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            host_chains = sum(1 for e in self._entries.values()
+                              if e["tier"] == "host")
+            return {
+                "host_bytes_budget": self.host_bytes,
+                "host_bytes_used": self._host_used,
+                "host_chains": host_chains,
+                "disk_bytes_used": self._disk_used,
+                "disk_chains": len(self._entries) - host_chains,
+                "demotes": self.demotes,
+                "promotes": self.promotes,
+                "demoted_pages": self.demoted_pages,
+                "promoted_pages": self.promoted_pages,
+                "disk_spills": self.disk_spills,
+                "disk_loads": self.disk_loads,
+                "drops": self.drops,
+                "corrupt_drops": self.corrupt_drops,
+            }
 
 
 @dataclass(frozen=True)
@@ -344,6 +639,13 @@ class PrefixCache:
         self.nodes = 0
         self.inserts = 0
         self.evictions = 0
+        # demote hook (ISSUE 16): called as ``on_evict(tokens, pages,
+        # last_used)`` with the ROOT→LEAF chain a leaf terminates,
+        # just before :meth:`evict_lru` drops it — the spill tier's
+        # entry point. NOT called from :meth:`clear` (invalidation
+        # must discard, a weight swap makes the KV garbage).
+        self.on_evict: Optional[Callable[
+            [np.ndarray, List[int], float], None]] = None
         # guards tree-STRUCTURE mutation vs foreign-thread stats():
         # the flight recorder dumps kv_snapshot from its own thread at
         # trip/SIGTERM time, possibly mid-insert on the scheduler
@@ -464,11 +766,31 @@ class PrefixCache:
                 if not cands:
                     break
                 for nd in cands:
+                    if self.on_evict is not None:
+                        self._offer_evicted(nd)
                     self._drop(nd)
                     freed += 1
                     if freed >= n_pages:
                         break
         return freed
+
+    def _offer_evicted(self, nd: _Node) -> None:
+        # caller holds _mutate_lock; spell out the root→leaf chain the
+        # doomed leaf terminates — the spill tier needs a whole
+        # importable unit, not one orphan page. Best-effort: eviction
+        # must free pages even when the demote path fails.
+        chain: List[_Node] = []
+        cur: Optional[_Node] = nd
+        while cur is not None:
+            chain.append(cur)
+            cur = cur.parent
+        chain.reverse()
+        try:
+            self.on_evict(
+                np.concatenate([c.tokens for c in chain]),
+                [c.page for c in chain], nd.last_used)
+        except Exception:
+            pass
 
     def clear(self) -> int:
         """Release every tree reference (deepest first). Pages shared
@@ -538,7 +860,13 @@ class PagedKV:
     def __init__(self, model, spec: PagedKVSpec, *,
                  prefix_cache: bool = True,
                  clock: Callable[[], float] = time.time,
-                 draft_model=None):
+                 draft_model=None,
+                 host_bytes: int = 0,
+                 disk_path: Optional[str] = None,
+                 disk_bytes: Optional[int] = None,
+                 spill_min_pages: int = 2,
+                 spill_max_idle_s: Optional[float] = None,
+                 promote_min_pages: int = 2):
         from tpuflow.infer.generate import paged_kv_arrays, paged_page_bytes
 
         self.model = model
@@ -572,6 +900,26 @@ class PagedKV:
         # metrics plane)
         self.exports = 0
         self.imports = 0
+        # tiered hierarchy (ISSUE 16): host-RAM / disk spill pools
+        # under this store. Demote rides the eviction hook (a chain
+        # evict_lru would discard exports into the pool instead);
+        # promote rides plan() (a spilled frontier deeper than the
+        # resident match imports before prefill falls back). Off by
+        # default — a budget of 0 and no disk path means no pool.
+        self.clock = clock
+        self.tier: Optional[TieredChainPool] = None
+        self.spill_min_pages = max(1, int(spill_min_pages))
+        self.spill_max_idle_s = spill_max_idle_s
+        self.promote_min_pages = max(1, int(promote_min_pages))
+        if host_bytes or disk_path:
+            if self.prefix is None:
+                raise ValueError(
+                    "the tiered KV hierarchy spills/refills the prefix "
+                    "tree — it needs prefix_cache=True")
+            self.tier = TieredChainPool(
+                int(host_bytes), disk_path=disk_path,
+                disk_bytes=disk_bytes, clock=clock)
+            self.prefix.on_evict = self._demote
         self._held_ratio_sum = 0.0
         self._held_ratio_n = 0
         self._held_cap_sum = 0.0
@@ -616,6 +964,15 @@ class PagedKV:
         if use_prefix and self.prefix is not None and p > 1:
             full_pages, m_tok, partial = self.prefix.match(prompt[:p - 1])
             m_full = m_tok // ps
+            if self.tier is not None and self._promote(prompt[:p - 1],
+                                                       m_full):
+                # a spilled frontier landed (plan() only runs at a
+                # scheduler boundary, so the promote lands like any
+                # transfer — decode never stalls on it); re-match to
+                # pick the deeper chain up
+                full_pages, m_tok, partial = self.prefix.match(
+                    prompt[:p - 1])
+                m_full = m_tok // ps
         need_total = self.pages_needed(p, max_new)
         if initial_new is None:
             need_init = need_total
@@ -878,7 +1235,7 @@ class PagedKV:
             self.prefix.evict_lru(short)
             fresh = self.allocator.alloc(n_new)
         if fresh is None:
-            raise PageWireError(
+            raise PageStoreDry(
                 f"allocator dry: {n_new} pages short even after LRU "
                 f"pressure — falling back to local prefill")
         # payload bytes -> per-leaf host arrays (k pages each)
@@ -909,6 +1266,80 @@ class PagedKV:
         self.allocator.release(fresh)
         self.imports += 1
         return n_new
+
+    # ---- tiered hierarchy (ISSUE 16) --------------------------------
+    def _demote(self, tokens: np.ndarray, pages: List[int],
+                last_used: float) -> None:
+        """Eviction hook: export a doomed tree chain into the spill
+        pool instead of discarding its warmth. Gated by the warmth
+        threshold — short chains (< ``spill_min_pages``) and chains
+        idle past ``spill_max_idle_s`` are not worth the gather; a
+        chain whose head the pool already covers is deduped BEFORE the
+        device read. Runs on the scheduler thread under the tree's
+        mutate lock (export reads device pages, never the tree)."""
+        if self.tier is None or len(pages) < self.spill_min_pages:
+            return
+        if (self.spill_max_idle_s is not None
+                and self.clock() - last_used > self.spill_max_idle_s):
+            return
+        ps = self.spec.page_size
+        keys = chunk_keys(tokens, ps)
+        if not keys or self.tier.covers(keys[-1].hex()):
+            return
+        self.tier.put(self.export_chain(tokens, pages))
+
+    def _promote(self, prompt: np.ndarray, m_full: int) -> bool:
+        """Prefix-miss path of :meth:`plan`: consult the spill pool
+        for coverage deeper than the resident match and import the
+        frontier before prefill falls back. Gated by the cost-table
+        crossover ``promote_min_pages`` (the bench measures import vs
+        recompute; 1-page promotes don't pay). A corrupt spill drops
+        from the pool and the plan proceeds as a plain miss — nothing
+        retained (the :class:`PageWireError` contract); a merely-dry
+        store keeps the chain for a later attempt. Returns whether
+        anything landed (the caller re-matches)."""
+        ps = self.spec.page_size
+        usable = (int(prompt.size) // ps) * ps
+        if usable // ps - m_full < self.promote_min_pages:
+            return False
+        keys = chunk_keys(prompt[:usable], ps)
+        hit = self.tier.match(
+            keys, min_pages=m_full + self.promote_min_pages)
+        if hit is None:
+            return False
+        try:
+            landed = self.import_chain(hit)
+        except PageStoreDry:
+            return False
+        except PageWireError:
+            self.tier.drop(hit["chunk_keys"][-1], corrupt=True)
+            return False
+        self.tier.promotes += 1
+        self.tier.promoted_pages += landed
+        return landed > 0
+
+    def chain_for(self, tokens) -> Optional[Dict[str, Any]]:
+        """Deepest exportable coverage of a token prefix as ONE wire —
+        the resident tree (re-exported) or a spilled chain, whichever
+        reaches further. The donor side of a directory-routed
+        cross-replica pull; scheduler thread only (device gather +
+        radix walk). None when nothing covers a single full page."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        ps = self.spec.page_size
+        full_pages: List[int] = []
+        m_full = 0
+        if self.prefix is not None and tokens.size >= ps:
+            full_pages, m_tok, _ = self.prefix.match(tokens)
+            m_full = m_tok // ps
+        if self.tier is not None and tokens.size >= ps:
+            hit = self.tier.match(chunk_keys(tokens, ps),
+                                  min_pages=m_full + 1)
+            if hit is not None:
+                return hit
+        if m_full:
+            return self.export_chain(tokens[:m_full * ps],
+                                     full_pages[:m_full])
+        return None
 
     def insert_prompt(self, prompt: np.ndarray, plan: PagePlan) -> int:
         """After the join prefill: publish the request's full prompt
@@ -988,4 +1419,6 @@ class PagedKV:
         out.update(self.allocator.stats())
         if self.prefix is not None:
             out["prefix"] = self.prefix.stats()
+        if self.tier is not None:
+            out["tier"] = self.tier.stats()
         return out

@@ -129,6 +129,16 @@ class InProcessReplica(Replica):
                       reason: str = "transfer failed") -> None:
         self.sched.fail_transfer(transfer_id, reason)
 
+    # ---- tiered KV / directory pulls (ISSUE 16) ---------------------
+    def request_chain(self, tokens, on_ready) -> None:
+        """Donor side of a directory pull: answer with this replica's
+        deepest coverage of the prefix (resident or spilled) via
+        ``on_ready(wire_or_None)`` at the scheduler's next boundary."""
+        self.sched.request_chain(tokens, on_ready)
+
+    def kv_chain_report(self) -> List[Dict[str, Any]]:
+        return self.sched.kv_chain_report()
+
     # ---- zero-downtime deployment (ISSUE 15) ------------------------
     @property
     def model_version(self):
@@ -516,6 +526,42 @@ class HTTPReplica(Replica):
                 "transfer_id": str(transfer_id), "reason": str(reason)})
         except Exception:
             pass  # an unreachable worker times the transfer out itself
+
+    # ---- tiered KV / directory pulls (ISSUE 16) ---------------------
+    def request_chain(self, tokens, on_ready) -> None:
+        """Donor side over HTTP: the blocking fetch rides a background
+        thread (the worker answers at its next scheduler boundary), so
+        the caller — the router, possibly on another replica's
+        scheduler thread — never blocks. ``on_ready(None)`` on any
+        transport fault: the puller falls back to local prefill."""
+        ids = np.asarray(tokens, np.int32).reshape(-1).tolist()
+
+        def run():
+            from tpuflow.serve.pages import wire_from_json
+
+            wire = None
+            try:
+                out = self._post_json("/v1/worker/fetch_chain",
+                                      {"tokens": ids})
+                if out.get("wire") is not None:
+                    wire = wire_from_json(out["wire"])
+            except Exception:
+                wire = None
+            try:
+                on_ready(wire)
+            except Exception:
+                pass
+
+        threading.Thread(
+            target=run, daemon=True,
+            name=f"tpuflow-httprep-fetch-{self.name}").start()
+
+    def kv_chain_report(self) -> List[Dict[str, Any]]:
+        try:
+            return list(self._get_json(
+                "/v1/worker/chain_report").get("chains", ()))
+        except Exception:
+            return []
 
     # ---- zero-downtime deployment (ISSUE 15) ------------------------
     def swap_from_manifest(self, mpath: str, *,

@@ -319,6 +319,7 @@ class Router:
         transfer_min_tokens: Optional[int] = None,
         transfer_chunk_pages: int = 8,
         standby: Sequence[int] = (),
+        tier_directory: bool = False,
     ):
         """``placement='load'`` is the real policy (least-loaded with
         prefix affinity when ``affinity``); ``'spray'`` hashes the
@@ -349,7 +350,23 @@ class Router:
         request admits there as a prefix hit. Every transfer failure
         (prefill rejected, wire CRC, dead replica) falls back to a
         plain local-prefill submit: tokens are identical either way,
-        so disaggregation is purely a placement optimization."""
+        so disaggregation is purely a placement optimization.
+
+        TIER-GLOBAL PREFIX DIRECTORY (ISSUE 16): ``tier_directory``
+        lifts the per-replica affinity table into a tier-wide map from
+        chunk-key chains to EVERY replica (and tier — resident page
+        tree, host pool, disk) holding them: placement writes feed the
+        resident entries, and the maintenance sweep merges each
+        replica's ``kv_chain_report()`` (its spilled chains). A
+        request whose prompt none of its home's caches cover, but
+        which SOME live replica holds ≥ ``transfer_min_tokens``
+        deeper, triggers a cross-replica PULL riding the exact
+        ``offer_chain``/``await_transfer`` machinery above: the holder
+        re-exports (or serves from its spill pool) at its next
+        boundary and the chain streams to the home in transfer chunks.
+        Every pull fault falls back to a local prefill — like the
+        disagg transfer, a pull is purely a work-placement
+        optimization and tokens are identical either way."""
         if not replicas:
             raise ValueError("router needs at least one replica")
         if placement not in ("load", "spray"):
@@ -426,6 +443,13 @@ class Router:
         # prefill-side affinity: repeated prefixes prefill where their
         # pages already sit in the PREFILL replica's own tree
         self._pf_affinity: "OrderedDict[bytes, int]" = OrderedDict()
+        # tier-global prefix directory (ISSUE 16): chunk key →
+        # {replica idx: tier} over every holder, resident AND spilled
+        # (LRU-capped like the affinity table; staleness is safe — a
+        # pull miss fail_transfers into a local prefill)
+        self.tier_directory = bool(tier_directory)
+        self._directory: "OrderedDict[bytes, Dict[int, str]]" = (
+            OrderedDict())
         if max_total_queue is None:
             mq = [self._safe_snapshot(i).get("max_queue")
                   for i in range(len(self.replicas))]
@@ -455,6 +479,7 @@ class Router:
             "shed": 0, "shed_kv": 0, "rejected": 0, "failovers": 0,
             "replicas_failed": 0, "drains": 0,
             "transfers": 0, "transfer_fallbacks": 0,
+            "pulls": 0, "pull_fallbacks": 0,
         }
         self.placements: Dict[str, int] = {
             rep.name: 0 for rep in self.replicas}
@@ -485,6 +510,16 @@ class Router:
         with self._lock:
             self.counts[key] = self.counts.get(key, 0) + by
         inc_counter(f"router.{key}_total", by)
+
+    def _directory_put_locked(self, keys: Sequence[bytes], idx: int,
+                              tier: str) -> None:
+        # caller holds self._lock; LRU-capped alongside the affinity
+        # table (same capacity — one knob)
+        for k in keys:
+            self._directory.setdefault(k, {})[idx] = tier
+            self._directory.move_to_end(k)
+        while len(self._directory) > self._affinity_cap:
+            self._directory.popitem(last=False)
 
     def _live_indices(self) -> List[int]:
         with self._lock:
@@ -682,6 +717,50 @@ class Router:
                 uncached = int(ids.size) - cached_tokens
                 do_transfer = uncached >= self.transfer_min_tokens
 
+        # ---- tier-global directory pull (ISSUE 16) ------------------
+        # the home is picked as above; when the DIRECTORY knows a
+        # different live replica holds the prefix ≥ transfer_min_tokens
+        # deeper than anything the home has (resident or spilled), the
+        # chain is PULLED from that holder over offer_chain instead of
+        # recomputed — the request routes to any replica that can
+        # import its chain, not just the one that computed it
+        do_pull = False
+        pull_src: Optional[int] = None
+        pull_tokens: Optional[np.ndarray] = None
+        if (self.tier_directory and not do_transfer
+                and self._placement != "spray" and keys):
+            home0 = order[0]
+            home_v = self._snap_version(snaps[home0])
+            with self._lock:
+                cached_tokens = 0
+                for j, k in enumerate(keys):
+                    ent = self._directory.get(k)
+                    if not (self._affinity.get(k) == home0
+                            or (ent is not None and home0 in ent)):
+                        break
+                    cached_tokens = (j + 1) * self.affinity_ps
+                for j in range(len(keys) - 1, -1, -1):
+                    covered = (j + 1) * self.affinity_ps
+                    if (covered - cached_tokens
+                            < self.transfer_min_tokens):
+                        break  # shallower coverage only shrinks it
+                    ent = self._directory.get(keys[j])
+                    if not ent:
+                        continue
+                    # holders must be live, open, same model version
+                    # (a chain under other weights is garbage — the
+                    # ISSUE 15 version fence); standby holders DO
+                    # donate (alive, just taking no placements)
+                    hold = [i for i in sorted(ent)
+                            if i != home0 and i in snaps
+                            and not snaps[i].get("closed")
+                            and self._snap_version(snaps[i]) == home_v]
+                    if hold:
+                        do_pull = True
+                        pull_src = hold[0]
+                        pull_tokens = ids[:covered]
+                        break
+
         # ---- place ---------------------------------------------------
         bucket = self.replicas[order[0]].bucket_of(int(ids.size))
         with self._lock:
@@ -719,7 +798,8 @@ class Router:
             # segments; admission lands the boundary the last chunk
             # does (or falls back to a local prefill if anything on
             # the prefill path breaks — fail_transfer unblocks it)
-            await_tid = f"{rid}.tx" if do_transfer else None
+            await_tid = (f"{rid}.tx" if (do_transfer or do_pull)
+                         else None)
             # keyword added only when set: non-transferring tiers keep
             # the PR 8 replica signature (duck-typed backends/fakes)
             extra = ({"await_transfer": await_tid}
@@ -744,6 +824,9 @@ class Router:
                 if do_transfer:
                     rr._transfer = {"phase": "prefill", "tid": await_tid,
                                     "prefill": None, "pf_req": None}
+                elif do_pull:
+                    rr._transfer = {"phase": "pull", "tid": await_tid,
+                                    "prefill": pull_src, "pf_req": None}
                 with self._lock:
                     self._admit_counts[bucket] = n + 1
                     self._inflight[rid] = rr
@@ -755,6 +838,9 @@ class Router:
                             self._affinity.move_to_end(k)
                         while len(self._affinity) > self._affinity_cap:
                             self._affinity.popitem(last=False)
+                        if self.tier_directory:
+                            self._directory_put_locked(keys, idx,
+                                                       "resident")
                 placed = idx
                 break
         if placed is not None:
@@ -770,6 +856,8 @@ class Router:
                               depth=scores.get(placed, 0))
             if do_transfer:
                 self._begin_transfer(rr, pf_live, keys)
+            elif do_pull:
+                self._begin_pull(rr, pull_src, pull_tokens, await_tid)
             return rr
         # every eligible replica said no. If every refusal was a
         # drain/stop that landed after the eligibility snapshot, this
@@ -957,6 +1045,98 @@ class Router:
             except Exception:
                 pass
 
+    # ---- tier-global directory pulls (ISSUE 16) ---------------------
+    def _begin_pull(self, rr: RouterRequest, src_idx: int,
+                    tokens: np.ndarray, tid: str) -> None:
+        """Directory-routed cross-replica pull: ask the holder for its
+        chain (answered at ITS next scheduler boundary — resident
+        re-export or spill-pool read, whichever is deeper) and stream
+        the wire to the request's decode home in transfer chunks over
+        the same ``offer_chain``/``await_transfer`` machinery a
+        disaggregated prefill transfer rides. The request already sits
+        QUEUED at the home gated on ``tid``; any fault on this path
+        fail_transfers it into a LOCAL prefill — tokens identical
+        either way."""
+        from tpuflow.serve.pages import split_chain
+
+        src = self.replicas[src_idx]
+
+        def _fallback(reason: str) -> None:
+            self._count("pull_fallbacks")
+            self.metrics.event(rr.id, "pull_fallback", reason=reason,
+                              from_replica=src.name)
+            d = rr.replica
+            if d >= 0:
+                try:
+                    self.replicas[d].fail_transfer(tid, reason)
+                except Exception:
+                    pass
+
+        def on_ready(wire) -> None:
+            if not rr._claim_transfer("pull", "landing"):
+                return  # a maintenance sweep already aborted this one
+            d_idx = rr.replica
+            if wire is None or not wire.get("n_pages"):
+                return _fallback("holder had nothing to export")
+            if d_idx < 0 or d_idx == src_idx:
+                # failover rebound the request onto the holder itself:
+                # its own plan() promotes locally, no wire needed
+                return _fallback("request landed on the holder")
+            try:
+                chunks = split_chain(wire, self.transfer_chunk_pages)
+                for j, ch in enumerate(chunks):
+                    self.replicas[d_idx].offer_chain(
+                        ch, transfer_id=tid,
+                        last=(j == len(chunks) - 1))
+            except Exception as e:
+                return _fallback(repr(e))
+            with rr._lock:
+                if rr._transfer is not None:
+                    rr._transfer["phase"] = "decode"
+            self._count("pulls")
+            with self._lock:
+                self._directory_put_locked(
+                    [bytes.fromhex(h) for h in
+                     wire.get("chunk_keys", ())],
+                    d_idx, "resident")
+            self.metrics.event(
+                rr.id, "pull",
+                pages=int(wire.get("n_pages", 0)),
+                bytes=sum(len(p) for p in wire.get("payloads", ())),
+                from_replica=src.name,
+                to_replica=self.replicas[d_idx].name)
+
+        try:
+            src.request_chain(tokens, on_ready)
+        except Exception as e:
+            if rr._claim_transfer("pull", "landing"):
+                _fallback(repr(e))
+
+    def directory_sweep(self) -> int:
+        """Merge every live replica's spilled-chain report into the
+        directory (the resident entries placement already wrote).
+        Rides :meth:`maintain`; returns rows merged."""
+        merged = 0
+        for idx in self._live_indices():
+            rep = self.replicas[idx]
+            report = getattr(rep, "kv_chain_report", None)
+            if report is None:
+                continue
+            try:
+                chains = report()
+            except Exception:
+                continue
+            for ch in chains or ():
+                try:
+                    keys = [bytes.fromhex(h) for h in ch["keys"]]
+                    tier = str(ch.get("tier", "host"))
+                except (KeyError, TypeError, ValueError):
+                    continue
+                with self._lock:
+                    self._directory_put_locked(keys, idx, tier)
+                merged += 1
+        return merged
+
     # ---- deployment plane (ISSUE 15) --------------------------------
     @staticmethod
     def _snap_version(snap: Dict[str, Any]) -> Optional[str]:
@@ -1140,10 +1320,30 @@ class Router:
                         if rr._transfer is not None
                         and rr._transfer.get("phase") == "prefill"
                         and rr._transfer.get("prefill") in failed]
+            # directory pulls stranded on a failed HOLDER (ISSUE 16):
+            # same safety net, same fallback
+            stranded_pulls = [rr for rr in self._inflight.values()
+                              if rr._transfer is not None
+                              and rr._transfer.get("phase") == "pull"
+                              and rr._transfer.get("prefill") in failed]
         for rr in stranded:
             self._abort_transfer(rr, "prefill replica failed",
                                  claim=True)
             progress = True
+        for rr in stranded_pulls:
+            if rr._claim_transfer("pull", "landing"):
+                self._count("pull_fallbacks")
+                d = rr.replica
+                if d >= 0:
+                    tid = (rr._transfer or {}).get("tid")
+                    try:
+                        self.replicas[d].fail_transfer(
+                            tid, "pull holder failed")
+                    except Exception:
+                        pass
+                progress = True
+        if self.tier_directory:
+            self.directory_sweep()
         from tpuflow.obs.gauges import set_gauge
 
         set_gauge("router.replicas", float(len(self.replicas)))
@@ -1389,6 +1589,9 @@ class Router:
             out["router.replicas_standby"] = float(len(self._standby))
             out["router.replicas_retiring"] = float(len(self._retiring))
             out["router.affinity_table"] = float(len(self._affinity))
+            if self.tier_directory:
+                out["router.directory_table"] = float(
+                    len(self._directory))
             for name, n in self.placements.items():
                 out[f"router.placements.{name}"] = float(n)
         return out

@@ -92,6 +92,10 @@ class ServeScheduler:
         kv_kernel: Optional[bool] = None,
         kv_prefix_cache: bool = True,
         kv_prefix_insert_generated: bool = True,
+        kv_host_bytes: int = 0,
+        kv_disk_path: Optional[str] = None,
+        kv_spill_min_pages: int = 2,
+        kv_promote_min_pages: int = 2,
         speculate_k: int = 0,
         draft_model=None,
         draft_params=None,
@@ -231,10 +235,26 @@ class ServeScheduler:
             # --no-kv-prefix-insert-generated).
             self.kv_insert_generated = bool(
                 kv_prefix_insert_generated) and self.kv_prefix_cache
+            # tiered hierarchy (ISSUE 16): host/disk spill pools under
+            # the page store — evicted chains demote instead of drop,
+            # plan() promotes spilled frontiers back before prefill
+            if (kv_host_bytes or kv_disk_path) and not self.kv_prefix_cache:
+                raise ValueError(
+                    "the tiered KV hierarchy (kv_host_bytes/"
+                    "kv_disk_path) spills and refills the prefix tree "
+                    "— it requires kv_prefix_cache=True")
         else:
             self.kv_spec = None
             self.kv_prefix_cache = False
             self.kv_insert_generated = False
+            if kv_host_bytes or kv_disk_path:
+                raise ValueError(
+                    "the tiered KV hierarchy requires kv='paged' — "
+                    "page chains are its spill unit")
+        self.kv_host_bytes = int(kv_host_bytes)
+        self.kv_disk_path = kv_disk_path
+        self.kv_spill_min_pages = int(kv_spill_min_pages)
+        self.kv_promote_min_pages = int(kv_promote_min_pages)
         self.prefill_budget_tokens = (
             None if prefill_budget_tokens is None
             else int(prefill_budget_tokens))
@@ -315,6 +335,13 @@ class ServeScheduler:
         self._chain_inbox: "Deque[tuple]" = deque()
         self._transfers: Dict[str, Dict[str, Any]] = {}
         self._transfer_seq = 0
+        # outbound chain fetches (ISSUE 16): the donor side of a
+        # directory-routed cross-replica pull. Requests queue here from
+        # any thread; the scheduler thread answers them at boundary
+        # start (prefix-tree walk + device gather stay on the one
+        # device-owning thread, and a fetch never blocks decode
+        # mid-segment)
+        self._fetch_inbox: "Deque[tuple]" = deque()
         self.speculate_k = int(speculate_k)
         self.draft_model = draft_model
         self.draft_params = draft_params
@@ -831,6 +858,79 @@ class ServeScheduler:
             self.metrics.on_kv_import(tid, landed, nbytes, ms)
         return progress
 
+    # ---- chain-fetch surface (ISSUE 16, directory pulls) ------------
+    def request_chain(self, tokens, on_ready) -> None:
+        """Ask this replica for its deepest coverage of a token prefix
+        (resident tree re-export or spilled chain, whichever reaches
+        further) — callable from any thread; the answer arrives via
+        ``on_ready(wire_or_None)`` from the SCHEDULER thread at its
+        next boundary (the gather never preempts a decode segment).
+        The donor side of a router directory pull: the caller streams
+        the wire to the puller via :meth:`offer_chain`. ``on_ready``
+        gets None when nothing covers a full page (or the fetch
+        failed) — the cue to ``fail_transfer`` the puller into a local
+        prefill."""
+        if self.kv_spec is None:
+            raise ValueError(
+                "request_chain requires kv='paged' — page chains are "
+                "the wire format")
+        with self._lock:
+            self._fetch_inbox.append((np.asarray(tokens, np.int32)
+                                      .reshape(-1), on_ready))
+            self._work.notify_all()
+
+    def fetch_chain(self, tokens,
+                    timeout: float = 10.0) -> Optional[Dict[str, Any]]:
+        """Blocking wrapper over :meth:`request_chain` for foreign
+        threads (the HTTP worker surface). NEVER call from the
+        scheduler thread — it would deadlock waiting on itself; use
+        ``kv_state.chain_for`` there."""
+        done = threading.Event()
+        box: List[Optional[Dict[str, Any]]] = [None]
+
+        def _cb(wire):
+            box[0] = wire
+            done.set()
+
+        self.request_chain(tokens, _cb)
+        done.wait(timeout)
+        return box[0]
+
+    def _drain_fetch_inbox(self) -> bool:
+        """Answer every queued chain fetch (scheduler thread, boundary
+        start). Runs even when closed/draining — a retiring replica
+        keeps donating its warmth (pure reads) until the process
+        exits."""
+        progress = False
+        while True:
+            with self._lock:
+                if not self._fetch_inbox:
+                    break
+                tokens, on_ready = self._fetch_inbox.popleft()
+            progress = True
+            wire = None
+            try:
+                if self.kv_state is not None:
+                    wire = self.kv_state.chain_for(tokens)
+            except Exception:  # defensive: a donor fault must not
+                wire = None    # kill the decode loop
+            try:
+                on_ready(wire)
+            except Exception:
+                pass
+        return progress
+
+    def kv_chain_report(self) -> List[Dict[str, Any]]:
+        """Per-chain ``{'keys': [hex...], 'tier': 'host'|'disk'}``
+        rows for every SPILLED chain this replica holds — what the
+        router's tier-global directory sweep merges (resident warmth
+        it already learned from its own placements). Safe from any
+        thread; empty without a tier pool."""
+        kvs = self.kv_state
+        if kvs is None or kvs.tier is None:
+            return []
+        return kvs.tier.report()
+
     def _transfer_blocked(self, req: Request, now: float) -> bool:
         """Whether an ``await_transfer`` request must stay queued:
         True only while its transfer is genuinely pending AND young —
@@ -904,6 +1004,10 @@ class ServeScheduler:
                 clock=self.clock,
                 draft_model=(self.draft_model
                              if self.speculate_k else None),
+                host_bytes=self.kv_host_bytes,
+                disk_path=self.kv_disk_path,
+                spill_min_pages=self.kv_spill_min_pages,
+                promote_min_pages=self.kv_promote_min_pages,
             )
         return self.kv_state
 
@@ -976,6 +1080,10 @@ class ServeScheduler:
         cleared = 0
         if self.kv_state is not None and self.kv_state.prefix is not None:
             cleared = self.kv_state.prefix.clear()
+        if self.kv_state is not None and self.kv_state.tier is not None:
+            # spilled chains are KV under the OLD weights — garbage
+            # now, same invalidation rule as the resident tree
+            self.kv_state.tier.clear()
         ms = (self.clock() - t0) * 1e3
         self.metrics.on_weight_swap(version, ms, draft=draft,
                                     cleared_pages=cleared)
@@ -1213,6 +1321,11 @@ class ServeScheduler:
             # the last chunk lands, and chunks interleave with the
             # segments below while their request is still queued
             progress |= self._drain_chain_inbox()
+        if self.kv_spec is not None and self._fetch_inbox:
+            # answer outbound chain fetches (ISSUE 16): a directory
+            # pull's donor gather happens here, between segments, so
+            # it never stalls a decode mid-segment
+            progress |= self._drain_fetch_inbox()
         with self._lock:
             buckets = set(self._queues) | set(self.pools)
             # deadline expiry MID-QUEUE (before any slot is spent on it)
@@ -1452,6 +1565,8 @@ class ServeScheduler:
     def idle(self) -> bool:
         with self._lock:
             if any(self._queues.values()):
+                return False
+            if self._fetch_inbox:  # an unanswered chain fetch is work
                 return False
             pools = list(self.pools.values())
         return not any(p.has_live() for p in pools)
