@@ -1293,6 +1293,16 @@ def main() -> int:
                         "every output stays token-identical to a "
                         "never-evicted oracle; writes "
                         "BENCH_*_serve_tiered.json")
+    p.add_argument("--serve-fleet", action="store_true",
+                   help="fleet-scale router hot path (ISSUE 17): "
+                        "2->128 host-only virtual-clock fake replicas "
+                        "behind the router in cached-snapshot mode on "
+                        "a saturating prefix-diverse trace; records "
+                        "wall router microseconds per placed request "
+                        "vs tier width (flat = the O(1) claim) and "
+                        "virtual tier tok/s scaling vs replica count; "
+                        "pure host policy - no model, no device, no "
+                        "compiles; writes BENCH_*_router_fleet.json")
     p.add_argument("--serve-longctx", action="store_true",
                    help="long-context serving A/B (ISSUE 13): a "
                         "steady short-request trace with ONE long "
@@ -1374,6 +1384,7 @@ def main() -> int:
              else "serve_router" if args.serve_router
              else "serve_disagg" if args.serve_disagg
              else "serve_tiered" if args.serve_tiered
+             else "serve_fleet" if args.serve_fleet
              else "serve_deploy" if args.serve_deploy
              else "serve_longctx" if args.serve_longctx
              else "serve_paged" if args.serve_paged
@@ -1489,6 +1500,8 @@ def _bench(args) -> int:
         return _bench_serve_disagg(args, devices)
     if args.serve_tiered:
         return _bench_serve_tiered(args, devices)
+    if args.serve_fleet:
+        return _bench_serve_fleet(args, devices)
     if args.serve_deploy:
         return _bench_serve_deploy(args, devices)
     if args.serve_longctx:
@@ -4256,6 +4269,323 @@ def _bench_serve_router(args, devices) -> int:
     )
     emit(scaling, scaling, diagnostics=diag,
          metric="serve_router_tok_s_scaling_2v1", unit="x")
+    return 0
+
+
+def _bench_serve_fleet(args, devices) -> int:
+    """--serve-fleet: the ISSUE 17 record — router overhead per
+    placed request vs tier width, 2 to 128 replicas.
+
+    The router is PURE HOST POLICY, so the fleet drive needs no
+    model, no device and no compiles: replicas are the test suite's
+    injectable-clock fakes scaled up — each bills virtual seconds per
+    decode segment into its OWN clock, so 128 of them genuinely
+    overlap in simulated time while the ROUTER's cost is measured in
+    real wall time (``perf_counter`` around every ``submit``). Two
+    axes ride one record:
+
+    - **overhead vs width**: median/p95 wall microseconds per placed
+      request at each tier width, on a saturating all-at-the-frontier
+      arrival burst. Flat (max/min median <= 1.2 across 2..128) is
+      the tentpole claim: cached snapshot plane + O(log N) heaps +
+      sharded affinity state left no O(width) term on the hot path;
+    - **tok/s scaling**: virtual tier tok/s must scale >=0.9-linear
+      in replica count on a PREFIX-DIVERSE trace (many distinct
+      prefixes, each repeated a few times — affinity pulls repeats
+      together without letting any replica become the tier).
+
+    ``value`` = scaling fraction at the widest tier (tok/s vs the
+    2-replica run, divided by the ideal width ratio)."""
+    import numpy as np
+
+    from tpuflow.serve.metrics import percentiles
+    from tpuflow.serve.request import Request, RequestState
+    from tpuflow.serve.router import Router
+
+    widths = [2, 8, 32, 64, 128]
+    per_rep = 48 if args.smoke else 96  # requests per replica
+    slots, seg_tokens, ps = 4, 8, 4
+    seg_cost_s = 0.004  # virtual seconds per decode segment
+    maint_every = 64  # submits between cached-plane refresh sweeps
+
+    class _FleetReplica:
+        """Host-only replica fake on a virtual clock: admits up to
+        ``slots`` rows, serves ``seg_tokens``/row/segment, bills
+        ``seg_cost_s`` virtual seconds per segment (batched: the
+        segment costs the same at any occupancy, like a real pool)."""
+
+        def __init__(self, name, vc):
+            self.name = name
+            self.vc = vc
+            self.slots = slots
+            self.max_new_cap = 32
+            self.page_size = ps
+            self.max_queue = 1 << 20
+            self.kv_free = 1 << 20
+            self.tokenizer = None
+            self.queue, self.running, self.finished = [], [], []
+            self.served: dict = {}
+            self.closed = False
+            self.is_draining = False
+
+            class _M:
+                @staticmethod
+                def events(rid):
+                    return []
+
+            self.metrics = _M()
+
+        def bucket_of(self, plen):
+            return max(8, 1 << (max(1, int(plen)) - 1).bit_length())
+
+        def pages_needed(self, plen, max_new):
+            return -(-(plen + max_new - 1) // self.page_size)
+
+        def submit(self, ids, max_new, *, deadline_s=None,
+                   stream_cb=None, request_id=None, stream_id=None,
+                   speculate=True):
+            from tpuflow.serve.request import (QueueFull,
+                                               SchedulerClosed)
+
+            if self.closed:
+                raise SchedulerClosed("scheduler is stopped")
+            if len(self.queue) >= self.max_queue:
+                raise QueueFull(len(self.queue), 0.05)
+            req = Request(prompt_ids=np.asarray(ids, np.int32),
+                          max_new_tokens=int(max_new),
+                          id=request_id or "", stream_cb=stream_cb)
+            req.stream_id = int(stream_id or 0) % self.slots
+            self.queue.append(req)
+            return req
+
+        def cancel(self, req):
+            if req in self.queue:
+                self.queue.remove(req)
+                req.finalize(RequestState.CANCELLED, "cancelled")
+                if req.stream_cb:
+                    req.stream_cb(req, [], True)
+                return True
+            return False
+
+        def load_snapshot(self):
+            return {"queue_depth": len(self.queue),
+                    "running": len(self.running),
+                    "closed": self.closed or self.is_draining,
+                    "draining": self.is_draining,
+                    "kv_pages_free": self.kv_free,
+                    "kv_pages_total": self.kv_free,
+                    # the ISSUE 17 shed hint: Retry-After reads from
+                    # the cached plane, zero RPCs on an overloaded tier
+                    "retry_after_s": 0.05}
+
+        def readiness(self):
+            return {"ready": not self.closed}
+
+        def health(self):
+            return {"failed": False, "closed": self.closed,
+                    "draining": self.is_draining}
+
+        def retry_after_s(self):
+            return 0.05
+
+        def metrics_snapshot(self):
+            return {}
+
+        def start(self):
+            pass
+
+        def drain(self):
+            self.is_draining = True
+            self.closed = True
+
+        def stop(self, drain=True, timeout=0.0):
+            self.closed = True
+
+        def step(self):
+            progress = False
+            while self.queue and len(self.running) < self.slots:
+                req = self.queue.pop(0)
+                req.state = RequestState.RUNNING
+                req.ts_admitted = self.vc.now
+                self.served[id(req)] = 0
+                self.running.append(req)
+                progress = True
+            if not self.running:
+                return progress
+            self.vc.now += seg_cost_s
+            for req in list(self.running):
+                done = self.served[id(req)] + seg_tokens
+                self.served[id(req)] = done
+                if done >= req.max_new_tokens:
+                    base = int(np.sum(req.prompt_ids.astype(
+                        np.int64))) * 31 + req.stream_id * 7
+                    toks = [(base + j) % 997
+                            for j in range(req.max_new_tokens)]
+                    req.tokens.extend(toks)
+                    self.running.remove(req)
+                    self.served.pop(id(req), None)
+                    self.finished.append(req)
+                    req.finalize(RequestState.DONE)
+                    if req.stream_cb:
+                        req.stream_cb(req, toks, True)
+            return True
+
+        def idle(self):
+            return not self.queue and not self.running
+
+    def run(width: int) -> dict:
+        n_req = per_rep * width
+        rng = np.random.default_rng(width)
+        # prefix-diverse trace: 4*width distinct 12-token prefixes
+        # (3 chunk keys at page_size 4), each drawn ~3 times on
+        # average, plus a short random suffix — affinity has real
+        # work (repeats stick) but no prefix can capture the tier
+        prefixes = [rng.integers(1, 50_000, (12,)).astype(np.int32)
+                    for _ in range(4 * width)]
+        prompts = []
+        budgets = []
+        for _ in range(n_req):
+            pfx = prefixes[int(rng.integers(0, len(prefixes)))]
+            sfx = rng.integers(1, 50_000, (int(rng.integers(2, 6)),))
+            prompts.append(np.concatenate([pfx,
+                                           sfx.astype(np.int32)]))
+            # uniform budgets: the scaling axis measures PLACEMENT
+            # balance, and the router balances what it can see (queue
+            # depth + running) — a random budget mix would fold
+            # invisible token-weight variance into the straggler
+            # makespan and measure luck, not routing
+            budgets.append(16)
+        clocks = [_VClock() for _ in range(width)]
+        reps = [_FleetReplica(f"replica{r}", clocks[r])
+                for r in range(width)]
+        # running simulation frontier: the router stamps events with
+        # this clock on EVERY placement, so a max() over all replica
+        # clocks would put an O(width) term back into the hot path we
+        # are measuring — clocks only advance in the step loop below,
+        # which updates the frontier incrementally
+        frontier = [0.0]
+        router = Router(reps, snapshot_cache=True,
+                        clock=lambda: frontier[0])
+        router.maintain()  # warm the plane before the timed loop
+        walls = []
+        rrs = []
+        for i in range(n_req):
+            if i and i % maint_every == 0:
+                router.maintain()
+            t0 = time.perf_counter()
+            rr = router.submit(prompts[i], max_new_tokens=budgets[i])
+            walls.append(time.perf_counter() - t0)
+            rrs.append(rr)
+        # drain: step the most-behind busy replica (virtual overlap),
+        # maintenance on its own cadence like the online thread
+        steps = 0
+        while True:
+            busy = [r for r in range(width) if not reps[r].idle()]
+            if not busy:
+                break
+            r = min(busy, key=lambda q: clocks[q].now)
+            reps[r].step()
+            frontier[0] = max(frontier[0], clocks[r].now)
+            steps += 1
+            if steps % 256 == 0:
+                router.maintain()
+        assert all(rr.state.value == "done" for rr in rrs)
+        makespan = max(c.now for c in clocks)
+        toks = sum(len(rr.tokens) for rr in rrs)
+        us = [w * 1e6 for w in walls]
+        pct = {k: round(v, 1) for k, v in percentiles(us).items()}
+        snap = router.snapshot()
+        placements = sorted(
+            int(v) for k, v in snap.items()
+            if k.startswith("router.placements."))
+        rec = {
+            "replicas": width,
+            "requests": n_req,
+            "tokens": toks,
+            "makespan_virtual_s": round(makespan, 3),
+            "tok_s_virtual": round(toks / makespan, 1),
+            "router_us_per_request": round(
+                sum(us) / max(1, len(us)), 1),
+            "router_us": pct,
+            "placements_min": placements[0],
+            "placements_max": placements[-1],
+            "affinity_hits": int(snap["router.affinity_hits"]),
+            "affinity_spills": int(snap["router.affinity_spills"]),
+            "snapshot_refreshes": int(
+                snap["router.snapshot_refreshes"]),
+            "placed": int(snap["router.placed"]),
+        }
+        ls = router.load_snapshot()
+        rec["snapshot_staleness_s"] = round(
+            float(ls.get("snapshot_staleness_s", 0.0)), 3)
+        return rec
+
+    results = {}
+    for w in widths:
+        results[w] = run(w)
+        _progress({"phase": f"serve_fleet_w{w}",
+                   "record": results[w]})
+
+    base = results[widths[0]]
+    meds = [results[w]["router_us"].get("p50",
+            results[w]["router_us_per_request"]) for w in widths]
+    flatness = round(max(meds) / max(min(meds), 1e-9), 3)
+    scaling_by_width = {}
+    for w in widths:
+        ideal = w / widths[0]
+        scaling_by_width[str(w)] = round(
+            (results[w]["tok_s_virtual"] / base["tok_s_virtual"])
+            / ideal, 4)
+    scaling_frac = scaling_by_width[str(widths[-1])]
+    diag = {
+        "device_kind": devices[0].device_kind,
+        "workload": {"requests_per_replica": per_rep,
+                     "prefix_tokens": 12, "page_size": ps,
+                     "slots": slots, "seg_tokens": seg_tokens,
+                     "seg_cost_s": seg_cost_s,
+                     "maintain_every_submits": maint_every,
+                     "prefix_diverse": True},
+        "widths": widths,
+        "overhead_vs_width": {
+            str(w): {"router_us_per_request":
+                     results[w]["router_us_per_request"],
+                     "router_us": results[w]["router_us"]}
+            for w in widths},
+        "overhead_flatness_ratio": flatness,
+        "scaling": {
+            "tok_s_by_width": {str(w): results[w]["tok_s_virtual"]
+                               for w in widths},
+            "scaling_frac_by_width": scaling_by_width,
+            "scaling_frac_at_max_width": scaling_frac,
+        },
+        "tiers": {str(w): results[w] for w in widths},
+        "span_totals_ms": _span_totals(),
+    }
+    rec = {
+        "metric": "serve_fleet_scaling_frac_at_max_width",
+        "value": scaling_frac,
+        "unit": "frac",
+        "vs_baseline": flatness,
+        "mode": "serve_fleet",
+        "smoke": bool(args.smoke),
+        "diagnostics": diag,
+    }
+    out_path = args.serve_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_LOCAL_r17_router_fleet.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    wmax = widths[-1]
+    print(
+        f"# serve-fleet router overhead p50 "
+        f"{results[widths[0]]['router_us'].get('p50')}us@{widths[0]} "
+        f"-> {results[wmax]['router_us'].get('p50')}us@{wmax} "
+        f"(flatness x{flatness:.2f}) | tok/s scaling frac at "
+        f"{wmax} reps = {scaling_frac:.3f} -> {out_path}",
+        file=sys.stderr, flush=True,
+    )
+    emit(scaling_frac, flatness, diagnostics=diag,
+         metric="serve_fleet_scaling_frac_at_max_width", unit="frac")
     return 0
 
 

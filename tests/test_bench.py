@@ -454,6 +454,50 @@ def test_smoke_serve_deploy_emits_schema(tmp_path):
 
 
 @pytest.mark.slow
+def test_smoke_serve_fleet_emits_schema(tmp_path):
+    """--serve-fleet: the ISSUE 17 record — router placement overhead
+    vs tier width (2->128 host-only virtual-clock fakes in cached-
+    snapshot mode) and virtual tok/s scaling on a prefix-diverse
+    saturating trace. Acceptance axes: per-request overhead flat
+    (+-20%) across widths, tok/s >=0.9-linear at max width."""
+    out = str(tmp_path / "BENCH_TEST_serve_fleet.json")
+    r = _run("--smoke", "--serve-fleet", "--serve-out", out,
+             timeout=1400)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "serve_fleet_scaling_frac_at_max_width"
+    assert "error" not in rec
+    d = rec["diagnostics"]
+    # overhead-vs-width: every width measured, with percentiles, and
+    # the flatness ratio (max/min of per-width p50) recorded beside
+    # them — vs_baseline carries the same ratio at the top level
+    assert d["widths"][0] == 2 and d["widths"][-1] == 128
+    ow = d["overhead_vs_width"]
+    for w in ("2", "128"):
+        assert ow[w]["router_us_per_request"] > 0
+        assert ow[w]["router_us"]["p50"] > 0
+    assert d["overhead_flatness_ratio"] >= 1.0
+    assert rec["vs_baseline"] == d["overhead_flatness_ratio"]
+    # scaling: virtual tok/s per width, normalized to ideal-linear
+    sc = d["scaling"]
+    assert sc["tok_s_by_width"]["128"] > sc["tok_s_by_width"]["2"]
+    # top-level value is the same frac, rounded for the one-liner
+    assert abs(sc["scaling_frac_at_max_width"] - rec["value"]) < 0.01
+    assert 0 < rec["value"] <= 1.2
+    # per-width tier records: every request placed and served, the
+    # cached plane actually refreshed, placements near-balanced
+    t128 = d["tiers"]["128"]
+    assert t128["replicas"] == 128
+    assert t128["placed"] == t128["requests"]
+    assert t128["snapshot_refreshes"] >= 1
+    assert t128["placements_min"] > 0
+    assert d["workload"]["prefix_diverse"] is True
+    with open(out) as f:
+        disk = json.load(f)
+    assert disk["mode"] == "serve_fleet"
+
+
+@pytest.mark.slow
 def test_smoke_serve_longctx_emits_schema(tmp_path):
     """--serve-longctx: the ISSUE 13 record — concurrent short-request
     p95 ITL flatness across the 8x long-prompt growth with chunking ON

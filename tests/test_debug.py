@@ -44,6 +44,7 @@ def test_nan_check():
         nan_check({"bad": jnp.array([1.0, float("nan")])})
 
 
+@pytest.mark.slow
 def test_trainer_wires_consistency_callback(tmp_path):
     """consistency_check_every runs clean through real DP training."""
     from tpuflow.core.config import TrainConfig

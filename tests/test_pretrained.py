@@ -109,6 +109,7 @@ def _torch_key_iter():
     yield "head_conv", "features.18.0", "features.18.1"
 
 
+@pytest.mark.slow
 def test_torchvision_converter_matches_flax_tree(tmp_path):
     """Synthetic torch state_dict (flax values inverse-transposed into
     torch layout) converts back to EXACTLY the model's backbone tree."""

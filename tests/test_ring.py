@@ -137,6 +137,7 @@ def test_striped_gradients_match():
         np.testing.assert_allclose(a, b_, atol=5e-5, rtol=5e-4)
 
 
+@pytest.mark.slow
 def test_striped_noncausal_same_as_contiguous():
     # layout only matters under the causal mask
     b, h, s, d = 1, 1, 16, 8

@@ -30,6 +30,7 @@ The load-bearing pins:
 
 import os
 import re
+import time
 
 import numpy as np
 import pytest
@@ -542,6 +543,163 @@ def test_router_snapshot_readiness_and_load():
     b.tripped = True
     router.maintain()
     assert router.readiness()["ready"] is False
+
+
+# ---------------------------------------------------------------------
+# fleet-scale hot path (ISSUE 17): cached snapshot plane, sharded
+# state, bounded health sweeps — all host-only fakes
+# ---------------------------------------------------------------------
+
+class CountingReplica(FakeReplica):
+    """FakeReplica that counts load_snapshot RPCs (the fan-out the
+    cached plane exists to eliminate)."""
+
+    def __init__(self, name, **kw):
+        super().__init__(name, **kw)
+        self.snap_calls = 0
+
+    def load_snapshot(self):
+        self.snap_calls += 1
+        return super().load_snapshot()
+
+
+def test_snapshot_cache_zero_rpc_submits_and_delta_spreading():
+    """Cached mode: after the __init__ warm-up, submit pays ZERO
+    load_snapshot RPCs — and the local _note_placed deltas still
+    spread placements exactly the way sync-mode refetches would."""
+    a = CountingReplica("a", max_queue=64)
+    b = CountingReplica("b", max_queue=64)
+    router = Router([a, b], snapshot_cache=True, clock=lambda: 0.0)
+    base = (a.snap_calls, b.snap_calls)
+    for k in range(6):
+        router.submit(_ids(7, 7, k + 1), 2)
+    assert (a.snap_calls, b.snap_calls) == base, (
+        "cached-mode submits must not fan out snapshot RPCs")
+    # no refresh ran between submits, yet load still balanced: the
+    # plane was corrected locally after every placement
+    assert sorted(router.placements.values()) == [3, 3]
+    # sync mode (the default) keeps the per-submit freshness contract
+    c = CountingReplica("c", max_queue=64)
+    d = CountingReplica("d", max_queue=64)
+    sync = Router([c, d], clock=lambda: 0.0)
+    c0 = (c.snap_calls, d.snap_calls)
+    sync.submit(_ids(1, 2, 3), 2)
+    assert (c.snap_calls, d.snap_calls) == (c0[0] + 1, c0[1] + 1)
+
+
+def test_snapshot_cache_token_identity_matches_sync_mode():
+    """The cached plane is a PLACEMENT optimization: the same trace
+    through a sync-mode and a cached-mode tier produces identical
+    tokens per request (stream-id pinning is unchanged)."""
+    trace = [_ids(3, 1, 4, 1, 5, 9, 2, 6, k + 1) for k in range(8)]
+
+    def run(cache):
+        reps = [FakeReplica(f"{cache}{i}", max_queue=64)
+                for i in range(2)]
+        router = Router(reps, snapshot_cache=cache,
+                        clock=lambda: 0.0)
+        rrs = [router.submit(p, 3) for p in trace]
+        router.run_until_idle()
+        return [rr.tokens for rr in rrs]
+
+    assert run(False) == run(True)
+
+
+def test_place_ms_and_staleness_observability():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    router = Router([a, b], snapshot_cache=True, clock=lambda: 0.0)
+    router.submit(_ids(5, 5, 5), 2)
+    router.maintain()
+    snap = router.metrics_snapshot()
+    for p in (50, 95, 99):
+        assert snap[f"router.place_ms_p{p}"] >= 0.0
+    assert snap["router.snapshot_staleness_s"] >= 0.0
+    assert snap["router.snapshot_refreshes"] >= 2.0
+    load = router.load_snapshot()
+    assert load["snapshot_staleness_s"] >= 0.0
+    assert load["place_ms_p95"] >= 0.0
+    assert load["snapshot_refreshes"] >= 2
+    assert "health_lagged" in load
+
+
+def test_slow_health_probe_lags_not_stalls_failover():
+    """One replica's health RPC hanging must not stall the sweep: the
+    probe carries over (slow != failed, counted health_lagged) while
+    the OTHER replica's failure is acted on in the same sweep."""
+    import threading
+
+    release = threading.Event()
+
+    class SlowHealth(FakeReplica):
+        def health(self):
+            release.wait(timeout=10.0)
+            return super().health()
+
+    slow, sick = SlowHealth("slow"), FakeReplica("sick")
+    router = Router([slow, sick], health_timeout_s=0.05,
+                    clock=lambda: 0.0)
+    try:
+        sick.tripped = True
+        t0 = time.perf_counter()
+        router.maintain()
+        assert time.perf_counter() - t0 < 2.0, "sweep stalled"
+        assert 1 in router._failed, "failover must not wait on slow"
+        assert 0 not in router._failed, "slow is NOT failed"
+        assert router.counts["health_lagged"] >= 1
+    finally:
+        release.set()
+    # the parked probe resolves by the next sweep: still healthy
+    router.maintain()
+    assert 0 not in router._failed
+
+
+def test_min_retry_prefers_snapshot_hint_over_rpc():
+    """Retry-After derives from the cached plane's retry_after_s hint
+    when the snapshot carries one — zero probe RPCs on a shed — and
+    probe failures on the fallback path are counted, not swallowed."""
+
+    class HintedReplica(FakeReplica):
+        def load_snapshot(self):
+            snap = super().load_snapshot()
+            snap["retry_after_s"] = 0.25
+            return snap
+
+        def retry_after_s(self):
+            raise RuntimeError("hint should have made this dead code")
+
+    h = HintedReplica("h", max_queue=64, retry=9.0)
+    router = Router([h], max_total_queue=0, clock=lambda: 0.0)
+    with pytest.raises(QueueFull) as ei:
+        router.submit(_ids(1, 2), 2)
+    assert ei.value.retry_after_s == 0.25
+    assert router.counts["retry_probe_errors"] == 0
+
+    class DeafReplica(FakeReplica):
+        def retry_after_s(self):
+            raise RuntimeError("probe RPC failed")
+
+    d = DeafReplica("d", max_queue=64)
+    router2 = Router([d], max_total_queue=0, clock=lambda: 0.0)
+    with pytest.raises(QueueFull):
+        router2.submit(_ids(1, 2), 2)
+    assert router2.counts["retry_probe_errors"] == 1
+    assert any(e["event"] == "retry_probe_error"
+               for e in router2.metrics.events("-shed-"))
+
+
+def test_sharded_affinity_lru_stays_bounded():
+    """The sharded affinity table enforces the same capacity bound
+    the single OrderedDict did: distinct-prefix traffic far beyond
+    the cap leaves at most ``affinity_capacity`` entries."""
+    rng = np.random.default_rng(7)
+    reps = [FakeReplica(f"r{i}", max_queue=256) for i in range(2)]
+    router = Router(reps, affinity_capacity=32, affinity_shards=4,
+                    clock=lambda: 0.0)
+    for _ in range(100):
+        p = rng.integers(1, 50_000, (9,)).astype(np.int32)
+        router.submit(p, 2)
+        router.run_until_idle()
+    assert 0 < len(router._affinity) <= 32
 
 
 # ---------------------------------------------------------------------

@@ -22,6 +22,7 @@ def test_offline_mnv2_parity():
     assert rec["n_converted_tensors"] == 260
 
 
+@pytest.mark.slow
 def test_offline_resnet18_parity():
     sd = vw.synth_resnet_state_dict(18, seed=3)
     rec = vw.validate_model("resnet18", sd, hw=65)

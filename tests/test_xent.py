@@ -158,6 +158,7 @@ def test_lm_trainer_fused_matches_plain():
         tr_tp._make_steps()
 
 
+@pytest.mark.slow
 def test_lm_trainer_fused_gspmd_and_moe_match_plain():
     """The GSPMD branch of loss_of through the fused op: ZeRO-1
     (replicated head, sharded moments) and the MoE train path (fused

@@ -100,6 +100,7 @@ def test_zero_validates():
         SpmdTrainer(_tiny_vit(), TrainConfig(), mesh=mesh, zero="zero9")
 
 
+@pytest.mark.slow
 def test_zero1_with_frozen_backbone_masked_optimizer():
     """optax.masked rewrites the moment tree's structure (MaskedNode),
     which used to defeat ZeRO spec assignment silently — moments came

@@ -229,6 +229,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--no-affinity", action="store_true",
                    help="--replicas>1: disable prefix-affinity "
                         "placement (pure least-loaded)")
+    p.add_argument("--snapshot-cache", action="store_true",
+                   help="--replicas>1: serve placements off the "
+                        "cached snapshot plane (refreshed on the "
+                        "maintenance cadence, corrected by local "
+                        "deltas) instead of re-snapshotting every "
+                        "replica per request — the fleet-scale mode; "
+                        "staleness is bounded by the maintain poll "
+                        "interval and visible as the "
+                        "router.snapshot_staleness_s gauge")
     p.add_argument("--trace-spans", action="store_true",
                    help="enable the tpuflow.obs.trace span tracer "
                         "(request ids become trace ids; inspect via "
@@ -391,6 +400,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             affinity=not args.no_affinity,
             transfer_chunk_pages=args.transfer_chunk_pages,
             tier_directory=args.kv_tier_directory,
+            snapshot_cache=args.snapshot_cache,
         )
         if args.transfer_min_tokens is not None:
             router_kw["transfer_min_tokens"] = args.transfer_min_tokens

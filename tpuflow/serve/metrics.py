@@ -55,6 +55,19 @@ def percentiles(values: List[float],
     return out
 
 
+def register_router_metrics() -> None:
+    """Eagerly materialize the router hot-path histogram (ISSUE 17):
+    ``router.place_ms`` is in the registry — hence on ``/metrics``
+    and in the snapshot ring — from router CONSTRUCTION, not from the
+    first placement, so a freshly deployed tier's dashboards don't
+    read as a missing series. Idempotent: re-registering would zero
+    an existing instance's counts, so one is kept if present."""
+    from tpuflow.obs.gauges import get_histogram
+
+    if get_histogram("router.place_ms") is None:
+        register_histogram("router.place_ms", Histogram())
+
+
 def _bounded_append(lst: list, value, cap: int) -> None:
     """Append keeping only the most recent ``cap`` entries — every
     per-request series here is a sliding window, never an unbounded

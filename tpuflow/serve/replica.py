@@ -54,7 +54,12 @@ class Replica:
       the scheduler's own ``QueueFull`` / ``SchedulerClosed`` /
       ``ValueError`` taxonomy;
     - :meth:`load_snapshot` — the placement sensor (queue depth,
-      running rows, free KV pages, windowed latency p95s);
+      running rows, free KV pages, windowed latency p95s). An optional
+      ``retry_after_s`` key is the shed hint (ISSUE 17): when present,
+      the router's cached snapshot plane derives tier Retry-After from
+      it instead of firing one :meth:`retry_after_s` RPC per eligible
+      replica at the exact moment the tier is overloaded — backends
+      without the key still work, they just pay the RPC fallback;
     - :meth:`health` — ``{"failed": bool, ...}``, the failover input;
     - :meth:`drain` / :meth:`stop` / :meth:`start`;
     - :meth:`bucket_of` and the ``slots`` / ``max_new_cap`` /

@@ -40,14 +40,35 @@ The router is PURE HOST POLICY: it never touches device arrays — all
 device work stays on the replica schedulers' threads (a grep guard in
 tests/test_serve_router.py pins this boundary the way PR 7's jit-site
 guard pins the compile registry).
+
+FLEET-SCALE HOT PATH (ISSUE 17): placement cost is flat in tier
+width. Submit reads a **cached snapshot plane** (per-replica load
+snapshots refreshed synchronously per submit by default, or on the
+maintenance cadence with ``snapshot_cache=True`` — a bounded-staleness
+view corrected by local deltas at place time) instead of fanning one
+RPC per replica per request; candidate order comes from lazy
+version-stamped **heaps** keyed exactly like the old full sort
+``(queue_depth + running, -kv_pages_free, idx)``; the affinity /
+prefill-affinity / tier-directory / hot-head tables are **sharded
+LRU maps** (one lock per shard, keyed by the chunk digest's first
+byte) so concurrent submits don't convoy; stream-id pinning
+serializes on a **per-bucket** counter lock (token identity needs
+counter-read→place→commit atomic only per bucket, never globally);
+and :meth:`Router.maintain` probes health **concurrently** with a
+sweep deadline, so one wedged replica's health RPC cannot stall
+failover for the rest of the tier. ``bench.py --serve-fleet`` drives
+2→128 virtual-clock replicas through this path and records router
+µs/placed-request vs width.
 """
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from concurrent import futures as _futures
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -290,6 +311,96 @@ class RouterRequest:
         return out
 
 
+_POOL_LOCK = threading.Lock()
+_POOL = None  # process-shared probe pool (lazily created)
+
+
+def _probe_pool():
+    """The process-shared thread pool behind concurrent snapshot
+    refreshes and health probes. Shared across every router in the
+    process (a test suite constructs hundreds of tiers — per-router
+    pools would pile up idle threads), bounded by core count, and
+    never shut down: probe tasks are tiny and the pool drains at
+    process exit."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            import os
+            from concurrent.futures import ThreadPoolExecutor
+
+            _POOL = ThreadPoolExecutor(
+                max_workers=min(16, max(4, os.cpu_count() or 4)),
+                thread_name_prefix="tpuflow-router-probe")
+        return _POOL
+
+
+class _ShardedLRU:
+    """A bounded LRU map sharded by the first byte of its keys — the
+    chunk digests :func:`tpuflow.serve.pages.chunk_keys` produces are
+    uniform in every byte, so shard fill is even. One lock per shard:
+    concurrent submits walking the affinity/directory/hot tables
+    convoy only when they touch the same shard, not on one global
+    router lock.
+
+    Matches the plain ``OrderedDict`` tables it replaces: WRITES bump
+    recency and evict beyond the per-shard cap; reads never bump.
+    ``update`` applies a read-modify-write under the shard lock and
+    must return a FRESH value (copy-on-write) when the old one may be
+    concurrently read outside the lock."""
+
+    def __init__(self, capacity: int, shards: int = 16):
+        n = 1
+        while n * 2 <= max(1, int(shards)):
+            n *= 2
+        self._mask = n - 1
+        self._cap = max(1, int(capacity) // n)
+        self._maps: List["OrderedDict[bytes, Any]"] = [
+            OrderedDict() for _ in range(n)]
+        self._locks = [threading.Lock() for _ in range(n)]
+
+    def _shard(self, key) -> int:
+        return (key[0] if key else 0) & self._mask
+
+    def get(self, key, default=None):
+        j = self._shard(key)
+        with self._locks[j]:
+            return self._maps[j].get(key, default)
+
+    def put(self, key, value) -> None:
+        j = self._shard(key)
+        m = self._maps[j]
+        with self._locks[j]:
+            m[key] = value
+            m.move_to_end(key)
+            while len(m) > self._cap:
+                m.popitem(last=False)
+
+    def update(self, key, fn: Callable[[Any], Any]) -> Any:
+        j = self._shard(key)
+        m = self._maps[j]
+        with self._locks[j]:
+            val = fn(m.get(key))
+            m[key] = val
+            m.move_to_end(key)
+            while len(m) > self._cap:
+                m.popitem(last=False)
+            return val
+
+    def values(self) -> List[Any]:
+        out: List[Any] = []
+        for j, m in enumerate(self._maps):
+            with self._locks[j]:
+                out.extend(m.values())
+        return out
+
+    def __len__(self) -> int:
+        total = 0
+        for j, m in enumerate(self._maps):
+            with self._locks[j]:
+                total += len(m)
+        return total
+
+
 class Router:
     """Front tier over N replicas — one submit/stream/cancel surface
     with load-aware placement, prefix affinity, shedding, failover and
@@ -320,6 +431,9 @@ class Router:
         transfer_chunk_pages: int = 8,
         standby: Sequence[int] = (),
         tier_directory: bool = False,
+        snapshot_cache: bool = False,
+        health_timeout_s: float = 5.0,
+        affinity_shards: int = 16,
     ):
         """``placement='load'`` is the real policy (least-loaded with
         prefix affinity when ``affinity``); ``'spray'`` hashes the
@@ -366,7 +480,21 @@ class Router:
         boundary and the chain streams to the home in transfer chunks.
         Every pull fault falls back to a local prefill — like the
         disagg transfer, a pull is purely a work-placement
-        optimization and tokens are identical either way."""
+        optimization and tokens are identical either way.
+
+        FLEET-SCALE HOT PATH (ISSUE 17): ``snapshot_cache=False``
+        (the default) refreshes the snapshot plane synchronously at
+        every submit — the same per-request view the tier always had,
+        minus any other RPC fan-out; ``snapshot_cache=True`` lets
+        submit read the bounded-staleness plane the maintenance sweep
+        refreshes (staleness ≤ the maintain cadence, corrected by
+        local place-time deltas) — zero snapshot RPCs on the hot
+        path, the fleet-width mode. ``health_timeout_s`` bounds one
+        maintenance sweep's wait on concurrent health probes: a probe
+        still in flight at the deadline is parked and re-checked next
+        sweep (slow is NOT failed) instead of stalling failover for
+        the rest of the tier. ``affinity_shards`` (power of two)
+        shards the affinity/directory/hot tables' locks."""
         if not replicas:
             raise ValueError("router needs at least one replica")
         if placement not in ("load", "spray"):
@@ -396,8 +524,12 @@ class Router:
         self.affinity_ps: Optional[int] = (
             int(ps) if (affinity and ps) else None)
         self.affinity_slack = int(affinity_slack)
-        self._affinity: "OrderedDict[bytes, int]" = OrderedDict()
         self._affinity_cap = int(affinity_capacity)
+        self._affinity_shards = int(affinity_shards)
+        # sharded state maps (ISSUE 17): chunk-key → holder, one lock
+        # per shard so concurrent submits don't convoy on the router
+        self._affinity = _ShardedLRU(self._affinity_cap,
+                                     self._affinity_shards)
         # replica classes (ISSUE 14): prefill-class replicas never
         # decode; the tier is DISAGGREGATED when both phases exist
         self.classes: List[str] = [
@@ -430,8 +562,8 @@ class Router:
         # replay source — deepest chunk-chain key → hit count + the
         # covering token prefix (a version bump invalidates cached KV,
         # so warmth is REBUILT by re-prefilling these, not transferred)
-        self._hot: "OrderedDict[bytes, Dict[str, Any]]" = OrderedDict()
         self._hot_cap = 512
+        self._hot = _ShardedLRU(self._hot_cap, self._affinity_shards)
         # rollout hook: DeploymentManager.tick rides the maintenance
         # cadence through here (online tiers)
         self.on_maintain: List[Callable[[], Any]] = []
@@ -442,29 +574,28 @@ class Router:
         self.transfer_chunk_pages = max(1, int(transfer_chunk_pages))
         # prefill-side affinity: repeated prefixes prefill where their
         # pages already sit in the PREFILL replica's own tree
-        self._pf_affinity: "OrderedDict[bytes, int]" = OrderedDict()
+        self._pf_affinity = _ShardedLRU(self._affinity_cap,
+                                        self._affinity_shards)
         # tier-global prefix directory (ISSUE 16): chunk key →
         # {replica idx: tier} over every holder, resident AND spilled
         # (LRU-capped like the affinity table; staleness is safe — a
         # pull miss fail_transfers into a local prefill)
         self.tier_directory = bool(tier_directory)
-        self._directory: "OrderedDict[bytes, Dict[int, str]]" = (
-            OrderedDict())
-        if max_total_queue is None:
-            mq = [self._safe_snapshot(i).get("max_queue")
-                  for i in range(len(self.replicas))]
-            mq = [int(m) for m in mq if m]
-            max_total_queue = sum(mq) if mq else None
-        self.max_total_queue = max_total_queue
+        self._directory = _ShardedLRU(self._affinity_cap,
+                                      self._affinity_shards)
         self.shed_on_dry_kv = bool(shed_on_dry_kv)
+        self._snapshot_cache = bool(snapshot_cache)
+        self.health_timeout_s = float(health_timeout_s)
         self._lock = threading.Lock()
-        # serializes [read stream counter → place → commit counter]:
-        # concurrent submits must get DISTINCT, submission-ordered
-        # stream ids (two racers sharing one id would sample from the
-        # same stream and desync the single-scheduler parity sequence
-        # forever). Never taken from replica callbacks → no inversion
-        # against _lock / RouterRequest._lock.
+        # per-bucket stream-counter locks (ISSUE 17): counter-read →
+        # place → counter-commit is ONE critical section, but only PER
+        # BUCKET — the tier-global pinning counter is per bucket, so
+        # two racers in DIFFERENT buckets can never share a stream id
+        # and need not serialize. _place_lock guards only the lazy
+        # lock-table itself. Bucket locks are never taken from replica
+        # callbacks → no inversion against _lock / RouterRequest._lock.
         self._place_lock = threading.Lock()
+        self._bucket_locks: Dict[int, threading.Lock] = {}
         self._inflight: Dict[str, RouterRequest] = {}
         self._admit_counts: Dict[int, int] = {}  # tier-global stream ids
         self._failed: Dict[int, str] = {}
@@ -480,9 +611,45 @@ class Router:
             "replicas_failed": 0, "drains": 0,
             "transfers": 0, "transfer_fallbacks": 0,
             "pulls": 0, "pull_fallbacks": 0,
+            "snapshot_refreshes": 0, "snapshot_errors": 0,
+            "health_lagged": 0, "retry_probe_errors": 0,
         }
         self.placements: Dict[str, int] = {
             rep.name: 0 for rep in self.replicas}
+        # ---- cached snapshot plane (ISSUE 17) -----------------------
+        # one load snapshot per replica, plus index arrays + lazy
+        # version-stamped heaps derived from it under _idx_lock; the
+        # plane is refreshed per submit (sync mode) or per maintain
+        # sweep (cached mode) and corrected by _note_placed deltas
+        n_rep = len(self.replicas)
+        self._idx_lock = threading.Lock()
+        self._snaps: List[Dict[str, Any]] = [{} for _ in range(n_rep)]
+        self._snap_ts: List[float] = [0.0] * n_rep
+        self._score: List[int] = [0] * n_rep
+        self._qd: List[int] = [0] * n_rep
+        self._free: List[Optional[int]] = [None] * n_rep
+        self._closed_snap: List[bool] = [False] * n_rep
+        self._ver_label: List[Optional[str]] = [None] * n_rep
+        self._in_heap: List[bool] = [False] * n_rep
+        self._entry_ver: List[int] = [0] * n_rep
+        self._heap: List[Tuple[int, int, int, int]] = []
+        self._free_heap: List[Tuple[int, int, int]] = []
+        self._agg_depth = 0
+        self._n_depth0 = 0
+        self._n_eligible = 0
+        self._all_paged = False
+        self._health_pending: Dict[int, Any] = {}
+        self._plane_warm = False
+        from tpuflow.serve.metrics import register_router_metrics
+
+        register_router_metrics()
+        self._refresh_plane(range(n_rep))
+        if max_total_queue is None:
+            mq = [self._snaps[i].get("max_queue")
+                  for i in range(n_rep)]
+            mq = [int(m) for m in mq if m]
+            max_total_queue = sum(mq) if mq else None
+        self.max_total_queue = max_total_queue
         # post-mortem: the flight recorder snapshots the tier state
         # (weakly bound, like the scheduler's request provider)
         import weakref
@@ -502,6 +669,7 @@ class Router:
         try:
             return self.replicas[idx].load_snapshot()
         except Exception:
+            self._count("snapshot_errors")
             return {"queue_depth": 0, "running": 0, "closed": True}
 
     def _count(self, key: str, by: int = 1) -> None:
@@ -511,20 +679,189 @@ class Router:
             self.counts[key] = self.counts.get(key, 0) + by
         inc_counter(f"router.{key}_total", by)
 
-    def _directory_put_locked(self, keys: Sequence[bytes], idx: int,
-                              tier: str) -> None:
-        # caller holds self._lock; LRU-capped alongside the affinity
-        # table (same capacity — one knob)
+    def _directory_put(self, keys: Sequence[bytes], idx: int,
+                       tier: str) -> None:
+        # LRU-capped alongside the affinity table (same capacity —
+        # one knob). Copy-on-write merge: readers hold entry dicts
+        # outside the shard lock, so a writer must never mutate one
+        # in place.
         for k in keys:
-            self._directory.setdefault(k, {})[idx] = tier
-            self._directory.move_to_end(k)
-        while len(self._directory) > self._affinity_cap:
-            self._directory.popitem(last=False)
+            def _merge(ent):
+                ent = dict(ent) if ent else {}
+                ent[idx] = tier
+                return ent
 
-    def _live_indices(self) -> List[int]:
+            self._directory.update(k, _merge)
+
+    # ---- cached snapshot plane (ISSUE 17) ---------------------------
+    def _refresh_plane(self, indices, concurrent: bool = False) -> None:
+        """Fetch fresh load snapshots for ``indices`` and rebuild the
+        index arrays/heaps. Sync mode calls this per submit (the old
+        per-request view, one code path); cached mode calls it from
+        :meth:`maintain` — submit then reads local state only."""
+        indices = [i for i in indices if 0 <= i < len(self.replicas)]
+        if concurrent and len(indices) > 1:
+            pool = _probe_pool()
+            futs = [(i, pool.submit(self._safe_snapshot, i))
+                    for i in indices]
+            fetched = [(i, f.result()) for i, f in futs]
+        else:
+            fetched = [(i, self._safe_snapshot(i)) for i in indices]
+        now = time.monotonic()
+        for i, snap in fetched:
+            self._snaps[i] = snap
+            self._snap_ts[i] = now
+        self._plane_warm = all(t > 0.0 for t in self._snap_ts)
+        self._count("snapshot_refreshes")
+        self._rebuild_index()
+
+    def _ensure_plane(self, live: List[int]) -> None:
+        """Cached mode: fetch only never-seen replicas (none, after
+        __init__'s full refresh) — submit pays zero snapshot RPCs.
+        O(1) once the plane is warm: the missing-scan only runs while
+        some replica has never been snapshotted."""
+        if self._plane_warm:
+            return
+        missing = [i for i in live if self._snap_ts[i] == 0.0]
+        if missing:
+            self._refresh_plane(missing, concurrent=len(missing) >= 8)
+
+    def _rebuild_index(self) -> None:
+        """Recompute the index arrays, aggregates, and heaps from the
+        current snapshot plane — O(N), paid once per plane refresh or
+        eligibility transition, never per candidate. Bumps every
+        entry version so stale heap entries die lazily."""
         with self._lock:
             failed = set(self._failed)
-        return [i for i in range(len(self.replicas)) if i not in failed]
+            standby = set(self._standby)
+        n = len(self.replicas)
+        heap: List[Tuple[int, int, int, int]] = []
+        free_heap: List[Tuple[int, int, int]] = []
+        agg_depth = n_depth0 = n_eligible = 0
+        all_paged = True
+        # the live list is cached here (every failure-set transition
+        # rebuilds the index) so submit never pays an O(N) scan for it
+        self._live_cache = [i for i in range(n) if i not in failed]
+        with self._idx_lock:
+            for i in range(n):
+                snap = self._snaps[i]
+                qd = int(snap.get("queue_depth", 0) or 0)
+                running = int(snap.get("running", 0) or 0)
+                free = snap.get("kv_pages_free")
+                free = None if free is None else int(free)
+                closed = bool(snap.get("closed"))
+                self._qd[i] = qd
+                self._score[i] = qd + running
+                self._free[i] = free
+                self._closed_snap[i] = closed
+                self._ver_label[i] = self._snap_version(snap)
+                self._entry_ver[i] += 1
+                elig = (i not in failed and not closed
+                        and i not in self._prefill_set
+                        and i not in standby)
+                self._in_heap[i] = elig
+                if elig:
+                    n_eligible += 1
+                    agg_depth += qd
+                    if qd == 0:
+                        n_depth0 += 1
+                    if free is None:
+                        all_paged = False
+                    heap.append((self._score[i], -(free or 0), i,
+                                 self._entry_ver[i]))
+                    free_heap.append((-(free or 0), i,
+                                      self._entry_ver[i]))
+            heapq.heapify(heap)
+            heapq.heapify(free_heap)
+            self._heap = heap
+            self._free_heap = free_heap
+            self._agg_depth = agg_depth
+            self._n_depth0 = n_depth0
+            self._n_eligible = n_eligible
+            self._all_paged = all_paged and n_eligible > 0
+
+    def _note_placed(self, idx: int, pages: int = 0) -> None:
+        """Local delta correction after a successful placement: the
+        cached plane learns +1 depth / -pages headroom immediately, so
+        cached-mode submits spread between refreshes exactly the way
+        sync-mode refetches would show."""
+        with self._idx_lock:
+            in_heap = self._in_heap[idx]
+            if in_heap and self._qd[idx] == 0:
+                self._n_depth0 -= 1
+            self._qd[idx] += 1
+            self._score[idx] += 1
+            if in_heap:
+                self._agg_depth += 1
+            if self._free[idx] is not None and pages:
+                self._free[idx] = max(0, self._free[idx] - int(pages))
+            self._entry_ver[idx] += 1
+            if in_heap:
+                heapq.heappush(
+                    self._heap,
+                    (self._score[idx], -(self._free[idx] or 0), idx,
+                     self._entry_ver[idx]))
+                heapq.heappush(
+                    self._free_heap,
+                    (-(self._free[idx] or 0), idx,
+                     self._entry_ver[idx]))
+
+    def _pop_candidate_locked(self, restore: List[tuple]) -> Optional[int]:
+        # caller holds _idx_lock; valid pops land in ``restore`` so an
+        # unplaced candidate's entry goes back on the heap afterwards
+        while self._heap:
+            ent = heapq.heappop(self._heap)
+            score, negfree, i, ver = ent
+            if ver != self._entry_ver[i] or not self._in_heap[i]:
+                continue  # stale entry: a fresh one exists (or i left)
+            restore.append(ent)
+            return i
+        return None
+
+    def _peek_max_free_locked(self) -> Optional[Tuple[int, int]]:
+        # caller holds _idx_lock
+        while self._free_heap:
+            negfree, i, ver = self._free_heap[0]
+            if ver != self._entry_ver[i] or not self._in_heap[i]:
+                heapq.heappop(self._free_heap)
+                continue
+            return i, -negfree
+        return None
+
+    def _eligible_indices(self) -> List[int]:
+        with self._idx_lock:
+            return [i for i in range(len(self.replicas))
+                    if self._in_heap[i]]
+
+    def _eligible_order(self) -> List[int]:
+        # the old full-sort order, off the cached arrays — the shed /
+        # contention fallback, never the steady-state hot path
+        with self._idx_lock:
+            return sorted(
+                (i for i in range(len(self.replicas))
+                 if self._in_heap[i]),
+                key=lambda i: (self._score[i], -(self._free[i] or 0),
+                               i))
+
+    def _staleness_s(self, live: Optional[List[int]] = None) -> float:
+        if live is None:
+            live = self._live_indices()
+        now = time.monotonic()
+        return max((now - self._snap_ts[i] for i in live
+                    if self._snap_ts[i] > 0.0), default=0.0)
+
+    def _bucket_lock(self, bucket: int) -> threading.Lock:
+        with self._place_lock:
+            lk = self._bucket_locks.get(bucket)
+            if lk is None:
+                lk = self._bucket_locks[bucket] = threading.Lock()
+            return lk
+
+    def _live_indices(self) -> List[int]:
+        # O(1): every failure-set transition goes through
+        # _rebuild_index, which REPLACES this list (never mutates it)
+        # — so handing out the current one is a safe snapshot
+        return self._live_cache
 
     def _accepting_failover(self) -> bool:
         with self._lock:
@@ -565,7 +902,22 @@ class Router:
         makes a version A/B during a rollout token-identical per
         version; a version nothing live serves raises
         :class:`SchedulerClosed` (503 — go elsewhere, the version is
-        gone or not yet rolled)."""
+        gone or not yet rolled). Every call — placed, shed, or
+        rejected — lands in the ``router.place_ms`` histogram."""
+        from tpuflow.obs.gauges import observe
+
+        t0 = time.perf_counter()
+        try:
+            return self._submit(
+                prompt, max_new_tokens, deadline_s=deadline_s,
+                stream_cb=stream_cb, request_id=request_id,
+                speculate=speculate, pin_version=pin_version)
+        finally:
+            observe("router.place_ms",
+                    (time.perf_counter() - t0) * 1e3)
+
+    def _submit(self, prompt, max_new_tokens, *, deadline_s, stream_cb,
+                request_id, speculate, pin_version) -> RouterRequest:
         ids = self._encode(prompt)
         if max_new_tokens is None:
             max_new_tokens = self.max_new_cap
@@ -578,74 +930,146 @@ class Router:
         live = self._live_indices()
         if not live:
             raise SchedulerClosed("router has no live replicas")
-        snaps = {i: self._safe_snapshot(i) for i in live}
-        # DECODE placement candidates: prefill-class replicas never
-        # own a request's decode (ISSUE 14) — they serve prompt passes
-        # through _begin_transfer below; standby replicas (ISSUE 15)
-        # take no traffic until a rollout activates them
+        # snapshot plane: sync mode pays the per-submit refresh (the
+        # tier's historical freshness contract); cached mode reads
+        # the view maintain() keeps within its poll cadence
+        if self._snapshot_cache:
+            self._ensure_plane(live)
+        else:
+            self._refresh_plane(live)
         with self._lock:
             standby = set(self._standby)
-        eligible = [i for i in live if not snaps[i].get("closed")
-                    and i not in self._prefill_set
-                    and i not in standby]
+        # version-pinned and spray placements replicate the full-sort
+        # ordering off the cached arrays (still zero per-request
+        # RPCs); everything else — the fleet hot path — goes through
+        # the heaps
+        if pin_version is not None or self._placement == "spray":
+            return self._submit_ordered(
+                ids, int(max_new_tokens), live, standby, deadline_s,
+                stream_cb, request_id, speculate, pin_version)
+        return self._submit_heap(
+            ids, int(max_new_tokens), live, standby, deadline_s,
+            stream_cb, request_id, speculate)
+
+    def _min_retry(self, pool: Sequence[int]) -> float:
+        """Min Retry-After across ``pool`` — read from the cached
+        snapshot plane's ``retry_after_s`` hint when the replica's
+        load snapshot carries one (zero RPCs on an overloaded tier),
+        with the per-replica RPC as the fallback for backends that
+        don't; probe failures are COUNTED and logged, never silently
+        swallowed."""
+        vals = []
+        for i in pool:
+            hint = self._snaps[i].get("retry_after_s")
+            if hint is not None:
+                try:
+                    vals.append(float(hint))
+                    continue
+                except (TypeError, ValueError):
+                    pass
+            try:
+                vals.append(float(self.replicas[i].retry_after_s()))
+            except Exception as e:
+                self._count("retry_probe_errors")
+                self.metrics.event(
+                    "-shed-", "retry_probe_error",
+                    replica=self.replicas[i].name, error=repr(e))
+        return min(vals) if vals else 1.0
+
+    def _shed(self, kind: str, depth: int,
+              pool: Sequence[int]) -> None:
+        retry = self._min_retry(pool)
+        self._count("shed")
+        if kind == "kv":
+            self._count("shed_kv")
+        self.metrics.event("-shed-", "shed", kind=kind,
+                           depth=depth, retry_after_s=retry)
+        raise QueueFull(depth, retry)
+
+    def _kv_dry(self, rows: List[Tuple[int, Optional[int], int]],
+                n_prompt: int, max_new: int) -> bool:
+        # the original per-replica dry test, over cached rows: shed
+        # only when EVERY eligible replica is paged, short of its OWN
+        # pages_needed (page sizes may differ), and backlogged
+        if not rows:
+            return False
+        for i, free, qd in rows:
+            if free is None:
+                return False  # not a paged tier: pages never the gate
+            need = self.replicas[i].pages_needed(n_prompt, max_new)
+            if not (free < (need or 0) and qd > 0):
+                return False
+        return True
+
+    def _affinity_walk(
+            self, ids: np.ndarray) -> Tuple[List[bytes], Optional[int]]:
+        """Deepest-known-chain affinity target for this prompt, plus
+        its chunk keys; also does the hot-head accounting (ISSUE 15):
+        the deepest chain this prompt exercises, with its covering
+        token prefix — what a rollout replays onto a freshly swapped
+        replica to rebuild prefix warmth."""
+        if self.affinity_ps is None or ids.size <= 1:
+            return [], None
+        keys = chunk_keys(ids[: ids.size - 1], self.affinity_ps)
+        tgt = None
+        for j in range(len(keys) - 1, -1, -1):
+            tgt = self._affinity.get(keys[j])
+            if tgt is not None:
+                break
+        if keys:
+            head = keys[-1]
+            prefix = np.asarray(ids[: len(keys) * self.affinity_ps],
+                                np.int32)
+
+            def _bump(rec):
+                if rec is None:
+                    rec = {"count": 0, "tokens": prefix}
+                rec["count"] += 1
+                return rec
+
+            self._hot.update(head, _bump)
+        return keys, tgt
+
+    def _submit_ordered(self, ids, max_new_tokens, live, standby,
+                        deadline_s, stream_cb, request_id, speculate,
+                        pin_version) -> RouterRequest:
+        """Version-pinned / spray placement: the original full-sort
+        ordering, replayed over the cached plane arrays — same
+        eligibility, same (load, -headroom, idx) key, same spray
+        rotation; the only change is WHERE the load view comes from
+        (the snapshot plane, not N per-request RPCs)."""
+        with self._idx_lock:
+            eligible = [i for i in range(len(self.replicas))
+                        if self._in_heap[i]]
+            scores = {i: self._score[i] for i in eligible}
+            frees = {i: self._free[i] for i in eligible}
+            qds = {i: self._qd[i] for i in eligible}
+            vers = {i: self._ver_label[i] for i in eligible}
         if not eligible:
             raise SchedulerClosed(
                 "every decode-capable replica is draining or closed")
         if pin_version is not None:
-            eligible = [i for i in eligible
-                        if self._snap_version(snaps[i]) == pin_version]
+            eligible = [i for i in eligible if vers[i] == pin_version]
             if not eligible:
                 raise SchedulerClosed(
                     f"model version {pin_version!r} is not served by "
                     f"any live replica")
-        depth = sum(int(snaps[i].get("queue_depth", 0)) for i in eligible)
-
-        def _min_retry() -> float:
-            vals = []
-            for i in eligible:
-                try:
-                    vals.append(float(self.replicas[i].retry_after_s()))
-                except Exception:
-                    pass
-            return min(vals) if vals else 1.0
-
+        depth = sum(qds[i] for i in eligible)
         if (self.max_total_queue is not None
                 and depth >= self.max_total_queue):
-            retry = _min_retry()
-            self._count("shed")
-            self.metrics.event("-shed-", "shed", kind="queue",
-                              depth=depth, retry_after_s=retry)
-            raise QueueFull(depth, retry)
+            self._shed("queue", depth, eligible)
         if self.shed_on_dry_kv:
-            dry = []
-            for i in eligible:
-                free = snaps[i].get("kv_pages_free")
-                if free is None:
-                    dry = []
-                    break  # not a paged tier: pages never the gate
-                need = self.replicas[i].pages_needed(
-                    int(ids.size), int(max_new_tokens))
-                dry.append(free < (need or 0)
-                           and int(snaps[i].get("queue_depth", 0)) > 0)
-            if dry and all(dry):
-                retry = _min_retry()
-                self._count("shed")
-                self._count("shed_kv")
-                self.metrics.event("-shed-", "shed", kind="kv",
-                                  depth=depth, retry_after_s=retry)
-                raise QueueFull(depth, retry)
+            rows = [(i, frees[i], qds[i]) for i in eligible]
+            if self._kv_dry(rows, int(ids.size), int(max_new_tokens)):
+                self._shed("kv", depth, eligible)
 
-        # ---- ordering: least-loaded, affinity-first, or spray -------
-        scores = {i: int(snaps[i].get("queue_depth", 0))
-                  + int(snaps[i].get("running", 0)) for i in eligible}
+        # ---- ordering: least-loaded (pinned) or spray ---------------
         # decode placement tie-break on PAGE HEADROOM (ISSUE 14): at
         # equal load, the replica with the most free pages hosts the
         # decode — that is the resource a decode-class replica sells
         order = sorted(
             eligible,
-            key=lambda i: (scores[i],
-                           -int(snaps[i].get("kv_pages_free") or 0),
-                           i))
+            key=lambda i: (scores[i], -(frees[i] or 0), i))
         affinity_used = False
         keys: List[bytes] = []
         if self._placement == "spray":
@@ -653,32 +1077,8 @@ class Router:
 
             j = zlib.crc32(ids.tobytes()) % len(order)
             order = sorted(eligible)[j:] + sorted(eligible)[:j]
-        elif self.affinity_ps is not None and ids.size > 1:
-            keys = chunk_keys(ids[: ids.size - 1], self.affinity_ps)
-            with self._lock:
-                tgt = None
-                for j in range(len(keys) - 1, -1, -1):
-                    tgt = self._affinity.get(keys[j])
-                    if tgt is not None:
-                        break
-                if keys:
-                    # hot-head accounting (ISSUE 15): the deepest
-                    # chain this prompt exercises, with its covering
-                    # token prefix — what a rollout replays onto a
-                    # freshly swapped replica to rebuild prefix warmth
-                    head = keys[-1]
-                    rec = self._hot.get(head)
-                    if rec is None:
-                        self._hot[head] = rec = {
-                            "count": 0,
-                            "tokens": np.asarray(
-                                ids[: len(keys) * self.affinity_ps],
-                                np.int32),
-                        }
-                    rec["count"] += 1
-                    self._hot.move_to_end(head)
-                    while len(self._hot) > self._hot_cap:
-                        self._hot.popitem(last=False)
+        else:
+            keys, tgt = self._affinity_walk(ids)
             if tgt is not None and tgt in eligible:
                 if scores[tgt] <= scores[order[0]] + self.affinity_slack:
                     order.remove(tgt)
@@ -686,98 +1086,235 @@ class Router:
                     affinity_used = True
                 else:
                     self._count("affinity_spills")
+        decisions = self._phase_decisions(ids, keys, order[0], live,
+                                          standby)
+        return self._place(
+            ids, max_new_tokens, deadline_s, stream_cb, request_id,
+            speculate, pin_version, first=order[0],
+            candidates=iter(order), keys=keys,
+            affinity_used=affinity_used, depth=depth,
+            retry_pool=lambda: eligible, decisions=decisions)
 
-        # ---- two-phase placement (ISSUE 14) -------------------------
-        # the decode HOME is order[0] (affinity + load + headroom);
-        # whether the PROMPT PASS runs there too is a second decision:
-        # when the tier is disaggregated and the home's estimated
-        # uncached suffix is long enough to be worth shipping pages,
-        # the prefill goes to a prefill-class replica and the chain
-        # follows the request to its decode home over the wire
+    def _phase_decisions(self, ids, keys, home, live, standby):
+        """The two second-phase placement decisions for a request
+        whose decode HOME is ``home``, off the cached plane arrays.
+
+        TWO-PHASE PLACEMENT (ISSUE 14): whether the PROMPT PASS runs
+        on a prefill-class replica (when the tier is disaggregated
+        and the home's estimated uncached suffix is long enough to be
+        worth shipping pages) — the chain then follows the request to
+        its decode home over the wire. Version fence (ISSUE 15): a
+        chain exported by a replica on a DIFFERENT model version is
+        garbage for the decode home — mid-rollout, transfers only
+        cross same-version pairs; everything else local-prefills
+        (tokens identical).
+
+        TIER-GLOBAL DIRECTORY PULL (ISSUE 16): when the DIRECTORY
+        knows a different live replica holds the prefix
+        ≥ transfer_min_tokens deeper than anything the home has
+        (resident or spilled), the chain is PULLED from that holder
+        over offer_chain instead of recomputed."""
         do_transfer = False
+        pf_live: List[int] = []
         if self.disaggregated and self._placement != "spray":
-            # version fence (ISSUE 15): a chain exported by a replica
-            # on a DIFFERENT model version is garbage for the decode
-            # home — mid-rollout, transfers only cross same-version
-            # pairs; everything else local-prefills (tokens identical)
-            home_v = self._snap_version(snaps[order[0]])
+            home_v = self._ver_label[home]
             pf_live = [i for i in live if i in self._prefill_set
-                       and not snaps[i].get("closed")
+                       and not self._closed_snap[i]
                        and i not in standby
-                       and self._snap_version(snaps[i]) == home_v]
+                       and self._ver_label[i] == home_v]
             if pf_live:
                 cached_tokens = 0
                 if keys:
-                    tgt0 = order[0]
-                    with self._lock:
-                        for j, k in enumerate(keys):
-                            if self._affinity.get(k) != tgt0:
-                                break
-                            cached_tokens = (j + 1) * self.affinity_ps
+                    for j, k in enumerate(keys):
+                        if self._affinity.get(k) != home:
+                            break
+                        cached_tokens = (j + 1) * self.affinity_ps
                 uncached = int(ids.size) - cached_tokens
                 do_transfer = uncached >= self.transfer_min_tokens
-
-        # ---- tier-global directory pull (ISSUE 16) ------------------
-        # the home is picked as above; when the DIRECTORY knows a
-        # different live replica holds the prefix ≥ transfer_min_tokens
-        # deeper than anything the home has (resident or spilled), the
-        # chain is PULLED from that holder over offer_chain instead of
-        # recomputed — the request routes to any replica that can
-        # import its chain, not just the one that computed it
         do_pull = False
         pull_src: Optional[int] = None
         pull_tokens: Optional[np.ndarray] = None
         if (self.tier_directory and not do_transfer
                 and self._placement != "spray" and keys):
-            home0 = order[0]
-            home_v = self._snap_version(snaps[home0])
-            with self._lock:
-                cached_tokens = 0
-                for j, k in enumerate(keys):
-                    ent = self._directory.get(k)
-                    if not (self._affinity.get(k) == home0
-                            or (ent is not None and home0 in ent)):
-                        break
-                    cached_tokens = (j + 1) * self.affinity_ps
-                for j in range(len(keys) - 1, -1, -1):
-                    covered = (j + 1) * self.affinity_ps
-                    if (covered - cached_tokens
-                            < self.transfer_min_tokens):
-                        break  # shallower coverage only shrinks it
-                    ent = self._directory.get(keys[j])
-                    if not ent:
-                        continue
-                    # holders must be live, open, same model version
-                    # (a chain under other weights is garbage — the
-                    # ISSUE 15 version fence); standby holders DO
-                    # donate (alive, just taking no placements)
-                    hold = [i for i in sorted(ent)
-                            if i != home0 and i in snaps
-                            and not snaps[i].get("closed")
-                            and self._snap_version(snaps[i]) == home_v]
-                    if hold:
-                        do_pull = True
-                        pull_src = hold[0]
-                        pull_tokens = ids[:covered]
-                        break
+            home_v = self._ver_label[home]
+            live_set = set(live)
+            cached_tokens = 0
+            for j, k in enumerate(keys):
+                ent = self._directory.get(k)
+                if not (self._affinity.get(k) == home
+                        or (ent is not None and home in ent)):
+                    break
+                cached_tokens = (j + 1) * self.affinity_ps
+            for j in range(len(keys) - 1, -1, -1):
+                covered = (j + 1) * self.affinity_ps
+                if (covered - cached_tokens
+                        < self.transfer_min_tokens):
+                    break  # shallower coverage only shrinks it
+                ent = self._directory.get(keys[j])
+                if not ent:
+                    continue
+                # holders must be live, open, same model version
+                # (a chain under other weights is garbage — the
+                # ISSUE 15 version fence); standby holders DO
+                # donate (alive, just taking no placements)
+                hold = [i for i in sorted(ent)
+                        if i != home and i in live_set
+                        and not self._closed_snap[i]
+                        and self._ver_label[i] == home_v]
+                if hold:
+                    do_pull = True
+                    pull_src = hold[0]
+                    pull_tokens = ids[:covered]
+                    break
+        return do_transfer, pf_live, do_pull, pull_src, pull_tokens
 
-        # ---- place ---------------------------------------------------
-        bucket = self.replicas[order[0]].bucket_of(int(ids.size))
+    def _submit_heap(self, ids, max_new_tokens, live, standby,
+                     deadline_s, stream_cb, request_id,
+                     speculate) -> RouterRequest:
+        """The fleet hot path: O(1) sheds off the plane aggregates,
+        O(log N) candidate order off the lazy version-stamped heap
+        (same (load, -headroom, idx) key the full sort used), the
+        affinity valve applied against the heap's best. Entries
+        popped for candidates that did NOT take the request go back
+        on the heap; the placed replica's entry is superseded by
+        :meth:`_note_placed`'s fresh one."""
+        with self._idx_lock:
+            n_eligible = self._n_eligible
+            depth = self._agg_depth
+        if n_eligible == 0:
+            raise SchedulerClosed(
+                "every decode-capable replica is draining or closed")
+        if (self.max_total_queue is not None
+                and depth >= self.max_total_queue):
+            self._shed("queue", depth, self._eligible_indices())
+        if self.shed_on_dry_kv:
+            self._kv_shed_fast(ids, max_new_tokens, depth)
+        keys, tgt = self._affinity_walk(ids)
+        restore: List[tuple] = []
+        rr: Optional[RouterRequest] = None
+        try:
+            with self._idx_lock:
+                best = self._pop_candidate_locked(restore)
+                best_score = self._score[best] if best is not None else 0
+            if best is None:
+                # contention fallback: every current heap entry is
+                # checked out by a racing submit — fall back to the
+                # array sort (never the sequential steady state)
+                order0 = self._eligible_order()
+                if not order0:
+                    raise SchedulerClosed(
+                        "every decode-capable replica is draining or "
+                        "closed")
+                best = order0[0]
+                best_score = self._score[best]
+            first = best
+            affinity_used = False
+            if (tgt is not None and 0 <= tgt < len(self.replicas)
+                    and self._in_heap[tgt]):
+                if (tgt == best
+                        or self._score[tgt]
+                        <= best_score + self.affinity_slack):
+                    first = tgt
+                    affinity_used = True
+                else:
+                    self._count("affinity_spills")
+            decisions = self._phase_decisions(ids, keys, first, live,
+                                              standby)
+            rr = self._place(
+                ids, max_new_tokens, deadline_s, stream_cb, request_id,
+                speculate, None, first=first,
+                candidates=self._heap_candidates(first, best, restore),
+                keys=keys, affinity_used=affinity_used, depth=depth,
+                retry_pool=self._eligible_indices,
+                decisions=decisions)
+            return rr
+        finally:
+            placed = rr._replica_idx if rr is not None else -1
+            with self._idx_lock:
+                for ent in restore:
+                    score, negfree, i, ver = ent
+                    if (i != placed and ver == self._entry_ver[i]
+                            and self._in_heap[i]):
+                        heapq.heappush(self._heap, ent)
+
+    def _kv_shed_fast(self, ids, max_new_tokens, depth) -> None:
+        """O(1) gates for the all-allocators-dry shed: a tier that is
+        not fully paged, or has ANY idle eligible replica, or whose
+        max-headroom replica covers its own pages_needed, cannot be
+        all-dry — only when every gate fails does the exact (cached,
+        RPC-free) per-replica scan run, preserving the original
+        mixed-page-size dry semantics before a 429."""
+        with self._idx_lock:
+            if not self._all_paged or self._n_depth0 > 0:
+                return
+            top = self._peek_max_free_locked()
+        if top is not None:
+            i, free = top
+            need = self.replicas[i].pages_needed(
+                int(ids.size), int(max_new_tokens))
+            if free >= (need or 0):
+                return
+        with self._idx_lock:
+            rows = [(i, self._free[i], self._qd[i])
+                    for i in range(len(self.replicas))
+                    if self._in_heap[i]]
+        if self._kv_dry(rows, int(ids.size), int(max_new_tokens)):
+            self._shed("kv", depth, [i for i, _, _ in rows])
+
+    def _heap_candidates(self, first: int, best: int,
+                         restore: List[tuple]):
+        """Candidate order for the heap path: the affinity pick (when
+        promoted), the heap best, then lazy pops in exact sort order;
+        if racing submits have the remaining entries checked out, the
+        array sort finishes the walk so a rejection cascade still
+        tries every eligible replica."""
+        tried = {first}
+        yield first
+        if best != first:
+            tried.add(best)
+            yield best
+        while True:
+            with self._idx_lock:
+                i = self._pop_candidate_locked(restore)
+            if i is None:
+                break
+            if i in tried:
+                continue
+            tried.add(i)
+            yield i
+        for i in self._eligible_order():
+            if i not in tried:
+                tried.add(i)
+                yield i
+
+    def _place(self, ids, max_new_tokens, deadline_s, stream_cb,
+               request_id, speculate, pin_version, *, first, candidates,
+               keys, affinity_used, depth, retry_pool,
+               decisions) -> RouterRequest:
+        """Shared placement tail: stream-id pinning, the try-each-
+        candidate loop, commit, events, and the transfer/pull
+        kickoffs. ``retry_pool`` is a thunk — the Retry-After pool is
+        only materialized when a shed/rejection actually needs it."""
+        do_transfer, pf_live, do_pull, pull_src, pull_tokens = decisions
+        bucket = self.replicas[first].bucket_of(int(ids.size))
         with self._lock:
             self._seq += 1
             rid = request_id or f"rt-{self._seq}"
         last_qf: Optional[QueueFull] = None
         saw_closed = False
         placed: Optional[int] = None
+        placed_score = 0
         # counter-read → place → counter-commit is ONE critical
-        # section (_place_lock): the tier-global per-bucket stream
-        # pinning hands this submission EXACTLY the id a single
+        # section, PER BUCKET (ISSUE 17): the tier-global per-bucket
+        # stream pinning hands this submission EXACTLY the id a single
         # scheduler with the same slot count would — concurrent
-        # submits must serialize here or two racers share an id (same
-        # sampling stream) and every later id desyncs from the parity
-        # sequence. The counter advances only on successful placement,
-        # like the single scheduler's.
-        with self._place_lock:
+        # submits IN THE SAME BUCKET must serialize here or two racers
+        # share an id (same sampling stream) and every later id
+        # desyncs from the parity sequence; different buckets advance
+        # independent counters and proceed in parallel. The counter
+        # advances only on successful placement, like the single
+        # scheduler's.
+        with self._bucket_lock(bucket):
             with self._lock:
                 n = self._admit_counts.get(bucket, 0)
             stream_id = n % self.slots
@@ -804,7 +1341,7 @@ class Router:
             # the PR 8 replica signature (duck-typed backends/fakes)
             extra = ({"await_transfer": await_tid}
                      if await_tid is not None else {})
-            for idx in order:
+            for idx in candidates:
                 rep = self.replicas[idx]
                 cb = rr._make_cb()
                 try:
@@ -832,28 +1369,31 @@ class Router:
                     self._inflight[rid] = rr
                     self.placements[rep.name] = (
                         self.placements.get(rep.name, 0) + 1)
-                    if keys:
-                        for k in keys:
-                            self._affinity[k] = idx
-                            self._affinity.move_to_end(k)
-                        while len(self._affinity) > self._affinity_cap:
-                            self._affinity.popitem(last=False)
-                        if self.tier_directory:
-                            self._directory_put_locked(keys, idx,
-                                                       "resident")
+                if keys:
+                    for k in keys:
+                        self._affinity.put(k, idx)
+                    if self.tier_directory:
+                        self._directory_put(keys, idx, "resident")
                 placed = idx
+                placed_score = self._score[idx]
                 break
         if placed is not None:
+            try:
+                pages = self.replicas[placed].pages_needed(
+                    int(ids.size), int(max_new_tokens))
+            except Exception:
+                pages = 0
+            self._note_placed(placed, int(pages or 0))
             self._count("placed")
-            if affinity_used and placed == order[0]:
+            if affinity_used and placed == first:
                 self._count("affinity_hits")
             self.metrics.event(rid, "placed",
                               replica=self.replicas[placed].name,
                               stream_id=stream_id, bucket=bucket,
                               affinity=bool(affinity_used
-                                            and placed == order[0]),
+                                            and placed == first),
                               transfer=bool(do_transfer),
-                              depth=scores.get(placed, 0))
+                              depth=placed_score)
             if do_transfer:
                 self._begin_transfer(rr, pf_live, keys)
             elif do_pull:
@@ -866,7 +1406,7 @@ class Router:
         # draining tier.
         if last_qf is None and saw_closed:
             raise SchedulerClosed("every replica is draining or closed")
-        retry = _min_retry()
+        retry = self._min_retry(retry_pool())
         if last_qf is not None:
             retry = min(retry, last_qf.retry_after_s)
         self._count("rejected")
@@ -928,23 +1468,20 @@ class Router:
         without recomputing); every rejection falls through to the
         next candidate, and total rejection falls back to a local
         prefill on the decode home — tokens identical either way."""
-        snaps = {i: self._safe_snapshot(i) for i in pf_candidates}
-        open_pf = [i for i in pf_candidates
-                   if not snaps[i].get("closed")]
+        with self._idx_lock:
+            open_pf = [i for i in pf_candidates
+                       if not self._closed_snap[i]]
+            pf_scores = {i: self._score[i] for i in open_pf}
         if not open_pf:
             return self._abort_transfer(
                 rr, "no open prefill replica", claim=True)
-        pf_scores = {i: int(snaps[i].get("queue_depth", 0))
-                     + int(snaps[i].get("running", 0))
-                     for i in open_pf}
         order = sorted(open_pf, key=lambda i: (pf_scores[i], i))
         if keys:
-            with self._lock:
-                tgt = None
-                for j in range(len(keys) - 1, -1, -1):
-                    tgt = self._pf_affinity.get(keys[j])
-                    if tgt is not None:
-                        break
+            tgt = None
+            for j in range(len(keys) - 1, -1, -1):
+                tgt = self._pf_affinity.get(keys[j])
+                if tgt is not None:
+                    break
             if (tgt in pf_scores
                     and pf_scores[tgt] <= pf_scores[order[0]]
                     + self.affinity_slack):
@@ -969,13 +1506,8 @@ class Router:
             with rr._lock:
                 if rr._transfer is not None:
                     rr._transfer["pf_req"] = pf_req
-            with self._lock:
-                if keys:
-                    for k in keys:
-                        self._pf_affinity[k] = idx
-                        self._pf_affinity.move_to_end(k)
-                    while len(self._pf_affinity) > self._affinity_cap:
-                        self._pf_affinity.popitem(last=False)
+            for k in keys:
+                self._pf_affinity.put(k, idx)
             self.metrics.event(rr.id, "prefill_placed",
                               replica=rep.name)
             return
@@ -1094,11 +1626,10 @@ class Router:
                 if rr._transfer is not None:
                     rr._transfer["phase"] = "decode"
             self._count("pulls")
-            with self._lock:
-                self._directory_put_locked(
-                    [bytes.fromhex(h) for h in
-                     wire.get("chunk_keys", ())],
-                    d_idx, "resident")
+            self._directory_put(
+                [bytes.fromhex(h) for h in
+                 wire.get("chunk_keys", ())],
+                d_idx, "resident")
             self.metrics.event(
                 rr.id, "pull",
                 pages=int(wire.get("n_pages", 0)),
@@ -1132,8 +1663,7 @@ class Router:
                     tier = str(ch.get("tier", "host"))
                 except (KeyError, TypeError, ValueError):
                     continue
-                with self._lock:
-                    self._directory_put_locked(keys, idx, tier)
+                self._directory_put(keys, idx, tier)
                 merged += 1
         return merged
 
@@ -1181,6 +1711,7 @@ class Router:
         :meth:`activate`)."""
         with self._lock:
             self._standby.add(int(idx))
+        self._rebuild_index()
 
     def activate(self, idx: int) -> None:
         """Standby → active: the replica joins placement (least-
@@ -1190,6 +1721,10 @@ class Router:
             self._standby.discard(int(idx))
             self._retiring.discard(int(idx))
             self._failed.pop(int(idx), None)
+        # a freshly activated replica may have swapped weights while
+        # parked — refetch its snapshot so the version fence sees the
+        # new label before the next placement, then rebuild the heaps
+        self._refresh_plane([int(idx)])
         self.metrics.event("-deploy-", "replica_activated",
                            replica=self.replicas[idx].name)
 
@@ -1203,6 +1738,9 @@ class Router:
             self.replicas[idx].drain()
         except Exception:
             pass
+        # the drain flips the replica's snapshot to closed — refetch
+        # so cached-plane submits route around it immediately
+        self._refresh_plane([int(idx)])
         self.metrics.event("-deploy-", "replica_retiring",
                            replica=self.replicas[idx].name)
 
@@ -1212,6 +1750,7 @@ class Router:
         with self._lock:
             self._retiring.discard(int(idx))
         self.mark_failed(idx, reason="retired (deploy)")
+        self._rebuild_index()
 
     def recycle_as_standby(self, idx: int) -> None:
         """Drained-out replica → the next rollout's standby."""
@@ -1219,6 +1758,7 @@ class Router:
             self._retiring.discard(int(idx))
             self._standby.add(int(idx))
             self._failed.pop(int(idx), None)
+        self._rebuild_index()
         self.metrics.event("-deploy-", "replica_recycled",
                            replica=self.replicas[idx].name)
 
@@ -1228,10 +1768,9 @@ class Router:
         version bump invalidates cached KV, so warmth on the incoming
         replica is rebuilt by RE-PREFILLING these, never by
         transferring stale pages."""
-        with self._lock:
-            recs = sorted(self._hot.values(),
-                          key=lambda r: -int(r["count"]))[: max(0, int(n))]
-            return [np.array(r["tokens"], np.int32) for r in recs]
+        recs = sorted(self._hot.values(),
+                      key=lambda r: -int(r["count"]))[: max(0, int(n))]
+        return [np.array(r["tokens"], np.int32) for r in recs]
 
     def is_online(self) -> bool:
         """Whether the online maintenance thread is running (the
@@ -1255,19 +1794,63 @@ class Router:
         self._count("replicas_failed")
         self.metrics.event("-failover-", "replica_failed",
                           replica=self.replicas[idx].name, reason=reason)
+        self._rebuild_index()
+
+    def _probe_health(self, idx: int) -> Dict[str, Any]:
+        try:
+            return self.replicas[idx].health()
+        except Exception as e:
+            return {"failed": True, "error": repr(e)}
 
     def maintain(self) -> bool:
         """One health/failover sweep: poll every live replica's
         :meth:`health`, fail the tripped/closed ones, resubmit their
         never-admitted requests elsewhere. Returns whether anything
         changed. The online maintenance thread calls this on a poll
-        interval; offline drivers interleave it with replica steps."""
+        interval; offline drivers interleave it with replica steps.
+
+        Fleet scale (ISSUE 17): the sweep first refreshes the cached
+        snapshot plane (concurrently past 8 replicas), then probes
+        health through the shared pool under a ``health_timeout_s``
+        sweep deadline — a probe that misses the deadline carries over
+        to the next sweep (slow ≠ failed, counted ``health_lagged``)
+        instead of stalling failover for the whole tier."""
+        from tpuflow.obs.gauges import set_gauge
+
         progress = False
-        for idx in self._live_indices():
-            try:
-                h = self.replicas[idx].health()
-            except Exception as e:
-                h = {"failed": True, "error": repr(e)}
+        live = self._live_indices()
+        # staleness is measured BEFORE the refresh: it reports the age
+        # the previous interval actually left behind — the bound a
+        # cached-plane submit could have observed
+        set_gauge("router.snapshot_staleness_s",
+                  self._staleness_s(live))
+        self._refresh_plane(live, concurrent=len(live) >= 8)
+        live_set = set(live)
+        self._health_pending = {i: f for i, f in
+                                self._health_pending.items()
+                                if i in live_set}
+        results: Dict[int, Dict[str, Any]] = {}
+        if len(live) <= 1:
+            for idx in live:
+                results[idx] = self._probe_health(idx)
+        else:
+            pool = _probe_pool()
+            futs = {}
+            for idx in live:
+                f = self._health_pending.pop(idx, None)
+                if f is None:
+                    f = pool.submit(self._probe_health, idx)
+                futs[idx] = f
+            deadline = time.monotonic() + self.health_timeout_s
+            for idx, f in futs.items():
+                try:
+                    results[idx] = f.result(
+                        timeout=max(0.0, deadline - time.monotonic()))
+                except _futures.TimeoutError:
+                    self._health_pending[idx] = f
+                    self._count("health_lagged")
+        for idx in sorted(results):
+            h = results[idx]
             if h.get("failed"):
                 self.mark_failed(idx, reason=str(
                     h.get("error")
@@ -1344,8 +1927,6 @@ class Router:
                 progress = True
         if self.tier_directory:
             self.directory_sweep()
-        from tpuflow.obs.gauges import set_gauge
-
         set_gauge("router.replicas", float(len(self.replicas)))
         set_gauge("router.replicas_failed", float(len(failed)))
         # deployment hook (ISSUE 15): an active rollout's state
@@ -1372,15 +1953,18 @@ class Router:
         candidates = [i for i in self._live_indices()
                       if i != old_idx and i not in self._prefill_set
                       and i not in standby]
-        snaps = {i: self._safe_snapshot(i) for i in candidates}
+        # cached plane, not a snapshot fan-out: _failover runs right
+        # after maintain()'s refresh, so the arrays are this sweep's
+        with self._idx_lock:
+            scores = {i: self._score[i] for i in candidates}
+            closed = {i: self._closed_snap[i] for i in candidates}
+            vers = {i: self._ver_label[i] for i in candidates}
         if rr.pin_version is not None:
             candidates = [i for i in candidates
-                          if self._snap_version(snaps[i])
-                          == rr.pin_version]
+                          if vers[i] == rr.pin_version]
         order = sorted(
-            (i for i in candidates if not snaps[i].get("closed")),
-            key=lambda i: (int(snaps[i].get("queue_depth", 0))
-                           + int(snaps[i].get("running", 0)), i),
+            (i for i in candidates if not closed[i]),
+            key=lambda i: (scores[i], i),
         )
         if not order:
             if not self._accepting_failover() or not candidates:
@@ -1409,6 +1993,12 @@ class Router:
             with self._lock:
                 self.placements[rep.name] = (
                     self.placements.get(rep.name, 0) + 1)
+            try:
+                pages = rep.pages_needed(int(rr.prompt_ids.size),
+                                         int(rr.max_new_tokens))
+            except Exception:
+                pages = 0
+            self._note_placed(idx, int(pages or 0))
             self._count("failovers")
             self.metrics.event(rr.id, "failover",
                               from_replica=self.replicas[old_idx].name,
@@ -1610,6 +2200,18 @@ class Router:
         snap["router.queue_depth"] = float(sum(
             int(self._safe_snapshot(i).get("queue_depth", 0))
             for i in self._live_indices()))
+        # hot-path observability (ISSUE 17): placement latency
+        # percentiles + snapshot-plane staleness, so the flat-overhead
+        # claim is operator-visible on /v1/metrics
+        from tpuflow.obs.gauges import get_histogram
+
+        h = get_histogram("router.place_ms")
+        if h is not None and h.n:
+            for p in (50, 95, 99):
+                snap[f"router.place_ms_p{p}"] = float(
+                    h.percentile(p))
+        snap["router.snapshot_staleness_s"] = float(
+            self._staleness_s())
         return snap
 
     def load_snapshot(self) -> Dict[str, Any]:
@@ -1631,6 +2233,22 @@ class Router:
         frees = [s.get("kv_pages_free") for s in per.values()]
         if frees and all(f is not None for f in frees):
             out["kv_pages_free"] = int(sum(frees))
+        # fleet hot-path health (ISSUE 17): an LB composing several
+        # routers can see each tier's snapshot-plane freshness and
+        # placement latency without scraping Prometheus
+        out["snapshot_staleness_s"] = float(self._staleness_s())
+        from tpuflow.obs.gauges import get_histogram
+
+        h = get_histogram("router.place_ms")
+        if h is not None and h.n:
+            out["place_ms_p95"] = float(h.percentile(95))
+        with self._lock:
+            out["snapshot_refreshes"] = int(
+                self.counts.get("snapshot_refreshes", 0))
+            out["snapshot_errors"] = int(
+                self.counts.get("snapshot_errors", 0))
+            out["health_lagged"] = int(
+                self.counts.get("health_lagged", 0))
         return out
 
     def flight_snapshot(self) -> Dict[str, Any]:

@@ -1701,6 +1701,12 @@ class ServeScheduler:
         }
         if self.speculate_k:
             out["draft_version"] = self.draft_version
+        # shed sensor (ISSUE 17): the router's Retry-After derives
+        # from the cached snapshot plane — carrying the hint here
+        # saves one RPC per eligible replica per shed, exactly when
+        # the tier is overloaded. Computed OUTSIDE the lock block
+        # above: retry_after_s() takes the same non-reentrant lock.
+        out["retry_after_s"] = float(self.retry_after_s())
         if self.kv_state is not None:
             a = self.kv_state.allocator
             out["kv_pages_free"] = a.free_count()
