@@ -1318,6 +1318,19 @@ def main() -> int:
                    help="--serve-longctx: comma-separated "
                         "prefill_budget_tokens values for the SLO "
                         "monotonicity sweep")
+    p.add_argument("--serve-multiworkload", action="store_true",
+                   help="multi-workload serving (ISSUE 18): a mixed "
+                        "virtual-clock trace through TWO paged "
+                        "ServeSchedulers — an expert-parallel MoE "
+                        "decoder (per-expert token-load distribution, "
+                        "capacity-gate waits, never-wedge) and a "
+                        "ViT-prefix VLM whose image/text requests "
+                        "interleave in one continuous batch; records "
+                        "phase-2 prefill tokens saved on a "
+                        "repeated-image trace (the image-prefix "
+                        "cache-hit claim) + solo-oracle token "
+                        "identity; writes "
+                        "BENCH_*_serve_multiworkload.json")
     p.add_argument("--superstep", type=int, default=0, metavar="K",
                    help="A/B the superstep trainers (ISSUE 2): drive "
                         "the SAME compiled flagship train step as (a) a "
@@ -1387,6 +1400,7 @@ def main() -> int:
              else "serve_fleet" if args.serve_fleet
              else "serve_deploy" if args.serve_deploy
              else "serve_longctx" if args.serve_longctx
+             else "serve_multiworkload" if args.serve_multiworkload
              else "serve_paged" if args.serve_paged
              else "serve" if args.serve
              else "superstep" if args.superstep else args.model)
@@ -1506,6 +1520,8 @@ def _bench(args) -> int:
         return _bench_serve_deploy(args, devices)
     if args.serve_longctx:
         return _bench_serve_longctx(args, devices)
+    if args.serve_multiworkload:
+        return _bench_serve_multiworkload(args, devices)
     if args.serve_paged:
         return _bench_serve_paged(args, devices)
     if args.serve:
@@ -5881,6 +5897,247 @@ def _bench_serve_deploy(args, devices) -> int:
     )
     emit(ratio, ratio, diagnostics=diag,
          metric="serve_deploy_swap_p95_ttft_ratio", unit="x")
+    return 0
+
+
+def _bench_serve_multiworkload(args, devices) -> int:
+    """--serve-multiworkload: the ISSUE 18 record — two non-text-LM
+    workloads through the SAME paged slot engine. An expert-parallel
+    MoE decoder serves a mixed trace (per-expert token-load
+    distribution, the capacity-gate arm: hot-expert admissions HELD
+    but never wedged) and a ViT-prefix VLM serves interleaved
+    image+text traffic where every repeated image is a prefix-cache
+    hit (phase-2 prefill tokens saved — the headline value, as a
+    fraction of the ideal saveable image-prefix tokens). Both
+    workloads spot-check token identity against a fresh solo-served
+    scheduler. Virtual clock: deadlines/timestamps ride a manually
+    advanced clock, so records are wall-independent."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuflow.models import build_transformer_lm, vlm_prompt
+    from tpuflow.serve import ServeScheduler
+    from tpuflow.serve.metrics import ServeMetrics
+
+    if args.smoke:
+        dim, depth, heads, vocab = 32, 1, 2, 128
+        n_experts, image_vocab, img_hw = 4, 64, 16
+        n_moe_req, n_img, repeats, n_text = 8, 3, 3, 4
+    else:
+        dim, depth, heads, vocab = 64, 2, 4, 512
+        n_experts, image_vocab, img_hw = 8, 128, 32
+        n_moe_req, n_img, repeats, n_text = 32, 6, 4, 12
+    patch = 4
+    img_toks = (img_hw // patch) ** 2
+    slots, seg, ps, cap, new = 4, 4, 4, 16, 8
+    geo = dict(slots=slots, seg=seg, max_new_cap=cap, max_queue=64,
+               kv="paged", kv_page_size=ps, kv_pages=256)
+
+    def _init(**kw):
+        import flax.linen as nn
+
+        base = dict(vocab_size=vocab, dim=dim, depth=depth,
+                    heads=heads, mlp_ratio=2, dtype=jnp.float32)
+        base.update(kw)
+        lm = build_transformer_lm(**base)
+        params = nn.unbox(lm.init(
+            {"params": jax.random.key(0)},
+            jnp.zeros((1, 8), jnp.int32)))["params"]
+        return lm, params
+
+    class VClock:
+        now = 1e9
+
+        def __call__(self):
+            return VClock.now
+
+    def drive(sched, reqs):
+        steps = 0
+        while not sched.idle():
+            sched.step()
+            VClock.now += 0.01
+            steps += 1
+            assert steps < 200000, "multiworkload run wedged"
+        for r in reqs:
+            assert r.state.value == "done", (r.state.value, r.error)
+        return steps
+
+    def solo_tokens(built, prompt, n):
+        sc = ServeScheduler(
+            *built, metrics=ServeMetrics(gauge_prefix="serve"),
+            clock=VClock(), **geo)
+        rr = sc.submit(prompt, n)
+        drive(sc, [rr])
+        return [int(x) for x in rr.tokens]
+
+    # ---- MoE arm ----------------------------------------------------
+    moe = _init(n_experts=n_experts, moe_every=1, moe_top_k=2,
+                moe_no_drop=True)
+    rng = np.random.default_rng(18)
+    # ONE length bucket (lengths 5..7 -> bucket 8) and STAGGERED decode
+    # budgets: the short requests free slots while the long ones are
+    # still mid-flight, so second-wave admission happens against a LIVE
+    # pool — the only moment the capacity gate is allowed to hold.
+    moe_prompts = [rng.integers(1, vocab, (int(rng.integers(5, 8)),)
+                                ).astype(np.int32)
+                   for _ in range(n_moe_req)]
+    moe_new = [12 if i % 2 == 0 else 4 for i in range(n_moe_req)]
+
+    def run_moe(capacity_factor):
+        sched = ServeScheduler(
+            *moe, metrics=ServeMetrics(gauge_prefix="serve"),
+            clock=VClock(), moe_capacity_factor=capacity_factor,
+            **geo)
+        cum = np.zeros((n_experts,), np.float64)
+        inner = sched.metrics.on_moe_load
+
+        def tap(loads):
+            cum[:] += np.asarray(loads, np.float64)
+            inner(loads)
+
+        sched.metrics.on_moe_load = tap
+        reqs = [sched.submit(p, n)
+                for p, n in zip(moe_prompts, moe_new)]
+        steps = drive(sched, reqs)
+        return {
+            "steps": steps,
+            "served": len(reqs),
+            "expert_load": [round(float(x), 1) for x in cum],
+            "hot_expert_frac": round(
+                float(cum.max() / max(cum.sum(), 1.0)), 4),
+            "balance_max_over_mean": round(
+                float(cum.max() / max(cum.mean(), 1e-9)), 3),
+            "tokens_routed": int(sched.metrics.moe_tokens_routed),
+            "capacity_waits": int(sched.metrics.moe_capacity_waits),
+            "tokens": [[int(x) for x in r.tokens] for r in reqs],
+        }
+
+    _progress({"phase": "serve_multiworkload_warmup"})
+    moe_rec = run_moe(2.0)
+    _progress({"phase": "serve_multiworkload_moe",
+               "expert_load": moe_rec["expert_load"]})
+    # the capacity-gate arm: a vanishing factor marks EVERY live
+    # segment hot — admissions are held (waits count), yet the trace
+    # drains completely (degrade to queued, never wedge) and tokens
+    # never move (the gate is pure admission policy)
+    gated_rec = run_moe(1e-6)
+    _progress({"phase": "serve_multiworkload_moe_gated",
+               "capacity_waits": gated_rec["capacity_waits"]})
+    assert gated_rec["capacity_waits"] > 0, (
+        "gated arm never held an admission — trace shape no longer "
+        "exercises the capacity gate")
+    moe_identity = (
+        moe_rec["tokens"] == gated_rec["tokens"]
+        and all(moe_rec["tokens"][i] == solo_tokens(
+                    moe, moe_prompts[i], moe_new[i])
+                for i in range(2)))
+
+    # ---- VLM arm: repeated-image + text interleave ------------------
+    vlm = _init(image_vocab=image_vocab)
+    images = [rng.integers(0, 256, (img_hw, img_hw), dtype=np.uint8)
+              for _ in range(n_img)]
+    texts = [rng.integers(1, vocab, (4,)).astype(np.int32)
+             for _ in range(n_img * repeats)]
+    plain = [rng.integers(1, vocab, (6,)).astype(np.int32)
+             for _ in range(n_text)]
+
+    def vlm_trace(prefix_cache):
+        sched = ServeScheduler(
+            *vlm, metrics=ServeMetrics(gauge_prefix="serve"),
+            clock=VClock(),
+            **dict(geo, kv_prefix_cache=prefix_cache,
+                   kv_prefix_insert_generated=prefix_cache))
+        reqs = []
+        k = 0
+        for rep in range(repeats):  # phase rep>0 repeats every image
+            for i, img in enumerate(images):
+                p = vlm_prompt(img, texts[rep * n_img + i],
+                               patch=patch, image_vocab=image_vocab,
+                               text_vocab=vocab)
+                reqs.append(sched.submit(p, new))
+                if k < len(plain):  # text interleaves the same batch
+                    reqs.append(sched.submit(plain[k], new))
+                    k += 1
+            drive(sched, reqs)  # wave boundary: repeats are phase 2+
+        steps = drive(sched, reqs)
+        return sched, reqs, steps
+
+    sched, vreqs, vsteps = vlm_trace(prefix_cache=True)
+    saved = int(sched.metrics.prefill_tokens_saved)
+    ideal = n_img * (repeats - 1) * img_toks
+    hit_frac = round(saved / max(ideal, 1), 4)
+    base_sched, base_reqs, _ = vlm_trace(prefix_cache=False)
+    vlm_identity = (
+        [[int(x) for x in r.tokens] for r in vreqs]
+        == [[int(x) for x in r.tokens] for r in base_reqs]
+        and [int(x) for x in vreqs[0].tokens]
+        == solo_tokens(vlm, vlm_prompt(
+            images[0], texts[0], patch=patch,
+            image_vocab=image_vocab, text_vocab=vocab), new))
+    _progress({"phase": "serve_multiworkload_vlm",
+               "saved_phase2": saved, "ideal": ideal})
+
+    diag = {
+        "device_kind": devices[0].device_kind,
+        "workload": {
+            "moe": {"requests": n_moe_req, "n_experts": n_experts,
+                    "top_k": 2,
+                    "max_new_staggered": sorted(set(moe_new))},
+            "vlm": {"images": n_img, "repeats": repeats,
+                    "text_requests": n_text, "img_size": img_hw,
+                    "patch": patch, "image_tokens": img_toks,
+                    "image_vocab": image_vocab},
+            "seed": 18,
+        },
+        "slots": slots, "seg": seg, "page_size": ps,
+        "moe_expert_load": moe_rec["expert_load"],
+        "moe_hot_expert_frac": moe_rec["hot_expert_frac"],
+        "moe_balance_max_over_mean": moe_rec["balance_max_over_mean"],
+        "moe_tokens_routed": moe_rec["tokens_routed"],
+        "moe_capacity_waits": moe_rec["capacity_waits"],
+        "gated": {"capacity_waits": gated_rec["capacity_waits"],
+                  "served": gated_rec["served"],
+                  "steps": gated_rec["steps"],
+                  "never_wedged": gated_rec["served"] == n_moe_req},
+        "image_prefix": {
+            "phase2_tokens_saved": saved,
+            "ideal_saveable": ideal,
+            "hit_frac": hit_frac,
+            "baseline_saved": int(
+                base_sched.metrics.prefill_tokens_saved),
+        },
+        "vlm_steps": vsteps,
+        "tokens_match_oracle": bool(moe_identity and vlm_identity),
+        "span_totals_ms": _span_totals(),
+    }
+    rec = {
+        "metric": "serve_multiworkload_image_prefix_hit_frac",
+        "value": hit_frac,
+        "unit": "frac",
+        "vs_baseline": hit_frac,
+        "mode": "serve_multiworkload",
+        "smoke": bool(args.smoke),
+        "diagnostics": diag,
+    }
+    out_path = args.serve_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_LOCAL_r18_serve_multiworkload.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(
+        f"# serve-multiworkload image-prefix hit {hit_frac:.2f} "
+        f"({saved}/{ideal} phase-2 prefill tokens saved) | expert "
+        f"load {moe_rec['expert_load']} "
+        f"(hot {moe_rec['hot_expert_frac']}) | gated waits "
+        f"{gated_rec['capacity_waits']} served "
+        f"{gated_rec['served']}/{n_moe_req} | "
+        f"identical={diag['tokens_match_oracle']} -> {out_path}",
+        file=sys.stderr, flush=True,
+    )
+    emit(hit_frac, hit_frac, diagnostics=diag,
+         metric="serve_multiworkload_image_prefix_hit_frac",
+         unit="frac")
     return 0
 
 

@@ -498,6 +498,50 @@ def test_smoke_serve_fleet_emits_schema(tmp_path):
 
 
 @pytest.mark.slow
+def test_smoke_serve_multiworkload_emits_schema(tmp_path):
+    """--serve-multiworkload: the ISSUE 18 record — an expert-parallel
+    MoE decoder and a ViT-prefix VLM through the same paged slot
+    engine. Acceptance axes: per-expert token-load distribution
+    recorded, the capacity-gate arm HELD at least one admission yet
+    served the full trace (never wedged), every repeated image a
+    phase-2 prefix-cache hit, and both workloads token-identical to
+    fresh solo-served schedulers."""
+    out = str(tmp_path / "BENCH_TEST_serve_multiworkload.json")
+    r = _run("--smoke", "--serve-multiworkload", "--serve-out", out,
+             timeout=1400)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "serve_multiworkload_image_prefix_hit_frac"
+    assert "error" not in rec
+    d = rec["diagnostics"]
+    # MoE arm: every expert measured, loads consistent with the
+    # routed-token counter (top-2 routing -> even total)
+    loads = d["moe_expert_load"]
+    assert len(loads) == d["workload"]["moe"]["n_experts"]
+    assert all(x > 0 for x in loads)
+    assert d["moe_tokens_routed"] >= sum(loads)
+    assert d["moe_tokens_routed"] % 2 == 0
+    assert 0 < d["moe_hot_expert_frac"] < 1
+    # capacity-gate arm: admissions held, trace fully served anyway
+    g = d["gated"]
+    assert g["capacity_waits"] > 0
+    assert g["never_wedged"] is True
+    assert g["served"] == d["workload"]["moe"]["requests"]
+    # image-prefix arm: phase-2 prefills ride the prefix cache; the
+    # no-cache baseline saves nothing
+    ip = d["image_prefix"]
+    assert ip["phase2_tokens_saved"] > 0
+    assert ip["hit_frac"] > 0.5
+    assert ip["baseline_saved"] == 0
+    assert abs(rec["value"] - ip["hit_frac"]) < 1e-9
+    # both workloads stayed token-identical to their solo oracles
+    assert d["tokens_match_oracle"] is True
+    with open(out) as f:
+        disk = json.load(f)
+    assert disk["mode"] == "serve_multiworkload"
+
+
+@pytest.mark.slow
 def test_smoke_serve_longctx_emits_schema(tmp_path):
     """--serve-longctx: the ISSUE 13 record — concurrent short-request
     p95 ITL flatness across the 8x long-prompt growth with chunking ON

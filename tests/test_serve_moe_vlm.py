@@ -1,0 +1,440 @@
+"""Multi-workload serving (ISSUE 18): expert-parallel MoE decode and
+the ViT-prefix VLM through the paged slot engine.
+
+Tier discipline: everything here runs against tiny d32 models (the
+suite-wide serve geometry) on host-cheap paths. The load-bearing pins:
+
+- an MoE decoder served through the slot scheduler is TOKEN-IDENTICAL
+  to its own single-request wave oracle, greedy AND sampled, with
+  mid-flight joins — dropless routing makes each token's output a pure
+  function of its own hidden state, so batch composition never
+  perturbs tokens;
+- the per-expert token-load harvest reaches ALL THREE metrics surfaces
+  (ServeMetrics snapshot == /v1/metrics, the Prometheus exposition,
+  and load_snapshot()) plus the router's placement plane;
+- the host capacity gate (moe_overflow='queue') HOLDS new admissions
+  while an expert runs hot and a decode is live, and degrades to
+  queued — the in-flight batch always runs, the held request always
+  completes (never wedge);
+- image patches embed as prompt-PREFIX tokens riding sequence packing
+  unchanged: image and text requests interleave in one continuous
+  batch, token-identical to solo oracles, and a repeated image is a
+  prefix-CACHE hit (and tier demote/promote survivor) because the
+  deterministic patch-token chain hashes to the same chunk keys.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuflow.models import build_transformer_lm
+
+KW = dict(vocab_size=128, dim=32, depth=1, heads=2, mlp_ratio=2,
+          dtype=jnp.float32)
+# depth=1: only moe_every=1 places an MoE block (block i is MoE iff
+# i % moe_every == moe_every - 1) — the zero-block foot-gun is a
+# pointed construction error, pinned below
+MOE_KW = dict(KW, n_experts=4, moe_every=1, moe_top_k=2,
+              moe_no_drop=True)
+VLM_KW = dict(KW, image_vocab=64)
+GEO = dict(slots=2, seg=4, max_new_cap=24, kv="paged",
+           kv_page_size=4, kv_pages=49)
+SAMPLED = dict(temperature=0.8, top_k=20, seed=7)
+
+
+def _init(kw):
+    import flax.linen as nn
+
+    lm = build_transformer_lm(**kw)
+    params = nn.unbox(
+        lm.init({"params": jax.random.key(0)},
+                jnp.zeros((1, 8), jnp.int32)))["params"]
+    return lm, params
+
+
+@pytest.fixture(scope="module")
+def moe_lm():
+    return _init(MOE_KW)
+
+
+@pytest.fixture(scope="module")
+def vlm_lm():
+    return _init(VLM_KW)
+
+
+def _sched(built, **kw):
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = built
+    base = dict(GEO)
+    base.update(kw)
+    return ServeScheduler(lm, params, **base)
+
+
+def _drain(s, *reqs):
+    s.run_until_idle()
+    for r in reqs:
+        assert r.state.value == "done", (r.state.value, r.error)
+    return [list(r.tokens) for r in reqs]
+
+
+def _solo_oracle(built, ids, n, **samp):
+    """The single-request wave oracle: generate() with the request
+    alone in its bucket (greedy only — sampled streams are pinned by
+    the scheduler-vs-scheduler comparison below)."""
+    from tpuflow.infer.generate import generate
+
+    lm, params = built
+    bucket = max(8, 1 << (len(ids) - 1).bit_length())
+    prompt = np.zeros((1, bucket), np.int32)
+    prompt[0, bucket - len(ids):] = ids
+    pads = np.asarray([bucket - len(ids)], np.int32)
+    out = generate(lm, params, jnp.asarray(prompt), max_new_tokens=n,
+                   temperature=0.0, pad_lens=pads, **samp)
+    return list(np.asarray(out)[0, bucket:])
+
+
+def _img(seed, hw=16):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (hw, hw), dtype=np.uint8)
+
+
+# ---------------------------------------------------------------------
+# MoE decode: token identity, greedy and sampled, mid-flight joins
+# ---------------------------------------------------------------------
+
+def test_moe_serve_matches_solo_oracle_greedy(moe_lm):
+    """Mixed-length MoE requests (incl. a mid-flight join) each equal
+    their own single-request wave oracle — the ISSUE 18 identity pin:
+    expert routing sees a changing batch, tokens never move."""
+    sched = _sched(moe_lm)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 128, (n,)).astype(np.int32)
+               for n in (3, 5, 4)]
+    reqs = [sched.submit(p, 6) for p in prompts[:2]]
+    for _ in range(2):
+        assert sched.step()
+    reqs.append(sched.submit(prompts[2], 6))  # joins a live batch
+    got = _drain(sched, *reqs)
+    want = [_solo_oracle(moe_lm, p, 6) for p in prompts]
+    assert got == want
+
+
+def test_moe_serve_batch_composition_independence_sampled(moe_lm):
+    """SAMPLED identity: the same submissions served as an
+    interleaved batch vs drained one at a time produce identical
+    tokens — per-bucket stream ids depend only on admission ORDER, so
+    any divergence could only come from batch-dependent routing, which
+    dropless decode forbids."""
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(1, 128, (n,)).astype(np.int32)
+               for n in (3, 5, 4)]
+    batch = _sched(moe_lm, **SAMPLED)
+    reqs = [batch.submit(p, 6) for p in prompts[:2]]
+    for _ in range(2):
+        assert batch.step()
+    reqs.append(batch.submit(prompts[2], 6))
+    got = _drain(batch, *reqs)
+    solo = _sched(moe_lm, **SAMPLED)
+    want = []
+    for p in prompts:
+        r = solo.submit(p, 6)
+        want.extend(_drain(solo, r))
+    assert got == want
+
+
+# ---------------------------------------------------------------------
+# per-expert load: all three surfaces + the router placement signal
+# ---------------------------------------------------------------------
+
+def test_moe_expert_load_on_all_three_surfaces(moe_lm):
+    from tpuflow.obs import prom
+    from tpuflow.obs.gauges import counters, scalar_gauges
+
+    sched = _sched(moe_lm)
+    reqs = [sched.submit(np.full((3,), i + 1, np.int32), 4)
+            for i in range(2)]
+    _drain(sched, *reqs)
+    # surface 1: ServeMetrics snapshot (what /v1/metrics serves)
+    snap = sched.metrics.snapshot()
+    loads = [snap[f"serve.moe_expert_load_e{j}"] for j in range(4)]
+    assert sum(loads) > 0
+    assert snap["serve.moe_tokens_routed"] > 0
+    assert 0.25 <= snap["serve.moe_hot_expert_frac"] <= 1.0
+    assert snap["serve.moe_capacity_waits"] == 0
+    # surface 2: the Prometheus exposition (gauge family + counter)
+    text = prom.render("serve.")
+    assert "serve_moe_expert_load_e0" in text
+    assert "serve_moe_tokens_routed_total" in text
+    assert scalar_gauges("serve.moe_hot_expert_frac")
+    assert counters("serve.")["serve.moe_tokens_routed_total"] > 0
+    # surface 3: load_snapshot — the router's placement plane
+    ls = sched.load_snapshot()
+    assert ls["moe_hot_expert_frac"] == max(loads) / sum(loads)
+    assert ls["moe_expert_load"] == loads
+    # the counter is cumulative across segments; the gauge is the
+    # last segment's harvest — and top_k=2 routing means every load
+    # unit arrives in pairs
+    assert snap["serve.moe_tokens_routed"] >= sum(loads)
+    assert snap["serve.moe_tokens_routed"] % 2 == 0
+
+
+# ---------------------------------------------------------------------
+# capacity-factor admission gate: hold, count, degrade — never wedge
+# ---------------------------------------------------------------------
+
+def test_moe_capacity_gate_holds_admission_then_completes(moe_lm):
+    """With a vanishing capacity factor every live segment is 'hot':
+    a new request stays QUEUED while the in-flight batch decodes
+    (counted as moe_capacity_waits), the running request never
+    stalls, and the held request completes once decode goes idle —
+    the degrade-to-queued / never-wedge contract."""
+    sched = _sched(moe_lm, moe_capacity_factor=1e-6)
+    a = sched.submit(np.asarray([7, 3, 11], np.int32), 16)
+    assert sched.step()  # A joins + first segment → load harvested
+    assert sched._moe_load is not None
+    b = sched.submit(np.asarray([2, 9], np.int32), 4)
+    assert sched.step()  # gate holds B; A keeps decoding
+    assert a.state.value == "running"
+    assert b.state.value == "queued"
+    assert sched.metrics.moe_capacity_waits >= 1
+    got = _drain(sched, a, b)  # pool idles → gate releases → B runs
+    assert [len(t) for t in got] == [16, 4]
+    assert got[0] == _solo_oracle(moe_lm, [7, 3, 11], 16)
+    assert got[1] == _solo_oracle(moe_lm, [2, 9], 4)
+    # moe_overflow='off': same hot load, gauges only — B admits while
+    # A is still decoding
+    off = _sched(moe_lm, moe_capacity_factor=1e-6, moe_overflow="off")
+    a2 = off.submit(np.asarray([7, 3, 11], np.int32), 16)
+    assert off.step()
+    b2 = off.submit(np.asarray([2, 9], np.int32), 4)
+    assert off.step()
+    assert b2.state.value != "queued"  # admitted despite hot load
+    assert off.metrics.moe_capacity_waits == 0
+    _drain(off, a2, b2)
+
+
+def test_moe_config_validation_is_pointed(moe_lm):
+    from tpuflow.serve import ServeScheduler
+
+    lm, params = moe_lm
+    # capacity-dropped routing cannot serve token-identically
+    drop_lm, drop_params = _init(dict(MOE_KW, moe_no_drop=False))
+    with pytest.raises(ValueError, match="moe_no_drop"):
+        ServeScheduler(drop_lm, drop_params, **GEO)
+    # the load harvest rides the paged segment fn only
+    with pytest.raises(ValueError, match="paged"):
+        ServeScheduler(lm, params, slots=2, seg=4)
+    # speculation has no expert-load harvest yet
+    from tpuflow.models import draft_lm_config
+
+    dcfg = draft_lm_config(MOE_KW)
+    assert dcfg.get("n_experts", 0) == 0  # dense draft, by design
+    draft, dparams = _init(dcfg)
+    with pytest.raises(ValueError, match="speculate_k"):
+        ServeScheduler(lm, params, speculate_k=2, draft_model=draft,
+                       draft_params=dparams, **GEO)
+    # depth=1 + moe_every=2 places ZERO MoE blocks: loud, not silent
+    z_lm, z_params = _init(dict(MOE_KW, moe_every=2))
+    with pytest.raises(ValueError, match="moe_every"):
+        ServeScheduler(z_lm, z_params, **GEO)
+    with pytest.raises(ValueError, match="moe_capacity_factor"):
+        ServeScheduler(lm, params, moe_capacity_factor=0.0, **GEO)
+    with pytest.raises(ValueError, match="moe_overflow"):
+        ServeScheduler(lm, params, moe_overflow="drop", **GEO)
+
+
+# ---------------------------------------------------------------------
+# dropless routing is a per-token function (model level)
+# ---------------------------------------------------------------------
+
+def test_moe_no_drop_is_batch_composition_independent():
+    """no_drop=True output rows are pure functions of their own
+    hidden state: any sub-batch reproduces the full batch's rows
+    exactly — the property the serve identity pins ride on. The
+    expert-load sow is only harvested when 'moe' is mutable."""
+    from tpuflow.models.moe import MoEMlp
+
+    m = MoEMlp(dim=16, hidden=32, n_experts=4, top_k=2, no_drop=True,
+               dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 16), jnp.float32)
+    params = m.init({"params": jax.random.key(0)}, x)["params"]
+    full, aux = m.apply({"params": params}, x)
+    solo0, _ = m.apply({"params": params}, x[:1])
+    solo1, _ = m.apply({"params": params}, x[1:])
+    np.testing.assert_allclose(np.asarray(full[:1]), np.asarray(solo0),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(full[1:]), np.asarray(solo1),
+                               rtol=1e-6, atol=1e-6)
+    assert np.isfinite(float(aux))
+    (_, _), hv = m.apply({"params": params}, x, mutable=["moe"])
+    mask = np.asarray(jax.tree.leaves(hv["moe"])[0])
+    assert mask.shape == (2, 8, 4)
+    assert np.all(mask.sum(axis=-1) == 2)  # top_k experts per token
+
+
+# ---------------------------------------------------------------------
+# VLM: image-prefix tokens interleave with text in one batch
+# ---------------------------------------------------------------------
+
+def test_vlm_interleave_matches_solo_oracles(vlm_lm):
+    """An image request and plain-text requests share one continuous
+    batch (packing + pad_lens untouched: image patches are just
+    prefix TOKENS) and each equals its solo oracle; sampled ids stay
+    strictly text-vocab (the LM head never scores image ids)."""
+    from tpuflow.models import vlm_prompt
+
+    sched = _sched(vlm_lm)
+    rng = np.random.default_rng(3)
+    p_img = vlm_prompt(_img(1), np.asarray([5, 9], np.int32), patch=4,
+                       image_vocab=64, text_vocab=128)
+    assert p_img.size == 16 + 2 and np.all(p_img[:16] >= 128)
+    p_txt = rng.integers(1, 128, (4,)).astype(np.int32)
+    r_img = sched.submit(p_img, 6)
+    assert sched.step()  # text joins the live image decode mid-flight
+    r_txt = sched.submit(p_txt, 6)
+    got = _drain(sched, r_img, r_txt)
+    assert got[0] == _solo_oracle(vlm_lm, p_img, 6)
+    assert got[1] == _solo_oracle(vlm_lm, p_txt, 6)
+    assert all(t < 128 for t in got[0] + got[1])
+
+
+def test_vlm_repeated_image_is_a_prefix_cache_hit(vlm_lm):
+    """Two requests around the SAME image: the deterministic patch
+    chain hashes to identical chunk keys, so the second request's
+    image prefix is served from cached pages — prefill work saved,
+    tokens identical to the uncached oracle."""
+    from tpuflow.models import vlm_prompt
+
+    sched = _sched(vlm_lm)
+    img = _img(2)
+    p1 = vlm_prompt(img, np.asarray([5, 9], np.int32), patch=4,
+                    image_vocab=64, text_vocab=128)
+    p2 = vlm_prompt(img, np.asarray([40, 41, 42], np.int32), patch=4,
+                    image_vocab=64, text_vocab=128)
+    assert np.array_equal(p1[:16], p2[:16])  # the shared image prefix
+    r1 = sched.submit(p1, 4)
+    _drain(sched, r1)
+    before = sched.metrics.prefill_tokens_saved
+    r2 = sched.submit(p2, 4)
+    got = _drain(sched, r2)
+    # all 4 image pages (16 tokens at page_size=4) came from cache
+    assert sched.metrics.prefill_tokens_saved - before >= 16
+    assert got[0] == _solo_oracle(vlm_lm, p2, 4)
+
+
+def test_vlm_image_prefix_demotes_and_promotes(vlm_lm):
+    """The image prefix rides the tier hierarchy like any chain:
+    evicted under pressure it DEMOTES to the host pool, and the next
+    request over the same image PROMOTES it back — tokens identical
+    to a never-evicted scheduler."""
+    from tpuflow.models import vlm_prompt
+
+    img = _img(4)
+    p1 = vlm_prompt(img, np.asarray([5, 9], np.int32), patch=4,
+                    image_vocab=64, text_vocab=128)
+    p2 = vlm_prompt(img, np.asarray([40, 41, 42], np.int32), patch=4,
+                    image_vocab=64, text_vocab=128)
+
+    o = _sched(vlm_lm)
+    _drain(o, o.submit(p1, 4))
+    [want] = _drain(o, o.submit(p2, 4))
+
+    s = _sched(vlm_lm, kv_host_bytes=1 << 20)
+    _drain(s, s.submit(p1, 4))
+    assert s.kv_state.prefix.evict_lru(49) >= 3
+    assert s.kv_state.tier.stats()["demotes"] >= 1
+    [got] = _drain(s, s.submit(p2, 4))
+    assert got == want
+    st = s.kv_state.tier.stats()
+    assert st["promotes"] >= 1 and st["promoted_pages"] >= 3
+    assert s.metrics.prefill_tokens_saved >= 16
+
+
+def test_vlm_submit_rejects_out_of_range_ids(vlm_lm, moe_lm):
+    sched = _sched(vlm_lm)
+    with pytest.raises(ValueError, match="image_vocab"):
+        sched.submit(np.asarray([128 + 64], np.int32), 2)
+    text_only = _sched(moe_lm)
+    with pytest.raises(ValueError, match="no image vocabulary"):
+        text_only.submit(np.asarray([130], np.int32), 2)
+
+
+# ---------------------------------------------------------------------
+# vlm helpers: deterministic codebook, geometry validation
+# ---------------------------------------------------------------------
+
+def test_vlm_codebook_helpers():
+    from tpuflow.models import (build_vlm_lm, image_to_tokens,
+                                n_image_tokens, patchify, vlm_prompt)
+
+    img = _img(11, hw=8)
+    patches = patchify(img, 4)
+    assert patches.shape == (4, 16)
+    with pytest.raises(ValueError, match="multiple of"):
+        patchify(img, 3)
+    t1 = image_to_tokens(img, patch=4, image_vocab=64, text_vocab=128)
+    t2 = image_to_tokens(img.astype(np.float32) / 255.0, patch=4,
+                         image_vocab=64, text_vocab=128)
+    assert t1.dtype == np.int32 and t1.shape == (4,)
+    assert np.array_equal(t1, t2)  # float round-trip quantizes stably
+    assert np.all((t1 >= 128) & (t1 < 128 + 64))
+    p = vlm_prompt(img, np.asarray([1, 2], np.int32), patch=4,
+                   image_vocab=64, text_vocab=128)
+    assert np.array_equal(p[:4], t1) and list(p[4:]) == [1, 2]
+    assert np.array_equal(
+        vlm_prompt(None, np.asarray([1, 2], np.int32), patch=4,
+                   image_vocab=64, text_vocab=128),
+        np.asarray([1, 2], np.int32))
+    assert n_image_tokens(224, 16) == 196
+    with pytest.raises(ValueError, match="multiple of"):
+        build_vlm_lm(img_size=224, patch_size=15, **KW)
+    with pytest.raises(ValueError):
+        build_transformer_lm(**dict(KW, image_vocab=-1))
+    with pytest.raises(ValueError, match="top_k"):
+        build_transformer_lm(**dict(KW, n_experts=2, moe_top_k=3))
+
+
+# ---------------------------------------------------------------------
+# deployment plane: swaps and draft derivation over MoE/ViT trees
+# ---------------------------------------------------------------------
+
+def test_swap_weights_handles_moe_and_vlm_trees(moe_lm, vlm_lm):
+    """swap_weights validates MoE/ViT param trees exactly like dense
+    ones (flat leaf set + shape/dtype): a same-config re-init swaps
+    in and serves oracle-identically; a different expert count or
+    image table is refused with the leaf named."""
+    import flax.linen as nn
+
+    from tpuflow.serve.deploy import SwapMismatchError
+
+    lm, _ = moe_lm
+    sched = _sched(moe_lm)
+    _drain(sched, sched.submit(np.asarray([7, 3], np.int32), 4))
+    fresh = nn.unbox(
+        lm.init({"params": jax.random.key(1)},
+                jnp.zeros((1, 8), jnp.int32)))["params"]
+    sched.swap_weights(fresh, version="v2")
+    [got] = _drain(sched, sched.submit(np.asarray([7, 3], np.int32), 4))
+    assert got == _solo_oracle((lm, fresh), [7, 3], 4)
+    assert sched.load_snapshot()["model_version"]["label"] == "v2"
+    _, wrong_params = _init(dict(MOE_KW, n_experts=2))
+    with pytest.raises(SwapMismatchError):
+        sched.swap_weights(wrong_params)
+    vsched = _sched(vlm_lm)
+    wrong_iv, wrong_vparams = _init(dict(VLM_KW, image_vocab=32))
+    with pytest.raises(SwapMismatchError):
+        vsched.swap_weights(wrong_vparams)
+
+
+def test_draft_lm_config_moe_dense_and_vlm_inherits():
+    from tpuflow.models import draft_lm_config
+
+    cfg = draft_lm_config(dict(MOE_KW, image_vocab=64))
+    # the expert stack is never copied into a draft (cheap-draft
+    # break-even); the image table IS (same prompt ids must embed)
+    assert "n_experts" not in cfg and "moe_every" not in cfg
+    assert cfg["image_vocab"] == 64
+    assert cfg["vocab_size"] == 128 and cfg["depth"] == 1
+    assert "image_vocab" not in draft_lm_config(KW)

@@ -622,6 +622,74 @@ def test_place_ms_and_staleness_observability():
     assert "health_lagged" in load
 
 
+# ---------------------------------------------------------------------
+# expert-affinity placement (ISSUE 18): steer off hot-expert replicas
+# ---------------------------------------------------------------------
+
+class MoEFake(FakeReplica):
+    """FakeReplica publishing the MoE placement sensor (and a version
+    label, so the pin_version/_submit_ordered path is reachable)."""
+
+    moe_hot = 0.0
+    version = "v1"
+
+    def load_snapshot(self):
+        snap = super().load_snapshot()
+        snap["moe_hot_expert_frac"] = self.moe_hot
+        snap["model_version"] = self.version
+        return snap
+
+
+def test_expert_affinity_steers_and_spills():
+    """The heap (fleet) path: a load-tied winner whose hot-expert
+    fraction crossed the threshold loses unpinned placements to a
+    cool replica inside the slack window (expert_affinity_hits); with
+    every replica hot the winner keeps the request
+    (expert_affinity_spills); PREFIX affinity outranks the valve —
+    a chain owner serves its repeat prompt even while hot."""
+    from tpuflow.obs.gauges import counters
+
+    a, b = MoEFake("a", max_queue=64), MoEFake("b", max_queue=64)
+    a.moe_hot = 0.9
+    router = Router([a, b], clock=lambda: 0.0)
+    base = counters("router.").get("router.expert_affinity_hits_total", 0)
+    router.submit(_ids(9, 9, 1), 2)
+    assert router.placements["b"] == 1  # steered off the hot winner
+    assert router.counts["expert_affinity_hits"] == 1
+    assert counters("router.")["router.expert_affinity_hits_total"] == (
+        base + 1)
+    b.moe_hot = 0.9  # now the whole tier is hot: nowhere cool to go
+    router.submit(_ids(9, 9, 2), 2)
+    assert router.counts["expert_affinity_spills"] == 1
+    # prefix affinity first: place a chain while a is cool, reheat a,
+    # resubmit — the valve never overrides a pinned chain owner
+    a.moe_hot = b.moe_hot = 0.0
+    chain = _ids(*range(1, 9))  # two full pages -> affinity keys
+    pa = router.placements["a"]
+    router.submit(chain, 2)
+    owner = "a" if router.placements["a"] == pa + 1 else "b"
+    (a if owner == "a" else b).moe_hot = 0.9
+    hits = router.counts["affinity_hits"]
+    placed_before = router.placements[owner]
+    router.submit(chain, 2)
+    assert router.placements[owner] == placed_before + 1
+    assert router.counts["affinity_hits"] == hits + 1
+    assert router.counts["expert_affinity_hits"] == 1  # unchanged
+
+
+def test_expert_affinity_ordered_path_with_pin_version():
+    """The pin_version (full-sort) path applies the same valve."""
+    a, b = MoEFake("a", max_queue=64), MoEFake("b", max_queue=64)
+    a.moe_hot = 0.9
+    router = Router([a, b], clock=lambda: 0.0)
+    router.submit(_ids(7, 7, 1), 2, pin_version="v1")
+    assert router.placements["b"] == 1
+    assert router.counts["expert_affinity_hits"] == 1
+    b.moe_hot = 0.9
+    router.submit(_ids(7, 7, 2), 2, pin_version="v1")
+    assert router.counts["expert_affinity_spills"] == 1
+
+
 def test_slow_health_probe_lags_not_stalls_failover():
     """One replica's health RPC hanging must not stall the sweep: the
     probe carries over (slow != failed, counted health_lagged) while

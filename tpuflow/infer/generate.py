@@ -803,7 +803,15 @@ def paged_segment_fn(model, kv_spec, slots: int, out_len: int,
     (:meth:`~tpuflow.serve.slots.PagedSlotPool.segment_width`), so
     young rows attend over short windows. ``None`` keeps the per-step
     paged path (the int8 store, and the fused-kernel path where the
-    kernel IS the per-step fast path)."""
+    kernel IS the per-step fast path).
+
+    MoE models (``model.n_experts > 0``, ISSUE 18) return ONE extra
+    output: ``expert_load`` (n_experts,) float32 — routed-token counts
+    summed over the segment's LIVE rows and steps (each MoE block's
+    sown top-k assignment mass, finished rows masked out). The serve
+    engine harvests it for the per-expert gauges and the host-side
+    capacity admission gate; dense models keep the 4-tuple signature
+    unchanged."""
     dm = _serve_decode_model(model, kv_spec)
     return _compiled_paged_segment(
         dm, int(slots), int(out_len), int(n_row_pages), int(seg),
@@ -823,6 +831,10 @@ def _compiled_paged_segment(dm, b: int, out_len: int, n_row_pages: int,
                             table_width: Optional[int] = None):
     fill = jnp.int32(eos_id if eos_id is not None else 0)
     hoist = table_width is not None
+    # MoE load harvest (ISSUE 18): route the sown "moe" collection out
+    # through the scan carry — gated on the model so dense pools keep
+    # their exact signature (and executables)
+    n_exp = int(getattr(dm, "n_experts", 0) or 0)
 
     # donated page store (ISSUE 11): the KV writes happen in place —
     # this is what killed the O(kv_pages) segment-cost coupling the
@@ -837,22 +849,35 @@ def _compiled_paged_segment(dm, b: int, out_len: int, n_row_pages: int,
             rows = _rows_view(cache, page_table)
 
         def step(carry, i):
-            kv, out, done = carry
+            if n_exp:
+                kv, out, done, load = carry
+            else:
+                kv, out, done = carry
             pos = pos0 + i
             posc = jnp.clip(pos, 0, out_len - 1)
             tok = jnp.take_along_axis(out, posc[:, None], axis=1)
             wm = (~done & (pos < kv_limit))[:, None]
+            mut = ["cache", "moe"] if n_exp else ["cache"]
             if hoist:
                 lg, vars2 = dm.apply(
                     {"params": params, "cache": kv}, tok,
-                    mutable=["cache"], write_pos=pos, write_mask=wm,
+                    mutable=mut, write_pos=pos, write_mask=wm,
                 )
             else:
                 lg, vars2 = dm.apply(
                     {"params": params, "cache": kv}, tok,
-                    mutable=["cache"], page_table=page_table,
+                    mutable=mut, page_table=page_table,
                     write_pos=pos, write_mask=wm,
                 )
+            if n_exp:
+                # each MoE block sowed its (B, 1, E) top-k assignment
+                # mass; finished/over-limit rows run the matmuls (the
+                # batch is fixed-shape) but must not count as load
+                per_row = sum(leaf.sum(axis=1)
+                              for leaf in jax.tree.leaves(
+                                  vars2.get("moe", {})))
+                load = load + jnp.sum(
+                    jnp.where(wm, per_row, 0.0), axis=0)
             # the sampling step is the row's LOGICAL position — the
             # same value the wave oracle derives as t - pad_lens — so
             # a request's RNG stream is identical in both engines
@@ -865,12 +890,22 @@ def _compiled_paged_segment(dm, b: int, out_len: int, n_row_pages: int,
             outw = jnp.clip(pos + 1, 0, out_len - 1)
             out = jnp.put_along_axis(out, outw[:, None], nxt[:, None],
                                      axis=1, inplace=False)
+            if n_exp:
+                return (vars2["cache"], out, done, load), None
             return (vars2["cache"], out, done), None
 
-        carry0 = (rows if hoist else cache, out, done)
-        (kv_out, out, done2), _ = lax.scan(
-            step, carry0, jnp.arange(seg)
-        )
+        kv0 = rows if hoist else cache
+        if n_exp:
+            carry0 = (kv0, out, done,
+                      jnp.zeros((n_exp,), jnp.float32))
+            (kv_out, out, done2, load), _ = lax.scan(
+                step, carry0, jnp.arange(seg)
+            )
+        else:
+            carry0 = (kv0, out, done)
+            (kv_out, out, done2), _ = lax.scan(
+                step, carry0, jnp.arange(seg)
+            )
         if hoist:
             cache = _rows_scatter_back(cache, kv_out, page_table,
                                        pos0, kv_limit, done, seg)
@@ -879,6 +914,8 @@ def _compiled_paged_segment(dm, b: int, out_len: int, n_row_pages: int,
         tix = jnp.clip(pos0[:, None] + 1 + jnp.arange(seg)[None, :],
                        0, out_len - 1)
         toks = jnp.take_along_axis(out, tix, axis=1)
+        if n_exp:
+            return cache, out, done2, toks, load
         return cache, out, done2, toks
 
     return segment

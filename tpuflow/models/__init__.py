@@ -12,6 +12,13 @@ from tpuflow.models.pretrained import (  # noqa: F401
     save_backbone_npz,
 )
 from tpuflow.models.vit import ViTClassifier, build_vit  # noqa: F401
+from tpuflow.models.vlm import (  # noqa: F401
+    build_vlm_lm,
+    image_to_tokens,
+    n_image_tokens,
+    patchify,
+    vlm_prompt,
+)
 from tpuflow.models.transformer import (  # noqa: F401
     TransformerLM,
     build_transformer_lm,

@@ -19,6 +19,22 @@ MoE in the TPU-idiomatic GSPMD formulation:
   is returned alongside the output so the caller can add it to the
   task loss.
 
+``no_drop=True`` switches to DROPLESS routing (ISSUE 18, serving):
+every token keeps its renormalized top-k gates and every expert runs
+on every token (dense dispatch, gates zero the unrouted terms). There
+is no cumsum position and no capacity, so each token's output is a
+pure function of ITS OWN hidden state — the property paged serving
+needs for token identity, where a request's batch neighbors change
+segment to segment (capacity drops would make outputs depend on
+co-scheduled traffic). The capacity trade-off moves to the HOST: the
+scheduler's admission gate (``moe_capacity_factor``) throttles new
+work when an expert runs hot instead of dropping tokens mid-batch.
+
+Both modes sow per-expert routed-token counts into the ``"moe"``
+collection (shape (B, S, E) one-hot assignment mass) when the caller
+marks it mutable — the serve engine's per-expert load harvest; a
+no-op under the training ``mutable=['losses']`` convention.
+
 Use ``ep_axis=None`` (default) for replicated experts (single device /
 DP); ``ep_axis='expert'`` when the mesh carries an expert axis.
 """
@@ -47,6 +63,9 @@ class MoEMlp(nn.Module):
     aux_loss_weight: float = 0.01
     dtype: Any = jnp.bfloat16
     ep_axis: Optional[str] = None  # mesh axis sharding the expert dim
+    # dropless routing (serving): no capacity, every token keeps its
+    # renormalized top-k gates — batch-composition-independent outputs
+    no_drop: bool = False
 
     @nn.compact
     def __call__(self, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -77,25 +96,11 @@ class MoEMlp(nn.Module):
             mask = mask + one_hot
             remaining = remaining * (1.0 - one_hot)
 
-        # position of each token within its expert's buffer (per expert
-        # running count over tokens); tokens past capacity are dropped
-        position = jnp.cumsum(mask, axis=0) * mask - 1.0  # (T, E)
-        in_cap = (position < cap) & (mask > 0)
-        gates = jnp.where(in_cap, gates, 0.0)
-        # renormalize surviving gates so each token's weights sum to 1
-        denom = jnp.sum(gates, axis=-1, keepdims=True)
-        gates = gates / jnp.maximum(denom, 1e-9)
-
-        # (T, E, C) one-hot of (expert, slot) per token
-        pos_idx = jnp.clip(position, 0, cap - 1).astype(jnp.int32)
-        slot_one_hot = nn.one_hot(pos_idx, cap, dtype=jnp.float32)  # (T,E,C)
-        dispatch = slot_one_hot * in_cap[..., None]  # (T, E, C)
-
-        # dispatch tokens → (E, C, d); under GSPMD with expert-sharded
-        # weights XLA turns this into the dispatch all-to-all
-        expert_in = jnp.einsum(
-            "tec,td->ecd", dispatch, tokens.astype(jnp.float32)
-        ).astype(self.dtype)
+        # per-expert routed-token load, sown for the serve engine's
+        # harvest (mutable=['moe']); a silent no-op everywhere else.
+        # (B, S, E) so the decode segment fn can zero finished rows
+        # before reducing — the gauge counts LIVE tokens only.
+        self.sow("moe", "expert_tokens", mask.reshape(b, s, e))
 
         w_in = self.param(
             "w_in",
@@ -109,15 +114,58 @@ class MoEMlp(nn.Module):
             (e, self.hidden, d),
             jnp.float32,
         )
-        h = nn.silu(jnp.einsum(
-            "ecd,edh->ech", expert_in, w_in.astype(self.dtype)))
-        expert_out = jnp.einsum("ech,ehd->ecd", h, w_out.astype(self.dtype))
 
-        # combine back with gate weights (the combine all-to-all)
-        combine = dispatch * gates[..., None]  # (T, E, C)
-        out = jnp.einsum(
-            "tec,ecd->td", combine, expert_out.astype(jnp.float32)
-        )
+        if self.no_drop:
+            # dropless: renormalize the top-k gates directly (no
+            # capacity zeroing) and run EVERY expert on every token —
+            # the gates zero the unrouted terms in the combine. Each
+            # token's output depends only on its own hidden state, so
+            # serving stays token-identical no matter which requests
+            # share the batch. O(T·E·hidden) FLOPs — the dense-dispatch
+            # price, paid at decode batch sizes (slots × 1 token).
+            denom = jnp.sum(gates, axis=-1, keepdims=True)
+            gates_n = gates / jnp.maximum(denom, 1e-9)
+            h = nn.silu(jnp.einsum(
+                "td,edh->teh", tokens.astype(self.dtype),
+                w_in.astype(self.dtype)))
+            expert_out = jnp.einsum(
+                "teh,ehd->ted", h, w_out.astype(self.dtype))
+            out = jnp.einsum(
+                "te,ted->td", gates_n, expert_out.astype(jnp.float32))
+        else:
+            # position of each token within its expert's buffer (per
+            # expert running count over tokens); tokens past capacity
+            # are dropped
+            position = jnp.cumsum(mask, axis=0) * mask - 1.0  # (T, E)
+            in_cap = (position < cap) & (mask > 0)
+            gates = jnp.where(in_cap, gates, 0.0)
+            # renormalize surviving gates so each token's weights sum
+            # to 1
+            denom = jnp.sum(gates, axis=-1, keepdims=True)
+            gates = gates / jnp.maximum(denom, 1e-9)
+
+            # (T, E, C) one-hot of (expert, slot) per token
+            pos_idx = jnp.clip(position, 0, cap - 1).astype(jnp.int32)
+            slot_one_hot = nn.one_hot(
+                pos_idx, cap, dtype=jnp.float32)  # (T,E,C)
+            dispatch = slot_one_hot * in_cap[..., None]  # (T, E, C)
+
+            # dispatch tokens → (E, C, d); under GSPMD with expert-
+            # sharded weights XLA turns this into the dispatch
+            # all-to-all
+            expert_in = jnp.einsum(
+                "tec,td->ecd", dispatch, tokens.astype(jnp.float32)
+            ).astype(self.dtype)
+            h = nn.silu(jnp.einsum(
+                "ecd,edh->ech", expert_in, w_in.astype(self.dtype)))
+            expert_out = jnp.einsum(
+                "ech,ehd->ecd", h, w_out.astype(self.dtype))
+
+            # combine back with gate weights (the combine all-to-all)
+            combine = dispatch * gates[..., None]  # (T, E, C)
+            out = jnp.einsum(
+                "tec,ecd->td", combine, expert_out.astype(jnp.float32)
+            )
 
         # load-balance aux loss (Switch/GShard): E · Σ_e f_e · p_e where
         # f_e = fraction of tokens routed to e, p_e = mean router prob

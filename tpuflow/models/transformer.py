@@ -610,6 +610,7 @@ class DecoderBlock(nn.Module):
     rope_theta: float = 10000.0
     n_experts: int = 0  # >0 → MoE MLP in this block
     moe_top_k: int = 2
+    moe_no_drop: bool = False  # dropless routing (see MoEMlp.no_drop)
     ep_axis: Optional[str] = None
     decode: bool = False
     sp_layout: str = "contiguous"
@@ -646,7 +647,8 @@ class DecoderBlock(nn.Module):
             y, aux = MoEMlp(
                 self.dim, self.dim * self.mlp_ratio,
                 n_experts=self.n_experts, top_k=self.moe_top_k,
-                dtype=self.dtype, ep_axis=self.ep_axis, name="moe",
+                dtype=self.dtype, ep_axis=self.ep_axis,
+                no_drop=self.moe_no_drop, name="moe",
             )(y)
             # accumulated under mutable=['losses']; no-op otherwise
             self.sow("losses", "moe_aux", aux)
@@ -731,6 +733,7 @@ class TransformerLM(nn.Module):
     n_experts: int = 0  # >0 → MoE MLP in every moe_every-th block
     moe_every: int = 2
     moe_top_k: int = 2
+    moe_no_drop: bool = False  # dropless routing (serving; MoEMlp.no_drop)
     ep_axis: Optional[str] = None
     decode: bool = False  # autoregressive KV-cache mode (see infer.generate)
     remat: bool = False  # gradient checkpointing per block (long context)
@@ -751,6 +754,12 @@ class TransformerLM(nn.Module):
     kv_page_size: int = 16
     kv_quant: Optional[str] = None
     paged_kernel: Optional[bool] = None  # fused decode (CausalAttention)
+    # ViT-prefix VLM (ISSUE 18): ids in [vocab_size, vocab_size +
+    # image_vocab) embed through a SEPARATE per-patch-token table (the
+    # learned patch embedding — models.vlm maps image patches to these
+    # ids deterministically). The LM head stays text-vocab-wide, so
+    # image tokens can appear only in prompts, never in samples.
+    image_vocab: int = 0
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, segment_ids=None,
@@ -773,7 +782,31 @@ class TransformerLM(nn.Module):
             (self.vocab_size, self.dim),
             jnp.float32,
         )
-        x = jnp.take(embed, tokens, axis=0).astype(self.dtype)
+        if self.image_vocab > 0:
+            # two-table embed: image-prefix ids (>= vocab_size) gather
+            # from the patch-token table, everything else from the text
+            # table. Both gathers run (clipped ids), the where selects
+            # — static shapes, no data-dependent control flow. Past
+            # the embed, image tokens are ordinary positions: packing,
+            # pad_lens, pages, prefix chunk keys all apply unchanged.
+            img_embed = self.param(
+                "img_embed",
+                _part(nn.initializers.normal(0.02),
+                      (MODEL_AXIS, None), tp),
+                (self.image_vocab, self.dim),
+                jnp.float32,
+            )
+            is_img = tokens >= self.vocab_size
+            txt = jnp.take(
+                embed, jnp.clip(tokens, 0, self.vocab_size - 1), axis=0)
+            img = jnp.take(
+                img_embed,
+                jnp.clip(tokens - self.vocab_size, 0,
+                         self.image_vocab - 1),
+                axis=0)
+            x = jnp.where(is_img[..., None], img, txt).astype(self.dtype)
+        else:
+            x = jnp.take(embed, tokens, axis=0).astype(self.dtype)
         # remat trades FLOPs for HBM: 'full' checkpoints whole blocks
         # (activations recomputed in the backward — the standard
         # long-context lever, pairing with the ring's O(seq/sp)
@@ -803,7 +836,8 @@ class TransformerLM(nn.Module):
                 self.dim, self.heads, self.mlp_ratio, self.dtype,
                 self.attn_impl, self.seq_axis, self.rope_theta,
                 n_experts=self.n_experts if moe_block else 0,
-                moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
+                moe_top_k=self.moe_top_k,
+                moe_no_drop=self.moe_no_drop, ep_axis=self.ep_axis,
                 decode=self.decode, sp_layout=self.sp_layout,
                 remat_mlp=remat_mlp and not moe_block,
                 attn_window=self.attn_window,
@@ -843,6 +877,7 @@ def build_transformer_lm(
     n_experts: int = 0,
     moe_every: int = 2,
     moe_top_k: int = 2,
+    moe_no_drop: bool = False,
     ep_axis: Optional[str] = None,
     remat: bool = False,
     remat_policy: str = "full",
@@ -853,9 +888,21 @@ def build_transformer_lm(
     attn_bh_block: int = 1,
     rope_scaling: float = 1.0,
     rope_scaling_kind: str = "linear",
+    image_vocab: int = 0,
 ) -> TransformerLM:
     if dim % heads:
         raise ValueError("dim must be a multiple of heads")
+    if image_vocab < 0:
+        raise ValueError(
+            f"image_vocab must be >= 0 (size of the patch-token table; "
+            f"0 = text-only), got {image_vocab}"
+        )
+    if n_experts > 0 and moe_top_k > n_experts:
+        raise ValueError(
+            f"moe_top_k ({moe_top_k}) cannot exceed n_experts "
+            f"({n_experts}) — each token routes to top_k DISTINCT "
+            "experts"
+        )
     if kv_heads is not None:
         if kv_heads < 1 or heads % kv_heads:
             raise ValueError(
@@ -893,11 +940,13 @@ def build_transformer_lm(
         vocab_size=vocab_size, dim=dim, depth=depth, heads=heads,
         mlp_ratio=mlp_ratio, dtype=dtype, attn_impl=attn_impl,
         seq_axis=seq_axis, n_experts=n_experts, moe_every=moe_every,
-        moe_top_k=moe_top_k, ep_axis=ep_axis, remat=remat,
+        moe_top_k=moe_top_k, moe_no_drop=moe_no_drop, ep_axis=ep_axis,
+        remat=remat,
         remat_policy=remat_policy, sp_layout=sp_layout,
         attn_window=attn_window, kv_heads=kv_heads,
         tie_embeddings=tie_embeddings, attn_bh_block=attn_bh_block,
         rope_scaling=rope_scaling, rope_scaling_kind=rope_scaling_kind,
+        image_vocab=image_vocab,
     )
 
 
@@ -918,7 +967,16 @@ def draft_lm_config(base_config: Dict[str, Any], *,
     Draft quality only moves the ACCEPTANCE RATE — the oracle-parity
     acceptance rule makes outputs token-identical to the target's own
     decode no matter what the draft proposes — so a draft config is a
-    throughput tuning knob, not a correctness surface."""
+    throughput tuning knob, not a correctness surface.
+
+    An MoE target (``n_experts > 0`` in the base config) derives a
+    DENSE draft deliberately: the expert stack is never copied (a
+    quarter-dim draft carrying E expert MLPs would erase the
+    cheap-draft break-even, ISSUE 9's caveat), and acceptance-parity
+    means the dense draft can only cost acceptance rate, never
+    correctness. A VLM target's ``image_vocab`` IS inherited — the
+    draft must embed the same image-prefix ids or drafted rows would
+    read garbage prompt positions."""
     base = dict(base_config)
     if dim is None:
         # even: rotary halves head_dim, and heads=1 must stay legal
@@ -946,6 +1004,8 @@ def draft_lm_config(base_config: Dict[str, Any], *,
         "rope_scaling_kind": base.get("rope_scaling_kind", "linear"),
         "tie_embeddings": base.get("tie_embeddings", False),
     }
+    if int(base.get("image_vocab", 0) or 0) > 0:
+        cfg["image_vocab"] = int(base["image_vocab"])
     if kv_heads is not None:
         cfg["kv_heads"] = int(kv_heads)
     return cfg
@@ -971,6 +1031,13 @@ def share_draft_embeddings(draft_params, target_params):
         )
     out = dict(draft_params)
     out["embed"] = te
+    # VLM drafts: share the patch-token table too when both trees
+    # carry one of the same shape (same image_vocab and dim)
+    ti = target_params.get("img_embed")
+    di = draft_params.get("img_embed")
+    if (ti is not None and di is not None
+            and tuple(ti.shape) == tuple(di.shape)):
+        out["img_embed"] = ti
     th = target_params.get("lm_head")
     dh = draft_params.get("lm_head")
     if (isinstance(th, dict) and isinstance(dh, dict)

@@ -1,0 +1,155 @@
+"""ViT-prefix VLM: images as prompt-prefix tokens (ISSUE 18).
+
+The serving substrate moves int32 token chains — packing, ``pad_lens``
+masking, KV pages, chunk-keyed prefix caching, the tier hierarchy, the
+page wire. A vision-language workload rides ALL of it unchanged by
+making the image itself an int32 chain:
+
+- :func:`patchify` splits an (H, W, C) image into the ViT's
+  non-overlapping ``patch×patch`` grid (Dosovitskiy et al., "An Image
+  is Worth 16x16 Words" — PAPERS.md), flattened per patch;
+- :func:`image_to_tokens` maps each patch to ONE id in the model's
+  image vocabulary via a FROZEN quantize-then-hash codebook assignment
+  (uint8 quantization → blake2b → ``% image_vocab``). No learned
+  encoder runs on the host and no RNG is involved, so the mapping is
+  deterministic across processes and time: the same image always
+  yields the same chain, which is exactly what makes image prefixes
+  prefix-CACHEABLE — ``chunk_keys`` over identical chains collide, so
+  a shared image's KV pages hit in the radix tree, stay warm in the
+  PR 16 host/disk tiers, and dedup on the PR 14 wire, all for free;
+- the model side is ``TransformerLM(image_vocab=N)``: ids in
+  ``[vocab_size, vocab_size + image_vocab)`` gather from a separate
+  learned ``img_embed`` table (the patch embedding, trained end to
+  end through the LM), while the LM head stays text-vocab-wide so
+  image ids can never be SAMPLED — images are prompts, not outputs.
+
+What this is not: a full ViT tower in the prompt path. The codebook
+assignment is a discrete bottleneck (VQ-style, frozen rather than
+learned); ``models/vit.py`` remains the continuous-patch classifier.
+The trade is deliberate — a continuous vision encoder would make
+image prefixes unkeyable floats and fork the entire serving substrate,
+where the codebook keeps one engine serving both modalities.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from tpuflow.models.transformer import TransformerLM, build_transformer_lm
+
+
+def patchify(image: np.ndarray, patch: int) -> np.ndarray:
+    """(H, W, C) → (n_patches, patch*patch*C), row-major grid order —
+    the ViT patch grid as flat vectors. H and W must be multiples of
+    ``patch`` (same rule :func:`~tpuflow.models.vit.build_vit`
+    enforces)."""
+    img = np.asarray(image)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    if img.ndim != 3:
+        raise ValueError(
+            f"image must be (H, W) or (H, W, C), got shape "
+            f"{tuple(np.shape(image))}"
+        )
+    h, w, c = img.shape
+    if h % patch or w % patch:
+        raise ValueError(
+            f"image size {h}x{w} must be a multiple of patch_size "
+            f"({patch}) — non-overlapping grid, no padding"
+        )
+    gh, gw = h // patch, w // patch
+    grid = img.reshape(gh, patch, gw, patch, c)
+    return grid.transpose(0, 2, 1, 3, 4).reshape(gh * gw, patch * patch * c)
+
+
+def _quantize_patch(p: np.ndarray) -> np.ndarray:
+    """Frozen uint8 quantizer: float images (any range clipped to
+    [0, 1]) and uint8 images land on the SAME byte representation —
+    the determinism anchor for the hash."""
+    if np.issubdtype(p.dtype, np.floating):
+        return np.clip(np.asarray(p, np.float64) * 255.0 + 0.5,
+                       0, 255).astype(np.uint8)
+    return np.asarray(p).astype(np.uint8)
+
+
+def image_to_tokens(image: np.ndarray, *, patch: int, image_vocab: int,
+                    text_vocab: int) -> np.ndarray:
+    """Deterministic image → int32 prompt-prefix chain.
+
+    Each patch quantizes to uint8 and hashes (blake2b, 8 bytes) into
+    one codebook id; the returned ids live in ``[text_vocab,
+    text_vocab + image_vocab)`` — the ``img_embed`` range of a
+    ``TransformerLM(image_vocab=...)``. Host-only numpy: callers
+    prepend the result to their text ids and submit like any prompt.
+    Identical images (bit-identical after quantization) produce
+    identical chains — the property every downstream cache keys on."""
+    if image_vocab < 1:
+        raise ValueError(
+            f"image_vocab must be >= 1 to tokenize images, got "
+            f"{image_vocab}"
+        )
+    patches = patchify(image, patch)
+    toks = np.empty((patches.shape[0],), np.int32)
+    for i, p in enumerate(patches):
+        digest = hashlib.blake2b(
+            _quantize_patch(p).tobytes(), digest_size=8).digest()
+        toks[i] = text_vocab + int.from_bytes(digest, "little") % image_vocab
+    return toks
+
+
+def vlm_prompt(image: Optional[np.ndarray], text_ids: Sequence[int], *,
+               patch: int, image_vocab: int,
+               text_vocab: int) -> np.ndarray:
+    """Image-prefix ++ text ids as one int32 prompt (image optional —
+    text-only requests pass ``None`` and interleave in the same
+    batch). The image goes FIRST so shared images share a chain
+    PREFIX — the unit of prefix-cache reuse."""
+    text = np.asarray(list(text_ids), np.int32)
+    if image is None:
+        return text
+    img = image_to_tokens(image, patch=patch, image_vocab=image_vocab,
+                          text_vocab=text_vocab)
+    return np.concatenate([img, text]).astype(np.int32)
+
+
+def build_vlm_lm(
+    vocab_size: int = 32000,
+    image_vocab: int = 1024,
+    img_size: int = 224,
+    patch_size: int = 16,
+    **lm_kwargs: Any,
+) -> TransformerLM:
+    """A served VLM: :func:`build_transformer_lm` with the image-token
+    table sized and the patch geometry validated up front (the
+    patch-budget math a deployment sizes buckets around: one image
+    costs ``(img_size // patch_size)**2`` prompt positions)."""
+    if img_size < patch_size or img_size % patch_size:
+        raise ValueError(
+            f"img_size ({img_size}) must be a positive multiple of "
+            f"patch_size ({patch_size}) — patches tile the image "
+            "exactly (ViT grid)"
+        )
+    if image_vocab < 1:
+        raise ValueError(
+            f"image_vocab must be >= 1 for a VLM (it sizes the "
+            f"patch-token embedding table), got {image_vocab}"
+        )
+    return build_transformer_lm(
+        vocab_size=vocab_size, image_vocab=image_vocab, **lm_kwargs)
+
+
+def n_image_tokens(img_size: int, patch_size: int) -> int:
+    """Prompt positions one image consumes: the patch-grid size."""
+    return (img_size // patch_size) * (img_size // patch_size)
+
+
+__all__ = [
+    "patchify",
+    "image_to_tokens",
+    "vlm_prompt",
+    "build_vlm_lm",
+    "n_image_tokens",
+]

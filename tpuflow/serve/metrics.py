@@ -167,6 +167,16 @@ class ServeMetrics:
         # (on_kv delta-publishes them as registry counters too)
         self.kv_demotes = 0
         self.kv_promotes = 0
+        # expert-parallel MoE serving (ISSUE 18): latest per-expert
+        # segment load (list, gauge-mirrored), cumulative routed-token
+        # count, hot-expert share, and admissions held by the
+        # capacity gate — the three-surface contract (snapshot() →
+        # /v1/metrics, registry gauges → Prometheus, and the
+        # scheduler's load_snapshot() → router) all read these
+        self.moe_expert_load: List[float] = []
+        self.moe_tokens_routed = 0
+        self.moe_hot_expert_frac = 0.0
+        self.moe_capacity_waits = 0
         # live weight hot-swaps (ISSUE 15): model + draft combined;
         # the per-kind split lives on the registry counters
         self.weight_swaps = 0
@@ -405,6 +415,41 @@ class ServeMetrics:
         self.event(f"-transfer-{transfer_id}-", "kv_transfer_failure",
                    error=error, kind=kind)
 
+    # ---- expert-parallel MoE serving (ISSUE 18) ---------------------
+    def on_moe_load(self, loads) -> None:
+        """One decode segment's per-expert routed-token harvest
+        (scheduler thread, once per MoE segment): publish the
+        per-expert gauges (``moe_expert_load_e{j}``), the hot-expert
+        share gauge, and the monotone routed-token counter. The gauges
+        carry the LATEST segment — expert load is a placement/admission
+        signal, not an accumulation."""
+        vals = [float(x) for x in loads]
+        total = sum(vals)
+        hot = (max(vals) / total) if (vals and total > 0) else 0.0
+        with self._lock:
+            self.moe_expert_load = vals
+            self.moe_tokens_routed += int(round(total))
+            self.moe_hot_expert_frac = hot
+        for j, v in enumerate(vals):
+            set_gauge(f"{self.prefix}.moe_expert_load_e{j}", v)
+        set_gauge(f"{self.prefix}.moe_hot_expert_frac", hot)
+        if total > 0:
+            inc_counter(f"{self.prefix}.moe_tokens_routed_total",
+                        int(round(total)))
+
+    def on_moe_capacity_wait(self, bucket: int) -> None:
+        """The hot-expert admission gate held this bucket's queue head
+        at a boundary (``moe_overflow='queue'``) — the hot spot
+        degraded ADMISSION latency while the in-flight batch kept
+        decoding. A climbing steady-state rate means the routing is
+        skewed relative to moe_capacity_factor (retrain the router,
+        raise the factor, or spread load via the router's
+        expert-affinity signal)."""
+        with self._lock:
+            self.moe_capacity_waits += 1
+        inc_counter(f"{self.prefix}.moe_capacity_waits_total")
+        self.event("-moe-", "moe_capacity_wait", bucket=bucket)
+
     # ---- live weight hot-swap (ISSUE 15) ----------------------------
     def on_model_version(self, version) -> None:
         """Publish the served model version: the ``<prefix>.
@@ -564,6 +609,15 @@ class ServeMetrics:
             m[f"{self.prefix}.kv_demotes"] = float(self.kv_demotes)
             m[f"{self.prefix}.kv_promotes"] = float(self.kv_promotes)
             m[f"{self.prefix}.weight_swaps"] = float(self.weight_swaps)
+            for j, v in enumerate(self.moe_expert_load):
+                m[f"{self.prefix}.moe_expert_load_e{j}"] = float(v)
+            if self.moe_expert_load or self.moe_tokens_routed:
+                m[f"{self.prefix}.moe_tokens_routed"] = float(
+                    self.moe_tokens_routed)
+                m[f"{self.prefix}.moe_hot_expert_frac"] = float(
+                    self.moe_hot_expert_frac)
+                m[f"{self.prefix}.moe_capacity_waits"] = float(
+                    self.moe_capacity_waits)
             m[f"{self.prefix}.spec_rounds"] = float(self.spec_rounds)
             m[f"{self.prefix}.spec_drafted"] = float(self.spec_drafted)
             m[f"{self.prefix}.spec_accepted"] = float(self.spec_accepted)

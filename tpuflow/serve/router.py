@@ -434,6 +434,7 @@ class Router:
         snapshot_cache: bool = False,
         health_timeout_s: float = 5.0,
         affinity_shards: int = 16,
+        expert_hot_threshold: float = 0.5,
     ):
         """``placement='load'`` is the real policy (least-loaded with
         prefix affinity when ``affinity``); ``'spray'`` hashes the
@@ -494,7 +495,21 @@ class Router:
         still in flight at the deadline is parked and re-checked next
         sweep (slow is NOT failed) instead of stalling failover for
         the rest of the tier. ``affinity_shards`` (power of two)
-        shards the affinity/directory/hot tables' locks."""
+        shards the affinity/directory/hot tables' locks.
+
+        EXPERT-AFFINITY (ISSUE 18): MoE replicas publish
+        ``moe_hot_expert_frac`` in their load snapshots — the share of
+        the last decode segment's expert-routed tokens that landed on
+        the single hottest expert. When the load-placement winner's
+        fraction is at or above ``expert_hot_threshold`` (its routing
+        is collapsing onto one expert, so its host capacity gate is
+        close to holding admissions) and prefix affinity did NOT
+        already pin the request, the router prefers a cooler replica
+        within the same ``affinity_slack`` load window
+        (``expert_affinity_hits``); with no cool replica in the
+        window it keeps the winner (``expert_affinity_spills``).
+        Dense replicas publish no fraction and are always 'cool', so
+        the valve is a no-op on non-MoE tiers."""
         if not replicas:
             raise ValueError("router needs at least one replica")
         if placement not in ("load", "spray"):
@@ -607,6 +622,7 @@ class Router:
         # counters (mirrored onto the obs registry as router.*)
         self.counts: Dict[str, int] = {
             "placed": 0, "affinity_hits": 0, "affinity_spills": 0,
+            "expert_affinity_hits": 0, "expert_affinity_spills": 0,
             "shed": 0, "shed_kv": 0, "rejected": 0, "failovers": 0,
             "replicas_failed": 0, "drains": 0,
             "transfers": 0, "transfer_fallbacks": 0,
@@ -632,6 +648,10 @@ class Router:
         self._ver_label: List[Optional[str]] = [None] * n_rep
         self._in_heap: List[bool] = [False] * n_rep
         self._entry_ver: List[int] = [0] * n_rep
+        # expert-affinity plane (ISSUE 18): hottest-expert token
+        # fraction per replica, 0.0 for dense replicas (always cool)
+        self._moe_hot: List[float] = [0.0] * n_rep
+        self.expert_hot_threshold = float(expert_hot_threshold)
         self._heap: List[Tuple[int, int, int, int]] = []
         self._free_heap: List[Tuple[int, int, int]] = []
         self._agg_depth = 0
@@ -755,6 +775,8 @@ class Router:
                 self._free[i] = free
                 self._closed_snap[i] = closed
                 self._ver_label[i] = self._snap_version(snap)
+                self._moe_hot[i] = float(
+                    snap.get("moe_hot_expert_frac") or 0.0)
                 self._entry_ver[i] += 1
                 elig = (i not in failed and not closed
                         and i not in self._prefill_set
@@ -1086,6 +1108,27 @@ class Router:
                     affinity_used = True
                 else:
                     self._count("affinity_spills")
+        # ---- expert-affinity valve (ISSUE 18) -----------------------
+        # prefix affinity outranks expert cooling (cache locality is
+        # deterministic; expert heat is one segment old), so the valve
+        # only moves requests prefix affinity did not pin: if the
+        # load winner's hottest-expert fraction says its MoE routing
+        # has collapsed, prefer the best COOL replica inside the same
+        # slack window rather than feeding the hot spot.
+        if (not affinity_used and self._placement != "spray"
+                and self._moe_hot[order[0]]
+                >= self.expert_hot_threshold):
+            hot = list(self._moe_hot)
+            cool = [i for i in order[1:]
+                    if hot[i] < self.expert_hot_threshold
+                    and scores[i] <= scores[order[0]]
+                    + self.affinity_slack]
+            if cool:
+                order.remove(cool[0])
+                order.insert(0, cool[0])
+                self._count("expert_affinity_hits")
+            else:
+                self._count("expert_affinity_spills")
         decisions = self._phase_decisions(ids, keys, order[0], live,
                                           standby)
         return self._place(
@@ -1218,6 +1261,34 @@ class Router:
                     affinity_used = True
                 else:
                     self._count("affinity_spills")
+            # expert-affinity valve (ISSUE 18), heap flavor: only
+            # when prefix affinity did not pin and the pick is
+            # expert-hot does the O(N) cool scan run — the cold
+            # branch costs one float compare. Candidates are chosen
+            # under _idx_lock; _count (takes _lock) runs after it is
+            # released. Redirecting ``first`` composes with
+            # _heap_candidates, which yields first, then best, then
+            # the remaining pops.
+            if (not affinity_used
+                    and self._moe_hot[first]
+                    >= self.expert_hot_threshold):
+                with self._idx_lock:
+                    cool = [i for i in range(len(self.replicas))
+                            if i != first and self._in_heap[i]
+                            and self._moe_hot[i]
+                            < self.expert_hot_threshold
+                            and self._score[i]
+                            <= best_score + self.affinity_slack]
+                    pick = min(
+                        cool,
+                        key=lambda i: (self._score[i],
+                                       -(self._free[i] or 0), i),
+                    ) if cool else None
+                if pick is not None:
+                    first = pick
+                    self._count("expert_affinity_hits")
+                else:
+                    self._count("expert_affinity_spills")
             decisions = self._phase_decisions(ids, keys, first, live,
                                               standby)
             rr = self._place(

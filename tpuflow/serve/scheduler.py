@@ -99,6 +99,8 @@ class ServeScheduler:
         speculate_k: int = 0,
         draft_model=None,
         draft_params=None,
+        moe_capacity_factor: float = 1.25,
+        moe_overflow: str = "queue",
         prefill_budget_tokens: Optional[int] = None,
         ring_prefill: Optional[int] = None,
         ring_prefill_min_tokens: int = 512,
@@ -179,7 +181,23 @@ class ServeScheduler:
         throughput knob. Requires ``kv='paged'`` (rollback rides the
         per-row write positions); draft KV shares the target's page
         tables. Per-request opt-out: ``submit(..., speculate=False)``
-        rows run plain decode inside the same batch."""
+        rows run plain decode inside the same batch.
+
+        MoE serving (ISSUE 18): an MoE model (``n_experts > 0``) must
+        be built with ``moe_no_drop=True`` and serves through
+        ``kv='paged'`` — the paged segment fn harvests per-expert
+        routed-token loads every segment (the ``serve.moe_expert_load``
+        gauges). ``moe_capacity_factor`` is the HOST-side capacity
+        knob: when the hottest expert's last-segment load exceeds
+        ``factor × balanced_share`` (balanced share = slots × seg ×
+        top_k × n_moe_blocks / n_experts), NEW admissions hold at the
+        boundary (``moe_overflow='queue'``, counted as
+        ``moe_capacity_waits``) until routing cools — in-flight rows
+        always run, so a hot expert degrades admission latency, never
+        wedges the batch. ``moe_overflow='off'`` disables the gate
+        (gauges only). Dropless routing means this gate is a LOAD
+        shaper, not a correctness surface — outputs stay
+        token-identical either way."""
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_queue < 1:
@@ -366,9 +384,78 @@ class ServeScheduler:
                 raise ValueError(
                     f"draft vocab_size {dv} != target vocab_size {tv} "
                     f"— draft and target must share one tokenizer")
+            div = int(getattr(draft_model, "image_vocab", 0) or 0)
+            tiv = int(getattr(model, "image_vocab", 0) or 0)
+            if div != tiv:
+                raise ValueError(
+                    f"draft image_vocab {div} != target image_vocab "
+                    f"{tiv} — a VLM target's draft must embed the same "
+                    f"image-prefix ids (draft_lm_config inherits them) "
+                    f"or drafted rows read garbage prompt positions")
             from tpuflow.obs import memory as _mem
 
             _mem.tag("draft_params", draft_params)  # ledger (ISSUE 7)
+        # ---- multi-workload validation (ISSUE 18) -------------------
+        # MoE serving: dropless routing + paged KV + the host-side
+        # capacity admission gate; VLM: the extended-vocab id range.
+        # Every misconfiguration fails HERE with a pointed error, not
+        # deep in a compiled dispatch (the --kv-* validated-combo
+        # style).
+        self.moe_experts = int(getattr(model, "n_experts", 0) or 0)
+        self.moe_top_k = int(getattr(model, "moe_top_k", 2) or 2)
+        moe_every = int(getattr(model, "moe_every", 2) or 2)
+        depth = int(getattr(model, "depth", 0) or 0)
+        self.moe_blocks = sum(
+            1 for i in range(depth)
+            if self.moe_experts > 0 and i % moe_every == moe_every - 1)
+        self.moe_capacity_factor = float(moe_capacity_factor)
+        self.moe_overflow = moe_overflow
+        if self.moe_experts:
+            if not getattr(model, "moe_no_drop", False):
+                raise ValueError(
+                    "serving an MoE model requires moe_no_drop=True "
+                    "(build_transformer_lm(..., moe_no_drop=True)) — "
+                    "capacity-dropped routing makes a token's output "
+                    "depend on its batch neighbors, so serve outputs "
+                    "could not stay token-identical to the single-"
+                    "request oracle; dropless decode routing moves the "
+                    "capacity trade to this scheduler's admission gate "
+                    "(moe_capacity_factor)")
+            if kv != "paged":
+                raise ValueError(
+                    "MoE serving requires kv='paged' — the per-expert "
+                    "load harvest and the capacity admission gate ride "
+                    "the paged segment fn")
+            if speculate_k:
+                raise ValueError(
+                    "speculate_k does not combine with MoE targets yet "
+                    "— the draft/verify fns have no expert-load "
+                    "harvest, so the capacity admission gate would fly "
+                    "blind; serve the MoE target without speculation")
+            if self.moe_blocks == 0:
+                raise ValueError(
+                    f"n_experts={self.moe_experts} but moe_every="
+                    f"{moe_every} places no MoE block in depth={depth} "
+                    f"— blocks i with i % moe_every == moe_every - 1 "
+                    f"are MoE; use moe_every=1 for every-block MoE")
+            if not self.moe_capacity_factor > 0:
+                raise ValueError(
+                    f"moe_capacity_factor must be > 0 (the hot-expert "
+                    f"admission threshold as a multiple of the "
+                    f"balanced per-expert share), got "
+                    f"{moe_capacity_factor}")
+            if moe_overflow not in ("queue", "off"):
+                raise ValueError(
+                    f"moe_overflow must be 'queue' (hold new "
+                    f"admissions while an expert runs hot) or 'off' "
+                    f"(gauges only), got {moe_overflow!r}")
+        self.image_vocab = int(getattr(model, "image_vocab", 0) or 0)
+        if self.image_vocab < 0:
+            raise ValueError(
+                f"image_vocab must be >= 0, got {self.image_vocab}")
+        # latest per-expert segment harvest (numpy (n_experts,)); None
+        # until the first MoE segment runs
+        self._moe_load: Optional[np.ndarray] = None
         self.kv_state: Optional[PagedKV] = None  # built with first pool
         self.pools: Dict[int, SlotPool] = {}
         self._queues: Dict[int, Deque[Request]] = {}
@@ -503,6 +590,37 @@ class ServeScheduler:
                 hint = max(hint, ph)
         return hint
 
+    def _moe_capacity_tokens(self, pool) -> float:
+        """Hot-expert admission threshold in routed tokens per
+        segment: ``moe_capacity_factor`` × the balanced per-expert
+        share of one full segment's routing mass (slots rows × seg
+        steps × top_k choices × n_moe_blocks sows / n_experts)."""
+        balanced = (pool.slots * pool.seg * self.moe_top_k
+                    * self.moe_blocks) / max(1, self.moe_experts)
+        return self.moe_capacity_factor * balanced
+
+    def _moe_admission_hot(self, pool) -> bool:
+        """True while the hot-expert admission gate should hold NEW
+        admissions: MoE model, gate on, a live batch, and the last
+        harvested segment's hottest expert at/over the capacity
+        threshold. Never true for an idle pool — stale loads cannot
+        starve an empty batch."""
+        if (not self.moe_experts or self.moe_overflow != "queue"
+                or self._moe_load is None or not pool.decode_live()):
+            return False
+        return (float(self._moe_load.max())
+                >= self._moe_capacity_tokens(pool))
+
+    def moe_hot_expert_frac(self) -> float:
+        """Hottest expert's share of the last segment's routed-token
+        mass (0.0 before any MoE segment, or for dense models) — the
+        router's expert-affinity placement signal."""
+        load = self._moe_load
+        if load is None:
+            return 0.0
+        total = float(load.sum())
+        return float(load.max()) / total if total > 0 else 0.0
+
     def submit(
         self,
         prompt,
@@ -556,6 +674,28 @@ class ServeScheduler:
                 "await_transfer/prefill_only do not combine with "
                 "speculate_k (no draft-side wire harvest)")
         ids = self._encode(prompt)
+        if ids.size:
+            # multi-workload id-range check (ISSUE 18): text ids live
+            # in [0, vocab); image-prefix ids in [vocab, vocab +
+            # image_vocab). Out-of-range ids would gather garbage
+            # embeddings — fail at submit, not in a compiled dispatch.
+            vocab = int(getattr(self.model, "vocab_size", 0) or 0)
+            if vocab:
+                top = int(ids.max())
+                if top >= vocab + self.image_vocab:
+                    if self.image_vocab:
+                        raise ValueError(
+                            f"prompt id {top} >= vocab_size ({vocab}) "
+                            f"+ image_vocab ({self.image_vocab}) — "
+                            f"image-prefix ids come from models.vlm."
+                            f"image_to_tokens against THIS model's "
+                            f"vocab/image_vocab")
+                    raise ValueError(
+                        f"prompt id {top} >= vocab_size ({vocab}) — "
+                        f"this model has no image vocabulary "
+                        f"(image_vocab=0); build a VLM with "
+                        f"models.vlm.build_vlm_lm to serve image-"
+                        f"prefix prompts")
         if max_new_tokens is None:
             max_new_tokens = self.max_new_cap
         if not 1 <= int(max_new_tokens) <= self.max_new_cap:
@@ -1077,6 +1217,11 @@ class ServeScheduler:
                 for pool in pools:
                     pool.params = placed
                 self.model_version = version
+        if not draft:
+            # new weights route differently: drop the stale per-expert
+            # window so the admission gate / affinity signal restart
+            # from the first post-swap segment (ISSUE 18)
+            self._moe_load = None
         cleared = 0
         if self.kv_state is not None and self.kv_state.prefix is not None:
             cleared = self.kv_state.prefix.clear()
@@ -1363,6 +1508,15 @@ class ServeScheduler:
             chunk_admits: List[tuple] = []  # chunked prefill (ISSUE 13)
             ring_admits: List[tuple] = []  # ring prefill offload
             page_starved = False
+            # hot-expert admission gate (ISSUE 18): while the last
+            # segment's hottest expert exceeded the capacity-factor
+            # share, NEW admissions hold (the queue keeps its head) —
+            # in-flight rows below run regardless, so a routing hot
+            # spot shapes admission, never wedges the batch. An idle
+            # pool never gates (loads are stale the moment the rows
+            # that produced them finish).
+            moe_hot = self._moe_admission_hot(pool)
+            moe_blocked = False
             with self._lock:
                 q = self._queues.get(b, deque())
                 # horizon exhausted + fully drained → rewind for the
@@ -1374,6 +1528,9 @@ class ServeScheduler:
                 # admit: freed slots take the queue head(s), FIFO
                 free = pool.free_slots()
                 while free and q and pool.can_admit(q[0].max_new_tokens):
+                    if moe_hot:
+                        moe_blocked = True
+                        break
                     if self._transfer_blocked(q[0], now):
                         # the head's inbound page chain is still
                         # streaming: hold it (its admission will hit
@@ -1436,6 +1593,8 @@ class ServeScheduler:
                 )
             if page_starved:
                 self.metrics.on_page_wait(b)
+            if moe_blocked:
+                self.metrics.on_moe_capacity_wait(b)
             for adm in admits + chunk_admits + ring_admits:
                 if len(adm) == 3:
                     self.metrics.on_prefix(adm[1], adm[2])
@@ -1541,6 +1700,14 @@ class ServeScheduler:
                         self._finalize(req, RequestState.DONE)
                     self._stream(req, new, finished)
                 self.metrics.on_segment(live, pool.slots)
+                if self.moe_experts:
+                    # per-expert load harvest (ISSUE 18): the segment
+                    # fn counted each live token's top-k assignments —
+                    # the latest segment IS the gate's window
+                    load = getattr(pool, "last_expert_load", None)
+                    if load is not None:
+                        self._moe_load = np.asarray(load, np.float64)
+                        self.metrics.on_moe_load(self._moe_load)
                 if getattr(pool, "spec_k", 0):
                     drafted, accepted = pool.last_spec_stats
                     if drafted:
@@ -1701,6 +1868,13 @@ class ServeScheduler:
         }
         if self.speculate_k:
             out["draft_version"] = self.draft_version
+        if self.moe_experts:
+            # expert-affinity sensor (ISSUE 18): the router steers new
+            # placements away from replicas whose routing runs hot
+            out["moe_hot_expert_frac"] = self.moe_hot_expert_frac()
+            load = self._moe_load
+            out["moe_expert_load"] = (
+                None if load is None else [float(x) for x in load])
         # shed sensor (ISSUE 17): the router's Retry-After derives
         # from the cached snapshot plane — carrying the hint here
         # saves one RPC per eligible replica per shed, exactly when

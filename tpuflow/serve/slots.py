@@ -322,6 +322,11 @@ class PagedSlotPool:
         self.kv = kv
         self.eos_id = eos_id
         self.params = params
+        # MoE load harvest (ISSUE 18): segment fns of an MoE model
+        # return an extra (n_experts,) routed-token count; the latest
+        # harvest is stashed for the scheduler's gauges + admission gate
+        self.n_experts = int(getattr(model, "n_experts", 0) or 0)
+        self.last_expert_load: Optional[np.ndarray] = None
         ps = kv.spec.page_size
         # token horizon: a row's final token index is p + max_new - 1
         # <= bucket + cap - 1; its KV never exceeds p + max_new - 1
@@ -908,13 +913,19 @@ class PagedSlotPool:
         with trace.span("serve.decode_segment", phase="decode",
                         bucket=self.bucket, seg=self.seg,
                         live=live_before, paged=1, width=w or 0):
-            self.kv.cache, self.out, done_dev, toks = seg_fn(
+            res = seg_fn(
                 self.params, self.kv.cache, self.out,
                 jnp.asarray(self.done), jnp.asarray(pos0),
                 jnp.asarray(self.kv_limit), jnp.asarray(self.last_tok),
                 jnp.asarray(self.stream_ids), self._rng,
                 jnp.asarray(table),
             )
+            if self.n_experts:
+                (self.kv.cache, self.out, done_dev, toks,
+                 load_dev) = res
+                self.last_expert_load = np.asarray(load_dev)
+            else:
+                self.kv.cache, self.out, done_dev, toks = res
             self.segments_run += 1
             was_done = self.done
             self.done = np.array(done_dev)
