@@ -1303,6 +1303,17 @@ def main() -> int:
                         "virtual tier tok/s scaling vs replica count; "
                         "pure host policy - no model, no device, no "
                         "compiles; writes BENCH_*_router_fleet.json")
+    p.add_argument("--serve-trace", action="store_true",
+                   help="distributed tracing + SLO attribution "
+                        "(ISSUE 19): tracer-on-vs-off router submit "
+                        "p50 at 1-in-16 head sampling on the "
+                        "--serve-fleet virtual-clock drive (claim: "
+                        "<=1.02x), plus a 1p2d tiny-LM tier with a "
+                        "delay fault at serve.transfer.land showing "
+                        "the transfer phase dominating "
+                        "serve.ttft_breakdown and ONE merged tier "
+                        "trace with the spec'd span nesting; writes "
+                        "BENCH_*_serve_trace.json")
     p.add_argument("--serve-longctx", action="store_true",
                    help="long-context serving A/B (ISSUE 13): a "
                         "steady short-request trace with ONE long "
@@ -1398,6 +1409,7 @@ def main() -> int:
              else "serve_disagg" if args.serve_disagg
              else "serve_tiered" if args.serve_tiered
              else "serve_fleet" if args.serve_fleet
+             else "serve_trace" if args.serve_trace
              else "serve_deploy" if args.serve_deploy
              else "serve_longctx" if args.serve_longctx
              else "serve_multiworkload" if args.serve_multiworkload
@@ -1516,6 +1528,8 @@ def _bench(args) -> int:
         return _bench_serve_tiered(args, devices)
     if args.serve_fleet:
         return _bench_serve_fleet(args, devices)
+    if args.serve_trace:
+        return _bench_serve_trace(args, devices)
     if args.serve_deploy:
         return _bench_serve_deploy(args, devices)
     if args.serve_longctx:
@@ -2473,6 +2487,148 @@ class _VClock:
 
     def __call__(self):
         return self.now
+
+
+class _FleetReplica:
+    """Host-only replica fake on a virtual clock (the ``--serve-fleet``
+    and ``--serve-trace`` drives): admits up to ``slots`` rows, serves
+    ``seg_tokens``/row/segment, bills ``seg_cost_s`` virtual seconds
+    per segment (batched: the segment costs the same at any occupancy,
+    like a real pool)."""
+
+    def __init__(self, name, vc, *, slots=4, seg_tokens=8,
+                 page_size=4, seg_cost_s=0.004):
+        self.name = name
+        self.vc = vc
+        self.slots = slots
+        self.seg_tokens = seg_tokens
+        self.seg_cost_s = seg_cost_s
+        self.max_new_cap = 32
+        self.page_size = page_size
+        self.max_queue = 1 << 20
+        self.kv_free = 1 << 20
+        self.tokenizer = None
+        self.queue, self.running, self.finished = [], [], []
+        self.served: dict = {}
+        self.closed = False
+        self.is_draining = False
+
+        class _M:
+            @staticmethod
+            def events(rid):
+                return []
+
+        self.metrics = _M()
+
+    def bucket_of(self, plen):
+        return max(8, 1 << (max(1, int(plen)) - 1).bit_length())
+
+    def pages_needed(self, plen, max_new):
+        return -(-(plen + max_new - 1) // self.page_size)
+
+    def submit(self, ids, max_new, *, deadline_s=None,
+               stream_cb=None, request_id=None, stream_id=None,
+               speculate=True, trace_ctx=None):
+        # trace_ctx: the router stamps it on head-sampled requests
+        # (ISSUE 19); the host-only fake has no tracer of its own —
+        # accepting the kwarg keeps the traced A/B arm driving the
+        # same submit path a real worker sees
+        import numpy as np
+
+        from tpuflow.serve.request import (QueueFull, Request,
+                                           SchedulerClosed)
+
+        if self.closed:
+            raise SchedulerClosed("scheduler is stopped")
+        if len(self.queue) >= self.max_queue:
+            raise QueueFull(len(self.queue), 0.05)
+        req = Request(prompt_ids=np.asarray(ids, np.int32),
+                      max_new_tokens=int(max_new),
+                      id=request_id or "", stream_cb=stream_cb)
+        req.stream_id = int(stream_id or 0) % self.slots
+        self.queue.append(req)
+        return req
+
+    def cancel(self, req):
+        from tpuflow.serve.request import RequestState
+
+        if req in self.queue:
+            self.queue.remove(req)
+            req.finalize(RequestState.CANCELLED, "cancelled")
+            if req.stream_cb:
+                req.stream_cb(req, [], True)
+            return True
+        return False
+
+    def load_snapshot(self):
+        return {"queue_depth": len(self.queue),
+                "running": len(self.running),
+                "closed": self.closed or self.is_draining,
+                "draining": self.is_draining,
+                "kv_pages_free": self.kv_free,
+                "kv_pages_total": self.kv_free,
+                # the ISSUE 17 shed hint: Retry-After reads from
+                # the cached plane, zero RPCs on an overloaded tier
+                "retry_after_s": 0.05}
+
+    def readiness(self):
+        return {"ready": not self.closed}
+
+    def health(self):
+        return {"failed": False, "closed": self.closed,
+                "draining": self.is_draining}
+
+    def retry_after_s(self):
+        return 0.05
+
+    def metrics_snapshot(self):
+        return {}
+
+    def start(self):
+        pass
+
+    def drain(self):
+        self.is_draining = True
+        self.closed = True
+
+    def stop(self, drain=True, timeout=0.0):
+        self.closed = True
+
+    def step(self):
+        import numpy as np
+
+        from tpuflow.serve.request import RequestState
+
+        progress = False
+        while self.queue and len(self.running) < self.slots:
+            req = self.queue.pop(0)
+            req.state = RequestState.RUNNING
+            req.ts_admitted = self.vc.now
+            self.served[id(req)] = 0
+            self.running.append(req)
+            progress = True
+        if not self.running:
+            return progress
+        self.vc.now += self.seg_cost_s
+        for req in list(self.running):
+            done = self.served[id(req)] + self.seg_tokens
+            self.served[id(req)] = done
+            if done >= req.max_new_tokens:
+                base = int(np.sum(req.prompt_ids.astype(
+                    np.int64))) * 31 + req.stream_id * 7
+                toks = [(base + j) % 997
+                        for j in range(req.max_new_tokens)]
+                req.tokens.extend(toks)
+                self.running.remove(req)
+                self.served.pop(id(req), None)
+                self.finished.append(req)
+                req.finalize(RequestState.DONE)
+                if req.stream_cb:
+                    req.stream_cb(req, toks, True)
+        return True
+
+    def idle(self):
+        return not self.queue and not self.running
 
 
 def _serve_workload(seed: int, n: int, max_new_cap: int,
@@ -4315,7 +4471,6 @@ def _bench_serve_fleet(args, devices) -> int:
     import numpy as np
 
     from tpuflow.serve.metrics import percentiles
-    from tpuflow.serve.request import Request, RequestState
     from tpuflow.serve.router import Router
 
     widths = [2, 8, 32, 64, 128]
@@ -4323,131 +4478,6 @@ def _bench_serve_fleet(args, devices) -> int:
     slots, seg_tokens, ps = 4, 8, 4
     seg_cost_s = 0.004  # virtual seconds per decode segment
     maint_every = 64  # submits between cached-plane refresh sweeps
-
-    class _FleetReplica:
-        """Host-only replica fake on a virtual clock: admits up to
-        ``slots`` rows, serves ``seg_tokens``/row/segment, bills
-        ``seg_cost_s`` virtual seconds per segment (batched: the
-        segment costs the same at any occupancy, like a real pool)."""
-
-        def __init__(self, name, vc):
-            self.name = name
-            self.vc = vc
-            self.slots = slots
-            self.max_new_cap = 32
-            self.page_size = ps
-            self.max_queue = 1 << 20
-            self.kv_free = 1 << 20
-            self.tokenizer = None
-            self.queue, self.running, self.finished = [], [], []
-            self.served: dict = {}
-            self.closed = False
-            self.is_draining = False
-
-            class _M:
-                @staticmethod
-                def events(rid):
-                    return []
-
-            self.metrics = _M()
-
-        def bucket_of(self, plen):
-            return max(8, 1 << (max(1, int(plen)) - 1).bit_length())
-
-        def pages_needed(self, plen, max_new):
-            return -(-(plen + max_new - 1) // self.page_size)
-
-        def submit(self, ids, max_new, *, deadline_s=None,
-                   stream_cb=None, request_id=None, stream_id=None,
-                   speculate=True):
-            from tpuflow.serve.request import (QueueFull,
-                                               SchedulerClosed)
-
-            if self.closed:
-                raise SchedulerClosed("scheduler is stopped")
-            if len(self.queue) >= self.max_queue:
-                raise QueueFull(len(self.queue), 0.05)
-            req = Request(prompt_ids=np.asarray(ids, np.int32),
-                          max_new_tokens=int(max_new),
-                          id=request_id or "", stream_cb=stream_cb)
-            req.stream_id = int(stream_id or 0) % self.slots
-            self.queue.append(req)
-            return req
-
-        def cancel(self, req):
-            if req in self.queue:
-                self.queue.remove(req)
-                req.finalize(RequestState.CANCELLED, "cancelled")
-                if req.stream_cb:
-                    req.stream_cb(req, [], True)
-                return True
-            return False
-
-        def load_snapshot(self):
-            return {"queue_depth": len(self.queue),
-                    "running": len(self.running),
-                    "closed": self.closed or self.is_draining,
-                    "draining": self.is_draining,
-                    "kv_pages_free": self.kv_free,
-                    "kv_pages_total": self.kv_free,
-                    # the ISSUE 17 shed hint: Retry-After reads from
-                    # the cached plane, zero RPCs on an overloaded tier
-                    "retry_after_s": 0.05}
-
-        def readiness(self):
-            return {"ready": not self.closed}
-
-        def health(self):
-            return {"failed": False, "closed": self.closed,
-                    "draining": self.is_draining}
-
-        def retry_after_s(self):
-            return 0.05
-
-        def metrics_snapshot(self):
-            return {}
-
-        def start(self):
-            pass
-
-        def drain(self):
-            self.is_draining = True
-            self.closed = True
-
-        def stop(self, drain=True, timeout=0.0):
-            self.closed = True
-
-        def step(self):
-            progress = False
-            while self.queue and len(self.running) < self.slots:
-                req = self.queue.pop(0)
-                req.state = RequestState.RUNNING
-                req.ts_admitted = self.vc.now
-                self.served[id(req)] = 0
-                self.running.append(req)
-                progress = True
-            if not self.running:
-                return progress
-            self.vc.now += seg_cost_s
-            for req in list(self.running):
-                done = self.served[id(req)] + seg_tokens
-                self.served[id(req)] = done
-                if done >= req.max_new_tokens:
-                    base = int(np.sum(req.prompt_ids.astype(
-                        np.int64))) * 31 + req.stream_id * 7
-                    toks = [(base + j) % 997
-                            for j in range(req.max_new_tokens)]
-                    req.tokens.extend(toks)
-                    self.running.remove(req)
-                    self.served.pop(id(req), None)
-                    self.finished.append(req)
-                    req.finalize(RequestState.DONE)
-                    if req.stream_cb:
-                        req.stream_cb(req, toks, True)
-            return True
-
-        def idle(self):
-            return not self.queue and not self.running
 
     def run(width: int) -> dict:
         n_req = per_rep * width
@@ -4472,7 +4502,9 @@ def _bench_serve_fleet(args, devices) -> int:
             # makespan and measure luck, not routing
             budgets.append(16)
         clocks = [_VClock() for _ in range(width)]
-        reps = [_FleetReplica(f"replica{r}", clocks[r])
+        reps = [_FleetReplica(f"replica{r}", clocks[r], slots=slots,
+                              seg_tokens=seg_tokens, page_size=ps,
+                              seg_cost_s=seg_cost_s)
                 for r in range(width)]
         # running simulation frontier: the router stamps events with
         # this clock on EVERY placement, so a max() over all replica
@@ -4602,6 +4634,293 @@ def _bench_serve_fleet(args, devices) -> int:
     )
     emit(scaling_frac, flatness, diagnostics=diag,
          metric="serve_fleet_scaling_frac_at_max_width", unit="frac")
+    return 0
+
+
+def _bench_serve_trace(args, devices) -> int:
+    """--serve-trace: the ISSUE 19 record — tier-wide distributed
+    tracing + SLO phase attribution. Two arms ride one record:
+
+    - **overhead A/B** on the ``--serve-fleet`` virtual-clock drive at
+      width 8: router submit wall p50 with the tracer OFF vs ON at
+      1-in-16 head sampling (the always-on production setting).
+      Acceptance: traced/untraced p50 ratio <= 1.02 (min-of-k per arm
+      so a contended box cannot decide the A/B);
+    - **slow-transfer attribution demo** on a REAL 1 prefill + 2
+      decode tiny-LM tier: tracer on at head 1-in-1, a ``delay`` fault
+      armed at ``serve.transfer.land`` (sized from the un-faulted
+      run's own TTFT so it dominates by construction), and the record
+      pins (a) the transfer phase dominating ``serve.ttft_breakdown``
+      and (b) ONE merged tier trace for a faulted request with the
+      spec'd nesting: ``router.transfer`` child of ``router.prefill``,
+      ``serve.transfer_land`` child of the transfer, monotone
+      offset-corrected starts.
+
+    ``value`` = traced/untraced router submit p50 ratio."""
+    import numpy as np
+
+    from tpuflow.obs import trace
+    from tpuflow.serve.metrics import percentiles
+    from tpuflow.serve.router import Router
+
+    # ---- arm 1: tracer overhead on the fleet drive ------------------
+    width = 8
+    per_rep = 24 if args.smoke else 96
+    slots, seg_tokens, ps, seg_cost_s = 4, 8, 4, 0.004
+    maint_every = 64
+
+    def fleet_p50_us(seed: int) -> float:
+        n_req = per_rep * width
+        rng = np.random.default_rng(seed)
+        prefixes = [rng.integers(1, 50_000, (12,)).astype(np.int32)
+                    for _ in range(4 * width)]
+        prompts = []
+        for _ in range(n_req):
+            pfx = prefixes[int(rng.integers(0, len(prefixes)))]
+            sfx = rng.integers(1, 50_000, (int(rng.integers(2, 6)),))
+            prompts.append(np.concatenate([pfx, sfx.astype(np.int32)]))
+        clocks = [_VClock() for _ in range(width)]
+        reps = [_FleetReplica(f"replica{r}", clocks[r], slots=slots,
+                              seg_tokens=seg_tokens, page_size=ps,
+                              seg_cost_s=seg_cost_s)
+                for r in range(width)]
+        frontier = [0.0]
+        router = Router(reps, snapshot_cache=True,
+                        clock=lambda: frontier[0])
+        router.maintain()
+        walls, rrs = [], []
+        for i in range(n_req):
+            if i and i % maint_every == 0:
+                router.maintain()
+            t0 = time.perf_counter()
+            rr = router.submit(prompts[i], max_new_tokens=16)
+            walls.append(time.perf_counter() - t0)
+            rrs.append(rr)
+        steps = 0
+        while True:
+            busy = [r for r in range(width) if not reps[r].idle()]
+            if not busy:
+                break
+            r = min(busy, key=lambda q: clocks[q].now)
+            reps[r].step()
+            frontier[0] = max(frontier[0], clocks[r].now)
+            steps += 1
+            if steps % 256 == 0:
+                router.maintain()
+        assert all(rr.state.value == "done" for rr in rrs)
+        return percentiles([w * 1e6 for w in walls])["p50"]
+
+    # pre-warm the traced bytecode paths (Span creation, sampler,
+    # ring commit) OUTSIDE the timed runs: the adaptive interpreter
+    # specializes these on first executions, and with only 1-in-16
+    # requests traced the early "on" runs otherwise keep paying
+    # first-touch cost for several repeats
+    trace.enable()
+    trace.configure_sampling(head_n=16)
+    for i in range(2048):
+        if trace.is_enabled() and trace.head_sampled(f"warm-{i}"):
+            sp = trace.begin("router.request", trace_id=f"warm-{i}")
+            trace.end(sp)
+    trace.clear()
+
+    k = 9 if args.smoke else 15
+    offs, ons = [], []
+    for rep_i in range(k + 1):
+        # alternate arms so drift on a shared box hits both equally;
+        # the first pair is warmup (first-touch imports on the traced
+        # path) and is discarded
+        trace.disable()
+        trace.configure_sampling(head_n=1)
+        off = fleet_p50_us(100 + rep_i)
+        trace.enable()
+        trace.configure_sampling(head_n=16)
+        on = fleet_p50_us(100 + rep_i)
+        if rep_i:
+            offs.append(off)
+            ons.append(on)
+    trace.disable()
+    trace.configure_sampling(head_n=1)
+    p50_off, p50_on = min(offs), min(ons)
+    overhead_ratio = round(p50_on / max(p50_off, 1e-9), 4)
+    _progress({"phase": "serve_trace_overhead",
+               "p50_off_us": round(p50_off, 1),
+               "p50_on_us": round(p50_on, 1),
+               "ratio": overhead_ratio})
+
+    # ---- arm 2: 1p2d slow-transfer attribution demo -----------------
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.obs.health import Watchdog
+    from tpuflow.serve.metrics import TTFT_PHASES, ServeMetrics
+    from tpuflow.serve.replica import InProcessReplica
+    from tpuflow.serve.scheduler import ServeScheduler
+    from tpuflow.testing import faults
+
+    vocab, dim, depth, heads = 512, 128, 2, 4
+    model = build_transformer_lm(vocab_size=vocab, dim=dim,
+                                 depth=depth, heads=heads,
+                                 attn_impl="einsum")
+    params = nn.unbox(
+        model.init({"params": jax.random.key(0)},
+                   jnp.zeros((1, 8), jnp.int32)))["params"]
+    rng = np.random.default_rng(7)
+    # long prompts (>= transfer_min_tokens) so every request takes the
+    # prefill-replica -> transfer -> decode-home path
+    script = [(rng.integers(1, vocab, (13,)).astype(np.int32), 6)
+              for _ in range(4)]
+
+    def tier_run(fault_delay_s=None):
+        scheds = [
+            ServeScheduler(model, params, slots=2, seg=4,
+                           max_new_cap=12, kv="paged", kv_page_size=4,
+                           kv_pages=49, replica_class=cls,
+                           watchdog=Watchdog(),
+                           metrics=ServeMetrics(
+                               gauge_prefix=f"serve.replica{i}"))
+            for i, cls in enumerate(("prefill", "decode", "decode"))
+        ]
+        reps = [InProcessReplica(s, name=f"rep{i}")
+                for i, s in enumerate(scheds)]
+        router = Router(reps, transfer_min_tokens=8)
+        # each Router numbers its requests from rt-1: drop the
+        # previous run's spans or tier_trace("rt-1") would stitch
+        # THREE runs into one trace
+        trace.clear()
+        if fault_delay_s:
+            faults.inject("serve.transfer.land", "delay", times=-1,
+                          delay_s=fault_delay_s)
+        try:
+            rrs = [router.submit(p, n) for p, n in script]
+            router.run_until_idle()
+        finally:
+            if fault_delay_s:
+                faults.clear("serve.transfer.land")
+        assert all(rr.state.value == "done" for rr in rrs), [
+            (rr.state.value, rr.error) for rr in rrs]
+        phase_tot = {ph: 0.0 for ph in TTFT_PHASES}
+        n_obs = 0
+        for s in scheds:
+            for phname, h in s.metrics.ttft_breakdown.items():
+                st = h.state()
+                phase_tot[phname] += float(st["total"])
+                n_obs = max(n_obs, int(st["n"]))
+        tt = router.tier_trace(rrs[0].id)
+        return phase_tot, n_obs, tt
+
+    trace.enable()
+    trace.configure_sampling(head_n=1)
+    tier_run()  # warmup: first-touch pool compiles stay out of the A/B
+    base_tot, base_n, base_tt = tier_run()
+    # size the injected delay from the UN-faulted run's own TTFT so
+    # the transfer phase dominates by construction on any box: each
+    # request lands >=1 chunk, so delay >= 1.2x the whole baseline
+    # per-request TTFT makes transfer > everything else combined
+    base_ttft_ms = sum(base_tot.values()) / max(1, base_n)
+    delay_s = min(2.0, max(0.25, 1.2 * base_ttft_ms / 1e3))
+    fault_tot, fault_n, fault_tt = tier_run(fault_delay_s=delay_s)
+    trace.disable()
+    trace.configure_sampling(head_n=1)
+
+    def transfer_frac(tot):
+        return tot.get("transfer", 0.0) / max(sum(tot.values()), 1e-9)
+
+    frac_base = round(transfer_frac(base_tot), 4)
+    frac_fault = round(transfer_frac(fault_tot), 4)
+
+    spans = fault_tt["spans"]
+    t0 = min((s["start_s"] for s in spans), default=0.0)
+    brief = [{"name": s["name"], "source": s.get("source"),
+              "span_id": s.get("span_id"),
+              "parent_id": s.get("parent_id"),
+              "start_ms": round((s["start_s"] - t0) * 1e3, 3),
+              "dur_ms": round(float(s.get("dur_ms") or 0.0), 3)}
+             for s in spans]
+
+    def first(name):
+        return next((s for s in brief if s["name"] == name), None)
+
+    root = first("router.request")
+    pf = first("router.prefill")
+    tx = first("router.transfer")
+    land = first("serve.transfer_land")
+    nesting = {
+        "prefill_child_of_root": bool(
+            root and pf and pf["parent_id"] == root["span_id"]),
+        "transfer_child_of_prefill": bool(
+            pf and tx and tx["parent_id"] == pf["span_id"]),
+        "land_child_of_transfer": bool(
+            tx and land and land["parent_id"] == tx["span_id"]),
+        "monotone_starts": all(
+            brief[i]["start_ms"] <= brief[i + 1]["start_ms"]
+            for i in range(len(brief) - 1)),
+    }
+    _progress({"phase": "serve_trace_attribution",
+               "transfer_frac_base": frac_base,
+               "transfer_frac_fault": frac_fault,
+               "nesting": nesting})
+
+    diag = {
+        "device_kind": devices[0].device_kind,
+        "overhead": {
+            "fleet_width": width,
+            "requests_per_replica": per_rep,
+            "head_sample_n": 16,
+            "repeats_min_of": k,
+            "router_p50_us_off": round(p50_off, 2),
+            "router_p50_us_on": round(p50_on, 2),
+            "p50_off_runs_us": [round(v, 2) for v in offs],
+            "p50_on_runs_us": [round(v, 2) for v in ons],
+            "ratio_p50": overhead_ratio,
+        },
+        "attribution": {
+            "tier": "1p2d",
+            "requests": len(script),
+            "fault_point": "serve.transfer.land",
+            "fault_delay_s": round(delay_s, 3),
+            "ttft_breakdown_total_ms": {
+                "baseline": {kk: round(v, 2)
+                             for kk, v in base_tot.items()},
+                "faulted": {kk: round(v, 2)
+                            for kk, v in fault_tot.items()},
+            },
+            "transfer_frac_baseline": frac_base,
+            "transfer_frac_faulted": frac_fault,
+            "transfer_dominates": frac_fault > 0.5,
+        },
+        "tier_trace": {
+            "id": fault_tt["id"],
+            "sources": fault_tt["sources"],
+            "nesting": nesting,
+            "spans": brief,
+        },
+        "span_totals_ms": _span_totals(),
+    }
+    rec = {
+        "metric": "serve_trace_overhead_ratio_p50",
+        "value": overhead_ratio,
+        "unit": "ratio",
+        "vs_baseline": frac_fault,
+        "mode": "serve_trace",
+        "smoke": bool(args.smoke),
+        "diagnostics": diag,
+    }
+    out_path = args.serve_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_LOCAL_r19_serve_trace.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(
+        f"# serve-trace overhead p50 {p50_off:.1f}us off -> "
+        f"{p50_on:.1f}us on (x{overhead_ratio:.3f} at 1-in-16) | "
+        f"transfer frac {frac_base:.2f} -> {frac_fault:.2f} under "
+        f"{delay_s:.2f}s land delay -> {out_path}",
+        file=sys.stderr, flush=True,
+    )
+    emit(overhead_ratio, frac_fault, diagnostics=diag,
+         metric="serve_trace_overhead_ratio_p50", unit="ratio")
     return 0
 
 

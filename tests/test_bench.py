@@ -769,3 +769,43 @@ def test_hlo_fusion_census_on_uint8_conv():
     assert (census["u8_convert_fused_with_conv"]
             or census["standalone_u8_convert_computations"] >= 1
             or census["u8_convert_in_entry"]), census
+
+@pytest.mark.slow
+def test_smoke_serve_trace_emits_schema(tmp_path):
+    """--serve-trace: the ISSUE 19 record — tracing-enabled router
+    overhead at 1-in-16 head sampling on the fleet virtual-clock trace
+    (arm 1), and the injected-slow-transfer attribution demo on a real
+    1p2d tier (arm 2): the merged tier trace nests correctly and the
+    transfer phase dominates serve.ttft_breakdown under the fault."""
+    out = str(tmp_path / "BENCH_TEST_serve_trace.json")
+    r = _run("--smoke", "--serve-trace", "--serve-out", out,
+             timeout=1400)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "serve_trace_overhead_ratio_p50"
+    assert "error" not in rec
+    d = rec["diagnostics"]
+    # overhead arm: min-of-k p50 on/off, ratio sane. The issue's
+    # acceptance bound is <=1.02 measured on the committed full run;
+    # the smoke run on a shared CI box gets a lenient guard only.
+    ov = d["overhead"]
+    assert ov["head_sample_n"] == 16
+    assert ov["router_p50_us_off"] > 0 and ov["router_p50_us_on"] > 0
+    assert 0.8 <= rec["value"] <= 1.2, rec["value"]
+    # attribution arm: the fault made transfer dominate the breakdown
+    at = d["attribution"]
+    assert at["fault_point"] == "serve.transfer.land"
+    assert at["transfer_dominates"] is True
+    assert at["transfer_frac_faulted"] > at["transfer_frac_baseline"]
+    assert rec["vs_baseline"] == at["transfer_frac_faulted"]
+    # the merged tier trace: one stitched trace, nesting pinned
+    tt = d["tier_trace"]
+    assert set(tt["sources"]) >= {"router"}
+    nest = tt["nesting"]
+    assert nest["prefill_child_of_root"] is True
+    assert nest["transfer_child_of_prefill"] is True
+    assert nest["land_child_of_transfer"] is True
+    assert nest["monotone_starts"] is True
+    with open(out) as f:
+        disk = json.load(f)
+    assert disk["mode"] == "serve_trace"

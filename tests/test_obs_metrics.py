@@ -134,6 +134,34 @@ def test_prometheus_exposition_golden():
     assert "obs_m_lat_ms_p50_cum" not in by_name
 
 
+def test_prometheus_phase_label_folding_golden():
+    """ISSUE 19 satellite: per-phase member histograms
+    ``<prefix>.req_phase_ms.<phase>`` / ``<prefix>.ttft_breakdown.<phase>``
+    fold into ONE family with a ``phase=\"...\"`` label — composing with
+    the ``replica=\"<i>\"`` fold, labels in pinned (le, phase, replica)
+    order so existing recording rules keep matching verbatim."""
+    observe("obs_m.replica0.ttft_breakdown.transfer", 40.0)
+    observe("obs_m.replica1.ttft_breakdown.queue_wait", 2.0)
+    observe("obs_m.req_phase_ms.decode_steady", 9.0)  # no replica
+    set_gauge("obs_m.replica0.queue_depth", 1.0)      # no phase
+    text = prom.render("obs_m.")
+
+    # one family per metric, not one per phase member
+    assert text.count("# TYPE obs_m_ttft_breakdown histogram") == 1
+    assert "obs_m_ttft_breakdown_transfer" not in text
+    # golden lines: phase slots BETWEEN le and replica
+    assert ('obs_m_ttft_breakdown_bucket'
+            '{le="+Inf",phase="transfer",replica="0"} 1') in text
+    assert ('obs_m_ttft_breakdown_count'
+            '{phase="queue_wait",replica="1"} 1') in text
+    assert 'obs_m_ttft_breakdown_sum{phase="transfer",replica="0"} 40' \
+        in text
+    # phase label without a replica marker stands alone
+    assert 'obs_m_req_phase_ms_count{phase="decode_steady"} 1' in text
+    # replica fold without a phase member is untouched (ISSUE 8 golden)
+    assert 'obs_m_queue_depth{replica="0"} 1' in text
+
+
 def test_prometheus_exporter_http():
     observe("obs_m.lat_ms", 42.0)
     server = prom.start_exporter(port=0, prefix="obs_m.",

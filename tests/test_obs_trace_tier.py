@@ -1,0 +1,459 @@
+"""Tier-wide distributed tracing + SLO phase attribution (ISSUE 19).
+
+The cross-PROCESS half of the tracing plane, tested without processes:
+
+- bounded always-on sampling: the head rate is honored and
+  deterministic on the trace id (every process votes identically), and
+  tail-keep always wins for errored / slow / windowed-p95-outlier
+  requests even when the head dropped them;
+- clock-offset merge: ``merge_tier_spans`` subtracts each part's
+  RTT-midpoint offset estimate and clamps residual skew so a
+  parent/child edge can never run backwards; event instants (span_id
+  None) never capture root spans as fake parents;
+- the SLO phase vector: clamped adjacent timestamp differences, so the
+  phases SUM to the client-observed e2e latency by construction;
+- one stitched tier trace: a replica fake simulating a remote process
+  (skewed wall clock, trace-context adoption, ``trace_spans()``
+  fan-out) behind a real ``Router`` yields ONE merged trace with the
+  parent/child edge crossing the process boundary and the skew
+  corrected out of the remote spans' timestamps;
+- the ``trace-report`` CLI renders that merged view as a per-phase
+  text timeline.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tpuflow.obs import trace
+from tpuflow.serve.request import (
+    QueueFull,
+    Request,
+    RequestState,
+    SchedulerClosed,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture
+def tracer():
+    trace.enable(capacity=4096)
+    yield
+    trace.configure_sampling(head_n=1)
+    trace.disable()
+    trace.clear()
+
+
+# ---------------------------------------------------------------------
+# sampling decisions
+# ---------------------------------------------------------------------
+
+def _head_dropped_id(prefix: str) -> str:
+    """An id the current head sampler drops (exists for any n >= 2)."""
+    for i in range(10_000):
+        rid = f"{prefix}-{i}"
+        if not trace.head_sampled(rid):
+            return rid
+    raise AssertionError("no head-dropped id found")
+
+
+def test_head_sampling_rate_and_determinism(tracer):
+    trace.configure_sampling(head_n=4)
+    ids = [f"req-{i}" for i in range(600)]
+    votes = [trace.head_sampled(r) for r in ids]
+    # deterministic: the same ids vote the same way again (what lets
+    # the router and every worker agree per request with no handshake)
+    assert votes == [trace.head_sampled(r) for r in ids]
+    frac = sum(votes) / len(votes)
+    assert 0.15 < frac < 0.40, frac  # ~1/4 up to crc32 binning noise
+    trace.configure_sampling(head_n=1)
+    assert all(trace.head_sampled(r) for r in ids)
+    with pytest.raises(ValueError):
+        trace.configure_sampling(head_n=0)
+
+
+def test_head_sampled_spans_commit_straight_to_ring(tracer):
+    trace.configure_sampling(head_n=1)
+    assert trace.begin_request("keep-1") is True
+    s = trace.begin("w.request", trace_id="keep-1")
+    trace.end(s)
+    assert [x["name"] for x in trace.spans_for("keep-1")] == ["w.request"]
+    assert trace.finish_request("keep-1", latency_ms=3.0) is True
+
+
+def test_tail_keep_error_always_wins(tracer):
+    trace.configure_sampling(head_n=1 << 20, tail_slow_ms=None)
+    rid = _head_dropped_id("err")
+    assert trace.begin_request(rid) is False
+    s = trace.begin("w.request", trace_id=rid)
+    trace.end(s)
+    # buffered, not committed: the ring shows nothing yet
+    assert trace.spans_for(rid) == []
+    assert trace.finish_request(rid, error=True, latency_ms=1.0) is True
+    assert [x["name"] for x in trace.spans_for(rid)] == ["w.request"]
+
+
+def test_tail_keep_slow_threshold_and_fast_drop(tracer):
+    trace.configure_sampling(head_n=1 << 20, tail_slow_ms=50.0)
+    fast = _head_dropped_id("fast")
+    trace.begin_request(fast)
+    trace.end(trace.begin("w.request", trace_id=fast))
+    assert trace.finish_request(fast, latency_ms=5.0) is False
+    assert trace.spans_for(fast) == []  # dropped for good
+    slow = _head_dropped_id("slow")
+    trace.begin_request(slow)
+    trace.end(trace.begin("w.request", trace_id=slow))
+    assert trace.finish_request(slow, latency_ms=75.0) is True
+    assert [x["name"] for x in trace.spans_for(slow)] == ["w.request"]
+
+
+def test_tail_keep_windowed_p95_outlier(tracer):
+    trace.configure_sampling(head_n=1 << 20, tail_slow_ms=None)
+    # warm the latency window well past the minimum sample count
+    for i in range(30):
+        rid = _head_dropped_id(f"warm{i}")
+        trace.begin_request(rid)
+        trace.finish_request(rid, latency_ms=10.0)
+    outlier = _head_dropped_id("outlier")
+    trace.begin_request(outlier)
+    trace.end(trace.begin("w.request", trace_id=outlier))
+    # >= the windowed p95 (all 10ms): kept with NO configured threshold
+    assert trace.finish_request(outlier, latency_ms=500.0) is True
+    assert trace.spans_for(outlier)
+
+
+# ---------------------------------------------------------------------
+# SLO phase vector
+# ---------------------------------------------------------------------
+
+def test_phase_vector_sums_to_e2e():
+    from tpuflow.serve.metrics import PHASES
+
+    req = Request(prompt_ids=np.arange(1, 6, dtype=np.int32),
+                  max_new_tokens=4, id="ph-1")
+    t0 = 1000.0
+    req.ts_arrival = t0
+    req.ts_transfer = t0 + 0.010
+    req.ts_admitted = t0 + 0.015
+    req.ts_prefill_done = t0 + 0.040
+    req.ts_first_token = t0 + 0.050
+    req.ts_done = t0 + 0.200
+    ph = req.phases()
+    assert set(ph) == set(PHASES)
+    assert ph["transfer"] == pytest.approx(10.0)
+    assert ph["queue_wait"] == pytest.approx(5.0)
+    assert ph["place"] == 0.0
+    assert ph["prefill"] == pytest.approx(25.0)
+    assert ph["first_decode"] == pytest.approx(10.0)
+    assert ph["decode_steady"] == pytest.approx(150.0)
+    assert sum(ph.values()) == pytest.approx(200.0, abs=1e-6)
+
+
+def test_phase_vector_identity_survives_bad_stamps():
+    """Clamping makes the identity unconditional: missing and
+    out-of-order timestamps redistribute between neighbors but the
+    phases still sum to the client-observed e2e latency exactly."""
+    req = Request(prompt_ids=np.arange(1, 6, dtype=np.int32),
+                  max_new_tokens=4, id="ph-2")
+    t0 = 1000.0
+    req.ts_arrival = t0
+    req.ts_done = t0 + 0.100
+    # no transfer (local prefill), prefill_done stamped BEFORE admit
+    req.ts_transfer = None
+    req.ts_admitted = t0 + 0.030
+    req.ts_prefill_done = t0 + 0.010
+    req.ts_first_token = t0 + 0.060
+    ph = req.phases()
+    assert all(v >= 0.0 for v in ph.values()), ph
+    assert sum(ph.values()) == pytest.approx(100.0, abs=1e-6)
+    assert ph["transfer"] == 0.0
+
+
+# ---------------------------------------------------------------------
+# clock-offset merge
+# ---------------------------------------------------------------------
+
+def _span(name, sid, parent, start_s, dur_ms=1.0, **attrs):
+    return {"name": name, "span_id": sid, "parent_id": parent,
+            "thread": "t", "start_s": start_s, "dur_ms": dur_ms,
+            "attrs": attrs}
+
+
+def test_clock_offset_merge_with_injected_skew():
+    skew = 7.5  # worker clock runs 7.5s AHEAD of the router's
+    router_part = [_span("router.request", 1, None, 100.0, 50.0)]
+    worker_part = [
+        _span("serve.request", 2, 1, 100.010 + skew, 40.0),
+        _span("serve.queue", 3, 2, 100.012 + skew, 2.0),
+    ]
+    merged = trace.merge_tier_spans([
+        ("router", 0.0, router_part),
+        ("w0", skew, worker_part),
+    ])
+    by_id = {s["span_id"]: s for s in merged}
+    assert by_id[2]["source"] == "w0"
+    assert by_id[2]["start_s"] == pytest.approx(100.010, abs=1e-6)
+    assert by_id[3]["start_s"] == pytest.approx(100.012, abs=1e-6)
+    starts = [s["start_s"] for s in merged]
+    assert starts == sorted(starts)
+
+
+def test_merge_clamps_residual_skew_on_parent_child_edges():
+    """An UNDER-estimated offset cannot produce a child that starts
+    before its parent: the merge clamps the edge monotone."""
+    router_part = [_span("router.request", 1, None, 100.0, 50.0)]
+    # corrected start lands 80ms BEFORE the parent (estimate error)
+    worker_part = [_span("serve.request", 2, 1, 99.920 + 5.0, 40.0)]
+    merged = trace.merge_tier_spans([
+        ("router", 0.0, router_part),
+        ("w0", 5.0, worker_part),
+    ])
+    by_id = {s["span_id"]: s for s in merged}
+    assert by_id[2]["start_s"] == pytest.approx(100.0, abs=1e-9)
+    # and the clamp PROPAGATES down a chain in one pass
+    chain = [
+        _span("a", 10, None, 100.0, 10.0),
+        _span("b", 11, 10, 99.0, 5.0),
+        _span("c", 12, 11, 98.0, 2.0),
+    ]
+    merged = trace.merge_tier_spans([("x", 0.0, chain)])
+    by_id = {s["span_id"]: s for s in merged}
+    assert by_id[11]["start_s"] == by_id[12]["start_s"] == 100.0
+
+
+def test_event_instants_do_not_reparent_roots():
+    """Event instants carry span_id None; a root span's parent_id is
+    also None — the merge must not treat the instant as the root's
+    parent and clamp the root against it."""
+    part = [
+        {"name": "event:finish", "span_id": None, "parent_id": None,
+         "thread": None, "start_s": 150.0, "dur_ms": 0.0,
+         "instant": True, "attrs": {}},
+        _span("router.request", 1, None, 100.0, 10.0),
+    ]
+    merged = trace.merge_tier_spans([("router", 0.0, part)])
+    root = next(s for s in merged if s["span_id"] == 1)
+    assert root["start_s"] == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------
+# one stitched tier trace through a real Router
+# ---------------------------------------------------------------------
+
+class _RemoteWorker:
+    """Replica-protocol fake simulating a SEPARATE worker process: its
+    wall clock runs ``skew_s`` ahead, it adopts the trace context the
+    router stamps on ``submit`` (spans parented across the process
+    boundary, stamped on the SKEWED clock), and it serves those spans
+    back through ``trace_spans()`` exactly like an HTTP replica's
+    ``GET /v1/trace/<id>``."""
+
+    def __init__(self, name, skew_s):
+        self.name = name
+        self.skew_s = skew_s
+        self.slots = 2
+        self.max_new_cap = 16
+        self.page_size = 4
+        self.max_queue = 64
+        self.tokenizer = None
+        self.queue, self.running, self.finished = [], [], []
+        self.closed = False
+        self.is_draining = False
+        self.trace_ctxs = {}
+        self._spans = {}
+        self._next_sid = 1000
+
+        class _M:
+            @staticmethod
+            def events(rid):
+                return []
+
+        self.metrics = _M()
+
+    def bucket_of(self, plen):
+        return max(8, 1 << (max(1, int(plen)) - 1).bit_length())
+
+    def pages_needed(self, plen, max_new):
+        return -(-(plen + max_new - 1) // self.page_size)
+
+    def submit(self, ids, max_new, *, deadline_s=None, stream_cb=None,
+               request_id=None, stream_id=None, speculate=True,
+               trace_ctx=None):
+        if self.closed:
+            raise SchedulerClosed("scheduler is stopped")
+        if len(self.queue) >= self.max_queue:
+            raise QueueFull(len(self.queue), 0.05)
+        req = Request(prompt_ids=np.asarray(ids, np.int32),
+                      max_new_tokens=int(max_new),
+                      id=request_id or "", stream_cb=stream_cb)
+        req.stream_id = int(stream_id or 0) % self.slots
+        self.queue.append(req)
+        self.trace_ctxs[req.id] = trace_ctx
+        if trace_ctx:
+            now = time.time() + self.skew_s
+            sid = self._next_sid
+            self._next_sid += 2
+            tid = str(trace_ctx.get("trace_id", req.id))
+            self._spans[tid] = [
+                _span("serve.request", sid,
+                      trace_ctx.get("parent_span"), now, 5.0),
+                _span("serve.queue", sid + 1, sid, now + 0.001, 1.0),
+            ]
+        return req
+
+    def cancel(self, req):
+        if req in self.queue:
+            self.queue.remove(req)
+            req.finalize(RequestState.CANCELLED, "cancelled")
+            if req.stream_cb:
+                req.stream_cb(req, [], True)
+            return True
+        return False
+
+    def load_snapshot(self):
+        return {"queue_depth": len(self.queue),
+                "running": len(self.running),
+                "closed": self.closed or self.is_draining,
+                "draining": self.is_draining,
+                "kv_pages_free": 1 << 20,
+                "kv_pages_total": 1 << 20,
+                "wall_s": time.time() + self.skew_s}
+
+    def readiness(self):
+        return {"ready": not self.closed}
+
+    def health(self):
+        return {"failed": False, "closed": self.closed,
+                "draining": self.is_draining,
+                "wall_s": time.time() + self.skew_s}
+
+    def retry_after_s(self):
+        return 0.05
+
+    def metrics_snapshot(self):
+        return {}
+
+    def trace_spans(self, request_id):
+        return list(self._spans.get(str(request_id), []))
+
+    def start(self):
+        pass
+
+    def drain(self):
+        self.is_draining = True
+        self.closed = True
+
+    def stop(self, drain=True, timeout=0.0):
+        self.closed = True
+
+    def step(self):
+        while self.queue and len(self.running) < self.slots:
+            req = self.queue.pop(0)
+            req.state = RequestState.RUNNING
+            self.running.append(req)
+        for req in list(self.running):
+            toks = list(range(req.max_new_tokens))
+            req.tokens.extend(toks)
+            self.running.remove(req)
+            self.finished.append(req)
+            req.finalize(RequestState.DONE)
+            if req.stream_cb:
+                req.stream_cb(req, toks, True)
+
+    def idle(self):
+        return not self.queue and not self.running
+
+
+def test_cross_process_tier_trace_stitches_one_trace(tracer):
+    from tpuflow.serve.router import Router
+
+    trace.configure_sampling(head_n=1)
+    skew = 5.0
+    w = _RemoteWorker("w0", skew_s=skew)
+    router = Router([w])
+    router.maintain()  # probes carry the wall anchor -> offset noted
+    rr = router.submit(np.arange(1, 7, dtype=np.int32),
+                       max_new_tokens=4)
+    while not w.idle():
+        w.step()
+    assert rr.state.value == "done"
+    # the worker genuinely received the router's trace context
+    ctx = w.trace_ctxs[rr.id]
+    assert ctx and ctx["trace_id"] == rr.id
+
+    tt = router.tier_trace(rr.id)
+    assert tt["id"] == rr.id
+    sources = {s["source"] for s in tt["spans"]}
+    assert sources == {"router", "w0"}
+    root = next(s for s in tt["spans"] if s["name"] == "router.request")
+    wreq = next(s for s in tt["spans"] if s["name"] == "serve.request")
+    assert wreq["source"] == "w0"
+    # the parent/child edge crosses the process boundary
+    assert wreq["parent_id"] == root["span_id"]
+    # the 5s skew is corrected out: the remote span lands within the
+    # request's real wall window, not 5s in the future
+    assert abs(wreq["start_s"] - root["start_s"]) < 1.0
+    assert tt["clock_offset_s"]["w0"] == pytest.approx(skew, abs=0.5)
+    starts = [s["start_s"] for s in tt["spans"]]
+    assert starts == sorted(starts)
+    # and the flight-recorder bundle carries the tier view
+    fs = router.flight_snapshot()
+    assert rr.id in fs["trace"]["tier_traces"]
+    assert fs["trace"]["sampling"]["head_n"] == 1
+
+
+def test_head_dropped_request_stamps_no_context(tracer):
+    """A head-dropped request pays NO router spans and ships no
+    context — the <=2% place-overhead budget depends on it."""
+    from tpuflow.serve.router import Router
+
+    trace.configure_sampling(head_n=1 << 20)
+    w = _RemoteWorker("w0", skew_s=0.0)
+    router = Router([w])
+    rr = router.submit(np.arange(1, 7, dtype=np.int32),
+                       max_new_tokens=4)
+    while not w.idle():
+        w.step()
+    assert rr.state.value == "done"
+    assert w.trace_ctxs[rr.id] is None
+    assert not any(s["name"] == "router.request"
+                   for s in trace.spans_for(rr.id))
+
+
+# ---------------------------------------------------------------------
+# trace-report CLI
+# ---------------------------------------------------------------------
+
+def test_tier_timeline_and_trace_report_cli(tracer, tmp_path, capsys):
+    from tpuflow.obs.report import tier_timeline
+    from tpuflow.serve.router import Router
+
+    trace.configure_sampling(head_n=1)
+    w = _RemoteWorker("w0", skew_s=2.0)
+    router = Router([w])
+    router.maintain()
+    rr = router.submit(np.arange(1, 7, dtype=np.int32),
+                       max_new_tokens=4)
+    while not w.idle():
+        w.step()
+    tt = router.tier_trace(rr.id)
+
+    text = tier_timeline(tt)
+    assert f"tier trace {rr.id}" in text
+    assert "router" in text and "w0" in text
+    assert "router.request" in text and "serve.request" in text
+    assert "phase attribution" in text
+    assert "queue_wait" in text  # serve.queue classified via the map
+
+    import json
+
+    p = tmp_path / "tier_trace.json"
+    p.write_text(json.dumps(tt))
+    from tpuflow.cli.obs import main
+
+    assert main(["trace-report", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert f"tier trace {rr.id}" in out
+    assert "phase attribution" in out

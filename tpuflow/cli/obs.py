@@ -20,6 +20,14 @@ parsed through the same loader, :mod:`tpuflow.obs.report`)::
       the dump, gauge snapshot, in-flight serve requests. Given a dump
       ROOT directory, the newest bundle inside is shown.
 
+  python -m tpuflow.cli.obs trace-report <bundle|file|url>
+      per-phase text timeline of a MERGED tier trace (ISSUE 19): one
+      row per span across router + replicas in offset-corrected start
+      order with parent nesting and a phase-attribution footer. Takes
+      a router ``/v1/trace/<id>`` URL, a saved copy of that JSON, or a
+      flight-record bundle (renders the ``tier_traces`` the router
+      bundled).
+
   python -m tpuflow.cli.obs memreport <bundle-or-root>
       the memory-and-compile plane of a bundle (ISSUE 7): the
       device-buffer ledger (per-component bytes + peaks + untagged
@@ -37,6 +45,43 @@ from __future__ import annotations
 import argparse
 import sys
 from typing import List, Optional
+
+
+def _load_tier_traces(path: str) -> List[dict]:
+    """Resolve a ``trace-report`` operand into tier-trace dicts: a
+    router ``/v1/trace/<id>`` URL, a saved copy of that JSON, or a
+    flight-record bundle whose router provider bundled recent
+    ``tier_traces``."""
+    import json
+
+    if path.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(path, timeout=10) as r:
+            return [json.load(r)]
+    import os
+
+    if os.path.isdir(path):
+        from tpuflow.obs.flight import load
+
+        out = []
+        for name, sec in sorted(load(path).items()):
+            if not isinstance(sec, dict):
+                continue
+            tt = (sec.get("trace") or {}).get("tier_traces") \
+                if isinstance(sec.get("trace"), dict) else None
+            for rid, spans in sorted((tt or {}).items()):
+                out.append({"id": rid, "spans": spans,
+                            "clock_offset_s": sec["trace"].get(
+                                "clock_offset_s")})
+        return out
+    with open(path) as f:
+        obj = json.load(f)
+    if isinstance(obj, dict) and "spans" in obj:
+        return [obj]
+    tt = obj.get("tier_traces") if isinstance(obj, dict) else None
+    return [{"id": rid, "spans": spans}
+            for rid, spans in sorted((tt or {}).items())]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -57,12 +102,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                                  "newest bundle wins)")
     pp.add_argument("--spans", type=int, default=12,
                     help="how many of the last spans to show")
+    pc = sub.add_parser("trace-report",
+                        help="per-phase text timeline of a merged "
+                             "tier trace")
+    pc.add_argument("path", help="router /v1/trace/<id> URL, a saved "
+                                 "tier-trace JSON, or a flight bundle")
     pm = sub.add_parser("memreport",
                         help="memory-and-compile report of a bundle "
                              "(ledger + executables + KV sub-view)")
     pm.add_argument("path", help="bundle directory (or the dump root — "
                                  "newest bundle wins)")
     args = p.parse_args(argv)
+
+    if args.cmd == "trace-report":
+        from tpuflow.obs.report import tier_timeline
+
+        try:
+            traces = _load_tier_traces(args.path)
+        except (OSError, ValueError) as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        if not traces:
+            print(f"no tier traces under {args.path}", file=sys.stderr)
+            return 1
+        print("\n\n".join(tier_timeline(t) for t in traces))
+        return 0
 
     if args.cmd == "postmortem":
         from tpuflow.obs.flight import format_postmortem, load

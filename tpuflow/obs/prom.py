@@ -71,8 +71,33 @@ def split_replica(name: str):
     return name[:m.start()] + name[m.end():], m.group(1)
 
 
-def _label(rep, extra: str = "") -> str:
+#: SLO phase-attribution families (ISSUE 19): the per-phase member
+#: histograms ``<prefix>.req_phase_ms.<phase>`` /
+#: ``<prefix>.ttft_breakdown.<phase>`` fold into ONE family per
+#: metric with a ``phase="<name>"`` label — composing with the replica
+#: fold above, so a dashboard queries
+#: ``sum by (phase) (rate(serve_req_phase_ms_bucket[5m]))`` across the
+#: tier instead of regex-joining 6 metric names per replica.
+_PHASE_RE = re.compile(r"\.(req_phase_ms|ttft_breakdown)\.(\w+)$")
+
+
+def split_phase(name: str):
+    """``serve.req_phase_ms.queue_wait`` →
+    ``("serve.req_phase_ms", "queue_wait")``; names without a phase
+    member suffix pass through as ``(name, None)``."""
+    m = _PHASE_RE.search(name)
+    if m is None:
+        return name, None
+    return name[:m.start()] + "." + m.group(1), m.group(2)
+
+
+def _label(rep, extra: str = "", phase=None) -> str:
+    # label order is pinned (le, phase, replica): the golden tests —
+    # and any operator's recording rules — match rendered lines
+    # verbatim, so phase slots between the existing labels without
+    # moving them
     parts = [p for p in (extra,
+                         None if phase is None else f'phase="{phase}"',
                          None if rep is None else f'replica="{rep}"')
              if p]
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -121,27 +146,30 @@ def render(prefix: Optional[str] = None, stride: int = 8) -> str:
 
     def _families(d: Dict[str, object]) -> "Dict[str, list]":
         # fold serve.replica<i>.* members into one family per metric,
-        # keyed (replica_label, value); plain names stay label-free
+        # keyed (replica_label, phase_label, value); phase members
+        # (req_phase_ms.<ph> / ttft_breakdown.<ph>) fold the same way;
+        # plain names stay label-free
         fams: Dict[str, list] = {}
         for name in sorted(d):
             fam, rep = split_replica(name)
-            fams.setdefault(fam, []).append((rep, d[name]))
+            fam, ph = split_phase(fam)
+            fams.setdefault(fam, []).append((rep, ph, d[name]))
         return fams
 
     for fam, members in sorted(_families(scalars).items()):
         mn = metric_name(fam)
         lines.append(f"# HELP {mn} tpuflow gauge {fam}")
         lines.append(f"# TYPE {mn} gauge")
-        for rep, v in members:
-            lines.append(f"{mn}{_label(rep)} {_fmt(v)}")
+        for rep, ph, v in members:
+            lines.append(f"{mn}{_label(rep, phase=ph)} {_fmt(v)}")
     for fam, members in sorted(_families(cntrs).items()):
         mn = metric_name(fam)
         if not mn.endswith("_total"):
             mn += "_total"
         lines.append(f"# HELP {mn} tpuflow counter {fam}")
         lines.append(f"# TYPE {mn} counter")
-        for rep, v in members:
-            lines.append(f"{mn}{_label(rep)} {_fmt(v)}")
+        for rep, ph, v in members:
+            lines.append(f"{mn}{_label(rep, phase=ph)} {_fmt(v)}")
     bounds = bucket_bounds()
     # every stride-th bound STARTING AT THE FIRST: with the default
     # stride 8 on the 2**(1/8) grid that is exactly 1e-3 * 2^k — the
@@ -152,7 +180,7 @@ def render(prefix: Optional[str] = None, stride: int = 8) -> str:
         mn = metric_name(fam)
         lines.append(f"# HELP {mn} tpuflow histogram {fam}")
         lines.append(f"# TYPE {mn} histogram")
-        for rep, hist in members:
+        for rep, ph, hist in members:
             st = hist.state()
             cum = 0
             i0 = 0
@@ -164,12 +192,15 @@ def render(prefix: Optional[str] = None, stride: int = 8) -> str:
                 # 0.0020000000000000005) that would make every le
                 # label 17 digits of noise in dashboards
                 le = f'le="{bounds[bi]:.6g}"'
-                lines.append(f"{mn}_bucket{_label(rep, le)} {cum}")
+                lines.append(
+                    f"{mn}_bucket{_label(rep, le, phase=ph)} {cum}")
             cum += sum(st["counts"][i0:])
             le_inf = 'le="+Inf"'
-            lines.append(f"{mn}_bucket{_label(rep, le_inf)} {cum}")
-            lines.append(f"{mn}_sum{_label(rep)} {_fmt(st['total'])}")
-            lines.append(f"{mn}_count{_label(rep)} {st['n']}")
+            lines.append(
+                f"{mn}_bucket{_label(rep, le_inf, phase=ph)} {cum}")
+            lines.append(
+                f"{mn}_sum{_label(rep, phase=ph)} {_fmt(st['total'])}")
+            lines.append(f"{mn}_count{_label(rep, phase=ph)} {st['n']}")
     return "\n".join(lines) + "\n"
 
 

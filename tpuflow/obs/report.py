@@ -215,6 +215,99 @@ def format_report(bd: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# span-name → SLO phase for the tier timeline's attribution footer
+# (ISSUE 19). Router- and worker-side spans that cover the same wall
+# interval (router.prefill wraps the worker's serve.prefill_join) land
+# in the SAME phase, and the footer unions intervals per phase, so the
+# overlap does not double-count.
+_TIER_PHASE = {
+    "serve.queue": "queue_wait",
+    "router.prefill": "prefill",
+    "serve.prefill_join": "prefill",
+    "router.transfer": "transfer",
+    "router.pull": "transfer",
+    "serve.transfer_land": "transfer",
+    "serve.decode_segment": "decode",
+}
+
+
+def tier_timeline(trace: Dict[str, Any], width: int = 40) -> str:
+    """Render a merged tier trace (the ``/v1/trace/<id>`` payload /
+    ``Router.tier_trace`` result) as a per-phase text timeline: one row
+    per span in offset-corrected start order, indented by parent
+    nesting and tagged with its source process, a proportional bar over
+    the request's wall window, and a phase-attribution footer (interval
+    union per SLO phase, so parent/child overlap is not double-counted).
+    """
+    spans = list(trace.get("spans") or ())
+    if not spans:
+        return f"tier trace {trace.get('id')}: no spans (sampled out?)"
+    durs = [s for s in spans if not s.get("instant")]
+    insts = [s for s in spans if s.get("instant")]
+    t0 = min(float(s["start_s"]) for s in spans)
+    t1 = max(
+        (float(s["start_s"]) + float(s.get("dur_ms") or 0.0) / 1e3
+         for s in spans),
+        default=t0,
+    )
+    e2e_ms = max((t1 - t0) * 1e3, 1e-9)
+    by_id = {s["span_id"]: s for s in durs
+             if s.get("span_id") is not None}
+
+    def depth(s: Dict[str, Any]) -> int:
+        d, seen = 0, set()
+        while s.get("parent_id") in by_id and s["parent_id"] not in seen:
+            seen.add(s["parent_id"])
+            s = by_id[s["parent_id"]]
+            d += 1
+        return d
+
+    srcs = sorted({str(s.get("source") or "?") for s in spans})
+    off = trace.get("clock_offset_s") or {}
+    hdr = (f"tier trace {trace.get('id')} — {len(srcs)} source"
+           f"{'s' if len(srcs) != 1 else ''} ({', '.join(srcs)}) — "
+           f"{len(durs)} spans + {len(insts)} events, "
+           f"e2e {e2e_ms:.1f} ms")
+    lines = [hdr]
+    if off:
+        lines.append("  clock offsets vs router: " + ", ".join(
+            f"{k}={v * 1e3:+.3f} ms" for k, v in sorted(off.items())))
+    sw = max(len(s) for s in srcs)
+    for s in spans:
+        start_ms = (float(s["start_s"]) - t0) * 1e3
+        name = ("  " * depth(s) + s["name"]) if not s.get("instant") \
+            else ("  " + s["name"])
+        if s.get("instant"):
+            pos = min(width - 1, int(width * start_ms / e2e_ms))
+            bar = " " * pos + "·"
+            tail = f"@{start_ms:9.3f} ms"
+        else:
+            dur = float(s.get("dur_ms") or 0.0)
+            b0 = min(width - 1, int(width * start_ms / e2e_ms))
+            b1 = min(width, max(b0 + 1,
+                                int(width * (start_ms + dur) / e2e_ms)))
+            bar = " " * b0 + "=" * (b1 - b0)
+            tail = f"@{start_ms:9.3f} ms  {dur:9.3f} ms"
+        lines.append(f"  {str(s.get('source') or '?'):<{sw}} "
+                     f"|{bar:<{width}}| {tail}  {name}")
+    phases: Dict[str, List] = {}
+    for s in durs:
+        ph = _TIER_PHASE.get(s["name"]) or (s.get("attrs") or {}).get(
+            "phase")
+        if ph:
+            us0 = float(s["start_s"]) * 1e6
+            phases.setdefault(str(ph), []).append(
+                (us0, us0 + float(s.get("dur_ms") or 0.0) * 1e3))
+    if phases:
+        lines.append("  phase attribution (interval union):")
+        for ph, iv in sorted(phases.items(),
+                             key=lambda kv: -_union_ms(kv[1])):
+            ms = _union_ms(iv)
+            lines.append(f"    {ph:<12} {ms:9.3f} ms  "
+                         f"{100 * ms / e2e_ms:5.1f}%")
+    return "\n".join(lines)
+
+
 def top_spans(spans: Optional[List[Dict[str, Any]]] = None,
               top: int = 15) -> List[Dict[str, Any]]:
     """Per-name total/mean/count table, heaviest first — the host-span

@@ -52,21 +52,46 @@ import json
 import os
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 _LOCK = threading.Lock()
 _ENABLED = False  # fast-path flag: read unlocked on every span() call
 _RING: "collections.deque[Dict[str, Any]]" = collections.deque(maxlen=65536)
 # wall anchor: (time.time(), perf_counter_ns) at enable() — maps the
-# monotonic span clock onto epoch time for export/correlation
+# monotonic span clock onto epoch time for export/correlation. Export
+# paths prefer each record's OWN ``t0_wall`` (stamped at commit time);
+# the module anchor is only the fallback for records committed by an
+# older tracer build.
 _ANCHOR: Tuple[float, int] = (time.time(), time.perf_counter_ns())
-_IDS = itertools.count(1)
+# span/trace ids are seeded by pid so ids minted by DIFFERENT worker
+# processes never collide when the router merges a tier trace — within
+# one process the counter stays strictly increasing as before
+_IDS = itertools.count(((os.getpid() & 0x7FFF) << 40) + 1)
 # (trace_id, span_id) of the innermost open `with span(...)` in this
 # context; inherited by threads only through explicit begin(trace_id=)
 # or contextvars.copy_context (plain threading.Thread starts fresh)
 _CTX: "contextvars.ContextVar[Optional[Tuple[Any, int]]]" = (
     contextvars.ContextVar("tpuflow_trace_ctx", default=None)
 )
+
+# ---- bounded always-on sampling (ISSUE 19) --------------------------
+# head-sample 1-in-N (deterministic on the trace id, so the router and
+# every worker that adopts its trace context make the SAME decision
+# with no extra wire field) + tail-keep: a head-dropped request's spans
+# are buffered per trace and COMMITTED anyway when the request errors
+# or lands past the tail latency threshold / the windowed p95 — the
+# outliers are exactly the traces worth keeping at fleet rates.
+_SAMPLE_HEAD_N = 1  # 1 = trace everything (the pre-ISSUE-19 behavior)
+_SAMPLE_TAIL_SLOW_MS: Optional[float] = None
+_PENDING: "collections.OrderedDict[Any, List[Dict[str, Any]]]" = (
+    collections.OrderedDict()
+)
+_PENDING_MAX_TRACES = 256
+_PENDING_MAX_SPANS = 512
+# recent request latencies (kept AND dropped) — the tail-keep p95 base
+_LAT_WINDOW: "collections.deque[float]" = collections.deque(maxlen=512)
+_LAT_MIN_SAMPLES = 16
 
 
 class Span:
@@ -164,6 +189,8 @@ def is_enabled() -> bool:
 def clear() -> None:
     with _LOCK:
         _RING.clear()
+        _PENDING.clear()
+        _LAT_WINDOW.clear()
 
 
 # ---- span creation --------------------------------------------------
@@ -210,6 +237,11 @@ def end(s: Optional[Span], **attrs: Any) -> None:
         return  # disabled mid-span: drop rather than record a torn ring
     if attrs:
         s.attrs.update(attrs)
+    # per-span wall anchor, stamped at COMMIT time (ISSUE 19 satellite):
+    # a re-enable() mid-flight replaces the module anchor, so export
+    # must never map an old record through the new epoch — each record
+    # carries its own epoch start instead
+    wall0, pc0 = _ANCHOR
     rec = {
         "name": s.name,
         "trace": s.trace,
@@ -217,12 +249,18 @@ def end(s: Optional[Span], **attrs: Any) -> None:
         "parent": s.parent,
         "t0_ns": s.t0,
         "t1_ns": t1,
+        "t0_wall": wall0 + (s.t0 - pc0) / 1e9,
         "dur_ms": (t1 - s.t0) / 1e6,
         "tid": s.tid,
         "thread": s.thread,
         "attrs": s.attrs,
     }
     with _LOCK:
+        pend = _PENDING.get(s.trace)
+        if pend is not None:  # head-dropped trace: buffer for tail-keep
+            if len(pend) < _PENDING_MAX_SPANS:
+                pend.append(rec)
+            return
         _RING.append(rec)
 
 
@@ -252,7 +290,6 @@ def spans_for(trace_id: Any) -> List[Dict[str, Any]]:
     """JSON-safe spans of one trace (the ``/v1/trace/<request_id>``
     payload): durations in ms, start offsets relative to the wall
     anchor, attrs coerced to JSON scalars."""
-    wall0, pc0 = _ANCHOR
     out = []
     for s in snapshot(trace_id=trace_id):
         out.append({
@@ -260,11 +297,22 @@ def spans_for(trace_id: Any) -> List[Dict[str, Any]]:
             "span_id": s["span"],
             "parent_id": s["parent"],
             "thread": s["thread"],
-            "start_s": round(wall0 + (s["t0_ns"] - pc0) / 1e9, 6),
+            "start_s": round(_rec_wall(s), 6),
             "dur_ms": round(s["dur_ms"], 3),
             "attrs": {k: _jsonable(v) for k, v in s["attrs"].items()},
         })
     return out
+
+
+def _rec_wall(rec: Dict[str, Any]) -> float:
+    """Epoch start of one ring record — the record's own commit-time
+    anchor when present (always, since ISSUE 19), else the module
+    anchor (records from an older build)."""
+    w = rec.get("t0_wall")
+    if w is not None:
+        return float(w)
+    wall0, pc0 = _ANCHOR
+    return wall0 + (rec["t0_ns"] - pc0) / 1e9
 
 
 def phase_totals_ms(prefix: Optional[str] = None) -> Dict[str, float]:
@@ -299,7 +347,6 @@ def export_chrome_trace(path: str) -> str:
     thread) — loadable in Perfetto / ``chrome://tracing``, including
     side-by-side with a ``jax.profiler`` capture of the same run.
     Returns ``path``."""
-    wall0, pc0 = _ANCHOR
     pid = os.getpid()
     with _LOCK:
         spans = list(_RING)
@@ -314,7 +361,7 @@ def export_chrome_trace(path: str) -> str:
         events.append({"ph": "M", "name": "thread_name", "pid": pid,
                        "tid": tid, "args": {"name": tname}})
     for s in spans:
-        ts_us = (wall0 + (s["t0_ns"] - pc0) / 1e9) * 1e6
+        ts_us = _rec_wall(s) * 1e6
         args = {k: _jsonable(v) for k, v in s["attrs"].items()}
         args["trace_id"] = _jsonable(s["trace"])
         args["span_id"] = s["span"]
@@ -344,6 +391,191 @@ def export_chrome_trace(path: str) -> str:
     with open(tmp, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     os.replace(tmp, path)  # atomic: never a torn artifact
+    return path
+
+
+# ---- sampling (ISSUE 19) --------------------------------------------
+
+def configure_sampling(head_n: int = 1,
+                       tail_slow_ms: Optional[float] = None) -> None:
+    """Bounded always-on sampling: ``head_n`` keeps 1-in-N requests up
+    front (deterministic on the trace id, so every process in the tier
+    agrees); ``tail_slow_ms`` keeps a head-dropped request anyway when
+    it errors or finishes at/above the threshold — and, once the
+    latency window is warm, at/above the windowed p95 (outlier
+    tail-keep). ``head_n=1`` with ``tail_slow_ms=None`` is the
+    trace-everything default."""
+    global _SAMPLE_HEAD_N, _SAMPLE_TAIL_SLOW_MS
+    if int(head_n) < 1:
+        raise ValueError(f"head_n must be >= 1, got {head_n}")
+    with _LOCK:
+        _SAMPLE_HEAD_N = int(head_n)
+        _SAMPLE_TAIL_SLOW_MS = (
+            None if tail_slow_ms is None else float(tail_slow_ms))
+
+
+def sampling() -> Dict[str, Any]:
+    return {"head_n": _SAMPLE_HEAD_N,
+            "tail_slow_ms": _SAMPLE_TAIL_SLOW_MS}
+
+
+def head_sampled(trace_id: Any) -> bool:
+    """The head decision for one trace id — stable across processes
+    (crc32 of the id string), so a worker adopting the router's trace
+    context independently reaches the same verdict."""
+    n = _SAMPLE_HEAD_N
+    if n <= 1:
+        return True
+    return zlib.crc32(str(trace_id).encode()) % n == 0
+
+
+def begin_request(trace_id: Any) -> bool:
+    """Register one request with the sampler. Returns the head
+    decision; a head-dropped request's spans are buffered (bounded)
+    so :func:`finish_request` can still tail-keep them. No-op (False)
+    when the tracer is disabled."""
+    if not _ENABLED:
+        return False
+    if head_sampled(trace_id):
+        return True
+    with _LOCK:
+        if trace_id not in _PENDING:
+            _PENDING[trace_id] = []
+            while len(_PENDING) > _PENDING_MAX_TRACES:
+                _PENDING.popitem(last=False)
+    return False
+
+
+def finish_request(trace_id: Any, *, error: bool = False,
+                   latency_ms: Optional[float] = None) -> bool:
+    """Settle one request's sampling fate. Head-sampled requests are
+    already in the ring (returns True). Head-dropped requests are
+    COMMITTED anyway — tail-keep — when they errored, crossed the
+    configured ``tail_slow_ms``, or landed at/above the windowed p95;
+    otherwise their buffered spans drop. Every latency feeds the
+    outlier window either way."""
+    with _LOCK:
+        prior = list(_LAT_WINDOW) if _PENDING else []
+        if latency_ms is not None:
+            _LAT_WINDOW.append(float(latency_ms))
+        pend = _PENDING.pop(trace_id, None)
+        if pend is None:
+            return _ENABLED
+        keep = bool(error)
+        if not keep and latency_ms is not None:
+            thr = _SAMPLE_TAIL_SLOW_MS
+            if thr is not None and latency_ms >= thr:
+                keep = True
+            elif len(prior) >= _LAT_MIN_SAMPLES:
+                prior.sort()
+                p95 = prior[min(len(prior) - 1,
+                                int(0.95 * (len(prior) - 1) + 0.5))]
+                keep = latency_ms >= p95
+        if keep:
+            _RING.extend(pend)
+        return keep
+
+
+# ---- cross-process clock alignment + tier merge (ISSUE 19) ----------
+
+def wall_anchor() -> Dict[str, float]:
+    """This process's wall anchor — shipped in ``load_snapshot()`` /
+    ``health()`` so the router can estimate the per-replica clock
+    offset from the probe's RTT midpoint."""
+    return {"wall_s": time.time()}
+
+
+def merge_tier_spans(
+    parts: List[Tuple[str, float, List[Dict[str, Any]]]],
+) -> List[Dict[str, Any]]:
+    """Merge per-process :func:`spans_for` payloads into ONE tier
+    timeline: ``parts`` is ``[(source, offset_s, spans)]`` where
+    ``offset_s`` is the source clock minus the merger's clock (the
+    router's RTT-midpoint estimate). Each span's ``start_s`` is
+    offset-corrected into the merger's epoch, then clamped so a child
+    never starts before its parent — residual skew below the estimate's
+    error bound cannot produce a non-monotone parent/child edge."""
+    merged: List[Dict[str, Any]] = []
+    for source, offset_s, spans in parts:
+        for s in spans or []:
+            c = dict(s)
+            c["source"] = source
+            c["start_s"] = round(float(s["start_s"]) - float(offset_s), 6)
+            merged.append(c)
+    # event instants carry span_id None — keep them out of the id map
+    # so a root span (parent_id None) never "finds" an instant as its
+    # parent and gets clamped against it
+    by_id = {s["span_id"]: s for s in merged
+             if s.get("span_id") is not None}
+    # clamp parent-first (memoized walk up the parent chain): start
+    # order is NOT topological here — an over-corrected part can put a
+    # whole subtree before its cross-source parent, and a child must
+    # clamp against its parent's CLAMPED start, not the raw one
+    resolved = set()
+
+    def _clamp(s):
+        sid = s.get("span_id")
+        if sid in resolved:
+            return s["start_s"]
+        if sid is not None:
+            # marked before the parent walk: a malformed parent cycle
+            # short-circuits instead of recursing forever
+            resolved.add(sid)
+        pid = s.get("parent_id")
+        p = by_id.get(pid) if pid is not None else None
+        if p is not None and p is not s:
+            ps = _clamp(p)
+            if s["start_s"] < ps:
+                s["start_s"] = ps
+        return s["start_s"]
+
+    for s in merged:
+        _clamp(s)
+    merged.sort(key=lambda s: (s["start_s"], s["span_id"] or 0))
+    return merged
+
+
+def export_chrome_spans(path: str, spans: List[Dict[str, Any]],
+                        label: str = "tpuflow tier trace") -> str:
+    """Write merged :func:`spans_for`-shaped spans (``start_s`` epoch
+    seconds, ``dur_ms``) as Chrome trace-event JSON — one pid track per
+    ``source`` so a tier trace renders router and replicas side by
+    side. Returns ``path``."""
+    sources: List[str] = []
+    for s in spans:
+        src = str(s.get("source", "local"))
+        if src not in sources:
+            sources.append(src)
+    events: List[Dict[str, Any]] = []
+    for pid, src in enumerate(sources, start=1):
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "args": {"name": f"{label}: {src}"}})
+    for s in spans:
+        pid = sources.index(str(s.get("source", "local"))) + 1
+        args = {k: _jsonable(v)
+                for k, v in (s.get("attrs") or {}).items()}
+        args["span_id"] = s["span_id"]
+        if s.get("parent_id") is not None:
+            args["parent_id"] = s["parent_id"]
+        ev: Dict[str, Any] = {
+            "ph": "X", "name": s["name"], "cat": "tpuflow",
+            "pid": pid, "tid": 1,
+            "ts": round(float(s["start_s"]) * 1e6, 3),
+            "dur": round(float(s["dur_ms"]) * 1e3, 3),
+            "args": args,
+        }
+        if s.get("instant"):
+            ev["ph"] = "i"
+            ev["s"] = "t"
+            ev.pop("dur")
+        events.append(ev)
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, path)
     return path
 
 

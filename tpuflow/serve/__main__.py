@@ -242,6 +242,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="enable the tpuflow.obs.trace span tracer "
                         "(request ids become trace ids; inspect via "
                         "GET /v1/trace/<id>)")
+    p.add_argument("--trace-sample", type=int, default=None,
+                   metavar="N",
+                   help="with --trace-spans: head-sample 1-in-N "
+                        "requests for full span recording (default 1 "
+                        "= every request). The hash is over the "
+                        "request id, so the router and every worker "
+                        "vote identically per request")
+    p.add_argument("--trace-tail-slow-ms", type=float, default=None,
+                   metavar="MS",
+                   help="with --trace-spans: tail-keep head-dropped "
+                        "traces whose request errored or whose "
+                        "latency is >= MS or >= the windowed p95 — "
+                        "the outliers you want are kept even at a "
+                        "low head rate")
     p.add_argument("--stall-timeout", type=float, default=None,
                    metavar="S",
                    help="arm the stall watchdog: trip (latched; fail "
@@ -333,10 +347,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             p.error(f"--ring-prefill must be a power of two in "
                     f"[2, 8], got {n}")
 
+    if args.trace_sample is not None and not args.trace_spans:
+        p.error("--trace-sample requires --trace-spans")
+    if args.trace_tail_slow_ms is not None and not args.trace_spans:
+        p.error("--trace-tail-slow-ms requires --trace-spans")
     if args.trace_spans:
         from tpuflow.obs import trace as _trace
 
         _trace.enable()
+        if (args.trace_sample is not None
+                or args.trace_tail_slow_ms is not None):
+            _trace.configure_sampling(
+                head_n=args.trace_sample or 1,
+                tail_slow_ms=args.trace_tail_slow_ms)
     # SIGTERM channel FIRST (train/preempt.py): the flag handler must
     # be innermost so flight.install (which CHAINS the previous
     # handler) dumps its bundle and then still flips the drain flag

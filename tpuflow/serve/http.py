@@ -33,9 +33,14 @@ Endpoints::
   GET  /metrics       Prometheus/OpenMetrics text exposition of the
                       whole gauge registry (tpuflow.obs.prom)
   GET  /v1/events/ID  structured event log for one request id
-  GET  /v1/trace/ID   host spans of one request (trace id == request
-                      id — tpuflow.obs.trace; [] unless the tracer is
+  GET  /v1/trace/ID   spans of one request (trace id == request id —
+                      tpuflow.obs.trace; [] unless the tracer is
                       enabled: TPUFLOW_TRACE_SPANS=1 or --trace-spans)
+                      merged with the event log as instant events
+                      (ISSUE 19) — and when this frontend serves a
+                      Router, the TIER view: spans fanned out from
+                      every replica that touched the request, clock-
+                      offset corrected and merged into one timeline
   GET  /healthz       LIVENESS: {"ok": true, ...} whenever the process
                       answers — never consults scheduler progress
   GET  /readyz        READINESS: 200 only while the scheduler is open,
@@ -222,9 +227,29 @@ class _Handler(BaseHTTPRequestHandler):
             from tpuflow.obs import trace
 
             rid = self.path[len("/v1/trace/"):]
+            if hasattr(sched, "tier_trace"):
+                # router frontend (ISSUE 19): fan out to every replica
+                # that touched this request and return ONE merged,
+                # offset-corrected tier trace
+                return self._json(200, sched.tier_trace(rid))
+            spans = trace.spans_for(rid)
+            # merge the structured event log as instant events (ISSUE
+            # 19 satellite): one endpoint tells the full per-replica
+            # story — spans for durations, events for the lifecycle
+            # edges (submit/admit/first_token/finish) between them
+            for ev in sched.metrics.events(rid):
+                attrs = {k: v for k, v in ev.items()
+                         if k not in ("ts", "event")}
+                spans.append({
+                    "name": f"event:{ev.get('event')}",
+                    "span_id": None, "parent_id": None, "thread": None,
+                    "start_s": round(float(ev.get("ts", 0.0)), 6),
+                    "dur_ms": 0.0, "instant": True, "attrs": attrs,
+                })
+            spans.sort(key=lambda s: s["start_s"])
             self._json(200, {"id": rid,
                              "tracer_enabled": trace.is_enabled(),
-                             "spans": trace.spans_for(rid)})
+                             "spans": spans})
         else:
             self._json(404, {"error": f"no route {self.path}"})
 
@@ -332,6 +357,8 @@ class _Handler(BaseHTTPRequestHandler):
                 kw["deadline_s"] = float(body["deadline_s"])
             if body.get("id"):
                 kw["request_id"] = str(body["id"])
+            if isinstance(body.get("trace_ctx"), dict):
+                kw["trace_ctx"] = dict(body["trace_ctx"])
             req = sched.submit_prefill(prompt, **kw)
             timeout = float(body.get("timeout_s")
                             or self.server.request_timeout_s)
@@ -352,10 +379,13 @@ class _Handler(BaseHTTPRequestHandler):
             wire = body.get("wire")
             if not isinstance(wire, dict):
                 raise ValueError("offer_chain needs a 'wire' object")
+            tctx = (dict(body["trace_ctx"])
+                    if isinstance(body.get("trace_ctx"), dict) else None)
             tid = sched.offer_chain(
                 wire_from_json(wire),
                 transfer_id=body.get("transfer_id"),
-                last=bool(body.get("last", True)))
+                last=bool(body.get("last", True)),
+                trace_ctx=tctx)
             return self._json(200, {"transfer_id": tid, "ok": True})
         if self.path == "/v1/worker/fetch_chain":
             # directory pull donor (ISSUE 16): answer with this
@@ -433,6 +463,11 @@ class _Handler(BaseHTTPRequestHandler):
             kwargs["speculate"] = bool(body["speculate"])
         if body.get("await_transfer") is not None:
             kwargs["await_transfer"] = str(body["await_transfer"])
+        if isinstance(body.get("trace_ctx"), dict):
+            # distributed-trace adoption (ISSUE 19): the router's
+            # trace id / parent span ride the RPC so this worker's
+            # spans join the SAME trace the router opened
+            kwargs["trace_ctx"] = dict(body["trace_ctx"])
         timeout = float(body.get("timeout_s")
                         or self.server.request_timeout_s)
         events: "queue.Queue" = queue.Queue()

@@ -37,6 +37,20 @@ from tpuflow.obs.gauges import (
 from tpuflow.serve.request import Request
 
 
+# SLO phase-attribution vector (ISSUE 19): every finished request folds
+# its stamped timeline into exactly these phases (Request.phases()), so
+# the per-phase histograms partition e2e latency — summing the phase
+# means reconstructs the mean e2e, and a fault in one stage (slow
+# transfer wire, placement stall) shows up as ITS phase dominating.
+PHASES = ("queue_wait", "place", "transfer", "prefill",
+          "first_decode", "decode_steady")
+# The pre-first-token subset: these phases partition TTFT the same way
+# (serve.ttft_breakdown.* — the sensor ROADMAP item 3's control loop
+# reads to learn WHICH phase is burning the TTFT budget).
+TTFT_PHASES = ("queue_wait", "place", "transfer", "prefill",
+               "first_decode")
+
+
 def percentiles(values: List[float],
                 pcts=(50.0, 95.0, 99.0)) -> Dict[str, float]:
     """EXACT nearest-rank percentiles of a concrete sample list, keyed
@@ -131,6 +145,23 @@ class ServeMetrics:
         # buckets, /v1/metrics windowed percentiles, load_snapshot()
         self.kv_transfer_ms = register_histogram(
             f"{gauge_prefix}.kv_transfer_ms", Histogram())
+        # SLO phase attribution (ISSUE 19): one histogram per phase of
+        # the fixed vector — every finished request observes into ALL
+        # of them (0ms when a phase didn't apply), so the per-phase
+        # counts stay aligned and the families partition e2e / TTFT.
+        # Registered like the others: Prometheus buckets (folded under
+        # a phase= label by obs/prom.py), windowed /v1/metrics
+        # percentiles, and load_snapshot() p95s for the router.
+        self.phase_hists = {
+            ph: register_histogram(
+                f"{gauge_prefix}.req_phase_ms.{ph}", Histogram())
+            for ph in PHASES
+        }
+        self.ttft_breakdown = {
+            ph: register_histogram(
+                f"{gauge_prefix}.ttft_breakdown.{ph}", Histogram())
+            for ph in TTFT_PHASES
+        }
         self.tokens_out = 0
         self.segments = 0
         self.segment_live_rows = 0
@@ -255,6 +286,18 @@ class ServeMetrics:
         inc_counter(f"{self.prefix}.requests_{req.state.value}_total")
         self.event(req.id, "finish", state=req.state.value,
                    n_tokens=len(req.tokens), error=req.error, **t)
+
+    def on_phases(self, req: Request) -> None:
+        """Fold a finished request's stamped timeline into the fixed
+        SLO phase vector (ISSUE 19). Called by the scheduler right
+        after the terminal transition stamps ``ts_done`` — by
+        construction the observed phases sum to the client-observed
+        e2e latency exactly (see :meth:`Request.phases`)."""
+        ph = req.phases()
+        for name, hist in self.phase_hists.items():
+            hist.observe(ph[name])
+        for name, hist in self.ttft_breakdown.items():
+            hist.observe(ph[name])
 
     def on_segment(self, live_rows: int, slot_rows: int) -> None:
         with self._lock:
@@ -650,4 +693,19 @@ class ServeMetrics:
                 m[f"{self.prefix}.{name}_{pk}"] = round(pv, 3)
             for pk, pv in cum.items():
                 m[f"{self.prefix}.{name}_{pk}_cum"] = round(pv, 3)
+        # SLO phase attribution (ISSUE 19): windowed percentiles per
+        # phase of the two breakdown families. Primary-keys-only (no
+        # _cum mirror) — 11 member histograms would double the
+        # snapshot's key count for a view the Prometheus buckets
+        # already carry cumulatively.
+        for fam, hists in (("req_phase_ms", self.phase_hists),
+                           ("ttft_breakdown", self.ttft_breakdown)):
+            for phname, hist in hists.items():
+                cum = hist.percentiles()
+                if not cum:
+                    continue  # no finished requests yet
+                win = windowed.get(f"{self.prefix}.{fam}.{phname}")
+                prim = (win["percentiles"] if win else {}) or cum
+                for pk, pv in prim.items():
+                    m[f"{self.prefix}.{fam}.{phname}_{pk}"] = round(pv, 3)
         return m

@@ -115,19 +115,31 @@ class PageStoreDry(PageWireError):
     from "the store is merely full right now — keep the chain"."""
 
 
-def split_chain(wire: Dict[str, Any],
-                chunk_pages: int) -> List[Dict[str, Any]]:
+def split_chain(wire: Dict[str, Any], chunk_pages: int,
+                trace_ctx: Optional[Dict[str, Any]] = None,
+                ) -> List[Dict[str, Any]]:
     """Split one :meth:`PagedKV.export_chain` wire into transferable
     chunks of at most ``chunk_pages`` pages each. Every chunk carries
     the token PREFIX through its own end (the radix path the importer
     needs) plus only its own page payloads (``first_page`` says where
     they sit in the chain), so chunks stream independently and land
     one scheduler boundary at a time — the transfer-overlap half of
-    the disaggregation story."""
+    the disaggregation story.
+
+    ``trace_ctx`` (ISSUE 19) stamps distributed-trace metadata
+    (``{"trace_id": ..., "parent_span": ...}``) onto every chunk under
+    the ``trace`` key: the importer's landing spans join the sender's
+    trace. The key is ignored by header validation and rides the JSON
+    codec unchanged — wires from older builds simply lack it."""
     n = int(wire["n_pages"])
     cp = max(1, int(chunk_pages))
     if n <= cp:
-        return [wire] if n else []
+        if not n:
+            return []
+        if trace_ctx is not None:
+            wire = dict(wire)
+            wire["trace"] = dict(trace_ctx)
+        return [wire]
     ps = int(wire["page_size"])
     out = []
     for s in range(0, n, cp):
@@ -141,6 +153,8 @@ def split_chain(wire: Dict[str, Any],
             payloads=wire["payloads"][s:e],
             crc32=wire["crc32"][s:e],
         )
+        if trace_ctx is not None:
+            ch["trace"] = dict(trace_ctx)
         out.append(ch)
     return out
 

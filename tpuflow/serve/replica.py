@@ -81,6 +81,15 @@ class Replica:
     def health(self) -> Dict[str, Any]:
         raise NotImplementedError
 
+    def trace_spans(self, request_id: str):
+        """Per-replica spans of one trace for the router's tier-trace
+        fan-out (ISSUE 19). ``None`` means this replica records into
+        the ROUTER's own process-wide span ring (in-process replicas)
+        — its spans are already in the router's local view and fanning
+        out would double-count them. Out-of-process backends return
+        the replica-local span list instead."""
+        return None
+
 
 class InProcessReplica(Replica):
     """One in-process :class:`ServeScheduler` behind the replica
@@ -101,12 +110,13 @@ class InProcessReplica(Replica):
                request_id: Optional[str] = None,
                stream_id: Optional[int] = None,
                speculate: bool = True,
-               await_transfer: Optional[str] = None) -> Request:
+               await_transfer: Optional[str] = None,
+               trace_ctx: Optional[Dict[str, Any]] = None) -> Request:
         return self.sched.submit(
             prompt, max_new_tokens, deadline_s=deadline_s,
             stream_cb=stream_cb, request_id=request_id,
             stream_id=stream_id, speculate=speculate,
-            await_transfer=await_transfer,
+            await_transfer=await_transfer, trace_ctx=trace_ctx,
         )
 
     def cancel(self, request) -> bool:
@@ -120,15 +130,18 @@ class InProcessReplica(Replica):
     def submit_prefill(self, prompt, *,
                        deadline_s: Optional[float] = None,
                        stream_cb: Optional[Callable] = None,
-                       request_id: Optional[str] = None) -> Request:
+                       request_id: Optional[str] = None,
+                       trace_ctx: Optional[Dict[str, Any]] = None
+                       ) -> Request:
         return self.sched.submit_prefill(
             prompt, deadline_s=deadline_s, stream_cb=stream_cb,
-            request_id=request_id)
+            request_id=request_id, trace_ctx=trace_ctx)
 
     def offer_chain(self, wire, *, transfer_id: Optional[str] = None,
-                    last: bool = True) -> str:
+                    last: bool = True,
+                    trace_ctx: Optional[Dict[str, Any]] = None) -> str:
         return self.sched.offer_chain(wire, transfer_id=transfer_id,
-                                      last=last)
+                                      last=last, trace_ctx=trace_ctx)
 
     def fail_transfer(self, transfer_id: str,
                       reason: str = "transfer failed") -> None:
@@ -368,7 +381,8 @@ class HTTPReplica(Replica):
                request_id: Optional[str] = None,
                stream_id: Optional[int] = None,
                speculate: bool = True,
-               await_transfer: Optional[str] = None) -> Request:
+               await_transfer: Optional[str] = None,
+               trace_ctx: Optional[Dict[str, Any]] = None) -> Request:
         ids = self._encode_prompt(prompt)
         if max_new_tokens is None:
             max_new_tokens = self.max_new_cap
@@ -385,6 +399,8 @@ class HTTPReplica(Replica):
             body["stream_id"] = int(stream_id)
         if await_transfer is not None:
             body["await_transfer"] = str(await_transfer)
+        if trace_ctx:  # distributed-trace context (ISSUE 19)
+            body["trace_ctx"] = dict(trace_ctx)
         conn, resp = self._open("POST", "/v1/worker/submit", body)
         if resp.status != 200:
             try:
@@ -475,7 +491,9 @@ class HTTPReplica(Replica):
     def submit_prefill(self, prompt, *,
                        deadline_s: Optional[float] = None,
                        stream_cb: Optional[Callable] = None,
-                       request_id: Optional[str] = None) -> Request:
+                       request_id: Optional[str] = None,
+                       trace_ctx: Optional[Dict[str, Any]] = None
+                       ) -> Request:
         """Run a prefill-only request on the worker and mirror its
         exported wire back (``shadow.export``); the blocking HTTP call
         rides a background thread so the caller (the router, possibly
@@ -495,6 +513,8 @@ class HTTPReplica(Replica):
                     "id": shadow.id,
                     **({"deadline_s": float(deadline_s)}
                        if deadline_s is not None else {}),
+                    **({"trace_ctx": dict(trace_ctx)}
+                       if trace_ctx else {}),
                 })
                 if out.get("wire") is not None:
                     shadow.export = wire_from_json(out["wire"])
@@ -515,12 +535,14 @@ class HTTPReplica(Replica):
         return shadow
 
     def offer_chain(self, wire, *, transfer_id: Optional[str] = None,
-                    last: bool = True) -> str:
+                    last: bool = True,
+                    trace_ctx: Optional[Dict[str, Any]] = None) -> str:
         from tpuflow.serve.pages import wire_to_json
 
         out = self._post_json("/v1/worker/offer_chain", {
             "transfer_id": transfer_id, "last": bool(last),
             "wire": wire_to_json(wire),
+            **({"trace_ctx": dict(trace_ctx)} if trace_ctx else {}),
         })
         return str(out["transfer_id"])
 
@@ -615,6 +637,17 @@ class HTTPReplica(Replica):
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         return self._get_json("/v1/metrics")
+
+    def trace_spans(self, request_id: str):
+        """Tier-trace fan-out donor (ISSUE 19): this worker's spans
+        (+ event-log instants) for one trace id — the replica-local
+        ``/v1/trace/<id>`` body. An unreachable worker contributes
+        nothing rather than failing the whole tier view."""
+        try:
+            return list(self._get_json(
+                f"/v1/trace/{request_id}").get("spans", ()))
+        except Exception:
+            return []
 
     # ---- shape facts -------------------------------------------------
     def bucket_of(self, prompt_len: int) -> int:

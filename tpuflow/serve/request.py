@@ -112,6 +112,39 @@ class Request:
     # per-row ITL delta (serve.itl_ms, ISSUE 13); scheduler-stamped
     ts_last_tokens: Optional[float] = None
     ts_done: Optional[float] = None
+    # SLO phase-attribution stamps (ISSUE 19): when the awaited
+    # inbound transfer settled (landed OR failed — either way the
+    # request stops charging the transfer phase), and when the prompt
+    # pass finished (chunked or atomic) — the prefill/first-decode
+    # boundary. None collapses the phase into its neighbor.
+    ts_transfer: Optional[float] = None
+    ts_prefill_done: Optional[float] = None
+
+    def phases(self) -> Dict[str, float]:
+        """The fixed SLO phase vector (ms): adjacent differences over
+        the stamped timeline arrival → transfer settled → admitted →
+        prefill done → first token → done, each clamped ≥ 0 — so the
+        phases SUM to the client-observed e2e latency exactly (the
+        attribution identity the tier's breakdown histograms pin).
+        ``place`` is the router's phase, 0 at the replica."""
+        t_arr = self.ts_arrival
+        t_done = self.ts_done if self.ts_done is not None else t_arr
+
+        def clamp(t, lo, hi):
+            return lo if t is None else min(max(t, lo), hi)
+
+        t_tx = clamp(self.ts_transfer, t_arr, t_done)
+        t_adm = clamp(self.ts_admitted, t_tx, t_done)
+        t_pf = clamp(self.ts_prefill_done, t_adm, t_done)
+        t_ft = clamp(self.ts_first_token, t_pf, t_done)
+        return {
+            "transfer": (t_tx - t_arr) * 1e3,
+            "queue_wait": (t_adm - t_tx) * 1e3,
+            "place": 0.0,
+            "prefill": (t_pf - t_adm) * 1e3,
+            "first_decode": (t_ft - t_pf) * 1e3,
+            "decode_steady": (t_done - t_ft) * 1e3,
+        }
 
     _done_event: threading.Event = field(default_factory=threading.Event,
                                          repr=False)

@@ -66,12 +66,13 @@ from __future__ import annotations
 import heapq
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from concurrent import futures as _futures
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tpuflow.obs import trace as _trace
 from tpuflow.serve.pages import chunk_keys
 from tpuflow.serve.request import (
     QueueFull,
@@ -167,6 +168,15 @@ class RouterRequest:
         # 'landing' (claimed by completion/abort) → 'decode' (chunks
         # shipped). Aborts release the inner via fail_transfer.
         self._transfer: Optional[Dict[str, Any]] = None
+        # distributed tracing (ISSUE 19): the router's root span
+        # (ended at the terminal) and the trace context stamped into
+        # every worker RPC for this request. Both None when tracing is
+        # off OR the request is head-dropped — the router pays span
+        # costs only for sampled requests (the hot-path overhead
+        # budget); a tail-kept trace recovers the REPLICA spans, which
+        # buffer regardless of the head decision.
+        self._tspan = None
+        self._tctx: Optional[Dict[str, Any]] = None
 
     # ---- wiring (router-owned) --------------------------------------
     def _make_cb(self) -> Callable:
@@ -652,6 +662,19 @@ class Router:
         # fraction per replica, 0.0 for dense replicas (always cool)
         self._moe_hot: List[float] = [0.0] * n_rep
         self.expert_hot_threshold = float(expert_hot_threshold)
+        # cross-process clock alignment (ISSUE 19): per-replica wall
+        # offset (replica clock MINUS router clock) estimated from the
+        # RTT midpoint of any probe whose reply carries a ``wall_s``
+        # anchor (load_snapshot / health). |error| <= rtt/2, so the
+        # sample behind the current estimate keeps its RTT
+        # (_wall_rtt) as the quality bound and a one-off stalled
+        # probe cannot displace a tighter estimate (see _note_wall).
+        self._wall_off: List[float] = [0.0] * n_rep
+        self._wall_rtt: List[float] = [float("inf")] * n_rep
+        self._wall_ts: List[float] = [0.0] * n_rep
+        # recently traced (head-sampled) request ids — the flight
+        # recorder's tier-trace bundle reads these (bounded)
+        self._recent_traced: "deque[str]" = deque(maxlen=8)
         self._heap: List[Tuple[int, int, int, int]] = []
         self._free_heap: List[Tuple[int, int, int]] = []
         self._agg_depth = 0
@@ -686,11 +709,37 @@ class Router:
 
     # ---- small helpers ----------------------------------------------
     def _safe_snapshot(self, idx: int) -> Dict[str, Any]:
+        t0 = time.time()
         try:
-            return self.replicas[idx].load_snapshot()
+            snap = self.replicas[idx].load_snapshot()
         except Exception:
             self._count("snapshot_errors")
             return {"queue_depth": 0, "running": 0, "closed": True}
+        self._note_wall(idx, t0, time.time(), snap.get("wall_s"))
+        return snap
+
+    def _note_wall(self, idx: int, t0: float, t1: float,
+                   wall_s: Any) -> None:
+        """Fold one probe's wall anchor into the per-replica clock-
+        offset estimate (ISSUE 19): the reply's ``wall_s`` was stamped
+        somewhere inside [t0, t1] on the router's clock, so the RTT
+        midpoint bounds the offset error by rtt/2. Best-RTT-wins with
+        aging: a sample looser than 2x the current bound is noise
+        unless the estimate has gone stale (120s)."""
+        if wall_s is None:
+            return
+        try:
+            wall_s = float(wall_s)
+        except (TypeError, ValueError):
+            return
+        rtt = max(0.0, t1 - t0)
+        now = time.monotonic()
+        with self._idx_lock:
+            if (rtt <= self._wall_rtt[idx] * 2.0
+                    or now - self._wall_ts[idx] > 120.0):
+                self._wall_off[idx] = wall_s - (t0 + t1) / 2.0
+                self._wall_rtt[idx] = rtt
+                self._wall_ts[idx] = now
 
     def _count(self, key: str, by: int = 1) -> None:
         from tpuflow.obs.gauges import inc_counter
@@ -1412,6 +1461,21 @@ class Router:
             # the PR 8 replica signature (duck-typed backends/fakes)
             extra = ({"await_transfer": await_tid}
                      if await_tid is not None else {})
+            # distributed tracing (ISSUE 19): spans + wire context only
+            # for head-sampled requests — the 15-in-16 majority pays
+            # one flag read and one crc32 (the <=2% place-p50 budget)
+            if _trace.is_enabled() and _trace.head_sampled(rid):
+                sp = _trace.begin(
+                    "router.request", trace_id=rid, bucket=bucket,
+                    prompt_tokens=int(ids.size),
+                    max_new_tokens=int(max_new_tokens))
+                if sp is not None:  # disabled in the begin race
+                    rr._tspan = sp
+                    rr._tctx = {"trace_id": rid,
+                                "parent_span": sp.span}
+                    extra["trace_ctx"] = rr._tctx
+                    with self._lock:
+                        self._recent_traced.append(rid)
             for idx in candidates:
                 rep = self.replicas[idx]
                 cb = rr._make_cb()
@@ -1475,6 +1539,9 @@ class Router:
         # is the drain contract's 503 (go elsewhere), NOT a 429
         # (retry here) — a 429 would tell the LB to retry into a
         # draining tier.
+        if rr._tspan is not None:
+            _trace.end(rr._tspan, rejected=True)
+            rr._tspan = rr._tctx = None
         if last_qf is None and saw_closed:
             raise SchedulerClosed("every replica is draining or closed")
         retry = self._min_retry(retry_pool())
@@ -1528,6 +1595,11 @@ class Router:
     def _on_request_done(self, rr: RouterRequest) -> None:
         with self._lock:
             self._inflight.pop(rr.id, None)
+        if rr._tspan is not None:
+            _trace.end(rr._tspan, state=rr.state.value,
+                       replica=rr._replica_idx,
+                       resubmits=rr.resubmits)
+            rr._tspan = None
 
     # ---- prefill/decode transfers (ISSUE 14) ------------------------
     def _begin_transfer(self, rr: RouterRequest,
@@ -1563,6 +1635,24 @@ class Router:
             if finished:
                 self._finish_transfer(rr, inner)
 
+        # tracing (ISSUE 19): the prefill leg gets its own child span
+        # under the router root; its trace context rides the RPC with
+        # trace_id = the REQUEST id (overriding the worker-side
+        # ``{rid}.pf`` request id), so the prefill worker's spans join
+        # the same trace. Conditional kwarg: untraced tiers keep the
+        # PR 14 replica signature.
+        pf_span = None
+        pf_kw: Dict[str, Any] = {}
+        if rr._tctx is not None:
+            pf_span = _trace.begin(
+                "router.prefill", trace_id=rr.id,
+                parent_id=rr._tctx.get("parent_span"))
+            if pf_span is not None:
+                pf_kw["trace_ctx"] = {"trace_id": rr.id,
+                                      "parent_span": pf_span.span}
+                with rr._lock:
+                    if rr._transfer is not None:
+                        rr._transfer["span"] = pf_span
         for idx in order:
             rep = self.replicas[idx]
             with rr._lock:
@@ -1571,7 +1661,7 @@ class Router:
             try:
                 pf_req = rep.submit_prefill(
                     rr.prompt_ids, stream_cb=on_pf,
-                    request_id=f"{rr.id}.pf")
+                    request_id=f"{rr.id}.pf", **pf_kw)
             except Exception:
                 continue
             with rr._lock:
@@ -1607,17 +1697,49 @@ class Router:
                 rr, f"prefill failed: "
                     f"{pf_req.error or pf_req.state.value}")
         rep = self.replicas[d_idx]
+        # tracing (ISSUE 19): the wire leg is a CHILD of the prefill
+        # span — the tier trace nests transfer under prefill — and its
+        # context rides both the chunk metadata (split_chain) and the
+        # offer_chain RPC, so the decode home's landing spans join as
+        # children of this transfer span.
+        tx_span = None
+        tx_kw: Dict[str, Any] = {}
+        tx_ctx = None
+        with rr._lock:
+            pf_span = (rr._transfer or {}).get("span")
+        if rr._tctx is not None:
+            tx_span = _trace.begin(
+                "router.transfer", trace_id=rr.id,
+                parent_id=(pf_span.span if pf_span is not None
+                           else rr._tctx.get("parent_span")),
+                transfer_id=tid, to_replica=rep.name)
+            if tx_span is not None:
+                tx_ctx = {"trace_id": rr.id,
+                          "parent_span": tx_span.span}
+                tx_kw["trace_ctx"] = tx_ctx
         try:
-            chunks = split_chain(wire, self.transfer_chunk_pages)
+            chunks = split_chain(wire, self.transfer_chunk_pages,
+                                 trace_ctx=tx_ctx)
             for j, ch in enumerate(chunks):
                 rep.offer_chain(ch, transfer_id=tid,
-                                last=(j == len(chunks) - 1))
+                                last=(j == len(chunks) - 1), **tx_kw)
             if not chunks:
                 # nothing cacheable to ship (sub-page prompt): unblock
                 # the waiting admission rather than time it out
+                if tx_span is not None:
+                    _trace.end(tx_span, failed="empty chain")
                 return self._abort_transfer(rr, "empty chain")
         except Exception as e:
+            if tx_span is not None:
+                _trace.end(tx_span, failed=repr(e))
             return self._abort_transfer(rr, repr(e))
+        if tx_span is not None:
+            _trace.end(tx_span, pages=int(wire.get("n_pages", 0)),
+                       chunks=len(chunks))
+            _trace.end(pf_span)
+            with rr._lock:
+                if rr._transfer is not None:
+                    rr._transfer.pop("span", None)
         with rr._lock:
             if rr._transfer is not None:
                 rr._transfer["phase"] = "decode"
@@ -1639,6 +1761,9 @@ class Router:
             return
         with rr._lock:
             tid = (rr._transfer or {}).get("tid")
+            pf_span = (rr._transfer or {}).pop("span", None)
+        if pf_span is not None:
+            _trace.end(pf_span, failed=reason)
         self._count("transfer_fallbacks")
         self.metrics.event(rr.id, "transfer_fallback", reason=reason)
         d_idx = rr.replica
@@ -1685,14 +1810,36 @@ class Router:
                 # failover rebound the request onto the holder itself:
                 # its own plan() promotes locally, no wire needed
                 return _fallback("request landed on the holder")
+            # tracing (ISSUE 19): a directory pull's wire leg is a
+            # transfer span under the router root, its context riding
+            # the chunk metadata + offer_chain like a disagg transfer
+            tx_span = None
+            tx_ctx = None
+            tx_kw: Dict[str, Any] = {}
+            if rr._tctx is not None:
+                tx_span = _trace.begin(
+                    "router.pull", trace_id=rr.id,
+                    parent_id=rr._tctx.get("parent_span"),
+                    transfer_id=tid, from_replica=src.name)
+                if tx_span is not None:
+                    tx_ctx = {"trace_id": rr.id,
+                              "parent_span": tx_span.span}
+                    tx_kw["trace_ctx"] = tx_ctx
             try:
-                chunks = split_chain(wire, self.transfer_chunk_pages)
+                chunks = split_chain(wire, self.transfer_chunk_pages,
+                                     trace_ctx=tx_ctx)
                 for j, ch in enumerate(chunks):
                     self.replicas[d_idx].offer_chain(
                         ch, transfer_id=tid,
-                        last=(j == len(chunks) - 1))
+                        last=(j == len(chunks) - 1), **tx_kw)
             except Exception as e:
+                if tx_span is not None:
+                    _trace.end(tx_span, failed=repr(e))
                 return _fallback(repr(e))
+            if tx_span is not None:
+                _trace.end(tx_span,
+                           pages=int(wire.get("n_pages", 0)),
+                           chunks=len(chunks))
             with rr._lock:
                 if rr._transfer is not None:
                     rr._transfer["phase"] = "decode"
@@ -1868,10 +2015,13 @@ class Router:
         self._rebuild_index()
 
     def _probe_health(self, idx: int) -> Dict[str, Any]:
+        t0 = time.time()
         try:
-            return self.replicas[idx].health()
+            h = self.replicas[idx].health()
         except Exception as e:
             return {"failed": True, "error": repr(e)}
+        self._note_wall(idx, t0, time.time(), h.get("wall_s"))
+        return h
 
     def maintain(self) -> bool:
         """One health/failover sweep: poll every live replica's
@@ -2308,6 +2458,10 @@ class Router:
         # routers can see each tier's snapshot-plane freshness and
         # placement latency without scraping Prometheus
         out["snapshot_staleness_s"] = float(self._staleness_s())
+        # wall anchor (ISSUE 19): a tier-of-tiers LB estimates THIS
+        # router's clock offset the way this router estimates its
+        # replicas' — the sensor composes
+        out["wall_s"] = time.time()
         from tpuflow.obs.gauges import get_histogram
 
         h = get_histogram("router.place_ms")
@@ -2320,6 +2474,67 @@ class Router:
                 self.counts.get("snapshot_errors", 0))
             out["health_lagged"] = int(
                 self.counts.get("health_lagged", 0))
+        return out
+
+    # ---- tier trace collection (ISSUE 19) ---------------------------
+    def tier_trace(self, request_id: str,
+                   export_path: Optional[str] = None) -> Dict[str, Any]:
+        """ONE merged tier trace for a request: the router's own spans
+        and event-log instants, plus a fan-out to every replica that
+        touched the request (the event log knows — placed, prefill,
+        transfer endpoints), each part offset-corrected by that
+        replica's RTT-midpoint clock estimate into the ROUTER's epoch
+        and merged with monotone parent/child edges
+        (:func:`tpuflow.obs.trace.merge_tier_spans`). In-process
+        replicas share the router's span ring and are covered by the
+        local part (``trace_spans() is None``). ``export_path`` also
+        writes the merged view as one Chrome trace."""
+        rid = str(request_id)
+        events = self.metrics.events(rid)
+        local = _trace.spans_for(rid)
+        for ev in events:
+            attrs = {k: v for k, v in ev.items()
+                     if k not in ("ts", "event")}
+            local.append({
+                "name": f"event:{ev.get('event')}",
+                "span_id": None, "parent_id": None, "thread": None,
+                "start_s": round(float(ev.get("ts", 0.0)), 6),
+                "dur_ms": 0.0, "instant": True, "attrs": attrs,
+            })
+        parts = [("router", 0.0, local)]
+        # the replicas this request touched, from the event log: its
+        # decode home, prefill replica, and any transfer/pull endpoint
+        by_name = {self.replicas[i].name: i
+                   for i in range(len(self.replicas))}
+        touched: List[int] = []
+        for ev in events:
+            for key in ("replica", "to_replica", "from_replica"):
+                idx = by_name.get(ev.get(key))
+                if idx is not None and idx not in touched:
+                    touched.append(idx)
+        offsets: Dict[str, float] = {}
+        for idx in touched:
+            rep = self.replicas[idx]
+            fetch = getattr(rep, "trace_spans", None)
+            spans = fetch(rid) if fetch is not None else None
+            if spans is None:  # shares the router's span ring
+                continue
+            with self._idx_lock:
+                off = self._wall_off[idx]
+            offsets[rep.name] = round(off, 6)
+            if spans:
+                parts.append((rep.name, off, spans))
+        merged = _trace.merge_tier_spans(parts)
+        out: Dict[str, Any] = {
+            "id": rid,
+            "tracer_enabled": _trace.is_enabled(),
+            "sources": [p[0] for p in parts],
+            "clock_offset_s": offsets,
+            "spans": merged,
+        }
+        if export_path:
+            out["path"] = _trace.export_chrome_spans(
+                export_path, merged, label=f"{self.name} {rid}")
         return out
 
     def flight_snapshot(self) -> Dict[str, Any]:
@@ -2345,6 +2560,22 @@ class Router:
         # same snaps (an HTTP replica pays a round-trip per fetch)
         snaps = {self.replicas[i].name: self._safe_snapshot(i)
                  for i in range(len(self.replicas))}
+        # tier tracing view (ISSUE 19): sampling config, the per-
+        # replica clock-offset estimates, and the merged tier trace of
+        # the most recent sampled requests — a crash bundle then
+        # carries the cross-process story, not just this process's ring
+        with self._lock:
+            recent = list(self._recent_traced)[-2:]
+        with self._idx_lock:
+            wall_off = {self.replicas[i].name: round(self._wall_off[i], 6)
+                        for i in range(len(self.replicas))
+                        if self._wall_ts[i] > 0.0}
+        tier_traces = {}
+        for rid in recent:
+            try:
+                tier_traces[rid] = self.tier_trace(rid)["spans"]
+            except Exception:
+                pass
         return {
             "draining": draining,
             "closed": closed,
@@ -2357,4 +2588,10 @@ class Router:
             "placements": dict(self.placements),
             "replicas": snaps,
             "inflight": inflight,
+            "trace": {
+                "enabled": _trace.is_enabled(),
+                "sampling": _trace.sampling(),
+                "clock_offset_s": wall_off,
+                "tier_traces": tier_traces,
+            },
         }

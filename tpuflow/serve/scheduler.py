@@ -633,6 +633,7 @@ class ServeScheduler:
         speculate: bool = True,
         await_transfer: Optional[str] = None,
         prefill_only: bool = False,
+        trace_ctx: Optional[Dict[str, Any]] = None,
     ) -> Request:
         """Queue one request. Raises :class:`QueueFull` when the
         admission queue is at capacity (backpressure),
@@ -662,7 +663,15 @@ class ServeScheduler:
         (``transfer_wait_s``), when it admits with a LOCAL prefill —
         tokens are identical either way. ``prefill_only`` admits a
         prompt-pass-only request that exports its page chain
-        (:meth:`submit_prefill` is the public spelling)."""
+        (:meth:`submit_prefill` is the public spelling).
+
+        ``trace_ctx`` (ISSUE 19) adopts an inbound distributed-trace
+        context — ``{"trace_id": ..., "parent_span": ...}``, the
+        router's stamp on the worker RPC — so this scheduler's
+        lifecycle spans join the tier-level trace instead of starting
+        a fresh one (``trace_id`` defaults to the request id, the
+        ISSUE 4 correlation contract; ``parent_span`` parents the
+        ``serve.request`` root under the router's span)."""
         from tpuflow.packaging.lm import _bucket_len
 
         if (await_transfer or prefill_only) and self.kv_spec is None:
@@ -759,15 +768,29 @@ class ServeScheduler:
         # stays in production code. begin here (caller thread), end on
         # the scheduler thread: the cross-thread contract of
         # tpuflow.obs.trace.
-        root = trace.begin("serve.request", trace_id=req.id,
+        # an inbound trace context (the router's RPC stamp) overrides
+        # the default trace id and parents the root span — every
+        # process a request touches then shares ONE trace (ISSUE 19)
+        t_id = req.id
+        t_parent = None
+        if trace_ctx:
+            t_id = trace_ctx.get("trace_id") or req.id
+            t_parent = trace_ctx.get("parent_span")
+        req._trace_id = t_id
+        # sampling: registers head-dropped traces for tail-keep; the
+        # head decision is deterministic on the trace id, so the whole
+        # tier votes identically without an extra wire field
+        trace.begin_request(t_id)
+        root = trace.begin("serve.request", trace_id=t_id,
+                           parent_id=t_parent,
                            bucket=bucket,
                            prompt_tokens=int(ids.size),
                            max_new_tokens=int(max_new_tokens))
         parent = root.span if root is not None else None
         req._span_request = root
-        req._span_queue = trace.begin("serve.queue", trace_id=req.id,
+        req._span_queue = trace.begin("serve.queue", trace_id=t_id,
                                       parent_id=parent, phase="queue")
-        req._span_ttft = trace.begin("serve.ttft", trace_id=req.id,
+        req._span_ttft = trace.begin("serve.ttft", trace_id=t_id,
                                      parent_id=parent)
         with self._lock:
             if self._closed:
@@ -859,6 +882,7 @@ class ServeScheduler:
         deadline_s: Optional[float] = None,
         stream_cb: Optional[Callable] = None,
         request_id: Optional[str] = None,
+        trace_ctx: Optional[Dict[str, Any]] = None,
     ) -> Request:
         """Queue a PREFILL-ONLY request: the scheduler admits it like
         any other (prefix-cache match, atomic / chunked / ring prompt
@@ -873,6 +897,7 @@ class ServeScheduler:
         return self.submit(
             prompt, 1, deadline_s=deadline_s, stream_cb=stream_cb,
             request_id=request_id, speculate=False, prefill_only=True,
+            trace_ctx=trace_ctx,
         )
 
     #: retained transfer records (a server must not grow without
@@ -894,7 +919,8 @@ class ServeScheduler:
             del self._transfers[tid]
 
     def offer_chain(self, wire, *, transfer_id: Optional[str] = None,
-                    last: bool = True) -> str:
+                    last: bool = True,
+                    trace_ctx: Optional[Dict[str, Any]] = None) -> str:
         """Queue one page-chain wire (or :func:`split_chain` chunk)
         for import at the next scheduler boundary — callable from any
         thread; the device scatter stays on the scheduler thread.
@@ -906,7 +932,12 @@ class ServeScheduler:
         ``await_transfer=`` that id. A verify failure (CRC, header,
         gap, dry allocator) marks the transfer FAILED — the waiting
         request falls back to a local prefill, never a truncated
-        stream. Returns the transfer id."""
+        stream. Returns the transfer id.
+
+        ``trace_ctx`` (ISSUE 19) attaches a distributed-trace context
+        to the TRANSFER (landing spans join the sender's trace even
+        when individual wire chunks carry no ``trace`` metadata of
+        their own)."""
         if self.kv_spec is None:
             raise ValueError(
                 "offer_chain requires kv='paged' — KV pages are the "
@@ -928,6 +959,8 @@ class ServeScheduler:
             })
             if st["done"]:
                 raise ValueError(f"transfer {tid} already completed")
+            if trace_ctx:
+                st["trace"] = dict(trace_ctx)
             st["offered"] += 1
             if last:
                 st["last_offered"] = True
@@ -953,6 +986,7 @@ class ServeScheduler:
             if st["done"] or st["failed"]:
                 return
             st["failed"] = str(reason)
+            st["ts_settled"] = self.clock()
             self._work.notify_all()
         self.metrics.on_kv_transfer_failure(str(transfer_id),
                                             str(reason), kind="abort")
@@ -964,6 +998,8 @@ class ServeScheduler:
         failed transfer are dropped unlanded — they would only raise
         the same gap error)."""
         from tpuflow.serve.pages import PageWireError, wire_bytes
+
+        from tpuflow.testing import faults
 
         progress = False
         while True:
@@ -978,6 +1014,19 @@ class ServeScheduler:
                 with self._lock:
                     st["processed"] += 1
                 continue
+            # landing span joins the SENDER's trace (ISSUE 19): the
+            # chunk's own wire metadata wins, the transfer-level
+            # context (offer_chain trace_ctx) is the fallback
+            tctx = ((wire.get("trace") if isinstance(wire, dict)
+                     else None) or st.get("trace") or {})
+            sp = trace.begin("serve.transfer_land",
+                             trace_id=tctx.get("trace_id") or tid,
+                             parent_id=tctx.get("parent_span"),
+                             transfer_id=tid)
+            # injected-slow-transfer point: a "delay" fault here makes
+            # the transfer phase dominate the TTFT breakdown — the
+            # attribution demo bench.py --serve-trace pins
+            faults.fire("serve.transfer.land")
             kvs = self._ensure_kv()
             t0 = self.clock()
             try:
@@ -986,6 +1035,8 @@ class ServeScheduler:
                 with self._lock:
                     st["processed"] += 1
                     st["failed"] = str(e)
+                    st["ts_settled"] = self.clock()
+                trace.end(sp, failed=str(e))
                 self.metrics.on_kv_transfer_failure(tid, str(e))
                 continue
             ms = (self.clock() - t0) * 1e3
@@ -995,6 +1046,8 @@ class ServeScheduler:
                 if (st["last_offered"]
                         and st["processed"] >= st["offered"]):
                     st["done"] = True
+                    st["ts_settled"] = self.clock()
+            trace.end(sp, pages=landed, bytes=nbytes)
             self.metrics.on_kv_import(tid, landed, nbytes, ms)
         return progress
 
@@ -1092,6 +1145,7 @@ class ServeScheduler:
             return False
         if now - min(st["ts"], req.ts_arrival) > self.transfer_wait_s:
             st["failed"] = "transfer timeout"
+            st["ts_settled"] = now
             self.metrics.on_kv_transfer_failure(
                 str(tid), "transfer timeout", kind="timeout")
             return False
@@ -1124,6 +1178,8 @@ class ServeScheduler:
         from tpuflow.serve.pages import wire_bytes
 
         req.export = wire
+        if req.ts_prefill_done is None:
+            req.ts_prefill_done = t0  # export began when prefill ended
         self.metrics.on_kv_export(req, n_full, wire_bytes(wire), ms)
         if req.ts_first_token is None:
             # the prompt pass IS this request's product: stamp TTFT at
@@ -1311,6 +1367,10 @@ class ServeScheduler:
             "closed": closed,
             "draining": draining,
             "ready": bool(r.get("ready")),
+            # wall anchor (ISSUE 19): health probes double as clock-
+            # offset samples — the router reads this against the
+            # probe's RTT midpoint (same contract as load_snapshot)
+            "wall_s": time.time(),
         }
 
     # ---- lifecycle internals (scheduler thread) ---------------------
@@ -1326,6 +1386,18 @@ class ServeScheduler:
         trace.end(getattr(req, "_span_ttft", None))
         trace.end(getattr(req, "_span_request", None),
                   state=state.value, n_tokens=len(req.tokens))
+        # SLO phase attribution (ISSUE 19): fold the request's stamped
+        # timeline into the fixed phase vector — the per-phase
+        # histograms the router/autoscaler control loops read
+        self.metrics.on_phases(req)
+        # sampling fate: tail-keep errored/outlier requests that the
+        # head decision dropped (no-op while tracing is off)
+        if trace.is_enabled():
+            e2e = (req.ts_done - req.ts_arrival) * 1e3
+            trace.finish_request(
+                getattr(req, "_trace_id", req.id),
+                error=state is not RequestState.DONE,
+                latency_ms=e2e)
         if state is not RequestState.DONE:
             # non-DONE terminals never reach the harvest path's final
             # stream event — emit it here so streaming clients unblock
@@ -1612,6 +1684,16 @@ class ServeScheduler:
                     _slot, req = adm[0], adm[1]
                     req.state = RequestState.RUNNING
                     req.ts_admitted = t_adm
+                    if req.await_transfer is not None:
+                        # phase attribution (ISSUE 19): charge the
+                        # transfer phase up to when its transfer
+                        # settled (landed or failed), never past
+                        # admission — phases() clamps the rest
+                        st_tx = self._transfers.get(
+                            str(req.await_transfer))
+                        if st_tx is not None:
+                            req.ts_transfer = st_tx.get("ts_settled",
+                                                        t_adm)
                     self.metrics.on_admit(req)
                     # queue-wait span ends where ts_admitted is stamped
                     # — span duration and metrics queue_wait_ms
@@ -1639,6 +1721,12 @@ class ServeScheduler:
                     self.metrics.on_prefill_chunk(b, n_pf, done_pf)
                     if done_pf:
                         req_pf = pool.occupants[_slot_pf]
+                        if req_pf is not None:
+                            # prefill/first-decode boundary stamp —
+                            # the chunked pass is the one place the
+                            # prefill phase is separable from the
+                            # admission stamp (ISSUE 19)
+                            req_pf.ts_prefill_done = self.clock()
                         if (req_pf is not None
                                 and req_pf.prefill_only):
                             self._complete_prefill(pool, _slot_pf,
@@ -1865,6 +1953,11 @@ class ServeScheduler:
             # deployment sensors (ISSUE 15): the router's version
             # fence / pin_version placement reads these
             "model_version": self.model_version,
+            # clock-alignment anchor (ISSUE 19): this process's wall
+            # clock at snapshot time — the router pairs it with the
+            # probe's RTT midpoint to estimate the per-replica offset
+            # that lines merged tier traces up
+            "wall_s": time.time(),
         }
         if self.speculate_k:
             out["draft_version"] = self.draft_version
@@ -1906,6 +1999,18 @@ class ServeScheduler:
             win = windowed.get(f"{pfx}.{key}")
             pcts = (win["percentiles"] if win else {}) or hist.percentiles()
             out[f"{key}_p95"] = pcts.get("p95")
+        # per-phase TTFT/e2e attribution (ISSUE 19): windowed p95 per
+        # SLO phase — what item 3's control loop reads to learn WHICH
+        # phase is burning the budget, not just that p95 moved
+        phases = {}
+        for ph, hist in self.metrics.phase_hists.items():
+            if not len(hist):
+                continue
+            win = windowed.get(f"{pfx}.req_phase_ms.{ph}")
+            pcts = (win["percentiles"] if win else {}) or hist.percentiles()
+            phases[ph] = pcts.get("p95")
+        if phases:
+            out["phase_ms_p95"] = phases
         return out
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:

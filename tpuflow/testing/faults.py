@@ -24,7 +24,12 @@ those planes). Points currently threaded:
   integrity-footer fallback tests eat these);
 - ``ckpt.shard``      — same, per sharded-checkpoint shard file;
 - ``elastic.boundary``— superstep block boundaries (elastic resize
-  tests schedule world changes here).
+  tests schedule world changes here);
+- ``serve.transfer.land`` — in the decode scheduler's chain inbox,
+  before a KV wire chunk is applied (``delay`` makes the transfer
+  phase dominate a request's SLO breakdown — the tracing/attribution
+  tests and ``bench.py --serve-trace`` inject slow transfers here;
+  ``raise`` exercises the transfer-abort path).
 
 Faults are one-shot by default (``times=1``): a NaN injected at step N
 trips the watchdog once, and the post-rollback REPLAY of step N runs
