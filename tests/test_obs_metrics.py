@@ -162,6 +162,42 @@ def test_prometheus_phase_label_folding_golden():
     assert 'obs_m_queue_depth{replica="0"} 1' in text
 
 
+def test_prometheus_version_label_folding_golden():
+    """ISSUE 20 satellite: per-version cut metrics
+    ``<prefix>.version.<label>.<metric>`` fold into ONE family with a
+    ``version="..."`` label, composing with the replica and phase
+    folds in the PINNED (le, phase, replica, version) label order —
+    and families the version fold does not touch render
+    byte-identically whether or not version cuts sit in the
+    registry (existing recording rules keep matching verbatim)."""
+    observe("obs_m.lat_ms", 7.0)
+    set_gauge("obs_m.queue_depth", 2.0)
+    base_text = prom.render("obs_m.")
+
+    observe("obs_m.version.step2-ab12cd34.ttft_ms", 40.0)
+    observe("obs_m.replica0.version.step2-ab12cd34"
+            ".req_phase_ms.transfer", 3.0)
+    inc_counter("obs_m.version.step2-ab12cd34.requests_done_total", 5)
+    text = prom.render("obs_m.")
+
+    # one family per metric, never a family named after the infix
+    assert text.count("# TYPE obs_m_ttft_ms histogram") == 1
+    assert "obs_m_version" not in text
+    # golden lines: version slots LAST, after phase and replica
+    assert ('obs_m_ttft_ms_bucket'
+            '{le="+Inf",version="step2-ab12cd34"} 1') in text
+    assert ('obs_m_req_phase_ms_count'
+            '{phase="transfer",replica="0",version="step2-ab12cd34"} 1'
+            ) in text
+    assert ('obs_m_requests_done_total'
+            '{version="step2-ab12cd34"} 5') in text
+    # byte-identity: every line the base render produced reappears
+    # verbatim — the version fold is invisible to what it never labels
+    new_lines = set(text.splitlines())
+    for line in base_text.splitlines():
+        assert line in new_lines, f"family drifted: {line!r}"
+
+
 def test_prometheus_exporter_http():
     observe("obs_m.lat_ms", 42.0)
     server = prom.start_exporter(port=0, prefix="obs_m.",
@@ -251,6 +287,37 @@ def test_default_ring_feeds_snapshot_gauges():
     doc = json.loads(json.dumps(ring.export()))
     assert doc["n_snapshots"] == 1
     assert "obs_m.sg_ms" in doc["windowed"]
+
+
+def test_ring_counter_increase_reset_clamp():
+    """ISSUE 20 satellite: windowed counter increase over the ring —
+    the Prometheus ``increase()`` idiom. None before any baseline; a
+    counter born inside the window counts in full (baseline 0); and
+    across a counter RESET the delta clamps at 0 (the restarted
+    process under-reports until the baseline rotates out) instead of
+    going negative — the same clamp :func:`delta_histogram` pins."""
+    clk = [1000.0]
+    ring = timeseries.SnapshotRing(interval_s=5.0, window_s=30.0,
+                                   clock=lambda: clk[0])
+    name = "obs_m.reqs_total"
+    assert ring.counter_increase(name, 30.0) is None  # empty ring
+    inc_counter(name, 10)
+    ring.tick()
+    clk[0] += 5.0
+    inc_counter(name, 7)
+    ring.tick()
+    assert ring.counter_increase(name, 30.0) == 7.0
+    # a counter the baseline never saw counts from zero
+    inc_counter("obs_m.born_total", 4)
+    assert ring.counter_increase("obs_m.born_total", 30.0) == 4.0
+    # reset: the registry restarts below the baseline value
+    clear_gauges(name)
+    inc_counter(name, 3)
+    clk[0] += 5.0
+    ring.tick()
+    assert ring.counter_increase(name, 30.0) == 0.0  # clamped, not -7
+    # module-level helper degrades to None with no default ring
+    assert timeseries.windowed_counter_increase(name) is None
 
 
 # ---------------------------------------------------------------------

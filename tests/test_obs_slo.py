@@ -1,0 +1,267 @@
+"""SLO engine units (ISSUE 20): objective grammar, windowed latency
+verdicts, multiwindow burn-rate behavior, fold-aware matching, the
+no-ring cumulative degrade, and the install surface.
+
+All host-only and fast (tier-1): injectable clocks drive the snapshot
+ring, counters/histograms are hand-fed through the registry — the
+evaluator never collects anything itself, which is the point.
+"""
+
+import pytest
+
+from tpuflow.obs import slo, timeseries
+from tpuflow.obs.gauges import clear_gauges, inc_counter, observe
+from tpuflow.obs.slo import (
+    SLObjective,
+    SLOEvaluator,
+    default_objectives,
+    fold_metric,
+    format_slo_report,
+)
+
+
+@pytest.fixture(autouse=True)
+def _slo_hygiene():
+    timeseries.stop()
+    clear_gauges("slo_t.")
+    slo.uninstall()
+    yield
+    timeseries.stop()
+    clear_gauges("slo_t.")
+    slo.uninstall()
+
+
+# ---------------------------------------------------------------------
+# grammar
+# ---------------------------------------------------------------------
+
+def test_parse_latency_spec():
+    o = SLObjective.parse("ttft=serve.ttft_ms:p95<2000ms@60s")
+    assert o.name == "ttft" and o.kind == "latency"
+    assert o.metrics == ("serve.ttft_ms",)
+    assert o.percentile == 95.0 and o.threshold_ms == 2000.0
+    assert o.window_s == 60.0
+    # unit suffixes and the name are optional; bare metrics take the
+    # serve. prefix and name themselves after the metric
+    o2 = SLObjective.parse("itl_ms:p99<200@30")
+    assert o2.name == "itl_ms"
+    assert o2.metrics == ("serve.itl_ms",)
+    assert o2.percentile == 99.0
+
+
+def test_parse_budget_spec():
+    o = SLObjective.parse(
+        "errors=requests_failed_total+kv_transfer_failures_total"
+        "/requests_done_total+requests_failed_total<0.01@60s/300sx2")
+    assert o.name == "errors" and o.kind == "budget"
+    assert o.metrics == ("serve.requests_failed_total",
+                         "serve.kv_transfer_failures_total")
+    assert o.total_metrics == ("serve.requests_done_total",
+                               "serve.requests_failed_total")
+    assert o.budget == 0.01
+    assert o.window_s == 60.0 and o.long_window_s == 300.0
+    assert o.burn_threshold == 2.0
+    # burn factor defaults to 1x
+    assert SLObjective.parse("a/b<.05@10/50").burn_threshold == 1.0
+
+
+def test_parse_rejects_garbage():
+    for bad in ("ttft_ms", "ttft_ms:p95<2000", "a/b<0.01",
+                "a:p95<x@60", ""):
+        with pytest.raises(ValueError, match="unparseable SLO spec"):
+            SLObjective.parse(bad)
+
+
+def test_default_objectives_shape():
+    objs = default_objectives()
+    assert [o.name for o in objs] == ["ttft", "itl", "errors"]
+    assert objs[0].kind == "latency" and objs[2].kind == "budget"
+    # the error budget counts transfer fallbacks as bad and failures
+    # in BOTH numerator and denominator (failed requests completed)
+    assert "serve.kv_transfer_failures_total" in objs[2].metrics
+    assert "serve.requests_failed_total" in objs[2].total_metrics
+
+
+def test_fold_metric_matches_exposition_fold():
+    assert fold_metric("serve.replica3.ttft_ms") == "serve.ttft_ms"
+    assert fold_metric(
+        "serve.version.step2-ab12.ttft_ms") == "serve.ttft_ms"
+    assert fold_metric(
+        "serve.replica0.version.step2-ab12.requests_done_total"
+    ) == "serve.requests_done_total"
+    assert fold_metric("serve.ttft_ms") == "serve.ttft_ms"
+
+
+# ---------------------------------------------------------------------
+# latency objectives over ring windows
+# ---------------------------------------------------------------------
+
+def _ring(clk, interval_s=60.0, window_s=300.0):
+    return timeseries.SnapshotRing(interval_s=interval_s,
+                                   window_s=window_s,
+                                   clock=lambda: clk[0])
+
+
+def test_latency_objective_windowed_verdicts():
+    """The window judges only the window: an old fast era behind the
+    baseline neither saves nor damns the current one."""
+    clk = [0.0]
+    ring = _ring(clk)
+    o = SLObjective.parse("lat=slo_t.lat_ms:p95<100@60")
+    ev = SLOEvaluator([o], ring=ring, clock=lambda: clk[0])
+    for _ in range(50):
+        observe("slo_t.lat_ms", 10.0)  # fast era
+    ring.tick()
+    clk[0] = 60.0
+    rep = ev.evaluate()
+    v = rep["objectives"][0]
+    assert v["ok"] is True and v["insufficient_data"]  # idle window
+    for _ in range(50):
+        observe("slo_t.lat_ms", 500.0)  # regression era
+    rep = ev.evaluate()
+    v = rep["objectives"][0]
+    assert v["ok"] is False and rep["ok"] is False
+    assert v["windowed"] is True and v["count"] == 50
+    assert v["value_ms"] > 100.0
+    assert v["margin"] < 0  # breach = negative headroom
+    # the regression rotates out: a clean newer window is ok again
+    ring.tick()
+    clk[0] = 120.0
+    for _ in range(50):
+        observe("slo_t.lat_ms", 20.0)
+    v = ev.evaluate()["objectives"][0]
+    assert v["ok"] is True and v["margin"] > 0
+    # replica members fold into the same objective — enough slow
+    # observations on a MEMBER metric drag the folded p95 over
+    for _ in range(10):
+        observe("slo_t.replica7.lat_ms", 9999.0)
+    v = ev.evaluate()["objectives"][0]
+    assert v["ok"] is False and v["count"] == 60
+
+
+# ---------------------------------------------------------------------
+# multiwindow burn rate
+# ---------------------------------------------------------------------
+
+def test_multiwindow_burn_short_spike_tolerated_sustained_trips():
+    """The SRE multiwindow contract: a short error spike burns the
+    60 s window past threshold but the 300 s window absorbs it — no
+    breach; the SAME per-minute badness sustained for the long window
+    trips both and breaches. Budget 0.1, burn >= 1x."""
+    clk = [0.0]
+    ring = _ring(clk, interval_s=60.0, window_s=300.0)
+    o = SLObjective.parse(
+        "errors=slo_t.bad_total/slo_t.total_total<0.1@60/300x1")
+    ev = SLOEvaluator([o], ring=ring, clock=lambda: clk[0])
+
+    def interval(bad, total):
+        ring.tick()
+        clk[0] += 60.0
+        inc_counter("slo_t.bad_total", bad)
+        inc_counter("slo_t.total_total", total)
+
+    for _ in range(5):
+        interval(0, 100)  # five clean minutes fill the long window
+    interval(20, 100)     # one bad minute: 20% >> 10% budget
+    v = ev.evaluate()["objectives"][0]
+    assert v["burn_short"] == pytest.approx(2.0)
+    assert v["burn_long"] < 1.0      # 20/600 over the long window
+    assert v["ok"] is True           # a blip never pages
+    # sustain the badness until the long window confirms
+    guard = 0
+    while ev.evaluate()["objectives"][0]["ok"]:
+        interval(20, 100)
+        guard += 1
+        assert guard < 10, "sustained burn never tripped"
+    v = ev.evaluate()["objectives"][0]
+    assert v["burn_short"] >= 1.0 and v["burn_long"] >= 1.0
+    assert v["margin"] < 0
+
+
+def test_budget_counts_fold_and_zero_traffic():
+    """Replica/version counter members sum into the objective's
+    folded names; zero traffic is insufficient data, ok, and never a
+    division error."""
+    clk = [0.0]
+    ring = _ring(clk, interval_s=5.0, window_s=25.0)
+    o = SLObjective.parse(
+        "e=slo_t.bad_total/slo_t.total_total<0.5@5/25x1")
+    ev = SLOEvaluator([o], ring=ring, clock=lambda: clk[0])
+    ring.tick()
+    clk[0] = 5.0
+    v = ev.evaluate()["objectives"][0]
+    assert v["ok"] is True and v.get("insufficient_data")
+    inc_counter("slo_t.replica0.bad_total", 2)
+    inc_counter("slo_t.version.step2-ab.bad_total", 1)
+    inc_counter("slo_t.replica0.total_total", 3)
+    inc_counter("slo_t.replica1.total_total", 1)
+    v = ev.evaluate()["objectives"][0]
+    assert v["bad_short"] == 3.0 and v["total_short"] == 4.0
+    assert v["ok"] is False  # 0.75 > 0.5 budget on both windows
+
+
+# ---------------------------------------------------------------------
+# degrade, cache, install surface, renderer
+# ---------------------------------------------------------------------
+
+def test_no_ring_degrades_to_cumulative():
+    """PR 5 semantics: with no ring anywhere the windows degrade to
+    cumulative-since-start and the report SAYS so."""
+    observe("slo_t.lat_ms", 50.0)
+    ev = SLOEvaluator([SLObjective.parse("lat=slo_t.lat_ms:p95<100@60")],
+                      clock=lambda: 0.0)
+    rep = ev.evaluate()
+    v = rep["objectives"][0]
+    assert v["windowed"] is False and v["ok"] is True
+    assert "[cumulative: no ring]" in format_slo_report(rep)
+
+
+def test_report_caches_within_cache_s():
+    clk = [0.0]
+    ring = _ring(clk, interval_s=5.0, window_s=25.0)
+    ev = SLOEvaluator([SLObjective.parse("lat=slo_t.lat_ms:p95<100@60")],
+                      ring=ring, clock=lambda: clk[0], cache_s=5.0)
+    r1 = ev.report()
+    observe("slo_t.lat_ms", 999.0)
+    assert ev.report() is r1          # cached: no delta walk
+    clk[0] = 6.0
+    assert ev.report() is not r1      # stale: recomputed
+    assert ev.verdicts_compact()["lat"]["ok"] is False
+
+
+def test_install_flight_provider_and_uninstall(tmp_path):
+    """install() makes the evaluator the process default AND a flight
+    provider: a dumped bundle carries the slo report; uninstall
+    removes both (the provider never serves a stale evaluator)."""
+    from tpuflow.obs import flight
+
+    ev = SLOEvaluator(default_objectives(), clock=lambda: 0.0)
+    assert slo.install(ev) is ev
+    assert slo.default_evaluator() is ev
+    bundle_dir = flight.dump(str(tmp_path), "slo-test")
+    doc = flight.load(bundle_dir).get("slo")
+    assert doc is not None and "objectives" in doc
+    assert [v["name"] for v in doc["objectives"]] == [
+        "ttft", "itl", "errors"]
+    slo.uninstall()
+    assert slo.default_evaluator() is None
+    bundle2 = flight.dump(str(tmp_path), "slo-test-2")
+    assert flight.load(bundle2).get("slo") is None
+
+
+def test_format_slo_report_rows():
+    rep = {"ts": 12.0, "ok": False, "objectives": [
+        {"name": "ttft", "kind": "latency", "metric": "serve.ttft_ms",
+         "percentile": 95.0, "threshold_ms": 2000.0, "window_s": 60.0,
+         "windowed": True, "ok": False, "value_ms": 2500.0,
+         "count": 10, "margin": -0.25},
+        {"name": "errors", "kind": "budget", "budget": 0.01,
+         "burn_threshold": 1.0, "window_s": 60.0,
+         "long_window_s": 300.0, "windowed": True, "ok": True,
+         "burn_short": 0.2, "burn_long": 0.1, "margin": 0.9},
+    ]}
+    text = format_slo_report(rep)
+    assert "overall=BREACH" in text
+    assert "[FAIL] ttft" in text and "2500.0ms" in text
+    assert "[ok ] errors" in text and "0.20x/0.10x" in text
+    assert "-25.0%" in text and "+90.0%" in text

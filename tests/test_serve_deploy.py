@@ -656,6 +656,387 @@ def test_deploy_obs_surfaces(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# canary scoring (ISSUE 20): version cuts judge the first rotation
+# ---------------------------------------------------------------------
+
+
+class FakeCanaryReplica(FakeDeployReplica):
+    """FakeDeployReplica + the ISSUE 20 per-version metric sensor:
+    every served request records into a synthetic version cut, with
+    per-label injectable latency (``ttft_by_label``) and failure
+    cadence (``fail_by_label``: count every Nth completion as a
+    failure terminal in the cut) — the knobs a canary test turns to
+    make the NEW version observably bad without touching request
+    state (streams still complete; the regression lives in the
+    metrics plane, where the scorer reads)."""
+
+    def __init__(self, name, version, **kw):
+        super().__init__(name, version, **kw)
+        self.ttft_by_label = {}
+        self.fail_by_label = {}
+        self._vstats = {}
+        self._served_n = {}
+
+    def _cut(self, label):
+        from tpuflow.obs.gauges import Histogram
+
+        rec = self._vstats.get(label)
+        if rec is None:
+            rec = self._vstats[label] = {
+                "done": 0, "failed": 0, "transfer_fallbacks": 0,
+                "tokens_out": 0,
+                "hists": {"ttft_ms": Histogram(),
+                          "itl_ms": Histogram(),
+                          "req_phase_ms.transfer": Histogram(),
+                          "req_phase_ms.decode": Histogram()}}
+        return rec
+
+    def step(self):
+        before = len(self.finished)
+        progress = super().step()
+        label = (self.version or {}).get("label")
+        for req in self.finished[before:]:
+            rec = self._cut(label)
+            n = self._served_n[label] = self._served_n.get(label, 0) + 1
+            every = int(self.fail_by_label.get(label, 0))
+            if every and n % every == 0:
+                rec["failed"] += 1
+                continue
+            ttft = float(self.ttft_by_label.get(label, 10.0))
+            rec["done"] += 1
+            rec["tokens_out"] += len(req.tokens)
+            rec["hists"]["ttft_ms"].observe(ttft)
+            rec["hists"]["itl_ms"].observe(ttft / 10.0)
+            # the regression localizes to transfer: its phase share
+            # scales with ttft while decode stays flat
+            rec["hists"]["req_phase_ms.transfer"].observe(ttft * 0.6)
+            rec["hists"]["req_phase_ms.decode"].observe(2.0)
+        return progress
+
+    def version_snapshot(self):
+        return {label: {"requests": rec["done"] + rec["failed"],
+                        "done": rec["done"], "failed": rec["failed"],
+                        "transfer_fallbacks": rec["transfer_fallbacks"],
+                        "tokens_out": rec["tokens_out"],
+                        "hists": {hn: h.state()
+                                  for hn, h in rec["hists"].items()}}
+                for label, rec in self._vstats.items()}
+
+
+def _fake_canary_tier(tmp_path, policy, n_active=2):
+    """A blue/green tier with a MUTABLE virtual clock (windows need
+    time to pass) and version-cut-capable fakes."""
+    from tpuflow.serve.deploy import DeploymentManager, manifest_version
+    from tpuflow.serve.router import Router
+
+    m1 = _save_np_ckpt(tmp_path, 1, seed=1)
+    v1 = manifest_version(m1)
+    reps = [FakeCanaryReplica(f"rep{i}", v1)
+            for i in range(n_active + 1)]
+    router = Router(reps, standby=(n_active,))
+    clk = {"t": 0.0}
+    mgr = DeploymentManager(router, replay_hot=4, canary=policy,
+                            clock=lambda: clk["t"])
+    return router, reps, mgr, v1, clk
+
+
+def _canary_traffic(router, v1_label, v2_label, per_version=4):
+    """Pinned traffic to BOTH versions (the scorer needs comparands
+    on each side of the cut regardless of placement luck). Returns
+    the submitted requests; v2 submits stop raising once the rollback
+    drain closes the new replica."""
+    out = []
+    for label in (v1_label, v2_label):
+        for _ in range(per_version):
+            try:
+                out.append(router.submit(
+                    np.asarray([1, 2, 3], np.int32), 4,
+                    pin_version=label))
+            except Exception:
+                break
+    return out
+
+
+def test_canary_regression_rolls_back(tmp_path):
+    """The acceptance arc: push a version whose ttft/itl cuts blow up
+    → the scorer breaches on latency ratio within ``fail_windows``
+    consecutive windows → the manager retires the NEW replica through
+    the zero-truncation drain, recycles it as standby, never rotates
+    past the canary — and the history records a FAILED, rolled-back
+    push with the phase localization naming transfer."""
+    from tpuflow.obs.gauges import counters
+    from tpuflow.serve.canary import CanaryPolicy
+
+    pol = CanaryPolicy(windows=3, window_s=5.0, min_requests=4,
+                       fail_windows=2, latency_ratio=1.5)
+    router, reps, mgr, v1, clk = _fake_canary_tier(tmp_path, pol)
+    for rep in reps:
+        rep.ttft_by_label = {v1["label"]: 10.0}
+    m2 = _save_np_ckpt(tmp_path, 2, seed=2)
+    from tpuflow.serve.deploy import manifest_version
+
+    v2 = manifest_version(m2)
+    for rep in reps:
+        rep.ttft_by_label[v2["label"]] = 100.0  # x10: a felt regression
+
+    rollbacks0 = counters("serve.").get(
+        "serve.deploy_rollbacks_total", 0.0)
+    mgr.begin(str(m2), online=False)
+    submitted = []
+    guard = 0
+    while mgr.active:
+        submitted += _canary_traffic(router, v1["label"], v2["label"])
+        _drive(router, reps)
+        clk["t"] += 1.0
+        mgr.tick()
+        guard += 1
+        assert guard < 200, "rollout did not converge"
+    _drive(router, reps)
+
+    rec = mgr.history[-1]
+    assert rec["rolled_back"] is True
+    assert rec["error"] and "canary retired new version" in rec["error"]
+    summary = rec["canary"]
+    assert summary["verdict"] == "retire_new"
+    # detection within the fail_windows budget (<= policy.windows)
+    assert summary["windows_scored"] <= pol.windows
+    assert any("ttft_ms p95" in r or "itl_ms p95" in r
+               for r in summary["reasons"])
+    # phase localization names the blown-up phase, not the flat one
+    assert any(p.startswith("transfer") for p in
+               summary["phase_regressions"])
+    assert not any(p.startswith("decode") for p in
+                   summary["phase_regressions"])
+    # tier never rotated past the canary: actives all back on v1,
+    # the new replica recycled as a standby
+    for i in router.active_indices():
+        from tpuflow.serve.deploy import version_label
+
+        assert version_label(router.replica_version(i)) == v1["label"]
+    assert router.standby_indices(), "new replica not recycled"
+    # protective rollback counted apart from mechanical failures
+    assert counters("serve.")["serve.deploy_rollbacks_total"] == \
+        rollbacks0 + 1.0
+    # zero truncated streams: every request that was admitted
+    # finished DONE with its full budget
+    assert submitted
+    assert all(rr.state.value == "done" for rr in submitted), [
+        (rr.id, rr.state.value, rr.error) for rr in submitted
+        if rr.state.value != "done"]
+    assert all(len(rr.tokens) == 4 for rr in submitted)
+
+
+def test_canary_clean_push_completes_rollout(tmp_path):
+    """False-positive control: a push whose cuts match the old
+    version sails through scoring (verdict retire_old) and the
+    rollout completes to the new version everywhere — no rollback,
+    no failure, canary summary attached to the SUCCESS record."""
+    from tpuflow.serve.canary import CanaryPolicy
+    from tpuflow.serve.deploy import manifest_version, version_label
+
+    pol = CanaryPolicy(windows=2, window_s=5.0, min_requests=4,
+                       fail_windows=2)
+    router, reps, mgr, v1, clk = _fake_canary_tier(tmp_path, pol)
+    m2 = _save_np_ckpt(tmp_path, 2, seed=2)
+    v2 = manifest_version(m2)
+
+    mgr.begin(str(m2), online=False)
+    guard = 0
+    while mgr.active:
+        _canary_traffic(router, v1["label"], v2["label"])
+        _drive(router, reps)
+        clk["t"] += 1.0
+        mgr.tick()
+        guard += 1
+        assert guard < 200, "rollout did not converge"
+    _drive(router, reps)
+
+    rec = mgr.history[-1]
+    assert rec["error"] is None
+    assert rec["rolled_back"] is False
+    assert rec["canary"]["verdict"] == "retire_old"
+    assert rec["canary"]["bad_windows"] == 0
+    for i in router.active_indices():
+        assert version_label(router.replica_version(i)) == v2["label"]
+    assert router.standby_indices()
+
+
+def test_canary_error_rate_breach(tmp_path):
+    """The error-budget trigger: a new version failing 1-in-2
+    completions breaches the absolute ceiling AND the ratio vs a
+    clean old version — retired without any latency regression."""
+    from tpuflow.serve.canary import CanaryPolicy
+    from tpuflow.serve.deploy import manifest_version
+
+    pol = CanaryPolicy(windows=3, window_s=5.0, min_requests=4,
+                       fail_windows=2, max_error_rate=0.05,
+                       error_ratio=3.0)
+    router, reps, mgr, v1, clk = _fake_canary_tier(tmp_path, pol)
+    m2 = _save_np_ckpt(tmp_path, 2, seed=2)
+    v2 = manifest_version(m2)
+    for rep in reps:
+        rep.fail_by_label = {v2["label"]: 2}  # every 2nd completion
+
+    mgr.begin(str(m2), online=False)
+    guard = 0
+    while mgr.active:
+        _canary_traffic(router, v1["label"], v2["label"])
+        _drive(router, reps)
+        clk["t"] += 1.0
+        mgr.tick()
+        guard += 1
+        assert guard < 200
+    rec = mgr.history[-1]
+    assert rec["rolled_back"] is True
+    assert any("error rate" in r for r in rec["canary"]["reasons"])
+
+
+def test_canary_inconclusive_windows_are_retried(tmp_path):
+    """A window that never sees ``min_requests`` of the new version
+    scores inconclusive and is RETRIED, not counted — traffic decides
+    when judgment is possible, and the rollout stays held open."""
+    from tpuflow.serve.canary import CanaryPolicy
+    from tpuflow.serve.deploy import manifest_version
+
+    pol = CanaryPolicy(windows=1, window_s=5.0, min_requests=4)
+    router, reps, mgr, v1, clk = _fake_canary_tier(tmp_path, pol)
+    m2 = _save_np_ckpt(tmp_path, 2, seed=2)
+    v2 = manifest_version(m2)
+    mgr.begin(str(m2), online=False)
+    # two idle windows: no traffic at all -> inconclusive, still held
+    for _ in range(2):
+        clk["t"] += 5.0
+        mgr.tick()
+        _drive(router, reps)
+    assert mgr.active
+    st_summary = mgr.state()
+    scorer = mgr._state["canary"]
+    assert scorer.windows_scored == 0
+    assert sum(1 for r in scorer.window_results if r["inconclusive"]) == 2
+    # traffic arrives -> the next window judges and the rollout moves
+    guard = 0
+    while mgr.active:
+        _canary_traffic(router, v1["label"], v2["label"])
+        _drive(router, reps)
+        clk["t"] += 1.0
+        mgr.tick()
+        guard += 1
+        assert guard < 200
+    assert mgr.history[-1]["error"] is None
+    assert st_summary is not None  # state() stayed serviceable mid-hold
+
+    # liveness cap (max_idle_windows): a hold on a DRAINED tier can
+    # never score, so after the cap the scorer concludes instead of
+    # holding the blue/green window forever — clean-but-idle completes
+    # the rollout (what a canary-less push would have done)
+    pol2 = CanaryPolicy(windows=1, window_s=5.0, min_requests=4,
+                        max_idle_windows=3)
+    idle_dir = tmp_path / "idle"
+    idle_dir.mkdir()
+    router2, reps2, mgr2, _v1b, clk2 = _fake_canary_tier(idle_dir, pol2)
+    m3 = _save_np_ckpt(tmp_path / "idle", 2, seed=3)
+    mgr2.begin(str(m3), online=False)
+    guard = 0
+    while mgr2.active:
+        clk2["t"] += 5.0
+        mgr2.tick()
+        _drive(router2, reps2)
+        guard += 1
+        assert guard < 20, "idle canary hold never gave up"
+    dep = mgr2.history[-1]
+    assert dep["error"] is None and not dep.get("rolled_back")
+    assert dep["canary"]["verdict"] == "retire_old"
+    assert dep["canary"]["windows_scored"] == 0
+    assert dep["canary"]["inconclusive_windows"] == 3
+
+
+def test_canary_quality_probes_gate_rollout(tmp_path):
+    """The final gate: clean windows + a pin_version quality probe.
+    With the right expected tokens (the NEW version's oracle) the
+    probe passes and the rollout completes; with a wrong expectation
+    the divergence fails CLOSED and the push rolls back."""
+    from tpuflow.serve.canary import CanaryPolicy
+    from tpuflow.serve.deploy import manifest_version, version_label
+
+    # length-9 probe prompt -> its own bucket (16), so the probe is
+    # the FIRST submit there and gets stream_id 0 deterministically
+    probe_prompt = list(range(1, 10))
+
+    def run(sub, expected_version):
+        d = tmp_path / sub
+        d.mkdir()
+        m2 = _save_np_ckpt(d, 2, seed=2)
+        v2 = manifest_version(m2)
+        exp = fake_tokens(np.asarray(probe_prompt, np.int32), 0, 4,
+                          expected_version(v2))
+        pol = CanaryPolicy(windows=1, window_s=5.0, min_requests=4,
+                           quality_probes=((probe_prompt, exp),),
+                           probe_timeout_s=60.0)
+        router, reps, mgr, v1, clk = _fake_canary_tier(d, pol)
+        mgr.begin(str(m2), online=False)
+        guard = 0
+        while mgr.active:
+            _canary_traffic(router, v1["label"], v2["label"])
+            _drive(router, reps)
+            clk["t"] += 1.0
+            mgr.tick()
+            guard += 1
+            assert guard < 200
+        _drive(router, reps)
+        return router, v2, mgr.history[-1]
+
+    # wrong oracle -> probe divergence -> fail closed, rolled back
+    router, v2, rec = run("wrong", lambda v2: "not-the-real-label")
+    assert rec["rolled_back"] is True
+    assert rec["canary"]["verdict"] == "retire_new"
+    assert any("probe tokens diverged" in r
+               for r in rec["canary"]["probe_failures"])
+    # right oracle (new version's tokens) -> gate passes
+    router, v2, rec = run("right", lambda v2: v2["label"])
+    assert rec["error"] is None
+    assert rec["canary"]["verdict"] == "retire_old"
+    assert not rec["canary"]["probe_failures"]
+    for i in router.active_indices():
+        assert version_label(router.replica_version(i)) == v2["label"]
+
+
+def test_router_version_snapshot_merges_across_replicas(tmp_path):
+    """Tier-level version cuts: two replicas serving the same label
+    merge — counters add, histogram states add bucket-wise — and a
+    version only one replica saw passes through; fakes without the
+    sensor contribute nothing (duck-typed, no error)."""
+    from tpuflow.serve.deploy import manifest_version
+    from tpuflow.serve.router import Router
+
+    m1 = _save_np_ckpt(tmp_path, 1, seed=1)
+    v1 = manifest_version(m1)
+    a = FakeCanaryReplica("a", v1)
+    b = FakeCanaryReplica("b", v1)
+    plain = FakeDeployReplica("plain", v1)  # no version_snapshot
+    router = Router([a, b, plain])
+    for rep, n in ((a, 3), (b, 2)):
+        for i in range(n):
+            req = rep.submit(np.asarray([1, 2], np.int32), 4,
+                             stream_id=i)
+            rep.step()
+    b.version = {"step": 9, "digest": "d", "label": "step9-beef"}
+    req = b.submit(np.asarray([5], np.int32), 4, stream_id=0)
+    b.step()
+
+    snap = router.version_snapshot()
+    lab = v1["label"]
+    assert snap[lab]["done"] == 5
+    assert snap[lab]["hists"]["ttft_ms"]["n"] == 5
+    assert snap["step9-beef"]["done"] == 1
+    # merged totals equal the sum of the parts (no double count, no
+    # mutation of either source state)
+    assert snap[lab]["tokens_out"] == (
+        a.version_snapshot()[lab]["tokens_out"]
+        + b.version_snapshot()[lab]["tokens_out"])
+    assert a.version_snapshot()[lab]["hists"]["ttft_ms"]["n"] == 3
+
+
+# ---------------------------------------------------------------------
 # real-scheduler swap: token identity, validation, reopen
 # ---------------------------------------------------------------------
 

@@ -545,6 +545,43 @@ def test_router_snapshot_readiness_and_load():
     assert router.readiness()["ready"] is False
 
 
+def test_tier_windowed_error_rate_aggregation():
+    """ISSUE 20: the tier load snapshot carries a REQUEST-WEIGHTED
+    windowed error rate summed from the per-replica
+    errors_windowed/requests_windowed sensors (an LB or the canary
+    scorer sees a spike, not a cumulative average); replicas without
+    the fields (older workers, plain fakes) contribute nothing, and
+    an idle tier reports 0.0, never a division error."""
+
+    class ErrReplica(FakeReplica):
+        def __init__(self, name, errs, reqs, **kw):
+            super().__init__(name, **kw)
+            self._errwin = (errs, reqs)
+
+        def load_snapshot(self):
+            snap = super().load_snapshot()
+            errs, reqs = self._errwin
+            snap["error_rate"] = errs / reqs if reqs else 0.0
+            snap["errors_windowed"] = errs
+            snap["requests_windowed"] = reqs
+            return snap
+
+    a = ErrReplica("a", 1, 10)
+    b = ErrReplica("b", 0, 30)
+    plain = FakeReplica("c")  # no windowed sensor: contributes nothing
+    router = Router([a, b, plain], clock=lambda: 0.0)
+    router.maintain()
+    load = router.load_snapshot()
+    assert load["errors_windowed"] == 1.0
+    assert load["requests_windowed"] == 40.0
+    # request-weighted 1/40 — NOT the mean of per-replica rates
+    # ((0.1 + 0.0) / 2 would overweight the quiet replica)
+    assert load["error_rate"] == pytest.approx(1 / 40, abs=1e-6)
+
+    idle = Router([FakeReplica("x")], clock=lambda: 0.0)
+    assert idle.load_snapshot()["error_rate"] == 0.0
+
+
 # ---------------------------------------------------------------------
 # fleet-scale hot path (ISSUE 17): cached snapshot plane, sharded
 # state, bounded health sweeps — all host-only fakes
@@ -813,6 +850,12 @@ def test_load_snapshot_real_scheduler(tiny_lm):
     assert snap["slots_per_bucket"] == 2
     assert "kv_pages_free" not in snap  # contiguous: pages never gate
     assert snap["ttft_ms_p95"] is None  # no traffic served yet
+    # ISSUE 20: the windowed error sensor is part of the shape (0.0
+    # and empty on an idle scheduler, degrading to cumulative counts
+    # when no snapshot ring ticks)
+    assert snap["error_rate"] == 0.0
+    assert snap["errors_windowed"] == 0
+    assert snap["requests_windowed"] == 0
     paged = _sched(tiny_lm, kv="paged", kv_page_size=4, kv_pages=32)
     assert paged.load_snapshot()["kv_pages_free"] == 31
     assert paged.load_snapshot()["kv_pages_total"] == 31
@@ -910,14 +953,17 @@ def test_router_tier_never_touches_device_arrays():
     All device work stays on the replica schedulers' threads; a future
     'quick fix' that fetches device state in the router would put
     device syncs on the placement path of every request."""
-    root = os.path.join(os.path.dirname(__file__), "..", "tpuflow",
-                        "serve")
+    root = os.path.join(os.path.dirname(__file__), "..", "tpuflow")
     pat = re.compile(
         r"(?:\bimport\s+jax\b|\bfrom\s+jax\b|\bjax\s*\.|\bjnp\s*\.|"
         r"\bblock_until_ready\b|\bdevice_put\b)"
     )
     offenders = []
-    for fn in ("router.py", "replica.py"):
+    # ISSUE 20 extends the boundary: the SLO evaluator and canary
+    # scorer are decision layers over registry snapshots — a device
+    # sync there would stall the deploy tick / every load_snapshot
+    for fn in ("serve/router.py", "serve/replica.py",
+               "serve/canary.py", "obs/slo.py"):
         src = open(os.path.join(root, fn)).read()
         for m in pat.finditer(src):
             line = src[:m.start()].count("\n") + 1

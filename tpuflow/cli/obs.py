@@ -28,6 +28,13 @@ parsed through the same loader, :mod:`tpuflow.obs.report`)::
       flight-record bundle (renders the ``tier_traces`` the router
       bundled).
 
+  python -m tpuflow.cli.obs slo-report <bundle|file|url>
+      objective-by-objective SLO verdicts (ISSUE 20): latency
+      percentiles vs thresholds and multiwindow error-budget burn
+      rates with margins. Takes a frontend ``/v1/slo`` URL, a saved
+      copy of that JSON, or a flight-record bundle (renders its
+      ``slo.json`` section).
+
   python -m tpuflow.cli.obs memreport <bundle-or-root>
       the memory-and-compile plane of a bundle (ISSUE 7): the
       device-buffer ledger (per-component bytes + peaks + untagged
@@ -84,6 +91,29 @@ def _load_tier_traces(path: str) -> List[dict]:
             for rid, spans in sorted((tt or {}).items())]
 
 
+def _load_slo_report(path: str) -> Optional[dict]:
+    """Resolve an ``slo-report`` operand into a report dict: a
+    frontend ``/v1/slo`` URL, a saved copy of that JSON, or a
+    flight-record bundle carrying the ``slo`` provider section."""
+    import json
+
+    if path.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(path, timeout=10) as r:
+            return json.load(r)
+    import os
+
+    if os.path.isdir(path):
+        from tpuflow.obs.flight import load
+
+        sec = load(path).get("slo")
+        return sec if isinstance(sec, dict) else None
+    with open(path) as f:
+        obj = json.load(f)
+    return obj if isinstance(obj, dict) else None
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="tpuflow.cli.obs",
                                 description=__doc__.splitlines()[0])
@@ -112,7 +142,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(ledger + executables + KV sub-view)")
     pm.add_argument("path", help="bundle directory (or the dump root — "
                                  "newest bundle wins)")
+    ps = sub.add_parser("slo-report",
+                        help="objective-by-objective SLO verdicts "
+                             "(latency + burn-rate margins)")
+    ps.add_argument("path", help="frontend /v1/slo URL, a saved SLO "
+                                 "report JSON, or a flight bundle")
     args = p.parse_args(argv)
+
+    if args.cmd == "slo-report":
+        from tpuflow.obs.slo import format_slo_report
+
+        try:
+            report = _load_slo_report(args.path)
+        except (OSError, ValueError) as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        if not report or "objectives" not in report:
+            print(f"no SLO report under {args.path}", file=sys.stderr)
+            return 1
+        print(format_slo_report(report))
+        return 0
 
     if args.cmd == "trace-report":
         from tpuflow.obs.report import tier_timeline

@@ -91,14 +91,36 @@ def split_phase(name: str):
     return name[:m.start()] + "." + m.group(1), m.group(2)
 
 
-def _label(rep, extra: str = "", phase=None) -> str:
-    # label order is pinned (le, phase, replica): the golden tests —
-    # and any operator's recording rules — match rendered lines
-    # verbatim, so phase slots between the existing labels without
-    # moving them
+#: per-version metric cuts (ISSUE 20): the serve metrics record the
+#: hot request-outcome families a second time under
+#: ``<prefix>.version.<label>.<metric>`` so blue and green stay
+#: comparable mid-rollout — the exposition folds the marker into a
+#: ``version="<label>"`` label. Labels come from
+#: :func:`tpuflow.serve.deploy.version_label` (``step<N>-<crc8hex>``)
+#: whose alphabet is registry-name safe.
+_VERSION_RE = re.compile(r"\.version\.([A-Za-z0-9_\-]+)(?=\.)")
+
+
+def split_version(name: str):
+    """``serve.version.step2-ab12cd34.ttft_ms`` →
+    ``("serve.ttft_ms", "step2-ab12cd34")``; names without the marker
+    pass through as ``(name, None)``."""
+    m = _VERSION_RE.search(name)
+    if m is None:
+        return name, None
+    return name[:m.start()] + name[m.end():], m.group(1)
+
+
+def _label(rep, extra: str = "", phase=None, version=None) -> str:
+    # label order is pinned (le, phase, replica, version): the golden
+    # tests — and any operator's recording rules — match rendered
+    # lines verbatim, so each new label slots in without moving the
+    # existing ones (version appends after replica, ISSUE 20)
     parts = [p for p in (extra,
                          None if phase is None else f'phase="{phase}"',
-                         None if rep is None else f'replica="{rep}"')
+                         None if rep is None else f'replica="{rep}"',
+                         None if version is None
+                         else f'version="{version}"')
              if p]
     return "{" + ",".join(parts) + "}" if parts else ""
 
@@ -146,30 +168,34 @@ def render(prefix: Optional[str] = None, stride: int = 8) -> str:
 
     def _families(d: Dict[str, object]) -> "Dict[str, list]":
         # fold serve.replica<i>.* members into one family per metric,
-        # keyed (replica_label, phase_label, value); phase members
-        # (req_phase_ms.<ph> / ttft_breakdown.<ph>) fold the same way;
-        # plain names stay label-free
+        # keyed (replica_label, phase_label, version_label, value);
+        # version-cut members (.version.<label>., ISSUE 20) and phase
+        # members (req_phase_ms.<ph> / ttft_breakdown.<ph>) fold the
+        # same way; plain names stay label-free
         fams: Dict[str, list] = {}
         for name in sorted(d):
             fam, rep = split_replica(name)
+            fam, ver = split_version(fam)
             fam, ph = split_phase(fam)
-            fams.setdefault(fam, []).append((rep, ph, d[name]))
+            fams.setdefault(fam, []).append((rep, ph, ver, d[name]))
         return fams
 
     for fam, members in sorted(_families(scalars).items()):
         mn = metric_name(fam)
         lines.append(f"# HELP {mn} tpuflow gauge {fam}")
         lines.append(f"# TYPE {mn} gauge")
-        for rep, ph, v in members:
-            lines.append(f"{mn}{_label(rep, phase=ph)} {_fmt(v)}")
+        for rep, ph, ver, v in members:
+            lines.append(
+                f"{mn}{_label(rep, phase=ph, version=ver)} {_fmt(v)}")
     for fam, members in sorted(_families(cntrs).items()):
         mn = metric_name(fam)
         if not mn.endswith("_total"):
             mn += "_total"
         lines.append(f"# HELP {mn} tpuflow counter {fam}")
         lines.append(f"# TYPE {mn} counter")
-        for rep, ph, v in members:
-            lines.append(f"{mn}{_label(rep, phase=ph)} {_fmt(v)}")
+        for rep, ph, ver, v in members:
+            lines.append(
+                f"{mn}{_label(rep, phase=ph, version=ver)} {_fmt(v)}")
     bounds = bucket_bounds()
     # every stride-th bound STARTING AT THE FIRST: with the default
     # stride 8 on the 2**(1/8) grid that is exactly 1e-3 * 2^k — the
@@ -180,7 +206,7 @@ def render(prefix: Optional[str] = None, stride: int = 8) -> str:
         mn = metric_name(fam)
         lines.append(f"# HELP {mn} tpuflow histogram {fam}")
         lines.append(f"# TYPE {mn} histogram")
-        for rep, ph, hist in members:
+        for rep, ph, ver, hist in members:
             st = hist.state()
             cum = 0
             i0 = 0
@@ -193,14 +219,19 @@ def render(prefix: Optional[str] = None, stride: int = 8) -> str:
                 # label 17 digits of noise in dashboards
                 le = f'le="{bounds[bi]:.6g}"'
                 lines.append(
-                    f"{mn}_bucket{_label(rep, le, phase=ph)} {cum}")
+                    f"{mn}_bucket"
+                    f"{_label(rep, le, phase=ph, version=ver)} {cum}")
             cum += sum(st["counts"][i0:])
             le_inf = 'le="+Inf"'
             lines.append(
-                f"{mn}_bucket{_label(rep, le_inf, phase=ph)} {cum}")
+                f"{mn}_bucket"
+                f"{_label(rep, le_inf, phase=ph, version=ver)} {cum}")
             lines.append(
-                f"{mn}_sum{_label(rep, phase=ph)} {_fmt(st['total'])}")
-            lines.append(f"{mn}_count{_label(rep, phase=ph)} {st['n']}")
+                f"{mn}_sum{_label(rep, phase=ph, version=ver)}"
+                f" {_fmt(st['total'])}")
+            lines.append(
+                f"{mn}_count{_label(rep, phase=ph, version=ver)}"
+                f" {st['n']}")
     return "\n".join(lines) + "\n"
 
 

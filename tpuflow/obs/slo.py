@@ -1,0 +1,410 @@
+"""Multiwindow burn-rate SLO evaluation over snapshot-ring deltas
+(ISSUE 20) — the decision layer of the metrics plane.
+
+PR 19 left tpuflow with rich *signals* (phase vectors, windowed
+percentiles, merged traces) and nothing that CONSUMES them: no code
+answered "is this tier meeting its objectives right now?". This module
+does, with zero new collection machinery: objectives are declared
+against registry metric names and evaluated by DELTA-DIFFERENCING the
+:class:`tpuflow.obs.timeseries.SnapshotRing` captures the metrics
+plane already takes — the same ``increase()``/``histogram_quantile``
+idiom a Prometheus server applies to the exported families, done
+in-process so verdicts ride ``/v1/slo``, ``load_snapshot()`` and
+flight bundles without a scrape loop.
+
+Two objective kinds:
+
+- **latency** — ``pP(metric)`` over a trailing window must stay under
+  a threshold (``serve.ttft_ms:p95<2000@60``);
+- **error budget** — the SRE burn-rate idiom: ``burn = (bad/total) /
+  budget`` per window, evaluated over a SHORT and a LONG window
+  simultaneously and breaching only when BOTH burn past the
+  threshold. The short window makes detection fast; the long window
+  keeps a brief blip from paging (a short spike alone recovers before
+  the long window confirms it). ``serve.requests_failed_total/
+  serve.requests_done_total<0.01@60/300x2`` reads "burning >2x the
+  budget that would spend 1% of requests, confirmed on both the 60 s
+  and 300 s windows".
+
+Metric names FOLD the way the Prometheus exposition folds them: an
+objective on ``serve.ttft_ms`` aggregates ``serve.replica<i>.ttft_ms``
+(and any ``.version.<label>.`` cuts) across the in-process tier, so
+one declaration covers a multi-replica frontend.
+
+Pure host policy: lists, dicts and the registry — no jax, no device
+work (pinned by a grep-guard test, the PR 7/8 idiom). The canary
+scorer (:mod:`tpuflow.serve.canary`) and the roadmap's autoscaler read
+the same evaluator.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpuflow.obs.gauges import counters as _counters
+from tpuflow.obs.gauges import histograms as _histograms
+from tpuflow.obs.prom import split_replica, split_version
+from tpuflow.obs.timeseries import SnapshotRing, delta_histogram
+
+
+def fold_metric(name: str) -> str:
+    """Registry name → the folded family name an objective matches:
+    ``serve.replica0.version.step2-ab.ttft_ms`` → ``serve.ttft_ms``
+    (exactly the Prometheus-exposition fold, minus the phase split —
+    phase members are distinct metrics an objective names directly)."""
+    fam, _ = split_replica(name)
+    fam, _ = split_version(fam)
+    return fam
+
+
+# latency: [name=]metric:pP<T[ms]@W[s]
+_LAT_RE = re.compile(
+    r"^(?:(?P<name>[\w\-]+)=)?(?P<metric>[\w.\-]+):p(?P<pct>\d+(?:\.\d+)?)"
+    r"<(?P<thresh>\d+(?:\.\d+)?)(?:ms)?@(?P<win>\d+(?:\.\d+)?)s?$")
+# budget: [name=]bad[+bad2]/total[+total2]<B@Ws[s]/Wl[s][xF]
+_BUD_RE = re.compile(
+    r"^(?:(?P<name>[\w\-]+)=)?(?P<bad>[\w.\-+]+)/(?P<total>[\w.\-+]+)"
+    r"<(?P<budget>\d*\.?\d+)@(?P<short>\d+(?:\.\d+)?)s?/"
+    r"(?P<long>\d+(?:\.\d+)?)s?(?:x(?P<burn>\d+(?:\.\d+)?))?$")
+
+
+def _qualify(metric: str, prefix: str) -> str:
+    """Bare metric names (no dot) pick up the serve prefix —
+    ``ttft_ms`` → ``serve.ttft_ms`` — so CLI declarations stay
+    short; dotted names pass through untouched."""
+    return metric if "." in metric else f"{prefix}.{metric}"
+
+
+@dataclass(frozen=True)
+class SLObjective:
+    """One declared objective: ``(metric, window, threshold |
+    error-budget)``. Latency objectives set ``threshold_ms``; budget
+    objectives set ``budget`` + ``total_metrics`` (multiwindow:
+    ``window_s`` short, ``long_window_s`` long, tripping only when
+    both burn >= ``burn_threshold``)."""
+
+    name: str
+    metrics: Tuple[str, ...]            # latency metric, or bad counters
+    window_s: float = 60.0
+    # latency kind
+    percentile: float = 95.0
+    threshold_ms: Optional[float] = None
+    # error-budget kind
+    budget: Optional[float] = None      # allowed bad fraction
+    total_metrics: Tuple[str, ...] = field(default_factory=tuple)
+    long_window_s: Optional[float] = None
+    burn_threshold: float = 1.0
+
+    @property
+    def kind(self) -> str:
+        return "latency" if self.threshold_ms is not None else "budget"
+
+    @staticmethod
+    def parse(spec: str, prefix: str = "serve") -> "SLObjective":
+        """Compact declaration grammar (the ``--slo`` CLI syntax)::
+
+            [name=]metric:pP<THRESH[ms]@WINDOW[s]
+            [name=]bad[+bad]/total[+total]<BUDGET@SHORT[s]/LONG[s][xBURN]
+
+        e.g. ``ttft=serve.ttft_ms:p95<2000@60`` or
+        ``errors=requests_failed_total/requests_done_total<0.01@60/300x2``.
+        Bare metric names take the ``serve.`` prefix."""
+        s = spec.strip()
+        m = _LAT_RE.match(s)
+        if m:
+            metric = _qualify(m.group("metric"), prefix)
+            return SLObjective(
+                name=m.group("name") or metric.rsplit(".", 1)[-1],
+                metrics=(metric,),
+                window_s=float(m.group("win")),
+                percentile=float(m.group("pct")),
+                threshold_ms=float(m.group("thresh")),
+            )
+        m = _BUD_RE.match(s)
+        if m:
+            bad = tuple(_qualify(b, prefix)
+                        for b in m.group("bad").split("+"))
+            total = tuple(_qualify(t, prefix)
+                          for t in m.group("total").split("+"))
+            return SLObjective(
+                name=m.group("name") or "budget",
+                metrics=bad,
+                total_metrics=total,
+                window_s=float(m.group("short")),
+                long_window_s=float(m.group("long")),
+                budget=float(m.group("budget")),
+                burn_threshold=float(m.group("burn") or 1.0),
+            )
+        raise ValueError(
+            f"unparseable SLO spec {spec!r} — expected "
+            f"'[name=]metric:pP<T@W' (latency) or "
+            f"'[name=]bad/total<B@Ws/Wl[xF]' (error budget)")
+
+
+def default_objectives(prefix: str = "serve") -> List[SLObjective]:
+    """The stock serving objectives ``--slo default`` installs: TTFT
+    and ITL p95 ceilings plus a request error budget (failure
+    terminals + transfer fallbacks over completions) burned on
+    60 s / 300 s windows."""
+    return [
+        SLObjective.parse(f"ttft={prefix}.ttft_ms:p95<2000@60"),
+        SLObjective.parse(f"itl={prefix}.itl_ms:p95<200@60"),
+        SLObjective.parse(
+            f"errors={prefix}.requests_failed_total"
+            f"+{prefix}.kv_transfer_failures_total"
+            f"/{prefix}.requests_done_total"
+            f"+{prefix}.requests_failed_total<0.01@60/300x1"),
+    ]
+
+
+class SLOEvaluator:
+    """Evaluate objectives against the live registry + a snapshot
+    ring.
+
+    ``ring=None`` reads the process default ring
+    (:func:`tpuflow.obs.timeseries.default_ring`); with no ring at all
+    the windows degrade to cumulative-since-start (PR 5 semantics) and
+    the report says so per objective (``windowed: false``).
+    :meth:`report` caches for ``cache_s`` so hot surfaces
+    (``load_snapshot``, flight providers) can quote verdicts without
+    paying a delta walk per call; :meth:`evaluate` always recomputes.
+    The clock is injectable for virtual-clock tests and benches."""
+
+    def __init__(self, objectives: List[SLObjective], *,
+                 ring: Optional[SnapshotRing] = None,
+                 clock=time.time, cache_s: float = 5.0):
+        if not objectives:
+            raise ValueError("SLOEvaluator needs at least one objective")
+        self.objectives = list(objectives)
+        self._ring = ring
+        self.clock = clock
+        self.cache_s = float(cache_s)
+        self._lock = threading.Lock()
+        self._cache: Optional[Dict[str, Any]] = None
+        self._cache_t = -math.inf
+
+    # ---- windowed reads (fold-aware) --------------------------------
+    def _the_ring(self) -> Optional[SnapshotRing]:
+        if self._ring is not None:
+            return self._ring
+        from tpuflow.obs import timeseries
+
+        ring = timeseries.default_ring()
+        return ring if (ring is not None and len(ring)) else None
+
+    def _baseline(self, ring, window_s: float, now: float):
+        if ring is None:
+            return None
+        return ring._baseline(window_s, now)
+
+    def _windowed_hist(self, ring, metric: str, window_s: float,
+                       now: float):
+        """Sum of windowed deltas across every registry histogram that
+        folds to ``metric`` (replica/version members of one family);
+        None when no histogram matches."""
+        base = self._baseline(ring, window_s, now)
+        agg = None
+        for name, h in _histograms().items():
+            if fold_metric(name) != metric:
+                continue
+            d = delta_histogram(
+                h.state(), (base or {}).get("hists", {}).get(name))
+            if agg is None:
+                agg = d
+            else:
+                agg.merge(d)
+        return agg
+
+    def _windowed_counter(self, ring, metrics: Tuple[str, ...],
+                          window_s: float, now: float) -> float:
+        """Summed windowed increase across every registry counter that
+        folds to one of ``metrics`` (clamped at 0 per member — the
+        counter-reset idiom)."""
+        base = self._baseline(ring, window_s, now)
+        bc = (base or {}).get("counters", {})
+        tot = 0.0
+        for name, v in _counters().items():
+            if fold_metric(name) in metrics:
+                tot += max(0.0, float(v) - float(bc.get(name, 0.0)))
+        return tot
+
+    # ---- evaluation -------------------------------------------------
+    def _eval_latency(self, ring, o: SLObjective, now: float,
+                      windowed: bool) -> Dict[str, Any]:
+        h = self._windowed_hist(ring, o.metrics[0], o.window_s, now)
+        v: Dict[str, Any] = {
+            "name": o.name, "kind": "latency", "metric": o.metrics[0],
+            "percentile": o.percentile, "threshold_ms": o.threshold_ms,
+            "window_s": o.window_s, "windowed": windowed,
+        }
+        n = h.n if h is not None else 0
+        if n == 0:
+            # a window with no traffic can't breach a latency SLO —
+            # but the verdict says it judged nothing
+            v.update(ok=True, value_ms=None, count=0, margin=None,
+                     insufficient_data=True)
+            return v
+        pct = h.percentile(o.percentile)
+        ok = pct <= o.threshold_ms
+        v.update(
+            ok=bool(ok), value_ms=round(float(pct), 3), count=int(n),
+            margin=round((o.threshold_ms - pct) / o.threshold_ms, 4),
+        )
+        return v
+
+    def _eval_budget(self, ring, o: SLObjective, now: float,
+                     windowed: bool) -> Dict[str, Any]:
+        long_w = o.long_window_s or 5 * o.window_s
+
+        def burn(w: float):
+            bad = self._windowed_counter(ring, o.metrics, w, now)
+            tot = self._windowed_counter(ring, o.total_metrics, w, now)
+            rate = (bad / tot) if tot else 0.0
+            return (rate / o.budget if o.budget else math.inf,
+                    bad, tot)
+
+        b_short, bad_s, tot_s = burn(o.window_s)
+        b_long, bad_l, tot_l = burn(long_w)
+        # multiwindow AND: the short window detects fast, the long
+        # window confirms it isn't a blip — the binding quantity is
+        # the SMALLER burn
+        binding = min(b_short, b_long)
+        ok = binding < o.burn_threshold
+        v: Dict[str, Any] = {
+            "name": o.name, "kind": "budget",
+            "bad_metrics": list(o.metrics),
+            "total_metrics": list(o.total_metrics),
+            "budget": o.budget, "burn_threshold": o.burn_threshold,
+            "window_s": o.window_s, "long_window_s": long_w,
+            "windowed": windowed,
+            "burn_short": round(b_short, 4),
+            "burn_long": round(b_long, 4),
+            "bad_short": bad_s, "total_short": tot_s,
+            "bad_long": bad_l, "total_long": tot_l,
+            "ok": bool(ok),
+            "margin": round(
+                (o.burn_threshold - binding) / o.burn_threshold, 4),
+        }
+        if tot_s == 0 and tot_l == 0:
+            v["insufficient_data"] = True
+        return v
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Recompute every objective's verdict: ``{ts, ok,
+        objectives: [...]}`` with per-objective margins (positive =
+        headroom as a fraction of the threshold)."""
+        t = self.clock() if now is None else now
+        ring = self._the_ring()
+        windowed = ring is not None
+        verdicts = []
+        for o in self.objectives:
+            if o.kind == "latency":
+                verdicts.append(self._eval_latency(ring, o, t, windowed))
+            else:
+                verdicts.append(self._eval_budget(ring, o, t, windowed))
+        report = {
+            "ts": t,
+            "ok": all(v["ok"] for v in verdicts),
+            "objectives": verdicts,
+        }
+        with self._lock:
+            self._cache = report
+            self._cache_t = t
+        return report
+
+    def report(self, max_age_s: Optional[float] = None) -> Dict[str, Any]:
+        """The most recent evaluation, recomputed when older than
+        ``max_age_s`` (default ``cache_s``) — what hot surfaces
+        quote."""
+        age = self.cache_s if max_age_s is None else float(max_age_s)
+        now = self.clock()
+        with self._lock:
+            cached = self._cache
+            fresh = cached is not None and (now - self._cache_t) <= age
+        if fresh:
+            return cached
+        return self.evaluate(now)
+
+    def verdicts_compact(self) -> Dict[str, Dict[str, Any]]:
+        """``{name: {ok, margin}}`` — the load_snapshot-sized view."""
+        rep = self.report()
+        return {
+            v["name"]: {"ok": v["ok"], "margin": v.get("margin")}
+            for v in rep["objectives"]
+        }
+
+
+# ---- process default evaluator (the /v1/slo + flight surface) -------
+
+_DEFAULT: Optional[SLOEvaluator] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def install(evaluator: SLOEvaluator) -> SLOEvaluator:
+    """Make ``evaluator`` the process default: ``/v1/slo`` serves its
+    report, ``load_snapshot()`` quotes its compact verdicts, and every
+    flight bundle captures an ``slo.json`` section. Last install
+    wins."""
+    global _DEFAULT
+    from tpuflow.obs import flight
+
+    with _DEFAULT_LOCK:
+        _DEFAULT = evaluator
+    flight.add_provider("slo", lambda: (
+        _DEFAULT.report() if _DEFAULT is not None else None))
+    return evaluator
+
+
+def uninstall() -> None:
+    global _DEFAULT
+    from tpuflow.obs import flight
+
+    with _DEFAULT_LOCK:
+        _DEFAULT = None
+    flight.remove_provider("slo")
+
+
+def default_evaluator() -> Optional[SLOEvaluator]:
+    return _DEFAULT
+
+
+# ---- text rendering (cli.obs slo-report) ----------------------------
+
+def format_slo_report(report: Dict[str, Any]) -> str:
+    """One objective per row: verdict, value vs threshold, margin —
+    the ``cli.obs slo-report`` renderer (beside ``trace-report``)."""
+    lines = [f"SLO report  ts={report.get('ts', 0):.3f}  "
+             f"overall={'OK' if report.get('ok') else 'BREACH'}"]
+    for v in report.get("objectives", []):
+        mark = "ok " if v.get("ok") else "FAIL"
+        extra = " (no data)" if v.get("insufficient_data") else ""
+        win = ("" if v.get("windowed", True)
+               else " [cumulative: no ring]")
+        if v.get("kind") == "latency":
+            val = v.get("value_ms")
+            val_s = "-" if val is None else f"{val:.1f}ms"
+            lines.append(
+                f"  [{mark}] {v['name']:<12} p{v['percentile']:g} "
+                f"{v['metric']} = {val_s} "
+                f"(< {v['threshold_ms']:g}ms @ {v['window_s']:g}s, "
+                f"margin {_fmt_margin(v.get('margin'))})"
+                f"{extra}{win}")
+        else:
+            lines.append(
+                f"  [{mark}] {v['name']:<12} burn "
+                f"{v.get('burn_short', 0):.2f}x/{v.get('burn_long', 0):.2f}x "
+                f"(budget {v['budget']:g} @ {v['window_s']:g}s/"
+                f"{v['long_window_s']:g}s, trip >= "
+                f"{v['burn_threshold']:g}x, "
+                f"margin {_fmt_margin(v.get('margin'))})"
+                f"{extra}{win}")
+    return "\n".join(lines)
+
+
+def _fmt_margin(m) -> str:
+    return "-" if m is None else f"{m * 100:+.1f}%"

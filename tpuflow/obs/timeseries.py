@@ -225,6 +225,22 @@ class SnapshotRing:
         cur = _counters().get(name, 0.0)
         return max(0.0, cur - base["counters"][name]) / dt
 
+    def counter_increase(self, name: str,
+                         window_s: Optional[float] = None,
+                         now: Optional[float] = None) -> Optional[float]:
+        """Absolute increase of counter ``name`` over the trailing
+        window — the Prometheus ``increase()`` idiom, clamped at 0
+        like :func:`delta_histogram` (a reset counter under-reports
+        until the baseline rotates out rather than going negative).
+        None when the ring has no baseline yet; a counter born inside
+        the window counts in full (baseline value 0). The SLO
+        evaluator's error-budget burn reads (ISSUE 20)."""
+        base = self._baseline(window_s, now)
+        if base is None:
+            return None
+        cur = _counters().get(name, 0.0)
+        return max(0.0, cur - base["counters"].get(name, 0.0))
+
     # ---- export -----------------------------------------------------
     def export(self) -> Dict[str, Any]:
         """JSON-able dump of the ring — per-snapshot counters and
@@ -346,3 +362,15 @@ def windowed_summaries(prefix: Optional[str] = None
     if ring is None or not len(ring):
         return {}
     return ring.summaries(prefix=prefix)
+
+
+def windowed_counter_increase(name: str,
+                              window_s: Optional[float] = None
+                              ) -> Optional[float]:
+    """Default-ring :meth:`SnapshotRing.counter_increase`; None when
+    no ring is ticking (callers degrade to their cumulative view —
+    PR 5 semantics)."""
+    ring = _DEFAULT
+    if ring is None or not len(ring):
+        return None
+    return ring.counter_increase(name, window_s)

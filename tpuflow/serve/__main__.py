@@ -100,6 +100,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--deploy-poll", type=float, default=2.0,
                    metavar="S",
                    help="--watch-checkpoints: poll interval")
+    p.add_argument("--slo", action="append", default=None,
+                   metavar="SPEC",
+                   help="install an SLO objective (repeatable): "
+                        "'[name=]metric:pP<T@W' (latency) or "
+                        "'[name=]bad/total<B@Ws/Wl[xF]' (error "
+                        "budget); 'default' installs the stock "
+                        "serving objectives. Verdicts ride /v1/slo, "
+                        "load_snapshot and flight bundles")
+    p.add_argument("--canary", action="store_true",
+                   help="score the first rotation of every rollout "
+                        "as a canary (new-vs-old version cuts) and "
+                        "auto-roll-back on a breach; needs --standby")
+    p.add_argument("--canary-windows", type=int, default=3,
+                   metavar="N",
+                   help="--canary: clean evaluation windows before "
+                        "full rotation")
+    p.add_argument("--canary-window", type=float, default=15.0,
+                   metavar="SECS",
+                   help="--canary: evaluation window length")
+    p.add_argument("--canary-min-requests", type=int, default=8,
+                   metavar="N",
+                   help="--canary: per-version per-window request "
+                        "floor below which a window is inconclusive")
     p.add_argument("--deploy-replay", type=int, default=8,
                    metavar="N",
                    help="--watch-checkpoints: hottest prefix-chain "
@@ -291,6 +314,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.watch_checkpoints and not args.standby:
         p.error("--watch-checkpoints needs --standby (the rollout "
                 "restores into the standby replica's buffers)")
+    if args.canary and not args.standby:
+        p.error("--canary needs --standby (scoring judges the "
+                "blue/green window a rollout opens)")
     if args.standby and not args.connect and args.kv != "paged":
         # hot prefix replay and prefix invalidation are paged-KV
         # concepts; the swap itself would work, but an un-warmed
@@ -507,6 +533,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             detector.start()
         server = start_http_server(front, args.host, args.port,
                                    request_timeout_s=args.request_timeout)
+        if args.slo:
+            # SLO plane (ISSUE 20): objectives evaluate as multiwindow
+            # burn rates over the snapshot ring the frontend already
+            # ticks; verdicts ride /v1/slo, load_snapshot and flight
+            from tpuflow.obs.slo import (
+                SLObjective,
+                SLOEvaluator,
+                default_objectives,
+                install as install_slo,
+            )
+
+            objectives = []
+            for spec in args.slo:
+                if spec.strip() == "default":
+                    objectives.extend(default_objectives())
+                else:
+                    try:
+                        objectives.append(SLObjective.parse(spec))
+                    except ValueError as e:
+                        p.error(str(e))
+            install_slo(SLOEvaluator(objectives))
+            print(f"SLO objectives installed: "
+                  f"{', '.join(o.name for o in objectives)} "
+                  f"(GET /v1/slo)", flush=True)
         watcher = None
         if args.watch_checkpoints:
             # zero-downtime deployment (ISSUE 15): poll the namespace;
@@ -518,9 +568,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                 ModelWatcher,
             )
 
+            canary_policy = None
+            if args.canary:
+                from tpuflow.serve.canary import CanaryPolicy
+
+                canary_policy = CanaryPolicy(
+                    windows=args.canary_windows,
+                    window_s=args.canary_window,
+                    min_requests=args.canary_min_requests)
             manager = DeploymentManager(
                 front, replay_hot=args.deploy_replay,
-                drain_timeout_s=max(60.0, 2 * args.drain_timeout))
+                drain_timeout_s=max(60.0, 2 * args.drain_timeout),
+                canary=canary_policy)
             if hasattr(front, "on_maintain"):
                 # rollouts also advance on the router's maintenance
                 # cadence (tick() serializes against the watcher's

@@ -1,0 +1,357 @@
+"""Canary scoring for blue/green rollouts (ISSUE 20) — the decision
+that closes the deployment loop.
+
+PR 15's :class:`~tpuflow.serve.deploy.DeploymentManager` rotates a
+weight push to 100% of the tier on pure mechanics: if the swap
+succeeds, the version ships. This module makes the FIRST rotation a
+judged canary window: while the new-version replica and the remaining
+old-version replicas both serve traffic, a :class:`CanaryScorer`
+delta-differences the tier's per-version metric cuts
+(:meth:`Router.version_snapshot`, ISSUE 20) per evaluation window and
+compares new vs old on the signals that matter:
+
+- **windowed error rate** — failure terminals + transfer fallbacks
+  over completions, absolute ceiling AND ratio vs old;
+- **ttft/itl p95 ratios** — the latency regressions a user feels;
+- **phase-vector regressions** — the PR 19 per-phase p95s localize
+  WHY a bad version is bad (a transfer blowup vs a queue_wait blowup
+  name different suspects) — annotation, not an independent trigger;
+- optional **pin_version quality probes** — prompts with expected
+  token outputs, pinned to the new version (PR 15's token-identical
+  per-version A/B), run as the final gate before full rotation.
+
+Verdicts: ``retire_new`` (the push is bad — the manager drains the
+NEW replica with the same zero-truncation machinery a normal rotation
+uses on old ones and recycles it as standby; the tier never rotates
+past the canary) or ``retire_old`` (proceed with the normal
+rotation). Scoring happens on the manager's :meth:`tick` cadence —
+never on the router's submit hot path — and all arithmetic is plain
+host dicts/lists (pure host policy, pinned by the same grep-guard
+idiom as the router tier).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tpuflow.obs.timeseries import delta_histogram
+
+#: histogram names inside a version cut compared at p95
+_LATENCY_HISTS = ("ttft_ms", "itl_ms")
+
+
+@dataclass
+class CanaryPolicy:
+    """Scoring thresholds for one canary window sequence. Defaults
+    suit a bench/test cadence; production wants ``window_s`` at tens
+    of seconds (burn-rate window sizing in README: the window must
+    see ``min_requests`` of BOTH versions or it scores as
+    inconclusive and is retried, not counted)."""
+
+    #: clean evaluation windows required before retire_old
+    windows: int = 3
+    #: evaluation window length (manager-clock seconds)
+    window_s: float = 5.0
+    #: per-window per-version request floor below which the window is
+    #: inconclusive (neither counted nor failed — traffic decides)
+    min_requests: int = 8
+    #: absolute new-version windowed error-rate ceiling
+    max_error_rate: float = 0.05
+    #: new/old windowed error-rate ratio that breaches (only past the
+    #: absolute ceiling — a 0.1% vs 0.01% ratio is noise, not a fire)
+    error_ratio: float = 3.0
+    #: new/old p95 ratio on ttft_ms / itl_ms that breaches
+    latency_ratio: float = 1.5
+    #: new/old per-phase p95 ratio recorded as a localization
+    #: annotation (phase regressions explain a breach, never trigger
+    #: one alone)
+    phase_ratio: float = 2.0
+    #: consecutive bad windows that retire the new version early
+    fail_windows: int = 2
+    #: liveness cap: consecutive INCONCLUSIVE windows after which the
+    #: scorer concludes anyway instead of holding the blue/green
+    #: window forever on a drained tier (a hold with zero traffic can
+    #: never score). Any unconfirmed bad window biases the forced
+    #: verdict to retire_new; a clean-but-idle hold completes the
+    #: rollout (matching what a canary-less push would have done),
+    #: running the quality probes first when configured. 0 disables.
+    max_idle_windows: int = 40
+    #: optional quality probes: ``(prompt_tokens, expected_tokens)``
+    #: pairs submitted pinned to the NEW version as the final gate
+    quality_probes: Tuple = field(default_factory=tuple)
+    #: wall budget for the probe phase before it fails closed
+    probe_timeout_s: float = 60.0
+
+
+class CanaryScorer:
+    """Score one rollout's new-vs-old version cuts window by window.
+
+    Drive with :meth:`tick` on the deployment manager's cadence (the
+    clock is injectable — virtual-clock benches and tests pass the
+    tier's clock). The scorer owns its captures: each window's
+    comparison is ``version_snapshot(now) - version_snapshot(window
+    start)``, so it needs no snapshot ring and works under any
+    clock."""
+
+    def __init__(self, router, *, old_label: str, new_label: str,
+                 policy: Optional[CanaryPolicy] = None,
+                 clock: Callable[[], float] = time.time):
+        self.router = router
+        self.old_label = str(old_label)
+        self.new_label = str(new_label)
+        self.policy = policy or CanaryPolicy()
+        self.clock = clock
+        self.windows_scored = 0
+        self.consecutive_bad = 0
+        self.consecutive_inconclusive = 0
+        self.bad_windows = 0
+        self._starved_reason: Optional[str] = None
+        self.window_results: List[Dict[str, Any]] = []
+        self._base: Optional[Dict[str, Any]] = None
+        self._next_t: Optional[float] = None
+        self._verdict: Optional[str] = None
+        self._probes: Optional[List[Any]] = None
+        self._probe_t0: Optional[float] = None
+        self._probe_failures: List[str] = []
+
+    # ---- lifecycle ---------------------------------------------------
+    def begin(self) -> None:
+        """Capture the baseline cut and arm the first window."""
+        self._base = self.router.version_snapshot()
+        self._next_t = self.clock() + self.policy.window_s
+
+    def tick(self) -> Optional[str]:
+        """Advance: score a window when one is due, run the probe
+        gate when the horizon is reached. Returns the final verdict
+        (``retire_new`` / ``retire_old``) once decided, else None
+        (keep scoring)."""
+        if self._verdict is not None:
+            return self._verdict
+        if self._base is None:
+            self.begin()
+            return None
+        if self._probes is not None:
+            return self._tick_probes()
+        if self.clock() < self._next_t:
+            return None
+        self._next_t = self.clock() + self.policy.window_s
+        self.score_window()
+        return self._verdict
+
+    @property
+    def verdict(self) -> Optional[str]:
+        return self._verdict
+
+    # ---- window scoring ----------------------------------------------
+    @staticmethod
+    def _delta_cut(base: Optional[Dict[str, Any]],
+                   cur: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+        """Windowed view of one version's cut: counter deltas (clamped
+        at 0 — the reset idiom) + delta histograms."""
+        if cur is None:
+            return None
+        b = base or {}
+        out: Dict[str, Any] = {
+            k: max(0, int(cur.get(k, 0)) - int(b.get(k, 0)))
+            for k in ("done", "failed", "transfer_fallbacks",
+                      "tokens_out")
+        }
+        out["requests"] = out["done"] + out["failed"]
+        bh = b.get("hists", {})
+        out["hists"] = {
+            name: delta_histogram(st, bh.get(name))
+            for name, st in cur.get("hists", {}).items()
+        }
+        return out
+
+    @staticmethod
+    def _err(cut: Dict[str, Any]) -> float:
+        reqs = max(1, cut["requests"])
+        return (cut["failed"] + cut["transfer_fallbacks"]) / reqs
+
+    @staticmethod
+    def _p95(cut: Dict[str, Any], name: str) -> Optional[float]:
+        h = cut["hists"].get(name)
+        if h is None or not h.n:
+            return None
+        return h.percentile(95.0)
+
+    def score_window(self) -> Dict[str, Any]:
+        """Compare the window's new-vs-old deltas and fold the result
+        into the running verdict state. Inconclusive windows (either
+        version under the traffic floor) are retried, not counted."""
+        pol = self.policy
+        snap = self.router.version_snapshot()
+        new = self._delta_cut(
+            (self._base or {}).get(self.new_label),
+            snap.get(self.new_label))
+        old = self._delta_cut(
+            (self._base or {}).get(self.old_label),
+            snap.get(self.old_label))
+        self._base = snap
+        res: Dict[str, Any] = {
+            "ts": self.clock(), "bad": False, "inconclusive": False,
+            "reasons": [], "phase_regressions": [],
+            "new_requests": 0 if new is None else new["requests"],
+            "old_requests": 0 if old is None else old["requests"],
+        }
+        if new is None or new["requests"] < pol.min_requests:
+            res["inconclusive"] = True
+            self.window_results.append(res)
+            self.consecutive_inconclusive += 1
+            if (pol.max_idle_windows
+                    and self.consecutive_inconclusive
+                    >= pol.max_idle_windows):
+                # liveness give-up: a drained tier can never feed a
+                # window, and an eternal hold wedges the rollout
+                if self.bad_windows:
+                    self._starved_reason = (
+                        f"canary starved: {self.consecutive_inconclusive}"
+                        f" consecutive idle window(s) with "
+                        f"{self.bad_windows} unconfirmed bad window(s)")
+                    self._verdict = "retire_new"
+                elif pol.quality_probes:
+                    self._start_probes()
+                else:
+                    self._verdict = "retire_old"
+            return res
+        self.consecutive_inconclusive = 0
+        err_new = self._err(new)
+        res["error_rate_new"] = round(err_new, 4)
+        has_old = old is not None and old["requests"] >= pol.min_requests
+        if has_old:
+            err_old = self._err(old)
+            res["error_rate_old"] = round(err_old, 4)
+            if (err_new > pol.max_error_rate
+                    and err_new > pol.error_ratio * max(err_old, 1e-9)):
+                res["reasons"].append(
+                    f"error rate {err_new:.3f} vs old {err_old:.3f} "
+                    f"(> {pol.max_error_rate:g} and > "
+                    f"{pol.error_ratio:g}x old)")
+            for name in _LATENCY_HISTS:
+                pn, po = self._p95(new, name), self._p95(old, name)
+                if pn is None or po is None or po <= 0:
+                    continue
+                ratio = pn / po
+                res[f"{name}_p95_ratio"] = round(ratio, 3)
+                if ratio > pol.latency_ratio:
+                    res["reasons"].append(
+                        f"{name} p95 x{ratio:.2f} "
+                        f"({pn:.1f}ms vs {po:.1f}ms)")
+            # phase localization (never a trigger): WHICH phase of the
+            # PR 19 vector blew up names the suspect subsystem
+            for name in new["hists"]:
+                if not name.startswith("req_phase_ms."):
+                    continue
+                pn, po = self._p95(new, name), self._p95(old, name)
+                if pn is None or po is None or po <= 0:
+                    continue
+                if pn / po > pol.phase_ratio:
+                    res["phase_regressions"].append(
+                        f"{name.split('.', 1)[1]} p95 x{pn / po:.2f}")
+        else:
+            res["no_old_baseline"] = True
+            # no comparand: only the absolute error ceiling can judge
+            if err_new > pol.max_error_rate:
+                res["reasons"].append(
+                    f"error rate {err_new:.3f} > {pol.max_error_rate:g}"
+                    f" (no old-version baseline)")
+        res["bad"] = bool(res["reasons"])
+        self.window_results.append(res)
+        self.windows_scored += 1
+        if res["bad"]:
+            self.bad_windows += 1
+            self.consecutive_bad += 1
+        else:
+            self.consecutive_bad = 0
+        if self.consecutive_bad >= pol.fail_windows:
+            self._verdict = "retire_new"
+        elif self.windows_scored >= pol.windows:
+            if self.bad_windows:
+                # unhealed badness at the horizon: not confident —
+                # protect the tier
+                self._verdict = "retire_new"
+            elif pol.quality_probes:
+                self._start_probes()
+            else:
+                self._verdict = "retire_old"
+        return res
+
+    # ---- quality probes (final gate) ---------------------------------
+    def _start_probes(self) -> None:
+        import numpy as np
+
+        self._probes = []
+        self._probe_t0 = self.clock()
+        for prompt, expected in self.policy.quality_probes:
+            exp = [int(t) for t in expected]
+            try:
+                req = self.router.submit(
+                    np.asarray(prompt, np.int32), len(exp),
+                    pin_version=self.new_label)
+            except Exception as e:
+                self._probe_failures.append(
+                    f"probe submit failed: {type(e).__name__}: {e}")
+                continue
+            self._probes.append((req, exp))
+
+    def _tick_probes(self) -> Optional[str]:
+        pending = []
+        for req, exp in self._probes:
+            state = getattr(req.state, "value", req.state)
+            if state in ("queued", "running"):
+                pending.append((req, exp))
+                continue
+            if state != "done":
+                self._probe_failures.append(
+                    f"probe {state}: {getattr(req, 'error', None)}")
+            elif [int(t) for t in req.tokens] != exp:
+                self._probe_failures.append(
+                    f"probe tokens diverged from expected "
+                    f"({list(req.tokens)[:8]}... vs {exp[:8]}...)")
+        self._probes = pending
+        if pending:
+            if (self.clock() - self._probe_t0
+                    > self.policy.probe_timeout_s):
+                # fail CLOSED: an unanswerable probe is not a pass
+                self._probe_failures.append(
+                    f"{len(pending)} probe(s) timed out after "
+                    f"{self.policy.probe_timeout_s:g}s")
+                self._verdict = "retire_new"
+            return self._verdict
+        self._verdict = ("retire_new" if self._probe_failures
+                         else "retire_old")
+        return self._verdict
+
+    # ---- summary ------------------------------------------------------
+    def reasons(self) -> List[str]:
+        """Every breach reason across scored windows + probe
+        failures — what the rollback record carries."""
+        out: List[str] = []
+        for res in self.window_results:
+            out.extend(res["reasons"])
+        if self._starved_reason:
+            out.append(self._starved_reason)
+        out.extend(self._probe_failures)
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """JSON-able scoring record for the deploy history / flight
+        note: verdict, window tallies, breach reasons, and the phase
+        localizations that say WHY."""
+        phases: List[str] = []
+        for res in self.window_results:
+            phases.extend(res.get("phase_regressions", ()))
+        return {
+            "old": self.old_label, "new": self.new_label,
+            "verdict": self._verdict,
+            "windows_scored": self.windows_scored,
+            "bad_windows": self.bad_windows,
+            "inconclusive_windows": sum(
+                1 for r in self.window_results if r["inconclusive"]),
+            "reasons": self.reasons(),
+            "phase_regressions": phases,
+            "probe_failures": list(self._probe_failures),
+        }

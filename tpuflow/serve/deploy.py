@@ -473,14 +473,28 @@ class DeploymentManager:
     with replica steps (offline tests); :meth:`deploy` is the
     blocking convenience for scripts. ``target='draft'`` pushes draft
     weights through the same rotation (speculative acceptance rises
-    live; target weights untouched)."""
+    live; target weights untouched).
+
+    With a ``canary`` policy (ISSUE 20) the FIRST rotation becomes a
+    judged canary window: after the new version activates, the old
+    replica is NOT retired yet — both serve traffic while a
+    :class:`~tpuflow.serve.canary.CanaryScorer` compares their
+    per-version metric cuts window by window. ``retire_old`` proceeds
+    with the normal rotation (later rotations skip re-scoring — the
+    version is proven); ``retire_new`` ROLLS BACK: the new replica
+    drains through the same zero-truncation machinery and recycles as
+    standby, the rollout finishes degraded (the watcher sees a failed
+    push, never a deployed version), and the tier keeps serving old
+    throughout."""
 
     def __init__(self, router, *, replay_hot: int = 8,
                  drain_timeout_s: float = 300.0,
+                 canary=None,
                  clock: Callable[[], float] = time.time):
         self.router = router
         self.replay_hot = int(replay_hot)
         self.drain_timeout_s = float(drain_timeout_s)
+        self.canary = canary  # Optional[tpuflow.serve.canary.CanaryPolicy]
         self.clock = clock
         self._lock = threading.Lock()
         # serializes tick() bodies: the router's maintenance thread
@@ -556,6 +570,22 @@ class DeploymentManager:
         self.router.activate(idx)
         st["activated"].append(idx)
         st["old_idx"] = old[0]
+        if self.canary is not None and not st.get("canary_done"):
+            # canary window (ISSUE 20): hold the retirement — old and
+            # new both serve while the scorer compares their version
+            # cuts; _tick acts on the verdict
+            from tpuflow.serve.canary import CanaryScorer
+
+            old_version = self.router.replica_version(
+                old[0], target=st["target"])
+            st["canary"] = CanaryScorer(
+                self.router, old_label=version_label(old_version),
+                new_label=st["label"], policy=self.canary,
+                clock=self.clock)
+            st["canary"].begin()
+            st["new_idx"] = idx
+            st["drain_t0"] = None
+            return
         st["drain_t0"] = self.clock()
         self.router.begin_retire(old[0])
 
@@ -618,6 +648,30 @@ class DeploymentManager:
             st = self._state
         if st is None:
             return False
+        scorer = st.get("canary")
+        if scorer is not None and not st.get("canary_done"):
+            verdict = scorer.tick()
+            if verdict is None:
+                return True  # window still open — keep serving both
+            st["canary_done"] = True
+            st["canary_summary"] = scorer.summary()
+            self.router.metrics.event(
+                "-deploy-", "canary_verdict", version=st["label"],
+                verdict=verdict,
+                reasons=scorer.reasons()[:4] or None)
+            if verdict == "retire_new":
+                # ROLLBACK: drain the NEW replica through the same
+                # zero-truncation machinery a rotation uses on old
+                # ones; the old replica was never retired and keeps
+                # serving — the tier never rotates past the canary
+                st["rolled_back"] = True
+                st["old_idx"] = st["new_idx"]
+                st["drain_t0"] = self.clock()
+                self.router.begin_retire(st["new_idx"])
+            else:
+                st["drain_t0"] = self.clock()
+                self.router.begin_retire(st["old_idx"])
+            return True
         old = st["old_idx"]
         if old is None:
             return False
@@ -642,6 +696,14 @@ class DeploymentManager:
         self.router.recycle_as_standby(old)
         st["recycled"].append(old)
         st["old_idx"] = None
+        if st.get("rolled_back"):
+            # the drained replica was the NEW one: rollback complete —
+            # finish degraded so deploy()/the watcher see a FAILED
+            # push, never a deployed version
+            reasons = (st.get("canary_summary") or {}).get("reasons", [])
+            why = "; ".join(reasons[:3]) or "canary breach"
+            self._finish(st, error=f"canary retired new version: {why}")
+            return False
         remaining = self._old_version_actives(st["label"], st["target"])
         if remaining:
             try:
@@ -693,7 +755,10 @@ class DeploymentManager:
             st = self._state
         if st is None:
             return
-        if st["old_idx"] is not None:
+        # only a replica whose RETIREMENT began needs recycling; in a
+        # canary scoring window old_idx is still an ACTIVE replica
+        # (drain_t0 None) and must keep serving
+        if st["old_idx"] is not None and st["drain_t0"] is not None:
             try:
                 self.router.recycle_as_standby(st["old_idx"])
             except Exception:
@@ -723,8 +788,16 @@ class DeploymentManager:
             "noop": noop,
             "error": error,
         }
+        if st.get("canary_summary") is not None:
+            rec["canary"] = st["canary_summary"]
+            rec["rolled_back"] = bool(st.get("rolled_back"))
         self.history.append(rec)
         del self.history[:-16]
+        if st.get("rolled_back"):
+            # a rollback is a PROTECTIVE failure: counted apart from
+            # mechanical deploy failures so a dashboard can tell "the
+            # canary saved us" from "the swap machinery broke"
+            inc_counter("serve.deploy_rollbacks_total")
         if error is not None:
             inc_counter("serve.deploy_failures_total")
         elif noop:

@@ -187,6 +187,18 @@ class _Handler(BaseHTTPRequestHandler):
             snap.update(scalar_gauges("router"))
             snap.update(counters("router"))
             self._json(200, snap)
+        elif self.path == "/v1/slo":
+            # SLO verdicts (ISSUE 20): the process default evaluator's
+            # report — objective-by-objective ok/margins, latency
+            # percentiles vs thresholds, multiwindow burn rates
+            from tpuflow.obs import slo as _slo
+
+            ev = _slo.default_evaluator()
+            if ev is None:
+                return self._json(404, {
+                    "error": "no SLO objectives installed "
+                             "(start the frontend with --slo)"})
+            self._json(200, ev.report())
         elif self.path.startswith("/v1/worker/"):
             if not hasattr(sched, "submit_prefill"):
                 return self._json(404, {
@@ -217,6 +229,10 @@ class _Handler(BaseHTTPRequestHandler):
                            {"retry_after_s": sched.retry_after_s()})
             elif self.path == "/v1/worker/chain_report":
                 self._json(200, {"chains": sched.kv_chain_report()})
+            elif self.path == "/v1/worker/version_snapshot":
+                # per-version metric cuts (ISSUE 20): the canary
+                # scorer's comparand for a worker fronted over HTTP
+                self._json(200, sched.version_snapshot())
             else:
                 self._json(404, {"error": f"no route {self.path}"})
         elif self.path.startswith("/v1/events/"):

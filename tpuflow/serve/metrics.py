@@ -91,6 +91,71 @@ def _bounded_append(lst: list, value, cap: int) -> None:
         del lst[: len(lst) - cap]
 
 
+def _safe_version_label(version) -> Optional[str]:
+    """The served version as a registry-name-safe label, or None for a
+    versionless scheduler. :func:`tpuflow.serve.deploy.version_label`
+    already emits a safe alphabet (``step<N>-<crc8hex>``); anything
+    else is sanitized so a hand-set version can't corrupt registry
+    names or the Prometheus ``version=`` fold."""
+    import re as _re
+
+    label = (version.get("label") if isinstance(version, dict)
+             else version)
+    if label in (None, ""):
+        return None
+    return _re.sub(r"[^A-Za-z0-9_\-]", "-", str(label))
+
+
+class _VersionCut:
+    """One model version's metric cut (ISSUE 20): the hot
+    request-outcome families recorded a SECOND time under
+    ``<prefix>.version.<label>.*`` — TTFT/ITL, the phase vector,
+    error/fallback counts, tokens served — so blue and green are
+    directly comparable mid-rollout. Registered like the uncut
+    families (Prometheus folds the marker into ``version="<label>"``,
+    the snapshot ring windows them); counter mirrors feed
+    :meth:`ServeMetrics.version_snapshot` for the canary scorer."""
+
+    __slots__ = ("label", "prefix", "ttft_ms", "itl_ms", "phase_hists",
+                 "requests_done", "requests_failed",
+                 "transfer_fallbacks", "tokens_out")
+
+    def __init__(self, base_prefix: str, label: str):
+        self.label = label
+        self.prefix = f"{base_prefix}.version.{label}"
+        self.ttft_ms = register_histogram(
+            f"{self.prefix}.ttft_ms", Histogram())
+        self.itl_ms = register_histogram(
+            f"{self.prefix}.itl_ms", Histogram())
+        self.phase_hists = {
+            ph: register_histogram(
+                f"{self.prefix}.req_phase_ms.{ph}", Histogram())
+            for ph in PHASES
+        }
+        self.requests_done = 0
+        self.requests_failed = 0
+        self.transfer_fallbacks = 0
+        self.tokens_out = 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Cumulative counters + raw histogram states — the wire shape
+        :meth:`Router.version_snapshot` sums across replicas and the
+        canary scorer delta-differences per window."""
+        return {
+            "requests": self.requests_done + self.requests_failed,
+            "done": self.requests_done,
+            "failed": self.requests_failed,
+            "transfer_fallbacks": self.transfer_fallbacks,
+            "tokens_out": self.tokens_out,
+            "hists": {
+                "ttft_ms": self.ttft_ms.state(),
+                "itl_ms": self.itl_ms.state(),
+                **{f"req_phase_ms.{ph}": h.state()
+                   for ph, h in self.phase_hists.items()},
+            },
+        }
+
+
 class ServeMetrics:
     """Aggregate + per-request serving metrics (thread-safe).
 
@@ -116,7 +181,8 @@ class ServeMetrics:
 
     def __init__(self, max_event_requests: int = 512,
                  gauge_prefix: str = "serve",
-                 max_events_per_request: int = 128):
+                 max_events_per_request: int = 128,
+                 max_version_cuts: int = 4):
         self._lock = threading.Lock()
         self.prefix = gauge_prefix
         self.max_events_per_request = max_events_per_request
@@ -124,6 +190,21 @@ class ServeMetrics:
             "submitted": 0, "rejected": 0, "admitted": 0, "done": 0,
             "cancelled": 0, "expired": 0,
         }
+        # request-FAILURE terminals (ISSUE 20): a finish with
+        # ``req.error`` set — watchdog cancels, transfer aborts,
+        # un-resumable evictions — as distinct from plain client
+        # cancels. Feeds the windowed error rate placement and the
+        # canary scorer read.
+        self.requests_failed = 0
+        # per-model_version metric cuts (ISSUE 20): bounded OrderedDict
+        # label → _VersionCut, oldest evicted (registry names dropped)
+        # beyond max_version_cuts — a long-lived server sees many
+        # versions but only blue/green are ever comparands.
+        self.version_label: Optional[str] = None
+        self._active_cut: Optional[_VersionCut] = None
+        self._version_cuts: "OrderedDict[str, _VersionCut]" = (
+            OrderedDict())
+        self._max_version_cuts = max(1, int(max_version_cuts))
         self.ttft_ms = register_histogram(
             f"{gauge_prefix}.ttft_ms", Histogram())
         self.queue_wait_ms = register_histogram(
@@ -265,25 +346,49 @@ class ServeMetrics:
 
     def on_first_token(self, req: Request) -> None:
         if req.ts_first_token is not None:
-            self.ttft_ms.observe(
-                (req.ts_first_token - req.ts_arrival) * 1e3
-            )
+            ttft = (req.ts_first_token - req.ts_arrival) * 1e3
+            self.ttft_ms.observe(ttft)
+            cut = self._active_cut
+            if cut is not None:
+                cut.ttft_ms.observe(ttft)
         self.event(req.id, "first_token")
 
     def on_finish(self, req: Request) -> None:
         key = {"done": "done", "cancelled": "cancelled",
                "expired": "expired"}.get(req.state.value)
         t = req.timing()
+        # failure terminal := finished WITH an error recorded — a
+        # watchdog cancel, transfer abort, un-resumable eviction —
+        # never a plain client cancel or a clean completion
+        failed = bool(req.error)
+        cut = self._active_cut
         with self._lock:
             if key:
                 self.counts[key] += 1
+            if failed:
+                self.requests_failed += 1
             self.tokens_out += len(req.tokens)
+            if cut is not None:
+                if failed:
+                    cut.requests_failed += 1
+                elif req.state.value == "done":
+                    cut.requests_done += 1
+                cut.tokens_out += len(req.tokens)
         if req.state.value == "done":
             if t["decode_ms"] is not None:
                 self.decode_ms.observe(t["decode_ms"])
             if t["e2e_ms"] is not None:
                 self.e2e_ms.observe(t["e2e_ms"])
         inc_counter(f"{self.prefix}.requests_{req.state.value}_total")
+        if failed:
+            inc_counter(f"{self.prefix}.requests_failed_total")
+        if cut is not None:
+            inc_counter(f"{cut.prefix}.requests_{req.state.value}_total")
+            if failed:
+                inc_counter(f"{cut.prefix}.requests_failed_total")
+            if req.tokens:
+                inc_counter(f"{cut.prefix}.tokens_out_total",
+                            len(req.tokens))
         self.event(req.id, "finish", state=req.state.value,
                    n_tokens=len(req.tokens), error=req.error, **t)
 
@@ -298,6 +403,10 @@ class ServeMetrics:
             hist.observe(ph[name])
         for name, hist in self.ttft_breakdown.items():
             hist.observe(ph[name])
+        cut = self._active_cut
+        if cut is not None:
+            for name, hist in cut.phase_hists.items():
+                hist.observe(ph[name])
 
     def on_segment(self, live_rows: int, slot_rows: int) -> None:
         with self._lock:
@@ -377,7 +486,11 @@ class ServeMetrics:
         request's previous token-producing boundary, over the
         ``n_new`` tokens this boundary emitted — observed as per-token
         ITL. Scheduler thread, once per (row, boundary): O(1)."""
-        self.itl_ms.observe(delta_ms / max(1, int(n_new)))
+        per_tok = delta_ms / max(1, int(n_new))
+        self.itl_ms.observe(per_tok)
+        cut = self._active_cut
+        if cut is not None:
+            cut.itl_ms.observe(per_tok)
 
     def on_prefill_chunk(self, bucket: int, tokens: int,
                          completed: bool) -> None:
@@ -450,11 +563,16 @@ class ServeMetrics:
         ``kv_transfer_crc_failures_total``; ``'timeout'``/``'abort'``
         (chain never arrived, prefill side broke) count only on the
         generic ``kv_transfer_failures_total``."""
+        cut = self._active_cut
         with self._lock:
             self.kv_transfer_failures += 1
+            if cut is not None:
+                cut.transfer_fallbacks += 1
         inc_counter(f"{self.prefix}.kv_transfer_failures_total")
         if kind == "verify":
             inc_counter(f"{self.prefix}.kv_transfer_crc_failures_total")
+        if cut is not None:
+            inc_counter(f"{cut.prefix}.kv_transfer_failures_total")
         self.event(f"-transfer-{transfer_id}-", "kv_transfer_failure",
                    error=error, kind=kind)
 
@@ -506,9 +624,79 @@ class ServeMetrics:
             step = version.get("step")
         set_gauge(f"{self.prefix}.model_version",
                   float(-1 if step is None else step))
+        self.set_version_cut(_safe_version_label(version))
         self.event("-deploy-", "model_version",
                    version=(version.get("label")
                             if isinstance(version, dict) else version))
+
+    def set_version_cut(self, label: Optional[str]) -> None:
+        """Point the per-version metric cut (ISSUE 20) at ``label`` —
+        every request-outcome hook from here on records into that
+        version's families too. ``None`` (versionless) disables
+        cutting. Cuts beyond ``max_version_cuts`` evict oldest-first,
+        dropping their registry names so a long-lived server's
+        registry stays bounded."""
+        with self._lock:
+            if label is None:
+                self.version_label = None
+                self._active_cut = None
+                return
+            cut = self._version_cuts.get(label)
+            if cut is None:
+                cut = _VersionCut(self.prefix, label)
+                self._version_cuts[label] = cut
+            else:
+                self._version_cuts.move_to_end(label)
+            evicted = []
+            while len(self._version_cuts) > self._max_version_cuts:
+                _, old = self._version_cuts.popitem(last=False)
+                evicted.append(old)
+            self.version_label = label
+            self._active_cut = cut
+        if evicted:
+            from tpuflow.obs.gauges import clear_gauges
+
+            for old in evicted:
+                clear_gauges(f"{old.prefix}.")
+
+    def version_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-version cumulative cuts: ``{label: {done, failed,
+        transfer_fallbacks, tokens_out, hists: {name: state}}}`` — the
+        comparand the canary scorer delta-differences per evaluation
+        window and the Router sums across replicas (ISSUE 20)."""
+        with self._lock:
+            cuts = list(self._version_cuts.values())
+        return {c.label: c.snapshot() for c in cuts}
+
+    def windowed_error_rate(self, window_s: Optional[float] = None):
+        """``(rate, errors, requests)`` over the default snapshot-ring
+        window (ISSUE 20 satellite): errors = request-failure
+        terminals + KV-transfer fallbacks, requests = done + failed.
+        Without a ticking ring this degrades to the cumulative view
+        (PR 5 semantics) — same keys, all-time values."""
+        from tpuflow.obs import timeseries
+
+        with self._lock:
+            cum = {
+                f"{self.prefix}.requests_failed_total":
+                    float(self.requests_failed),
+                f"{self.prefix}.kv_transfer_failures_total":
+                    float(self.kv_transfer_failures),
+                f"{self.prefix}.requests_done_total":
+                    float(self.counts["done"]),
+            }
+
+        def _inc(name: str) -> float:
+            d = timeseries.windowed_counter_increase(name, window_s)
+            return cum[name] if d is None else d
+
+        failed = _inc(f"{self.prefix}.requests_failed_total")
+        fallbacks = _inc(f"{self.prefix}.kv_transfer_failures_total")
+        done = _inc(f"{self.prefix}.requests_done_total")
+        errors = failed + fallbacks
+        requests = done + failed
+        return ((errors / requests if requests else 0.0),
+                errors, requests)
 
     def on_weight_swap(self, version, ms: float, *, draft: bool,
                        cleared_pages: int = 0) -> None:
@@ -624,6 +812,8 @@ class ServeMetrics:
                 f"{self.prefix}.{k}": float(v) for k, v in self.counts.items()
             }
             m[f"{self.prefix}.queue_depth"] = float(self.queue_depth)
+            m[f"{self.prefix}.requests_failed"] = float(
+                self.requests_failed)
             m[f"{self.prefix}.prefix_hits"] = float(self.prefix_hits)
             m[f"{self.prefix}.prefix_misses"] = float(self.prefix_misses)
             m[f"{self.prefix}.prefix_hit_rate"] = (

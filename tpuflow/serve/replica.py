@@ -176,6 +176,11 @@ class InProcessReplica(Replica):
     def load_snapshot(self) -> Dict[str, Any]:
         return self.sched.load_snapshot()
 
+    def version_snapshot(self) -> Dict[str, Any]:
+        """Per-version metric cuts (ISSUE 20) — the canary scorer's
+        comparand."""
+        return self.sched.version_snapshot()
+
     def readiness(self) -> Dict[str, Any]:
         return self.sched.readiness()
 
@@ -616,6 +621,15 @@ class HTTPReplica(Replica):
     # ---- sensors -----------------------------------------------------
     def load_snapshot(self) -> Dict[str, Any]:
         return self._get_json("/v1/worker/load_snapshot")
+
+    def version_snapshot(self) -> Dict[str, Any]:
+        """Per-version metric cuts (ISSUE 20); an unreachable worker
+        contributes nothing to the tier aggregate rather than failing
+        the canary read."""
+        try:
+            return self._get_json("/v1/worker/version_snapshot")
+        except Exception:
+            return {}
 
     def readiness(self) -> Dict[str, Any]:
         conn, resp = self._open("GET", "/readyz", None,

@@ -2474,6 +2474,60 @@ class Router:
                 self.counts.get("snapshot_errors", 0))
             out["health_lagged"] = int(
                 self.counts.get("health_lagged", 0))
+        # tier windowed error rate (ISSUE 20): request-weighted sum of
+        # the per-replica windowed sensors — an LB (or the canary
+        # scorer) sees an error SPIKE, not a cumulative average
+        errs = sum(float(s.get("errors_windowed", 0) or 0)
+                   for s in per.values())
+        reqs = sum(float(s.get("requests_windowed", 0) or 0)
+                   for s in per.values())
+        out["error_rate"] = round(errs / reqs, 6) if reqs else 0.0
+        out["errors_windowed"] = errs
+        out["requests_windowed"] = reqs
+        return out
+
+    def version_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Tier-level per-version metric cuts (ISSUE 20): each live
+        replica's :meth:`version_snapshot` summed per version label —
+        counters add, histogram states add bucket-wise — so blue and
+        green are directly comparable mid-rollout no matter how the
+        router spread their traffic. Replicas without the sensor
+        (duck-typed fakes, old workers) contribute nothing."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for i in self._live_indices():
+            fetch = getattr(self.replicas[i], "version_snapshot", None)
+            if fetch is None:
+                continue
+            try:
+                snap = fetch()
+            except Exception:
+                continue
+            for label, cut in (snap or {}).items():
+                agg = out.get(label)
+                if agg is None:
+                    out[label] = {
+                        k: (dict((hn, dict(hs))
+                                 for hn, hs in v.items())
+                            if k == "hists" else v)
+                        for k, v in cut.items()
+                    }
+                    continue
+                for k, v in cut.items():
+                    if k == "hists":
+                        for hn, hs in v.items():
+                            cur = agg["hists"].get(hn)
+                            if cur is None:
+                                agg["hists"][hn] = dict(hs)
+                                continue
+                            cur["counts"] = [
+                                a + b for a, b in zip(cur["counts"],
+                                                      hs["counts"])]
+                            cur["n"] = cur["n"] + hs["n"]
+                            cur["total"] = cur["total"] + hs["total"]
+                            cur["vmin"] = min(cur["vmin"], hs["vmin"])
+                            cur["vmax"] = max(cur["vmax"], hs["vmax"])
+                    else:
+                        agg[k] = agg.get(k, 0) + v
         return out
 
     # ---- tier trace collection (ISSUE 19) ---------------------------

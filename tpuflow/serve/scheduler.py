@@ -2011,7 +2011,31 @@ class ServeScheduler:
             phases[ph] = pcts.get("p95")
         if phases:
             out["phase_ms_p95"] = phases
+        # windowed error rate (ISSUE 20): failure terminals + transfer
+        # fallbacks per trailing window — a long-healthy replica's
+        # error SPIKE is visible to placement and the canary scorer,
+        # not buried under its cumulative history (degrades to
+        # cumulative without a ticking ring, PR 5 semantics)
+        rate, errors, requests = self.metrics.windowed_error_rate()
+        out["error_rate"] = round(rate, 6)
+        out["errors_windowed"] = errors
+        out["requests_windowed"] = requests
+        # SLO verdicts (ISSUE 20): the process default evaluator's
+        # compact view, cached — never a delta walk per placement
+        from tpuflow.obs import slo as _slo
+
+        ev = _slo.default_evaluator()
+        if ev is not None:
+            out["slo"] = ev.verdicts_compact()
         return out
+
+    def version_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-``model_version`` cumulative metric cuts (ISSUE 20):
+        counters + raw histogram states per version label — what the
+        canary scorer delta-differences to compare blue vs green
+        mid-rollout. Plain dicts off the metrics plane; safe from any
+        thread."""
+        return self.metrics.version_snapshot()
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop the loop. ``drain=True`` serves out queued+running work
